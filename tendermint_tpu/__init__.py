@@ -1,0 +1,16 @@
+"""tendermint-tpu: a TPU-native BFT state-machine-replication framework.
+
+A from-scratch rebuild of the capabilities of Tendermint Core (the reference
+at joeabbey/tendermint): BFT consensus over an arbitrary deterministic
+application (ABCI), p2p gossip networking, block/state sync, light clients,
+and remote signers — with the signature-verification and merkle-hashing hot
+paths executed as batched JAX/XLA programs on TPU, gated behind the same
+plugin boundary the reference uses (crypto.BatchVerifier,
+reference: crypto/crypto.go:53-61).
+"""
+
+from .version import __version__  # noqa: F401
+
+TM_CORE_SEMVER = "0.35.0"
+P2P_PROTOCOL = 8
+BLOCK_PROTOCOL = 11

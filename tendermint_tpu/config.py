@@ -1,0 +1,256 @@
+"""Node configuration.
+
+Mirrors the reference's master Config struct and sections (reference:
+config/config.go:61-74 — Base, RPC, P2P, Mempool, StateSync, Consensus,
+TxIndex, Instrumentation, PrivValidator) with TOML persistence via stdlib
+tomllib for reads and a template writer for `init`.
+
+Consensus timeouts follow config/config.go:923-939 (propose/prevote/
+precommit + deltas, timeout-commit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = [
+    "Config",
+    "BaseConfig",
+    "RPCConfig",
+    "P2PConfig",
+    "MempoolConfig",
+    "StateSyncConfig",
+    "BlockSyncConfig",
+    "ConsensusConfig",
+    "TxIndexConfig",
+    "InstrumentationConfig",
+    "PrivValidatorConfig",
+    "TPUConfig",
+    "load_config",
+    "write_config",
+]
+
+MODE_VALIDATOR = "validator"
+MODE_FULL = "full"
+MODE_SEED = "seed"
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    moniker: str = "anonymous"
+    mode: str = MODE_VALIDATOR
+    home: str = "~/.tendermint_tpu"
+    db_backend: str = "sqlite"  # sqlite | memdb
+    db_dir: str = "data"
+    log_level: str = "info"
+    log_format: str = "plain"
+    genesis_file: str = "config/genesis.json"
+    node_key_file: str = "config/node_key.json"
+    abci: str = "builtin"  # builtin | socket
+    proxy_app: str = "kvstore"
+
+    def root(self) -> str:
+        return os.path.expanduser(self.home)
+
+    def path(self, rel: str) -> str:
+        return os.path.join(self.root(), rel)
+
+
+@dataclass
+class PrivValidatorConfig:
+    key_file: str = "config/priv_validator_key.json"
+    state_file: str = "data/priv_validator_state.json"
+    listen_addr: str = ""  # non-empty => remote signer
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit: float = 10.0
+    max_body_bytes: int = 1_000_000
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    persistent_peers: str = ""
+    bootstrap_peers: str = ""
+    max_connections: int = 64
+    max_incoming_connection_attempts: int = 100
+    send_rate: int = 5_120_000
+    recv_rate: int = 5_120_000
+    pex: bool = True
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+    queue_type: str = "priority"  # fifo | priority
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    size: int = 5000
+    max_txs_bytes: int = 1 << 30
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1 << 20
+    ttl_duration: float = 0.0  # seconds; 0 = no TTL
+    ttl_num_blocks: int = 0
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: list[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period: float = 168 * 3600.0
+    discovery_time: float = 15.0
+    chunk_request_timeout: float = 15.0
+    fetchers: int = 4
+
+
+@dataclass
+class BlockSyncConfig:
+    enable: bool = True
+
+
+@dataclass
+class ConsensusConfig:
+    wal_file: str = "data/cs.wal/wal"
+    # Reference defaults, config/config.go:923-939 (milliseconds there).
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+    double_sign_check_height: int = 0
+
+    def propose_timeout(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote_timeout(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit_timeout(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: list[str] = field(default_factory=lambda: ["kv"])  # kv | null
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    namespace: str = "tendermint_tpu"
+
+
+@dataclass
+class TPUConfig:
+    """Device-offload knobs — no analog in the reference; this gates the
+    TPU-backed BatchVerifier and merkle kernels (the north-star seam,
+    reference: crypto/crypto.go:53-61)."""
+
+    enable: bool = True
+    min_batch_size: int = 8  # below this, CPU single-verify wins
+    bucket_sizes: list[int] = field(
+        default_factory=lambda: [8, 32, 128, 512, 2048, 8192, 16384]
+    )
+    donate_buffers: bool = True
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    priv_validator: PrivValidatorConfig = field(default_factory=PrivValidatorConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig
+    )
+    tpu: TPUConfig = field(default_factory=TPUConfig)
+
+    def ensure_dirs(self) -> None:
+        root = self.base.root()
+        for sub in ("config", "data", os.path.dirname(self.consensus.wal_file)):
+            os.makedirs(os.path.join(root, sub), exist_ok=True)
+
+
+_SECTIONS = {
+    "base": BaseConfig,
+    "priv_validator": PrivValidatorConfig,
+    "rpc": RPCConfig,
+    "p2p": P2PConfig,
+    "mempool": MempoolConfig,
+    "statesync": StateSyncConfig,
+    "blocksync": BlockSyncConfig,
+    "consensus": ConsensusConfig,
+    "tx_index": TxIndexConfig,
+    "instrumentation": InstrumentationConfig,
+    "tpu": TPUConfig,
+}
+
+
+def load_config(path: str) -> Config:
+    import tomllib
+
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    cfg = Config()
+    for section, cls in _SECTIONS.items():
+        data = raw.get(section, {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        setattr(
+            cfg, section, cls(**{k: v for k, v in data.items() if k in known})
+        )
+    return cfg
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    raise TypeError(f"unsupported TOML value: {v!r}")
+
+
+def write_config(cfg: Config, path: str) -> None:
+    lines = ["# tendermint-tpu node configuration", ""]
+    for section in _SECTIONS:
+        obj = getattr(cfg, section)
+        lines.append(f"[{section}]")
+        for f in dataclasses.fields(obj):
+            lines.append(f"{f.name} = {_toml_value(getattr(obj, f.name))}")
+        lines.append("")
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))

@@ -1,0 +1,125 @@
+"""Thread-safe-enough bit array used by VoteSet / PartSet / blocksync
+bookkeeping (reference: libs/bits/bit_array.go). Backed by a Python int
+(arbitrary precision) rather than []uint64 — same observable semantics,
+including the proto form (bits count + little-endian uint64 words).
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Iterator, List, Optional
+
+__all__ = ["BitArray"]
+
+
+class BitArray:
+    __slots__ = ("size", "_bits")
+
+    def __init__(self, size: int) -> None:
+        if size < 0:
+            raise ValueError("negative size")
+        self.size = size
+        self._bits = 0
+
+    # -- element access --
+
+    def get(self, i: int) -> bool:
+        if i < 0 or i >= self.size:
+            return False
+        return bool(self._bits >> i & 1)
+
+    def set(self, i: int, value: bool = True) -> bool:
+        if i < 0 or i >= self.size:
+            return False
+        if value:
+            self._bits |= 1 << i
+        else:
+            self._bits &= ~(1 << i)
+        return True
+
+    # -- set algebra (sizes may differ; result is sized like self, matching
+    # the reference's Or/And behavior of max/min sizing kept simple) --
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        out = BitArray(max(self.size, other.size))
+        out._bits = self._bits | other._bits
+        return out
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        out = BitArray(min(self.size, other.size))
+        out._bits = self._bits & other._bits & ((1 << out.size) - 1)
+        return out
+
+    def not_(self) -> "BitArray":
+        out = BitArray(self.size)
+        out._bits = ~self._bits & ((1 << self.size) - 1)
+        return out
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        out = BitArray(self.size)
+        out._bits = self._bits & ~other._bits & ((1 << self.size) - 1)
+        return out
+
+    def update(self, other: "BitArray") -> None:
+        """Copy other's bits into self (sized to self)."""
+        self._bits = other._bits & ((1 << self.size) - 1)
+
+    # -- queries --
+
+    def is_empty(self) -> bool:
+        return self._bits == 0
+
+    def is_full(self) -> bool:
+        return self.size > 0 and self._bits == (1 << self.size) - 1
+
+    def count(self) -> int:
+        return self._bits.bit_count()
+
+    def indices(self) -> Iterator[int]:
+        bits = self._bits
+        i = 0
+        while bits:
+            if bits & 1:
+                yield i
+            bits >>= 1
+            i += 1
+
+    def pick_random(self) -> Optional[int]:
+        """Return a uniformly random set index, or None if empty
+        (reference: libs/bits/bit_array.go PickRandom — used to choose which
+        block part / vote to gossip next)."""
+        idxs = list(self.indices())
+        if not idxs:
+            return None
+        return idxs[secrets.randbelow(len(idxs))]
+
+    def copy(self) -> "BitArray":
+        out = BitArray(self.size)
+        out._bits = self._bits
+        return out
+
+    # -- wire form --
+
+    def to_words(self) -> List[int]:
+        n_words = (self.size + 63) // 64
+        return [(self._bits >> (64 * w)) & ((1 << 64) - 1) for w in range(n_words)]
+
+    @classmethod
+    def from_words(cls, size: int, words: List[int]) -> "BitArray":
+        out = cls(size)
+        bits = 0
+        for w, word in enumerate(words):
+            bits |= word << (64 * w)
+        out._bits = bits & ((1 << size) - 1) if size else 0
+        return out
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, BitArray)
+            and self.size == other.size
+            and self._bits == other._bits
+        )
+
+    def __repr__(self) -> str:
+        s = "".join("x" if self.get(i) else "_" for i in range(min(self.size, 64)))
+        return f"BA{{{self.size}:{s}}}"

@@ -1,0 +1,119 @@
+"""Service lifecycle primitives.
+
+Every long-running component in the framework (reactors, routers, the
+consensus machine, RPC servers) follows one lifecycle contract, mirroring the
+reference's service.Service (reference: libs/service/service.go:24-49):
+start-once, stop-once, wait-for-termination. Ours is asyncio-native: a
+Service owns a set of tasks which are cancelled on stop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Coroutine, Optional
+
+from .log import Logger, get_logger
+
+__all__ = ["Service", "ServiceError"]
+
+
+class ServiceError(Exception):
+    pass
+
+
+class Service:
+    """Base class for long-running components.
+
+    Subclasses override `on_start` (spawn tasks via `self.spawn`) and
+    optionally `on_stop` (cleanup before task cancellation).
+    """
+
+    def __init__(self, name: str = "", logger: Optional[Logger] = None) -> None:
+        self.name = name or type(self).__name__
+        self.logger = logger or get_logger(self.name)
+        self._started = False
+        self._stopped = False
+        self._tasks: list[asyncio.Task] = []
+        self._pending_stop: Optional[asyncio.Task] = None
+        self._done = asyncio.Event()
+
+    # -- lifecycle --
+
+    @property
+    def is_running(self) -> bool:
+        return self._started and not self._stopped
+
+    async def start(self) -> None:
+        if self._started:
+            raise ServiceError(f"{self.name}: already started")
+        if self._stopped:
+            raise ServiceError(f"{self.name}: already stopped; cannot restart")
+        self._started = True
+        self.logger.info("starting service")
+        try:
+            await self.on_start()
+        except Exception:
+            self._stopped = True
+            await self._cancel_tasks()
+            self._done.set()
+            raise
+
+    async def stop(self) -> None:
+        if not self._started or self._stopped:
+            if self._stopped:
+                # A concurrent stop() is (or was) draining tasks; don't
+                # return until teardown actually finished.
+                await self._done.wait()
+            return
+        self._stopped = True
+        self.logger.info("stopping service")
+        try:
+            await self.on_stop()
+        finally:
+            await self._cancel_tasks()
+            self._done.set()
+
+    async def _cancel_tasks(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        # return_exceptions keeps a cancellation of stop() itself
+        # propagating while swallowing the tasks' own CancelledErrors.
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+    async def wait(self) -> None:
+        """Block until the service has fully stopped."""
+        await self._done.wait()
+
+    def spawn(self, coro: Coroutine, name: str = "") -> asyncio.Task:
+        """Spawn a task owned by this service; cancelled on stop. Uncaught
+        exceptions stop the service (fail-fast, like the reference's
+        consensus panic-on-error policy, internal/consensus/state.go:820)."""
+        task = asyncio.get_event_loop().create_task(
+            self._run_guarded(coro, name or self.name)
+        )
+        self._tasks.append(task)
+        return task
+
+    async def _run_guarded(self, coro: Coroutine, name: str) -> None:
+        try:
+            await coro
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.logger.exception(f"task {name} failed")
+            # Detach to avoid self-await deadlock during stop(); hold a
+            # strong reference so the stop task can't be GC'd before it runs.
+            stop_task = asyncio.get_event_loop().create_task(self.stop())
+            self._pending_stop = stop_task
+            stop_task.add_done_callback(
+                lambda _t: setattr(self, "_pending_stop", None)
+            )
+
+    # -- overridables --
+
+    async def on_start(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    async def on_stop(self) -> None:  # pragma: no cover - trivial default
+        pass

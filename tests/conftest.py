@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so sharding/collective code paths
+(`tendermint_tpu.parallel`) are exercised without TPU hardware. This must be
+set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_home(tmp_path):
+    from tendermint_tpu.config import Config
+
+    cfg = Config()
+    cfg.base.home = str(tmp_path)
+    cfg.ensure_dirs()
+    return cfg

@@ -1,0 +1,107 @@
+import asyncio
+
+import pytest
+
+from tendermint_tpu.libs.bits import BitArray
+from tendermint_tpu.libs.service import Service, ServiceError
+
+
+def test_bitarray_basic():
+    ba = BitArray(10)
+    assert ba.is_empty() and not ba.is_full()
+    assert ba.set(3) and ba.set(9)
+    assert not ba.set(10)  # out of range
+    assert ba.get(3) and not ba.get(4)
+    assert ba.count() == 2
+    assert list(ba.indices()) == [3, 9]
+    assert ba.pick_random() in (3, 9)
+
+
+def test_bitarray_algebra():
+    a, b = BitArray(8), BitArray(8)
+    a.set(1), a.set(2)
+    b.set(2), b.set(3)
+    assert list(a.or_(b).indices()) == [1, 2, 3]
+    assert list(a.and_(b).indices()) == [2]
+    assert list(a.sub(b).indices()) == [1]
+    assert a.not_().count() == 6
+    full = BitArray(4)
+    for i in range(4):
+        full.set(i)
+    assert full.is_full()
+
+
+def test_bitarray_words_roundtrip():
+    ba = BitArray(130)
+    for i in (0, 63, 64, 129):
+        ba.set(i)
+    again = BitArray.from_words(130, ba.to_words())
+    assert again == ba
+
+
+class _Svc(Service):
+    def __init__(self):
+        super().__init__("test")
+        self.ticks = 0
+
+    async def on_start(self):
+        self.spawn(self._tick())
+
+    async def _tick(self):
+        while True:
+            self.ticks += 1
+            await asyncio.sleep(0.01)
+
+
+def test_service_lifecycle():
+    async def run():
+        svc = _Svc()
+        await svc.start()
+        assert svc.is_running
+        with pytest.raises(ServiceError):
+            await svc.start()
+        await asyncio.sleep(0.05)
+        await svc.stop()
+        assert not svc.is_running
+        await svc.wait()
+        assert svc.ticks >= 2
+        with pytest.raises(ServiceError):
+            await svc.start()  # no restart
+
+    asyncio.run(run())
+
+
+def test_service_task_failure_stops_service():
+    async def run():
+        class Bad(Service):
+            async def on_start(self):
+                self.spawn(self._boom())
+
+            async def _boom(self):
+                raise RuntimeError("boom")
+
+        svc = Bad("bad")
+        await svc.start()
+        for _ in range(50):
+            if not svc.is_running:
+                break
+            await asyncio.sleep(0.01)
+        assert not svc.is_running
+
+    asyncio.run(run())
+
+
+def test_config_roundtrip(tmp_path):
+    from tendermint_tpu.config import Config, load_config, write_config
+
+    cfg = Config()
+    cfg.base.chain_id = "test-chain"
+    cfg.consensus.timeout_propose = 1.25
+    cfg.tpu.bucket_sizes = [4, 16]
+    path = str(tmp_path / "config" / "config.toml")
+    write_config(cfg, path)
+    loaded = load_config(path)
+    assert loaded.base.chain_id == "test-chain"
+    assert loaded.consensus.timeout_propose == 1.25
+    assert loaded.tpu.bucket_sizes == [4, 16]
+    assert loaded.consensus.propose_timeout(2) == 1.25 + 2 * 0.5
