@@ -1,1 +1,24 @@
+"""Crypto layer: keys, signatures, hashing, merkle trees, batch verification.
 
+The public surface mirrors the reference's crypto package family
+(crypto/, crypto/batch, crypto/merkle, crypto/tmhash) with a TPU offload
+seam behind BatchVerifier (see tendermint_tpu.crypto.tpu_verifier).
+"""
+
+from .keys import (  # noqa: F401
+    Address,
+    BatchVerifier,
+    PrivKey,
+    PubKey,
+    address_hash,
+    pubkey_from_proto,
+    pubkey_from_type_and_bytes,
+    pubkey_to_proto,
+)
+from .ed25519 import (  # noqa: F401
+    Ed25519BatchVerifier,
+    PrivKeyEd25519,
+    PubKeyEd25519,
+)
+from .secp256k1 import PrivKeySecp256k1, PubKeySecp256k1  # noqa: F401
+from . import batch, merkle, tmhash  # noqa: F401

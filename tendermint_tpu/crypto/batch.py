@@ -1,0 +1,85 @@
+"""Batch-verifier dispatch — the offload decision point.
+
+Mirrors crypto/batch/batch.go:11-33 (CreateBatchVerifier /
+SupportsBatchVerifier switching on key type) and extends it with the
+device registry: when a TPU/accelerator backend has been registered (see
+tendermint_tpu.crypto.tpu_verifier.install) and the caller hints a large
+enough batch, the returned verifier runs on device. CPU remains the
+default, exactly like the reference keeps pure-Go as the default.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .keys import BatchVerifier, PubKey
+
+__all__ = [
+    "create_batch_verifier",
+    "supports_batch_verifier",
+    "register_device_factory",
+    "device_factory_installed",
+]
+
+# key type -> CPU batch verifier factory
+_CPU_FACTORIES: dict[str, Callable[[], BatchVerifier]] = {}
+# key type -> device batch verifier factory (size_hint -> verifier or None)
+_DEVICE_FACTORIES: dict[
+    str, Callable[[int], Optional[BatchVerifier]]
+] = {}
+
+
+def register_cpu_factory(
+    key_type: str, factory: Callable[[], BatchVerifier]
+) -> None:
+    _CPU_FACTORIES[key_type] = factory
+
+
+def register_device_factory(
+    key_type: str, factory: Callable[[int], Optional[BatchVerifier]]
+) -> None:
+    _DEVICE_FACTORIES[key_type] = factory
+
+
+def device_factory_installed(key_type: str) -> bool:
+    return key_type in _DEVICE_FACTORIES
+
+
+def supports_batch_verifier(pk: Optional[PubKey]) -> bool:
+    return pk is not None and pk.type() in _CPU_FACTORIES
+
+
+def create_batch_verifier(
+    pk: PubKey, size_hint: int = 0
+) -> BatchVerifier:
+    """Return the best available batch verifier for this key type.
+
+    size_hint is the expected number of add() calls (a Commit's signature
+    count); device backends use it to pick a padded bucket shape and may
+    decline small batches (returning None → CPU fallback).
+    """
+    key_type = pk.type()
+    dev = _DEVICE_FACTORIES.get(key_type)
+    if dev is not None:
+        verifier = dev(size_hint)
+        if verifier is not None:
+            return verifier
+    cpu = _CPU_FACTORIES.get(key_type)
+    if cpu is None:
+        raise ValueError(f"key type {key_type!r} does not support batching")
+    return cpu()
+
+
+def _register_defaults() -> None:
+    from .ed25519 import KEY_TYPE as ED, Ed25519BatchVerifier
+
+    register_cpu_factory(ED, Ed25519BatchVerifier)
+    try:
+        from .sr25519 import KEY_TYPE as SR, Sr25519BatchVerifier
+
+        register_cpu_factory(SR, Sr25519BatchVerifier)
+    except ImportError:  # sr25519 backend optional
+        pass
+
+
+_register_defaults()

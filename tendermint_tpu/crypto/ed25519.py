@@ -1,0 +1,151 @@
+"""ed25519 keys with ZIP-215 verification semantics.
+
+Behavioral parity with the reference's crypto/ed25519 package
+(reference: crypto/ed25519/ed25519.go): 32-byte public keys, 64-byte
+signatures, address = sha256(pubkey)[:20], ZIP-215 verification so single
+and batch verification can never disagree (ed25519.go:27-29).
+
+Fast path: OpenSSL (via the `cryptography` wheel) for signing and strict
+verification. Any signature OpenSSL accepts is also ZIP-215-valid
+(cofactorless acceptance implies cofactored acceptance, and OpenSSL only
+accepts canonical encodings, a subset of ZIP-215's); on OpenSSL rejection we
+re-check with the pure-Python ZIP-215 oracle to catch the edge cases
+(non-canonical A/R encodings, mixed-cofactor components) that ZIP-215
+deliberately accepts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    NoEncryption,
+    PrivateFormat,
+    PublicFormat,
+)
+
+from . import ed25519_math
+from .keys import (
+    Address,
+    BatchVerifier,
+    PrivKey,
+    PubKey,
+    address_hash,
+    register_key_type,
+)
+
+__all__ = ["PubKeyEd25519", "PrivKeyEd25519", "Ed25519BatchVerifier", "KEY_TYPE"]
+
+KEY_TYPE = "ed25519"
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 64  # seed || pubkey, matching the Go ed25519 layout
+SIGNATURE_SIZE = 64
+JSON_PUBKEY_NAME = "tendermint/PubKeyEd25519"
+JSON_PRIVKEY_NAME = "tendermint/PrivKeyEd25519"
+
+
+class PubKeyEd25519(PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes) -> None:
+        if len(data) != PUBKEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def address(self) -> Address:
+        return address_hash(self._bytes)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            Ed25519PublicKey.from_public_bytes(self._bytes).verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            # OpenSSL is stricter than ZIP-215; consult the oracle.
+            return ed25519_math.zip215_verify(self._bytes, msg, sig)
+
+
+class PrivKeyEd25519(PrivKey):
+    __slots__ = ("_seed", "_pub")
+
+    def __init__(self, data: bytes) -> None:
+        if len(data) == PRIVKEY_SIZE:
+            seed = data[:32]
+        elif len(data) == 32:
+            seed = data
+        else:
+            raise ValueError("ed25519 privkey must be 32 or 64 bytes")
+        self._seed = bytes(seed)
+        sk = Ed25519PrivateKey.from_private_bytes(self._seed)
+        self._pub = sk.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw
+        )
+
+    @classmethod
+    def generate(cls) -> "PrivKeyEd25519":
+        sk = Ed25519PrivateKey.generate()
+        return cls(
+            sk.private_bytes(Encoding.Raw, PrivateFormat.Raw, NoEncryption())
+        )
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "PrivKeyEd25519":
+        return cls(seed)
+
+    def bytes(self) -> bytes:
+        # 64-byte seed||pub layout like Go's ed25519.PrivateKey
+        return self._seed + self._pub
+
+    def sign(self, msg: bytes) -> bytes:
+        return Ed25519PrivateKey.from_private_bytes(self._seed).sign(msg)
+
+    def pub_key(self) -> PubKey:
+        return PubKeyEd25519(self._pub)
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+class Ed25519BatchVerifier(BatchVerifier):
+    """CPU batch verifier: sequential ZIP-215-semantics verification.
+
+    Matches the reference CPU behavior (crypto/ed25519/ed25519.go:202-237
+    wraps curve25519-voi's batch verifier); the TPU implementation lives in
+    tendermint_tpu.crypto.tpu_verifier and is selected by crypto.batch when
+    a device is available and the batch is large enough.
+    """
+
+    def __init__(self) -> None:
+        self._items: List[Tuple[PubKeyEd25519, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, message: bytes, signature: bytes) -> None:
+        if not isinstance(pub_key, PubKeyEd25519):
+            raise TypeError("Ed25519BatchVerifier requires ed25519 keys")
+        if len(signature) != SIGNATURE_SIZE:
+            raise ValueError("malformed signature size")
+        self._items.append((pub_key, bytes(message), bytes(signature)))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        if not self._items:
+            return False, []
+        bitmap = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
+        return all(bitmap), bitmap
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+register_key_type(KEY_TYPE, PubKeyEd25519, proto_field=1)

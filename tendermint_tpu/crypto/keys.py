@@ -1,0 +1,141 @@
+"""Key and batch-verifier interfaces — the plugin boundary.
+
+Mirrors the semantics of the reference's crypto.PubKey / crypto.PrivKey /
+crypto.BatchVerifier interfaces (reference: crypto/crypto.go:23-61). The
+BatchVerifier contract is the seam the whole TPU offload hangs on:
+
+    add(pubkey, message, signature) -> None   (queue; may raise on bad input)
+    verify() -> (all_ok: bool, per_item: list[bool])
+
+`verify()` must report exactly which indices failed — consensus uses the
+bitmap to attribute invalid signatures to validators
+(reference: types/validation.go:240-249).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from abc import ABC, abstractmethod
+from typing import List, Tuple
+
+__all__ = [
+    "PubKey",
+    "PrivKey",
+    "BatchVerifier",
+    "Address",
+    "address_hash",
+    "register_key_type",
+    "pubkey_from_type_and_bytes",
+    "pubkey_to_proto",
+    "pubkey_from_proto",
+]
+
+ADDRESS_SIZE = 20  # tmhash truncated size (reference: crypto/crypto.go:11-19)
+
+Address = bytes
+
+
+def address_hash(data: bytes) -> Address:
+    """sha256(data)[:20] (reference: crypto/crypto.go AddressHash)."""
+    return hashlib.sha256(data).digest()[:ADDRESS_SIZE]
+
+
+class PubKey(ABC):
+    @abstractmethod
+    def address(self) -> Address: ...
+
+    @abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abstractmethod
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool: ...
+
+    @abstractmethod
+    def type(self) -> str: ...
+
+    def equals(self, other: "PubKey") -> bool:
+        return self.type() == other.type() and self.bytes() == other.bytes()
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, PubKey) and self.equals(other)
+
+    def __hash__(self) -> int:
+        return hash((self.type(), self.bytes()))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.bytes().hex()[:16]}…)"
+
+
+class PrivKey(ABC):
+    @abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+    @abstractmethod
+    def pub_key(self) -> PubKey: ...
+
+    @abstractmethod
+    def type(self) -> str: ...
+
+
+class BatchVerifier(ABC):
+    """Accumulate (pk, msg, sig) triples, verify all at once.
+
+    Implementations: CPU per-curve batchers and the TPU-backed verifier in
+    tendermint_tpu.crypto.tpu_verifier. Semantics of verify() follow
+    reference crypto/crypto.go:53-61: returns (every sig valid, bitmap). The
+    bitmap has one entry per add() in order.
+    """
+
+    @abstractmethod
+    def add(self, pub_key: PubKey, message: bytes, signature: bytes) -> None: ...
+
+    @abstractmethod
+    def verify(self) -> Tuple[bool, List[bool]]: ...
+
+    def __len__(self) -> int:  # number of queued items; override if cheap
+        raise NotImplementedError
+
+
+# -- key type registry (reference: crypto/encoding/codec.go + jsontypes) --
+
+_KEY_TYPES: dict[str, type] = {}
+_PROTO_FIELD: dict[str, int] = {}  # key type -> PublicKey oneof field number
+
+
+def register_key_type(key_type: str, pubkey_cls: type, proto_field: int) -> None:
+    _KEY_TYPES[key_type] = pubkey_cls
+    _PROTO_FIELD[key_type] = proto_field
+
+
+def pubkey_from_type_and_bytes(key_type: str, data: bytes) -> PubKey:
+    cls = _KEY_TYPES.get(key_type)
+    if cls is None:
+        raise ValueError(f"unknown key type {key_type!r}")
+    return cls(data)
+
+
+def pubkey_to_proto(pk: PubKey) -> bytes:
+    """Encode as tendermint.crypto.PublicKey (oneof: ed25519=1,
+    secp256k1=2, sr25519=3 — reference: proto/tendermint/crypto/keys.pb.go).
+    Used verbatim in validator-set hashing (types/validator.go:130)."""
+    from ..encoding.proto import ProtoWriter
+
+    field = _PROTO_FIELD.get(pk.type())
+    if field is None:
+        raise ValueError(f"key type {pk.type()!r} has no proto mapping")
+    w = ProtoWriter()
+    w.bytes(field, pk.bytes())
+    return w.finish()
+
+
+def pubkey_from_proto(data: bytes) -> PubKey:
+    from ..encoding.proto import iter_fields
+
+    for field, _wt, value in iter_fields(data):
+        for key_type, f in _PROTO_FIELD.items():
+            if f == field:
+                return pubkey_from_type_and_bytes(key_type, value)
+    raise ValueError("PublicKey proto has no recognized key")

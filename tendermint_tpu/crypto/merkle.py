@@ -1,0 +1,262 @@
+"""RFC-6962-style SHA-256 merkle trees and proofs.
+
+Behavioral parity with the reference's crypto/merkle package: 0x00/0x01
+leaf/inner domain separation (crypto/merkle/hash.go:21,34), split point at
+the largest power of two < n (crypto/merkle/tree.go:94), empty-tree hash =
+sha256("") (hash.go:16), Proof verification with aunts ordered bottom-up
+(crypto/merkle/proof.go:52,71), and multi-op ProofOperators chaining
+(crypto/merkle/proof_op.go).
+
+The batched/device variant of root computation and proof verification lives
+in tendermint_tpu.ops.merkle_kernel; this module is the canonical CPU
+implementation and oracle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+__all__ = [
+    "hash_from_byte_slices",
+    "proofs_from_byte_slices",
+    "Proof",
+    "ProofOp",
+    "ProofOperators",
+    "ValueOp",
+    "leaf_hash",
+    "inner_hash",
+    "empty_hash",
+]
+
+_LEAF_PREFIX = b"\x00"
+_INNER_PREFIX = b"\x01"
+
+
+def empty_hash() -> bytes:
+    return hashlib.sha256(b"").digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return hashlib.sha256(_LEAF_PREFIX + leaf).digest()
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(_INNER_PREFIX + left + right).digest()
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n."""
+    if n < 2:
+        raise ValueError("n must be >= 2")
+    return 1 << ((n - 1).bit_length() - 1)
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    """Merkle root of the list (same tree shape as the reference's
+    recursive definition, crypto/merkle/tree.go:11-66)."""
+    if not items:
+        return empty_hash()
+    return _reduce([leaf_hash(it) for it in items])
+
+
+def _reduce(hashes: List[bytes]) -> bytes:
+    if len(hashes) == 1:
+        return hashes[0]
+    k = _split_point(len(hashes))
+    return inner_hash(_reduce(hashes[:k]), _reduce(hashes[k:]))
+
+
+@dataclass
+class Proof:
+    """Merkle inclusion proof (reference: crypto/merkle/proof.go:27-38)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes] = field(default_factory=list)
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> None:
+        if self.total < 0:
+            raise ValueError("proof total must be positive")
+        if self.index < 0:
+            raise ValueError("proof index cannot be negative")
+        lh = leaf_hash(leaf)
+        if lh != self.leaf_hash:
+            raise ValueError("invalid leaf hash")
+        computed = self.compute_root_hash()
+        if computed != root_hash:
+            raise ValueError("invalid root hash")
+
+    def compute_root_hash(self) -> Optional[bytes]:
+        return _compute_hash_from_aunts(
+            self.index, self.total, self.leaf_hash, self.aunts
+        )
+
+    # proto form (reference: proto/tendermint/crypto/proof.pb.go Proof)
+    def to_proto_bytes(self) -> bytes:
+        from ..encoding.proto import ProtoWriter
+
+        w = ProtoWriter()
+        w.int(1, self.total)
+        w.int(2, self.index)
+        w.bytes(3, self.leaf_hash)
+        for aunt in self.aunts:
+            w.bytes(4, aunt)
+        return w.finish()
+
+    @classmethod
+    def from_proto_bytes(cls, data: bytes) -> "Proof":
+        from ..encoding.proto import FieldReader
+
+        r = FieldReader(data)
+        return cls(
+            total=r.int64(1),
+            index=r.int64(2),
+            leaf_hash=r.bytes(3),
+            aunts=list(r.get_all(4)),
+        )
+
+
+def _compute_hash_from_aunts(
+    index: int, total: int, leaf: bytes, aunts: List[bytes]
+) -> Optional[bytes]:
+    if index >= total or index < 0 or total <= 0:
+        return None
+    if total == 1:
+        return leaf if not aunts else None
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _compute_hash_from_aunts(index, k, leaf, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _compute_hash_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(
+    items: Sequence[bytes],
+) -> tuple[bytes, List[Proof]]:
+    """Root hash plus an inclusion proof per item
+    (reference: crypto/merkle/proof.go ProofsFromByteSlices)."""
+    total = len(items)
+    leaf_hashes = [leaf_hash(it) for it in items]
+    proofs = [
+        Proof(total=total, index=i, leaf_hash=leaf_hashes[i], aunts=[])
+        for i in range(total)
+    ]
+    _build_aunts(leaf_hashes, list(range(total)), proofs)
+    root = hash_from_byte_slices(items) if items else empty_hash()
+    return root, proofs
+
+
+def _build_aunts(
+    hashes: List[bytes], idxs: List[int], proofs: List[Proof]
+) -> bytes:
+    if len(hashes) == 1:
+        return hashes[0]
+    k = _split_point(len(hashes))
+    left = _build_aunts(hashes[:k], idxs[:k], proofs)
+    right = _build_aunts(hashes[k:], idxs[k:], proofs)
+    for i in idxs[:k]:
+        proofs[i].aunts.append(right)
+    for i in idxs[k:]:
+        proofs[i].aunts.append(left)
+    return inner_hash(left, right)
+
+
+# -- multi-op proofs (reference: crypto/merkle/proof_op.go) --
+
+
+@dataclass
+class ProofOp:
+    type: str
+    key: bytes
+    data: bytes
+
+
+class ProofOperator:
+    def run(self, values: List[bytes]) -> List[bytes]:
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:
+        raise NotImplementedError
+
+
+class ValueOp(ProofOperator):
+    """Proves a (key, value) pair rolls up into a merkle root
+    (reference: crypto/merkle/proof_value.go)."""
+
+    TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: Proof) -> None:
+        self.key = key
+        self.proof = proof
+
+    def run(self, values: List[bytes]) -> List[bytes]:
+        if len(values) != 1:
+            raise ValueError("ValueOp expects one value")
+        vhash = hashlib.sha256(values[0]).digest()
+        from ..encoding.proto import ProtoWriter
+
+        w = ProtoWriter()
+        w.bytes(1, self.key)
+        w.bytes(2, vhash)
+        kv_bytes = w.finish()
+        if leaf_hash(kv_bytes) != self.proof.leaf_hash:
+            raise ValueError("leaf hash mismatch in ValueOp")
+        root = self.proof.compute_root_hash()
+        if root is None:
+            raise ValueError("bad proof in ValueOp")
+        return [root]
+
+    def get_key(self) -> bytes:
+        return self.key
+
+
+class ProofOperators:
+    """A chain of operators verified bottom-up against a root
+    (reference: crypto/merkle/proof_op.go:60-90)."""
+
+    def __init__(self, ops: List[ProofOperator]) -> None:
+        self.ops = ops
+
+    def verify_value(self, root: bytes, keypath: str, value: bytes) -> None:
+        self.verify(root, keypath, [value])
+
+    def verify(self, root: bytes, keypath: str, args: List[bytes]) -> None:
+        keys = _parse_key_path(keypath)
+        for op in self.ops:
+            key = op.get_key()
+            if key:
+                if not keys or keys[-1] != key:
+                    raise ValueError(f"key mismatch on path: {key!r}")
+                keys.pop()
+            args = op.run(args)
+        if args != [root]:
+            raise ValueError("proof did not produce the expected root")
+        if keys:
+            raise ValueError("keypath not fully consumed")
+
+
+def _parse_key_path(path: str) -> List[bytes]:
+    """Parse /url-encoded/key/path into keys, last component first
+    (reference: crypto/merkle/proof_key_path.go)."""
+    from urllib.parse import unquote_to_bytes
+
+    if not path.startswith("/"):
+        raise ValueError("key path must start with /")
+    parts = [p for p in path.split("/")[1:] if p]
+    keys = []
+    for part in parts:
+        if part.startswith("x:"):
+            keys.append(bytes.fromhex(part[2:]))
+        else:
+            keys.append(unquote_to_bytes(part))
+    return keys

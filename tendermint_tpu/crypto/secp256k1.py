@@ -1,0 +1,115 @@
+"""secp256k1 ECDSA keys (reference: crypto/secp256k1/secp256k1.go).
+
+33-byte compressed public keys, Bitcoin-style addresses
+RIPEMD160(SHA256(pubkey)), 64-byte r||s signatures with low-s
+normalization. No batch support (matching the reference —
+crypto/batch/batch.go only dispatches ed25519/sr25519).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
+
+from .keys import Address, PrivKey, PubKey, register_key_type
+
+__all__ = ["PubKeySecp256k1", "PrivKeySecp256k1", "KEY_TYPE"]
+
+KEY_TYPE = "secp256k1"
+PUBKEY_SIZE = 33
+SIGNATURE_LEN = 64
+_CURVE = ec.SECP256K1()
+_ORDER = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+class PubKeySecp256k1(PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes) -> None:
+        if len(data) != PUBKEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUBKEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def address(self) -> Address:
+        sha = hashlib.sha256(self._bytes).digest()
+        ripemd = hashlib.new("ripemd160")
+        ripemd.update(sha)
+        return ripemd.digest()
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_LEN:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        # Reject malleable (high-s) signatures like the reference
+        # (crypto/secp256k1/secp256k1.go Verify requires normalized s).
+        if s > _ORDER // 2 or r == 0 or s == 0:
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                _CURVE, self._bytes
+            )
+            pub.verify(
+                encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256())
+            )
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+
+class PrivKeySecp256k1(PrivKey):
+    __slots__ = ("_sk",)
+
+    def __init__(self, data: bytes) -> None:
+        if len(data) != 32:
+            raise ValueError("secp256k1 privkey must be 32 bytes")
+        self._sk = ec.derive_private_key(
+            int.from_bytes(data, "big"), _CURVE
+        )
+
+    @classmethod
+    def generate(cls) -> "PrivKeySecp256k1":
+        sk = ec.generate_private_key(_CURVE)
+        return cls(
+            sk.private_numbers().private_value.to_bytes(32, "big")
+        )
+
+    def bytes(self) -> bytes:
+        return self._sk.private_numbers().private_value.to_bytes(32, "big")
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._sk.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _ORDER // 2:
+            s = _ORDER - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKey:
+        return PubKeySecp256k1(
+            self._sk.public_key().public_bytes(
+                Encoding.X962, PublicFormat.CompressedPoint
+            )
+        )
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+register_key_type(KEY_TYPE, PubKeySecp256k1, proto_field=2)

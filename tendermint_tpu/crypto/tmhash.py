@@ -1,0 +1,23 @@
+"""SHA-256 hashing helpers (reference: crypto/tmhash/hash.go):
+full 32-byte digests plus the 20-byte truncated form used for addresses."""
+
+from __future__ import annotations
+
+import hashlib
+
+__all__ = ["SIZE", "TRUNCATED_SIZE", "sum256", "sum_truncated", "new"]
+
+SIZE = 32
+TRUNCATED_SIZE = 20
+
+
+def sum256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def sum_truncated(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]
+
+
+def new():
+    return hashlib.sha256()

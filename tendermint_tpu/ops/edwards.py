@@ -1,0 +1,208 @@
+"""Batched twisted-Edwards (ed25519) group ops on TPU.
+
+Points are int32 arrays shaped (..., 4, NLIMBS) holding extended
+homogeneous coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, xy = T/Z on
+-x^2 + y^2 = 1 + d x^2 y^2. The coordinate axis is deliberately part of
+the array: every group operation becomes two *stacked* field
+multiplications over the (..., 4) axis, so the VPU sees wide fused
+elementwise work instead of four scalar-coded muls.
+
+Formulas: add-2008-hwcd-3 and dbl-2008-hwcd (complete for a = -1, d
+non-square, so identity/doubling/small-order inputs all flow through the
+same code path — no data-dependent branching, which is what jit wants).
+
+The second operand of addition is kept in "cached" form
+(Y-X, Y+X, 2d*T, 2Z), turning each addition into exactly: one stacked
+4-way mul (A, B, C, D), cheap carried adds/subs, one stacked 4-way mul
+(X3, Y3, Z3, T3).
+
+Oracle: tendermint_tpu.crypto.ed25519_math (pure-Python bigints).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto import ed25519_math as em
+from . import field25519 as F
+
+__all__ = [
+    "identity",
+    "point_add_cached",
+    "point_double",
+    "cache_point",
+    "negate",
+    "decompress",
+    "is_identity",
+    "pack_point",
+    "niels_table_b",
+]
+
+D_INT = em.D
+D2_INT = 2 * em.D % em.P
+SQRT_M1_INT = em.SQRT_M1
+
+_D2_LIMBS = F.to_limbs(D2_INT)
+_ONE = F.to_limbs(1)
+
+
+def identity(batch_shape) -> jnp.ndarray:
+    """(0, 1, 1, 0) broadcast over batch dims -> (..., 4, NLIMBS)."""
+    pt = np.zeros((4, F.NLIMBS), dtype=np.int32)
+    pt[1] = _ONE
+    pt[2] = _ONE
+    return jnp.broadcast_to(jnp.asarray(pt), (*batch_shape, 4, F.NLIMBS))
+
+
+def pack_point(x: int, y: int) -> np.ndarray:
+    """Host-side: affine ints -> extended coords limb array (4, NLIMBS)."""
+    return np.stack(
+        [
+            F.to_limbs(x),
+            F.to_limbs(y),
+            F.to_limbs(1),
+            F.to_limbs(x * y % em.P),
+        ]
+    )
+
+
+def cache_point(p: jnp.ndarray) -> jnp.ndarray:
+    """Extended -> cached (Y-X, Y+X, 2d*T, 2Z) for use as an addition rhs."""
+    X, Y, Z, T = (p[..., i, :] for i in range(4))
+    two_p = jnp.asarray(F._2P_LIMBS)
+    pre = jnp.stack([Y - X + two_p, Y + X, T, Z + Z], axis=-2)
+    pre = F.carry(pre)
+    consts = jnp.stack(
+        [
+            jnp.asarray(_ONE),
+            jnp.asarray(_ONE),
+            jnp.asarray(_D2_LIMBS),
+            jnp.asarray(_ONE),
+        ]
+    )
+    return F.mul(pre, jnp.broadcast_to(consts, pre.shape))
+
+
+def point_add_cached(p: jnp.ndarray, qc: jnp.ndarray) -> jnp.ndarray:
+    """p (extended) + q (cached) -> extended."""
+    X, Y, Z, T = (p[..., i, :] for i in range(4))
+    two_p = jnp.asarray(F._2P_LIMBS)
+    lhs = F.carry(jnp.stack([Y - X + two_p, Y + X, T, Z], axis=-2))
+    prods = F.mul(lhs, qc)  # A, B, C, D' (D' = Z1 * 2Z2)
+    A, B, C, Dv = (prods[..., i, :] for i in range(4))
+    mids = F.carry(
+        jnp.stack(
+            [B - A + two_p, Dv - C + two_p, Dv + C, B + A], axis=-2
+        )
+    )  # E, F, G, H
+    E, Fv, G, H = (mids[..., i, :] for i in range(4))
+    out_l = jnp.stack([E, G, Fv, E], axis=-2)
+    out_r = jnp.stack([Fv, H, G, H], axis=-2)
+    return F.mul(out_l, out_r)  # X3, Y3, Z3, T3
+
+
+def point_double(p: jnp.ndarray) -> jnp.ndarray:
+    X, Y, Z, _T = (p[..., i, :] for i in range(4))
+    sq_in = F.carry(jnp.stack([X, Y, Z, X + Y], axis=-2))
+    sq = F.mul(sq_in, sq_in)  # A, B, Zs, S
+    A, B, Zs, S = (sq[..., i, :] for i in range(4))
+    two_p = jnp.asarray(F._2P_LIMBS)
+    # E = A+B-S, F = 2Zs + (A-B), G = A-B, H = A+B
+    mids = F.carry(
+        jnp.stack(
+            [
+                A + B - S + two_p,
+                Zs + Zs + A - B + two_p,
+                A - B + two_p,
+                A + B,
+            ],
+            axis=-2,
+        )
+    )
+    E, Fv, G, H = (mids[..., i, :] for i in range(4))
+    out_l = jnp.stack([E, G, Fv, E], axis=-2)
+    out_r = jnp.stack([Fv, H, G, H], axis=-2)
+    return F.mul(out_l, out_r)
+
+
+def negate(p: jnp.ndarray) -> jnp.ndarray:
+    """(X, Y, Z, T) -> (-X, Y, Z, -T)."""
+    X, Y, Z, T = (p[..., i, :] for i in range(4))
+    two_p = jnp.asarray(F._2P_LIMBS)
+    return F.carry(jnp.stack([two_p - X, Y, Z, two_p - T], axis=-2))
+
+
+def is_identity(p: jnp.ndarray) -> jnp.ndarray:
+    """Projective identity test: X ≡ 0 and Y ≡ Z (mod p)."""
+    X, Y, Z, _ = (p[..., i, :] for i in range(4))
+    return F.is_zero(X) & F.eq(Y, Z)
+
+
+# -- decompression (RFC 8032 §5.1.3 with ZIP-215 non-canonical-y
+#    acceptance handled host-side by reducing y mod p) --
+
+
+def decompress(y: jnp.ndarray, sign: jnp.ndarray):
+    """Batched point decompression.
+
+    y: (..., NLIMBS) field element (already reduced mod p on host),
+    sign: (...) int32 0/1 — the x-parity bit from the wire encoding.
+    Returns (point (..., 4, NLIMBS), ok (...) bool). Mirrors the
+    reference's curve25519-voi decompression semantics; the square root is
+    computed as u*v^3 * (u*v^7)^((p-5)/8) with the sqrt(-1) correction.
+    """
+    one = jnp.broadcast_to(jnp.asarray(_ONE), y.shape)
+    y2 = F.sqr(y)
+    u = F.sub(y2, one)
+    v = F.add(F.mul(y2, jnp.broadcast_to(jnp.asarray(F.to_limbs(D_INT)), y.shape)), one)
+    v2 = F.sqr(v)
+    v3 = F.mul(v2, v)
+    v7 = F.mul(F.sqr(v3), v)
+    t = F.pow_constexp(F.mul(u, v7), (em.P - 5) // 8)
+    x = F.mul(F.mul(u, v3), t)
+    vx2 = F.mul(v, F.sqr(x))
+    root_ok = F.eq(vx2, u)
+    neg_root_ok = F.eq(vx2, F.neg(u))
+    x_alt = F.mul(x, jnp.broadcast_to(jnp.asarray(F.to_limbs(SQRT_M1_INT)), x.shape))
+    x = F.select(neg_root_ok, x_alt, x)
+    ok = root_ok | neg_root_ok
+    # parity fix: need canonical x for bit 0
+    x_can = F.canonical(x)
+    parity = x_can[..., 0] & 1
+    x_flipped = F.neg(x)
+    x = F.select(parity != sign, x_flipped, x)
+    # x == 0 with sign == 1 is invalid ("-0")
+    x_zero = F.is_zero(x)
+    ok = ok & ~(x_zero & (sign == 1))
+    xy = F.mul(x, y)
+    pt = jnp.stack([x, y, jnp.broadcast_to(jnp.asarray(_ONE), y.shape), xy], axis=-2)
+    return pt, ok
+
+
+# -- host-side table generation (niels form, Z = 1) --
+
+
+def niels_table_b() -> np.ndarray:
+    """(16, 4, NLIMBS): cached-form entries for j*B, j = 0..15, Z = 1.
+    Layout matches cache_point output: (y-x, y+x, 2d*xy, 2)."""
+    entries = []
+    pt = em.IDENTITY
+    for _j in range(16):
+        X, Y, Z, _T = pt
+        zinv = pow(Z, em.P - 2, em.P)
+        x, y = X * zinv % em.P, Y * zinv % em.P
+        entries.append(
+            np.stack(
+                [
+                    F.to_limbs((y - x) % em.P),
+                    F.to_limbs((y + x) % em.P),
+                    F.to_limbs(D2_INT * x * y % em.P),
+                    F.to_limbs(2),
+                ]
+            )
+        )
+        pt = em.point_add(pt, em.B_POINT)
+    return np.stack(entries)

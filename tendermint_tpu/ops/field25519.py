@@ -1,0 +1,258 @@
+"""GF(2^255 - 19) arithmetic as batched int32 limb vectors for TPU.
+
+TPUs have no native big-integer or 64-bit-saturating integer units, so field
+elements are unsaturated 20-limb radix-2^13 vectors (20 x 13 = 260 bits) in
+int32, shaped (..., 20) with arbitrary leading batch dims. Why radix 13: a
+schoolbook product coefficient is at most 20 * (2^13)^2 = 1.34e9 < 2^31 - 1,
+so the whole multiply pipeline — convolution, carry chains, and the
+2^260 ≡ 19*32 = 608 (mod p) fold — stays in native int32 ops the VPU
+vectorizes across the batch dimension. This replaces the reference's
+curve25519-voi 64-bit limb arithmetic (reference: crypto/ed25519/ed25519.go
+via go.mod:23) with a formulation XLA can fuse and shard.
+
+Invariant: every field element handed between public ops here is
+"normalized": all limbs in [0, 2^13] (value may exceed p; values are only
+made canonical for comparisons/parity via `canonical`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "NLIMBS",
+    "RADIX",
+    "P_INT",
+    "to_limbs",
+    "from_limbs",
+    "add",
+    "sub",
+    "neg",
+    "mul",
+    "sqr",
+    "mul_const",
+    "carry",
+    "canonical",
+    "is_zero",
+    "eq",
+    "select",
+    "pow_constexp",
+    "zeros_like_batch",
+    "const_limbs",
+]
+
+NLIMBS = 20
+RADIX = 13
+BASE = 1 << RADIX  # 8192
+MASK = BASE - 1
+P_INT = 2**255 - 19
+# 2^260 mod p: limb index NLIMBS wraps with this factor.
+FOLD = 19 * (1 << (NLIMBS * RADIX - 255))  # 608
+
+# p and 2p in radix-2^13 limbs (for subtraction bias and canonical reduce)
+_P_LIMBS = np.array(
+    [(P_INT >> (RADIX * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
+)
+_2P_LIMBS = np.array(
+    [((2 * P_INT) >> (RADIX * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
+)
+
+
+# -- host-side packing --
+
+
+def to_limbs(x: int) -> np.ndarray:
+    x %= P_INT
+    return np.array(
+        [(x >> (RADIX * i)) & MASK for i in range(NLIMBS)], dtype=np.int32
+    )
+
+
+def from_limbs(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(arr[i]) << (RADIX * i) for i in range(NLIMBS)) % P_INT
+
+
+def const_limbs(x: int) -> jnp.ndarray:
+    return jnp.asarray(to_limbs(x))
+
+
+def zeros_like_batch(batch_shape) -> jnp.ndarray:
+    return jnp.zeros((*batch_shape, NLIMBS), dtype=jnp.int32)
+
+
+# -- carrying --
+
+
+def _chain(limbs_list):
+    """Sequential carry chain over a python list of (...,)-shaped int32
+    coefficient arrays. Returns (digits, carry_out)."""
+    out = []
+    c = None
+    for x in limbs_list:
+        t = x if c is None else x + c
+        out.append(t & MASK)
+        c = t >> RADIX
+    return out, c
+
+
+def _pass(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry pass over (..., NLIMBS): every limb sheds its
+    high bits to its neighbor simultaneously; the top limb's carry folds
+    into limb 0 with the 2^260 ≡ 608 identity. O(1) depth (vs a
+    sequential 20-step chain) — this is what keeps the XLA graph small
+    and the VPU busy. Works for negative transients too: `& MASK` /
+    `>> RADIX` on two's-complement int32 preserve x = (x & MASK) +
+    (x >> RADIX) * 2^RADIX."""
+    c = x >> RADIX
+    d = x & MASK
+    shifted = jnp.concatenate(
+        [c[..., -1:] * FOLD, c[..., :-1]], axis=-1
+    )
+    return d + shifted
+
+
+def carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Loose-normalize: input limbs |x_i| < 2^17ish, output limbs in
+    [-2^11, 2^13 + 2^11). Two parallel passes suffice: after pass one all
+    limbs are <= 2^13 + (2^17 >> 13) + 608*small; after pass two the
+    slack is a few units. The loose bound (≤ ~9500) keeps schoolbook
+    products within int32: 20 * 9500^2 < 2^31."""
+    return _pass(_pass(x))
+
+
+# -- basic ops (always return normalized elements) --
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # a - b + 2p: stays positive for normalized inputs.
+    return carry(a - b + jnp.asarray(_2P_LIMBS))
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return carry(jnp.asarray(_2P_LIMBS) - a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook product as 20 shifted multiply-accumulates over 39
+    convolution coefficients, carried with parallel passes, then folded
+    mod p. Batched over leading dims.
+
+    Bounds: with loose-normalized inputs (|limbs| ≤ ~9500) conv
+    coefficients are ≤ 20 * 9500^2 < 2^31. Two widening parallel passes
+    plus one plain pass bring all 41 digit slots to ≤ 2^13 + small (the
+    product value < 2^523 fits 41 slots, so the last pass provably sheds
+    no carry). Digits at positions k ≥ 20 fold back with
+    2^(13k) ≡ 608 * 2^(13(k-20)); position 40 folds twice (608^2)."""
+    x = None  # (..., 39) conv accumulator
+    pad_cfg = [(0, 0)] * (a.ndim - 1)
+    for i in range(NLIMBS):
+        term = a[..., i : i + 1] * b  # (..., 20)
+        shifted = jnp.pad(term, pad_cfg + [(i, NLIMBS - 1 - i)])
+        x = shifted if x is None else x + shifted
+
+    # widening parallel passes (carry out of the top slot becomes a new slot)
+    for _ in range(2):
+        c = x >> RADIX
+        d = x & MASK
+        zero = jnp.zeros_like(x[..., :1])
+        x = jnp.concatenate(
+            [d + jnp.concatenate([zero, c[..., :-1]], axis=-1), c[..., -1:]],
+            axis=-1,
+        )
+    # one plain pass (top carry is provably zero now)
+    c = x >> RADIX
+    d = x & MASK
+    zero = jnp.zeros_like(x[..., :1])
+    x = d + jnp.concatenate([zero, c[..., :-1]], axis=-1)
+
+    low = x[..., :NLIMBS]
+    hi = x[..., NLIMBS : 2 * NLIMBS] * FOLD  # positions 20..39 -> 0..19
+    out = low + hi
+    out = out.at[..., 0].add(x[..., 2 * NLIMBS] * (FOLD * FOLD))
+    # limbs now ≤ 2^13 + 608*2^13 + small < 2^23; two passes normalize.
+    return carry(out)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_const(a: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Multiply by a small constant (< 2^17), e.g. 2d folding factors."""
+    return carry(a * jnp.int32(c)) if c < (1 << 17) else mul(a, const_limbs(c))
+
+
+# -- canonical form and comparisons --
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce to [0, p): fold high bits twice, then two conditional
+    subtractions of p."""
+    cols = [x[..., i] for i in range(NLIMBS)]
+    for _ in range(2):
+        # bits >= 255 live in limb 19 from bit 8 up (19*13 = 247)
+        hi = cols[NLIMBS - 1] >> (255 - RADIX * (NLIMBS - 1))
+        cols[NLIMBS - 1] = cols[NLIMBS - 1] & ((1 << (255 - RADIX * (NLIMBS - 1))) - 1)
+        cols[0] = cols[0] + hi * 19
+        cols, c = _chain(cols)
+        cols[0] = cols[0] + c * FOLD
+        cols, _ = _chain(cols)
+    v = jnp.stack(cols, axis=-1)
+    for _ in range(2):
+        v = _cond_sub_p(v)
+    return v
+
+
+def _cond_sub_p(v: jnp.ndarray) -> jnp.ndarray:
+    p = jnp.asarray(_P_LIMBS)
+    cols = [v[..., i] for i in range(NLIMBS)]
+    diff = []
+    borrow = None
+    for i in range(NLIMBS):
+        t = cols[i] - p[i] - (0 if borrow is None else borrow)
+        borrow = (t < 0).astype(jnp.int32)
+        diff.append(t + borrow * BASE)
+    ge = borrow == 0  # v >= p
+    d = jnp.stack(diff, axis=-1)
+    return jnp.where(ge[..., None], d, v)
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """True where the (possibly non-canonical) element ≡ 0 mod p."""
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(sub(a, b))
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise field select; cond shaped like the batch dims."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def pow_constexp(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """x^e for a compile-time-constant exponent via left-to-right
+    square-and-multiply under lax.scan (fixed trip count, so XLA compiles
+    one body — no data-dependent control flow)."""
+    bits = np.array(
+        [(exponent >> i) & 1 for i in range(exponent.bit_length())][::-1],
+        dtype=np.bool_,
+    )
+    one = jnp.broadcast_to(const_limbs(1), x.shape)
+
+    def body(acc, bit):
+        acc = sqr(acc)
+        acc = jnp.where(bit, mul(acc, x), acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, one, jnp.asarray(bits))
+    return acc

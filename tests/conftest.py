@@ -17,6 +17,21 @@ if "xla_force_host_platform_device_count" not in flags:
 import pytest  # noqa: E402
 
 
+def _enable_compilation_cache() -> None:
+    """Persist XLA compilations across test runs (the ed25519 kernel is a
+    big program; first compile is ~1-4 min, cached reloads are instant)."""
+    import jax
+
+    cache_dir = os.path.join(
+        os.path.dirname(__file__), "..", ".jax_cache"
+    )
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+_enable_compilation_cache()
+
+
 @pytest.fixture
 def tmp_home(tmp_path):
     from tendermint_tpu.config import Config

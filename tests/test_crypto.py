@@ -1,0 +1,172 @@
+import hashlib
+
+import pytest
+
+from tendermint_tpu.crypto import (
+    Ed25519BatchVerifier,
+    PrivKeyEd25519,
+    PrivKeySecp256k1,
+    PubKeyEd25519,
+    batch,
+    merkle,
+    pubkey_from_proto,
+    pubkey_to_proto,
+    tmhash,
+)
+from tendermint_tpu.crypto import ed25519_math as em
+
+
+def test_ed25519_sign_verify_roundtrip():
+    sk = PrivKeyEd25519.generate()
+    pk = sk.pub_key()
+    msg = b"vote sign bytes"
+    sig = sk.sign(msg)
+    assert len(sig) == 64
+    assert pk.verify_signature(msg, sig)
+    assert not pk.verify_signature(msg + b"x", sig)
+    assert not pk.verify_signature(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    assert len(pk.address()) == 20
+    assert pk.address() == hashlib.sha256(pk.bytes()).digest()[:20]
+
+
+def test_ed25519_rfc8032_vector():
+    # RFC 8032 §7.1 TEST 2
+    seed = bytes.fromhex(
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb"
+    )
+    sk = PrivKeyEd25519.from_seed(seed)
+    assert sk.pub_key().bytes() == bytes.fromhex(
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c"
+    )
+    msg = bytes.fromhex("72")
+    sig = sk.sign(msg)
+    assert sig == bytes.fromhex(
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+    )
+    # pure-python ZIP-215 oracle agrees
+    assert em.zip215_verify(sk.pub_key().bytes(), msg, sig)
+
+
+def test_zip215_oracle_matches_fast_path_on_random_sigs():
+    for i in range(8):
+        sk = PrivKeyEd25519.from_seed(hashlib.sha256(bytes([i])).digest())
+        msg = f"msg-{i}".encode()
+        sig = sk.sign(msg)
+        assert em.zip215_verify(sk.pub_key().bytes(), msg, sig)
+        bad = sig[:32] + (int.from_bytes(sig[32:], "little") ^ 1).to_bytes(32, "little")
+        assert not em.zip215_verify(sk.pub_key().bytes(), msg, bad)
+        assert sk.pub_key().verify_signature(msg, sig)
+
+
+def test_zip215_rejects_high_s():
+    sk = PrivKeyEd25519.generate()
+    msg = b"m"
+    sig = sk.sign(msg)
+    s = int.from_bytes(sig[32:], "little")
+    high_s = s + em.L
+    bad = sig[:32] + high_s.to_bytes(32, "little")
+    assert not sk.pub_key().verify_signature(msg, bad)
+    assert not em.zip215_verify(sk.pub_key().bytes(), msg, bad)
+
+
+def test_batch_verifier_bitmap():
+    bv = Ed25519BatchVerifier()
+    keys = [PrivKeyEd25519.generate() for _ in range(5)]
+    msgs = [f"m{i}".encode() for i in range(5)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    sigs[2] = keys[2].sign(b"other")  # corrupt one
+    for k, m, s in zip(keys, msgs, sigs):
+        bv.add(k.pub_key(), m, s)
+    ok, bitmap = bv.verify()
+    assert not ok
+    assert bitmap == [True, True, False, True, True]
+    assert len(bv) == 5
+
+
+def test_batch_dispatch():
+    sk = PrivKeyEd25519.generate()
+    assert batch.supports_batch_verifier(sk.pub_key())
+    bv = batch.create_batch_verifier(sk.pub_key(), size_hint=4)
+    assert isinstance(bv, Ed25519BatchVerifier)
+    sk2 = PrivKeySecp256k1.generate()
+    assert not batch.supports_batch_verifier(sk2.pub_key())
+    with pytest.raises(ValueError):
+        batch.create_batch_verifier(sk2.pub_key())
+
+
+def test_secp256k1_roundtrip():
+    sk = PrivKeySecp256k1.generate()
+    pk = sk.pub_key()
+    assert len(pk.bytes()) == 33
+    assert len(pk.address()) == 20
+    msg = b"tx bytes"
+    sig = sk.sign(msg)
+    assert len(sig) == 64
+    assert pk.verify_signature(msg, sig)
+    assert not pk.verify_signature(b"other", sig)
+    # high-s rejected
+    s = int.from_bytes(sig[32:], "big")
+    order = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+    high = sig[:32] + (order - s).to_bytes(32, "big")
+    assert not pk.verify_signature(msg, high)
+
+
+def test_pubkey_proto_roundtrip():
+    for sk in (PrivKeyEd25519.generate(), PrivKeySecp256k1.generate()):
+        pk = sk.pub_key()
+        enc = pubkey_to_proto(pk)
+        back = pubkey_from_proto(enc)
+        assert back == pk
+
+
+def test_tmhash():
+    assert tmhash.sum256(b"") == hashlib.sha256(b"").digest()
+    assert tmhash.sum_truncated(b"abc") == hashlib.sha256(b"abc").digest()[:20]
+
+
+def test_merkle_known_shapes():
+    # empty
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    # single leaf: root == leafHash(item)
+    item = b"hello"
+    assert merkle.hash_from_byte_slices([item]) == hashlib.sha256(
+        b"\x00" + item
+    ).digest()
+    # two leaves
+    l0 = hashlib.sha256(b"\x00a").digest()
+    l1 = hashlib.sha256(b"\x00b").digest()
+    assert merkle.hash_from_byte_slices([b"a", b"b"]) == hashlib.sha256(
+        b"\x01" + l0 + l1
+    ).digest()
+    # three leaves: split point 2 -> inner(inner(l0,l1), l2)
+    l2 = hashlib.sha256(b"\x00c").digest()
+    left = hashlib.sha256(b"\x01" + l0 + l1).digest()
+    assert merkle.hash_from_byte_slices([b"a", b"b", b"c"]) == hashlib.sha256(
+        b"\x01" + left + l2
+    ).digest()
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13, 100])
+def test_merkle_proofs(n):
+    items = [f"item-{i}".encode() for i in range(n)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, proof in enumerate(proofs):
+        proof.verify(root, items[i])
+        assert proof.total == n and proof.index == i
+        with pytest.raises(ValueError):
+            proof.verify(root, b"wrong leaf")
+    # tampered root
+    with pytest.raises(ValueError):
+        proofs[0].verify(b"\x00" * 32, items[0])
+
+
+def test_merkle_proof_proto_roundtrip():
+    items = [b"a", b"b", b"c"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    p = proofs[1]
+    again = merkle.Proof.from_proto_bytes(p.to_proto_bytes())
+    assert again.total == p.total and again.index == p.index
+    assert again.leaf_hash == p.leaf_hash and again.aunts == p.aunts
+    again.verify(root, items[1])

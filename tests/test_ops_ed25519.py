@@ -1,0 +1,84 @@
+"""Differential tests for the full device ed25519 batch verifier against
+the CPU implementations (OpenSSL fast path + pure-Python ZIP-215 oracle).
+This mirrors the reference's own batch-vs-single equivalence strategy
+(reference: types/validation_test.go, crypto/ed25519/ed25519_test.go)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+
+@pytest.fixture(scope="module")
+def verifier():
+    return Ed25519Verifier(bucket_sizes=[8])
+
+
+def _sign_set(n, tag=b""):
+    keys = [
+        PrivKeyEd25519.from_seed(hashlib.sha256(tag + bytes([i])).digest())
+        for i in range(n)
+    ]
+    msgs = [b"msg-" + tag + bytes([i]) for i in range(n)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    return [k.pub_key().bytes() for k in keys], msgs, sigs
+
+
+def test_valid_batch(verifier):
+    pks, msgs, sigs = _sign_set(6)
+    ok = verifier.verify(pks, msgs, sigs)
+    assert ok.tolist() == [True] * 6
+
+
+def test_mixed_batch_bitmap(verifier):
+    pks, msgs, sigs = _sign_set(6, b"x")
+    # corrupt sig at 1, message at 3, pubkey at 5
+    sigs[1] = sigs[1][:32] + (
+        (int.from_bytes(sigs[1][32:], "little") ^ 1).to_bytes(32, "little")
+    )
+    msgs[3] = b"tampered"
+    pks[5] = hashlib.sha256(b"not a point seed").digest()  # likely invalid/other key
+    ok = verifier.verify(pks, msgs, sigs)
+    # cross-check every index against the ZIP-215 oracle
+    expect = [em.zip215_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+    assert ok.tolist() == expect
+    assert not ok[1] and not ok[3] and not ok[5]
+
+
+def test_high_s_rejected(verifier):
+    pks, msgs, sigs = _sign_set(2, b"s")
+    s = int.from_bytes(sigs[0][32:], "little")
+    sigs[0] = sigs[0][:32] + (s + em.L).to_bytes(32, "little")
+    ok = verifier.verify(pks, msgs, sigs)
+    assert ok.tolist() == [False, True]
+
+
+def test_malformed_sizes(verifier):
+    pks, msgs, sigs = _sign_set(3, b"z")
+    sigs[0] = sigs[0][:40]
+    pks[1] = pks[1][:10]
+    ok = verifier.verify(pks, msgs, sigs)
+    assert ok.tolist() == [False, False, True]
+
+
+def test_noncanonical_y_zip215_accepted(verifier):
+    # Build a signature whose R has a y >= p encoding: R = point with
+    # small y where y + p < 2^255. Craft via oracle: take a valid sig and
+    # re-encode R non-canonically if possible; else assert oracle parity.
+    pks, msgs, sigs = _sign_set(1, b"nc")
+    r_int = int.from_bytes(sigs[0][:32], "little")
+    y = r_int & ((1 << 255) - 1)
+    if y + em.P < (1 << 255):  # rarely true for random points
+        nc = (y + em.P) | (r_int & (1 << 255))
+        sigs[0] = nc.to_bytes(32, "little") + sigs[0][32:]
+    ok = verifier.verify(pks, msgs, sigs)
+    expect = [em.zip215_verify(pks[0], msgs[0], sigs[0])]
+    assert ok.tolist() == expect
+
+
+def test_empty_batch(verifier):
+    assert verifier.verify([], [], []).tolist() == []

@@ -1,0 +1,157 @@
+"""Differential tests: device field/point ops vs pure-Python bigint oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.crypto import ed25519_math as em
+from tendermint_tpu.ops import edwards as E
+from tendermint_tpu.ops import field25519 as F
+
+random.seed(42)
+P = F.P_INT
+
+# jit wrappers: eager per-op dispatch is slow on the virtual-device CPU
+# platform; one compiled program per shape keeps the suite fast.
+_add_cached = jax.jit(lambda p, q: E.point_add_cached(p, E.cache_point(q)))
+_double = jax.jit(E.point_double)
+_decompress = jax.jit(E.decompress)
+_field = {
+    name: jax.jit(getattr(F, name))
+    for name in ("add", "sub", "mul", "neg", "sqr", "canonical", "is_zero", "eq")
+}
+
+
+def _pack(vals):
+    return jnp.asarray(np.stack([F.to_limbs(v) for v in vals]))
+
+
+@pytest.fixture(scope="module")
+def elems():
+    xs = [random.randrange(P) for _ in range(16)] + [0, 1, P - 1, P - 2]
+    ys = [random.randrange(P) for _ in range(20)]
+    return xs, ys, _pack(xs), _pack(ys)
+
+
+def _vals(arr):
+    a = np.asarray(arr)
+    return [F.from_limbs(a[i]) for i in range(a.shape[0])]
+
+
+def test_field_ops(elems):
+    xs, ys, A, B = elems
+    assert _vals(_field['add'](A, B)) == [(x + y) % P for x, y in zip(xs, ys)]
+    assert _vals(_field['sub'](A, B)) == [(x - y) % P for x, y in zip(xs, ys)]
+    assert _vals(_field['mul'](A, B)) == [(x * y) % P for x, y in zip(xs, ys)]
+    assert _vals(_field['neg'](A)) == [(-x) % P for x in xs]
+    assert _vals(_field['sqr'](A)) == [x * x % P for x in xs]
+
+
+def test_field_deep_chain(elems):
+    xs, ys, A, B = elems
+    C, D = A, B
+    ce, de = list(xs), list(ys)
+    for _ in range(4):
+        C2 = F.mul(F.sub(C, D), F.add(C, D))
+        ce2 = [(c - d) * (c + d) % P for c, d in zip(ce, de)]
+        D, de = C, ce
+        C, ce = C2, ce2
+    assert _vals(C) == ce
+
+
+def test_canonical_and_iszero(elems):
+    xs, ys, A, B = elems
+    can = np.asarray(F.canonical(_field['sub'](A, B)))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        val = sum(int(can[i][j]) << (13 * j) for j in range(F.NLIMBS))
+        assert val == (x - y) % P
+    assert bool(jnp.all(_field['is_zero'](_field['sub'](A, A))))
+    assert not bool(jnp.any(_field['eq'](A, B)))
+
+
+def test_pow_constexp(elems):
+    xs, _, A, _ = elems
+    e = (P - 5) // 8
+    assert _vals(F.pow_constexp(A, e)) == [pow(x, e, P) for x in xs]
+
+
+def _rand_points(n):
+    pts = []
+    for _ in range(n):
+        k = random.randrange(1, em.L)
+        pts.append(em.scalar_mult(k, em.B_POINT))
+    return pts
+
+
+def _pack_points(pts):
+    arrs = []
+    for pt in pts:
+        X, Y, Z, _ = pt
+        zinv = pow(Z, P - 2, P)
+        x, y = X * zinv % P, Y * zinv % P
+        arrs.append(E.pack_point(x, y))
+    return jnp.asarray(np.stack(arrs))
+
+
+def _affine(dev_pts):
+    """Device extended points -> list of affine (x, y) ints."""
+    a = np.asarray(F.canonical(jnp.asarray(dev_pts)))
+    out = []
+    for i in range(a.shape[0]):
+        X = sum(int(a[i][0][j]) << (13 * j) for j in range(F.NLIMBS))
+        Y = sum(int(a[i][1][j]) << (13 * j) for j in range(F.NLIMBS))
+        Z = sum(int(a[i][2][j]) << (13 * j) for j in range(F.NLIMBS))
+        zi = pow(Z, P - 2, P)
+        out.append((X * zi % P, Y * zi % P))
+    return out
+
+
+def _affine_ref(pt):
+    X, Y, Z, _ = pt
+    zi = pow(Z, P - 2, P)
+    return (X * zi % P, Y * zi % P)
+
+
+def test_point_add_double():
+    ps = _rand_points(6)
+    qs = _rand_points(6)
+    dp, dq = _pack_points(ps), _pack_points(qs)
+    got = _affine(_add_cached(dp, dq))
+    expect = [_affine_ref(em.point_add(p, q)) for p, q in zip(ps, qs)]
+    assert got == expect
+    got2 = _affine(_double(dp))
+    assert got2 == [_affine_ref(em.point_double(p)) for p in ps]
+    # negate + identity checks
+    got3 = _affine(_add_cached(dp, E.negate(dp)))
+    ident = np.asarray(
+        E.is_identity(_add_cached(dp, E.negate(dp)))
+    )
+    assert ident.all()
+    # adding the identity leaves the point unchanged
+    idp = E.identity((6,))
+    assert _affine(_add_cached(dp, idp)) == [
+        _affine_ref(p) for p in ps
+    ]
+
+
+def test_decompress_matches_oracle():
+    pts = _rand_points(5)
+    raw = [em.compress(p) for p in pts]
+    ys, signs = [], []
+    for r in raw:
+        yi = int.from_bytes(r, "little")
+        signs.append(yi >> 255)
+        ys.append(yi & ((1 << 255) - 1))
+    y_arr = _pack(ys)
+    s_arr = jnp.asarray(np.array(signs, dtype=np.int32))
+    dev_pts, ok = _decompress(y_arr, s_arr)
+    assert np.asarray(ok).all()
+    assert _affine(dev_pts) == [_affine_ref(p) for p in pts]
+    # invalid encodings rejected: y with no sqrt
+    bad_y = 2  # x^2 = (4-1)/(4d+1): overwhelmingly non-square for y=2
+    dev_pts2, ok2 = E.decompress(_pack([bad_y]), jnp.asarray(np.array([0], np.int32)))
+    assert bool(np.asarray(ok2)[0]) == (em.decompress((2).to_bytes(32, "little")) is not None)
