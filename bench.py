@@ -1,0 +1,97 @@
+"""Benchmark: ed25519 batch-verify throughput on the attached device.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The metric is sig-verifies/sec/chip (BASELINE.json's primary metric) at
+batch 8192. `vs_baseline` is the speedup over this host's CPU
+single-verify path (OpenSSL via the `cryptography` wheel) measured in the
+same process — the reference publishes no absolute numbers, so the CPU
+baseline is measured, matching BASELINE.md's methodology.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _make_batch(n: int, seed: int = 11):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    rng = np.random.default_rng(seed)
+    pks, msgs, sigs = [], [], []
+    # sign with a handful of keys (signing cost isn't what we measure)
+    keys = []
+    for _ in range(min(n, 64)):
+        sk = Ed25519PrivateKey.from_private_bytes(
+            rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+        )
+        keys.append(
+            (sk, sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw))
+        )
+    for i in range(n):
+        sk, pk = keys[i % len(keys)]
+        msg = rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(sk.sign(msg))
+    return pks, msgs, sigs
+
+
+def main() -> None:
+    from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+    n = 8192
+    pks, msgs, sigs = _make_batch(n)
+
+    verifier = Ed25519Verifier(bucket_sizes=[n])
+    # warm-up: compile + first run
+    ok = verifier.verify(pks, msgs, sigs)
+    assert bool(ok.all()), "warm-up batch failed to verify"
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ok = verifier.verify(pks, msgs, sigs)
+    dt = (time.perf_counter() - t0) / reps
+    assert bool(ok.all())
+    device_sigs_per_sec = n / dt
+
+    # CPU baseline: OpenSSL single verify over a slice, extrapolated
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+
+    m = 512
+    handles = [Ed25519PublicKey.from_public_bytes(pk) for pk in pks[:m]]
+    t0 = time.perf_counter()
+    for h, msg, sig in zip(handles, msgs[:m], sigs[:m]):
+        h.verify(sig, msg)
+    cpu_dt = time.perf_counter() - t0
+    cpu_sigs_per_sec = m / cpu_dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput",
+                "value": round(device_sigs_per_sec, 1),
+                "unit": "sigs/s/chip",
+                "vs_baseline": round(
+                    device_sigs_per_sec / cpu_sigs_per_sec, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Device-backed BatchVerifier — the TPU side of the plugin boundary.
+
+The reference gates all batch verification behind crypto.BatchVerifier
+(crypto/crypto.go:53-61) with curve25519-voi underneath
+(crypto/ed25519/ed25519.go:202-237). Here the implementation underneath
+is the XLA program in tendermint_tpu.ops.ed25519_kernel; install() makes
+crypto.batch.create_batch_verifier return it for ed25519 keys when the
+batch is large enough to beat host latency. CPU remains the default
+until install() is called, exactly like the reference defaults to pure
+Go.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .batch import register_device_factory
+from .keys import BatchVerifier, PubKey
+
+__all__ = ["TpuEd25519BatchVerifier", "install", "DEFAULT_MIN_BATCH"]
+
+# Below this many signatures the fixed dispatch cost (host packing +
+# device roundtrip, ~100s of µs) exceeds CPU verify time; let CPU win.
+DEFAULT_MIN_BATCH = 8
+
+
+class TpuEd25519BatchVerifier(BatchVerifier):
+    """Queues triples on host, verifies in one device program.
+
+    Same verify() contract as the CPU path: (all_ok, bitmap), bitmap
+    aligned with add() order, malformed entries reported invalid
+    per-index rather than raising at verify time.
+    """
+
+    def __init__(self, verifier=None) -> None:
+        from ..ops import ed25519_kernel
+
+        self._verifier = verifier
+        self._kernel = ed25519_kernel
+        self._pks: List[bytes] = []
+        self._msgs: List[bytes] = []
+        self._sigs: List[bytes] = []
+
+    def add(self, pub_key: PubKey, message: bytes, signature: bytes) -> None:
+        if pub_key.type() != "ed25519":
+            raise TypeError("TpuEd25519BatchVerifier requires ed25519 keys")
+        if len(signature) != 64:
+            raise ValueError("malformed signature size")
+        self._pks.append(pub_key.bytes())
+        self._msgs.append(bytes(message))
+        self._sigs.append(bytes(signature))
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        if not self._pks:
+            return False, []
+        if self._verifier is not None:
+            bitmap = self._verifier.verify(
+                self._pks, self._msgs, self._sigs
+            )
+        else:
+            bitmap = self._kernel.batch_verify_host(
+                self._pks, self._msgs, self._sigs
+            )
+        bits = [bool(b) for b in bitmap]
+        return all(bits), bits
+
+    def __len__(self) -> int:
+        return len(self._pks)
+
+
+_SHARED_VERIFIER = None
+_MIN_BATCH = DEFAULT_MIN_BATCH
+
+
+def _factory(size_hint: int) -> Optional[BatchVerifier]:
+    if 0 < size_hint < _MIN_BATCH:
+        return None  # CPU fallback for tiny batches
+    return TpuEd25519BatchVerifier(_SHARED_VERIFIER)
+
+
+def install(
+    min_batch: int = DEFAULT_MIN_BATCH, mesh=None
+) -> None:
+    """Register the device factory. With a mesh, batches are sharded
+    across it (tendermint_tpu.parallel.sharding); otherwise single-chip."""
+    global _SHARED_VERIFIER, _MIN_BATCH
+    _MIN_BATCH = min_batch
+    if mesh is not None:
+        from ..parallel.sharding import ShardedEd25519Verifier
+
+        _SHARED_VERIFIER = ShardedEd25519Verifier(mesh)
+    else:
+        _SHARED_VERIFIER = None
+    register_device_factory("ed25519", _factory)

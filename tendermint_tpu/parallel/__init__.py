@@ -1,1 +1,15 @@
+"""Device-mesh parallelism for the TPU crypto path."""
 
+from .sharding import (  # noqa: F401
+    SIG_AXIS,
+    ShardedEd25519Verifier,
+    make_mesh,
+    sharded_batch_verify,
+)
+
+__all__ = [
+    "SIG_AXIS",
+    "ShardedEd25519Verifier",
+    "make_mesh",
+    "sharded_batch_verify",
+]

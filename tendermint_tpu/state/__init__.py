@@ -1,1 +1,12 @@
+"""Replicated state and its persistence."""
 
+from .store import ABCIResponses, StateStore  # noqa: F401
+from .types import State, median_time, state_from_genesis  # noqa: F401
+
+__all__ = [
+    "ABCIResponses",
+    "State",
+    "StateStore",
+    "median_time",
+    "state_from_genesis",
+]

@@ -1,1 +1,6 @@
+"""Persistence: KV backends and the block store."""
 
+from .block_store import BlockStore  # noqa: F401
+from .kv import Batch, KVStore, MemKV, SqliteKV, open_db  # noqa: F401
+
+__all__ = ["BlockStore", "Batch", "KVStore", "MemKV", "SqliteKV", "open_db"]

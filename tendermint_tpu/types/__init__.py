@@ -1,1 +1,60 @@
+"""Core consensus datatypes (reference: types/ package)."""
 
+from .block import (  # noqa: F401
+    Block,
+    make_block,
+    max_data_bytes,
+    max_data_bytes_no_evidence,
+)
+from .block_id import BlockID, PartSetHeader  # noqa: F401
+from .canonical import (  # noqa: F401
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    PROPOSAL_TYPE,
+    proposal_sign_bytes,
+    vote_sign_bytes,
+)
+from .commit import (  # noqa: F401
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    Commit,
+    CommitSig,
+)
+from .evidence import (  # noqa: F401
+    DuplicateVoteEvidence,
+    Evidence,
+    LightClientAttackEvidence,
+    evidence_from_proto,
+    evidence_list_hash,
+    evidence_to_proto,
+)
+from .genesis import GenesisDoc, GenesisValidator  # noqa: F401
+from .header import Consensus, Header  # noqa: F401
+from .light import LightBlock, SignedHeader  # noqa: F401
+from .params import (  # noqa: F401
+    BlockParams,
+    ConsensusParams,
+    EvidenceParams,
+    ValidatorParams,
+    VersionParams,
+)
+from .part_set import BLOCK_PART_SIZE_BYTES, Part, PartSet  # noqa: F401
+from .proposal import Proposal  # noqa: F401
+from .timestamp import now_ns  # noqa: F401
+from .tx import tx_hash, tx_key, txs_hash  # noqa: F401
+from .validation import (  # noqa: F401
+    Fraction,
+    InvalidCommitError,
+    NotEnoughVotingPowerError,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from .validator import Validator, ValidatorSet  # noqa: F401
+from .vote import Vote  # noqa: F401
+from .vote_set import (  # noqa: F401
+    ConflictingVoteError,
+    VoteSet,
+    commit_to_vote_set,
+)

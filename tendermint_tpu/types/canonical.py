@@ -1,0 +1,107 @@
+"""Canonical sign-bytes for votes and proposals.
+
+The byte strings validators sign. Must match the reference exactly:
+CanonicalVote / CanonicalProposal (reference: types/canonical.go:42-66,
+proto/tendermint/types/canonical.proto) marshalled with a varint length
+prefix (protoio.MarshalDelimited — reference: types/vote.go:93-101,
+types/proposal.go:110-118).
+
+Height and round are sfixed64 here (canonicalization requires fixed-size
+encoding, per the comment in canonical.proto) while the non-canonical
+Vote/Proposal messages use varints.
+"""
+
+from __future__ import annotations
+
+from ..encoding.proto import ProtoWriter, length_prefixed
+from .block_id import BlockID
+from .timestamp import encode_timestamp
+
+__all__ = [
+    "PREVOTE_TYPE",
+    "PRECOMMIT_TYPE",
+    "PROPOSAL_TYPE",
+    "canonical_block_id",
+    "canonical_vote_bytes",
+    "vote_sign_bytes",
+    "proposal_sign_bytes",
+]
+
+# SignedMsgType enum (proto/tendermint/types/types.pb.go SignedMsgType:
+# prevote=1, precommit=2, proposal=32)
+PREVOTE_TYPE = 1
+PRECOMMIT_TYPE = 2
+PROPOSAL_TYPE = 32
+
+
+def canonical_block_id(block_id: BlockID) -> bytes | None:
+    """CanonicalBlockID body, or None for a zero BlockID (nil votes carry
+    no block_id field at all — reference: types/canonical.go:18-34)."""
+    if block_id.is_zero():
+        return None
+    w = ProtoWriter()
+    w.bytes(1, block_id.hash)
+    # CanonicalPartSetHeader, gogoproto nullable=false → always written
+    psh = ProtoWriter()
+    psh.uint(1, block_id.part_set_header.total)
+    psh.bytes(2, block_id.part_set_header.hash)
+    w.message(2, psh.finish())
+    return w.finish()
+
+
+def canonical_vote_bytes(
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+    chain_id: str,
+) -> bytes:
+    """CanonicalVote message body (no length prefix)."""
+    w = ProtoWriter()
+    w.int(1, msg_type)
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    w.message(4, canonical_block_id(block_id))
+    # Timestamp, nullable=false → always written, even epoch zero
+    w.message(5, encode_timestamp(timestamp_ns))
+    w.string(6, chain_id)
+    return w.finish()
+
+
+def vote_sign_bytes(
+    chain_id: str,
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+) -> bytes:
+    """The exact bytes a validator signs for a vote
+    (reference: types/vote.go:93)."""
+    return length_prefixed(
+        canonical_vote_bytes(
+            msg_type, height, round_, block_id, timestamp_ns, chain_id
+        )
+    )
+
+
+def proposal_sign_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id: BlockID,
+    timestamp_ns: int,
+) -> bytes:
+    """CanonicalProposal sign-bytes (reference: types/proposal.go:110,
+    types/canonical.go:42-53). pol_round is varint int64; -1 means none."""
+    w = ProtoWriter()
+    w.int(1, PROPOSAL_TYPE)
+    w.sfixed64(2, height)
+    w.sfixed64(3, round_)
+    w.int(4, pol_round)
+    w.message(5, canonical_block_id(block_id))
+    w.message(6, encode_timestamp(timestamp_ns))
+    w.string(7, chain_id)
+    return length_prefixed(w.finish())

@@ -1,0 +1,74 @@
+"""Canonical timestamps.
+
+Consensus-critical times are integer nanoseconds since the Unix epoch
+(UTC). The reference passes Go time.Time around and marshals it as
+google.protobuf.Timestamp {seconds=1, nanos=2} inside sign-bytes
+(reference: types/canonical.go:13,70-75, gogoproto stdtime); an integer
+avoids Go's monotonic-clock/locale pitfalls entirely while encoding to the
+identical wire bytes.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from datetime import datetime, timezone
+
+from ..encoding.proto import FieldReader, ProtoWriter
+
+__all__ = [
+    "encode_timestamp",
+    "decode_timestamp",
+    "now_ns",
+    "to_rfc3339",
+    "from_rfc3339",
+    "canonical_ns",
+]
+
+NS = 1_000_000_000
+
+
+def now_ns() -> int:
+    return _time.time_ns()
+
+
+def encode_timestamp(ns: int) -> bytes:
+    """google.protobuf.Timestamp wire encoding."""
+    seconds, nanos = divmod(ns, NS)
+    w = ProtoWriter()
+    w.int(1, seconds)
+    w.int(2, nanos)
+    return w.finish()
+
+
+def decode_timestamp(data: bytes) -> int:
+    r = FieldReader(data)
+    return r.int64(1) * NS + r.int64(2)
+
+
+def canonical_ns(ns: int) -> int:
+    """Truncate to millisecond precision like libs/time.Canonical
+    (reference: libs/time/time.go Canonical: UTC + truncate to ms)."""
+    return ns - ns % 1_000_000
+
+
+def to_rfc3339(ns: int) -> str:
+    seconds, nanos = divmod(ns, NS)
+    dt = datetime.fromtimestamp(seconds, tz=timezone.utc)
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    if nanos:
+        frac = f"{nanos:09d}".rstrip("0")
+        return f"{base}.{frac}Z"
+    return base + "Z"
+
+
+def from_rfc3339(s: str) -> int:
+    if s.endswith("Z"):
+        s = s[:-1]
+    frac = 0
+    if "." in s:
+        s, frac_s = s.split(".")
+        frac = int(frac_s.ljust(9, "0")[:9])
+    dt = datetime.strptime(s, "%Y-%m-%dT%H:%M:%S").replace(
+        tzinfo=timezone.utc
+    )
+    return int(dt.timestamp()) * NS + frac

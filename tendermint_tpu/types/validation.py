@@ -1,0 +1,283 @@
+"""Commit verification — the framework's north-star hot path.
+
+Mirrors types/validation.go exactly: VerifyCommit (:25, checks ALL sigs
+for incentivization), VerifyCommitLight (:59, stops at 2/3),
+VerifyCommitLightTrusting (:94, fraction of a *trusted* set, lookup by
+address), and the batch/single pair (:152/:265). The batch path packs a
+whole Commit's (pubkey, sign-bytes, signature) triples into one
+crypto.batch verifier — on TPU that is a single device program over the
+padded batch (tendermint_tpu.ops.ed25519_kernel), sharded across the
+mesh for large validator sets (tendermint_tpu.parallel.sharding).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..crypto.batch import create_batch_verifier, supports_batch_verifier
+from .block_id import BlockID
+from .commit import Commit, CommitSig
+from .validator import ValidatorSet
+
+__all__ = [
+    "BATCH_VERIFY_THRESHOLD",
+    "Fraction",
+    "NotEnoughVotingPowerError",
+    "InvalidCommitError",
+    "verify_commit",
+    "verify_commit_light",
+    "verify_commit_light_trusting",
+]
+
+BATCH_VERIFY_THRESHOLD = 2  # reference: types/validation.go:12
+
+
+@dataclass(frozen=True)
+class Fraction:
+    """Trust level, e.g. 1/3 (reference: libs/math/fraction.go)."""
+
+    numerator: int
+    denominator: int
+
+    def validate(self) -> None:
+        if self.denominator == 0:
+            raise ValueError("fraction has zero denominator")
+
+
+class InvalidCommitError(ValueError):
+    pass
+
+
+class NotEnoughVotingPowerError(InvalidCommitError):
+    def __init__(self, got: int, needed: int) -> None:
+        super().__init__(
+            f"invalid commit -- insufficient voting power: got {got}, "
+            f"needed more than {needed}"
+        )
+        self.got = got
+        self.needed = needed
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    return len(
+        commit.signatures
+    ) >= BATCH_VERIFY_THRESHOLD and supports_batch_verifier(
+        vals.get_proposer().pub_key
+    )
+
+
+def verify_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+) -> None:
+    """+2/3 signed, verifying ALL signatures (incentivization needs the
+    full bitmap — reference: types/validation.go:18-51)."""
+    _verify_basic(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.is_absent()  # noqa: E731
+    count = lambda c: c.is_for_block()  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed,
+            ignore, count, True, True,
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed,
+            ignore, count, True, True,
+        )
+
+
+def verify_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+) -> None:
+    """+2/3 signed, early exit once the tally crosses 2/3
+    (reference: types/validation.go:55-85)."""
+    _verify_basic(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: not c.is_for_block()  # noqa: E731
+    count = lambda c: True  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed,
+            ignore, count, False, True,
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed,
+            ignore, count, False, True,
+        )
+
+
+def verify_commit_light_trusting(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    trust_level: Fraction,
+) -> None:
+    """trust_level (e.g. 1/3) of a TRUSTED validator set signed; lookup
+    by address since sets needn't match
+    (reference: types/validation.go:87-131)."""
+    if vals is None:
+        raise InvalidCommitError("nil validator set")
+    trust_level.validate()
+    if commit is None:
+        raise InvalidCommitError("nil commit")
+    total_mul = vals.total_voting_power() * trust_level.numerator
+    if total_mul >= 1 << 63:
+        raise InvalidCommitError(
+            "int64 overflow while calculating voting power needed"
+        )
+    voting_power_needed = total_mul // trust_level.denominator
+    ignore = lambda c: not c.is_for_block()  # noqa: E731
+    count = lambda c: True  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed,
+            ignore, count, False, False,
+        )
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed,
+            ignore, count, False, False,
+        )
+
+
+def _verify_basic(
+    vals: Optional[ValidatorSet],
+    commit: Optional[Commit],
+    height: int,
+    block_id: BlockID,
+) -> None:
+    """reference: types/validation.go:330-352."""
+    if vals is None:
+        raise InvalidCommitError("nil validator set")
+    if commit is None:
+        raise InvalidCommitError("nil commit")
+    if vals.size() != len(commit.signatures):
+        raise InvalidCommitError(
+            f"invalid commit -- wrong set size: {vals.size()} vs "
+            f"{len(commit.signatures)}"
+        )
+    if height != commit.height:
+        raise InvalidCommitError(
+            f"invalid commit -- wrong height: {height} vs {commit.height}"
+        )
+    if block_id != commit.block_id:
+        raise InvalidCommitError(
+            f"invalid commit -- wrong block ID: want {block_id}, "
+            f"got {commit.block_id}"
+        )
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """reference: types/validation.go:152-262. One device call for the
+    whole commit; on failure the bitmap localizes the first bad index."""
+    tallied = 0
+    seen_vals: dict[int, int] = {}
+    batch_sig_idxs: list[int] = []
+    bv = create_batch_verifier(
+        vals.get_proposer().pub_key, size_hint=len(commit.signatures)
+    )
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(
+                commit_sig.validator_address
+            )
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise InvalidCommitError(
+                    f"double vote from {val.address.hex()} "
+                    f"({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        batch_sig_idxs.append(idx)
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+    ok, valid_sigs = bv.verify()
+    if ok:
+        return
+    for i, sig_ok in enumerate(valid_sigs):
+        if not sig_ok:
+            idx = batch_sig_idxs[i]
+            raise InvalidCommitError(
+                f"wrong signature (#{idx}): "
+                f"{commit.signatures[idx].signature.hex()}"
+            )
+    raise RuntimeError(
+        "BUG: batch verification failed with no invalid signatures"
+    )
+
+
+def _verify_commit_single(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """reference: types/validation.go:265-328."""
+    tallied = 0
+    seen_vals: dict[int, int] = {}
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(
+                commit_sig.validator_address
+            )
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise InvalidCommitError(
+                    f"double vote from {val.address.hex()} "
+                    f"({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        if not val.pub_key.verify_signature(
+            vote_sign_bytes, commit_sig.signature
+        ):
+            raise InvalidCommitError(
+                f"wrong signature (#{idx}): "
+                f"{commit_sig.signature.hex()}"
+            )
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(tallied, voting_power_needed)

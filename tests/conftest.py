@@ -7,12 +7,21 @@ set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The ambient environment may pin JAX_PLATFORMS to the real TPU backend;
+# unit tests always run on a virtual 8-device CPU mesh so sharding and
+# collective paths are exercised deterministically (and the TPU tunnel is
+# left to bench.py). jax.config wins over the env pin.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,244 @@
+"""Storage: KV backends, BlockStore, StateStore."""
+
+import pytest
+
+from tendermint_tpu.state import (
+    ABCIResponses,
+    State,
+    StateStore,
+    state_from_genesis,
+)
+from tendermint_tpu.store import Batch, BlockStore, MemKV, SqliteKV
+from tendermint_tpu.types import Commit, GenesisDoc, GenesisValidator
+from tendermint_tpu.types.genesis import GenesisValidator
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+from .test_types import CHAIN_ID, make_validators
+
+
+@pytest.fixture(params=["mem", "sqlite"])
+def db(request, tmp_path):
+    if request.param == "mem":
+        yield MemKV()
+    else:
+        kv = SqliteKV(str(tmp_path / "test.sqlite"))
+        yield kv
+        kv.close()
+
+
+class TestKV:
+    def test_roundtrip_and_order(self, db):
+        db.set(b"b", b"2")
+        db.set(b"a", b"1")
+        db.set(b"c", b"3")
+        assert db.get(b"a") == b"1"
+        assert [k for k, _ in db.iterate()] == [b"a", b"b", b"c"]
+        assert [k for k, _ in db.iterate(reverse=True)] == [b"c", b"b", b"a"]
+        assert [k for k, _ in db.iterate(b"b")] == [b"b", b"c"]
+        assert [k for k, _ in db.iterate(b"a", b"c")] == [b"a", b"b"]
+
+    def test_batch_atomic(self, db):
+        b = Batch()
+        b.set(b"x", b"1")
+        b.set(b"y", b"2")
+        b.delete(b"x")
+        db.write_batch(b)
+        assert db.get(b"x") is None
+        assert db.get(b"y") == b"2"
+
+
+def make_chain_block(height, prev_commit=None):
+    """A minimal valid block at `height` for store tests."""
+    from tendermint_tpu.types import make_block
+
+    b = make_block(height, [b"tx-%d" % height], prev_commit or Commit(), [])
+    b.header.chain_id = CHAIN_ID
+    b.header.validators_hash = b"\x01" * 32
+    b.header.next_validators_hash = b"\x01" * 32
+    b.header.consensus_hash = b"\x02" * 32
+    b.header.proposer_address = b"\x03" * 20
+    return b
+
+
+class TestBlockStore:
+    def test_empty(self, db):
+        bs = BlockStore(db)
+        assert bs.base() == 0
+        assert bs.height() == 0
+        assert bs.size() == 0
+        assert bs.load_block(1) is None
+
+    def test_save_load_roundtrip(self, db):
+        bs = BlockStore(db)
+        blocks = []
+        for h in range(1, 6):
+            b = make_chain_block(h)
+            parts = b.make_part_set(128)
+            seen = Commit(height=h)
+            bs.save_block(b, parts, seen)
+            blocks.append(b)
+        assert bs.base() == 1
+        assert bs.height() == 5
+        assert bs.size() == 5
+        b3 = bs.load_block(3)
+        assert b3.hash() == blocks[2].hash()
+        meta = bs.load_block_meta(3)
+        assert meta.header.height == 3
+        assert meta.num_txs == 1
+        by_hash = bs.load_block_by_hash(blocks[2].hash())
+        assert by_hash.header.height == 3
+        part = bs.load_block_part(3, 0)
+        assert part is not None and part.index == 0
+
+    def test_save_rejects_gap(self, db):
+        bs = BlockStore(db)
+        b1 = make_chain_block(1)
+        bs.save_block(b1, b1.make_part_set(128), Commit(height=1))
+        b5 = make_chain_block(5)
+        with pytest.raises(ValueError, match="expected 2"):
+            bs.save_block(b5, b5.make_part_set(128), Commit(height=5))
+
+    def test_prune(self, db):
+        bs = BlockStore(db)
+        for h in range(1, 6):
+            b = make_chain_block(h)
+            bs.save_block(b, b.make_part_set(128), Commit(height=h))
+        pruned = bs.prune_blocks(4)
+        assert pruned == 3
+        assert bs.base() == 4
+        assert bs.height() == 5
+        assert bs.load_block(2) is None
+        assert bs.load_block(4) is not None
+
+
+def make_genesis(n=3):
+    privs = [
+        PrivKeyEd25519.from_seed(bytes([i + 1]) * 32) for i in range(n)
+    ]
+    return GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000 * 10**9,
+        validators=[
+            GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
+        ],
+    ), privs
+
+
+class TestStateStore:
+    def test_genesis_state_save_load(self, db):
+        gen, _ = make_genesis()
+        st = state_from_genesis(gen)
+        ss = StateStore(db)
+        ss.save(st)
+        loaded = ss.load()
+        assert loaded.chain_id == CHAIN_ID
+        assert loaded.last_block_height == 0
+        assert loaded.validators.hash() == st.validators.hash()
+        assert (
+            loaded.consensus_params.block.max_bytes
+            == st.consensus_params.block.max_bytes
+        )
+
+    def test_validators_by_height(self, db):
+        gen, _ = make_genesis()
+        st = state_from_genesis(gen)
+        ss = StateStore(db)
+        ss.save(st)
+        v1 = ss.load_validators(1)
+        assert v1 is not None
+        assert v1.hash() == st.validators.hash()
+        v2 = ss.load_validators(2)
+        assert v2 is not None
+
+    def test_params_by_height(self, db):
+        gen, _ = make_genesis()
+        st = state_from_genesis(gen)
+        ss = StateStore(db)
+        ss.save(st)
+        p = ss.load_params(1)
+        assert p is not None
+        assert p.block.max_bytes == st.consensus_params.block.max_bytes
+
+    def test_abci_responses(self, db):
+        ss = StateStore(db)
+        resp = ABCIResponses(deliver_txs=[b"\x08\x01", b""], end_block=b"")
+        ss.save_abci_responses(7, resp)
+        loaded = ss.load_abci_responses(7)
+        assert loaded.deliver_txs == [b"\x08\x01", b""]
+
+    def test_genesis_json_roundtrip(self, tmp_path):
+        gen, _ = make_genesis()
+        path = str(tmp_path / "genesis.json")
+        gen.save_as(path)
+        gen2 = GenesisDoc.from_file(path)
+        assert gen2.chain_id == gen.chain_id
+        assert gen2.genesis_time_ns == gen.genesis_time_ns
+        assert len(gen2.validators) == 3
+        assert (
+            gen2.validator_set().hash() == gen.validator_set().hash()
+        )
+
+
+class TestPruneAndRollback:
+    """Regression tests for sparse-pointer pruning and rollback
+    semantics (matching internal/state/store.go:243-330 and
+    internal/state/rollback.go:13-104)."""
+
+    def _grown_chain(self, db, heights=6):
+        """State store saved at each height with an unchanged val set
+        (so later records are sparse pointers to height 1)."""
+        gen, _ = make_genesis()
+        st = state_from_genesis(gen)
+        ss = StateStore(db)
+        ss.save(st)
+        for h in range(1, heights):
+            st = st.copy()
+            st.last_block_height = h
+            st.last_validators = st.validators
+            st.validators = st.next_validators
+            st.next_validators = st.next_validators.copy_increment_proposer_priority(1)
+            ss.save(st)
+        return ss, st
+
+    def test_prune_materializes_pointed_to_records(self, db):
+        ss, st = self._grown_chain(db)
+        assert ss.load_validators(5) is not None
+        ss.prune(5)
+        # records below 5 are gone, but 5+ still loadable
+        assert ss.load_validators(5) is not None
+        assert ss.load_validators(6) is not None
+        assert ss.load_params(5) is not None
+
+    def test_rollback(self, db):
+        from tendermint_tpu.store import MemKV
+
+        ss, st = self._grown_chain(db, heights=4)
+        bs = BlockStore(MemKV())
+        for h in range(1, 4):
+            b = make_chain_block(h)
+            bs.save_block(b, b.make_part_set(128), Commit(height=h))
+        rolled = ss.rollback(bs)
+        assert rolled.last_block_height == 2
+        # time comes from block 2's header, not block 3's
+        assert rolled.last_block_time_ns == bs.load_block_meta(2).header.time_ns
+        assert rolled.validators.hash() == st.last_validators.hash()
+
+    def test_rollback_noop_when_blockstore_ahead(self, db):
+        from tendermint_tpu.store import MemKV
+
+        ss, st = self._grown_chain(db, heights=3)  # state at height 2
+        bs = BlockStore(MemKV())
+        for h in range(1, 4):  # blockstore at height 3 (one ahead)
+            b = make_chain_block(h)
+            bs.save_block(b, b.make_part_set(128), Commit(height=h))
+        rolled = ss.rollback(bs)
+        assert rolled.last_block_height == st.last_block_height
+
+    def test_block_store_prune_removes_commits(self, db):
+        bs = BlockStore(db)
+        for h in range(1, 6):
+            b = make_chain_block(h)
+            bs.save_block(b, b.make_part_set(128), Commit(height=h))
+        bs.prune_blocks(4)
+        assert bs.load_block_commit(2) is None  # commit for pruned height
+        assert bs.load_block_commit(4) is not None

@@ -1,0 +1,324 @@
+"""Types layer: canonical encoding, votes, commits, headers, validator sets.
+
+Mirrors the reference's own test strategy (types/validation_test.go,
+types/validator_set_test.go, types/block_test.go): table-driven unit
+tests plus batch-vs-single equivalence.
+"""
+
+import pytest
+
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.types import (
+    PRECOMMIT_TYPE,
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSetHeader,
+    Proposal,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+    commit_to_vote_set,
+    make_block,
+)
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.vote_set import ConflictingVoteError
+
+CHAIN_ID = "test-chain"
+
+
+def make_validators(n, power=10):
+    """n deterministic validators with their privkeys, sorted as the
+    ValidatorSet sorts them."""
+    pairs = []
+    for i in range(n):
+        pk = PrivKeyEd25519.from_seed(bytes([i + 1]) * 32)
+        pairs.append(pk)
+    vals = ValidatorSet(
+        [
+            Validator(pub_key=pk.pub_key(), voting_power=power)
+            for pk in pairs
+        ]
+    )
+    by_addr = {pk.pub_key().address(): pk for pk in pairs}
+    privs = [by_addr[v.address] for v in vals.validators]
+    return vals, privs
+
+
+def make_block_id(seed=b"\x01"):
+    return BlockID(
+        hash=seed * 32,
+        part_set_header=PartSetHeader(total=1, hash=seed * 32),
+    )
+
+
+def signed_vote(priv, vals, idx, block_id, height=1, round_=0, ts=1000):
+    v = Vote(
+        type=PRECOMMIT_TYPE,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=ts,
+        validator_address=vals.validators[idx].address,
+        validator_index=idx,
+    )
+    v.signature = priv.sign(v.sign_bytes(CHAIN_ID))
+    return v
+
+
+class TestVote:
+    def test_sign_verify_roundtrip(self):
+        vals, privs = make_validators(1)
+        v = signed_vote(privs[0], vals, 0, make_block_id())
+        v.verify(CHAIN_ID, privs[0].pub_key())
+
+    def test_verify_rejects_wrong_chain(self):
+        vals, privs = make_validators(1)
+        v = signed_vote(privs[0], vals, 0, make_block_id())
+        with pytest.raises(ValueError):
+            v.verify("other-chain", privs[0].pub_key())
+
+    def test_proto_roundtrip(self):
+        vals, privs = make_validators(1)
+        v = signed_vote(privs[0], vals, 0, make_block_id())
+        v2 = Vote.from_proto(v.to_proto())
+        assert v2 == v
+
+    def test_nil_vote_sign_bytes_differ(self):
+        vals, privs = make_validators(1)
+        a = signed_vote(privs[0], vals, 0, make_block_id())
+        b = signed_vote(privs[0], vals, 0, BlockID())
+        assert a.sign_bytes(CHAIN_ID) != b.sign_bytes(CHAIN_ID)
+
+
+class TestProposal:
+    def test_sign_verify_proto(self):
+        priv = PrivKeyEd25519.from_seed(b"\x07" * 32)
+        p = Proposal(
+            height=3,
+            round=1,
+            pol_round=-1,
+            block_id=make_block_id(),
+            timestamp_ns=123456789,
+        )
+        p.signature = priv.sign(p.sign_bytes(CHAIN_ID))
+        assert p.verify(CHAIN_ID, priv.pub_key())
+        p2 = Proposal.from_proto(p.to_proto())
+        assert p2 == p
+        assert p2.pol_round == -1
+
+
+class TestValidatorSet:
+    def test_sorted_by_power_then_address(self):
+        privs = [PrivKeyEd25519.from_seed(bytes([i]) * 32) for i in range(1, 5)]
+        vals = ValidatorSet(
+            [
+                Validator(pub_key=privs[0].pub_key(), voting_power=5),
+                Validator(pub_key=privs[1].pub_key(), voting_power=50),
+                Validator(pub_key=privs[2].pub_key(), voting_power=20),
+                Validator(pub_key=privs[3].pub_key(), voting_power=20),
+            ]
+        )
+        powers = [v.voting_power for v in vals.validators]
+        assert powers == [50, 20, 20, 5]
+        # equal powers tie-break by address ascending
+        a, b = vals.validators[1], vals.validators[2]
+        assert a.address < b.address
+        assert vals.total_voting_power() == 95
+
+    def test_proposer_rotation_weighted(self):
+        vals, _ = make_validators(3)
+        # equal power: each validator proposes once per 3 rounds
+        seen = []
+        vs = vals.copy()
+        for _ in range(6):
+            seen.append(vs.get_proposer().address)
+            vs.increment_proposer_priority(1)
+        assert len(set(seen[:3])) == 3
+        assert seen[:3] == seen[3:6]
+
+    def test_proposer_frequency_proportional(self):
+        privs = [PrivKeyEd25519.from_seed(bytes([i]) * 32) for i in (1, 2)]
+        vals = ValidatorSet(
+            [
+                Validator(pub_key=privs[0].pub_key(), voting_power=3),
+                Validator(pub_key=privs[1].pub_key(), voting_power=1),
+            ]
+        )
+        heavy = max(
+            vals.validators, key=lambda v: v.voting_power
+        ).address
+        count = 0
+        vs = vals.copy()
+        for _ in range(40):
+            if vs.get_proposer().address == heavy:
+                count += 1
+            vs.increment_proposer_priority(1)
+        assert count == 30  # 3/4 of 40
+
+    def test_update_with_change_set(self):
+        vals, privs = make_validators(3)
+        new_priv = PrivKeyEd25519.from_seed(b"\x99" * 32)
+        vals.update_with_change_set(
+            [Validator(pub_key=new_priv.pub_key(), voting_power=7)]
+        )
+        assert vals.size() == 4
+        # remove one
+        vals.update_with_change_set(
+            [Validator(pub_key=new_priv.pub_key(), voting_power=0)]
+        )
+        assert vals.size() == 3
+
+    def test_hash_changes_with_membership(self):
+        vals, _ = make_validators(3)
+        vals2, _ = make_validators(4)
+        assert vals.hash() != vals2.hash()
+
+    def test_proto_roundtrip(self):
+        vals, _ = make_validators(3)
+        vals.get_proposer()
+        v2 = ValidatorSet.from_proto(vals.to_proto())
+        assert v2.hash() == vals.hash()
+        assert [v.address for v in v2.validators] == [
+            v.address for v in vals.validators
+        ]
+
+
+class TestVoteSet:
+    def test_quorum_and_commit(self):
+        vals, privs = make_validators(4)
+        bid = make_block_id()
+        vs = VoteSet(CHAIN_ID, 1, 0, PRECOMMIT_TYPE, vals)
+        assert not vs.has_two_thirds_majority()
+        for i in range(3):
+            assert vs.add_vote(signed_vote(privs[i], vals, i, bid))
+        assert vs.has_two_thirds_majority()
+        maj, ok = vs.two_thirds_majority()
+        assert ok and maj == bid
+        commit = vs.make_commit()
+        assert commit.size() == 4
+        assert commit.signatures[3].is_absent()
+        assert sum(1 for s in commit.signatures if s.is_for_block()) == 3
+
+    def test_duplicate_vote_not_added(self):
+        vals, privs = make_validators(4)
+        bid = make_block_id()
+        vs = VoteSet(CHAIN_ID, 1, 0, PRECOMMIT_TYPE, vals)
+        v = signed_vote(privs[0], vals, 0, bid)
+        assert vs.add_vote(v)
+        assert not vs.add_vote(v)
+
+    def test_conflicting_vote_raises(self):
+        vals, privs = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 1, 0, PRECOMMIT_TYPE, vals)
+        assert vs.add_vote(signed_vote(privs[0], vals, 0, make_block_id(b"\x01")))
+        with pytest.raises(ConflictingVoteError):
+            vs.add_vote(signed_vote(privs[0], vals, 0, make_block_id(b"\x02")))
+
+    def test_nil_votes_tally_but_no_block_majority(self):
+        vals, privs = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 1, 0, PRECOMMIT_TYPE, vals)
+        for i in range(3):
+            vs.add_vote(signed_vote(privs[i], vals, i, BlockID()))
+        assert vs.has_two_thirds_any()
+        maj, ok = vs.two_thirds_majority()
+        assert ok and maj == BlockID()  # 2/3 for nil
+
+    def test_commit_roundtrip_through_vote_set(self):
+        vals, privs = make_validators(4)
+        bid = make_block_id()
+        vs = VoteSet(CHAIN_ID, 5, 2, PRECOMMIT_TYPE, vals)
+        for i in range(4):
+            vs.add_vote(
+                signed_vote(privs[i], vals, i, bid, height=5, round_=2)
+            )
+        commit = vs.make_commit()
+        vs2 = commit_to_vote_set(CHAIN_ID, commit, vals)
+        assert vs2.has_two_thirds_majority()
+        c2 = vs2.make_commit()
+        assert c2.hash() == commit.hash()
+
+
+class TestCommit:
+    def test_proto_roundtrip(self):
+        vals, privs = make_validators(4)
+        bid = make_block_id()
+        vs = VoteSet(CHAIN_ID, 1, 0, PRECOMMIT_TYPE, vals)
+        for i in range(3):
+            vs.add_vote(signed_vote(privs[i], vals, i, bid))
+        commit = vs.make_commit()
+        c2 = Commit.from_proto(commit.to_proto())
+        assert c2.hash() == commit.hash()
+        assert c2.block_id == commit.block_id
+
+    def test_validate_basic(self):
+        c = Commit(height=1, round=0, block_id=make_block_id(), signatures=[])
+        with pytest.raises(ValueError, match="no signatures"):
+            c.validate_basic()
+
+
+class TestHeaderAndBlock:
+    def test_header_hash_deterministic_and_field_sensitive(self):
+        h = Header(
+            chain_id=CHAIN_ID,
+            height=3,
+            time_ns=1234,
+            validators_hash=b"\x01" * 32,
+            next_validators_hash=b"\x02" * 32,
+            consensus_hash=b"\x03" * 32,
+            proposer_address=b"\x04" * 20,
+        )
+        h1 = h.hash()
+        assert len(h1) == 32
+        h.height = 4
+        assert h.hash() != h1
+
+    def test_header_hash_empty_without_validators_hash(self):
+        assert Header(chain_id=CHAIN_ID, height=1).hash() == b""
+
+    def test_header_proto_roundtrip(self):
+        h = Header(
+            chain_id=CHAIN_ID,
+            height=3,
+            time_ns=1234,
+            validators_hash=b"\x01" * 32,
+            proposer_address=b"\x04" * 20,
+        )
+        h2 = Header.from_proto(h.to_proto())
+        assert h2 == h
+
+    def test_block_roundtrip_and_part_set(self):
+        commit = Commit()
+        b = make_block(1, [b"tx1", b"tx2"], commit, [])
+        b.header.validators_hash = b"\x01" * 32
+        b.header.next_validators_hash = b"\x01" * 32
+        b.header.consensus_hash = b"\x02" * 32
+        b.header.proposer_address = b"\x03" * 20
+        assert len(b.hash()) == 32
+        ps = b.make_part_set(64)
+        assert ps.is_complete()
+        b2 = type(b).from_proto(ps.assemble())
+        assert b2.hash() == b.hash()
+        assert b2.txs == [b"tx1", b"tx2"]
+
+
+class TestPartSet:
+    def test_add_part_verifies_proof(self):
+        data = bytes(range(256)) * 10
+        ps = PartSet.from_data(data, part_size=128)
+        rebuilt = PartSet.from_header(ps.header())
+        for p in ps.parts:
+            assert rebuilt.add_part(p)
+        assert rebuilt.is_complete()
+        assert rebuilt.assemble() == data
+
+    def test_add_part_rejects_corrupt(self):
+        data = b"x" * 300
+        ps = PartSet.from_data(data, part_size=128)
+        rebuilt = PartSet.from_header(ps.header())
+        bad = ps.parts[0]
+        bad.bytes = b"y" + bad.bytes[1:]
+        with pytest.raises(ValueError, match="invalid proof"):
+            rebuilt.add_part(bad)

@@ -1,0 +1,172 @@
+"""VerifyCommit family — batch/single equivalence and device parity.
+
+The reference's own pattern (types/validation_test.go): every case must
+produce the same outcome whether verified signature-by-signature or as
+one batch — and here additionally when the batch runs on the device
+kernel.
+"""
+
+import pytest
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.crypto.tpu_verifier import TpuEd25519BatchVerifier
+from tendermint_tpu.types import (
+    BlockID,
+    CommitSig,
+    Fraction,
+    InvalidCommitError,
+    NotEnoughVotingPowerError,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from tendermint_tpu.types.validation import (
+    _verify_commit_single,
+)
+
+from .test_types import (
+    CHAIN_ID,
+    make_block_id,
+    make_validators,
+    signed_vote,
+)
+from tendermint_tpu.types import PRECOMMIT_TYPE, VoteSet
+
+
+def make_commit(n=4, signers=None, height=1, round_=0):
+    """Commit with an explicit signer subset (may lack a majority —
+    built directly rather than via VoteSet, like the reference's
+    validation tests construct arbitrary commits)."""
+    from tendermint_tpu.types import Commit
+
+    vals, privs = make_validators(n)
+    bid = make_block_id()
+    signers = set(range(n) if signers is None else signers)
+    sigs = []
+    for i in range(n):
+        if i in signers:
+            v = signed_vote(
+                privs[i], vals, i, bid, height=height, round_=round_
+            )
+            sigs.append(
+                CommitSig.for_block(
+                    v.signature, v.validator_address, v.timestamp_ns
+                )
+            )
+        else:
+            sigs.append(CommitSig.absent())
+    return vals, bid, Commit(
+        height=height, round=round_, block_id=bid, signatures=sigs
+    )
+
+
+class TestVerifyCommit:
+    def test_all_signed_ok(self):
+        vals, bid, commit = make_commit(4)
+        verify_commit(CHAIN_ID, vals, bid, 1, commit)
+        verify_commit_light(CHAIN_ID, vals, bid, 1, commit)
+        verify_commit_light_trusting(
+            CHAIN_ID, vals, commit, Fraction(1, 3)
+        )
+
+    def test_two_thirds_exactly_insufficient(self):
+        # 2 of 4 equal-power signers is NOT > 2/3
+        vals, bid, commit = make_commit(4, signers=[0, 1])
+        with pytest.raises(NotEnoughVotingPowerError):
+            verify_commit(CHAIN_ID, vals, bid, 1, commit)
+
+    def test_three_quarters_sufficient(self):
+        vals, bid, commit = make_commit(4, signers=[0, 1, 2])
+        verify_commit(CHAIN_ID, vals, bid, 1, commit)
+
+    def test_wrong_height_rejected(self):
+        vals, bid, commit = make_commit(4)
+        with pytest.raises(InvalidCommitError, match="height"):
+            verify_commit(CHAIN_ID, vals, bid, 2, commit)
+
+    def test_wrong_block_id_rejected(self):
+        vals, bid, commit = make_commit(4)
+        with pytest.raises(InvalidCommitError, match="block ID"):
+            verify_commit(
+                CHAIN_ID, vals, make_block_id(b"\x09"), 1, commit
+            )
+
+    def test_corrupt_signature_rejected_with_index(self):
+        vals, bid, commit = make_commit(4)
+        sig = bytearray(commit.signatures[2].signature)
+        sig[0] ^= 0xFF
+        commit.signatures[2].signature = bytes(sig)
+        with pytest.raises(InvalidCommitError, match=r"#2"):
+            verify_commit(CHAIN_ID, vals, bid, 1, commit)
+
+    def test_set_size_mismatch(self):
+        vals, bid, commit = make_commit(4)
+        commit.signatures.append(CommitSig.absent())
+        with pytest.raises(InvalidCommitError, match="wrong set size"):
+            verify_commit(CHAIN_ID, vals, bid, 1, commit)
+
+    def test_batch_single_equivalence(self):
+        """reference: types/validation.go:146-148 — the batch path and
+        single path must agree on every input."""
+        cases = [
+            make_commit(4),
+            make_commit(4, signers=[0, 1, 2]),
+            make_commit(7, signers=[0, 2, 3, 5, 6]),
+        ]
+        for vals, bid, commit in cases:
+            verify_commit(CHAIN_ID, vals, bid, 1, commit)  # batch (CPU)
+            _verify_commit_single(
+                CHAIN_ID,
+                vals,
+                commit,
+                vals.total_voting_power() * 2 // 3,
+                lambda c: c.is_absent(),
+                lambda c: c.is_for_block(),
+                True,
+                True,
+            )
+
+    def test_light_trusting_lookup_by_address(self):
+        # trusted set = subset of signers' set: lookup must go by address
+        vals, bid, commit = make_commit(4)
+        # the full set passes at 1/3
+        verify_commit_light_trusting(
+            CHAIN_ID, vals, commit, Fraction(1, 3)
+        )
+        # only 2 of 4 signed: fails a 2/3 trust level
+        vals2, _bid2, commit2 = make_commit(4, signers=[0, 1])
+        with pytest.raises(NotEnoughVotingPowerError):
+            verify_commit_light_trusting(
+                CHAIN_ID, vals2, commit2, Fraction(2, 3)
+            )
+
+
+class TestDeviceCommitVerify:
+    """Device parity: the TPU kernel path must agree with CPU on every
+    commit (differential test, SURVEY.md §4 item d)."""
+
+    @pytest.fixture(autouse=True)
+    def install_device(self):
+        from tendermint_tpu.crypto import tpu_verifier
+
+        tpu_verifier.install(min_batch=2)
+        yield
+        crypto_batch._DEVICE_FACTORIES.clear()
+
+    def test_device_verify_valid_commit(self):
+        vals, bid, commit = make_commit(4)
+        verify_commit(CHAIN_ID, vals, bid, 1, commit)
+
+    def test_device_flags_bad_signature(self):
+        vals, bid, commit = make_commit(4)
+        sig = bytearray(commit.signatures[1].signature)
+        sig[1] ^= 0x01
+        commit.signatures[1].signature = bytes(sig)
+        with pytest.raises(InvalidCommitError, match=r"#1"):
+            verify_commit(CHAIN_ID, vals, bid, 1, commit)
+
+    def test_device_verifier_used(self):
+        v = crypto_batch.create_batch_verifier(
+            make_validators(1)[0].validators[0].pub_key, size_hint=100
+        )
+        assert isinstance(v, TpuEd25519BatchVerifier)
