@@ -1,1 +1,14 @@
+"""ABCI — the application blockchain interface (reference: abci/)."""
 
+from . import types  # noqa: F401
+from .client import (  # noqa: F401
+    ABCIClient,
+    LocalClient,
+    SocketClient,
+    local_creator,
+    socket_creator,
+)
+from .kvstore import KVStoreApplication  # noqa: F401
+from .proxy import AppConns  # noqa: F401
+from .server import SocketServer  # noqa: F401
+from .types import Application, BaseApplication  # noqa: F401

@@ -1,0 +1,107 @@
+"""ABCI socket server — serves an Application to out-of-process nodes.
+
+reference: abci/server/socket_server.go (varint-framed request loop per
+connection) and abci/server/server.go (NewServer switch on transport).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..encoding.proto import encode_varint
+from ..libs.service import Service
+from . import types as T
+from .client import _read_delimited
+from .codec import decode_request, encode_response
+
+__all__ = ["SocketServer"]
+
+
+class SocketServer(Service):
+    def __init__(self, address: str, app: T.Application) -> None:
+        super().__init__(name="abci.server")
+        self.address = address
+        self.app = app
+        self._server: Optional[asyncio.base_events.Server] = None
+        # One lock for the app across all connections: the reference's apps
+        # guard internal state themselves; here the server is the guard.
+        self._app_lock = asyncio.Lock()
+
+    async def on_start(self) -> None:
+        if self.address.startswith("unix://"):
+            self._server = await asyncio.start_unix_server(
+                self._handle, self.address[len("unix://") :]
+            )
+        else:
+            hostport = (
+                self.address[len("tcp://") :]
+                if self.address.startswith("tcp://")
+                else self.address
+            )
+            host, _, port = hostport.rpartition(":")
+            self._server = await asyncio.start_server(
+                self._handle, host or "127.0.0.1", int(port)
+            )
+
+    @property
+    def listen_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                msg = await _read_delimited(reader)
+                req = decode_request(msg)
+                try:
+                    resp = await self._dispatch(req)
+                except Exception as e:  # app bug → exception response
+                    self.logger.exception("abci app raised")
+                    resp = T.ResponseException(error=str(e))
+                body = encode_response(resp)
+                writer.write(encode_varint(len(body)) + body)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def _dispatch(self, req):
+        if isinstance(req, T.RequestEcho):
+            return T.ResponseEcho(message=req.message)
+        if isinstance(req, T.RequestFlush):
+            return T.ResponseFlush()
+        async with self._app_lock:
+            if isinstance(req, T.RequestInfo):
+                return self.app.info(req)
+            if isinstance(req, T.RequestQuery):
+                return self.app.query(req)
+            if isinstance(req, T.RequestCheckTx):
+                return self.app.check_tx(req)
+            if isinstance(req, T.RequestInitChain):
+                return self.app.init_chain(req)
+            if isinstance(req, T.RequestBeginBlock):
+                return self.app.begin_block(req)
+            if isinstance(req, T.RequestDeliverTx):
+                return self.app.deliver_tx(req)
+            if isinstance(req, T.RequestEndBlock):
+                return self.app.end_block(req)
+            if isinstance(req, T.RequestCommit):
+                return self.app.commit()
+            if isinstance(req, T.RequestListSnapshots):
+                return self.app.list_snapshots(req)
+            if isinstance(req, T.RequestOfferSnapshot):
+                return self.app.offer_snapshot(req)
+            if isinstance(req, T.RequestLoadSnapshotChunk):
+                return self.app.load_snapshot_chunk(req)
+            if isinstance(req, T.RequestApplySnapshotChunk):
+                return self.app.apply_snapshot_chunk(req)
+        raise ValueError(f"unknown ABCI request {type(req).__name__}")

@@ -320,6 +320,7 @@ class StateStore:
         new_state.last_validators = prev_last_vals
         new_state.last_height_validators_changed = val_change
         new_state.consensus_params = params
+        new_state.app_version = params.version.app_version
         new_state.last_height_consensus_params_changed = params_change
         new_state.app_hash = meta.header.app_hash
         new_state.last_results_hash = meta.header.last_results_hash

@@ -171,21 +171,23 @@ class BlockStore:
         """Backfill (reverse-sync) storage of header+commit without the
         full block (reference: internal/store/store.go:533-570)."""
         height = sh.header.height
-        if self.load_block_meta(height) is not None:
-            raise ValueError(
-                f"block meta already exists at height {height}"
+        with self._lock:
+            if self.load_block_meta(height) is not None:
+                raise ValueError(
+                    f"block meta already exists at height {height}"
+                )
+            batch = Batch()
+            meta = BlockMeta(
+                block_id=block_id, block_size=-1, header=sh.header, num_txs=-1
             )
-        batch = Batch()
-        meta = BlockMeta(
-            block_id=block_id, block_size=-1, header=sh.header, num_txs=-1
-        )
-        batch.set(_meta_key(height), meta.to_proto())
-        batch.set(_commit_key(height - 1), sh.commit.to_proto())
-        batch.set(_hash_key(sh.header.hash()), struct.pack(">q", height))
-        self._db.write_batch(batch)
+            batch.set(_meta_key(height), meta.to_proto())
+            batch.set(_commit_key(height - 1), sh.commit.to_proto())
+            batch.set(_hash_key(sh.header.hash()), struct.pack(">q", height))
+            self._db.write_batch(batch)
 
     def save_seen_commit(self, seen_commit: Commit) -> None:
-        self._db.set(_seen_commit_key(), seen_commit.to_proto())
+        with self._lock:
+            self._db.set(_seen_commit_key(), seen_commit.to_proto())
 
     # -- pruning --
 

@@ -161,8 +161,8 @@ class SqliteKV(KVStore):
             self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
             self._conn.commit()
 
-    def iterate(self, start=None, end=None, reverse=False):
-        q = "SELECT k, v FROM kv"
+    def _range_query(self, select, start, end, reverse, limit=None):
+        q = select
         cond, args = [], []
         if start is not None:
             cond.append("k >= ?")
@@ -173,10 +173,28 @@ class SqliteKV(KVStore):
         if cond:
             q += " WHERE " + " AND ".join(cond)
         q += " ORDER BY k" + (" DESC" if reverse else "")
+        if limit is not None:
+            q += f" LIMIT {int(limit)}"
+        return q, args
+
+    def iterate(self, start=None, end=None, reverse=False):
+        q, args = self._range_query("SELECT k, v FROM kv", start, end, reverse)
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()
         for k, v in rows:
             yield bytes(k), bytes(v)
+
+    def first_key(self, start=None, end=None):
+        q, args = self._range_query("SELECT k FROM kv", start, end, False, 1)
+        with self._lock:
+            row = self._conn.execute(q, args).fetchone()
+        return bytes(row[0]) if row else None
+
+    def last_key(self, start=None, end=None):
+        q, args = self._range_query("SELECT k FROM kv", start, end, True, 1)
+        with self._lock:
+            row = self._conn.execute(q, args).fetchone()
+        return bytes(row[0]) if row else None
 
     def write_batch(self, batch: Batch) -> None:
         with self._lock:

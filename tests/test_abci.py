@@ -1,0 +1,262 @@
+"""ABCI layer tests: codec round-trips, local + socket clients, proxy mux,
+kvstore app semantics (reference test model: abci/example/example_test.go,
+abci/client/socket_client_test.go, abci/example/kvstore/kvstore_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import (
+    AppConns,
+    KVStoreApplication,
+    LocalClient,
+    SocketClient,
+    SocketServer,
+    local_creator,
+)
+from tendermint_tpu.abci import types as T
+from tendermint_tpu.abci.codec import (
+    decode_request,
+    decode_response,
+    encode_request,
+    encode_response,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+
+REQ_SAMPLES = [
+    T.RequestEcho(message="hello"),
+    T.RequestFlush(),
+    T.RequestInfo(version="v1", block_version=11, p2p_version=8, abci_version="0.17"),
+    T.RequestInitChain(
+        time_ns=123456789,
+        chain_id="test-chain",
+        validators=(
+            T.ValidatorUpdate(pub_key=T.PubKey("ed25519", b"\x01" * 32), power=10),
+        ),
+        app_state_bytes=b'{"x":1}',
+        initial_height=5,
+    ),
+    T.RequestQuery(data=b"k", path="/store", height=7, prove=True),
+    T.RequestBeginBlock(
+        hash=b"\xaa" * 32,
+        header_bytes=b"\x0a\x00",
+        last_commit_info=T.LastCommitInfo(
+            round=2,
+            votes=(
+                T.VoteInfo(
+                    validator=T.Validator(address=b"\x02" * 20, power=3),
+                    signed_last_block=True,
+                ),
+            ),
+        ),
+        byzantine_validators=(
+            T.Misbehavior(
+                kind=T.MISBEHAVIOR_DUPLICATE_VOTE,
+                validator=T.Validator(address=b"\x03" * 20, power=4),
+                height=9,
+                time_ns=1111,
+                total_voting_power=100,
+            ),
+        ),
+    ),
+    T.RequestCheckTx(tx=b"a=1", type=T.CheckTxType.RECHECK),
+    T.RequestDeliverTx(tx=b"a=1"),
+    T.RequestEndBlock(height=12),
+    T.RequestCommit(),
+    T.RequestListSnapshots(),
+    T.RequestOfferSnapshot(
+        snapshot=T.Snapshot(height=10, format=1, chunks=3, hash=b"\x04" * 32),
+        app_hash=b"\x05" * 32,
+    ),
+    T.RequestLoadSnapshotChunk(height=10, format=1, chunk=2),
+    T.RequestApplySnapshotChunk(index=1, chunk=b"chunk", sender="peer1"),
+]
+
+RESP_SAMPLES = [
+    T.ResponseException(error="boom"),
+    T.ResponseEcho(message="hello"),
+    T.ResponseFlush(),
+    T.ResponseInfo(
+        data="{}", version="kv/1", app_version=1, last_block_height=4,
+        last_block_app_hash=b"\x06" * 32,
+    ),
+    T.ResponseInitChain(app_hash=b"\x07" * 32),
+    T.ResponseQuery(code=0, key=b"k", value=b"v", height=4, log="exists"),
+    T.ResponseBeginBlock(
+        events=(
+            T.Event(
+                type="begin",
+                attributes=(T.EventAttribute(b"k", b"v", True),),
+            ),
+        )
+    ),
+    T.ResponseCheckTx(code=0, gas_wanted=1, priority=9, sender="s"),
+    T.ResponseDeliverTx(
+        code=0,
+        data=b"result",
+        events=(T.Event(type="app", attributes=(T.EventAttribute(b"a", b"b"),)),),
+    ),
+    T.ResponseEndBlock(
+        validator_updates=(
+            T.ValidatorUpdate(pub_key=T.PubKey("ed25519", b"\x08" * 32), power=0),
+        )
+    ),
+    T.ResponseCommit(data=b"\x09" * 32, retain_height=2),
+    T.ResponseListSnapshots(
+        snapshots=(T.Snapshot(height=1, format=1, chunks=1, hash=b"\x0a" * 32),)
+    ),
+    T.ResponseOfferSnapshot(result=T.OFFER_SNAPSHOT_ACCEPT),
+    T.ResponseLoadSnapshotChunk(chunk=b"bytes"),
+    T.ResponseApplySnapshotChunk(
+        result=T.APPLY_CHUNK_RETRY, refetch_chunks=(0, 2), reject_senders=("bad",)
+    ),
+]
+
+
+@pytest.mark.parametrize("req", REQ_SAMPLES, ids=lambda r: type(r).__name__)
+def test_request_roundtrip(req):
+    assert decode_request(encode_request(req)) == req
+
+
+@pytest.mark.parametrize("resp", RESP_SAMPLES, ids=lambda r: type(r).__name__)
+def test_response_roundtrip(resp):
+    assert decode_response(encode_response(resp)) == resp
+
+
+# ---------------------------------------------------------------------------
+# kvstore app
+
+
+def test_kvstore_set_get_commit():
+    app = KVStoreApplication()
+    assert app.check_tx(T.RequestCheckTx(tx=b"name=alice")).is_ok
+    app.begin_block(T.RequestBeginBlock())
+    assert app.deliver_tx(T.RequestDeliverTx(tx=b"name=alice")).is_ok
+    app.end_block(T.RequestEndBlock(height=1))
+    c1 = app.commit()
+    assert c1.data != b""
+
+    r = app.query(T.RequestQuery(data=b"name"))
+    assert r.value == b"alice"
+    # bare tx stores key=key
+    app.deliver_tx(T.RequestDeliverTx(tx=b"solo"))
+    assert app.query(T.RequestQuery(data=b"solo")).value == b"solo"
+    # app hash changes deterministically with state
+    c2 = app.commit()
+    assert c2.data != c1.data
+    app2 = KVStoreApplication()
+    app2.deliver_tx(T.RequestDeliverTx(tx=b"name=alice"))
+    app2.deliver_tx(T.RequestDeliverTx(tx=b"solo"))
+    assert app2.commit().data == c2.data
+
+
+def test_kvstore_validator_updates():
+    app = KVStoreApplication()
+    pk = b"\x11" * 32
+    tx = f"val:{pk.hex()}!7".encode()
+    assert app.check_tx(T.RequestCheckTx(tx=tx)).is_ok
+    assert not app.check_tx(T.RequestCheckTx(tx=b"val:zz!1")).is_ok
+    app.begin_block(T.RequestBeginBlock())
+    assert app.deliver_tx(T.RequestDeliverTx(tx=tx)).is_ok
+    resp = app.end_block(T.RequestEndBlock(height=1))
+    assert resp.validator_updates == (
+        T.ValidatorUpdate(pub_key=T.PubKey("ed25519", pk), power=7),
+    )
+    assert app.query(T.RequestQuery(path="/val", data=pk.hex().encode())).value == b"7"
+
+
+def test_kvstore_snapshot_restore():
+    app = KVStoreApplication()
+    for i in range(50):
+        app.deliver_tx(T.RequestDeliverTx(tx=f"k{i}=v{i}".encode()))
+    app.commit()
+    snap = app.take_snapshot()
+    assert app.list_snapshots(T.RequestListSnapshots()).snapshots[0] == snap
+
+    restored = KVStoreApplication()
+    assert (
+        restored.offer_snapshot(
+            T.RequestOfferSnapshot(snapshot=snap, app_hash=app.app_hash)
+        ).result
+        == T.OFFER_SNAPSHOT_ACCEPT
+    )
+    for i in range(snap.chunks):
+        chunk = app.load_snapshot_chunk(
+            T.RequestLoadSnapshotChunk(height=snap.height, format=1, chunk=i)
+        ).chunk
+        restored.apply_snapshot_chunk(T.RequestApplySnapshotChunk(index=i, chunk=chunk))
+    assert restored.app_hash == app.app_hash
+    assert restored.state == app.state
+
+
+# ---------------------------------------------------------------------------
+# clients
+
+
+def test_local_client_roundtrip():
+    async def go():
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        await client.start()
+        assert (await client.echo("hi")).message == "hi"
+        await client.deliver_tx(T.RequestDeliverTx(tx=b"x=y"))
+        resp = await client.commit()
+        assert resp.data == app.app_hash
+        await client.stop()
+
+    run(go())
+
+
+def test_socket_client_server_roundtrip():
+    async def go():
+        app = KVStoreApplication()
+        server = SocketServer("tcp://127.0.0.1:0", app)
+        await server.start()
+        client = SocketClient(f"tcp://127.0.0.1:{server.listen_port}")
+        await client.start()
+
+        assert (await client.echo("ping")).message == "ping"
+        info = await client.info(T.RequestInfo(version="v"))
+        assert info.last_block_height == 0
+
+        # pipeline several requests concurrently; FIFO matching must hold
+        results = await asyncio.gather(
+            client.deliver_tx(T.RequestDeliverTx(tx=b"a=1")),
+            client.deliver_tx(T.RequestDeliverTx(tx=b"b=2")),
+            client.check_tx(T.RequestCheckTx(tx=b"c=3")),
+        )
+        assert all(r.is_ok for r in results)
+        commit = await client.commit()
+        assert commit.data == app.app_hash
+        q = await client.query(T.RequestQuery(data=b"a"))
+        assert q.value == b"1"
+
+        await client.stop()
+        await server.stop()
+
+    run(go())
+
+
+def test_app_conns_mux():
+    async def go():
+        app = KVStoreApplication()
+        conns = AppConns(local_creator(app))
+        await conns.start()
+        # four independent connections hit one app
+        await conns.mempool.check_tx(T.RequestCheckTx(tx=b"m=1"))
+        await conns.consensus.deliver_tx(T.RequestDeliverTx(tx=b"c=1"))
+        info = await conns.query.info(T.RequestInfo())
+        assert info.last_block_height == 0
+        snaps = await conns.snapshot.list_snapshots(T.RequestListSnapshots())
+        assert snaps.snapshots == ()
+        await conns.stop()
+
+    run(go())
