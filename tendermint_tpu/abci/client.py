@@ -187,16 +187,21 @@ class SocketClient(ABCIClient):
                 if not fut.done():
                     fut.set_result(resp)
         except asyncio.CancelledError:
+            self._err = ABCIClientError("client stopped")
+            self._drain_pending(self._err)
             raise
         except Exception as e:  # any stream/codec failure kills the conn
             self._err = e
             # _request enqueues futures under _write_lock and re-checks _err
             # there, so taking the lock here closes the drain race.
             async with self._write_lock:
-                while not self._pending.empty():
-                    fut = self._pending.get_nowait()
-                    if not fut.done():
-                        fut.set_exception(ABCIClientError(str(e)))
+                self._drain_pending(e)
+
+    def _drain_pending(self, err: Exception) -> None:
+        while not self._pending.empty():
+            fut = self._pending.get_nowait()
+            if not fut.done():
+                fut.set_exception(ABCIClientError(str(err)))
 
     async def _request(self, req):
         if self._writer is None:

@@ -1,1 +1,5 @@
+"""Mempool — pending-transaction pool (reference: internal/mempool/)."""
 
+from .cache import LRUTxCache, NopTxCache  # noqa: F401
+from .mempool import TxMempool  # noqa: F401
+from .types import Mempool, MempoolError, TxInfo, WrappedTx, tx_key  # noqa: F401

@@ -1,0 +1,301 @@
+"""TxMempool — the priority mempool.
+
+reference: internal/mempool/mempool.go (:28-56 design comment, CheckTx
+:202, priority eviction :264-312, Update :380, recheck :471, TTL purge
+:524). Transactions are validated through the ABCI mempool connection,
+held with their priority/sender, reaped for proposals in priority order,
+and gossiped in FIFO (arrival) order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..abci import types as abci
+from ..abci.client import ABCIClient
+from ..config import MempoolConfig
+from ..libs.log import get_logger
+from .cache import LRUTxCache, NopTxCache
+from .types import (
+    Mempool,
+    MempoolError,
+    TxInfo,
+    TxMempoolFullError,
+    WrappedTx,
+    tx_key,
+)
+
+__all__ = ["TxMempool"]
+
+# reference: internal/state/tx_filter.go pre-check is installed by the node;
+# here the byte cap is enforced directly from config.
+
+
+class TxMempool(Mempool):
+    def __init__(
+        self,
+        app_conn: ABCIClient,
+        cfg: Optional[MempoolConfig] = None,
+        height: int = 0,
+    ) -> None:
+        self.cfg = cfg or MempoolConfig()
+        self.logger = get_logger("mempool")
+        self._app = app_conn
+        self._height = height
+        self._txs: Dict[bytes, WrappedTx] = {}  # key → wtx, insertion order
+        self._senders: Dict[str, bytes] = {}  # sender → tx key
+        self._bytes = 0
+        self.cache = (
+            LRUTxCache(self.cfg.cache_size)
+            if self.cfg.cache_size > 0
+            else NopTxCache()
+        )
+        self._lock = asyncio.Lock()  # held by consensus across Commit+Update
+        self._tx_available = asyncio.Event()
+        self._postcheck = None
+
+    # -- sizes --
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def size_bytes(self) -> int:
+        return self._bytes
+
+    def is_full(self, tx_size: int) -> bool:
+        return (
+            len(self._txs) >= self.cfg.size
+            or self._bytes + tx_size > self.cfg.max_txs_bytes
+        )
+
+    # -- lifecycle with consensus --
+
+    async def lock(self) -> None:
+        await self._lock.acquire()
+
+    def unlock(self) -> None:
+        self._lock.release()
+
+    async def flush_app_conn(self) -> None:
+        await self._app.flush()
+
+    def flush(self) -> None:
+        """Drop everything (RPC unsafe_flush_mempool)."""
+        self._txs.clear()
+        self._senders.clear()
+        self._bytes = 0
+        self.cache.reset()
+
+    # -- ingestion --
+
+    async def check_tx(
+        self, tx: bytes, tx_info: Optional[TxInfo] = None
+    ) -> abci.ResponseCheckTx:
+        """Validate tx via the app and admit it to the pool
+        (reference: internal/mempool/mempool.go:202-263). Takes the
+        mempool lock, so ingestion is excluded while consensus holds it
+        across Commit+Update — a tx can never be validated against
+        pre-commit app state and inserted post-commit."""
+        async with self._lock:
+            return await self._check_tx_locked(tx, tx_info)
+
+    async def _check_tx_locked(
+        self, tx: bytes, tx_info: Optional[TxInfo]
+    ) -> abci.ResponseCheckTx:
+        tx_info = tx_info or TxInfo()
+        if len(tx) > self.cfg.max_tx_bytes:
+            raise MempoolError(
+                f"tx too large: {len(tx)} > {self.cfg.max_tx_bytes}"
+            )
+        if not self.cache.push(tx):
+            # seen before: note the gossiping peer for the existing entry
+            wtx = self._txs.get(tx_key(tx))
+            if wtx is not None and tx_info.sender_id:
+                wtx.peers.add(tx_info.sender_id)
+            raise MempoolError("tx already exists in cache")
+
+        res = await self._app.check_tx(abci.RequestCheckTx(tx=tx))
+        if not res.is_ok:
+            if not self.cfg.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            return res
+
+        if res.sender and res.sender in self._senders:
+            self.cache.remove(tx)
+            raise MempoolError(
+                f"rejected tx with sender {res.sender!r}: already present"
+            )
+
+        wtx = WrappedTx(
+            tx=tx,
+            priority=res.priority,
+            sender=res.sender,
+            gas_wanted=res.gas_wanted,
+            height=self._height,
+            timestamp=time.monotonic(),
+        )
+        if tx_info.sender_id:
+            wtx.peers.add(tx_info.sender_id)
+        if not self._try_insert(wtx):
+            self.cache.remove(tx)
+            raise TxMempoolFullError(len(self._txs), self._bytes)
+        return res
+
+    def _try_insert(self, wtx: WrappedTx) -> bool:
+        """Insert, evicting strictly-lower-priority txs when full
+        (reference: internal/mempool/mempool.go:264-312)."""
+        if self.is_full(wtx.size()):
+            victims = sorted(
+                (w for w in self._txs.values() if w.priority < wtx.priority),
+                key=lambda w: (w.priority, -w.seq),
+            )
+            freed = 0
+            chosen = []
+            need_bytes = self._bytes + wtx.size() - self.cfg.max_txs_bytes
+            need_count = len(self._txs) + 1 - self.cfg.size
+            for v in victims:
+                chosen.append(v)
+                freed += v.size()
+                if freed >= need_bytes and len(chosen) >= need_count:
+                    break
+            else:
+                return False  # not enough low-priority mass to evict
+            for v in chosen:
+                self.logger.debug(
+                    "evicting lower-priority tx", key=v.key.hex()[:16]
+                )
+                self._remove(v.key, remove_from_cache=True)
+        self._txs[wtx.key] = wtx
+        if wtx.sender:
+            self._senders[wtx.sender] = wtx.key
+        self._bytes += wtx.size()
+        self._tx_available.set()
+        return True
+
+    def _remove(self, key: bytes, remove_from_cache: bool = False) -> None:
+        wtx = self._txs.pop(key, None)
+        if wtx is None:
+            return
+        if wtx.sender:
+            self._senders.pop(wtx.sender, None)
+        self._bytes -= wtx.size()
+        if remove_from_cache:
+            self.cache.remove_by_key(key)
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        self._remove(key, remove_from_cache=True)
+
+    def get_tx(self, key: bytes) -> Optional[bytes]:
+        wtx = self._txs.get(key)
+        return wtx.tx if wtx else None
+
+    # -- reaping (proposal construction) --
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """Priority-descending reap under byte/gas budgets
+        (reference: internal/mempool/mempool.go:328-366)."""
+        out: List[bytes] = []
+        total_bytes = 0
+        total_gas = 0
+        for wtx in sorted(
+            self._txs.values(), key=lambda w: (-w.priority, w.seq)
+        ):
+            sz = wtx.size()
+            if max_bytes > -1 and total_bytes + sz > max_bytes:
+                continue
+            if max_gas > -1 and total_gas + wtx.gas_wanted > max_gas:
+                continue
+            total_bytes += sz
+            total_gas += wtx.gas_wanted
+            out.append(wtx.tx)
+        return out
+
+    def reap_max_txs(self, max_txs: int) -> List[bytes]:
+        n = len(self._txs) if max_txs < 0 else min(max_txs, len(self._txs))
+        ordered = sorted(self._txs.values(), key=lambda w: (-w.priority, w.seq))
+        return [w.tx for w in ordered[:n]]
+
+    # -- post-commit update --
+
+    async def update(
+        self,
+        block_height: int,
+        block_txs: Sequence[bytes],
+        deliver_tx_responses: Sequence[abci.ResponseDeliverTx],
+    ) -> None:
+        """Called by BlockExecutor.Commit with the mempool lock held
+        (reference: internal/mempool/mempool.go:380-445)."""
+        self._height = block_height
+        for tx, res in zip(block_txs, deliver_tx_responses):
+            if res.is_ok:
+                self.cache.push(tx)  # committed: never re-admit
+            elif not self.cfg.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            self._remove(tx_key(tx))
+
+        self._purge_expired(block_height)
+
+        if self._txs:
+            if self.cfg.recheck:
+                await self._recheck()
+        if self._txs:
+            self._tx_available.set()
+
+    async def _recheck(self) -> None:
+        """Re-validate all pool txs against post-commit app state
+        (reference: internal/mempool/mempool.go:471-523)."""
+        for key in list(self._txs.keys()):
+            wtx = self._txs.get(key)
+            if wtx is None:
+                continue
+            res = await self._app.check_tx(
+                abci.RequestCheckTx(tx=wtx.tx, type=abci.CheckTxType.RECHECK)
+            )
+            if not res.is_ok:
+                self._remove(
+                    key,
+                    remove_from_cache=not self.cfg.keep_invalid_txs_in_cache,
+                )
+            else:
+                wtx.priority = res.priority
+                wtx.gas_wanted = res.gas_wanted
+
+    def _purge_expired(self, block_height: int) -> None:
+        """TTL eviction (reference: internal/mempool/mempool.go:524-570)."""
+        if not self.cfg.ttl_duration and not self.cfg.ttl_num_blocks:
+            return
+        now = time.monotonic()
+        for key in list(self._txs.keys()):
+            wtx = self._txs[key]
+            if (
+                self.cfg.ttl_duration
+                and now - wtx.timestamp > self.cfg.ttl_duration
+            ) or (
+                self.cfg.ttl_num_blocks
+                and block_height - wtx.height > self.cfg.ttl_num_blocks
+            ):
+                self._remove(key, remove_from_cache=True)
+
+    # -- gossip support --
+
+    def next_gossip_tx(self, after_seq: int) -> Optional[WrappedTx]:
+        """First tx with seq > after_seq in FIFO order, or None."""
+        for wtx in self._txs.values():  # insertion-ordered
+            if wtx.seq > after_seq:
+                return wtx
+        return None
+
+    async def wait_for_tx(self, after_seq: int) -> WrappedTx:
+        """Block until a tx with seq > after_seq exists (gossip cursor,
+        the clist-walk analog; reference: internal/mempool/reactor.go)."""
+        while True:
+            wtx = self.next_gossip_tx(after_seq)
+            if wtx is not None:
+                return wtx
+            self._tx_available.clear()
+            await self._tx_available.wait()
+
+    def tx_available(self) -> asyncio.Event:
+        return self._tx_available

@@ -1,0 +1,148 @@
+"""Pubsub — query-addressed publish/subscribe.
+
+reference: internal/pubsub/pubsub.go (:105 Server, :188 SubscribeWithArgs,
+:292-344 publish fan-out). Subscribers register a client ID + compiled
+query; published messages carry event tags and are delivered to every
+subscription whose query matches. Each subscription owns a bounded queue;
+a slow subscriber overflowing its queue is terminated with an error
+(reference: internal/pubsub/subscription.go), keeping one laggard from
+stalling consensus event publication.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..libs.service import Service
+from .query import Query, compile_query  # noqa: F401
+
+__all__ = [
+    "Message",
+    "Subscription",
+    "Server",
+    "SubscriptionError",
+    "ERR_TERMINATED",
+    "Query",
+    "compile_query",
+]
+
+ERR_TERMINATED = "subscription terminated: queue overflow"
+
+
+class SubscriptionError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Message:
+    """What a subscriber receives: the payload plus the tag map it matched."""
+
+    data: object
+    events: Dict[str, List[str]] = field(default_factory=dict)
+
+
+class Subscription:
+    """A single subscriber feed with a bounded buffer."""
+
+    def __init__(self, client_id: str, query: Query, limit: int = 100) -> None:
+        self.client_id = client_id
+        self.query = query
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, limit))
+        self._terminated: Optional[str] = None
+
+    def _deliver(self, msg: Message) -> bool:
+        if self._terminated:
+            return False
+        try:
+            self._queue.put_nowait(msg)
+            return True
+        except asyncio.QueueFull:
+            self._terminated = ERR_TERMINATED
+            return False
+
+    def _terminate(self, reason: str) -> None:
+        if not self._terminated:
+            self._terminated = reason
+
+    async def next(self) -> Message:
+        """Await the next matching message; raises if terminated and
+        drained."""
+        while True:
+            if self._queue.empty() and self._terminated:
+                raise SubscriptionError(self._terminated)
+            if self._terminated:
+                try:
+                    return self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    raise SubscriptionError(self._terminated)
+            try:
+                return await asyncio.wait_for(self._queue.get(), timeout=0.5)
+            except asyncio.TimeoutError:
+                continue
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> Message:
+        try:
+            return await self.next()
+        except SubscriptionError:
+            raise StopAsyncIteration
+
+
+class Server(Service):
+    """The pubsub hub (reference: internal/pubsub/pubsub.go:105)."""
+
+    def __init__(self, name: str = "pubsub") -> None:
+        super().__init__(name=name)
+        # (client_id, query string) → Subscription
+        self._subs: Dict[Tuple[str, str], Subscription] = {}
+
+    def subscribe(
+        self, client_id: str, query: "Query | str", limit: int = 100
+    ) -> Subscription:
+        q = compile_query(query) if isinstance(query, str) else query
+        key = (client_id, str(q))
+        if key in self._subs:
+            raise SubscriptionError(
+                f"{client_id} already subscribed to {q}"
+            )
+        sub = Subscription(client_id, q, limit)
+        self._subs[key] = sub
+        return sub
+
+    def unsubscribe(self, client_id: str, query: "Query | str") -> None:
+        qs = str(compile_query(query) if isinstance(query, str) else query)
+        sub = self._subs.pop((client_id, qs), None)
+        if sub is None:
+            raise SubscriptionError(f"{client_id} not subscribed to {qs}")
+        sub._terminate("unsubscribed")
+
+    def unsubscribe_all(self, client_id: str) -> None:
+        keys = [k for k in self._subs if k[0] == client_id]
+        if not keys:
+            raise SubscriptionError(f"{client_id} has no subscriptions")
+        for k in keys:
+            self._subs.pop(k)._terminate("unsubscribed")
+
+    def num_clients(self) -> int:
+        return len({cid for cid, _ in self._subs})
+
+    def publish(self, data: object, events: Optional[Dict[str, List[str]]] = None):
+        """Synchronous fan-out: delivery is put_nowait into bounded queues,
+        so publishing never blocks the caller (the consensus hot loop)."""
+        events = events or {}
+        dead: List[Tuple[str, str]] = []
+        for key, sub in self._subs.items():
+            if sub.query.matches(events):
+                if not sub._deliver(Message(data=data, events=events)):
+                    dead.append(key)
+        for key in dead:
+            self._subs.pop(key, None)
+
+    async def on_stop(self) -> None:
+        for sub in self._subs.values():
+            sub._terminate("server stopped")
+        self._subs.clear()

@@ -1,12 +1,29 @@
-"""Replicated state and its persistence."""
+"""Replicated state, execution, and indexing."""
 
+from .execution import (  # noqa: F401
+    BlockExecutor,
+    EmptyEvidencePool,
+    results_hash,
+    update_state,
+    validate_block,
+)
+from .indexer import IndexerService, KVSink, NullSink, TxResult  # noqa: F401
 from .store import ABCIResponses, StateStore  # noqa: F401
 from .types import State, median_time, state_from_genesis  # noqa: F401
 
 __all__ = [
     "ABCIResponses",
+    "BlockExecutor",
+    "EmptyEvidencePool",
+    "IndexerService",
+    "KVSink",
+    "NullSink",
     "State",
     "StateStore",
+    "TxResult",
     "median_time",
+    "results_hash",
     "state_from_genesis",
+    "update_state",
+    "validate_block",
 ]

@@ -1,0 +1,300 @@
+"""Event indexer — block/tx event sinks feeding tx_search/block_search.
+
+reference: internal/state/indexer/ (EventSink iface eventsink.go:26,
+kv sink indexer/sink/kv, null sink, IndexerService
+indexer_service.go:20-90). The KV sink indexes events whose attributes
+were marked `index: true` by the app, plus the reserved tx.hash/tx.height
+keys, and answers the same query language used by the event bus.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..abci import types as abci
+from ..abci.codec import _dec_resp_deliver_tx, _enc_resp_deliver_tx
+from ..encoding.proto import FieldReader, ProtoWriter
+from ..eventbus import EventBus
+from ..libs.service import Service
+from ..pubsub.query import Query, compile_query
+from ..store.kv import KVStore
+from ..types import events as E
+from ..types.tx import tx_hash
+
+__all__ = ["TxResult", "EventSink", "KVSink", "NullSink", "IndexerService"]
+
+
+@dataclass
+class TxResult:
+    """reference: proto/tendermint/abci/types.pb.go TxResult."""
+
+    height: int
+    index: int
+    tx: bytes
+    result: abci.ResponseDeliverTx
+
+    def to_proto(self) -> bytes:
+        w = ProtoWriter()
+        w.int(1, self.height)
+        w.uint(2, self.index)
+        w.bytes(3, self.tx)
+        w.message(4, _enc_resp_deliver_tx(self.result))
+        return w.finish()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "TxResult":
+        r = FieldReader(data)
+        return cls(
+            height=r.int64(1),
+            index=r.uint(2),
+            tx=r.bytes(3),
+            result=_dec_resp_deliver_tx(r.bytes(4)),
+        )
+
+
+class EventSink:
+    """reference: internal/state/indexer/eventsink.go:26-42."""
+
+    def type(self) -> str:
+        raise NotImplementedError
+
+    def index_block_events(self, height: int, events: Sequence[abci.Event]) -> None:
+        raise NotImplementedError
+
+    def index_tx_events(self, results: Sequence[TxResult]) -> None:
+        raise NotImplementedError
+
+    def search_tx_events(self, query: "Query | str") -> List[TxResult]:
+        raise NotImplementedError
+
+    def search_block_events(self, query: "Query | str") -> List[int]:
+        raise NotImplementedError
+
+    def get_tx_by_hash(self, h: bytes) -> Optional[TxResult]:
+        raise NotImplementedError
+
+    def has_block(self, height: int) -> bool:
+        raise NotImplementedError
+
+
+class NullSink(EventSink):
+    """reference: indexer/sink/null."""
+
+    def type(self) -> str:
+        return "null"
+
+    def index_block_events(self, height, events) -> None: ...
+
+    def index_tx_events(self, results) -> None: ...
+
+    def search_tx_events(self, query) -> List[TxResult]:
+        return []
+
+    def search_block_events(self, query) -> List[int]:
+        return []
+
+    def get_tx_by_hash(self, h) -> Optional[TxResult]:
+        return None
+
+    def has_block(self, height: int) -> bool:
+        return False
+
+
+_TX_BY_HASH = b"th/"
+_TX_INDEX = b"ti/"
+_BLOCK_INDEX = b"bi/"
+_SEP = b"\x00"
+
+
+def _esc(s: str) -> bytes:
+    """Escape tag/value bytes so the 0x00 key separator cannot appear
+    inside them (0x00 → 0x01 0x01, 0x01 → 0x01 0x02)."""
+    raw = s.encode(errors="replace")
+    return raw.replace(b"\x01", b"\x01\x02").replace(b"\x00", b"\x01\x01")
+
+
+def _unesc(raw: bytes) -> str:
+    return (
+        raw.replace(b"\x01\x01", b"\x00")
+        .replace(b"\x01\x02", b"\x01")
+        .decode(errors="replace")
+    )
+
+
+def _indexed_attrs(events: Sequence[abci.Event]) -> List[Tuple[str, str]]:
+    out = []
+    for ev in events or ():
+        if not ev.type:
+            continue
+        for attr in ev.attributes:
+            if attr.index:
+                out.append(
+                    (
+                        f"{ev.type}.{attr.key.decode(errors='replace')}",
+                        attr.value.decode(errors="replace"),
+                    )
+                )
+    return out
+
+
+class KVSink(EventSink):
+    """Embedded-KV event sink (reference: indexer/sink/kv/kv.go)."""
+
+    def __init__(self, db: KVStore) -> None:
+        self._db = db
+
+    def type(self) -> str:
+        return "kv"
+
+    # -- writes --
+
+    def index_block_events(
+        self, height: int, events: Sequence[abci.Event]
+    ) -> None:
+        hb = struct.pack(">q", height)
+        self._db.set(_BLOCK_INDEX + b"height/" + hb, hb)
+        for tag, value in _indexed_attrs(events):
+            self._db.set(
+                _BLOCK_INDEX + _esc(tag) + _SEP + _esc(value) + _SEP + hb,
+                hb,
+            )
+
+    def index_tx_events(self, results: Sequence[TxResult]) -> None:
+        for tr in results:
+            h = tx_hash(tr.tx)
+            self._db.set(_TX_BY_HASH + h, tr.to_proto())
+            pos = struct.pack(">qI", tr.height, tr.index)
+            pairs = _indexed_attrs(tr.result.events)
+            pairs.append((E.TX_HEIGHT_KEY, str(tr.height)))
+            pairs.append((E.TX_HASH_KEY, h.hex().upper()))
+            for tag, value in pairs:
+                self._db.set(
+                    _TX_INDEX + _esc(tag) + _SEP + _esc(value) + _SEP + pos,
+                    h,
+                )
+
+    # -- reads --
+
+    def get_tx_by_hash(self, h: bytes) -> Optional[TxResult]:
+        data = self._db.get(_TX_BY_HASH + h)
+        return TxResult.from_proto(data) if data is not None else None
+
+    def has_block(self, height: int) -> bool:
+        return self._db.has(
+            _BLOCK_INDEX + b"height/" + struct.pack(">q", height)
+        )
+
+    def _scan_condition(self, prefix: bytes, cond) -> Dict[bytes, bytes]:
+        """tag-index scan → {position_key: payload} for entries whose value
+        satisfies the condition."""
+        base = prefix + _esc(cond.tag) + _SEP
+        out: Dict[bytes, bytes] = {}
+        if cond.op == "=" and isinstance(cond.arg, str):
+            exact = base + _esc(cond.arg) + _SEP
+            for k, v in self._db.iterate(exact, exact + b"\xff"):
+                out[k[len(exact):]] = v
+            return out
+        for k, v in self._db.iterate(base, base + b"\xff"):
+            rest = k[len(base):]
+            value, _, pos = rest.partition(_SEP)
+            if _cond_matches(cond, _unesc(value)):
+                out[pos] = v
+        return out
+
+    def search_tx_events(self, query: "Query | str") -> List[TxResult]:
+        q = compile_query(query) if isinstance(query, str) else query
+        conds = q._conditions
+        if not conds:
+            return []
+        sets = [self._scan_condition(_TX_INDEX, c) for c in conds]
+        keys = set(sets[0])
+        for s in sets[1:]:
+            keys &= set(s)
+        hashes = {sets[0][k] for k in keys}
+        out = []
+        for h in hashes:
+            tr = self.get_tx_by_hash(h)
+            if tr is not None:
+                out.append(tr)
+        out.sort(key=lambda t: (t.height, t.index))
+        return out
+
+    def search_block_events(self, query: "Query | str") -> List[int]:
+        q = compile_query(query) if isinstance(query, str) else query
+        conds = q._conditions
+        if not conds:
+            return []
+        sets = []
+        for c in conds:
+            if c.tag == E.BLOCK_HEIGHT_KEY:
+                # height is indexed positionally under bi/height/
+                found = {}
+                base = _BLOCK_INDEX + b"height/"
+                for k, v in self._db.iterate(base, base + b"\xff"):
+                    height = struct.unpack(">q", v)[0]
+                    if _cond_matches(c, str(height)):
+                        found[v] = v
+                sets.append(found)
+            else:
+                sets.append(self._scan_condition(_BLOCK_INDEX, c))
+        keys = set(sets[0])
+        for s in sets[1:]:
+            keys &= set(s)
+        heights = sorted(
+            struct.unpack(">q", sets[0][k])[0] for k in keys
+        )
+        return heights
+
+
+def _cond_matches(cond, value: str) -> bool:
+    return cond.matches([value])
+
+
+class IndexerService(Service):
+    """Subscribes to the event bus and feeds every sink
+    (reference: internal/state/indexer/indexer_service.go:20-90)."""
+
+    def __init__(self, sinks: List[EventSink], event_bus: EventBus) -> None:
+        super().__init__(name="indexer")
+        self.sinks = sinks
+        self.bus = event_bus
+
+    async def on_start(self) -> None:
+        self._block_sub = self.bus.subscribe(
+            "indexer", f"{E.EVENT_TYPE_KEY} = '{E.EventValue.NEW_BLOCK}'",
+            limit=1000,
+        )
+        self._tx_sub = self.bus.subscribe(
+            "indexer", f"{E.EVENT_TYPE_KEY} = '{E.EventValue.TX}'", limit=10000
+        )
+        self.spawn(self._index_blocks())
+        self.spawn(self._index_txs())
+
+    async def on_stop(self) -> None:
+        try:
+            self.bus.unsubscribe_all("indexer")
+        except Exception:
+            pass
+
+    async def _index_blocks(self) -> None:
+        async for msg in self._block_sub:
+            data = msg.data
+            events = []
+            for src in (data.result_begin_block, data.result_end_block):
+                events.extend(getattr(src, "events", ()) or ())
+            for sink in self.sinks:
+                sink.index_block_events(data.block.header.height, events)
+
+    async def _index_txs(self) -> None:
+        async for msg in self._tx_sub:
+            data = msg.data
+            tr = TxResult(
+                height=data.height,
+                index=data.index,
+                tx=data.tx,
+                result=data.result,
+            )
+            for sink in self.sinks:
+                sink.index_tx_events([tr])

@@ -50,27 +50,73 @@ class ABCIResponses:
         self,
         deliver_txs: Optional[List[bytes]] = None,
         end_block: bytes = b"",
+        begin_block: bytes = b"",
     ) -> None:
         self.deliver_txs = deliver_txs or []
         self.end_block = end_block
+        self.begin_block = begin_block
+
+    @property
+    def deliver_tx_objs(self):
+        """Decoded DeliverTx responses (decoded lazily when loaded from
+        disk; the executor sets the cache directly after execution)."""
+        if not hasattr(self, "_deliver_tx_objs"):
+            from ..abci.codec import _dec_resp_deliver_tx
+
+            self._deliver_tx_objs = [
+                _dec_resp_deliver_tx(d) for d in self.deliver_txs
+            ]
+        return self._deliver_tx_objs
+
+    @deliver_tx_objs.setter
+    def deliver_tx_objs(self, objs) -> None:
+        self._deliver_tx_objs = objs
+
+    @property
+    def end_block_obj(self):
+        if not hasattr(self, "_end_block_obj"):
+            from ..abci.codec import _dec_resp_end_block
+
+            self._end_block_obj = _dec_resp_end_block(self.end_block)
+        return self._end_block_obj
+
+    @end_block_obj.setter
+    def end_block_obj(self, obj) -> None:
+        self._end_block_obj = obj
+
+    @property
+    def begin_block_obj(self):
+        if not hasattr(self, "_begin_block_obj"):
+            from ..abci.codec import _dec_resp_begin_block
+
+            self._begin_block_obj = _dec_resp_begin_block(self.begin_block)
+        return self._begin_block_obj
+
+    @begin_block_obj.setter
+    def begin_block_obj(self, obj) -> None:
+        self._begin_block_obj = obj
 
     def to_proto(self) -> bytes:
         w = ProtoWriter()
         for dt in self.deliver_txs:
             w.message(1, dt)
         w.message(2, self.end_block)
+        w.message(3, self.begin_block)
         return w.finish()
 
     @classmethod
     def from_proto(cls, data: bytes) -> "ABCIResponses":
         dts: List[bytes] = []
         eb = b""
+        bb = b""
         for f, _wt, v in iter_fields(data):
             if f == 1:
                 dts.append(v)
             elif f == 2:
                 eb = v
-        return cls(deliver_txs=dts, end_block=eb)
+            elif f == 3:
+                bb = v
+        return cls(deliver_txs=dts, end_block=eb, begin_block=bb)
 
 
 class _ValInfo:

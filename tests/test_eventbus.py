@@ -1,0 +1,209 @@
+"""Pubsub query language, server fan-out, and event bus tests
+(reference test model: internal/pubsub/pubsub_test.go,
+internal/pubsub/query/query_test.go, internal/eventbus/event_bus_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.eventbus import EventBus
+from tendermint_tpu.pubsub import (
+    Server,
+    SubscriptionError,
+    compile_query,
+)
+from tendermint_tpu.pubsub.query import QuerySyntaxError, query_for_event
+from tendermint_tpu.types import events as E
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# query language
+
+
+@pytest.mark.parametrize(
+    "query,tags,want",
+    [
+        ("tm.event = 'Tx'", {"tm.event": ["Tx"]}, True),
+        ("tm.event = 'Tx'", {"tm.event": ["NewBlock"]}, False),
+        ("tm.event = 'Tx'", {}, False),
+        ("tx.height = 5", {"tx.height": ["5"]}, True),
+        ("tx.height = 5", {"tx.height": ["6"]}, False),
+        ("tx.height < 10", {"tx.height": ["5"]}, True),
+        ("tx.height <= 5", {"tx.height": ["5"]}, True),
+        ("tx.height > 100", {"tx.height": ["99"]}, False),
+        ("tx.height >= 99", {"tx.height": ["99"]}, True),
+        # multi-valued tags: any value matching suffices
+        ("app.key = 'k2'", {"app.key": ["k1", "k2"]}, True),
+        ("app.key CONTAINS 'arti'", {"app.key": ["particle"]}, True),
+        ("app.key CONTAINS 'arti'", {"app.key": ["art-free"]}, False),
+        ("app.key EXISTS", {"app.key": ["x"]}, True),
+        ("app.key EXISTS", {"other": ["x"]}, False),
+        (
+            "tm.event = 'Tx' AND tx.height = 5",
+            {"tm.event": ["Tx"], "tx.height": ["5"]},
+            True,
+        ),
+        (
+            "tm.event = 'Tx' AND tx.height = 5",
+            {"tm.event": ["Tx"], "tx.height": ["7"]},
+            False,
+        ),
+        # non-numeric values never match numeric comparisons
+        ("tx.height > 1", {"tx.height": ["abc"]}, False),
+    ],
+)
+def test_query_matches(query, tags, want):
+    assert compile_query(query).matches(tags) is want
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "AND",
+        "tag =",
+        "= 'x'",
+        "tag CONTAINS 5",
+        "tag < 'str'",
+        "a = 'x' AND",
+        "a = 'x' b = 'y'",
+    ],
+)
+def test_query_syntax_errors(bad):
+    with pytest.raises(QuerySyntaxError):
+        compile_query(bad)
+
+
+def test_query_for_event():
+    q = query_for_event("NewBlock")
+    assert q.matches({"tm.event": ["NewBlock"]})
+    assert not q.matches({"tm.event": ["Tx"]})
+
+
+# ---------------------------------------------------------------------------
+# pubsub server
+
+
+def test_pubsub_fanout_and_unsubscribe():
+    async def go():
+        s = Server()
+        await s.start()
+        sub_tx = s.subscribe("c1", "tm.event = 'Tx'")
+        sub_all = s.subscribe("c2", "tm.event EXISTS")
+
+        s.publish("block-data", {"tm.event": ["NewBlock"]})
+        s.publish("tx-data", {"tm.event": ["Tx"]})
+
+        msg = await sub_tx.next()
+        assert msg.data == "tx-data"
+        first = await sub_all.next()
+        second = await sub_all.next()
+        assert [first.data, second.data] == ["block-data", "tx-data"]
+
+        s.unsubscribe("c1", "tm.event = 'Tx'")
+        with pytest.raises(SubscriptionError):
+            s.unsubscribe("c1", "tm.event = 'Tx'")
+        assert s.num_clients() == 1
+        await s.stop()
+
+    run(go())
+
+
+def test_pubsub_slow_subscriber_terminated():
+    async def go():
+        s = Server()
+        await s.start()
+        sub = s.subscribe("slow", "tm.event EXISTS", limit=2)
+        for _ in range(3):  # overflow the 2-slot buffer
+            s.publish("x", {"tm.event": ["Tx"]})
+        # buffered messages still drain, then the subscription errors out
+        await sub.next()
+        await sub.next()
+        with pytest.raises(SubscriptionError):
+            await sub.next()
+        # server dropped it
+        assert s.num_clients() == 0
+        await s.stop()
+
+    run(go())
+
+
+def test_pubsub_duplicate_subscribe_rejected():
+    async def go():
+        s = Server()
+        await s.start()
+        s.subscribe("c", "tm.event = 'Tx'")
+        with pytest.raises(SubscriptionError):
+            s.subscribe("c", "tm.event = 'Tx'")
+        await s.stop()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# event bus
+
+
+class _Hdr:
+    height = 7
+
+
+class _Blk:
+    header = _Hdr()
+
+
+def test_eventbus_tx_tags_and_app_events():
+    async def go():
+        bus = EventBus()
+        await bus.start()
+        sub = bus.subscribe("test", "tm.event = 'Tx' AND app.creator = 'kvstore'")
+        other = bus.subscribe("test", "tm.event = 'Tx' AND app.creator = 'nobody'")
+
+        result = abci.ResponseDeliverTx(
+            events=(
+                abci.Event(
+                    type="app",
+                    attributes=(abci.EventAttribute(b"creator", b"kvstore", True),),
+                ),
+            )
+        )
+        bus.publish_tx(
+            E.EventDataTx(height=7, tx=b"a=1", index=0, result=result),
+            tx_hash=b"\xab" * 32,
+        )
+        msg = await sub.next()
+        assert msg.events[E.TX_HEIGHT_KEY] == ["7"]
+        assert msg.events[E.TX_HASH_KEY] == ["AB" * 32]
+        assert msg.data.height == 7
+        assert other._queue.empty()
+        await bus.stop()
+
+    run(go())
+
+
+def test_eventbus_new_block_and_round_steps():
+    async def go():
+        bus = EventBus()
+        await bus.start()
+        sub_nb = bus.subscribe("t", query_for_event(E.EventValue.NEW_BLOCK))
+        sub_step = bus.subscribe("t", query_for_event(E.EventValue.NEW_ROUND_STEP))
+
+        bus.publish_new_block(
+            E.EventDataNewBlock(block=_Blk(), block_id=None)
+        )
+        bus.publish_new_round_step(
+            E.EventDataRoundState(height=7, round=0, step="propose")
+        )
+        nb = await sub_nb.next()
+        assert nb.events[E.BLOCK_HEIGHT_KEY] == ["7"]
+        st = await sub_step.next()
+        assert st.data.step == "propose"
+        bus.unsubscribe_all("t")
+        await bus.stop()
+
+    run(go())

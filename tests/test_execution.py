@@ -1,0 +1,226 @@
+"""BlockExecutor tests — proposal→apply→commit over real kvstore app
+(reference model: internal/state/execution_test.go, validation_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import KVStoreApplication, LocalClient
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.eventbus import EventBus
+from tendermint_tpu.mempool import TxMempool
+from tendermint_tpu.pubsub.query import query_for_event
+from tendermint_tpu.state import StateStore, state_from_genesis
+from tendermint_tpu.state.execution import (
+    BlockExecutor,
+    results_hash,
+    update_state,
+    validate_block,
+)
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types import Commit, CommitSig, events as E
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
+
+CHAIN = "exec-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_env(n_vals=1):
+    privs = [PrivKeyEd25519.from_seed(bytes([i + 10]) * 32) for i in range(n_vals)]
+    genesis = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs],
+    )
+    state = state_from_genesis(genesis)
+    app = KVStoreApplication()
+    client = LocalClient(app)
+    store = StateStore(MemKV())
+    store.save(state)
+    mempool = TxMempool(client, MempoolConfig())
+    bus = EventBus()
+    block_store = BlockStore(MemKV())
+    execu = BlockExecutor(
+        store, client, mempool, block_store=block_store, event_bus=bus
+    )
+    return state, app, client, store, mempool, bus, execu, privs
+
+
+def commit_for(state, block, block_id, privs):
+    """Sign a precommit for `block` by every validator, as its Commit."""
+    sigs = []
+    vals = state.validators
+    for i, v in enumerate(vals.validators):
+        priv = next(p for p in privs if p.pub_key().address() == v.address)
+        vote = Vote(
+            type=PRECOMMIT_TYPE,
+            height=block.header.height,
+            round=0,
+            block_id=block_id,
+            timestamp_ns=block.header.time_ns + 1,
+            validator_address=v.address,
+            validator_index=i,
+        )
+        vote.signature = priv.sign(vote.sign_bytes(CHAIN))
+        sigs.append(
+            CommitSig.for_block(
+                vote.signature, vote.validator_address, vote.timestamp_ns
+            )
+        )
+    return Commit(
+        height=block.header.height, round=0, block_id=block_id, signatures=sigs
+    )
+
+
+def test_results_hash_deterministic_and_sensitive():
+    r1 = [abci.ResponseDeliverTx(code=0, data=b"a", gas_used=1)]
+    r2 = [abci.ResponseDeliverTx(code=0, data=b"a", gas_used=1)]
+    r3 = [abci.ResponseDeliverTx(code=1, data=b"a", gas_used=1)]
+    # log/info/events are non-deterministic fields and must NOT affect it
+    r4 = [abci.ResponseDeliverTx(code=0, data=b"a", gas_used=1, log="noise")]
+    assert results_hash(r1) == results_hash(r2) == results_hash(r4)
+    assert results_hash(r1) != results_hash(r3)
+
+
+def test_two_block_chain_with_txs_and_events():
+    async def go():
+        state, app, client, store, mempool, bus, execu, privs = make_env()
+        await bus.start()
+        sub = bus.subscribe("t", query_for_event(E.EventValue.NEW_BLOCK))
+        sub_tx = bus.subscribe("t", "tm.event = 'Tx' AND tx.height = 1")
+
+        await mempool.check_tx(b"alpha=1")
+        proposer = state.validators.get_proposer().address
+
+        # ---- height 1 ----
+        block1, parts1 = execu.create_proposal_block(
+            1, state, Commit(height=0), proposer
+        )
+        assert block1.txs == [b"alpha=1"]
+        bid1 = block1.block_id()
+        state1 = await execu.apply_block(state, bid1, block1)
+
+        assert state1.last_block_height == 1
+        assert state1.app_hash == app.app_hash != b""
+        assert mempool.size() == 0  # committed tx removed
+        ev = await sub.next()
+        assert ev.data.block.header.height == 1
+        txev = await sub_tx.next()
+        assert txev.data.tx == b"alpha=1"
+
+        # ---- height 2 (LastCommit batch-verified) ----
+        commit1 = commit_for(state1, block1, bid1, privs)
+        await mempool.check_tx(b"beta=2")
+        block2, _ = execu.create_proposal_block(2, state1, commit1, proposer)
+        bid2 = block2.block_id()
+        state2 = await execu.apply_block(state1, bid2, block2)
+        assert state2.last_block_height == 2
+        # results hash of height 2 covers its DeliverTx responses,
+        # reloadable from the state store
+        reloaded = store.load_abci_responses(2)
+        assert state2.last_results_hash == results_hash(reloaded.deliver_tx_objs)
+        assert store.load().last_block_height == 2
+        assert app.state[b"beta"] == b"2"
+        # state store has validators for both heights
+        assert store.load_validators(1) is not None
+        assert store.load_validators(2) is not None
+        await bus.stop()
+
+    run(go())
+
+
+def test_validate_block_rejects_tampering():
+    async def go():
+        state, app, client, store, mempool, bus, execu, privs = make_env()
+        proposer = state.validators.get_proposer().address
+        block1, _ = execu.create_proposal_block(
+            1, state, Commit(height=0), proposer
+        )
+        bid1 = block1.block_id()
+
+        # wrong app hash (re-derive dependent hashes so only AppHash is off)
+        block1.hash()  # fill header first
+        block1.header.app_hash = b"\xff" * 32
+        with pytest.raises(ValueError, match="AppHash"):
+            validate_block(state, block1)
+
+        # wrong chain id
+        block2, _ = execu.create_proposal_block(
+            1, state, Commit(height=0), proposer
+        )
+        block2.header.chain_id = "not-the-chain"
+        with pytest.raises(ValueError, match="ChainID"):
+            validate_block(state, block2)
+
+        # non-validator proposer
+        block3, _ = execu.create_proposal_block(
+            1, state, Commit(height=0), b"\x01" * 20
+        )
+        with pytest.raises(ValueError, match="proposer"):
+            validate_block(state, block3)
+
+    run(go())
+
+
+def test_apply_block_rejects_bad_last_commit():
+    async def go():
+        state, app, client, store, mempool, bus, execu, privs = make_env()
+        proposer = state.validators.get_proposer().address
+        block1, _ = execu.create_proposal_block(
+            1, state, Commit(height=0), proposer
+        )
+        bid1 = block1.block_id()
+        state1 = await execu.apply_block(state, bid1, block1)
+
+        # commit signed by an impostor key
+        impostor = PrivKeyEd25519.from_seed(b"\x99" * 32)
+        commit1 = commit_for(state1, block1, bid1, privs)
+        vote = Vote(
+            type=PRECOMMIT_TYPE,
+            height=1,
+            round=0,
+            block_id=bid1,
+            timestamp_ns=block1.header.time_ns + 1,
+            validator_address=state1.validators.validators[0].address,
+            validator_index=0,
+        )
+        commit1.signatures[0] = CommitSig.for_block(
+            impostor.sign(vote.sign_bytes(CHAIN)),
+            vote.validator_address,
+            vote.timestamp_ns,
+        )
+        block2, _ = execu.create_proposal_block(2, state1, commit1, proposer)
+        with pytest.raises(Exception):
+            await execu.apply_block(state1, block2.block_id(), block2)
+
+    run(go())
+
+
+def test_validator_update_via_endblock():
+    async def go():
+        state, app, client, store, mempool, bus, execu, privs = make_env()
+        proposer = state.validators.get_proposer().address
+        new_val = PrivKeyEd25519.from_seed(b"\x55" * 32)
+        tx = f"val:{new_val.pub_key().bytes().hex()}!8".encode()
+        await mempool.check_tx(tx)
+        block1, _ = execu.create_proposal_block(
+            1, state, Commit(height=0), proposer
+        )
+        bid1 = block1.block_id()
+        state1 = await execu.apply_block(state, bid1, block1)
+        # validators update lands in next_validators at h+2
+        assert len(state1.validators) == 1
+        assert len(state1.next_validators) == 2
+        assert state1.last_height_validators_changed == 3
+        addrs = {v.address for v in state1.next_validators.validators}
+        assert new_val.pub_key().address() in addrs
+
+    run(go())

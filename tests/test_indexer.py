@@ -1,0 +1,155 @@
+"""Indexer sink + service tests (reference model:
+internal/state/indexer/indexer_service_test.go, sink/kv/kv_test.go)."""
+
+import asyncio
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.eventbus import EventBus
+from tendermint_tpu.state.indexer import (
+    IndexerService,
+    KVSink,
+    NullSink,
+    TxResult,
+)
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types import events as E
+from tendermint_tpu.types.tx import tx_hash
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_tx_result(height, index, tx, key=b"k", indexed=True):
+    return TxResult(
+        height=height,
+        index=index,
+        tx=tx,
+        result=abci.ResponseDeliverTx(
+            events=(
+                abci.Event(
+                    type="app",
+                    attributes=(
+                        abci.EventAttribute(b"key", key, indexed),
+                        abci.EventAttribute(b"noindex", b"x", False),
+                    ),
+                ),
+            )
+        ),
+    )
+
+
+def test_kv_sink_tx_roundtrip_and_search():
+    sink = KVSink(MemKV())
+    trs = [
+        make_tx_result(1, 0, b"tx-a", key=b"apple"),
+        make_tx_result(1, 1, b"tx-b", key=b"banana"),
+        make_tx_result(2, 0, b"tx-c", key=b"apple"),
+    ]
+    sink.index_tx_events(trs)
+
+    got = sink.get_tx_by_hash(tx_hash(b"tx-a"))
+    assert got is not None and got.tx == b"tx-a" and got.height == 1
+
+    # search by indexed app event
+    hits = sink.search_tx_events("app.key = 'apple'")
+    assert [t.tx for t in hits] == [b"tx-a", b"tx-c"]
+
+    # non-indexed attributes are not searchable
+    assert sink.search_tx_events("app.noindex = 'x'") == []
+
+    # reserved keys: height + hash
+    assert [t.tx for t in sink.search_tx_events("tx.height = 2")] == [b"tx-c"]
+    h = tx_hash(b"tx-b").hex().upper()
+    assert [t.tx for t in sink.search_tx_events(f"tx.hash = '{h}'")] == [b"tx-b"]
+
+    # conjunction intersects
+    hits = sink.search_tx_events("app.key = 'apple' AND tx.height < 2")
+    assert [t.tx for t in hits] == [b"tx-a"]
+
+    # range over heights
+    hits = sink.search_tx_events("tx.height >= 1")
+    assert len(hits) == 3
+
+
+def test_kv_sink_block_events():
+    sink = KVSink(MemKV())
+    sink.index_block_events(
+        5,
+        [
+            abci.Event(
+                type="val_update",
+                attributes=(abci.EventAttribute(b"pubkey", b"aa", True),),
+            )
+        ],
+    )
+    sink.index_block_events(6, [])
+    assert sink.has_block(5) and sink.has_block(6) and not sink.has_block(7)
+    assert sink.search_block_events("val_update.pubkey = 'aa'") == [5]
+    assert sink.search_block_events("block.height > 5") == [6]
+
+
+def test_indexer_service_end_to_end():
+    async def go():
+        bus = EventBus()
+        await bus.start()
+        sink = KVSink(MemKV())
+        svc = IndexerService([sink, NullSink()], bus)
+        await svc.start()
+
+        class _Hdr:
+            height = 3
+
+        class _Blk:
+            header = _Hdr()
+
+        bus.publish_new_block(
+            E.EventDataNewBlock(
+                block=_Blk(),
+                block_id=None,
+                result_end_block=abci.ResponseEndBlock(
+                    events=(
+                        abci.Event(
+                            type="end",
+                            attributes=(
+                                abci.EventAttribute(b"done", b"yes", True),
+                            ),
+                        ),
+                    )
+                ),
+            )
+        )
+        bus.publish_tx(
+            E.EventDataTx(
+                height=3,
+                tx=b"indexed-tx",
+                index=0,
+                result=abci.ResponseDeliverTx(),
+            ),
+            tx_hash=tx_hash(b"indexed-tx"),
+        )
+        # service consumes asynchronously
+        for _ in range(100):
+            if sink.has_block(3) and sink.get_tx_by_hash(tx_hash(b"indexed-tx")):
+                break
+            await asyncio.sleep(0.01)
+        assert sink.has_block(3)
+        assert sink.search_block_events("end.done = 'yes'") == [3]
+        assert sink.get_tx_by_hash(tx_hash(b"indexed-tx")).height == 3
+        await svc.stop()
+        await bus.stop()
+
+    run(go())
+
+
+def test_kv_sink_nul_bytes_in_values():
+    """Values containing the key separator must not corrupt matching."""
+    sink = KVSink(MemKV())
+    sink.index_tx_events(
+        [make_tx_result(1, 0, b"tx-nul", key=b"a\x00b"),
+         make_tx_result(1, 1, b"tx-plain", key=b"a")]
+    )
+    hits = sink.search_tx_events("app.key = 'a'")
+    assert [t.tx for t in hits] == [b"tx-plain"]
+    hits = sink.search_tx_events("app.key CONTAINS 'a'")
+    assert {t.tx for t in hits} == {b"tx-nul", b"tx-plain"}

@@ -1,0 +1,193 @@
+"""Mempool tests (reference model: internal/mempool/mempool_test.go,
+cache_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import KVStoreApplication, LocalClient
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.mempool import (
+    LRUTxCache,
+    MempoolError,
+    TxInfo,
+    TxMempool,
+    tx_key,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class PriorityApp(KVStoreApplication):
+    """CheckTx priority = int suffix of the tx (`p<prio>:payload`)."""
+
+    def check_tx(self, req):
+        tx = req.tx
+        if tx.startswith(b"bad"):
+            return abci.ResponseCheckTx(code=1, log="rejected")
+        prio = 0
+        if tx.startswith(b"p") and b":" in tx:
+            try:
+                prio = int(tx[1 : tx.index(b":")])
+            except ValueError:
+                pass
+        return abci.ResponseCheckTx(gas_wanted=1, priority=prio)
+
+
+def make_pool(cfg=None):
+    app = PriorityApp()
+    client = LocalClient(app)
+    return TxMempool(client, cfg or MempoolConfig()), app
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_cache_lru_eviction():
+    c = LRUTxCache(2)
+    assert c.push(b"a") and c.push(b"b")
+    assert not c.push(b"a")  # dup
+    c.push(b"c")  # evicts b (a was refreshed by the dup push)
+    assert c.has(b"a") and c.has(b"c") and not c.has(b"b")
+    c.remove(b"a")
+    assert not c.has(b"a")
+
+
+def test_checktx_admits_and_dedups():
+    async def go():
+        pool, _ = make_pool()
+        res = await pool.check_tx(b"p5:hello")
+        assert res.is_ok and pool.size() == 1
+        with pytest.raises(MempoolError):
+            await pool.check_tx(b"p5:hello")  # cache dup
+        assert pool.size() == 1
+        # invalid tx not admitted, and removed from cache so it can retry
+        res = await pool.check_tx(b"bad1")
+        assert not res.is_ok and pool.size() == 1
+        assert not pool.cache.has(b"bad1")
+
+    run(go())
+
+
+def test_reap_priority_order_and_budgets():
+    async def go():
+        pool, _ = make_pool()
+        for i, prio in enumerate([3, 9, 1, 7]):
+            await pool.check_tx(f"p{prio}:tx{i}".encode())
+        txs = pool.reap_max_bytes_max_gas(-1, -1)
+        prios = [int(t[1 : t.index(b":")]) for t in txs]
+        assert prios == [9, 7, 3, 1]
+        # gas budget of 2 → only two txs (gas_wanted=1 each)
+        assert len(pool.reap_max_bytes_max_gas(-1, 2)) == 2
+        # byte budget fits only the first tx
+        assert len(pool.reap_max_bytes_max_gas(8, -1)) == 1
+        assert len(pool.reap_max_txs(3)) == 3
+
+    run(go())
+
+
+def test_eviction_of_lower_priority_when_full():
+    async def go():
+        cfg = MempoolConfig()
+        cfg.size = 2
+        pool, _ = make_pool(cfg)
+        await pool.check_tx(b"p1:a")
+        await pool.check_tx(b"p2:b")
+        # higher priority evicts the lowest
+        await pool.check_tx(b"p9:c")
+        assert pool.size() == 2
+        keys = {w.tx for w in pool._txs.values()}
+        assert keys == {b"p2:b", b"p9:c"}
+        # lower priority than everything resident → rejected
+        with pytest.raises(MempoolError):
+            await pool.check_tx(b"p0:d")
+        # rejected tx must be re-admittable later (not stuck in cache)
+        assert not pool.cache.has(b"p0:d")
+
+    run(go())
+
+
+def test_update_removes_committed_and_rechecks():
+    async def go():
+        pool, app = make_pool()
+        await pool.check_tx(b"p5:a")
+        await pool.check_tx(b"p6:b")
+        assert pool.size() == 2
+
+        # commit tx a → removed from pool, stays in cache
+        await pool.update(
+            2, [b"p5:a"], [abci.ResponseDeliverTx(code=0)]
+        )
+        assert pool.size() == 1
+        with pytest.raises(MempoolError):
+            await pool.check_tx(b"p5:a")  # committed txs stay cached
+
+        # app starts rejecting everything → recheck clears the pool
+        app.check_tx = lambda req: abci.ResponseCheckTx(code=1)
+        await pool.update(3, [], [])
+        assert pool.size() == 0
+
+    run(go())
+
+
+def test_ttl_purge_by_blocks():
+    async def go():
+        cfg = MempoolConfig()
+        cfg.ttl_num_blocks = 2
+        cfg.recheck = False
+        pool, _ = make_pool(cfg)
+        await pool.check_tx(b"p1:old")  # enters at height 0
+        await pool.update(1, [], [])
+        assert pool.size() == 1
+        await pool.update(3, [], [])  # 3 - 0 > 2 → expired
+        assert pool.size() == 0
+
+    run(go())
+
+
+def test_gossip_cursor_fifo():
+    async def go():
+        pool, _ = make_pool()
+        await pool.check_tx(b"p9:first")
+        await pool.check_tx(b"p1:second")
+        w1 = pool.next_gossip_tx(0)
+        assert w1.tx == b"p9:first"  # FIFO despite priority
+        w2 = pool.next_gossip_tx(w1.seq)
+        assert w2.tx == b"p1:second"
+        assert pool.next_gossip_tx(w2.seq) is None
+
+        # wait_for_tx wakes on insert
+        waiter = asyncio.create_task(pool.wait_for_tx(w2.seq))
+        await asyncio.sleep(0.01)
+        assert not waiter.done()
+        await pool.check_tx(b"p2:third")
+        got = await asyncio.wait_for(waiter, 1)
+        assert got.tx == b"p2:third"
+
+    run(go())
+
+
+def test_max_tx_bytes_enforced():
+    async def go():
+        cfg = MempoolConfig()
+        cfg.max_tx_bytes = 4
+        pool, _ = make_pool(cfg)
+        with pytest.raises(MempoolError):
+            await pool.check_tx(b"way-too-long")
+
+    run(go())
+
+
+def test_peer_tracking_on_duplicate():
+    async def go():
+        pool, _ = make_pool()
+        await pool.check_tx(b"p1:x", TxInfo(sender_id=1))
+        with pytest.raises(MempoolError):
+            await pool.check_tx(b"p1:x", TxInfo(sender_id=2))
+        wtx = pool._txs[tx_key(b"p1:x")]
+        assert wtx.peers == {1, 2}
+
+    run(go())
