@@ -1,1 +1,19 @@
+"""Validator signing — the PrivValidator boundary.
 
+reference: types/priv_validator.go:28-33 (interface), privval/file.go
+(FilePV with last-sign-state double-sign protection). The signer is a
+host-side component by design: consensus safety (never sign twice) is a
+disk-durability property, not a compute problem, so nothing here touches
+the device.
+"""
+
+from .types import MockPV, PrivValidator
+from .file import FilePV, FilePVKey, FilePVLastSignState
+
+__all__ = [
+    "PrivValidator",
+    "MockPV",
+    "FilePV",
+    "FilePVKey",
+    "FilePVLastSignState",
+]

@@ -1,0 +1,171 @@
+"""FilePV double-sign protection tests (reference: privval/file_test.go)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.privval import FilePV, MockPV
+from tendermint_tpu.privval.file import (
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+    STEP_PROPOSE,
+)
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def make_block_id(h=b"\x01" * 32) -> BlockID:
+    return BlockID(hash=h, part_set_header=PartSetHeader(1, b"\x02" * 32))
+
+
+def make_vote(height=1, round_=0, type_=PREVOTE_TYPE, block_id=None, addr=b"\x00" * 20):
+    return Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=block_id if block_id is not None else make_block_id(),
+        validator_address=addr,
+        validator_index=0,
+    )
+
+
+@pytest.fixture
+def pv(tmp_path):
+    return FilePV.generate(
+        str(tmp_path / "priv_key.json"), str(tmp_path / "priv_state.json")
+    )
+
+
+def test_generate_save_load_roundtrip(tmp_path, pv):
+    pv.save()
+    loaded = FilePV.load(pv.key.file_path, pv.last_sign_state.file_path)
+    assert loaded.key.priv_key.bytes() == pv.key.priv_key.bytes()
+    assert loaded.key.address == pv.key.address
+
+
+def test_load_or_generate_is_stable(tmp_path):
+    k, s = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    a = FilePV.load_or_generate(k, s)
+    b = FilePV.load_or_generate(k, s)
+    assert a.key.address == b.key.address
+
+
+def test_sign_vote_and_verify(pv):
+    vote = make_vote(addr=pv.key.address)
+    run(pv.sign_vote("test-chain", vote))
+    vote.verify("test-chain", pv.key.pub_key)
+
+
+def test_sign_proposal_and_verify(pv):
+    prop = Proposal(height=1, round=0, block_id=make_block_id())
+    run(pv.sign_proposal("test-chain", prop))
+    assert prop.verify("test-chain", pv.key.pub_key)
+
+
+def test_same_hrs_reuses_signature(pv):
+    v1 = make_vote(addr=pv.key.address)
+    run(pv.sign_vote("c", v1))
+    # Same vote, different timestamp → same signature + timestamp reused.
+    v2 = make_vote(addr=pv.key.address)
+    v2.timestamp_ns = v1.timestamp_ns + 1_000_000_000
+    run(pv.sign_vote("c", v2))
+    assert v2.signature == v1.signature
+    assert v2.timestamp_ns == v1.timestamp_ns
+
+
+def test_conflicting_vote_same_hrs_refused(pv):
+    v1 = make_vote(addr=pv.key.address)
+    run(pv.sign_vote("c", v1))
+    v2 = make_vote(addr=pv.key.address, block_id=make_block_id(b"\x03" * 32))
+    with pytest.raises(ValueError, match="conflicting data"):
+        run(pv.sign_vote("c", v2))
+
+
+def test_height_regression_refused(pv):
+    run(pv.sign_vote("c", make_vote(height=10, addr=pv.key.address)))
+    with pytest.raises(ValueError, match="height regression"):
+        run(pv.sign_vote("c", make_vote(height=9, addr=pv.key.address)))
+
+
+def test_round_regression_refused(pv):
+    run(pv.sign_vote("c", make_vote(height=5, round_=3, addr=pv.key.address)))
+    with pytest.raises(ValueError, match="round regression"):
+        run(pv.sign_vote("c", make_vote(height=5, round_=2, addr=pv.key.address)))
+
+
+def test_step_regression_refused(pv):
+    v = make_vote(height=5, type_=PRECOMMIT_TYPE, addr=pv.key.address)
+    run(pv.sign_vote("c", v))
+    with pytest.raises(ValueError, match="step regression"):
+        run(pv.sign_vote("c", make_vote(height=5, type_=PREVOTE_TYPE, addr=pv.key.address)))
+
+
+def test_step_order_propose_prevote_precommit(pv):
+    prop = Proposal(height=7, round=0, block_id=make_block_id())
+    run(pv.sign_proposal("c", prop))
+    run(pv.sign_vote("c", make_vote(height=7, type_=PREVOTE_TYPE, addr=pv.key.address)))
+    run(pv.sign_vote("c", make_vote(height=7, type_=PRECOMMIT_TYPE, addr=pv.key.address)))
+    assert pv.last_sign_state.step == STEP_PRECOMMIT
+
+
+def test_state_survives_crash(tmp_path):
+    """Signature released then process restarts: the reloaded signer must
+    still refuse to sign a conflicting vote at the same HRS."""
+    k, s = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(k, s)
+    pv.save()
+    run(pv.sign_vote("c", make_vote(height=3, addr=pv.key.address)))
+
+    pv2 = FilePV.load(k, s)
+    assert pv2.last_sign_state.height == 3
+    assert pv2.last_sign_state.step == STEP_PREVOTE
+    with pytest.raises(ValueError, match="conflicting data"):
+        run(pv2.sign_vote("c", make_vote(height=3, block_id=make_block_id(b"\x09" * 32), addr=pv.key.address)))
+
+
+def test_nil_vote_signing(pv):
+    v = make_vote(block_id=BlockID(), addr=pv.key.address)
+    run(pv.sign_vote("c", v))
+    v.verify("c", pv.key.pub_key)
+
+
+def test_mockpv_signs():
+    pv = MockPV()
+    v = make_vote()
+    run(pv.sign_vote("c", v))
+    pub = run(pv.get_pub_key())
+    v.validator_address = pub.address()
+    v.verify("c", pub)
+
+
+def test_load_missing_state_file_refused(tmp_path, pv):
+    """A lost state file must not silently disable double-sign protection."""
+    pv.key.save()
+    with pytest.raises(FileNotFoundError):
+        FilePV.load(pv.key.file_path, pv.last_sign_state.file_path)
+    # the explicit escape hatch still works
+    pv2 = FilePV.load_empty_state(pv.key.file_path, pv.last_sign_state.file_path)
+    assert pv2.last_sign_state.height == 0
+
+
+def test_key_file_permissions(tmp_path, pv):
+    pv.save()
+    assert os.stat(pv.key.file_path).st_mode & 0o777 == 0o600
+    assert os.stat(pv.last_sign_state.file_path).st_mode & 0o777 == 0o600
+
+
+def test_state_file_is_json(tmp_path, pv):
+    run(pv.sign_vote("c", make_vote(addr=pv.key.address)))
+    with open(pv.last_sign_state.file_path) as f:
+        raw = json.load(f)
+    assert raw["height"] == 1 and raw["step"] == STEP_PREVOTE
+    assert len(bytes.fromhex(raw["signature"])) == 64
