@@ -92,6 +92,9 @@ class Service:
         task = asyncio.get_event_loop().create_task(
             self._run_guarded(coro, name or self.name)
         )
+        # If the task is cancelled before its first tick, the inner coroutine
+        # never starts; close it then to avoid "never awaited" warnings.
+        task.add_done_callback(lambda _t: coro.close())
         self._tasks.append(task)
         return task
 

@@ -54,7 +54,6 @@ class TxMempool(Mempool):
         )
         self._lock = asyncio.Lock()  # held by consensus across Commit+Update
         self._tx_available = asyncio.Event()
-        self._postcheck = None
 
     # -- sizes --
 
@@ -109,12 +108,21 @@ class TxMempool(Mempool):
             raise MempoolError(
                 f"tx too large: {len(tx)} > {self.cfg.max_tx_bytes}"
             )
+        key = tx_key(tx)
         if not self.cache.push(tx):
             # seen before: note the gossiping peer for the existing entry
-            wtx = self._txs.get(tx_key(tx))
+            wtx = self._txs.get(key)
             if wtx is not None and tx_info.sender_id:
                 wtx.peers.add(tx_info.sender_id)
             raise MempoolError("tx already exists in cache")
+        if key in self._txs:
+            # pool-resident but cache-evicted (shared LRU churn): don't
+            # re-insert — that would double-count bytes and reset the
+            # gossip seq (reference: mempool.go txStore.GetTxByHash guard)
+            wtx = self._txs[key]
+            if tx_info.sender_id:
+                wtx.peers.add(tx_info.sender_id)
+            raise MempoolError("tx already exists in the mempool")
 
         res = await self._app.check_tx(abci.RequestCheckTx(tx=tx))
         if not res.is_ok:

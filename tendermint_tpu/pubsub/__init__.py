@@ -30,6 +30,13 @@ __all__ = [
 
 ERR_TERMINATED = "subscription terminated: queue overflow"
 
+# Pushed into a subscription's queue on termination so a consumer blocked
+# in `await get()` wakes immediately instead of polling. On queue-overflow
+# termination the queue is full (consumer not blocked), so the sentinel
+# being undeliverable there is fine: the consumer hits the terminated
+# check after draining.
+_SENTINEL = object()
+
 
 class SubscriptionError(Exception):
     pass
@@ -65,22 +72,24 @@ class Subscription:
     def _terminate(self, reason: str) -> None:
         if not self._terminated:
             self._terminated = reason
+            try:
+                self._queue.put_nowait(_SENTINEL)
+            except asyncio.QueueFull:
+                pass  # consumer isn't blocked; it'll see _terminated
 
     async def next(self) -> Message:
-        """Await the next matching message; raises if terminated and
-        drained."""
+        """Await the next matching message; raises SubscriptionError once
+        terminated and drained. Event-driven — no polling."""
         while True:
-            if self._queue.empty() and self._terminated:
-                raise SubscriptionError(self._terminated)
-            if self._terminated:
-                try:
-                    return self._queue.get_nowait()
-                except asyncio.QueueEmpty:
-                    raise SubscriptionError(self._terminated)
             try:
-                return await asyncio.wait_for(self._queue.get(), timeout=0.5)
-            except asyncio.TimeoutError:
-                continue
+                msg = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                if self._terminated:
+                    raise SubscriptionError(self._terminated)
+                msg = await self._queue.get()
+            if msg is _SENTINEL:
+                raise SubscriptionError(self._terminated or "terminated")
+            return msg
 
     def __aiter__(self):
         return self

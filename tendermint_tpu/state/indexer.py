@@ -18,6 +18,7 @@ from ..abci.codec import _dec_resp_deliver_tx, _enc_resp_deliver_tx
 from ..encoding.proto import FieldReader, ProtoWriter
 from ..eventbus import EventBus
 from ..libs.service import Service
+from ..pubsub import SubscriptionError
 from ..pubsub.query import Query, compile_query
 from ..store.kv import KVStore
 from ..types import events as E
@@ -262,13 +263,8 @@ class IndexerService(Service):
         self.bus = event_bus
 
     async def on_start(self) -> None:
-        self._block_sub = self.bus.subscribe(
-            "indexer", f"{E.EVENT_TYPE_KEY} = '{E.EventValue.NEW_BLOCK}'",
-            limit=1000,
-        )
-        self._tx_sub = self.bus.subscribe(
-            "indexer", f"{E.EVENT_TYPE_KEY} = '{E.EventValue.TX}'", limit=10000
-        )
+        self._resubscribe("block")
+        self._resubscribe("tx")
         self.spawn(self._index_blocks())
         self.spawn(self._index_txs())
 
@@ -279,22 +275,62 @@ class IndexerService(Service):
             pass
 
     async def _index_blocks(self) -> None:
-        async for msg in self._block_sub:
-            data = msg.data
-            events = []
-            for src in (data.result_begin_block, data.result_end_block):
-                events.extend(getattr(src, "events", ()) or ())
-            for sink in self.sinks:
-                sink.index_block_events(data.block.header.height, events)
+        await self._consume("block", lambda: self._block_sub, self._on_block)
 
     async def _index_txs(self) -> None:
-        async for msg in self._tx_sub:
-            data = msg.data
-            tr = TxResult(
-                height=data.height,
-                index=data.index,
-                tx=data.tx,
-                result=data.result,
+        await self._consume("tx", lambda: self._tx_sub, self._on_tx)
+
+    async def _consume(self, kind: str, get_sub, handler) -> None:
+        """Drain a subscription forever. A sink error is logged, not fatal
+        (one bad height must not kill indexing); a queue-overflow
+        termination resubscribes loudly instead of silently stopping."""
+        while self.is_running:
+            try:
+                msg = await get_sub().next()
+            except SubscriptionError as e:
+                if not self.is_running or str(e) in (
+                    "unsubscribed", "server stopped"
+                ):
+                    return  # clean shutdown paths, not a lost subscription
+                self.logger.error(
+                    f"{kind} subscription lost; resubscribing "
+                    "(events in the gap are not indexed)",
+                    err=str(e),
+                )
+                self._resubscribe(kind)
+                continue
+            try:
+                handler(msg.data)
+            except Exception:
+                self.logger.exception(f"failed to index {kind} events")
+
+    def _resubscribe(self, kind: str) -> None:
+        if kind == "block":
+            self._block_sub = self.bus.subscribe(
+                "indexer",
+                f"{E.EVENT_TYPE_KEY} = '{E.EventValue.NEW_BLOCK}'",
+                limit=1000,
             )
-            for sink in self.sinks:
-                sink.index_tx_events([tr])
+        else:
+            self._tx_sub = self.bus.subscribe(
+                "indexer",
+                f"{E.EVENT_TYPE_KEY} = '{E.EventValue.TX}'",
+                limit=10000,
+            )
+
+    def _on_block(self, data) -> None:
+        events = []
+        for src in (data.result_begin_block, data.result_end_block):
+            events.extend(getattr(src, "events", ()) or ())
+        for sink in self.sinks:
+            sink.index_block_events(data.block.header.height, events)
+
+    def _on_tx(self, data) -> None:
+        tr = TxResult(
+            height=data.height,
+            index=data.index,
+            tx=data.tx,
+            result=data.result,
+        )
+        for sink in self.sinks:
+            sink.index_tx_events([tr])

@@ -207,3 +207,30 @@ def test_eventbus_new_block_and_round_steps():
         await bus.stop()
 
     run(go())
+
+
+def test_pubsub_next_wakes_on_terminate():
+    """A consumer blocked in next() must wake promptly when its
+    subscription is terminated (no 0.5s polling)."""
+    import time as _time
+    from tendermint_tpu.pubsub import Server, SubscriptionError
+
+    async def go():
+        srv = Server()
+        sub = srv.subscribe("c", "tm.event = 'Tx'", limit=2)
+
+        async def consume():
+            try:
+                await sub.next()
+            except SubscriptionError as e:
+                return str(e)
+
+        task = asyncio.get_event_loop().create_task(consume())
+        await asyncio.sleep(0.01)  # let consumer block in next()
+        t0 = _time.monotonic()
+        srv.unsubscribe("c", "tm.event = 'Tx'")
+        reason = await asyncio.wait_for(task, timeout=1.0)
+        assert _time.monotonic() - t0 < 0.2
+        assert reason == "unsubscribed"
+
+    run(go())

@@ -191,3 +191,19 @@ def test_peer_tracking_on_duplicate():
         assert wtx.peers == {1, 2}
 
     run(go())
+
+
+def test_duplicate_with_no_cache_does_not_double_count():
+    """Pool-resident tx re-gossiped while absent from the cache must not
+    double-count bytes or reset the gossip seq (cache_size=0 → NopTxCache)."""
+    async def go():
+        pool, _ = make_pool(MempoolConfig(cache_size=0))
+        await pool.check_tx(b"p5:hello")
+        n, b, seq = pool.size(), pool.size_bytes(), pool.next_gossip_tx(-1).seq
+        with pytest.raises(MempoolError, match="already exists in the mempool"):
+            await pool.check_tx(b"p5:hello")
+        assert pool.size() == n
+        assert pool.size_bytes() == b
+        assert pool.next_gossip_tx(-1).seq == seq
+
+    run(go())
