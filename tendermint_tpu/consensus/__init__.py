@@ -1,1 +1,24 @@
+"""Consensus engine — BFT state machine, WAL, timeouts, wire messages.
 
+reference: internal/consensus/. The compute-heavy verification paths it
+drives (per-vote signature checks, whole-commit batch verification) live
+in the crypto/types layers and run on the device; this package is the
+host-side orchestration.
+"""
+
+from .state import ConsensusState
+from .ticker import TimeoutTicker
+from .types import HeightVoteSet, RoundState, RoundStep, step_name
+from .wal import WAL, NopWAL, iter_wal_records
+
+__all__ = [
+    "ConsensusState",
+    "TimeoutTicker",
+    "HeightVoteSet",
+    "RoundState",
+    "RoundStep",
+    "step_name",
+    "WAL",
+    "NopWAL",
+    "iter_wal_records",
+]

@@ -1,0 +1,1085 @@
+"""ConsensusState — the Tendermint BFT state machine.
+
+reference: internal/consensus/state.go. One async receive loop serializes
+every input (peer messages, own messages, timeouts) through the WAL, then
+drives the round-step transitions:
+
+    NewHeight → NewRound → Propose → Prevote → (PrevoteWait) →
+    Precommit → (PrecommitWait) → Commit → NewHeight …
+
+Single-writer by construction (reference: state.go:803 receiveRoutine):
+all mutation happens on the receive task; producers only enqueue. The
+signature-verification hot paths hit the device:
+  - per-vote verify in VoteSet.add_vote (crypto layer seam),
+  - whole-LastCommit batch verify inside BlockExecutor.validate_block →
+    types.validation.verify_commit (the TPU kernel's north-star call).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from ..config import ConsensusConfig
+from ..eventbus import EventBus
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..privval.types import PrivValidator
+from ..state.execution import BlockExecutor
+from ..state.types import State
+from ..store.block_store import BlockStore
+from ..types import events as E
+from ..types.block import Block
+from ..types.block_id import BlockID, PartSetHeader
+from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from ..types.commit import Commit
+from ..types.part_set import PartSet
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+from ..types.vote_set import ConflictingVoteError, VoteSet, commit_to_vote_set
+from .msgs import (
+    BlockPartMessage,
+    EndHeightMessage,
+    MsgInfo,
+    ProposalMessage,
+    TimeoutInfo,
+    VoteMessage,
+)
+from .ticker import TimeoutTicker
+from .types import HeightVoteSet, RoundState, RoundStep, step_name
+from .wal import WAL, NopWAL
+
+__all__ = ["ConsensusState"]
+
+
+class ConsensusState(Service):
+    """reference: internal/consensus/state.go:60 (struct), :803
+    (receiveRoutine)."""
+
+    def __init__(
+        self,
+        cfg: ConsensusConfig,
+        state: State,
+        block_exec: BlockExecutor,
+        block_store: BlockStore,
+        privval: Optional[PrivValidator] = None,
+        event_bus: Optional[EventBus] = None,
+        wal: "WAL | NopWAL | None" = None,
+        evidence_pool=None,
+    ) -> None:
+        super().__init__(name="consensus", logger=get_logger("consensus"))
+        self.cfg = cfg
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.privval = privval
+        self.privval_pub_key = None
+        self.event_bus = event_bus
+        self.wal = wal if wal is not None else NopWAL()
+        self.evpool = evidence_pool
+
+        self.rs = RoundState()
+        self.state: Optional[State] = None
+
+        self.peer_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+        self.internal_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+        self.ticker = TimeoutTicker()
+        self._replay_mode = False
+        # height of the last EndHeight marker found in the WAL on boot
+        self._done_first_block = asyncio.Event()
+
+        # overridable for Byzantine tests
+        # (reference: state.go decideProposal/doPrevote function fields)
+        self.decide_proposal = self._default_decide_proposal
+        self.do_prevote = self._default_do_prevote
+
+        self._update_to_state(state)
+        self._reconstruct_last_commit_from_store(state)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    async def on_start(self) -> None:
+        if self.privval is not None:
+            self.privval_pub_key = await self.privval.get_pub_key()
+        await self.wal.start()
+        await self.ticker.start()
+        await self._catchup_replay(self.rs.height)
+        self.spawn(self._receive_routine(), "receive")
+        self._schedule_round_0()
+
+    async def on_stop(self) -> None:
+        await self.ticker.stop()
+        await self.wal.stop()
+
+    # ------------------------------------------------------------------
+    # public API (used by reactor / RPC / tests)
+
+    def get_round_state(self) -> RoundState:
+        return self.rs
+
+    def send_peer_msg(self, msg, peer_id: str) -> None:
+        """Enqueue a consensus message from the network."""
+        self.peer_msg_queue.put_nowait(MsgInfo(msg=msg, peer_id=peer_id))
+
+    def _send_internal(self, msg) -> None:
+        self.internal_msg_queue.put_nowait(MsgInfo(msg=msg, peer_id=""))
+
+    def is_proposer(self, address: bytes) -> bool:
+        return self.rs.validators.get_proposer().address == address
+
+    def privval_address(self) -> Optional[bytes]:
+        return (
+            self.privval_pub_key.address()
+            if self.privval_pub_key is not None
+            else None
+        )
+
+    async def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
+        """Test/RPC helper: block until consensus reaches `height`."""
+        deadline = time.monotonic() + timeout
+        while self.rs.height < height:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"height {height} not reached (at {self.rs.height})"
+                )
+            await asyncio.sleep(0.01)
+
+    # ------------------------------------------------------------------
+    # state transitions between heights
+
+    def _update_to_state(self, state: State) -> None:
+        """Reset the RoundState for the height after state.last_block_height
+        (reference: state.go:670-792 updateToState)."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"updateToState at height {state.last_block_height} "
+                f"while at {rs.height}/{rs.commit_round}"
+            )
+        if (
+            self.state is not None
+            and self.state.last_block_height > 0
+            and self.state.last_block_height + 1 != rs.height
+        ):
+            # (LastBlockHeight==0 means genesis; rs.height is then
+            # initial_height which may be > 1 — reference: state.go:688-700)
+            raise RuntimeError("inconsistent state for ConsensusState")
+
+        # Carry over +2/3 precommits as the new LastCommit
+        last_commit: Optional[VoteSet] = None
+        if state.last_block_height > 0 and rs.commit_round > -1:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if precommits is None or not precommits.has_two_thirds_majority():
+                raise RuntimeError(
+                    "updateToState called without +2/3 precommits"
+                )
+            last_commit = precommits
+        elif state.last_block_height > 0:
+            last_commit = rs.last_commit  # restart path, set by reconstruct
+
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        now_ns = time.time_ns()
+        if rs.commit_time_ns == 0:
+            start_time_ns = now_ns + int(self.cfg.timeout_commit * 1e9)
+        else:
+            start_time_ns = rs.commit_time_ns + int(
+                self.cfg.timeout_commit * 1e9
+            )
+
+        validators = state.validators
+        rs.height = height
+        rs.round = 0
+        rs.step = RoundStep.NEW_HEIGHT
+        rs.start_time_ns = start_time_ns
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.commit_round = -1
+        rs.last_commit = last_commit
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.state = state
+
+    def _reconstruct_last_commit_from_store(self, state: State) -> None:
+        """On restart, rebuild LastCommit from the stored seen-commit
+        (reference: state.go:640-668 reconstructLastCommit)."""
+        if state.last_block_height == 0:
+            return
+        if self.rs.last_commit is not None:
+            return
+        seen = self.block_store.load_seen_commit()
+        if seen is None or seen.height != state.last_block_height:
+            seen = self.block_store.load_block_commit(state.last_block_height)
+        if seen is None:
+            raise RuntimeError(
+                f"failed to reconstruct last commit; commit for height "
+                f"{state.last_block_height} not found"
+            )
+        vote_set = commit_to_vote_set(
+            state.chain_id, seen, state.last_validators
+        )
+        if not vote_set.has_two_thirds_majority():
+            raise RuntimeError(
+                "failed to reconstruct last commit; does not have +2/3"
+            )
+        self.rs.last_commit = vote_set
+
+    def _schedule_round_0(self) -> None:
+        """reference: state.go scheduleRound0."""
+        sleep_s = max(0.0, (self.rs.start_time_ns - time.time_ns()) / 1e9)
+        self._schedule_timeout(
+            sleep_s, self.rs.height, 0, RoundStep.NEW_HEIGHT
+        )
+
+    def _schedule_timeout(
+        self, duration_s: float, height: int, round_: int, step: int
+    ) -> None:
+        self.ticker.schedule(
+            TimeoutInfo(
+                duration_s=duration_s, height=height, round=round_, step=step
+            )
+        )
+
+    # ------------------------------------------------------------------
+    # the receive loop (reference: state.go:803 receiveRoutine)
+
+    async def _receive_routine(self) -> None:
+        internal_get = peer_get = timeout_get = None
+        loop = asyncio.get_event_loop()
+        try:
+            while True:
+                if internal_get is None:
+                    internal_get = loop.create_task(
+                        self.internal_msg_queue.get()
+                    )
+                if peer_get is None:
+                    peer_get = loop.create_task(self.peer_msg_queue.get())
+                if timeout_get is None:
+                    timeout_get = loop.create_task(
+                        self.ticker.timeout_queue.get()
+                    )
+                done, _pending = await asyncio.wait(
+                    {internal_get, peer_get, timeout_get},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                # Internal messages drain first: own votes/proposal must
+                # apply before further peer input (the reference processes
+                # whichever select case fires; strict priority here is
+                # deterministic).
+                if internal_get in done:
+                    mi = internal_get.result()
+                    internal_get = None
+                    self.wal.write_sync(mi)  # own message: fsync before act
+                    await self._handle_msg(mi)
+                if peer_get in done:
+                    mi = peer_get.result()
+                    peer_get = None
+                    self.wal.write(mi)
+                    await self._handle_msg(mi)
+                if timeout_get in done:
+                    ti = timeout_get.result()
+                    timeout_get = None
+                    self.wal.write(ti)
+                    await self._handle_timeout(ti)
+        finally:
+            for t in (internal_get, peer_get, timeout_get):
+                if t is not None and not t.done():
+                    t.cancel()
+
+    async def _handle_msg(self, mi: MsgInfo) -> None:
+        """reference: state.go:891-960 handleMsg."""
+        msg, peer_id = mi.msg, mi.peer_id
+        try:
+            if isinstance(msg, ProposalMessage):
+                self._set_proposal(msg.proposal)
+            elif isinstance(msg, BlockPartMessage):
+                added = await self._add_proposal_block_part(msg, peer_id)
+                if added:
+                    await self._handle_complete_proposal()
+            elif isinstance(msg, VoteMessage):
+                await self._try_add_vote(msg.vote, peer_id)
+            else:
+                self.logger.error(
+                    "unknown msg type in receive loop", type=type(msg).__name__
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error(
+                "failed to process message",
+                height=self.rs.height,
+                round=self.rs.round,
+                msg_type=type(msg).__name__,
+                err=str(e),
+            )
+
+    async def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """reference: state.go:962-1011 handleTimeout."""
+        rs = self.rs
+        if (
+            ti.height != rs.height
+            or ti.round < rs.round
+            or (ti.round == rs.round and ti.step < rs.step)
+        ):
+            self.logger.debug("ignoring tock because we are ahead", ti=repr(ti))
+            return
+        if ti.step == RoundStep.NEW_HEIGHT:
+            await self._enter_new_round(ti.height, 0)
+        elif ti.step == RoundStep.NEW_ROUND:
+            await self._enter_propose(ti.height, 0)
+        elif ti.step == RoundStep.PROPOSE:
+            self._publish_round_state_event("timeout_propose")
+            await self._enter_prevote(ti.height, ti.round)
+        elif ti.step == RoundStep.PREVOTE_WAIT:
+            self._publish_round_state_event("timeout_wait")
+            await self._enter_precommit(ti.height, ti.round)
+        elif ti.step == RoundStep.PRECOMMIT_WAIT:
+            self._publish_round_state_event("timeout_wait")
+            await self._enter_precommit(ti.height, ti.round)
+            await self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise RuntimeError(f"invalid timeout step {ti.step}")
+
+    # ------------------------------------------------------------------
+    # round-step transitions
+
+    async def _enter_new_round(self, height: int, round_: int) -> None:
+        """reference: state.go:1062-1142."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
+        ):
+            return
+        self.logger.info(
+            "entering new round",
+            height=height,
+            round=round_,
+            current=rs.height_round_step(),
+        )
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+        rs.round = round_
+        rs.step = RoundStep.NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            # round 0 keeps the proposal from NewHeight; later rounds start
+            # over (valid block, if any, is re-proposed by the new proposer)
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next round's votes too
+        rs.triggered_timeout_precommit = False
+        if self.event_bus:
+            self.event_bus.publish_new_round(
+                E.EventDataNewRound(
+                    height=height,
+                    round=round_,
+                    step=step_name(rs.step),
+                    proposer_address=rs.validators.get_proposer().address,
+                )
+            )
+        await self._enter_propose(height, round_)
+
+    async def _enter_propose(self, height: int, round_: int) -> None:
+        """reference: state.go:1144-1213."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PROPOSE
+        ):
+            return
+        self.logger.debug("entering propose step", hrs=rs.height_round_step())
+        rs.step = RoundStep.PROPOSE
+        self._new_step()
+
+        # Propose timeout regardless of proposer identity
+        self._schedule_timeout(
+            self.cfg.propose_timeout(round_), height, round_, RoundStep.PROPOSE
+        )
+
+        addr = self.privval_address()
+        if (
+            addr is not None
+            and rs.validators.has_address(addr)
+            and not self._replay_mode  # replay feeds the recorded proposal
+        ):
+            if self.is_proposer(addr):
+                self.logger.debug("our turn to propose")
+                await self.decide_proposal(height, round_)
+
+        if self._is_proposal_complete():
+            await self._enter_prevote(height, round_)
+
+    async def _default_decide_proposal(self, height: int, round_: int) -> None:
+        """reference: state.go:1215-1266 defaultDecideProposal."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            commit = self._load_commit_for_proposal(height)
+            if commit is None:
+                self.logger.error("propose: no last commit available")
+                return
+            block, block_parts = self.block_exec.create_proposal_block(
+                height, self.state, commit, self.privval_address()
+            )
+
+        block_id = BlockID(
+            hash=block.hash(), part_set_header=block_parts.header()
+        )
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=block_id,
+        )
+        try:
+            await self.privval.sign_proposal(self.state.chain_id, proposal)
+        except Exception as e:
+            if not self._replay_mode:
+                self.logger.error("propose: failed to sign proposal", err=str(e))
+            return
+        self._send_internal(ProposalMessage(proposal=proposal))
+        for i in range(block_parts.total):
+            part = block_parts.get_part(i)
+            self._send_internal(
+                BlockPartMessage(height=rs.height, round=round_, part=part)
+            )
+        self.logger.info(
+            "signed proposal", height=height, round=round_,
+            hash=block.hash().hex()[:16],
+        )
+
+    def _load_commit_for_proposal(self, height: int) -> Optional[Commit]:
+        if height == self.state.initial_height:
+            return Commit(height=0, round=0, block_id=BlockID(), signatures=[])
+        if (
+            self.rs.last_commit is not None
+            and self.rs.last_commit.has_two_thirds_majority()
+        ):
+            return self.rs.last_commit.make_commit()
+        return None
+
+    def _is_proposal_complete(self) -> bool:
+        """reference: state.go:1268-1282."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    async def _enter_prevote(self, height: int, round_: int) -> None:
+        """reference: state.go:1323-1352."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE
+        ):
+            return
+        self.logger.debug("entering prevote step", hrs=rs.height_round_step())
+        rs.step = RoundStep.PREVOTE
+        self._new_step()
+        await self.do_prevote(height, round_)
+
+    async def _default_do_prevote(self, height: int, round_: int) -> None:
+        """reference: state.go:1354-1417 defaultDoPrevote."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self.logger.debug("prevote: locked block")
+            await self._sign_add_vote(PREVOTE_TYPE, rs.locked_block.hash(),
+                                      rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self.logger.debug("prevote: ProposalBlock is nil; voting nil")
+            await self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except Exception as e:
+            self.logger.error(
+                "prevote: ProposalBlock is invalid; voting nil", err=str(e)
+            )
+            await self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
+        await self._sign_add_vote(
+            PREVOTE_TYPE,
+            rs.proposal_block.hash(),
+            rs.proposal_block_parts.header(),
+        )
+
+    async def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """reference: state.go enterPrevoteWait."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE_WAIT
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise RuntimeError(
+                "enterPrevoteWait without +2/3 prevotes for any block"
+            )
+        rs.step = RoundStep.PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            self.cfg.prevote_timeout(round_),
+            height, round_, RoundStep.PREVOTE_WAIT,
+        )
+
+    async def _enter_precommit(self, height: int, round_: int) -> None:
+        """reference: state.go:1419-1540."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PRECOMMIT
+        ):
+            return
+        self.logger.debug("entering precommit step", hrs=rs.height_round_step())
+        rs.step = RoundStep.PRECOMMIT
+        self._new_step()
+
+        prevotes = rs.votes.prevotes(round_)
+        block_id, ok = (
+            prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
+        )
+
+        if not ok:
+            self.logger.debug("precommit: no +2/3 prevotes; precommitting nil")
+            await self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+
+        self._publish_round_state_event("polka")
+
+        if block_id.is_zero():
+            # +2/3 prevoted nil: unlock and precommit nil
+            if rs.locked_block is not None:
+                self.logger.debug("precommit: +2/3 prevoted nil; unlocking")
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            await self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+            return
+
+        if rs.locked_block is not None and rs.locked_block.hashes_to(
+            block_id.hash
+        ):
+            self.logger.debug("precommit: +2/3 prevoted locked block; relocking")
+            rs.locked_round = round_
+            self._publish_round_state_event("relock")
+            await self._sign_add_vote(
+                PRECOMMIT_TYPE, block_id.hash, block_id.part_set_header
+            )
+            return
+
+        if rs.proposal_block is not None and rs.proposal_block.hashes_to(
+            block_id.hash
+        ):
+            self.logger.debug(
+                "precommit: +2/3 prevoted proposal block; locking",
+                hash=block_id.hash.hex()[:16],
+            )
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self._publish_round_state_event("lock")
+            await self._sign_add_vote(
+                PRECOMMIT_TYPE, block_id.hash, block_id.part_set_header
+            )
+            return
+
+        # +2/3 prevotes for a block we don't have: unlock, fetch it
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+            block_id.part_set_header
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet.from_header(
+                block_id.part_set_header
+            )
+        await self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
+
+    async def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """reference: state.go enterPrecommitWait."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise RuntimeError(
+                "enterPrecommitWait without +2/3 precommits for any block"
+            )
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(
+            self.cfg.precommit_timeout(round_),
+            height, round_, RoundStep.PRECOMMIT_WAIT,
+        )
+
+    async def _enter_commit(self, height: int, commit_round: int) -> None:
+        """reference: state.go:1573-1634."""
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStep.COMMIT:
+            return
+        self.logger.info(
+            "entering commit step", hrs=rs.height_round_step(),
+            commit_round=commit_round,
+        )
+        rs.step = RoundStep.COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time_ns = time.time_ns()
+        self._new_step()
+
+        precommits = rs.votes.precommits(commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok:
+            raise RuntimeError("enterCommit expects +2/3 precommits")
+
+        if rs.locked_block is not None and rs.locked_block.hashes_to(
+            block_id.hash
+        ):
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(
+            block_id.hash
+        ):
+            if rs.proposal_block_parts is None or not (
+                rs.proposal_block_parts.has_header(block_id.part_set_header)
+            ):
+                self.logger.info(
+                    "commit is for a block we do not know about; "
+                    "set ProposalBlock=nil",
+                    commit=block_id.hash.hex()[:16],
+                )
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet.from_header(
+                    block_id.part_set_header
+                )
+                self._publish_round_state_event("valid_block")
+        await self._try_finalize_commit(height)
+
+    async def _try_finalize_commit(self, height: int) -> None:
+        """reference: state.go:1636-1662."""
+        rs = self.rs
+        if rs.height != height:
+            raise RuntimeError("tryFinalizeCommit at wrong height")
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok or block_id.is_zero():
+            self.logger.error(
+                "failed attempt to finalize commit; there was no +2/3 majority "
+                "or +2/3 was for nil"
+            )
+            return
+        if rs.proposal_block is None or not rs.proposal_block.hashes_to(
+            block_id.hash
+        ):
+            self.logger.debug(
+                "failed attempt to finalize commit; we do not have the "
+                "commit block",
+                proposal_block=(
+                    rs.proposal_block.hash().hex()[:16]
+                    if rs.proposal_block else "nil"
+                ),
+                commit_block=block_id.hash.hex()[:16],
+            )
+            return
+        await self._finalize_commit(height)
+
+    async def _finalize_commit(self, height: int) -> None:
+        """Save the block, write EndHeight, ApplyBlock, advance
+        (reference: state.go:1664-1777)."""
+        rs = self.rs
+        if rs.height != height or rs.step != RoundStep.COMMIT:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, _ = precommits.two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+
+        block.validate_basic()
+        self.block_exec.validate_block(self.state, block)
+
+        self.logger.info(
+            "finalizing commit of block",
+            height=height,
+            hash=block.hash().hex()[:16],
+            num_txs=len(block.txs),
+        )
+
+        if self.block_store.height() < block.header.height:
+            seen_commit = precommits.make_commit()
+            self.block_store.save_block(block, block_parts, seen_commit)
+        else:
+            self.logger.debug(
+                "calling finalizeCommit on already stored block", height=height
+            )
+
+        # EndHeight implies the blockstore has the block; crash before it →
+        # ApplyBlock re-runs via handshake on restart (reference:
+        # state.go:1714-1733)
+        self.wal.write_end_height(height)
+
+        state_copy = self.state.copy()
+        new_state = await self.block_exec.apply_block(
+            state_copy,
+            BlockID(hash=block.hash(), part_set_header=block_parts.header()),
+            block,
+        )
+
+        self._update_to_state(new_state)
+        self._done_first_block.set()
+
+        if self.privval is not None:
+            try:
+                self.privval_pub_key = await self.privval.get_pub_key()
+            except Exception as e:
+                self.logger.error(
+                    "failed to refetch privval pubkey", err=str(e)
+                )
+        self._schedule_round_0()
+
+    # ------------------------------------------------------------------
+    # proposals
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """reference: state.go:1786-1836 defaultSetProposal."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            0 <= proposal.pol_round >= proposal.round
+        ):
+            raise ValueError("invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposal.verify(self.state.chain_id, proposer.pub_key):
+            raise ValueError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet.from_header(
+                proposal.block_id.part_set_header
+            )
+        self.logger.info(
+            "received proposal",
+            height=proposal.height,
+            round=proposal.round,
+            hash=proposal.block_id.hash.hex()[:16],
+        )
+
+    async def _add_proposal_block_part(
+        self, msg: BlockPartMessage, peer_id: str
+    ) -> bool:
+        """reference: state.go:1838-1896. True if the part was added."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if added and rs.proposal_block_parts.is_complete():
+            data = rs.proposal_block_parts.assemble()
+            rs.proposal_block = Block.from_proto(data)
+            self.logger.info(
+                "received complete proposal block",
+                height=rs.proposal_block.header.height,
+                hash=rs.proposal_block.hash().hex()[:16],
+            )
+            if self.event_bus:
+                self.event_bus.publish_complete_proposal(
+                    E.EventDataCompleteProposal(
+                        height=rs.height,
+                        round=rs.round,
+                        step=step_name(rs.step),
+                        block_id=BlockID(
+                            hash=rs.proposal_block.hash(),
+                            part_set_header=rs.proposal_block_parts.header(),
+                        ),
+                    )
+                )
+        return added
+
+    async def _handle_complete_proposal(self) -> None:
+        """reference: state.go:1898-1942."""
+        rs = self.rs
+        if rs.proposal_block is None:
+            return
+        prevotes = rs.votes.prevotes(rs.round)
+        if prevotes is not None:
+            block_id, has_two_thirds = prevotes.two_thirds_majority()
+            if (
+                has_two_thirds
+                and not block_id.is_zero()
+                and rs.valid_round < rs.round
+            ):
+                if rs.proposal_block.hashes_to(block_id.hash):
+                    rs.valid_round = rs.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= RoundStep.PROPOSE and self._is_proposal_complete():
+            await self._enter_prevote(rs.height, rs.round)
+        elif rs.step == RoundStep.COMMIT:
+            await self._try_finalize_commit(rs.height)
+
+    # ------------------------------------------------------------------
+    # votes
+
+    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """reference: state.go:2010-2056."""
+        try:
+            return await self._add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            addr = self.privval_address()
+            if addr is not None and vote.validator_address == addr:
+                self.logger.error(
+                    "found conflicting vote from ourselves; "
+                    "did you unsafe_reset a validator?",
+                    height=vote.height, round=vote.round, type=vote.type,
+                )
+                return False
+            if self.evpool is not None and hasattr(
+                self.evpool, "report_conflicting_votes"
+            ):
+                self.evpool.report_conflicting_votes(e.vote_a, e.vote_b)
+            self.logger.debug(
+                "found and sent conflicting votes to the evidence pool",
+                vote_a=str(e.vote_a), vote_b=str(e.vote_b),
+            )
+            return False
+        except ValueError as e:
+            self.logger.info("failed attempting to add vote", err=str(e))
+            return False
+
+    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """reference: state.go:2058-2235."""
+        rs = self.rs
+        height = rs.height
+
+        # Late precommit for the previous height (during timeout_commit)
+        if vote.height + 1 == height and vote.type == PRECOMMIT_TYPE:
+            if rs.step != RoundStep.NEW_HEIGHT:
+                return False
+            if rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if not added:
+                return False
+            self._publish_vote_event(vote)
+            if self.cfg.skip_timeout_commit and rs.last_commit.has_all():
+                await self._enter_new_round(height, 0)
+            return added
+
+        if vote.height != height:
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        self._publish_vote_event(vote)
+
+        if vote.type == PREVOTE_TYPE:
+            await self._after_prevote_added(vote)
+        elif vote.type == PRECOMMIT_TYPE:
+            await self._after_precommit_added(vote)
+        return added
+
+    async def _after_prevote_added(self, vote: Vote) -> None:
+        rs = self.rs
+        height = rs.height
+        prevotes = rs.votes.prevotes(vote.round)
+        block_id, ok = prevotes.two_thirds_majority()
+        if ok:
+            # Unlock on a newer POL for a different block
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round <= rs.round
+                and not rs.locked_block.hashes_to(block_id.hash)
+            ):
+                self.logger.debug(
+                    "unlocking because of POL", locked_round=rs.locked_round,
+                    pol_round=vote.round,
+                )
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            # Update the valid block
+            if (
+                not block_id.is_zero()
+                and rs.valid_round < vote.round == rs.round
+            ):
+                if rs.proposal_block is not None and rs.proposal_block.hashes_to(
+                    block_id.hash
+                ):
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    # polka for a block we don't have: fetch it
+                    rs.proposal_block = None
+                if rs.proposal_block_parts is None or not (
+                    rs.proposal_block_parts.has_header(
+                        block_id.part_set_header
+                    )
+                ):
+                    rs.proposal_block_parts = PartSet.from_header(
+                        block_id.part_set_header
+                    )
+                self._publish_round_state_event("valid_block")
+
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            await self._enter_new_round(height, vote.round)
+        elif rs.round == vote.round and rs.step >= RoundStep.PREVOTE:
+            block_id, ok = prevotes.two_thirds_majority()
+            if ok and (self._is_proposal_complete() or block_id.is_zero()):
+                await self._enter_precommit(height, vote.round)
+            elif prevotes.has_two_thirds_any():
+                await self._enter_prevote_wait(height, vote.round)
+        elif (
+            rs.proposal is not None
+            and 0 <= rs.proposal.pol_round == vote.round
+        ):
+            if self._is_proposal_complete():
+                await self._enter_prevote(height, rs.round)
+
+    async def _after_precommit_added(self, vote: Vote) -> None:
+        rs = self.rs
+        height = rs.height
+        precommits = rs.votes.precommits(vote.round)
+        block_id, ok = precommits.two_thirds_majority()
+        if ok:
+            await self._enter_new_round(height, vote.round)
+            await self._enter_precommit(height, vote.round)
+            if not block_id.is_zero():
+                await self._enter_commit(height, vote.round)
+                if self.cfg.skip_timeout_commit and precommits.has_all():
+                    await self._enter_new_round(rs.height, 0)
+            else:
+                await self._enter_precommit_wait(height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            await self._enter_new_round(height, vote.round)
+            await self._enter_precommit_wait(height, vote.round)
+
+    async def _sign_add_vote(
+        self, msg_type: int, hash_: bytes, header
+    ) -> Optional[Vote]:
+        """Sign our vote and feed it back through the internal queue
+        (reference: state.go:2316-2372 signAddVote)."""
+        rs = self.rs
+        if self.privval is None or self.privval_pub_key is None:
+            return None
+        addr = self.privval_pub_key.address()
+        if not rs.validators.has_address(addr):
+            return None
+        if self._replay_mode:
+            return None
+        idx, _ = rs.validators.get_by_address(addr)
+        vote = Vote(
+            type=msg_type,
+            height=rs.height,
+            round=rs.round,
+            block_id=BlockID(
+                hash=hash_,
+                part_set_header=header if header is not None else PartSetHeader(),
+            ),
+            timestamp_ns=self._vote_time(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        try:
+            await self.privval.sign_vote(self.state.chain_id, vote)
+        except Exception as e:
+            self.logger.error("failed signing vote", err=str(e))
+            return None
+        self._send_internal(VoteMessage(vote=vote))
+        self.logger.debug(
+            "signed and pushed vote", height=rs.height, round=rs.round,
+            type=msg_type,
+        )
+        return vote
+
+    def _vote_time(self) -> int:
+        """Monotonic vote time: now, but never before lastBlockTime+1ms
+        (reference: state.go voteTime)."""
+        now = time.time_ns()
+        min_vote_time = now
+        if self.state is not None and self.state.last_block_time_ns > 0:
+            min_vote_time = self.state.last_block_time_ns + 1_000_000
+        return max(now, min_vote_time)
+
+    # ------------------------------------------------------------------
+    # WAL replay (crash recovery)
+
+    async def _catchup_replay(self, height: int) -> None:
+        """Replay WAL messages recorded after the last EndHeight
+        (reference: internal/consensus/replay.go:96-170)."""
+        msgs = self.wal.search_for_end_height(height - 1)
+        if msgs is None:
+            return
+        self._replay_mode = True
+        try:
+            for msg in msgs:
+                if isinstance(msg, MsgInfo):
+                    await self._handle_msg(msg)
+                elif isinstance(msg, TimeoutInfo):
+                    await self._handle_timeout(msg)
+                elif isinstance(msg, EndHeightMessage):
+                    raise RuntimeError(
+                        f"unexpected EndHeight {msg.height} during replay "
+                        f"of height {height}"
+                    )
+                # EventDataRoundStateWAL markers are informational
+        finally:
+            self._replay_mode = False
+        self.logger.info("replayed WAL messages", count=len(msgs), height=height)
+
+    # ------------------------------------------------------------------
+    # events
+
+    def _new_step(self) -> None:
+        rsw = E.EventDataRoundState(
+            height=self.rs.height,
+            round=self.rs.round,
+            step=step_name(self.rs.step),
+        )
+        if self.event_bus and not self._replay_mode:
+            self.event_bus.publish_new_round_step(rsw)
+
+    def _publish_round_state_event(self, kind: str) -> None:
+        if self.event_bus is None or self._replay_mode:
+            return
+        data = E.EventDataRoundState(
+            height=self.rs.height,
+            round=self.rs.round,
+            step=step_name(self.rs.step),
+        )
+        publish = {
+            "timeout_propose": self.event_bus.publish_timeout_propose,
+            "timeout_wait": self.event_bus.publish_timeout_wait,
+            "polka": self.event_bus.publish_polka,
+            "relock": self.event_bus.publish_relock,
+            "lock": self.event_bus.publish_lock,
+            "valid_block": self.event_bus.publish_valid_block,
+        }.get(kind)
+        if publish:
+            publish(data)
+
+    def _publish_vote_event(self, vote: Vote) -> None:
+        if self.event_bus and not self._replay_mode:
+            self.event_bus.publish_vote(E.EventDataVote(vote=vote))

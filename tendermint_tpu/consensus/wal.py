@@ -1,0 +1,215 @@
+"""Write-ahead log for consensus inputs.
+
+Every message is written to the WAL BEFORE it is processed, so a crashed
+node replays exactly the inputs it had seen and lands in the same round
+state (reference: internal/consensus/wal.go; write-before-process in
+state.go:855-870).
+
+Record framing (reference: wal.go encoder :268-292):
+    crc32(4, big-endian) | length(4, big-endian) | proto(TimedWALMessage)
+CRC is Python's zlib.crc32 (IEEE polynomial) rather than the reference's
+Castagnoli table — on-disk WALs are framework-local, not cross-verified.
+
+Own votes/proposals use write_sync (fsync) so a signature can never
+outlive its WAL record across a crash (reference: state.go:861).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import time
+import zlib
+from typing import Iterator, Optional, Tuple
+
+from ..libs.log import get_logger
+from ..libs.service import Service
+from .msgs import (
+    EndHeightMessage,
+    decode_timed_wal_message,
+    encode_timed_wal_message,
+)
+
+__all__ = ["WAL", "NopWAL", "WALDecodeError", "iter_wal_records"]
+
+MAX_MSG_SIZE = 1 << 20  # 1 MB (reference: wal.go maxMsgSizeBytes)
+FLUSH_INTERVAL_S = 2.0  # reference: wal.go walDefaultFlushInterval
+
+
+class WALDecodeError(Exception):
+    """Corrupt record (bad CRC / overlong / truncated mid-record)."""
+
+
+def _frame(payload: bytes) -> bytes:
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return struct.pack(">II", crc, len(payload)) + payload
+
+
+def _read_record(f: io.BufferedReader) -> Optional[bytes]:
+    """One framed record, None at clean EOF, WALDecodeError if torn."""
+    hdr = f.read(8)
+    if len(hdr) == 0:
+        return None
+    if len(hdr) < 8:
+        raise WALDecodeError("truncated record header")
+    crc, length = struct.unpack(">II", hdr)
+    if length > MAX_MSG_SIZE:
+        raise WALDecodeError(f"record too big: {length}")
+    payload = f.read(length)
+    if len(payload) < length:
+        raise WALDecodeError("truncated record body")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WALDecodeError("CRC mismatch")
+    return payload
+
+
+def iter_wal_records(path: str) -> Iterator[Tuple[int, object]]:
+    """Yield (time_ns, msg) from a WAL file, stopping at the first torn
+    record (a crash mid-write leaves a torn tail; everything before it is
+    intact — reference: wal.go:97-103 repair semantics)."""
+    with open(path, "rb") as f:
+        while True:
+            try:
+                payload = _read_record(f)
+            except WALDecodeError:
+                return
+            if payload is None:
+                return
+            yield decode_timed_wal_message(payload)
+
+
+class WAL(Service):
+    """reference: internal/consensus/wal.go BaseWAL."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__(name="wal", logger=get_logger("consensus.wal"))
+        self.path = path
+        self._f: Optional[io.BufferedWriter] = None
+        self._dirty = False
+
+    async def on_start(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._truncate_torn_tail()
+        self._f = open(self.path, "ab")
+        self.spawn(self._flush_routine(), "wal-flush")
+
+    async def on_stop(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            self._f = None
+
+    def _truncate_torn_tail(self) -> None:
+        """Drop a torn final record left by a crash so appends start at a
+        record boundary."""
+        if not os.path.exists(self.path):
+            return
+        good_end = 0
+        with open(self.path, "rb") as f:
+            while True:
+                try:
+                    if _read_record(f) is None:
+                        break
+                    good_end = f.tell()
+                except WALDecodeError:
+                    self.logger.error(
+                        "WAL has a torn tail; truncating",
+                        good_bytes=good_end,
+                    )
+                    break
+        if good_end < os.path.getsize(self.path):
+            with open(self.path, "r+b") as f:
+                f.truncate(good_end)
+
+    # -- writes --
+
+    def write(self, msg) -> None:
+        """Buffered append (peer messages, timeouts — reference:
+        wal.go:173)."""
+        if self._f is None:
+            return
+        payload = encode_timed_wal_message(time.time_ns(), msg)
+        if len(payload) > MAX_MSG_SIZE:
+            raise ValueError(f"WAL message too big: {len(payload)}")
+        self._f.write(_frame(payload))
+        self._dirty = True
+
+    def write_sync(self, msg) -> None:
+        """Append + flush + fsync. Used for own messages: the signature
+        this record describes must hit disk before it leaves the process
+        (reference: wal.go:183-196, state.go:861)."""
+        self.write(msg)
+        self.flush_and_sync()
+
+    def flush_and_sync(self) -> None:
+        if self._f is None or not self._dirty:
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._dirty = False
+
+    async def _flush_routine(self) -> None:
+        """Periodic group flush (reference: wal.go:116 processFlushTicks)."""
+        import asyncio
+
+        while True:
+            await asyncio.sleep(FLUSH_INTERVAL_S)
+            self.flush_and_sync()
+
+    # -- replay support --
+
+    def write_end_height(self, height: int) -> None:
+        """Height fully committed; the replay cut point
+        (reference: state.go:867 updateToState → wal.WriteSync(EndHeight))."""
+        self.write_sync(EndHeightMessage(height=height))
+
+    def search_for_end_height(
+        self, height: int
+    ) -> Optional[list]:
+        """All messages recorded AFTER EndHeight(height), i.e. the inputs
+        of height+1 onward, or None if that marker isn't in the log
+        (reference: wal.go:202-254). height 0 means 'from the start' when
+        no EndHeight(0) exists but the log is non-empty."""
+        if not os.path.exists(self.path):
+            return None
+        out: list = []
+        found = False
+        for _ts, msg in iter_wal_records(self.path):
+            if isinstance(msg, EndHeightMessage) and msg.height == height:
+                found = True
+                out = []
+                continue
+            # Later EndHeight markers ARE returned so catchup replay can
+            # detect an inconsistent store/WAL (crash between EndHeight
+            # fsync and state save) instead of silently merging heights.
+            if found or height == 0:
+                out.append(msg)
+        if found:
+            return out
+        # Special case: a fresh WAL that never completed `height` but has
+        # records (reference treats missing EndHeight(0) as start-of-file).
+        if height == 0 and out:
+            return out
+        return None
+
+
+class NopWAL:
+    """For tests and non-validator replay paths
+    (reference: wal.go nilWAL)."""
+
+    def write(self, msg) -> None: ...
+
+    def write_sync(self, msg) -> None: ...
+
+    def flush_and_sync(self) -> None: ...
+
+    def write_end_height(self, height: int) -> None: ...
+
+    def search_for_end_height(self, height: int):
+        return None
+
+    async def start(self) -> None: ...
+
+    async def stop(self) -> None: ...

@@ -1,0 +1,229 @@
+"""WAL, consensus message codec, and timeout ticker tests
+(reference: internal/consensus/wal_test.go, ticker semantics)."""
+
+import asyncio
+import os
+import struct
+
+import pytest
+
+from tendermint_tpu.consensus.msgs import (
+    BlockPartMessage,
+    EndHeightMessage,
+    EventDataRoundStateWAL,
+    HasVoteMessage,
+    MsgInfo,
+    NewRoundStepMessage,
+    NewValidBlockMessage,
+    ProposalMessage,
+    ProposalPOLMessage,
+    TimeoutInfo,
+    VoteMessage,
+    VoteSetBitsMessage,
+    VoteSetMaj23Message,
+    decode_msg,
+    decode_timed_wal_message,
+    encode_msg,
+    encode_timed_wal_message,
+)
+from tendermint_tpu.consensus.ticker import TimeoutTicker
+from tendermint_tpu.consensus.wal import (
+    MAX_MSG_SIZE,
+    WAL,
+    WALDecodeError,
+    iter_wal_records,
+)
+from tendermint_tpu.crypto.merkle import Proof
+from tendermint_tpu.libs.bits import BitArray
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.types.part_set import Part
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def bid():
+    return BlockID(hash=b"\x11" * 32, part_set_header=PartSetHeader(2, b"\x22" * 32))
+
+
+# -- codec roundtrips --
+
+
+@pytest.mark.parametrize(
+    "msg",
+    [
+        NewRoundStepMessage(height=5, round=1, step=3, seconds_since_start_time=7, last_commit_round=0),
+        NewValidBlockMessage(height=5, round=1, block_part_set_header=PartSetHeader(3, b"\x07" * 32), block_parts=BitArray.from_words(3, [0b101]), is_commit=True),
+        ProposalMessage(proposal=Proposal(height=2, round=0, pol_round=-1, block_id=bid(), timestamp_ns=123456789, signature=b"\x01" * 64)),
+        ProposalPOLMessage(height=4, proposal_pol_round=1, proposal_pol=BitArray.from_words(4, [0b1011])),
+        BlockPartMessage(height=9, round=2, part=Part(index=1, bytes=b"chunk", proof=Proof(total=2, index=1, leaf_hash=b"\x03" * 32))),
+        VoteMessage(vote=Vote(type=PREVOTE_TYPE, height=3, round=0, block_id=bid(), timestamp_ns=42, validator_address=b"\x05" * 20, validator_index=2, signature=b"\x06" * 64)),
+        HasVoteMessage(height=3, round=0, type=PRECOMMIT_TYPE, index=7),
+        VoteSetMaj23Message(height=3, round=0, type=PREVOTE_TYPE, block_id=bid()),
+        VoteSetBitsMessage(height=3, round=0, type=PREVOTE_TYPE, block_id=bid(), votes=BitArray.from_words(5, [0b11010])),
+    ],
+    ids=lambda m: type(m).__name__,
+)
+def test_msg_envelope_roundtrip(msg):
+    data = encode_msg(msg)
+    back = decode_msg(data)
+    assert back == msg
+
+
+def test_wal_message_roundtrips():
+    for msg in (
+        MsgInfo(msg=HasVoteMessage(height=1, round=0, type=PREVOTE_TYPE, index=0), peer_id="peer1"),
+        TimeoutInfo(duration_s=3.5, height=10, round=2, step=4),
+        EndHeightMessage(height=33),
+        EventDataRoundStateWAL(height=5, round=0, step="RoundStepPropose"),
+    ):
+        data = encode_timed_wal_message(1_700_000_000_000_000_000, msg)
+        ts, back = decode_timed_wal_message(data)
+        assert ts == 1_700_000_000_000_000_000
+        assert back == msg
+
+
+# -- WAL file behavior --
+
+
+def wal_path(tmp_path):
+    return str(tmp_path / "cs.wal" / "wal")
+
+
+def test_wal_write_read_roundtrip(tmp_path):
+    async def go():
+        w = WAL(wal_path(tmp_path))
+        await w.start()
+        w.write(MsgInfo(msg=HasVoteMessage(height=1, round=0, type=PREVOTE_TYPE, index=3)))
+        w.write_sync(TimeoutInfo(duration_s=1.0, height=1, round=0, step=3))
+        w.write_end_height(1)
+        await w.stop()
+
+    run(go())
+    msgs = [m for _, m in iter_wal_records(wal_path(tmp_path))]
+    assert len(msgs) == 3
+    assert isinstance(msgs[0], MsgInfo)
+    assert isinstance(msgs[1], TimeoutInfo)
+    assert msgs[2] == EndHeightMessage(height=1)
+
+
+def test_wal_search_for_end_height(tmp_path):
+    async def go():
+        w = WAL(wal_path(tmp_path))
+        await w.start()
+        for h in (1, 2, 3):
+            w.write(MsgInfo(msg=HasVoteMessage(height=h, round=0, type=PREVOTE_TYPE, index=h)))
+            w.write_end_height(h)
+        w.write(MsgInfo(msg=HasVoteMessage(height=4, round=0, type=PREVOTE_TYPE, index=4)))
+        await w.stop()
+
+        after2 = w.search_for_end_height(2)
+        assert after2 is not None
+        # messages of heights 3 and 4 (EndHeight markers skipped)
+        hv = [m.msg.index for m in after2 if isinstance(m, MsgInfo)]
+        assert hv == [3, 4]
+
+        assert w.search_for_end_height(9) is None
+
+    run(go())
+
+
+def test_wal_torn_tail_truncated_on_restart(tmp_path):
+    path = wal_path(tmp_path)
+
+    async def write_good():
+        w = WAL(path)
+        await w.start()
+        w.write_sync(MsgInfo(msg=HasVoteMessage(height=1, round=0, type=PREVOTE_TYPE, index=1)))
+        await w.stop()
+
+    run(write_good())
+    size_good = os.path.getsize(path)
+    # simulate crash mid-write: valid header, truncated body
+    with open(path, "ab") as f:
+        f.write(struct.pack(">II", 0xDEAD, 100) + b"short")
+
+    async def restart():
+        w = WAL(path)
+        await w.start()
+        await w.stop()
+
+    run(restart())
+    assert os.path.getsize(path) == size_good
+    assert len(list(iter_wal_records(path))) == 1
+
+
+def test_wal_corrupt_crc_stops_iteration(tmp_path):
+    path = wal_path(tmp_path)
+
+    async def go():
+        w = WAL(path)
+        await w.start()
+        w.write_sync(EndHeightMessage(height=1))
+        w.write_sync(EndHeightMessage(height=2))
+        await w.stop()
+
+    run(go())
+    # flip a byte in the second record's payload
+    with open(path, "r+b") as f:
+        data = f.read()
+        f.seek(len(data) - 1)
+        f.write(bytes([data[-1] ^ 0xFF]))
+    msgs = list(iter_wal_records(path))
+    assert len(msgs) == 1  # stops at corruption
+
+
+def test_wal_oversize_message_rejected(tmp_path):
+    async def go():
+        w = WAL(wal_path(tmp_path))
+        await w.start()
+        big = MsgInfo(
+            msg=BlockPartMessage(
+                part=Part(
+                    index=0,
+                    bytes=b"x" * (MAX_MSG_SIZE + 10),
+                    proof=Proof(total=1, index=0, leaf_hash=b"\x00" * 32),
+                )
+            )
+        )
+        with pytest.raises(ValueError, match="too big"):
+            w.write(big)
+        await w.stop()
+
+    run(go())
+
+
+# -- ticker --
+
+
+def test_ticker_fires_and_ignores_stale():
+    async def go():
+        t = TimeoutTicker()
+        await t.start()
+        t.schedule(TimeoutInfo(duration_s=0.05, height=2, round=1, step=4))
+        # stale schedules (older height/round) must be ignored
+        t.schedule(TimeoutInfo(duration_s=0.01, height=1, round=0, step=4))
+        t.schedule(TimeoutInfo(duration_s=0.01, height=2, round=0, step=4))
+        ti = await asyncio.wait_for(t.timeout_queue.get(), timeout=1.0)
+        assert (ti.height, ti.round, ti.step) == (2, 1, 4)
+        assert t.timeout_queue.empty()
+        await t.stop()
+
+    run(go())
+
+
+def test_ticker_newer_overrides_pending():
+    async def go():
+        t = TimeoutTicker()
+        await t.start()
+        t.schedule(TimeoutInfo(duration_s=10.0, height=1, round=0, step=4))
+        t.schedule(TimeoutInfo(duration_s=0.02, height=1, round=1, step=4))
+        ti = await asyncio.wait_for(t.timeout_queue.get(), timeout=1.0)
+        assert ti.round == 1
+        await t.stop()
+
+    run(go())
