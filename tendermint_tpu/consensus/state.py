@@ -410,12 +410,12 @@ class ConsensusState(Service):
             self.cfg.propose_timeout(round_), height, round_, RoundStep.PROPOSE
         )
 
+        # Replay runs this too: the privval re-signs (same-HRS returns the
+        # identical signature) and the queued message dedups against the
+        # replayed one — matching the reference, where replayMode only
+        # silences logging (reference: replay.go:98-100, state.go:1258).
         addr = self.privval_address()
-        if (
-            addr is not None
-            and rs.validators.has_address(addr)
-            and not self._replay_mode  # replay feeds the recorded proposal
-        ):
+        if addr is not None and rs.validators.has_address(addr):
             if self.is_proposer(addr):
                 self.logger.debug("our turn to propose")
                 await self.decide_proposal(height, round_)
@@ -987,8 +987,6 @@ class ConsensusState(Service):
         addr = self.privval_pub_key.address()
         if not rs.validators.has_address(addr):
             return None
-        if self._replay_mode:
-            return None
         idx, _ = rs.validators.get_by_address(addr)
         vote = Vote(
             type=msg_type,
@@ -1005,7 +1003,8 @@ class ConsensusState(Service):
         try:
             await self.privval.sign_vote(self.state.chain_id, vote)
         except Exception as e:
-            self.logger.error("failed signing vote", err=str(e))
+            if not self._replay_mode:
+                self.logger.error("failed signing vote", err=str(e))
             return None
         self._send_internal(VoteMessage(vote=vote))
         self.logger.debug(

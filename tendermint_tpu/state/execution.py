@@ -41,10 +41,43 @@ from .types import State, median_time
 __all__ = [
     "BlockExecutor",
     "EmptyEvidencePool",
+    "build_last_commit_info",
     "results_hash",
     "validate_block",
     "validator_updates_from_abci",
 ]
+
+
+def build_last_commit_info(
+    block: Block, last_vals: "ValidatorSet | None", initial_height: int
+) -> abci.LastCommitInfo:
+    """ABCI LastCommitInfo from a block's LastCommit and the validator set
+    of the previous height; None last_vals (pruned history) yields votes=()
+    (reference: internal/state/execution.go getBeginBlockValidatorInfo).
+    Shared by BlockExecutor and the handshake replay path."""
+    if block.header.height == initial_height:
+        return abci.LastCommitInfo()
+    if last_vals is None:
+        return abci.LastCommitInfo(round=block.last_commit.round)
+    votes = []
+    for i, v in enumerate(last_vals.validators):
+        sig = (
+            block.last_commit.signatures[i]
+            if i < len(block.last_commit.signatures)
+            else None
+        )
+        signed = sig is not None and sig.block_id_flag != BLOCK_ID_FLAG_ABSENT
+        votes.append(
+            abci.VoteInfo(
+                validator=abci.Validator(
+                    address=v.address, power=v.voting_power
+                ),
+                signed_last_block=signed,
+            )
+        )
+    return abci.LastCommitInfo(
+        round=block.last_commit.round, votes=tuple(votes)
+    )
 
 
 def _deterministic_deliver_tx(r: abci.ResponseDeliverTx) -> bytes:
@@ -327,30 +360,10 @@ class BlockExecutor:
         self, state: State, block: Block
     ) -> abci.LastCommitInfo:
         """reference: internal/state/execution.go getBeginBlockValidatorInfo."""
-        if block.header.height == state.initial_height:
-            return abci.LastCommitInfo()
         last_vals = self.store.load_validators(block.header.height - 1)
         if last_vals is None:
             last_vals = state.last_validators
-        votes = []
-        for i, v in enumerate(last_vals.validators):
-            sig = (
-                block.last_commit.signatures[i]
-                if i < len(block.last_commit.signatures)
-                else None
-            )
-            signed = sig is not None and sig.block_id_flag != BLOCK_ID_FLAG_ABSENT
-            votes.append(
-                abci.VoteInfo(
-                    validator=abci.Validator(
-                        address=v.address, power=v.voting_power
-                    ),
-                    signed_last_block=signed,
-                )
-            )
-        return abci.LastCommitInfo(
-            round=block.last_commit.round, votes=tuple(votes)
-        )
+        return build_last_commit_info(block, last_vals, state.initial_height)
 
     def _begin_block_evidence(
         self, state: State, block: Block

@@ -1,0 +1,178 @@
+"""Handshaker / ReplayBlocks tests — app behind store, crash between
+SaveBlock and state save, crash between Commit and state save
+(reference model: internal/consensus/replay_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import KVStoreApplication, LocalClient
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.consensus.replay import Handshaker, HandshakeError
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.state import state_from_genesis
+
+from .test_consensus_state import Node, single_genesis
+
+CHAIN = "cs-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def run_chain_to(node, height):
+    await node.cs.start()
+    await node.cs.wait_for_height(height, timeout=30.0)
+    await node.cs.stop()
+
+
+def test_fresh_chain_init_chain():
+    async def go():
+        priv = PrivKeyEd25519.from_seed(b"\x21" * 32)
+        genesis = single_genesis(priv)
+        state = state_from_genesis(genesis)
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        node = Node(priv, genesis)  # for stores only; not started
+        h = Handshaker(
+            node.state_store, state, node.block_store, genesis
+        )
+        await h.handshake(client)
+        assert h.n_blocks == 0
+        # InitChain delivered the validator set to the app
+        assert len(app.validator_set) == 1
+
+    run(go())
+
+
+def test_app_behind_store_replays_into_app():
+    async def go():
+        priv = PrivKeyEd25519.from_seed(b"\x22" * 32)
+        genesis = single_genesis(priv)
+        node = Node(priv, genesis)
+        # real boot order: handshake (InitChain) before consensus starts
+        boot = Handshaker(
+            node.state_store, node.state_store.load(), node.block_store,
+            genesis,
+        )
+        await boot.handshake(node.client)
+        node.cs.state = node.state_store.load()
+        await run_chain_to(node, 4)
+        tip = node.block_store.height()
+        state = node.state_store.load()
+        assert state.last_block_height == tip
+
+        # a fresh app instance (height 0) must be caught up via replay
+        fresh_app = KVStoreApplication()
+        fresh_client = LocalClient(fresh_app)
+        h = Handshaker(
+            node.state_store, state, node.block_store, genesis
+        )
+        app_hash = await h.handshake(fresh_client)
+        assert h.n_blocks == tip
+        assert fresh_app.height == tip
+        assert app_hash == state.app_hash
+        info = await fresh_client.info(abci.RequestInfo())
+        assert info.last_block_height == tip
+
+    run(go())
+
+
+def test_crash_before_apply_replays_last_block_with_real_app():
+    async def go():
+        priv = PrivKeyEd25519.from_seed(b"\x23" * 32)
+        genesis = single_genesis(priv)
+        node = Node(priv, genesis)
+
+        # crash after SaveBlock(3) but before ApplyBlock(3)
+        real_apply = node.exec.apply_block
+
+        async def crashing_apply(state, block_id, block):
+            if block.header.height == 3:
+                raise RuntimeError("simulated crash before apply")
+            return await real_apply(state, block_id, block)
+
+        node.exec.apply_block = crashing_apply
+        await node.cs.start()
+        with pytest.raises(TimeoutError):
+            await node.cs.wait_for_height(4, timeout=1.5)
+        await node.cs.stop()
+
+        assert node.block_store.height() == 3
+        state = node.state_store.load()
+        assert state.last_block_height == 2
+        assert node.app.height == 2  # app also never saw block 3
+
+        node.exec.apply_block = real_apply
+        h = Handshaker(
+            node.state_store, state, node.block_store, genesis
+        )
+        app_hash = await h.handshake(node.client)
+        new_state = node.state_store.load()
+        assert new_state.last_block_height == 3
+        assert node.app.height == 3
+        assert app_hash == new_state.app_hash
+
+    run(go())
+
+
+def test_crash_after_commit_replays_with_mock_app():
+    async def go():
+        priv = PrivKeyEd25519.from_seed(b"\x24" * 32)
+        genesis = single_genesis(priv)
+        node = Node(priv, genesis)
+
+        # crash after the app committed height 3 but before state save
+        real_save = node.state_store.save
+
+        def crashing_save(state):
+            if state.last_block_height == 3:
+                raise RuntimeError("simulated crash before state save")
+            return real_save(state)
+
+        node.state_store.save = crashing_save
+        await node.cs.start()
+        with pytest.raises(TimeoutError):
+            await node.cs.wait_for_height(4, timeout=1.5)
+        await node.cs.stop()
+        node.state_store.save = real_save
+
+        assert node.block_store.height() == 3
+        state = node.state_store.load()
+        assert state.last_block_height == 2
+        assert node.app.height == 3  # app DID commit block 3
+        app_commits_before = node.app.height
+
+        h = Handshaker(
+            node.state_store, state, node.block_store, genesis
+        )
+        app_hash = await h.handshake(node.client)
+        new_state = node.state_store.load()
+        assert new_state.last_block_height == 3
+        # the real app was not driven again (mock served the responses)
+        assert node.app.height == app_commits_before
+        assert app_hash == new_state.app_hash
+
+    run(go())
+
+
+def test_app_ahead_of_store_is_an_error():
+    async def go():
+        priv = PrivKeyEd25519.from_seed(b"\x25" * 32)
+        genesis = single_genesis(priv)
+        node = Node(priv, genesis)
+        await run_chain_to(node, 3)
+        state = node.state_store.load()
+
+        class AheadApp(KVStoreApplication):
+            def info(self, req):
+                return abci.ResponseInfo(last_block_height=99)
+
+        h = Handshaker(
+            node.state_store, state, node.block_store, genesis
+        )
+        with pytest.raises(HandshakeError, match="ahead of store"):
+            await h.handshake(LocalClient(AheadApp()))
+
+    run(go())
