@@ -1,1 +1,52 @@
+"""P2P stack — router, peer manager, transports, secret connection.
 
+reference: internal/p2p/. The inter-host (DCN) fabric of the framework:
+encrypted TCP gossip between validator nodes. Intra-host device-mesh
+communication uses XLA collectives (tendermint_tpu/parallel), not this
+stack — see SURVEY.md §2.4 for the mapping.
+"""
+
+from .channel import Channel
+from .peermanager import (
+    PeerManager,
+    PeerManagerOptions,
+    PeerStatus,
+    PeerUpdate,
+)
+from .router import Router, RouterOptions
+from .transport import (
+    Connection,
+    MemoryNetwork,
+    MemoryTransport,
+    TCPTransport,
+    Transport,
+)
+from .types import (
+    ChannelDescriptor,
+    Envelope,
+    NodeInfo,
+    PeerError,
+    node_id_from_pubkey,
+    parse_node_address,
+)
+
+__all__ = [
+    "Channel",
+    "ChannelDescriptor",
+    "Connection",
+    "Envelope",
+    "MemoryNetwork",
+    "MemoryTransport",
+    "NodeInfo",
+    "PeerError",
+    "PeerManager",
+    "PeerManagerOptions",
+    "PeerStatus",
+    "PeerUpdate",
+    "Router",
+    "RouterOptions",
+    "TCPTransport",
+    "Transport",
+    "node_id_from_pubkey",
+    "parse_node_address",
+]

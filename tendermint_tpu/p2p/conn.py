@@ -1,0 +1,157 @@
+"""SecretConnection — authenticated encryption for peer links.
+
+reference: internal/p2p/conn/secret_connection.go. Station-to-Station:
+X25519 ECDH (:289-301) → HKDF key derivation (:337-389) → per-direction
+ChaCha20-Poly1305 AEAD frames with counter nonces (:455), identity proven
+by an ed25519 signature over the derived challenge (:391-453).
+
+Wire format (framework-local; not byte-compatible with the Go impl):
+  handshake: 32-byte ephemeral X25519 pubkey each way (plaintext)
+  then AEAD frames: 4-byte BE ciphertext length | ciphertext
+  first frame each way: AuthSig{pubkey=1, sig=2} proto
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
+
+from ..crypto.keys import PrivKey, PubKey, pubkey_from_type_and_bytes
+from ..encoding.proto import FieldReader, ProtoWriter
+
+__all__ = ["SecretConnection", "HandshakeError"]
+
+MAX_FRAME = 1 << 22  # 4 MiB ciphertext cap per frame
+_HKDF_INFO = b"TENDERMINT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+
+
+class HandshakeError(Exception):
+    pass
+
+
+def _derive(shared: bytes, local_eph: bytes, remote_eph: bytes):
+    """→ (send_key, recv_key, challenge). Key order is fixed by sorting
+    the ephemeral pubkeys, so both sides agree without a role bit
+    (reference: secret_connection.go deriveSecrets + sort32)."""
+    okm = HKDF(
+        algorithm=hashes.SHA256(),
+        length=96,
+        salt=None,
+        info=_HKDF_INFO,
+    ).derive(shared + min(local_eph, remote_eph) + max(local_eph, remote_eph))
+    key_a, key_b, challenge = okm[:32], okm[32:64], okm[64:]
+    if local_eph < remote_eph:
+        return key_a, key_b, challenge
+    return key_b, key_a, challenge
+
+
+def _auth_sig_bytes(pub: PubKey, sig: bytes) -> bytes:
+    w = ProtoWriter()
+    w.string(1, pub.type())
+    w.bytes(2, pub.bytes())
+    w.bytes(3, sig)
+    return w.finish()
+
+
+def _parse_auth_sig(data: bytes) -> Tuple[PubKey, bytes]:
+    r = FieldReader(data)
+    pub = pubkey_from_type_and_bytes(r.string(1), r.bytes(2))
+    return pub, r.bytes(3)
+
+
+class SecretConnection:
+    """Encrypted, authenticated framed stream over an asyncio socket."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        send_key: bytes,
+        recv_key: bytes,
+        remote_pubkey: PubKey,
+    ) -> None:
+        self._reader = reader
+        self._writer = writer
+        self._send = ChaCha20Poly1305(send_key)
+        self._recv = ChaCha20Poly1305(recv_key)
+        self._send_nonce = 0
+        self._recv_nonce = 0
+        self.remote_pubkey = remote_pubkey
+        self._write_lock = asyncio.Lock()
+
+    # -- establishment --
+
+    @classmethod
+    async def handshake(
+        cls,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        local_priv: PrivKey,
+    ) -> "SecretConnection":
+        """Mutual-auth handshake; symmetric (no initiator role)."""
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw
+        )
+        writer.write(eph_pub)
+        await writer.drain()
+        remote_eph = await reader.readexactly(32)
+        if remote_eph == eph_pub:
+            raise HandshakeError("remote echoed our ephemeral key")
+        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        send_key, recv_key, challenge = _derive(shared, eph_pub, remote_eph)
+
+        conn = cls(
+            reader, writer, send_key, recv_key, remote_pubkey=None  # set below
+        )
+        # Exchange identity proofs over the encrypted link
+        sig = local_priv.sign(challenge)
+        await conn.write_frame(_auth_sig_bytes(local_priv.pub_key(), sig))
+        remote_pub, remote_sig = _parse_auth_sig(await conn.read_frame())
+        if not remote_pub.verify_signature(challenge, remote_sig):
+            raise HandshakeError("challenge signature verification failed")
+        conn.remote_pubkey = remote_pub
+        return conn
+
+    # -- framed AEAD I/O --
+
+    def _nonce(self, counter: int) -> bytes:
+        return struct.pack("<Q", counter) + b"\x00\x00\x00\x00"
+
+    async def write_frame(self, plaintext: bytes) -> None:
+        async with self._write_lock:
+            ct = self._send.encrypt(
+                self._nonce(self._send_nonce), plaintext, None
+            )
+            self._send_nonce += 1
+            self._writer.write(struct.pack(">I", len(ct)) + ct)
+            await self._writer.drain()
+
+    async def read_frame(self) -> bytes:
+        hdr = await self._reader.readexactly(4)
+        (length,) = struct.unpack(">I", hdr)
+        if length > MAX_FRAME:
+            raise HandshakeError(f"frame too large: {length}")
+        ct = await self._reader.readexactly(length)
+        pt = self._recv.decrypt(self._nonce(self._recv_nonce), ct, None)
+        self._recv_nonce += 1
+        return pt
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass

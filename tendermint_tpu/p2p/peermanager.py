@@ -1,0 +1,333 @@
+"""PeerManager — peer lifecycle state machine and address book.
+
+reference: internal/p2p/peermanager.go (design comment :63-119, state
+transitions :386-778, Subscribe :828, Advertise :793). The manager owns
+which peers to dial, what to do on failure (exponential backoff), when to
+evict, and who gets the connection slots (persistent peers always win).
+
+States (implicit, like the reference):
+  candidate → dialing → connected → ready → evicting → disconnected
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..libs.log import get_logger
+from .types import NodeID, parse_node_address
+
+__all__ = ["PeerManager", "PeerManagerOptions", "PeerUpdate", "PeerStatus"]
+
+
+class PeerStatus:
+    UP = "up"
+    DOWN = "down"
+
+
+@dataclass
+class PeerUpdate:
+    node_id: NodeID
+    status: str
+
+
+@dataclass
+class PeerManagerOptions:
+    """reference: peermanager.go:121-175."""
+
+    persistent_peers: List[str] = field(default_factory=list)
+    max_connected: int = 16
+    max_connected_upgrade: int = 4
+    max_peers: int = 1000
+    min_retry_time: float = 0.5
+    max_retry_time: float = 600.0
+    max_retry_time_persistent: float = 20.0
+    retry_time_jitter: float = 0.1
+
+
+@dataclass
+class _Peer:
+    node_id: NodeID
+    addresses: Set[Tuple[str, int]] = field(default_factory=set)
+    persistent: bool = False
+    dial_attempts: int = 0
+    last_dial_failure: float = 0.0
+    connected: bool = False
+    ready: bool = False
+    inbound: bool = False
+    evicting: bool = False
+    score: int = 0
+
+    def retry_delay(self, opts: PeerManagerOptions) -> float:
+        if self.dial_attempts == 0:
+            return 0.0
+        cap = (
+            opts.max_retry_time_persistent
+            if self.persistent
+            else opts.max_retry_time
+        )
+        delay = opts.min_retry_time * (2 ** min(self.dial_attempts - 1, 16))
+        delay = min(delay, cap)
+        return delay * (1 + random.random() * opts.retry_time_jitter)
+
+
+class PeerManager:
+    def __init__(
+        self,
+        self_id: NodeID,
+        options: Optional[PeerManagerOptions] = None,
+        store=None,  # optional KVStore for address-book persistence
+    ) -> None:
+        self.self_id = self_id
+        self.opts = options or PeerManagerOptions()
+        self.logger = get_logger("p2p.peermanager")
+        self._peers: Dict[NodeID, _Peer] = {}
+        self._subscribers: List[asyncio.Queue] = []
+        self._evict_queue: asyncio.Queue[NodeID] = asyncio.Queue()
+        self._wakeup = asyncio.Event()  # new candidates / freed slots
+        self._store = store
+        self._last_persist = 0.0
+        self._dirty = False
+        if store is not None:
+            self._load()
+        for addr in self.opts.persistent_peers:
+            if addr:
+                self.add(addr, persistent=True)
+
+    # -- address book --
+
+    def add(self, address: str, persistent: bool = False) -> bool:
+        """Add a peer address; returns True if new
+        (reference: peermanager.go:386-420)."""
+        node_id, host, port = parse_node_address(address)
+        if not node_id:
+            raise ValueError(f"address {address!r} has no node ID")
+        if node_id == self.self_id:
+            return False
+        peer = self._peers.get(node_id)
+        if peer is None:
+            if len(self._peers) >= self.opts.max_peers:
+                return False
+            peer = _Peer(node_id=node_id)
+            self._peers[node_id] = peer
+        new = (host, port) not in peer.addresses
+        peer.addresses.add((host, port))
+        peer.persistent = peer.persistent or persistent
+        if new:
+            self._persist()
+            self._wakeup.set()
+        return new
+
+    def advertise(self, limit: int = 100) -> List[str]:
+        """Addresses to share via PEX (reference: peermanager.go:793-826)."""
+        out = []
+        for peer in self._peers.values():
+            for host, port in peer.addresses:
+                out.append(f"{peer.node_id}@{host}:{port}")
+        random.shuffle(out)
+        return out[:limit]
+
+    def peers(self) -> List[NodeID]:
+        return [p.node_id for p in self._peers.values() if p.ready]
+
+    def num_connected(self) -> int:
+        return sum(1 for p in self._peers.values() if p.connected)
+
+    # -- dialing --
+
+    async def dial_next(self) -> Tuple[NodeID, str, int]:
+        """Block until a peer should be dialed; marks it dialing
+        (reference: peermanager.go DialNext/TryDialNext)."""
+        while True:
+            candidate = self._next_dial_candidate()
+            if candidate is not None:
+                peer, (host, port) = candidate
+                peer.connected = True  # reserve the slot (dialing state)
+                peer.dial_attempts += 1
+                return peer.node_id, host, port
+            self._wakeup.clear()
+            # wake on new peers, or poll for expired backoffs
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass
+
+    def _next_dial_candidate(self) -> Optional[Tuple[_Peer, Tuple[str, int]]]:
+        if self.num_connected() >= self.opts.max_connected:
+            return None
+        now = time.monotonic()
+        best: Optional[_Peer] = None
+        for peer in self._peers.values():
+            if peer.connected or not peer.addresses:
+                continue
+            if now - peer.last_dial_failure < peer.retry_delay(self.opts):
+                continue
+            if best is None or (peer.persistent, -peer.dial_attempts) > (
+                best.persistent, -best.dial_attempts
+            ):
+                best = peer
+        if best is None:
+            return None
+        return best, next(iter(best.addresses))
+
+    def dial_failed(self, node_id: NodeID) -> None:
+        """reference: peermanager.go:499-530."""
+        peer = self._peers.get(node_id)
+        if peer is None:
+            return
+        peer.connected = False
+        peer.last_dial_failure = time.monotonic()
+        self._wakeup.set()
+
+    def dialed(self, node_id: NodeID) -> None:
+        """Outbound connection established
+        (reference: peermanager.go:532-583)."""
+        peer = self._peers.get(node_id)
+        if peer is None:
+            raise ValueError(f"dialed unknown peer {node_id}")
+        peer.dial_attempts = 0
+        peer.connected = True
+        peer.inbound = False
+
+    def accepted(self, node_id: NodeID) -> None:
+        """Inbound connection; may exceed capacity → schedule eviction of
+        someone (reference: peermanager.go:585-640)."""
+        if node_id == self.self_id:
+            raise ValueError("rejecting connection from self")
+        peer = self._peers.get(node_id)
+        if peer is None:
+            peer = _Peer(node_id=node_id)
+            self._peers[node_id] = peer
+        if peer.connected:
+            raise ValueError(f"peer {node_id} is already connected")
+        # capacity check BEFORE reserving the slot, or a rejected inbound
+        # peer would leak a phantom connected=True entry forever
+        if (
+            self.num_connected() + 1
+            > self.opts.max_connected + self.opts.max_connected_upgrade
+        ):
+            raise ValueError("already connected to maximum number of peers")
+        peer.connected = True
+        peer.inbound = True
+        if self.num_connected() > self.opts.max_connected:
+            self._schedule_eviction()
+
+    def ready(self, node_id: NodeID) -> None:
+        """Peer handshaked and routed; notify subscribers
+        (reference: peermanager.go:642-676)."""
+        peer = self._peers.get(node_id)
+        if peer is None or not peer.connected:
+            return
+        peer.ready = True
+        self._notify(PeerUpdate(node_id=node_id, status=PeerStatus.UP))
+
+    def disconnected(self, node_id: NodeID) -> None:
+        """reference: peermanager.go:696-736."""
+        peer = self._peers.get(node_id)
+        if peer is None:
+            return
+        was_ready = peer.ready
+        was_evicting = peer.evicting
+        peer.connected = False
+        peer.ready = False
+        peer.evicting = False
+        if was_evicting:
+            # evicted for misbehavior: apply dial backoff so we don't
+            # immediately re-establish the same bad peer
+            peer.dial_attempts += 1
+            peer.last_dial_failure = time.monotonic()
+        if was_ready:
+            self._notify(PeerUpdate(node_id=node_id, status=PeerStatus.DOWN))
+        self._wakeup.set()
+
+    def errored(self, node_id: NodeID, err: str) -> None:
+        """Reactor-reported misbehavior → evict
+        (reference: peermanager.go:678-694)."""
+        peer = self._peers.get(node_id)
+        if peer is None or not peer.connected or peer.evicting:
+            return
+        self.logger.info("evicting peer", peer=node_id, err=err)
+        peer.evicting = True
+        peer.score -= 10
+        self._evict_queue.put_nowait(node_id)
+
+    async def evict_next(self) -> NodeID:
+        """Next peer the router should disconnect
+        (reference: peermanager.go EvictNext)."""
+        return await self._evict_queue.get()
+
+    def _schedule_eviction(self) -> None:
+        """Pick the lowest-value connected peer to make room."""
+        victims = [
+            p for p in self._peers.values()
+            if p.connected and not p.persistent and not p.evicting
+        ]
+        if not victims:
+            return
+        victim = min(victims, key=lambda p: p.score)
+        victim.evicting = True
+        self._evict_queue.put_nowait(victim.node_id)
+
+    # -- subscriptions --
+
+    def subscribe(self) -> asyncio.Queue:
+        """Peer up/down feed (reference: peermanager.go:828-870)."""
+        q: asyncio.Queue = asyncio.Queue(maxsize=256)
+        self._subscribers.append(q)
+        return q
+
+    def _notify(self, update: PeerUpdate) -> None:
+        for q in self._subscribers:
+            try:
+                q.put_nowait(update)
+            except asyncio.QueueFull:
+                self.logger.error(
+                    "peer update subscriber overflowed; dropping update"
+                )
+
+    # -- persistence (address book) --
+
+    def _persist(self) -> None:
+        """Debounced: serializing the full book per PEX address would be
+        O(n²) during sync. flush() forces the write (router shutdown)."""
+        if self._store is None:
+            return
+        if time.monotonic() - self._last_persist < 1.0:
+            self._dirty = True
+            return
+        self._write_book()
+
+    def flush(self) -> None:
+        if self._store is not None and self._dirty:
+            self._write_book()
+
+    def _write_book(self) -> None:
+        doc = {
+            p.node_id: {
+                "addresses": sorted(f"{h}:{pt}" for h, pt in p.addresses),
+                "persistent": p.persistent,
+                "score": p.score,
+            }
+            for p in self._peers.values()
+        }
+        self._store.set(b"peermanager/addressbook", json.dumps(doc).encode())
+        self._last_persist = time.monotonic()
+        self._dirty = False
+
+    def _load(self) -> None:
+        raw = self._store.get(b"peermanager/addressbook")
+        if not raw:
+            return
+        doc = json.loads(raw.decode())
+        for node_id, info in doc.items():
+            peer = _Peer(node_id=node_id)
+            for addr in info.get("addresses", []):
+                host, _, port = addr.rpartition(":")
+                peer.addresses.add((host, int(port)))
+            peer.persistent = info.get("persistent", False)
+            peer.score = info.get("score", 0)
+            self._peers[node_id] = peer

@@ -1,0 +1,285 @@
+"""Router — the p2p message hub.
+
+reference: internal/p2p/router.go (design comment :108-152). Reactors open
+typed channels; the router dials/accepts peers via the transport, runs one
+send and one receive task per peer, demuxes inbound messages by channel ID
+into reactor queues, and routes outbound envelopes (unicast or broadcast)
+onto per-peer queues. PeerManager decides who to dial and who to evict.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from ..crypto.keys import PrivKey
+from ..libs.log import get_logger
+from ..libs.service import Service
+from .channel import Channel
+from .peermanager import PeerManager
+from .transport import Connection, Transport
+from .types import ChannelDescriptor, Envelope, NodeID, NodeInfo
+
+__all__ = ["Router", "RouterOptions"]
+
+
+class RouterOptions:
+    def __init__(
+        self,
+        handshake_timeout: float = 20.0,
+        dial_timeout: float = 5.0,
+        peer_queue_size: int = 128,
+        num_concurrent_dials: int = 8,
+    ) -> None:
+        self.handshake_timeout = handshake_timeout
+        self.dial_timeout = dial_timeout
+        self.peer_queue_size = peer_queue_size
+        self.num_concurrent_dials = num_concurrent_dials
+
+
+class Router(Service):
+    def __init__(
+        self,
+        node_info: NodeInfo,
+        priv_key: PrivKey,
+        peer_manager: PeerManager,
+        transport: Transport,
+        listen_addr: str = "",
+        options: Optional[RouterOptions] = None,
+    ) -> None:
+        super().__init__(name="router", logger=get_logger("p2p.router"))
+        self.node_info = node_info
+        self.priv_key = priv_key
+        self.peer_manager = peer_manager
+        self.transport = transport
+        self.listen_addr = listen_addr
+        self.opts = options or RouterOptions()
+        self._channels: Dict[int, Channel] = {}
+        self._peer_queues: Dict[NodeID, asyncio.Queue] = {}
+        self._peer_conns: Dict[NodeID, Connection] = {}
+        self._peer_tasks: Dict[NodeID, list] = {}
+
+    # -- reactor API --
+
+    def open_channel(self, descriptor: ChannelDescriptor) -> Channel:
+        """reference: router.go OpenChannel."""
+        if descriptor.channel_id in self._channels:
+            raise ValueError(
+                f"channel {descriptor.channel_id} already open"
+            )
+        ch = Channel(descriptor)
+        self._channels[descriptor.channel_id] = ch
+        # advertise the channel in our NodeInfo
+        if descriptor.channel_id not in self.node_info.channels:
+            self.node_info.channels += bytes([descriptor.channel_id])
+        self.spawn(self._route_channel_out(ch), f"ch{ch.id}-out")
+        self.spawn(self._route_channel_errors(ch), f"ch{ch.id}-err")
+        return ch
+
+    def peer_ids(self):
+        return list(self._peer_conns.keys())
+
+    # -- lifecycle --
+
+    async def on_start(self) -> None:
+        if self.listen_addr:
+            await self.transport.listen(self.listen_addr)
+        # accept always runs: memory transports accept without listening
+        self.spawn(self._accept_loop(), "accept")
+        for _ in range(self.opts.num_concurrent_dials):
+            self.spawn(self._dial_loop(), "dial")
+        self.spawn(self._evict_loop(), "evict")
+
+    async def on_stop(self) -> None:
+        for node_id in list(self._peer_conns):
+            self._close_peer(node_id)
+        self.peer_manager.flush()  # write any debounced address book state
+        await self.transport.close()
+
+    # -- dialing / accepting (reference: router.go dialPeers/acceptPeers) --
+
+    async def _dial_loop(self) -> None:
+        while True:
+            node_id, host, port = await self.peer_manager.dial_next()
+            try:
+                conn = await asyncio.wait_for(
+                    self.transport.dial(host, port),
+                    timeout=self.opts.dial_timeout,
+                )
+            except Exception as e:
+                self.logger.debug(
+                    "failed to dial peer", peer=node_id, err=str(e)
+                )
+                self.peer_manager.dial_failed(node_id)
+                continue
+            try:
+                peer_info = await self._handshake(conn)
+                if peer_info.node_id != node_id:
+                    raise ConnectionError(
+                        f"expected {node_id}, got {peer_info.node_id}"
+                    )
+                self.peer_manager.dialed(node_id)
+            except Exception as e:
+                self.logger.info(
+                    "peer handshake failed", peer=node_id, err=str(e)
+                )
+                conn.close()
+                self.peer_manager.dial_failed(node_id)
+                continue
+            self._start_peer(peer_info.node_id, conn)
+
+    async def _accept_loop(self) -> None:
+        while True:
+            conn = await self.transport.accept()
+            self.spawn(self._accept_one(conn), "accept-one")
+
+    async def _accept_one(self, conn: Connection) -> None:
+        try:
+            peer_info = await self._handshake(conn)
+            self.peer_manager.accepted(peer_info.node_id)
+        except Exception as e:
+            self.logger.debug("inbound handshake failed", err=str(e))
+            conn.close()
+            return
+        self._start_peer(peer_info.node_id, conn)
+
+    async def _handshake(self, conn: Connection) -> NodeInfo:
+        peer_info, _peer_pub = await asyncio.wait_for(
+            conn.handshake(self.node_info, self.priv_key),
+            timeout=self.opts.handshake_timeout,
+        )
+        peer_info.validate_basic()
+        if peer_info.node_id == self.node_info.node_id:
+            raise ConnectionError("rejecting connection from self")
+        self.node_info.compatible_with(peer_info)
+        return peer_info
+
+    # -- per-peer routines (reference: router.go routePeer) --
+
+    def _start_peer(self, node_id: NodeID, conn: Connection) -> None:
+        if node_id in self._peer_conns:
+            # duplicate connection; keep the existing one
+            conn.close()
+            self.peer_manager.disconnected(node_id)
+            return
+        self._peer_conns[node_id] = conn
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.opts.peer_queue_size)
+        self._peer_queues[node_id] = q
+        send_t = self.spawn(self._send_peer(node_id, conn, q), f"send-{node_id[:8]}")
+        recv_t = self.spawn(self._recv_peer(node_id, conn), f"recv-{node_id[:8]}")
+        self._peer_tasks[node_id] = [send_t, recv_t]
+        self.peer_manager.ready(node_id)
+        self.logger.info("peer connected", peer=node_id[:12], addr=conn.remote_addr)
+
+    async def _send_peer(
+        self, node_id: NodeID, conn: Connection, queue: asyncio.Queue
+    ) -> None:
+        while True:
+            channel_id, payload = await queue.get()
+            try:
+                await conn.send(channel_id, payload)
+            except asyncio.CancelledError:
+                raise
+            except ValueError as e:
+                # our own oversized/bad payload: drop it, keep the peer
+                self.logger.error(
+                    "dropping unsendable message", ch=channel_id, err=str(e)
+                )
+            except Exception:
+                # any transport failure means the connection is done; it
+                # must never escape into Service fail-fast and kill the
+                # whole router (single-peer failure ≠ node failure)
+                self._peer_down(node_id)
+                return
+
+    async def _recv_peer(self, node_id: NodeID, conn: Connection) -> None:
+        try:
+            while True:
+                channel_id, payload = await conn.receive()
+                ch = self._channels.get(channel_id)
+                if ch is None:
+                    continue  # unknown channel: drop
+                try:
+                    msg = ch.descriptor.decode(payload)
+                except Exception as e:
+                    self.logger.info(
+                        "peer sent invalid message; evicting",
+                        peer=node_id[:12], ch=channel_id, err=str(e),
+                    )
+                    self.peer_manager.errored(node_id, f"bad message: {e}")
+                    return
+                if not ch.deliver(
+                    Envelope(message=msg, from_peer=node_id)
+                ):
+                    self.logger.debug(
+                        "reactor queue full; dropping message",
+                        ch=channel_id,
+                    )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # tampered AEAD frames (InvalidTag), oversized frames, resets —
+            # all are peer-connection failures, not router failures
+            self.logger.debug(
+                "peer receive failed", peer=node_id[:12], err=str(e)
+            )
+            self._peer_down(node_id)
+
+    def _peer_down(self, node_id: NodeID) -> None:
+        if node_id not in self._peer_conns:
+            return
+        self._close_peer(node_id)
+        self.peer_manager.disconnected(node_id)
+        self.logger.info("peer disconnected", peer=node_id[:12])
+
+    def _close_peer(self, node_id: NodeID) -> None:
+        conn = self._peer_conns.pop(node_id, None)
+        if conn is not None:
+            conn.close()
+        self._peer_queues.pop(node_id, None)
+        for t in self._peer_tasks.pop(node_id, []):
+            if not t.done() and t is not asyncio.current_task():
+                t.cancel()
+        self._tasks = [t for t in self._tasks if not t.done()]
+
+    # -- outbound routing (reference: router.go routeChannel) --
+
+    async def _route_channel_out(self, ch: Channel) -> None:
+        while True:
+            envelope = await ch.out_queue.get()
+            try:
+                payload = ch.descriptor.encode(envelope.message)
+            except Exception as e:
+                self.logger.error(
+                    "failed to encode outbound message", ch=ch.id, err=str(e)
+                )
+                continue
+            if envelope.broadcast:
+                targets = list(self._peer_queues.keys())
+            elif envelope.to:
+                targets = [envelope.to]
+            else:
+                self.logger.error("outbound envelope has no destination")
+                continue
+            for node_id in targets:
+                q = self._peer_queues.get(node_id)
+                if q is None:
+                    continue
+                try:
+                    q.put_nowait((ch.id, payload))
+                except asyncio.QueueFull:
+                    self.logger.debug(
+                        "peer queue full; dropping message",
+                        peer=node_id[:12], ch=ch.id,
+                    )
+
+    async def _route_channel_errors(self, ch: Channel) -> None:
+        while True:
+            peer_error = await ch.error_queue.get()
+            self.peer_manager.errored(peer_error.node_id, peer_error.err)
+
+    async def _evict_loop(self) -> None:
+        """reference: router.go evictPeers."""
+        while True:
+            node_id = await self.peer_manager.evict_next()
+            self._peer_down(node_id)

@@ -1,0 +1,427 @@
+"""P2P stack tests — secret connection, peer manager lifecycle, memory
+network routing, TCP router end-to-end
+(reference model: internal/p2p/*_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.consensus import msgs as cmsgs
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    Envelope,
+    MemoryNetwork,
+    MemoryTransport,
+    NodeInfo,
+    PeerError,
+    PeerManager,
+    PeerManagerOptions,
+    PeerStatus,
+    Router,
+    TCPTransport,
+    node_id_from_pubkey,
+    parse_node_address,
+)
+from tendermint_tpu.p2p.conn import HandshakeError, SecretConnection
+from tendermint_tpu.p2p.p2ptest import TestNetwork
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- addresses --
+
+
+def test_parse_node_address():
+    nid = "ab" * 20
+    assert parse_node_address(f"{nid}@1.2.3.4:26656") == (nid, "1.2.3.4", 26656)
+    assert parse_node_address(f"tcp://{nid}@host") == (nid, "host", 26656)
+    assert parse_node_address("1.2.3.4:9")[0] == ""
+    with pytest.raises(ValueError):
+        parse_node_address("zz" * 20 + "@x:1")
+
+
+# -- secret connection --
+
+
+def test_secret_connection_roundtrip_and_tamper():
+    async def go():
+        a_priv = PrivKeyEd25519.from_seed(b"\x0a" * 32)
+        b_priv = PrivKeyEd25519.from_seed(b"\x0b" * 32)
+        server_conn = {}
+        got = asyncio.Event()
+
+        async def on_client(reader, writer):
+            sc = await SecretConnection.handshake(reader, writer, b_priv)
+            server_conn["sc"] = sc
+            got.set()
+
+        server = await asyncio.start_server(on_client, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        client = await SecretConnection.handshake(reader, writer, a_priv)
+        await got.wait()
+        srv = server_conn["sc"]
+
+        # mutual authentication
+        assert client.remote_pubkey.bytes() == b_priv.pub_key().bytes()
+        assert srv.remote_pubkey.bytes() == a_priv.pub_key().bytes()
+
+        # encrypted roundtrips both directions
+        await client.write_frame(b"hello from a")
+        assert await srv.read_frame() == b"hello from a"
+        await srv.write_frame(b"hello from b")
+        assert await client.read_frame() == b"hello from b"
+
+        # large frame
+        big = bytes(range(256)) * 4000  # ~1MB
+        await client.write_frame(big)
+        assert await srv.read_frame() == big
+
+        client.close()
+        srv.close()
+        server.close()
+        await server.wait_closed()
+
+    run(go())
+
+
+def test_secret_connection_wrong_key_rejected():
+    """A MITM re-signing the challenge with a different key must fail the
+    pubkey/node-ID binding check at the transport layer; here we check
+    that the signature itself must match the derived challenge."""
+    async def go():
+        a_priv = PrivKeyEd25519.from_seed(b"\x0c" * 32)
+
+        import struct as _s
+
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PrivateKey,
+            X25519PublicKey,
+        )
+        from cryptography.hazmat.primitives.ciphers.aead import (
+            ChaCha20Poly1305,
+        )
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        from tendermint_tpu.p2p.conn import _auth_sig_bytes, _derive
+
+        async def on_client(reader, writer):
+            # speak the handshake but sign garbage instead of the challenge
+            eph = X25519PrivateKey.generate()
+            eph_pub = eph.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw
+            )
+            writer.write(eph_pub)
+            remote = await reader.readexactly(32)
+            shared = eph.exchange(X25519PublicKey.from_public_bytes(remote))
+            send_key, recv_key, challenge = _derive(shared, eph_pub, remote)
+            mitm = PrivKeyEd25519.from_seed(b"\x0d" * 32)
+            bad_sig = mitm.sign(b"not the challenge")
+            ct = ChaCha20Poly1305(send_key).encrypt(
+                _s.pack("<Q", 0) + b"\x00" * 4,
+                _auth_sig_bytes(mitm.pub_key(), bad_sig),
+                None,
+            )
+            writer.write(_s.pack(">I", len(ct)) + ct)
+            await writer.drain()
+            writer.close()  # else Server.wait_closed() blocks on 3.12
+
+        server = await asyncio.start_server(on_client, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        with pytest.raises(HandshakeError, match="challenge"):
+            await asyncio.wait_for(
+                SecretConnection.handshake(reader, writer, a_priv), timeout=5
+            )
+        writer.close()
+        server.close()
+        await server.wait_closed()
+
+    run(go())
+
+
+# -- peer manager --
+
+
+def test_peer_manager_dial_lifecycle():
+    async def go():
+        pm = PeerManager("aa" * 20, PeerManagerOptions(max_connected=2))
+        nid1, nid2 = "bb" * 20, "cc" * 20
+        assert pm.add(f"{nid1}@h1:1")
+        assert not pm.add(f"{nid1}@h1:1")  # duplicate
+        assert pm.add(f"{nid2}@h2:2")
+        # self is never added
+        assert not pm.add(f"{'aa' * 20}@self:1")
+
+        node_id, host, port = await pm.dial_next()
+        pm.dialed(node_id)
+        got2, _, _ = await pm.dial_next()
+        assert {node_id, got2} == {nid1, nid2}
+        pm.dialed(got2)
+        assert pm.num_connected() == 2
+
+        sub = pm.subscribe()
+        pm.ready(nid1)
+        up = await asyncio.wait_for(sub.get(), 1)
+        assert up.node_id == nid1 and up.status == PeerStatus.UP
+        pm.disconnected(nid1)
+        down = await asyncio.wait_for(sub.get(), 1)
+        assert down.status == PeerStatus.DOWN
+        assert pm.num_connected() == 1
+
+    run(go())
+
+
+def test_peer_manager_backoff_after_failure():
+    async def go():
+        pm = PeerManager(
+            "aa" * 20,
+            PeerManagerOptions(min_retry_time=5.0),  # long backoff
+        )
+        nid = "bb" * 20
+        pm.add(f"{nid}@h:1")
+        node_id, _, _ = await pm.dial_next()
+        pm.dial_failed(node_id)
+        # backoff: no candidate available immediately
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(pm.dial_next(), timeout=0.3)
+
+    run(go())
+
+
+def test_peer_manager_persistent_priority():
+    async def go():
+        pm = PeerManager(
+            "aa" * 20,
+            PeerManagerOptions(persistent_peers=[f"{'dd' * 20}@pp:1"]),
+        )
+        pm.add(f"{'bb' * 20}@h:1")
+        node_id, _, _ = await pm.dial_next()
+        assert node_id == "dd" * 20  # persistent dialed first
+
+    run(go())
+
+
+def test_peer_manager_evicts_on_error():
+    async def go():
+        pm = PeerManager("aa" * 20)
+        nid = "bb" * 20
+        pm.add(f"{nid}@h:1")
+        node_id, _, _ = await pm.dial_next()
+        pm.dialed(node_id)
+        pm.ready(node_id)
+        pm.errored(node_id, "bad message")
+        victim = await asyncio.wait_for(pm.evict_next(), 1)
+        assert victim == nid
+
+    run(go())
+
+
+def test_peer_manager_address_book_persists():
+    from tendermint_tpu.store.kv import MemKV
+
+    db = MemKV()
+    pm = PeerManager("aa" * 20, store=db)
+    pm.add(f"{'bb' * 20}@host1:26656")
+    pm2 = PeerManager("aa" * 20, store=db)
+    assert pm2.advertise(10) == [f"{'bb' * 20}@host1:26656"]
+
+
+# -- routed networks --
+
+ECHO_CH = ChannelDescriptor(
+    channel_id=0x99,
+    message_type=cmsgs.HasVoteMessage,
+    name="echo",
+)
+
+
+def test_memory_network_broadcast_and_unicast():
+    async def go():
+        net = TestNetwork(3)
+        channels = [n.open_channel(ECHO_CH) for n in net.nodes]
+        await net.start()
+
+        # broadcast from node0 reaches node1 and node2
+        await channels[0].send(
+            Envelope(
+                message=cmsgs.HasVoteMessage(height=7, round=0, type=1, index=3),
+                broadcast=True,
+            )
+        )
+        for ch in channels[1:]:
+            env = await asyncio.wait_for(ch.receive(), 5)
+            assert env.message.height == 7
+            assert env.from_peer == net.nodes[0].node_id
+
+        # unicast node1 → node2 only
+        await channels[1].send(
+            Envelope(
+                message=cmsgs.HasVoteMessage(height=9, round=1, type=2, index=0),
+                to=net.nodes[2].node_id,
+            )
+        )
+        env = await asyncio.wait_for(channels[2].receive(), 5)
+        assert env.message.height == 9
+        assert channels[0].in_queue.empty()
+
+        await net.stop()
+
+    run(go())
+
+
+def test_peer_error_evicts_peer():
+    async def go():
+        net = TestNetwork(2)
+        channels = [n.open_channel(ECHO_CH) for n in net.nodes]
+        await net.start()
+        bad = net.nodes[1].node_id
+        sub = net.nodes[0].peer_manager.subscribe()
+        await channels[0].send_error(PeerError(node_id=bad, err="misbehaved"))
+        update = await asyncio.wait_for(sub.get(), 5)
+        assert update.node_id == bad and update.status == PeerStatus.DOWN
+        # misbehavior applies dial backoff
+        peer = net.nodes[0].peer_manager._peers[bad]
+        assert peer.last_dial_failure > 0 and peer.score < 0
+        await net.stop()
+
+    run(go())
+
+
+def test_tcp_router_end_to_end():
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 30]) * 32) for i in range(2)]
+        ids = [node_id_from_pubkey(p.pub_key()) for p in privs]
+        transports = [TCPTransport(), TCPTransport()]
+        infos = [
+            NodeInfo(node_id=ids[i], network="tcp-chain", moniker=f"n{i}")
+            for i in range(2)
+        ]
+        pms = [PeerManager(ids[i]) for i in range(2)]
+        routers = [
+            Router(
+                infos[i], privs[i], pms[i], transports[i],
+                listen_addr=f"127.0.0.1:0",
+            )
+            for i in range(2)
+        ]
+        channels = [r.open_channel(ECHO_CH) for r in routers]
+        for r in routers:
+            await r.start()
+        # node0 dials node1's ephemeral port
+        port = transports[1].listen_port
+        pms[0].add(f"{ids[1]}@127.0.0.1:{port}")
+
+        async def connected():
+            while not (pms[0].peers() and pms[1].peers()):
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(connected(), 10)
+
+        await channels[0].send(
+            Envelope(
+                message=cmsgs.HasVoteMessage(height=42, round=0, type=1, index=1),
+                to=ids[1],
+            )
+        )
+        env = await asyncio.wait_for(channels[1].receive(), 5)
+        assert env.message.height == 42
+        assert env.from_peer == ids[0]
+
+        # and the reverse direction over the same connection
+        await channels[1].send(
+            Envelope(
+                message=cmsgs.HasVoteMessage(height=43, round=0, type=1, index=1),
+                to=ids[0],
+            )
+        )
+        env0 = await asyncio.wait_for(channels[0].receive(), 5)
+        assert env0.message.height == 43
+
+        for r in routers:
+            await r.stop()
+
+    run(go())
+
+
+def test_tcp_wrong_network_rejected():
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 40]) * 32) for i in range(2)]
+        ids = [node_id_from_pubkey(p.pub_key()) for p in privs]
+        transports = [TCPTransport(), TCPTransport()]
+        infos = [
+            NodeInfo(node_id=ids[0], network="chain-A", moniker="n0"),
+            NodeInfo(node_id=ids[1], network="chain-B", moniker="n1"),
+        ]
+        pms = [PeerManager(ids[i]) for i in range(2)]
+        routers = [
+            Router(infos[i], privs[i], pms[i], transports[i],
+                   listen_addr="127.0.0.1:0")
+            for i in range(2)
+        ]
+        for r in routers:
+            r.open_channel(ECHO_CH)
+            await r.start()
+        pms[0].add(f"{ids[1]}@127.0.0.1:{transports[1].listen_port}")
+        await asyncio.sleep(0.5)
+        assert not pms[0].peers()  # incompatible networks never connect
+        assert not pms[1].peers()
+        for r in routers:
+            await r.stop()
+
+    run(go())
+
+
+def test_tampered_frame_drops_peer_not_router():
+    """A peer sending a garbled AEAD frame must only lose its own
+    connection — the router (and other peers) survive."""
+    async def go():
+        net = TestNetwork(3)
+        channels = [n.open_channel(ECHO_CH) for n in net.nodes]
+        await net.start()
+
+        # reach into node1's TCP-less memory conn: memory transport has no
+        # crypto, so instead test via the TCP path with 2 extra nodes
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 70]) * 32) for i in range(2)]
+        ids = [node_id_from_pubkey(p.pub_key()) for p in privs]
+        transports = [TCPTransport(), TCPTransport()]
+        pms = [PeerManager(ids[i]) for i in range(2)]
+        routers = [
+            Router(
+                NodeInfo(node_id=ids[i], network="x", moniker=f"t{i}"),
+                privs[i], pms[i], transports[i], listen_addr="127.0.0.1:0",
+            )
+            for i in range(2)
+        ]
+        chans = [r.open_channel(ECHO_CH) for r in routers]
+        for r in routers:
+            await r.start()
+        pms[0].add(f"{ids[1]}@127.0.0.1:{transports[1].listen_port}")
+
+        async def connected():
+            while not (pms[0].peers() and pms[1].peers()):
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(connected(), 10)
+
+        # corrupt node1→node0 traffic by writing junk into the raw socket
+        sub = pms[0].subscribe()
+        conn = routers[1]._peer_conns[ids[0]]
+        conn._secret._writer.write(b"\x00\x00\x00\x08" + b"garbage!")
+        await conn._secret._writer.drain()
+
+        # node0 drops the peer (DOWN event) but the router itself survives
+        update = await asyncio.wait_for(sub.get(), 10)
+        assert update.node_id == ids[1] and update.status == PeerStatus.DOWN
+        assert routers[0].is_running
+        for r in routers:
+            await r.stop()
+        await net.stop()
+
+    run(go())
