@@ -1,0 +1,232 @@
+"""PeerState — what we know about a peer's round state.
+
+reference: internal/consensus/peer_state.go. The gossip routines consult
+this to decide which proposal parts and votes the peer still needs; the
+reactor updates it from NewRoundStep/NewValidBlock/HasVote/ProposalPOL
+messages and from everything we send the peer.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from ..libs.bits import BitArray
+from ..types.block_id import PartSetHeader
+from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from ..types.vote import Vote
+from .types import RoundState, RoundStep
+
+__all__ = ["PeerRoundState", "PeerState"]
+
+
+@dataclass
+class PeerRoundState:
+    """reference: internal/consensus/types/peer_round_state.go."""
+
+    height: int = 0
+    round: int = -1
+    step: int = 0
+    start_time_ns: int = 0
+    proposal: bool = False
+    proposal_block_parts_header: PartSetHeader = field(
+        default_factory=PartSetHeader
+    )
+    proposal_block_parts: Optional[BitArray] = None
+    proposal_pol_round: int = -1
+    proposal_pol: Optional[BitArray] = None
+    prevotes: Optional[BitArray] = None
+    precommits: Optional[BitArray] = None
+    last_commit_round: int = -1
+    last_commit: Optional[BitArray] = None
+    catchup_commit_round: int = -1
+    catchup_commit: Optional[BitArray] = None
+
+
+class PeerState:
+    def __init__(self, peer_id: str) -> None:
+        self.peer_id = peer_id
+        self.prs = PeerRoundState()
+
+    # -- applying peer messages (reference: peer_state.go:340-470) --
+
+    def apply_new_round_step(self, msg) -> None:
+        prs = self.prs
+        if (
+            msg.height < prs.height
+            or (msg.height == prs.height and msg.round < prs.round)
+        ):
+            return
+        psh, pparts = prs.proposal_block_parts_header, prs.proposal_block_parts
+        start_time = time.time_ns() - msg.seconds_since_start_time * 1_000_000_000
+        old_height, old_round = prs.height, prs.round
+        prs.height = msg.height
+        prs.round = msg.round
+        prs.step = msg.step
+        prs.start_time_ns = start_time
+        if old_height != msg.height or old_round != msg.round:
+            prs.proposal = False
+            prs.proposal_block_parts_header = PartSetHeader()
+            prs.proposal_block_parts = None
+            prs.proposal_pol_round = -1
+            prs.proposal_pol = None
+            prs.prevotes = None
+            prs.precommits = None
+        if old_height == msg.height and old_round != msg.round and (
+            msg.round == prs.catchup_commit_round
+        ):
+            prs.precommits = prs.catchup_commit
+        if old_height != msg.height:
+            if old_height == msg.height - 1:
+                prs.last_commit = prs.precommits
+                prs.last_commit_round = old_round
+            else:
+                prs.last_commit = None
+                prs.last_commit_round = msg.last_commit_round
+            prs.catchup_commit_round = -1
+            prs.catchup_commit = None
+
+    def apply_new_valid_block(self, msg) -> None:
+        prs = self.prs
+        if prs.height != msg.height:
+            return
+        if prs.round != msg.round and not msg.is_commit:
+            return
+        prs.proposal_block_parts_header = msg.block_part_set_header
+        prs.proposal_block_parts = msg.block_parts
+
+    def apply_proposal_pol(self, msg) -> None:
+        prs = self.prs
+        if prs.height != msg.height:
+            return
+        if prs.proposal_pol_round != msg.proposal_pol_round:
+            return
+        prs.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg) -> None:
+        if self.prs.height != msg.height:
+            return
+        self.set_has_vote(msg.height, msg.round, msg.type, msg.index)
+
+    def apply_vote_set_bits(self, msg, our_votes: Optional[BitArray]) -> None:
+        """reference: peer_state.go ApplyVoteSetBitsMessage. The bits we
+        know the peer has = (what we tracked minus what we asked about)
+        OR the peer's reply."""
+        votes = self._get_vote_bits(msg.height, msg.round, msg.type)
+        if votes is None or msg.votes is None:
+            return
+        if our_votes is None:
+            votes.update(msg.votes)
+        else:
+            other_votes = votes.sub(our_votes)
+            has_votes = other_votes.or_(msg.votes)
+            votes.update(has_votes)
+
+    # -- tracking what we've sent (reference: peer_state.go:150-330) --
+
+    def set_has_proposal(self, proposal) -> None:
+        prs = self.prs
+        if prs.height != proposal.height or prs.round != proposal.round:
+            return
+        if prs.proposal:
+            return
+        prs.proposal = True
+        if prs.proposal_block_parts is not None:
+            return  # already set by NewValidBlock
+        prs.proposal_block_parts_header = proposal.block_id.part_set_header
+        prs.proposal_block_parts = BitArray(
+            max(1, proposal.block_id.part_set_header.total)
+        )
+        prs.proposal_pol_round = proposal.pol_round
+        prs.proposal_pol = None
+
+    def set_has_proposal_block_part(
+        self, height: int, round_: int, index: int
+    ) -> None:
+        prs = self.prs
+        if prs.height != height or prs.round != round_:
+            return
+        if prs.proposal_block_parts is None:
+            return
+        if 0 <= index < prs.proposal_block_parts.size:
+            prs.proposal_block_parts.set(index, True)
+
+    def set_has_vote(
+        self, height: int, round_: int, vote_type: int, index: int
+    ) -> None:
+        votes = self._get_vote_bits(height, round_, vote_type)
+        if votes is not None and 0 <= index < votes.size:
+            votes.set(index, True)
+
+    def ensure_vote_bits(self, num_validators: int) -> None:
+        """Allocate vote bit arrays once the validator count is known
+        (reference: peer_state.go EnsureVoteBitArrays)."""
+        prs = self.prs
+        if prs.prevotes is None:
+            prs.prevotes = BitArray(num_validators)
+        if prs.precommits is None:
+            prs.precommits = BitArray(num_validators)
+        if prs.proposal_pol is None and prs.proposal_pol_round >= 0:
+            prs.proposal_pol = BitArray(num_validators)
+        if prs.last_commit is None and prs.last_commit_round >= 0:
+            prs.last_commit = BitArray(num_validators)
+        if prs.catchup_commit is None and prs.catchup_commit_round >= 0:
+            prs.catchup_commit = BitArray(num_validators)
+
+    def ensure_catchup_commit_round(
+        self, height: int, round_: int, num_validators: int
+    ) -> None:
+        prs = self.prs
+        if prs.height != height:
+            return
+        if prs.catchup_commit_round == round_:
+            return
+        prs.catchup_commit_round = round_
+        prs.catchup_commit = BitArray(num_validators)
+
+    def _get_vote_bits(
+        self, height: int, round_: int, vote_type: int
+    ) -> Optional[BitArray]:
+        prs = self.prs
+        if prs.height == height:
+            if prs.round == round_:
+                return (
+                    prs.prevotes
+                    if vote_type == PREVOTE_TYPE
+                    else prs.precommits
+                )
+            if prs.catchup_commit_round == round_ and vote_type == PRECOMMIT_TYPE:
+                return prs.catchup_commit
+            if prs.proposal_pol_round == round_ and vote_type == PREVOTE_TYPE:
+                return prs.proposal_pol
+            return None
+        if prs.height == height + 1:
+            if prs.last_commit_round == round_ and vote_type == PRECOMMIT_TYPE:
+                return prs.last_commit
+            return None
+        return None
+
+    # -- vote selection for gossip (reference: peer_state.go:196-260) --
+
+    def pick_vote_to_send(self, votes) -> Optional[Vote]:
+        """Given a VoteSet-like (with bit_array()/get_by_index()), pick a
+        random vote the peer doesn't have."""
+        if votes is None or votes.size() == 0:
+            return None
+        height = votes.height
+        round_ = votes.round
+        vote_type = votes.signed_msg_type
+        if self.prs.height == height:
+            self.ensure_vote_bits(votes.size())
+        peer_bits = self._get_vote_bits(height, round_, vote_type)
+        if peer_bits is None:
+            return None
+        ours = votes.bit_array()
+        missing = ours.sub(peer_bits)
+        candidates = list(missing.indices())
+        if not candidates:
+            return None
+        index = random.choice(candidates)
+        return votes.get_by_index(index)

@@ -1,0 +1,648 @@
+"""Consensus reactor — gossips the consensus protocol over p2p.
+
+reference: internal/consensus/reactor.go. Four channels (State 0x20,
+Data 0x21, Vote 0x22, VoteSetBits 0x23; descriptors :31-75); per-peer
+gossip tasks (gossipDataRoutine :492, gossipVotesRoutine :752,
+queryMaj23Routine :850); round-step/HasVote broadcasts driven by event
+bus observation (:362).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from ..config import ConsensusConfig
+from ..eventbus import EventBus
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..p2p.channel import Channel
+from ..p2p.peermanager import PeerStatus
+from ..p2p.types import ChannelDescriptor, Envelope, PeerError
+from ..pubsub import SubscriptionError
+from ..types import events as E
+from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from .msgs import (
+    BlockPartMessage,
+    HasVoteMessage,
+    NewRoundStepMessage,
+    NewValidBlockMessage,
+    ProposalMessage,
+    ProposalPOLMessage,
+    VoteMessage,
+    VoteSetBitsMessage,
+    VoteSetMaj23Message,
+    decode_msg,
+    encode_msg,
+)
+from .peer_state import PeerState
+from .state import ConsensusState
+from .types import RoundStep
+
+__all__ = [
+    "ConsensusReactor",
+    "STATE_CHANNEL",
+    "DATA_CHANNEL",
+    "VOTE_CHANNEL",
+    "VOTE_SET_BITS_CHANNEL",
+    "consensus_channel_descriptors",
+]
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+
+class _MsgCodec:
+    """All four channels share the consensus Message oneof envelope."""
+
+    encode = staticmethod(encode_msg)
+    decode = staticmethod(decode_msg)
+
+
+def consensus_channel_descriptors():
+    """reference: reactor.go:31-67 (priorities and queue sizes)."""
+    return {
+        STATE_CHANNEL: ChannelDescriptor(
+            channel_id=STATE_CHANNEL, message_type=_MsgCodec, priority=8,
+            send_queue_capacity=64, recv_buffer_capacity=128, name="state",
+        ),
+        DATA_CHANNEL: ChannelDescriptor(
+            channel_id=DATA_CHANNEL, message_type=_MsgCodec, priority=12,
+            send_queue_capacity=64, recv_buffer_capacity=512, name="data",
+        ),
+        VOTE_CHANNEL: ChannelDescriptor(
+            channel_id=VOTE_CHANNEL, message_type=_MsgCodec, priority=10,
+            send_queue_capacity=64, recv_buffer_capacity=4096, name="vote",
+        ),
+        VOTE_SET_BITS_CHANNEL: ChannelDescriptor(
+            channel_id=VOTE_SET_BITS_CHANNEL, message_type=_MsgCodec,
+            priority=5, send_queue_capacity=8, recv_buffer_capacity=128,
+            name="votebits",
+        ),
+    }
+
+
+class ConsensusReactor(Service):
+    def __init__(
+        self,
+        cs: ConsensusState,
+        channels: Dict[int, Channel],
+        peer_updates: asyncio.Queue,
+        event_bus: EventBus,
+        cfg: Optional[ConsensusConfig] = None,
+        wait_sync: bool = False,
+    ) -> None:
+        super().__init__(name="consensus.reactor", logger=get_logger("consensus.reactor"))
+        self.cs = cs
+        self.state_ch = channels[STATE_CHANNEL]
+        self.data_ch = channels[DATA_CHANNEL]
+        self.vote_ch = channels[VOTE_CHANNEL]
+        self.vote_bits_ch = channels[VOTE_SET_BITS_CHANNEL]
+        self.peer_updates = peer_updates
+        self.event_bus = event_bus
+        self.cfg = cfg or cs.cfg
+        self.peers: Dict[str, PeerState] = {}
+        self._peer_tasks: Dict[str, list] = {}
+        # wait_sync: started in block-sync mode; consensus runs after
+        # switch_to_consensus (reference: reactor.go:252 SwitchToConsensus)
+        self.wait_sync = wait_sync
+
+    async def on_start(self) -> None:
+        if not self.wait_sync:
+            await self.cs.start()
+        self.spawn(self._peer_update_routine(), "peer-updates")
+        self.spawn(self._recv_routine(self.state_ch, self._handle_state_msg), "recv-state")
+        self.spawn(self._recv_routine(self.data_ch, self._handle_data_msg), "recv-data")
+        self.spawn(self._recv_routine(self.vote_ch, self._handle_vote_msg), "recv-vote")
+        self.spawn(self._recv_routine(self.vote_bits_ch, self._handle_vote_bits_msg), "recv-votebits")
+        self.spawn(self._broadcast_routine(), "broadcasts")
+
+    async def on_stop(self) -> None:
+        if self.cs.is_running:
+            await self.cs.stop()
+
+    async def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
+        """Called by block sync when caught up
+        (reference: reactor.go:252-306)."""
+        self.logger.info("switching to consensus")
+        self.wait_sync = False
+        await self.cs.start()
+
+    # ------------------------------------------------------------------
+    # per-peer lifecycle
+
+    async def _peer_update_routine(self) -> None:
+        while True:
+            update = await self.peer_updates.get()
+            if update.status == PeerStatus.UP:
+                self._add_peer(update.node_id)
+            elif update.status == PeerStatus.DOWN:
+                self._remove_peer(update.node_id)
+
+    def _add_peer(self, peer_id: str) -> None:
+        if peer_id in self.peers:
+            return
+        ps = PeerState(peer_id)
+        self.peers[peer_id] = ps
+        tasks = [
+            self.spawn(self._gossip_data_routine(ps), f"gossip-data-{peer_id[:8]}"),
+            self.spawn(self._gossip_votes_routine(ps), f"gossip-votes-{peer_id[:8]}"),
+            self.spawn(self._query_maj23_routine(ps), f"maj23-{peer_id[:8]}"),
+        ]
+        self._peer_tasks[peer_id] = tasks
+        # tell the new peer where we are
+        self.state_ch.try_send(
+            Envelope(message=self._our_new_round_step(), to=peer_id)
+        )
+
+    def _remove_peer(self, peer_id: str) -> None:
+        self.peers.pop(peer_id, None)
+        for t in self._peer_tasks.pop(peer_id, []):
+            if not t.done():
+                t.cancel()
+        self._tasks = [t for t in self._tasks if not t.done()]
+
+    # ------------------------------------------------------------------
+    # broadcasts (reference: reactor.go:362-430)
+
+    async def _broadcast_routine(self) -> None:
+        sub_steps = self.event_bus.subscribe(
+            f"cs-reactor-{id(self)}",
+            f"{E.EVENT_TYPE_KEY} = '{E.EventValue.NEW_ROUND_STEP}'",
+            limit=256,
+        )
+        sub_votes = self.event_bus.subscribe(
+            f"cs-reactor-{id(self)}",
+            f"{E.EVENT_TYPE_KEY} = '{E.EventValue.VOTE}'",
+            limit=4096,
+        )
+        step_t = asyncio.ensure_future(sub_steps.next())
+        vote_t = asyncio.ensure_future(sub_votes.next())
+        try:
+            while True:
+                done, _ = await asyncio.wait(
+                    {step_t, vote_t}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if step_t in done:
+                    try:
+                        step_t.result()
+                        self.state_ch.try_send(
+                            Envelope(
+                                message=self._our_new_round_step(),
+                                broadcast=True,
+                            )
+                        )
+                    except SubscriptionError:
+                        return
+                    step_t = asyncio.ensure_future(sub_steps.next())
+                if vote_t in done:
+                    try:
+                        msg = vote_t.result()
+                        vote = msg.data.vote
+                        self.state_ch.try_send(
+                            Envelope(
+                                message=HasVoteMessage(
+                                    height=vote.height,
+                                    round=vote.round,
+                                    type=vote.type,
+                                    index=vote.validator_index,
+                                ),
+                                broadcast=True,
+                            )
+                        )
+                    except SubscriptionError:
+                        return
+                    vote_t = asyncio.ensure_future(sub_votes.next())
+        finally:
+            for t in (step_t, vote_t):
+                if not t.done():
+                    t.cancel()
+
+    def _our_new_round_step(self) -> NewRoundStepMessage:
+        rs = self.cs.rs
+        import time as _time
+
+        secs = max(0, (_time.time_ns() - rs.start_time_ns) // 1_000_000_000)
+        last_commit_round = -1
+        if rs.last_commit is not None:
+            last_commit_round = rs.last_commit.round
+        return NewRoundStepMessage(
+            height=rs.height,
+            round=rs.round,
+            step=rs.step,
+            seconds_since_start_time=secs,
+            last_commit_round=last_commit_round,
+        )
+
+    # ------------------------------------------------------------------
+    # inbound handlers
+
+    async def _recv_routine(self, channel: Channel, handler) -> None:
+        async for envelope in channel:
+            try:
+                await handler(envelope)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.logger.error(
+                    "failed to process message",
+                    ch=channel.name,
+                    peer=envelope.from_peer[:12],
+                    err=str(e),
+                )
+                await channel.send_error(
+                    PeerError(node_id=envelope.from_peer, err=str(e))
+                )
+
+    async def _handle_state_msg(self, envelope: Envelope) -> None:
+        """reference: reactor.go:1088-1164 handleStateMessage."""
+        ps = self.peers.get(envelope.from_peer)
+        if ps is None:
+            return
+        msg = envelope.message
+        if isinstance(msg, NewRoundStepMessage):
+            msg.validate_basic()
+            ps.apply_new_round_step(msg)
+        elif isinstance(msg, NewValidBlockMessage):
+            msg.validate_basic()
+            ps.apply_new_valid_block(msg)
+        elif isinstance(msg, HasVoteMessage):
+            msg.validate_basic()
+            ps.ensure_vote_bits(self.cs.rs.validators.size())
+            ps.apply_has_vote(msg)
+        elif isinstance(msg, VoteSetMaj23Message):
+            msg.validate_basic()
+            rs = self.cs.rs
+            if rs.height != msg.height:
+                return
+            rs.votes.set_peer_maj23(
+                msg.round, msg.type, ps.peer_id, msg.block_id
+            )
+            # respond with our bits for that block ID
+            if msg.type == PREVOTE_TYPE:
+                our_votes_set = rs.votes.prevotes(msg.round)
+            else:
+                our_votes_set = rs.votes.precommits(msg.round)
+            bits = (
+                our_votes_set.bit_array_by_block_id(msg.block_id)
+                if our_votes_set is not None
+                else None
+            )
+            self.vote_bits_ch.try_send(
+                Envelope(
+                    message=VoteSetBitsMessage(
+                        height=msg.height,
+                        round=msg.round,
+                        type=msg.type,
+                        block_id=msg.block_id,
+                        votes=bits,
+                    ),
+                    to=ps.peer_id,
+                )
+            )
+        else:
+            raise ValueError(
+                f"unexpected message on state channel: {type(msg).__name__}"
+            )
+
+    async def _handle_data_msg(self, envelope: Envelope) -> None:
+        """reference: reactor.go:1166-1212."""
+        ps = self.peers.get(envelope.from_peer)
+        if ps is None:
+            return
+        if self.wait_sync:
+            return  # ignore consensus data while block-syncing
+        msg = envelope.message
+        if isinstance(msg, ProposalMessage):
+            msg.validate_basic()
+            ps.set_has_proposal(msg.proposal)
+            self.cs.send_peer_msg(msg, ps.peer_id)
+        elif isinstance(msg, ProposalPOLMessage):
+            msg.validate_basic()
+            ps.apply_proposal_pol(msg)
+        elif isinstance(msg, BlockPartMessage):
+            msg.validate_basic()
+            ps.set_has_proposal_block_part(
+                msg.height, msg.round, msg.part.index
+            )
+            self.cs.send_peer_msg(msg, ps.peer_id)
+        else:
+            raise ValueError(
+                f"unexpected message on data channel: {type(msg).__name__}"
+            )
+
+    async def _handle_vote_msg(self, envelope: Envelope) -> None:
+        """reference: reactor.go:1214-1244."""
+        ps = self.peers.get(envelope.from_peer)
+        if ps is None:
+            return
+        if self.wait_sync:
+            return
+        msg = envelope.message
+        if not isinstance(msg, VoteMessage):
+            raise ValueError(
+                f"unexpected message on vote channel: {type(msg).__name__}"
+            )
+        msg.validate_basic()
+        vote = msg.vote
+        ps.ensure_vote_bits(self.cs.rs.validators.size())
+        ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+        self.cs.send_peer_msg(msg, ps.peer_id)
+
+    async def _handle_vote_bits_msg(self, envelope: Envelope) -> None:
+        """reference: reactor.go:1246-1290."""
+        ps = self.peers.get(envelope.from_peer)
+        if ps is None:
+            return
+        msg = envelope.message
+        if not isinstance(msg, VoteSetBitsMessage):
+            raise ValueError(
+                f"unexpected message on votebits channel: "
+                f"{type(msg).__name__}"
+            )
+        msg.validate_basic()
+        rs = self.cs.rs
+        our_votes = None
+        if rs.height == msg.height:
+            if msg.type == PREVOTE_TYPE:
+                vs = rs.votes.prevotes(msg.round)
+            else:
+                vs = rs.votes.precommits(msg.round)
+            if vs is not None:
+                our_votes = vs.bit_array_by_block_id(msg.block_id)
+        ps.apply_vote_set_bits(msg, our_votes)
+
+    # ------------------------------------------------------------------
+    # gossip routines
+
+    async def _gossip_data_routine(self, ps: PeerState) -> None:
+        """Send the peer proposal/parts it lacks; catch it up from the
+        block store when behind (reference: reactor.go:492-610)."""
+        sleep = self.cfg.peer_gossip_sleep_duration
+        while True:
+            rs = self.cs.rs
+            prs = ps.prs
+            sent = False
+
+            # 1) proposal first: it carries the part-set header the peer
+            # needs before parts are useful (reference sends parts only
+            # once headers match, reactor.go:505-540)
+            if (
+                rs.height == prs.height
+                and rs.round == prs.round
+                and rs.proposal is not None
+                and not prs.proposal
+            ):
+                if self.data_ch.try_send(
+                    Envelope(
+                        message=ProposalMessage(proposal=rs.proposal),
+                        to=ps.peer_id,
+                    )
+                ):
+                    ps.set_has_proposal(rs.proposal)
+                    sent = True
+                if 0 <= rs.proposal.pol_round:
+                    pol = rs.votes.prevotes(rs.proposal.pol_round)
+                    if pol is not None:
+                        self.data_ch.try_send(
+                            Envelope(
+                                message=ProposalPOLMessage(
+                                    height=rs.height,
+                                    proposal_pol_round=rs.proposal.pol_round,
+                                    proposal_pol=pol.bit_array(),
+                                ),
+                                to=ps.peer_id,
+                            )
+                        )
+
+            # 2) same height/round with matching part-set headers: parts
+            if (
+                not sent
+                and rs.proposal_block_parts is not None
+                and rs.height == prs.height
+                and rs.round == prs.round
+                and prs.proposal_block_parts is not None
+                and prs.proposal_block_parts_header
+                == rs.proposal_block_parts.header()
+            ):
+                part = self._pick_part_to_send(
+                    rs.proposal_block_parts, prs.proposal_block_parts
+                )
+                if part is not None:
+                    sent = self.data_ch.try_send(
+                        Envelope(
+                            message=BlockPartMessage(
+                                height=rs.height, round=rs.round, part=part
+                            ),
+                            to=ps.peer_id,
+                        )
+                    )
+                    if sent:
+                        ps.set_has_proposal_block_part(
+                            rs.height, rs.round, part.index
+                        )
+
+            # 3) peer is behind: parts of its next committed block
+            if (
+                not sent
+                and 0 < prs.height < rs.height
+                and prs.height >= self.cs.block_store.base()
+            ):
+                sent = self._gossip_catchup_part(ps)
+
+            if not sent:
+                await asyncio.sleep(sleep)
+            else:
+                await asyncio.sleep(0)  # yield
+
+    def _pick_part_to_send(self, our_parts, peer_bits):
+        import random as _random
+
+        missing = our_parts.parts_bit_array.sub(peer_bits)
+        candidates = list(missing.indices())
+        if not candidates:
+            return None
+        return our_parts.get_part(_random.choice(candidates))
+
+    def _gossip_catchup_part(self, ps: PeerState) -> bool:
+        """reference: reactor.go gossipDataForCatchup."""
+        prs = ps.prs
+        meta = self.cs.block_store.load_block_meta(prs.height)
+        if meta is None:
+            return False
+        # make sure the peer's part-set header matches the stored block
+        if prs.proposal_block_parts is None:
+            ps.prs.proposal_block_parts_header = meta.block_id.part_set_header
+            from ..libs.bits import BitArray
+
+            ps.prs.proposal_block_parts = BitArray(
+                max(1, meta.block_id.part_set_header.total)
+            )
+        if prs.proposal_block_parts_header != meta.block_id.part_set_header:
+            return False
+        missing = [
+            i
+            for i in range(prs.proposal_block_parts_header.total)
+            if not prs.proposal_block_parts.get(i)
+        ]
+        if not missing:
+            return False
+        import random as _random
+
+        index = _random.choice(missing)
+        part = self.cs.block_store.load_block_part(prs.height, index)
+        if part is None:
+            return False
+        if self.data_ch.try_send(
+            Envelope(
+                message=BlockPartMessage(
+                    height=prs.height, round=prs.round, part=part
+                ),
+                to=ps.peer_id,
+            )
+        ):
+            ps.set_has_proposal_block_part(prs.height, prs.round, index)
+            return True
+        return False
+
+    async def _gossip_votes_routine(self, ps: PeerState) -> None:
+        """reference: reactor.go:752-848."""
+        sleep = self.cfg.peer_gossip_sleep_duration
+        while True:
+            rs = self.cs.rs
+            prs = ps.prs
+            sent = False
+
+            if rs.height == prs.height:
+                sent = self._gossip_votes_same_height(ps)
+            elif (
+                prs.height != 0
+                and rs.height == prs.height + 1
+                and rs.last_commit is not None
+            ):
+                # peer one behind us: send them our last commit precommits
+                sent = self._send_vote(ps, ps.pick_vote_to_send(rs.last_commit))
+            elif (
+                prs.height != 0
+                and rs.height >= prs.height + 2
+                and prs.height >= self.cs.block_store.base()
+            ):
+                # far behind: votes from the stored commit for their height
+                commit = self.cs.block_store.load_block_commit(prs.height)
+                if commit is not None:
+                    ps.ensure_catchup_commit_round(
+                        prs.height, commit.round,
+                        self._validators_size_at(prs.height),
+                    )
+                    sent = self._send_commit_vote(ps, commit)
+
+            if not sent:
+                await asyncio.sleep(sleep)
+            else:
+                await asyncio.sleep(0)
+
+    def _validators_size_at(self, height: int) -> int:
+        vals = self.cs.block_exec.store.load_validators(height)
+        return vals.size() if vals is not None else self.cs.rs.validators.size()
+
+    def _gossip_votes_same_height(self, ps: PeerState) -> bool:
+        """reference: reactor.go gossipVotesForHeight."""
+        rs = self.cs.rs
+        prs = ps.prs
+        # peer's round matches a previous POL round → its prevotes
+        if prs.step == RoundStep.NEW_HEIGHT and rs.last_commit is not None:
+            if self._send_vote(ps, ps.pick_vote_to_send(rs.last_commit)):
+                return True
+        if prs.step <= RoundStep.PROPOSE and prs.round != -1 and (
+            prs.round <= rs.round and prs.proposal_pol_round != -1
+        ):
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and self._send_vote(
+                ps, ps.pick_vote_to_send(pol)
+            ):
+                return True
+        if prs.step <= RoundStep.PREVOTE_WAIT and prs.round != -1 and (
+            prs.round <= rs.round
+        ):
+            prevotes = rs.votes.prevotes(prs.round)
+            if prevotes is not None and self._send_vote(
+                ps, ps.pick_vote_to_send(prevotes)
+            ):
+                return True
+        if prs.step <= RoundStep.PRECOMMIT_WAIT and prs.round != -1 and (
+            prs.round <= rs.round
+        ):
+            precommits = rs.votes.precommits(prs.round)
+            if precommits is not None and self._send_vote(
+                ps, ps.pick_vote_to_send(precommits)
+            ):
+                return True
+        if prs.proposal_pol_round != -1:
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and self._send_vote(
+                ps, ps.pick_vote_to_send(pol)
+            ):
+                return True
+        return False
+
+    def _send_vote(self, ps: PeerState, vote) -> bool:
+        if vote is None:
+            return False
+        if self.vote_ch.try_send(
+            Envelope(message=VoteMessage(vote=vote), to=ps.peer_id)
+        ):
+            ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+            return True
+        return False
+
+    def _send_commit_vote(self, ps: PeerState, commit) -> bool:
+        """Send a random precommit out of a stored commit."""
+        import random as _random
+
+        prs = ps.prs
+        missing = [
+            i
+            for i, sig in enumerate(commit.signatures)
+            if not sig.is_absent()
+            and (
+                prs.catchup_commit is None
+                or (i < prs.catchup_commit.size and not prs.catchup_commit.get(i))
+            )
+        ]
+        if not missing:
+            return False
+        index = _random.choice(missing)
+        vote = commit.get_vote(index)
+        return self._send_vote(ps, vote)
+
+    async def _query_maj23_routine(self, ps: PeerState) -> None:
+        """Periodically tell peers about our 2/3 majorities
+        (reference: reactor.go:850-966)."""
+        sleep = self.cfg.peer_query_maj23_sleep_duration
+        while True:
+            await asyncio.sleep(sleep)
+            rs = self.cs.rs
+            prs = ps.prs
+            if rs.height != prs.height or rs.votes is None:
+                continue
+            for vote_type, vs in (
+                (PREVOTE_TYPE, rs.votes.prevotes(prs.round)),
+                (PRECOMMIT_TYPE, rs.votes.precommits(prs.round)),
+            ):
+                if vs is None:
+                    continue
+                block_id, ok = vs.two_thirds_majority()
+                if ok:
+                    self.state_ch.try_send(
+                        Envelope(
+                            message=VoteSetMaj23Message(
+                                height=prs.height,
+                                round=prs.round,
+                                type=vote_type,
+                                block_id=block_id,
+                            ),
+                            to=ps.peer_id,
+                        )
+                    )

@@ -119,8 +119,16 @@ class ConsensusState(Service):
         return self.rs
 
     def send_peer_msg(self, msg, peer_id: str) -> None:
-        """Enqueue a consensus message from the network."""
-        self.peer_msg_queue.put_nowait(MsgInfo(msg=msg, peer_id=peer_id))
+        """Enqueue a consensus message from the network. Drops on
+        overflow — gossip is redundant and retried, and a slow consensus
+        loop must backpressure peers, not crash the reactor."""
+        try:
+            self.peer_msg_queue.put_nowait(MsgInfo(msg=msg, peer_id=peer_id))
+        except asyncio.QueueFull:
+            self.logger.debug(
+                "peer msg queue full; dropping",
+                msg_type=type(msg).__name__, peer=peer_id[:12],
+            )
 
     def _send_internal(self, msg) -> None:
         self.internal_msg_queue.put_nowait(MsgInfo(msg=msg, peer_id=""))

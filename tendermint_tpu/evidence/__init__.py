@@ -1,1 +1,29 @@
+"""Evidence subsystem — pool, verification, gossip reactor.
 
+reference: internal/evidence/.
+"""
+
+from .pool import EvidenceError, EvidencePool
+from .reactor import (
+    EVIDENCE_CHANNEL,
+    EvidenceListMessage,
+    EvidenceReactor,
+    evidence_channel_descriptor,
+)
+from .verify import (
+    verify_duplicate_vote,
+    verify_evidence,
+    verify_light_client_attack,
+)
+
+__all__ = [
+    "EVIDENCE_CHANNEL",
+    "EvidenceError",
+    "EvidenceListMessage",
+    "EvidencePool",
+    "EvidenceReactor",
+    "evidence_channel_descriptor",
+    "verify_duplicate_vote",
+    "verify_evidence",
+    "verify_light_client_attack",
+]

@@ -1,0 +1,143 @@
+"""Evidence reactor — gossips pending evidence on channel 0x38.
+
+reference: internal/evidence/reactor.go (channel :22, broadcast
+:112-190). Each peer gets a task streaming the pool's pending list;
+received evidence is verified by the pool before admission.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+from ..encoding.proto import FieldReader, ProtoWriter
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..p2p.channel import Channel
+from ..p2p.peermanager import PeerStatus
+from ..p2p.types import ChannelDescriptor, Envelope, PeerError
+from ..types.evidence import Evidence, evidence_from_proto, evidence_to_proto
+from .pool import EvidenceError, EvidencePool
+
+__all__ = [
+    "EvidenceReactor",
+    "EvidenceListMessage",
+    "EVIDENCE_CHANNEL",
+    "evidence_channel_descriptor",
+]
+
+EVIDENCE_CHANNEL = 0x38
+_BROADCAST_INTERVAL = 1.0  # reapply pending list to peers at this cadence
+
+
+@dataclass
+class EvidenceListMessage:
+    """proto/tendermint/evidence EvidenceList{evidence=1}."""
+
+    evidence: Tuple[Evidence, ...] = ()
+
+    def to_proto(self) -> bytes:
+        w = ProtoWriter()
+        for ev in self.evidence:
+            w.bytes(1, evidence_to_proto(ev))
+        return w.finish()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "EvidenceListMessage":
+        r = FieldReader(data)
+        return cls(
+            evidence=tuple(evidence_from_proto(b) for b in r.get_all(1))
+        )
+
+
+def evidence_channel_descriptor():
+    return ChannelDescriptor(
+        channel_id=EVIDENCE_CHANNEL,
+        message_type=EvidenceListMessage,
+        priority=6,
+        send_queue_capacity=16,
+        recv_buffer_capacity=32,
+        name="evidence",
+    )
+
+
+class EvidenceReactor(Service):
+    def __init__(
+        self,
+        pool: EvidencePool,
+        channel: Channel,
+        peer_updates: asyncio.Queue,
+    ) -> None:
+        super().__init__(name="evidence.reactor", logger=get_logger("evidence.reactor"))
+        self.pool = pool
+        self.channel = channel
+        self.peer_updates = peer_updates
+        self._peer_tasks: Dict[str, asyncio.Task] = {}
+
+    async def on_start(self) -> None:
+        self.spawn(self._peer_update_routine(), "peer-updates")
+        self.spawn(self._recv_routine(), "recv")
+
+    async def _peer_update_routine(self) -> None:
+        while True:
+            update = await self.peer_updates.get()
+            if update.status == PeerStatus.UP:
+                if update.node_id not in self._peer_tasks:
+                    self._peer_tasks[update.node_id] = self.spawn(
+                        self._broadcast_to_peer(update.node_id),
+                        f"ev-gossip-{update.node_id[:8]}",
+                    )
+            elif update.status == PeerStatus.DOWN:
+                t = self._peer_tasks.pop(update.node_id, None)
+                if t is not None and not t.done():
+                    t.cancel()
+                self._tasks = [x for x in self._tasks if not x.done()]
+
+    async def _recv_routine(self) -> None:
+        async for envelope in self.channel:
+            for ev in envelope.message.evidence:
+                try:
+                    self.pool.add_evidence(ev)
+                except EvidenceError as e:
+                    # A lagging node can't verify future-height evidence:
+                    # that is not peer misbehavior (reference gates sends
+                    # on peer height; we tolerate on receive instead)
+                    if "don't have header" in str(e) or "too old" in str(e):
+                        self.logger.debug(
+                            "cannot verify gossiped evidence yet",
+                            err=str(e),
+                        )
+                        continue
+                    self.logger.info(
+                        "peer sent invalid evidence",
+                        peer=envelope.from_peer[:12],
+                        err=str(e),
+                    )
+                    await self.channel.send_error(
+                        PeerError(node_id=envelope.from_peer, err=str(e))
+                    )
+                    break
+
+    async def _broadcast_to_peer(self, peer_id: str) -> None:
+        """Periodically (re)send pending evidence the peer may lack
+        (reference: reactor.go:112-190 broadcastEvidenceLoop)."""
+        sent: set = set()
+        ticks = 0
+        while True:
+            pending, _ = self.pool.pending_evidence(1 << 20)
+            fresh = [ev for ev in pending if ev.hash() not in sent]
+            if fresh:
+                if self.channel.try_send(
+                    Envelope(
+                        message=EvidenceListMessage(evidence=tuple(fresh)),
+                        to=peer_id,
+                    )
+                ):
+                    sent.update(ev.hash() for ev in fresh)
+            await asyncio.sleep(_BROADCAST_INTERVAL)
+            ticks += 1
+            if ticks % 10 == 0:
+                # periodic re-offer: a peer that was too far behind to
+                # verify the first send gets another chance once caught up
+                sent.clear()

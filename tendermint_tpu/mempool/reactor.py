@@ -1,0 +1,117 @@
+"""Mempool reactor — tx gossip on channel 0x30.
+
+reference: internal/mempool/reactor.go (channel id types.go:14,
+descriptor :100-113, per-peer broadcast :150-230). Each peer gets a task
+walking the mempool's FIFO gossip cursor; txs a peer sent us are never
+echoed back to it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..encoding.proto import FieldReader, ProtoWriter
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..p2p.channel import Channel
+from ..p2p.peermanager import PeerStatus
+from ..p2p.types import ChannelDescriptor, Envelope
+from .mempool import TxMempool
+from .types import MempoolError, TxInfo
+
+__all__ = ["MempoolReactor", "TxsMessage", "MEMPOOL_CHANNEL", "mempool_channel_descriptor"]
+
+MEMPOOL_CHANNEL = 0x30
+
+
+@dataclass
+class TxsMessage:
+    """proto/tendermint/mempool/types.pb.go Txs{txs=1}."""
+
+    txs: Tuple[bytes, ...] = ()
+
+    def to_proto(self) -> bytes:
+        w = ProtoWriter()
+        for tx in self.txs:
+            w.bytes(1, tx)
+        return w.finish()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "TxsMessage":
+        r = FieldReader(data)
+        return cls(txs=tuple(r.get_all(1)))
+
+
+def mempool_channel_descriptor(max_tx_bytes: int = 1 << 20):
+    """reference: internal/mempool/reactor.go:100-113 (batch-sized)."""
+    return ChannelDescriptor(
+        channel_id=MEMPOOL_CHANNEL,
+        message_type=TxsMessage,
+        priority=5,
+        send_queue_capacity=128,
+        recv_message_capacity=max_tx_bytes * 10,
+        recv_buffer_capacity=1024,
+        name="mempool",
+    )
+
+
+class MempoolReactor(Service):
+    def __init__(
+        self,
+        mempool: TxMempool,
+        channel: Channel,
+        peer_updates: asyncio.Queue,
+        broadcast: bool = True,
+    ) -> None:
+        super().__init__(name="mempool.reactor", logger=get_logger("mempool.reactor"))
+        self.mempool = mempool
+        self.channel = channel
+        self.peer_updates = peer_updates
+        self.broadcast = broadcast
+        self._peer_tasks: Dict[str, asyncio.Task] = {}
+
+    async def on_start(self) -> None:
+        self.spawn(self._peer_update_routine(), "peer-updates")
+        self.spawn(self._recv_routine(), "recv")
+
+    async def _peer_update_routine(self) -> None:
+        while True:
+            update = await self.peer_updates.get()
+            if update.status == PeerStatus.UP and self.broadcast:
+                if update.node_id not in self._peer_tasks:
+                    self._peer_tasks[update.node_id] = self.spawn(
+                        self._broadcast_to_peer(update.node_id),
+                        f"tx-gossip-{update.node_id[:8]}",
+                    )
+            elif update.status == PeerStatus.DOWN:
+                t = self._peer_tasks.pop(update.node_id, None)
+                if t is not None and not t.done():
+                    t.cancel()
+                self._tasks = [x for x in self._tasks if not x.done()]
+
+    async def _recv_routine(self) -> None:
+        async for envelope in self.channel:
+            msg = envelope.message
+            info = TxInfo(sender_id=envelope.from_peer)
+            for tx in msg.txs:
+                try:
+                    await self.mempool.check_tx(tx, info)
+                except MempoolError:
+                    pass  # dup/full/invalid: normal gossip noise
+
+    async def _broadcast_to_peer(self, peer_id: str) -> None:
+        """Walk the FIFO cursor; skip txs the peer already knows
+        (reference: reactor.go:150-230 broadcastTxRoutine)."""
+        cursor = -1
+        while True:
+            wtx = await self.mempool.wait_for_tx(cursor)
+            cursor = wtx.seq
+            if peer_id in wtx.peers:
+                continue  # peer sent it to us
+            # blocking send: backpressure instead of silently skipping the
+            # tx for this peer forever (reference blocks on SendEnvelope)
+            await self.channel.send(
+                Envelope(message=TxsMessage(txs=(wtx.tx,)), to=peer_id)
+            )

@@ -172,7 +172,10 @@ class PeerManager:
                 best = peer
         if best is None:
             return None
-        return best, next(iter(best.addresses))
+        # rotate through known addresses across retries so one stale
+        # address can't shadow a live one
+        addrs = sorted(best.addresses)
+        return best, addrs[best.dial_attempts % len(addrs)]
 
     def dial_failed(self, node_id: NodeID) -> None:
         """reference: peermanager.go:499-530."""

@@ -1,0 +1,150 @@
+"""Evidence pool + reactor tests: double-sign evidence is formed,
+verified, gossiped, committed into a block, and reported to the app
+(reference model: internal/evidence/pool_test.go, verify_test.go,
+reactor_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.evidence import EvidenceError, EvidencePool
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.store.kv import MemKV
+
+from .test_reactors import CHAIN, make_cluster, start_cluster, stop_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_double_sign(priv, height, vals, time_ns, index=0):
+    """Two conflicting precommits by the same validator."""
+    addr = priv.pub_key().address()
+
+    def vote_for(tag):
+        v = Vote(
+            type=PRECOMMIT_TYPE,
+            height=height,
+            round=0,
+            block_id=BlockID(
+                hash=tag * 32, part_set_header=PartSetHeader(1, tag * 32)
+            ),
+            timestamp_ns=time_ns,
+            validator_address=addr,
+            validator_index=index,
+        )
+        v.signature = priv.sign(v.sign_bytes(CHAIN))
+        return v
+
+    va, vb = vote_for(b"\xaa"), vote_for(b"\xbb")
+    return DuplicateVoteEvidence.from_votes(
+        va, vb, block_time_ns=time_ns, val_set=vals
+    )
+
+
+def test_pool_verifies_and_admits_double_sign_evidence():
+    async def go():
+        net, nodes = make_cluster(4)
+        await start_cluster(net, nodes)
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout=60.0) for n in nodes)
+            )
+        finally:
+            await stop_cluster(net, nodes)
+
+        node = nodes[0]
+        vals = node.state_store.load_validators(2)
+        header_time = node.block_store.load_block_meta(2).header.time_ns
+        # priv index 1 double-signed at height 2
+        priv = PrivKeyEd25519.from_seed(bytes([101]) * 32)
+        idx, _val = vals.get_by_address(priv.pub_key().address())
+        ev = make_double_sign(priv, 2, vals, header_time, index=idx)
+
+        node.evpool.add_evidence(ev)
+        assert node.evpool.is_pending(ev)
+        pending, size = node.evpool.pending_evidence(1 << 20)
+        assert len(pending) == 1 and size > 0
+        node.evpool.check_evidence(pending)  # block-validation path
+
+        # garbage evidence is refused
+        bad = make_double_sign(priv, 2, vals, header_time, index=idx)
+        bad.vote_b.signature = b"\x00" * 64
+        with pytest.raises(EvidenceError):
+            node.evpool.add_evidence(bad)
+
+    run(go())
+
+
+def test_evidence_gossips_and_commits():
+    async def go():
+        net, nodes = make_cluster(4)
+        await start_cluster(net, nodes)
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout=60.0) for n in nodes)
+            )
+            node = nodes[0]
+            vals = node.state_store.load_validators(2)
+            header_time = node.block_store.load_block_meta(2).header.time_ns
+            priv = PrivKeyEd25519.from_seed(bytes([102]) * 32)
+            idx, _ = vals.get_by_address(priv.pub_key().address())
+            ev = make_double_sign(priv, 2, vals, header_time, index=idx)
+            node.evpool.add_evidence(ev)
+
+            # evidence must reach every pool and land in a committed block
+            async def committed_everywhere():
+                while not all(n.evpool.is_committed(ev) for n in nodes):
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(committed_everywhere(), 60.0)
+        finally:
+            await stop_cluster(net, nodes)
+
+        # find the block carrying it and check ABCI byzantine report
+        found = False
+        for h in range(1, nodes[0].block_store.height() + 1):
+            block = nodes[0].block_store.load_block(h)
+            if block.evidence:
+                found = True
+                assert block.evidence[0].hash() == ev.hash()
+        assert found, "evidence never committed into a block"
+        for n in nodes:
+            assert not n.evpool.is_pending(ev)
+
+    run(go())
+
+
+def test_consensus_reported_conflicting_votes_become_evidence():
+    async def go():
+        net, nodes = make_cluster(4)
+        await start_cluster(net, nodes)
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout=60.0) for n in nodes)
+            )
+            node = nodes[0]
+            vals = node.state_store.load_validators(2)
+            header_time = node.block_store.load_block_meta(2).header.time_ns
+            priv = PrivKeyEd25519.from_seed(bytes([103]) * 32)
+            idx, _ = vals.get_by_address(priv.pub_key().address())
+            ev = make_double_sign(priv, 2, vals, header_time, index=idx)
+            # simulate what consensus does on ConflictingVoteError
+            node.evpool.report_conflicting_votes(ev.vote_a, ev.vote_b)
+            assert node.evpool.size() == 0  # buffered, not yet materialized
+
+            async def materialized():
+                while node.evpool.size() == 0:
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(materialized(), 60.0)
+            assert node.evpool.size() == 1
+        finally:
+            await stop_cluster(net, nodes)
+
+    run(go())

@@ -1,0 +1,213 @@
+"""Multi-validator consensus through the real reactor + p2p stack —
+the in-process equivalent of the reference's 4-validator localnet
+(reference model: internal/consensus/reactor_test.go, p2ptest harness).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import KVStoreApplication, LocalClient
+from tendermint_tpu.config import ConsensusConfig, MempoolConfig
+from tendermint_tpu.consensus import ConsensusState
+from tendermint_tpu.consensus.reactor import (
+    ConsensusReactor,
+    consensus_channel_descriptors,
+)
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.eventbus import EventBus
+from tendermint_tpu.evidence import (
+    EvidencePool,
+    EvidenceReactor,
+    evidence_channel_descriptor,
+)
+from tendermint_tpu.mempool import TxMempool
+from tendermint_tpu.mempool.reactor import (
+    MempoolReactor,
+    mempool_channel_descriptor,
+)
+from tendermint_tpu.p2p.p2ptest import TestNetwork
+from tendermint_tpu.privval import MockPV
+from tendermint_tpu.state import StateStore, state_from_genesis
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.store.block_store import BlockStore
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+CHAIN = "reactor-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def fast_config():
+    return ConsensusConfig(
+        timeout_propose=2.0,
+        timeout_propose_delta=0.5,
+        timeout_prevote=1.0,
+        timeout_prevote_delta=0.5,
+        timeout_precommit=1.0,
+        timeout_precommit_delta=0.5,
+        timeout_commit=0.2,
+        skip_timeout_commit=False,
+        peer_gossip_sleep_duration=0.01,
+        peer_query_maj23_sleep_duration=0.5,
+    )
+
+
+class FullNode:
+    """Everything a validator runs, wired over a p2ptest node."""
+
+    def __init__(self, p2p_node, priv, genesis):
+        self.p2p = p2p_node
+        self.app = KVStoreApplication()
+        self.client = LocalClient(self.app)
+        self.state_store = StateStore(MemKV())
+        state = state_from_genesis(genesis)
+        self.state_store.save(state)
+        self.block_store = BlockStore(MemKV())
+        self.mempool = TxMempool(self.client, MempoolConfig())
+        self.bus = EventBus()
+        self.evpool = EvidencePool(MemKV(), self.state_store, self.block_store)
+        self.exec = BlockExecutor(
+            self.state_store, self.client, self.mempool,
+            evidence_pool=self.evpool, block_store=self.block_store,
+            event_bus=self.bus,
+        )
+        self.cs = ConsensusState(
+            fast_config(), state, self.exec, self.block_store,
+            privval=MockPV(priv), event_bus=self.bus,
+            evidence_pool=self.evpool,
+        )
+        cs_channels = {
+            cid: self.p2p.open_channel(d)
+            for cid, d in consensus_channel_descriptors().items()
+        }
+        self.cs_reactor = ConsensusReactor(
+            self.cs, cs_channels, self.p2p.peer_manager.subscribe(), self.bus
+        )
+        self.mp_reactor = MempoolReactor(
+            self.mempool,
+            self.p2p.open_channel(mempool_channel_descriptor()),
+            self.p2p.peer_manager.subscribe(),
+        )
+        self.ev_reactor = EvidenceReactor(
+            self.evpool,
+            self.p2p.open_channel(evidence_channel_descriptor()),
+            self.p2p.peer_manager.subscribe(),
+        )
+
+    async def start(self):
+        await self.bus.start()
+        await self.cs_reactor.start()
+        await self.mp_reactor.start()
+        await self.ev_reactor.start()
+
+    async def stop(self):
+        await self.ev_reactor.stop()
+        await self.mp_reactor.stop()
+        await self.cs_reactor.stop()
+        await self.bus.stop()
+
+
+def make_cluster(n):
+    privs = [PrivKeyEd25519.from_seed(bytes([i + 100]) * 32) for i in range(n)]
+    genesis = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
+        ],
+    )
+    net = TestNetwork(n, chain_id=CHAIN)
+    nodes = [
+        FullNode(net.nodes[i], privs[i], genesis) for i in range(n)
+    ]
+    return net, nodes
+
+
+async def start_cluster(net, nodes):
+    for node in nodes:
+        await node.start()
+    await net.start()
+
+
+async def stop_cluster(net, nodes):
+    for node in nodes:
+        await node.stop()
+    await net.stop()
+
+
+def test_four_validators_reach_consensus_over_p2p():
+    async def go():
+        net, nodes = make_cluster(4)
+        await start_cluster(net, nodes)
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(4, timeout=60.0) for n in nodes)
+            )
+        finally:
+            await stop_cluster(net, nodes)
+
+        for h in range(1, 4):
+            hashes = {n.block_store.load_block(h).hash() for n in nodes}
+            assert len(hashes) == 1, f"divergent block at height {h}"
+        proposers = {
+            nodes[0].block_store.load_block(h).header.proposer_address
+            for h in range(1, 4)
+        }
+        assert len(proposers) >= 2  # rotation happened
+
+    run(go())
+
+
+def test_tx_gossip_and_commit_over_p2p():
+    async def go():
+        net, nodes = make_cluster(4)
+        await start_cluster(net, nodes)
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(2, timeout=60.0) for n in nodes)
+            )
+            # submit a tx at node 3 only; gossip must carry it to proposers
+            await nodes[3].mempool.check_tx(b"gossip=works")
+            target = nodes[0].cs.rs.height + 3
+            await asyncio.gather(
+                *(n.cs.wait_for_height(target, timeout=60.0) for n in nodes)
+            )
+        finally:
+            await stop_cluster(net, nodes)
+
+        for n in nodes:
+            assert n.app.state.get(b"gossip") == b"works", "tx missing on a node"
+
+    run(go())
+
+
+def test_lagging_node_catches_up():
+    async def go():
+        net, nodes = make_cluster(4)
+        # start only 3 of 4 validators; consensus still has 3/4 > 2/3 power
+        for node in nodes[:3]:
+            await node.start()
+        await net.start()
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout=60.0) for n in nodes[:3])
+            )
+            # now start the laggard; catchup gossip must bring it up
+            await nodes[3].start()
+            await nodes[3].cs.wait_for_height(3, timeout=60.0)
+        finally:
+            await stop_cluster(net, nodes)
+
+        h = min(3, nodes[3].block_store.height())
+        assert h >= 2
+        for height in range(1, h + 1):
+            assert (
+                nodes[3].block_store.load_block(height).hash()
+                == nodes[0].block_store.load_block(height).hash()
+            )
+
+    run(go())
