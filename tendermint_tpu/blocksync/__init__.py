@@ -1,1 +1,32 @@
+"""Block sync — catch up to the chain head by fetching verified blocks.
 
+reference: internal/blocksync/.
+"""
+
+from .msgs import (
+    BlockRequestMessage,
+    BlockResponseMessage,
+    BlocksyncCodec,
+    NoBlockResponseMessage,
+    StatusRequestMessage,
+    StatusResponseMessage,
+)
+from .pool import BlockPool
+from .reactor import (
+    BLOCKSYNC_CHANNEL,
+    BlocksyncReactor,
+    blocksync_channel_descriptor,
+)
+
+__all__ = [
+    "BLOCKSYNC_CHANNEL",
+    "BlockPool",
+    "BlockRequestMessage",
+    "BlockResponseMessage",
+    "BlocksyncCodec",
+    "BlocksyncReactor",
+    "NoBlockResponseMessage",
+    "StatusRequestMessage",
+    "StatusResponseMessage",
+    "blocksync_channel_descriptor",
+]

@@ -488,7 +488,17 @@ class ConsensusReactor(Service):
             if not prs.proposal_block_parts.get(i)
         ]
         if not missing:
+            # We think we sent everything, yet the peer hasn't advanced.
+            # Our marks are optimistic (a part can be dropped before the
+            # peer's part tracker exists, e.g. arriving ahead of the
+            # precommits that initialize it in enterCommit) — so after a
+            # stall, forget and resend. Parts are idempotent.
+            ps.catchup_stall = getattr(ps, "catchup_stall", 0) + 1
+            if ps.catchup_stall * self.cfg.peer_gossip_sleep_duration > 1.0:
+                ps.catchup_stall = 0
+                ps.prs.proposal_block_parts = None
             return False
+        ps.catchup_stall = 0
         import random as _random
 
         index = _random.choice(missing)
@@ -532,9 +542,12 @@ class ConsensusReactor(Service):
                 # far behind: votes from the stored commit for their height
                 commit = self.cs.block_store.load_block_commit(prs.height)
                 if commit is not None:
+                    n = self._validators_size_at(prs.height)
+                    # allocate the bit arrays the pick/mark cycle uses —
+                    # unallocated bits would mean every send repeats
+                    ps.ensure_vote_bits(n)
                     ps.ensure_catchup_commit_round(
-                        prs.height, commit.round,
-                        self._validators_size_at(prs.height),
+                        prs.height, commit.round, n
                     )
                     sent = self._send_commit_vote(ps, commit)
 
@@ -598,17 +611,22 @@ class ConsensusReactor(Service):
         return False
 
     def _send_commit_vote(self, ps: PeerState, commit) -> bool:
-        """Send a random precommit out of a stored commit."""
+        """Send a random precommit out of a stored commit. Picks and marks
+        against the SAME bit array (_get_vote_bits), like the reference's
+        PickSendVote — checking one array but marking another loops
+        forever (reference: peer_state.go PickSendVote/SetHasVote)."""
         import random as _random
 
-        prs = ps.prs
+        peer_bits = ps._get_vote_bits(
+            commit.height, commit.round, PRECOMMIT_TYPE
+        )
         missing = [
             i
             for i, sig in enumerate(commit.signatures)
             if not sig.is_absent()
             and (
-                prs.catchup_commit is None
-                or (i < prs.catchup_commit.size and not prs.catchup_commit.get(i))
+                peer_bits is None
+                or (i < peer_bits.size and not peer_bits.get(i))
             )
         ]
         if not missing:
@@ -623,6 +641,15 @@ class ConsensusReactor(Service):
         sleep = self.cfg.peer_query_maj23_sleep_duration
         while True:
             await asyncio.sleep(sleep)
+            # periodic re-announce: a NewRoundStep broadcast dropped on a
+            # full queue must not leave the peer's view of us stale forever.
+            # Not while syncing — advertising the stale pre-sync height
+            # would trigger catchup gossip we'd just discard.
+            if self.wait_sync:
+                continue
+            self.state_ch.try_send(
+                Envelope(message=self._our_new_round_step(), to=ps.peer_id)
+            )
             rs = self.cs.rs
             prs = ps.prs
             if rs.height != prs.height or rs.votes is None:

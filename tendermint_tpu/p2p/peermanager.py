@@ -278,8 +278,15 @@ class PeerManager:
     # -- subscriptions --
 
     def subscribe(self) -> asyncio.Queue:
-        """Peer up/down feed (reference: peermanager.go:828-870)."""
+        """Peer up/down feed, seeded with peers that are ALREADY up so a
+        late subscriber (e.g. a reactor started after connections formed)
+        doesn't miss them (reference: peermanager.go:828-870)."""
         q: asyncio.Queue = asyncio.Queue(maxsize=256)
+        for p in self._peers.values():
+            if p.ready:
+                q.put_nowait(
+                    PeerUpdate(node_id=p.node_id, status=PeerStatus.UP)
+                )
         self._subscribers.append(q)
         return q
 

@@ -201,6 +201,11 @@ class StateStore:
 
     # -- validator sets per height --
 
+    def save_validators(self, height: int, vals: ValidatorSet) -> None:
+        """Store a historically-verified validator set (statesync
+        backfill; reference: internal/state/store.go SaveValidatorSets)."""
+        self._save_validators(height, vals, height)
+
     def _save_validators(
         self,
         height: int,

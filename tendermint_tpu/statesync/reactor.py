@@ -1,0 +1,559 @@
+"""State sync — bootstrap a fresh node from an application snapshot.
+
+reference: internal/statesync/reactor.go (channels :36-45), syncer.go
+(:159-552: discovery → selection → OfferSnapshot → parallel chunk fetch →
+ApplySnapshotChunk → verifyApp), stateprovider.go (trusted state via
+light blocks over the LightBlock channel), chunks.go, snapshots.go.
+
+Trust model this round: fetched light blocks are verified for internal
+consistency (commit carries 2/3 of the block's own validator set through
+the batched device verify; hash linkage between consecutive headers).
+Anchoring to an operator-supplied trust root is layered on by the light
+client package, which replaces _verify_light_block here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..abci import types as abci
+from ..config import StateSyncConfig
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..p2p.channel import Channel
+from ..p2p.peermanager import PeerStatus
+from ..p2p.types import ChannelDescriptor, Envelope, PeerError
+from ..state.types import State
+from ..types.block_id import BlockID
+from ..types.light import LightBlock, SignedHeader
+from ..types.params import ConsensusParams
+from ..types.validation import verify_commit_light
+from .msgs import (
+    ChunkRequestMessage,
+    ChunkResponseMessage,
+    LightBlockRequestMessage,
+    LightBlockResponseMessage,
+    ParamsRequestMessage,
+    ParamsResponseMessage,
+    SnapshotsRequestMessage,
+    SnapshotsResponseMessage,
+    StatesyncCodec,
+)
+
+__all__ = [
+    "StatesyncReactor",
+    "SNAPSHOT_CHANNEL",
+    "CHUNK_CHANNEL",
+    "LIGHT_BLOCK_CHANNEL",
+    "PARAMS_CHANNEL",
+    "statesync_channel_descriptors",
+    "SyncError",
+]
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+LIGHT_BLOCK_CHANNEL = 0x62
+PARAMS_CHANNEL = 0x63
+
+_RECENT_SNAPSHOTS = 10  # serve at most N (reference: reactor.go:56)
+_CHUNK_TIMEOUT = 10.0
+_LIGHT_BLOCK_TIMEOUT = 5.0
+
+
+class SyncError(Exception):
+    pass
+
+
+def statesync_channel_descriptors():
+    """reference: reactor.go:36-45."""
+    return {
+        cid: ChannelDescriptor(
+            channel_id=cid,
+            message_type=StatesyncCodec,
+            priority=p,
+            send_queue_capacity=cap,
+            recv_buffer_capacity=128,
+            name=name,
+        )
+        for cid, p, cap, name in (
+            (SNAPSHOT_CHANNEL, 6, 10, "snapshot"),
+            (CHUNK_CHANNEL, 3, 4, "chunk"),
+            (LIGHT_BLOCK_CHANNEL, 2, 10, "lightblock"),
+            (PARAMS_CHANNEL, 2, 10, "params"),
+        )
+    }
+
+
+@dataclass
+class _Snapshot:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes
+    peers: Set[str] = field(default_factory=set)
+
+    def key(self) -> Tuple[int, int, bytes]:
+        return (self.height, self.format, self.hash)
+
+
+class StatesyncReactor(Service):
+    def __init__(
+        self,
+        chain_id: str,
+        initial_state: State,
+        app_client,  # snapshot connection
+        state_store,
+        block_store,
+        channels: Dict[int, Channel],
+        peer_updates: asyncio.Queue,
+        cfg: Optional[StateSyncConfig] = None,
+    ) -> None:
+        super().__init__(name="statesync", logger=get_logger("statesync"))
+        self.chain_id = chain_id
+        self.initial_state = initial_state
+        self.app = app_client
+        self.state_store = state_store
+        self.block_store = block_store
+        self.snapshot_ch = channels[SNAPSHOT_CHANNEL]
+        self.chunk_ch = channels[CHUNK_CHANNEL]
+        self.light_ch = channels[LIGHT_BLOCK_CHANNEL]
+        self.params_ch = channels[PARAMS_CHANNEL]
+        self.peer_updates = peer_updates
+        self.cfg = cfg or StateSyncConfig()
+        self.peers: Set[str] = set()
+        # discovery pool
+        self._snapshots: Dict[Tuple[int, int, bytes], _Snapshot] = {}
+        self._rejected: Set[Tuple[int, int, bytes]] = set()
+        # in-flight response routing, keyed by (sender_peer, request key)
+        self._chunk_waiters: Dict[Tuple, asyncio.Future] = {}
+        self._light_waiters: Dict[Tuple[str, int], asyncio.Future] = {}
+        self._params_waiters: Dict[Tuple[str, int], asyncio.Future] = {}
+        self.synced_state: Optional[State] = None
+
+    async def on_start(self) -> None:
+        self.spawn(self._peer_update_routine(), "peer-updates")
+        self.spawn(self._recv(self.snapshot_ch, self._on_snapshot_msg), "recv-snap")
+        self.spawn(self._recv(self.chunk_ch, self._on_chunk_msg), "recv-chunk")
+        self.spawn(self._recv(self.light_ch, self._on_light_msg), "recv-light")
+        self.spawn(self._recv(self.params_ch, self._on_params_msg), "recv-params")
+
+    # ------------------------------------------------------------------
+    # serving side (every node serves; reference: reactor.go handle*)
+
+    async def _recv(self, channel: Channel, handler) -> None:
+        async for envelope in channel:
+            try:
+                await handler(envelope)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.logger.error(
+                    "statesync message failed", ch=channel.name, err=str(e)
+                )
+
+    async def _peer_update_routine(self) -> None:
+        while True:
+            update = await self.peer_updates.get()
+            if update.status == PeerStatus.UP:
+                self.peers.add(update.node_id)
+            else:
+                self.peers.discard(update.node_id)
+                for snap in self._snapshots.values():
+                    snap.peers.discard(update.node_id)
+
+    async def _on_snapshot_msg(self, envelope: Envelope) -> None:
+        msg = envelope.message
+        if isinstance(msg, SnapshotsRequestMessage):
+            res = await self.app.list_snapshots(abci.RequestListSnapshots())
+            for snap in sorted(
+                res.snapshots, key=lambda s: s.height, reverse=True
+            )[:_RECENT_SNAPSHOTS]:
+                self.snapshot_ch.try_send(
+                    Envelope(
+                        message=SnapshotsResponseMessage(
+                            height=snap.height,
+                            format=snap.format,
+                            chunks=snap.chunks,
+                            hash=snap.hash,
+                            metadata=snap.metadata,
+                        ),
+                        to=envelope.from_peer,
+                    )
+                )
+        elif isinstance(msg, SnapshotsResponseMessage):
+            key = (msg.height, msg.format, msg.hash)
+            if key in self._rejected:
+                return
+            snap = self._snapshots.get(key)
+            if snap is None:
+                snap = _Snapshot(
+                    height=msg.height, format=msg.format, chunks=msg.chunks,
+                    hash=msg.hash, metadata=msg.metadata,
+                )
+                self._snapshots[key] = snap
+            snap.peers.add(envelope.from_peer)
+
+    async def _on_chunk_msg(self, envelope: Envelope) -> None:
+        msg = envelope.message
+        if isinstance(msg, ChunkRequestMessage):
+            res = await self.app.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=msg.height, format=msg.format, chunk=msg.index
+                )
+            )
+            self.chunk_ch.try_send(
+                Envelope(
+                    message=ChunkResponseMessage(
+                        height=msg.height,
+                        format=msg.format,
+                        index=msg.index,
+                        chunk=res.chunk,
+                        missing=not res.chunk,
+                    ),
+                    to=envelope.from_peer,
+                )
+            )
+        elif isinstance(msg, ChunkResponseMessage):
+            # sender-keyed: a third peer can't poison the future of a
+            # request we sent to someone else
+            fut = self._chunk_waiters.pop(
+                (envelope.from_peer, msg.height, msg.format, msg.index),
+                None,
+            )
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+
+    async def _on_light_msg(self, envelope: Envelope) -> None:
+        msg = envelope.message
+        if isinstance(msg, LightBlockRequestMessage):
+            lb = self._load_light_block(msg.height)
+            self.light_ch.try_send(
+                Envelope(
+                    message=LightBlockResponseMessage(light_block=lb),
+                    to=envelope.from_peer,
+                )
+            )
+        elif isinstance(msg, LightBlockResponseMessage):
+            if msg.light_block is None or msg.light_block.signed_header is None:
+                return
+            h = msg.light_block.signed_header.header.height
+            fut = self._light_waiters.pop((envelope.from_peer, h), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg.light_block)
+
+    async def _on_params_msg(self, envelope: Envelope) -> None:
+        msg = envelope.message
+        if isinstance(msg, ParamsRequestMessage):
+            params = self.state_store.load_params(msg.height)
+            if params is None:
+                state = self.state_store.load()
+                params = state.consensus_params if state else None
+            if params is not None:
+                self.params_ch.try_send(
+                    Envelope(
+                        message=ParamsResponseMessage(
+                            height=msg.height,
+                            consensus_params=params.to_proto(),
+                        ),
+                        to=envelope.from_peer,
+                    )
+                )
+        elif isinstance(msg, ParamsResponseMessage):
+            fut = self._params_waiters.pop(
+                (envelope.from_peer, msg.height), None
+            )
+            if fut is not None and not fut.done():
+                fut.set_result(msg.consensus_params)
+
+    def _load_light_block(self, height: int) -> Optional[LightBlock]:
+        """reference: statesync/reactor.go handleLightBlockMessage →
+        state provider's view of a stored height."""
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)
+        vals = self.state_store.load_validators(height)
+        if meta is None or commit is None or vals is None:
+            return None
+        return LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validator_set=vals,
+        )
+
+    # ------------------------------------------------------------------
+    # sync side (reference: syncer.go SyncAny :159)
+
+    async def sync(self) -> State:
+        """Discover snapshots, restore the best one, return the
+        bootstrapped State. Raises SyncError if no snapshot worked."""
+        self.logger.info(
+            "discovering snapshots",
+            seconds=self.cfg.discovery_time,
+        )
+        self.snapshot_ch.try_send(
+            Envelope(message=SnapshotsRequestMessage(), broadcast=True)
+        )
+        await asyncio.sleep(self.cfg.discovery_time)
+
+        while True:
+            snapshot = self._best_snapshot()
+            if snapshot is None:
+                raise SyncError("no viable snapshots discovered")
+            try:
+                state = await self._sync_snapshot(snapshot)
+                self.synced_state = state
+                return state
+            except SyncError as e:
+                self.logger.error(
+                    "snapshot restore failed; trying next",
+                    height=snapshot.height,
+                    err=str(e),
+                )
+                self._rejected.add(snapshot.key())
+                self._snapshots.pop(snapshot.key(), None)
+
+    def _best_snapshot(self) -> Optional[_Snapshot]:
+        """Highest height, then most peers (reference: snapshots.go
+        snapshotPool.Best ranking)."""
+        candidates = [
+            s for s in self._snapshots.values()
+            if s.peers and s.key() not in self._rejected
+        ]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: (s.height, len(s.peers)))
+
+    async def _sync_snapshot(self, snapshot: _Snapshot) -> State:
+        """reference: syncer.go Sync :263-460."""
+        h = snapshot.height
+        self.logger.info(
+            "restoring snapshot", height=h, format=snapshot.format,
+            chunks=snapshot.chunks,
+        )
+        # 1. trusted state info from light blocks at h, h+1, h+2
+        lb_h = await self._fetch_light_block(h, snapshot.peers)
+        lb_h1 = await self._fetch_light_block(h + 1, snapshot.peers)
+        lb_h2 = await self._fetch_light_block(h + 2, snapshot.peers)
+        app_hash = lb_h1.signed_header.header.app_hash
+
+        # 2. offer to the app
+        offer = await self.app.offer_snapshot(
+            abci.RequestOfferSnapshot(
+                snapshot=abci.Snapshot(
+                    height=snapshot.height,
+                    format=snapshot.format,
+                    chunks=snapshot.chunks,
+                    hash=snapshot.hash,
+                    metadata=snapshot.metadata,
+                ),
+                app_hash=app_hash,
+            )
+        )
+        if offer.result != abci.OFFER_SNAPSHOT_ACCEPT:
+            raise SyncError(f"snapshot rejected by app: {offer.result}")
+
+        # 3. fetch chunks in parallel, apply in order
+        chunks = await self._fetch_chunks(snapshot)
+        for index in range(snapshot.chunks):
+            res = await self.app.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(
+                    index=index, chunk=chunks[index], sender=""
+                )
+            )
+            if res.result != abci.APPLY_CHUNK_ACCEPT:
+                raise SyncError(f"chunk {index} rejected: {res.result}")
+
+        # 4. verify the app landed on the trusted hash
+        info = await self.app.info(abci.RequestInfo())
+        if info.last_block_height != h:
+            raise SyncError(
+                f"app restored to height {info.last_block_height}, "
+                f"expected {h}"
+            )
+        if info.last_block_app_hash != app_hash:
+            raise SyncError(
+                f"app hash mismatch after restore: "
+                f"{info.last_block_app_hash.hex()[:16]} != "
+                f"{app_hash.hex()[:16]}"
+            )
+
+        # 5. build + persist the trusted state
+        params = await self._fetch_params(h + 1, snapshot.peers)
+        state = self._build_state(lb_h, lb_h1, lb_h2, params)
+        self.state_store.bootstrap(state)
+        self.block_store.save_signed_header(
+            lb_h.signed_header,
+            lb_h1.signed_header.header.last_block_id,
+        )
+        self.logger.info("snapshot restored", height=h)
+        return state
+
+    async def _fetch_chunks(self, snapshot: _Snapshot) -> Dict[int, bytes]:
+        """Parallel chunk fetch with per-chunk retry over providers
+        (reference: syncer.go fetchChunks :464-520, chunks.go)."""
+        out: Dict[int, bytes] = {}
+        sem = asyncio.Semaphore(self.cfg.fetchers)
+
+        async def fetch(index: int) -> None:
+            async with sem:
+                for attempt in range(4):
+                    providers = sorted(snapshot.peers)
+                    if not providers:
+                        # all providers disconnected mid-fetch
+                        raise SyncError("no remaining snapshot providers")
+                    peer = random.choice(providers)
+                    fut = asyncio.get_event_loop().create_future()
+                    self._chunk_waiters[
+                        (peer, snapshot.height, snapshot.format, index)
+                    ] = fut
+                    self.chunk_ch.try_send(
+                        Envelope(
+                            message=ChunkRequestMessage(
+                                height=snapshot.height,
+                                format=snapshot.format,
+                                index=index,
+                            ),
+                            to=peer,
+                        )
+                    )
+                    try:
+                        res = await asyncio.wait_for(
+                            fut, timeout=self.cfg.chunk_request_timeout
+                        )
+                    except asyncio.TimeoutError:
+                        continue
+                    if res.missing:
+                        continue
+                    out[index] = res.chunk
+                    return
+                raise SyncError(f"failed to fetch chunk {index}")
+
+        await asyncio.gather(*(fetch(i) for i in range(snapshot.chunks)))
+        return out
+
+    async def _fetch_light_block(
+        self, height: int, peers: Set[str]
+    ) -> LightBlock:
+        """Fetch + verify a light block from snapshot providers
+        (reference: stateprovider.go P2P provider)."""
+        for peer in list(peers) + list(self.peers):
+            fut = asyncio.get_event_loop().create_future()
+            self._light_waiters[(peer, height)] = fut
+            self.light_ch.try_send(
+                Envelope(
+                    message=LightBlockRequestMessage(height=height), to=peer
+                )
+            )
+            try:
+                lb = await asyncio.wait_for(
+                    fut, timeout=_LIGHT_BLOCK_TIMEOUT
+                )
+            except asyncio.TimeoutError:
+                continue
+            try:
+                self._verify_light_block(lb, height)
+            except Exception as e:
+                self.logger.info(
+                    "peer sent invalid light block", peer=peer[:12],
+                    err=str(e),
+                )
+                continue
+            return lb
+        raise SyncError(f"could not fetch light block at height {height}")
+
+    def _verify_light_block(self, lb: LightBlock, height: int) -> None:
+        """Internal-consistency verification (see module docstring)."""
+        sh = lb.signed_header
+        if sh.header.height != height:
+            raise ValueError("wrong height")
+        if sh.header.chain_id != self.chain_id:
+            raise ValueError("wrong chain id")
+        if lb.validator_set.hash() != sh.header.validators_hash:
+            raise ValueError("validator set doesn't match header")
+        if sh.commit.block_id.hash != sh.header.hash():
+            raise ValueError("commit is for a different block")
+        # 2/3 of the set signed — one batched device verify
+        verify_commit_light(
+            self.chain_id,
+            lb.validator_set,
+            sh.commit.block_id,
+            height,
+            sh.commit,
+        )
+
+    async def _fetch_params(
+        self, height: int, peers: Set[str]
+    ) -> ConsensusParams:
+        for peer in list(peers) + list(self.peers):
+            fut = asyncio.get_event_loop().create_future()
+            self._params_waiters[(peer, height)] = fut
+            self.params_ch.try_send(
+                Envelope(
+                    message=ParamsRequestMessage(height=height), to=peer
+                )
+            )
+            try:
+                raw = await asyncio.wait_for(fut, timeout=_LIGHT_BLOCK_TIMEOUT)
+            except asyncio.TimeoutError:
+                continue
+            return ConsensusParams.from_proto(raw)
+        raise SyncError(f"could not fetch consensus params at {height}")
+
+    def _build_state(
+        self,
+        lb_h: LightBlock,
+        lb_h1: LightBlock,
+        lb_h2: LightBlock,
+        params: ConsensusParams,
+    ) -> State:
+        """reference: stateprovider.go State() :150-200."""
+        h = lb_h.signed_header.header.height
+        state = self.initial_state.copy()
+        state.last_block_height = h
+        state.last_block_time_ns = lb_h.signed_header.header.time_ns
+        state.last_block_id = lb_h.signed_header.commit.block_id
+        state.app_hash = lb_h1.signed_header.header.app_hash
+        state.last_results_hash = lb_h1.signed_header.header.last_results_hash
+        state.last_validators = lb_h.validator_set
+        state.validators = lb_h1.validator_set
+        state.next_validators = lb_h2.validator_set
+        state.last_height_validators_changed = h + 1
+        state.consensus_params = params
+        state.last_height_consensus_params_changed = h + 1
+        return state
+
+    # ------------------------------------------------------------------
+    # backfill (reference: reactor.go:341-363, ADR-068)
+
+    async def backfill(self, state: State) -> int:
+        """Fetch and store verified signed headers backward from the sync
+        base to the evidence window; returns how many were stored."""
+        max_age = state.consensus_params.evidence.max_age_num_blocks
+        stop_height = max(state.initial_height, state.last_block_height - max_age)
+        height = self.block_store.base() - 1
+        stored = 0
+        prev_header = None
+        meta = self.block_store.load_block_meta(self.block_store.base())
+        if meta is not None:
+            prev_header = meta.header
+        while height >= stop_height and prev_header is not None:
+            try:
+                lb = await self._fetch_light_block(height, self.peers)
+            except SyncError:
+                break
+            # linkage: the newer header must point at this block
+            if prev_header.last_block_id.hash != lb.signed_header.header.hash():
+                self.logger.error(
+                    "backfill light block does not link", height=height
+                )
+                break
+            self.block_store.save_signed_header(
+                lb.signed_header, prev_header.last_block_id
+            )
+            self.state_store.save_validators(height, lb.validator_set)
+            prev_header = lb.signed_header.header
+            height -= 1
+            stored += 1
+        return stored

@@ -283,6 +283,8 @@ def test_peer_error_evicts_peer():
         await net.start()
         bad = net.nodes[1].node_id
         sub = net.nodes[0].peer_manager.subscribe()
+        seeded = await asyncio.wait_for(sub.get(), 5)
+        assert seeded.status == PeerStatus.UP  # subscribe seeds live peers
         await channels[0].send_error(PeerError(node_id=bad, err="misbehaved"))
         update = await asyncio.wait_for(sub.get(), 5)
         assert update.node_id == bad and update.status == PeerStatus.DOWN
@@ -412,6 +414,8 @@ def test_tampered_frame_drops_peer_not_router():
 
         # corrupt node1→node0 traffic by writing junk into the raw socket
         sub = pms[0].subscribe()
+        seeded = await asyncio.wait_for(sub.get(), 5)
+        assert seeded.status == PeerStatus.UP  # subscribe seeds live peers
         conn = routers[1]._peer_conns[ids[0]]
         conn._secret._writer.write(b"\x00\x00\x00\x08" + b"garbage!")
         await conn._secret._writer.drain()

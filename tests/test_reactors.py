@@ -59,7 +59,13 @@ def fast_config():
 class FullNode:
     """Everything a validator runs, wired over a p2ptest node."""
 
-    def __init__(self, p2p_node, priv, genesis):
+    def __init__(self, p2p_node, priv, genesis, block_sync=False,
+                 state_sync=False):
+        from tendermint_tpu.blocksync import (
+            BlocksyncReactor,
+            blocksync_channel_descriptor,
+        )
+
         self.p2p = p2p_node
         self.app = KVStoreApplication()
         self.client = LocalClient(self.app)
@@ -77,7 +83,8 @@ class FullNode:
         )
         self.cs = ConsensusState(
             fast_config(), state, self.exec, self.block_store,
-            privval=MockPV(priv), event_bus=self.bus,
+            privval=MockPV(priv) if priv is not None else None,
+            event_bus=self.bus,
             evidence_pool=self.evpool,
         )
         cs_channels = {
@@ -85,7 +92,8 @@ class FullNode:
             for cid, d in consensus_channel_descriptors().items()
         }
         self.cs_reactor = ConsensusReactor(
-            self.cs, cs_channels, self.p2p.peer_manager.subscribe(), self.bus
+            self.cs, cs_channels, self.p2p.peer_manager.subscribe(), self.bus,
+            wait_sync=block_sync or state_sync,
         )
         self.mp_reactor = MempoolReactor(
             self.mempool,
@@ -97,14 +105,45 @@ class FullNode:
             self.p2p.open_channel(evidence_channel_descriptor()),
             self.p2p.peer_manager.subscribe(),
         )
+        self.bs_reactor = BlocksyncReactor(
+            state, self.exec, self.block_store,
+            self.p2p.open_channel(blocksync_channel_descriptor()),
+            self.p2p.peer_manager.subscribe(),
+            block_sync=block_sync,
+            consensus_reactor=self.cs_reactor,
+            event_bus=self.bus,
+        )
+        from tendermint_tpu.config import StateSyncConfig
+        from tendermint_tpu.statesync import (
+            StatesyncReactor,
+            statesync_channel_descriptors,
+        )
+
+        self.ss_reactor = StatesyncReactor(
+            genesis.chain_id,
+            state,
+            self.client,
+            self.state_store,
+            self.block_store,
+            {
+                cid: self.p2p.open_channel(d)
+                for cid, d in statesync_channel_descriptors().items()
+            },
+            self.p2p.peer_manager.subscribe(),
+            cfg=StateSyncConfig(discovery_time=0.5),
+        )
 
     async def start(self):
         await self.bus.start()
         await self.cs_reactor.start()
         await self.mp_reactor.start()
         await self.ev_reactor.start()
+        await self.bs_reactor.start()
+        await self.ss_reactor.start()
 
     async def stop(self):
+        await self.ss_reactor.stop()
+        await self.bs_reactor.stop()
         await self.ev_reactor.stop()
         await self.mp_reactor.stop()
         await self.cs_reactor.stop()

@@ -1,0 +1,155 @@
+"""State sync tests — a fresh node restores an app snapshot, verifies
+it against fetched light blocks, block-syncs the remainder, and follows
+consensus (reference model: internal/statesync/syncer_test.go,
+reactor_test.go)."""
+
+import asyncio
+
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.p2p.p2ptest import TestNetwork
+from tendermint_tpu.statesync import (
+    ChunkRequestMessage,
+    ChunkResponseMessage,
+    LightBlockRequestMessage,
+    SnapshotsRequestMessage,
+    SnapshotsResponseMessage,
+    StatesyncCodec,
+)
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+from .test_reactors import CHAIN, FullNode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_statesync_codec_roundtrip():
+    for msg in (
+        SnapshotsRequestMessage(),
+        SnapshotsResponseMessage(height=5, format=1, chunks=3, hash=b"\x01" * 32),
+        ChunkRequestMessage(height=5, format=1, index=2),
+        ChunkResponseMessage(height=5, format=1, index=2, chunk=b"data"),
+        LightBlockRequestMessage(height=9),
+    ):
+        assert StatesyncCodec.decode(StatesyncCodec.encode(msg)) == msg
+
+
+def test_fresh_node_state_syncs_then_follows():
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 100]) * 32) for i in range(4)]
+        genesis = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
+            ],
+        )
+        net = TestNetwork(5, chain_id=CHAIN)
+        validators = [
+            FullNode(net.nodes[i], privs[i], genesis) for i in range(4)
+        ]
+        fresh = FullNode(net.nodes[4], None, genesis, state_sync=True)
+
+        for v in validators:
+            await v.start()
+        await net.start()
+        try:
+            # chain advances; snapshot taken at some height
+            await asyncio.gather(
+                *(v.cs.wait_for_height(5, timeout=90.0) for v in validators)
+            )
+            snaps = [v.app.take_snapshot() for v in validators]
+            snap_height = snaps[0].height
+            assert snap_height >= 3
+            # keep going so light blocks at h+1, h+2 exist
+            await asyncio.gather(
+                *(
+                    v.cs.wait_for_height(snap_height + 5, timeout=90.0)
+                    for v in validators
+                )
+            )
+
+            await fresh.start()
+            state = await asyncio.wait_for(fresh.ss_reactor.sync(), 60.0)
+            assert state.last_block_height == snap_height
+            # the app was restored without replaying blocks
+            assert fresh.app.height == snap_height
+            assert fresh.app.app_hash == state.app_hash
+
+            # stored signed header at the base
+            assert fresh.block_store.load_block_meta(snap_height) is not None
+
+            # block sync the rest, then follow consensus
+            await fresh.bs_reactor.start_sync(state)
+
+            async def synced():
+                while not fresh.bs_reactor.synced:
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(synced(), 60.0)
+            target = validators[0].cs.rs.height + 2
+            await fresh.cs.wait_for_height(target, timeout=60.0)
+        finally:
+            for v in validators:
+                await v.stop()
+            await fresh.stop()
+            await net.stop()
+
+        # chains agree above the snapshot base
+        for h in range(snap_height + 1, snap_height + 3):
+            assert (
+                fresh.block_store.load_block(h).hash()
+                == validators[0].block_store.load_block(h).hash()
+            )
+
+    run(go())
+
+
+def test_backfill_stores_prior_headers():
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 100]) * 32) for i in range(4)]
+        genesis = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
+            ],
+        )
+        net = TestNetwork(5, chain_id=CHAIN)
+        validators = [
+            FullNode(net.nodes[i], privs[i], genesis) for i in range(4)
+        ]
+        fresh = FullNode(net.nodes[4], None, genesis, state_sync=True)
+        for v in validators:
+            await v.start()
+        await net.start()
+        try:
+            await asyncio.gather(
+                *(v.cs.wait_for_height(6, timeout=90.0) for v in validators)
+            )
+            snaps = [v.app.take_snapshot() for v in validators]
+            snap_height = snaps[0].height
+            await asyncio.gather(
+                *(
+                    v.cs.wait_for_height(snap_height + 4, timeout=90.0)
+                    for v in validators
+                )
+            )
+            await fresh.start()
+            state = await asyncio.wait_for(fresh.ss_reactor.sync(), 60.0)
+            stored = await asyncio.wait_for(
+                fresh.ss_reactor.backfill(state), 60.0
+            )
+            assert stored >= snap_height - 1  # back to height 1
+            for h in range(1, snap_height):
+                meta = fresh.block_store.load_block_meta(h)
+                assert meta is not None and meta.header.height == h
+                assert fresh.state_store.load_validators(h) is not None
+        finally:
+            for v in validators:
+                await v.stop()
+            await fresh.stop()
+            await net.stop()
+
+    run(go())
