@@ -1036,7 +1036,13 @@ class ConsensusState(Service):
     async def _catchup_replay(self, height: int) -> None:
         """Replay WAL messages recorded after the last EndHeight
         (reference: internal/consensus/replay.go:96-170)."""
-        msgs = self.wal.search_for_end_height(height - 1)
+        # At the chain's first height there is no EndHeight(height-1)
+        # record; the WAL opens with EndHeight(0)
+        # (reference: replay.go:127-129).
+        end_height = height - 1
+        if self.state is not None and height == self.state.initial_height:
+            end_height = 0
+        msgs = self.wal.search_for_end_height(end_height)
         if msgs is None:
             return
         self._replay_mode = True

@@ -56,6 +56,7 @@ class _Peer:
     persistent: bool = False
     dial_attempts: int = 0
     last_dial_failure: float = 0.0
+    dialing: bool = False
     connected: bool = False
     ready: bool = False
     inbound: bool = False
@@ -135,7 +136,10 @@ class PeerManager:
         return [p.node_id for p in self._peers.values() if p.ready]
 
     def num_connected(self) -> int:
-        return sum(1 for p in self._peers.values() if p.connected)
+        # a dialing peer holds a slot too, or we would over-dial
+        return sum(
+            1 for p in self._peers.values() if p.connected or p.dialing
+        )
 
     # -- dialing --
 
@@ -146,7 +150,7 @@ class PeerManager:
             candidate = self._next_dial_candidate()
             if candidate is not None:
                 peer, (host, port) = candidate
-                peer.connected = True  # reserve the slot (dialing state)
+                peer.dialing = True  # reserve the slot
                 peer.dial_attempts += 1
                 return peer.node_id, host, port
             self._wakeup.clear()
@@ -162,7 +166,7 @@ class PeerManager:
         now = time.monotonic()
         best: Optional[_Peer] = None
         for peer in self._peers.values():
-            if peer.connected or not peer.addresses:
+            if peer.connected or peer.dialing or not peer.addresses:
                 continue
             if now - peer.last_dial_failure < peer.retry_delay(self.opts):
                 continue
@@ -178,20 +182,27 @@ class PeerManager:
         return best, addrs[best.dial_attempts % len(addrs)]
 
     def dial_failed(self, node_id: NodeID) -> None:
-        """reference: peermanager.go:499-530."""
+        """reference: peermanager.go:499-530. Only clears the dialing
+        reservation — a live inbound connection accepted during the dial
+        (crossover) must keep its connected state."""
         peer = self._peers.get(node_id)
         if peer is None:
             return
-        peer.connected = False
+        peer.dialing = False
         peer.last_dial_failure = time.monotonic()
         self._wakeup.set()
 
     def dialed(self, node_id: NodeID) -> None:
-        """Outbound connection established
-        (reference: peermanager.go:532-583)."""
+        """Outbound connection established. Raises if the peer is
+        already connected — a dial/accept crossover must keep the
+        existing connection, not silently double-register
+        (reference: peermanager.go:569 'peer is already connected')."""
         peer = self._peers.get(node_id)
         if peer is None:
             raise ValueError(f"dialed unknown peer {node_id}")
+        if peer.connected:
+            raise ValueError(f"peer {node_id} is already connected")
+        peer.dialing = False
         peer.dial_attempts = 0
         peer.connected = True
         peer.inbound = False
@@ -208,9 +219,12 @@ class PeerManager:
         if peer.connected:
             raise ValueError(f"peer {node_id} is already connected")
         # capacity check BEFORE reserving the slot, or a rejected inbound
-        # peer would leak a phantom connected=True entry forever
+        # peer would leak a phantom connected=True entry forever. This
+        # peer's own dialing reservation (crossover) already occupies a
+        # slot, so it must not count twice.
+        occupied = self.num_connected() - (1 if peer.dialing else 0)
         if (
-            self.num_connected() + 1
+            occupied + 1
             > self.opts.max_connected + self.opts.max_connected_upgrade
         ):
             raise ValueError("already connected to maximum number of peers")

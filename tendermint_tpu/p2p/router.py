@@ -158,9 +158,11 @@ class Router(Service):
 
     def _start_peer(self, node_id: NodeID, conn: Connection) -> None:
         if node_id in self._peer_conns:
-            # duplicate connection; keep the existing one
+            # duplicate connection: keep the existing one. No
+            # disconnected() — the live peer's state must not be torn
+            # down (reactors would drop peer state while its connection
+            # keeps delivering).
             conn.close()
-            self.peer_manager.disconnected(node_id)
             return
         self._peer_conns[node_id] = conn
         q: asyncio.Queue = asyncio.Queue(maxsize=self.opts.peer_queue_size)

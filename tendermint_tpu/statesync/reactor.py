@@ -5,17 +5,23 @@ reference: internal/statesync/reactor.go (channels :36-45), syncer.go
 ApplySnapshotChunk → verifyApp), stateprovider.go (trusted state via
 light blocks over the LightBlock channel), chunks.go, snapshots.go.
 
-Trust model this round: fetched light blocks are verified for internal
-consistency (commit carries 2/3 of the block's own validator set through
-the batched device verify; hash linkage between consecutive headers).
-Anchoring to an operator-supplied trust root is layered on by the light
-client package, which replaces _verify_light_block here.
+Trust model: state sync requires an operator-supplied trust root
+(``trust_height`` + ``trust_hash``, reference config.go:811-895). The
+syncer fetches the light block at the trust height, checks its header
+hash against the configured hash, and then requires every snapshot
+light block to carry >=1/3 of the anchored validator set's power
+(``verify_commit_light_trusting``) in addition to 2/3 of its own
+claimed set — the same two checks light.VerifyNonAdjacent performs
+(reference light/verifier.go:106). Consecutive fetched headers are
+additionally checked for hash linkage and next-validators-hash
+chaining.
 """
 
 from __future__ import annotations
 
 import asyncio
 import random
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -30,7 +36,11 @@ from ..state.types import State
 from ..types.block_id import BlockID
 from ..types.light import LightBlock, SignedHeader
 from ..types.params import ConsensusParams
-from ..types.validation import verify_commit_light
+from ..types.validation import (
+    Fraction,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
 from .msgs import (
     ChunkRequestMessage,
     ChunkResponseMessage,
@@ -287,7 +297,25 @@ class StatesyncReactor(Service):
 
     async def sync(self) -> State:
         """Discover snapshots, restore the best one, return the
-        bootstrapped State. Raises SyncError if no snapshot worked."""
+        bootstrapped State. Raises SyncError if no snapshot worked.
+
+        Requires the operator trust root (reference: config.go:811-895
+        — TrustHeight/TrustHash are mandatory for state sync)."""
+        if self.cfg.trust_height <= 0 or not self.cfg.trust_hash:
+            raise SyncError(
+                "state sync requires statesync.trust_height and "
+                "statesync.trust_hash (obtain them out-of-band from a "
+                "trusted source)"
+            )
+        try:
+            trust_hash = bytes.fromhex(self.cfg.trust_hash)
+        except ValueError as e:
+            raise SyncError(f"invalid statesync.trust_hash: {e}") from e
+        if len(trust_hash) != 32:
+            raise SyncError(
+                f"statesync.trust_hash must be 32 hex bytes, got "
+                f"{len(trust_hash)}"
+            )
         self.logger.info(
             "discovering snapshots",
             seconds=self.cfg.discovery_time,
@@ -297,12 +325,14 @@ class StatesyncReactor(Service):
         )
         await asyncio.sleep(self.cfg.discovery_time)
 
+        anchor = await self._fetch_trust_anchor(trust_hash)
+
         while True:
             snapshot = self._best_snapshot()
             if snapshot is None:
                 raise SyncError("no viable snapshots discovered")
             try:
-                state = await self._sync_snapshot(snapshot)
+                state = await self._sync_snapshot(snapshot, anchor)
                 self.synced_state = state
                 return state
             except SyncError as e:
@@ -314,18 +344,47 @@ class StatesyncReactor(Service):
                 self._rejected.add(snapshot.key())
                 self._snapshots.pop(snapshot.key(), None)
 
+    async def _fetch_trust_anchor(self, trust_hash: bytes) -> LightBlock:
+        """Fetch the light block at the configured trust height and pin
+        its header hash to the operator-supplied value (reference:
+        stateprovider.go:56 — light client initialised from
+        TrustOptions)."""
+        anchor = await self._fetch_light_block(self.cfg.trust_height, set())
+        got = anchor.signed_header.header.hash()
+        if got != trust_hash:
+            raise SyncError(
+                f"trust anchor mismatch at height {self.cfg.trust_height}: "
+                f"header hash {got.hex()[:16]} != configured "
+                f"{trust_hash.hex()[:16]}"
+            )
+        # the anchor must be within the trust (unbonding) period, or
+        # validators who have since unbonded could sign a fabricated
+        # chain risk-free (reference: light/verifier.go HeaderExpired)
+        age_s = (time.time_ns() - anchor.signed_header.header.time_ns) / 1e9
+        if age_s > self.cfg.trust_period:
+            raise SyncError(
+                f"trust anchor at height {self.cfg.trust_height} is "
+                f"{age_s:.0f}s old, beyond the trust period "
+                f"{self.cfg.trust_period:.0f}s"
+            )
+        return anchor
+
     def _best_snapshot(self) -> Optional[_Snapshot]:
         """Highest height, then most peers (reference: snapshots.go
         snapshotPool.Best ranking)."""
         candidates = [
             s for s in self._snapshots.values()
             if s.peers and s.key() not in self._rejected
+            # can't anchor trust for snapshots below the trust height
+            and s.height >= self.cfg.trust_height
         ]
         if not candidates:
             return None
         return max(candidates, key=lambda s: (s.height, len(s.peers)))
 
-    async def _sync_snapshot(self, snapshot: _Snapshot) -> State:
+    async def _sync_snapshot(
+        self, snapshot: _Snapshot, anchor: LightBlock
+    ) -> State:
         """reference: syncer.go Sync :263-460."""
         h = snapshot.height
         self.logger.info(
@@ -336,6 +395,28 @@ class StatesyncReactor(Service):
         lb_h = await self._fetch_light_block(h, snapshot.peers)
         lb_h1 = await self._fetch_light_block(h + 1, snapshot.peers)
         lb_h2 = await self._fetch_light_block(h + 2, snapshot.peers)
+
+        # anchor: the snapshot-height commit must carry >=1/3 of the
+        # operator-trusted validator set's power (VerifyNonAdjacent's
+        # trusting half, light/verifier.go:106). Adjacent to the anchor
+        # the check degenerates to exact next-validators chaining.
+        self._verify_against_anchor(anchor, lb_h)
+
+        # cross-height linkage: headers must chain by hash and by
+        # next-validators-hash (reference: VerifyAdjacent,
+        # light/verifier.go:33)
+        for older, newer in ((lb_h, lb_h1), (lb_h1, lb_h2)):
+            oh, nh = older.signed_header.header, newer.signed_header.header
+            if nh.last_block_id.hash != oh.hash():
+                raise SyncError(
+                    f"light block at {nh.height} does not link to header "
+                    f"at {oh.height}"
+                )
+            if oh.next_validators_hash != newer.validator_set.hash():
+                raise SyncError(
+                    f"validator set at {nh.height} does not match "
+                    f"next_validators_hash at {oh.height}"
+                )
         app_hash = lb_h1.signed_header.header.app_hash
 
         # 2. offer to the app
@@ -389,6 +470,41 @@ class StatesyncReactor(Service):
         )
         self.logger.info("snapshot restored", height=h)
         return state
+
+    def _verify_against_anchor(
+        self, anchor: LightBlock, lb: LightBlock
+    ) -> None:
+        """One-hop skipping verification from the trust anchor
+        (reference: light/verifier.go VerifyNonAdjacent :106 — the full
+        bisection lives in the light client package)."""
+        target = lb.signed_header.header.height
+        anchor_h = anchor.signed_header.header.height
+        if target == anchor_h:
+            if lb.signed_header.header.hash() != anchor.signed_header.header.hash():
+                raise SyncError("snapshot light block contradicts trust anchor")
+            return
+        if target == anchor_h + 1:
+            if (
+                anchor.signed_header.header.next_validators_hash
+                != lb.validator_set.hash()
+            ):
+                raise SyncError(
+                    "adjacent snapshot validator set does not match the "
+                    "anchor's next_validators_hash"
+                )
+            return
+        try:
+            verify_commit_light_trusting(
+                self.chain_id,
+                anchor.validator_set,
+                lb.signed_header.commit,
+                Fraction(1, 3),
+            )
+        except Exception as e:
+            raise SyncError(
+                f"snapshot height {target} not verifiable from trust "
+                f"anchor at {anchor_h}: {e}"
+            ) from e
 
     async def _fetch_chunks(self, snapshot: _Snapshot) -> Dict[int, bytes]:
         """Parallel chunk fetch with per-chunk retry over providers

@@ -223,6 +223,31 @@ def test_peer_manager_evicts_on_error():
     run(go())
 
 
+def test_peer_manager_dial_accept_crossover():
+    """dialed() must raise for an already-connected peer, and a failed
+    dial must not clobber the live inbound connection's state
+    (reference: peermanager.go:569)."""
+
+    async def go():
+        pm = PeerManager("aa" * 20)
+        nid = "bb" * 20
+        pm.add(f"{nid}@h:1")
+        node_id, _, _ = await pm.dial_next()
+        # crossover: the same peer dialed us and the inbound handshake
+        # completed first
+        pm.accepted(nid)
+        pm.ready(nid)
+        with pytest.raises(ValueError):
+            pm.dialed(node_id)
+        # the router closes the dial conn and reports dial_failed; the
+        # live inbound connection must remain up
+        pm.dial_failed(node_id)
+        assert pm.num_connected() == 1
+        assert pm.peers() == [nid]
+
+    run(go())
+
+
 def test_peer_manager_address_book_persists():
     from tendermint_tpu.store.kv import MemKV
 

@@ -4,6 +4,7 @@ consensus (reference model: internal/statesync/syncer_test.go,
 reactor_test.go)."""
 
 import asyncio
+import time
 
 from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
 from tendermint_tpu.p2p.p2ptest import TestNetwork
@@ -35,12 +36,44 @@ def test_statesync_codec_roundtrip():
         assert StatesyncCodec.decode(StatesyncCodec.encode(msg)) == msg
 
 
+def test_sync_requires_trust_root():
+    """State sync must refuse to run without an operator trust anchor
+    (reference: config.go:811-895)."""
+
+    async def go():
+        from tendermint_tpu.statesync import SyncError
+        from tendermint_tpu.statesync.reactor import (
+            CHUNK_CHANNEL,
+            LIGHT_BLOCK_CHANNEL,
+            PARAMS_CHANNEL,
+            SNAPSHOT_CHANNEL,
+            StatesyncReactor,
+        )
+
+        reactor = StatesyncReactor(
+            CHAIN, None, None, None, None,
+            {
+                SNAPSHOT_CHANNEL: None, CHUNK_CHANNEL: None,
+                LIGHT_BLOCK_CHANNEL: None, PARAMS_CHANNEL: None,
+            },
+            asyncio.Queue(),
+        )
+        try:
+            await reactor.sync()
+        except SyncError as e:
+            assert "trust_height" in str(e)
+        else:
+            raise AssertionError("sync() succeeded without a trust root")
+
+    run(go())
+
+
 def test_fresh_node_state_syncs_then_follows():
     async def go():
         privs = [PrivKeyEd25519.from_seed(bytes([i + 100]) * 32) for i in range(4)]
         genesis = GenesisDoc(
             chain_id=CHAIN,
-            genesis_time_ns=1_700_000_000_000_000_000,
+            genesis_time_ns=time.time_ns(),
             validators=[
                 GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
             ],
@@ -71,6 +104,11 @@ def test_fresh_node_state_syncs_then_follows():
             )
 
             await fresh.start()
+            # operator supplies the trust root out-of-band
+            fresh.ss_reactor.cfg.trust_height = 1
+            fresh.ss_reactor.cfg.trust_hash = (
+                validators[0].block_store.load_block_meta(1).header.hash().hex()
+            )
             state = await asyncio.wait_for(fresh.ss_reactor.sync(), 60.0)
             assert state.last_block_height == snap_height
             # the app was restored without replaying blocks
@@ -111,7 +149,7 @@ def test_backfill_stores_prior_headers():
         privs = [PrivKeyEd25519.from_seed(bytes([i + 100]) * 32) for i in range(4)]
         genesis = GenesisDoc(
             chain_id=CHAIN,
-            genesis_time_ns=1_700_000_000_000_000_000,
+            genesis_time_ns=time.time_ns(),
             validators=[
                 GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
             ],
@@ -137,6 +175,10 @@ def test_backfill_stores_prior_headers():
                 )
             )
             await fresh.start()
+            fresh.ss_reactor.cfg.trust_height = 1
+            fresh.ss_reactor.cfg.trust_hash = (
+                validators[0].block_store.load_block_meta(1).header.hash().hex()
+            )
             state = await asyncio.wait_for(fresh.ss_reactor.sync(), 60.0)
             stored = await asyncio.wait_for(
                 fresh.ss_reactor.backfill(state), 60.0
