@@ -17,7 +17,13 @@ from typing import List, Optional, Tuple
 from .batch import register_device_factory
 from .keys import BatchVerifier, PubKey
 
-__all__ = ["TpuEd25519BatchVerifier", "install", "DEFAULT_MIN_BATCH"]
+__all__ = [
+    "TpuEd25519BatchVerifier",
+    "install",
+    "installed",
+    "stats",
+    "DEFAULT_MIN_BATCH",
+]
 
 # Below this many signatures the fixed dispatch cost (host packing +
 # device roundtrip, ~100s of µs) exceeds CPU verify time; let CPU win.
@@ -61,6 +67,8 @@ class TpuEd25519BatchVerifier(BatchVerifier):
             bitmap = self._kernel.batch_verify_host(
                 self._pks, self._msgs, self._sigs
             )
+        _STATS["batches"] += 1
+        _STATS["sigs"] += len(self._pks)
         bits = [bool(b) for b in bitmap]
         return all(bits), bits
 
@@ -70,6 +78,22 @@ class TpuEd25519BatchVerifier(BatchVerifier):
 
 _SHARED_VERIFIER = None
 _MIN_BATCH = DEFAULT_MIN_BATCH
+_INSTALLED = False
+_STATS = {"batches": 0, "sigs": 0}
+
+
+def installed() -> Optional[int]:
+    """The currently-installed min_batch threshold, or None if the
+    device factory has never been registered. Install state is
+    process-global (one device runtime per process); multi-node
+    embedders share whichever install ran last."""
+    return _MIN_BATCH if _INSTALLED else None
+
+
+def stats() -> dict:
+    """Device-path usage counters — lets the node (and tests) assert the
+    batch path actually runs on device in the served configuration."""
+    return dict(_STATS)
 
 
 def _factory(size_hint: int) -> Optional[BatchVerifier]:
@@ -83,8 +107,9 @@ def install(
 ) -> None:
     """Register the device factory. With a mesh, batches are sharded
     across it (tendermint_tpu.parallel.sharding); otherwise single-chip."""
-    global _SHARED_VERIFIER, _MIN_BATCH
+    global _SHARED_VERIFIER, _MIN_BATCH, _INSTALLED
     _MIN_BATCH = min_batch
+    _INSTALLED = True
     if mesh is not None:
         from ..parallel.sharding import ShardedEd25519Verifier
 

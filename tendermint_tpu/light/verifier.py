@@ -1,0 +1,243 @@
+"""Core light-client verification — the batch-verify showcase.
+
+reference: light/verifier.go (VerifyNonAdjacent :33, VerifyAdjacent
+:106, Verify :158, verifyNewHeaderAndVals :174, HeaderExpired :214,
+VerifyBackwards :228; DefaultTrustLevel :16).
+
+Both verification modes bottom out in the commit-verification family
+(types/validation.py), which dispatches whole commits through the
+device BatchVerifier when installed — a 10k-header sync is 10-20k
+batched device verifies (BASELINE config 4).
+"""
+
+from __future__ import annotations
+
+from ..types.light import SignedHeader
+from ..types.validation import (
+    Fraction,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from ..types.validator import ValidatorSet
+from .errors import (
+    InvalidHeaderError,
+    NewValSetCantBeTrustedError,
+    OldHeaderExpiredError,
+    VerificationError,
+)
+
+__all__ = [
+    "DEFAULT_TRUST_LEVEL",
+    "MAX_CLOCK_DRIFT_NS",
+    "verify",
+    "verify_adjacent",
+    "verify_non_adjacent",
+    "verify_backwards",
+    "header_expired",
+]
+
+# reference: light/verifier.go:16
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+# reference: light/client.go defaultMaxClockDrift (10 s)
+MAX_CLOCK_DRIFT_NS = 10 * 1_000_000_000
+
+
+def header_expired(
+    h: SignedHeader, trusting_period_ns: int, now_ns: int
+) -> bool:
+    """reference: light/verifier.go:214-222."""
+    expiration = h.header.time_ns + trusting_period_ns
+    return now_ns > expiration
+
+
+def _validate_trust_level(lvl: Fraction) -> None:
+    """Must be in [1/3, 1] (reference: light/verifier.go:251-259)."""
+    if (
+        lvl.numerator * 3 < lvl.denominator
+        or lvl.numerator > lvl.denominator
+        or lvl.denominator == 0
+    ):
+        raise ValueError(f"trust level must be within [1/3, 1], got {lvl}")
+
+
+def _verify_new_header_and_vals(
+    chain_id: str,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusted_header: SignedHeader,
+    now_ns: int,
+    max_clock_drift_ns: int,
+) -> None:
+    """reference: light/verifier.go:174-212."""
+    try:
+        untrusted_header.validate_basic(chain_id)
+    except ValueError as e:
+        raise InvalidHeaderError(f"untrusted header invalid: {e}") from e
+    if untrusted_header.header.height <= trusted_header.header.height:
+        raise InvalidHeaderError(
+            f"expected new header height {untrusted_header.header.height} "
+            f"to be greater than trusted {trusted_header.header.height}"
+        )
+    if untrusted_header.header.time_ns <= trusted_header.header.time_ns:
+        raise InvalidHeaderError(
+            "expected new header time after trusted header time"
+        )
+    if untrusted_header.header.time_ns >= now_ns + max_clock_drift_ns:
+        raise InvalidHeaderError(
+            "new header time is from the future (beyond clock drift)"
+        )
+    if (
+        untrusted_header.header.validators_hash
+        != untrusted_vals.hash()
+    ):
+        raise InvalidHeaderError(
+            "validator set does not match header validators_hash"
+        )
+
+
+def verify_non_adjacent(
+    chain_id: str,
+    trusted_header: SignedHeader,
+    trusted_next_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now_ns: int,
+    max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+) -> None:
+    """Skipping verification: trust-level of the *trusted* set must have
+    signed the new header, plus 2/3 of the new header's own set
+    (reference: light/verifier.go:33-104).
+
+    Raises NewValSetCantBeTrustedError when the trusting check fails —
+    the signal to bisect."""
+    if untrusted_header.header.height == trusted_header.header.height + 1:
+        raise ValueError("headers must be non-adjacent in height")
+    _validate_trust_level(trust_level)
+    if header_expired(trusted_header, trusting_period_ns, now_ns):
+        raise OldHeaderExpiredError(
+            trusted_header.header.time_ns + trusting_period_ns, now_ns
+        )
+    _verify_new_header_and_vals(
+        chain_id, untrusted_header, untrusted_vals, trusted_header,
+        now_ns, max_clock_drift_ns,
+    )
+    # trust-level of the set we trust signed it (batch device verify)
+    try:
+        verify_commit_light_trusting(
+            chain_id,
+            trusted_next_vals,
+            untrusted_header.commit,
+            trust_level,
+        )
+    except Exception as e:
+        raise NewValSetCantBeTrustedError(str(e)) from e
+    # 2/3 of its own claimed set signed it (batch device verify)
+    try:
+        verify_commit_light(
+            chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.header.height,
+            untrusted_header.commit,
+        )
+    except Exception as e:
+        raise InvalidHeaderError(str(e)) from e
+
+
+def verify_adjacent(
+    chain_id: str,
+    trusted_header: SignedHeader,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now_ns: int,
+    max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS,
+) -> None:
+    """Sequential verification: the new validator set is pinned by the
+    trusted header's next_validators_hash
+    (reference: light/verifier.go:106-156)."""
+    if untrusted_header.header.height != trusted_header.header.height + 1:
+        raise ValueError("headers must be adjacent in height")
+    if header_expired(trusted_header, trusting_period_ns, now_ns):
+        raise OldHeaderExpiredError(
+            trusted_header.header.time_ns + trusting_period_ns, now_ns
+        )
+    _verify_new_header_and_vals(
+        chain_id, untrusted_header, untrusted_vals, trusted_header,
+        now_ns, max_clock_drift_ns,
+    )
+    if (
+        untrusted_header.header.validators_hash
+        != trusted_header.header.next_validators_hash
+    ):
+        raise InvalidHeaderError(
+            "header validators_hash does not match trusted header "
+            "next_validators_hash"
+        )
+    try:
+        verify_commit_light(
+            chain_id,
+            untrusted_vals,
+            untrusted_header.commit.block_id,
+            untrusted_header.header.height,
+            untrusted_header.commit,
+        )
+    except Exception as e:
+        raise InvalidHeaderError(str(e)) from e
+
+
+def verify(
+    chain_id: str,
+    trusted_header: SignedHeader,
+    trusted_next_vals: ValidatorSet,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now_ns: int,
+    max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+) -> None:
+    """Dispatch adjacent/non-adjacent (reference: light/verifier.go:158)."""
+    if untrusted_header.header.height != trusted_header.header.height + 1:
+        verify_non_adjacent(
+            chain_id, trusted_header, trusted_next_vals,
+            untrusted_header, untrusted_vals,
+            trusting_period_ns, now_ns, max_clock_drift_ns, trust_level,
+        )
+    else:
+        verify_adjacent(
+            chain_id, trusted_header, untrusted_header, untrusted_vals,
+            trusting_period_ns, now_ns, max_clock_drift_ns,
+        )
+
+
+def verify_backwards(
+    chain_id: str,
+    untrusted_header: SignedHeader,
+    trusted_header: SignedHeader,
+) -> None:
+    """Verify an OLDER header against a trusted newer one by hash
+    chaining (reference: light/verifier.go:228-249). No signature check:
+    the hash linkage is the proof."""
+    try:
+        untrusted_header.validate_basic(chain_id)
+    except ValueError as e:
+        raise InvalidHeaderError(str(e)) from e
+    if untrusted_header.header.height >= trusted_header.header.height:
+        raise InvalidHeaderError(
+            "untrusted header must have a smaller height"
+        )
+    if untrusted_header.header.time_ns >= trusted_header.header.time_ns:
+        raise InvalidHeaderError(
+            "untrusted header must have an earlier time"
+        )
+    if (
+        trusted_header.header.last_block_id.hash
+        != untrusted_header.header.hash()
+    ):
+        raise VerificationError(
+            f"trusted header last_block_id does not match untrusted "
+            f"header hash at height {untrusted_header.header.height}"
+        )

@@ -1,1 +1,6 @@
+"""Node assembly (reference: node/ — makeNode, OnStart)."""
 
+from .key import NodeKey
+from .node import Node, make_node
+
+__all__ = ["Node", "NodeKey", "make_node"]

@@ -1,0 +1,423 @@
+"""Node assembly — compose every subsystem into a runnable node.
+
+reference: node/node.go:116-412 (makeNode), node/setup.go (initDBs,
+createPeerManager, createRouter, create*Reactor), node/public.go (New).
+
+Wiring order mirrors the reference: DBs → stores → genesis → device
+verifier install → proxy app → event bus + indexer → privval → ABCI
+handshake → peer manager / router → mempool/evidence/consensus/
+blocksync/statesync reactors → start. The TPU-backed BatchVerifier is
+installed from config *before* any verification path runs, so the
+served path (consensus LastCommit checks, blocksync VerifyCommitLight,
+statesync light-block verification) all dispatch through the device
+seam (reference plugin boundary: crypto/crypto.go:53-61).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+from ..abci.client import local_creator, socket_creator
+from ..abci.kvstore import KVStoreApplication
+from ..abci.proxy import AppConns
+from ..config import (
+    MODE_SEED,
+    MODE_VALIDATOR,
+    Config,
+)
+from ..consensus import ConsensusState
+from ..consensus.reactor import (
+    ConsensusReactor,
+    consensus_channel_descriptors,
+)
+from ..consensus.replay import Handshaker
+from ..consensus.wal import WAL
+from ..crypto import tpu_verifier
+from ..eventbus import EventBus
+from ..evidence import (
+    EvidencePool,
+    EvidenceReactor,
+    evidence_channel_descriptor,
+)
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..mempool import TxMempool
+from ..mempool.reactor import MempoolReactor, mempool_channel_descriptor
+from ..p2p.peermanager import PeerManager, PeerManagerOptions
+from ..p2p.router import Router, RouterOptions
+from ..p2p.transport import TCPTransport, Transport
+from ..p2p.types import NodeInfo
+from ..privval import FilePV
+from ..state import StateStore, state_from_genesis
+from ..state.execution import BlockExecutor
+from ..state.indexer import IndexerService, KVSink, NullSink
+from ..store.block_store import BlockStore
+from ..store.kv import open_db
+from ..types.genesis import GenesisDoc
+from .key import NodeKey
+
+__all__ = ["Node", "make_node"]
+
+
+class Node(Service):
+    """A full node (validator or not), assembled from a Config.
+
+    reference: node/node.go nodeImpl. Construction (make_node) is
+    synchronous and cheap; everything with I/O ordering constraints
+    (proxy start, ABCI handshake, reactor startup, sync orchestration)
+    happens in on_start.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        genesis: GenesisDoc,
+        app=None,
+        transport: Optional[Transport] = None,
+    ) -> None:
+        super().__init__(name="node", logger=get_logger("node"))
+        if cfg.base.mode == MODE_SEED:
+            raise NotImplementedError(
+                "seed mode requires the PEX reactor"
+            )
+        self.cfg = cfg
+        self.genesis = genesis
+        genesis.validate_and_complete()
+
+        # -- device verifier install (the north-star seam) --
+        # Done first so every later verification dispatches through it.
+        # Install state is process-global (one device runtime per
+        # process); warn when two in-process nodes disagree on policy.
+        if cfg.tpu.enable:
+            prior = tpu_verifier.installed()
+            if prior is not None and prior != cfg.tpu.min_batch_size:
+                self.logger.info(
+                    "tpu verifier already installed with a different "
+                    "min_batch; overriding process-wide",
+                    prior=prior, new=cfg.tpu.min_batch_size,
+                )
+            tpu_verifier.install(min_batch=cfg.tpu.min_batch_size)
+        elif tpu_verifier.installed() is not None:
+            self.logger.info(
+                "tpu.enable=false but the device verifier is already "
+                "installed process-wide by another node; it stays active"
+            )
+
+        # -- DBs + stores (reference: node/setup.go initDBs) --
+        backend = cfg.base.db_backend
+        db_dir = cfg.base.path(cfg.base.db_dir)
+        self._dbs = []
+
+        def _db(name: str):
+            db = open_db(name, backend, db_dir)
+            self._dbs.append(db)
+            return db
+
+        self.block_store = BlockStore(_db("blockstore"))
+        self.state_store = StateStore(_db("state"))
+        self._evidence_db = _db("evidence")
+
+        # -- proxy app (reference: internal/proxy) --
+        if cfg.base.abci == "builtin":
+            self._app = app if app is not None else KVStoreApplication()
+            creator = local_creator(self._app)
+        elif cfg.base.abci == "socket":
+            self._app = None
+            creator = socket_creator(cfg.base.proxy_app, must_connect=True)
+        else:
+            raise ValueError(f"unknown abci mode {cfg.base.abci!r}")
+        self.proxy = AppConns(creator)
+
+        # -- event bus + indexer --
+        self.event_bus = EventBus()
+        sinks = []
+        for kind in cfg.tx_index.indexer:
+            if kind == "kv":
+                sinks.append(KVSink(_db("tx_index")))
+            elif kind == "null":
+                sinks.append(NullSink())
+            else:
+                raise ValueError(f"unknown indexer {kind!r}")
+        self.indexer = IndexerService(sinks or [NullSink()], self.event_bus)
+
+        # -- privval (reference: node/setup.go createPrivval) --
+        self.privval = None
+        if cfg.base.mode == MODE_VALIDATOR:
+            self.privval = FilePV.load_or_generate(
+                cfg.base.path(cfg.priv_validator.key_file),
+                cfg.base.path(cfg.priv_validator.state_file),
+            )
+
+        # -- state --
+        state = self.state_store.load()
+        if state is None:
+            state = state_from_genesis(genesis)
+            self.state_store.save(state)
+        self.initial_state = state
+
+        # -- p2p (reference: node/setup.go createPeerManager/createRouter) --
+        self.node_key = NodeKey.load_or_generate(
+            cfg.base.path(cfg.base.node_key_file)
+        )
+        listen = cfg.p2p.laddr.replace("tcp://", "")
+        advertise = (
+            cfg.p2p.external_address.replace("tcp://", "")
+            if cfg.p2p.external_address
+            else listen
+        )
+        self.node_info = NodeInfo(
+            node_id=self.node_key.node_id,
+            listen_addr=advertise,
+            network=genesis.chain_id,
+            moniker=cfg.base.moniker,
+        )
+        persistent = [
+            p.strip()
+            for p in cfg.p2p.persistent_peers.split(",")
+            if p.strip()
+        ]
+        self.peer_manager = PeerManager(
+            self.node_key.node_id,
+            PeerManagerOptions(
+                persistent_peers=persistent,
+                max_connected=cfg.p2p.max_connections,
+            ),
+            store=_db("peerstore"),
+        )
+        for addr in (
+            a.strip() for a in cfg.p2p.bootstrap_peers.split(",")
+        ):
+            if addr:
+                self.peer_manager.add(addr)
+        self.transport = transport if transport is not None else TCPTransport()
+        self.router = Router(
+            self.node_info,
+            self.node_key.priv_key,
+            self.peer_manager,
+            self.transport,
+            listen_addr=listen,
+            options=RouterOptions(
+                handshake_timeout=cfg.p2p.handshake_timeout,
+                dial_timeout=cfg.p2p.dial_timeout,
+            ),
+        )
+
+        # reactors are built in on_start, after the ABCI handshake
+        self.mempool: Optional[TxMempool] = None
+        self.evidence_pool: Optional[EvidencePool] = None
+        self.block_exec: Optional[BlockExecutor] = None
+        self.consensus: Optional[ConsensusState] = None
+        self.consensus_reactor: Optional[ConsensusReactor] = None
+        self.mempool_reactor: Optional[MempoolReactor] = None
+        self.evidence_reactor: Optional[EvidenceReactor] = None
+        self.blocksync_reactor = None
+        self.statesync_reactor = None
+        self.genesis_state_synced = False
+
+    # ------------------------------------------------------------------
+
+    async def on_start(self) -> None:
+        """reference: node/node.go OnStart :415-470. A failure partway
+        through tears down whatever already started — Service.stop()
+        won't call on_stop after a failed start."""
+        try:
+            await self._start_impl()
+        except BaseException:
+            await self._teardown()
+            raise
+
+    async def _start_impl(self) -> None:
+        cfg = self.cfg
+        await self.proxy.start()
+        await self.event_bus.start()
+        await self.indexer.start()
+
+        # ABCI handshake: replay stored blocks into the app until app,
+        # store, and state agree (reference: replay.go:240)
+        handshaker = Handshaker(
+            self.state_store,
+            self.initial_state,
+            self.block_store,
+            self.genesis,
+            event_bus=self.event_bus,
+        )
+        await handshaker.handshake(self.proxy.consensus)
+        state = self.state_store.load()
+        assert state is not None
+
+        # -- build reactors against the post-handshake state --
+        self.mempool = TxMempool(
+            self.proxy.mempool, cfg.mempool, height=state.last_block_height
+        )
+        self.evidence_pool = EvidencePool(
+            self._evidence_db, self.state_store, self.block_store
+        )
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            self.proxy.consensus,
+            self.mempool,
+            evidence_pool=self.evidence_pool,
+            block_store=self.block_store,
+            event_bus=self.event_bus,
+        )
+        wal = WAL(cfg.base.path(cfg.consensus.wal_file))
+        self.consensus = ConsensusState(
+            cfg.consensus,
+            state,
+            self.block_exec,
+            self.block_store,
+            privval=self.privval,
+            event_bus=self.event_bus,
+            wal=wal,
+            evidence_pool=self.evidence_pool,
+        )
+
+        # sync orchestration flags (reference: node/node.go:230
+        # onlyValidatorIsUs skips block sync entirely)
+        state_sync = cfg.statesync.enable and state.last_block_height == 0
+        block_sync = cfg.blocksync.enable and not self._only_validator_is_us(
+            state
+        )
+        wait_sync = state_sync or block_sync
+
+        cs_channels = {
+            cid: self.router.open_channel(d)
+            for cid, d in consensus_channel_descriptors().items()
+        }
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus,
+            cs_channels,
+            self.peer_manager.subscribe(),
+            self.event_bus,
+            cfg=cfg.consensus,
+            wait_sync=wait_sync,
+        )
+        self.mempool_reactor = MempoolReactor(
+            self.mempool,
+            self.router.open_channel(mempool_channel_descriptor()),
+            self.peer_manager.subscribe(),
+        )
+        self.evidence_reactor = EvidenceReactor(
+            self.evidence_pool,
+            self.router.open_channel(evidence_channel_descriptor()),
+            self.peer_manager.subscribe(),
+        )
+        from ..blocksync import BlocksyncReactor, blocksync_channel_descriptor
+
+        self.blocksync_reactor = BlocksyncReactor(
+            state,
+            self.block_exec,
+            self.block_store,
+            self.router.open_channel(blocksync_channel_descriptor()),
+            self.peer_manager.subscribe(),
+            block_sync=block_sync and not state_sync,
+            consensus_reactor=self.consensus_reactor,
+            event_bus=self.event_bus,
+        )
+        from ..statesync import StatesyncReactor, statesync_channel_descriptors
+
+        self.statesync_reactor = StatesyncReactor(
+            self.genesis.chain_id,
+            state,
+            self.proxy.snapshot,
+            self.state_store,
+            self.block_store,
+            {
+                cid: self.router.open_channel(d)
+                for cid, d in statesync_channel_descriptors().items()
+            },
+            self.peer_manager.subscribe(),
+            cfg=cfg.statesync,
+        )
+
+        # -- start everything (channels are registered; safe to listen) --
+        await self.router.start()
+        await self.consensus_reactor.start()
+        await self.mempool_reactor.start()
+        await self.evidence_reactor.start()
+        await self.blocksync_reactor.start()
+        await self.statesync_reactor.start()
+
+        if state_sync:
+            self.spawn(self._state_sync_then_follow(), "state-sync")
+
+        self.logger.info(
+            "node started",
+            node_id=self.node_key.node_id,
+            chain_id=self.genesis.chain_id,
+            mode=cfg.base.mode,
+            tpu="installed" if cfg.tpu.enable else "disabled",
+        )
+
+    async def _state_sync_then_follow(self) -> None:
+        """statesync → blocksync → consensus (reference:
+        node/node.go:592 startStateSync → SwitchToBlockSync)."""
+        try:
+            state = await self.statesync_reactor.sync()
+            await self.statesync_reactor.backfill(state)
+            self.genesis_state_synced = True
+            await self.blocksync_reactor.start_sync(state)
+        except Exception as e:
+            self.logger.error("state sync failed", err=str(e))
+            raise
+
+    def _only_validator_is_us(self, state) -> bool:
+        """reference: node/node.go:230 onlyValidatorIsUs."""
+        if self.privval is None:
+            return False
+        if state.validators.size() != 1:
+            return False
+        addr = state.validators.validators[0].address
+        return addr == self.privval.key.address
+
+    async def on_stop(self) -> None:
+        """reference: node/node.go OnStop — reverse start order."""
+        await self._teardown()
+
+    async def _teardown(self) -> None:
+        for svc in (
+            self.statesync_reactor,
+            self.blocksync_reactor,
+            self.evidence_reactor,
+            self.mempool_reactor,
+            self.consensus_reactor,
+            self.router,
+            self.indexer,
+            self.event_bus,
+            self.proxy,
+        ):
+            if svc is not None and svc.is_running:
+                try:
+                    await svc.stop()
+                except Exception as e:
+                    self.logger.error(
+                        "error stopping service", svc=svc.name, err=str(e)
+                    )
+        self.peer_manager.flush()
+        for db in self._dbs:
+            try:
+                db.close()
+            except Exception as e:
+                self.logger.error("error closing db", err=str(e))
+        self._dbs = []
+
+
+def make_node(
+    cfg: Config,
+    app=None,
+    genesis: Optional[GenesisDoc] = None,
+    transport: Optional[Transport] = None,
+) -> Node:
+    """Build a Node from config files on disk (reference:
+    node/node.go:116 makeNode + node/public.go New).
+
+    `app` overrides the builtin application (defaults to kvstore);
+    `genesis`/`transport` overrides support tests and in-process
+    harnesses.
+    """
+    cfg.ensure_dirs()
+    if genesis is None:
+        genesis = GenesisDoc.from_file(cfg.base.path(cfg.base.genesis_file))
+    return Node(cfg, genesis, app=app, transport=transport)

@@ -24,6 +24,7 @@ from ..crypto.keys import (
     pubkey_from_type_and_bytes,
 )
 from ..encoding.proto import ProtoWriter, iter_fields
+from ..libs.osutil import atomic_write
 from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
 from ..types.proposal import Proposal
 from ..types.vote import Vote
@@ -46,33 +47,10 @@ def vote_to_step(vote: Vote) -> int:
     raise ValueError(f"unknown vote type: {vote.type}")
 
 
-def _atomic_write(path: str, data: str, mode: int = 0o600) -> None:
-    """Write-fsync-rename-fsync(dir) so the file is never torn and the
-    rename is crash-durable (reference: internal/libs/tempfile/tempfile.go
-    WriteFileAtomic; key/state files are 0600 like privval/file.go).
-
-    Deliberately synchronous: a signature must never escape before its
-    HRS checkpoint is on disk, and the consensus core serializes signing,
-    so the fsync happens at most once per own-vote — same policy as the
-    reference's WriteSync on the WAL.
-    """
-    tmp = path + ".tmp"
-    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
-    try:
-        with os.fdopen(fd, "w") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        dir_fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dir_fd)
-        finally:
-            os.close(dir_fd)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+# A signature must never escape before its HRS checkpoint is on disk;
+# the consensus core serializes signing, so the fsync happens at most
+# once per own-vote — same policy as the reference's WAL WriteSync.
+_atomic_write = atomic_write
 
 
 def _strip_timestamp(sign_bytes: bytes, ts_field: int) -> bytes:
