@@ -1,1 +1,49 @@
+"""Light client (reference: light/ — client, verifier, detector,
+providers, trusted store)."""
 
+from .client import Client, TrustOptions
+from .errors import (
+    DivergenceError,
+    InvalidHeaderError,
+    LightBlockNotFoundError,
+    LightClientError,
+    NewValSetCantBeTrustedError,
+    NoWitnessesError,
+    OldHeaderExpiredError,
+    VerificationError,
+)
+from .provider import LocalProvider, P2PProvider, Provider
+from .store import LightStore
+from .verifier import (
+    DEFAULT_TRUST_LEVEL,
+    MAX_CLOCK_DRIFT_NS,
+    header_expired,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "Client",
+    "TrustOptions",
+    "Provider",
+    "LocalProvider",
+    "P2PProvider",
+    "LightStore",
+    "DEFAULT_TRUST_LEVEL",
+    "MAX_CLOCK_DRIFT_NS",
+    "verify",
+    "verify_adjacent",
+    "verify_non_adjacent",
+    "verify_backwards",
+    "header_expired",
+    "LightClientError",
+    "OldHeaderExpiredError",
+    "NewValSetCantBeTrustedError",
+    "InvalidHeaderError",
+    "VerificationError",
+    "LightBlockNotFoundError",
+    "NoWitnessesError",
+    "DivergenceError",
+]

@@ -6,13 +6,11 @@ ApplySnapshotChunk → verifyApp), stateprovider.go (trusted state via
 light blocks over the LightBlock channel), chunks.go, snapshots.go.
 
 Trust model: state sync requires an operator-supplied trust root
-(``trust_height`` + ``trust_hash``, reference config.go:811-895). The
-syncer fetches the light block at the trust height, checks its header
-hash against the configured hash, and then requires every snapshot
-light block to carry >=1/3 of the anchored validator set's power
-(``verify_commit_light_trusting``) in addition to 2/3 of its own
-claimed set — the same two checks light.VerifyNonAdjacent performs
-(reference light/verifier.go:106). Consecutive fetched headers are
+(``trust_height`` + ``trust_hash``, reference config.go:811-895) and
+verifies snapshot light blocks through an embedded light client
+(sequential/skipping bisection from the pinned root, reference
+stateprovider.go:33-51) whose providers fetch over the LightBlock
+channel from the snapshot peers. Consecutive fetched headers are
 additionally checked for hash linkage and next-validators-hash
 chaining.
 """
@@ -21,7 +19,6 @@ from __future__ import annotations
 
 import asyncio
 import random
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -36,11 +33,8 @@ from ..state.types import State
 from ..types.block_id import BlockID
 from ..types.light import LightBlock, SignedHeader
 from ..types.params import ConsensusParams
-from ..types.validation import (
-    Fraction,
-    verify_commit_light,
-    verify_commit_light_trusting,
-)
+from ..light.errors import LightClientError
+from ..types.validation import verify_commit_light
 from .msgs import (
     ChunkRequestMessage,
     ChunkResponseMessage,
@@ -240,7 +234,7 @@ class StatesyncReactor(Service):
     async def _on_light_msg(self, envelope: Envelope) -> None:
         msg = envelope.message
         if isinstance(msg, LightBlockRequestMessage):
-            lb = self._load_light_block(msg.height)
+            lb = await self._load_light_block(msg.height)
             self.light_ch.try_send(
                 Envelope(
                     message=LightBlockResponseMessage(light_block=lb),
@@ -251,7 +245,11 @@ class StatesyncReactor(Service):
             if msg.light_block is None or msg.light_block.signed_header is None:
                 return
             h = msg.light_block.signed_header.header.height
-            fut = self._light_waiters.pop((envelope.from_peer, h), None)
+            # a tip request is keyed (peer, 0); the response carries
+            # the actual height
+            fut = self._light_waiters.pop(
+                (envelope.from_peer, h), None
+            ) or self._light_waiters.pop((envelope.from_peer, 0), None)
             if fut is not None and not fut.done():
                 fut.set_result(msg.light_block)
 
@@ -279,18 +277,18 @@ class StatesyncReactor(Service):
             if fut is not None and not fut.done():
                 fut.set_result(msg.consensus_params)
 
-    def _load_light_block(self, height: int) -> Optional[LightBlock]:
-        """reference: statesync/reactor.go handleLightBlockMessage →
-        state provider's view of a stored height."""
-        meta = self.block_store.load_block_meta(height)
-        commit = self.block_store.load_block_commit(height)
-        vals = self.state_store.load_validators(height)
-        if meta is None or commit is None or vals is None:
+    async def _load_light_block(self, height: int) -> Optional[LightBlock]:
+        """reference: statesync/reactor.go handleLightBlockMessage.
+        Serving delegates to the same LocalProvider logic the light
+        proxy uses (0 = tip, seen-commit fallback at the tip)."""
+        from ..light.errors import LightBlockNotFoundError
+        from ..light.provider import LocalProvider
+
+        provider = LocalProvider(self.block_store, self.state_store)
+        try:
+            return await provider.light_block(height)
+        except LightBlockNotFoundError:
             return None
-        return LightBlock(
-            signed_header=SignedHeader(header=meta.header, commit=commit),
-            validator_set=vals,
-        )
 
     # ------------------------------------------------------------------
     # sync side (reference: syncer.go SyncAny :159)
@@ -325,17 +323,23 @@ class StatesyncReactor(Service):
         )
         await asyncio.sleep(self.cfg.discovery_time)
 
-        anchor = await self._fetch_trust_anchor(trust_hash)
+        light_client = self._make_light_client(trust_hash)
+        # pin the trust root up front: a root failure is an operator
+        # config / provider problem, NOT a reason to reject snapshots
+        try:
+            await light_client.initialize()
+        except LightClientError as e:
+            raise SyncError(f"trust root verification failed: {e}") from e
 
         while True:
             snapshot = self._best_snapshot()
             if snapshot is None:
                 raise SyncError("no viable snapshots discovered")
             try:
-                state = await self._sync_snapshot(snapshot, anchor)
+                state = await self._sync_snapshot(snapshot, light_client)
                 self.synced_state = state
                 return state
-            except SyncError as e:
+            except (SyncError, LightClientError) as e:
                 self.logger.error(
                     "snapshot restore failed; trying next",
                     height=snapshot.height,
@@ -344,30 +348,30 @@ class StatesyncReactor(Service):
                 self._rejected.add(snapshot.key())
                 self._snapshots.pop(snapshot.key(), None)
 
-    async def _fetch_trust_anchor(self, trust_hash: bytes) -> LightBlock:
-        """Fetch the light block at the configured trust height and pin
-        its header hash to the operator-supplied value (reference:
-        stateprovider.go:56 — light client initialised from
-        TrustOptions)."""
-        anchor = await self._fetch_light_block(self.cfg.trust_height, set())
-        got = anchor.signed_header.header.hash()
-        if got != trust_hash:
-            raise SyncError(
-                f"trust anchor mismatch at height {self.cfg.trust_height}: "
-                f"header hash {got.hex()[:16]} != configured "
-                f"{trust_hash.hex()[:16]}"
-            )
-        # the anchor must be within the trust (unbonding) period, or
-        # validators who have since unbonded could sign a fabricated
-        # chain risk-free (reference: light/verifier.go HeaderExpired)
-        age_s = (time.time_ns() - anchor.signed_header.header.time_ns) / 1e9
-        if age_s > self.cfg.trust_period:
-            raise SyncError(
-                f"trust anchor at height {self.cfg.trust_height} is "
-                f"{age_s:.0f}s old, beyond the trust period "
-                f"{self.cfg.trust_period:.0f}s"
-            )
-        return anchor
+    def _make_light_client(self, trust_hash: bytes):
+        """Embedded light client over the snapshot peers (reference:
+        stateprovider.go:33-51 — trusted state via light client over
+        the LightBlock channel)."""
+        from ..light import Client, LightStore, P2PProvider, TrustOptions
+        from ..store.kv import MemKV
+
+        providers = [
+            P2PProvider(peer, self._fetch_light_block_from)
+            for peer in sorted(self.peers)
+        ]
+        if not providers:
+            raise SyncError("no peers to serve light blocks")
+        return Client(
+            self.chain_id,
+            TrustOptions(
+                period_ns=int(self.cfg.trust_period * 1e9),
+                height=self.cfg.trust_height,
+                hash=trust_hash,
+            ),
+            providers[0],
+            providers[1:],
+            LightStore(MemKV()),
+        )
 
     def _best_snapshot(self) -> Optional[_Snapshot]:
         """Highest height, then most peers (reference: snapshots.go
@@ -383,7 +387,7 @@ class StatesyncReactor(Service):
         return max(candidates, key=lambda s: (s.height, len(s.peers)))
 
     async def _sync_snapshot(
-        self, snapshot: _Snapshot, anchor: LightBlock
+        self, snapshot: _Snapshot, light_client
     ) -> State:
         """reference: syncer.go Sync :263-460."""
         h = snapshot.height
@@ -391,20 +395,16 @@ class StatesyncReactor(Service):
             "restoring snapshot", height=h, format=snapshot.format,
             chunks=snapshot.chunks,
         )
-        # 1. trusted state info from light blocks at h, h+1, h+2
-        lb_h = await self._fetch_light_block(h, snapshot.peers)
-        lb_h1 = await self._fetch_light_block(h + 1, snapshot.peers)
-        lb_h2 = await self._fetch_light_block(h + 2, snapshot.peers)
-
-        # anchor: the snapshot-height commit must carry >=1/3 of the
-        # operator-trusted validator set's power (VerifyNonAdjacent's
-        # trusting half, light/verifier.go:106). Adjacent to the anchor
-        # the check degenerates to exact next-validators chaining.
-        self._verify_against_anchor(anchor, lb_h)
+        # 1. trusted state info from light blocks at h, h+1, h+2 —
+        # each verified from the operator trust root by the embedded
+        # light client (bisection through validator churn)
+        lb_h = await light_client.verify_light_block_at_height(h)
+        lb_h1 = await light_client.verify_light_block_at_height(h + 1)
+        lb_h2 = await light_client.verify_light_block_at_height(h + 2)
 
         # cross-height linkage: headers must chain by hash and by
-        # next-validators-hash (reference: VerifyAdjacent,
-        # light/verifier.go:33)
+        # next-validators-hash (defense in depth over the light
+        # client's commit checks)
         for older, newer in ((lb_h, lb_h1), (lb_h1, lb_h2)):
             oh, nh = older.signed_header.header, newer.signed_header.header
             if nh.last_block_id.hash != oh.hash():
@@ -471,40 +471,25 @@ class StatesyncReactor(Service):
         self.logger.info("snapshot restored", height=h)
         return state
 
-    def _verify_against_anchor(
-        self, anchor: LightBlock, lb: LightBlock
-    ) -> None:
-        """One-hop skipping verification from the trust anchor
-        (reference: light/verifier.go VerifyNonAdjacent :106 — the full
-        bisection lives in the light client package)."""
-        target = lb.signed_header.header.height
-        anchor_h = anchor.signed_header.header.height
-        if target == anchor_h:
-            if lb.signed_header.header.hash() != anchor.signed_header.header.hash():
-                raise SyncError("snapshot light block contradicts trust anchor")
-            return
-        if target == anchor_h + 1:
-            if (
-                anchor.signed_header.header.next_validators_hash
-                != lb.validator_set.hash()
-            ):
-                raise SyncError(
-                    "adjacent snapshot validator set does not match the "
-                    "anchor's next_validators_hash"
-                )
-            return
+    async def _fetch_light_block_from(
+        self, height: int, peer: str
+    ) -> Optional[LightBlock]:
+        """Raw per-peer fetch for the embedded light client's
+        P2PProviders; verification is the client's job. height 0 asks
+        for the peer's tip."""
+        fut = asyncio.get_event_loop().create_future()
+        self._light_waiters[(peer, height)] = fut
         try:
-            verify_commit_light_trusting(
-                self.chain_id,
-                anchor.validator_set,
-                lb.signed_header.commit,
-                Fraction(1, 3),
+            self.light_ch.try_send(
+                Envelope(
+                    message=LightBlockRequestMessage(height=height), to=peer
+                )
             )
-        except Exception as e:
-            raise SyncError(
-                f"snapshot height {target} not verifiable from trust "
-                f"anchor at {anchor_h}: {e}"
-            ) from e
+            return await asyncio.wait_for(fut, timeout=_LIGHT_BLOCK_TIMEOUT)
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            self._light_waiters.pop((peer, height), None)
 
     async def _fetch_chunks(self, snapshot: _Snapshot) -> Dict[int, bytes]:
         """Parallel chunk fetch with per-chunk retry over providers
@@ -554,19 +539,10 @@ class StatesyncReactor(Service):
     ) -> LightBlock:
         """Fetch + verify a light block from snapshot providers
         (reference: stateprovider.go P2P provider)."""
-        for peer in list(peers) + list(self.peers):
-            fut = asyncio.get_event_loop().create_future()
-            self._light_waiters[(peer, height)] = fut
-            self.light_ch.try_send(
-                Envelope(
-                    message=LightBlockRequestMessage(height=height), to=peer
-                )
-            )
-            try:
-                lb = await asyncio.wait_for(
-                    fut, timeout=_LIGHT_BLOCK_TIMEOUT
-                )
-            except asyncio.TimeoutError:
+        candidates = list(dict.fromkeys(list(peers) + list(self.peers)))
+        for peer in candidates:
+            lb = await self._fetch_light_block_from(height, peer)
+            if lb is None:
                 continue
             try:
                 self._verify_light_block(lb, height)

@@ -1,0 +1,394 @@
+"""Light client tests — sequential + skipping (bisection) verification,
+backwards verification, trust root pinning, divergence detection
+(reference model: light/client_test.go, light/verifier_test.go,
+light/detector_test.go).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.light import (
+    Client,
+    DivergenceError,
+    LightBlockNotFoundError,
+    LightClientError,
+    LightStore,
+    NewValSetCantBeTrustedError,
+    Provider,
+    TrustOptions,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+from tendermint_tpu.store.kv import MemKV
+from tendermint_tpu.types import BlockID, Commit, CommitSig, Vote
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
+from tendermint_tpu.types.header import Consensus, Header
+from tendermint_tpu.types.light import LightBlock, SignedHeader
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.validator import Validator, ValidatorSet
+
+CHAIN = "light-chain"
+HOUR_NS = 3600 * 1_000_000_000
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def val_pair(seed: int, power: int = 10):
+    pk = PrivKeyEd25519.from_seed(bytes([seed]) * 32)
+    return Validator(pub_key=pk.pub_key(), voting_power=power), pk
+
+
+def make_set(seeds, power=10):
+    pairs = [val_pair(s, power) for s in seeds]
+    vals = ValidatorSet([v for v, _ in pairs])
+    by_addr = {v.address: pk for v, pk in pairs}
+    privs = [by_addr[v.address] for v in vals.validators]
+    return vals, privs
+
+
+def build_chain(
+    n_heights,
+    seeds_at=None,
+    base_time_ns=None,
+    app_hash=b"\x07" * 32,
+    chain_id=CHAIN,
+):
+    """A verifiable chain of LightBlocks 1..n_heights.
+
+    `seeds_at(h)` returns the validator seed list at height h (controls
+    churn); default is a static 4-validator set."""
+    if seeds_at is None:
+        seeds_at = lambda h: [1, 2, 3, 4]  # noqa: E731
+    if base_time_ns is None:
+        base_time_ns = time.time_ns() - n_heights * 2_000_000_000
+    blocks = {}
+    prev_bid = BlockID()
+    for h in range(1, n_heights + 1):
+        vals, privs = make_set(seeds_at(h))
+        next_vals, _ = make_set(seeds_at(h + 1))
+        header = Header(
+            version=Consensus(block=11),
+            chain_id=chain_id,
+            height=h,
+            time_ns=base_time_ns + h * 1_000_000_000,
+            last_block_id=prev_bid,
+            validators_hash=vals.hash(),
+            next_validators_hash=next_vals.hash(),
+            app_hash=app_hash,
+            proposer_address=vals.validators[0].address,
+        )
+        bid = BlockID(
+            hash=header.hash(),
+            part_set_header=PartSetHeader(total=1, hash=b"\x22" * 32),
+        )
+        sigs = []
+        for i, v in enumerate(vals.validators):
+            vote = Vote(
+                type=PRECOMMIT_TYPE,
+                height=h,
+                round=0,
+                block_id=bid,
+                timestamp_ns=header.time_ns,
+                validator_address=v.address,
+                validator_index=i,
+            )
+            vote.signature = privs[i].sign(vote.sign_bytes(chain_id))
+            sigs.append(
+                CommitSig.for_block(
+                    vote.signature, v.address, vote.timestamp_ns
+                )
+            )
+        commit = Commit(height=h, round=0, block_id=bid, signatures=sigs)
+        blocks[h] = LightBlock(
+            signed_header=SignedHeader(header=header, commit=commit),
+            validator_set=vals,
+        )
+        prev_bid = bid
+    return blocks
+
+
+class DictProvider(Provider):
+    def __init__(self, blocks, id_="dict"):
+        self.blocks = blocks
+        self._id = id_
+        self.reported = []
+
+    def id(self):
+        return self._id
+
+    async def light_block(self, height):
+        if height == 0:
+            height = max(self.blocks)
+        if height not in self.blocks:
+            raise LightBlockNotFoundError(str(height))
+        return self.blocks[height]
+
+    async def report_evidence(self, ev):
+        self.reported.append(ev)
+
+
+def make_client(blocks, witnesses=None, sequential=False, store=None,
+                trust_height=1, period_ns=200 * HOUR_NS):
+    root = blocks[trust_height]
+    return Client(
+        CHAIN,
+        TrustOptions(
+            period_ns=period_ns,
+            height=trust_height,
+            hash=root.signed_header.hash(),
+        ),
+        DictProvider(blocks, "primary"),
+        witnesses if witnesses is not None else [],
+        store if store is not None else LightStore(MemKV()),
+        sequential=sequential,
+    )
+
+
+# ---------------------------------------------------------------------------
+# verifier unit tests
+
+
+class TestVerifier:
+    def test_adjacent_ok(self):
+        blocks = build_chain(3)
+        now = time.time_ns()
+        verify_adjacent(
+            CHAIN,
+            blocks[1].signed_header,
+            blocks[2].signed_header,
+            blocks[2].validator_set,
+            200 * HOUR_NS,
+            now,
+        )
+
+    def test_non_adjacent_ok_same_vals(self):
+        blocks = build_chain(5)
+        now = time.time_ns()
+        verify_non_adjacent(
+            CHAIN,
+            blocks[1].signed_header,
+            blocks[1].validator_set,
+            blocks[5].signed_header,
+            blocks[5].validator_set,
+            200 * HOUR_NS,
+            now,
+        )
+
+    def test_non_adjacent_full_churn_untrusted(self):
+        # validator set at height 8 shares nobody with height 1
+        def seeds(h):
+            if h >= 6:
+                return [11, 12, 13, 14]
+            return [1, 2, 3, 4]
+
+        blocks = build_chain(8, seeds_at=seeds)
+        now = time.time_ns()
+        with pytest.raises(NewValSetCantBeTrustedError):
+            verify_non_adjacent(
+                CHAIN,
+                blocks[1].signed_header,
+                blocks[1].validator_set,
+                blocks[8].signed_header,
+                blocks[8].validator_set,
+                200 * HOUR_NS,
+                now,
+            )
+
+    def test_backwards_ok_and_tampered(self):
+        blocks = build_chain(3)
+        verify_backwards(
+            CHAIN, blocks[2].signed_header, blocks[3].signed_header
+        )
+        with pytest.raises(Exception):
+            verify_backwards(
+                CHAIN, blocks[1].signed_header, blocks[3].signed_header
+            )
+
+
+# ---------------------------------------------------------------------------
+# store
+
+
+def test_light_store_roundtrip_and_prune():
+    blocks = build_chain(10)
+    store = LightStore(MemKV())
+    for lb in blocks.values():
+        store.save_light_block(lb)
+    assert store.size() == 10
+    assert store.latest_light_block().height == 10
+    assert store.first_light_block().height == 1
+    assert store.light_block_before(5).height == 4
+    store.prune(3)
+    assert store.size() == 3
+    assert store.first_light_block().height == 8
+
+
+# ---------------------------------------------------------------------------
+# client
+
+
+def test_client_sequential_sync():
+    blocks = build_chain(12)
+    client = make_client(blocks, sequential=True)
+
+    async def go():
+        lb = await client.verify_light_block_at_height(12)
+        assert lb.height == 12
+        # sequential stored every interim header
+        assert client.store.size() == 12
+
+    run(go())
+
+
+def test_client_skipping_single_hop():
+    blocks = build_chain(30)
+    client = make_client(blocks)
+
+    async def go():
+        lb = await client.verify_light_block_at_height(30)
+        assert lb.height == 30
+        # static validator set: one non-adjacent hop, no interim fetches
+        assert client.store.size() == 2
+
+    run(go())
+
+
+def test_client_skipping_bisects_through_churn():
+    # one validator replaced every 3 heights: height 13+ shares nobody
+    # with height 1, forcing pivots
+    def seeds(h):
+        base = [1, 2, 3, 4]
+        for i in range((h - 1) // 3):
+            base[i % 4] = 11 + i
+        return base
+
+    blocks = build_chain(16, seeds_at=seeds)
+    client = make_client(blocks)
+
+    async def go():
+        lb = await client.verify_light_block_at_height(16)
+        assert lb.height == 16
+        assert client.store.size() > 2  # pivots were stored
+
+    run(go())
+
+    # every stored block must be part of the real chain
+    for h in range(1, 17):
+        stored = client.store.light_block(h)
+        if stored is not None:
+            assert stored.signed_header.hash() == blocks[h].signed_header.hash()
+
+
+def test_client_backwards_verification():
+    blocks = build_chain(10)
+    client = make_client(blocks, trust_height=8)
+
+    async def go():
+        lb = await client.verify_light_block_at_height(3)
+        assert lb.height == 3
+        assert (
+            lb.signed_header.hash() == blocks[3].signed_header.hash()
+        )
+
+    run(go())
+
+
+def test_client_rejects_wrong_trust_hash():
+    blocks = build_chain(3)
+    client = Client(
+        CHAIN,
+        TrustOptions(period_ns=200 * HOUR_NS, height=1, hash=b"\x13" * 32),
+        DictProvider(blocks),
+        [],
+        LightStore(MemKV()),
+    )
+    with pytest.raises(LightClientError):
+        run(client.initialize())
+
+
+def test_client_rejects_expired_root():
+    blocks = build_chain(3, base_time_ns=time.time_ns() - 400 * HOUR_NS)
+    client = make_client(blocks, period_ns=1 * HOUR_NS)
+    with pytest.raises(LightClientError):
+        run(client.initialize())
+
+
+def test_client_primary_failover_to_witness():
+    blocks = build_chain(8)
+    empty = DictProvider({1: blocks[1]}, "flaky")
+    good = DictProvider(blocks, "witness")
+    client = Client(
+        CHAIN,
+        TrustOptions(
+            period_ns=200 * HOUR_NS,
+            height=1,
+            hash=blocks[1].signed_header.hash(),
+        ),
+        empty,
+        [good],
+        LightStore(MemKV()),
+    )
+
+    async def go():
+        lb = await client.verify_light_block_at_height(8)
+        assert lb.height == 8
+        assert client.primary.id() == "witness"
+
+    run(go())
+
+
+def test_detector_catches_forked_witness():
+    """A witness serving a *verifiable* conflicting header at the target
+    height is a light-client attack: evidence is reported and the
+    client halts (reference: light/detector_test.go)."""
+    blocks = build_chain(8)
+    fork = build_chain(8, app_hash=b"\x66" * 32)  # same vals, different state
+    # sanity: same height, different hash, both properly signed
+    assert (
+        blocks[8].signed_header.hash() != fork[8].signed_header.hash()
+    )
+    witness = DictProvider(fork, "forked-witness")
+    client = make_client(blocks, witnesses=[witness])
+
+    async def go():
+        with pytest.raises(DivergenceError) as exc_info:
+            await client.verify_light_block_at_height(8)
+        assert exc_info.value.evidence
+        assert witness.reported  # evidence went to the witness too
+
+    run(go())
+
+
+def test_detector_drops_garbage_witness():
+    blocks = build_chain(8)
+    garbage = build_chain(8, chain_id="other-chain")
+    witness = DictProvider(garbage, "garbage-witness")
+    honest = DictProvider(blocks, "honest-witness")
+    client = make_client(blocks, witnesses=[witness, honest])
+
+    async def go():
+        lb = await client.verify_light_block_at_height(8)
+        assert lb.height == 8
+        ids = [w.id() for w in client.witnesses]
+        assert "garbage-witness" not in ids
+        assert "honest-witness" in ids
+
+    run(go())
+
+
+def test_client_update_to_latest():
+    blocks = build_chain(6)
+    client = make_client(blocks)
+
+    async def go():
+        lb = await client.update()
+        assert lb.height == 6
+        assert await client.update() is None  # already latest
+
+    run(go())
