@@ -22,10 +22,17 @@ reuses a constant 16-entry niels table of B at every window — scaling by
 16^w happens for free inside the shared Horner doublings. Then add -R,
 triple-double (x8 cofactor), and test the projective identity.
 
+Layout: all device arrays are batch-minor ((NLIMBS, N) field elements,
+(4, NLIMBS, N) points — see field25519's layout note; batch-major
+stranded ~85% of the VPU lanes). Table indexing is a 16-way one-hot
+select (compare + masked accumulate), not a gather: per-lane dynamic
+gathers serialize on TPU, while the one-hot form is pure vector ALU.
+
 Scalar prep (SHA-512 of the messages, reduction mod L, nibble
-decomposition) happens on host: messages are variable-length and the hash
-is cheap relative to the curve math; moving SHA-512 on-device is the
-ops/sha512 follow-up.
+decomposition) happens on host: messages are variable-length and the
+hash is cheap relative to the curve math. Everything except the SHA-512
+calls themselves is vectorized numpy (Barrett reduction mod L on 16-bit
+limbs); moving SHA-512 on-device is the ops/sha512 follow-up.
 
 Shapes are bucketed (pad to the next configured bucket) so XLA compiles a
 handful of programs once and reuses them for every Commit size.
@@ -48,7 +55,7 @@ from . import field25519 as F
 
 __all__ = ["Ed25519Verifier", "batch_verify_host"]
 
-_TB0 = None  # lazy (16, 4, NLIMBS) fixed-base niels table (host numpy;
+_TB0 = None  # lazy (16, 4, NLIMBS, 1) fixed-base niels table (host numpy;
 # converted per use so jit tracing never captures a cached tracer)
 
 
@@ -60,48 +67,50 @@ def _tb0():
 
 
 def _build_neg_a_table(A: jnp.ndarray) -> jnp.ndarray:
-    """(N, 4, L) extended -A -> (N, 16, 4, L) cached table of j*(-A)."""
+    """(4, L, N) extended -A -> (16, 4, L, N) cached table of j*(-A)."""
     negA = E.negate(A)
     cached_negA = E.cache_point(negA)
-    entries = [E.identity(negA.shape[:-2]), negA]
+    entries = [E.identity(A.shape[-1]), negA]
     for j in range(2, 16):
         if j % 2 == 0:
             entries.append(E.point_double(entries[j // 2]))
         else:
             entries.append(E.point_add_cached(entries[j - 1], cached_negA))
     cached = [E.cache_point(e) for e in entries]
-    return jnp.stack(cached, axis=1)  # (N, 16, 4, L)
+    return jnp.stack(cached, axis=0)  # (16, 4, L, N)
 
 
-def _scalar_mult_check(
-    yA, signA, yR, signR, dS, dk
-) -> jnp.ndarray:
-    """Core device program. All args batched on dim 0.
+def _onehot_select(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """table (16, 4, L, {N|1}), idx (N,) -> (4, L, N) via 16-way masked
+    accumulate (no per-lane gather)."""
+    js = jnp.arange(16, dtype=idx.dtype)
+    mask = (idx[None, :] == js[:, None]).astype(table.dtype)  # (16, N)
+    return jnp.sum(table * mask[:, None, None, :], axis=0)
 
-    yA/yR: (N, L) field elements; signA/signR: (N,) int32;
-    dS/dk: (N, 64) int32 radix-16 digits, little-endian.
+
+def _scalar_mult_check(yA, signA, yR, signR, dS, dk) -> jnp.ndarray:
+    """Core device program. Batch axis minor.
+
+    yA/yR: (L, N) field elements; signA/signR: (N,) int32;
+    dS/dk: (64, N) int32 radix-16 digits, little-endian.
     Returns ok: (N,) bool.
     """
     A, okA = E.decompress(yA, signA)
     R, okR = E.decompress(yR, signR)
-    TA = _build_neg_a_table(A)  # (N, 16, 4, L)
+    TA = _build_neg_a_table(A)  # (16, 4, L, N)
 
-    tb0 = _tb0()  # (16, 4, L)
+    tb0 = _tb0()  # (16, 4, L, 1)
     # scan from the most significant window down
-    dS_steps = jnp.flip(dS.T, axis=0)  # (64, N)
-    dk_steps = jnp.flip(dk.T, axis=0)
+    dS_steps = jnp.flip(dS, axis=0)  # (64, N)
+    dk_steps = jnp.flip(dk, axis=0)
 
-    acc0 = E.identity(yA.shape[:-1])
+    acc0 = E.identity(yA.shape[-1])
 
     def body(acc, xs):
         ds_w, dk_w = xs
         acc = lax.fori_loop(0, 4, lambda _i, a: E.point_double(a), acc)
-        ta = jnp.take_along_axis(
-            TA, dk_w[:, None, None, None], axis=1
-        ).squeeze(1)
-        acc = E.point_add_cached(acc, ta)
-        tb = jnp.take(tb0, ds_w, axis=0)  # (N, 4, L)
-        acc = E.point_add_cached(acc, tb)
+        acc = E.point_add_cached(acc, _onehot_select(TA, dk_w))
+        acc = E.point_add_cached(acc, _onehot_select(tb0, ds_w))
         return acc, None
 
     acc, _ = lax.scan(body, acc0, (dS_steps, dk_steps))
@@ -111,60 +120,158 @@ def _scalar_mult_check(
     return E.is_identity(acc) & okA & okR
 
 
-# -- host packing --
+# -- device-side scalar prep --
+#
+# Everything between the SHA-512 digests and the curve math runs inside
+# the same jitted program: byte -> limb unpacking, the reduction of the
+# 512-bit digest mod L, S < L canonicality, and nibble decomposition.
+# Host numpy versions of these were memory-bandwidth-bound (~6 us/sig);
+# on device they are a rounding error next to the scalar multiplication.
+
+_L_INT = em.L
+_DELTA16_INT = 16 * (_L_INT - (1 << 252))  # 16*delta, 129 bits: 2^256 ≡ -16*delta
 
 
-def _fe_from_le32(data: np.ndarray) -> np.ndarray:
-    """(N, 32) uint8 LE-encoded y (bit 255 already cleared) -> (N, L)
-    int32 limbs, reduced mod p. Vectorized bit repacking."""
-    n = data.shape[0]
-    bits = np.unpackbits(data, axis=1, bitorder="little")  # (N, 256)
-    out = np.zeros((n, F.NLIMBS), dtype=np.int64)
+def _bytes_const(value: int, k: int) -> np.ndarray:
+    """(k, 1) int32 radix-2^8 limbs of a constant."""
+    return np.array(
+        [(value >> (8 * i)) & 0xFF for i in range(k)], dtype=np.int32
+    )[:, None]
+
+
+_C8 = _bytes_const(_DELTA16_INT, 17)
+_L8 = _bytes_const(_L_INT, 32)
+
+
+def _fe_from_bytes_dev(b: jnp.ndarray) -> jnp.ndarray:
+    """(32, N) int32 byte rows (bit 7 of row 31 already cleared) ->
+    (NLIMBS, N) radix-2^13 limbs. The value (< 2^255) may exceed p —
+    fine: field ops accept any normalized-limb representative
+    (ZIP-215 accepts non-canonical y encodings)."""
+    b = jnp.concatenate(
+        [b, jnp.zeros((2, b.shape[1]), dtype=b.dtype)], axis=0
+    )
+    limbs = []
     for i in range(F.NLIMBS):
-        lo = F.RADIX * i
-        hi = min(lo + F.RADIX, 256)
-        w = 1 << np.arange(hi - lo, dtype=np.int64)
-        out[:, i] = bits[:, lo:hi] @ w
-    # values may be >= p (ZIP-215 accepts); fold bits >= 255 via mod p:
-    # bit 255 was cleared by the caller so out < 2^255 < 2p; conditional
-    # subtract p once.
-    val_ge_p = _ge_p(out)
-    out = np.where(val_ge_p[:, None], _sub_p(out), out)
-    return out.astype(np.int32)
+        s = F.RADIX * i
+        b0 = s >> 3
+        v = b[b0] + (b[b0 + 1] << 8) + (b[b0 + 2] << 16)
+        limbs.append((v >> (s & 7)) & F.MASK)
+    return jnp.stack(limbs, axis=0)
 
 
-_P_LIMBS_NP = np.array(
-    [(em.P >> (F.RADIX * i)) & (F.BASE - 1) for i in range(F.NLIMBS)],
-    dtype=np.int64,
-)
+def _norm8(x: jnp.ndarray, passes: int) -> jnp.ndarray:
+    """Radix-2^8 carry/borrow propagation, `passes` fixed rounds: lower
+    limbs land in [0, 2^8), the top limb keeps the value's sign. A
+    ripple can travel one limb per round, so `passes` >= rows for full
+    canonicalization; 2 for loose bounding between multiplies."""
+    for _ in range(passes):
+        c = x[:-1] >> 8
+        x = jnp.concatenate([x[:-1] - (c << 8), x[-1:]], axis=0)
+        x = x.at[1:].add(c)
+    return x
 
 
-def _ge_p(limbs: np.ndarray) -> np.ndarray:
-    ge = np.ones(limbs.shape[0], dtype=bool)
-    decided = np.zeros(limbs.shape[0], dtype=bool)
-    for i in range(F.NLIMBS - 1, -1, -1):
-        gt = limbs[:, i] > _P_LIMBS_NP[i]
-        lt = limbs[:, i] < _P_LIMBS_NP[i]
-        ge = np.where(~decided & gt, True, ge)
-        ge = np.where(~decided & lt, False, ge)
-        decided |= gt | lt
-    return ge
+def _mul_c8(a: jnp.ndarray, width: int) -> jnp.ndarray:
+    """(ka, N) signed radix-2^8 limbs x 16*delta -> (width, N) raw conv.
+    Partial sums <= 17 * 2^9 * 2^8 < 2^22: safely int32."""
+    ka = a.shape[0]
+    acc = None
+    for i in range(_C8.shape[0]):
+        t = jnp.pad(a * _C8[i], ((i, width - i - ka), (0, 0)))
+        acc = t if acc is None else acc + t
+    return acc
 
 
-def _sub_p(limbs: np.ndarray) -> np.ndarray:
-    out = limbs - _P_LIMBS_NP[None, :]
-    for i in range(F.NLIMBS - 1):
-        borrow = out[:, i] < 0
-        out[:, i] += borrow * F.BASE
-        out[:, i + 1] -= borrow
-    return out
+def _mod_l_dev(d: jnp.ndarray) -> jnp.ndarray:
+    """(64, N) int32 digest byte rows (LE) -> (32, N) canonical byte
+    rows of the value mod L.
+
+    Three folds of the high half with 2^256 ≡ -16*delta, then an
+    approximate quotient by the top 4 bits and conditional +L fixes:
+      fold1: < 2^512          -> |x| < 2^385  (50 rows)
+      fold2: |hi| < 2^129     -> |x| < 2^259  (35 rows)
+      (full normalize so lo is canonical)
+      fold3: |hi| < 2^3       -> x in (-2^132, 2^256)  (33 rows)
+      +L if negative; q = x >> 252 in [0,15]; x -= q*L -> (-16d, 2^252)
+      +L if negative -> [0, L)."""
+    x = d
+    for split, width in ((32, 50), (32, 35)):
+        lo = jnp.pad(
+            x[:split], ((0, width - split), (0, 0))
+        )
+        x = _norm8(lo - _mul_c8(x[split:], width), 2)
+    x = _norm8(x, 36)  # canonical lower limbs, signed top
+    lo = jnp.pad(x[:32], ((0, 1), (0, 0)))
+    x = _norm8(lo - _mul_c8(x[32:], 33), 34)
+    neg = (x[-1] < 0).astype(jnp.int32)
+    x = x.at[:32].add(neg[None, :] * jnp.asarray(_L8))
+    x = _norm8(x, 34)
+    # value < 2^257: bits 252..255 in row 31, bit 256 in row 32
+    q = (x[31] >> 4) + (x[32] << 4)
+    l8_33 = jnp.asarray(np.pad(_L8, ((0, 1), (0, 0))))
+    x = x - q[None, :] * l8_33
+    x = _norm8(x, 34)
+    neg = (x[-1] < 0).astype(jnp.int32)
+    x = x.at[:32].add(neg[None, :] * jnp.asarray(_L8))
+    return _norm8(x, 34)[:32]
 
 
-def _nibbles_le(data: np.ndarray) -> np.ndarray:
-    """(N, 32) uint8 -> (N, 64) int32 radix-16 digits, little-endian."""
-    lo = (data & 0x0F).astype(np.int32)
-    hi = (data >> 4).astype(np.int32)
-    return np.stack([lo, hi], axis=2).reshape(data.shape[0], 64)
+def _s_lt_l_dev(s: jnp.ndarray) -> jnp.ndarray:
+    """(32, N) int32 byte rows of S (LE) -> (N,) bool: S < L
+    (ZIP-215 rule 2: S must be canonical)."""
+    l_bytes = np.asarray(_L8)[:, 0]
+    lt = jnp.zeros(s.shape[1], dtype=bool)
+    decided = jnp.zeros(s.shape[1], dtype=bool)
+    for i in range(31, -1, -1):
+        lo = s[i] < int(l_bytes[i])
+        hi = s[i] > int(l_bytes[i])
+        lt = jnp.where(~decided & lo, True, lt)
+        decided = decided | lo | hi
+    return lt
+
+
+def _nibbles_dev(b: jnp.ndarray) -> jnp.ndarray:
+    """(32, N) canonical byte rows -> (64, N) radix-16 digits, LE."""
+    lo = b & 0x0F
+    hi = b >> 4
+    return jnp.stack([lo, hi], axis=1).reshape(64, b.shape[1])
+
+
+def _verify_program(pk_b, sig_b, dig_b) -> jnp.ndarray:
+    """The full device program: byte rows in, validity bitmap out.
+
+    pk_b (32, N), sig_b (64, N) uint8/int32 byte rows; dig_b (64, N)
+    SHA-512(R||A||M) byte rows. Returns (N,) bool."""
+    pk = pk_b.astype(jnp.int32)
+    sig = sig_b.astype(jnp.int32)
+    dig = dig_b.astype(jnp.int32)
+    signA = pk[31] >> 7
+    pk = pk.at[31].set(pk[31] & 0x7F)
+    r = sig[:32]
+    signR = r[31] >> 7
+    r = r.at[31].set(r[31] & 0x7F)
+    s = sig[32:]
+    yA = _fe_from_bytes_dev(pk)
+    yR = _fe_from_bytes_dev(r)
+    s_ok = _s_lt_l_dev(s)
+    dS = _nibbles_dev(s)
+    dk = _nibbles_dev(_mod_l_dev(dig))
+    ok = _scalar_mult_check(yA, signA, yR, signR, dS, dk)
+    return ok & s_ok
+
+
+# -- host packing (only SHA-512 and byte joins remain on host) --
+
+
+def _join_cols(items: Sequence[bytes], width: int, pad: int) -> np.ndarray:
+    """Join n equal-length byte strings into a (width, n+pad) uint8
+    array, batch-minor, zero-padded on the right."""
+    arr = np.frombuffer(b"".join(items), dtype=np.uint8).reshape(-1, width)
+    out = arr.T
+    if pad:
+        return np.pad(out, ((0, 0), (0, pad)))
+    return np.ascontiguousarray(out)
 
 
 class Ed25519Verifier:
@@ -175,7 +282,9 @@ class Ed25519Verifier:
     invocations)."""
 
     def __init__(self, bucket_sizes: Optional[Sequence[int]] = None) -> None:
-        self.bucket_sizes = sorted(bucket_sizes or [8, 32, 128, 512, 2048, 8192, 16384])
+        self.bucket_sizes = sorted(
+            bucket_sizes or [8, 32, 128, 512, 2048, 8192, 16384]
+        )
         self._compiled = {}
 
     def _bucket(self, n: int) -> int:
@@ -187,7 +296,7 @@ class Ed25519Verifier:
     def _program(self, size: int):
         fn = self._compiled.get(size)
         if fn is None:
-            fn = jax.jit(_scalar_mult_check)
+            fn = jax.jit(_verify_program)
             self._compiled[size] = fn
         return fn
 
@@ -210,57 +319,35 @@ class Ed25519Verifier:
             ],
             dtype=bool,
         )
-        # host scalar prep
-        pk_arr = np.zeros((n, 32), dtype=np.uint8)
-        r_arr = np.zeros((n, 32), dtype=np.uint8)
-        s_ok = np.zeros(n, dtype=bool)
-        dS = np.zeros((n, 32), dtype=np.uint8)
-        dk = np.zeros((n, 32), dtype=np.uint8)
-        for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
-            if not size_ok[i]:
-                continue
-            pk_arr[i] = np.frombuffer(pk, dtype=np.uint8)
-            r_arr[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-            s = int.from_bytes(sig[32:], "little")
-            if s >= em.L:
-                continue  # ZIP-215 rule 2: S must be canonical
-            s_ok[i] = True
-            dS[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-            k = (
-                int.from_bytes(
-                    hashlib.sha512(sig[:32] + pk + msg).digest(), "little"
-                )
-                % em.L
-            )
-            dk[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
-
-        signA = (pk_arr[:, 31] >> 7).astype(np.int32)
-        signR = (r_arr[:, 31] >> 7).astype(np.int32)
-        pk_arr[:, 31] &= 0x7F
-        r_arr[:, 31] &= 0x7F
-        yA = _fe_from_le32(pk_arr)
-        yR = _fe_from_le32(r_arr)
-
+        if not size_ok.all():
+            pubkeys = [
+                pk if ok else b"\x00" * 32
+                for pk, ok in zip(pubkeys, size_ok)
+            ]
+            sigs = [
+                sig if ok else b"\x00" * 64
+                for sig, ok in zip(sigs, size_ok)
+            ]
+        # host work is just byte joins + SHA-512; everything else (limb
+        # unpacking, mod-L, S-canonicality, digits, curve math) is one
+        # device program
         bucket = self._bucket(n)
         pad = bucket - n
-        if pad:
-            yA = np.pad(yA, ((0, pad), (0, 0)))
-            yR = np.pad(yR, ((0, pad), (0, 0)))
-            signA = np.pad(signA, (0, pad))
-            signR = np.pad(signR, (0, pad))
-            dS = np.pad(dS, ((0, pad), (0, 0)))
-            dk = np.pad(dk, ((0, pad), (0, 0)))
-
+        pk_b = _join_cols(pubkeys, 32, pad)
+        sig_b = _join_cols(sigs, 64, pad)
+        dig_b = _join_cols(
+            [
+                hashlib.sha512(sig[:32] + pk + msg).digest()
+                for pk, msg, sig in zip(pubkeys, msgs, sigs)
+            ],
+            64,
+            pad,
+        )
         ok = self._program(bucket)(
-            jnp.asarray(yA),
-            jnp.asarray(signA),
-            jnp.asarray(yR),
-            jnp.asarray(signR),
-            jnp.asarray(_nibbles_le(dS)),
-            jnp.asarray(_nibbles_le(dk)),
+            jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
         )
         ok = np.asarray(ok)[:n]
-        return ok & s_ok & size_ok
+        return ok & size_ok
 
 
 _DEFAULT: Optional[Ed25519Verifier] = None

@@ -1,11 +1,12 @@
 """Batched twisted-Edwards (ed25519) group ops on TPU.
 
-Points are int32 arrays shaped (..., 4, NLIMBS) holding extended
+Points are int32 arrays shaped (..., 4, NLIMBS, N) holding extended
 homogeneous coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, xy = T/Z on
--x^2 + y^2 = 1 + d x^2 y^2. The coordinate axis is deliberately part of
-the array: every group operation becomes two *stacked* field
-multiplications over the (..., 4) axis, so the VPU sees wide fused
-elementwise work instead of four scalar-coded muls.
+-x^2 + y^2 = 1 + d x^2 y^2, batch axis minor (see field25519 layout
+note). The coordinate axis is deliberately part of the array: every
+group operation becomes two *stacked* field multiplications over the
+(..., 4) axis, so the VPU sees wide fused elementwise work instead of
+four scalar-coded muls.
 
 Formulas: add-2008-hwcd-3 and dbl-2008-hwcd (complete for a = -1, d
 non-square, so identity/doubling/small-order inputs all flow through the
@@ -24,7 +25,6 @@ from __future__ import annotations
 import numpy as np
 
 import jax.numpy as jnp
-from jax import lax
 
 from ..crypto import ed25519_math as em
 from . import field25519 as F
@@ -35,6 +35,7 @@ __all__ = [
     "point_double",
     "cache_point",
     "negate",
+    "negate_cached",
     "decompress",
     "is_identity",
     "pack_point",
@@ -49,12 +50,12 @@ _D2_LIMBS = F.to_limbs(D2_INT)
 _ONE = F.to_limbs(1)
 
 
-def identity(batch_shape) -> jnp.ndarray:
-    """(0, 1, 1, 0) broadcast over batch dims -> (..., 4, NLIMBS)."""
-    pt = np.zeros((4, F.NLIMBS), dtype=np.int32)
-    pt[1] = _ONE
-    pt[2] = _ONE
-    return jnp.broadcast_to(jnp.asarray(pt), (*batch_shape, 4, F.NLIMBS))
+def identity(n: int) -> jnp.ndarray:
+    """(0, 1, 1, 0) broadcast over the batch -> (4, NLIMBS, N)."""
+    pt = np.zeros((4, F.NLIMBS, 1), dtype=np.int32)
+    pt[1, :, 0] = _ONE
+    pt[2, :, 0] = _ONE
+    return jnp.broadcast_to(jnp.asarray(pt), (4, F.NLIMBS, n))
 
 
 def pack_point(x: int, y: int) -> np.ndarray:
@@ -71,47 +72,74 @@ def pack_point(x: int, y: int) -> np.ndarray:
 
 def cache_point(p: jnp.ndarray) -> jnp.ndarray:
     """Extended -> cached (Y-X, Y+X, 2d*T, 2Z) for use as an addition rhs."""
-    X, Y, Z, T = (p[..., i, :] for i in range(4))
+    X = p[..., 0, :, :]
+    Y = p[..., 1, :, :]
+    Z = p[..., 2, :, :]
+    T = p[..., 3, :, :]
     two_p = jnp.asarray(F._2P_LIMBS)
-    pre = jnp.stack([Y - X + two_p, Y + X, T, Z + Z], axis=-2)
-    pre = F.carry(pre)
+    pre = jnp.stack([Y - X + two_p, Y + X, T, Z + Z], axis=-3)
+    pre = F.carry1(pre)
     consts = jnp.stack(
         [
-            jnp.asarray(_ONE),
-            jnp.asarray(_ONE),
-            jnp.asarray(_D2_LIMBS),
-            jnp.asarray(_ONE),
+            _ONE[:, None],
+            _ONE[:, None],
+            _D2_LIMBS[:, None],
+            _ONE[:, None],
         ]
-    )
-    return F.mul(pre, jnp.broadcast_to(consts, pre.shape))
+    )  # (4, NLIMBS, 1)
+    return F.mul(pre, jnp.broadcast_to(jnp.asarray(consts), pre.shape))
+
+
+def negate_cached(qc: jnp.ndarray) -> jnp.ndarray:
+    """Negate a cached point: swap (Y-X, Y+X) and negate the 2dT slot.
+    Cheap (no muls) — lets signed-digit windows halve table sizes."""
+    ymx = qc[..., 0, :, :]
+    ypx = qc[..., 1, :, :]
+    t2d = qc[..., 2, :, :]
+    z2 = qc[..., 3, :, :]
+    return jnp.stack([ypx, ymx, F.neg(t2d), z2], axis=-3)
 
 
 def point_add_cached(p: jnp.ndarray, qc: jnp.ndarray) -> jnp.ndarray:
     """p (extended) + q (cached) -> extended."""
-    X, Y, Z, T = (p[..., i, :] for i in range(4))
+    X = p[..., 0, :, :]
+    Y = p[..., 1, :, :]
+    Z = p[..., 2, :, :]
+    T = p[..., 3, :, :]
     two_p = jnp.asarray(F._2P_LIMBS)
-    lhs = F.carry(jnp.stack([Y - X + two_p, Y + X, T, Z], axis=-2))
+    lhs = F.carry1(jnp.stack([Y - X + two_p, Y + X, T, Z], axis=-3))
     prods = F.mul(lhs, qc)  # A, B, C, D' (D' = Z1 * 2Z2)
-    A, B, C, Dv = (prods[..., i, :] for i in range(4))
-    mids = F.carry(
+    A = prods[..., 0, :, :]
+    B = prods[..., 1, :, :]
+    C = prods[..., 2, :, :]
+    Dv = prods[..., 3, :, :]
+    mids = F.carry1(
         jnp.stack(
-            [B - A + two_p, Dv - C + two_p, Dv + C, B + A], axis=-2
+            [B - A + two_p, Dv - C + two_p, Dv + C, B + A], axis=-3
         )
     )  # E, F, G, H
-    E, Fv, G, H = (mids[..., i, :] for i in range(4))
-    out_l = jnp.stack([E, G, Fv, E], axis=-2)
-    out_r = jnp.stack([Fv, H, G, H], axis=-2)
+    E = mids[..., 0, :, :]
+    Fv = mids[..., 1, :, :]
+    G = mids[..., 2, :, :]
+    H = mids[..., 3, :, :]
+    out_l = jnp.stack([E, G, Fv, E], axis=-3)
+    out_r = jnp.stack([Fv, H, G, H], axis=-3)
     return F.mul(out_l, out_r)  # X3, Y3, Z3, T3
 
 
 def point_double(p: jnp.ndarray) -> jnp.ndarray:
-    X, Y, Z, _T = (p[..., i, :] for i in range(4))
-    sq_in = F.carry(jnp.stack([X, Y, Z, X + Y], axis=-2))
-    sq = F.mul(sq_in, sq_in)  # A, B, Zs, S
-    A, B, Zs, S = (sq[..., i, :] for i in range(4))
+    X = p[..., 0, :, :]
+    Y = p[..., 1, :, :]
+    Z = p[..., 2, :, :]
+    sq_in = F.carry1(jnp.stack([X, Y, Z, X + Y], axis=-3))
+    sq = F.sqr(sq_in)  # A, B, Zs, S
+    A = sq[..., 0, :, :]
+    B = sq[..., 1, :, :]
+    Zs = sq[..., 2, :, :]
+    S = sq[..., 3, :, :]
     two_p = jnp.asarray(F._2P_LIMBS)
     # E = A+B-S, F = 2Zs + (A-B), G = A-B, H = A+B
-    mids = F.carry(
+    mids = F.carry1(
         jnp.stack(
             [
                 A + B - S + two_p,
@@ -119,25 +147,33 @@ def point_double(p: jnp.ndarray) -> jnp.ndarray:
                 A - B + two_p,
                 A + B,
             ],
-            axis=-2,
+            axis=-3,
         )
     )
-    E, Fv, G, H = (mids[..., i, :] for i in range(4))
-    out_l = jnp.stack([E, G, Fv, E], axis=-2)
-    out_r = jnp.stack([Fv, H, G, H], axis=-2)
+    E = mids[..., 0, :, :]
+    Fv = mids[..., 1, :, :]
+    G = mids[..., 2, :, :]
+    H = mids[..., 3, :, :]
+    out_l = jnp.stack([E, G, Fv, E], axis=-3)
+    out_r = jnp.stack([Fv, H, G, H], axis=-3)
     return F.mul(out_l, out_r)
 
 
 def negate(p: jnp.ndarray) -> jnp.ndarray:
     """(X, Y, Z, T) -> (-X, Y, Z, -T)."""
-    X, Y, Z, T = (p[..., i, :] for i in range(4))
+    X = p[..., 0, :, :]
+    Y = p[..., 1, :, :]
+    Z = p[..., 2, :, :]
+    T = p[..., 3, :, :]
     two_p = jnp.asarray(F._2P_LIMBS)
-    return F.carry(jnp.stack([two_p - X, Y, Z, two_p - T], axis=-2))
+    return F.carry(jnp.stack([two_p - X, Y, Z, two_p - T], axis=-3))
 
 
 def is_identity(p: jnp.ndarray) -> jnp.ndarray:
     """Projective identity test: X ≡ 0 and Y ≡ Z (mod p)."""
-    X, Y, Z, _ = (p[..., i, :] for i in range(4))
+    X = p[..., 0, :, :]
+    Y = p[..., 1, :, :]
+    Z = p[..., 2, :, :]
     return F.is_zero(X) & F.eq(Y, Z)
 
 
@@ -148,37 +184,45 @@ def is_identity(p: jnp.ndarray) -> jnp.ndarray:
 def decompress(y: jnp.ndarray, sign: jnp.ndarray):
     """Batched point decompression.
 
-    y: (..., NLIMBS) field element (already reduced mod p on host),
-    sign: (...) int32 0/1 — the x-parity bit from the wire encoding.
-    Returns (point (..., 4, NLIMBS), ok (...) bool). Mirrors the
-    reference's curve25519-voi decompression semantics; the square root is
-    computed as u*v^3 * (u*v^7)^((p-5)/8) with the sqrt(-1) correction.
+    y: (NLIMBS, N) field element (already reduced mod p on host),
+    sign: (N,) int32 0/1 — the x-parity bit from the wire encoding.
+    Returns (point (4, NLIMBS, N), ok (N,) bool). Mirrors the
+    reference's curve25519-voi decompression semantics; the square root
+    is computed as u*v^3 * (u*v^7)^((p-5)/8) with the sqrt(-1)
+    correction, the exponentiation via the 254-squaring addition chain
+    (field25519.pow_p58).
     """
-    one = jnp.broadcast_to(jnp.asarray(_ONE), y.shape)
+    one = jnp.broadcast_to(F.const_limbs(1), y.shape)
     y2 = F.sqr(y)
     u = F.sub(y2, one)
-    v = F.add(F.mul(y2, jnp.broadcast_to(jnp.asarray(F.to_limbs(D_INT)), y.shape)), one)
+    v = F.add(
+        F.mul(y2, jnp.broadcast_to(F.const_limbs(D_INT), y.shape)), one
+    )
     v2 = F.sqr(v)
     v3 = F.mul(v2, v)
     v7 = F.mul(F.sqr(v3), v)
-    t = F.pow_constexp(F.mul(u, v7), (em.P - 5) // 8)
+    t = F.pow_p58(F.mul(u, v7))
     x = F.mul(F.mul(u, v3), t)
     vx2 = F.mul(v, F.sqr(x))
     root_ok = F.eq(vx2, u)
     neg_root_ok = F.eq(vx2, F.neg(u))
-    x_alt = F.mul(x, jnp.broadcast_to(jnp.asarray(F.to_limbs(SQRT_M1_INT)), x.shape))
+    x_alt = F.mul(
+        x, jnp.broadcast_to(F.const_limbs(SQRT_M1_INT), x.shape)
+    )
     x = F.select(neg_root_ok, x_alt, x)
     ok = root_ok | neg_root_ok
     # parity fix: need canonical x for bit 0
     x_can = F.canonical(x)
-    parity = x_can[..., 0] & 1
+    parity = x_can[..., 0, :] & 1
     x_flipped = F.neg(x)
     x = F.select(parity != sign, x_flipped, x)
     # x == 0 with sign == 1 is invalid ("-0")
     x_zero = F.is_zero(x)
     ok = ok & ~(x_zero & (sign == 1))
     xy = F.mul(x, y)
-    pt = jnp.stack([x, y, jnp.broadcast_to(jnp.asarray(_ONE), y.shape), xy], axis=-2)
+    pt = jnp.stack(
+        [x, y, jnp.broadcast_to(F.const_limbs(1), y.shape), xy], axis=-3
+    )
     return pt, ok
 
 
@@ -186,8 +230,9 @@ def decompress(y: jnp.ndarray, sign: jnp.ndarray):
 
 
 def niels_table_b() -> np.ndarray:
-    """(16, 4, NLIMBS): cached-form entries for j*B, j = 0..15, Z = 1.
-    Layout matches cache_point output: (y-x, y+x, 2d*xy, 2)."""
+    """(16, 4, NLIMBS, 1): cached-form entries for j*B, j = 0..15, Z = 1.
+    Layout matches cache_point output: (y-x, y+x, 2d*xy, 2); trailing
+    1-axis broadcasts over the batch."""
     entries = []
     pt = em.IDENTITY
     for _j in range(16):
@@ -205,4 +250,4 @@ def niels_table_b() -> np.ndarray:
             )
         )
         pt = em.point_add(pt, em.B_POINT)
-    return np.stack(entries)
+    return np.stack(entries)[..., None]

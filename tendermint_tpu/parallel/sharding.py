@@ -67,11 +67,15 @@ class ShardedEd25519Verifier(K.Ed25519Verifier):
     def _program(self, size: int):
         fn = self._compiled.get(size)
         if fn is None:
-            batch = NamedSharding(self.mesh, P(SIG_AXIS))
+            # batch axis is MINOR (see field25519 layout note): the
+            # program takes (32, N) pk bytes, (64, N) sig bytes,
+            # (64, N) digest bytes and returns the (N,) bitmap
+            vec = NamedSharding(self.mesh, P(SIG_AXIS))
+            mat = NamedSharding(self.mesh, P(None, SIG_AXIS))
             fn = jax.jit(
-                K._scalar_mult_check,
-                in_shardings=(batch, batch, batch, batch, batch, batch),
-                out_shardings=NamedSharding(self.mesh, P(SIG_AXIS)),
+                K._verify_program,
+                in_shardings=(mat, mat, mat),
+                out_shardings=vec,
             )
             self._compiled[size] = fn
         return fn
