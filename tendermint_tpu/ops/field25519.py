@@ -191,9 +191,12 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     out = low + hi
     extra = x[..., 2 * NLIMBS, :] * (FOLD * FOLD)
     out = out.at[..., 0, :].add(extra)
-    # limbs now ≤ 2^13 + 608*2^13 + small < 2^23; ONE pass brings them
-    # to ≤ 2^13 + 2^10 — inside the ≤ ~10300 loose-normal envelope.
-    return _pass(out)
+    # limbs now ≤ 2^13 + 608*2^13 + small < 2^23. TWO passes are needed:
+    # after one, limbs 1..19 are ≤ 2^13 + 2^10, but limb 0 picks up the
+    # top limb's wraparound carry ×608 (≈ 610*608 ≈ 2^18.5) — outside the
+    # loose-normal envelope, and a following mul would overflow int32 on
+    # the a0*b0 coefficient. The second pass sheds it.
+    return carry(out)
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -242,7 +245,7 @@ def sqr(a: jnp.ndarray) -> jnp.ndarray:
     out = low + hi
     extra = x[..., 2 * NLIMBS, :] * (FOLD * FOLD)
     out = out.at[..., 0, :].add(extra)
-    return _pass(out)
+    return carry(out)  # two passes — see mul() tail comment
 
 
 def mul_const(a: jnp.ndarray, c: int) -> jnp.ndarray:
