@@ -214,6 +214,7 @@ class Node(Service):
         self.evidence_reactor: Optional[EvidenceReactor] = None
         self.blocksync_reactor = None
         self.statesync_reactor = None
+        self.rpc_server = None
         self.genesis_state_synced = False
 
     # ------------------------------------------------------------------
@@ -340,6 +341,34 @@ class Node(Service):
         await self.blocksync_reactor.start()
         await self.statesync_reactor.start()
 
+        # -- RPC (reference: node/node.go:480-540 startRPC) --
+        if cfg.rpc.laddr:
+            from ..rpc import Environment, RPCServer
+
+            env = Environment(
+                chain_id=self.genesis.chain_id,
+                block_store=self.block_store,
+                state_store=self.state_store,
+                mempool=self.mempool,
+                event_bus=self.event_bus,
+                consensus=self.consensus,
+                consensus_reactor=self.consensus_reactor,
+                peer_manager=self.peer_manager,
+                proxy=self.proxy,
+                genesis=self.genesis,
+                evidence_pool=self.evidence_pool,
+                event_sinks=self.indexer.sinks,
+                node_info=self.node_info,
+                privval=self.privval,
+                cfg=cfg,
+            )
+            self.rpc_server = RPCServer(
+                env,
+                laddr=cfg.rpc.laddr,
+                max_body_bytes=cfg.rpc.max_body_bytes,
+            )
+            await self.rpc_server.start()
+
         if state_sync:
             self.spawn(self._state_sync_then_follow(), "state-sync")
 
@@ -378,6 +407,7 @@ class Node(Service):
 
     async def _teardown(self) -> None:
         for svc in (
+            self.rpc_server,
             self.statesync_reactor,
             self.blocksync_reactor,
             self.evidence_reactor,

@@ -135,6 +135,19 @@ class PeerManager:
     def peers(self) -> List[NodeID]:
         return [p.node_id for p in self._peers.values() if p.ready]
 
+    def connected_peers(self) -> List[Tuple[NodeID, str]]:
+        """(node_id, first known address) for every ready peer —
+        the net_info RPC surface (reference: net.go:16-44)."""
+        out = []
+        for p in self._peers.values():
+            if p.ready:
+                addr = ""
+                if p.addresses:
+                    host, port = sorted(p.addresses)[0]
+                    addr = f"{host}:{port}"
+                out.append((p.node_id, addr))
+        return out
+
     def num_connected(self) -> int:
         # a dialing peer holds a slot too, or we would over-dial
         return sum(

@@ -1,0 +1,849 @@
+"""RPC method implementations against a node Environment.
+
+reference: internal/rpc/core/{routes.go:30-73, env.go, blocks.go,
+mempool.go, status.go, tx.go, consensus.go, abci.go, events.go,
+evidence.go, net.go, health.go}. The Environment holds the same node
+internals the reference's does; every public method is one JSON-RPC
+route.
+
+JSON conventions (framework-local, documented rather than inherited
+from Go's accidents): bytes are lowercase hex strings; transaction
+payloads are base64 (they are opaque app data); heights and other
+int64s are JSON numbers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ..crypto.keys import PubKey
+from ..types.validator import ValidatorSet
+from ..eventbus import EventBus
+from ..libs.log import get_logger
+from ..mempool import Mempool, MempoolError, TxInfo
+from ..pubsub import SubscriptionError
+from ..state.indexer import EventSink
+from ..types import events as tme
+from ..types.genesis import GenesisDoc
+from ..types.tx import tx_hash
+from .jsonrpc import (
+    INTERNAL_ERROR,
+    INVALID_PARAMS,
+    RPCError,
+    RPCRequest,
+)
+
+__all__ = ["Environment", "GENESIS_CHUNK_SIZE"]
+
+GENESIS_CHUNK_SIZE = 16 * 1024 * 1024  # reference: env.go:51
+
+
+def encode(obj: Any) -> Any:
+    """Generic domain-object -> JSON-encodable structure."""
+    if isinstance(obj, PubKey):
+        return {"type": obj.type(), "value": obj.bytes().hex()}
+    if isinstance(obj, ValidatorSet):
+        return {
+            "validators": [encode(v) for v in obj.validators],
+            "proposer": (
+                encode(obj.get_proposer()) if obj.size() else None
+            ),
+            "total_voting_power": obj.total_voting_power(),
+        }
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: encode(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if isinstance(obj, (list, tuple)):
+        return [encode(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): encode(v) for k, v in obj.items()}
+    return obj
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def _decode_tx_param(params: Dict[str, Any]) -> bytes:
+    tx = params.get("tx")
+    if not isinstance(tx, str):
+        raise RPCError(INVALID_PARAMS, "missing tx param (base64 string)")
+    try:
+        return base64.b64decode(tx, validate=True)
+    except Exception:
+        raise RPCError(INVALID_PARAMS, "tx is not valid base64")
+
+
+def _decode_hash_param(params: Dict[str, Any], key: str = "hash") -> bytes:
+    h = params.get(key)
+    if not isinstance(h, str):
+        raise RPCError(INVALID_PARAMS, f"missing {key} param (hex string)")
+    try:
+        return bytes.fromhex(h)
+    except ValueError:
+        raise RPCError(INVALID_PARAMS, f"{key} is not valid hex")
+
+
+class Environment:
+    """Node internals the RPC methods read (reference: env.go:58-100)."""
+
+    def __init__(
+        self,
+        *,
+        chain_id: str,
+        block_store,
+        state_store,
+        mempool: Optional[Mempool] = None,
+        event_bus: Optional[EventBus] = None,
+        consensus=None,  # ConsensusState
+        consensus_reactor=None,
+        peer_manager=None,
+        proxy=None,  # AppConns
+        genesis: Optional[GenesisDoc] = None,
+        evidence_pool=None,
+        event_sinks: Optional[List[EventSink]] = None,
+        node_info=None,
+        privval=None,
+        cfg=None,
+    ) -> None:
+        self.chain_id = chain_id
+        self.block_store = block_store
+        self.state_store = state_store
+        self.mempool = mempool
+        self.event_bus = event_bus
+        self.consensus = consensus
+        self.consensus_reactor = consensus_reactor
+        self.peer_manager = peer_manager
+        self.proxy = proxy
+        self.genesis = genesis
+        self.evidence_pool = evidence_pool
+        self.event_sinks = event_sinks or []
+        self.node_info = node_info
+        self.privval = privval
+        self.cfg = cfg
+        self.logger = get_logger("rpc.core")
+        # ws client_id -> set of query strings (for unsubscribe_all)
+        self._ws_subs: Dict[str, set] = {}
+        self._genesis_chunks: Optional[List[bytes]] = None
+
+    # -- route table (reference: routes.go:30-73) --
+
+    def routes(self) -> Dict[str, Any]:
+        r = {
+            "health": self.health,
+            "status": self.status,
+            "net_info": self.net_info,
+            "genesis": self.genesis_route,
+            "genesis_chunked": self.genesis_chunked,
+            "blockchain": self.blockchain,
+            "header": self.header,
+            "header_by_hash": self.header_by_hash,
+            "block": self.block,
+            "block_by_hash": self.block_by_hash,
+            "block_results": self.block_results,
+            "commit": self.commit,
+            "validators": self.validators,
+            "consensus_state": self.consensus_state,
+            "dump_consensus_state": self.dump_consensus_state,
+            "consensus_params": self.consensus_params,
+            "unconfirmed_txs": self.unconfirmed_txs,
+            "num_unconfirmed_txs": self.num_unconfirmed_txs,
+            "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_async": self.broadcast_tx_async,
+            "broadcast_tx_commit": self.broadcast_tx_commit,
+            "check_tx": self.check_tx,
+            "remove_tx": self.remove_tx,
+            "unsafe_flush_mempool": self.unsafe_flush_mempool,
+            "abci_query": self.abci_query,
+            "abci_info": self.abci_info,
+            "broadcast_evidence": self.broadcast_evidence,
+            "tx": self.tx,
+            "tx_search": self.tx_search,
+            "block_search": self.block_search,
+            "subscribe": self.subscribe,
+            "unsubscribe": self.unsubscribe,
+            "unsubscribe_all": self.unsubscribe_all,
+        }
+        return r
+
+    # -- info routes --
+
+    async def health(self, req: RPCRequest):
+        return {}
+
+    async def status(self, req: RPCRequest):
+        """reference: internal/rpc/core/status.go:24."""
+        latest_height = self.block_store.height()
+        latest_meta = (
+            self.block_store.load_block_meta(latest_height)
+            if latest_height
+            else None
+        )
+        sync_info = {
+            "latest_block_hash": (
+                latest_meta.block_id.hash.hex() if latest_meta else ""
+            ),
+            "latest_app_hash": (
+                latest_meta.header.app_hash.hex() if latest_meta else ""
+            ),
+            "latest_block_height": latest_height,
+            "latest_block_time": (
+                latest_meta.header.time_ns if latest_meta else 0
+            ),
+            "earliest_block_height": self.block_store.base(),
+            "catching_up": (
+                self.consensus_reactor.wait_sync
+                if self.consensus_reactor is not None
+                else False
+            ),
+        }
+        validator_info = {}
+        if self.privval is not None:
+            addr = self.privval.key.address
+            power = 0
+            state = self.state_store.load()
+            if state is not None:
+                _, val = state.validators.get_by_address(addr)
+                if val is not None:
+                    power = val.voting_power
+            validator_info = {
+                "address": addr.hex(),
+                "pub_key": self.privval.key.pub_key.bytes().hex(),
+                "voting_power": power,
+            }
+        return {
+            "node_info": encode(self.node_info) if self.node_info else {},
+            "sync_info": sync_info,
+            "validator_info": validator_info,
+        }
+
+    async def net_info(self, req: RPCRequest):
+        """reference: internal/rpc/core/net.go:16."""
+        peers = []
+        if self.peer_manager is not None:
+            for pid, addr in self.peer_manager.connected_peers():
+                peers.append({"node_id": pid, "url": addr})
+        return {
+            "listening": True,
+            "n_peers": len(peers),
+            "peers": peers,
+        }
+
+    async def genesis_route(self, req: RPCRequest):
+        if self.genesis is None:
+            raise RPCError(INTERNAL_ERROR, "genesis not available")
+        import json as _json
+
+        return {"genesis": _json.loads(self.genesis.to_json())}
+
+    async def genesis_chunked(self, req: RPCRequest):
+        """reference: env.go InitGenesisChunks + net.go GenesisChunked."""
+        if self.genesis is None:
+            raise RPCError(INTERNAL_ERROR, "genesis not available")
+        if self._genesis_chunks is None:
+            data = self.genesis.to_json().encode()
+            self._genesis_chunks = [
+                data[i : i + GENESIS_CHUNK_SIZE]
+                for i in range(0, len(data), GENESIS_CHUNK_SIZE)
+            ] or [b""]
+        chunks = self._genesis_chunks
+        chunk = int(req.params.get("chunk", 0))
+        if not 0 <= chunk < len(chunks):
+            raise RPCError(
+                INVALID_PARAMS,
+                f"chunk {chunk} out of range (total {len(chunks)})",
+            )
+        return {
+            "chunk": chunk,
+            "total": len(chunks),
+            "data": _b64(chunks[chunk]),
+        }
+
+    # -- block routes (reference: internal/rpc/core/blocks.go) --
+
+    def _height_param(
+        self, params: Dict[str, Any], default_latest: bool = True
+    ) -> int:
+        h = params.get("height")
+        if h is None:
+            if not default_latest:
+                raise RPCError(INVALID_PARAMS, "missing height param")
+            return self.block_store.height()
+        height = int(h)
+        base = self.block_store.base()
+        top = self.block_store.height()
+        if height < base or height > top:
+            raise RPCError(
+                INVALID_PARAMS,
+                f"height {height} not available (base {base}, height {top})",
+            )
+        return height
+
+    async def blockchain(self, req: RPCRequest):
+        """Block metas in [min_height, max_height], newest first
+        (reference: blocks.go:26 BlockchainInfo, 20-block page)."""
+        top = self.block_store.height()
+        base = self.block_store.base()
+        max_h = min(int(req.params.get("max_height", top) or top), top)
+        min_h = max(int(req.params.get("min_height", base) or base), base)
+        min_h = max(min_h, max_h - 19)
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            m = self.block_store.load_block_meta(h)
+            if m is not None:
+                metas.append(encode(m))
+        return {
+            "last_height": top,
+            "block_metas": metas,
+        }
+
+    async def header(self, req: RPCRequest):
+        height = self._height_param(req.params)
+        meta = self.block_store.load_block_meta(height)
+        if meta is None:
+            raise RPCError(INVALID_PARAMS, f"no header at height {height}")
+        return {"header": encode(meta.header)}
+
+    async def header_by_hash(self, req: RPCRequest):
+        h = _decode_hash_param(req.params)
+        meta = self.block_store.load_block_meta_by_hash(h)
+        if meta is None:
+            return {"header": None}
+        return {"header": encode(meta.header)}
+
+    async def block(self, req: RPCRequest):
+        height = self._height_param(req.params)
+        block = self.block_store.load_block(height)
+        meta = self.block_store.load_block_meta(height)
+        if block is None or meta is None:
+            raise RPCError(INVALID_PARAMS, f"no block at height {height}")
+        return {
+            "block_id": encode(meta.block_id),
+            "block": encode(block),
+        }
+
+    async def block_by_hash(self, req: RPCRequest):
+        h = _decode_hash_param(req.params)
+        block = self.block_store.load_block_by_hash(h)
+        if block is None:
+            return {"block_id": None, "block": None}
+        meta = self.block_store.load_block_meta(block.header.height)
+        return {
+            "block_id": encode(meta.block_id) if meta else None,
+            "block": encode(block),
+        }
+
+    async def block_results(self, req: RPCRequest):
+        """reference: blocks.go:148 BlockResults."""
+        height = self._height_param(req.params)
+        resp = self.state_store.load_abci_responses(height)
+        if resp is None:
+            raise RPCError(
+                INVALID_PARAMS, f"no results for height {height}"
+            )
+        val_updates = resp.end_block_obj.validator_updates if (
+            resp.end_block_obj is not None
+        ) else []
+        return {
+            "height": height,
+            "txs_results": [encode(r) for r in resp.deliver_tx_objs],
+            "begin_block_events": (
+                [encode(e) for e in resp.begin_block_obj.events]
+                if resp.begin_block_obj is not None
+                else []
+            ),
+            "end_block_events": (
+                [encode(e) for e in resp.end_block_obj.events]
+                if resp.end_block_obj is not None
+                else []
+            ),
+            "validator_updates": [encode(v) for v in val_updates],
+            "consensus_param_updates": (
+                encode(resp.end_block_obj.consensus_param_updates)
+                if resp.end_block_obj is not None
+                else None
+            ),
+        }
+
+    async def commit(self, req: RPCRequest):
+        height = self._height_param(req.params)
+        meta = self.block_store.load_block_meta(height)
+        if meta is None:
+            raise RPCError(INVALID_PARAMS, f"no block at height {height}")
+        commit = self.block_store.load_block_commit(height)
+        canonical = commit is not None
+        if commit is None and height == self.block_store.height():
+            commit = self.block_store.load_seen_commit()
+        return {
+            "signed_header": {
+                "header": encode(meta.header),
+                "commit": encode(commit) if commit else None,
+            },
+            "canonical": canonical,
+        }
+
+    async def validators(self, req: RPCRequest):
+        """reference: consensus.go:21 Validators (paginated)."""
+        height = self._height_param(req.params)
+        vals = self.state_store.load_validators(height)
+        if vals is None:
+            raise RPCError(
+                INVALID_PARAMS, f"no validator set at height {height}"
+            )
+        page = int(req.params.get("page", 1))
+        per_page = min(int(req.params.get("per_page", 30)), 100)
+        total = vals.size()
+        start = (page - 1) * per_page
+        if start < 0 or (start >= total and total > 0):
+            raise RPCError(INVALID_PARAMS, f"page {page} out of range")
+        sel = vals.validators[start : start + per_page]
+        return {
+            "block_height": height,
+            "validators": [encode(v) for v in sel],
+            "count": len(sel),
+            "total": total,
+        }
+
+    async def consensus_params(self, req: RPCRequest):
+        height = self._height_param(req.params)
+        params = self.state_store.load_params(height)
+        if params is None:
+            state = self.state_store.load()
+            params = state.consensus_params if state else None
+        return {
+            "block_height": height,
+            "consensus_params": encode(params) if params else None,
+        }
+
+    async def consensus_state(self, req: RPCRequest):
+        """Round-state summary (reference: consensus.go:66)."""
+        if self.consensus is None:
+            raise RPCError(INTERNAL_ERROR, "consensus not available")
+        rs = self.consensus.get_round_state()
+        return {
+            "round_state": {
+                "height": rs.height,
+                "round": rs.round,
+                "step": int(rs.step),
+                "start_time": rs.start_time_ns,
+                "proposal_block_hash": (
+                    rs.proposal_block.hash().hex()
+                    if rs.proposal_block is not None
+                    else ""
+                ),
+                "locked_block_hash": (
+                    rs.locked_block.hash().hex()
+                    if rs.locked_block is not None
+                    else ""
+                ),
+                "valid_block_hash": (
+                    rs.valid_block.hash().hex()
+                    if rs.valid_block is not None
+                    else ""
+                ),
+            }
+        }
+
+    async def dump_consensus_state(self, req: RPCRequest):
+        """Full round state incl. vote sets (reference: consensus.go:36)."""
+        if self.consensus is None:
+            raise RPCError(INTERNAL_ERROR, "consensus not available")
+        rs = self.consensus.get_round_state()
+        votes = []
+        if rs.votes is not None:
+            for r in range(rs.round + 1):
+                prevotes = rs.votes.prevotes(r)
+                precommits = rs.votes.precommits(r)
+                votes.append(
+                    {
+                        "round": r,
+                        "prevotes": (
+                            str(prevotes) if prevotes is not None else ""
+                        ),
+                        "precommits": (
+                            str(precommits)
+                            if precommits is not None
+                            else ""
+                        ),
+                    }
+                )
+        return {
+            "round_state": {
+                "height": rs.height,
+                "round": rs.round,
+                "step": int(rs.step),
+                "validators": encode(rs.validators),
+                "proposal": encode(rs.proposal),
+                "locked_round": rs.locked_round,
+                "valid_round": rs.valid_round,
+                "votes": votes,
+                "commit_round": rs.commit_round,
+            }
+        }
+
+    # -- mempool routes (reference: internal/rpc/core/mempool.go) --
+
+    def _require_mempool(self) -> Mempool:
+        if self.mempool is None:
+            raise RPCError(INTERNAL_ERROR, "mempool not available")
+        return self.mempool
+
+    async def broadcast_tx_async(self, req: RPCRequest):
+        """Fire-and-forget (reference: mempool.go:22)."""
+        mp = self._require_mempool()
+        tx = _decode_tx_param(req.params)
+
+        async def _check():
+            try:
+                await mp.check_tx(tx, TxInfo())
+            except MempoolError as e:
+                self.logger.info("async tx rejected", err=str(e))
+
+        asyncio.ensure_future(_check())
+        return {"hash": tx_hash(tx).hex()}
+
+    async def broadcast_tx_sync(self, req: RPCRequest):
+        """Wait for CheckTx result (reference: mempool.go:38)."""
+        mp = self._require_mempool()
+        tx = _decode_tx_param(req.params)
+        try:
+            res = await mp.check_tx(tx, TxInfo())
+        except MempoolError as e:
+            raise RPCError(INTERNAL_ERROR, f"tx rejected: {e}")
+        return {
+            "code": res.code,
+            "data": _b64(res.data),
+            "log": res.log,
+            "codespace": res.codespace,
+            "hash": tx_hash(tx).hex(),
+        }
+
+    async def check_tx(self, req: RPCRequest):
+        """CheckTx against the query connection without adding to the
+        pool (reference: mempool.go:135)."""
+        if self.proxy is None:
+            raise RPCError(INTERNAL_ERROR, "proxy app not available")
+        from ..abci import types as abci
+
+        tx = _decode_tx_param(req.params)
+        res = await self.proxy.query.check_tx(abci.RequestCheckTx(tx=tx))
+        return encode(res)
+
+    async def remove_tx(self, req: RPCRequest):
+        """reference: mempool.go:149 (by tx key = sha256 of tx)."""
+        mp = self._require_mempool()
+        key = _decode_hash_param(req.params, "tx_key")
+        mp.remove_tx_by_key(key)
+        return {}
+
+    async def broadcast_tx_commit(self, req: RPCRequest):
+        """Subscribe to the tx event, CheckTx, then wait for delivery in
+        a block (reference: mempool.go:58-129)."""
+        mp = self._require_mempool()
+        if self.event_bus is None:
+            raise RPCError(INTERNAL_ERROR, "event bus not available")
+        tx = _decode_tx_param(req.params)
+        txh = tx_hash(tx)
+        query = (
+            f"{tme.EVENT_TYPE_KEY}='{tme.EventValue.TX}'"
+            f" AND {tme.TX_HASH_KEY}='{txh.hex().upper()}'"
+        )
+        client_id = f"broadcast_tx_commit-{txh.hex()[:16]}"
+        try:
+            sub = self.event_bus.subscribe(client_id, query, limit=1)
+        except SubscriptionError as e:
+            raise RPCError(INTERNAL_ERROR, str(e))
+        try:
+            try:
+                check = await mp.check_tx(tx, TxInfo())
+            except MempoolError as e:
+                raise RPCError(INTERNAL_ERROR, f"tx rejected: {e}")
+            result: Dict[str, Any] = {
+                "check_tx": encode(check),
+                "hash": txh.hex(),
+                "height": 0,
+                "deliver_tx": None,
+            }
+            if check.code != 0:
+                return result
+            timeout = (
+                self.cfg.rpc.timeout_broadcast_tx_commit
+                if self.cfg is not None
+                else 10.0
+            )
+            try:
+                msg = await asyncio.wait_for(sub.next(), timeout)
+            except asyncio.TimeoutError:
+                raise RPCError(
+                    INTERNAL_ERROR,
+                    "timed out waiting for tx to be included in a block",
+                )
+            ev: tme.EventDataTx = msg.data
+            result["height"] = ev.height
+            result["deliver_tx"] = encode(ev.result)
+            return result
+        finally:
+            self.event_bus.unsubscribe_all(client_id)
+
+    async def unconfirmed_txs(self, req: RPCRequest):
+        """reference: mempool.go:160."""
+        mp = self._require_mempool()
+        limit = int(req.params.get("limit", 30))
+        txs = mp.reap_max_txs(limit)
+        return {
+            "n_txs": len(txs),
+            "total": mp.size(),
+            "total_bytes": mp.size_bytes(),
+            "txs": [_b64(tx) for tx in txs],
+        }
+
+    async def num_unconfirmed_txs(self, req: RPCRequest):
+        mp = self._require_mempool()
+        return {
+            "n_txs": mp.size(),
+            "total": mp.size(),
+            "total_bytes": mp.size_bytes(),
+        }
+
+    async def unsafe_flush_mempool(self, req: RPCRequest):
+        mp = self._require_mempool()
+        mp.flush()
+        return {}
+
+    # -- ABCI passthrough (reference: internal/rpc/core/abci.go) --
+
+    async def abci_query(self, req: RPCRequest):
+        if self.proxy is None:
+            raise RPCError(INTERNAL_ERROR, "proxy app not available")
+        from ..abci import types as abci
+
+        data = req.params.get("data", "")
+        if not isinstance(data, str):
+            raise RPCError(INVALID_PARAMS, "data must be a hex string")
+        try:
+            data_b = bytes.fromhex(data)
+        except ValueError:
+            raise RPCError(INVALID_PARAMS, "data is not valid hex")
+        res = await self.proxy.query.query(
+            abci.RequestQuery(
+                data=data_b,
+                path=req.params.get("path", ""),
+                height=int(req.params.get("height", 0)),
+                prove=bool(req.params.get("prove", False)),
+            )
+        )
+        return {"response": encode(res)}
+
+    async def abci_info(self, req: RPCRequest):
+        if self.proxy is None:
+            raise RPCError(INTERNAL_ERROR, "proxy app not available")
+        from ..abci import types as abci
+
+        res = await self.proxy.query.info(abci.RequestInfo())
+        return {"response": encode(res)}
+
+    # -- evidence (reference: internal/rpc/core/evidence.go) --
+
+    async def broadcast_evidence(self, req: RPCRequest):
+        if self.evidence_pool is None:
+            raise RPCError(INTERNAL_ERROR, "evidence pool not available")
+        from ..types.evidence import evidence_from_proto
+
+        raw = req.params.get("evidence")
+        if not isinstance(raw, str):
+            raise RPCError(
+                INVALID_PARAMS, "missing evidence param (hex proto)"
+            )
+        try:
+            ev = evidence_from_proto(bytes.fromhex(raw))
+        except Exception as e:
+            raise RPCError(INVALID_PARAMS, f"invalid evidence: {e}")
+        try:
+            self.evidence_pool.add_evidence(ev)
+        except Exception as e:
+            raise RPCError(INTERNAL_ERROR, f"evidence rejected: {e}")
+        return {"hash": ev.hash().hex()}
+
+    # -- tx / block search (reference: internal/rpc/core/tx.go,
+    #    blocks.go:244 BlockSearch) --
+
+    def _kv_sink(self) -> EventSink:
+        for s in self.event_sinks:
+            if s.type() == "kv":
+                return s
+        raise RPCError(
+            INTERNAL_ERROR, "tx indexing is disabled (no kv sink)"
+        )
+
+    async def tx(self, req: RPCRequest):
+        sink = self._kv_sink()
+        h = _decode_hash_param(req.params)
+        res = sink.get_tx_by_hash(h)
+        if res is None:
+            raise RPCError(INVALID_PARAMS, f"tx {h.hex()} not found")
+        return {
+            "hash": h.hex(),
+            "height": res.height,
+            "index": res.index,
+            "tx_result": encode(res.result),
+            "tx": _b64(res.tx),
+        }
+
+    async def tx_search(self, req: RPCRequest):
+        sink = self._kv_sink()
+        query = req.params.get("query")
+        if not isinstance(query, str):
+            raise RPCError(INVALID_PARAMS, "missing query param")
+        results = sink.search_tx_events(query)
+        if bool(req.params.get("order_by") == "desc"):
+            results = list(reversed(results))
+        page = int(req.params.get("page", 1))
+        per_page = min(int(req.params.get("per_page", 30)), 100)
+        start = (page - 1) * per_page
+        sel = results[start : start + per_page]
+        return {
+            "txs": [
+                {
+                    "hash": tx_hash(r.tx).hex(),
+                    "height": r.height,
+                    "index": r.index,
+                    "tx_result": encode(r.result),
+                    "tx": _b64(r.tx),
+                }
+                for r in sel
+            ],
+            "total_count": len(results),
+        }
+
+    async def block_search(self, req: RPCRequest):
+        sink = self._kv_sink()
+        query = req.params.get("query")
+        if not isinstance(query, str):
+            raise RPCError(INVALID_PARAMS, "missing query param")
+        heights = sink.search_block_events(query)
+        if req.params.get("order_by") == "desc":
+            heights = list(reversed(heights))
+        page = int(req.params.get("page", 1))
+        per_page = min(int(req.params.get("per_page", 30)), 100)
+        start = (page - 1) * per_page
+        sel = heights[start : start + per_page]
+        blocks = []
+        for h in sel:
+            meta = self.block_store.load_block_meta(h)
+            block = self.block_store.load_block(h)
+            if meta is not None and block is not None:
+                blocks.append(
+                    {
+                        "block_id": encode(meta.block_id),
+                        "block": encode(block),
+                    }
+                )
+        return {"blocks": blocks, "total_count": len(heights)}
+
+    # -- subscriptions (websocket only; reference: events.go) --
+
+    _MAX_SUBS_PER_CLIENT = 5
+
+    async def subscribe(self, req: RPCRequest):
+        if req.ws is None:
+            raise RPCError(
+                INVALID_PARAMS, "subscribe requires a websocket connection"
+            )
+        if self.event_bus is None:
+            raise RPCError(INTERNAL_ERROR, "event bus not available")
+        query = req.params.get("query")
+        if not isinstance(query, str):
+            raise RPCError(INVALID_PARAMS, "missing query param")
+        ws = req.ws
+        limit = (
+            self.cfg.rpc.max_subscriptions_per_client
+            if self.cfg is not None
+            else self._MAX_SUBS_PER_CLIENT
+        )
+        subs = self._ws_subs.setdefault(ws.client_id, set())
+        if len(subs) >= limit:
+            raise RPCError(
+                INTERNAL_ERROR, "too many subscriptions for this client"
+            )
+        if query in subs:
+            raise RPCError(INVALID_PARAMS, "already subscribed to query")
+        try:
+            sub = self.event_bus.subscribe(ws.client_id, query, limit=100)
+        except SubscriptionError as e:
+            raise RPCError(INTERNAL_ERROR, str(e))
+        except ValueError as e:
+            raise RPCError(INVALID_PARAMS, f"invalid query: {e}")
+        subs.add(query)
+        if ws.on_close is None:
+            ws.on_close = self._ws_disconnected
+        asyncio.ensure_future(self._pump_events(ws, sub, query, req.req_id))
+        return {}
+
+    async def _pump_events(self, ws, sub, query: str, req_id) -> None:
+        """Forward matching events as JSON-RPC notifications until the
+        subscription dies or the socket closes (reference:
+        events.go:50-85)."""
+        try:
+            while not ws.closed.is_set():
+                msg = await sub.next()
+                await ws.send_json(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": req_id,
+                        "result": {
+                            "query": query,
+                            "data": {
+                                "type": type(msg.data).__name__,
+                                "value": encode(msg.data),
+                            },
+                            "events": encode(msg.events),
+                        },
+                    }
+                )
+        except SubscriptionError:
+            pass  # cancelled or terminated
+        except asyncio.CancelledError:
+            pass
+
+    async def unsubscribe(self, req: RPCRequest):
+        if req.ws is None or self.event_bus is None:
+            raise RPCError(
+                INVALID_PARAMS, "unsubscribe requires a websocket connection"
+            )
+        query = req.params.get("query")
+        if not isinstance(query, str):
+            raise RPCError(INVALID_PARAMS, "missing query param")
+        try:
+            self.event_bus.unsubscribe(req.ws.client_id, query)
+        except SubscriptionError:
+            raise RPCError(INVALID_PARAMS, "subscription not found")
+        self._ws_subs.get(req.ws.client_id, set()).discard(query)
+        return {}
+
+    async def unsubscribe_all(self, req: RPCRequest):
+        if req.ws is None or self.event_bus is None:
+            raise RPCError(
+                INVALID_PARAMS,
+                "unsubscribe_all requires a websocket connection",
+            )
+        try:
+            self.event_bus.unsubscribe_all(req.ws.client_id)
+        except SubscriptionError:
+            pass  # idempotent: no subscriptions is fine
+        self._ws_subs.pop(req.ws.client_id, None)
+        return {}
+
+    def _ws_disconnected(self, ws) -> None:
+        if self.event_bus is not None:
+            try:
+                self.event_bus.unsubscribe_all(ws.client_id)
+            except SubscriptionError:
+                pass  # client already unsubscribed everything
+        self._ws_subs.pop(ws.client_id, None)

@@ -1,0 +1,453 @@
+"""Minimal JSON-RPC 2.0 server over HTTP POST, URI GET, and websocket.
+
+reference: rpc/jsonrpc/server/{http_json_handler,http_uri_handler,
+ws_handler}.go. Re-designed for asyncio streams: one handler task per
+TCP connection, no external HTTP framework. The websocket side
+implements the RFC 6455 subset the reference's ws clients use (text
+frames, ping/pong, close), because `subscribe` is only meaningful on a
+persistent duplex connection (routes.go:30-33).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import json
+import struct
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable, Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+from ..libs.log import get_logger
+
+__all__ = [
+    "RPCError",
+    "RPCRequest",
+    "JSONRPCServer",
+    "WSConn",
+    "PARSE_ERROR",
+    "INVALID_REQUEST",
+    "METHOD_NOT_FOUND",
+    "INVALID_PARAMS",
+    "INTERNAL_ERROR",
+]
+
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class RPCError(Exception):
+    """Carries a JSON-RPC error code + message to the client."""
+
+    def __init__(self, code: int, message: str, data: Optional[str] = None):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+    def to_obj(self) -> dict:
+        err: Dict[str, Any] = {"code": self.code, "message": self.message}
+        if self.data is not None:
+            err["data"] = self.data
+        return err
+
+
+@dataclass
+class RPCRequest:
+    """One decoded request, transport-independent."""
+
+    method: str
+    params: Dict[str, Any]
+    req_id: Any
+    # set only for websocket requests: lets handlers (subscribe) push
+    # frames outside the request/response cycle
+    ws: Optional["WSConn"] = None
+
+
+# handler(request) -> result object (JSON-encodable)
+Handler = Callable[[RPCRequest], Awaitable[Any]]
+
+
+def _response(req_id: Any, result: Any = None, error: Optional[dict] = None):
+    obj: Dict[str, Any] = {"jsonrpc": "2.0", "id": req_id}
+    if error is not None:
+        obj["error"] = error
+    else:
+        obj["result"] = result
+    return obj
+
+
+class WSConn:
+    """Server side of one websocket connection.
+
+    Owns the write half (single writer task -> no interleaved frames)
+    and tracks the client id used for eventbus subscriptions so the
+    server can unsubscribe on disconnect (reference:
+    rpc/jsonrpc/server/ws_handler.go OnDisconnect).
+    """
+
+    def __init__(self, reader, writer, remote: str) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.remote = remote
+        self.client_id = f"ws-{remote}"
+        self._sendq: asyncio.Queue = asyncio.Queue(maxsize=512)
+        self.closed = asyncio.Event()
+        self.on_close: Optional[Callable[["WSConn"], None]] = None
+
+    async def send_json(self, obj: Any) -> None:
+        if self.closed.is_set():
+            return
+        try:
+            self._sendq.put_nowait(("text", json.dumps(obj)))
+        except asyncio.QueueFull:
+            # slow client: drop the connection rather than buffer
+            # unboundedly (reference pubsub terminates slow subscribers)
+            self._close()
+
+    def _close(self) -> None:
+        if not self.closed.is_set():
+            self.closed.set()
+            if self.on_close is not None:
+                self.on_close(self)
+
+    async def _writer_loop(self) -> None:
+        closed = asyncio.ensure_future(self.closed.wait())
+        try:
+            while not self.closed.is_set():
+                get = asyncio.ensure_future(self._sendq.get())
+                done, _ = await asyncio.wait(
+                    [get, closed], return_when=asyncio.FIRST_COMPLETED
+                )
+                if get not in done:
+                    get.cancel()
+                    break
+                kind, payload = get.result()
+                if kind == "text":
+                    frame = _encode_frame(0x1, payload.encode())
+                elif kind == "pong":
+                    frame = _encode_frame(0xA, payload)
+                else:  # close
+                    frame = _encode_frame(0x8, payload)
+                self.writer.write(frame)
+                await self.writer.drain()
+                if kind == "close":
+                    break
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            closed.cancel()
+            self._close()
+
+
+def _encode_frame(opcode: int, payload: bytes) -> bytes:
+    """Server->client frame (unmasked, FIN set)."""
+    head = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        head += bytes([n])
+    elif n < (1 << 16):
+        head += bytes([126]) + struct.pack(">H", n)
+    else:
+        head += bytes([127]) + struct.pack(">Q", n)
+    return head + payload
+
+
+async def _read_frame(reader) -> tuple[int, bytes]:
+    """Returns (opcode, payload) of one client frame (handles masking
+    and fragmentation-free messages; continuation frames are
+    concatenated by the caller loop)."""
+    h = await reader.readexactly(2)
+    opcode = h[0] & 0x0F
+    masked = h[1] & 0x80
+    n = h[1] & 0x7F
+    if n == 126:
+        n = struct.unpack(">H", await reader.readexactly(2))[0]
+    elif n == 127:
+        n = struct.unpack(">Q", await reader.readexactly(8))[0]
+    if n > (10 << 20):
+        raise ConnectionError("websocket frame too large")
+    mask = await reader.readexactly(4) if masked else b"\x00" * 4
+    data = bytearray(await reader.readexactly(n))
+    if masked:
+        for i in range(n):
+            data[i] ^= mask[i % 4]
+    return opcode, bytes(data)
+
+
+class JSONRPCServer:
+    """Routes JSON-RPC methods; speaks HTTP/1.1 + websocket upgrade.
+
+    URI GET form: /method?param=value with JSON-encoded values (strings
+    may be bare). POST form: JSON-RPC 2.0 single or batch. Websocket
+    endpoint at /websocket (reference: rpc/jsonrpc/server).
+    """
+
+    def __init__(
+        self,
+        routes: Dict[str, Handler],
+        max_body_bytes: int = 1_000_000,
+    ) -> None:
+        self.routes = routes
+        self.max_body_bytes = max_body_bytes
+        self.logger = get_logger("rpc.server")
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
+        self._ws_conns: set[WSConn] = set()
+
+    async def start(self, host: str, port: int) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, port
+        )
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for ws in list(self._ws_conns):
+            ws._close()
+        for task in list(self._conns):
+            task.cancel()
+        self._conns.clear()
+
+    # -- connection handling --
+
+    async def _handle_conn(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        self._conns.add(task)
+        try:
+            await self._serve_http(reader, writer)
+        except (
+            ConnectionError,
+            asyncio.IncompleteReadError,
+            asyncio.TimeoutError,
+        ):
+            pass
+        except Exception as e:  # pragma: no cover - defensive
+            self.logger.error("rpc conn error", err=repr(e))
+        finally:
+            self._conns.discard(task)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _serve_http(self, reader, writer) -> None:
+        while True:
+            req_line = await reader.readline()
+            if not req_line:
+                return
+            try:
+                method, target, _version = (
+                    req_line.decode("latin-1").strip().split(" ", 2)
+                )
+            except ValueError:
+                return
+            headers: Dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin-1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+
+            if headers.get("upgrade", "").lower() == "websocket":
+                await self._serve_websocket(reader, writer, headers)
+                return
+
+            body = b""
+            n = int(headers.get("content-length", "0") or "0")
+            if n > self.max_body_bytes:
+                await self._http_reply(writer, 413, b"body too large")
+                return
+            if n:
+                body = await reader.readexactly(n)
+
+            if method == "POST":
+                resp = await self._handle_post_body(body)
+            elif method == "GET":
+                resp = await self._handle_uri(target)
+            else:
+                await self._http_reply(writer, 405, b"method not allowed")
+                return
+            # default=str: a handler returning an exotic object must not
+            # kill the connection mid-response
+            payload = json.dumps(resp, default=str).encode()
+            await self._http_reply(
+                writer, 200, payload, ctype="application/json"
+            )
+            if headers.get("connection", "").lower() == "close":
+                return
+
+    async def _http_reply(
+        self, writer, status: int, body: bytes, ctype: str = "text/plain"
+    ) -> None:
+        reason = {200: "OK", 405: "Method Not Allowed", 413: "Too Large"}.get(
+            status, "Error"
+        )
+        writer.write(
+            (
+                f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "\r\n"
+            ).encode()
+            + body
+        )
+        await writer.drain()
+
+    # -- POST / GET dispatch --
+
+    async def _handle_post_body(self, body: bytes):
+        try:
+            obj = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return _response(
+                None, error=RPCError(PARSE_ERROR, "parse error").to_obj()
+            )
+        if isinstance(obj, list):
+            return [await self._dispatch_obj(o) for o in obj]
+        return await self._dispatch_obj(obj)
+
+    async def _dispatch_obj(self, obj: Any, ws: Optional[WSConn] = None):
+        if not isinstance(obj, dict) or "method" not in obj:
+            return _response(
+                None,
+                error=RPCError(INVALID_REQUEST, "invalid request").to_obj(),
+            )
+        req_id = obj.get("id")
+        params = obj.get("params") or {}
+        if isinstance(params, list):
+            return _response(
+                req_id,
+                error=RPCError(
+                    INVALID_PARAMS, "positional params not supported"
+                ).to_obj(),
+            )
+        req = RPCRequest(
+            method=obj["method"], params=params, req_id=req_id, ws=ws
+        )
+        return await self._dispatch(req)
+
+    async def _handle_uri(self, target: str):
+        parts = urlsplit(target)
+        method = parts.path.strip("/")
+        if method == "":
+            # route listing, like the reference's index page
+            return _response(None, result=sorted(self.routes))
+        params: Dict[str, Any] = {}
+        for k, v in parse_qsl(parts.query):
+            try:
+                params[k] = json.loads(v)
+            except ValueError:
+                params[k] = v  # bare string
+        return await self._dispatch(
+            RPCRequest(method=method, params=params, req_id=-1)
+        )
+
+    async def _dispatch(self, req: RPCRequest):
+        handler = self.routes.get(req.method)
+        if handler is None:
+            return _response(
+                req.req_id,
+                error=RPCError(
+                    METHOD_NOT_FOUND, f"unknown method {req.method!r}"
+                ).to_obj(),
+            )
+        try:
+            result = await handler(req)
+        except RPCError as e:
+            return _response(req.req_id, error=e.to_obj())
+        except (TypeError, ValueError, KeyError) as e:
+            # int()/decode failures on client-supplied params; logged so
+            # a genuine server bug surfacing here stays visible
+            self.logger.info(
+                "rpc invalid params", method=req.method, err=repr(e)
+            )
+            return _response(
+                req.req_id,
+                error=RPCError(INVALID_PARAMS, str(e)).to_obj(),
+            )
+        except Exception as e:
+            self.logger.error(
+                "rpc handler error", method=req.method, err=repr(e)
+            )
+            return _response(
+                req.req_id,
+                error=RPCError(INTERNAL_ERROR, repr(e)).to_obj(),
+            )
+        return _response(req.req_id, result=result)
+
+    # -- websocket --
+
+    async def _serve_websocket(self, reader, writer, headers) -> None:
+        key = headers.get("sec-websocket-key")
+        if not key:
+            await self._http_reply(writer, 405, b"bad websocket handshake")
+            return
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        writer.write(
+            (
+                "HTTP/1.1 101 Switching Protocols\r\n"
+                "Upgrade: websocket\r\n"
+                "Connection: Upgrade\r\n"
+                f"Sec-WebSocket-Accept: {accept}\r\n\r\n"
+            ).encode()
+        )
+        await writer.drain()
+
+        peer = writer.get_extra_info("peername")
+        remote = f"{peer[0]}:{peer[1]}" if peer else "unknown"
+        ws = WSConn(reader, writer, remote)
+        self._ws_conns.add(ws)
+        wtask = asyncio.ensure_future(ws._writer_loop())
+        msg = bytearray()
+        try:
+            while True:
+                opcode, payload = await _read_frame(reader)
+                if opcode == 0x8:  # close
+                    ws._sendq.put_nowait(("close", payload[:2]))
+                    break
+                if opcode == 0x9:  # ping
+                    ws._sendq.put_nowait(("pong", payload))
+                    continue
+                if opcode in (0x1, 0x2, 0x0):
+                    msg.extend(payload)
+                    # FIN bit already folded into _read_frame? no —
+                    # reference clients don't fragment; treat each
+                    # data frame as a complete message.
+                    try:
+                        obj = json.loads(bytes(msg))
+                    except ValueError:
+                        await ws.send_json(
+                            _response(
+                                None,
+                                error=RPCError(
+                                    PARSE_ERROR, "parse error"
+                                ).to_obj(),
+                            )
+                        )
+                        msg.clear()
+                        continue
+                    msg.clear()
+                    resp = await self._dispatch_obj(obj, ws=ws)
+                    await ws.send_json(resp)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            ws._close()
+            self._ws_conns.discard(ws)
+            wtask.cancel()

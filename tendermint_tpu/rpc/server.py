@@ -1,0 +1,56 @@
+"""RPC server service: binds the route table to the JSON-RPC machinery.
+
+reference: node/node.go:480-540 (startRPC) + rpc/jsonrpc/server.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.log import get_logger
+from ..libs.service import Service
+from .core import Environment
+from .jsonrpc import JSONRPCServer
+
+__all__ = ["RPCServer"]
+
+
+def _split_laddr(laddr: str) -> tuple[str, int]:
+    addr = laddr.replace("tcp://", "")
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class RPCServer(Service):
+    """Serves the Environment's routes over HTTP/WS on cfg.rpc.laddr."""
+
+    def __init__(
+        self,
+        env: Environment,
+        laddr: str = "tcp://127.0.0.1:26657",
+        max_body_bytes: int = 1_000_000,
+    ) -> None:
+        super().__init__(name="rpc", logger=get_logger("rpc"))
+        self.env = env
+        self.laddr = laddr
+        self._srv: Optional[JSONRPCServer] = None
+        self._max_body = max_body_bytes
+
+    @property
+    def bound_port(self) -> int:
+        """Actual listen port (laddr may specify port 0 in tests)."""
+        assert self._srv is not None
+        return self._srv.bound_port
+
+    async def on_start(self) -> None:
+        host, port = _split_laddr(self.laddr)
+        self._srv = JSONRPCServer(
+            self.env.routes(), max_body_bytes=self._max_body
+        )
+        await self._srv.start(host, port)
+        self.logger.info("rpc server listening", addr=f"{host}:{self.bound_port}")
+
+    async def on_stop(self) -> None:
+        if self._srv is not None:
+            await self._srv.stop()
+            self._srv = None

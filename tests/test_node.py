@@ -47,6 +47,7 @@ def make_home(tmp_path, i: int, genesis: GenesisDoc,
     cfg.base.db_backend = "memdb"
     cfg.ensure_dirs()
     fast_consensus(cfg)
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"  # ephemeral port per node
     cfg.tpu.min_batch_size = 2  # 4-validator commits hit the device path
     genesis.save_as(cfg.base.path(cfg.base.genesis_file))
     if priv is not None:
