@@ -1,13 +1,25 @@
-"""Benchmark: ed25519 batch-verify throughput on the attached device.
+"""Benchmark suite: the BASELINE.md configs on the attached device.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-The metric is sig-verifies/sec/chip (BASELINE.json's primary metric) at
-batch 8192. `vs_baseline` is the speedup over this host's CPU
-single-verify path (OpenSSL via the `cryptography` wheel) measured in the
-same process — the reference publishes no absolute numbers, so the CPU
-baseline is measured, matching BASELINE.md's methodology.
+Primary metric (BASELINE.json): ed25519 sig-verifies/sec/chip at batch
+8192, with batches pipelined through the device (dispatch/gather) the
+way the node's verify path streams commits. `vs_baseline` is the
+speedup over this host's measured CPU single-verify rate (OpenSSL via
+the `cryptography` wheel) — the reference publishes no absolute numbers
+(BASELINE.md), and no Go toolchain exists in this image to run its
+batch harness, so the measured OpenSSL rate is the baseline and the
+`extra` dict reports everything needed to re-derive other comparisons.
+
+`extra` carries the remaining BASELINE.md configs:
+  - verify_commit_light p50/p95 latency @ 150 validators (config 3)
+  - verify_commit (all sigs) p50 latency @ 10k validators (config 5's
+    scale, ed25519-only until sr25519 lands)
+  - light-client sequential header sync rate @ 150 validators
+    (config 4, measured over a 50-header window)
+  - device round-trip latency (the axon tunnel adds ~50 ms per
+    synchronous call; pipelining hides it, p50 latencies include it)
 """
 
 from __future__ import annotations
@@ -29,7 +41,6 @@ def _make_batch(n: int, seed: int = 11):
 
     rng = np.random.default_rng(seed)
     pks, msgs, sigs = [], [], []
-    # sign with a handful of keys (signing cost isn't what we measure)
     keys = []
     for _ in range(min(n, 64)):
         sk = Ed25519PrivateKey.from_private_bytes(
@@ -47,47 +58,270 @@ def _make_batch(n: int, seed: int = 11):
     return pks, msgs, sigs
 
 
-def main() -> None:
+def bench_throughput():
+    """Primary: pipelined batch-verify throughput at batch 8192."""
     from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
 
     n = 8192
     pks, msgs, sigs = _make_batch(n)
-
     verifier = Ed25519Verifier(bucket_sizes=[n])
-    # warm-up: compile + first run
     ok = verifier.verify(pks, msgs, sigs)
     assert bool(ok.all()), "warm-up batch failed to verify"
 
-    reps = 5
+    depth = 4  # batches in flight
+    reps = 8
     t0 = time.perf_counter()
+    handles = []
     for _ in range(reps):
-        ok = verifier.verify(pks, msgs, sigs)
+        handles.append(verifier.dispatch(pks, msgs, sigs))
+        if len(handles) >= depth:
+            ok = verifier.gather(handles.pop(0))
+    for h in handles:
+        ok = verifier.gather(h)
     dt = (time.perf_counter() - t0) / reps
     assert bool(ok.all())
-    device_sigs_per_sec = n / dt
+    return n / dt
 
-    # CPU baseline: OpenSSL single verify over a slice, extrapolated
+
+def bench_cpu_baseline(pks, msgs, sigs):
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PublicKey,
     )
 
-    m = 512
-    handles = [Ed25519PublicKey.from_public_bytes(pk) for pk in pks[:m]]
+    m = len(pks)
+    handles = [Ed25519PublicKey.from_public_bytes(pk) for pk in pks]
     t0 = time.perf_counter()
-    for h, msg, sig in zip(handles, msgs[:m], sigs[:m]):
+    for h, msg, sig in zip(handles, msgs, sigs):
         h.verify(sig, msg)
-    cpu_dt = time.perf_counter() - t0
-    cpu_sigs_per_sec = m / cpu_dt
+    return m / (time.perf_counter() - t0)
 
+
+def _make_commit(n_vals: int, chain_id: str):
+    """A synthetic height-1 commit signed by all n_vals validators."""
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.commit import Commit, CommitSig
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
+
+    privs = [
+        PrivKeyEd25519.from_seed(
+            int(i).to_bytes(4, "big") + b"\x33" * 28
+        )
+        for i in range(n_vals)
+    ]
+    vals = ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+    )
+    block_id = BlockID(
+        hash=b"\xaa" * 32,
+        part_set_header=PartSetHeader(total=1, hash=b"\xbb" * 32),
+    )
+    now = time.time_ns()
+    order = {v.address: i for i, v in enumerate(vals.validators)}
+    commit_sigs = [None] * n_vals
+    for p in privs:
+        addr = p.pub_key().address()
+        vote = Vote(
+            type=PRECOMMIT_TYPE,
+            height=1,
+            round=0,
+            block_id=block_id,
+            timestamp_ns=now,
+            validator_address=addr,
+            validator_index=order[addr],
+        )
+        sig = p.sign(vote.sign_bytes(chain_id))
+        commit_sigs[order[addr]] = CommitSig.for_block(sig, addr, now)
+    return vals, Commit(
+        height=1, round=0, block_id=block_id, signatures=commit_sigs
+    )
+
+
+def bench_commit_latency(n_vals: int, reps: int, light: bool):
+    """p50/p95 wall latency of a full commit verification on device."""
+    from tendermint_tpu.crypto import tpu_verifier
+    from tendermint_tpu.types import validation
+
+    tpu_verifier.install(min_batch=2)
+    chain_id = f"bench-{n_vals}"
+    vals, commit = _make_commit(n_vals, chain_id)
+    fn = (
+        validation.verify_commit_light if light else validation.verify_commit
+    )
+    # warm-up compiles the bucket
+    fn(chain_id, vals, commit.block_id, 1, commit)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(chain_id, vals, commit.block_id, 1, commit)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return (
+        times[len(times) // 2] * 1e3,
+        times[int(len(times) * 0.95)] * 1e3,
+    )
+
+
+def _build_light_chain(chain_id: str, n_heights: int, n_vals: int):
+    """A verifiable chain of LightBlocks 1..n_heights with a static
+    n_vals validator set (the BASELINE config-4 shape)."""
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
+    from tendermint_tpu.types.commit import Commit, CommitSig
+    from tendermint_tpu.types.header import Consensus, Header
+    from tendermint_tpu.types.light import LightBlock, SignedHeader
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+
+    privs = [
+        PrivKeyEd25519.from_seed(int(i).to_bytes(4, "big") + b"\x44" * 28)
+        for i in range(n_vals)
+    ]
+    vals = ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+    )
+    order = {p.pub_key().address(): i for i, p in enumerate(privs)}
+    base_ns = time.time_ns() - n_heights * 2_000_000_000
+    blocks = {}
+    prev_bid = BlockID()
+    for h in range(1, n_heights + 1):
+        header = Header(
+            version=Consensus(block=11),
+            chain_id=chain_id,
+            height=h,
+            time_ns=base_ns + h * 1_000_000_000,
+            last_block_id=prev_bid,
+            validators_hash=vals.hash(),
+            next_validators_hash=vals.hash(),
+            app_hash=b"\x07" * 32,
+            proposer_address=vals.validators[0].address,
+        )
+        bid = BlockID(
+            hash=header.hash(),
+            part_set_header=PartSetHeader(total=1, hash=b"\x22" * 32),
+        )
+        commit_sigs = [None] * n_vals
+        for p in privs:
+            addr = p.pub_key().address()
+            vote = Vote(
+                type=PRECOMMIT_TYPE,
+                height=h,
+                round=0,
+                block_id=bid,
+                timestamp_ns=header.time_ns,
+                validator_address=addr,
+                validator_index=order[addr],
+            )
+            sig = p.sign(vote.sign_bytes(chain_id))
+            commit_sigs[order[addr]] = CommitSig.for_block(
+                sig, addr, header.time_ns
+            )
+        blocks[h] = LightBlock(
+            signed_header=SignedHeader(
+                header=header,
+                commit=Commit(
+                    height=h, round=0, block_id=bid, signatures=commit_sigs
+                ),
+            ),
+            validator_set=vals,
+        )
+        prev_bid = bid
+    return blocks
+
+
+def bench_light_sync(n_vals: int = 150, n_headers: int = 50):
+    """Light-client sequential sync rate (BASELINE config 4 at reduced
+    header count; reported as headers/s)."""
+    import asyncio
+
+    from tendermint_tpu.crypto import tpu_verifier
+    from tendermint_tpu.light import Client, LightStore, TrustOptions
+    from tendermint_tpu.light.provider import Provider
+    from tendermint_tpu.store.kv import MemKV
+
+    tpu_verifier.install(min_batch=2)
+    chain_id = "bench-light"
+    lbs = _build_light_chain(chain_id, n_headers + 1, n_vals)
+
+    class P(Provider):
+        def id(self):
+            return "bench"
+
+        async def light_block(self, height):
+            return lbs[height if height > 0 else max(lbs)]
+
+        async def report_evidence(self, ev):
+            pass
+
+    async def go():
+        lc = Client(
+            chain_id,
+            TrustOptions(
+                period_ns=10**18,
+                height=1,
+                hash=lbs[1].signed_header.hash(),
+            ),
+            P(),
+            [],
+            LightStore(MemKV()),
+            sequential=True,
+        )
+        t0 = time.perf_counter()
+        await lc.verify_light_block_at_height(n_headers + 1, time.time_ns())
+        return n_headers / (time.perf_counter() - t0)
+
+    return asyncio.run(go())
+
+
+def bench_device_rtt():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.int32)
+    f(x).block_until_ready()
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] * 1e3
+
+
+def main() -> None:
+    pks, msgs, sigs = _make_batch(512, seed=7)
+    cpu_rate = bench_cpu_baseline(pks, msgs, sigs)
+    device_rate = bench_throughput()
+    rtt_ms = bench_device_rtt()
+    p50_150, p95_150 = bench_commit_latency(150, reps=20, light=True)
+    p50_10k, p95_10k = bench_commit_latency(10_000, reps=10, light=False)
+    try:
+        light_rate = bench_light_sync()
+    except Exception as e:  # pragma: no cover - keep the primary line
+        light_rate = None
+        light_err = repr(e)
     print(
         json.dumps(
             {
                 "metric": "ed25519_batch_verify_throughput",
-                "value": round(device_sigs_per_sec, 1),
+                "value": round(device_rate, 1),
                 "unit": "sigs/s/chip",
-                "vs_baseline": round(
-                    device_sigs_per_sec / cpu_sigs_per_sec, 3
-                ),
+                "vs_baseline": round(device_rate / cpu_rate, 3),
+                "extra": {
+                    "cpu_single_verify_sigs_per_s": round(cpu_rate, 1),
+                    "device_rtt_ms_p50": round(rtt_ms, 2),
+                    "verify_commit_light_150_p50_ms": round(p50_150, 2),
+                    "verify_commit_light_150_p95_ms": round(p95_150, 2),
+                    "verify_commit_10k_p50_ms": round(p50_10k, 2),
+                    "verify_commit_10k_p95_ms": round(p95_10k, 2),
+                    "light_sync_headers_per_s_150vals": (
+                        round(light_rate, 2) if light_rate else light_err
+                    ),
+                },
             }
         )
     )

@@ -309,9 +309,23 @@ class Ed25519Verifier:
         """Returns a bool bitmap, one per triple. Malformed inputs are
         reported invalid rather than raising (the BatchVerifier.add layer
         enforces sizes upstream)."""
+        return self.gather(self.dispatch(pubkeys, msgs, sigs))
+
+    def dispatch(
+        self,
+        pubkeys: Sequence[bytes],
+        msgs: Sequence[bytes],
+        sigs: Sequence[bytes],
+    ):
+        """Asynchronously launch verification; returns an opaque handle
+        for gather(). Device dispatch is non-blocking in JAX, so several
+        batches can be in flight at once — on a tunneled device this
+        hides the per-call round-trip latency (the verify-ahead pattern
+        from SURVEY §7: stream commits through the device without
+        stalling the consensus loop)."""
         n = len(pubkeys)
         if n == 0:
-            return np.zeros(0, dtype=bool)
+            return (None, 0, np.zeros(0, dtype=bool))
         size_ok = np.array(
             [
                 len(pk) == 32 and len(sig) == 64
@@ -346,8 +360,14 @@ class Ed25519Verifier:
         ok = self._program(bucket)(
             jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
         )
-        ok = np.asarray(ok)[:n]
-        return ok & size_ok
+        return (ok, n, size_ok)
+
+    def gather(self, handle) -> np.ndarray:
+        """Block on a dispatch() handle and return the bitmap."""
+        ok, n, size_ok = handle
+        if ok is None:
+            return size_ok
+        return np.asarray(ok)[:n] & size_ok
 
 
 _DEFAULT: Optional[Ed25519Verifier] = None

@@ -225,20 +225,11 @@ class WSClient:
         return head + mask + body
 
     async def _recv_loop(self) -> None:
+        from .jsonrpc import _read_frame  # shared parser (+ size guard)
+
         try:
             while True:
-                h = await self._reader.readexactly(2)
-                opcode = h[0] & 0x0F
-                n = h[1] & 0x7F
-                if n == 126:
-                    n = struct.unpack(
-                        ">H", await self._reader.readexactly(2)
-                    )[0]
-                elif n == 127:
-                    n = struct.unpack(
-                        ">Q", await self._reader.readexactly(8)
-                    )[0]
-                payload = await self._reader.readexactly(n)
+                opcode, payload = await _read_frame(self._reader)
                 if opcode == 0x8:
                     break
                 if opcode == 0x9:  # ping -> pong

@@ -555,7 +555,12 @@ class Environment:
             f"{tme.EVENT_TYPE_KEY}='{tme.EventValue.TX}'"
             f" AND {tme.TX_HASH_KEY}='{txh.hex().upper()}'"
         )
-        client_id = f"broadcast_tx_commit-{txh.hex()[:16]}"
+        # unique per request: concurrent submissions of the SAME tx must
+        # not collide on the (client_id, query) subscription key
+        self._commit_waiters = getattr(self, "_commit_waiters", 0) + 1
+        client_id = (
+            f"broadcast_tx_commit-{txh.hex()[:16]}-{self._commit_waiters}"
+        )
         try:
             sub = self.event_bus.subscribe(client_id, query, limit=1)
         except SubscriptionError as e:
@@ -762,6 +767,10 @@ class Environment:
         if not isinstance(query, str):
             raise RPCError(INVALID_PARAMS, "missing query param")
         ws = req.ws
+        # register cleanup BEFORE anything can fail: a client whose only
+        # subscribe attempts error out must still be swept on disconnect
+        if ws.on_close is None:
+            ws.on_close = self._ws_disconnected
         limit = (
             self.cfg.rpc.max_subscriptions_per_client
             if self.cfg is not None
@@ -781,8 +790,6 @@ class Environment:
         except ValueError as e:
             raise RPCError(INVALID_PARAMS, f"invalid query: {e}")
         subs.add(query)
-        if ws.on_close is None:
-            ws.on_close = self._ws_disconnected
         asyncio.ensure_future(self._pump_events(ws, sub, query, req.req_id))
         return {}
 

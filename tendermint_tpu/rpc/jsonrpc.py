@@ -114,6 +114,13 @@ class WSConn:
     def _close(self) -> None:
         if not self.closed.is_set():
             self.closed.set()
+            try:
+                # abort the transport so the read loop and the peer see
+                # the disconnect immediately (a slow subscriber must be
+                # dropped, not silently muted)
+                self.writer.close()
+            except Exception:
+                pass
             if self.on_close is not None:
                 self.on_close(self)
 

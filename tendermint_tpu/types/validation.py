@@ -188,14 +188,19 @@ def _verify_commit_batch(
     count_all_signatures: bool,
     look_up_by_index: bool,
 ) -> None:
-    """reference: types/validation.go:152-262. One device call for the
-    whole commit; on failure the bitmap localizes the first bad index."""
+    """reference: types/validation.go:152-262, extended for mixed-key
+    validator sets (the BASELINE mixed ed25519/sr25519 stress shape):
+    one batch verifier PER KEY TYPE, created lazily, so ed25519
+    signatures ride the device path while other types use their own CPU
+    batch verifiers. The reference's single-verifier form errors out of
+    mixed sets (its BatchVerifier.Add rejects foreign key types with no
+    fallback); grouping by type preserves its semantics for uniform
+    sets and makes mixed sets first-class. A key type with no batch
+    support at all (secp256k1) verifies inline."""
     tallied = 0
     seen_vals: dict[int, int] = {}
-    batch_sig_idxs: list[int] = []
-    bv = create_batch_verifier(
-        vals.get_proposer().pub_key, size_hint=len(commit.signatures)
-    )
+    # key type -> (verifier, [commit sig indexes added to it])
+    groups: dict[str, tuple] = {}
     for idx, commit_sig in enumerate(commit.signatures):
         if ignore_sig(commit_sig):
             continue
@@ -214,27 +219,55 @@ def _verify_commit_batch(
                 )
             seen_vals[val_idx] = idx
         vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
-        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
-        batch_sig_idxs.append(idx)
+        key_type = val.pub_key.type()
+        if not supports_batch_verifier(val.pub_key):
+            # no batch support for this type: verify inline
+            if not val.pub_key.verify_signature(
+                vote_sign_bytes, commit_sig.signature
+            ):
+                raise InvalidCommitError(
+                    f"wrong signature (#{idx}): "
+                    f"{commit_sig.signature.hex()}"
+                )
+        else:
+            group = groups.get(key_type)
+            if group is None:
+                group = (
+                    create_batch_verifier(
+                        val.pub_key, size_hint=len(commit.signatures)
+                    ),
+                    [],
+                )
+                groups[key_type] = group
+            group[0].add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+            group[1].append(idx)
         if count_sig(commit_sig):
             tallied += val.voting_power
         if not count_all_signatures and tallied > voting_power_needed:
             break
     if tallied <= voting_power_needed:
         raise NotEnoughVotingPowerError(tallied, voting_power_needed)
-    ok, valid_sigs = bv.verify()
-    if ok:
-        return
-    for i, sig_ok in enumerate(valid_sigs):
-        if not sig_ok:
-            idx = batch_sig_idxs[i]
-            raise InvalidCommitError(
-                f"wrong signature (#{idx}): "
-                f"{commit.signatures[idx].signature.hex()}"
+    first_bad: Optional[int] = None
+    for bv, batch_sig_idxs in groups.values():
+        ok, valid_sigs = bv.verify()
+        if ok:
+            continue
+        bad = [
+            batch_sig_idxs[i]
+            for i, sig_ok in enumerate(valid_sigs)
+            if not sig_ok
+        ]
+        if not bad:
+            raise RuntimeError(
+                "BUG: batch verification failed with no invalid signatures"
             )
-    raise RuntimeError(
-        "BUG: batch verification failed with no invalid signatures"
-    )
+        if first_bad is None or bad[0] < first_bad:
+            first_bad = bad[0]
+    if first_bad is not None:
+        raise InvalidCommitError(
+            f"wrong signature (#{first_bad}): "
+            f"{commit.signatures[first_bad].signature.hex()}"
+        )
 
 
 def _verify_commit_single(

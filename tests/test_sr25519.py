@@ -1,0 +1,192 @@
+"""sr25519 (schnorrkel over ristretto255) differential + seam tests
+(reference model: crypto/sr25519/sr25519_test.go, plus merlin's and
+RFC 9496's published vectors for the transcript/group layers)."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import ristretto as rst
+from tendermint_tpu.crypto.batch import (
+    create_batch_verifier,
+    supports_batch_verifier,
+)
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.crypto.merlin import Transcript
+from tendermint_tpu.crypto.sr25519 import (
+    PrivKeySr25519,
+    PubKeySr25519,
+    Sr25519BatchVerifier,
+)
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
+from tendermint_tpu.types.commit import Commit, CommitSig
+from tendermint_tpu.types.validation import (
+    InvalidCommitError,
+    verify_commit,
+    verify_commit_light,
+)
+from tendermint_tpu.types.validator import Validator, ValidatorSet
+from tendermint_tpu.types.vote import Vote
+
+
+def test_merlin_published_vector():
+    """merlin's transcript equivalence test vector (merlin crate,
+    transcript.rs tests)."""
+    t = Transcript(b"test protocol")
+    t.append_message(b"some label", b"some data")
+    c = t.challenge_bytes(b"challenge", 32)
+    assert c.hex() == (
+        "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+    )
+
+
+def test_ristretto_rfc9496_generator_multiples():
+    vectors = [
+        "0000000000000000000000000000000000000000000000000000000000000000",
+        "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+        "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+        "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+        "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    ]
+    for k, want in enumerate(vectors):
+        assert rst.encode(rst.mul_base(k)).hex() == want
+    # decode rejects non-canonical / negative encodings
+    assert rst.decode(b"\x01" + b"\x00" * 31) is None  # odd => negative
+    assert rst.decode(b"\xff" * 32) is None  # >= p
+
+
+def test_sign_verify_roundtrip():
+    sk = PrivKeySr25519.from_seed(b"\x0a" * 32)
+    pk = sk.pub_key()
+    assert pk.type() == "sr25519"
+    assert len(pk.bytes()) == 32
+    msg = b"consensus vote bytes"
+    sig = sk.sign(msg)
+    assert len(sig) == 64
+    assert sig[63] & 0x80  # schnorrkel v1 marker bit
+    assert pk.verify_signature(msg, sig)
+    assert not pk.verify_signature(msg + b"x", sig)
+    # tampered R and s both rejected
+    for i in (0, 40):
+        bad = bytearray(sig)
+        bad[i] ^= 1
+        assert not pk.verify_signature(msg, bytes(bad))
+    # missing marker bit rejected (pre-v0.1.1 format)
+    nomark = bytearray(sig)
+    nomark[63] &= 0x7F
+    assert not pk.verify_signature(msg, bytes(nomark))
+    # wrong key rejected
+    other = PrivKeySr25519.from_seed(b"\x0b" * 32).pub_key()
+    assert not other.verify_signature(msg, sig)
+
+
+def test_signatures_are_randomized_but_stable():
+    """schnorrkel mixes fresh randomness into the witness: two
+    signatures over the same message differ yet both verify."""
+    sk = PrivKeySr25519.from_seed(b"\x0c" * 32)
+    pk = sk.pub_key()
+    s1, s2 = sk.sign(b"m"), sk.sign(b"m")
+    assert s1 != s2
+    assert pk.verify_signature(b"m", s1)
+    assert pk.verify_signature(b"m", s2)
+
+
+def test_batch_verifier_seam():
+    sk = PrivKeySr25519.from_seed(b"\x0d" * 32)
+    assert supports_batch_verifier(sk.pub_key())
+    bv = create_batch_verifier(sk.pub_key())
+    assert isinstance(bv, Sr25519BatchVerifier)
+    sks = [PrivKeySr25519.from_seed(bytes([i]) * 32) for i in range(1, 7)]
+    msgs = [b"msg-%d" % i for i in range(6)]
+    sigs = [s.sign(m) for s, m in zip(sks, msgs)]
+    for s, m, sig in zip(sks, msgs, sigs):
+        bv.add(s.pub_key(), m, sig)
+    ok, bitmap = bv.verify()
+    assert ok and all(bitmap)
+    # one corrupted signature is localized
+    bv2 = create_batch_verifier(sk.pub_key())
+    for i, (s, m, sig) in enumerate(zip(sks, msgs, sigs)):
+        if i == 3:
+            sig = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+        bv2.add(s.pub_key(), m, sig)
+    ok, bitmap = bv2.verify()
+    assert not ok
+    assert bitmap == [True, True, True, False, True, True]
+    # foreign key type rejected at add()
+    with pytest.raises(TypeError):
+        bv2.add(PrivKeyEd25519.from_seed(b"\x01" * 32).pub_key(), b"m", b"s" * 64)
+
+
+def _mixed_commit(n_ed: int, n_sr: int, chain_id: str = "mixed-chain"):
+    privs = [
+        PrivKeyEd25519.from_seed(bytes([10 + i]) * 32) for i in range(n_ed)
+    ] + [
+        PrivKeySr25519.from_seed(bytes([60 + i]) * 32) for i in range(n_sr)
+    ]
+    vals = ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+    )
+    block_id = BlockID(
+        hash=b"\x11" * 32,
+        part_set_header=PartSetHeader(total=1, hash=b"\x22" * 32),
+    )
+    now = time.time_ns()
+    order = {v.address: i for i, v in enumerate(vals.validators)}
+    commit_sigs = [None] * len(privs)
+    for p in privs:
+        addr = p.pub_key().address()
+        vote = Vote(
+            type=PRECOMMIT_TYPE,
+            height=5,
+            round=0,
+            block_id=block_id,
+            timestamp_ns=now,
+            validator_address=addr,
+            validator_index=order[addr],
+        )
+        sig = p.sign(vote.sign_bytes(chain_id))
+        commit_sigs[order[addr]] = CommitSig.for_block(sig, addr, now)
+    commit = Commit(
+        height=5, round=0, block_id=block_id, signatures=commit_sigs
+    )
+    return vals, commit, block_id, privs, order
+
+
+class TestMixedKeyCommit:
+    """BASELINE stress config 5's shape: mixed ed25519/sr25519
+    validator sets through VerifyCommit — per-key-type batch grouping
+    (the reference's single-verifier batch errors out of mixed sets)."""
+
+    def test_mixed_commit_verifies(self):
+        vals, commit, block_id, _, _ = _mixed_commit(5, 4)
+        verify_commit("mixed-chain", vals, block_id, 5, commit)
+        verify_commit_light("mixed-chain", vals, block_id, 5, commit)
+
+    def test_mixed_commit_bad_sr_sig_flagged(self):
+        vals, commit, block_id, privs, order = _mixed_commit(5, 4)
+        # corrupt one sr25519 signature (validator index of the first
+        # sr25519 key)
+        sr_addr = privs[5].pub_key().address()
+        idx = order[sr_addr]
+        cs = commit.signatures[idx]
+        commit.signatures[idx] = CommitSig.for_block(
+            cs.signature[:-2] + bytes([cs.signature[-2] ^ 1, cs.signature[-1]]),
+            cs.validator_address,
+            cs.timestamp_ns,
+        )
+        with pytest.raises(InvalidCommitError, match=f"#{idx}"):
+            verify_commit("mixed-chain", vals, block_id, 5, commit)
+
+    def test_mixed_commit_bad_ed_sig_flagged(self):
+        vals, commit, block_id, privs, order = _mixed_commit(5, 4)
+        ed_addr = privs[2].pub_key().address()
+        idx = order[ed_addr]
+        cs = commit.signatures[idx]
+        commit.signatures[idx] = CommitSig.for_block(
+            bytes([cs.signature[0] ^ 1]) + cs.signature[1:],
+            cs.validator_address,
+            cs.timestamp_ns,
+        )
+        with pytest.raises(InvalidCommitError, match=f"#{idx}"):
+            verify_commit("mixed-chain", vals, block_id, 5, commit)
