@@ -20,6 +20,7 @@ from typing import List, Optional, Sequence
 
 __all__ = [
     "hash_from_byte_slices",
+    "verify_proofs_batch",
     "proofs_from_byte_slices",
     "Proof",
     "ProofOp",
@@ -32,6 +33,13 @@ __all__ = [
 
 _LEAF_PREFIX = b"\x00"
 _INNER_PREFIX = b"\x01"
+
+# Device offload hooks, set by ops.merkle_kernel.install(): each takes
+# the same inputs as the CPU path and returns None to decline (batch
+# too small), keeping CPU the default exactly like the BatchVerifier
+# seam (reference plugin boundary: crypto/crypto.go:53-61).
+_device_root_hook = None
+_device_proofs_hook = None
 
 
 def empty_hash() -> bytes:
@@ -55,10 +63,40 @@ def _split_point(n: int) -> int:
 
 def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     """Merkle root of the list (same tree shape as the reference's
-    recursive definition, crypto/merkle/tree.go:11-66)."""
+    recursive definition, crypto/merkle/tree.go:11-66). Large lists are
+    offloaded when the device backend is installed."""
     if not items:
         return empty_hash()
-    return _reduce([leaf_hash(it) for it in items])
+    leaf_hashes = [leaf_hash(it) for it in items]
+    if _device_root_hook is not None:
+        root = _device_root_hook(leaf_hashes)
+        if root is not None:
+            return root
+    return _reduce(leaf_hashes)
+
+
+def verify_proofs_batch(proofs, root_hash: bytes, leaves: Sequence[bytes]):
+    """Batch proof verification: bool bitmap, device-backed when
+    installed (reference shape: crypto/merkle/proof.go:52 Verify, run
+    per proof; the batch form is the merkle analog of
+    BatchVerifier.Verify)."""
+    import numpy as _np
+
+    checked = _np.array(
+        [
+            len(p.leaf_hash) == 32 and leaf_hash(leaf) == p.leaf_hash
+            for p, leaf in zip(proofs, leaves)
+        ],
+        dtype=bool,
+    )
+    if _device_proofs_hook is not None:
+        bitmap = _device_proofs_hook(proofs, root_hash)
+        if bitmap is not None:
+            return checked & bitmap
+    cpu = _np.array(
+        [p.compute_root_hash() == root_hash for p in proofs], dtype=bool
+    )
+    return checked & cpu
 
 
 def _reduce(hashes: List[bytes]) -> bytes:

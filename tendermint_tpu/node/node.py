@@ -99,6 +99,9 @@ class Node(Service):
                     prior=prior, new=cfg.tpu.min_batch_size,
                 )
             tpu_verifier.install(min_batch=cfg.tpu.min_batch_size)
+            from ..ops import merkle_kernel
+
+            merkle_kernel.install()
         elif tpu_verifier.installed() is not None:
             self.logger.info(
                 "tpu.enable=false but the device verifier is already "

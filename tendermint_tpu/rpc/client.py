@@ -225,11 +225,15 @@ class WSClient:
         return head + mask + body
 
     async def _recv_loop(self) -> None:
-        from .jsonrpc import _read_frame  # shared parser (+ size guard)
+        from .jsonrpc import _read_frame  # shared parser
 
         try:
             while True:
-                opcode, payload = await _read_frame(self._reader)
+                # responses from our own server (block dumps etc.) can
+                # legitimately exceed the server-side 10 MB guard
+                opcode, payload = await _read_frame(
+                    self._reader, max_frame=1 << 30
+                )
                 if opcode == 0x8:
                     break
                 if opcode == 0x9:  # ping -> pong

@@ -132,6 +132,7 @@ class Environment:
         # ws client_id -> set of query strings (for unsubscribe_all)
         self._ws_subs: Dict[str, set] = {}
         self._genesis_chunks: Optional[List[bytes]] = None
+        self._commit_waiters = 0  # uniquifies broadcast_tx_commit subs
 
     # -- route table (reference: routes.go:30-73) --
 
@@ -557,7 +558,7 @@ class Environment:
         )
         # unique per request: concurrent submissions of the SAME tx must
         # not collide on the (client_id, query) subscription key
-        self._commit_waiters = getattr(self, "_commit_waiters", 0) + 1
+        self._commit_waiters += 1
         client_id = (
             f"broadcast_tx_commit-{txh.hex()[:16]}-{self._commit_waiters}"
         )

@@ -166,10 +166,13 @@ def _encode_frame(opcode: int, payload: bytes) -> bytes:
     return head + payload
 
 
-async def _read_frame(reader) -> tuple[int, bytes]:
-    """Returns (opcode, payload) of one client frame (handles masking
-    and fragmentation-free messages; continuation frames are
-    concatenated by the caller loop)."""
+async def _read_frame(
+    reader, max_frame: int = 10 << 20
+) -> tuple[int, bytes]:
+    """Returns (opcode, payload) of one frame (handles masking and
+    fragmentation-free messages; continuation frames are concatenated
+    by the caller loop). max_frame bounds a hostile/corrupt declared
+    length — callers with trusted peers pass a larger cap."""
     h = await reader.readexactly(2)
     opcode = h[0] & 0x0F
     masked = h[1] & 0x80
@@ -178,7 +181,7 @@ async def _read_frame(reader) -> tuple[int, bytes]:
         n = struct.unpack(">H", await reader.readexactly(2))[0]
     elif n == 127:
         n = struct.unpack(">Q", await reader.readexactly(8))[0]
-    if n > (10 << 20):
+    if n > max_frame:
         raise ConnectionError("websocket frame too large")
     mask = await reader.readexactly(4) if masked else b"\x00" * 4
     data = bytearray(await reader.readexactly(n))

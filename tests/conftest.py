@@ -6,12 +6,23 @@ set before jax is imported anywhere.
 """
 
 import os
+import sys
 
 # The ambient environment may pin JAX_PLATFORMS to the real TPU backend;
 # unit tests always run on a virtual 8-device CPU mesh so sharding and
 # collective paths are exercised deterministically (and the TPU tunnel is
 # left to bench.py). jax.config wins over the env pin.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop the device-plugin site dir from the import path entirely: plugin
+# *discovery* opens the device tunnel even under JAX_PLATFORMS=cpu, and a
+# wedged tunnel then hangs every test process at jax import. Match the
+# exact directory name, not a substring of the whole path.
+_PLUGIN_DIR = ".axon_site"
+sys.path = [p for p in sys.path if os.path.basename(p) != _PLUGIN_DIR]
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and os.path.basename(p) != _PLUGIN_DIR
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

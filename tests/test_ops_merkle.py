@@ -1,0 +1,138 @@
+"""Differential tests: device SHA-256/merkle kernels vs the CPU oracle
+(the reference's own batch-vs-single equivalence pattern,
+types/validation.go:146-148, applied to crypto/merkle)."""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.ops import merkle_kernel as MK
+from tendermint_tpu.ops import sha256_kernel as SK
+
+random.seed(99)
+
+
+def _rand(n: int) -> bytes:
+    return bytes(random.randrange(256) for _ in range(n))
+
+
+def _cols(items):
+    return jnp.asarray(
+        np.frombuffer(b"".join(items), dtype=np.uint8).reshape(
+            len(items), -1
+        ).T
+    )
+
+
+class TestSha256Kernel:
+    @pytest.mark.parametrize("length", [0, 1, 32, 55, 56, 64, 65, 119, 200])
+    def test_matches_hashlib_across_padding_boundaries(self, length):
+        msgs = [_rand(length) for _ in range(7)]
+        got = np.asarray(SK.sha256_fixed(_cols(msgs) if length else
+                                         jnp.zeros((0, 7), jnp.uint8)))
+        for i, m in enumerate(msgs):
+            assert got[:, i].tobytes() == hashlib.sha256(m).digest()
+
+    def test_leaf_and_inner_prefixes(self):
+        leaves = [_rand(40) for _ in range(5)]
+        got = np.asarray(SK.leaf_hash_batch(_cols(leaves)))
+        for i, leaf in enumerate(leaves):
+            assert got[:, i].tobytes() == merkle.leaf_hash(leaf)
+        lefts = [_rand(32) for _ in range(5)]
+        rights = [_rand(32) for _ in range(5)]
+        got = np.asarray(SK.inner_hash_batch(_cols(lefts), _cols(rights)))
+        for i in range(5):
+            assert got[:, i].tobytes() == merkle.inner_hash(
+                lefts[i], rights[i]
+            )
+
+
+class TestTreeRoot:
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 13, 64, 100, 257])
+    def test_matches_cpu_tree_shape(self, n):
+        """Pairwise level reduction must reproduce the reference's
+        split-point tree for every size, power of two or not."""
+        items = [_rand(random.randrange(1, 80)) for _ in range(n)]
+        want = merkle.hash_from_byte_slices(items)
+        got = MK.tree_root([merkle.leaf_hash(it) for it in items])
+        assert got == want
+
+
+class TestProofVerification:
+    def test_batch_verify_valid_and_corrupted(self):
+        items = [b"item-%d" % i for i in range(37)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        # all valid
+        bitmap = MK.verify_proofs(proofs, root)
+        assert bitmap.all() and len(bitmap) == 37
+        # corrupt one aunt, one leaf hash, one index
+        proofs[5].aunts[0] = bytes(32)
+        proofs[11].leaf_hash = bytes(32)
+        proofs[20].index = 21
+        bitmap = MK.verify_proofs(proofs, root)
+        expect = np.ones(37, dtype=bool)
+        expect[[5, 11, 20]] = False
+        # index 21 now carries proof-of-20's aunts: wrong root
+        assert (bitmap == expect).all(), np.nonzero(bitmap != expect)
+
+    def test_mixed_depths_one_program(self):
+        """Proofs from trees of different sizes (different depths) pad
+        into one scan."""
+        items_a = [b"a%d" % i for i in range(3)]
+        items_b = [b"b%d" % i for i in range(64)]
+        root_a, proofs_a = merkle.proofs_from_byte_slices(items_a)
+        root_b, proofs_b = merkle.proofs_from_byte_slices(items_b)
+        assert MK.verify_proofs(proofs_a, root_a).all()
+        assert MK.verify_proofs(proofs_b, root_b).all()
+        # cross-root check fails
+        assert not MK.verify_proofs(proofs_a, root_b).any()
+
+    def test_structurally_invalid_reported_false(self):
+        items = [b"x%d" % i for i in range(8)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        proofs[2].total = 0
+        proofs[3].aunts = proofs[3].aunts[:-1]  # wrong depth
+        bitmap = MK.verify_proofs(proofs, root)
+        expect = np.ones(8, dtype=bool)
+        expect[[2, 3]] = False
+        assert (bitmap == expect).all()
+
+
+class TestInstallGate:
+    def test_hash_from_byte_slices_routes_large_lists(self):
+        items = [b"tx-%d" % i for i in range(600)]
+        want_cpu = merkle.hash_from_byte_slices(items)
+        MK.install(min_leaves=512)
+        try:
+            before = MK.stats()["roots"]
+            got = merkle.hash_from_byte_slices(items)
+            assert got == want_cpu
+            assert MK.stats()["roots"] == before + 1
+            # small lists stay on CPU
+            small = [b"s%d" % i for i in range(4)]
+            r = merkle.hash_from_byte_slices(small)
+            assert MK.stats()["roots"] == before + 1
+            assert r == merkle.hash_from_byte_slices(small)
+        finally:
+            MK.uninstall()
+
+    def test_verify_proofs_batch_seam(self):
+        items = [b"p%d" % i for i in range(80)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        MK.install(min_leaves=16)
+        try:
+            bitmap = merkle.verify_proofs_batch(proofs, root, items)
+            assert bitmap.all()
+            # a tampered LEAF (not just proof) is caught by the
+            # leaf-hash pre-check
+            items2 = list(items)
+            items2[7] = b"tampered"
+            bitmap = merkle.verify_proofs_batch(proofs, root, items2)
+            assert not bitmap[7] and bitmap.sum() == 79
+        finally:
+            MK.uninstall()
