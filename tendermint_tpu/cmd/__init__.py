@@ -1,1 +1,3 @@
+"""Operator CLI package (reference: cmd/tendermint/)."""
 
+from .commands import build_parser, main  # noqa: F401

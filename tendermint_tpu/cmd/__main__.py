@@ -1,0 +1,7 @@
+"""`python -m tendermint_tpu.cmd` entry point."""
+
+import sys
+
+from .commands import main
+
+sys.exit(main())

@@ -1,0 +1,591 @@
+"""Operator CLI: `python -m tendermint_tpu.cmd <command>`.
+
+reference: cmd/tendermint/commands/ (init, run_node/start, light,
+rollback, testnet, gen_validator, gen_node_key, show_validator,
+show_node_id, reset, inspect, replay, version). argparse instead of
+cobra; every command operates on a --home directory laid out exactly
+like make_node expects (config/config.toml, config/genesis.json,
+config/node_key.json, config/priv_validator_key.json, data/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from .. import version as _version
+from ..config import Config, load_config, write_config
+from ..crypto.ed25519 import PrivKeyEd25519
+
+
+def _config_path(home: str) -> str:
+    return os.path.join(os.path.expanduser(home), "config", "config.toml")
+
+
+def _load_home(home: str) -> Config:
+    path = _config_path(home)
+    if os.path.exists(path):
+        cfg = load_config(path)
+    else:
+        cfg = Config()
+    cfg.base.home = home
+    return cfg
+
+
+# -- init (reference: commands/init.go) -------------------------------------
+
+
+def cmd_init(args) -> int:
+    from ..node.key import NodeKey
+    from ..privval import FilePV
+    from ..types.genesis import GenesisDoc, GenesisValidator
+
+    cfg = Config()
+    cfg.base.home = args.home
+    cfg.base.mode = args.mode
+    cfg.base.moniker = args.moniker
+    cfg.ensure_dirs()
+
+    genesis_path = cfg.base.path(cfg.base.genesis_file)
+    pv = None
+    if args.mode == "validator":
+        pv = FilePV.load_or_generate(
+            cfg.base.path(cfg.priv_validator.key_file),
+            cfg.base.path(cfg.priv_validator.state_file),
+        )
+    if os.path.exists(genesis_path):
+        print(f"found genesis file {genesis_path}")
+        genesis = GenesisDoc.from_file(genesis_path)
+    else:
+        chain_id = args.chain_id or f"test-chain-{os.urandom(3).hex()}"
+        validators = []
+        if pv is not None:
+            validators.append(
+                GenesisValidator(pub_key=pv.key.pub_key, power=10)
+            )
+        genesis = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time_ns=time.time_ns(),
+            validators=validators,
+        )
+        genesis.save_as(genesis_path)
+        print(f"generated genesis file {genesis_path}")
+    cfg.base.chain_id = genesis.chain_id
+    NodeKey.load_or_generate(cfg.base.path(cfg.base.node_key_file))
+    write_config(cfg, _config_path(args.home))
+    print(f"initialized {args.mode} node in {cfg.base.root()}")
+    return 0
+
+
+# -- start (reference: commands/run_node.go) --------------------------------
+
+
+def cmd_start(args) -> int:
+    from ..node import make_node
+
+    cfg = _load_home(args.home)
+    if args.moniker:
+        cfg.base.moniker = args.moniker
+
+    async def run() -> None:
+        node = make_node(cfg)
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        # a failed start tears itself down (Node.on_start wraps
+        # _start_impl in its own teardown), so only a SUCCESSFUL start
+        # owes a stop() here
+        await node.start()
+        try:
+            await stop.wait()
+        finally:
+            await node.stop()
+
+    asyncio.run(run())
+    return 0
+
+
+# -- key / identity commands ------------------------------------------------
+
+
+def cmd_gen_validator(args) -> int:
+    """reference: commands/gen_validator.go — prints a fresh key."""
+    from ..privval import FilePV
+
+    priv = PrivKeyEd25519.generate()
+    out = {
+        "address": priv.pub_key().address().hex().upper(),
+        "pub_key": {"type": "ed25519", "value": priv.pub_key().bytes().hex()},
+        "priv_key": {"type": "ed25519", "value": priv.bytes().hex()},
+    }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    """Write a fresh node key into --home and print its ID; refuses to
+    overwrite (reference: commands/gen_node_key.go)."""
+    from ..node.key import NodeKey
+
+    cfg = _load_home(args.home)
+    cfg.ensure_dirs()
+    path = cfg.base.path(cfg.base.node_key_file)
+    if os.path.exists(path):
+        print(f"node key file already exists at {path}", file=sys.stderr)
+        return 1
+    nk = NodeKey(priv_key=PrivKeyEd25519.generate())
+    nk.save_as(path)
+    print(nk.node_id)
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from ..node.key import NodeKey
+
+    cfg = _load_home(args.home)
+    nk = NodeKey.load_or_generate(cfg.base.path(cfg.base.node_key_file))
+    print(nk.node_id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from ..privval import FilePV
+
+    cfg = _load_home(args.home)
+    pv = FilePV.load_or_generate(
+        cfg.base.path(cfg.priv_validator.key_file),
+        cfg.base.path(cfg.priv_validator.state_file),
+    )
+    print(
+        json.dumps(
+            {
+                "type": pv.key.pub_key.type(),
+                "value": pv.key.pub_key.bytes().hex(),
+            }
+        )
+    )
+    return 0
+
+
+# -- rollback / reset (reference: commands/rollback.go, reset.go) ----------
+
+
+def cmd_rollback(args) -> int:
+    from ..state import StateStore
+    from ..store.block_store import BlockStore
+    from ..store.kv import open_db
+
+    cfg = _load_home(args.home)
+    db_dir = cfg.base.path(cfg.base.db_dir)
+    state_db = open_db("state", cfg.base.db_backend, db_dir)
+    block_db = open_db("blockstore", cfg.base.db_backend, db_dir)
+    try:
+        state_store = StateStore(state_db)
+        block_store = BlockStore(block_db)
+        new_state = state_store.rollback(block_store)
+        print(
+            f"rolled back state to height {new_state.last_block_height} "
+            f"app_hash {new_state.app_hash.hex()}"
+        )
+    finally:
+        state_db.close()
+        block_db.close()
+    return 0
+
+
+def cmd_reset_unsafe(args) -> int:
+    """Remove all data, keep config + keys; reset privval state
+    (reference: commands/reset.go UnsafeResetAll)."""
+    cfg = _load_home(args.home)
+    data = cfg.base.path("data")
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    os.makedirs(
+        os.path.dirname(cfg.base.path(cfg.consensus.wal_file)), exist_ok=True
+    )
+    print(f"removed all data in {data} (config and keys kept)")
+    return 0
+
+
+# -- testnet (reference: commands/testnet.go) -------------------------------
+
+
+def cmd_testnet(args) -> int:
+    from ..node.key import NodeKey
+    from ..privval import FilePV
+    from ..types.genesis import GenesisDoc, GenesisValidator
+
+    n = args.validators
+    out = os.path.expanduser(args.output_dir)
+    privs = [PrivKeyEd25519.generate() for _ in range(n)]
+    genesis = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
+        ],
+    )
+    cfgs: List[Config] = []
+    node_ids: List[str] = []
+    for i in range(n):
+        cfg = Config()
+        cfg.base.home = os.path.join(out, f"node{i}")
+        cfg.base.chain_id = genesis.chain_id
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
+        cfg.ensure_dirs()
+        genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+        FilePV.from_priv_key(
+            privs[i],
+            cfg.base.path(cfg.priv_validator.key_file),
+            cfg.base.path(cfg.priv_validator.state_file),
+        ).save()
+        nk = NodeKey.load_or_generate(cfg.base.path(cfg.base.node_key_file))
+        node_ids.append(nk.node_id)
+        cfgs.append(cfg)
+    for i, cfg in enumerate(cfgs):
+        cfg.p2p.persistent_peers = ",".join(
+            f"{node_ids[j]}@127.0.0.1:{args.starting_port + 2 * j}"
+            for j in range(n)
+            if j != i
+        )
+        write_config(cfg, _config_path(cfg.base.home))
+    print(
+        f"wrote {n}-validator testnet for chain {genesis.chain_id} "
+        f"under {out}"
+    )
+    return 0
+
+
+# -- light (reference: commands/light.go — verifying proxy) -----------------
+
+
+def cmd_light(args) -> int:
+    from ..light import Client, LightStore, TrustOptions
+    from ..light.provider import HTTPProvider
+    from ..rpc.jsonrpc import (
+        INVALID_PARAMS,
+        JSONRPCServer,
+        RPCError,
+    )
+    from ..store.kv import open_db
+
+    home = os.path.expanduser(args.home)
+    os.makedirs(os.path.join(home, "light"), exist_ok=True)
+    db = open_db("light", "sqlite", os.path.join(home, "light"))
+
+    async def run() -> None:
+        primary = HTTPProvider(args.primary)
+        witnesses = [HTTPProvider(w) for w in args.witness or []]
+        client = Client(
+            args.chain_id,
+            TrustOptions(
+                period_ns=int(args.trust_period * 1e9),
+                height=args.trust_height,
+                hash=bytes.fromhex(args.trust_hash),
+            ),
+            primary,
+            witnesses,
+            LightStore(db),
+            sequential=args.sequential,
+        )
+
+        from ..rpc.core import encode
+
+        async def _verified(height: int):
+            return await client.verify_light_block_at_height(
+                height, time.time_ns()
+            )
+
+        async def route_header(req):
+            h = int(req.params.get("height", 0))
+            if h <= 0:
+                raise RPCError(INVALID_PARAMS, "height required")
+            lb = await _verified(h)
+            return {"header": encode(lb.signed_header.header)}
+
+        async def route_commit(req):
+            h = int(req.params.get("height", 0))
+            if h <= 0:
+                raise RPCError(INVALID_PARAMS, "height required")
+            lb = await _verified(h)
+            return {
+                "signed_header": encode(lb.signed_header),
+                "canonical": True,
+            }
+
+        async def route_light_block(req):
+            h = int(req.params.get("height", 0))
+            if h <= 0:
+                raise RPCError(INVALID_PARAMS, "height required")
+            lb = await _verified(h)
+            return {"height": h, "light_block": lb.to_proto().hex()}
+
+        async def route_status(req):
+            lb = client.store.latest_light_block()
+            latest = lb.height if lb is not None else 0
+            return {
+                "chain_id": args.chain_id,
+                "trusted_height": latest,
+                "primary": args.primary,
+                "witnesses": [w.id() for w in witnesses],
+            }
+
+        server = JSONRPCServer(
+            {
+                "header": route_header,
+                "commit": route_commit,
+                "light_block": route_light_block,
+                "status": route_status,
+            }
+        )
+        host, _, port = args.laddr.replace("tcp://", "").rpartition(":")
+        await server.start(host or "127.0.0.1", int(port))
+        print(
+            f"light client proxy for {args.chain_id} on "
+            f"{host}:{server.bound_port} (primary {args.primary})",
+            flush=True,
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await server.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        db.close()
+    return 0
+
+
+# -- inspect (reference: internal/inspect) ----------------------------------
+
+
+def cmd_inspect(args) -> int:
+    """Read-only RPC over a STOPPED node's data directories."""
+    from ..rpc.core import Environment
+    from ..rpc.jsonrpc import JSONRPCServer
+    from ..state import StateStore
+    from ..state.indexer import KVSink
+    from ..store.block_store import BlockStore
+    from ..store.kv import open_db
+    from ..types.genesis import GenesisDoc
+
+    cfg = _load_home(args.home)
+    db_dir = cfg.base.path(cfg.base.db_dir)
+    dbs = [open_db(n, cfg.base.db_backend, db_dir)
+           for n in ("blockstore", "state", "tx_index")]
+    genesis = None
+    gpath = cfg.base.path(cfg.base.genesis_file)
+    if os.path.exists(gpath):
+        genesis = GenesisDoc.from_file(gpath)
+    env = Environment(
+        chain_id=genesis.chain_id if genesis else "",
+        block_store=BlockStore(dbs[0]),
+        state_store=StateStore(dbs[1]),
+        genesis=genesis,
+        event_sinks=[KVSink(dbs[2])],
+        cfg=cfg,
+    )
+    read_only = {
+        k: v
+        for k, v in env.routes().items()
+        if k
+        in (
+            "health", "status", "genesis", "genesis_chunked", "blockchain",
+            "header", "header_by_hash", "block", "block_by_hash",
+            "block_results", "commit", "validators", "consensus_params",
+            "tx", "tx_search", "block_search", "light_block",
+        )
+    }
+
+    async def run() -> None:
+        server = JSONRPCServer(read_only)
+        host, _, port = (
+            args.laddr.replace("tcp://", "").rpartition(":")
+        )
+        await server.start(host or "127.0.0.1", int(port))
+        print(
+            f"inspect server on {host}:{server.bound_port} "
+            f"(read-only routes: {', '.join(sorted(read_only))})",
+            flush=True,
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await server.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        for db in dbs:
+            db.close()
+    return 0
+
+
+# -- replay (reference: commands/replay.go) ---------------------------------
+
+
+def cmd_replay(args) -> int:
+    """Re-execute stored blocks through a fresh builtin app (sanity /
+    debugging tool; reference: consensus/replay_file.go)."""
+    from ..abci.client import local_creator
+    from ..abci.kvstore import KVStoreApplication
+    from ..abci.proxy import AppConns
+    from ..consensus.replay import Handshaker
+    from ..state import StateStore, state_from_genesis
+    from ..store.block_store import BlockStore
+    from ..store.kv import MemKV, open_db
+    from ..types.genesis import GenesisDoc
+
+    cfg = _load_home(args.home)
+    db_dir = cfg.base.path(cfg.base.db_dir)
+    block_db = open_db("blockstore", cfg.base.db_backend, db_dir)
+    genesis = GenesisDoc.from_file(cfg.base.path(cfg.base.genesis_file))
+
+    async def run() -> None:
+        block_store = BlockStore(block_db)
+        # fresh in-memory state: replay everything from genesis
+        state_store = StateStore(MemKV())
+        state = state_from_genesis(genesis)
+        state_store.save(state)
+        proxy = AppConns(local_creator(KVStoreApplication()))
+        await proxy.start()
+        try:
+            handshaker = Handshaker(
+                state_store, state, block_store, genesis
+            )
+            await handshaker.handshake(proxy.consensus)
+            final = state_store.load()
+            print(
+                f"replayed {block_store.height()} blocks; final height "
+                f"{final.last_block_height} app_hash "
+                f"{final.app_hash.hex()}"
+            )
+        finally:
+            await proxy.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        block_db.close()
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(_version.__version__)
+    return 0
+
+
+# -- parser -----------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tendermint_tpu",
+        description="TPU-native BFT consensus node (tendermint-compatible)",
+    )
+    p.add_argument(
+        "--home",
+        default=os.environ.get("TMHOME", "~/.tendermint_tpu"),
+        help="node home directory",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize a node home directory")
+    sp.add_argument(
+        "mode",
+        nargs="?",
+        default="validator",
+        choices=["validator", "full", "seed"],
+    )
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--moniker", default="anonymous")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--moniker", default="")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("gen-validator", help="print a fresh validator key")
+    sp.set_defaults(fn=cmd_gen_validator)
+
+    sp = sub.add_parser("gen-node-key", help="generate a node key")
+    sp.set_defaults(fn=cmd_gen_node_key)
+
+    sp = sub.add_parser("show-node-id", help="print this node's p2p ID")
+    sp.set_defaults(fn=cmd_show_node_id)
+
+    sp = sub.add_parser(
+        "show-validator", help="print this node's validator pubkey"
+    )
+    sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser(
+        "rollback", help="rewind state one height (after an app hash panic)"
+    )
+    sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser(
+        "unsafe-reset-all", help="wipe data, keep config and keys"
+    )
+    sp.set_defaults(fn=cmd_reset_unsafe)
+
+    sp = sub.add_parser("testnet", help="write N-validator testnet homes")
+    sp.add_argument("--validators", "-v", type=int, default=4)
+    sp.add_argument("--output-dir", "-o", default="./testnet")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser(
+        "light", help="run a verifying light-client RPC proxy"
+    )
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True, help="full node RPC addr")
+    sp.add_argument(
+        "--witness", action="append", help="witness RPC addr (repeatable)"
+    )
+    sp.add_argument("--trust-height", type=int, required=True)
+    sp.add_argument("--trust-hash", required=True)
+    sp.add_argument(
+        "--trust-period", type=float, default=168 * 3600.0, help="seconds"
+    )
+    sp.add_argument("--sequential", action="store_true")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser(
+        "inspect", help="read-only RPC over a stopped node's data"
+    )
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:26657")
+    sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser(
+        "replay", help="re-execute stored blocks through a fresh app"
+    )
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("version", help="print the version")
+    sp.set_defaults(fn=cmd_version)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

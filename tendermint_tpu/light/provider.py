@@ -74,6 +74,42 @@ class LocalProvider(Provider):
         self.reported_evidence.append(ev)
 
 
+class HTTPProvider(Provider):
+    """Fetch light blocks from a full node's RPC `light_block` route
+    (reference: light/provider/http)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0) -> None:
+        from ..rpc.client import HTTPClient
+
+        self.addr = addr
+        self._client = HTTPClient(addr, timeout=timeout)
+
+    def id(self) -> str:
+        return self.addr
+
+    async def light_block(self, height: int) -> LightBlock:
+        from ..rpc.client import RPCClientError
+
+        try:
+            res = await self._client.call("light_block", height=height)
+        except RPCClientError as e:
+            raise LightBlockNotFoundError(
+                f"{self.addr}: {e}"
+            ) from e
+        return LightBlock.from_proto(bytes.fromhex(res["light_block"]))
+
+    async def report_evidence(self, ev) -> None:
+        try:
+            await self._client.call(
+                "broadcast_evidence", evidence=ev.to_proto().hex()
+            )
+        except Exception:
+            pass  # best effort, matching the reference's behavior
+
+    async def close(self) -> None:
+        await self._client.close()
+
+
 class P2PProvider(Provider):
     """Fetch light blocks from a peer via an async fetch callable
     (statesync reactor's light-block channel machinery)."""

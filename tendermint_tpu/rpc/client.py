@@ -152,9 +152,18 @@ class WSClient:
     `next_event`.
     """
 
-    def __init__(self, addr: str, timeout: float = 10.0) -> None:
+    def __init__(
+        self,
+        addr: str,
+        timeout: float = 10.0,
+        max_frame: int = 10 << 20,
+    ) -> None:
+        """max_frame bounds a hostile server's declared frame length;
+        raise it only for a trusted (e.g. local) endpoint whose block
+        dumps legitimately exceed 10 MB."""
         self.host, self.port = _parse_addr(addr)
         self.timeout = timeout
+        self.max_frame = max_frame
         self._reader = None
         self._writer = None
         self._ids = itertools.count(1)
@@ -229,10 +238,8 @@ class WSClient:
 
         try:
             while True:
-                # responses from our own server (block dumps etc.) can
-                # legitimately exceed the server-side 10 MB guard
                 opcode, payload = await _read_frame(
-                    self._reader, max_frame=1 << 30
+                    self._reader, max_frame=self.max_frame
                 )
                 if opcode == 0x8:
                     break

@@ -168,6 +168,7 @@ class Environment:
             "tx": self.tx,
             "tx_search": self.tx_search,
             "block_search": self.block_search,
+            "light_block": self.light_block,
             "subscribe": self.subscribe,
             "unsubscribe": self.unsubscribe,
             "unsubscribe_all": self.unsubscribe_all,
@@ -752,6 +753,31 @@ class Environment:
                     }
                 )
         return {"blocks": blocks, "total_count": len(heights)}
+
+    async def light_block(self, req: RPCRequest):
+        """SignedHeader + ValidatorSet as proto hex — the light
+        client's HTTP provider surface (reference: light/provider/http
+        assembles the same from /commit + /validators; one proto blob
+        round-trips exactly)."""
+        from ..types.light import LightBlock, SignedHeader
+
+        height = self._height_param(req.params)
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)
+        if commit is None and height == self.block_store.height():
+            seen = self.block_store.load_seen_commit()
+            if seen is not None and seen.height == height:
+                commit = seen
+        vals = self.state_store.load_validators(height)
+        if meta is None or commit is None or vals is None:
+            raise RPCError(
+                INVALID_PARAMS, f"no light block at height {height}"
+            )
+        lb = LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validator_set=vals,
+        )
+        return {"height": height, "light_block": lb.to_proto().hex()}
 
     # -- subscriptions (websocket only; reference: events.go) --
 

@@ -222,14 +222,21 @@ class JSONRPCServer:
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
+        # cancel live connection handlers BEFORE wait_closed():
+        # wait_closed waits for handlers to finish, and a keep-alive
+        # client parked on readline() would never finish on its own
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
         for ws in list(self._ws_conns):
             ws._close()
-        for task in list(self._conns):
+        tasks = list(self._conns)
+        for task in tasks:
             task.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
         self._conns.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
 
     # -- connection handling --
 

@@ -1,0 +1,212 @@
+"""Operator CLI tests (reference model: cmd/tendermint/commands/*_test.go).
+
+Drives the argparse surface exactly as an operator would: init a home,
+start a node briefly, roll back, build a testnet, and run the verifying
+light proxy against a live node.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.cmd import main as cli_main
+from tendermint_tpu.config import load_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*argv) -> int:
+    return cli_main(list(argv))
+
+
+def test_init_writes_home(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "cli-chain") == 0
+    for rel in (
+        "config/config.toml",
+        "config/genesis.json",
+        "config/node_key.json",
+        "config/priv_validator_key.json",
+    ):
+        assert os.path.exists(os.path.join(home, rel)), rel
+    cfg = load_config(os.path.join(home, "config", "config.toml"))
+    assert cfg.base.chain_id == "cli-chain"
+    assert cfg.base.mode == "validator"
+    # idempotent: a second init keeps the genesis
+    assert run_cli("--home", home, "init", "validator") == 0
+    cfg2 = load_config(os.path.join(home, "config", "config.toml"))
+    assert cfg2.base.chain_id == "cli-chain"
+
+
+def test_key_commands(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    assert run_cli("--home", home, "init", "validator") == 0
+    capsys.readouterr()
+    assert run_cli("--home", home, "show-node-id") == 0
+    node_id = capsys.readouterr().out.strip()
+    assert len(node_id) == 40
+    assert run_cli("--home", home, "show-validator") == 0
+    val = json.loads(capsys.readouterr().out)
+    assert val["type"] == "ed25519" and len(val["value"]) == 64
+    assert run_cli("gen-validator") == 0
+    gv = json.loads(capsys.readouterr().out)
+    assert len(gv["priv_key"]["value"]) in (64, 128)
+    assert run_cli("version") == 0
+    assert capsys.readouterr().out.strip()
+
+
+def test_testnet_layout(tmp_path, capsys):
+    out = str(tmp_path / "net")
+    assert run_cli("testnet", "-v", "3", "-o", out,
+                   "--chain-id", "net-chain", "--starting-port", "30000") == 0
+    genesis_hashes = set()
+    for i in range(3):
+        home = os.path.join(out, f"node{i}")
+        cfg = load_config(os.path.join(home, "config", "config.toml"))
+        assert cfg.base.chain_id == "net-chain"
+        # fully meshed persistent peers
+        assert cfg.p2p.persistent_peers.count("@") == 2
+        with open(os.path.join(home, "config", "genesis.json")) as f:
+            genesis_hashes.add(f.read())
+    assert len(genesis_hashes) == 1  # identical genesis across homes
+
+
+def test_start_runs_and_produces_blocks(tmp_path):
+    """`start` in a subprocess: SIGTERM stops it cleanly; a restart plus
+    `rollback` exercises the recovery surface."""
+    home = str(tmp_path / "home")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "start-chain") == 0
+    # speed up consensus + free RPC port
+    cfg_path = os.path.join(home, "config", "config.toml")
+    cfg = load_config(cfg_path)
+    cfg.consensus.timeout_commit = 0.2
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    from tendermint_tpu.config import write_config
+
+    write_config(cfg, cfg_path)
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cmd",
+         "--home", home, "start"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        cwd=REPO,
+    )
+    try:
+        deadline = time.time() + 90
+        from tendermint_tpu.state import StateStore
+        from tendermint_tpu.store.kv import open_db
+
+        height = 0
+        while time.time() < deadline and height < 2:
+            time.sleep(2.0)
+            try:
+                db = open_db("state", "sqlite", os.path.join(home, "data"))
+                st = StateStore(db).load()
+                height = st.last_block_height if st else 0
+                db.close()
+            except Exception:
+                pass
+        assert height >= 2, "node produced no blocks"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode == 0
+
+    # rollback rewinds one height
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert run_cli("--home", home, "rollback") == 0
+    assert "rolled back state to height" in buf.getvalue()
+
+    # unsafe-reset-all clears data but keeps keys
+    with redirect_stdout(buf):
+        assert run_cli("--home", home, "unsafe-reset-all") == 0
+    assert os.path.exists(
+        os.path.join(home, "config", "priv_validator_key.json")
+    )
+    assert not os.path.exists(
+        os.path.join(home, "data", "state.sqlite")
+    )
+
+
+def test_light_proxy_serves_verified_headers(tmp_path):
+    """Boot a full node in-process, run the light proxy logic against
+    its RPC, and fetch a verified header through the proxy surface
+    (reference: commands/light.go)."""
+    from tendermint_tpu.config import Config
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.light import Client, LightStore, TrustOptions
+    from tendermint_tpu.light.provider import HTTPProvider
+    from tendermint_tpu.node import make_node
+    from tendermint_tpu.privval import FilePV
+    from tendermint_tpu.rpc import HTTPClient
+    from tendermint_tpu.store.kv import MemKV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    async def go():
+        priv = PrivKeyEd25519.from_seed(b"\x31" * 32)
+        genesis = GenesisDoc(
+            chain_id="light-cli",
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pub_key=priv.pub_key(), power=5)],
+        )
+        cfg = Config()
+        cfg.base.home = str(tmp_path / "full")
+        cfg.base.chain_id = "light-cli"
+        cfg.base.db_backend = "memdb"
+        cfg.consensus.timeout_commit = 0.2
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.ensure_dirs()
+        genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+        FilePV.from_priv_key(
+            priv,
+            cfg.base.path(cfg.priv_validator.key_file),
+            cfg.base.path(cfg.priv_validator.state_file),
+        ).save()
+        node = make_node(cfg)
+        await node.start()
+        try:
+            await node.consensus.wait_for_height(4, timeout=60.0)
+            addr = f"127.0.0.1:{node.rpc_server.bound_port}"
+            # trust root = block 1 via the HTTP provider
+            provider = HTTPProvider(addr)
+            lb1 = await provider.light_block(1)
+            client = Client(
+                "light-cli",
+                TrustOptions(
+                    period_ns=10**18,
+                    height=1,
+                    hash=lb1.signed_header.hash(),
+                ),
+                provider,
+                [],
+                LightStore(MemKV()),
+            )
+            lb3 = await client.verify_light_block_at_height(
+                3, time.time_ns()
+            )
+            want = node.block_store.load_block(3).hash()
+            assert lb3.signed_header.header.hash() == want
+        finally:
+            await node.stop()
+
+    asyncio.run(go())
