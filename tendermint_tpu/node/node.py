@@ -78,10 +78,6 @@ class Node(Service):
         transport: Optional[Transport] = None,
     ) -> None:
         super().__init__(name="node", logger=get_logger("node"))
-        if cfg.base.mode == MODE_SEED:
-            raise NotImplementedError(
-                "seed mode requires the PEX reactor"
-            )
         self.cfg = cfg
         self.genesis = genesis
         genesis.validate_and_complete()
@@ -204,6 +200,11 @@ class Node(Service):
             options=RouterOptions(
                 handshake_timeout=cfg.p2p.handshake_timeout,
                 dial_timeout=cfg.p2p.dial_timeout,
+                send_rate=cfg.p2p.send_rate,
+                recv_rate=cfg.p2p.recv_rate,
+                max_incoming_per_ip=(
+                    cfg.p2p.max_incoming_connection_attempts
+                ),
             ),
         )
 
@@ -217,6 +218,7 @@ class Node(Service):
         self.evidence_reactor: Optional[EvidenceReactor] = None
         self.blocksync_reactor = None
         self.statesync_reactor = None
+        self.pex_reactor = None
         self.rpc_server = None
         self.genesis_state_synced = False
 
@@ -234,6 +236,10 @@ class Node(Service):
 
     async def _start_impl(self) -> None:
         cfg = self.cfg
+        if cfg.base.mode == MODE_SEED:
+            # seed nodes run ONLY peer exchange (reference: node/seed.go)
+            await self._start_seed()
+            return
         await self.proxy.start()
         await self.event_bus.start()
         await self.indexer.start()
@@ -336,6 +342,15 @@ class Node(Service):
             cfg=cfg.statesync,
         )
 
+        if cfg.p2p.pex:
+            from ..p2p.pex import PexReactor, pex_channel_descriptor
+
+            self.pex_reactor = PexReactor(
+                self.peer_manager,
+                self.router.open_channel(pex_channel_descriptor()),
+                self.peer_manager.subscribe(),
+            )
+
         # -- start everything (channels are registered; safe to listen) --
         await self.router.start()
         await self.consensus_reactor.start()
@@ -343,6 +358,8 @@ class Node(Service):
         await self.evidence_reactor.start()
         await self.blocksync_reactor.start()
         await self.statesync_reactor.start()
+        if self.pex_reactor is not None:
+            await self.pex_reactor.start()
 
         # -- RPC (reference: node/node.go:480-540 startRPC) --
         if cfg.rpc.laddr:
@@ -383,6 +400,21 @@ class Node(Service):
             tpu="installed" if cfg.tpu.enable else "disabled",
         )
 
+    async def _start_seed(self) -> None:
+        """Seed-mode boot: router + PEX only (reference: node/seed.go)."""
+        from ..p2p.pex import PexReactor, pex_channel_descriptor
+
+        self.pex_reactor = PexReactor(
+            self.peer_manager,
+            self.router.open_channel(pex_channel_descriptor()),
+            self.peer_manager.subscribe(),
+        )
+        await self.router.start()
+        await self.pex_reactor.start()
+        self.logger.info(
+            "seed node started", node_id=self.node_key.node_id
+        )
+
     async def _state_sync_then_follow(self) -> None:
         """statesync → blocksync → consensus (reference:
         node/node.go:592 startStateSync → SwitchToBlockSync)."""
@@ -411,6 +443,7 @@ class Node(Service):
     async def _teardown(self) -> None:
         for svc in (
             self.rpc_server,
+            self.pex_reactor,
             self.statesync_reactor,
             self.blocksync_reactor,
             self.evidence_reactor,

@@ -62,6 +62,7 @@ class _Peer:
     inbound: bool = False
     evicting: bool = False
     score: int = 0
+    connected_at: float = 0.0
 
     def retry_delay(self, opts: PeerManagerOptions) -> float:
         if self.dial_attempts == 0:
@@ -183,9 +184,9 @@ class PeerManager:
                 continue
             if now - peer.last_dial_failure < peer.retry_delay(self.opts):
                 continue
-            if best is None or (peer.persistent, -peer.dial_attempts) > (
-                best.persistent, -best.dial_attempts
-            ):
+            if best is None or (
+                peer.persistent, peer.score, -peer.dial_attempts
+            ) > (best.persistent, best.score, -best.dial_attempts):
                 best = peer
         if best is None:
             return None
@@ -203,6 +204,7 @@ class PeerManager:
             return
         peer.dialing = False
         peer.last_dial_failure = time.monotonic()
+        peer.score = max(peer.score - 1, -100)
         self._wakeup.set()
 
     def dialed(self, node_id: NodeID) -> None:
@@ -253,6 +255,7 @@ class PeerManager:
         if peer is None or not peer.connected:
             return
         peer.ready = True
+        peer.connected_at = time.monotonic()
         self._notify(PeerUpdate(node_id=node_id, status=PeerStatus.UP))
 
     def disconnected(self, node_id: NodeID) -> None:
@@ -262,6 +265,20 @@ class PeerManager:
             return
         was_ready = peer.ready
         was_evicting = peer.evicting
+        # standing reflects SUSTAINED good service, not connection
+        # events: +1 only after >=10 min of clean uptime (misbehavior
+        # docks -10 via errored()). A reconnect-churning peer gains
+        # nothing, so it can't farm eviction resistance or dial priority
+        # (reference: peermanager.go scoring intent,
+        # peermanager_scoring_test.go)
+        if (
+            was_ready
+            and not was_evicting
+            and peer.connected_at
+            and time.monotonic() - peer.connected_at >= 600.0
+        ):
+            peer.score = min(peer.score + 1, 100)
+        peer.connected_at = 0.0
         peer.connected = False
         peer.ready = False
         peer.evicting = False

@@ -5,12 +5,22 @@ typed channels; the router dials/accepts peers via the transport, runs one
 send and one receive task per peer, demuxes inbound messages by channel ID
 into reactor queues, and routes outbound envelopes (unicast or broadcast)
 onto per-peer queues. PeerManager decides who to dial and who to evict.
+
+The per-peer send path carries the reference MConnection's features
+(conn/connection.go): per-channel queues drained by priority (votes
+preempt block parts), token-bucket send/recv rate limiting
+(:45-46 default rates), and ping/pong keepalive with an any-traffic
+liveness deadline. They live here at the router layer rather than
+inside a TCP framing class so every transport (memory included) gets
+identical semantics — one scheduler, not one per transport.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, Optional
+import time as _time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
 
 from ..crypto.keys import PrivKey
 from ..libs.log import get_logger
@@ -20,7 +30,13 @@ from .peermanager import PeerManager
 from .transport import Connection, Transport
 from .types import ChannelDescriptor, Envelope, NodeID, NodeInfo
 
-__all__ = ["Router", "RouterOptions"]
+__all__ = ["Router", "RouterOptions", "PING_CHANNEL_ID"]
+
+# Reserved keepalive channel, handled by the router itself
+# (reference: conn/connection.go channelTypePing/Pong packets).
+PING_CHANNEL_ID = 0xFF
+_PING = b"\x01"
+_PONG = b"\x02"
 
 
 class RouterOptions:
@@ -30,11 +46,115 @@ class RouterOptions:
         dial_timeout: float = 5.0,
         peer_queue_size: int = 128,
         num_concurrent_dials: int = 8,
+        send_rate: int = 5_120_000,  # bytes/s; reference default 500 KB/s
+        recv_rate: int = 5_120_000,
+        ping_interval: float = 30.0,
+        pong_timeout: float = 15.0,
+        max_incoming_per_ip: int = 100,  # attempts per tracking window
+        incoming_window: float = 10.0,
     ) -> None:
         self.handshake_timeout = handshake_timeout
         self.dial_timeout = dial_timeout
         self.peer_queue_size = peer_queue_size
         self.num_concurrent_dials = num_concurrent_dials
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
+        self.ping_interval = ping_interval
+        self.pong_timeout = pong_timeout
+        self.max_incoming_per_ip = max_incoming_per_ip
+        self.incoming_window = incoming_window
+
+
+class _RateLimiter:
+    """Token bucket (reference: internal/libs/flowrate as used by
+    conn/connection.go): await permission to move n bytes."""
+
+    def __init__(self, rate: int) -> None:
+        self.rate = rate
+        self._tokens = float(rate)  # one-second burst
+        self._last = _time.monotonic()
+
+    async def wait(self, n: int) -> None:
+        if self.rate <= 0:
+            return
+        now = _time.monotonic()
+        self._tokens = min(
+            self.rate, self._tokens + (now - self._last) * self.rate
+        )
+        self._last = now
+        self._tokens -= n
+        if self._tokens < 0:
+            await asyncio.sleep(-self._tokens / self.rate)
+
+
+class _PeerSendQueue:
+    """Per-channel FIFO queues drained highest-priority-first
+    (reference: conn/connection.go sendRoutine + channel priorities).
+    Bounded per channel by the descriptor's send_queue_capacity; a full
+    channel drops the message (never blocks other channels)."""
+
+    def __init__(self, default_capacity: int = 64) -> None:
+        # channel_id -> (priority, capacity, deque)
+        self._queues: Dict[int, Tuple[int, int, Deque[bytes]]] = {}
+        self._ready = asyncio.Event()
+        self._default_capacity = default_capacity
+        self._pong_queued = False
+
+    def register(self, descriptor: ChannelDescriptor) -> None:
+        old = self._queues.get(descriptor.channel_id)
+        self._queues[descriptor.channel_id] = (
+            descriptor.priority,
+            descriptor.send_queue_capacity,
+            old[2] if old is not None else deque(),
+        )
+
+    def put(self, channel_id: int, payload: bytes) -> bool:
+        entry = self._queues.get(channel_id)
+        if entry is None:
+            # late-opened or router-internal channel: default slot
+            entry = (1, self._default_capacity, deque())
+            self._queues[channel_id] = entry
+        priority, capacity, q = entry
+        if len(q) >= capacity:
+            return False
+        q.append(payload)
+        self._ready.set()
+        return True
+
+    def put_keepalive(self, payload: bytes) -> None:
+        """Ping/pong traffic: max priority. Pongs coalesce — at most ONE
+        pending pong regardless of inbound ping rate (reference:
+        conn/connection.go's size-1 pong channel; otherwise a peer
+        streaming pings without reading grows this queue unboundedly)."""
+        if payload == _PONG:
+            if self._pong_queued:
+                return
+            self._pong_queued = True
+        entry = self._queues.get(PING_CHANNEL_ID)
+        if entry is None:
+            entry = (1 << 30, 1 << 30, deque())
+            self._queues[PING_CHANNEL_ID] = entry
+        entry[2].append(payload)
+        self._ready.set()
+
+    async def get(self) -> Tuple[int, bytes]:
+        while True:
+            best = None
+            for cid, (priority, _cap, q) in self._queues.items():
+                if q and (best is None or priority > best[0]):
+                    best = (priority, cid, q)
+            if best is not None:
+                _, cid, q = best
+                payload = q.popleft()
+                if cid == PING_CHANNEL_ID and payload == _PONG:
+                    self._pong_queued = False
+                if not any(
+                    qq for _p, _c, qq in self._queues.values() if qq
+                ):
+                    self._ready.clear()
+                return cid, payload
+            self._ready.clear()
+            await self._ready.wait()
 
 
 class Router(Service):
@@ -55,9 +175,13 @@ class Router(Service):
         self.listen_addr = listen_addr
         self.opts = options or RouterOptions()
         self._channels: Dict[int, Channel] = {}
-        self._peer_queues: Dict[NodeID, asyncio.Queue] = {}
+        self._peer_queues: Dict[NodeID, _PeerSendQueue] = {}
         self._peer_conns: Dict[NodeID, Connection] = {}
         self._peer_tasks: Dict[NodeID, list] = {}
+        self._peer_last_recv: Dict[NodeID, float] = {}
+        # per-IP connection-attempt tracking
+        # (reference: internal/p2p/conn_tracker.go)
+        self._conn_tracker: Dict[str, Deque[float]] = {}
 
     # -- reactor API --
 
@@ -67,11 +191,19 @@ class Router(Service):
             raise ValueError(
                 f"channel {descriptor.channel_id} already open"
             )
+        if descriptor.channel_id == PING_CHANNEL_ID:
+            raise ValueError(
+                f"channel {PING_CHANNEL_ID:#x} is reserved for keepalive"
+            )
         ch = Channel(descriptor)
         self._channels[descriptor.channel_id] = ch
         # advertise the channel in our NodeInfo
         if descriptor.channel_id not in self.node_info.channels:
             self.node_info.channels += bytes([descriptor.channel_id])
+        # register on queues of peers that connected before this channel
+        # opened, so its priority/capacity take effect
+        for q in self._peer_queues.values():
+            q.register(descriptor)
         self.spawn(self._route_channel_out(ch), f"ch{ch.id}-out")
         self.spawn(self._route_channel_errors(ch), f"ch{ch.id}-err")
         return ch
@@ -131,7 +263,36 @@ class Router(Service):
     async def _accept_loop(self) -> None:
         while True:
             conn = await self.transport.accept()
+            if not self._track_incoming(conn.remote_addr):
+                self.logger.info(
+                    "rejecting connection: too many attempts from IP",
+                    addr=conn.remote_addr,
+                )
+                conn.close()
+                continue
             self.spawn(self._accept_one(conn), "accept-one")
+
+    def _track_incoming(self, remote_addr: str) -> bool:
+        """Per-IP accept rate limiting
+        (reference: internal/p2p/conn_tracker.go)."""
+        ip = remote_addr.rsplit(":", 1)[0]
+        now = _time.monotonic()
+        window = self._conn_tracker.setdefault(ip, deque())
+        while window and now - window[0] > self.opts.incoming_window:
+            window.popleft()
+        if len(self._conn_tracker) > 1024:
+            # sweep drained windows so the tracker can't grow one entry
+            # per distinct source IP ever seen
+            for tracked_ip in list(self._conn_tracker):
+                w = self._conn_tracker[tracked_ip]
+                while w and now - w[0] > self.opts.incoming_window:
+                    w.popleft()
+                if not w and tracked_ip != ip:
+                    del self._conn_tracker[tracked_ip]
+        if len(window) >= self.opts.max_incoming_per_ip:
+            return False
+        window.append(now)
+        return True
 
     async def _accept_one(self, conn: Connection) -> None:
         try:
@@ -141,6 +302,16 @@ class Router(Service):
             self.logger.debug("inbound handshake failed", err=str(e))
             conn.close()
             return
+        # record the peer's self-reported listen address so PEX can
+        # advertise inbound peers too (reference: the handshake's
+        # NodeInfo.ListenAddr feeding the address book)
+        if peer_info.listen_addr:
+            try:
+                self.peer_manager.add(
+                    f"{peer_info.node_id}@{peer_info.listen_addr}"
+                )
+            except ValueError:
+                pass  # unparseable self-report: ignore
         self._start_peer(peer_info.node_id, conn)
 
     async def _handshake(self, conn: Connection) -> NodeInfo:
@@ -165,19 +336,25 @@ class Router(Service):
             conn.close()
             return
         self._peer_conns[node_id] = conn
-        q: asyncio.Queue = asyncio.Queue(maxsize=self.opts.peer_queue_size)
+        q = _PeerSendQueue(default_capacity=self.opts.peer_queue_size)
+        for ch in self._channels.values():
+            q.register(ch.descriptor)
         self._peer_queues[node_id] = q
+        self._peer_last_recv[node_id] = _time.monotonic()
         send_t = self.spawn(self._send_peer(node_id, conn, q), f"send-{node_id[:8]}")
         recv_t = self.spawn(self._recv_peer(node_id, conn), f"recv-{node_id[:8]}")
-        self._peer_tasks[node_id] = [send_t, recv_t]
+        ping_t = self.spawn(self._ping_peer(node_id, q), f"ping-{node_id[:8]}")
+        self._peer_tasks[node_id] = [send_t, recv_t, ping_t]
         self.peer_manager.ready(node_id)
         self.logger.info("peer connected", peer=node_id[:12], addr=conn.remote_addr)
 
     async def _send_peer(
-        self, node_id: NodeID, conn: Connection, queue: asyncio.Queue
+        self, node_id: NodeID, conn: Connection, queue: _PeerSendQueue
     ) -> None:
+        limiter = _RateLimiter(self.opts.send_rate)
         while True:
             channel_id, payload = await queue.get()
+            await limiter.wait(len(payload))
             try:
                 await conn.send(channel_id, payload)
             except asyncio.CancelledError:
@@ -194,10 +371,42 @@ class Router(Service):
                 self._peer_down(node_id)
                 return
 
+    async def _ping_peer(self, node_id: NodeID, queue: _PeerSendQueue) -> None:
+        """Keepalive: ping on the reserved channel; ANY received traffic
+        counts as liveness (reference: conn/connection.go pingRoutine +
+        recv deadline)."""
+        interval = self.opts.ping_interval
+        if interval <= 0:
+            return
+        while True:
+            await asyncio.sleep(interval)
+            last = self._peer_last_recv.get(node_id)
+            if last is None:
+                return
+            idle = _time.monotonic() - last
+            if idle > interval + self.opts.pong_timeout:
+                self.logger.info(
+                    "peer unresponsive; disconnecting",
+                    peer=node_id[:12], idle=round(idle, 1),
+                )
+                self._peer_down(node_id)
+                return
+            if idle > interval / 2:
+                queue.put_keepalive(_PING)
+
     async def _recv_peer(self, node_id: NodeID, conn: Connection) -> None:
+        limiter = _RateLimiter(self.opts.recv_rate)
         try:
             while True:
                 channel_id, payload = await conn.receive()
+                self._peer_last_recv[node_id] = _time.monotonic()
+                await limiter.wait(len(payload))
+                if channel_id == PING_CHANNEL_ID:
+                    if payload == _PING:
+                        q = self._peer_queues.get(node_id)
+                        if q is not None:
+                            q.put_keepalive(_PONG)
+                    continue  # pong needs no action: any traffic is liveness
                 ch = self._channels.get(channel_id)
                 if ch is None:
                     continue  # unknown channel: drop
@@ -239,6 +448,7 @@ class Router(Service):
         if conn is not None:
             conn.close()
         self._peer_queues.pop(node_id, None)
+        self._peer_last_recv.pop(node_id, None)
         for t in self._peer_tasks.pop(node_id, []):
             if not t.done() and t is not asyncio.current_task():
                 t.cancel()
@@ -267,11 +477,9 @@ class Router(Service):
                 q = self._peer_queues.get(node_id)
                 if q is None:
                     continue
-                try:
-                    q.put_nowait((ch.id, payload))
-                except asyncio.QueueFull:
+                if not q.put(ch.id, payload):
                     self.logger.debug(
-                        "peer queue full; dropping message",
+                        "peer channel queue full; dropping message",
                         peer=node_id[:12], ch=ch.id,
                     )
 
