@@ -141,13 +141,35 @@ class Node(Service):
                 raise ValueError(f"unknown indexer {kind!r}")
         self.indexer = IndexerService(sinks or [NullSink()], self.event_bus)
 
+        # node identity key (also the privval listener's transport key)
+        self.node_key = NodeKey.load_or_generate(
+            cfg.base.path(cfg.base.node_key_file)
+        )
+
         # -- privval (reference: node/setup.go createPrivval) --
         self.privval = None
+        self.privval_listener = None
+        self.privval_pub_key = None
         if cfg.base.mode == MODE_VALIDATOR:
-            self.privval = FilePV.load_or_generate(
-                cfg.base.path(cfg.priv_validator.key_file),
-                cfg.base.path(cfg.priv_validator.state_file),
-            )
+            if cfg.priv_validator.listen_addr:
+                # remote signer dials in (reference:
+                # privval/signer_listener_endpoint.go via
+                # createAndStartPrivValidatorSocketClient)
+                from ..privval.signer import (
+                    RetrySignerClient,
+                    SignerListenerEndpoint,
+                )
+
+                self.privval_listener = SignerListenerEndpoint(
+                    cfg.priv_validator.listen_addr,
+                    self.node_key.priv_key,
+                )
+                self.privval = RetrySignerClient(self.privval_listener)
+            else:
+                self.privval = FilePV.load_or_generate(
+                    cfg.base.path(cfg.priv_validator.key_file),
+                    cfg.base.path(cfg.priv_validator.state_file),
+                )
 
         # -- state --
         state = self.state_store.load()
@@ -157,9 +179,6 @@ class Node(Service):
         self.initial_state = state
 
         # -- p2p (reference: node/setup.go createPeerManager/createRouter) --
-        self.node_key = NodeKey.load_or_generate(
-            cfg.base.path(cfg.base.node_key_file)
-        )
         listen = cfg.p2p.laddr.replace("tcp://", "")
         advertise = (
             cfg.p2p.external_address.replace("tcp://", "")
@@ -243,6 +262,14 @@ class Node(Service):
         await self.proxy.start()
         await self.event_bus.start()
         await self.indexer.start()
+        if self.privval_listener is not None:
+            await self.privval_listener.start()
+        # resolve the validator identity once: with a remote signer this
+        # blocks until the signer dials in (reference: node/setup.go
+        # createAndStartPrivValidatorSocketClient + GetPubKey)
+        self.privval_pub_key = None
+        if self.privval is not None:
+            self.privval_pub_key = await self.privval.get_pub_key()
 
         # ABCI handshake: replay stored blocks into the app until app,
         # store, and state agree (reference: replay.go:240)
@@ -379,7 +406,7 @@ class Node(Service):
                 evidence_pool=self.evidence_pool,
                 event_sinks=self.indexer.sinks,
                 node_info=self.node_info,
-                privval=self.privval,
+                privval_pub_key=self.privval_pub_key,
                 cfg=cfg,
             )
             self.rpc_server = RPCServer(
@@ -429,12 +456,12 @@ class Node(Service):
 
     def _only_validator_is_us(self, state) -> bool:
         """reference: node/node.go:230 onlyValidatorIsUs."""
-        if self.privval is None:
+        if self.privval_pub_key is None:
             return False
         if state.validators.size() != 1:
             return False
         addr = state.validators.validators[0].address
-        return addr == self.privval.key.address
+        return addr == self.privval_pub_key.address()
 
     async def on_stop(self) -> None:
         """reference: node/node.go OnStop — reverse start order."""
@@ -450,6 +477,7 @@ class Node(Service):
             self.mempool_reactor,
             self.consensus_reactor,
             self.router,
+            self.privval_listener,
             self.indexer,
             self.event_bus,
             self.proxy,

@@ -120,7 +120,7 @@ class PexReactor(Service):
 
     async def _poll_loop(self) -> None:
         while True:
-            await asyncio.sleep(0.5)
+            await asyncio.sleep(min(0.5, _MIN_POLL_INTERVAL / 2))
             now = time.monotonic()
             # expire in-flight requests: the request or its response may
             # ride a droppable queue, and a peer stuck in _requested

@@ -9,6 +9,12 @@ the device.
 
 from .types import MockPV, PrivValidator
 from .file import FilePV, FilePVKey, FilePVLastSignState
+from .signer import (
+    RemoteSignerError,
+    RetrySignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
 
 __all__ = [
     "PrivValidator",
@@ -16,4 +22,8 @@ __all__ = [
     "FilePV",
     "FilePVKey",
     "FilePVLastSignState",
+    "RemoteSignerError",
+    "RetrySignerClient",
+    "SignerListenerEndpoint",
+    "SignerServer",
 ]

@@ -110,7 +110,7 @@ class Environment:
         evidence_pool=None,
         event_sinks: Optional[List[EventSink]] = None,
         node_info=None,
-        privval=None,
+        privval_pub_key: Optional[PubKey] = None,
         cfg=None,
     ) -> None:
         self.chain_id = chain_id
@@ -126,7 +126,7 @@ class Environment:
         self.evidence_pool = evidence_pool
         self.event_sinks = event_sinks or []
         self.node_info = node_info
-        self.privval = privval
+        self.privval_pub_key = privval_pub_key
         self.cfg = cfg
         self.logger = get_logger("rpc.core")
         # ws client_id -> set of query strings (for unsubscribe_all)
@@ -207,8 +207,8 @@ class Environment:
             ),
         }
         validator_info = {}
-        if self.privval is not None:
-            addr = self.privval.key.address
+        if self.privval_pub_key is not None:
+            addr = self.privval_pub_key.address()
             power = 0
             state = self.state_store.load()
             if state is not None:
@@ -217,7 +217,7 @@ class Environment:
                     power = val.voting_power
             validator_info = {
                 "address": addr.hex(),
-                "pub_key": self.privval.key.pub_key.bytes().hex(),
+                "pub_key": self.privval_pub_key.bytes().hex(),
                 "voting_power": power,
             }
         return {

@@ -154,7 +154,7 @@ def test_four_validator_localnet_memory(tmp_path):
             await n.start()
         try:
             await asyncio.gather(
-                *(n.consensus.wait_for_height(4, timeout=90.0) for n in nodes)
+                *(n.consensus.wait_for_height(4, timeout=180.0) for n in nodes)
             )
             # all nodes agree on block 3
             hashes = {n.block_store.load_block(3).hash() for n in nodes}
@@ -197,7 +197,7 @@ def test_two_validator_localnet_tcp(tmp_path):
             await n.start()
         try:
             await asyncio.gather(
-                *(n.consensus.wait_for_height(3, timeout=90.0) for n in nodes)
+                *(n.consensus.wait_for_height(3, timeout=180.0) for n in nodes)
             )
             assert (
                 nodes[0].block_store.load_block(2).hash()

@@ -306,7 +306,7 @@ class TestPexReactor:
                     await r.start()
                 a.peer_manager.add(f"{b.node_id}@{b.addr}")
                 c.peer_manager.add(f"{b.node_id}@{b.addr}")
-                deadline = time.monotonic() + 15.0
+                deadline = time.monotonic() + 30.0
                 while time.monotonic() < deadline:
                     # a learns c's address via pex through b, then dials
                     if c.node_id in a.peer_manager.peers():
