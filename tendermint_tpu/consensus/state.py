@@ -23,6 +23,7 @@ from typing import Optional
 
 from ..config import ConsensusConfig
 from ..eventbus import EventBus
+from ..libs import metrics as M
 from ..libs.log import get_logger
 from ..libs.service import Service
 from ..privval.types import PrivValidator
@@ -37,6 +38,7 @@ from ..types.commit import Commit
 from ..types.part_set import PartSet
 from ..types.proposal import Proposal
 from ..types.vote import Vote
+
 from ..types.vote_set import ConflictingVoteError, VoteSet, commit_to_vote_set
 from .msgs import (
     BlockPartMessage,
@@ -49,6 +51,34 @@ from .msgs import (
 from .ticker import TimeoutTicker
 from .types import HeightVoteSet, RoundState, RoundStep, step_name
 from .wal import WAL, NopWAL
+
+# reference: internal/consensus/metrics.go:8-9 (height, rounds,
+# validators, block interval/size/txs via go-kit prometheus)
+_m_height = M.new_gauge("consensus", "height", "Height of the chain.")
+_m_rounds = M.new_gauge(
+    "consensus", "rounds", "Number of rounds at the current height."
+)
+_m_validators = M.new_gauge(
+    "consensus", "validators", "Number of validators."
+)
+_m_validators_power = M.new_gauge(
+    "consensus", "validators_power", "Total voting power of validators."
+)
+_m_block_interval = M.new_histogram(
+    "consensus",
+    "block_interval_seconds",
+    "Time between this and the last block.",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+)
+_m_num_txs = M.new_gauge(
+    "consensus", "num_txs", "Number of transactions in the latest block."
+)
+_m_total_txs = M.new_counter(
+    "consensus", "total_txs", "Total number of transactions committed."
+)
+_m_block_size = M.new_gauge(
+    "consensus", "block_size_bytes", "Size of the latest block."
+)
 
 __all__ = ["ConsensusState"]
 
@@ -219,6 +249,10 @@ class ConsensusState(Service):
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self.state = state
+        _m_height.set(height)
+        _m_rounds.set(0)
+        _m_validators.set(validators.size())
+        _m_validators_power.set(validators.total_voting_power())
 
     def _reconstruct_last_commit_from_store(self, state: State) -> None:
         """On restart, rebuild LastCommit from the stored seen-commit
@@ -370,6 +404,7 @@ class ConsensusState(Service):
             rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
         ):
             return
+        _m_rounds.set(round_)
         self.logger.info(
             "entering new round",
             height=height,
@@ -730,6 +765,17 @@ class ConsensusState(Service):
             hash=block.hash().hex()[:16],
             num_txs=len(block.txs),
         )
+        _m_num_txs.set(len(block.txs))
+        _m_total_txs.inc(len(block.txs))
+        _m_block_size.set(block.size())
+        if self.state.last_block_time_ns:
+            _m_block_interval.observe(
+                max(
+                    0.0,
+                    (block.header.time_ns - self.state.last_block_time_ns)
+                    / 1e9,
+                )
+            )
 
         if self.block_store.height() < block.header.height:
             seen_commit = precommits.make_commit()

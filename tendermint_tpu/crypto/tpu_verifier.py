@@ -14,8 +14,24 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+from ..libs import metrics as M
 from .batch import register_device_factory
 from .keys import BatchVerifier, PubKey
+
+# device-offload observability (no reference analog — this is the
+# north-star seam's instrumentation)
+_m_batches = M.new_counter(
+    "tpu", "verify_batches_total", "Device batch-verify invocations."
+)
+_m_sigs = M.new_counter(
+    "tpu", "verify_sigs_total", "Signatures verified on device."
+)
+_m_verify_time = M.new_histogram(
+    "tpu",
+    "verify_seconds",
+    "Wall time of one batch verification.",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+)
 
 __all__ = [
     "TpuEd25519BatchVerifier",
@@ -59,16 +75,17 @@ class TpuEd25519BatchVerifier(BatchVerifier):
     def verify(self) -> Tuple[bool, List[bool]]:
         if not self._pks:
             return False, []
-        if self._verifier is not None:
-            bitmap = self._verifier.verify(
-                self._pks, self._msgs, self._sigs
-            )
-        else:
-            bitmap = self._kernel.batch_verify_host(
-                self._pks, self._msgs, self._sigs
-            )
-        _STATS["batches"] += 1
-        _STATS["sigs"] += len(self._pks)
+        with _m_verify_time.time():
+            if self._verifier is not None:
+                bitmap = self._verifier.verify(
+                    self._pks, self._msgs, self._sigs
+                )
+            else:
+                bitmap = self._kernel.batch_verify_host(
+                    self._pks, self._msgs, self._sigs
+                )
+        _m_batches.inc()
+        _m_sigs.inc(len(self._pks))
         bits = [bool(b) for b in bitmap]
         return all(bits), bits
 
@@ -79,7 +96,6 @@ class TpuEd25519BatchVerifier(BatchVerifier):
 _SHARED_VERIFIER = None
 _MIN_BATCH = DEFAULT_MIN_BATCH
 _INSTALLED = False
-_STATS = {"batches": 0, "sigs": 0}
 
 
 def installed() -> Optional[int]:
@@ -93,7 +109,10 @@ def installed() -> Optional[int]:
 def stats() -> dict:
     """Device-path usage counters — lets the node (and tests) assert the
     batch path actually runs on device in the served configuration."""
-    return dict(_STATS)
+    return {
+        "batches": int(_m_batches.value()),
+        "sigs": int(_m_sigs.value()),
+    }
 
 
 def _factory(size_hint: int) -> Optional[BatchVerifier]:

@@ -1,0 +1,303 @@
+"""Prometheus-style metrics: registry, instruments, text exposition.
+
+reference: the go-kit prometheus metrics threaded through every
+subsystem (internal/consensus/metrics.go, internal/p2p/metrics.go,
+internal/mempool/metrics.go, internal/state/metrics.go; catalog in
+docs/nodes/metrics.md:21-53) and the node-served endpoint
+(node/node.go:606). Zero-dependency implementation of the subset those
+use: Counter, Gauge, Histogram with static label names, rendered in the
+Prometheus text exposition format (version 0.0.4).
+
+Known limitation vs the reference: instruments register on a
+process-global registry (module-level definitions at each subsystem),
+where the reference threads a per-node Metrics struct. One node per
+process — the production deployment — is exact; multiple in-process
+nodes (the in-memory localnet test harness) interleave writes to the
+same series, so scrape values are only meaningful for single-node
+processes. Threading per-node registries through the constructors is
+the follow-up if embedding several nodes becomes a served use case.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "DEFAULT_REGISTRY",
+    "new_counter",
+    "new_gauge",
+    "new_histogram",
+]
+
+
+def _fmt_labels(names: Sequence[str], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{v}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v)
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(
+        self, name: str, help_: str, label_names: Sequence[str] = ()
+    ) -> None:
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def with_labels(self, **labels: str):
+        """Bound child for a label combination."""
+        key = tuple(str(labels[n]) for n in self.label_names)
+        return self._child(key)
+
+    def _child(self, key: Tuple[str, ...]):
+        raise NotImplementedError
+
+    def render(self) -> List[str]:
+        raise NotImplementedError
+
+    def _header(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(str(labels[n]) for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(str(labels[n]) for n in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        out = self._header()
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, v in items:
+            out.append(
+                f"{self.name}{_fmt_labels(self.label_names, key)}"
+                f" {_fmt_value(v)}"
+            )
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(str(labels[n]) for n in self.label_names)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def add(self, amount: float, **labels: str) -> None:
+        key = tuple(str(labels[n]) for n in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(str(labels[n]) for n in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def render(self) -> List[str]:
+        out = self._header()
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, v in items:
+            out.append(
+                f"{self.name}{_fmt_labels(self.label_names, key)}"
+                f" {_fmt_value(v)}"
+            )
+        return out
+
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name,
+        help_,
+        label_names=(),
+        buckets: Sequence[float] = _DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        # key -> (per-bucket counts, sum, count)
+        self._values: Dict[Tuple[str, ...], list] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(str(labels[n]) for n in self.label_names)
+        with self._lock:
+            entry = self._values.get(key)
+            if entry is None:
+                entry = [[0] * len(self.buckets), 0.0, 0]
+                self._values[key] = entry
+            counts, _, _ = entry
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            entry[1] += value
+            entry[2] += 1
+
+    def time(self, **labels: str):
+        """Context manager observing elapsed seconds."""
+        return _Timer(self, labels)
+
+    def count(self, **labels: str) -> int:
+        key = tuple(str(labels[n]) for n in self.label_names)
+        entry = self._values.get(key)
+        return entry[2] if entry else 0
+
+    def sum(self, **labels: str) -> float:
+        key = tuple(str(labels[n]) for n in self.label_names)
+        entry = self._values.get(key)
+        return entry[1] if entry else 0.0
+
+    def render(self) -> List[str]:
+        out = self._header()
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, (counts, total, n) in items:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum = counts[i]
+                lbls = dict(zip(self.label_names, key))
+                lbls["le"] = _fmt_value(float(b))
+                names = list(self.label_names) + ["le"]
+                vals = tuple(lbls[x] for x in names)
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(names, vals)} {cum}"
+                )
+            names = list(self.label_names) + ["le"]
+            vals = tuple(list(key) + ["+Inf"])
+            out.append(
+                f"{self.name}_bucket{_fmt_labels(names, vals)} {n}"
+            )
+            out.append(
+                f"{self.name}_sum{_fmt_labels(self.label_names, key)}"
+                f" {_fmt_value(total)}"
+            )
+            out.append(
+                f"{self.name}_count{_fmt_labels(self.label_names, key)} {n}"
+            )
+        return out
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict) -> None:
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(
+            time.perf_counter() - self._t0, **self.labels
+        )
+        return False
+
+
+class Registry:
+    """Named collection rendered as one exposition document."""
+
+    def __init__(self, namespace: str = "tendermint_tpu") -> None:
+        self.namespace = namespace
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing  # idempotent (node restarts in-process)
+            self._metrics[metric.name] = metric
+            return metric
+
+    def get(self, name: str) -> Optional[_Metric]:
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT_REGISTRY = Registry()
+
+
+def _full_name(subsystem: str, name: str) -> str:
+    return f"{DEFAULT_REGISTRY.namespace}_{subsystem}_{name}"
+
+
+def new_counter(
+    subsystem: str, name: str, help_: str, label_names=()
+) -> Counter:
+    return DEFAULT_REGISTRY.register(
+        Counter(_full_name(subsystem, name), help_, label_names)
+    )
+
+
+def new_gauge(subsystem: str, name: str, help_: str, label_names=()) -> Gauge:
+    return DEFAULT_REGISTRY.register(
+        Gauge(_full_name(subsystem, name), help_, label_names)
+    )
+
+
+def new_histogram(
+    subsystem: str, name: str, help_: str, label_names=(), buckets=None
+) -> Histogram:
+    return DEFAULT_REGISTRY.register(
+        Histogram(
+            _full_name(subsystem, name),
+            help_,
+            label_names,
+            buckets=buckets or _DEFAULT_BUCKETS,
+        )
+    )

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence
 from ..abci import types as abci
 from ..abci.client import ABCIClient
 from ..config import MempoolConfig
+from ..libs import metrics as M
 from ..libs.log import get_logger
 from .cache import LRUTxCache, NopTxCache
 from .types import (
@@ -54,6 +55,12 @@ class TxMempool(Mempool):
         )
         self._lock = asyncio.Lock()  # held by consensus across Commit+Update
         self._tx_available = asyncio.Event()
+        self._m_size = M.new_gauge(
+            "mempool", "size", "Number of uncommitted transactions."
+        )
+        self._m_failed = M.new_counter(
+            "mempool", "failed_txs_total", "Transactions rejected by CheckTx."
+        )
 
     # -- sizes --
 
@@ -126,6 +133,7 @@ class TxMempool(Mempool):
 
         res = await self._app.check_tx(abci.RequestCheckTx(tx=tx))
         if not res.is_ok:
+            self._m_failed.inc()
             if not self.cfg.keep_invalid_txs_in_cache:
                 self.cache.remove(tx)
             return res
@@ -179,6 +187,7 @@ class TxMempool(Mempool):
         if wtx.sender:
             self._senders[wtx.sender] = wtx.key
         self._bytes += wtx.size()
+        self._m_size.set(len(self._txs))
         self._tx_available.set()
         return True
 
@@ -189,6 +198,7 @@ class TxMempool(Mempool):
         if wtx.sender:
             self._senders.pop(wtx.sender, None)
         self._bytes -= wtx.size()
+        self._m_size.set(len(self._txs))
         if remove_from_cache:
             self.cache.remove_by_key(key)
 

@@ -416,6 +416,12 @@ class Node(Service):
             )
             await self.rpc_server.start()
 
+        # -- Prometheus exposition (reference: node/node.go:606) --
+        if cfg.instrumentation.prometheus:
+            await self._start_metrics_server(
+                cfg.instrumentation.prometheus_listen_addr
+            )
+
         if state_sync:
             self.spawn(self._state_sync_then_follow(), "state-sync")
 
@@ -426,6 +432,43 @@ class Node(Service):
             mode=cfg.base.mode,
             tpu="installed" if cfg.tpu.enable else "disabled",
         )
+
+    async def _start_metrics_server(self, addr: str) -> None:
+        """Plain-text Prometheus exposition on /metrics."""
+        from ..libs.metrics import DEFAULT_REGISTRY
+
+        host, _, port = addr.replace("tcp://", "").rpartition(":")
+
+        async def handler(reader, writer):
+            try:
+                line = await reader.readline()
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                body = DEFAULT_REGISTRY.render().encode()
+                status = (
+                    b"200 OK" if b"/metrics" in line else b"404 Not Found"
+                )
+                if status != b"200 OK":
+                    body = b"see /metrics\n"
+                writer.write(
+                    b"HTTP/1.1 " + status + b"\r\n"
+                    b"Content-Type: text/plain; version=0.0.4\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                    b"Connection: close\r\n\r\n" + body
+                )
+                await writer.drain()
+            except (ConnectionError, asyncio.IncompleteReadError):
+                pass
+            finally:
+                writer.close()
+
+        self._metrics_server = await asyncio.start_server(
+            handler, host or "0.0.0.0", int(port)
+        )
+        self.metrics_port = self._metrics_server.sockets[0].getsockname()[1]
+        self.logger.info("prometheus metrics", addr=f"{host}:{self.metrics_port}")
 
     async def _start_seed(self) -> None:
         """Seed-mode boot: router + PEX only (reference: node/seed.go)."""
@@ -468,6 +511,10 @@ class Node(Service):
         await self._teardown()
 
     async def _teardown(self) -> None:
+        ms = getattr(self, "_metrics_server", None)
+        if ms is not None:
+            ms.close()
+            self._metrics_server = None
         for svc in (
             self.rpc_server,
             self.pex_reactor,

@@ -185,6 +185,7 @@ class SignerListenerEndpoint(Service, PrivValidator):
         if self._conn is not None:
             self._conn.close()
             self._conn = None
+        self._conn_ready.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -227,6 +228,9 @@ class SignerListenerEndpoint(Service, PrivValidator):
             except asyncio.TimeoutError:
                 raise RemoteSignerConnectionError("no signer connected")
             conn = self._conn
+            if conn is None:  # shutdown/teardown race
+                self._conn_ready.clear()
+                raise RemoteSignerConnectionError("signer connection gone")
             try:
                 await conn.send(data)
                 resp = await asyncio.wait_for(
@@ -239,14 +243,29 @@ class SignerListenerEndpoint(Service, PrivValidator):
                 # garbled frame, oversized frame) leaves the secret
                 # connection's nonces desynced — the connection is toast
                 # either way: drop it and wait for a re-dial
-                if self._conn is conn:
-                    self._conn = None
-                    self._conn_ready.clear()
-                conn.close()
+                self._poison(conn)
                 raise RemoteSignerConnectionError(
                     f"signer connection failed: {e!r}"
                 )
-        return _parse(resp)
+            try:
+                return _parse(resp)
+            except ValueError as e:
+                # decryptable but malformed message: a broken or hostile
+                # signer — same treatment as a transport failure, and
+                # crucially it must NOT escape as ValueError (the ping
+                # loop only absorbs RemoteSignerError; anything else
+                # would fail-fast the whole listener service)
+                self._poison(conn)
+                raise RemoteSignerConnectionError(
+                    f"malformed signer message: {e}"
+                )
+
+    def _poison(self, conn: Optional[_Conn]) -> None:
+        if conn is not None and self._conn is conn:
+            self._conn = None
+            self._conn_ready.clear()
+        if conn is not None:
+            conn.close()
 
     @staticmethod
     def _unwrap(body: bytes, expect_field: int, got_field: int) -> bytes:

@@ -21,6 +21,7 @@ from ..crypto.keys import pubkey_from_type_and_bytes
 from ..crypto.merkle import hash_from_byte_slices
 from ..encoding.proto import ProtoWriter
 from ..eventbus import EventBus
+from ..libs import metrics as M
 from ..libs.log import get_logger
 from ..mempool.types import Mempool
 from ..types.block import Block
@@ -46,6 +47,14 @@ __all__ = [
     "validate_block",
     "validator_updates_from_abci",
 ]
+
+# reference: internal/state/metrics.go (block processing histogram)
+_m_block_processing = M.new_histogram(
+    "state",
+    "block_processing_seconds",
+    "Time spent processing a block (validate + execute + commit).",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+)
 
 
 def build_last_commit_info(
@@ -275,6 +284,12 @@ class BlockExecutor:
     ) -> State:
         """Validate, execute against the app, update state, commit
         (reference: internal/state/execution.go:151-237)."""
+        with _m_block_processing.time():
+            return await self._apply_block_timed(state, block_id, block)
+
+    async def _apply_block_timed(
+        self, state: State, block_id: BlockID, block: Block
+    ) -> State:
         self.validate_block(state, block)
 
         responses = await self._exec_block(state, block)

@@ -312,9 +312,27 @@ class TestPexReactor:
                     if c.node_id in a.peer_manager.peers():
                         break
                     await asyncio.sleep(0.1)
-                assert c.node_id in a.peer_manager.peers(), (
-                    "pex never propagated c's address to a"
-                )
+                if c.node_id not in a.peer_manager.peers():
+                    diag = {
+                        "a.peers": a.peer_manager.peers(),
+                        "b.peers": b.peer_manager.peers(),
+                        "c.peers": c.peer_manager.peers(),
+                        "a.book": {
+                            pid: sorted(p.addresses)
+                            for pid, p in a.peer_manager._peers.items()
+                        },
+                        "b.book": {
+                            pid: sorted(p.addresses)
+                            for pid, p in b.peer_manager._peers.items()
+                        },
+                        "a.requested": reactors[0]._requested,
+                        "a.available": reactors[0]._available,
+                        "a.added": reactors[0].total_added,
+                        "ids": {
+                            "a": a.node_id, "b": b.node_id, "c": c.node_id
+                        },
+                    }
+                    pytest.fail(f"pex never propagated c to a: {diag}")
             finally:
                 pexmod._MIN_POLL_INTERVAL = old
                 for r in reactors:
