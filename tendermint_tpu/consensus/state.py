@@ -328,8 +328,34 @@ class ConsensusState(Service):
                 if peer_get in done:
                     mi = peer_get.result()
                     peer_get = None
-                    self.wal.write(mi)
-                    await self._handle_msg(mi)
+                    # verify-ahead: drain whatever else is already
+                    # queued (bounded) and batch-verify the vote
+                    # signatures in one device call before processing
+                    # serially (SURVEY §7; reference hot path:
+                    # state.go:2010,2058 + vote_set.go:203 verifies one
+                    # by one on CPU)
+                    batch = [mi]
+                    while len(batch) < 256:
+                        try:
+                            batch.append(self.peer_msg_queue.get_nowait())
+                        except asyncio.QueueEmpty:
+                            break
+                    self._preverify_votes(batch)
+                    for m in batch:
+                        # own messages keep strict priority over the
+                        # rest of the drained batch: a just-signed own
+                        # vote must be fsynced + applied before further
+                        # peer input (same invariant as the un-batched
+                        # loop above)
+                        while True:
+                            try:
+                                own = self.internal_msg_queue.get_nowait()
+                            except asyncio.QueueEmpty:
+                                break
+                            self.wal.write_sync(own)
+                            await self._handle_msg(own)
+                        self.wal.write(m)
+                        await self._handle_msg(m)
                 if timeout_get in done:
                     ti = timeout_get.result()
                     timeout_get = None
@@ -339,6 +365,59 @@ class ConsensusState(Service):
             for t in (internal_get, peer_get, timeout_get):
                 if t is not None and not t.done():
                     t.cancel()
+
+    def _preverify_votes(self, batch: list) -> None:
+        """Batch-verify signatures of queued votes for the CURRENT
+        height in one device call; valid ones are marked so
+        VoteSet.add_vote skips its per-vote CPU verify. Runs inside the
+        single-writer loop against rs.validators — the exact set every
+        HeightVoteSet of this height verifies with — so the marker never
+        widens acceptance. Failed or foreign-height votes are left
+        unmarked and take the normal verify path (which produces the
+        proper per-vote error)."""
+        from ..crypto.batch import (
+            create_batch_verifier,
+            supports_batch_verifier,
+        )
+
+        rs = self.rs
+        candidates = []
+        for mi in batch:
+            msg = mi.msg
+            if not isinstance(msg, VoteMessage):
+                continue
+            vote = msg.vote
+            if (
+                vote.height != rs.height
+                or vote.signature is None
+                or getattr(vote, "_pre_verified", False)
+            ):
+                continue
+            addr, val = rs.validators.get_by_index(vote.validator_index)
+            if val is None or addr != vote.validator_address:
+                continue
+            if val.pub_key.address() != vote.validator_address:
+                continue  # same check Vote.verify performs
+            candidates.append((vote, val.pub_key))
+        if len(candidates) < 2 or not supports_batch_verifier(
+            candidates[0][1]
+        ):
+            return
+        try:
+            bv = create_batch_verifier(
+                candidates[0][1], size_hint=len(candidates)
+            )
+            for vote, pk in candidates:
+                bv.add(pk, vote.sign_bytes(self.state.chain_id), vote.signature)
+            _all_ok, bitmap = bv.verify()
+        except Exception as e:
+            # mixed key types or a device hiccup: fall back to the
+            # per-vote path for the whole batch
+            self.logger.debug("verify-ahead batch failed", err=str(e))
+            return
+        for (vote, _pk), ok in zip(candidates, bitmap):
+            if ok:
+                vote._pre_verified = True
 
     async def _handle_msg(self, mi: MsgInfo) -> None:
         """reference: state.go:891-960 handleMsg."""

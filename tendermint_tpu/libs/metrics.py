@@ -36,11 +36,22 @@ __all__ = [
 ]
 
 
+def _escape_label(v: str) -> str:
+    """Text-format escaping: backslash, quote, newline — one corrupt
+    label value must not make the whole scrape unparseable."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(names: Sequence[str], values: Tuple[str, ...]) -> str:
     if not names:
         return ""
     inner = ",".join(
-        f'{n}="{v}"' for n, v in zip(names, values)
+        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)
     )
     return "{" + inner + "}"
 

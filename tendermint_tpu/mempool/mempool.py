@@ -92,6 +92,7 @@ class TxMempool(Mempool):
         self._txs.clear()
         self._senders.clear()
         self._bytes = 0
+        self._m_size.set(0)
         self.cache.reset()
 
     # -- ingestion --

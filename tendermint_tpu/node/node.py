@@ -441,9 +441,11 @@ class Node(Service):
 
         async def handler(reader, writer):
             try:
-                line = await reader.readline()
+                # bound the whole request read: this is an unauthenticated
+                # port and a half-open request must not pin a task forever
+                line = await asyncio.wait_for(reader.readline(), 10.0)
                 while True:
-                    h = await reader.readline()
+                    h = await asyncio.wait_for(reader.readline(), 10.0)
                     if h in (b"\r\n", b"\n", b""):
                         break
                 body = DEFAULT_REGISTRY.render().encode()
@@ -459,7 +461,11 @@ class Node(Service):
                     b"Connection: close\r\n\r\n" + body
                 )
                 await writer.drain()
-            except (ConnectionError, asyncio.IncompleteReadError):
+            except (
+                ConnectionError,
+                asyncio.IncompleteReadError,
+                asyncio.TimeoutError,
+            ):
                 pass
             finally:
                 writer.close()
@@ -514,6 +520,10 @@ class Node(Service):
         ms = getattr(self, "_metrics_server", None)
         if ms is not None:
             ms.close()
+            try:
+                await asyncio.wait_for(ms.wait_closed(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass  # straggling scrape connections die with the loop
             self._metrics_server = None
         for svc in (
             self.rpc_server,

@@ -150,8 +150,13 @@ class VoteSet:
             if existing.signature == vote.signature:
                 return False  # duplicate
             raise ValueError("non-deterministic signature")
-        # Check signature (raises on failure).
-        vote.verify(self.chain_id, val.pub_key)
+        # Check signature (raises on failure). The verify-ahead queue
+        # (consensus/state.py _preverify_votes) may have already batch-
+        # verified this exact vote on device against THIS height's
+        # validator set; the marker is set only after the same
+        # address+signature checks passed there.
+        if not getattr(vote, "_pre_verified", False):
+            vote.verify(self.chain_id, val.pub_key)
         added, conflicting = self._add_verified_vote(
             vote, block_key, val.voting_power
         )

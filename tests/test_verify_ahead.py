@@ -1,0 +1,196 @@
+"""Verify-ahead vote queue tests: queued votes are batch-verified in
+one call before the single-writer loop processes them, and the marker
+never widens acceptance (SURVEY §7 verify-ahead design; reference hot
+path: internal/consensus/state.go:2010,2058 + types/vote_set.go:203).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tendermint_tpu.consensus.msgs import MsgInfo, VoteMessage
+from tendermint_tpu.crypto import tpu_verifier
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.validator import Validator, ValidatorSet
+from tendermint_tpu.types.vote import Vote
+from tendermint_tpu.types.vote_set import VoteSet
+
+from tests.test_consensus_state import Node, fast_config
+
+CHAIN = "va-chain"
+
+
+def _votes(privs, vals, height, block_id, vtype=PREVOTE_TYPE):
+    order = {v.address: i for i, v in enumerate(vals.validators)}
+    out = []
+    now = time.time_ns()
+    for p in privs:
+        addr = p.pub_key().address()
+        v = Vote(
+            type=vtype,
+            height=height,
+            round=0,
+            block_id=block_id,
+            timestamp_ns=now,
+            validator_address=addr,
+            validator_index=order[addr],
+        )
+        v.signature = p.sign(v.sign_bytes(CHAIN))
+        out.append(v)
+    return out
+
+
+def _genesis(privs):
+    return GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
+        ],
+    )
+
+
+def test_preverify_marks_valid_and_skips_invalid():
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 1]) * 32)
+                 for i in range(6)]
+        genesis = _genesis(privs)
+        node = Node(privs[0], genesis)
+        cs = node.cs
+        vals = cs.rs.validators
+        bid = BlockID(
+            hash=b"\x42" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x43" * 32),
+        )
+        votes = _votes(privs, vals, cs.rs.height, bid)
+        # corrupt one signature
+        votes[3].signature = (
+            votes[3].signature[:-1]
+            + bytes([votes[3].signature[-1] ^ 1])
+        )
+        batch = [MsgInfo(msg=VoteMessage(vote=v), peer_id="p") for v in votes]
+        cs._preverify_votes(batch)
+        marked = [getattr(v, "_pre_verified", False) for v in votes]
+        assert marked == [True, True, True, False, True, True]
+
+        # the corrupted vote still fails through the normal path
+        vs = VoteSet(CHAIN, cs.rs.height, 0, PREVOTE_TYPE, vals)
+        for i, v in enumerate(votes):
+            if i == 3:
+                with pytest.raises(ValueError, match="invalid signature"):
+                    vs.add_vote(v)
+            else:
+                assert vs.add_vote(v)
+
+    asyncio.run(go())
+
+
+def test_preverify_ignores_foreign_heights_and_bad_indexes():
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 30]) * 32)
+                 for i in range(4)]
+        node = Node(privs[0], _genesis(privs))
+        cs = node.cs
+        vals = cs.rs.validators
+        bid = BlockID(
+            hash=b"\x52" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x53" * 32),
+        )
+        future = _votes(privs, vals, cs.rs.height + 5, bid)
+        wrong_index = _votes(privs, vals, cs.rs.height, bid)
+        for v in wrong_index:
+            v.validator_index = (v.validator_index + 1) % 4
+        batch = [
+            MsgInfo(msg=VoteMessage(vote=v), peer_id="p")
+            for v in future + wrong_index
+        ]
+        cs._preverify_votes(batch)
+        assert not any(
+            getattr(v, "_pre_verified", False)
+            for v in future + wrong_index
+        )
+
+    asyncio.run(go())
+
+
+def test_marker_does_not_bypass_address_or_hrs_checks():
+    """A hostile peer cannot smuggle a vote past VoteSet by setting the
+    attribute name externally: add_vote still enforces index/address/
+    HRS and duplicate checks before the signature step."""
+
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 60]) * 32)
+                 for i in range(4)]
+        node = Node(privs[0], _genesis(privs))
+        cs = node.cs
+        vals = cs.rs.validators
+        bid = BlockID(
+            hash=b"\x62" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x63" * 32),
+        )
+        vote = _votes(privs, vals, cs.rs.height, bid)[0]
+        vote._pre_verified = True
+        # point at a DIFFERENT validator's slot than the vote's address
+        vote.validator_index = (vote.validator_index + 1) % 4
+        vs = VoteSet(CHAIN, cs.rs.height, 0, PREVOTE_TYPE, vals)
+        with pytest.raises(ValueError, match="does not match"):
+            vs.add_vote(vote)
+
+    asyncio.run(go())
+
+
+def test_batched_votes_flow_through_receive_loop():
+    """End-to-end through the running consensus loop: a burst of
+    queued votes is drained, pre-verified in one batch, and tallied
+    (3 of 4 validators precommit -> commit advances the height)."""
+
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 90]) * 32)
+                 for i in range(4)]
+        genesis = _genesis(privs)
+        # the node must be height 1/round 0's proposer or it has no
+        # proposal to vote on (no peers to receive one from)
+        probe = ValidatorSet(
+            [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+        )
+        proposer_addr = probe.get_proposer().address
+        me = next(
+            p for p in privs if p.pub_key().address() == proposer_addr
+        )
+        node = Node(me, genesis, cfg=fast_config(
+            timeout_propose=2.0,
+        ))
+        cs = node.cs
+        sigs_before = tpu_verifier.stats()["sigs"]
+        tpu_verifier.install(min_batch=2)
+        await cs.start()
+        try:
+            # wait for our proposal for height 1 to exist
+            deadline = time.monotonic() + 10.0
+            while cs.rs.proposal_block is None:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("no proposal")
+                await asyncio.sleep(0.02)
+            bid = BlockID(
+                hash=cs.rs.proposal_block.hash(),
+                part_set_header=cs.rs.proposal_block_parts.header(),
+            )
+            height = cs.rs.height
+            # burst: prevotes + precommits from the other 3 validators
+            others = [p for p in privs if p is not me]
+            for vtype in (PREVOTE_TYPE, PRECOMMIT_TYPE):
+                for v in _votes(others, cs.rs.validators, height, bid,
+                                vtype):
+                    cs.send_peer_msg(VoteMessage(vote=v), "peerX")
+                await asyncio.sleep(0.3)
+            await cs.wait_for_height(height + 1, timeout=15.0)
+            # the burst went through the device batch path
+            assert tpu_verifier.stats()["sigs"] > sigs_before
+        finally:
+            await cs.stop()
+
+    asyncio.run(go())
