@@ -346,12 +346,25 @@ class ConsensusState(Service):
                         # rest of the drained batch: a just-signed own
                         # vote must be fsynced + applied before further
                         # peer input (same invariant as the un-batched
-                        # loop above)
+                        # loop above). The pending internal_get task may
+                        # have already claimed a queued own message —
+                        # consume it there first, or the vote would sit
+                        # in the completed task until the batch ends.
                         while True:
-                            try:
-                                own = self.internal_msg_queue.get_nowait()
-                            except asyncio.QueueEmpty:
-                                break
+                            own = None
+                            if (
+                                internal_get is not None
+                                and internal_get.done()
+                            ):
+                                own = internal_get.result()
+                                internal_get = None
+                            else:
+                                try:
+                                    own = (
+                                        self.internal_msg_queue.get_nowait()
+                                    )
+                                except asyncio.QueueEmpty:
+                                    break
                             self.wal.write_sync(own)
                             await self._handle_msg(own)
                         self.wal.write(m)
@@ -382,6 +395,7 @@ class ConsensusState(Service):
 
         rs = self.rs
         candidates = []
+        key_type = None
         for mi in batch:
             msg = mi.msg
             if not isinstance(msg, VoteMessage):
@@ -389,15 +403,24 @@ class ConsensusState(Service):
             vote = msg.vote
             if (
                 vote.height != rs.height
-                or vote.signature is None
+                or not vote.signature
+                or len(vote.signature) != 64
                 or getattr(vote, "_pre_verified", False)
             ):
+                # malformed entries go to the per-vote path; they must
+                # not make bv.add throw and kill the whole batch (one
+                # hostile 63-byte signature would otherwise disable the
+                # fast path for every vote in the burst)
                 continue
             addr, val = rs.validators.get_by_index(vote.validator_index)
             if val is None or addr != vote.validator_address:
                 continue
             if val.pub_key.address() != vote.validator_address:
                 continue  # same check Vote.verify performs
+            if key_type is None:
+                key_type = val.pub_key.type()
+            elif val.pub_key.type() != key_type:
+                continue  # mixed set: batch the first type only
             candidates.append((vote, val.pub_key))
         if len(candidates) < 2 or not supports_batch_verifier(
             candidates[0][1]

@@ -441,13 +441,25 @@ class Node(Service):
 
         async def handler(reader, writer):
             try:
-                # bound the whole request read: this is an unauthenticated
-                # port and a half-open request must not pin a task forever
-                line = await asyncio.wait_for(reader.readline(), 10.0)
-                while True:
-                    h = await asyncio.wait_for(reader.readline(), 10.0)
+                # bound the whole request (deadline + header cap): this
+                # is an unauthenticated port, and a slow-loris client
+                # feeding one header per few seconds must not pin a
+                # task forever
+                deadline = asyncio.get_event_loop().time() + 10.0
+
+                async def _line():
+                    budget = deadline - asyncio.get_event_loop().time()
+                    if budget <= 0:
+                        raise asyncio.TimeoutError
+                    return await asyncio.wait_for(reader.readline(), budget)
+
+                line = await _line()
+                for _ in range(100):  # header cap
+                    h = await _line()
                     if h in (b"\r\n", b"\n", b""):
                         break
+                else:
+                    raise asyncio.TimeoutError
                 body = DEFAULT_REGISTRY.render().encode()
                 status = (
                     b"200 OK" if b"/metrics" in line else b"404 Not Found"

@@ -1,0 +1,307 @@
+"""Byzantine and fault-injection tests over live networks
+(reference models: internal/consensus/byzantine_test.go — a
+double-signing validator driven through an in-process network —
+and test/e2e/runner/perturb.go — kill/disconnect perturbations).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tendermint_tpu.config import Config
+from tendermint_tpu.consensus.msgs import VoteMessage
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.node import NodeKey, make_node
+from tendermint_tpu.p2p.transport import MemoryNetwork, MemoryTransport
+from tendermint_tpu.p2p.types import Envelope
+from tendermint_tpu.privval import FilePV, MockPV
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.canonical import PREVOTE_TYPE
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.vote import Vote
+
+CHAIN = "byz-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _fast(cfg: Config) -> None:
+    cfg.consensus.timeout_propose = 2.0
+    cfg.consensus.timeout_prevote = 1.0
+    cfg.consensus.timeout_precommit = 1.0
+    cfg.consensus.timeout_commit = 0.2
+    cfg.consensus.peer_gossip_sleep_duration = 0.01
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+
+
+def _localnet(tmp_path, n, chain_id=CHAIN, db="memdb"):
+    privs = [
+        PrivKeyEd25519.from_seed(bytes([i + 120]) * 32) for i in range(n)
+    ]
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
+        ],
+    )
+    net = MemoryNetwork()
+    cfgs = []
+    for i in range(n):
+        cfg = Config()
+        cfg.base.home = str(tmp_path / f"node{i}")
+        cfg.base.chain_id = chain_id
+        cfg.base.db_backend = db
+        cfg.ensure_dirs()
+        _fast(cfg)
+        cfg.p2p.laddr = f"node{i}:26656"
+        genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+        FilePV.from_priv_key(
+            privs[i],
+            cfg.base.path(cfg.priv_validator.key_file),
+            cfg.base.path(cfg.priv_validator.state_file),
+        ).save()
+        cfgs.append(cfg)
+    node_ids = [
+        NodeKey.load_or_generate(c.base.path(c.base.node_key_file)).node_id
+        for c in cfgs
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.p2p.persistent_peers = ",".join(
+            f"{node_ids[j]}@node{j}:26656" for j in range(n) if j != i
+        )
+    nodes = [
+        make_node(cfg, transport=MemoryTransport(net, f"node{i}:26656"))
+        for i, cfg in enumerate(cfgs)
+    ]
+    return privs, genesis, net, cfgs, node_ids, nodes
+
+
+def test_double_signing_validator_caught_evidenced_committed(tmp_path):
+    """A validator that signs conflicting prevotes over the REAL
+    reactor/vote-channel path is detected by honest peers, turned into
+    DuplicateVoteEvidence, and committed in a block
+    (reference: internal/consensus/byzantine_test.go:552)."""
+
+    async def go():
+        privs, genesis, net, cfgs, node_ids, nodes = _localnet(tmp_path, 4)
+        byz_idx = 0
+        byz_priv = privs[byz_idx]
+        byz = nodes[byz_idx]
+        # no double-sign protection on the byzantine node
+        byz.privval = MockPV(byz_priv)
+
+        for n in nodes:
+            await n.start()
+        try:
+            cs = byz.consensus
+            reactor = byz.consensus_reactor
+            byz_addr = byz_priv.pub_key().address()
+            attacked = asyncio.Event()
+
+            orig_do_prevote = cs.do_prevote
+
+            async def byz_do_prevote(height, round_):
+                # honest prevote first (signed + gossiped normally)
+                await orig_do_prevote(height, round_)
+                if attacked.is_set() or cs.rs.proposal_block is None:
+                    return
+                # conflicting prevote for a fabricated block, sent over
+                # the real vote channel to every peer
+                order = {
+                    v.address: i
+                    for i, v in enumerate(cs.rs.validators.validators)
+                }
+                evil = Vote(
+                    type=PREVOTE_TYPE,
+                    height=height,
+                    round=round_,
+                    block_id=BlockID(
+                        hash=b"\xde" * 32,
+                        part_set_header=PartSetHeader(
+                            total=1, hash=b"\xad" * 32
+                        ),
+                    ),
+                    timestamp_ns=time.time_ns(),
+                    validator_address=byz_addr,
+                    validator_index=order[byz_addr],
+                )
+                await byz.privval.sign_vote(genesis.chain_id, evil)
+                await reactor.vote_ch.send(
+                    Envelope(message=VoteMessage(vote=evil), broadcast=True)
+                )
+                attacked.set()
+
+            cs.do_prevote = byz_do_prevote
+
+            # evidence should land in a committed block on honest nodes
+            deadline = time.monotonic() + 120.0
+            found = None
+            while time.monotonic() < deadline and found is None:
+                await asyncio.sleep(0.3)
+                for n in nodes[1:]:
+                    for h in range(1, n.block_store.height() + 1):
+                        block = n.block_store.load_block(h)
+                        if block is None:
+                            continue
+                        for ev in block.evidence:
+                            if isinstance(ev, DuplicateVoteEvidence):
+                                found = (n, h, ev)
+                                break
+            assert found is not None, "evidence never committed"
+            _, height, ev = found
+            assert ev.vote_a.validator_address == byz_addr
+            assert ev.vote_b.validator_address == byz_addr
+            assert ev.vote_a.block_id != ev.vote_b.block_id
+            # the chain keeps making progress after the attack
+            tip = max(n.block_store.height() for n in nodes[1:])
+            await nodes[1].consensus.wait_for_height(tip + 1, timeout=60.0)
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(go())
+
+
+def test_kill_node_then_restart_catches_up(tmp_path):
+    """Perturbation 'kill': stop one validator, let the others advance,
+    restart it over the same home dir — block sync must bring it back
+    to the tip (reference: test/e2e/runner/perturb.go kill + the
+    blocksync switchover)."""
+
+    async def go():
+        privs, genesis, net, cfgs, node_ids, nodes = _localnet(
+            tmp_path, 4, chain_id="kill-chain", db="sqlite"
+        )
+        for n in nodes:
+            await n.start()
+        try:
+            await asyncio.gather(
+                *(n.consensus.wait_for_height(3, timeout=120.0)
+                  for n in nodes)
+            )
+            # kill node3
+            await nodes[3].stop()
+            survivors = nodes[:3]
+            tip = max(n.block_store.height() for n in survivors)
+            await asyncio.gather(
+                *(n.consensus.wait_for_height(tip + 3, timeout=120.0)
+                  for n in survivors)
+            )
+            # restart from the same home; must catch up via block sync
+            revived = make_node(
+                cfgs[3],
+                transport=MemoryTransport(net, "node3:26656"),
+            )
+            await revived.start()
+            nodes[3] = revived
+            target = max(n.block_store.height() for n in survivors)
+            deadline = time.monotonic() + 120.0
+            while revived.block_store.height() < target:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"revived node at {revived.block_store.height()}, "
+                        f"target {target}"
+                    )
+                await asyncio.sleep(0.3)
+            # and it agrees with the others
+            h = revived.block_store.height()
+            assert (
+                revived.block_store.load_block(h - 1).hash()
+                == survivors[0].block_store.load_block(h - 1).hash()
+            )
+        finally:
+            for n in nodes:
+                if n.is_running:
+                    await n.stop()
+
+    run(go())
+
+
+def test_disconnect_all_peers_then_reconnect(tmp_path):
+    """Perturbation 'disconnect': sever every connection of one node;
+    persistent-peer redial must restore them and consensus continues
+    (reference: test/e2e/runner/perturb.go disconnect)."""
+
+    async def go():
+        privs, genesis, net, cfgs, node_ids, nodes = _localnet(
+            tmp_path, 4, chain_id="disc-chain"
+        )
+        for n in nodes:
+            await n.start()
+        try:
+            await asyncio.gather(
+                *(n.consensus.wait_for_height(2, timeout=120.0)
+                  for n in nodes)
+            )
+            victim = nodes[3]
+            for pid in list(victim.router._peer_conns):
+                victim.router._peer_down(pid)
+            assert not victim.peer_manager.peers()
+            # redial restores the mesh
+            deadline = time.monotonic() + 60.0
+            while len(victim.peer_manager.peers()) < 3:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"only {len(victim.peer_manager.peers())} peers back"
+                    )
+                await asyncio.sleep(0.2)
+            # and consensus keeps advancing on every node
+            tip = max(n.block_store.height() for n in nodes)
+            await asyncio.gather(
+                *(n.consensus.wait_for_height(tip + 2, timeout=120.0)
+                  for n in nodes)
+            )
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(go())
+
+
+def test_replay_initial_height_above_one(tmp_path):
+    """Replay-matrix cell: a chain whose genesis initial_height > 1
+    must recover from a crash at its FIRST height (WAL EndHeight maps
+    to 0 — reference: internal/consensus/replay.go:127-129)."""
+
+    async def go():
+        priv = PrivKeyEd25519.from_seed(b"\x7f" * 32)
+        genesis = GenesisDoc(
+            chain_id="ih-chain",
+            genesis_time_ns=time.time_ns(),
+            initial_height=5,
+            validators=[GenesisValidator(pub_key=priv.pub_key(), power=10)],
+        )
+        cfg = Config()
+        cfg.base.home = str(tmp_path / "ih")
+        cfg.base.chain_id = "ih-chain"
+        cfg.base.db_backend = "sqlite"
+        cfg.ensure_dirs()
+        _fast(cfg)
+        genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+        FilePV.from_priv_key(
+            priv,
+            cfg.base.path(cfg.priv_validator.key_file),
+            cfg.base.path(cfg.priv_validator.state_file),
+        ).save()
+        node = make_node(cfg)
+        await node.start()
+        try:
+            await node.consensus.wait_for_height(7, timeout=60.0)
+            assert node.block_store.base() >= 5  # chain starts at 5
+        finally:
+            await node.stop()
+        # restart: WAL replay over initial_height must not be skipped
+        node2 = make_node(cfg)
+        await node2.start()
+        try:
+            h = node2.block_store.height()
+            await node2.consensus.wait_for_height(h + 2, timeout=60.0)
+        finally:
+            await node2.stop()
+
+    run(go())
