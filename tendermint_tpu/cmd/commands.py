@@ -486,6 +486,82 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_debug_dump(args) -> int:
+    """Collect a diagnostic bundle from a node's home into a tarball:
+    config, genesis, store heights + state summary, a WAL copy, and a
+    live /metrics scrape when reachable (reference:
+    cmd/tendermint/commands/debug/{dump,io}.go)."""
+    import io
+    import tarfile
+    import urllib.request
+
+    from ..state import StateStore
+    from ..store.block_store import BlockStore
+    from ..store.kv import open_db
+
+    cfg = _load_home(args.home)
+    out_path = os.path.expanduser(args.output)
+
+    def add_bytes(tar, name, data: bytes):
+        info = tarfile.TarInfo(name)
+        info.size = len(data)
+        info.mtime = int(time.time())
+        tar.addfile(info, io.BytesIO(data))
+
+    with tarfile.open(out_path, "w:gz") as tar:
+        for rel in (
+            "config/config.toml",
+            "config/genesis.json",
+        ):
+            path = cfg.base.path(rel)
+            if os.path.exists(path):
+                tar.add(path, arcname=os.path.basename(path))
+        wal_path = cfg.base.path(cfg.consensus.wal_file)
+        if os.path.exists(wal_path):
+            tar.add(wal_path, arcname="cs.wal")
+        # store summary (opens read-only copies of the DBs)
+        summary = {"collected_at": time.time()}
+        try:
+            db_dir = cfg.base.path(cfg.base.db_dir)
+            bdb = open_db("blockstore", cfg.base.db_backend, db_dir)
+            sdb = open_db("state", cfg.base.db_backend, db_dir)
+            try:
+                bs = BlockStore(bdb)
+                st = StateStore(sdb).load()
+                summary["block_store"] = {
+                    "base": bs.base(),
+                    "height": bs.height(),
+                }
+                if st is not None:
+                    summary["state"] = {
+                        "height": st.last_block_height,
+                        "app_hash": st.app_hash.hex(),
+                        "validators": st.validators.size(),
+                        "chain_id": st.chain_id,
+                    }
+            finally:
+                bdb.close()
+                sdb.close()
+        except Exception as e:
+            summary["store_error"] = repr(e)
+        add_bytes(
+            tar, "summary.json", json.dumps(summary, indent=2).encode()
+        )
+        # live metrics scrape, best effort
+        if args.metrics_url:
+            try:
+                with urllib.request.urlopen(
+                    args.metrics_url, timeout=5
+                ) as resp:
+                    add_bytes(tar, "metrics.txt", resp.read())
+            except Exception as e:
+                add_bytes(
+                    tar, "metrics_error.txt", repr(e).encode()
+                )
+    print(f"wrote debug bundle to {out_path}")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(_version.__version__)
     return 0
@@ -579,6 +655,17 @@ def build_parser() -> argparse.ArgumentParser:
         "replay", help="re-execute stored blocks through a fresh app"
     )
     sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser(
+        "debug", help="collect a diagnostic bundle into a tarball"
+    )
+    sp.add_argument("--output", "-o", default="./debug_bundle.tar.gz")
+    sp.add_argument(
+        "--metrics-url",
+        default="",
+        help="live /metrics endpoint to scrape into the bundle",
+    )
+    sp.set_defaults(fn=cmd_debug_dump)
 
     sp = sub.add_parser("version", help="print the version")
     sp.set_defaults(fn=cmd_version)

@@ -434,8 +434,9 @@ class ConsensusState(Service):
                 bv.add(pk, vote.sign_bytes(self.state.chain_id), vote.signature)
             _all_ok, bitmap = bv.verify()
         except Exception as e:
-            # mixed key types or a device hiccup: fall back to the
-            # per-vote path for the whole batch
+            # a device hiccup: fall back to the per-vote path for the
+            # whole batch (candidate filtering already excluded
+            # malformed signatures and mixed key types)
             self.logger.debug("verify-ahead batch failed", err=str(e))
             return
         for (vote, _pk), ok in zip(candidates, bitmap):

@@ -195,11 +195,15 @@ def iter_fields(data: bytes) -> Iterator[Tuple[int, int, "int | bytes"]]:
         if wire_type == 0:
             value, offset = decode_varint(data, offset)
         elif wire_type == 1:
+            if offset + 8 > len(data):
+                raise ValueError("truncated fixed64 field")
             (value,) = struct.unpack_from("<Q", data, offset)
             offset += 8
         elif wire_type == 2:
             value, offset = read_length_prefixed(data, offset)
         elif wire_type == 5:
+            if offset + 4 > len(data):
+                raise ValueError("truncated fixed32 field")
             (value,) = struct.unpack_from("<I", data, offset)
             offset += 4
         else:

@@ -477,6 +477,7 @@ class Node(Service):
                 ConnectionError,
                 asyncio.IncompleteReadError,
                 asyncio.TimeoutError,
+                ValueError,  # readline: line longer than the 64K limit
             ):
                 pass
             finally:

@@ -148,6 +148,51 @@ def test_start_runs_and_produces_blocks(tmp_path):
     )
 
 
+def test_debug_bundle(tmp_path, capsys):
+    """`debug` collects config/genesis/WAL/store summary after a run
+    (reference: commands/debug/dump.go)."""
+    import asyncio as aio
+    import tarfile
+
+    home = str(tmp_path / "dbg")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "dbg-chain") == 0
+    # produce a little history in-process
+    from tendermint_tpu.node import make_node
+    from tendermint_tpu.config import load_config, write_config
+
+    cfg_path = os.path.join(home, "config", "config.toml")
+    cfg = load_config(cfg_path)
+    cfg.consensus.timeout_commit = 0.2
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    write_config(cfg, cfg_path)
+
+    async def produce():
+        cfg2 = load_config(cfg_path)
+        cfg2.base.home = home
+        node = make_node(cfg2)
+        await node.start()
+        try:
+            await node.consensus.wait_for_height(3, timeout=60.0)
+        finally:
+            await node.stop()
+
+    aio.run(produce())
+    out = str(tmp_path / "bundle.tar.gz")
+    assert run_cli("--home", home, "debug", "-o", out) == 0
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+        assert "config.toml" in names
+        assert "genesis.json" in names
+        assert "summary.json" in names
+        assert "cs.wal" in names
+        summary = json.loads(
+            tar.extractfile("summary.json").read()
+        )
+        assert summary["block_store"]["height"] >= 2
+        assert summary["state"]["chain_id"] == "dbg-chain"
+
+
 def test_light_proxy_serves_verified_headers(tmp_path):
     """Boot a full node in-process, run the light proxy logic against
     its RPC, and fetch a verified header through the proxy surface

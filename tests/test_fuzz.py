@@ -1,0 +1,344 @@
+"""Fuzz tests: random/mutated bytes against every surface that parses
+untrusted input (reference: test/fuzz/{mempool/checktx.go,
+p2p/secretconnection, rpc/jsonrpc}, plus internal/consensus/wal_fuzz.go).
+
+Deterministic seeds: failures reproduce. The property under test is
+always "rejects cleanly or round-trips" — never a crash, hang, or
+uncontrolled exception type.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from tendermint_tpu.abci import KVStoreApplication, LocalClient
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.mempool import MempoolError, TxMempool
+
+random.seed(0xF22)
+
+
+def _rand_bytes(max_len=512):
+    return bytes(
+        random.randrange(256) for _ in range(random.randrange(max_len))
+    )
+
+
+class TestProtoDecoderFuzz:
+    """Every from_proto must raise ValueError-family errors (or parse)
+    on arbitrary bytes — never IndexError/KeyError/UnboundLocal/hangs."""
+
+    CODECS = None
+
+    @classmethod
+    def _codecs(cls):
+        if cls.CODECS is None:
+            from tendermint_tpu.blocksync import msgs as bs
+            from tendermint_tpu.consensus import msgs as cs
+            from tendermint_tpu.p2p.pex import _Codec as PexCodec
+            from tendermint_tpu.statesync import msgs as ss
+            from tendermint_tpu.types.block import Block
+            from tendermint_tpu.types.commit import Commit
+            from tendermint_tpu.types.evidence import evidence_from_proto
+            from tendermint_tpu.types.header import Header
+            from tendermint_tpu.types.light import LightBlock
+            from tendermint_tpu.types.proposal import Proposal
+            from tendermint_tpu.types.validator import ValidatorSet
+            from tendermint_tpu.types.vote import Vote
+
+            cls.CODECS = [
+                Vote.from_proto,
+                Proposal.from_proto,
+                Commit.from_proto,
+                Header.from_proto,
+                Block.from_proto,
+                LightBlock.from_proto,
+                ValidatorSet.from_proto,
+                evidence_from_proto,
+                cs.decode_msg,
+                bs.BlocksyncCodec.decode,
+                ss.StatesyncCodec.decode,
+                PexCodec.decode,
+            ]
+            cls.CODECS = [c for c in cls.CODECS if c is not None]
+        return cls.CODECS
+
+    @pytest.mark.parametrize("trial", range(8))
+    def test_random_bytes(self, trial):
+        random.seed(0x1000 + trial)
+        for decoder in self._codecs():
+            for _ in range(40):
+                data = _rand_bytes()
+                try:
+                    decoder(data)
+                except (ValueError, KeyError, TypeError, EOFError):
+                    # structured rejection is fine; KeyError/TypeError
+                    # would ideally normalize to ValueError but must at
+                    # least be deterministic exceptions, not crashes
+                    pass
+
+    def test_mutated_valid_messages(self):
+        """Bit-flip real encodings: decoders must reject or reparse,
+        never wedge."""
+        import time as _time
+
+        from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+        from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
+        from tendermint_tpu.types.vote import Vote
+
+        vote = Vote(
+            type=PRECOMMIT_TYPE,
+            height=7,
+            round=1,
+            block_id=BlockID(
+                hash=b"\x01" * 32,
+                part_set_header=PartSetHeader(total=3, hash=b"\x02" * 32),
+            ),
+            timestamp_ns=_time.time_ns(),
+            validator_address=b"\x03" * 20,
+            validator_index=2,
+            signature=b"\x04" * 64,
+        )
+        blob = vote.to_proto()
+        random.seed(0xBEEF)
+        for _ in range(300):
+            b = bytearray(blob)
+            for _ in range(random.randrange(1, 4)):
+                b[random.randrange(len(b))] ^= 1 << random.randrange(8)
+            try:
+                Vote.from_proto(bytes(b))
+            except (ValueError, KeyError, TypeError, EOFError):
+                pass
+
+
+class TestMempoolCheckTxFuzz:
+    """reference: test/fuzz/mempool/checktx.go — arbitrary tx bytes
+    through CheckTx must be accepted or rejected, never corrupt the
+    pool accounting."""
+
+    def test_random_txs(self):
+        async def go():
+            app = KVStoreApplication()
+            mp = TxMempool(
+                LocalClient(app), MempoolConfig(size=100, cache_size=200)
+            )
+            random.seed(0x2000)
+            accepted = 0
+            for _ in range(300):
+                tx = _rand_bytes(64)
+                try:
+                    res = await mp.check_tx(tx)
+                    if res.is_ok:
+                        accepted += 1
+                except MempoolError:
+                    pass
+            assert mp.size() <= 100
+            assert mp.size_bytes() >= 0
+            # pool accounting must reconcile with the entries
+            assert mp.size_bytes() == sum(
+                w.size() for w in mp._txs.values()
+            )
+
+        asyncio.run(go())
+
+
+class TestSecretConnectionFuzz:
+    """reference: test/fuzz/p2p/secretconnection — garbage on the wire
+    during/after the handshake must fail cleanly."""
+
+    def test_garbage_handshake(self):
+        from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+        from tendermint_tpu.p2p.conn import SecretConnection
+
+        async def go():
+            random.seed(0x3000)
+            for trial in range(10):
+                server_up = asyncio.Event()
+
+                async def evil_client(reader, writer):
+                    writer.write(_rand_bytes(200) or b"\x00")
+                    try:
+                        await writer.drain()
+                        writer.close()
+                    except ConnectionError:
+                        pass
+
+                async def handle(reader, writer):
+                    try:
+                        await asyncio.wait_for(
+                            SecretConnection.handshake(
+                                reader,
+                                writer,
+                                PrivKeyEd25519.from_seed(b"\x05" * 32),
+                            ),
+                            timeout=5.0,
+                        )
+                        raise AssertionError(
+                            "handshake accepted garbage"
+                        )
+                    except AssertionError:
+                        raise
+                    except Exception:
+                        pass  # clean rejection
+                    finally:
+                        server_up.set()
+                        writer.close()
+
+                server = await asyncio.start_server(
+                    handle, "127.0.0.1", 0
+                )
+                port = server.sockets[0].getsockname()[1]
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port
+                )
+                await evil_client(reader, writer)
+                await asyncio.wait_for(server_up.wait(), timeout=10.0)
+                server.close()
+                await server.wait_closed()
+
+        asyncio.run(go())
+
+    def test_tampered_frames_post_handshake(self):
+        """AEAD must reject modified ciphertext as a connection error."""
+        from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+        from tendermint_tpu.p2p.conn import SecretConnection
+
+        async def go():
+            done = asyncio.Event()
+            result = {}
+
+            async def server_side(reader, writer):
+                try:
+                    sc = await SecretConnection.handshake(
+                        reader, writer, PrivKeyEd25519.from_seed(b"\x06" * 32)
+                    )
+                    await sc.read_frame()
+                    result["ok"] = True
+                except Exception as e:
+                    result["err"] = type(e).__name__
+                finally:
+                    done.set()
+                    writer.close()
+
+            server = await asyncio.start_server(
+                server_side, "127.0.0.1", 0
+            )
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            sc = await SecretConnection.handshake(
+                reader, writer, PrivKeyEd25519.from_seed(b"\x07" * 32)
+            )
+            # write a frame, then flip one ciphertext byte before it
+            # hits the wire: emulate by sending a manually corrupted
+            # frame (encrypt honestly, tamper the bytes)
+            import tendermint_tpu.p2p.conn as connmod
+
+            frame = b"hello underneath the aead"
+            # encrypt via the real path into a buffer
+            class _Cap:
+                def __init__(self):
+                    self.buf = b""
+
+                def write(self, b):
+                    self.buf += b
+
+                async def drain(self):
+                    pass
+
+            cap = _Cap()
+            real_writer = sc._writer
+            sc._writer = cap
+            await sc.write_frame(frame)
+            sc._writer = real_writer
+            tampered = bytearray(cap.buf)
+            tampered[-1] ^= 1
+            real_writer.write(bytes(tampered))
+            await real_writer.drain()
+            await asyncio.wait_for(done.wait(), timeout=10.0)
+            assert "ok" not in result, "tampered frame accepted"
+            server.close()
+            await server.wait_closed()
+            writer.close()
+
+        asyncio.run(go())
+
+
+class TestJSONRPCServerFuzz:
+    """reference: test/fuzz/rpc/jsonrpc — random bodies against the
+    HTTP handler must produce JSON-RPC errors, not crashes."""
+
+    def test_random_bodies(self):
+        from tendermint_tpu.rpc.jsonrpc import JSONRPCServer, RPCRequest
+
+        async def ok_handler(req: RPCRequest):
+            return {"ok": True}
+
+        async def go():
+            srv = JSONRPCServer({"m": ok_handler})
+            await srv.start("127.0.0.1", 0)
+            port = srv.bound_port
+            random.seed(0x4000)
+            try:
+                for _ in range(25):
+                    body = _rand_bytes(300)
+                    reader, writer = await asyncio.open_connection(
+                        "127.0.0.1", port
+                    )
+                    writer.write(
+                        b"POST / HTTP/1.1\r\nHost: x\r\n"
+                        b"Content-Length: %d\r\n\r\n" % len(body) + body
+                    )
+                    await writer.drain()
+                    line = await asyncio.wait_for(
+                        reader.readline(), timeout=5.0
+                    )
+                    assert b"200" in line  # JSON-RPC error inside a 200
+                    writer.close()
+                # and a valid call still works afterwards
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port
+                )
+                body = b'{"jsonrpc":"2.0","id":1,"method":"m","params":{}}'
+                writer.write(
+                    b"POST / HTTP/1.1\r\nHost: x\r\n"
+                    b"Content-Length: %d\r\n\r\n" % len(body) + body
+                )
+                await writer.drain()
+                line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                assert b"200" in line
+                writer.close()
+            finally:
+                await srv.stop()
+
+        asyncio.run(go())
+
+
+class TestWALFuzz:
+    """reference: internal/consensus/wal_fuzz.go — arbitrary trailing
+    garbage in the WAL file must be truncated at the last valid record,
+    never crash recovery."""
+
+    def test_garbage_tails(self, tmp_path):
+        from tendermint_tpu.consensus.wal import WAL, iter_wal_records
+
+        async def go():
+            random.seed(0x5000)
+            for trial in range(10):
+                path = str(tmp_path / f"wal{trial}")
+                wal = WAL(path)
+                await wal.start()
+                for h in (1, 2, 3):
+                    wal.write_end_height(h)
+                await wal.stop()
+                with open(path, "ab") as f:
+                    f.write(_rand_bytes(100))
+                records = list(iter_wal_records(path))
+                assert len(records) >= 3  # valid prefix kept, no crash
+                # recovery opens and appends cleanly
+                wal2 = WAL(path)
+                await wal2.start()
+                wal2.write_end_height(4)
+                await wal2.stop()
+
+        asyncio.run(go())
