@@ -183,7 +183,8 @@ def _build_light_chain(chain_id: str, n_heights: int, n_vals: int):
     vals = ValidatorSet(
         [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
     )
-    order = {p.pub_key().address(): i for i, p in enumerate(privs)}
+    # index by the set's own (sorted) order, not privs enumeration order
+    order = {v.address: i for i, v in enumerate(vals.validators)}
     base_ns = time.time_ns() - n_heights * 2_000_000_000
     blocks = {}
     prev_bid = BlockID()

@@ -1,0 +1,146 @@
+"""Component-level timing probe for the ed25519 device program.
+
+Times each stage of the verification pipeline at batch N on the
+attached device, plus an int32 VPU roofline probe, to direct kernel
+optimization. Not part of the test suite; run manually:
+
+    python scripts/perf_probe.py [N]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    import __graft_entry__ as G
+    from tendermint_tpu.ops import ed25519_kernel as K
+    from tendermint_tpu.ops import edwards as E
+    from tendermint_tpu.ops import field25519 as F
+
+    reps = max(1, 8192 // n)
+    pk, sig, dig = G._example_batch(min(n, 512))
+    tile = lambda a: np.tile(a, (1, -(-n // a.shape[1])))[:, :n]  # noqa: E731
+    pk_b = jnp.asarray(tile(pk))
+    sig_b = jnp.asarray(tile(sig))
+    dig_b = jnp.asarray(tile(dig))
+
+    full = jax.jit(K._verify_tile)
+    t_full = timeit(full, pk_b, sig_b, dig_b, reps=reps)
+    print(f"full program      N={n}: {t_full*1e3:8.1f} ms  "
+          f"({n/t_full:,.0f} sigs/s)")
+
+    # stage: byte prep + digits (everything before decompress)
+    def prep(pk_b, sig_b, dig_b):
+        pk = pk_b.astype(jnp.int32)
+        sg = sig_b.astype(jnp.int32)
+        dg = dig_b.astype(jnp.int32)
+        pk = pk.at[31].set(pk[31] & 0x7F)
+        r = sg[:32]
+        r = r.at[31].set(r[31] & 0x7F)
+        yA = K._fe_from_bytes_dev(pk)
+        yR = K._fe_from_bytes_dev(r)
+        s_ok = K._s_lt_l_dev(sg[32:])
+        dS = K._nibbles_dev(sg[32:])
+        dk = K._nibbles_dev(K._mod_l_dev(dg))
+        return yA, yR, s_ok, dS, dk
+
+    jprep = jax.jit(prep)
+    t_prep = timeit(jprep, pk_b, sig_b, dig_b, reps=reps)
+    print(f"scalar prep           : {t_prep*1e3:8.1f} ms")
+
+    yA, yR, s_ok, dS, dk = jprep(pk_b, sig_b, dig_b)
+    signA = jnp.zeros((n,), jnp.int32)
+
+    # stage: decompress both points
+    dec = jax.jit(lambda yA, yR, s: (E.decompress(yA, s), E.decompress(yR, s)))
+    t_dec = timeit(dec, yA, yR, signA, reps=reps)
+    print(f"decompress x2         : {t_dec*1e3:8.1f} ms")
+
+    (A, _), (R, _) = dec(yA, yR, signA)
+
+    # stage: -A table build
+    tbl = jax.jit(K._build_neg_a_table)
+    t_tbl = timeit(tbl, A, reps=reps)
+    print(f"neg-A table build     : {t_tbl*1e3:8.1f} ms")
+
+    TA = tbl(A)
+
+    # stage: the full curve stage (decompress + table + scan + compare)
+    # — the production body, not a copy (scan-only time = this minus
+    # the decompress and table rows above)
+    jcurve = jax.jit(K._scalar_mult_check)
+    signR = jnp.zeros((n,), jnp.int32)
+    t_curve = timeit(jcurve, yA, signA, yR, signR, dS, dk, reps=reps)
+    print(f"curve stage (prod)    : {t_curve*1e3:8.1f} ms")
+
+    # stage: single point ops (per-call, amortized over a 64-iter loop)
+    def dbl_loop(p):
+        return lax.fori_loop(0, 256, lambda _i, a: E.point_double(a), p)
+
+    t_dbl = timeit(jax.jit(dbl_loop), A, reps=reps)
+    print(f"256 point_doubles     : {t_dbl*1e3:8.1f} ms "
+          f"({t_dbl/256*1e6:.0f} us each)")
+
+    def add_loop(p, qc):
+        return lax.fori_loop(
+            0, 128, lambda _i, a: E.point_add_cached(a, qc), p
+        )
+
+    QC = E.cache_point(A)
+    t_add = timeit(jax.jit(add_loop), A, QC, reps=reps)
+    print(f"128 point_adds        : {t_add*1e3:8.1f} ms "
+          f"({t_add/128*1e6:.0f} us each)")
+
+    def sel_loop(TA, dk):
+        def body(i, acc):
+            return acc + K._onehot_select(TA, dk[0])
+        return lax.fori_loop(0, 128, body, jnp.zeros_like(TA[0]))
+
+    t_sel = timeit(jax.jit(sel_loop), TA, dk, reps=reps)
+    print(f"128 onehot selects    : {t_sel*1e3:8.1f} ms "
+          f"({t_sel/128*1e6:.0f} us each)")
+
+    # roofline: raw int32 multiply-add on the same array shape
+    def mac_loop(a, b):
+        def body(i, acc):
+            return acc + (a * b + acc) * jnp.int32(3)
+        return lax.fori_loop(0, 1000, body, jnp.zeros_like(a))
+
+    a = jnp.ones((4, 39, n), jnp.int32)
+    t_mac = timeit(jax.jit(mac_loop), a, a, reps=reps)
+    per = t_mac / 1000
+    elems = 4 * 39 * n
+    print(f"1000 int32 3-MAC iters on (4,39,{n}): {t_mac*1e3:8.1f} ms "
+          f"-> {elems*3/per/1e9:.0f} G int32-MAC/s")
+
+    # field op costs
+    x = jnp.ones((4, F.NLIMBS, n), jnp.int32)
+    t_mul = timeit(
+        jax.jit(lambda x: lax.fori_loop(0, 64, lambda _i, a: F.mul(a, x), x)),
+        x, reps=reps,
+    )
+    print(f"64 stacked F.mul      : {t_mul*1e3:8.1f} ms "
+          f"({t_mul/64*1e6:.0f} us each)")
+
+
+if __name__ == "__main__":
+    main()

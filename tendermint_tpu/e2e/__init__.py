@@ -1,0 +1,22 @@
+"""Manifest-driven end-to-end testnet harness.
+
+In-process analog of the reference's docker-compose e2e suite
+(test/e2e/): TOML manifests describe networks, a runner executes the
+setup/start/load/perturb/wait/test/benchmark schedule over real Nodes
+on a MemoryNetwork, and a seeded generator permutes manifests for CI.
+"""
+
+from .generator import generate
+from .manifest import LoadSpec, Manifest, NodeSpec, Perturbation
+from .runner import Runner, RunReport, run_manifest
+
+__all__ = [
+    "generate",
+    "LoadSpec",
+    "Manifest",
+    "NodeSpec",
+    "Perturbation",
+    "Runner",
+    "RunReport",
+    "run_manifest",
+]

@@ -1,0 +1,164 @@
+"""Testnet manifests: declarative descriptions of e2e networks.
+
+The reference drives its end-to-end suite from TOML manifests
+(test/e2e/pkg/manifest.go) that a runner turns into docker-compose
+testnets (test/e2e/runner/setup.go). The TPU-native build keeps the
+manifest surface but targets the in-process asyncio harness instead of
+containers: every node is a real `node.Node` over a MemoryNetwork, so
+one pytest process hosts the whole network and fault schedule.
+
+Manifest shape (TOML; all sections optional except validators):
+
+    chain_id = "e2e-net"
+    initial_height = 1
+    target_height = 6            # run until every live node is here
+
+    [validators]                 # name -> voting power
+    validator01 = 10
+    validator02 = 10
+
+    [node.validator01]
+    mode = "validator"           # validator | full | seed
+    database = "memdb"           # memdb | sqlite
+    start_at = 0                 # >0: boot only at that network height
+    state_sync = false
+    perturb = ["kill:4", "disconnect:3", "pause:5", "restart:6"]
+    misbehaviors = { double-prevote = 3 }   # action -> height
+
+    [load]
+    tx_rate = 5                  # txs/second pushed at random nodes
+    tx_size = 64
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+__all__ = ["Manifest", "NodeSpec", "LoadSpec", "Perturbation"]
+
+MODES = ("validator", "full", "seed")
+PERTURBATIONS = ("kill", "restart", "disconnect", "pause")
+MISBEHAVIORS = ("double-prevote",)
+
+
+@dataclass
+class Perturbation:
+    """A fault applied to one node when the network reaches `height`."""
+
+    action: str
+    height: int
+
+    @classmethod
+    def parse(cls, s: str) -> "Perturbation":
+        action, _, h = s.partition(":")
+        if action not in PERTURBATIONS:
+            raise ValueError(f"unknown perturbation {action!r}")
+        return cls(action=action, height=int(h or 1))
+
+
+@dataclass
+class NodeSpec:
+    name: str
+    mode: str = "validator"
+    database: str = "memdb"
+    start_at: int = 0
+    state_sync: bool = False
+    perturb: List[Perturbation] = field(default_factory=list)
+    misbehaviors: Dict[str, int] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if self.mode not in MODES:
+            raise ValueError(f"{self.name}: unknown mode {self.mode!r}")
+        for m in self.misbehaviors:
+            if m not in MISBEHAVIORS:
+                raise ValueError(f"{self.name}: unknown misbehavior {m!r}")
+        if self.state_sync and self.start_at == 0:
+            raise ValueError(
+                f"{self.name}: state_sync requires start_at > 0 "
+                "(there must be history to sync)"
+            )
+
+
+@dataclass
+class LoadSpec:
+    tx_rate: float = 0.0
+    tx_size: int = 64
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-net"
+    initial_height: int = 1
+    target_height: int = 5
+    validators: Dict[str, int] = field(default_factory=dict)
+    nodes: Dict[str, NodeSpec] = field(default_factory=dict)
+    load: LoadSpec = field(default_factory=LoadSpec)
+
+    @classmethod
+    def parse(cls, data: dict) -> "Manifest":
+        m = cls(
+            chain_id=data.get("chain_id", "e2e-net"),
+            initial_height=int(data.get("initial_height", 1)),
+            target_height=int(data.get("target_height", 5)),
+            validators={
+                k: int(v) for k, v in data.get("validators", {}).items()
+            },
+        )
+        for name, nd in data.get("node", {}).items():
+            spec = NodeSpec(
+                name=name,
+                mode=nd.get(
+                    "mode",
+                    "validator" if name in m.validators else "full",
+                ),
+                database=nd.get("database", "memdb"),
+                start_at=int(nd.get("start_at", 0)),
+                state_sync=bool(nd.get("state_sync", False)),
+                perturb=[
+                    Perturbation.parse(p) for p in nd.get("perturb", [])
+                ],
+                misbehaviors={
+                    k: int(v)
+                    for k, v in nd.get("misbehaviors", {}).items()
+                },
+            )
+            m.nodes[name] = spec
+        ld = data.get("load", {})
+        m.load = LoadSpec(
+            tx_rate=float(ld.get("tx_rate", 0.0)),
+            tx_size=int(ld.get("tx_size", 64)),
+        )
+        m.validate()
+        return m
+
+    @classmethod
+    def from_toml(cls, path: str) -> "Manifest":
+        with open(path, "rb") as f:
+            return cls.parse(tomllib.load(f))
+
+    def validate(self) -> None:
+        if not self.validators:
+            raise ValueError("manifest needs at least one validator")
+        # validators without an explicit node section get a default one
+        for name in self.validators:
+            self.nodes.setdefault(name, NodeSpec(name=name))
+        for name in self.validators:
+            if self.nodes[name].mode != "validator":
+                raise ValueError(f"{name} has power but is not a validator")
+        for spec in self.nodes.values():
+            spec.validate()
+        live_from_start = [
+            s for s in self.nodes.values()
+            if s.start_at == 0 and s.mode == "validator"
+        ]
+        power_up = sum(self.validators[s.name] for s in live_from_start)
+        if power_up * 3 <= sum(self.validators.values()) * 2:
+            raise ValueError(
+                "validators online at genesis hold <=2/3 power; "
+                "the network could never start"
+            )
+
+    def sorted_nodes(self) -> List[Tuple[str, NodeSpec]]:
+        return sorted(self.nodes.items())

@@ -1,0 +1,474 @@
+"""Manifest-driven e2e testnet runner.
+
+The reference's runner (test/e2e/runner/{setup,start,load,perturb,
+wait,test,benchmark}.go) builds docker-compose networks from TOML
+manifests, applies transaction load and fault perturbations, waits for
+convergence, then runs black-box invariant tests against live RPC. This
+runner keeps that phase structure but hosts the network in-process:
+real Nodes over a MemoryNetwork, so the whole schedule — delayed
+starts, double-signers, kills, disconnects — runs inside one asyncio
+loop, deterministically and fast enough for CI.
+
+Phases (all driven from `Runner.run()`):
+  setup     — workdir, genesis, per-node config/keys (setup.go)
+  start     — boot start_at=0 nodes; late nodes join at their heights
+              (start.go)
+  load      — background tx generator at `load.tx_rate` (load.go)
+  perturb   — kill/restart/disconnect/pause at scheduled heights
+              (perturb.go)
+  wait      — every live node reaches target_height (wait.go)
+  test      — invariants: common-prefix hash equality, app-hash
+              agreement, committed evidence for every misbehaving node,
+              tx inclusion under load (test/e2e/tests/)
+  benchmark — block-interval avg/stddev/min/max over the run
+              (benchmark.go:14-23)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..config import Config
+from ..consensus.msgs import VoteMessage
+from ..crypto.ed25519 import PrivKeyEd25519
+from ..node import NodeKey, make_node
+from ..p2p.transport import MemoryNetwork, MemoryTransport
+from ..p2p.types import Envelope
+from ..privval import FilePV, MockPV
+from ..types.block_id import BlockID, PartSetHeader
+from ..types.canonical import PREVOTE_TYPE
+from ..types.evidence import DuplicateVoteEvidence
+from ..types.genesis import GenesisDoc, GenesisValidator
+from ..types.vote import Vote
+from .manifest import Manifest, NodeSpec
+
+__all__ = ["Runner", "RunReport"]
+
+
+@dataclass
+class RunReport:
+    """Outcome of a manifest run (returned by Runner.run())."""
+
+    reached_height: int = 0
+    blocks: int = 0
+    interval_avg: float = 0.0
+    interval_stddev: float = 0.0
+    interval_min: float = 0.0
+    interval_max: float = 0.0
+    txs_submitted: int = 0
+    txs_committed: int = 0
+    evidence_heights: Dict[str, int] = field(default_factory=dict)
+    failures: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+class _NodeHandle:
+    def __init__(self, spec: NodeSpec, cfg: Config, priv=None):
+        self.spec = spec
+        self.cfg = cfg
+        self.priv = priv  # validator key, if any
+        self.node = None
+        self.started = False
+
+    @property
+    def live(self) -> bool:
+        return self.node is not None and self.node.is_running
+
+
+class Runner:
+    def __init__(
+        self, manifest: Manifest, home: str, timeout: float = 240.0
+    ):
+        self.m = manifest
+        self.home = home
+        self.timeout = timeout
+        self.net = MemoryNetwork()
+        self.handles: Dict[str, _NodeHandle] = {}
+        self._node_ids: Dict[str, str] = {}
+        self._tx_seq = 0
+        self._resume_tasks: List[asyncio.Task] = []
+        self.report = RunReport()
+
+    # -- setup (reference: test/e2e/runner/setup.go) --
+
+    def setup(self) -> None:
+        m = self.m
+        privs = {
+            name: PrivKeyEd25519.from_seed(
+                name.encode().ljust(32, b"\x9e")[:32]
+            )
+            for name in m.validators
+        }
+        genesis = GenesisDoc(
+            chain_id=m.chain_id,
+            genesis_time_ns=time.time_ns(),
+            initial_height=m.initial_height,
+            validators=[
+                GenesisValidator(pub_key=privs[n].pub_key(), power=p)
+                for n, p in sorted(m.validators.items())
+            ],
+        )
+        for name, spec in self.m.sorted_nodes():
+            cfg = Config()
+            cfg.base.home = os.path.join(self.home, name)
+            cfg.base.chain_id = m.chain_id
+            cfg.base.db_backend = spec.database
+            cfg.base.mode = spec.mode
+            cfg.consensus.timeout_propose = 2.0
+            cfg.consensus.timeout_prevote = 1.0
+            cfg.consensus.timeout_precommit = 1.0
+            cfg.consensus.timeout_commit = 0.2
+            cfg.consensus.peer_gossip_sleep_duration = 0.01
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
+            cfg.p2p.laddr = f"{name}:26656"
+            cfg.statesync.enable = spec.state_sync
+            cfg.ensure_dirs()
+            genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+            priv = privs.get(name)
+            if priv is not None:
+                FilePV.from_priv_key(
+                    priv,
+                    cfg.base.path(cfg.priv_validator.key_file),
+                    cfg.base.path(cfg.priv_validator.state_file),
+                ).save()
+            self.handles[name] = _NodeHandle(spec, cfg, priv)
+            self._node_ids[name] = NodeKey.load_or_generate(
+                cfg.base.path(cfg.base.node_key_file)
+            ).node_id
+        all_names = list(self.handles)
+        for name, h in self.handles.items():
+            h.cfg.p2p.persistent_peers = ",".join(
+                f"{self._node_ids[o]}@{o}:26656"
+                for o in all_names
+                if o != name
+            )
+
+    # -- start (reference: test/e2e/runner/start.go) --
+
+    async def _start_node(self, name: str) -> None:
+        h = self.handles[name]
+        if h.spec.state_sync and h.node is None:
+            self._seed_state_sync_trust(h)
+        h.node = make_node(
+            h.cfg,
+            transport=MemoryTransport(self.net, f"{name}:26656"),
+        )
+        self._arm_misbehaviors(h)
+        await h.node.start()
+        h.started = True
+
+    def _seed_state_sync_trust(self, h: _NodeHandle) -> None:
+        """Anchor the late joiner's state-sync trust to a live node's
+        chain (the operator-supplied trust root in production)."""
+        for other in self.handles.values():
+            if other.live and other.node.block_store.height() >= 1:
+                bm = other.node.block_store.load_block_meta(1)
+                if bm is not None:
+                    h.cfg.statesync.trust_height = 1
+                    h.cfg.statesync.trust_hash = (
+                        bm.block_id.hash.hex()
+                    )
+                    return
+
+    def _arm_misbehaviors(self, h: _NodeHandle) -> None:
+        at = h.spec.misbehaviors.get("double-prevote")
+        if at is None or h.priv is None:
+            return
+        node = h.node
+        node.privval = MockPV(h.priv)  # no double-sign protection
+        addr = h.priv.pub_key().address()
+        fired = set()
+
+        def arm() -> None:
+            cs = node.consensus
+            reactor = node.consensus_reactor
+            orig = cs.do_prevote
+
+            async def evil_prevote(height, round_):
+                await orig(height, round_)
+                if height < at or height in fired:
+                    return
+                if cs.rs.proposal_block is None:
+                    return
+                fired.add(height)
+                order = {
+                    v.address: i
+                    for i, v in enumerate(cs.rs.validators.validators)
+                }
+                vote = Vote(
+                    type=PREVOTE_TYPE,
+                    height=height,
+                    round=round_,
+                    block_id=BlockID(
+                        hash=b"\xe1" * 32,
+                        part_set_header=PartSetHeader(
+                            total=1, hash=b"\xe2" * 32
+                        ),
+                    ),
+                    timestamp_ns=time.time_ns(),
+                    validator_address=addr,
+                    validator_index=order[addr],
+                )
+                await node.privval.sign_vote(self.m.chain_id, vote)
+                await reactor.vote_ch.send(
+                    Envelope(
+                        message=VoteMessage(vote=vote), broadcast=True
+                    )
+                )
+
+            cs.do_prevote = evil_prevote
+
+        # consensus objects exist only after start; patch lazily
+        self._post_start = getattr(self, "_post_start", {})
+        self._post_start[h.spec.name] = arm
+
+    # -- load (reference: test/e2e/runner/load.go) --
+
+    async def _load_loop(self) -> None:
+        rate = self.m.load.tx_rate
+        if rate <= 0:
+            return
+        period = 1.0 / rate
+        i = 0
+        while True:
+            await asyncio.sleep(period)
+            live = [h for h in self.handles.values() if h.live]
+            if not live:
+                continue
+            h = live[i % len(live)]
+            i += 1
+            self._tx_seq += 1
+            key = f"load-{self._tx_seq}"
+            val = os.urandom(max(1, self.m.load.tx_size // 2)).hex()
+            tx = f"{key}={val}".encode()[: self.m.load.tx_size]
+            try:
+                await h.node.mempool.check_tx(tx)
+                self.report.txs_submitted += 1
+            except Exception:
+                pass  # full mempool / node stopping: load is best-effort
+
+    # -- perturb (reference: test/e2e/runner/perturb.go) --
+
+    async def _apply_perturbation(self, name: str, action: str) -> None:
+        h = self.handles[name]
+        if action == "kill":
+            if h.live:
+                await h.node.stop()
+        elif action == "restart":
+            if h.live:
+                await h.node.stop()
+            await self._start_node(name)
+            self._run_post_start(name)
+        elif action == "disconnect":
+            if h.live:
+                router = h.node.router
+                for pid in list(router._peer_conns):
+                    router._peer_down(pid)
+        elif action == "pause":
+            if h.live:
+                await h.node.stop()
+
+                async def resume():
+                    await asyncio.sleep(3.0)
+                    if not h.live:
+                        await self._start_node(name)
+                        self._run_post_start(name)
+
+                self._resume_tasks.append(
+                    asyncio.get_running_loop().create_task(resume())
+                )
+
+    def _run_post_start(self, name: str) -> None:
+        hook = getattr(self, "_post_start", {}).get(name)
+        if hook and self.handles[name].live:
+            hook()
+
+    # -- orchestration --
+
+    def _network_height(self) -> int:
+        return max(
+            (
+                h.node.block_store.height()
+                for h in self.handles.values()
+                if h.live
+            ),
+            default=0,
+        )
+
+    async def run(self) -> RunReport:
+        self.setup()
+        for name, h in self.m.sorted_nodes():
+            if self.handles[name].spec.start_at == 0:
+                await self._start_node(name)
+        for name in self.handles:
+            self._run_post_start(name)
+
+        load_task = asyncio.get_running_loop().create_task(
+            self._load_loop()
+        )
+        pending_starts = {
+            name: h.spec.start_at
+            for name, h in self.handles.items()
+            if h.spec.start_at > 0
+        }
+        schedule: List[tuple] = []
+        for name, h in self.handles.items():
+            for p in h.spec.perturb:
+                schedule.append((p.height, name, p.action))
+        schedule.sort()
+
+        deadline = time.monotonic() + self.timeout
+        try:
+            while True:
+                if time.monotonic() > deadline:
+                    self.report.failures.append(
+                        f"timeout before height {self.m.target_height} "
+                        f"(at {self._network_height()})"
+                    )
+                    break
+                await asyncio.sleep(0.25)
+                height = self._network_height()
+                for name, at in list(pending_starts.items()):
+                    if height >= at:
+                        del pending_starts[name]
+                        await self._start_node(name)
+                        self._run_post_start(name)
+                while schedule and schedule[0][0] <= height:
+                    _, name, action = schedule.pop(0)
+                    await self._apply_perturbation(name, action)
+                if (
+                    height >= self.m.target_height
+                    and not pending_starts
+                    and not schedule
+                ):
+                    # every live node must individually converge
+                    laggard = [
+                        h
+                        for h in self.handles.values()
+                        if h.live
+                        and h.node.block_store.height()
+                        < self.m.target_height
+                    ]
+                    if not laggard:
+                        break
+        finally:
+            load_task.cancel()
+            for t in self._resume_tasks:
+                t.cancel()
+            await asyncio.gather(
+                load_task, *self._resume_tasks, return_exceptions=True
+            )
+
+        self._check_invariants()
+        self._benchmark()
+        for h in self.handles.values():
+            if h.live:
+                await h.node.stop()
+        return self.report
+
+    # -- test (reference: test/e2e/tests/) --
+
+    def _live_nodes(self):
+        return [h for h in self.handles.values() if h.live]
+
+    def _check_invariants(self) -> None:
+        rep = self.report
+        live = self._live_nodes()
+        if not live:
+            rep.failures.append("no live nodes at end of run")
+            return
+        rep.reached_height = min(
+            h.node.block_store.height() for h in live
+        )
+        if rep.reached_height < self.m.target_height:
+            rep.failures.append(
+                f"converged height {rep.reached_height} < target "
+                f"{self.m.target_height}"
+            )
+        # identical blocks across nodes over the common prefix
+        ref = live[0]
+        base = max(h.node.block_store.base() for h in live)
+        for height in range(max(base, 1), rep.reached_height + 1):
+            want = ref.node.block_store.load_block_meta(height)
+            for h in live[1:]:
+                got = h.node.block_store.load_block_meta(height)
+                if got is None or want is None:
+                    continue  # pruned / state-synced node
+                if got.block_id.hash != want.block_id.hash:
+                    rep.failures.append(
+                        f"fork at height {height}: "
+                        f"{h.spec.name} disagrees with {ref.spec.name}"
+                    )
+        # committed txs under load
+        if self.m.load.tx_rate > 0:
+            committed = 0
+            for height in range(1, rep.reached_height + 1):
+                block = ref.node.block_store.load_block(height)
+                if block is not None:
+                    committed += len(block.txs)
+            rep.txs_committed = committed
+            if rep.txs_submitted > 0 and committed == 0:
+                rep.failures.append("load ran but no txs were committed")
+        # evidence for every double-signer
+        for name, h in self.handles.items():
+            if "double-prevote" not in h.spec.misbehaviors:
+                continue
+            addr = h.priv.pub_key().address()
+            found = None
+            for height in range(1, rep.reached_height + 1):
+                block = ref.node.block_store.load_block(height)
+                if block is None:
+                    continue
+                for ev in block.evidence:
+                    if (
+                        isinstance(ev, DuplicateVoteEvidence)
+                        and ev.vote_a.validator_address == addr
+                    ):
+                        found = height
+            if found is None:
+                rep.failures.append(
+                    f"no DuplicateVoteEvidence committed for {name}"
+                )
+            else:
+                rep.evidence_heights[name] = found
+
+    # -- benchmark (reference: test/e2e/runner/benchmark.go:14-23) --
+
+    def _benchmark(self) -> None:
+        live = self._live_nodes()
+        if not live:
+            return
+        store = live[0].node.block_store
+        times: List[int] = []
+        for height in range(1, self.report.reached_height + 1):
+            bm = store.load_block_meta(height)
+            if bm is not None:
+                times.append(bm.header.time_ns)
+        if len(times) < 2:
+            return
+        deltas = [
+            (b - a) / 1e9 for a, b in zip(times, times[1:])
+        ]
+        rep = self.report
+        rep.blocks = len(deltas)
+        rep.interval_avg = sum(deltas) / len(deltas)
+        mean = rep.interval_avg
+        rep.interval_stddev = (
+            sum((d - mean) ** 2 for d in deltas) / len(deltas)
+        ) ** 0.5
+        rep.interval_min = min(deltas)
+        rep.interval_max = max(deltas)
+
+
+def run_manifest(
+    manifest: Manifest, home: str, timeout: float = 240.0
+) -> RunReport:
+    """Convenience sync wrapper."""
+    return asyncio.run(Runner(manifest, home, timeout=timeout).run())

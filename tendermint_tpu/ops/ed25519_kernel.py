@@ -104,20 +104,39 @@ def _scalar_mult_check(yA, signA, yR, signR, dS, dk) -> jnp.ndarray:
     dS_steps = jnp.flip(dS, axis=0)  # (64, N)
     dk_steps = jnp.flip(dk, axis=0)
 
-    acc0 = E.identity(yA.shape[-1])
+    # The scan carry is the T-less 3-stack (X, Y, Z): doublings never
+    # read T and the final comparison is projective, so only the ops
+    # feeding an addition materialize T (point ops drop the T output
+    # mul otherwise — 25% of each output multiply).
+    acc0 = E.identity(yA.shape[-1])[..., :3, :, :]
 
     def body(acc, xs):
         ds_w, dk_w = xs
-        acc = lax.fori_loop(0, 4, lambda _i, a: E.point_double(a), acc)
+        acc = lax.fori_loop(
+            0, 3, lambda _i, a: E.point_double(a, with_t=False), acc
+        )
+        acc = E.point_double(acc)  # T feeds the addition below
         acc = E.point_add_cached(acc, _onehot_select(TA, dk_w))
-        acc = E.point_add_cached(acc, _onehot_select(tb0, ds_w))
+        acc = E.point_add_cached(
+            acc, _onehot_select(tb0, ds_w), with_t=False
+        )
         return acc, None
 
     acc, _ = lax.scan(body, acc0, (dS_steps, dk_steps))
-    acc = E.point_add_cached(acc, E.cache_point(E.negate(R)))
-    for _ in range(3):  # cofactor 8
-        acc = E.point_double(acc)
-    return E.is_identity(acc) & okA & okR
+    # ZIP-215 cofactored equation, rearranged so nothing needs T:
+    # [8]([S]B - [k]A) == [8]R  <=>  [8]([S]B - [k]A - R) == identity.
+    for _ in range(3):  # cofactor 8, both sides
+        acc = E.point_double(acc, with_t=False)
+        R = E.point_double(R, with_t=False)
+    # projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1
+    lhs = jnp.stack([acc[..., 0, :, :], acc[..., 1, :, :]], axis=-3)
+    rhs = jnp.stack([R[..., 0, :, :], R[..., 1, :, :]], axis=-3)
+    z_acc = jnp.broadcast_to(acc[..., 2:3, :, :], lhs.shape)
+    z_r = jnp.broadcast_to(R[..., 2:3, :, :], rhs.shape)
+    cross_l = F.mul(lhs, z_r)
+    cross_r = F.mul(rhs, z_acc)
+    same = jnp.all(F.eq(cross_l, cross_r), axis=-2)
+    return same & okA & okR
 
 
 # -- device-side scalar prep --
@@ -238,11 +257,15 @@ def _nibbles_dev(b: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([lo, hi], axis=1).reshape(64, b.shape[1])
 
 
-def _verify_program(pk_b, sig_b, dig_b) -> jnp.ndarray:
+def _verify_tile(pk_b, sig_b, dig_b) -> jnp.ndarray:
     """The full device program: byte rows in, validity bitmap out.
 
     pk_b (32, N), sig_b (64, N) uint8/int32 byte rows; dig_b (64, N)
-    SHA-512(R||A||M) byte rows. Returns (N,) bool."""
+    SHA-512(R||A||M) byte rows. Returns (N,) bool.
+
+    Pure jnp on values — the same body runs as a jitted XLA program
+    (CPU and fallback) and as the per-tile body of the fused Pallas
+    kernel (ops/ed25519_pallas.py)."""
     pk = pk_b.astype(jnp.int32)
     sig = sig_b.astype(jnp.int32)
     dig = dig_b.astype(jnp.int32)
@@ -290,13 +313,36 @@ class Ed25519Verifier:
     def _bucket(self, n: int) -> int:
         for b in self.bucket_sizes:
             if n <= b:
-                return b
-        return n  # oversized: compile exact (rare)
+                break
+        else:
+            b = n  # oversized (rare)
+        if self._pallas_wanted():
+            # The fused Pallas kernel tiles the batch in full 128-lane
+            # blocks. Rounding small buckets up costs nothing: the VPU
+            # lane tile is 128 wide, so an 8-lane XLA program wastes
+            # 94% of every vector register anyway.
+            from .ed25519_pallas import TILE
+
+            b = max(TILE, -(-b // TILE) * TILE)
+        return b
+
+    @staticmethod
+    def _pallas_wanted() -> bool:
+        import os
+
+        if os.environ.get("TM_TPU_NO_PALLAS"):
+            return False
+        return jax.default_backend() == "tpu"
 
     def _program(self, size: int):
         fn = self._compiled.get(size)
         if fn is None:
-            fn = jax.jit(_verify_program)
+            if self._pallas_wanted():
+                from .ed25519_pallas import verify_pallas
+
+                fn = verify_pallas
+            else:
+                fn = jax.jit(_verify_tile)
             self._compiled[size] = fn
         return fn
 
@@ -357,9 +403,31 @@ class Ed25519Verifier:
             64,
             pad,
         )
-        ok = self._program(bucket)(
-            jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
-        )
+        prog = self._program(bucket)
+        try:
+            ok = prog(
+                jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
+            )
+        except Exception as e:
+            from .ed25519_pallas import verify_pallas
+
+            if prog is not verify_pallas:
+                raise  # a non-Pallas program failing is a real error
+            # Mosaic lowering failure: permanently fall back to the XLA
+            # program for this bucket (same math, same semantics).
+            import logging
+
+            logging.getLogger("tendermint_tpu.ops").warning(
+                "pallas ed25519 kernel failed for bucket %d; "
+                "falling back to the XLA program: %s",
+                bucket,
+                e,
+            )
+            fn = jax.jit(_verify_tile)
+            self._compiled[bucket] = fn
+            ok = fn(
+                jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
+            )
         return (ok, n, size_ok)
 
     def gather(self, handle) -> np.ndarray:

@@ -100,8 +100,14 @@ def negate_cached(qc: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([ypx, ymx, F.neg(t2d), z2], axis=-3)
 
 
-def point_add_cached(p: jnp.ndarray, qc: jnp.ndarray) -> jnp.ndarray:
-    """p (extended) + q (cached) -> extended."""
+def point_add_cached(
+    p: jnp.ndarray, qc: jnp.ndarray, with_t: bool = True
+) -> jnp.ndarray:
+    """p (extended) + q (cached) -> extended.
+
+    `with_t=False` drops the T3 output mul (the caller's next op is a
+    doubling or a projective compare, neither of which reads T) — the
+    output stacks (X3, Y3, Z3) only."""
     X = p[..., 0, :, :]
     Y = p[..., 1, :, :]
     Z = p[..., 2, :, :]
@@ -122,12 +128,21 @@ def point_add_cached(p: jnp.ndarray, qc: jnp.ndarray) -> jnp.ndarray:
     Fv = mids[..., 1, :, :]
     G = mids[..., 2, :, :]
     H = mids[..., 3, :, :]
-    out_l = jnp.stack([E, G, Fv, E], axis=-3)
-    out_r = jnp.stack([Fv, H, G, H], axis=-3)
-    return F.mul(out_l, out_r)  # X3, Y3, Z3, T3
+    if with_t:
+        out_l = jnp.stack([E, G, Fv, E], axis=-3)
+        out_r = jnp.stack([Fv, H, G, H], axis=-3)
+    else:
+        out_l = jnp.stack([E, G, Fv], axis=-3)
+        out_r = jnp.stack([Fv, H, G], axis=-3)
+    return F.mul(out_l, out_r)  # X3, Y3, Z3(, T3)
 
 
-def point_double(p: jnp.ndarray) -> jnp.ndarray:
+def point_double(p: jnp.ndarray, with_t: bool = True) -> jnp.ndarray:
+    """Double an extended point. Reads only (X, Y, Z), so a 3-stacked
+    T-less input from a previous `with_t=False` op is accepted;
+    `with_t=False` likewise drops the T3 output mul (25% of the
+    doubling's second stacked multiply) when the next op is another
+    doubling or a projective compare."""
     X = p[..., 0, :, :]
     Y = p[..., 1, :, :]
     Z = p[..., 2, :, :]
@@ -154,8 +169,12 @@ def point_double(p: jnp.ndarray) -> jnp.ndarray:
     Fv = mids[..., 1, :, :]
     G = mids[..., 2, :, :]
     H = mids[..., 3, :, :]
-    out_l = jnp.stack([E, G, Fv, E], axis=-3)
-    out_r = jnp.stack([Fv, H, G, H], axis=-3)
+    if with_t:
+        out_l = jnp.stack([E, G, Fv, E], axis=-3)
+        out_r = jnp.stack([Fv, H, G, H], axis=-3)
+    else:
+        out_l = jnp.stack([E, G, Fv], axis=-3)
+        out_r = jnp.stack([Fv, H, G], axis=-3)
     return F.mul(out_l, out_r)
 
 

@@ -73,7 +73,7 @@ class ShardedEd25519Verifier(K.Ed25519Verifier):
             vec = NamedSharding(self.mesh, P(SIG_AXIS))
             mat = NamedSharding(self.mesh, P(None, SIG_AXIS))
             fn = jax.jit(
-                K._verify_program,
+                K._verify_tile,
                 in_shardings=(mat, mat, mat),
                 out_shardings=vec,
             )

@@ -1,0 +1,56 @@
+"""Differential test: fused Pallas ed25519 kernel vs the XLA program.
+
+Runs in Pallas interpret mode on CPU (Mosaic lowering is exercised on
+real hardware by bench.py); the XLA `_verify_tile` program — itself
+differential-tested against the pure-Python ZIP-215 oracle in
+test_ops_ed25519.py — is the reference here. A small tile keeps the
+interpreter affordable in CI.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519  # noqa: E402
+from tendermint_tpu.ops import ed25519_kernel as K  # noqa: E402
+from tendermint_tpu.ops.ed25519_pallas import verify_pallas  # noqa: E402
+
+TILE = 8
+
+
+def _batch(n, corrupt=()):
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = PrivKeyEd25519.from_seed(bytes([i]) * 32)
+        msg = b"pallas-%d" % i
+        sig = priv.sign(msg)
+        if i in corrupt:
+            sig = sig[:4] + bytes([sig[4] ^ 1]) + sig[5:]
+        pks.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(sig)
+    digs = [
+        hashlib.sha512(s[:32] + p + m).digest()
+        for p, m, s in zip(pks, msgs, sigs)
+    ]
+    return (
+        jnp.asarray(K._join_cols(pks, 32, 0)),
+        jnp.asarray(K._join_cols(sigs, 64, 0)),
+        jnp.asarray(K._join_cols(digs, 64, 0)),
+    )
+
+
+def test_pallas_matches_xla_program():
+    pk, sig, dig = _batch(2 * TILE, corrupt={3, 11})
+    ref = np.asarray(K._verify_tile(pk, sig, dig))
+    got = np.asarray(
+        verify_pallas(pk, sig, dig, interpret=True, tile=TILE)
+    )
+    assert ref.dtype == got.dtype == np.bool_
+    assert (ref == got).all()
+    assert not got[3] and not got[11]
+    assert got.sum() == 2 * TILE - 2
