@@ -8,7 +8,17 @@ by an ed25519 signature over the derived challenge (:391-453).
 Wire format (framework-local; not byte-compatible with the Go impl):
   handshake: 32-byte ephemeral X25519 pubkey each way (plaintext)
   then AEAD frames: 4-byte BE ciphertext length | ciphertext
-  first frame each way: AuthSig{pubkey=1, sig=2} proto
+  first frame each way: AuthSig{type=1, pubkey=2, sig=3} proto
+
+Transcript binding: the identity signature covers the HKDF challenge,
+which hashes the ECDH secret together with BOTH ephemeral keys — an
+attacker interposing its own ephemerals cannot replay either proof.
+Everything after the AuthSig frames (the transport's NodeInfo exchange,
+transport.py:191-196, and all router traffic) rides the AEAD channel
+keyed by that same transcript, so peer metadata is bound to the
+handshake rather than trusted plaintext. The byte layout is pinned by
+known-answer vectors in tests/test_conn_vectors.py; key derivation is
+cross-checked there against an independent HMAC-based HKDF.
 """
 
 from __future__ import annotations
