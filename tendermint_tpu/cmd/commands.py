@@ -544,6 +544,16 @@ def cmd_debug_dump(args) -> int:
                 sdb.close()
         except Exception as e:
             summary["store_error"] = repr(e)
+        # XLA profiler trace of a representative device batch
+        # (SURVEY §5: the debug bundle carries device traces the way
+        # the reference's carries pprof profiles)
+        if getattr(args, "device_profile", False):
+            try:
+                summary["device_profile"] = _capture_device_profile(tar)
+            except Exception as e:
+                add_bytes(
+                    tar, "device_profile_error.txt", repr(e).encode()
+                )
         add_bytes(
             tar, "summary.json", json.dumps(summary, indent=2).encode()
         )
@@ -560,6 +570,49 @@ def cmd_debug_dump(args) -> int:
                 )
     print(f"wrote debug bundle to {out_path}")
     return 0
+
+
+def _capture_device_profile(tar, n: int = 256) -> dict:
+    """Run one warmed batch through the device verifier under the XLA
+    profiler and pack the trace into the bundle (TensorBoard-loadable)."""
+    import tempfile
+
+    import jax
+
+    from ..crypto.ed25519 import PrivKeyEd25519
+    from ..ops.ed25519_kernel import Ed25519Verifier
+
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        priv = PrivKeyEd25519.from_seed(i.to_bytes(4, "big") + b"\x51" * 28)
+        msg = b"debug-profile-%d" % i
+        pks.append(priv.pub_key().bytes())
+        msgs.append(msg)
+        sigs.append(priv.sign(msg))
+    verifier = Ed25519Verifier()
+    t0 = time.perf_counter()
+    ok = verifier.verify(pks, msgs, sigs)  # warm-up compiles
+    compile_s = time.perf_counter() - t0
+    if not bool(ok.all()):
+        raise RuntimeError("profile batch failed to verify")
+    with tempfile.TemporaryDirectory(prefix="tt-device-profile-") as prof_dir:
+        with jax.profiler.trace(prof_dir):
+            t0 = time.perf_counter()
+            verifier.verify(pks, msgs, sigs)
+            run_s = time.perf_counter() - t0
+        for root, _dirs, files in os.walk(prof_dir):
+            for f in files:
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, prof_dir)
+                tar.add(
+                    full, arcname=os.path.join("device_profile", rel)
+                )
+    return {
+        "backend": jax.default_backend(),
+        "batch": n,
+        "warmup_s": round(compile_s, 3),
+        "profiled_run_s": round(run_s, 4),
+    }
 
 
 def cmd_version(args) -> int:
@@ -660,6 +713,12 @@ def build_parser() -> argparse.ArgumentParser:
         "debug", help="collect a diagnostic bundle into a tarball"
     )
     sp.add_argument("--output", "-o", default="./debug_bundle.tar.gz")
+    sp.add_argument(
+        "--device-profile",
+        action="store_true",
+        dest="device_profile",
+        help="include an XLA profiler trace of a device verify batch",
+    )
     sp.add_argument(
         "--metrics-url",
         default="",

@@ -193,6 +193,31 @@ def test_debug_bundle(tmp_path, capsys):
         assert summary["state"]["chain_id"] == "dbg-chain"
 
 
+def test_debug_bundle_device_profile(tmp_path):
+    """`debug --device-profile` packs an XLA profiler trace of a
+    verify batch into the bundle (SURVEY §5 device-trace analog of the
+    reference's pprof collection)."""
+    import tarfile
+
+    home = str(tmp_path / "dbgp")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "dbgp-chain") == 0
+    out = str(tmp_path / "bundle_prof.tar.gz")
+    assert run_cli(
+        "--home", home, "debug", "-o", out, "--device-profile"
+    ) == 0
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+        assert "summary.json" in names
+        summary = json.loads(tar.extractfile("summary.json").read())
+        assert "device_profile_error.txt" not in names, names
+        prof = summary["device_profile"]
+        assert prof["batch"] == 256 and prof["profiled_run_s"] > 0
+        assert any(n.startswith("device_profile/") for n in names), (
+            names
+        )
+
+
 def test_light_proxy_serves_verified_headers(tmp_path):
     """Boot a full node in-process, run the light proxy logic against
     its RPC, and fetch a verified header through the proxy surface

@@ -103,7 +103,10 @@ def test_full_handshake_framed_traffic_and_mutual_auth():
                 result["server_peer"] = sc.remote_pubkey.bytes()
                 msg = await sc.read_frame()
                 await sc.write_frame(b"ack:" + msg)
-            except Exception as e:  # pragma: no cover
+                # second read receives the tampered frame below
+                await sc.read_frame()
+                result["server_err"] = None  # tamper NOT detected
+            except Exception as e:
                 result["server_err"] = repr(e)
             finally:
                 writer.close()
@@ -116,6 +119,19 @@ def test_full_handshake_framed_traffic_and_mutual_auth():
         await sc.write_frame(b"node-info-bytes")
         assert await sc.read_frame() == b"ack:node-info-bytes"
         assert result.get("server_peer") == a_priv.pub_key().bytes()
+
+        # tamper: flip one ciphertext bit on the wire — AEAD must reject
+        ct = sc._send.encrypt(
+            sc._nonce(sc._send_nonce), b"tampered-payload", None
+        )
+        sc._send_nonce += 1
+        bad = bytes([ct[0] ^ 1]) + ct[1:]
+        writer.write(struct.pack(">I", len(bad)) + bad)
+        await writer.drain()
+        await asyncio.sleep(0.2)
+        assert result.get("server_err") is not None, (
+            "server accepted tampered frame"
+        )
         sc.close()
         srv.close()
         await srv.wait_closed()

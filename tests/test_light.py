@@ -210,6 +210,146 @@ class TestVerifier:
                 CHAIN, blocks[1].signed_header, blocks[3].signed_header
             )
 
+    # boundary cells modeled on the reference's model-based verifier
+    # traces (light/mbt/driver_test.go): header-field checks must fire
+    # before any signature work
+
+    @staticmethod
+    def _resign(header, seeds=(1, 2, 3, 4)):
+        """A properly signed SignedHeader for a (mutated) header, so
+        header-field checks are reached instead of hash linkage."""
+        import dataclasses
+
+        vals, privs = make_set(list(seeds))
+        header = dataclasses.replace(header, validators_hash=vals.hash())
+        bid = BlockID(
+            hash=header.hash(),
+            part_set_header=PartSetHeader(total=1, hash=b"\x22" * 32),
+        )
+        sigs = []
+        for i, v in enumerate(vals.validators):
+            vote = Vote(
+                type=PRECOMMIT_TYPE,
+                height=header.height,
+                round=0,
+                block_id=bid,
+                timestamp_ns=header.time_ns,
+                validator_address=v.address,
+                validator_index=i,
+            )
+            sigs.append(
+                CommitSig.for_block(
+                    privs[i].sign(vote.sign_bytes(CHAIN)),
+                    v.address,
+                    vote.timestamp_ns,
+                )
+            )
+        commit = Commit(
+            height=header.height, round=0, block_id=bid, signatures=sigs
+        )
+        return SignedHeader(header=header, commit=commit), vals
+
+    def test_rejects_non_monotonic_header_time(self):
+        import dataclasses
+
+        from tendermint_tpu.light.errors import InvalidHeaderError
+
+        blocks = build_chain(3)
+        bad_header = dataclasses.replace(
+            blocks[2].signed_header.header,
+            time_ns=blocks[1].signed_header.header.time_ns,
+        )
+        bad, bad_vals = self._resign(bad_header)
+        with pytest.raises(InvalidHeaderError, match="time"):
+            verify_adjacent(
+                CHAIN,
+                blocks[1].signed_header,
+                bad,
+                bad_vals,
+                200 * HOUR_NS,
+                time.time_ns(),
+            )
+
+    def test_rejects_header_time_from_future(self):
+        import dataclasses
+
+        from tendermint_tpu.light.errors import InvalidHeaderError
+
+        blocks = build_chain(3)
+        bad_header = dataclasses.replace(
+            blocks[2].signed_header.header,
+            time_ns=time.time_ns() + HOUR_NS,
+        )
+        bad, bad_vals = self._resign(bad_header)
+        with pytest.raises(InvalidHeaderError, match="future"):
+            verify_adjacent(
+                CHAIN,
+                blocks[1].signed_header,
+                bad,
+                bad_vals,
+                200 * HOUR_NS,
+                time.time_ns(),
+            )
+
+    def test_rejects_validator_set_hash_mismatch(self):
+        from tendermint_tpu.light.errors import InvalidHeaderError
+
+        blocks = build_chain(3)
+        wrong_vals, _ = make_set([21, 22, 23, 24])
+        with pytest.raises(InvalidHeaderError, match="validators_hash"):
+            verify_adjacent(
+                CHAIN,
+                blocks[1].signed_header,
+                blocks[2].signed_header,
+                wrong_vals,
+                200 * HOUR_NS,
+                time.time_ns(),
+            )
+
+    def test_trust_level_bounds(self):
+        from tendermint_tpu.types.validation import Fraction
+
+        blocks = build_chain(5)
+        now = time.time_ns()
+        for bad in (Fraction(1, 4), Fraction(4, 3), Fraction(1, 0)):
+            with pytest.raises(ValueError, match="trust level"):
+                verify_non_adjacent(
+                    CHAIN,
+                    blocks[1].signed_header,
+                    blocks[1].validator_set,
+                    blocks[5].signed_header,
+                    blocks[5].validator_set,
+                    200 * HOUR_NS,
+                    now,
+                    trust_level=bad,
+                )
+        # exactly 1/3 is the allowed lower bound
+        verify_non_adjacent(
+            CHAIN,
+            blocks[1].signed_header,
+            blocks[1].validator_set,
+            blocks[5].signed_header,
+            blocks[5].validator_set,
+            200 * HOUR_NS,
+            now,
+            trust_level=Fraction(1, 3),
+        )
+
+    def test_expired_trusted_header_rejected(self):
+        from tendermint_tpu.light.errors import OldHeaderExpiredError
+
+        base = time.time_ns() - 100 * HOUR_NS
+        blocks = build_chain(3, base_time_ns=base)
+        with pytest.raises(OldHeaderExpiredError):
+            verify_adjacent(
+                CHAIN,
+                blocks[1].signed_header,
+                blocks[2].signed_header,
+                blocks[2].validator_set,
+                HOUR_NS,  # trusting period long expired
+                time.time_ns(),
+            )
+
 
 # ---------------------------------------------------------------------------
 # store
