@@ -620,6 +620,146 @@ def cmd_version(args) -> int:
     return 0
 
 
+def _parse_tx(s: str) -> bytes:
+    """0x-prefixed hex, else the raw string bytes (reference:
+    abci/cmd/abci-cli stringOrHexToBytes)."""
+    if s.startswith("0x") or s.startswith("0X"):
+        return bytes.fromhex(s[2:])
+    return s.encode()
+
+
+async def _abci_exec(client, cmd: str, operand: str, path: str) -> None:
+    """One abci-cli style request/response (reference: abci/cmd/
+    abci-cli — echo/info/deliver_tx/check_tx/commit/query)."""
+    from ..abci import types as T
+
+    def show(code=None, data=None, log="", info=""):
+        if code is not None:
+            status = "OK" if code == 0 else f"{code}"
+            print(f"-> code: {status}")
+        if log:
+            print(f"-> log: {log}")
+        if info:
+            print(f"-> info: {info}")
+        if data:
+            try:
+                print(f"-> data: {data.decode()}")
+            except UnicodeDecodeError:
+                pass
+            print(f"-> data.hex: 0x{data.hex().upper()}")
+
+    if cmd == "echo":
+        resp = await client.echo(operand)
+        print(f"-> data: {resp.message}")
+    elif cmd == "info":
+        resp = await client.info(T.RequestInfo())
+        print(f"-> data: {resp.data}")
+        print(f"-> version: {resp.version}")
+        print(f"-> last_block_height: {resp.last_block_height}")
+        print(f"-> last_block_app_hash: 0x{resp.last_block_app_hash.hex()}")
+    elif cmd == "deliver-tx":
+        resp = await client.deliver_tx(
+            T.RequestDeliverTx(tx=_parse_tx(operand))
+        )
+        show(resp.code, resp.data, resp.log, resp.info)
+    elif cmd == "check-tx":
+        resp = await client.check_tx(
+            T.RequestCheckTx(tx=_parse_tx(operand))
+        )
+        show(resp.code, resp.data, resp.log, resp.info)
+    elif cmd == "commit":
+        resp = await client.commit()
+        show(0, resp.data)
+    elif cmd == "query":
+        resp = await client.query(
+            T.RequestQuery(data=_parse_tx(operand), path=path)
+        )
+        show(resp.code, None, resp.log, resp.info)
+        print(f"-> key: {resp.key.decode(errors='replace')}")
+        print(f"-> value: {resp.value.decode(errors='replace')}")
+    else:
+        raise ValueError(f"unknown abci command {cmd!r}")
+
+
+def cmd_abci(args) -> int:
+    """Drive an out-of-process ABCI application over its socket, or
+    serve the builtin kvstore app (reference: abci/cmd/ — the abci-cli
+    tool with its console and example-app server)."""
+    from ..abci.client import SocketClient
+    from ..abci.kvstore import KVStoreApplication
+    from ..abci.server import SocketServer
+
+    async def serve_kvstore():
+        srv = SocketServer(args.addr, KVStoreApplication())
+        await srv.start()
+        print(f"kvstore app listening on {args.addr}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            pass
+        finally:
+            await srv.stop()
+        return 0
+
+    async def drive():
+        client = SocketClient(args.addr, must_connect=True)
+        await client.start()
+        try:
+            if args.abci_cmd == "console":
+                print(
+                    "abci console: echo|info|deliver-tx|check-tx|"
+                    "commit|query <operand>  (ctrl-d to exit)",
+                    flush=True,
+                )
+                # stdin is read on a daemon thread: a thread parked in
+                # readline would otherwise block asyncio.run's executor
+                # shutdown on ctrl-c until the user pressed Enter
+                import threading
+
+                lines: asyncio.Queue = asyncio.Queue()
+                loop = asyncio.get_running_loop()
+
+                def _reader() -> None:
+                    for raw in sys.stdin:
+                        loop.call_soon_threadsafe(
+                            lines.put_nowait, raw
+                        )
+                    loop.call_soon_threadsafe(lines.put_nowait, None)
+
+                threading.Thread(target=_reader, daemon=True).start()
+                while True:
+                    line = await lines.get()
+                    if line is None:
+                        break
+                    parts = line.strip().split(None, 1)
+                    if not parts:
+                        continue
+                    try:
+                        await _abci_exec(
+                            client,
+                            parts[0],
+                            parts[1] if len(parts) > 1 else "",
+                            args.path,
+                        )
+                    except Exception as e:
+                        print(f"-> error: {e}", flush=True)
+            else:
+                try:
+                    await _abci_exec(
+                        client, args.abci_cmd, args.operand, args.path
+                    )
+                except ValueError as e:
+                    print(f"-> error: {e}", file=sys.stderr)
+                    return 1
+            return 0
+        finally:
+            await client.stop()
+
+    if args.abci_cmd == "kvstore":
+        return asyncio.run(serve_kvstore())
+    return asyncio.run(drive())
+
+
 # -- parser -----------------------------------------------------------------
 
 
@@ -680,6 +820,28 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser(
+        "abci",
+        help="abci-cli: drive an ABCI app socket or serve the kvstore",
+    )
+    sp.add_argument(
+        "abci_cmd",
+        choices=[
+            "kvstore",
+            "console",
+            "echo",
+            "info",
+            "deliver-tx",
+            "check-tx",
+            "commit",
+            "query",
+        ],
+    )
+    sp.add_argument("operand", nargs="?", default="")
+    sp.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    sp.add_argument("--path", default="/store", help="query path")
+    sp.set_defaults(fn=cmd_abci)
 
     sp = sub.add_parser(
         "light", help="run a verifying light-client RPC proxy"

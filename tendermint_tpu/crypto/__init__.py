@@ -21,4 +21,9 @@ from .ed25519 import (  # noqa: F401
     PubKeyEd25519,
 )
 from .secp256k1 import PrivKeySecp256k1, PubKeySecp256k1  # noqa: F401
+from .symmetric import (  # noqa: F401
+    XChaCha20Poly1305,
+    decrypt_symmetric,
+    encrypt_symmetric,
+)
 from . import batch, merkle, tmhash  # noqa: F401

@@ -1,0 +1,143 @@
+"""Symmetric encryption utilities: XChaCha20-Poly1305 and secretbox.
+
+Reference model: crypto/xchacha20poly1305/xchachapoly.go (24-byte-nonce
+AEAD via HChaCha20 subkey derivation) and crypto/xsalsa20symmetric/
+symmetric.go (secretbox-style `EncryptSymmetric` with a random nonce,
+used by key-file armor tooling). Framework-local deviation: the
+secretbox helpers here are built on XChaCha20-Poly1305 instead of
+XSalsa20-Poly1305 — same construction shape (random 24-byte nonce
+prepended to the sealed box), one cipher family for the whole stack.
+
+The HChaCha20 core is pure Python; its ChaCha permutation is
+differential-tested against the `cryptography` package's ChaCha20
+keystream (tests/test_symmetric.py), so the only hand-rolled math has
+an independent oracle.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+__all__ = [
+    "KEY_SIZE",
+    "NONCE_SIZE",
+    "XChaCha20Poly1305",
+    "encrypt_symmetric",
+    "decrypt_symmetric",
+    "hchacha20",
+]
+
+KEY_SIZE = 32
+NONCE_SIZE = 24  # XChaCha20 nonce
+
+
+def _rotl32(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+
+def _quarter(st, a, b, c, d) -> None:
+    st[a] = (st[a] + st[b]) & 0xFFFFFFFF
+    st[d] = _rotl32(st[d] ^ st[a], 16)
+    st[c] = (st[c] + st[d]) & 0xFFFFFFFF
+    st[b] = _rotl32(st[b] ^ st[c], 12)
+    st[a] = (st[a] + st[b]) & 0xFFFFFFFF
+    st[d] = _rotl32(st[d] ^ st[a], 8)
+    st[c] = (st[c] + st[d]) & 0xFFFFFFFF
+    st[b] = _rotl32(st[b] ^ st[c], 7)
+
+
+_SIGMA = struct.unpack("<4I", b"expand 32-byte k")
+
+
+def _chacha_rounds(state: list) -> list:
+    st = list(state)
+    for _ in range(10):  # 20 rounds: 10 column+diagonal double-rounds
+        _quarter(st, 0, 4, 8, 12)
+        _quarter(st, 1, 5, 9, 13)
+        _quarter(st, 2, 6, 10, 14)
+        _quarter(st, 3, 7, 11, 15)
+        _quarter(st, 0, 5, 10, 15)
+        _quarter(st, 1, 6, 11, 12)
+        _quarter(st, 2, 7, 8, 13)
+        _quarter(st, 3, 4, 9, 14)
+    return st
+
+
+def chacha20_block(key: bytes, counter: int, nonce12: bytes) -> bytes:
+    """One RFC 8439 ChaCha20 block (used only by the differential test
+    as the bridge between the permutation and the library keystream)."""
+    state = list(_SIGMA)
+    state += list(struct.unpack("<8I", key))
+    state.append(counter & 0xFFFFFFFF)
+    state += list(struct.unpack("<3I", nonce12))
+    working = _chacha_rounds(state)
+    out = [(w + s) & 0xFFFFFFFF for w, s in zip(working, state)]
+    return struct.pack("<16I", *out)
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    """HChaCha20 subkey derivation (draft-irtf-cfrg-xchacha §2.2):
+    the ChaCha permutation without the final feed-forward addition;
+    the subkey is words 0-3 and 12-15."""
+    if len(key) != KEY_SIZE:
+        raise ValueError("hchacha20 key must be 32 bytes")
+    if len(nonce16) != 16:
+        raise ValueError("hchacha20 input must be 16 bytes")
+    state = list(_SIGMA)
+    state += list(struct.unpack("<8I", key))
+    state += list(struct.unpack("<4I", nonce16))
+    st = _chacha_rounds(state)
+    return struct.pack("<4I", *st[0:4]) + struct.pack("<4I", *st[12:16])
+
+
+class XChaCha20Poly1305:
+    """AEAD with a 24-byte nonce (reference:
+    crypto/xchacha20poly1305/xchachapoly.go): derive a subkey with
+    HChaCha20 over the first 16 nonce bytes, then run standard
+    ChaCha20-Poly1305 with nonce 0x00000000 || nonce[16:24]."""
+
+    def __init__(self, key: bytes) -> None:
+        if len(key) != KEY_SIZE:
+            raise ValueError("key must be 32 bytes")
+        self._key = key
+
+    def _inner(self, nonce: bytes) -> tuple:
+        if len(nonce) != NONCE_SIZE:
+            raise ValueError("nonce must be 24 bytes")
+        subkey = hchacha20(self._key, nonce[:16])
+        return ChaCha20Poly1305(subkey), b"\x00\x00\x00\x00" + nonce[16:]
+
+    def encrypt(
+        self, nonce: bytes, plaintext: bytes, aad: bytes | None = None
+    ) -> bytes:
+        aead, n12 = self._inner(nonce)
+        return aead.encrypt(n12, plaintext, aad)
+
+    def decrypt(
+        self, nonce: bytes, ciphertext: bytes, aad: bytes | None = None
+    ) -> bytes:
+        aead, n12 = self._inner(nonce)
+        return aead.decrypt(n12, ciphertext, aad)
+
+
+def encrypt_symmetric(plaintext: bytes, secret: bytes) -> bytes:
+    """Seal with a fresh random 24-byte nonce; output nonce || box
+    (reference shape: crypto/xsalsa20symmetric/symmetric.go:19-27)."""
+    if len(secret) != KEY_SIZE:
+        raise ValueError("secret must be 32 bytes")
+    nonce = os.urandom(NONCE_SIZE)
+    return nonce + XChaCha20Poly1305(secret).encrypt(nonce, plaintext)
+
+
+def decrypt_symmetric(ciphertext: bytes, secret: bytes) -> bytes:
+    """Inverse of encrypt_symmetric; raises on tampering or wrong key
+    (reference: symmetric.go:30-46)."""
+    if len(secret) != KEY_SIZE:
+        raise ValueError("secret must be 32 bytes")
+    if len(ciphertext) < NONCE_SIZE + 16:
+        raise ValueError("ciphertext too short")
+    nonce, box = ciphertext[:NONCE_SIZE], ciphertext[NONCE_SIZE:]
+    return XChaCha20Poly1305(secret).decrypt(nonce, box)

@@ -280,3 +280,50 @@ def test_light_proxy_serves_verified_headers(tmp_path):
             await node.stop()
 
     asyncio.run(go())
+
+
+def test_abci_cli_against_kvstore_socket(tmp_path, capsys):
+    """abci-cli parity: serve the kvstore over a socket (one process),
+    drive echo/deliver-tx/commit/query through the `abci` subcommands
+    (reference: abci/cmd/ abci-cli + example kvstore server)."""
+    import socket
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    addr = f"tcp://127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    srv = subprocess.Popen(
+        [_sys.executable, "-m", "tendermint_tpu.cmd", "abci",
+         "kvstore", "--addr", addr],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        env=env,
+        text=True,
+    )
+    try:
+        line = srv.stdout.readline()
+        assert "listening" in line, line
+        assert run_cli("abci", "echo", "ping", "--addr", addr) == 0
+        assert "-> data: ping" in capsys.readouterr().out
+        assert run_cli(
+            "abci", "deliver-tx", "name=satoshi", "--addr", addr
+        ) == 0
+        assert "-> code: OK" in capsys.readouterr().out
+        assert run_cli("abci", "commit", "--addr", addr) == 0
+        capsys.readouterr()
+        assert run_cli("abci", "query", "name", "--addr", addr) == 0
+        out = capsys.readouterr().out
+        assert "-> value: satoshi" in out
+        assert run_cli("abci", "info", "--addr", addr) == 0
+        assert "last_block_height" in capsys.readouterr().out
+    finally:
+        srv.terminate()
+        try:
+            srv.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            srv.kill()
