@@ -58,11 +58,10 @@ def _make_batch(n: int, seed: int = 11):
     return pks, msgs, sigs
 
 
-def bench_throughput():
+def bench_throughput(n: int = 8192):
     """Primary: pipelined batch-verify throughput at batch 8192."""
     from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
 
-    n = 8192
     pks, msgs, sigs = _make_batch(n)
     verifier = Ed25519Verifier(bucket_sizes=[n])
     ok = verifier.verify(pks, msgs, sigs)
@@ -293,15 +292,64 @@ def bench_device_rtt():
     return times[len(times) // 2] * 1e3
 
 
+def _device_watchdog(timeout_s: float = 300.0) -> str:
+    """Probe device availability on a side thread. A SIGKILLed former
+    client can leave the tunneled TPU claimed for hours; if the device
+    doesn't answer in time, re-exec this process on the CPU backend so
+    the bench always emits its JSON line instead of hanging the driver.
+    (Re-exec, not in-process switch: the hung probe thread holds jax's
+    backend-init lock, so flipping jax_platforms here would deadlock.)"""
+    import os
+    import sys
+    import threading
+
+    if os.environ.get("TM_BENCH_CPU_FALLBACK"):
+        return "cpu-fallback (device unreachable)"
+    result = {}
+
+    def probe():
+        import jax
+
+        result["devices"] = [str(d) for d in jax.devices()]
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" in result:
+        return "device"
+    env = dict(os.environ)
+    env["TM_BENCH_CPU_FALLBACK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and os.path.basename(p) != ".axon_site"
+    )
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    raise AssertionError("unreachable")
+
+
 def main() -> None:
+    backend = _device_watchdog()
+    fallback = backend != "device"
     pks, msgs, sigs = _make_batch(512, seed=7)
     cpu_rate = bench_cpu_baseline(pks, msgs, sigs)
-    device_rate = bench_throughput()
+    # on the CPU fallback the big buckets take tens of minutes to
+    # compile+run; shrink every config so the driver still gets its
+    # JSON line (clearly marked) instead of a timeout
+    device_rate = bench_throughput(n=512 if fallback else 8192)
     rtt_ms = bench_device_rtt()
-    p50_150, p95_150 = bench_commit_latency(150, reps=20, light=True)
-    p50_10k, p95_10k = bench_commit_latency(10_000, reps=10, light=False)
+    p50_150, p95_150 = bench_commit_latency(
+        150, reps=5 if fallback else 20, light=True
+    )
+    if fallback:
+        p50_10k = p95_10k = None
+    else:
+        p50_10k, p95_10k = bench_commit_latency(
+            10_000, reps=10, light=False
+        )
     try:
-        light_rate = bench_light_sync()
+        light_rate = bench_light_sync(n_headers=10 if fallback else 50)
     except Exception as e:  # pragma: no cover - keep the primary line
         light_rate = None
         light_err = repr(e)
@@ -313,12 +361,17 @@ def main() -> None:
                 "unit": "sigs/s/chip",
                 "vs_baseline": round(device_rate / cpu_rate, 3),
                 "extra": {
+                    "backend": backend,
                     "cpu_single_verify_sigs_per_s": round(cpu_rate, 1),
                     "device_rtt_ms_p50": round(rtt_ms, 2),
                     "verify_commit_light_150_p50_ms": round(p50_150, 2),
                     "verify_commit_light_150_p95_ms": round(p95_150, 2),
-                    "verify_commit_10k_p50_ms": round(p50_10k, 2),
-                    "verify_commit_10k_p95_ms": round(p95_10k, 2),
+                    "verify_commit_10k_p50_ms": (
+                        round(p50_10k, 2) if p50_10k is not None else None
+                    ),
+                    "verify_commit_10k_p95_ms": (
+                        round(p95_10k, 2) if p95_10k is not None else None
+                    ),
                     "light_sync_headers_per_s_150vals": (
                         round(light_rate, 2) if light_rate else light_err
                     ),

@@ -21,7 +21,23 @@ from typing import Dict, List, Optional, Set, Tuple
 from ..libs.log import get_logger
 from .types import NodeID, parse_node_address
 
-__all__ = ["PeerManager", "PeerManagerOptions", "PeerUpdate", "PeerStatus"]
+__all__ = [
+    "PeerManager",
+    "PeerManagerOptions",
+    "PeerUpdate",
+    "PeerStatus",
+    "AlreadyConnectedError",
+    "CrossoverRejectError",
+]
+
+
+class AlreadyConnectedError(ValueError):
+    """The peer already holds a live connection slot."""
+
+
+class CrossoverRejectError(ValueError):
+    """Inbound rejected: our own outbound dial to this peer is the
+    canonical connection (we have the lower node ID)."""
 
 
 class PeerStatus:
@@ -136,6 +152,14 @@ class PeerManager:
     def peers(self) -> List[NodeID]:
         return [p.node_id for p in self._peers.values() if p.ready]
 
+    def connection_inbound(self, node_id: NodeID) -> Optional[bool]:
+        """Direction of the peer's live connection (None if not
+        connected) — the router's crossover replacement guard."""
+        peer = self._peers.get(node_id)
+        if peer is None or not peer.connected:
+            return None
+        return peer.inbound
+
     def connected_peers(self) -> List[Tuple[NodeID, str]]:
         """(node_id, first known address) for every ready peer —
         the net_info RPC surface (reference: net.go:16-44)."""
@@ -216,7 +240,9 @@ class PeerManager:
         if peer is None:
             raise ValueError(f"dialed unknown peer {node_id}")
         if peer.connected:
-            raise ValueError(f"peer {node_id} is already connected")
+            raise AlreadyConnectedError(
+                f"peer {node_id} is already connected"
+            )
         peer.dialing = False
         peer.dial_attempts = 0
         peer.connected = True
@@ -232,7 +258,23 @@ class PeerManager:
             peer = _Peer(node_id=node_id)
             self._peers[node_id] = peer
         if peer.connected:
-            raise ValueError(f"peer {node_id} is already connected")
+            raise AlreadyConnectedError(
+                f"peer {node_id} is already connected"
+            )
+        if peer.dialing and self.self_id < node_id:
+            # Simultaneous dial (crossover): without a deterministic
+            # winner both sides accept the other's inbound, both
+            # dialed() calls raise, both connections close, and the
+            # pair livelocks retrying. The canonical connection is the
+            # one dialed BY the lower node ID: the lower side rejects
+            # the inbound here and keeps its outbound; the higher side
+            # replaces its outbound when the canonical inbound arrives
+            # (router handles the replacement; reference concern:
+            # peermanager.go:569,636 crossover).
+            raise CrossoverRejectError(
+                f"dial/accept crossover with {node_id}: "
+                "lower node ID keeps its outbound dial"
+            )
         # capacity check BEFORE reserving the slot, or a rejected inbound
         # peer would leak a phantom connected=True entry forever. This
         # peer's own dialing reservation (crossover) already occupies a

@@ -27,7 +27,7 @@ from ..libs import metrics as M
 from ..libs.log import get_logger
 from ..libs.service import Service
 from .channel import Channel
-from .peermanager import PeerManager
+from .peermanager import AlreadyConnectedError, PeerManager
 from .transport import Connection, Transport
 from .types import ChannelDescriptor, Envelope, NodeID, NodeInfo
 
@@ -230,8 +230,10 @@ class Router(Service):
             await self.transport.listen(self.listen_addr)
         # accept always runs: memory transports accept without listening
         self.spawn(self._accept_loop(), "accept")
-        for _ in range(self.opts.num_concurrent_dials):
-            self.spawn(self._dial_loop(), "dial")
+        # ONE dispatcher; per-dial concurrency is bounded by the
+        # semaphore inside _dial_loop (spawning the loop N times would
+        # square the configured dial bound)
+        self.spawn(self._dial_loop(), "dial")
         self.spawn(self._evict_loop(), "evict")
 
     async def on_stop(self) -> None:
@@ -243,8 +245,26 @@ class Router(Service):
     # -- dialing / accepting (reference: router.go dialPeers/acceptPeers) --
 
     async def _dial_loop(self) -> None:
+        # dials run concurrently (bounded): a slow dial or handshake
+        # must not head-of-line-block every other candidate
+        # (reference: router.go dialPeers spawns per-candidate
+        # goroutines under a capacity limit)
+        sem = asyncio.Semaphore(self.opts.num_concurrent_dials)
         while True:
             node_id, host, port = await self.peer_manager.dial_next()
+            await sem.acquire()
+            # retries spawn a fresh task each attempt: drop completed
+            # ones or the service task list grows without bound
+            self._tasks = [t for t in self._tasks if not t.done()]
+            self.spawn(
+                self._dial_one(node_id, host, port, sem),
+                f"dial-{node_id[:8]}",
+            )
+
+    async def _dial_one(
+        self, node_id, host: str, port: int, sem: asyncio.Semaphore
+    ) -> None:
+        try:
             try:
                 conn = await asyncio.wait_for(
                     self.transport.dial(host, port),
@@ -255,22 +275,40 @@ class Router(Service):
                     "failed to dial peer", peer=node_id, err=str(e)
                 )
                 self.peer_manager.dial_failed(node_id)
-                continue
+                return
             try:
                 peer_info = await self._handshake(conn)
                 if peer_info.node_id != node_id:
                     raise ConnectionError(
                         f"expected {node_id}, got {peer_info.node_id}"
                     )
-                self.peer_manager.dialed(node_id)
             except Exception as e:
                 self.logger.info(
                     "peer handshake failed", peer=node_id, err=str(e)
                 )
                 conn.close()
                 self.peer_manager.dial_failed(node_id)
-                continue
+                return
+            try:
+                self.peer_manager.dialed(node_id)
+            except AlreadyConnectedError:
+                # an inbound can only have registered if we were NOT
+                # dialing when it arrived (accepted() rejects inbound
+                # during a lower-ID dial with CrossoverRejectError), so
+                # the existing connection is canonical: drop this dial
+                conn.close()
+                self.peer_manager.dial_failed(node_id)
+                return
+            except Exception as e:
+                self.logger.info(
+                    "dial rejected", peer=node_id, err=str(e)
+                )
+                conn.close()
+                self.peer_manager.dial_failed(node_id)
+                return
             self._start_peer(peer_info.node_id, conn)
+        finally:
+            sem.release()
 
     async def _accept_loop(self) -> None:
         while True:
@@ -309,9 +347,42 @@ class Router(Service):
     async def _accept_one(self, conn: Connection) -> None:
         try:
             peer_info = await self._handshake(conn)
-            self.peer_manager.accepted(peer_info.node_id)
         except Exception as e:
             self.logger.debug("inbound handshake failed", err=str(e))
+            conn.close()
+            return
+        nid = peer_info.node_id
+        try:
+            self.peer_manager.accepted(nid)
+        except AlreadyConnectedError:
+            if (
+                self.node_info.node_id > nid
+                and self.peer_manager.connection_inbound(nid) is False
+            ):
+                # dial/accept crossover, higher-ID side with its own
+                # outbound already registered: the CANONICAL connection
+                # is the lower-ID peer's outbound — this inbound.
+                # Replace ours (see peermanager.CrossoverRejectError).
+                # Only an existing OUTBOUND is replaced: a duplicate
+                # inbound must not let a peer churn our state.
+                self.logger.info(
+                    "crossover: replacing outbound with canonical "
+                    "inbound", peer=nid[:12],
+                )
+                self._peer_down(nid)
+                try:
+                    self.peer_manager.accepted(nid)
+                except Exception as e:
+                    self.logger.debug(
+                        "crossover replacement failed", err=str(e)
+                    )
+                    conn.close()
+                    return
+            else:
+                conn.close()
+                return
+        except Exception as e:
+            self.logger.debug("inbound rejected", err=str(e))
             conn.close()
             return
         # record the peer's self-reported listen address so PEX can

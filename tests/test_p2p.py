@@ -224,26 +224,38 @@ def test_peer_manager_evicts_on_error():
 
 
 def test_peer_manager_dial_accept_crossover():
-    """dialed() must raise for an already-connected peer, and a failed
-    dial must not clobber the live inbound connection's state
-    (reference: peermanager.go:569)."""
+    """Simultaneous dial resolution is deterministic: the LOWER node ID
+    keeps its outbound (rejecting the inbound), the higher accepts the
+    inbound and lets its own dial fail — one connection survives
+    instead of a mutual-close livelock
+    (reference concern: peermanager.go:569,636)."""
 
     async def go():
+        # lower-ID side: inbound during our dial is rejected, our
+        # outbound completes
         pm = PeerManager("aa" * 20)
         nid = "bb" * 20
         pm.add(f"{nid}@h:1")
         node_id, _, _ = await pm.dial_next()
-        # crossover: the same peer dialed us and the inbound handshake
-        # completed first
-        pm.accepted(nid)
-        pm.ready(nid)
-        with pytest.raises(ValueError):
-            pm.dialed(node_id)
-        # the router closes the dial conn and reports dial_failed; the
-        # live inbound connection must remain up
-        pm.dial_failed(node_id)
+        with pytest.raises(ValueError, match="crossover"):
+            pm.accepted(nid)
+        pm.dialed(node_id)
+        pm.ready(node_id)
         assert pm.num_connected() == 1
         assert pm.peers() == [nid]
+
+        # higher-ID side: the inbound wins, our own dial raises, and a
+        # failed dial must not clobber the live inbound state
+        pm2 = PeerManager("cc" * 20)
+        pm2.add(f"{nid}@h:1")
+        node_id2, _, _ = await pm2.dial_next()
+        pm2.accepted(nid)
+        pm2.ready(nid)
+        with pytest.raises(ValueError, match="already connected"):
+            pm2.dialed(node_id2)
+        pm2.dial_failed(node_id2)
+        assert pm2.num_connected() == 1
+        assert pm2.peers() == [nid]
 
     run(go())
 

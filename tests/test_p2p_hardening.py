@@ -340,3 +340,42 @@ class TestPexReactor:
                 await net.stop()
 
         run(go())
+
+
+class TestDialAcceptCrossover:
+    def test_simultaneous_dial_converges(self):
+        """Both peers learn each other's address at the same instant
+        and dial simultaneously. The deterministic crossover rule (the
+        lower node ID keeps its outbound) must converge to ONE live
+        connection instead of livelocking on mutual 'already connected'
+        rejections (reference concern: peermanager.go:569,636)."""
+
+        async def go():
+            for trial in range(6):
+                net = TestNetwork(2)
+                a, b = net.nodes
+                await a.router.start()
+                await b.router.start()
+                try:
+                    # add both directions in the same loop tick: both
+                    # dial loops wake together -> crossover
+                    a.peer_manager.add(f"{b.node_id}@{b.addr}")
+                    b.peer_manager.add(f"{a.node_id}@{a.addr}")
+                    deadline = time.monotonic() + 20.0
+                    while time.monotonic() < deadline:
+                        if (
+                            b.node_id in a.peer_manager.peers()
+                            and a.node_id in b.peer_manager.peers()
+                        ):
+                            break
+                        await asyncio.sleep(0.05)
+                    assert b.node_id in a.peer_manager.peers(), (
+                        f"trial {trial}: a never connected to b"
+                    )
+                    assert a.node_id in b.peer_manager.peers(), (
+                        f"trial {trial}: b never connected to a"
+                    )
+                finally:
+                    await net.stop()
+
+        run(go())
