@@ -329,8 +329,21 @@ def _device_watchdog(timeout_s: float = 300.0) -> str:
     raise AssertionError("unreachable")
 
 
+def _enable_compile_cache() -> None:
+    """Persist XLA compilations across runs (same cache the test suite
+    uses; the big verify programs take minutes to compile cold)."""
+    import os
+
+    import jax
+
+    cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def main() -> None:
     backend = _device_watchdog()
+    _enable_compile_cache()
     fallback = backend != "device"
     pks, msgs, sigs = _make_batch(512, seed=7)
     cpu_rate = bench_cpu_baseline(pks, msgs, sigs)

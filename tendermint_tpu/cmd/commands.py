@@ -184,20 +184,26 @@ def cmd_rollback(args) -> int:
     from ..store.kv import open_db
 
     cfg = _load_home(args.home)
-    db_dir = cfg.base.path(cfg.base.db_dir)
-    state_db = open_db("state", cfg.base.db_backend, db_dir)
-    block_db = open_db("blockstore", cfg.base.db_backend, db_dir)
     try:
-        state_store = StateStore(state_db)
-        block_store = BlockStore(block_db)
-        new_state = state_store.rollback(block_store)
-        print(
-            f"rolled back state to height {new_state.last_block_height} "
-            f"app_hash {new_state.app_hash.hex()}"
-        )
-    finally:
-        state_db.close()
-        block_db.close()
+        with _ensure_node_stopped(cfg):
+            db_dir = cfg.base.path(cfg.base.db_dir)
+            state_db = open_db("state", cfg.base.db_backend, db_dir)
+            block_db = open_db("blockstore", cfg.base.db_backend, db_dir)
+            try:
+                state_store = StateStore(state_db)
+                block_store = BlockStore(block_db)
+                new_state = state_store.rollback(block_store)
+                print(
+                    "rolled back state to height "
+                    f"{new_state.last_block_height} "
+                    f"app_hash {new_state.app_hash.hex()}"
+                )
+            finally:
+                state_db.close()
+                block_db.close()
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     return 0
 
 
@@ -205,13 +211,19 @@ def cmd_reset_unsafe(args) -> int:
     """Remove all data, keep config + keys; reset privval state
     (reference: commands/reset.go UnsafeResetAll)."""
     cfg = _load_home(args.home)
-    data = cfg.base.path("data")
-    if os.path.isdir(data):
-        shutil.rmtree(data)
-    os.makedirs(data, exist_ok=True)
-    os.makedirs(
-        os.path.dirname(cfg.base.path(cfg.consensus.wal_file)), exist_ok=True
-    )
+    try:
+        with _ensure_node_stopped(cfg):
+            data = cfg.base.path("data")
+            if os.path.isdir(data):
+                shutil.rmtree(data)
+            os.makedirs(data, exist_ok=True)
+            os.makedirs(
+                os.path.dirname(cfg.base.path(cfg.consensus.wal_file)),
+                exist_ok=True,
+            )
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     print(f"removed all data in {data} (config and keys kept)")
     return 0
 
@@ -620,6 +632,131 @@ def cmd_version(args) -> int:
     return 0
 
 
+class _ensure_node_stopped:
+    """Context manager for offline data-dir commands: refuse when a
+    RUNNING node holds the advisory LOCK, and hold the lock ourselves
+    for the command's duration so a node started mid-command fails
+    fast instead of racing the same databases
+    (counterpart of node.Node._acquire_data_lock)."""
+
+    def __init__(self, cfg: Config) -> None:
+        self.cfg = cfg
+        self.lock = os.path.join(
+            cfg.base.path(cfg.base.db_dir), "LOCK"
+        )
+        self._took = False
+
+    def __enter__(self) -> "_ensure_node_stopped":
+        from ..node.node import _pid_alive, _read_lock_pid
+
+        pid = _read_lock_pid(self.lock)
+        if pid and pid != os.getpid() and _pid_alive(pid):
+            raise RuntimeError(
+                f"node appears to be running (pid {pid}, lock "
+                f"{self.lock}); stop it first"
+            )
+        os.makedirs(os.path.dirname(self.lock), exist_ok=True)
+        with open(self.lock, "w") as f:
+            f.write(str(os.getpid()))
+        self._took = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._took:
+            try:
+                os.remove(self.lock)
+            except OSError:
+                pass
+
+
+def cmd_reindex_event(args) -> int:
+    """Rebuild the tx/block event indexes from stored blocks and saved
+    ABCI responses — recovery after index corruption or a sink config
+    change (reference: cmd/tendermint/commands/reindex_event.go)."""
+    cfg = _load_home(args.home)
+    try:
+        with _ensure_node_stopped(cfg):
+            return _reindex_events(cfg, args)
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+
+
+def _reindex_events(cfg: Config, args) -> int:
+    from ..state import StateStore
+    from ..state.indexer import KVSink, TxResult
+    from ..store.block_store import BlockStore
+    from ..store.kv import open_db
+
+    db_dir = cfg.base.path(cfg.base.db_dir)
+    bdb = open_db("blockstore", cfg.base.db_backend, db_dir)
+    sdb = open_db("state", cfg.base.db_backend, db_dir)
+    idb = open_db("tx_index", cfg.base.db_backend, db_dir)
+    try:
+        bs = BlockStore(bdb)
+        st = StateStore(sdb)
+        sink = KVSink(idb)
+        base, tip = bs.base(), bs.height()
+        start = args.start_height or base
+        end = args.end_height or tip
+        if start < base or end > tip or start > end:
+            print(
+                f"invalid range [{start}, {end}]: stored blocks span "
+                f"[{base}, {tip}]",
+                file=sys.stderr,
+            )
+            return 1
+        done = skipped = 0
+        for height in range(start, end + 1):
+            block = bs.load_block(height)
+            resp = st.load_abci_responses(height)
+            if block is None or resp is None:
+                skipped += 1
+                continue
+            if len(resp.deliver_txs) != len(block.txs):
+                # partial/corrupt responses: indexing a truncated zip
+                # would silently drop txs while claiming success
+                print(
+                    f"height {height}: {len(block.txs)} txs but "
+                    f"{len(resp.deliver_txs)} saved results; skipped",
+                    file=sys.stderr,
+                )
+                skipped += 1
+                continue
+            events = list(
+                getattr(resp.begin_block_obj, "events", ()) or ()
+            )
+            events += list(
+                getattr(resp.end_block_obj, "events", ()) or ()
+            )
+            sink.index_block_events(height, events)
+            results = [
+                TxResult(height=height, index=i, tx=tx, result=r)
+                for i, (tx, r) in enumerate(
+                    zip(block.txs, resp.deliver_tx_objs)
+                )
+            ]
+            if results:
+                sink.index_tx_events(results)
+            done += 1
+        if done == 0:
+            print(
+                f"no heights reindexed in [{start}, {end}]: stored "
+                "blocks or ABCI responses are missing (pruned?)",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"reindexed {done} heights in [{start}, {end}]"
+            + (f" ({skipped} skipped: missing data)" if skipped else "")
+        )
+        return 0
+    finally:
+        bdb.close()
+        sdb.close()
+        idb.close()
+
+
 def _parse_tx(s: str) -> bytes:
     """0x-prefixed hex, else the raw string bytes (reference:
     abci/cmd/abci-cli stringOrHexToBytes)."""
@@ -820,6 +957,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser(
+        "reindex-event",
+        help="rebuild tx/block event indexes from stored blocks",
+    )
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
 
     sp = sub.add_parser(
         "abci",

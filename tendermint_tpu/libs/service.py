@@ -96,7 +96,16 @@ class Service:
         # never starts; close it then to avoid "never awaited" warnings.
         task.add_done_callback(lambda _t: coro.close())
         self._tasks.append(task)
+        # drop finished tasks so services spawning per-event work
+        # (dials, accepts) don't grow the list without bound
+        task.add_done_callback(self._discard_task)
         return task
+
+    def _discard_task(self, task: asyncio.Task) -> None:
+        try:
+            self._tasks.remove(task)
+        except ValueError:
+            pass  # already cleared by stop()
 
     async def _run_guarded(self, coro: Coroutine, name: str) -> None:
         try:

@@ -247,11 +247,36 @@ class Node(Service):
         """reference: node/node.go OnStart :415-470. A failure partway
         through tears down whatever already started — Service.stop()
         won't call on_stop after a failed start."""
+        self._acquire_data_lock()
         try:
             await self._start_impl()
         except BaseException:
             await self._teardown()
             raise
+
+    def _acquire_data_lock(self) -> None:
+        """Advisory data-dir lock: offline commands (reindex-event,
+        rollback, reset) refuse to touch the DBs of a RUNNING node, and
+        a second node process on the same home fails fast instead of
+        corrupting stores. Same-pid locks are treated as stale so an
+        in-process crash-restart (the replay tests' crash simulation)
+        can reacquire."""
+        data_dir = self.cfg.base.path(self.cfg.base.db_dir)
+        os.makedirs(data_dir, exist_ok=True)
+        self._lock_path = os.path.join(data_dir, "LOCK")
+        pid = _read_lock_pid(self._lock_path)
+        if pid and pid != os.getpid() and _pid_alive(pid):
+            raise RuntimeError(
+                f"data dir {data_dir} is locked by running process {pid}"
+            )
+        with open(self._lock_path, "w") as f:
+            f.write(str(os.getpid()))
+
+    def _release_data_lock(self) -> None:
+        try:
+            os.remove(getattr(self, "_lock_path", ""))
+        except OSError:
+            pass
 
     async def _start_impl(self) -> None:
         cfg = self.cfg
@@ -566,6 +591,23 @@ class Node(Service):
             except Exception as e:
                 self.logger.error("error closing db", err=str(e))
         self._dbs = []
+        self._release_data_lock()
+
+
+def _read_lock_pid(path: str) -> int:
+    try:
+        with open(path) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
 
 
 def make_node(

@@ -219,6 +219,16 @@ class PeerManager:
         addrs = sorted(best.addresses)
         return best, addrs[best.dial_attempts % len(addrs)]
 
+    def dial_abandoned(self, node_id: NodeID) -> None:
+        """Clear a dial reservation without the failure penalty — the
+        dial was made redundant (e.g. a crossover resolved onto the
+        peer's connection), not refused. No score dock, no backoff."""
+        peer = self._peers.get(node_id)
+        if peer is None:
+            return
+        peer.dialing = False
+        self._wakeup.set()
+
     def dial_failed(self, node_id: NodeID) -> None:
         """reference: peermanager.go:499-530. Only clears the dialing
         reservation — a live inbound connection accepted during the dial

@@ -194,6 +194,8 @@ class Router(Service):
         # per-IP connection-attempt tracking
         # (reference: internal/p2p/conn_tracker.go)
         self._conn_tracker: Dict[str, Deque[float]] = {}
+        # last crossover replacement per peer (churn rate limit)
+        self._last_replacement: Dict[NodeID, float] = {}
 
     # -- reactor API --
 
@@ -251,11 +253,13 @@ class Router(Service):
         # goroutines under a capacity limit)
         sem = asyncio.Semaphore(self.opts.num_concurrent_dials)
         while True:
-            node_id, host, port = await self.peer_manager.dial_next()
+            # acquire the slot BEFORE taking a dial reservation:
+            # dial_next marks the peer dialing, and a reservation that
+            # sits queued behind a full semaphore would make accepted()
+            # crossover-reject healthy inbounds for a dial that hasn't
+            # even started
             await sem.acquire()
-            # retries spawn a fresh task each attempt: drop completed
-            # ones or the service task list grows without bound
-            self._tasks = [t for t in self._tasks if not t.done()]
+            node_id, host, port = await self.peer_manager.dial_next()
             self.spawn(
                 self._dial_one(node_id, host, port, sem),
                 f"dial-{node_id[:8]}",
@@ -292,12 +296,12 @@ class Router(Service):
             try:
                 self.peer_manager.dialed(node_id)
             except AlreadyConnectedError:
-                # an inbound can only have registered if we were NOT
-                # dialing when it arrived (accepted() rejects inbound
-                # during a lower-ID dial with CrossoverRejectError), so
-                # the existing connection is canonical: drop this dial
+                # the peer's connection registered while we dialed —
+                # the crossover resolved onto it. Drop this dial
+                # WITHOUT the failure penalty: the peer is healthy and
+                # connected, a score dock would skew eviction ordering
                 conn.close()
-                self.peer_manager.dial_failed(node_id)
+                self.peer_manager.dial_abandoned(node_id)
                 return
             except Exception as e:
                 self.logger.info(
@@ -355,16 +359,20 @@ class Router(Service):
         try:
             self.peer_manager.accepted(nid)
         except AlreadyConnectedError:
+            now = _time.monotonic()
             if (
                 self.node_info.node_id > nid
                 and self.peer_manager.connection_inbound(nid) is False
+                and now - self._last_replacement.get(nid, -1e9) > 30.0
             ):
                 # dial/accept crossover, higher-ID side with its own
                 # outbound already registered: the CANONICAL connection
                 # is the lower-ID peer's outbound — this inbound.
                 # Replace ours (see peermanager.CrossoverRejectError).
-                # Only an existing OUTBOUND is replaced: a duplicate
-                # inbound must not let a peer churn our state.
+                # Only an existing OUTBOUND is replaced, at most once
+                # per peer per 30s: a duplicate inbound must not let a
+                # peer churn our reactor state in a loop.
+                self._last_replacement[nid] = now
                 self.logger.info(
                     "crossover: replacing outbound with canonical "
                     "inbound", peer=nid[:12],
@@ -539,7 +547,6 @@ class Router(Service):
         for t in self._peer_tasks.pop(node_id, []):
             if not t.done() and t is not asyncio.current_task():
                 t.cancel()
-        self._tasks = [t for t in self._tasks if not t.done()]
 
     # -- outbound routing (reference: router.go routeChannel) --
 

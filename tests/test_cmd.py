@@ -327,3 +327,92 @@ def test_abci_cli_against_kvstore_socket(tmp_path, capsys):
             srv.wait(timeout=10)
         except subprocess.TimeoutExpired:
             srv.kill()
+
+
+def test_reindex_event_rebuilds_tx_index(tmp_path, capsys):
+    """`reindex-event` repopulates a wiped tx/block index from stored
+    blocks + ABCI responses (reference: commands/reindex_event.go)."""
+    import asyncio as aio
+
+    home = str(tmp_path / "reidx")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "reidx-chain") == 0
+    from tendermint_tpu.config import load_config, write_config
+    from tendermint_tpu.node import make_node
+
+    cfg_path = os.path.join(home, "config", "config.toml")
+    cfg = load_config(cfg_path)
+    cfg.consensus.timeout_commit = 0.2
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.base.db_backend = "sqlite"
+    write_config(cfg, cfg_path)
+
+    tx = b"reindex=me"
+
+    async def produce():
+        cfg2 = load_config(cfg_path)
+        cfg2.base.home = home
+        node = make_node(cfg2)
+        await node.start()
+        try:
+            await node.consensus.wait_for_height(2, timeout=60.0)
+            await node.mempool.check_tx(tx)
+            tip = node.block_store.height()
+            await node.consensus.wait_for_height(tip + 2, timeout=60.0)
+        finally:
+            await node.stop()
+
+    aio.run(produce())
+
+    # wipe the index, then rebuild it
+    import glob
+
+    for f in glob.glob(os.path.join(home, "data", "tx_index*")):
+        os.remove(f)
+    assert run_cli("--home", home, "reindex-event") == 0
+    out = capsys.readouterr().out
+    assert "reindexed" in out
+
+    from tendermint_tpu.state.indexer import KVSink
+    from tendermint_tpu.store.kv import open_db
+    from tendermint_tpu.types.tx import tx_hash
+
+    idb = open_db("tx_index", "sqlite", os.path.join(home, "data"))
+    try:
+        sink = KVSink(idb)
+        got = sink.get_tx_by_hash(tx_hash(tx))
+        assert got is not None and got.tx == tx
+        assert sink.has_block(2)
+    finally:
+        idb.close()
+
+
+def test_offline_commands_refuse_running_node(tmp_path, capsys):
+    """reindex-event/rollback/unsafe-reset-all check the advisory data
+    LOCK so they cannot race a live node's databases."""
+    import subprocess
+    import sys as _sys
+
+    home = str(tmp_path / "locked")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "lock-chain") == 0
+    lock_dir = os.path.join(home, "data")
+    os.makedirs(lock_dir, exist_ok=True)
+    lock = os.path.join(lock_dir, "LOCK")
+
+    # a live foreign pid holds the lock -> refused
+    other = subprocess.Popen([_sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        with open(lock, "w") as f:
+            f.write(str(other.pid))
+        assert run_cli("--home", home, "reindex-event") == 1
+        assert run_cli("--home", home, "rollback") == 1
+        assert run_cli("--home", home, "unsafe-reset-all") == 1
+    finally:
+        other.kill()
+        other.wait()
+
+    # dead pid -> stale lock, command proceeds past the guard
+    with open(lock, "w") as f:
+        f.write(str(other.pid))  # now dead
+    assert run_cli("--home", home, "unsafe-reset-all") == 0

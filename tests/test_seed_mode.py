@@ -1,0 +1,105 @@
+"""Seed-mode node test: validators that only know the seed discover
+each other via PEX and reach consensus (reference: node/seed.go — a
+PEX-only node whose job is address introduction)."""
+
+import asyncio
+import time
+
+from tendermint_tpu.config import Config
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.node import NodeKey, make_node
+from tendermint_tpu.p2p.transport import MemoryNetwork, MemoryTransport
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+CHAIN = "seed-chain"
+
+
+def _cfg(tmp_path, name: str, mode: str = "validator") -> Config:
+    cfg = Config()
+    cfg.base.home = str(tmp_path / name)
+    cfg.base.chain_id = CHAIN
+    cfg.base.db_backend = "memdb"
+    cfg.base.mode = mode
+    cfg.consensus.timeout_propose = 2.0
+    cfg.consensus.timeout_prevote = 1.0
+    cfg.consensus.timeout_precommit = 1.0
+    cfg.consensus.timeout_commit = 0.2
+    cfg.consensus.peer_gossip_sleep_duration = 0.01
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = f"{name}:26656"
+    cfg.ensure_dirs()
+    return cfg
+
+
+def test_validators_bootstrap_through_seed(tmp_path):
+    async def go():
+        n_vals = 3
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([i + 160]) * 32)
+            for i in range(n_vals)
+        ]
+        genesis = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=time.time_ns(),
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10)
+                for p in privs
+            ],
+        )
+        net = MemoryNetwork()
+
+        seed_cfg = _cfg(tmp_path, "seed", mode="seed")
+        genesis.save_as(seed_cfg.base.path(seed_cfg.base.genesis_file))
+        seed_id = NodeKey.load_or_generate(
+            seed_cfg.base.path(seed_cfg.base.node_key_file)
+        ).node_id
+        seed = make_node(
+            seed_cfg, transport=MemoryTransport(net, "seed:26656")
+        )
+
+        vals = []
+        for i in range(n_vals):
+            cfg = _cfg(tmp_path, f"val{i}")
+            genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+            FilePV.from_priv_key(
+                privs[i],
+                cfg.base.path(cfg.priv_validator.key_file),
+                cfg.base.path(cfg.priv_validator.state_file),
+            ).save()
+            # validators know ONLY the seed — peer discovery must come
+            # from PEX through it
+            cfg.p2p.bootstrap_peers = f"{seed_id}@seed:26656"
+            vals.append(
+                make_node(
+                    cfg,
+                    transport=MemoryTransport(net, f"val{i}:26656"),
+                )
+            )
+
+        await seed.start()
+        for v in vals:
+            await v.start()
+        try:
+            # every validator must find the other two and make blocks
+            await asyncio.gather(
+                *(
+                    v.consensus.wait_for_height(2, timeout=120.0)
+                    for v in vals
+                )
+            )
+            for v in vals:
+                peers = v.peer_manager.peers()
+                others = [
+                    o.node_key.node_id for o in vals if o is not v
+                ]
+                assert all(o in peers for o in others), (
+                    v.node_key.node_id,
+                    peers,
+                )
+        finally:
+            for v in vals:
+                await v.stop()
+            await seed.stop()
+
+    asyncio.run(go())
