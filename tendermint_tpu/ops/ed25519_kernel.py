@@ -328,11 +328,20 @@ class Ed25519Verifier:
 
     @staticmethod
     def _pallas_wanted() -> bool:
+        """Fused Pallas kernel gate. Opt-in (TM_TPU_PALLAS=1) for now:
+        the kernel is differential-verified in interpret mode
+        (tests/test_ops_pallas.py) but Mosaic compilation via this
+        environment's remote-compile tunnel has not been timed yet, and
+        an unbounded first compile must not eat the benchmark window.
+        The XLA program remains the measured default."""
         import os
 
         if os.environ.get("TM_TPU_NO_PALLAS"):
             return False
-        return jax.default_backend() == "tpu"
+        return (
+            os.environ.get("TM_TPU_PALLAS") == "1"
+            and jax.default_backend() == "tpu"
+        )
 
     def _program(self, size: int):
         fn = self._compiled.get(size)
