@@ -95,8 +95,10 @@ def bench_cpu_baseline(pks, msgs, sigs):
     return m / (time.perf_counter() - t0)
 
 
-def _make_commit(n_vals: int, chain_id: str):
-    """A synthetic height-1 commit signed by all n_vals validators."""
+def _make_commit(n_vals: int, chain_id: str, mixed: bool = False):
+    """A synthetic height-1 commit signed by all n_vals validators.
+    `mixed` interleaves ed25519 and sr25519 keys 1:1 (BASELINE config
+    5's mixed-curve stress shape)."""
     from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
     from tendermint_tpu.types.block_id import BlockID, PartSetHeader
     from tendermint_tpu.types.commit import Commit, CommitSig
@@ -104,12 +106,15 @@ def _make_commit(n_vals: int, chain_id: str):
     from tendermint_tpu.types.vote import Vote
     from tendermint_tpu.types.canonical import PRECOMMIT_TYPE
 
-    privs = [
-        PrivKeyEd25519.from_seed(
-            int(i).to_bytes(4, "big") + b"\x33" * 28
-        )
-        for i in range(n_vals)
-    ]
+    def _priv(i: int):
+        seed = int(i).to_bytes(4, "big") + b"\x33" * 28
+        if mixed and i % 2 == 1:
+            from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+            return PrivKeySr25519.from_seed(seed)
+        return PrivKeyEd25519.from_seed(seed)
+
+    privs = [_priv(i) for i in range(n_vals)]
     vals = ValidatorSet(
         [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
     )
@@ -138,14 +143,16 @@ def _make_commit(n_vals: int, chain_id: str):
     )
 
 
-def bench_commit_latency(n_vals: int, reps: int, light: bool):
+def bench_commit_latency(
+    n_vals: int, reps: int, light: bool, mixed: bool = False
+):
     """p50/p95 wall latency of a full commit verification on device."""
     from tendermint_tpu.crypto import tpu_verifier
     from tendermint_tpu.types import validation
 
     tpu_verifier.install(min_batch=2)
-    chain_id = f"bench-{n_vals}"
-    vals, commit = _make_commit(n_vals, chain_id)
+    chain_id = f"bench-{n_vals}" + ("-mixed" if mixed else "")
+    vals, commit = _make_commit(n_vals, chain_id, mixed=mixed)
     fn = (
         validation.verify_commit_light if light else validation.verify_commit
     )
@@ -292,7 +299,7 @@ def bench_device_rtt():
     return times[len(times) // 2] * 1e3
 
 
-def _device_watchdog(timeout_s: float = 300.0) -> str:
+def _device_watchdog(timeout_s: float = 0.0) -> str:
     """Probe device availability on a side thread. A SIGKILLed former
     client can leave the tunneled TPU claimed for hours; if the device
     doesn't answer in time, re-exec this process on the CPU backend so
@@ -305,6 +312,13 @@ def _device_watchdog(timeout_s: float = 300.0) -> str:
 
     if os.environ.get("TM_BENCH_CPU_FALLBACK"):
         return "cpu-fallback (device unreachable)"
+    if not timeout_s:
+        try:
+            timeout_s = float(
+                os.environ.get("TM_BENCH_DEVICE_TIMEOUT", "") or 300.0
+            )
+        except ValueError:
+            timeout_s = 300.0
     result = {}
 
     def probe():
@@ -355,12 +369,26 @@ def main() -> None:
     p50_150, p95_150 = bench_commit_latency(
         150, reps=5 if fallback else 20, light=True
     )
+    p50_mixed = None
+    mixed_err = None
     if fallback:
         p50_10k = p95_10k = None
     else:
         p50_10k, p95_10k = bench_commit_latency(
             10_000, reps=10, light=False
         )
+        # BASELINE config 5 shape: mixed ed25519/sr25519 validator set,
+        # run at 1k validators so the pure-Python sr25519 half (~6 ms
+        # per verify, 500 sigs/run) stays bounded and the ed25519 half
+        # reuses the 512 bucket the 150-validator config already
+        # compiled. Measures the mixed dispatch: ed25519 on device,
+        # sr25519 on the host verifier.
+        try:
+            p50_mixed, _ = bench_commit_latency(
+                1_000, reps=3, light=False, mixed=True
+            )
+        except Exception as e:
+            mixed_err = repr(e)
     try:
         light_rate = bench_light_sync(n_headers=10 if fallback else 50)
     except Exception as e:  # pragma: no cover - keep the primary line
@@ -384,6 +412,11 @@ def main() -> None:
                     ),
                     "verify_commit_10k_p95_ms": (
                         round(p95_10k, 2) if p95_10k is not None else None
+                    ),
+                    "verify_commit_1k_mixed_keys_p50_ms": (
+                        round(p50_mixed, 2)
+                        if p50_mixed is not None
+                        else mixed_err
                     ),
                     "light_sync_headers_per_s_150vals": (
                         round(light_rate, 2) if light_rate else light_err
