@@ -25,11 +25,16 @@ _SNAPSHOT_CHUNK = 1 << 16
 
 
 class KVStoreApplication(T.Application):
-    def __init__(self, retain_blocks: int = 0) -> None:
+    def __init__(
+        self, retain_blocks: int = 0, snapshot_interval: int = 0
+    ) -> None:
         self.state: Dict[bytes, bytes] = {}
         self.height = 0
         self.app_hash = b""
         self.retain_blocks = retain_blocks
+        # >0: advertise a state-sync snapshot every N heights (the
+        # reference's e2e app shape, test/e2e/app/snapshots.go)
+        self.snapshot_interval = snapshot_interval
         self.validator_set: Dict[str, T.ValidatorUpdate] = {}  # hex(pk) → update
         self._staged_updates: List[T.ValidatorUpdate] = []
         self._snapshots: Dict[Tuple[int, int], bytes] = {}  # (height, format)
@@ -136,6 +141,11 @@ class KVStoreApplication(T.Application):
     def commit(self) -> T.ResponseCommit:
         self.height += 1
         self.app_hash = self._compute_app_hash()
+        if (
+            self.snapshot_interval
+            and self.height % self.snapshot_interval == 0
+        ):
+            self.take_snapshot()
         retain = 0
         if self.retain_blocks and self.height >= self.retain_blocks:
             retain = self.height - self.retain_blocks + 1
@@ -157,6 +167,8 @@ class KVStoreApplication(T.Application):
         ).encode()
         chunks = max(1, (len(blob) + _SNAPSHOT_CHUNK - 1) // _SNAPSHOT_CHUNK)
         self._snapshots[(self.height, 1)] = blob
+        while len(self._snapshots) > 4:  # bounded retention
+            del self._snapshots[min(self._snapshots)]
         return T.Snapshot(
             height=self.height,
             format=1,

@@ -62,6 +62,7 @@ class RunReport:
     txs_submitted: int = 0
     txs_committed: int = 0
     evidence_heights: Dict[str, int] = field(default_factory=dict)
+    state_synced: Dict[str, bool] = field(default_factory=dict)
     failures: List[str] = field(default_factory=list)
 
     @property
@@ -76,6 +77,16 @@ class _NodeHandle:
         self.priv = priv  # validator key, if any
         self.node = None
         self.started = False
+        # sticky across kill/restart: the flag lives on the Node
+        # instance, and a restarted node (with history on disk) skips
+        # statesync by design
+        self.state_synced_once = False
+
+    def note_sync(self) -> None:
+        if self.node is not None and getattr(
+            self.node, "genesis_state_synced", False
+        ):
+            self.state_synced_once = True
 
     @property
     def live(self) -> bool:
@@ -129,6 +140,9 @@ class Runner:
             cfg.rpc.laddr = "tcp://127.0.0.1:0"
             cfg.p2p.laddr = f"{name}:26656"
             cfg.statesync.enable = spec.state_sync
+            if spec.state_sync:
+                cfg.statesync.discovery_time = 1.0
+                cfg.statesync.chunk_request_timeout = 5.0
             cfg.ensure_dirs()
             genesis.save_as(cfg.base.path(cfg.base.genesis_file))
             priv = privs.get(name)
@@ -152,12 +166,26 @@ class Runner:
 
     # -- start (reference: test/e2e/runner/start.go) --
 
+    # snapshots are advertised by every app when anyone will state
+    # sync (the reference e2e app's snapshot_interval manifest knob)
+    SNAPSHOT_INTERVAL = 2
+
+    def _make_app(self):
+        if not any(s.state_sync for s in self.m.nodes.values()):
+            return None  # make_node default app
+        from ..abci.kvstore import KVStoreApplication
+
+        return KVStoreApplication(
+            snapshot_interval=self.SNAPSHOT_INTERVAL
+        )
+
     async def _start_node(self, name: str) -> None:
         h = self.handles[name]
         if h.spec.state_sync and h.node is None:
             self._seed_state_sync_trust(h)
         h.node = make_node(
             h.cfg,
+            app=self._make_app(),
             transport=MemoryTransport(self.net, f"{name}:26656"),
         )
         self._arm_misbehaviors(h)
@@ -258,6 +286,7 @@ class Runner:
 
     async def _apply_perturbation(self, name: str, action: str) -> None:
         h = self.handles[name]
+        h.note_sync()
         if action == "kill":
             if h.live:
                 await h.node.stop()
@@ -416,6 +445,18 @@ class Runner:
             rep.txs_committed = committed
             if rep.txs_submitted > 0 and committed == 0:
                 rep.failures.append("load ran but no txs were committed")
+        # every state_sync node must have restored from a snapshot
+        # (not silently block-synced from genesis)
+        for name, h in self.handles.items():
+            if not h.spec.state_sync:
+                continue
+            h.note_sync()
+            rep.state_synced[name] = h.state_synced_once
+            if not h.state_synced_once:
+                rep.failures.append(
+                    f"{name} was configured for state sync but never "
+                    "restored a snapshot"
+                )
         # evidence for every double-signer
         for name, h in self.handles.items():
             if "double-prevote" not in h.spec.misbehaviors:

@@ -331,10 +331,27 @@ class StatesyncReactor(Service):
         except LightClientError as e:
             raise SyncError(f"trust root verification failed: {e}") from e
 
+        discovery_rounds = 0
         while True:
             snapshot = self._best_snapshot()
             if snapshot is None:
-                raise SyncError("no viable snapshots discovered")
+                # providers prune old snapshots while the chain moves;
+                # a one-shot discovery pool can empty out after a slow
+                # chunk round. Re-discover a few times before giving up
+                # (reference: syncer.go SyncAny's discovery retry loop).
+                discovery_rounds += 1
+                if discovery_rounds > 3:
+                    raise SyncError("no viable snapshots discovered")
+                self.logger.info(
+                    "re-discovering snapshots", attempt=discovery_rounds
+                )
+                self.snapshot_ch.try_send(
+                    Envelope(
+                        message=SnapshotsRequestMessage(), broadcast=True
+                    )
+                )
+                await asyncio.sleep(self.cfg.discovery_time)
+                continue
             try:
                 state = await self._sync_snapshot(snapshot, light_client)
                 self.synced_state = state

@@ -110,3 +110,26 @@ def test_run_late_joiner_and_disconnect(tmp_path):
     rep = run_manifest(m, str(tmp_path), timeout=180.0)
     assert rep.ok, rep.failures
     assert rep.reached_height >= 5
+
+
+def test_run_state_sync_late_joiner(tmp_path):
+    """A node joining at height 4 with state_sync: discovers a
+    snapshot from peers, restores the app without replaying all
+    blocks, then follows consensus (reference: the statesync manifests
+    in test/e2e/, runner/start.go waitForNodeHeight)."""
+    from tendermint_tpu.e2e.manifest import NodeSpec
+
+    m = Manifest(
+        chain_id="e2e-statesync",
+        target_height=8,
+        validators={f"validator{i:02d}": 10 for i in range(1, 4)},
+    )
+    m.validate()
+    m.nodes["full01"] = NodeSpec(
+        name="full01", mode="full", start_at=4, state_sync=True
+    )
+    m.validate()
+    rep = run_manifest(m, str(tmp_path), timeout=200.0)
+    assert rep.ok, rep.failures
+    assert rep.reached_height >= 8
+    assert rep.state_synced == {"full01": True}
