@@ -283,6 +283,48 @@ def bench_light_sync(n_vals: int = 150, n_headers: int = 50):
     return asyncio.run(go())
 
 
+def bench_batch_curve(sizes=(1, 8, 64, 1024), reps=5):
+    """Per-signature cost through the BatchVerifier seam at the
+    reference harness's batch sizes, Add() overhead included
+    (reference: crypto/ed25519/bench_test.go:30-67,
+    crypto/internal/benchmarking/bench.go:27-63). Returns
+    {batch_size: us/sig}."""
+    from tendermint_tpu.crypto import tpu_verifier
+    from tendermint_tpu.crypto.batch import create_batch_verifier
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+    tpu_verifier.install(min_batch=2)
+    out = {}
+    for n in sizes:
+        privs = [
+            PrivKeyEd25519.from_seed(int(i).to_bytes(4, "big") + b"\x55" * 28)
+            for i in range(min(n, 64))
+        ]
+        triples = []
+        for i in range(n):
+            p = privs[i % len(privs)]
+            msg = b"curve-%d" % i
+            triples.append((p.pub_key(), msg, p.sign(msg)))
+
+        def run_once():
+            # size_hint mirrors production callers (validation.py
+            # passes the commit's signature count): small batches take
+            # the CPU single-verify path, exactly like the seam
+            bv = create_batch_verifier(triples[0][0], size_hint=n)
+            for pk, msg, sig in triples:
+                bv.add(pk, msg, sig)
+            ok, _bits = bv.verify()
+            assert ok
+
+        run_once()  # compile/warm the bucket
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run_once()
+        per_sig = (time.perf_counter() - t0) / reps / n
+        out[str(n)] = round(per_sig * 1e6, 1)
+    return out
+
+
 def bench_device_rtt():
     import jax
     import jax.numpy as jnp
@@ -394,6 +436,12 @@ def main() -> None:
     except Exception as e:  # pragma: no cover - keep the primary line
         light_rate = None
         light_err = repr(e)
+    try:
+        curve = bench_batch_curve(
+            sizes=(1, 8) if fallback else (1, 8, 64, 1024)
+        )
+    except Exception as e:  # pragma: no cover
+        curve = {"error": repr(e)}
     print(
         json.dumps(
             {
@@ -421,6 +469,7 @@ def main() -> None:
                     "light_sync_headers_per_s_150vals": (
                         round(light_rate, 2) if light_rate else light_err
                     ),
+                    "batch_verify_us_per_sig_by_batch": curve,
                 },
             }
         )

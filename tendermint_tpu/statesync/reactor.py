@@ -345,6 +345,11 @@ class StatesyncReactor(Service):
                 self.logger.info(
                     "re-discovering snapshots", attempt=discovery_rounds
                 )
+                # transiently-rejected snapshots (e.g. light blocks at
+                # h+1/h+2 didn't exist yet) may verify now that the
+                # chain has advanced; the bounded round count keeps a
+                # permanently-bad snapshot from looping forever
+                self._rejected.clear()
                 self.snapshot_ch.try_send(
                     Envelope(
                         message=SnapshotsRequestMessage(), broadcast=True
