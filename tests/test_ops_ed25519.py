@@ -82,3 +82,58 @@ def test_noncanonical_y_zip215_accepted(verifier):
 
 def test_empty_batch(verifier):
     assert verifier.verify([], [], []).tolist() == []
+
+
+def test_small_order_points_match_oracle(verifier):
+    """Cofactor-sensitive edge class: small-order encodings for A and R
+    (identity, y=-1 order 2, y=0 order 4). ZIP-215's cofactored
+    equation accepts combinations a cofactorless verifier rejects; the
+    kernel must agree with the pure-Python oracle bit for bit
+    (reference semantics: crypto/ed25519/ed25519.go:27-29)."""
+    ident = bytes([1]) + bytes(31)                    # y=1, order 1
+    y_minus1 = int(em.P - 1).to_bytes(32, "little")   # y=-1, order 2
+    y0_a = bytes(32)                                  # y=0, order 4
+    y0_b = bytes(31) + bytes([0x80])                  # y=0, other root
+    small = [ident, y_minus1, y0_a, y0_b]
+    # order-8 torsion, derived not hard-coded: [L]P of an arbitrary
+    # curve point lands in the 8-torsion; keep the order-8 ones.
+    # Without these, [4]P == identity for every case and an off-by-one
+    # in the kernel's cofactor-doubling loop would go unnoticed.
+    for y in range(2, 200):
+        pt = em.decompress(int(y).to_bytes(32, "little"))
+        if pt is None:
+            continue
+        t = em.scalar_mult(em.L, pt)
+        if (
+            em.compress(em.scalar_mult(4, t)) != ident
+            and em.compress(em.scalar_mult(8, t)) == ident
+        ):
+            enc = em.compress(t)
+            small.append(enc)  # order-8 point
+            small.append(enc[:31] + bytes([enc[31] ^ 0x80]))  # its negation
+            break
+    assert len(small) == 6, "order-8 torsion point not found"
+
+    msg = b"small-order"
+    cases = []
+    # small-order A with R = small-order and S in {0, 1}
+    for a in small:
+        for r in small:
+            for s_int in (0, 1):
+                sig = r + int(s_int).to_bytes(32, "little")
+                cases.append((a, msg, sig))
+    # valid honest signature but R replaced by a small-order point
+    priv = PrivKeyEd25519.from_seed(b"\x77" * 32)
+    pk = priv.pub_key().bytes()
+    honest = priv.sign(msg)
+    for r in small:
+        cases.append((pk, msg, r + honest[32:]))
+
+    pks = [c[0] for c in cases]
+    msgs = [c[1] for c in cases]
+    sigs = [c[2] for c in cases]
+    got = verifier.verify(pks, msgs, sigs)
+    expect = [em.zip215_verify(p, m, s) for p, m, s in cases]
+    assert list(got) == expect, list(zip(got, expect))
+    # sanity: at least one cofactored acceptance exists in this set
+    assert any(expect), "expected some small-order case to verify"
