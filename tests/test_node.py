@@ -178,7 +178,15 @@ def test_two_validator_localnet_tcp(tmp_path):
         ]
         genesis = make_genesis(privs)
         cfgs = []
-        ports = [36656, 36657]
+        # pick free ports (fixed ones collide with concurrent runs)
+        import socket
+
+        socks = [socket.socket() for _ in range(2)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
         for i in range(2):
             cfg = make_home(tmp_path, i, genesis, privs[i])
             cfg.p2p.laddr = f"127.0.0.1:{ports[i]}"
