@@ -239,6 +239,7 @@ class Node(Service):
         self.statesync_reactor = None
         self.pex_reactor = None
         self.rpc_server = None
+        self.rpc_env = None
         self.genesis_state_synced = False
 
     # ------------------------------------------------------------------
@@ -413,29 +414,32 @@ class Node(Service):
         if self.pex_reactor is not None:
             await self.pex_reactor.start()
 
-        # -- RPC (reference: node/node.go:480-540 startRPC) --
-        if cfg.rpc.laddr:
-            from ..rpc import Environment, RPCServer
+        # -- RPC (reference: node/node.go:480-540 startRPC). The
+        # Environment always exists — in-process consumers
+        # (rpc.LocalClient) need it even when the network listener is
+        # disabled; only the server is gated on rpc.laddr --
+        from ..rpc import Environment, RPCServer
 
-            env = Environment(
-                chain_id=self.genesis.chain_id,
-                block_store=self.block_store,
-                state_store=self.state_store,
-                mempool=self.mempool,
-                event_bus=self.event_bus,
-                consensus=self.consensus,
-                consensus_reactor=self.consensus_reactor,
-                peer_manager=self.peer_manager,
-                proxy=self.proxy,
-                genesis=self.genesis,
-                evidence_pool=self.evidence_pool,
-                event_sinks=self.indexer.sinks,
-                node_info=self.node_info,
-                privval_pub_key=self.privval_pub_key,
-                cfg=cfg,
-            )
+        self.rpc_env = Environment(
+            chain_id=self.genesis.chain_id,
+            block_store=self.block_store,
+            state_store=self.state_store,
+            mempool=self.mempool,
+            event_bus=self.event_bus,
+            consensus=self.consensus,
+            consensus_reactor=self.consensus_reactor,
+            peer_manager=self.peer_manager,
+            proxy=self.proxy,
+            genesis=self.genesis,
+            evidence_pool=self.evidence_pool,
+            event_sinks=self.indexer.sinks,
+            node_info=self.node_info,
+            privval_pub_key=self.privval_pub_key,
+            cfg=cfg,
+        )
+        if cfg.rpc.laddr:
             self.rpc_server = RPCServer(
-                env,
+                self.rpc_env,
                 laddr=cfg.rpc.laddr,
                 max_body_bytes=cfg.rpc.max_body_bytes,
             )

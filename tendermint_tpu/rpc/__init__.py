@@ -4,7 +4,12 @@ reference: rpc/ (jsonrpc machinery + clients), internal/rpc/core
 (method implementations), node/node.go:480-540 (server startup).
 """
 
-from .client import HTTPClient, RPCClientError, WSClient  # noqa: F401
+from .client import (  # noqa: F401
+    HTTPClient,
+    LocalClient,
+    RPCClientError,
+    WSClient,
+)
 from .core import Environment  # noqa: F401
 from .jsonrpc import JSONRPCServer, RPCError, RPCRequest  # noqa: F401
 from .server import RPCServer  # noqa: F401

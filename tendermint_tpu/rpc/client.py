@@ -17,7 +17,7 @@ import os
 import struct
 from typing import Any, Dict, Optional
 
-__all__ = ["RPCClientError", "HTTPClient", "WSClient"]
+__all__ = ["RPCClientError", "HTTPClient", "LocalClient", "WSClient"]
 
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
@@ -299,3 +299,54 @@ class WSClient:
         """Next pushed subscription event's `result` object."""
         obj = await asyncio.wait_for(self._events.get(), timeout)
         return obj.get("result")
+
+
+class LocalClient:
+    """In-process client: calls the node's RPC handlers directly
+    against its Environment — same surface as HTTPClient.call but no
+    network hop (reference: rpc/client/local/local.go). Websocket-only
+    methods (subscribe/unsubscribe) are not supported here; in-process
+    consumers subscribe on the event bus directly."""
+
+    def __init__(self, env) -> None:
+        self._routes = env.routes()
+        self._ids = itertools.count(1)
+
+    @classmethod
+    def from_node(cls, node) -> "LocalClient":
+        if node.rpc_env is None:
+            raise RPCClientError(
+                "node has no RPC environment (rpc.laddr disabled "
+                "or node not started)"
+            )
+        return cls(node.rpc_env)
+
+    async def call(self, method: str, **params: Any) -> Any:
+        from .jsonrpc import (
+            INTERNAL_ERROR,
+            INVALID_PARAMS,
+            RPCError,
+            RPCRequest,
+        )
+
+        handler = self._routes.get(method)
+        if handler is None:
+            raise RPCClientError(f"unknown method {method!r}")
+        if method in ("subscribe", "unsubscribe", "unsubscribe_all"):
+            raise RPCClientError(
+                f"{method} requires a websocket; use the event bus "
+                "for in-process subscriptions"
+            )
+        req = RPCRequest(
+            method=method, params=dict(params), req_id=next(self._ids)
+        )
+        # mirror the server's error mapping (jsonrpc._dispatch) so a
+        # caller written against HTTPClient sees identical failures
+        try:
+            return await handler(req)
+        except RPCError as e:
+            raise RPCClientError(e.message, code=e.code) from e
+        except (TypeError, ValueError, KeyError) as e:
+            raise RPCClientError(str(e), code=INVALID_PARAMS) from e
+        except Exception as e:
+            raise RPCClientError(repr(e), code=INTERNAL_ERROR) from e

@@ -311,3 +311,34 @@ def test_uri_get_and_batch_post(tmp_path):
             await node.stop()
 
     run(go())
+
+
+def test_local_client_matches_http(tmp_path):
+    """LocalClient (in-process, no network hop) serves the same route
+    surface and answers as the HTTP client (reference:
+    rpc/client/local/local.go)."""
+    from tendermint_tpu.rpc import LocalClient, RPCClientError
+
+    async def go():
+        node, addr = await _boot(tmp_path)
+        http = HTTPClient(addr)
+        local = LocalClient.from_node(node)
+        try:
+            await node.consensus.wait_for_height(2, timeout=60.0)
+            h_status = await http.call("status")
+            l_status = await local.call("status")
+            assert l_status["node_info"] == h_status["node_info"]
+            assert l_status["validator_info"] == h_status["validator_info"]
+            l_block = await local.call("block", height=1)
+            h_block = await http.call("block", height=1)
+            assert l_block["block_id"] == h_block["block_id"]
+            assert await local.call("health") == {}
+            with pytest.raises(RPCClientError, match="websocket"):
+                await local.call("subscribe", query="tm.event='NewBlock'")
+            with pytest.raises(RPCClientError, match="unknown method"):
+                await local.call("nope")
+        finally:
+            await http.close()
+            await node.stop()
+
+    run(go())
