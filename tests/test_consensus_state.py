@@ -200,11 +200,15 @@ class RelayNet:
 
     def __init__(self, nodes):
         self.nodes = nodes
+        self.drop = lambda msg: False  # gossip fault injection hook
+
         for i, n in enumerate(nodes):
             orig = n.cs._send_internal
 
             def relayed(msg, _i=i, _orig=orig):
                 _orig(msg)
+                if self.drop(msg):
+                    return
                 for j, other in enumerate(self.nodes):
                     if j != _i:
                         other.cs.send_peer_msg(msg, peer_id=f"node{_i}")
@@ -246,5 +250,148 @@ def test_four_validators_reach_consensus():
             for h in range(1, 4)
         }
         assert len(proposers) >= 2
+
+    run(go())
+
+
+def test_dropped_proposal_forces_nil_round_then_commit():
+    """If height H's round-0 proposal never reaches the other
+    validators, they prevote/precommit nil, move to round 1, and commit
+    there (reference: state_test.go TestStateFullRoundNil + the
+    round-progression cells)."""
+
+    async def go():
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([i + 90]) * 32)
+            for i in range(4)
+        ]
+        genesis = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10)
+                for p in privs
+            ],
+        )
+        nodes = [Node(p, genesis) for p in privs]
+        net = RelayNet(nodes)
+
+        from tendermint_tpu.consensus.msgs import (
+            BlockPartMessage,
+            ProposalMessage,
+        )
+
+        target_height = 2
+
+        def drop(msg) -> bool:
+            # suppress gossip of height-2 round-0 proposal + parts
+            if isinstance(msg, ProposalMessage):
+                p = msg.proposal
+                return p.height == target_height and p.round == 0
+            if isinstance(msg, BlockPartMessage):
+                return msg.height == target_height and msg.round == 0
+            return False
+
+        net.drop = drop
+        for n in nodes:
+            await n.cs.start()
+        try:
+            await asyncio.gather(
+                *(
+                    n.cs.wait_for_height(target_height + 2, timeout=60.0)
+                    for n in nodes
+                )
+            )
+        finally:
+            for n in nodes:
+                await n.cs.stop()
+
+        commit = nodes[0].block_store.load_block_commit(target_height)
+        assert commit.round >= 1, (
+            f"height {target_height} committed in round {commit.round}; "
+            "the dropped proposal should have forced a nil round"
+        )
+        # other heights unaffected
+        assert nodes[0].block_store.load_block_commit(1).round == 0
+        hashes = {
+            n.block_store.load_block(target_height).hash() for n in nodes
+        }
+        assert len(hashes) == 1
+
+    run(go())
+
+
+def test_invalid_proposal_prevoted_nil_and_skipped():
+    """A proposer whose block fails ValidateBlock (wrong app_hash) gets
+    nil prevotes from honest validators; the height commits under a
+    later round's proposer and the chain continues (reference:
+    state_test.go TestStateBadProposal)."""
+
+    async def go():
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([i + 110]) * 32)
+            for i in range(4)
+        ]
+        genesis = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10)
+                for p in privs
+            ],
+        )
+        nodes = [Node(p, genesis) for p in privs]
+        RelayNet(nodes)
+
+        # every node, when proposing at height 2, produces a block with
+        # a corrupted app_hash — all validators (including itself on
+        # revalidation) reject it, so height 2 can only commit once the
+        # corruption window is past (we stop corrupting after round 1)
+        bad_heights = {2}
+        for n in nodes:
+            orig_create = n.exec.create_proposal_block
+
+            def create(
+                height, state, commit, proposer,
+                _orig=orig_create,
+            ):
+                block, part_set = _orig(height, state, commit, proposer)
+                if height in bad_heights:
+                    block.header.app_hash = b"\xbd" * 32
+                    block.fill_header()
+                    part_set = block.make_part_set()
+                return block, part_set
+            n.exec.create_proposal_block = create
+
+        for n in nodes:
+            await n.cs.start()
+        try:
+            # let height 2 churn one bad round, then lift the corruption
+            deadline = asyncio.get_event_loop().time() + 30.0
+            while nodes[0].cs.rs.height < 2:
+                await asyncio.sleep(0.05)
+                assert (
+                    asyncio.get_event_loop().time() < deadline
+                ), "never reached height 2"
+            while nodes[0].cs.rs.round < 1:
+                await asyncio.sleep(0.05)
+                if asyncio.get_event_loop().time() > deadline:
+                    break
+            bad_heights.clear()
+            await asyncio.gather(
+                *(n.cs.wait_for_height(4, timeout=60.0) for n in nodes)
+            )
+        finally:
+            for n in nodes:
+                await n.cs.stop()
+
+        commit = nodes[0].block_store.load_block_commit(2)
+        assert commit.round >= 1, (
+            "bad proposal at height 2 should have burned round 0, "
+            f"got commit round {commit.round}"
+        )
+        for h in range(1, 4):
+            hashes = {n.block_store.load_block(h).hash() for n in nodes}
+            assert len(hashes) == 1
 
     run(go())
