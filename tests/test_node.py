@@ -216,3 +216,34 @@ def test_two_validator_localnet_tcp(tmp_path):
                 await n.stop()
 
     run(go())
+
+
+def test_app_retain_height_prunes_block_store(tmp_path):
+    """The app's ResponseCommit.retain_height drives live block-store
+    pruning during consensus (reference: state/execution.go Commit →
+    pruneBlocks; kvstore retain_blocks knob)."""
+    from tendermint_tpu.abci import KVStoreApplication
+
+    async def go():
+        priv = PrivKeyEd25519.from_seed(b"\x2b" * 32)
+        genesis = make_genesis([priv])
+        cfg = make_home(tmp_path, 0, genesis, priv)
+        node = make_node(
+            cfg,
+            app=KVStoreApplication(retain_blocks=3),
+            genesis=genesis,
+        )
+        await node.start()
+        try:
+            await node.consensus.wait_for_height(8, timeout=120.0)
+            base = node.block_store.base()
+            assert base >= 4, f"expected pruning to advance base, got {base}"
+            assert node.block_store.load_block(1) is None
+            assert node.block_store.load_block(base) is not None
+            # consensus still advances after pruning
+            tip = node.block_store.height()
+            await node.consensus.wait_for_height(tip + 2, timeout=60.0)
+        finally:
+            await node.stop()
+
+    run(go())
