@@ -388,15 +388,21 @@ def cmd_light(args) -> int:
 def cmd_inspect(args) -> int:
     """Read-only RPC over a STOPPED node's data directories."""
     cfg = _load_home(args.home)
+    # hold the advisory lock for inspect's whole lifetime: a node
+    # (or reset/rollback) starting mid-serve must fail fast, not
+    # mutate the stores underneath us. Only the lock acquisition maps
+    # to the one-line refusal; serve-time errors propagate with their
+    # tracebacks.
+    guard = _ensure_node_stopped(cfg)
     try:
-        # hold the advisory lock for inspect's whole lifetime: a node
-        # (or reset/rollback) starting mid-serve must fail fast, not
-        # mutate the stores underneath us
-        with _ensure_node_stopped(cfg):
-            return _inspect_serve(cfg, args)
+        guard.__enter__()
     except RuntimeError as e:
         print(str(e), file=sys.stderr)
         return 1
+    try:
+        return _inspect_serve(cfg, args)
+    finally:
+        guard.__exit__(None, None, None)
 
 
 def _inspect_serve(cfg: Config, args) -> int:
@@ -644,20 +650,6 @@ def cmd_version(args) -> int:
     return 0
 
 
-def _check_lock_free(cfg: Config) -> None:
-    """Read-only guard: refuse when a running node holds the LOCK, but
-    take no lock of our own (inspect serves indefinitely)."""
-    from ..node.node import _pid_alive, _read_lock_pid
-
-    lock = os.path.join(cfg.base.path(cfg.base.db_dir), "LOCK")
-    pid = _read_lock_pid(lock)
-    if pid and pid != os.getpid() and _pid_alive(pid):
-        raise RuntimeError(
-            f"node appears to be running (pid {pid}, lock {lock}); "
-            "stop it first"
-        )
-
-
 class _ensure_node_stopped:
     """Context manager for offline data-dir commands: refuse when a
     RUNNING node holds the advisory LOCK, and hold the lock ourselves
@@ -673,7 +665,14 @@ class _ensure_node_stopped:
         self._took = False
 
     def __enter__(self) -> "_ensure_node_stopped":
-        _check_lock_free(self.cfg)
+        from ..node.node import _pid_alive, _read_lock_pid
+
+        pid = _read_lock_pid(self.lock)
+        if pid and pid != os.getpid() and _pid_alive(pid):
+            raise RuntimeError(
+                f"node appears to be running (pid {pid}, lock "
+                f"{self.lock}); stop it first"
+            )
         os.makedirs(os.path.dirname(self.lock), exist_ok=True)
         with open(self.lock, "w") as f:
             f.write(str(os.getpid()))

@@ -344,6 +344,11 @@ class Ed25519Verifier:
         )
 
     def _program(self, size: int):
+        """The compiled program for a bucket. One shape-polymorphic
+        jitted function serves every bucket (jit caches per shape
+        internally); the per-size dict exists for overrides — the
+        Pallas fallback swap in dispatch() and ShardedEd25519Verifier's
+        per-bucket sharded programs."""
         fn = self._compiled.get(size)
         if fn is None:
             if self._pallas_wanted():
@@ -351,7 +356,7 @@ class Ed25519Verifier:
 
                 fn = verify_pallas
             else:
-                fn = jax.jit(_verify_tile)
+                fn = _jit_verify_tile()
             self._compiled[size] = fn
         return fn
 
@@ -432,7 +437,7 @@ class Ed25519Verifier:
                 bucket,
                 e,
             )
-            fn = jax.jit(_verify_tile)
+            fn = _jit_verify_tile()
             self._compiled[bucket] = fn
             ok = fn(
                 jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
@@ -445,6 +450,18 @@ class Ed25519Verifier:
         if ok is None:
             return size_ok
         return np.asarray(ok)[:n] & size_ok
+
+
+_JIT_VERIFY = None
+
+
+def _jit_verify_tile():
+    """Shared jitted XLA program (shape-polymorphic; compiles once per
+    bucket shape inside jax's own cache)."""
+    global _JIT_VERIFY
+    if _JIT_VERIFY is None:
+        _JIT_VERIFY = jax.jit(_verify_tile)
+    return _JIT_VERIFY
 
 
 _DEFAULT: Optional[Ed25519Verifier] = None
