@@ -645,6 +645,56 @@ def _capture_device_profile(tar, n: int = 256) -> dict:
     }
 
 
+def cmd_e2e(args) -> int:
+    """Manifest-driven e2e testnets from the command line (reference:
+    the test/e2e runner + generator binaries)."""
+    from ..e2e import Manifest, generate, run_manifest
+
+    if args.e2e_cmd == "generate":
+        if args.manifest:
+            print(
+                "e2e generate takes no manifest argument",
+                file=sys.stderr,
+            )
+            return 1
+        out = os.path.expanduser(args.output_dir)
+        os.makedirs(out, exist_ok=True)
+        for i, m in enumerate(generate(seed=args.seed, count=args.count)):
+            path = os.path.join(out, f"gen-{args.seed}-{i}.toml")
+            with open(path, "w") as f:
+                f.write(m.to_toml())
+            print(path)
+        return 0
+    # run
+    if not args.manifest:
+        print("e2e run requires a manifest path", file=sys.stderr)
+        return 1
+    m = Manifest.from_toml(os.path.expanduser(args.manifest))
+    import tempfile
+
+    home = args.home_dir or tempfile.mkdtemp(prefix="tt-e2e-")
+    print(f"running {m.chain_id}: {len(m.nodes)} nodes -> {home}")
+    rep = run_manifest(m, home, timeout=args.timeout)
+    print(
+        json.dumps(
+            {
+                "ok": rep.ok,
+                "reached_height": rep.reached_height,
+                "blocks": rep.blocks,
+                "block_interval_avg_s": round(rep.interval_avg, 3),
+                "block_interval_stddev_s": round(rep.interval_stddev, 3),
+                "txs_submitted": rep.txs_submitted,
+                "txs_committed": rep.txs_committed,
+                "evidence_heights": rep.evidence_heights,
+                "state_synced": rep.state_synced,
+                "failures": rep.failures,
+            },
+            indent=2,
+        )
+    )
+    return 0 if rep.ok else 1
+
+
 def cmd_version(args) -> int:
     print(_version.__version__)
     return 0
@@ -1050,6 +1100,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="live /metrics endpoint to scrape into the bundle",
     )
     sp.set_defaults(fn=cmd_debug_dump)
+
+    sp = sub.add_parser(
+        "e2e", help="run or generate manifest-driven e2e testnets"
+    )
+    sp.add_argument("e2e_cmd", choices=["run", "generate"])
+    sp.add_argument("manifest", nargs="?", default="")
+    sp.add_argument("--home-dir", default="")
+    sp.add_argument("--timeout", type=float, default=240.0)
+    sp.add_argument("--seed", type=int, default=1)
+    sp.add_argument("--count", type=int, default=4)
+    sp.add_argument("--output-dir", "-o", default="./e2e-manifests")
+    sp.set_defaults(fn=cmd_e2e)
 
     sp = sub.add_parser("version", help="print the version")
     sp.set_defaults(fn=cmd_version)

@@ -162,3 +162,47 @@ class Manifest:
 
     def sorted_nodes(self) -> List[Tuple[str, NodeSpec]]:
         return sorted(self.nodes.items())
+
+    def to_toml(self) -> str:
+        """Serialize back to the TOML shape from_toml reads (tomllib is
+        read-only, so this is the writer half — kept next to the reader
+        so the two halves of the format evolve together)."""
+        lines = [
+            f'chain_id = "{self.chain_id}"',
+            f"initial_height = {self.initial_height}",
+            f"target_height = {self.target_height}",
+            "",
+            "[validators]",
+        ]
+        for name, power in sorted(self.validators.items()):
+            lines.append(f"{name} = {power}")
+        for name, spec in self.sorted_nodes():
+            lines += [
+                "",
+                f"[node.{name}]",
+                f'mode = "{spec.mode}"',
+                f'database = "{spec.database}"',
+            ]
+            if spec.start_at:
+                lines.append(f"start_at = {spec.start_at}")
+            if spec.state_sync:
+                lines.append("state_sync = true")
+            if spec.perturb:
+                entries = ", ".join(
+                    f'"{p.action}:{p.height}"' for p in spec.perturb
+                )
+                lines.append(f"perturb = [{entries}]")
+            if spec.misbehaviors:
+                entries = ", ".join(
+                    f"{k} = {v}"
+                    for k, v in sorted(spec.misbehaviors.items())
+                )
+                lines.append(f"misbehaviors = {{ {entries} }}")
+        if self.load.tx_rate:
+            lines += [
+                "",
+                "[load]",
+                f"tx_rate = {self.load.tx_rate}",
+                f"tx_size = {self.load.tx_size}",
+            ]
+        return "\n".join(lines) + "\n"
