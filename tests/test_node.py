@@ -247,3 +247,80 @@ def test_app_retain_height_prunes_block_store(tmp_path):
             await node.stop()
 
     run(go())
+
+
+def test_validator_joins_live_and_signs(tmp_path):
+    """A node not in genesis is granted power by a validator-update tx
+    mid-chain, and then actively signs commits (reference:
+    state_test.go TestValSetChanges family + the e2e validator-update
+    manifests)."""
+
+    async def go():
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([i + 140]) * 32)
+            for i in range(2)
+        ]
+        joiner_priv = PrivKeyEd25519.from_seed(b"\x8f" * 32)
+        genesis = make_genesis(privs)  # joiner NOT in genesis
+        net = MemoryNetwork()
+        cfgs = []
+        all_privs = privs + [joiner_priv]
+        for i, p in enumerate(all_privs):
+            cfg = make_home(tmp_path, i, genesis, p)
+            cfg.p2p.laddr = f"node{i}:26656"
+            cfgs.append(cfg)
+        node_ids = [
+            NodeKey.load_or_generate(
+                c.base.path(c.base.node_key_file)
+            ).node_id
+            for c in cfgs
+        ]
+        for i, cfg in enumerate(cfgs):
+            cfg.p2p.persistent_peers = ",".join(
+                f"{node_ids[j]}@node{j}:26656"
+                for j in range(3)
+                if j != i
+            )
+        nodes = [
+            make_node(c, transport=MemoryTransport(net, f"node{i}:26656"))
+            for i, c in enumerate(cfgs)
+        ]
+        for n in nodes:
+            await n.start()
+        try:
+            await nodes[0].consensus.wait_for_height(2, timeout=60.0)
+            # grant the joiner power via the kvstore validator tx
+            pk_hex = joiner_priv.pub_key().bytes().hex()
+            await nodes[0].mempool.check_tx(f"val:{pk_hex}!5".encode())
+            joiner_addr = joiner_priv.pub_key().address()
+
+            deadline = time.monotonic() + 120.0
+            signed = False
+            while time.monotonic() < deadline and not signed:
+                await asyncio.sleep(0.3)
+                store = nodes[0].block_store
+                for h in range(2, store.height() + 1):
+                    commit = store.load_block_commit(h)
+                    if commit is None:
+                        continue
+                    for sig in commit.signatures:
+                        if (
+                            sig.validator_address == joiner_addr
+                            and sig.is_for_block()
+                        ):
+                            signed = True
+            assert signed, "joiner never signed a commit"
+            # and the joiner's own chain agrees with the originals
+            h = min(
+                nodes[0].block_store.height(),
+                nodes[2].block_store.height(),
+            ) - 1
+            assert (
+                nodes[0].block_store.load_block(h).hash()
+                == nodes[2].block_store.load_block(h).hash()
+            )
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    run(go())
