@@ -118,6 +118,32 @@ def test_node_restart_handshake_resumes(tmp_path):
     run(go())
 
 
+def make_mesh(tmp_path, genesis, privs, net):
+    """Full-mesh make_node nodes over memory transports: homes, node
+    keys, persistent peers, transports."""
+    cfgs = []
+    for i, p in enumerate(privs):
+        cfg = make_home(tmp_path, i, genesis, p)
+        cfg.p2p.laddr = f"node{i}:26656"
+        cfgs.append(cfg)
+    node_ids = [
+        NodeKey.load_or_generate(
+            c.base.path(c.base.node_key_file)
+        ).node_id
+        for c in cfgs
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.p2p.persistent_peers = ",".join(
+            f"{node_ids[j]}@node{j}:26656"
+            for j in range(len(cfgs))
+            if j != i
+        )
+    return [
+        make_node(c, transport=MemoryTransport(net, f"node{i}:26656"))
+        for i, c in enumerate(cfgs)
+    ]
+
+
 def test_four_validator_localnet_memory(tmp_path):
     """4 make_node validators over memory transports produce blocks
     together, with commit verification running through the installed
@@ -130,26 +156,8 @@ def test_four_validator_localnet_memory(tmp_path):
         ]
         genesis = make_genesis(privs)
         net = MemoryNetwork()
-        cfgs, nodes = [], []
-        for i in range(4):
-            cfg = make_home(tmp_path, i, genesis, privs[i])
-            cfg.p2p.laddr = f"node{i}:26656"
-            cfgs.append(cfg)
-        # full mesh via persistent peers: need node IDs up front
-        node_ids = [
-            NodeKey.load_or_generate(
-                c.base.path(c.base.node_key_file)
-            ).node_id
-            for c in cfgs
-        ]
-        for i, cfg in enumerate(cfgs):
-            cfg.p2p.persistent_peers = ",".join(
-                f"{node_ids[j]}@node{j}:26656" for j in range(4) if j != i
-            )
         sigs_before = tpu_verifier.stats()["sigs"]
-        for i, cfg in enumerate(cfgs):
-            transport = MemoryTransport(net, f"node{i}:26656")
-            nodes.append(make_node(cfg, transport=transport))
+        nodes = make_mesh(tmp_path, genesis, privs, net)
         for n in nodes:
             await n.start()
         try:
@@ -263,52 +271,37 @@ def test_validator_joins_live_and_signs(tmp_path):
         joiner_priv = PrivKeyEd25519.from_seed(b"\x8f" * 32)
         genesis = make_genesis(privs)  # joiner NOT in genesis
         net = MemoryNetwork()
-        cfgs = []
-        all_privs = privs + [joiner_priv]
-        for i, p in enumerate(all_privs):
-            cfg = make_home(tmp_path, i, genesis, p)
-            cfg.p2p.laddr = f"node{i}:26656"
-            cfgs.append(cfg)
-        node_ids = [
-            NodeKey.load_or_generate(
-                c.base.path(c.base.node_key_file)
-            ).node_id
-            for c in cfgs
-        ]
-        for i, cfg in enumerate(cfgs):
-            cfg.p2p.persistent_peers = ",".join(
-                f"{node_ids[j]}@node{j}:26656"
-                for j in range(3)
-                if j != i
-            )
-        nodes = [
-            make_node(c, transport=MemoryTransport(net, f"node{i}:26656"))
-            for i, c in enumerate(cfgs)
-        ]
+        nodes = make_mesh(tmp_path, genesis, privs + [joiner_priv], net)
         for n in nodes:
             await n.start()
         try:
             await nodes[0].consensus.wait_for_height(2, timeout=60.0)
             # grant the joiner power via the kvstore validator tx
             pk_hex = joiner_priv.pub_key().bytes().hex()
-            await nodes[0].mempool.check_tx(f"val:{pk_hex}!5".encode())
+            res = await nodes[0].mempool.check_tx(
+                f"val:{pk_hex}!5".encode()
+            )
+            assert res.is_ok, res.log  # fail fast on tx rejection
             joiner_addr = joiner_priv.pub_key().address()
 
             deadline = time.monotonic() + 120.0
             signed = False
+            scanned = 1  # incremental: never rescan old commits
             while time.monotonic() < deadline and not signed:
                 await asyncio.sleep(0.3)
                 store = nodes[0].block_store
-                for h in range(2, store.height() + 1):
+                for h in range(scanned + 1, store.height() + 1):
                     commit = store.load_block_commit(h)
                     if commit is None:
-                        continue
-                    for sig in commit.signatures:
-                        if (
-                            sig.validator_address == joiner_addr
-                            and sig.is_for_block()
-                        ):
-                            signed = True
+                        break
+                    scanned = h
+                    if any(
+                        sig.validator_address == joiner_addr
+                        and sig.is_for_block()
+                        for sig in commit.signatures
+                    ):
+                        signed = True
+                        break
             assert signed, "joiner never signed a commit"
             # and the joiner's own chain agrees with the originals
             h = min(
