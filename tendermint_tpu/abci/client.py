@@ -83,6 +83,57 @@ class ABCIClient(Service):
         raise NotImplementedError
 
 
+class _RequestForwardingClient(ABCIClient):
+    """Per-method wrappers shared by clients that funnel every call
+    through one async ``_request(req)`` (socket and gRPC transports) —
+    a new ABCI method is added here once, not per transport."""
+
+    async def _request(self, req):
+        raise NotImplementedError
+
+    async def echo(self, message: str) -> T.ResponseEcho:
+        return await self._request(T.RequestEcho(message=message))
+
+    async def flush(self) -> None:
+        await self._request(T.RequestFlush())
+
+    async def info(self, req):
+        return await self._request(req)
+
+    async def query(self, req):
+        return await self._request(req)
+
+    async def check_tx(self, req):
+        return await self._request(req)
+
+    async def init_chain(self, req):
+        return await self._request(req)
+
+    async def begin_block(self, req):
+        return await self._request(req)
+
+    async def deliver_tx(self, req):
+        return await self._request(req)
+
+    async def end_block(self, req):
+        return await self._request(req)
+
+    async def commit(self):
+        return await self._request(T.RequestCommit())
+
+    async def list_snapshots(self, req):
+        return await self._request(req)
+
+    async def offer_snapshot(self, req):
+        return await self._request(req)
+
+    async def load_snapshot_chunk(self, req):
+        return await self._request(req)
+
+    async def apply_snapshot_chunk(self, req):
+        return await self._request(req)
+
+
 class LocalClient(ABCIClient):
     """In-process client: direct calls serialized by one lock
     (reference: abci/client/local_client.go)."""
@@ -139,7 +190,7 @@ class LocalClient(ABCIClient):
         return await self._call(self.app.apply_snapshot_chunk, req)
 
 
-class SocketClient(ABCIClient):
+class SocketClient(_RequestForwardingClient):
     """Out-of-process client over a varint-framed byte stream.
 
     Requests are written in order; the server answers in order, so
@@ -215,48 +266,6 @@ class SocketClient(ABCIClient):
             self._writer.write(encode_varint(len(body)) + body)
             await self._writer.drain()
         return await fut
-
-    async def echo(self, message: str) -> T.ResponseEcho:
-        return await self._request(T.RequestEcho(message=message))
-
-    async def flush(self) -> None:
-        await self._request(T.RequestFlush())
-
-    async def info(self, req):
-        return await self._request(req)
-
-    async def query(self, req):
-        return await self._request(req)
-
-    async def check_tx(self, req):
-        return await self._request(req)
-
-    async def init_chain(self, req):
-        return await self._request(req)
-
-    async def begin_block(self, req):
-        return await self._request(req)
-
-    async def deliver_tx(self, req):
-        return await self._request(req)
-
-    async def end_block(self, req):
-        return await self._request(req)
-
-    async def commit(self):
-        return await self._request(T.RequestCommit())
-
-    async def list_snapshots(self, req):
-        return await self._request(req)
-
-    async def offer_snapshot(self, req):
-        return await self._request(req)
-
-    async def load_snapshot_chunk(self, req):
-        return await self._request(req)
-
-    async def apply_snapshot_chunk(self, req):
-        return await self._request(req)
 
 
 async def _open(address: str):

@@ -1,0 +1,160 @@
+"""gRPC ABCI transport — the reference's third client/server variant
+(reference: abci/client/grpc_client.go, abci/server/grpc_server.go).
+
+One unary-unary method, ``/tendermint_tpu.abci.ABCI/Process``, carries
+the same deterministic request/response envelopes the socket transport
+frames (abci/codec.py encode_request/encode_response), so no generated
+stubs are needed: both ends register the method with identity
+(de)serializers and speak raw envelope bytes. Semantics match the
+socket pair — requests answered in order per connection, the
+application guarded by one lock (the 4-connection proxy provides the
+cross-subsystem concurrency boundary, abci/proxy.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import grpc
+from grpc import aio as grpc_aio
+
+from ..libs.log import get_logger
+from ..libs.service import Service
+from . import codec
+from . import types as T
+from .client import (
+    ABCIClientError,
+    ClientCreator,
+    _RequestForwardingClient,
+)
+from .server import dispatch_to_app
+
+__all__ = ["GRPCServer", "GRPCClient", "grpc_creator"]
+
+_SERVICE = "tendermint_tpu.abci.ABCI"
+_METHOD = "Process"
+
+
+def _strip_scheme(address: str) -> str:
+    for scheme in ("grpc://", "tcp://"):
+        if address.startswith(scheme):
+            return address[len(scheme):]
+    return address
+
+
+class GRPCServer(Service):
+    """Serve an Application over gRPC (reference:
+    abci/server/grpc_server.go)."""
+
+    def __init__(self, address: str, app: T.Application) -> None:
+        super().__init__(name="abci.grpc.server",
+                         logger=get_logger("abci.grpc"))
+        self.address = _strip_scheme(address)
+        self.app = app
+        self._app_lock = asyncio.Lock()
+        self._server: Optional[grpc_aio.Server] = None
+        self.bound_port: int = 0
+
+    async def on_start(self) -> None:
+        self._server = grpc_aio.server()
+        rpc = grpc.unary_unary_rpc_method_handler(
+            self._process,
+            request_deserializer=None,  # raw envelope bytes
+            response_serializer=None,
+        )
+        self._server.add_generic_rpc_handlers(
+            (
+                grpc.method_handlers_generic_handler(
+                    _SERVICE, {_METHOD: rpc}
+                ),
+            )
+        )
+        self.bound_port = self._server.add_insecure_port(self.address)
+        if self.bound_port == 0:
+            raise OSError(f"failed to bind gRPC server to {self.address}")
+        await self._server.start()
+        self.logger.info(
+            "abci grpc server listening",
+            addr=self.address,
+            port=self.bound_port,
+        )
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+
+    async def _process(self, request: bytes, context) -> bytes:
+        req = codec.decode_request(request)
+        if isinstance(req, T.RequestEcho):
+            resp = T.ResponseEcho(message=req.message)
+        elif isinstance(req, T.RequestFlush):
+            resp = T.ResponseFlush()
+        else:
+            try:
+                async with self._app_lock:
+                    resp = dispatch_to_app(self.app, req)
+            except Exception as e:
+                # same error contract as the socket server: the app
+                # exception rides back as ResponseException
+                self.logger.error("abci app raised", err=repr(e))
+                resp = T.ResponseException(error=repr(e))
+        return codec.encode_response(resp)
+
+
+class GRPCClient(_RequestForwardingClient):
+    """Out-of-process client over gRPC (reference:
+    abci/client/grpc_client.go). Per-call request/response — gRPC
+    provides the stream multiplexing the socket client hand-rolls."""
+
+    def __init__(self, address: str, must_connect: bool = True) -> None:
+        super().__init__(name="abci.grpc.client")
+        self.address = _strip_scheme(address)
+        self.must_connect = must_connect
+        self._channel: Optional[grpc_aio.Channel] = None
+        self._call = None
+
+    async def on_start(self) -> None:
+        self._channel = grpc_aio.insecure_channel(self.address)
+        self._call = self._channel.unary_unary(
+            f"/{_SERVICE}/{_METHOD}",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        if self.must_connect:
+            try:
+                await self.echo("connected")
+            except BaseException:
+                # a failed start never reaches on_stop: close the
+                # channel here or it leaks its background sockets
+                await self._channel.close()
+                self._channel = None
+                self._call = None
+                raise
+
+    async def on_stop(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+            self._call = None
+
+    async def _request(self, req):
+        if self._call is None:
+            raise ABCIClientError("grpc client not started")
+        payload = codec.encode_request(req)
+        try:
+            data = await self._call(payload)
+        except grpc_aio.AioRpcError as e:
+            raise ABCIClientError(
+                f"grpc: {e.code().name}: {e.details()}"
+            ) from e
+        resp = codec.decode_response(data)
+        if isinstance(resp, T.ResponseException):
+            # same contract as the socket client (client.py recv loop)
+            raise ABCIClientError(f"abci app exception: {resp.error}")
+        return resp
+
+
+def grpc_creator(address: str, must_connect: bool = True) -> ClientCreator:
+    return lambda: GRPCClient(address, must_connect=must_connect)

@@ -80,28 +80,34 @@ class SocketServer(Service):
         if isinstance(req, T.RequestFlush):
             return T.ResponseFlush()
         async with self._app_lock:
-            if isinstance(req, T.RequestInfo):
-                return self.app.info(req)
-            if isinstance(req, T.RequestQuery):
-                return self.app.query(req)
-            if isinstance(req, T.RequestCheckTx):
-                return self.app.check_tx(req)
-            if isinstance(req, T.RequestInitChain):
-                return self.app.init_chain(req)
-            if isinstance(req, T.RequestBeginBlock):
-                return self.app.begin_block(req)
-            if isinstance(req, T.RequestDeliverTx):
-                return self.app.deliver_tx(req)
-            if isinstance(req, T.RequestEndBlock):
-                return self.app.end_block(req)
-            if isinstance(req, T.RequestCommit):
-                return self.app.commit()
-            if isinstance(req, T.RequestListSnapshots):
-                return self.app.list_snapshots(req)
-            if isinstance(req, T.RequestOfferSnapshot):
-                return self.app.offer_snapshot(req)
-            if isinstance(req, T.RequestLoadSnapshotChunk):
-                return self.app.load_snapshot_chunk(req)
-            if isinstance(req, T.RequestApplySnapshotChunk):
-                return self.app.apply_snapshot_chunk(req)
-        raise ValueError(f"unknown ABCI request {type(req).__name__}")
+            return dispatch_to_app(self.app, req)
+
+
+def dispatch_to_app(app: T.Application, req):
+    """Application method dispatch shared by the socket and gRPC
+    servers (echo/flush are transport-level and stay in each server)."""
+    if isinstance(req, T.RequestInfo):
+        return app.info(req)
+    if isinstance(req, T.RequestQuery):
+        return app.query(req)
+    if isinstance(req, T.RequestCheckTx):
+        return app.check_tx(req)
+    if isinstance(req, T.RequestInitChain):
+        return app.init_chain(req)
+    if isinstance(req, T.RequestBeginBlock):
+        return app.begin_block(req)
+    if isinstance(req, T.RequestDeliverTx):
+        return app.deliver_tx(req)
+    if isinstance(req, T.RequestEndBlock):
+        return app.end_block(req)
+    if isinstance(req, T.RequestCommit):
+        return app.commit()
+    if isinstance(req, T.RequestListSnapshots):
+        return app.list_snapshots(req)
+    if isinstance(req, T.RequestOfferSnapshot):
+        return app.offer_snapshot(req)
+    if isinstance(req, T.RequestLoadSnapshotChunk):
+        return app.load_snapshot_chunk(req)
+    if isinstance(req, T.RequestApplySnapshotChunk):
+        return app.apply_snapshot_chunk(req)
+    raise ValueError(f"unknown ABCI request {type(req).__name__}")

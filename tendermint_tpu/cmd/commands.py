@@ -894,8 +894,17 @@ def cmd_abci(args) -> int:
     from ..abci.kvstore import KVStoreApplication
     from ..abci.server import SocketServer
 
+    if args.grpc:
+        from ..abci.grpc_transport import GRPCClient, GRPCServer
+
+        make_server = GRPCServer
+        make_client = GRPCClient
+    else:
+        make_server = SocketServer
+        make_client = SocketClient
+
     async def serve_kvstore():
-        srv = SocketServer(args.addr, KVStoreApplication())
+        srv = make_server(args.addr, KVStoreApplication())
         await srv.start()
         print(f"kvstore app listening on {args.addr}", flush=True)
         try:
@@ -907,7 +916,7 @@ def cmd_abci(args) -> int:
         return 0
 
     async def drive():
-        client = SocketClient(args.addr, must_connect=True)
+        client = make_client(args.addr, must_connect=True)
         await client.start()
         try:
             if args.abci_cmd == "console":
@@ -1054,6 +1063,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("operand", nargs="?", default="")
     sp.add_argument("--addr", default="tcp://127.0.0.1:26658")
     sp.add_argument("--path", default="/store", help="query path")
+    sp.add_argument(
+        "--grpc",
+        action="store_true",
+        help="use the gRPC ABCI transport instead of the socket one",
+    )
     sp.set_defaults(fn=cmd_abci)
 
     sp = sub.add_parser(

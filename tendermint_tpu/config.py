@@ -50,7 +50,7 @@ class BaseConfig:
     log_format: str = "plain"
     genesis_file: str = "config/genesis.json"
     node_key_file: str = "config/node_key.json"
-    abci: str = "builtin"  # builtin | socket
+    abci: str = "builtin"  # builtin | socket | grpc
     proxy_app: str = "kvstore"
 
     def root(self) -> str:

@@ -125,6 +125,11 @@ class Node(Service):
         elif cfg.base.abci == "socket":
             self._app = None
             creator = socket_creator(cfg.base.proxy_app, must_connect=True)
+        elif cfg.base.abci == "grpc":
+            from ..abci.grpc_transport import grpc_creator
+
+            self._app = None
+            creator = grpc_creator(cfg.base.proxy_app, must_connect=True)
         else:
             raise ValueError(f"unknown abci mode {cfg.base.abci!r}")
         self.proxy = AppConns(creator)
