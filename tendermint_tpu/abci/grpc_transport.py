@@ -114,6 +114,11 @@ class GRPCClient(_RequestForwardingClient):
         self.must_connect = must_connect
         self._channel: Optional[grpc_aio.Channel] = None
         self._call = None
+        # gRPC unary calls have no cross-call ordering; the socket
+        # transport's FIFO write/response matching is part of the ABCI
+        # connection contract (mempool recheck vs new check_tx must
+        # reach the app in submission order), so serialize requests.
+        self._order_lock = asyncio.Lock()
 
     async def on_start(self) -> None:
         self._channel = grpc_aio.insecure_channel(self.address)
@@ -144,7 +149,8 @@ class GRPCClient(_RequestForwardingClient):
             raise ABCIClientError("grpc client not started")
         payload = codec.encode_request(req)
         try:
-            data = await self._call(payload)
+            async with self._order_lock:
+                data = await self._call(payload)
         except grpc_aio.AioRpcError as e:
             raise ABCIClientError(
                 f"grpc: {e.code().name}: {e.details()}"

@@ -712,29 +712,25 @@ class _ensure_node_stopped:
         self.lock = os.path.join(
             cfg.base.path(cfg.base.db_dir), "LOCK"
         )
-        self._took = False
+        self._fd: int | None = None
 
     def __enter__(self) -> "_ensure_node_stopped":
-        from ..node.node import _pid_alive, _read_lock_pid
+        from ..node.node import acquire_pid_lock
 
-        pid = _read_lock_pid(self.lock)
-        if pid and pid != os.getpid() and _pid_alive(pid):
+        try:
+            self._fd = acquire_pid_lock(self.lock)
+        except RuntimeError as e:
             raise RuntimeError(
-                f"node appears to be running (pid {pid}, lock "
-                f"{self.lock}); stop it first"
-            )
-        os.makedirs(os.path.dirname(self.lock), exist_ok=True)
-        with open(self.lock, "w") as f:
-            f.write(str(os.getpid()))
-        self._took = True
+                f"node appears to be running ({e}); stop it first"
+            ) from None
         return self
 
     def __exit__(self, *exc) -> None:
-        if self._took:
-            try:
-                os.remove(self.lock)
-            except OSError:
-                pass
+        if self._fd is not None:
+            from ..node.node import release_pid_lock
+
+            release_pid_lock(self.lock, self._fd)
+            self._fd = None
 
 
 def cmd_reindex_event(args) -> int:

@@ -149,6 +149,20 @@ class Manifest:
                 raise ValueError(f"{name} has power but is not a validator")
         for spec in self.nodes.values():
             spec.validate()
+            # schedules past the target leave pending_starts/perturb
+            # queues non-empty, so Runner.run would spin to timeout and
+            # report failure even though the chain converged
+            if spec.start_at > self.target_height:
+                raise ValueError(
+                    f"{spec.name}: start_at {spec.start_at} is beyond "
+                    f"target_height {self.target_height}"
+                )
+            for p in spec.perturb:
+                if p.height > self.target_height:
+                    raise ValueError(
+                        f"{spec.name}: perturbation {p.action}:{p.height} "
+                        f"is beyond target_height {self.target_height}"
+                    )
         live_from_start = [
             s for s in self.nodes.values()
             if s.start_at == 0 and s.mode == "validator"

@@ -268,21 +268,16 @@ class Node(Service):
         in-process crash-restart (the replay tests' crash simulation)
         can reacquire."""
         data_dir = self.cfg.base.path(self.cfg.base.db_dir)
-        os.makedirs(data_dir, exist_ok=True)
         self._lock_path = os.path.join(data_dir, "LOCK")
-        pid = _read_lock_pid(self._lock_path)
-        if pid and pid != os.getpid() and _pid_alive(pid):
-            raise RuntimeError(
-                f"data dir {data_dir} is locked by running process {pid}"
-            )
-        with open(self._lock_path, "w") as f:
-            f.write(str(os.getpid()))
+        self._lock_fd = acquire_pid_lock(
+            self._lock_path, what=f"data dir {data_dir}"
+        )
 
     def _release_data_lock(self) -> None:
-        try:
-            os.remove(getattr(self, "_lock_path", ""))
-        except OSError:
-            pass
+        fd = getattr(self, "_lock_fd", None)
+        if fd is not None:
+            release_pid_lock(self._lock_path, fd)
+            self._lock_fd = None
 
     async def _start_impl(self) -> None:
         cfg = self.cfg
@@ -617,6 +612,64 @@ def _pid_alive(pid: int) -> bool:
     except OSError:
         return False
     return True
+
+
+def acquire_pid_lock(path: str, what: str = "") -> int:
+    """Atomically claim the advisory lockfile at `path`; returns an fd
+    that must be kept open while held and passed to release_pid_lock().
+
+    flock() on a held fd is the atomic claim step — two processes
+    starting simultaneously cannot both succeed (a read-check-then-write
+    pidfile guard fails exactly in the race it exists to prevent), the
+    kernel releases the lock if the holder dies mid-hold, and pid-reuse
+    cannot fake liveness. The file's pid content is secondary: it names
+    the holder for error messages, and a live *foreign* pid written
+    without the flock (a holder on another fs view, or tests simulating
+    a running node) still refuses. Our own pid in the file is fine — an
+    in-process crash-restart (the replay tests' crash simulation)
+    reacquires after its dead fd's flock lapsed.
+    """
+    import fcntl
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        pid = _read_lock_pid(path)
+        os.close(fd)
+        holder = f"process {pid}" if pid else "another process"
+        raise RuntimeError(
+            f"{what or path} is locked by running {holder}"
+        ) from None
+    pid = _read_lock_pid(path)
+    if pid and pid != os.getpid() and _pid_alive(pid):
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+        raise RuntimeError(
+            f"{what or path} is locked by running process {pid}"
+        )
+    os.ftruncate(fd, 0)
+    os.write(fd, str(os.getpid()).encode())
+    return fd
+
+
+def release_pid_lock(path: str, fd: int) -> None:
+    """Empty the pidfile and drop the flock. The file itself stays
+    (unlinking a flock-ed path lets a third process lock a fresh inode
+    while a second still holds the old one)."""
+    import fcntl
+
+    try:
+        os.ftruncate(fd, 0)
+        fcntl.flock(fd, fcntl.LOCK_UN)
+    except OSError:
+        pass
+    finally:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
 
 
 def make_node(

@@ -309,6 +309,19 @@ class Ed25519Verifier:
             bucket_sizes or [8, 32, 128, 512, 2048, 8192, 16384]
         )
         self._compiled = {}
+        # buckets whose Pallas program has completed on device at least
+        # once (first calls block, see dispatch())
+        self._pallas_proven = set()
+
+    @staticmethod
+    def _is_pallas(prog) -> bool:
+        import sys
+
+        # only consult the pallas module if something already imported
+        # it (i.e. a pallas program could possibly be in `prog`) — the
+        # default XLA path must never pay for, or fail on, this import
+        mod = sys.modules.get(__package__ + ".ed25519_pallas")
+        return mod is not None and prog is mod.verify_pallas
 
     def _bucket(self, n: int) -> int:
         for b in self.bucket_sizes:
@@ -422,10 +435,16 @@ class Ed25519Verifier:
             ok = prog(
                 jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
             )
+            if bucket not in self._pallas_proven and self._is_pallas(prog):
+                # JAX dispatch is asynchronous: a Mosaic *runtime*
+                # failure would otherwise surface later, at gather()'s
+                # np.asarray, past this fallback. Block on the first
+                # call of each Pallas bucket so device-side kernel
+                # failures downgrade to the XLA program here.
+                jax.block_until_ready(ok)
+                self._pallas_proven.add(bucket)
         except Exception as e:
-            from .ed25519_pallas import verify_pallas
-
-            if prog is not verify_pallas:
+            if not self._is_pallas(prog):
                 raise  # a non-Pallas program failing is a real error
             # Mosaic lowering failure: permanently fall back to the XLA
             # program for this bucket (same math, same semantics).
