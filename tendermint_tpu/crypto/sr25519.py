@@ -48,10 +48,19 @@ JSON_PRIVKEY_NAME = "tendermint/PrivKeySr25519"
 L = rst.L
 
 
+_SIGNING_PREFIX: Optional[Transcript] = None
+
+
 def _signing_transcript(msg: bytes) -> Transcript:
-    """signing_context([]).bytes(msg) (reference: privkey.go:16,48)."""
-    t = Transcript(b"SigningContext")
-    t.append_message(b"", b"")  # empty context
+    """signing_context([]).bytes(msg) (reference: privkey.go:16,48).
+    The state after the two constant appends is identical for every
+    signature, so it is computed once and cloned per call."""
+    global _SIGNING_PREFIX
+    if _SIGNING_PREFIX is None:
+        t = Transcript(b"SigningContext")
+        t.append_message(b"", b"")  # empty context
+        _SIGNING_PREFIX = t
+    t = _SIGNING_PREFIX.clone()
     t.append_message(b"sign-bytes", msg)
     return t
 
@@ -64,6 +73,43 @@ def _challenge(t: Transcript, pk_bytes: bytes, r_bytes: bytes) -> int:
     t.append_message(b"sign:R", r_bytes)
     wide = t.challenge_bytes(b"sign:c", 64)
     return int.from_bytes(wide, "little") % L
+
+
+def challenge_batch(pks, msgs, rs) -> list:
+    """Fiat-Shamir challenges for a whole batch: (G, 64)-vectorized
+    merlin transcripts per message-length group (crypto/merlin.py
+    TranscriptBatch; the STROBE control flow depends only on lengths),
+    permuted with one native keccakf_n call per step. Returns one
+    scalar int (already reduced mod L) per (pk, msg, R) triple, in
+    input order. This is the host-prep fast path for the sr25519
+    device verifier (ops/sr25519_kernel.py)."""
+    import numpy as np
+
+    from .merlin import TranscriptBatch
+
+    # ensure the cached signing-context prefix exists
+    _signing_transcript(b"")
+    out: list = [None] * len(msgs)
+    groups: dict = {}
+    for i, m in enumerate(msgs):
+        groups.setdefault(len(m), []).append(i)
+    for mlen, idxs in groups.items():
+        tb = TranscriptBatch(_SIGNING_PREFIX, len(idxs))
+        rows = lambda items, w: np.frombuffer(  # noqa: E731
+            b"".join(items), dtype=np.uint8
+        ).reshape(len(idxs), w)
+        tb.append_messages(
+            b"sign-bytes", rows([msgs[i] for i in idxs], mlen)
+        )
+        tb.append_message_const(b"proto-name", b"Schnorr-sig")
+        tb.append_messages(b"sign:pk", rows([pks[i] for i in idxs], 32))
+        tb.append_messages(b"sign:R", rows([rs[i] for i in idxs], 32))
+        wides = tb.challenge_bytes(b"sign:c", 64)
+        for row, i in enumerate(idxs):
+            out[i] = (
+                int.from_bytes(wides[row].tobytes(), "little") % L
+            )
+    return out
 
 
 def _scalar_divide_by_cofactor(b: bytes) -> int:

@@ -35,6 +35,7 @@ _m_verify_time = M.new_histogram(
 
 __all__ = [
     "TpuEd25519BatchVerifier",
+    "TpuSr25519BatchVerifier",
     "install",
     "installed",
     "stats",
@@ -46,7 +47,7 @@ __all__ = [
 DEFAULT_MIN_BATCH = 8
 
 
-class TpuEd25519BatchVerifier(BatchVerifier):
+class _TpuBatchVerifier(BatchVerifier):
     """Queues triples on host, verifies in one device program.
 
     Same verify() contract as the CPU path: (all_ok, bitmap), bitmap
@@ -54,18 +55,24 @@ class TpuEd25519BatchVerifier(BatchVerifier):
     per-index rather than raising at verify time.
     """
 
-    def __init__(self, verifier=None) -> None:
-        from ..ops import ed25519_kernel
+    KEY_TYPE = ""  # subclasses set
 
+    def __init__(self, verifier=None) -> None:
         self._verifier = verifier
-        self._kernel = ed25519_kernel
+        self._kernel = self._kernel_module()
         self._pks: List[bytes] = []
         self._msgs: List[bytes] = []
         self._sigs: List[bytes] = []
 
+    @staticmethod
+    def _kernel_module():
+        raise NotImplementedError
+
     def add(self, pub_key: PubKey, message: bytes, signature: bytes) -> None:
-        if pub_key.type() != "ed25519":
-            raise TypeError("TpuEd25519BatchVerifier requires ed25519 keys")
+        if pub_key.type() != self.KEY_TYPE:
+            raise TypeError(
+                f"{type(self).__name__} requires {self.KEY_TYPE} keys"
+            )
         if len(signature) != 64:
             raise ValueError("malformed signature size")
         self._pks.append(pub_key.bytes())
@@ -93,7 +100,32 @@ class TpuEd25519BatchVerifier(BatchVerifier):
         return len(self._pks)
 
 
+class TpuEd25519BatchVerifier(_TpuBatchVerifier):
+    KEY_TYPE = "ed25519"
+
+    @staticmethod
+    def _kernel_module():
+        from ..ops import ed25519_kernel
+
+        return ed25519_kernel
+
+
+class TpuSr25519BatchVerifier(_TpuBatchVerifier):
+    """Device sr25519 batch verifier (reference: crypto/sr25519/batch.go
+    backed by curve25519-voi; here ops/sr25519_kernel.py — ristretto
+    decode + schnorrkel equation on the shared curve core)."""
+
+    KEY_TYPE = "sr25519"
+
+    @staticmethod
+    def _kernel_module():
+        from ..ops import sr25519_kernel
+
+        return sr25519_kernel
+
+
 _SHARED_VERIFIER = None
+_SHARED_VERIFIER_SR = None
 _MIN_BATCH = DEFAULT_MIN_BATCH
 _INSTALLED = False
 
@@ -121,18 +153,33 @@ def _factory(size_hint: int) -> Optional[BatchVerifier]:
     return TpuEd25519BatchVerifier(_SHARED_VERIFIER)
 
 
+def _factory_sr(size_hint: int) -> Optional[BatchVerifier]:
+    if 0 < size_hint < _MIN_BATCH:
+        return None
+    return TpuSr25519BatchVerifier(_SHARED_VERIFIER_SR)
+
+
 def install(
     min_batch: int = DEFAULT_MIN_BATCH, mesh=None
 ) -> None:
-    """Register the device factory. With a mesh, batches are sharded
-    across it (tendermint_tpu.parallel.sharding); otherwise single-chip."""
-    global _SHARED_VERIFIER, _MIN_BATCH, _INSTALLED
+    """Register the device factories (ed25519 + sr25519). With a mesh,
+    ed25519 batches are sharded across it
+    (tendermint_tpu.parallel.sharding); otherwise single-chip."""
+    global _SHARED_VERIFIER, _SHARED_VERIFIER_SR, _MIN_BATCH, _INSTALLED
     _MIN_BATCH = min_batch
     _INSTALLED = True
+    # warm the native keccak library here (a subprocess cc compile on
+    # first use) so the first consensus-critical sr25519 verify never
+    # stalls behind a compiler
+    from .merlin import _native_lib
+
+    _native_lib()
     if mesh is not None:
         from ..parallel.sharding import ShardedEd25519Verifier
 
         _SHARED_VERIFIER = ShardedEd25519Verifier(mesh)
     else:
         _SHARED_VERIFIER = None
+    _SHARED_VERIFIER_SR = None  # single-chip (sharded sr25519: follow-up)
     register_device_factory("ed25519", _factory)
+    register_device_factory("sr25519", _factory_sr)

@@ -1,0 +1,99 @@
+"""Native (C) components, compiled on demand with the system compiler.
+
+The reference's runtime leans on native code through curve25519-voi's
+Go+assembly crypto (go.mod:23) and optional cgo DB backends
+(config/config.go:182-194). Here the TPU handles the curve math, but a
+few host-side primitives still need native speed — first among them
+Keccak-f[1600] for merlin/STROBE transcripts (crypto/merlin.py), where
+pure Python costs ~1 ms per permutation.
+
+Design: tiny dependency-free C files next to this module, compiled
+lazily to ``~/.cache/tendermint_tpu/`` (keyed by source hash, so edits
+recompile and concurrent processes converge on the same artifact) and
+loaded with ctypes. Every consumer keeps a pure-Python fallback; a
+missing or broken toolchain degrades performance, never correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+__all__ = ["load", "keccakf_lib"]
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIBS: dict = {}
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    d = os.path.join(base, "tendermint_tpu")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Compile-and-load ``<name>.c`` from this directory; returns the
+    CDLL, or None when disabled (TM_TPU_NO_NATIVE=1), the compiler is
+    missing, or compilation fails. Results (including failure) are
+    cached per process."""
+    if name in _LIBS:
+        return _LIBS[name]
+    lib = None
+    if not os.environ.get("TM_TPU_NO_NATIVE"):
+        try:
+            lib = _build(name)
+        except Exception:
+            lib = None
+    _LIBS[name] = lib
+    return lib
+
+
+def _build(name: str) -> Optional[ctypes.CDLL]:
+    src = os.path.join(_SRC_DIR, f"{name}.c")
+    with open(src, "rb") as f:
+        code = f.read()
+    tag = hashlib.sha256(code).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"{name}-{tag}.so")
+    if not os.path.exists(out):
+        cc = os.environ.get("CC", "cc")
+        # compile to a temp name then atomically rename, so concurrent
+        # processes never load a half-written .so
+        fd, tmp = tempfile.mkstemp(
+            suffix=".so", dir=os.path.dirname(out)
+        )
+        os.close(fd)
+        try:
+            subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return ctypes.CDLL(out)
+
+
+def keccakf_lib():
+    """The keccakf library with argtypes set, or None. Exposes
+    ``tm_keccakf(uint64_t st[25])`` and ``tm_keccakf_n(uint64_t*, long)``
+    over the 200-byte STROBE state (little-endian u64 lanes)."""
+    lib = load("keccakf")
+    if lib is None:
+        return None
+    if not getattr(lib, "_tm_configured", False):
+        lib.tm_keccakf.argtypes = [ctypes.c_void_p]
+        lib.tm_keccakf.restype = None
+        lib.tm_keccakf_n.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.tm_keccakf_n.restype = None
+        lib._tm_configured = True
+    return lib

@@ -53,7 +53,25 @@ from ..crypto import ed25519_math as em
 from . import edwards as E
 from . import field25519 as F
 
-__all__ = ["Ed25519Verifier", "batch_verify_host"]
+__all__ = [
+    "Ed25519Verifier",
+    "batch_verify_host",
+    "dual_mult_sb_minus_ka",
+    "DEFAULT_BUCKET_SIZES",
+    "bucket_for",
+]
+
+# shared by the ed25519 and sr25519 verifiers (ops/sr25519_kernel.py):
+# tune once, both curves follow
+DEFAULT_BUCKET_SIZES = (8, 32, 128, 512, 2048, 8192, 16384)
+
+
+def bucket_for(n: int, sizes: Sequence[int]) -> int:
+    """Smallest configured bucket >= n, or n itself when oversized."""
+    for b in sizes:
+        if n <= b:
+            return b
+    return n
 
 _TB0 = None  # lazy (16, 4, NLIMBS, 1) fixed-base niels table (host numpy;
 # converted per use so jit tracing never captures a cached tracer)
@@ -88,15 +106,16 @@ def _onehot_select(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(table * mask[:, None, None, :], axis=0)
 
 
-def _scalar_mult_check(yA, signA, yR, signR, dS, dk) -> jnp.ndarray:
-    """Core device program. Batch axis minor.
+def dual_mult_sb_minus_ka(A: jnp.ndarray, dS: jnp.ndarray, dk: jnp.ndarray) -> jnp.ndarray:
+    """[S]B - [k]A as a T-less (3, NLIMBS, N) projective stack.
 
-    yA/yR: (L, N) field elements; signA/signR: (N,) int32;
-    dS/dk: (64, N) int32 radix-16 digits, little-endian.
-    Returns ok: (N,) bool.
-    """
-    A, okA = E.decompress(yA, signA)
-    R, okR = E.decompress(yR, signR)
+    A: (4, L, N) extended point; dS/dk: (64, N) int32 radix-16 digits,
+    little-endian. One lax.scan over 64 windows (fixed trip count):
+    Horner `acc <- 16*acc + dk_w*(-A) + dS_w*B` with a per-signature
+    16-entry cached table of -A built on device and a constant niels
+    table of B. Shared by the ed25519 program (cofactored compare
+    follows) and the sr25519/ristretto program (ristretto equality
+    follows, ops/sr25519_kernel.py)."""
     TA = _build_neg_a_table(A)  # (16, 4, L, N)
 
     tb0 = _tb0()  # (16, 4, L, 1)
@@ -108,7 +127,7 @@ def _scalar_mult_check(yA, signA, yR, signR, dS, dk) -> jnp.ndarray:
     # read T and the final comparison is projective, so only the ops
     # feeding an addition materialize T (point ops drop the T output
     # mul otherwise — 25% of each output multiply).
-    acc0 = E.identity(yA.shape[-1])[..., :3, :, :]
+    acc0 = E.identity(A.shape[-1])[..., :3, :, :]
 
     def body(acc, xs):
         ds_w, dk_w = xs
@@ -123,6 +142,19 @@ def _scalar_mult_check(yA, signA, yR, signR, dS, dk) -> jnp.ndarray:
         return acc, None
 
     acc, _ = lax.scan(body, acc0, (dS_steps, dk_steps))
+    return acc
+
+
+def _scalar_mult_check(yA, signA, yR, signR, dS, dk) -> jnp.ndarray:
+    """Core device program. Batch axis minor.
+
+    yA/yR: (L, N) field elements; signA/signR: (N,) int32;
+    dS/dk: (64, N) int32 radix-16 digits, little-endian.
+    Returns ok: (N,) bool.
+    """
+    A, okA = E.decompress(yA, signA)
+    R, okR = E.decompress(yR, signR)
+    acc = dual_mult_sb_minus_ka(A, dS, dk)
     # ZIP-215 cofactored equation, rearranged so nothing needs T:
     # [8]([S]B - [k]A) == [8]R  <=>  [8]([S]B - [k]A - R) == identity.
     for _ in range(3):  # cofactor 8, both sides
@@ -236,18 +268,25 @@ def _mod_l_dev(d: jnp.ndarray) -> jnp.ndarray:
     return _norm8(x, 34)[:32]
 
 
-def _s_lt_l_dev(s: jnp.ndarray) -> jnp.ndarray:
-    """(32, N) int32 byte rows of S (LE) -> (N,) bool: S < L
-    (ZIP-215 rule 2: S must be canonical)."""
-    l_bytes = np.asarray(_L8)[:, 0]
-    lt = jnp.zeros(s.shape[1], dtype=bool)
-    decided = jnp.zeros(s.shape[1], dtype=bool)
+def _lt_const_dev(rows: jnp.ndarray, const8: np.ndarray) -> jnp.ndarray:
+    """(32, N) canonical byte rows (LE) -> (N,) bool: value < const.
+    Most-significant-byte-first scan; shared by the S < L check here
+    and the ristretto s < p canonicity check (ops/sr25519_kernel.py)."""
+    cb = np.asarray(const8)[:, 0]
+    lt = jnp.zeros(rows.shape[1], dtype=bool)
+    decided = jnp.zeros(rows.shape[1], dtype=bool)
     for i in range(31, -1, -1):
-        lo = s[i] < int(l_bytes[i])
-        hi = s[i] > int(l_bytes[i])
+        lo = rows[i] < int(cb[i])
+        hi = rows[i] > int(cb[i])
         lt = jnp.where(~decided & lo, True, lt)
         decided = decided | lo | hi
     return lt
+
+
+def _s_lt_l_dev(s: jnp.ndarray) -> jnp.ndarray:
+    """(32, N) int32 byte rows of S (LE) -> (N,) bool: S < L
+    (ZIP-215 rule 2: S must be canonical)."""
+    return _lt_const_dev(s, _L8)
 
 
 def _nibbles_dev(b: jnp.ndarray) -> jnp.ndarray:
@@ -305,9 +344,7 @@ class Ed25519Verifier:
     invocations)."""
 
     def __init__(self, bucket_sizes: Optional[Sequence[int]] = None) -> None:
-        self.bucket_sizes = sorted(
-            bucket_sizes or [8, 32, 128, 512, 2048, 8192, 16384]
-        )
+        self.bucket_sizes = sorted(bucket_sizes or DEFAULT_BUCKET_SIZES)
         self._compiled = {}
         # buckets whose Pallas program has completed on device at least
         # once (first calls block, see dispatch())
@@ -324,11 +361,7 @@ class Ed25519Verifier:
         return mod is not None and prog is mod.verify_pallas
 
     def _bucket(self, n: int) -> int:
-        for b in self.bucket_sizes:
-            if n <= b:
-                break
-        else:
-            b = n  # oversized (rare)
+        b = bucket_for(n, self.bucket_sizes)
         if self._pallas_wanted():
             # The fused Pallas kernel tiles the batch in full 128-lane
             # blocks. Rounding small buckets up costs nothing: the VPU

@@ -1,0 +1,266 @@
+"""Batched sr25519 (schnorrkel over ristretto255) verification on TPU.
+
+The device program takes a batch of (pubkey, signature, challenge-scalar)
+and returns a validity bitmap — the TPU replacement for the reference's
+sr25519 batch verifier (crypto/sr25519/batch.go via curve25519-voi)
+behind the same crypto.BatchVerifier seam (crypto/crypto.go:53-61).
+
+Verification equation (schnorrkel sign.rs, cofactorless — ristretto255
+is prime order):
+
+    [s]B - [k]A == R   (as ristretto255 group elements)
+
+with k the merlin-transcript Fiat-Shamir challenge. The merlin/STROBE
+transcript (Keccak-f permutations over a byte stream) stays on host —
+crypto/merlin.py backed by the native keccakf (tendermint_tpu/native) —
+because message lengths vary per signature; everything from the 32-byte
+challenge onward runs on device:
+
+    ristretto decode of A and R (RFC 9496 §4.3.1, incl. canonicity)
+    s < L canonicality + v1 marker-bit check
+    [s]B - [k]A via the shared Horner dual-mult
+        (ops/ed25519_kernel.dual_mult_sb_minus_ka — same -A table,
+        same niels B table, same 64-window radix-16 scan)
+    ristretto equality (RFC 9496 §4.4), projective so no inversions
+
+Layout: batch-minor throughout, matching field25519's layout note.
+Differential oracle: crypto/ristretto.py (Python ints, RFC 9496
+vectors) through crypto/sr25519.py's verify_signature.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto import ed25519_math as em
+from . import edwards as E
+from . import field25519 as F
+from .ed25519_kernel import (
+    DEFAULT_BUCKET_SIZES,
+    _bytes_const,
+    _fe_from_bytes_dev,
+    _join_cols,
+    _lt_const_dev,
+    _nibbles_dev,
+    _s_lt_l_dev,
+    bucket_for,
+    dual_mult_sb_minus_ka,
+)
+
+__all__ = ["Sr25519Verifier", "batch_verify_host"]
+
+_P8 = _bytes_const(em.P, 32)  # field prime as 32 LE byte limbs
+_SQRT_M1_INT = em.SQRT_M1
+_D_INT = em.D
+
+
+def _abs_dev(x: jnp.ndarray) -> jnp.ndarray:
+    """CT_ABS (RFC 9496 §4.1): negate iff the canonical form is odd."""
+    parity = F.canonical(x)[..., 0, :] & 1
+    return F.select(parity == 1, F.neg(x), x)
+
+
+def _is_negative_dev(x: jnp.ndarray) -> jnp.ndarray:
+    return (F.canonical(x)[..., 0, :] & 1) == 1
+
+
+def _sqrt_ratio_m1_dev(
+    u: jnp.ndarray, v: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """SQRT_RATIO_M1 (RFC 9496 §4.2), batched.
+
+    Returns (was_square (N,), r (NLIMBS, N)) with r = |sqrt(u/v)| when
+    u/v is square, else |sqrt(i*u/v)|. The exponentiation reuses the
+    (p-5)/8 addition chain (254 squarings) from the ed25519 kernel's
+    decompression path."""
+    v2 = F.sqr(v)
+    v3 = F.mul(v2, v)
+    v7 = F.mul(F.sqr(v3), v)
+    r = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
+    check = F.mul(v, F.sqr(r))
+    u_neg = F.neg(u)
+    sqrt_m1 = jnp.broadcast_to(F.const_limbs(_SQRT_M1_INT), u.shape)
+    correct = F.eq(check, u)
+    flipped = F.eq(check, u_neg)
+    flipped_i = F.eq(check, F.mul(u_neg, sqrt_m1))
+    r = F.select(flipped | flipped_i, F.mul(r, sqrt_m1), r)
+    return correct | flipped, _abs_dev(r)
+
+
+def ristretto_decode_dev(
+    b: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched ristretto255 decode (RFC 9496 §4.3.1).
+
+    b: (32, N) int32 byte rows. Returns (point (4, NLIMBS, N) extended
+    edwards coords, ok (N,) bool). Invalid encodings (non-canonical,
+    negative, non-square, t negative, y = 0) yield ok = False with a
+    bounded garbage point that flows safely through the curve math."""
+    nonneg = (b[0] & 1) == 0
+    canon = _lt_const_dev(b, _P8)  # value < p (bit 255 set fails too)
+    s = _fe_from_bytes_dev(
+        b.at[31].set(b[31] & 0x7F)
+    )  # mask bit 255 to keep limb bounds; canon already rejects it
+    one = jnp.broadcast_to(F.const_limbs(1), s.shape)
+    ss = F.sqr(s)
+    u1 = F.sub(one, ss)
+    u2 = F.add(one, ss)
+    u2_sqr = F.sqr(u2)
+    d = jnp.broadcast_to(F.const_limbs(_D_INT), s.shape)
+    v = F.sub(F.neg(F.mul(d, F.sqr(u1))), u2_sqr)
+    was_square, invsqrt = _sqrt_ratio_m1_dev(one, F.mul(v, u2_sqr))
+    den_x = F.mul(invsqrt, u2)
+    den_y = F.mul(F.mul(invsqrt, den_x), v)
+    x = _abs_dev(F.mul(F.add(s, s), den_x))
+    y = F.mul(u1, den_y)
+    t = F.mul(x, y)
+    ok = (
+        was_square
+        & ~_is_negative_dev(t)
+        & ~F.is_zero(y)
+        & nonneg
+        & canon
+    )
+    pt = jnp.stack([x, y, one, t], axis=-3)
+    return pt, ok
+
+
+def _ristretto_eq_dev(p3: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Ristretto equality (RFC 9496 §4.4): X1*Y2 == Y1*X2 or
+    Y1*Y2 == X1*X2. Projective: the Z factors multiply both sides of
+    each equation identically, so T-less (X, Y, Z) stacks suffice.
+    p3: (..., >=2, NLIMBS, N) stack; q: same (extra coords ignored)."""
+    X1, Y1 = p3[..., 0, :, :], p3[..., 1, :, :]
+    X2, Y2 = q[..., 0, :, :], q[..., 1, :, :]
+    lhs = jnp.stack([X1, Y1], axis=-3)
+    rhs = jnp.stack([Y2, X2], axis=-3)
+    cross = F.mul(lhs, rhs)  # X1*Y2, Y1*X2
+    eq1 = F.eq(cross[..., 0, :, :], cross[..., 1, :, :])
+    straight = F.mul(lhs, jnp.stack([X2, Y2], axis=-3))  # X1*X2, Y1*Y2
+    eq2 = F.eq(straight[..., 0, :, :], straight[..., 1, :, :])
+    return eq1 | eq2
+
+
+def _verify_tile_sr(pk_b, sig_b, k_b) -> jnp.ndarray:
+    """The full sr25519 device program: byte rows in, bitmap out.
+
+    pk_b (32, N) ristretto pubkey bytes; sig_b (64, N) R || s with the
+    schnorrkel v1 marker in bit 511; k_b (32, N) LE bytes of the
+    merlin challenge already reduced mod L on host. Returns (N,) bool.
+    """
+    pk = pk_b.astype(jnp.int32)
+    sig = sig_b.astype(jnp.int32)
+    kb = k_b.astype(jnp.int32)
+    marker_ok = (sig[63] >> 7) == 1  # schnorrkel v1 marker bit
+    s = sig[32:]
+    s = s.at[31].set(s[31] & 0x7F)
+    s_ok = _s_lt_l_dev(s)
+    A, okA = ristretto_decode_dev(pk)
+    R, okR = ristretto_decode_dev(sig[:32])
+    dS = _nibbles_dev(s)
+    dk = _nibbles_dev(kb)
+    acc = dual_mult_sb_minus_ka(A, dS, dk)  # [s]B - [k]A, T-less
+    return _ristretto_eq_dev(acc, R) & okA & okR & s_ok & marker_ok
+
+
+_JIT_VERIFY_SR = None
+
+
+def _jit_verify_tile_sr():
+    global _JIT_VERIFY_SR
+    if _JIT_VERIFY_SR is None:
+        _JIT_VERIFY_SR = jax.jit(_verify_tile_sr)
+    return _JIT_VERIFY_SR
+
+
+class Sr25519Verifier:
+    """Compiled, bucketed sr25519 batch verifier (device XLA program).
+
+    Mirrors ops.ed25519_kernel.Ed25519Verifier's dispatch()/gather()
+    shape: host work is merlin challenges + byte joins; decode, scalar
+    canonicality, and the curve math are one device program per bucket."""
+
+    def __init__(self, bucket_sizes: Optional[Sequence[int]] = None) -> None:
+        self.bucket_sizes = sorted(bucket_sizes or DEFAULT_BUCKET_SIZES)
+
+    def _bucket(self, n: int) -> int:
+        return bucket_for(n, self.bucket_sizes)
+
+    def verify(
+        self,
+        pubkeys: Sequence[bytes],
+        msgs: Sequence[bytes],
+        sigs: Sequence[bytes],
+    ) -> np.ndarray:
+        return self.gather(self.dispatch(pubkeys, msgs, sigs))
+
+    def dispatch(
+        self,
+        pubkeys: Sequence[bytes],
+        msgs: Sequence[bytes],
+        sigs: Sequence[bytes],
+    ):
+        """Asynchronously launch verification; returns a handle for
+        gather(). Malformed sizes are reported invalid per-index."""
+        from ..crypto.sr25519 import challenge_batch
+
+        n = len(pubkeys)
+        if n == 0:
+            return (None, 0, np.zeros(0, dtype=bool))
+        size_ok = np.array(
+            [
+                len(pk) == 32 and len(sig) == 64
+                for pk, sig in zip(pubkeys, sigs)
+            ],
+            dtype=bool,
+        )
+        if not size_ok.all():
+            pubkeys = [
+                pk if ok else b"\x00" * 32
+                for pk, ok in zip(pubkeys, size_ok)
+            ]
+            sigs = [
+                sig if ok else b"\x00" * 64
+                for sig, ok in zip(sigs, size_ok)
+            ]
+        # host: the merlin Fiat-Shamir challenges, vectorized per
+        # message-length group (crypto/sr25519.py challenge_batch —
+        # one native keccakf_n permutation call per transcript step)
+        ks = [
+            k.to_bytes(32, "little")
+            for k in challenge_batch(
+                pubkeys, msgs, [sig[:32] for sig in sigs]
+            )
+        ]
+        bucket = self._bucket(n)
+        pad = bucket - n
+        pk_b = _join_cols(pubkeys, 32, pad)
+        sig_b = _join_cols(sigs, 64, pad)
+        k_b = _join_cols(ks, 32, pad)
+        prog = _jit_verify_tile_sr()
+        ok = prog(
+            jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(k_b)
+        )
+        return (ok, n, size_ok)
+
+    def gather(self, handle) -> np.ndarray:
+        ok, n, size_ok = handle
+        if ok is None:
+            return size_ok
+        return np.asarray(ok)[:n] & size_ok
+
+
+_DEFAULT: Optional[Sr25519Verifier] = None
+
+
+def batch_verify_host(pubkeys, msgs, sigs) -> np.ndarray:
+    """Module-level convenience using a shared verifier instance."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = Sr25519Verifier()
+    return _DEFAULT.verify(pubkeys, msgs, sigs)

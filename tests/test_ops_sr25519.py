@@ -1,0 +1,182 @@
+"""Device sr25519 kernel tests: ristretto decode differentials (RFC
+9496), full verify differentials against the host schnorrkel oracle
+(crypto/sr25519.py), and the device seam through create_batch_verifier
+and VerifyCommit mixed sets (reference model: crypto/sr25519/batch.go,
+crypto/batch/batch.go:11-33)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.crypto import ristretto as rst
+from tendermint_tpu.crypto import tpu_verifier
+from tendermint_tpu.crypto.sr25519 import (
+    PrivKeySr25519,
+    PubKeySr25519,
+    Sr25519BatchVerifier,
+)
+from tendermint_tpu.ops import field25519 as F
+from tendermint_tpu.ops import sr25519_kernel as SK
+
+
+def _decode_rows(encodings):
+    rows = (
+        np.frombuffer(b"".join(encodings), dtype=np.uint8)
+        .reshape(-1, 32)
+        .T.astype(np.int32)
+    )
+    pt, ok = jax.jit(SK.ristretto_decode_dev)(jnp.asarray(rows))
+    return np.asarray(pt), np.asarray(ok)
+
+
+def _affine(pt, i):
+    x = F.from_limbs(np.asarray(F.canonical(pt[0, :, i : i + 1]))[:, 0])
+    y = F.from_limbs(np.asarray(F.canonical(pt[1, :, i : i + 1]))[:, 0])
+    return x, y
+
+
+class TestRistrettoDecodeDev:
+    def test_generator_multiples_match_host(self):
+        encs = [rst.encode(rst.mul_base(k)) for k in range(16)]
+        pt, ok = _decode_rows(encs)
+        assert ok.all()
+        for i, e in enumerate(encs):
+            hx, hy, hz, _ = rst.decode(e)
+            zi = pow(hz, rst.P - 2, rst.P)
+            assert _affine(pt, i) == (hx * zi % rst.P, hy * zi % rst.P)
+
+    def test_invalid_encodings_rejected(self):
+        bad = [
+            (1).to_bytes(32, "little"),  # negative (odd)
+            int(rst.P).to_bytes(32, "little"),  # == p: non-canonical
+            int(rst.P + 2).to_bytes(32, "little"),  # > p, even
+            b"\xff" * 32,  # way over p
+            bytes(range(32)),  # non-square candidate
+            (2).to_bytes(32, "little"),  # may or may not decode: differential
+        ]
+        _, ok = _decode_rows(bad)
+        for i, e in enumerate(bad):
+            assert bool(ok[i]) == (rst.decode(e) is not None), e.hex()
+
+    def test_random_differential(self):
+        rng = np.random.default_rng(7)
+        encs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(64)]
+        # make a quarter of them valid points
+        for j in range(0, 64, 4):
+            encs[j] = rst.encode(rst.mul_base(int(rng.integers(1, 2**62))))
+        _, ok = _decode_rows(encs)
+        for i, e in enumerate(encs):
+            assert bool(ok[i]) == (rst.decode(e) is not None), (i, e.hex())
+
+
+class TestSr25519KernelVerify:
+    def _fixtures(self, n=8):
+        privs = [PrivKeySr25519.from_seed(bytes([i + 1]) * 32) for i in range(n)]
+        msgs = [b"vote-%d" % i for i in range(n)]
+        sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+        pks = [p.pub_key().bytes() for p in privs]
+        return pks, msgs, sigs
+
+    def test_all_valid(self):
+        pks, msgs, sigs = self._fixtures()
+        assert SK.batch_verify_host(pks, msgs, sigs).all()
+
+    def test_corruptions_localized_and_match_host(self):
+        pks, msgs, sigs = self._fixtures()
+        sigs[1] = sigs[1][:63] + bytes([sigs[1][63] & 0x7F])  # marker off
+        # s >= L: set s to L (plus marker bit)
+        l_bytes = bytearray(int(rst.L).to_bytes(32, "little"))
+        l_bytes[31] |= 0x80
+        sigs[2] = sigs[2][:32] + bytes(l_bytes)
+        msgs[3] = b"tampered"
+        pks[4] = (1).to_bytes(32, "little")  # undecodable pubkey
+        sigs[5] = (1).to_bytes(32, "little") + sigs[5][32:]  # undecodable R
+        sigs[6] = b"short"  # malformed size
+        got = SK.batch_verify_host(pks, msgs, sigs)
+        expect = []
+        for pk, m, s in zip(pks, msgs, sigs):
+            try:
+                expect.append(PubKeySr25519(pk).verify_signature(m, s))
+            except ValueError:
+                expect.append(False)
+        assert got.tolist() == expect
+        assert got.tolist() == [True, False, False, False, False, False, False, True]
+
+    def test_padding_does_not_leak(self):
+        # a bucket-padded batch (3 -> bucket 8) must ignore pad lanes
+        pks, msgs, sigs = self._fixtures(3)
+        got = SK.batch_verify_host(pks, msgs, sigs)
+        assert got.shape == (3,) and got.all()
+
+
+class TestChallengeBatch:
+    def test_matches_scalar_transcripts(self):
+        from tendermint_tpu.crypto.sr25519 import (
+            _challenge,
+            _signing_transcript,
+            challenge_batch,
+        )
+
+        rng = np.random.default_rng(3)
+        pks = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(40)]
+        rs = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(40)]
+        # several length groups incl. empty and rate-straddling (>166)
+        msgs = [
+            b"m" * (0, 1, 100, 166, 167, 400)[i % 6] for i in range(40)
+        ]
+        want = [
+            _challenge(_signing_transcript(m), pk, r)
+            for pk, m, r in zip(pks, msgs, rs)
+        ]
+        assert challenge_batch(pks, msgs, rs) == want
+
+    def test_python_fallback_matches(self, monkeypatch):
+        from tendermint_tpu.crypto import merlin
+        from tendermint_tpu.crypto.sr25519 import challenge_batch
+
+        want = challenge_batch([b"\x01" * 32], [b"msg"], [b"\x02" * 32])
+        monkeypatch.setattr(merlin, "_NATIVE", False)  # force pure python
+        assert challenge_batch([b"\x01" * 32], [b"msg"], [b"\x02" * 32]) == want
+
+
+class TestDeviceSeam:
+    def test_install_routes_sr25519(self):
+        try:
+            tpu_verifier.install(min_batch=2)
+            sk = PrivKeySr25519.from_seed(b"\x0e" * 32)
+            bv = crypto_batch.create_batch_verifier(sk.pub_key(), size_hint=8)
+            assert isinstance(bv, tpu_verifier.TpuSr25519BatchVerifier)
+            # tiny batches still decline to CPU
+            bv_small = crypto_batch.create_batch_verifier(
+                sk.pub_key(), size_hint=1
+            )
+            assert isinstance(bv_small, Sr25519BatchVerifier)
+            sks = [PrivKeySr25519.from_seed(bytes([40 + i]) * 32) for i in range(6)]
+            msgs = [b"m%d" % i for i in range(6)]
+            for i, (s, m) in enumerate(zip(sks, msgs)):
+                sig = s.sign(m)
+                if i == 2:
+                    sig = sig[:8] + bytes([sig[8] ^ 1]) + sig[9:]
+                bv.add(s.pub_key(), m, sig)
+            ok, bitmap = bv.verify()
+            assert not ok
+            assert bitmap == [True, True, False, True, True, True]
+        finally:
+            crypto_batch._DEVICE_FACTORIES.clear()
+
+    def test_mixed_commit_on_device(self):
+        from .test_sr25519 import _mixed_commit
+        from tendermint_tpu.types.validation import verify_commit
+
+        try:
+            sigs_before = tpu_verifier.stats()["sigs"]
+            tpu_verifier.install(min_batch=2)
+            vals, commit, block_id, _, _ = _mixed_commit(5, 4)
+            verify_commit("mixed-chain", vals, block_id, 5, commit)
+            # both key-type groups went through device batch verifiers
+            assert tpu_verifier.stats()["sigs"] >= sigs_before + 9
+        finally:
+            crypto_batch._DEVICE_FACTORIES.clear()
