@@ -37,6 +37,12 @@ MODE_VALIDATOR = "validator"
 MODE_FULL = "full"
 MODE_SEED = "seed"
 
+# Canonical device-batch bucket sizes: the single source both curves'
+# verifiers follow (ops.ed25519_kernel re-exports this as
+# DEFAULT_BUCKET_SIZES; config.py owns it because it must stay
+# importable without jax).
+DEFAULT_BUCKET_SIZES = (8, 32, 128, 512, 2048, 8192, 16384)
+
 
 @dataclass
 class BaseConfig:
@@ -172,9 +178,13 @@ class TPUConfig:
     enable: bool = True
     min_batch_size: int = 8  # below this, CPU single-verify wins
     bucket_sizes: list[int] = field(
-        default_factory=lambda: [8, 32, 128, 512, 2048, 8192, 16384]
+        default_factory=lambda: list(DEFAULT_BUCKET_SIZES)
     )
     donate_buffers: bool = True
+    # devices > 1 shards signature batches over a data-parallel
+    # jax.sharding.Mesh of that many devices (tendermint_tpu.parallel);
+    # 0 = every visible device, 1 = single chip (no mesh)
+    devices: int = 1
 
 
 @dataclass

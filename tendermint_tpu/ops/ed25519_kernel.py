@@ -28,11 +28,11 @@ stranded ~85% of the VPU lanes). Table indexing is a 16-way one-hot
 select (compare + masked accumulate), not a gather: per-lane dynamic
 gathers serialize on TPU, while the one-hot form is pure vector ALU.
 
-Scalar prep (SHA-512 of the messages, reduction mod L, nibble
-decomposition) happens on host: messages are variable-length and the
-hash is cheap relative to the curve math. Everything except the SHA-512
-calls themselves is vectorized numpy (Barrett reduction mod L on 16-bit
-limbs); moving SHA-512 on-device is the ops/sha512 follow-up.
+Scalar prep (SHA-512 of R||A||M, reduction mod L, nibble decomposition)
+also runs on device: digests via ops/sha512_kernel.py per
+message-length group (sign-bytes in a Commit share one length, so the
+common case is a single fused group with no host round-trip), the rest
+inside the verify program. Host work is byte joins only.
 
 Shapes are bucketed (pad to the next configured bucket) so XLA compiles a
 handful of programs once and reuses them for every Commit size.
@@ -61,9 +61,9 @@ __all__ = [
     "bucket_for",
 ]
 
-# shared by the ed25519 and sr25519 verifiers (ops/sr25519_kernel.py):
-# tune once, both curves follow
-DEFAULT_BUCKET_SIZES = (8, 32, 128, 512, 2048, 8192, 16384)
+# shared by the ed25519 and sr25519 verifiers (ops/sr25519_kernel.py)
+# and the [tpu] config section: tune once, everything follows
+from ..config import DEFAULT_BUCKET_SIZES  # noqa: E402
 
 
 def bucket_for(n: int, sizes: Sequence[int]) -> int:
@@ -448,21 +448,14 @@ class Ed25519Verifier:
                 sig if ok else b"\x00" * 64
                 for sig, ok in zip(sigs, size_ok)
             ]
-        # host work is just byte joins + SHA-512; everything else (limb
-        # unpacking, mod-L, S-canonicality, digits, curve math) is one
-        # device program
+        # host work is byte joins only; hashing (SHA-512 of R||A||M),
+        # limb unpacking, mod-L, S-canonicality, digits, and the curve
+        # math all run on device
         bucket = self._bucket(n)
         pad = bucket - n
         pk_b = _join_cols(pubkeys, 32, pad)
         sig_b = _join_cols(sigs, 64, pad)
-        dig_b = _join_cols(
-            [
-                hashlib.sha512(sig[:32] + pk + msg).digest()
-                for pk, msg, sig in zip(pubkeys, msgs, sigs)
-            ],
-            64,
-            pad,
-        )
+        dig_b = self._digest_rows(pubkeys, msgs, sigs, bucket)
         prog = self._program(bucket)
         try:
             ok = prog(
@@ -496,6 +489,57 @@ class Ed25519Verifier:
             )
         return (ok, n, size_ok)
 
+    def _digest_rows(self, pubkeys, msgs, sigs, bucket):
+        """(64, bucket) rows of SHA512(R || A || M).
+
+        Device-hashed per message-length group (ops/sha512_kernel.py
+        compiles one program per length); the single-length common case
+        — every sign-bytes in a Commit has the same shape — keeps the
+        digests on device, feeding the verify program without a host
+        round-trip. TM_TPU_HOST_SHA512=1 restores hashlib (bench
+        comparisons)."""
+        import os
+
+        n = len(pubkeys)
+        if os.environ.get("TM_TPU_HOST_SHA512"):
+            return _join_cols(
+                [
+                    hashlib.sha512(sig[:32] + pk + msg).digest()
+                    for pk, msg, sig in zip(pubkeys, msgs, sigs)
+                ],
+                64,
+                bucket - n,
+            )
+        groups: dict = {}
+        for i, m in enumerate(msgs):
+            groups.setdefault(len(m), []).append(i)
+        if len(groups) == 1:
+            ((mlen, _),) = groups.items()
+            pre = _join_cols(
+                [
+                    sig[:32] + pk + msg
+                    for pk, msg, sig in zip(pubkeys, msgs, sigs)
+                ],
+                64 + mlen,
+                bucket - n,
+            )
+            return _jit_sha512()(jnp.asarray(pre))
+        dig = np.zeros((64, bucket), dtype=np.uint8)
+        for mlen, idxs in groups.items():
+            g = len(idxs)
+            gb = bucket_for(g, self.bucket_sizes)
+            pre = _join_cols(
+                [
+                    sigs[i][:32] + pubkeys[i] + msgs[i]
+                    for i in idxs
+                ],
+                64 + mlen,
+                gb - g,
+            )
+            out = np.asarray(_jit_sha512()(jnp.asarray(pre)))
+            dig[:, idxs] = out[:, :g]
+        return dig
+
     def gather(self, handle) -> np.ndarray:
         """Block on a dispatch() handle and return the bitmap."""
         ok, n, size_ok = handle
@@ -505,6 +549,18 @@ class Ed25519Verifier:
 
 
 _JIT_VERIFY = None
+_JIT_SHA512 = None
+
+
+def _jit_sha512():
+    """Shared jitted sha512_fixed (one compile per message length +
+    bucket shape inside jax's cache)."""
+    global _JIT_SHA512
+    if _JIT_SHA512 is None:
+        from .sha512_kernel import sha512_fixed
+
+        _JIT_SHA512 = jax.jit(sha512_fixed)
+    return _JIT_SHA512
 
 
 def _jit_verify_tile():

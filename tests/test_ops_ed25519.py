@@ -137,3 +137,51 @@ def test_small_order_points_match_oracle(verifier):
     assert list(got) == expect, list(zip(got, expect))
     # sanity: at least one cofactored acceptance exists in this set
     assert any(expect), "expected some small-order case to verify"
+
+
+def test_sha512_kernel_matches_hashlib():
+    """Device SHA-512 (ops/sha512_kernel.py) vs hashlib across block
+    boundaries (111/112 bytes is the one/two-block edge)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops.sha512_kernel import sha512_fixed
+
+    rng = np.random.default_rng(11)
+    for length in (0, 1, 111, 112, 127, 128, 250):
+        msgs = [
+            bytes(rng.integers(0, 256, length, dtype=np.uint8))
+            for _ in range(4)
+        ]
+        if length:
+            rows = (
+                np.frombuffer(b"".join(msgs), dtype=np.uint8)
+                .reshape(4, length)
+                .T
+            )
+        else:
+            rows = np.zeros((0, 4), dtype=np.uint8)
+        got = np.asarray(jax.jit(sha512_fixed)(jnp.asarray(rows)))
+        for i, m in enumerate(msgs):
+            assert got[:, i].tobytes() == hashlib.sha512(m).digest()
+
+
+def test_mixed_message_lengths_device_digests(verifier):
+    """dispatch groups by message length for the device SHA-512 and
+    reassembles digests in batch order."""
+    pks, msgs, sigs = _sign_set(6, b"len")
+    keys = [
+        PrivKeyEd25519.from_seed(hashlib.sha256(b"len" + bytes([i])).digest())
+        for i in range(6)
+    ]
+    msgs = [b"x" * (10 + 7 * (i % 3)) for i in range(6)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    sigs[2] = sigs[2][:10] + bytes([sigs[2][10] ^ 1]) + sigs[2][11:]
+    ok = verifier.verify(pks, msgs, sigs)
+    assert ok.tolist() == [True, True, False, True, True, True]
+
+
+def test_host_sha512_env_knob(verifier, monkeypatch):
+    monkeypatch.setenv("TM_TPU_HOST_SHA512", "1")
+    pks, msgs, sigs = _sign_set(5, b"knob")
+    assert verifier.verify(pks, msgs, sigs).all()
