@@ -394,8 +394,11 @@ class ConsensusState(Service):
         )
 
         rs = self.rs
-        candidates = []
-        key_type = None
+        # one candidate group per key type: a mixed ed25519/sr25519
+        # validator set pre-verifies every type, each through its own
+        # batch verifier (same per-type grouping as
+        # types/validation.py's commit path)
+        groups: dict = {}
         for mi in batch:
             msg = mi.msg
             if not isinstance(msg, VoteMessage):
@@ -417,31 +420,34 @@ class ConsensusState(Service):
                 continue
             if val.pub_key.address() != vote.validator_address:
                 continue  # same check Vote.verify performs
-            if key_type is None:
-                key_type = val.pub_key.type()
-            elif val.pub_key.type() != key_type:
-                continue  # mixed set: batch the first type only
-            candidates.append((vote, val.pub_key))
-        if len(candidates) < 2 or not supports_batch_verifier(
-            candidates[0][1]
-        ):
-            return
-        try:
-            bv = create_batch_verifier(
-                candidates[0][1], size_hint=len(candidates)
+            groups.setdefault(val.pub_key.type(), []).append(
+                (vote, val.pub_key)
             )
-            for vote, pk in candidates:
-                bv.add(pk, vote.sign_bytes(self.state.chain_id), vote.signature)
-            _all_ok, bitmap = bv.verify()
-        except Exception as e:
-            # a device hiccup: fall back to the per-vote path for the
-            # whole batch (candidate filtering already excluded
-            # malformed signatures and mixed key types)
-            self.logger.debug("verify-ahead batch failed", err=str(e))
-            return
-        for (vote, _pk), ok in zip(candidates, bitmap):
-            if ok:
-                vote._pre_verified = True
+        for candidates in groups.values():
+            if len(candidates) < 2 or not supports_batch_verifier(
+                candidates[0][1]
+            ):
+                continue
+            try:
+                bv = create_batch_verifier(
+                    candidates[0][1], size_hint=len(candidates)
+                )
+                for vote, pk in candidates:
+                    bv.add(
+                        pk,
+                        vote.sign_bytes(self.state.chain_id),
+                        vote.signature,
+                    )
+                _all_ok, bitmap = bv.verify()
+            except Exception as e:
+                # a device hiccup: fall back to the per-vote path for
+                # this group (candidate filtering already excluded
+                # malformed signatures)
+                self.logger.debug("verify-ahead batch failed", err=str(e))
+                continue
+            for (vote, _pk), ok in zip(candidates, bitmap):
+                if ok:
+                    vote._pre_verified = True
 
     async def _handle_msg(self, mi: MsgInfo) -> None:
         """reference: state.go:891-960 handleMsg."""

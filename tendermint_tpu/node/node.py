@@ -94,7 +94,10 @@ class Node(Service):
                     "min_batch; overriding process-wide",
                     prior=prior, new=cfg.tpu.min_batch_size,
                 )
-            tpu_verifier.install(min_batch=cfg.tpu.min_batch_size)
+            tpu_verifier.install(
+                min_batch=cfg.tpu.min_batch_size,
+                mesh=self._device_mesh(cfg.tpu.devices),
+            )
             from ..ops import merkle_kernel
 
             merkle_kernel.install()
@@ -259,6 +262,33 @@ class Node(Service):
         except BaseException:
             await self._teardown()
             raise
+
+    @staticmethod
+    def _device_mesh(devices: int):
+        """The batch-sharding mesh from `[tpu] devices` (reference
+        seam: the backend choice is config, not code —
+        crypto/crypto.go:53-61). 1 -> None (single chip); 0 -> every
+        visible device; n -> the first n (erroring if absent, since a
+        silently smaller mesh would change bucket padding semantics)."""
+        if devices == 1:
+            return None
+        if devices < 0:
+            raise RuntimeError(f"[tpu] devices = {devices}: must be >= 0")
+        import jax
+
+        from ..parallel import make_mesh
+
+        avail = jax.devices()
+        if devices == 0:
+            devices = len(avail)
+        if devices == 1:
+            return None
+        if len(avail) < devices:
+            raise RuntimeError(
+                f"[tpu] devices = {devices} but only {len(avail)} "
+                f"jax device(s) are visible"
+            )
+        return make_mesh(avail[:devices])
 
     def _acquire_data_lock(self) -> None:
         """Advisory data-dir lock: offline commands (reindex-event,

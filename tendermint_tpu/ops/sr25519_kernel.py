@@ -30,7 +30,7 @@ vectors) through crypto/sr25519.py's verify_signature.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,7 +38,6 @@ import jax
 import jax.numpy as jnp
 
 from ..crypto import ed25519_math as em
-from . import edwards as E
 from . import field25519 as F
 from .ed25519_kernel import (
     DEFAULT_BUCKET_SIZES,

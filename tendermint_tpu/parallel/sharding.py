@@ -56,7 +56,7 @@ class ShardedEd25519Verifier(K.Ed25519Verifier):
     ) -> None:
         self.mesh = mesh
         n = mesh.devices.size
-        sizes = bucket_sizes or [8, 32, 128, 512, 2048, 8192, 16384]
+        sizes = bucket_sizes or K.DEFAULT_BUCKET_SIZES
         super().__init__(sorted({-(-s // n) * n for s in sizes}))
 
     def _bucket(self, n: int) -> int:

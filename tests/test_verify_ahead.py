@@ -194,3 +194,43 @@ def test_batched_votes_flow_through_receive_loop():
             await cs.stop()
 
     asyncio.run(go())
+
+
+def test_preverify_mixed_key_types_batches_both_groups():
+    """A 50/50 ed25519/sr25519 validator set: verify-ahead groups the
+    burst per key type and pre-verifies BOTH groups (matching
+    types/validation.py's per-key-type commit grouping)."""
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+    async def go():
+        privs = [PrivKeyEd25519.from_seed(bytes([i + 1]) * 32)
+                 for i in range(3)] + \
+                [PrivKeySr25519.from_seed(bytes([i + 120]) * 32)
+                 for i in range(3)]
+        node = Node(privs[0], _genesis(privs))
+        cs = node.cs
+        vals = cs.rs.validators
+        bid = BlockID(
+            hash=b"\x72" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x73" * 32),
+        )
+        votes = _votes(privs, vals, cs.rs.height, bid)
+        # corrupt one signature in each group
+        by_type = {}
+        for p, v in zip(privs, votes):
+            by_type.setdefault(p.pub_key().type(), []).append(v)
+        bad = {kt: vs[1] for kt, vs in by_type.items()}
+        for v in bad.values():
+            v.signature = v.signature[:8] + bytes(
+                [v.signature[8] ^ 1]
+            ) + v.signature[9:]
+        batch = [
+            MsgInfo(msg=VoteMessage(vote=v), peer_id="p") for v in votes
+        ]
+        cs._preverify_votes(batch)
+        for kt, vs in by_type.items():
+            marked = [getattr(v, "_pre_verified", False) for v in vs]
+            want = [v is not bad[kt] for v in vs]
+            assert marked == want, (kt, marked)
+
+    asyncio.run(go())
