@@ -695,6 +695,41 @@ def cmd_e2e(args) -> int:
     return 0 if rep.ok else 1
 
 
+def cmd_key_migrate(args) -> int:
+    """Translate legacy string-prefixed database keys to the current
+    binary layout (reference: cmd/tendermint/commands/key_migrate.go +
+    scripts/keymigrate/migrate.go). Resumable: already-migrated keys
+    are skipped."""
+    from ..store.keymigrate import CONTEXTS, migrate_db
+    from ..store.kv import open_db
+
+    cfg = _load_home(args.home)
+    try:
+        with _ensure_node_stopped(cfg):
+            db_dir = cfg.base.path(cfg.base.db_dir)
+            total = 0
+            # iterate the migrator's own dispatch table so the command
+            # cannot drift from it (contexts born in the current layout
+            # have no entry and are not opened — open_db would create
+            # stray empty database files)
+            for i, ctx in enumerate(CONTEXTS):
+                db = open_db(ctx, cfg.base.db_backend, db_dir)
+                try:
+                    n = migrate_db(db, ctx)
+                finally:
+                    db.close()
+                print(
+                    f"[{i + 1}/{len(CONTEXTS)}] {ctx}: "
+                    f"{n} key(s) migrated"
+                )
+                total += n
+            print(f"completed database migration: {total} key(s)")
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_version(args) -> int:
     print(_version.__version__)
     return 0
@@ -1122,6 +1157,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--count", type=int, default=4)
     sp.add_argument("--output-dir", "-o", default="./e2e-manifests")
     sp.set_defaults(fn=cmd_e2e)
+
+    sp = sub.add_parser(
+        "key-migrate",
+        help="migrate legacy database key formats to the current layout",
+    )
+    sp.set_defaults(fn=cmd_key_migrate)
 
     sp = sub.add_parser("version", help="print the version")
     sp.set_defaults(fn=cmd_version)

@@ -41,7 +41,10 @@ MODE_SEED = "seed"
 # verifiers follow (ops.ed25519_kernel re-exports this as
 # DEFAULT_BUCKET_SIZES; config.py owns it because it must stay
 # importable without jax).
-DEFAULT_BUCKET_SIZES = (8, 32, 128, 512, 2048, 8192, 16384)
+# 12288 exists for the 10k-validator commit config (BASELINE 5): padding
+# 10k sigs to 16384 wastes 39% of the device program; 12288 = 96 * 128
+# stays Pallas-tile aligned and cuts that to 18%.
+DEFAULT_BUCKET_SIZES = (8, 32, 128, 512, 2048, 8192, 12288, 16384)
 
 
 @dataclass
@@ -159,7 +162,12 @@ class ConsensusConfig:
 
 @dataclass
 class TxIndexConfig:
-    indexer: list[str] = field(default_factory=lambda: ["kv"])  # kv | null
+    # kv | null | psql (reference: config/config.go TxIndexConfig +
+    # the psql sink under internal/state/indexer/sink/psql)
+    indexer: list[str] = field(default_factory=lambda: ["kv"])
+    # DSN for the "psql" sink: sqlite:<path>, sqlite::memory:, or
+    # postgres://... (needs psycopg). Empty = sqlite file in the data dir.
+    psql_conn: str = ""
 
 
 @dataclass

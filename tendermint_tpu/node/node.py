@@ -145,6 +145,19 @@ class Node(Service):
                 sinks.append(KVSink(_db("tx_index")))
             elif kind == "null":
                 sinks.append(NullSink())
+            elif kind == "psql":
+                # reference: indexer/sink/psql — SQL schema sink
+                from ..state.sink_sql import SQLSink
+
+                dsn = cfg.tx_index.psql_conn or (
+                    "sqlite:"
+                    + os.path.join(
+                        cfg.base.path(cfg.base.db_dir), "tx_index.sqlite"
+                    )
+                )
+                sinks.append(
+                    SQLSink(dsn, chain_id=self.genesis.chain_id)
+                )
             else:
                 raise ValueError(f"unknown indexer {kind!r}")
         self.indexer = IndexerService(sinks or [NullSink()], self.event_bus)
@@ -159,7 +172,21 @@ class Node(Service):
         self.privval_listener = None
         self.privval_pub_key = None
         if cfg.base.mode == MODE_VALIDATOR:
-            if cfg.priv_validator.listen_addr:
+            if cfg.priv_validator.listen_addr.startswith("grpc://"):
+                # node dials a gRPC signer (reference: node/setup.go:586
+                # "grpc" scheme -> DialRemoteSigner); started (and its
+                # lifecycle owned) via privval_listener like the socket
+                # variant
+                from ..privval.grpc import GRPCSignerClient
+                from ..privval.signer import RetrySignerClient
+
+                client = GRPCSignerClient(cfg.priv_validator.listen_addr)
+                self.privval_listener = client
+                # same retry envelope as the socket path: a signer that
+                # is not up yet (or blips) must not abort node.start();
+                # refusals (double-sign) still propagate immediately
+                self.privval = RetrySignerClient(client)
+            elif cfg.priv_validator.listen_addr:
                 # remote signer dials in (reference:
                 # privval/signer_listener_endpoint.go via
                 # createAndStartPrivValidatorSocketClient)
@@ -619,6 +646,13 @@ class Node(Service):
                         "error stopping service", svc=svc.name, err=str(e)
                     )
         self.peer_manager.flush()
+        for sink in getattr(self.indexer, "sinks", ()):
+            close = getattr(sink, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception as e:
+                    self.logger.error("error closing sink", err=str(e))
         for db in self._dbs:
             try:
                 db.close()

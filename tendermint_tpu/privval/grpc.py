@@ -1,0 +1,226 @@
+"""gRPC remote signer — the reference's second privval transport
+(reference: privval/grpc/{client.go,server.go,util.go}).
+
+Arrangement is inverted from the raw-socket signer (privval/signer.py):
+the SIGNER runs a gRPC server and the NODE dials it
+(reference: node/setup.go:548 DialRemoteSigner, selected by a
+`grpc://` scheme on the priv-validator listen address,
+node/setup.go:586). Double-sign protection still lives with the key in
+the signer process's FilePV.
+
+Like the ABCI gRPC transport (abci/grpc_transport.py), the three RPCs
+— GetPubKey, SignVote, SignProposal, mirroring proto/tendermint/privval
+PrivValidatorAPI — carry hand-rolled deterministic proto bodies through
+identity (de)serializers, so no generated stubs are needed.
+
+Error contract (reference client.go maps grpc status straight out):
+signer-side refusals (double-sign!) surface as RemoteSignerError and
+are never retried; transport-shaped failures surface as
+RemoteSignerConnectionError (gRPC reconnects under the hood).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import grpc
+from grpc import aio as grpc_aio
+
+from ..crypto.keys import PrivKey, PubKey, pubkey_from_proto, pubkey_to_proto
+from ..encoding.proto import FieldReader, ProtoWriter
+from ..libs.log import get_logger
+from ..libs.service import Service
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+from .signer import RemoteSignerConnectionError, RemoteSignerError
+from .types import PrivValidator
+
+__all__ = ["GRPCSignerServer", "GRPCSignerClient"]
+
+_SERVICE = "tendermint_tpu.privval.PrivValidatorAPI"
+_GET_PUB_KEY = "GetPubKey"
+_SIGN_VOTE = "SignVote"
+_SIGN_PROPOSAL = "SignProposal"
+
+# transport-shaped gRPC codes -> retryable connection error; everything
+# else is a signer-side refusal (reference: InvalidArgument for signing
+# errors, NotFound for pubkey, client.go maps them straight out)
+_TRANSPORT_CODES = frozenset(
+    {
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.CANCELLED,
+        grpc.StatusCode.UNKNOWN,
+    }
+)
+
+
+def _strip_scheme(addr: str) -> str:
+    for scheme in ("grpc://", "tcp://"):
+        if addr.startswith(scheme):
+            return addr[len(scheme):]
+    return addr
+
+
+def _req(chain_id: str, payload: bytes = b"") -> bytes:
+    w = ProtoWriter()
+    w.string(1, chain_id)
+    if payload:
+        w.bytes(2, payload)
+    return w.finish()
+
+
+def _resp(payload: bytes) -> bytes:
+    w = ProtoWriter()
+    w.bytes(1, payload)
+    return w.finish()
+
+
+class GRPCSignerServer(Service):
+    """The signer process: serves a FilePV over gRPC
+    (reference: privval/grpc/server.go SignerServer)."""
+
+    def __init__(
+        self,
+        listen_addr: str,
+        chain_id: str,
+        pv,  # FilePV (key + last-sign state)
+    ) -> None:
+        super().__init__(
+            name="privval-grpc-server", logger=get_logger("privval.grpc")
+        )
+        self.listen_addr = _strip_scheme(listen_addr)
+        self.chain_id = chain_id
+        self.pv = pv
+        self._server: Optional[grpc_aio.Server] = None
+        self.bound_port: Optional[int] = None
+
+    async def on_start(self) -> None:
+        self._server = grpc_aio.server()
+        handlers = {
+            _GET_PUB_KEY: grpc.unary_unary_rpc_method_handler(
+                self._get_pub_key
+            ),
+            _SIGN_VOTE: grpc.unary_unary_rpc_method_handler(
+                self._sign_vote
+            ),
+            _SIGN_PROPOSAL: grpc.unary_unary_rpc_method_handler(
+                self._sign_proposal
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self.bound_port = self._server.add_insecure_port(self.listen_addr)
+        await self._server.start()
+        self.logger.info(
+            "privval grpc signer listening", port=self.bound_port
+        )
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.5)
+            self._server = None
+
+    # -- handlers (reference: server.go GetPubKey/SignVote/SignProposal) --
+
+    async def _get_pub_key(self, request: bytes, context) -> bytes:
+        try:
+            pk = await self.pv.get_pub_key()
+            return _resp(pubkey_to_proto(pk))
+        except Exception as e:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"error getting pubkey: {e}"
+            )
+
+    async def _sign_vote(self, request: bytes, context) -> bytes:
+        r = FieldReader(request)
+        chain_id = r.string(1)
+        try:
+            vote = Vote.from_proto(r.bytes(2))
+            await self.pv.sign_vote(chain_id, vote)
+            return _resp(vote.to_proto())
+        except Exception as e:
+            # double-sign refusals land here: InvalidArgument, exactly
+            # like the reference server, so the client never retries
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"error signing vote: {e}",
+            )
+
+    async def _sign_proposal(self, request: bytes, context) -> bytes:
+        r = FieldReader(request)
+        chain_id = r.string(1)
+        try:
+            proposal = Proposal.from_proto(r.bytes(2))
+            await self.pv.sign_proposal(chain_id, proposal)
+            return _resp(proposal.to_proto())
+        except Exception as e:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"error signing proposal: {e}",
+            )
+
+
+class GRPCSignerClient(Service, PrivValidator):
+    """The node's PrivValidator dialing a gRPC signer
+    (reference: privval/grpc/client.go SignerClient +
+    util.go DialRemoteSigner)."""
+
+    def __init__(self, addr: str, timeout: float = 5.0) -> None:
+        Service.__init__(
+            self, name="privval-grpc-client", logger=get_logger("privval.grpc")
+        )
+        self.addr = _strip_scheme(addr)
+        self.timeout = timeout
+        self._channel: Optional[grpc_aio.Channel] = None
+        self._calls = {}
+
+    async def on_start(self) -> None:
+        self._channel = grpc_aio.insecure_channel(self.addr)
+        for method in (_GET_PUB_KEY, _SIGN_VOTE, _SIGN_PROPOSAL):
+            self._calls[method] = self._channel.unary_unary(
+                f"/{_SERVICE}/{method}",
+                request_serializer=None,
+                response_deserializer=None,
+            )
+
+    async def on_stop(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None
+            self._calls = {}
+
+    async def _call(self, method: str, payload: bytes) -> bytes:
+        call = self._calls.get(method)
+        if call is None:
+            raise RemoteSignerConnectionError("grpc signer client not started")
+        try:
+            return await call(payload, timeout=self.timeout)
+        except grpc_aio.AioRpcError as e:
+            msg = f"grpc signer: {e.code().name}: {e.details()}"
+            if e.code() in _TRANSPORT_CODES:
+                raise RemoteSignerConnectionError(msg) from e
+            raise RemoteSignerError(msg) from e
+
+    # -- PrivValidator --
+
+    async def get_pub_key(self) -> PubKey:
+        data = await self._call(_GET_PUB_KEY, _req(""))
+        return pubkey_from_proto(FieldReader(data).bytes(1))
+
+    async def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        data = await self._call(
+            _SIGN_VOTE, _req(chain_id, vote.to_proto())
+        )
+        signed = Vote.from_proto(FieldReader(data).bytes(1))
+        vote.signature = signed.signature
+        vote.timestamp_ns = signed.timestamp_ns
+
+    async def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        data = await self._call(
+            _SIGN_PROPOSAL, _req(chain_id, proposal.to_proto())
+        )
+        signed = Proposal.from_proto(FieldReader(data).bytes(1))
+        proposal.signature = signed.signature
+        proposal.timestamp_ns = signed.timestamp_ns

@@ -681,16 +681,21 @@ class Environment:
     # -- tx / block search (reference: internal/rpc/core/tx.go,
     #    blocks.go:244 BlockSearch) --
 
-    def _kv_sink(self) -> EventSink:
+    def _search_sink(self) -> EventSink:
+        """First search-capable sink. The reference only serves search
+        from its kv sink (the psql sink defers to raw SQL,
+        indexer/sink/psql/psql.go:238-256); our SQL sink answers the
+        read surface too, so any sink except Null qualifies."""
         for s in self.event_sinks:
-            if s.type() == "kv":
+            if s.type() in ("kv", "psql"):
                 return s
         raise RPCError(
-            INTERNAL_ERROR, "tx indexing is disabled (no kv sink)"
+            INTERNAL_ERROR,
+            "tx indexing is disabled (no search-capable sink)",
         )
 
     async def tx(self, req: RPCRequest):
-        sink = self._kv_sink()
+        sink = self._search_sink()
         h = _decode_hash_param(req.params)
         res = sink.get_tx_by_hash(h)
         if res is None:
@@ -704,7 +709,7 @@ class Environment:
         }
 
     async def tx_search(self, req: RPCRequest):
-        sink = self._kv_sink()
+        sink = self._search_sink()
         query = req.params.get("query")
         if not isinstance(query, str):
             raise RPCError(INVALID_PARAMS, "missing query param")
@@ -730,7 +735,7 @@ class Environment:
         }
 
     async def block_search(self, req: RPCRequest):
-        sink = self._kv_sink()
+        sink = self._search_sink()
         query = req.params.get("query")
         if not isinstance(query, str):
             raise RPCError(INVALID_PARAMS, "missing query param")

@@ -1,0 +1,131 @@
+"""Database key migration — legacy string-prefixed keys to the
+binary-prefix layout.
+
+reference: scripts/keymigrate/migrate.go + the `tendermint key-migrate`
+command (cmd/tendermint/commands/key_migrate.go). The reference
+translates its v0.34 ASCII key formats (``H:1``, ``P:1:0``, ``C:0``,
+``SC:1``, ``BH:<hex>``, ``validatorsKey:…``, ``stateKey``) into the
+v0.35 orderedcode layout; this framework's current layout is the
+analogous binary one (prefix byte + big-endian height —
+store/block_store.py, state/store.py), so the same legacy formats
+migrate into it. Values are carried over unchanged — the wire
+encodings already match the reference's protos — except where the
+legacy VALUE format differed (``BH:`` stored the height as ASCII
+decimal; it becomes the 8-byte big-endian the hash index reads).
+
+Migration is resumable and idempotent: legacy keys are detected by
+prefix, so a re-run (or a crash partway) skips everything already
+translated, matching the reference's "safe to resume" contract
+(migrate.go:40-44).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..state import store as state_store
+from ..store import block_store
+from .kv import KVStore
+
+__all__ = ["migrate_db", "CONTEXTS"]
+
+
+def _int(b: bytes) -> int:
+    return int(b.decode())
+
+
+def _h(height: int) -> bytes:
+    return struct.pack(">q", height)
+
+
+def _migrate_blockstore(key: bytes) -> Optional[Tuple[bytes, Callable]]:
+    """legacy key -> (new key, value translator) or None if not legacy.
+    reference formats: migrate.go:116-160."""
+    ident = lambda v: v  # noqa: E731
+    if key.startswith(b"H:"):
+        return block_store._meta_key(_int(key[2:])), ident
+    if key.startswith(b"P:"):
+        height, _, part = key[2:].partition(b":")
+        return (
+            block_store._part_key(_int(height), _int(part)),
+            ident,
+        )
+    if key.startswith(b"C:"):
+        return block_store._commit_key(_int(key[2:])), ident
+    if key.startswith(b"SC:"):
+        # the current layout keeps only the LATEST seen commit under a
+        # single key; migrate_db resolves the max-height winner
+        return block_store._seen_commit_key(), ident
+    if key.startswith(b"BH:"):
+        # legacy: hex hash key, ASCII-decimal height value
+        return (
+            block_store._hash_key(bytes.fromhex(key[3:].decode())),
+            lambda v: _h(_int(v)),
+        )
+    return None
+
+
+def _migrate_state(key: bytes) -> Optional[Tuple[bytes, Callable]]:
+    ident = lambda v: v  # noqa: E731
+    if key == b"stateKey":
+        return state_store._STATE, ident
+    if key.startswith(b"validatorsKey:"):
+        return state_store._vals_key(_int(key[14:])), ident
+    if key.startswith(b"consensusParamsKey:"):
+        return state_store._params_key(_int(key[19:])), ident
+    if key.startswith(b"abciResponsesKey:"):
+        return state_store._abci_key(_int(key[17:])), ident
+    return None
+
+
+CONTEXTS: Dict[str, Callable] = {
+    "blockstore": _migrate_blockstore,
+    "state": _migrate_state,
+}
+
+
+def migrate_db(db: KVStore, context: str) -> int:
+    """Translate every legacy-format key in `db`; returns the count.
+    Unknown contexts (tx_index, evidence, light, peerstore — born in
+    the current layout here) are no-ops, mirroring the reference's
+    per-context dispatch."""
+    fn = CONTEXTS.get(context)
+    if fn is None:
+        return 0
+    moves: List[Tuple[bytes, bytes, bytes]] = []  # old, new, value
+    seen_commit_best = None  # (height, old_key, value)
+    for key, value in list(db.iterate(None, None)):
+        try:
+            res = fn(bytes(key))
+        except (ValueError, UnicodeDecodeError):
+            continue  # not a well-formed legacy key: leave it alone
+        if res is None:
+            continue
+        new_key, xform = res
+        if context == "blockstore" and bytes(key).startswith(b"SC:"):
+            height = _int(bytes(key)[3:])
+            if (
+                seen_commit_best is None
+                or height > seen_commit_best[0]
+            ):
+                if seen_commit_best is not None:
+                    # the previous best is superseded: delete only
+                    moves.append((seen_commit_best[1], b"", b""))
+                seen_commit_best = (height, bytes(key), value)
+            else:
+                moves.append((bytes(key), b"", b""))  # delete only
+            continue
+        moves.append((bytes(key), new_key, xform(value)))
+    if seen_commit_best is not None:
+        _, old_key, value = seen_commit_best
+        moves.append(
+            (old_key, block_store._seen_commit_key(), value)
+        )
+    migrated = 0
+    for old_key, new_key, value in moves:
+        if new_key:
+            db.set(new_key, value)
+            migrated += 1
+        db.delete(old_key)  # delete-only: superseded SC: tombstones
+    return migrated

@@ -448,3 +448,71 @@ def test_e2e_cli_generate_and_run(tmp_path, capsys):
     assert rc == 0, out_text
     report = json.loads(out_text[out_text.index("{"):])
     assert report["ok"] and report["reached_height"] >= 3
+
+
+def test_key_migrate_translates_legacy_layout(tmp_path, capsys):
+    """`key-migrate` rewrites the reference's v0.34-style ASCII keys
+    (H:/P:/C:/SC:/BH:, stateKey/validatorsKey:…) into the current
+    binary-prefix layout, after which BlockStore/StateStore read the
+    data (reference: scripts/keymigrate/migrate.go). Re-running is a
+    no-op (resumable contract)."""
+    import struct
+
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.block_store import BlockStore
+    from tendermint_tpu.store.kv import open_db
+
+    from tests.test_store import make_chain_block
+
+    home = str(tmp_path / "legacy")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "mig-chain") == 0
+    cfg = load_config(os.path.join(home, "config", "config.toml"))
+    cfg_db_dir = cfg.base.path(cfg.base.db_dir)
+
+    # build canonical encodings with the CURRENT store, then rewrite
+    # the db into the legacy key layout
+    block = make_chain_block(3)
+    parts = block.make_part_set()
+    from tendermint_tpu.types import BlockID, Commit, CommitSig
+    from tendermint_tpu.types.block_id import PartSetHeader
+    from tendermint_tpu.types.block_meta import BlockMeta
+
+    meta = BlockMeta.from_block(block, len(block.to_proto()))
+    seen = Commit(
+        height=3,
+        round=0,
+        block_id=BlockID(hash=block.hash(),
+                         part_set_header=parts.header()),
+        signatures=[CommitSig.absent()],
+    )
+    db = open_db("blockstore", "sqlite", cfg_db_dir)
+    db.set(b"H:3", meta.to_proto())
+    for i in range(parts.header().total):
+        db.set(b"P:3:%d" % i, parts.get_part(i).to_proto())
+    db.set(b"C:2", block.last_commit.to_proto())
+    db.set(b"SC:2", seen.to_proto())  # superseded by SC:3
+    db.set(b"SC:3", seen.to_proto())
+    db.set(b"BH:" + block.hash().hex().encode(), b"3")
+    db.close()
+
+    assert run_cli("--home", home, "key-migrate") == 0
+    out = capsys.readouterr().out
+    assert "blockstore" in out and "completed database migration" in out
+
+    db = open_db("blockstore", "sqlite", cfg_db_dir)
+    try:
+        bs = BlockStore(db)
+        assert bs.height() == 3
+        got = bs.load_block(3)
+        assert got is not None and got.hash() == block.hash()
+        assert bs.load_block_meta_by_hash(block.hash()).header.height == 3
+        assert bs.load_seen_commit().height == 3
+        # legacy keys are gone
+        assert db.get(b"H:3") is None and db.get(b"SC:2") is None
+    finally:
+        db.close()
+
+    # second run: nothing legacy left
+    assert run_cli("--home", home, "key-migrate") == 0
+    assert "completed database migration: 0 key(s)" in capsys.readouterr().out
