@@ -1,0 +1,71 @@
+"""Node-driven WAL generator — the replay/crash-test fixture.
+
+reference: internal/consensus/wal_generator.go (WALGenerateNBlocks:
+"boot a node, run it until N blocks, hand back the WAL bytes"). Tests
+that hand-build WAL records exercise the codec but not the real
+sequencing of propose/vote/timeout inputs a live consensus run writes;
+this fixture produces the real thing: a single-validator node over the
+builtin kvstore app runs in-process until `n_blocks` are committed, and
+the WAL file it wrote is returned.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+__all__ = ["generate_wal"]
+
+
+async def generate_wal(
+    home: str,
+    n_blocks: int,
+    chain_id: str = "wal-generator",
+    timeout: float = 60.0,
+    seed: bytes = b"\x57" * 32,
+):
+    """Run a real node until `n_blocks` are committed; returns
+    (wal_path, genesis, priv_key). The node is stopped (WAL closed and
+    flushed) before returning."""
+    from ..config import Config
+    from ..crypto.ed25519 import PrivKeyEd25519
+    from ..node.node import make_node
+    from ..privval.file import FilePV
+    from ..types.genesis import GenesisDoc, GenesisValidator
+
+    priv = PrivKeyEd25519.from_seed(seed)
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator(pub_key=priv.pub_key(), power=10)
+        ],
+    )
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.chain_id = chain_id
+    cfg.base.db_backend = "memdb"
+    cfg.consensus.timeout_commit = 0.05
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.tpu.enable = False  # the fixture is about WAL bytes, not crypto
+    cfg.ensure_dirs()
+    genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+    FilePV.from_priv_key(
+        priv,
+        cfg.base.path(cfg.priv_validator.key_file),
+        cfg.base.path(cfg.priv_validator.state_file),
+    ).save()
+
+    node = make_node(cfg)
+    await node.start()
+    try:
+        await node.consensus.wait_for_height(
+            n_blocks + 1, timeout=timeout
+        )
+    finally:
+        await node.stop()
+    wal_path = cfg.base.path(cfg.consensus.wal_file)
+    assert os.path.exists(wal_path), wal_path
+    return wal_path, genesis, priv
