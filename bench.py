@@ -14,8 +14,13 @@ batch harness, so the measured OpenSSL rate is the baseline and the
 
 `extra` carries the remaining BASELINE.md configs:
   - verify_commit_light p50/p95 latency @ 150 validators (config 3)
-  - verify_commit (all sigs) p50 latency @ 10k validators (config 5's
-    scale, ed25519-only until sr25519 lands)
+  - verify_commit (all sigs) p50 latency @ 10k validators, with a
+    phase breakdown (sign-bytes / dispatch / gather / device-estimate)
+    so the <5 ms target is auditable net of the tunnel RTT
+  - the full config-5 mixed ed25519/sr25519 commits at 1k and 10k
+    validators — both curves on device (ops/{ed25519,sr25519}_kernel)
+  - per-signature batch curves for both key types at the reference
+    harness sizes {1, 8, 64, 1024} (+8192 for ed25519)
   - light-client sequential header sync rate @ 150 validators
     (config 4, measured over a 50-header window)
   - device round-trip latency (the axon tunnel adds ~50 ms per
@@ -283,10 +288,11 @@ def bench_light_sync(n_vals: int = 150, n_headers: int = 50):
     return asyncio.run(go())
 
 
-def bench_batch_curve(sizes=(1, 8, 64, 1024), reps=5):
+def bench_batch_curve(sizes=(1, 8, 64, 1024), reps=5, key_type="ed25519"):
     """Per-signature cost through the BatchVerifier seam at the
     reference harness's batch sizes, Add() overhead included
     (reference: crypto/ed25519/bench_test.go:30-67,
+    crypto/sr25519/bench_test.go:30,
     crypto/internal/benchmarking/bench.go:27-63). Returns
     {batch_size: us/sig}."""
     from tendermint_tpu.crypto import tpu_verifier
@@ -294,10 +300,16 @@ def bench_batch_curve(sizes=(1, 8, 64, 1024), reps=5):
     from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
 
     tpu_verifier.install(min_batch=2)
+    if key_type == "sr25519":
+        from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+        key_cls = PrivKeySr25519
+    else:
+        key_cls = PrivKeyEd25519
     out = {}
     for n in sizes:
         privs = [
-            PrivKeyEd25519.from_seed(int(i).to_bytes(4, "big") + b"\x55" * 28)
+            key_cls.from_seed(int(i).to_bytes(4, "big") + b"\x55" * 28)
             for i in range(min(n, 64))
         ]
         triples = []
@@ -323,6 +335,61 @@ def bench_batch_curve(sizes=(1, 8, 64, 1024), reps=5):
         per_sig = (time.perf_counter() - t0) / reps / n
         out[str(n)] = round(per_sig * 1e6, 1)
     return out
+
+
+def bench_commit_breakdown(n_vals: int = 10_000, reps: int = 5):
+    """Where a big commit verification's wall time goes — the
+    auditability half of the <5 ms 10k-validator target (BASELINE 5):
+
+      sign_bytes_ms  host: canonical vote encoding for every signature
+      dispatch_ms    host: byte joins + digest/program dispatch (async)
+      gather_ms      device program + transfer + tunnel round-trip
+      device_est_ms  gather_ms minus the measured per-call RTT — the
+                     on-device estimate a local (untunneled) chip would
+                     see as its floor
+
+    Uses the kernel verifier directly (same code path the seam's
+    TpuEd25519BatchVerifier drives) so the phases are separable; the
+    module-shared instance is reused so the 12288-bucket program
+    bench_commit_latency(10k) already compiled is not compiled twice."""
+    from tendermint_tpu.ops import ed25519_kernel as K
+
+    chain_id = f"bd-{n_vals}"
+    vals, commit = _make_commit(n_vals, chain_id)
+    by_addr = {v.address: v for v in vals.validators}
+    if K._DEFAULT is None:
+        K.batch_verify_host([], [], [])  # materialize the shared instance
+    verifier = K._DEFAULT
+    rtt_ms = bench_device_rtt()
+
+    def phases():
+        t0 = time.perf_counter()
+        pks, msgs, sigs = [], [], []
+        for idx, cs in enumerate(commit.signatures):
+            v = by_addr[cs.validator_address]
+            pks.append(v.pub_key.bytes())
+            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            sigs.append(cs.signature)
+        t1 = time.perf_counter()
+        handle = verifier.dispatch(pks, msgs, sigs)
+        t2 = time.perf_counter()
+        ok = verifier.gather(handle)
+        t3 = time.perf_counter()
+        assert bool(ok.all())
+        return (t1 - t0, t2 - t1, t3 - t2)
+
+    phases()  # warm/compile
+    rows = [phases() for _ in range(reps)]
+    rows.sort(key=lambda r: sum(r))
+    sb, dp, ga = rows[len(rows) // 2]
+    return {
+        "sign_bytes_ms": round(sb * 1e3, 2),
+        "dispatch_ms": round(dp * 1e3, 2),
+        "gather_ms": round(ga * 1e3, 2),
+        "device_est_ms": round(max(ga * 1e3 - rtt_ms, 0.0), 2),
+        "rtt_ms": round(rtt_ms, 2),
+        "bucket": verifier._bucket(n_vals),
+    }
 
 
 def bench_device_rtt():
@@ -430,24 +497,37 @@ def main() -> None:
     )
     p50_mixed = None
     mixed_err = None
+    p50_mixed_10k = None
+    breakdown = None
+    curve_sr = None
     if fallback:
         p50_10k = p95_10k = None
     else:
         p50_10k, p95_10k = bench_commit_latency(
             10_000, reps=10, light=False
         )
-        # BASELINE config 5 shape: mixed ed25519/sr25519 validator set,
-        # run at 1k validators so the pure-Python sr25519 half (~6 ms
-        # per verify, 500 sigs/run) stays bounded and the ed25519 half
-        # reuses the 512 bucket the 150-validator config already
-        # compiled. Measures the mixed dispatch: ed25519 on device,
-        # sr25519 on the host verifier.
+        try:
+            breakdown = bench_commit_breakdown(10_000, reps=5)
+        except Exception as e:
+            breakdown = {"error": repr(e)}
+        # BASELINE config 5: mixed ed25519/sr25519 validator sets —
+        # both curves on device (ed25519_kernel + sr25519_kernel), the
+        # merlin challenges batched on host (native keccak)
         try:
             p50_mixed, _ = bench_commit_latency(
-                1_000, reps=3, light=False, mixed=True
+                1_000, reps=5, light=False, mixed=True
+            )
+            p50_mixed_10k, _ = bench_commit_latency(
+                10_000, reps=3, light=False, mixed=True
             )
         except Exception as e:
             mixed_err = repr(e)
+        try:
+            curve_sr = bench_batch_curve(
+                sizes=(1, 8, 64, 1024), key_type="sr25519"
+            )
+        except Exception as e:
+            curve_sr = {"error": repr(e)}
     try:
         light_rate = bench_light_sync(n_headers=10 if fallback else 50)
     except Exception as e:  # pragma: no cover - keep the primary line
@@ -455,7 +535,7 @@ def main() -> None:
         light_err = repr(e)
     try:
         curve = bench_batch_curve(
-            sizes=(1, 8) if fallback else (1, 8, 64, 1024)
+            sizes=(1, 8) if fallback else (1, 8, 64, 1024, 8192)
         )
     except Exception as e:  # pragma: no cover
         curve = {"error": repr(e)}
@@ -483,11 +563,18 @@ def main() -> None:
                     "verify_commit_10k_p95_ms": (
                         round(p95_10k, 2) if p95_10k is not None else None
                     ),
+                    "verify_commit_10k_breakdown_ms": breakdown,
                     "verify_commit_1k_mixed_keys_p50_ms": (
                         round(p50_mixed, 2)
                         if p50_mixed is not None
                         else mixed_err
                     ),
+                    "verify_commit_10k_mixed_keys_p50_ms": (
+                        round(p50_mixed_10k, 2)
+                        if p50_mixed_10k is not None
+                        else mixed_err
+                    ),
+                    "sr25519_batch_verify_us_per_sig_by_batch": curve_sr,
                     "light_sync_headers_per_s_150vals": (
                         round(light_rate, 2) if light_rate else light_err
                     ),

@@ -1,0 +1,262 @@
+"""One scripted TPU session: everything round 3 needs from the chip.
+
+Run this the moment the tunneled device grants a claim (it may be
+wedged for hours after an unclean client death — see PERF.md). Stages,
+each persisted to DEVICE_SESSION.json as it completes so a mid-session
+wedge keeps earlier results:
+
+  1. rtt          — per-call tunnel round-trip (context for latencies)
+  2. xla_tput     — pipelined ed25519 throughput at 8192, XLA path
+                    (the post-T-less/projective tree, device-sha512)
+  3. pallas_probe — ONE verify_pallas compile+run at bucket 128 under
+                    a hard budget (TM_PALLAS_BUDGET_S, default 900 s);
+                    Mosaic compile goes through the remote-compile leg
+  4. pallas_tput  — if the probe succeeded: throughput at 8192 with
+                    TM_TPU_PALLAS=1
+  5. sr_tput      — sr25519 device throughput at 8192
+  6. Decision aid — prints whether to flip the Pallas default
+
+SIGTERM-safe: no stage SIGKILLs anything; a watchdog thread only
+*records* overruns, never kills the device client.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+RESULTS = os.path.join(os.path.dirname(__file__), "..", "DEVICE_SESSION.json")
+_state: dict = {"started_unix": time.time(), "stages": {}}
+_save_lock = threading.Lock()
+
+
+def _save() -> None:
+    # atomic replace + lock: the budget reporter thread saves
+    # concurrently with stage completions
+    with _save_lock:
+        tmp = RESULTS + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_state, f, indent=1)
+        os.replace(tmp, RESULTS)
+
+
+def _stage(name: str):
+    def deco(fn):
+        def run():
+            t0 = time.time()
+            try:
+                out = fn()
+                out = {"ok": True, **out}
+            except Exception as e:
+                out = {"ok": False, "error": repr(e)}
+            out["seconds"] = round(time.time() - t0, 1)
+            # merge, don't assign: the budget reporter may already have
+            # recorded over_budget_s in this stage's entry
+            _state["stages"].setdefault(name, {}).update(out)
+            _save()
+            print(f"[{name}] {_state['stages'][name]}", flush=True)
+
+        return run
+
+    return deco
+
+
+def _graceful_exit(signum, frame):
+    _state["interrupted"] = signum
+    _save()
+    sys.exit(128 + signum)
+
+
+signal.signal(signal.SIGTERM, _graceful_exit)
+signal.signal(signal.SIGINT, _graceful_exit)
+
+
+def _batch(n, seed=3):
+    import numpy as np
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    rng = np.random.default_rng(seed)
+    keys = []
+    for _ in range(64):
+        sk = Ed25519PrivateKey.from_private_bytes(
+            rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+        )
+        keys.append(
+            (sk, sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw))
+        )
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        sk, pk = keys[i % 64]
+        msg = b"device-session-%08d" % i
+        pks.append(pk)
+        msgs.append(msg)
+        sigs.append(sk.sign(msg))
+    return pks, msgs, sigs
+
+
+def _throughput(verifier, pks, msgs, sigs, reps=8, depth=4):
+    ok = verifier.verify(pks, msgs, sigs)
+    assert bool(ok.all()), "warm-up failed"
+    t0 = time.perf_counter()
+    handles = []
+    for _ in range(reps):
+        handles.append(verifier.dispatch(pks, msgs, sigs))
+        if len(handles) >= depth:
+            ok = verifier.gather(handles.pop(0))
+    for h in handles:
+        ok = verifier.gather(h)
+    dt = (time.perf_counter() - t0) / reps
+    assert bool(ok.all())
+    return len(pks) / dt
+
+
+@_stage("rtt")
+def stage_rtt():
+    import jax
+    import jax.numpy as jnp
+
+    _state["devices"] = [str(d) for d in jax.devices()]
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.int32)
+    f(x).block_until_ready()
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return {"rtt_ms_p50": round(ts[5] * 1e3, 2)}
+
+
+@_stage("xla_tput")
+def stage_xla():
+    os.environ.pop("TM_TPU_PALLAS", None)
+    from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+    pks, msgs, sigs = _batch(8192)
+    rate = _throughput(Ed25519Verifier(bucket_sizes=[8192]), pks, msgs, sigs)
+    return {"sigs_per_s": round(rate, 1)}
+
+
+@_stage("pallas_probe")
+def stage_pallas_probe():
+    """Time ONE Mosaic compile+run at bucket 128. The budget thread
+    only reports; it never kills the process (a SIGKILL wedges the
+    device claim server-side for hours)."""
+    budget = float(os.environ.get("TM_PALLAS_BUDGET_S", "900"))
+    os.environ["TM_TPU_PALLAS"] = "1"
+    progress = {"t0": time.time(), "done": False}
+
+    def reporter():
+        while not progress["done"]:
+            waited = time.time() - progress["t0"]
+            if waited > budget:
+                _state["stages"].setdefault("pallas_probe", {})[
+                    "over_budget_s"
+                ] = round(waited, 0)
+                _save()
+            time.sleep(30)
+
+    threading.Thread(target=reporter, daemon=True).start()
+    try:
+        from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+        pks, msgs, sigs = _batch(128, seed=5)
+        v = Ed25519Verifier(bucket_sizes=[128])
+        t0 = time.perf_counter()
+        ok = v.verify(pks, msgs, sigs)  # first call: compile + run
+        compile_s = time.perf_counter() - t0
+        assert bool(ok.all())
+        # a Pallas->XLA fallback inside dispatch() would also "pass":
+        # check which program actually served the bucket
+        used_pallas = v._is_pallas(v._compiled.get(v._bucket(128)))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            v.verify(pks, msgs, sigs)
+        warm_s = (time.perf_counter() - t0) / 5
+        return {
+            "compile_s": round(compile_s, 1),
+            "warm_run_s": round(warm_s, 4),
+            "used_pallas": bool(used_pallas),
+        }
+    finally:
+        progress["done"] = True
+        os.environ.pop("TM_TPU_PALLAS", None)
+
+
+@_stage("pallas_tput")
+def stage_pallas_tput():
+    probe = _state["stages"].get("pallas_probe", {})
+    if not (probe.get("ok") and probe.get("used_pallas")):
+        return {"skipped": "pallas probe did not succeed"}
+    os.environ["TM_TPU_PALLAS"] = "1"
+    try:
+        from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+        pks, msgs, sigs = _batch(8192)
+        rate = _throughput(
+            Ed25519Verifier(bucket_sizes=[8192]), pks, msgs, sigs
+        )
+        return {"sigs_per_s": round(rate, 1)}
+    finally:
+        os.environ.pop("TM_TPU_PALLAS", None)
+
+
+@_stage("sr_tput")
+def stage_sr():
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+    from tendermint_tpu.ops.sr25519_kernel import Sr25519Verifier
+
+    privs = [PrivKeySr25519.from_seed(bytes([i, 99]) + b"\x00" * 30)
+             for i in range(64)]
+    pks, msgs, sigs = [], [], []
+    for i in range(8192):
+        p = privs[i % 64]
+        m = b"sr-session-%08d" % i
+        pks.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(p.sign(m))
+    rate = _throughput(
+        Sr25519Verifier(bucket_sizes=[8192]), pks, msgs, sigs, reps=4
+    )
+    return {"sigs_per_s": round(rate, 1)}
+
+
+def main():
+    # persist compilations so a re-run after a wedge resumes fast
+    import jax
+
+    cache = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    for st in (stage_rtt, stage_xla, stage_pallas_probe,
+               stage_pallas_tput, stage_sr):
+        st()
+
+    s = _state["stages"]
+    xla = s.get("xla_tput", {}).get("sigs_per_s")
+    pal = s.get("pallas_tput", {}).get("sigs_per_s")
+    print("\n==== device session summary ====")
+    print(json.dumps(s, indent=1))
+    if xla and pal:
+        print(
+            f"pallas/xla = {pal / xla:.2f}x -> "
+            + ("FLIP the default to Pallas" if pal > xla else "keep XLA")
+        )
+
+
+if __name__ == "__main__":
+    main()
