@@ -29,6 +29,8 @@ import sys
 import threading
 import time
 
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
 RESULTS = os.path.join(os.path.dirname(__file__), "..", "DEVICE_SESSION.json")
 _state: dict = {"started_unix": time.time(), "stages": {}}
 _save_lock = threading.Lock()
@@ -36,8 +38,20 @@ _save_lock = threading.Lock()
 
 def _save() -> None:
     # atomic replace + lock: the budget reporter thread saves
-    # concurrently with stage completions
+    # concurrently with stage completions. Mutations of _state go
+    # through _mutate (same lock) so json.dump never iterates a dict
+    # another thread is inserting into.
     with _save_lock:
+        tmp = RESULTS + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_state, f, indent=1)
+        os.replace(tmp, RESULTS)
+
+
+def _mutate(fn) -> None:
+    """Apply fn(_state) and persist, all under the save lock."""
+    with _save_lock:
+        fn(_state)
         tmp = RESULTS + ".tmp"
         with open(tmp, "w") as f:
             json.dump(_state, f, indent=1)
@@ -56,8 +70,9 @@ def _stage(name: str):
             out["seconds"] = round(time.time() - t0, 1)
             # merge, don't assign: the budget reporter may already have
             # recorded over_budget_s in this stage's entry
-            _state["stages"].setdefault(name, {}).update(out)
-            _save()
+            _mutate(
+                lambda st: st["stages"].setdefault(name, {}).update(out)
+            )
             print(f"[{name}] {_state['stages'][name]}", flush=True)
 
         return run
@@ -109,14 +124,15 @@ def _throughput(verifier, pks, msgs, sigs, reps=8, depth=4):
     assert bool(ok.all()), "warm-up failed"
     t0 = time.perf_counter()
     handles = []
+    all_ok = True
     for _ in range(reps):
         handles.append(verifier.dispatch(pks, msgs, sigs))
         if len(handles) >= depth:
-            ok = verifier.gather(handles.pop(0))
+            all_ok &= bool(verifier.gather(handles.pop(0)).all())
     for h in handles:
-        ok = verifier.gather(h)
+        all_ok &= bool(verifier.gather(h).all())
     dt = (time.perf_counter() - t0) / reps
-    assert bool(ok.all())
+    assert all_ok, "a pipelined batch failed verification"
     return len(pks) / dt
 
 
@@ -161,10 +177,11 @@ def stage_pallas_probe():
         while not progress["done"]:
             waited = time.time() - progress["t0"]
             if waited > budget:
-                _state["stages"].setdefault("pallas_probe", {})[
-                    "over_budget_s"
-                ] = round(waited, 0)
-                _save()
+                _mutate(
+                    lambda st: st["stages"]
+                    .setdefault("pallas_probe", {})
+                    .__setitem__("over_budget_s", round(waited, 0))
+                )
             time.sleep(30)
 
     threading.Thread(target=reporter, daemon=True).start()

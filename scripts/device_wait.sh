@@ -1,0 +1,14 @@
+#!/bin/bash
+# Poll for the tunneled TPU to become claimable again. Each attempt is
+# a short-lived python that goes through the axon sitecustomize claim;
+# a TERM while waiting for the claim is safe (the claim was never
+# granted to us). Exits 0 the moment a device answers.
+for i in $(seq 1 "${1:-120}"); do
+  if timeout --signal=TERM 90 python -c "import jax; print(jax.devices())" >/tmp/device_wait_out 2>&1; then
+    echo "device back after $i attempts: $(cat /tmp/device_wait_out | tail -1)"
+    exit 0
+  fi
+  sleep 60
+done
+echo "device still unreachable after ${1:-120} attempts"
+exit 1

@@ -1,0 +1,109 @@
+"""Round-3 focused device probes, appended to DEVICE_SESSION.json:
+
+  pallas_probe2 — retry the Mosaic compile after the scatter fixes
+  pallas_tput2  — pallas throughput at 8192 if the probe held
+  xla_hostsha   — XLA throughput with host-side SHA-512 (A/B against
+                  the device-hash path, chasing the 45k vs 67k gap)
+
+SIGTERM-safe, never SIGKILLs the device client (see device_session.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+from device_session import (  # noqa: E402
+    RESULTS,
+    _batch,
+    _save,
+    _stage,
+    _state,
+    _throughput,
+)
+
+if os.path.exists(RESULTS):
+    with open(RESULTS) as f:
+        prev = json.load(f)
+    _state["stages"].update(prev.get("stages", {}))
+    _state["devices"] = prev.get("devices")
+
+
+@_stage("pallas_probe2")
+def stage_probe2():
+    os.environ["TM_TPU_PALLAS"] = "1"
+    try:
+        from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+        pks, msgs, sigs = _batch(128, seed=5)
+        v = Ed25519Verifier(bucket_sizes=[128])
+        t0 = time.perf_counter()
+        ok = v.verify(pks, msgs, sigs)
+        compile_s = time.perf_counter() - t0
+        assert bool(ok.all())
+        used_pallas = v._is_pallas(v._compiled.get(v._bucket(128)))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            v.verify(pks, msgs, sigs)
+        warm_s = (time.perf_counter() - t0) / 5
+        return {
+            "compile_s": round(compile_s, 1),
+            "warm_run_s": round(warm_s, 4),
+            "used_pallas": bool(used_pallas),
+        }
+    finally:
+        os.environ.pop("TM_TPU_PALLAS", None)
+
+
+@_stage("pallas_tput2")
+def stage_tput2():
+    probe = _state["stages"].get("pallas_probe2", {})
+    if not (probe.get("ok") and probe.get("used_pallas")):
+        return {"skipped": "pallas probe2 did not hold"}
+    os.environ["TM_TPU_PALLAS"] = "1"
+    try:
+        from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+        pks, msgs, sigs = _batch(8192)
+        v = Ed25519Verifier(bucket_sizes=[8192])
+        rate = _throughput(v, pks, msgs, sigs)
+        still_pallas = v._is_pallas(v._compiled.get(v._bucket(8192)))
+        return {"sigs_per_s": round(rate, 1), "used_pallas": bool(still_pallas)}
+    finally:
+        os.environ.pop("TM_TPU_PALLAS", None)
+
+
+@_stage("xla_hostsha")
+def stage_hostsha():
+    os.environ.pop("TM_TPU_PALLAS", None)
+    os.environ["TM_TPU_HOST_SHA512"] = "1"
+    try:
+        from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+        pks, msgs, sigs = _batch(8192)
+        rate = _throughput(Ed25519Verifier(bucket_sizes=[8192]), pks, msgs, sigs)
+        return {"sigs_per_s": round(rate, 1)}
+    finally:
+        os.environ.pop("TM_TPU_HOST_SHA512", None)
+
+
+def main():
+    import jax
+
+    cache = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    for st in (stage_probe2, stage_tput2, stage_hostsha):
+        st()
+    print(json.dumps(_state["stages"], indent=1))
+
+
+if __name__ == "__main__":
+    main()

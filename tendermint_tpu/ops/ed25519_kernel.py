@@ -100,37 +100,46 @@ def _build_neg_a_table(A: jnp.ndarray) -> jnp.ndarray:
 
 def _onehot_select(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """table (16, 4, L, {N|1}), idx (N,) -> (4, L, N) via 16-way masked
-    accumulate (no per-lane gather)."""
-    js = jnp.arange(16, dtype=idx.dtype)
-    mask = (idx[None, :] == js[:, None]).astype(table.dtype)  # (16, N)
+    accumulate (no per-lane gather). broadcasted_iota (not arange):
+    Mosaic rejects rank-1 iota."""
+    js = lax.broadcasted_iota(idx.dtype, (16, idx.shape[0]), 0)
+    mask = (idx[None, :] == js).astype(table.dtype)  # (16, N)
     return jnp.sum(table * mask[:, None, None, :], axis=0)
 
 
-def dual_mult_sb_minus_ka(A: jnp.ndarray, dS: jnp.ndarray, dk: jnp.ndarray) -> jnp.ndarray:
+def dual_mult_sb_minus_ka(
+    A: jnp.ndarray,
+    dS: jnp.ndarray,
+    dk: jnp.ndarray,
+    mosaic: bool = False,
+) -> jnp.ndarray:
     """[S]B - [k]A as a T-less (3, NLIMBS, N) projective stack.
 
     A: (4, L, N) extended point; dS/dk: (64, N) int32 radix-16 digits,
-    little-endian. One lax.scan over 64 windows (fixed trip count):
-    Horner `acc <- 16*acc + dk_w*(-A) + dS_w*B` with a per-signature
-    16-entry cached table of -A built on device and a constant niels
-    table of B. Shared by the ed25519 program (cofactored compare
-    follows) and the sr25519/ristretto program (ristretto equality
-    follows, ops/sr25519_kernel.py)."""
+    little-endian. 64 windows, most significant first, Horner
+    `acc <- 16*acc + dk_w*(-A) + dS_w*B` with a per-signature 16-entry
+    cached table of -A built on device and a constant niels table of B.
+    Shared by the ed25519 program (cofactored compare follows) and the
+    sr25519/ristretto program (ristretto equality follows,
+    ops/sr25519_kernel.py).
+
+    Two window-walk forms, same math:
+    - mosaic=False (XLA default): lax.scan over pre-flipped digit rows.
+    - mosaic=True (the Pallas tile): lax.fori_loop; the window's digit
+      row is picked by a one-hot masked sum because Mosaic lowers
+      neither scan's xs dynamic_slice nor jnp.flip's rev. 64 extra
+      MACs/window are noise next to the point ops."""
     TA = _build_neg_a_table(A)  # (16, 4, L, N)
 
     tb0 = _tb0()  # (16, 4, L, 1)
-    # scan from the most significant window down
-    dS_steps = jnp.flip(dS, axis=0)  # (64, N)
-    dk_steps = jnp.flip(dk, axis=0)
 
-    # The scan carry is the T-less 3-stack (X, Y, Z): doublings never
+    # The carry is the T-less 3-stack (X, Y, Z): doublings never
     # read T and the final comparison is projective, so only the ops
     # feeding an addition materialize T (point ops drop the T output
     # mul otherwise — 25% of each output multiply).
     acc0 = E.identity(A.shape[-1])[..., :3, :, :]
 
-    def body(acc, xs):
-        ds_w, dk_w = xs
+    def step(acc, ds_w, dk_w):
         acc = lax.fori_loop(
             0, 3, lambda _i, a: E.point_double(a, with_t=False), acc
         )
@@ -139,13 +148,30 @@ def dual_mult_sb_minus_ka(A: jnp.ndarray, dS: jnp.ndarray, dk: jnp.ndarray) -> j
         acc = E.point_add_cached(
             acc, _onehot_select(tb0, ds_w), with_t=False
         )
-        return acc, None
+        return acc
 
-    acc, _ = lax.scan(body, acc0, (dS_steps, dk_steps))
+    if mosaic:
+        rows = lax.broadcasted_iota(dS.dtype, dS.shape, 0)  # (64, N)
+
+        def body(w, acc):
+            sel = (rows == 63 - w).astype(dS.dtype)  # MSB-first walk
+            return step(
+                acc, jnp.sum(dS * sel, axis=0), jnp.sum(dk * sel, axis=0)
+            )
+
+        return lax.fori_loop(0, 64, body, acc0)
+
+    def scan_body(acc, xs):
+        ds_w, dk_w = xs
+        return step(acc, ds_w, dk_w), None
+
+    acc, _ = lax.scan(
+        scan_body, acc0, (jnp.flip(dS, axis=0), jnp.flip(dk, axis=0))
+    )
     return acc
 
 
-def _scalar_mult_check(yA, signA, yR, signR, dS, dk) -> jnp.ndarray:
+def _scalar_mult_check(yA, signA, yR, signR, dS, dk, mosaic=False) -> jnp.ndarray:
     """Core device program. Batch axis minor.
 
     yA/yR: (L, N) field elements; signA/signR: (N,) int32;
@@ -154,7 +180,7 @@ def _scalar_mult_check(yA, signA, yR, signR, dS, dk) -> jnp.ndarray:
     """
     A, okA = E.decompress(yA, signA)
     R, okR = E.decompress(yR, signR)
-    acc = dual_mult_sb_minus_ka(A, dS, dk)
+    acc = dual_mult_sb_minus_ka(A, dS, dk, mosaic=mosaic)
     # ZIP-215 cofactored equation, rearranged so nothing needs T:
     # [8]([S]B - [k]A) == [8]R  <=>  [8]([S]B - [k]A - R) == identity.
     for _ in range(3):  # cofactor 8, both sides
@@ -193,6 +219,12 @@ def _bytes_const(value: int, k: int) -> np.ndarray:
 _C8 = _bytes_const(_DELTA16_INT, 17)
 _L8 = _bytes_const(_L_INT, 32)
 
+# (32, 1) AND-mask clearing the sign bit of byte row 31 — the
+# mask-select form of `.at[31].set(b & 0x7F)`; jnp scatter updates
+# have no Pallas TPU lowering (Mosaic: "Unimplemented ... scatter")
+_TOPCLEAR = np.full((32, 1), 0xFF, dtype=np.int32)
+_TOPCLEAR[31, 0] = 0x7F
+
 
 def _fe_from_bytes_dev(b: jnp.ndarray) -> jnp.ndarray:
     """(32, N) int32 byte rows (bit 7 of row 31 already cleared) ->
@@ -216,10 +248,11 @@ def _norm8(x: jnp.ndarray, passes: int) -> jnp.ndarray:
     limbs land in [0, 2^8), the top limb keeps the value's sign. A
     ripple can travel one limb per round, so `passes` >= rows for full
     canonicalization; 2 for loose bounding between multiplies."""
+    zero = jnp.zeros_like(x[:1])
     for _ in range(passes):
         c = x[:-1] >> 8
         x = jnp.concatenate([x[:-1] - (c << 8), x[-1:]], axis=0)
-        x = x.at[1:].add(c)
+        x = x + jnp.concatenate([zero, c], axis=0)
     return x
 
 
@@ -255,16 +288,18 @@ def _mod_l_dev(d: jnp.ndarray) -> jnp.ndarray:
     x = _norm8(x, 36)  # canonical lower limbs, signed top
     lo = jnp.pad(x[:32], ((0, 1), (0, 0)))
     x = _norm8(lo - _mul_c8(x[32:], 33), 34)
-    neg = (x[-1] < 0).astype(jnp.int32)
-    x = x.at[:32].add(neg[None, :] * jnp.asarray(_L8))
+    l8_33 = jnp.asarray(np.pad(_L8, ((0, 1), (0, 0))))
+    # x[32], not x[-1]: jnp lowers negative indices via dynamic_slice,
+    # which Mosaic (Pallas TPU) cannot lower
+    neg = (x[32] < 0).astype(jnp.int32)
+    x = x + neg[None, :] * l8_33
     x = _norm8(x, 34)
     # value < 2^257: bits 252..255 in row 31, bit 256 in row 32
     q = (x[31] >> 4) + (x[32] << 4)
-    l8_33 = jnp.asarray(np.pad(_L8, ((0, 1), (0, 0))))
     x = x - q[None, :] * l8_33
     x = _norm8(x, 34)
-    neg = (x[-1] < 0).astype(jnp.int32)
-    x = x.at[:32].add(neg[None, :] * jnp.asarray(_L8))
+    neg = (x[32] < 0).astype(jnp.int32)
+    x = x + neg[None, :] * l8_33
     return _norm8(x, 34)[:32]
 
 
@@ -296,30 +331,31 @@ def _nibbles_dev(b: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([lo, hi], axis=1).reshape(64, b.shape[1])
 
 
-def _verify_tile(pk_b, sig_b, dig_b) -> jnp.ndarray:
+def _verify_tile(pk_b, sig_b, dig_b, mosaic: bool = False) -> jnp.ndarray:
     """The full device program: byte rows in, validity bitmap out.
 
     pk_b (32, N), sig_b (64, N) uint8/int32 byte rows; dig_b (64, N)
     SHA-512(R||A||M) byte rows. Returns (N,) bool.
 
     Pure jnp on values — the same body runs as a jitted XLA program
-    (CPU and fallback) and as the per-tile body of the fused Pallas
-    kernel (ops/ed25519_pallas.py)."""
+    (CPU and fallback) and, with mosaic=True (Mosaic-lowerable window
+    walk, see dual_mult_sb_minus_ka), as the per-tile body of the
+    fused Pallas kernel (ops/ed25519_pallas.py)."""
     pk = pk_b.astype(jnp.int32)
     sig = sig_b.astype(jnp.int32)
     dig = dig_b.astype(jnp.int32)
     signA = pk[31] >> 7
-    pk = pk.at[31].set(pk[31] & 0x7F)
+    pk = pk & _TOPCLEAR
     r = sig[:32]
     signR = r[31] >> 7
-    r = r.at[31].set(r[31] & 0x7F)
+    r = r & _TOPCLEAR
     s = sig[32:]
     yA = _fe_from_bytes_dev(pk)
     yR = _fe_from_bytes_dev(r)
     s_ok = _s_lt_l_dev(s)
     dS = _nibbles_dev(s)
     dk = _nibbles_dev(_mod_l_dev(dig))
-    ok = _scalar_mult_check(yA, signA, yR, signR, dS, dk)
+    ok = _scalar_mult_check(yA, signA, yR, signR, dS, dk, mosaic=mosaic)
     return ok & s_ok
 
 

@@ -59,9 +59,9 @@ def _closed_tile(tile: int = TILE):
     # jax.closure_convert hoists only captured jax arrays; the limb
     # constants here materialize during tracing (np -> jaxpr consts),
     # so lift them straight off the jaxpr instead.
-    cj = jax.make_jaxpr(lambda pk, sig, dig: K._verify_tile(pk, sig, dig))(
-        *avals
-    )
+    cj = jax.make_jaxpr(
+        lambda pk, sig, dig: K._verify_tile(pk, sig, dig, mosaic=True)
+    )(*avals)
     consts = cj.consts
 
     def closed(pk, sig, dig, *hoisted):

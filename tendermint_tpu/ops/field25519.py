@@ -189,8 +189,10 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     low = x[..., :NLIMBS, :]
     hi = x[..., NLIMBS : 2 * NLIMBS, :] * FOLD  # positions 20..39 -> 0..19
     out = low + hi
-    extra = x[..., 2 * NLIMBS, :] * (FOLD * FOLD)
-    out = out.at[..., 0, :].add(extra)
+    extra = x[..., 2 * NLIMBS : 2 * NLIMBS + 1, :] * (FOLD * FOLD)
+    out = jnp.concatenate(
+        [out[..., :1, :] + extra, out[..., 1:, :]], axis=-2
+    )
     # limbs now ≤ 2^13 + 608*2^13 + small < 2^23. TWO passes are needed:
     # after one, limbs 1..19 are ≤ 2^13 + 2^10, but limb 0 picks up the
     # top limb's wraparound carry ×608 (≈ 610*608 ≈ 2^18.5) — outside the
@@ -243,8 +245,10 @@ def sqr(a: jnp.ndarray) -> jnp.ndarray:
     low = x[..., :NLIMBS, :]
     hi = x[..., NLIMBS : 2 * NLIMBS, :] * FOLD
     out = low + hi
-    extra = x[..., 2 * NLIMBS, :] * (FOLD * FOLD)
-    out = out.at[..., 0, :].add(extra)
+    extra = x[..., 2 * NLIMBS : 2 * NLIMBS + 1, :] * (FOLD * FOLD)
+    out = jnp.concatenate(
+        [out[..., :1, :] + extra, out[..., 1:, :]], axis=-2
+    )
     return carry(out)  # two passes — see mul() tail comment
 
 

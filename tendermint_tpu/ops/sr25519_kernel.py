@@ -41,6 +41,7 @@ from ..crypto import ed25519_math as em
 from . import field25519 as F
 from .ed25519_kernel import (
     DEFAULT_BUCKET_SIZES,
+    _TOPCLEAR,
     _bytes_const,
     _fe_from_bytes_dev,
     _join_cols,
@@ -103,7 +104,7 @@ def ristretto_decode_dev(
     nonneg = (b[0] & 1) == 0
     canon = _lt_const_dev(b, _P8)  # value < p (bit 255 set fails too)
     s = _fe_from_bytes_dev(
-        b.at[31].set(b[31] & 0x7F)
+        b & _TOPCLEAR
     )  # mask bit 255 to keep limb bounds; canon already rejects it
     one = jnp.broadcast_to(F.const_limbs(1), s.shape)
     ss = F.sqr(s)
@@ -156,8 +157,7 @@ def _verify_tile_sr(pk_b, sig_b, k_b) -> jnp.ndarray:
     sig = sig_b.astype(jnp.int32)
     kb = k_b.astype(jnp.int32)
     marker_ok = (sig[63] >> 7) == 1  # schnorrkel v1 marker bit
-    s = sig[32:]
-    s = s.at[31].set(s[31] & 0x7F)
+    s = sig[32:] & _TOPCLEAR
     s_ok = _s_lt_l_dev(s)
     A, okA = ristretto_decode_dev(pk)
     R, okR = ristretto_decode_dev(sig[:32])

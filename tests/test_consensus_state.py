@@ -365,8 +365,10 @@ def test_invalid_proposal_prevoted_nil_and_skipped():
             await n.cs.start()
         try:
             # let height 2 churn one bad round, then lift the corruption
-            await nodes[0].cs.wait_for_height(2, timeout=30.0)
-            deadline = asyncio.get_event_loop().time() + 30.0
+            # (generous timeouts: under full-suite load with a cold XLA
+            # cache, rounds can take tens of seconds of wall time)
+            await nodes[0].cs.wait_for_height(2, timeout=90.0)
+            deadline = asyncio.get_event_loop().time() + 60.0
             while (
                 nodes[0].cs.rs.height == 2 and nodes[0].cs.rs.round < 1
             ):
@@ -375,7 +377,7 @@ def test_invalid_proposal_prevoted_nil_and_skipped():
                     break
             bad_heights.clear()
             await asyncio.gather(
-                *(n.cs.wait_for_height(4, timeout=60.0) for n in nodes)
+                *(n.cs.wait_for_height(4, timeout=120.0) for n in nodes)
             )
         finally:
             for n in nodes:
