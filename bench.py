@@ -365,11 +365,12 @@ def bench_commit_breakdown(n_vals: int = 10_000, reps: int = 5):
 
     def phases():
         t0 = time.perf_counter()
+        all_sb = commit.sign_bytes_batch(chain_id)
         pks, msgs, sigs = [], [], []
         for idx, cs in enumerate(commit.signatures):
             v = by_addr[cs.validator_address]
             pks.append(v.pub_key.bytes())
-            msgs.append(commit.vote_sign_bytes(chain_id, idx))
+            msgs.append(all_sb[idx])
             sigs.append(cs.signature)
         t1 = time.perf_counter()
         handle = verifier.dispatch(pks, msgs, sigs)

@@ -33,29 +33,26 @@ sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")
 
 RESULTS = os.path.join(os.path.dirname(__file__), "..", "DEVICE_SESSION.json")
 _state: dict = {"started_unix": time.time(), "stages": {}}
-_save_lock = threading.Lock()
-
-
-def _save() -> None:
-    # atomic replace + lock: the budget reporter thread saves
-    # concurrently with stage completions. Mutations of _state go
-    # through _mutate (same lock) so json.dump never iterates a dict
-    # another thread is inserting into.
-    with _save_lock:
-        tmp = RESULTS + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(_state, f, indent=1)
-        os.replace(tmp, RESULTS)
+# RLock, not Lock: the SIGTERM handler runs on the main thread and
+# calls _save(); with a plain Lock a signal landing inside _mutate's
+# critical section would self-deadlock — and a TERM that hangs invites
+# the SIGKILL that wedges the device claim.
+_save_lock = threading.RLock()
 
 
 def _mutate(fn) -> None:
-    """Apply fn(_state) and persist, all under the save lock."""
+    """Apply fn(_state) and persist, all under the save lock so
+    json.dump never iterates a dict another thread is inserting into."""
     with _save_lock:
         fn(_state)
         tmp = RESULTS + ".tmp"
         with open(tmp, "w") as f:
             json.dump(_state, f, indent=1)
         os.replace(tmp, RESULTS)
+
+
+def _save() -> None:
+    _mutate(lambda st: None)
 
 
 def _stage(name: str):

@@ -31,6 +31,11 @@ if os.path.exists(RESULTS):
         prev = json.load(f)
     _state["stages"].update(prev.get("stages", {}))
     _state["devices"] = prev.get("devices")
+# drop prior-session entries for the stages this run re-executes:
+# _stage merges (setdefault().update()), so a stale sigs_per_s from an
+# old success would otherwise survive inside a newly-skipped stage
+for _k in ("pallas_probe2", "pallas_tput2", "xla_hostsha"):
+    _state["stages"].pop(_k, None)
 
 
 @_stage("pallas_probe2")

@@ -56,12 +56,20 @@ def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
 def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     """One compression: state (8, N), block (16, N) uint32 -> (8, N).
 
-    Schedule extension and the 64 rounds are lax.scan loops, NOT
-    unrolled python loops: this jaxlib's CPU backend degenerates on the
-    fully-unrolled ~1300-op uint32 rotate/add chain (60s+ compiles and
-    runs that never return), while the scan form compiles a ~30-op body
-    once. On TPU the scan is also the right shape — XLA keeps the tiny
-    body resident and the batch axis fills the VPU lanes."""
+    Backend-conditional at trace time, like sha512_kernel._compress:
+
+    - CPU: lax.scan loops. This jaxlib's CPU backend degenerates on the
+      fully-unrolled ~1300-op uint32 rotate/add chain (60s+ compiles
+      and runs that never return), while the scan form compiles a
+      ~30-op body once.
+    - TPU: fully unrolled. The scan serializes 112 tiny device loops
+      XLA cannot fuse across (the same shape that cost the sha512 path
+      ~24% of ed25519 verify throughput); unrolled, the whole schedule
+      + 64 rounds fuse into a few kernels."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return _compress_unrolled(state, block)
     from jax import lax
 
     def sched_body(last16, _):
@@ -91,6 +99,27 @@ def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
         round_body, state, (w_all, jnp.asarray(_K))
     )
     return state + out
+
+
+def _compress_unrolled(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """Unrolled compression (see _compress): TPU-only trace-time form."""
+    w = [block[i] for i in range(16)]
+    for t in range(16, 64):
+        w15 = w[t - 15]
+        w2 = w[t - 2]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+
+    a, b, c, d, e, f, g, h = (state[i] for i in range(8))
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + np.uint32(_K[t]) + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + s0 + maj
+    return state + jnp.stack([a, b, c, d, e, f, g, h], axis=0)
 
 
 def sha256_fixed(data: jnp.ndarray) -> jnp.ndarray:

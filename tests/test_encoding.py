@@ -115,3 +115,78 @@ def test_iter_fields_fixed_types():
     fields = list(iter_fields(w.finish()))
     assert fields[0][0] == 1 and struct.unpack("<q", struct.pack("<Q", fields[0][2]))[0] == -2
     assert fields[1][0] == 2 and struct.unpack("<i", struct.pack("<I", fields[1][2]))[0] == -3
+
+
+def test_vote_sign_template_matches_full_marshal():
+    """VoteSignTemplate's spliced output must be byte-identical to the
+    full canonical marshal for every flag/timestamp/height shape a
+    commit can contain (the template is the hot path behind
+    Commit.vote_sign_bytes)."""
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.canonical import (
+        PRECOMMIT_TYPE,
+        VoteSignTemplate,
+        vote_sign_bytes,
+    )
+    from tendermint_tpu.types.commit import Commit, CommitSig
+
+    bid = BlockID(
+        hash=b"\x11" * 32,
+        part_set_header=PartSetHeader(total=3, hash=b"\x22" * 32),
+    )
+    for height, round_ in ((1, 0), (77, 4), (2**40, 1)):
+        for blk in (bid, BlockID()):
+            tpl = VoteSignTemplate(
+                "tpl-chain", PRECOMMIT_TYPE, height, round_, blk
+            )
+            for ts in (0, 1, 999_999_999, 1_700_000_000_123_456_789):
+                assert tpl.sign_bytes(ts) == vote_sign_bytes(
+                    "tpl-chain", PRECOMMIT_TYPE, height, round_, blk, ts
+                )
+
+    # and through the Commit cache: mixed for-block / nil signatures
+    sigs = [
+        CommitSig.for_block(b"\x01" * 64, b"\xaa" * 20, 5_000_000_001),
+        CommitSig.for_nil(b"\x02" * 64, b"\xbb" * 20, 6_000_000_002),
+        CommitSig.for_block(b"\x03" * 64, b"\xcc" * 20, 7_000_000_003),
+    ]
+    commit = Commit(height=9, round=2, block_id=bid, signatures=sigs)
+    for i in range(3):
+        assert commit.vote_sign_bytes("tpl-chain", i) == commit.get_vote(
+            i
+        ).sign_bytes("tpl-chain")
+
+
+def test_commit_sign_bytes_batch_matches_per_index():
+    """sign_bytes_batch: None at absent indexes, byte-identical to the
+    per-index path elsewhere, across mixed for-block/nil/absent sets."""
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.commit import Commit, CommitSig
+
+    bid = BlockID(
+        hash=b"\x44" * 32,
+        part_set_header=PartSetHeader(total=1, hash=b"\x55" * 32),
+    )
+    sigs = []
+    for i in range(25):
+        if i % 5 == 3:
+            sigs.append(CommitSig.absent())
+        elif i % 5 == 4:
+            sigs.append(
+                CommitSig.for_nil(
+                    bytes([i]) * 64, bytes([i]) * 20, 10**9 * i + i
+                )
+            )
+        else:
+            sigs.append(
+                CommitSig.for_block(
+                    bytes([i]) * 64, bytes([i]) * 20, 10**9 * i + 7 * i
+                )
+            )
+    commit = Commit(height=12, round=1, block_id=bid, signatures=sigs)
+    batch = commit.sign_bytes_batch("batch-chain")
+    for i, cs in enumerate(sigs):
+        if cs.is_absent():
+            assert batch[i] is None
+        else:
+            assert batch[i] == commit.get_vote(i).sign_bytes("batch-chain")

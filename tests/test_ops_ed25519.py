@@ -166,6 +166,26 @@ def test_sha512_kernel_matches_hashlib():
             assert got[:, i].tobytes() == hashlib.sha512(m).digest()
 
 
+def test_sha512_unrolled_compress_matches_scan_form():
+    """The TPU trace-time compression (_compress unrolled branch) vs
+    the scan form the CPU backend traces — the unrolled branch never
+    runs under JAX_PLATFORMS=cpu, so its math is covered directly."""
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import sha512_kernel as SK
+
+    import unittest.mock as mock
+
+    rng = np.random.default_rng(13)
+    state = jnp.asarray(rng.integers(0, 2**32, (8, 2, 5), dtype=np.uint32))
+    block = jnp.asarray(rng.integers(0, 2**32, (16, 2, 5), dtype=np.uint32))
+    # trace the unrolled branch by bypassing the backend gate
+    with mock.patch("jax.default_backend", return_value="tpu"):
+        got = np.asarray(SK._compress(state, block))
+    want = np.asarray(SK._compress_scan(state, block))
+    assert (got == want).all()
+
+
 def test_mixed_message_lengths_device_digests(verifier):
     """dispatch groups by message length for the device SHA-512 and
     reassembles digests in batch order."""

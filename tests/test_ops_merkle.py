@@ -38,6 +38,21 @@ class TestSha256Kernel:
         for i, m in enumerate(msgs):
             assert got[:, i].tobytes() == hashlib.sha256(m).digest()
 
+    def test_unrolled_compress_matches_scan_form(self):
+        """The TPU trace-time form (_compress_unrolled) against the CPU
+        scan form over random states/blocks — the unrolled branch never
+        traces on the CPU backend, so cover its math directly."""
+        rng = np.random.default_rng(5)
+        state = jnp.asarray(
+            rng.integers(0, 2**32, (8, 9), dtype=np.uint32)
+        )
+        block = jnp.asarray(
+            rng.integers(0, 2**32, (16, 9), dtype=np.uint32)
+        )
+        got = np.asarray(SK._compress_unrolled(state, block))
+        want = np.asarray(SK._compress(state, block))
+        assert (got == want).all()
+
     def test_leaf_and_inner_prefixes(self):
         leaves = [_rand(40) for _ in range(5)]
         got = np.asarray(SK.leaf_hash_batch(_cols(leaves)))
