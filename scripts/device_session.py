@@ -78,8 +78,10 @@ def _stage(name: str):
 
 
 def _graceful_exit(signum, frame):
-    _state["interrupted"] = signum
-    _save()
+    # through _mutate: an unlocked insert here could race the budget
+    # reporter's json.dump (the RLock makes this safe even if the
+    # signal lands while this thread already holds the lock)
+    _mutate(lambda st: st.__setitem__("interrupted", signum))
     sys.exit(128 + signum)
 
 

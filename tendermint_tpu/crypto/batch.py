@@ -45,6 +45,39 @@ def device_factory_installed(key_type: str) -> bool:
     return key_type in _DEVICE_FACTORIES
 
 
+# How many independent commits' signatures callers should merge into
+# one batch verifier when they have several available (the light
+# client's sequential window, statesync backfill). 1 = verify each
+# commit separately. The device install raises it when an accelerator
+# backend is live: merged batches amortize dispatch and fill buckets,
+# but on a CPU-backed kernel the padding waste inverts the win.
+# The value may be provided lazily (set_group_affinity_fn): deciding
+# it can require jax backend initialization, which must not happen at
+# install() time — a wedged device claim would hang node startup.
+_GROUP_AFFINITY: Optional[int] = 1
+_GROUP_AFFINITY_FN: Optional[Callable[[], int]] = None
+
+
+def set_group_affinity(n: int) -> None:
+    global _GROUP_AFFINITY, _GROUP_AFFINITY_FN
+    _GROUP_AFFINITY = max(1, int(n))
+    _GROUP_AFFINITY_FN = None
+
+
+def set_group_affinity_fn(fn: Callable[[], int]) -> None:
+    """Defer the affinity decision until the first caller needs it."""
+    global _GROUP_AFFINITY, _GROUP_AFFINITY_FN
+    _GROUP_AFFINITY = None
+    _GROUP_AFFINITY_FN = fn
+
+
+def group_affinity() -> int:
+    global _GROUP_AFFINITY
+    if _GROUP_AFFINITY is None:
+        _GROUP_AFFINITY = max(1, int(_GROUP_AFFINITY_FN()))
+    return _GROUP_AFFINITY
+
+
 def supports_batch_verifier(pk: Optional[PubKey]) -> bool:
     return pk is not None and pk.type() in _CPU_FACTORIES
 

@@ -183,3 +183,18 @@ def install(
     _SHARED_VERIFIER_SR = None  # single-chip (sharded sr25519: follow-up)
     register_device_factory("ed25519", _factory)
     register_device_factory("sr25519", _factory_sr)
+    # merged multi-commit batches (light sequential windows) only pay
+    # off on an accelerator; on a CPU-backed kernel the bucket padding
+    # of a merged window inverts the win (measured 5x slower). The
+    # decision needs jax.default_backend(), which initializes the
+    # backend — deferred to first use so a wedged device claim cannot
+    # hang install() itself at node startup (PERF.md, device-claim
+    # discipline).
+    from .batch import set_group_affinity_fn
+
+    def _affinity() -> int:
+        import jax
+
+        return 32 if jax.default_backend() == "tpu" else 1
+
+    set_group_affinity_fn(_affinity)

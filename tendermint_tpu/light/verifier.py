@@ -146,7 +146,7 @@ def verify_non_adjacent(
         raise InvalidHeaderError(str(e)) from e
 
 
-def verify_adjacent(
+def adjacent_header_checks(
     chain_id: str,
     trusted_header: SignedHeader,
     untrusted_header: SignedHeader,
@@ -155,9 +155,11 @@ def verify_adjacent(
     now_ns: int,
     max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS,
 ) -> None:
-    """Sequential verification: the new validator set is pinned by the
-    trusted header's next_validators_hash
-    (reference: light/verifier.go:106-156)."""
+    """The host-side half of verify_adjacent: every check except the
+    commit signature verification. Split out so the light client's
+    sequential group path can run all header checks for a window of
+    hops first, then verify every commit's signatures in ONE device
+    batch (light/client.py _verify_sequential)."""
     if untrusted_header.header.height != trusted_header.header.height + 1:
         raise ValueError("headers must be adjacent in height")
     if header_expired(trusted_header, trusting_period_ns, now_ns):
@@ -176,6 +178,24 @@ def verify_adjacent(
             "header validators_hash does not match trusted header "
             "next_validators_hash"
         )
+
+
+def verify_adjacent(
+    chain_id: str,
+    trusted_header: SignedHeader,
+    untrusted_header: SignedHeader,
+    untrusted_vals: ValidatorSet,
+    trusting_period_ns: int,
+    now_ns: int,
+    max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS,
+) -> None:
+    """Sequential verification: the new validator set is pinned by the
+    trusted header's next_validators_hash
+    (reference: light/verifier.go:106-156)."""
+    adjacent_header_checks(
+        chain_id, trusted_header, untrusted_header, untrusted_vals,
+        trusting_period_ns, now_ns, max_clock_drift_ns,
+    )
     try:
         verify_commit_light(
             chain_id,

@@ -25,6 +25,7 @@ __all__ = [
     "Fraction",
     "NotEnoughVotingPowerError",
     "InvalidCommitError",
+    "collect_commit_light",
     "verify_commit",
     "verify_commit_light",
     "verify_commit_light_trusting",
@@ -151,6 +152,41 @@ def verify_commit_light_trusting(
         )
 
 
+def collect_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+) -> list:
+    """verify_commit_light's host-side half: run every non-signature
+    check (set size, height, block ID, 2/3 tally with the same
+    early-exit) and return the (pub_key, sign_bytes, signature)
+    triples verify_commit_light would have signature-checked — without
+    checking them. Callers fold triples from MANY commits into one
+    device batch (the light client's sequential group sync,
+    light/client.py); any triple failing there must be re-verified
+    per-commit for the reference's exact error. Mirrors the tally
+    semantics of types/validation.go:55-85."""
+    _verify_basic(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    tallied = 0
+    out = []
+    all_sign_bytes = commit.sign_bytes_batch(chain_id)
+    for idx, commit_sig in enumerate(commit.signatures):
+        if not commit_sig.is_for_block():
+            continue
+        # look_up_by_index semantics (same-set verification)
+        val = vals.validators[idx]
+        out.append(
+            (val.pub_key, all_sign_bytes[idx], commit_sig.signature)
+        )
+        tallied += val.voting_power
+        if tallied > voting_power_needed:
+            return out
+    raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+
+
 def _verify_basic(
     vals: Optional[ValidatorSet],
     commit: Optional[Commit],
@@ -201,10 +237,15 @@ def _verify_commit_batch(
     seen_vals: dict[int, int] = {}
     # key type -> (verifier, [commit sig indexes added to it])
     groups: dict[str, tuple] = {}
-    # one templated pass for all sign-bytes: at 10k signatures the
-    # per-index marshal is the dominant host cost (see
-    # Commit.sign_bytes_batch)
-    all_sign_bytes = commit.sign_bytes_batch(chain_id)
+    # one templated pass for all sign-bytes when every signature will
+    # be checked (verify_commit): at 10k signatures the per-index
+    # marshal is the dominant host cost (see Commit.sign_bytes_batch).
+    # Early-exit variants (light/trusting stop at 2/3 and ignore nil
+    # votes) encode lazily per index instead — still template-cached —
+    # so no discarded rows are paid for.
+    all_sign_bytes = (
+        commit.sign_bytes_batch(chain_id) if count_all_signatures else None
+    )
     for idx, commit_sig in enumerate(commit.signatures):
         if ignore_sig(commit_sig):
             continue
@@ -222,7 +263,11 @@ def _verify_commit_batch(
                     f"({seen_vals[val_idx]} and {idx})"
                 )
             seen_vals[val_idx] = idx
-        vote_sign_bytes = all_sign_bytes[idx]
+        vote_sign_bytes = (
+            all_sign_bytes[idx]
+            if all_sign_bytes is not None
+            else commit.vote_sign_bytes(chain_id, idx)
+        )
         key_type = val.pub_key.type()
         if not supports_batch_verifier(val.pub_key):
             # no batch support for this type: verify inline

@@ -532,3 +532,72 @@ def test_client_update_to_latest():
         assert await client.update() is None  # already latest
 
     run(go())
+
+
+def test_client_sequential_windowed_multiwindow():
+    """A sync spanning several SEQUENTIAL_BATCH_HOPS windows stores
+    every interim header, exactly like the one-hop loop. Group
+    affinity is forced up (it defaults to 1 without an accelerator
+    install) so the merged-window path actually runs."""
+    from tendermint_tpu.crypto.batch import (
+        group_affinity,
+        set_group_affinity,
+    )
+    from tendermint_tpu.light.client import SEQUENTIAL_BATCH_HOPS
+
+    n = SEQUENTIAL_BATCH_HOPS * 2 + 5
+    blocks = build_chain(n)
+    client = make_client(blocks, sequential=True)
+
+    async def go():
+        lb = await client.verify_light_block_at_height(n)
+        assert lb.height == n
+        assert client.store.size() == n
+
+    prev = group_affinity()
+    set_group_affinity(SEQUENTIAL_BATCH_HOPS)
+    try:
+        run(go())
+    finally:
+        set_group_affinity(prev)
+
+
+def test_client_sequential_windowed_bad_sig_exact_error():
+    """A corrupted commit signature mid-window must surface the exact
+    per-height error via the fallback path, with every hop before it
+    verified and stored — reference one-hop semantics."""
+    from tendermint_tpu.light.client import SEQUENTIAL_BATCH_HOPS
+
+    n = SEQUENTIAL_BATCH_HOPS + 8
+    bad_h = SEQUENTIAL_BATCH_HOPS + 3  # inside the second window
+    from tendermint_tpu.crypto.batch import (
+        group_affinity,
+        set_group_affinity,
+    )
+    from tendermint_tpu.light.errors import InvalidHeaderError
+
+    blocks = build_chain(n)
+    bad = blocks[bad_h]
+    sigs = list(bad.signed_header.commit.signatures)
+    s0 = sigs[0]
+    sigs[0] = CommitSig.for_block(
+        s0.signature[:-1] + bytes([s0.signature[-1] ^ 1]),
+        s0.validator_address,
+        s0.timestamp_ns,
+    )
+    bad.signed_header.commit.signatures = sigs
+    client = make_client(blocks, sequential=True)
+
+    async def go():
+        with pytest.raises(InvalidHeaderError):
+            await client.verify_light_block_at_height(n)
+        # every height before the corruption verified and stored
+        assert client.store.light_block(bad_h - 1) is not None
+        assert client.store.light_block(bad_h) is None
+
+    prev = group_affinity()
+    set_group_affinity(SEQUENTIAL_BATCH_HOPS)
+    try:
+        run(go())
+    finally:
+        set_group_affinity(prev)
