@@ -34,7 +34,15 @@ if os.path.exists(RESULTS):
 # drop prior-session entries for the stages this run re-executes:
 # _stage merges (setdefault().update()), so a stale sigs_per_s from an
 # old success would otherwise survive inside a newly-skipped stage
-for _k in ("pallas_probe2", "pallas_tput2", "xla_hostsha"):
+for _k in (
+    "pallas_probe2",
+    "pallas_tput2",
+    "xla_hostsha",
+    "xla_tput3",
+    "xla_mosaic_form",
+    "sr_tput2",
+    "commit_10k",
+):
     _state["stages"].pop(_k, None)
 
 
@@ -96,6 +104,92 @@ def stage_hostsha():
         os.environ.pop("TM_TPU_HOST_SHA512", None)
 
 
+@_stage("xla_tput3")
+def stage_xla3():
+    """The current default tree: scan window walk + unrolled device
+    SHA-512. The r3 headline XLA number."""
+    os.environ.pop("TM_TPU_PALLAS", None)
+    from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+    pks, msgs, sigs = _batch(8192)
+    rate = _throughput(Ed25519Verifier(bucket_sizes=[8192]), pks, msgs, sigs)
+    return {"sigs_per_s": round(rate, 1)}
+
+
+@_stage("xla_mosaic_form")
+def stage_mosaic_form():
+    """A/B the two window-walk forms as plain XLA programs: scan+flip
+    (default) vs fori_loop+one-hot (the Pallas tile body). Attributes
+    part of the 67k->45k regression question (PERF.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tendermint_tpu.ops import ed25519_kernel as K
+
+    pks, msgs, sigs = _batch(8192, seed=9)
+    v = K.Ed25519Verifier(bucket_sizes=[8192])
+    handle = v.dispatch(pks, msgs, sigs)
+    ok = v.gather(handle)
+    assert bool(ok.all())
+    # rebuild the packed inputs exactly as dispatch() does
+    import hashlib
+
+    pk_b = K._join_cols(pks, 32, 0)
+    sig_b = K._join_cols(sigs, 64, 0)
+    dig_b = K._join_cols(
+        [
+            hashlib.sha512(s[:32] + p + m).digest()
+            for p, m, s in zip(pks, msgs, sigs)
+        ],
+        64,
+        0,
+    )
+    args = tuple(jnp.asarray(a) for a in (pk_b, sig_b, dig_b))
+    out = {}
+    for name, mosaic in (("scan", False), ("onehot", True)):
+        fn = jax.jit(lambda a, b, c, _m=mosaic: K._verify_tile(a, b, c, mosaic=_m))
+        r = fn(*args)
+        jax.block_until_ready(r)
+        assert bool(np.asarray(r).all())
+        t0 = time.perf_counter()
+        for _ in range(4):
+            jax.block_until_ready(fn(*args))
+        out[name + "_sigs_per_s"] = round(8192 / ((time.perf_counter() - t0) / 4), 1)
+    return out
+
+
+@_stage("sr_tput2")
+def stage_sr2():
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+    from tendermint_tpu.ops.sr25519_kernel import Sr25519Verifier
+
+    privs = [PrivKeySr25519.from_seed(bytes([i, 99]) + b"\x00" * 30)
+             for i in range(64)]
+    pks, msgs, sigs = [], [], []
+    for i in range(8192):
+        p = privs[i % 64]
+        m = b"sr-session-%08d" % i
+        pks.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(p.sign(m))
+    rate = _throughput(
+        Sr25519Verifier(bucket_sizes=[8192]), pks, msgs, sigs, reps=4
+    )
+    return {"sigs_per_s": round(rate, 1)}
+
+
+@_stage("commit_10k")
+def stage_commit_10k():
+    """10k-validator VerifyCommit p50 + phase breakdown with the
+    templated sign-bytes path (BASELINE config 5's latency half)."""
+    import bench
+
+    p50, p95 = bench.bench_commit_latency(10_000, reps=5, light=False)
+    breakdown = bench.bench_commit_breakdown(10_000, reps=5)
+    return {"p50_ms": round(p50, 2), "p95_ms": round(p95, 2), "breakdown": breakdown}
+
+
 def main():
     import jax
 
@@ -105,7 +199,15 @@ def main():
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-    for st in (stage_probe2, stage_tput2, stage_hostsha):
+    for st in (
+        stage_xla3,
+        stage_probe2,
+        stage_tput2,
+        stage_mosaic_form,
+        stage_sr2,
+        stage_commit_10k,
+        stage_hostsha,
+    ):
         st()
     print(json.dumps(_state["stages"], indent=1))
 

@@ -56,17 +56,24 @@ def device_factory_installed(key_type: str) -> bool:
 # install() time — a wedged device claim would hang node startup.
 _GROUP_AFFINITY: Optional[int] = 1
 _GROUP_AFFINITY_FN: Optional[Callable[[], int]] = None
+_GROUP_AFFINITY_EXPLICIT = False
 
 
 def set_group_affinity(n: int) -> None:
-    global _GROUP_AFFINITY, _GROUP_AFFINITY_FN
+    """Operator override — wins over any install-provided default
+    (set_group_affinity_fn will not replace it)."""
+    global _GROUP_AFFINITY, _GROUP_AFFINITY_FN, _GROUP_AFFINITY_EXPLICIT
     _GROUP_AFFINITY = max(1, int(n))
     _GROUP_AFFINITY_FN = None
+    _GROUP_AFFINITY_EXPLICIT = True
 
 
 def set_group_affinity_fn(fn: Callable[[], int]) -> None:
-    """Defer the affinity decision until the first caller needs it."""
+    """Defer the affinity decision until the first caller needs it.
+    A no-op if an operator already pinned a value explicitly."""
     global _GROUP_AFFINITY, _GROUP_AFFINITY_FN
+    if _GROUP_AFFINITY_EXPLICIT:
+        return
     _GROUP_AFFINITY = None
     _GROUP_AFFINITY_FN = fn
 

@@ -16,11 +16,14 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional
 
-from ..crypto.batch import create_batch_verifier, supports_batch_verifier
 from ..libs.log import get_logger
 from ..types.evidence import LightClientAttackEvidence
 from ..types.light import LightBlock
-from ..types.validation import Fraction, collect_commit_light
+from ..types.validation import (
+    Fraction,
+    collect_commit_light,
+    verify_triples_grouped,
+)
 from .errors import (
     DivergenceError,
     InvalidHeaderError,
@@ -53,31 +56,8 @@ _DEFAULT_PRUNING_SIZE = 1000  # reference: client.go defaultPruningSize
 SEQUENTIAL_BATCH_HOPS = 32
 
 
-def _batch_verify_triples(triples) -> None:
-    """One merged signature check over (pub_key, sign_bytes, signature)
-    triples collected from many commits, grouped per key type (the same
-    grouping _verify_commit_batch applies within one commit). Raises
-    InvalidHeaderError on any failure — callers fall back to per-hop
-    verification for the precise per-height error."""
-    groups: dict = {}
-    for pk, sb, sig in triples:
-        if not supports_batch_verifier(pk):
-            if not pk.verify_signature(sb, sig):
-                raise InvalidHeaderError(
-                    "wrong signature in sequential window"
-                )
-            continue
-        bv = groups.get(pk.type())
-        if bv is None:
-            bv = create_batch_verifier(pk, size_hint=len(triples))
-            groups[pk.type()] = bv
-        bv.add(pk, sb, sig)
-    for bv in groups.values():
-        ok, _bits = bv.verify()
-        if not ok:
-            raise InvalidHeaderError(
-                "wrong signature in sequential window"
-            )
+# merged multi-commit signature check shared with types/validation.py
+_batch_verify_triples = verify_triples_grouped
 
 
 @dataclass
@@ -253,19 +233,38 @@ class Client:
         from ..crypto.batch import group_affinity
 
         window = max(1, min(SEQUENTIAL_BATCH_HOPS, group_affinity()))
+        if window == 1:
+            # no accelerator-backed verifier installed: the reference's
+            # one-hop loop, no window machinery, no double-fetch on a
+            # verification failure
+            cur = trusted
+            for h in range(trusted.height + 1, target.height):
+                interim = await self._from_primary(h)
+                interim.validate_basic(self.chain_id)
+                self._verify_hop(cur, interim, now_ns)
+                self.store.save_light_block(interim)
+                cur = interim
+            self._verify_hop(cur, target, now_ns)
+            return target
         cur = trusted
         while cur.height < target.height:
             first = cur.height + 1
             last = min(first + window - 1, target.height)
             try:
-                chunk = list(
-                    await asyncio.gather(
-                        *(
-                            self._from_primary(h)
-                            for h in range(first, min(last + 1, target.height))
-                        )
-                    )
+                # return_exceptions so one failed fetch does not leave
+                # the window's other in-flight fetches orphaned (gather
+                # would otherwise raise immediately and abandon them)
+                fetched = await asyncio.gather(
+                    *(
+                        self._from_primary(h)
+                        for h in range(first, min(last + 1, target.height))
+                    ),
+                    return_exceptions=True,
                 )
+                for f in fetched:
+                    if isinstance(f, BaseException):
+                        raise f
+                chunk = list(fetched)
                 if last == target.height:
                     chunk.append(target)
                 prev = cur

@@ -54,9 +54,13 @@ def encode(obj: Any) -> Any:
             "total_voting_power": obj.total_voting_power(),
         }
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        # underscore fields are internal caches (Commit._hash,
+        # Commit._sign_templates, Header._hash ...) — never part of the
+        # wire shape, and not necessarily JSON-encodable
         return {
             f.name: encode(getattr(obj, f.name))
             for f in dataclasses.fields(obj)
+            if not f.name.startswith("_")
         }
     if isinstance(obj, bytes):
         return obj.hex()

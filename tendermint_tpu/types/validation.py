@@ -29,6 +29,7 @@ __all__ = [
     "verify_commit",
     "verify_commit_light",
     "verify_commit_light_trusting",
+    "verify_triples_grouped",
 ]
 
 BATCH_VERIFY_THRESHOLD = 2  # reference: types/validation.go:12
@@ -172,19 +173,49 @@ def collect_commit_light(
     voting_power_needed = vals.total_voting_power() * 2 // 3
     tallied = 0
     out = []
-    all_sign_bytes = commit.sign_bytes_batch(chain_id)
+    # lazy per-index encode (template-cached): this early-exit variant
+    # skips nil votes and stops at 2/3, so a full precompute would pay
+    # for rows it discards — same policy as _verify_commit_batch
     for idx, commit_sig in enumerate(commit.signatures):
         if not commit_sig.is_for_block():
             continue
         # look_up_by_index semantics (same-set verification)
         val = vals.validators[idx]
         out.append(
-            (val.pub_key, all_sign_bytes[idx], commit_sig.signature)
+            (
+                val.pub_key,
+                commit.vote_sign_bytes(chain_id, idx),
+                commit_sig.signature,
+            )
         )
         tallied += val.voting_power
         if tallied > voting_power_needed:
             return out
     raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+
+
+def verify_triples_grouped(triples) -> None:
+    """One merged signature check over (pub_key, sign_bytes, signature)
+    triples collected from MANY commits (collect_commit_light), grouped
+    per key type — the same grouping _verify_commit_batch applies
+    within one commit. Raises InvalidCommitError on any failure with no
+    index attribution: callers re-verify per commit for the precise
+    error (light/client.py sequential window fallback)."""
+    groups: dict = {}
+    for pk, sb, sig in triples:
+        if not supports_batch_verifier(pk):
+            if not pk.verify_signature(sb, sig):
+                raise InvalidCommitError("wrong signature in merged batch")
+            continue
+        bv = groups.get(pk.type())
+        if bv is None:
+            bv = create_batch_verifier(pk, size_hint=len(triples))
+            groups[pk.type()] = bv
+        bv.add(pk, sb, sig)
+    for bv in groups.values():
+        ok, _bits = bv.verify()
+        if not ok:
+            raise InvalidCommitError("wrong signature in merged batch")
 
 
 def _verify_basic(
