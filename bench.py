@@ -394,6 +394,61 @@ def bench_commit_breakdown(n_vals: int = 10_000, reps: int = 5):
     }
 
 
+def bench_mempool_checktx(n_txs: int = 2000):
+    """Mempool CheckTx ingest rate against the kvstore app over the
+    local ABCI client (reference harness:
+    internal/mempool/mempool_bench_test.go). Returns txs/s."""
+    import asyncio
+
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config import MempoolConfig
+    from tendermint_tpu.mempool.mempool import TxMempool
+
+    async def go():
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        mp = TxMempool(client, MempoolConfig())
+        t0 = time.perf_counter()
+        for i in range(n_txs):
+            await mp.check_tx(b"bench-%d=v%d" % (i, i))
+        dt = time.perf_counter() - t0
+        assert mp.size() == n_txs
+        return n_txs / dt
+
+    return asyncio.run(go())
+
+
+def bench_block_interval(target_height: int = 12):
+    """4-validator in-process localnet block production (BASELINE
+    config 1 / the reference's e2e benchmark shape,
+    test/e2e/runner/benchmark.go:14-23): avg/stddev/min/max block
+    interval over the run. Returns a dict or an error string."""
+    import tempfile
+
+    from tendermint_tpu.e2e.manifest import Manifest
+    from tendermint_tpu.e2e.runner import run_manifest
+
+    m = Manifest(
+        chain_id="bench-localnet",
+        validators={"v%d" % i: 10 for i in range(4)},
+        target_height=target_height,
+    )
+    m.load.tx_rate = 5.0  # the reference benchmark runs under tx load
+    m.validate()  # materializes the validator NodeSpecs
+    with tempfile.TemporaryDirectory() as home:
+        rep = run_manifest(m, home, timeout=240.0)
+    if not rep.ok:
+        return {"error": "; ".join(rep.failures) or "did not converge"}
+    return {
+        "blocks": rep.blocks,
+        "interval_avg_s": round(rep.interval_avg, 3),
+        "interval_stddev_s": round(rep.interval_stddev, 3),
+        "interval_min_s": round(rep.interval_min, 3),
+        "interval_max_s": round(rep.interval_max, 3),
+    }
+
+
 def bench_device_rtt():
     import jax
     import jax.numpy as jnp
@@ -541,6 +596,18 @@ def main() -> None:
         )
     except Exception as e:  # pragma: no cover
         curve = {"error": repr(e)}
+    try:
+        mempool_rate = round(
+            bench_mempool_checktx(500 if fallback else 2000), 1
+        )
+    except Exception as e:  # pragma: no cover
+        mempool_rate = repr(e)
+    try:
+        block_interval = bench_block_interval(
+            target_height=6 if fallback else 12
+        )
+    except Exception as e:  # pragma: no cover
+        block_interval = {"error": repr(e)}
     print(
         json.dumps(
             {
@@ -581,6 +648,8 @@ def main() -> None:
                         round(light_rate, 2) if light_rate else light_err
                     ),
                     "batch_verify_us_per_sig_by_batch": curve,
+                    "mempool_checktx_per_s": mempool_rate,
+                    "localnet_block_interval": block_interval,
                 },
             }
         )

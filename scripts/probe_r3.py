@@ -1,11 +1,23 @@
-"""Round-3 focused device probes, appended to DEVICE_SESSION.json:
+"""Round-3 focused device probes, appended to DEVICE_SESSION.json.
 
-  pallas_probe2 — retry the Mosaic compile after the scatter fixes
-  pallas_tput2  — pallas throughput at 8192 if the probe held
-  xla_hostsha   — XLA throughput with host-side SHA-512 (A/B against
-                  the device-hash path, chasing the 45k vs 67k gap)
+Stages, in run order:
 
-SIGTERM-safe, never SIGKILLs the device client (see device_session.py).
+  xla_tput3       — headline: the current default tree (scan window
+                    walk + unrolled device SHA-512) at 8192
+  pallas_probe2   — Mosaic compile retry after the scatter /
+                    dynamic_slice / iota / rev fixes (commit 86ed9fc)
+  pallas_tput2    — pallas throughput at 8192 if the probe held
+  xla_mosaic_form — scan+flip vs fori+one-hot window walks as plain
+                    XLA programs (regression attribution, PERF.md)
+  sr_tput2        — sr25519 throughput on the current tree
+  commit_10k      — 10k-validator VerifyCommit p50 + phase breakdown
+                    with the templated sign-bytes path
+  xla_hostsha     — XLA throughput with host-side SHA-512 (A/B
+                    against the device hash)
+
+Prior-session entries for these stages are dropped before the run (the
+stage writer merges). SIGTERM-safe, never SIGKILLs the device client
+(see device_session.py).
 """
 
 from __future__ import annotations

@@ -85,6 +85,19 @@ def group_affinity() -> int:
     return _GROUP_AFFINITY
 
 
+def group_affinity_state() -> tuple:
+    """Snapshot for restore_group_affinity — the save/restore idiom
+    for tests and embedders. Restoring via set_group_affinity(old)
+    would pin the explicit-override flag forever and silently disable
+    any later install()'s affinity fn."""
+    return (_GROUP_AFFINITY, _GROUP_AFFINITY_FN, _GROUP_AFFINITY_EXPLICIT)
+
+
+def restore_group_affinity(state: tuple) -> None:
+    global _GROUP_AFFINITY, _GROUP_AFFINITY_FN, _GROUP_AFFINITY_EXPLICIT
+    _GROUP_AFFINITY, _GROUP_AFFINITY_FN, _GROUP_AFFINITY_EXPLICIT = state
+
+
 def supports_batch_verifier(pk: Optional[PubKey]) -> bool:
     return pk is not None and pk.type() in _CPU_FACTORIES
 

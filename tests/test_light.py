@@ -540,7 +540,8 @@ def test_client_sequential_windowed_multiwindow():
     affinity is forced up (it defaults to 1 without an accelerator
     install) so the merged-window path actually runs."""
     from tendermint_tpu.crypto.batch import (
-        group_affinity,
+        group_affinity_state,
+        restore_group_affinity,
         set_group_affinity,
     )
     from tendermint_tpu.light.client import SEQUENTIAL_BATCH_HOPS
@@ -554,12 +555,12 @@ def test_client_sequential_windowed_multiwindow():
         assert lb.height == n
         assert client.store.size() == n
 
-    prev = group_affinity()
+    prev = group_affinity_state()
     set_group_affinity(SEQUENTIAL_BATCH_HOPS)
     try:
         run(go())
     finally:
-        set_group_affinity(prev)
+        restore_group_affinity(prev)
 
 
 def test_client_sequential_windowed_bad_sig_exact_error():
@@ -571,7 +572,8 @@ def test_client_sequential_windowed_bad_sig_exact_error():
     n = SEQUENTIAL_BATCH_HOPS + 8
     bad_h = SEQUENTIAL_BATCH_HOPS + 3  # inside the second window
     from tendermint_tpu.crypto.batch import (
-        group_affinity,
+        group_affinity_state,
+        restore_group_affinity,
         set_group_affinity,
     )
     from tendermint_tpu.light.errors import InvalidHeaderError
@@ -595,9 +597,9 @@ def test_client_sequential_windowed_bad_sig_exact_error():
         assert client.store.light_block(bad_h - 1) is not None
         assert client.store.light_block(bad_h) is None
 
-    prev = group_affinity()
+    prev = group_affinity_state()
     set_group_affinity(SEQUENTIAL_BATCH_HOPS)
     try:
         run(go())
     finally:
-        set_group_affinity(prev)
+        restore_group_affinity(prev)
