@@ -175,12 +175,16 @@ def install(
 
     _native_lib()
     if mesh is not None:
-        from ..parallel.sharding import ShardedEd25519Verifier
+        from ..parallel.sharding import (
+            ShardedEd25519Verifier,
+            ShardedSr25519Verifier,
+        )
 
         _SHARED_VERIFIER = ShardedEd25519Verifier(mesh)
+        _SHARED_VERIFIER_SR = ShardedSr25519Verifier(mesh)
     else:
         _SHARED_VERIFIER = None
-    _SHARED_VERIFIER_SR = None  # single-chip (sharded sr25519: follow-up)
+        _SHARED_VERIFIER_SR = None
     register_device_factory("ed25519", _factory)
     register_device_factory("sr25519", _factory_sr)
     # merged multi-commit batches (light sequential windows) only pay

@@ -186,9 +186,21 @@ class Sr25519Verifier:
 
     def __init__(self, bucket_sizes: Optional[Sequence[int]] = None) -> None:
         self.bucket_sizes = sorted(bucket_sizes or DEFAULT_BUCKET_SIZES)
+        self._compiled: dict = {}
 
     def _bucket(self, n: int) -> int:
         return bucket_for(n, self.bucket_sizes)
+
+    def _program(self, size: int):
+        """The compiled program for a bucket — one shape-polymorphic
+        jitted function by default; the per-size dict exists for
+        overrides (ShardedSr25519Verifier's mesh-partitioned
+        programs, tendermint_tpu.parallel.sharding)."""
+        fn = self._compiled.get(size)
+        if fn is None:
+            fn = _jit_verify_tile_sr()
+            self._compiled[size] = fn
+        return fn
 
     def verify(
         self,
@@ -241,7 +253,7 @@ class Sr25519Verifier:
         pk_b = _join_cols(pubkeys, 32, pad)
         sig_b = _join_cols(sigs, 64, pad)
         k_b = _join_cols(ks, 32, pad)
-        prog = _jit_verify_tile_sr()
+        prog = self._program(bucket)
         ok = prog(
             jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(k_b)
         )

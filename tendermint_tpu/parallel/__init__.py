@@ -3,6 +3,7 @@
 from .sharding import (  # noqa: F401
     SIG_AXIS,
     ShardedEd25519Verifier,
+    ShardedSr25519Verifier,
     make_mesh,
     sharded_batch_verify,
 )
@@ -10,6 +11,7 @@ from .sharding import (  # noqa: F401
 __all__ = [
     "SIG_AXIS",
     "ShardedEd25519Verifier",
+    "ShardedSr25519Verifier",
     "make_mesh",
     "sharded_batch_verify",
 ]

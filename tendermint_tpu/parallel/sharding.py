@@ -23,8 +23,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import ed25519_kernel as K
+from ..ops import sr25519_kernel as SR
 
-__all__ = ["make_mesh", "ShardedEd25519Verifier", "sharded_batch_verify"]
+__all__ = [
+    "make_mesh",
+    "ShardedEd25519Verifier",
+    "ShardedSr25519Verifier",
+    "sharded_batch_verify",
+]
 
 SIG_AXIS = "sig"
 
@@ -41,13 +47,17 @@ def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(np.array(devs), (SIG_AXIS,))
 
 
-class ShardedEd25519Verifier(K.Ed25519Verifier):
-    """Ed25519Verifier whose device program is partitioned over a mesh.
+class _MeshSharded:
+    """Mixin partitioning a bucketed verifier's device program over a
+    mesh. Buckets round up to a multiple of the mesh size so every
+    device gets an equal shard; host-side packing is identical to the
+    single-chip path — only placement changes. Subclasses name their
+    kernel via _TILE_FN / _DEFAULT_SIZES; everything else (bucket
+    rounding incl. oversized batches, the sharded jit) is shared so the
+    two curves' device layouts cannot drift apart."""
 
-    Bucket sizes are rounded up to a multiple of the mesh size so every
-    device gets an equal shard. Host-side packing is identical to the
-    single-chip path; only placement changes.
-    """
+    _TILE_FN = None  # staticmethod: the tile body to jit
+    _DEFAULT_SIZES: Sequence[int] = ()
 
     def __init__(
         self,
@@ -56,7 +66,7 @@ class ShardedEd25519Verifier(K.Ed25519Verifier):
     ) -> None:
         self.mesh = mesh
         n = mesh.devices.size
-        sizes = bucket_sizes or K.DEFAULT_BUCKET_SIZES
+        sizes = bucket_sizes or self._DEFAULT_SIZES
         super().__init__(sorted({-(-s // n) * n for s in sizes}))
 
     def _bucket(self, n: int) -> int:
@@ -68,17 +78,34 @@ class ShardedEd25519Verifier(K.Ed25519Verifier):
         fn = self._compiled.get(size)
         if fn is None:
             # batch axis is MINOR (see field25519 layout note): the
-            # program takes (32, N) pk bytes, (64, N) sig bytes,
-            # (64, N) digest bytes and returns the (N,) bitmap
+            # program takes (32, N) pk bytes, (64, N) sig bytes, and a
+            # (64|32, N) digest/challenge matrix, returns the (N,) bitmap
             vec = NamedSharding(self.mesh, P(SIG_AXIS))
             mat = NamedSharding(self.mesh, P(None, SIG_AXIS))
             fn = jax.jit(
-                K._verify_tile,
+                type(self)._TILE_FN,
                 in_shardings=(mat, mat, mat),
                 out_shardings=vec,
             )
             self._compiled[size] = fn
         return fn
+
+
+class ShardedEd25519Verifier(_MeshSharded, K.Ed25519Verifier):
+    """Ed25519Verifier whose device program is partitioned over a mesh."""
+
+    _TILE_FN = staticmethod(K._verify_tile)
+    _DEFAULT_SIZES = K.DEFAULT_BUCKET_SIZES
+
+
+class ShardedSr25519Verifier(_MeshSharded, SR.Sr25519Verifier):
+    """Sr25519Verifier partitioned over a mesh — same layout as the
+    ed25519 variant: 1-D data-parallel over `sig`, host packing
+    (merlin challenges + byte joins) unchanged. Reference analog:
+    crypto/sr25519/batch.go behind the crypto.BatchVerifier seam."""
+
+    _TILE_FN = staticmethod(SR._verify_tile_sr)
+    _DEFAULT_SIZES = SR.DEFAULT_BUCKET_SIZES
 
 
 def sharded_batch_verify(mesh, pubkeys, msgs, sigs) -> np.ndarray:

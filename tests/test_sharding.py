@@ -119,3 +119,72 @@ def test_device_mesh_config_validation():
     assert m is not None and m.devices.size == len(jax.devices())
     with pytest.raises(RuntimeError, match="only"):
         Node._device_mesh(10_000)
+
+
+# ---------------------------------------------------------------------------
+# sr25519
+
+
+def _sr_sign_set(n, tag=b"sr-shard"):
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+    keys = [
+        PrivKeySr25519.from_seed(hashlib.sha256(tag + bytes([i])).digest())
+        for i in range(n)
+    ]
+    msgs = [b"sr-sharded-" + bytes([i]) for i in range(n)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    return [k.pub_key().bytes() for k in keys], msgs, sigs
+
+
+def test_sr25519_bucket_rounds_to_mesh_multiples(mesh):
+    from tendermint_tpu.parallel import ShardedSr25519Verifier
+
+    v = ShardedSr25519Verifier(mesh, bucket_sizes=[4, 10, 100])
+    assert all(b % 8 == 0 for b in v.bucket_sizes)
+    for n in (1, 9, 101, 20_000):
+        assert v._bucket(n) % 8 == 0 and v._bucket(n) >= n
+
+
+def test_sr25519_uneven_batch_and_localization(mesh):
+    from tendermint_tpu.parallel import ShardedSr25519Verifier
+
+    pks, msgs, sigs = _sr_sign_set(13)
+    bad = {2, 8, 12}
+    for i in bad:
+        sigs[i] = sigs[i][:40] + bytes([sigs[i][40] ^ 1]) + sigs[i][41:]
+    v = ShardedSr25519Verifier(mesh, bucket_sizes=[8])
+    ok = v.verify(pks, msgs, sigs)
+    assert ok.tolist() == [i not in bad for i in range(13)]
+
+
+def test_sr25519_matches_single_chip(mesh):
+    from tendermint_tpu.ops.sr25519_kernel import Sr25519Verifier
+    from tendermint_tpu.parallel import ShardedSr25519Verifier
+
+    pks, msgs, sigs = _sr_sign_set(9, b"sr-eq")
+    sigs[4] = b"\x00" * 64
+    sharded = ShardedSr25519Verifier(mesh).verify(pks, msgs, sigs)
+    single = Sr25519Verifier().verify(pks, msgs, sigs)
+    assert sharded.tolist() == single.tolist()
+
+
+def test_mesh_install_shards_sr25519(mesh):
+    """install(mesh=...) must route sr25519 batches through the
+    sharded verifier too (crypto/crypto.go:53-61: backend is config)."""
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+    from tendermint_tpu.parallel import ShardedSr25519Verifier
+
+    tpu_verifier.install(min_batch=2, mesh=mesh)
+    try:
+        priv = PrivKeySr25519.from_seed(b"\x21" * 32)
+        bv = crypto_batch.create_batch_verifier(priv.pub_key(), size_hint=8)
+        assert isinstance(bv, tpu_verifier.TpuSr25519BatchVerifier)
+        assert isinstance(bv._verifier, ShardedSr25519Verifier)
+        for i in range(8):
+            m = b"mesh-sr-%d" % i
+            bv.add(priv.pub_key(), m, priv.sign(m))
+        ok, bitmap = bv.verify()
+        assert ok and bitmap == [True] * 8
+    finally:
+        crypto_batch._DEVICE_FACTORIES.clear()
