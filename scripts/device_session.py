@@ -85,8 +85,13 @@ def _graceful_exit(signum, frame):
     sys.exit(128 + signum)
 
 
-signal.signal(signal.SIGTERM, _graceful_exit)
-signal.signal(signal.SIGINT, _graceful_exit)
+def install_handlers() -> None:
+    """SIGTERM/SIGINT persist the stage state then exit. Called by the
+    stage runners' mains — NOT at import: importers that only want
+    _batch/_throughput (e.g. probe_pallas_fail) must not have a TERM
+    overwrite DEVICE_SESSION.json with an empty session."""
+    signal.signal(signal.SIGTERM, _graceful_exit)
+    signal.signal(signal.SIGINT, _graceful_exit)
 
 
 def _batch(n, seed=3):
@@ -249,6 +254,7 @@ def stage_sr():
 
 
 def main():
+    install_handlers()
     # persist compilations so a re-run after a wedge resumes fast
     import jax
 

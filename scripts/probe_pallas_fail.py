@@ -1,0 +1,79 @@
+"""Capture the exact Mosaic failure behind the Pallas fallback.
+
+probe_r3's pallas_probe2 compiled for ~70 s then fell back; the
+dispatch()-level fallback logged the exception and threw it away. This
+probe calls verify_pallas DIRECTLY (no fallback) at bucket 128 and
+writes the full traceback to PALLAS_FAIL.txt so the next kernel fix is
+aimed, not guessed. SIGTERM-safe, exits cleanly to release the claim.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "PALLAS_FAIL.txt")
+
+
+def main() -> None:
+    os.environ["TM_TPU_PALLAS"] = "1"
+    import jax
+    import numpy as np
+
+    cache = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    lines = [f"devices: {jax.devices()}"]
+    from device_session import _batch
+    from tendermint_tpu.ops import ed25519_kernel as K
+    from tendermint_tpu.ops.ed25519_pallas import verify_pallas
+
+    pks, msgs, sigs = _batch(128, seed=7)
+    pk_b = K._join_cols(pks, 32, 0)
+    sig_b = K._join_cols(sigs, 64, 0)
+    import hashlib
+
+    dig = [
+        hashlib.sha512(s[:32] + p + m).digest()
+        for p, m, s in zip(pks, msgs, sigs)
+    ]
+    dig_b = K._join_cols(dig, 64, 0)
+
+    t0 = time.perf_counter()
+    try:
+        import jax.numpy as jnp
+
+        ok = verify_pallas(
+            jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
+        )
+        ok = np.asarray(ok)
+        dt = time.perf_counter() - t0
+        lines.append(f"SUCCESS in {dt:.1f}s: all_valid={bool(ok.all())}")
+        # time warm runs
+        t0 = time.perf_counter()
+        for _ in range(5):
+            np.asarray(
+                verify_pallas(
+                    jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
+                )
+            )
+        lines.append(f"warm: {(time.perf_counter() - t0) / 5 * 1e3:.1f} ms/128")
+    except Exception:
+        dt = time.perf_counter() - t0
+        lines.append(f"FAILED after {dt:.1f}s:")
+        lines.append(traceback.format_exc())
+
+    with open(OUT, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print("\n".join(lines[-2:]))
+
+
+if __name__ == "__main__":
+    main()

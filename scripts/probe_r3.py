@@ -203,6 +203,9 @@ def stage_commit_10k():
 
 
 def main():
+    from device_session import install_handlers
+
+    install_handlers()
     import jax
 
     cache = os.path.abspath(

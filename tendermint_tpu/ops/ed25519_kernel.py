@@ -17,14 +17,16 @@ count, no data-dependent control flow):
     acc <- 16*acc + dk_w * (-A) + dS_w * B
 
 i.e. Horner evaluation for the variable-base term using a per-signature
-16-entry cached table of -A built on device, while the fixed-base term
-reuses a constant 16-entry niels table of B at every window — scaling by
-16^w happens for free inside the shared Horner doublings. Then add -R,
-triple-double (x8 cofactor), and test the projective identity.
+9-entry cached table of -A built on device (digits recoded to signed
+[-8, 7]; negative entries are the free cached negation), while the
+fixed-base term reuses a constant 9-entry niels table of B at every
+window — scaling by 16^w happens for free inside the shared Horner
+doublings. Then add -R, triple-double (x8 cofactor), and test the
+projective identity.
 
 Layout: all device arrays are batch-minor ((NLIMBS, N) field elements,
 (4, NLIMBS, N) points — see field25519's layout note; batch-major
-stranded ~85% of the VPU lanes). Table indexing is a 16-way one-hot
+stranded ~85% of the VPU lanes). Table indexing is a 9-way one-hot
 select (compare + masked accumulate), not a gather: per-lane dynamic
 gathers serialize on TPU, while the one-hot form is pure vector ALU.
 
@@ -73,7 +75,7 @@ def bucket_for(n: int, sizes: Sequence[int]) -> int:
             return b
     return n
 
-_TB0 = None  # lazy (16, 4, NLIMBS, 1) fixed-base niels table (host numpy;
+_TB0 = None  # lazy (9, 4, NLIMBS, 1) fixed-base niels table (host numpy;
 # converted per use so jit tracing never captures a cached tracer)
 
 
@@ -85,26 +87,70 @@ def _tb0():
 
 
 def _build_neg_a_table(A: jnp.ndarray) -> jnp.ndarray:
-    """(4, L, N) extended -A -> (16, 4, L, N) cached table of j*(-A)."""
+    """(4, L, N) extended -A -> (9, 4, L, N) cached table of j*(-A),
+    j = 0..8 — the signed-digit half-table (digits recoded to [-8, 7],
+    negative entries produced by the free cached negation in
+    _select_signed). 4 doublings + 3 additions vs the 14 point ops of
+    the old full [0, 15] table."""
     negA = E.negate(A)
     cached_negA = E.cache_point(negA)
-    entries = [E.identity(A.shape[-1]), negA]
-    for j in range(2, 16):
-        if j % 2 == 0:
-            entries.append(E.point_double(entries[j // 2]))
-        else:
-            entries.append(E.point_add_cached(entries[j - 1], cached_negA))
-    cached = [E.cache_point(e) for e in entries]
-    return jnp.stack(cached, axis=0)  # (16, 4, L, N)
+    e = {0: E.identity(A.shape[-1]), 1: negA}
+    e[2] = E.point_double(e[1])
+    e[3] = E.point_add_cached(e[2], cached_negA)
+    e[4] = E.point_double(e[2])
+    e[5] = E.point_add_cached(e[4], cached_negA)
+    e[6] = E.point_double(e[3])
+    e[7] = E.point_add_cached(e[6], cached_negA)
+    e[8] = E.point_double(e[4])
+    cached = [E.cache_point(e[j]) for j in range(9)]
+    return jnp.stack(cached, axis=0)  # (9, 4, L, N)
 
 
 def _onehot_select(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """table (16, 4, L, {N|1}), idx (N,) -> (4, L, N) via 16-way masked
+    """table (K, 4, L, {N|1}), idx (N,) -> (4, L, N) via K-way masked
     accumulate (no per-lane gather). broadcasted_iota (not arange):
     Mosaic rejects rank-1 iota."""
-    js = lax.broadcasted_iota(idx.dtype, (16, idx.shape[0]), 0)
-    mask = (idx[None, :] == js).astype(table.dtype)  # (16, N)
+    k = table.shape[0]
+    js = lax.broadcasted_iota(idx.dtype, (k, idx.shape[0]), 0)
+    mask = (idx[None, :] == js).astype(table.dtype)  # (K, N)
     return jnp.sum(table * mask[:, None, None, :], axis=0)
+
+
+def _recode_signed(d: jnp.ndarray) -> jnp.ndarray:
+    """(64, N) radix-16 digits in [0, 15], LE -> same value as signed
+    digits in [-8, 7]: e_i = t_i - 16*(t_i >= 8), t_i = d_i + c_i,
+    c_{i+1} = (t_i >= 8). The carry recurrence is generate/propagate
+    (g = d >= 8, p = d == 7), solved in log2(64) Kogge-Stone steps along
+    the digit axis — no sequential 64-chain in the graph.
+
+    A carry out of digit 63 is dropped; that loses 2^256, which only
+    happens for S >= 2^256 - 8*16^63 — such S fail the S < L
+    canonicality check and are already reported invalid, so the curve
+    result is irrelevant (same contract as the rest of the math on
+    malformed inputs)."""
+    g = d >= 8
+    p = d == 7
+    shift = 1
+    while shift < d.shape[0]:
+        zeros = jnp.zeros_like(g[:shift])
+        g = g | (p & jnp.concatenate([zeros, g[:-shift]], axis=0))
+        p = p & jnp.concatenate([zeros, p[:-shift]], axis=0)
+        shift *= 2
+    c = jnp.concatenate(
+        [jnp.zeros_like(g[:1]), g[:-1]], axis=0
+    ).astype(d.dtype)
+    t = d + c
+    return t - 16 * (t >= 8).astype(d.dtype)
+
+
+def _select_signed(table9: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
+    """table9 (9, 4, L, {N|1}) cached-form entries for j*P, j = 0..8;
+    e (N,) signed digit in [-8, 8] -> (4, L, N) cached |e|*P, negated
+    when e < 0 (cached negation = swap (Y-X, Y+X), negate 2dT — no
+    multiplies, edwards.negate_cached's identity applied post-select)."""
+    sel = _onehot_select(table9, jnp.abs(e))
+    sgn = (e < 0)[None, None, :]
+    return jnp.where(sgn, E.negate_cached(sel), sel)
 
 
 def dual_mult_sb_minus_ka(
@@ -116,8 +162,10 @@ def dual_mult_sb_minus_ka(
     """[S]B - [k]A as a T-less (3, NLIMBS, N) projective stack.
 
     A: (4, L, N) extended point; dS/dk: (64, N) int32 radix-16 digits,
-    little-endian. 64 windows, most significant first, Horner
-    `acc <- 16*acc + dk_w*(-A) + dS_w*B` with a per-signature 16-entry
+    little-endian, in [0, 15] (recoded to signed [-8, 7] on device —
+    half-size tables, negatives via the free cached negation). 64
+    windows, most significant first, Horner
+    `acc <- 16*acc + dk_w*(-A) + dS_w*B` with a per-signature 9-entry
     cached table of -A built on device and a constant niels table of B.
     Shared by the ed25519 program (cofactored compare follows) and the
     sr25519/ristretto program (ristretto equality follows,
@@ -129,9 +177,12 @@ def dual_mult_sb_minus_ka(
       row is picked by a one-hot masked sum because Mosaic lowers
       neither scan's xs dynamic_slice nor jnp.flip's rev. 64 extra
       MACs/window are noise next to the point ops."""
-    TA = _build_neg_a_table(A)  # (16, 4, L, N)
+    TA = _build_neg_a_table(A)  # (9, 4, L, N)
 
-    tb0 = _tb0()  # (16, 4, L, 1)
+    tb0 = _tb0()  # (9, 4, L, 1)
+
+    dS = _recode_signed(dS)
+    dk = _recode_signed(dk)
 
     # The carry is the T-less 3-stack (X, Y, Z): doublings never
     # read T and the final comparison is projective, so only the ops
@@ -144,9 +195,9 @@ def dual_mult_sb_minus_ka(
             0, 3, lambda _i, a: E.point_double(a, with_t=False), acc
         )
         acc = E.point_double(acc)  # T feeds the addition below
-        acc = E.point_add_cached(acc, _onehot_select(TA, dk_w))
+        acc = E.point_add_cached(acc, _select_signed(TA, dk_w))
         acc = E.point_add_cached(
-            acc, _onehot_select(tb0, ds_w), with_t=False
+            acc, _select_signed(tb0, ds_w), with_t=False
         )
         return acc
 
@@ -171,16 +222,23 @@ def dual_mult_sb_minus_ka(
     return acc
 
 
-def _scalar_mult_check(yA, signA, yR, signR, dS, dk, mosaic=False) -> jnp.ndarray:
+def _scalar_mult_check(
+    yA, signA, yR, signR, dS, dk, mosaic=False, dual_fn=None
+) -> jnp.ndarray:
     """Core device program. Batch axis minor.
 
     yA/yR: (L, N) field elements; signA/signR: (N,) int32;
     dS/dk: (64, N) int32 radix-16 digits, little-endian.
-    Returns ok: (N,) bool.
-    """
+    Returns ok: (N,) bool. `dual_fn` overrides the dual scalar-mult
+    (the segmented Pallas kernel plugs in here; everything around it —
+    decompression, cofactor clearing, the projective compare — stays
+    XLA, which fuses those fine)."""
     A, okA = E.decompress(yA, signA)
     R, okR = E.decompress(yR, signR)
-    acc = dual_mult_sb_minus_ka(A, dS, dk, mosaic=mosaic)
+    if dual_fn is None:
+        acc = dual_mult_sb_minus_ka(A, dS, dk, mosaic=mosaic)
+    else:
+        acc = dual_fn(A, dS, dk)
     # ZIP-215 cofactored equation, rearranged so nothing needs T:
     # [8]([S]B - [k]A) == [8]R  <=>  [8]([S]B - [k]A - R) == identity.
     for _ in range(3):  # cofactor 8, both sides
@@ -331,7 +389,7 @@ def _nibbles_dev(b: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([lo, hi], axis=1).reshape(64, b.shape[1])
 
 
-def _verify_tile(pk_b, sig_b, dig_b, mosaic: bool = False) -> jnp.ndarray:
+def _verify_tile(pk_b, sig_b, dig_b, mosaic: bool = False, dual_fn=None) -> jnp.ndarray:
     """The full device program: byte rows in, validity bitmap out.
 
     pk_b (32, N), sig_b (64, N) uint8/int32 byte rows; dig_b (64, N)
@@ -340,7 +398,8 @@ def _verify_tile(pk_b, sig_b, dig_b, mosaic: bool = False) -> jnp.ndarray:
     Pure jnp on values — the same body runs as a jitted XLA program
     (CPU and fallback) and, with mosaic=True (Mosaic-lowerable window
     walk, see dual_mult_sb_minus_ka), as the per-tile body of the
-    fused Pallas kernel (ops/ed25519_pallas.py)."""
+    fused Pallas kernel (ops/ed25519_pallas.py). `dual_fn` swaps in the
+    segmented Pallas dual-mult while the rest stays XLA."""
     pk = pk_b.astype(jnp.int32)
     sig = sig_b.astype(jnp.int32)
     dig = dig_b.astype(jnp.int32)
@@ -355,7 +414,9 @@ def _verify_tile(pk_b, sig_b, dig_b, mosaic: bool = False) -> jnp.ndarray:
     s_ok = _s_lt_l_dev(s)
     dS = _nibbles_dev(s)
     dk = _nibbles_dev(_mod_l_dev(dig))
-    ok = _scalar_mult_check(yA, signA, yR, signR, dS, dk, mosaic=mosaic)
+    ok = _scalar_mult_check(
+        yA, signA, yR, signR, dS, dk, mosaic=mosaic, dual_fn=dual_fn
+    )
     return ok & s_ok
 
 
@@ -394,7 +455,9 @@ class Ed25519Verifier:
         # it (i.e. a pallas program could possibly be in `prog`) — the
         # default XLA path must never pay for, or fail on, this import
         mod = sys.modules.get(__package__ + ".ed25519_pallas")
-        return mod is not None and prog is mod.verify_pallas
+        return mod is not None and (
+            prog is mod.verify_pallas or prog is mod.verify_hybrid
+        )
 
     def _bucket(self, n: int) -> int:
         b = bucket_for(n, self.bucket_sizes)
@@ -409,21 +472,29 @@ class Ed25519Verifier:
         return b
 
     @staticmethod
-    def _pallas_wanted() -> bool:
-        """Fused Pallas kernel gate. Opt-in (TM_TPU_PALLAS=1) for now:
-        the kernel is differential-verified in interpret mode
-        (tests/test_ops_pallas.py) but Mosaic compilation via this
-        environment's remote-compile tunnel has not been timed yet, and
-        an unbounded first compile must not eat the benchmark window.
-        The XLA program remains the measured default."""
+    def _pallas_wanted() -> Optional[str]:
+        """Fused Pallas kernel gate. Opt-in for now: the kernels are
+        differential-verified in interpret mode (tests/test_ops_pallas.py)
+        but Mosaic compilation via this environment's remote-compile
+        tunnel has not completed for the monolithic kernel, and an
+        unbounded first compile must not eat the benchmark window. The
+        XLA program remains the measured default.
+
+        TM_TPU_PALLAS=1|hybrid -> the segmented kernel (Pallas
+        dual-mult inside an XLA program — ~6x smaller Mosaic module);
+        TM_TPU_PALLAS=full -> the monolithic whole-tile kernel."""
         import os
 
         if os.environ.get("TM_TPU_NO_PALLAS"):
-            return False
-        return (
-            os.environ.get("TM_TPU_PALLAS") == "1"
-            and jax.default_backend() == "tpu"
-        )
+            return None
+        if jax.default_backend() != "tpu":
+            return None
+        v = os.environ.get("TM_TPU_PALLAS")
+        if v in ("1", "hybrid"):
+            return "hybrid"
+        if v == "full":
+            return "full"
+        return None
 
     def _program(self, size: int):
         """The compiled program for a bucket. One shape-polymorphic
@@ -433,7 +504,12 @@ class Ed25519Verifier:
         per-bucket sharded programs."""
         fn = self._compiled.get(size)
         if fn is None:
-            if self._pallas_wanted():
+            kind = self._pallas_wanted()
+            if kind == "hybrid":
+                from .ed25519_pallas import verify_hybrid
+
+                fn = verify_hybrid
+            elif kind == "full":
                 from .ed25519_pallas import verify_pallas
 
                 fn = verify_pallas

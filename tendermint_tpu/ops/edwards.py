@@ -248,13 +248,15 @@ def decompress(y: jnp.ndarray, sign: jnp.ndarray):
 # -- host-side table generation (niels form, Z = 1) --
 
 
-def niels_table_b() -> np.ndarray:
-    """(16, 4, NLIMBS, 1): cached-form entries for j*B, j = 0..15, Z = 1.
-    Layout matches cache_point output: (y-x, y+x, 2d*xy, 2); trailing
-    1-axis broadcasts over the batch."""
+def niels_table_b(count: int = 9) -> np.ndarray:
+    """(count, 4, NLIMBS, 1): cached-form entries for j*B, j = 0..count-1,
+    Z = 1. Default 9 entries — the signed-digit half-table (negatives
+    come free from the cached-negation identity). Layout matches
+    cache_point output: (y-x, y+x, 2d*xy, 2); trailing 1-axis broadcasts
+    over the batch."""
     entries = []
     pt = em.IDENTITY
-    for _j in range(16):
+    for _j in range(count):
         X, Y, Z, _T = pt
         zinv = pow(Z, em.P - 2, em.P)
         x, y = X * zinv % em.P, Y * zinv % em.P

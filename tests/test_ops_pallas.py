@@ -54,3 +54,66 @@ def test_pallas_matches_xla_program():
     assert (ref == got).all()
     assert not got[3] and not got[11]
     assert got.sum() == 2 * TILE - 2
+
+
+def test_hybrid_matches_xla_program():
+    """The segmented program (Pallas dual-mult, XLA around it) must
+    return the exact bitmap of the pure-XLA tile."""
+    from tendermint_tpu.ops.ed25519_pallas import verify_hybrid
+
+    pk, sig, dig = _batch(2 * TILE, corrupt={0, 9})
+    ref = np.asarray(K._verify_tile(pk, sig, dig))
+    got = np.asarray(verify_hybrid(pk, sig, dig, interpret=True, tile=TILE))
+    assert (ref == got).all()
+    assert not got[0] and not got[9]
+    assert got.sum() == 2 * TILE - 2
+
+
+def test_mosaic_jaxpr_clean():
+    """The mosaic-path bodies must stay free of primitives Mosaic
+    cannot lower (scatter, gather, dynamic_slice, rev, rank-1 iota) —
+    each was found the hard way on hardware (PERF.md). Guards the
+    kernels' lowerability without needing a TPU in CI."""
+    import jax
+
+    from tendermint_tpu.ops import field25519 as F
+
+    banned = {
+        "scatter", "scatter-add", "gather", "dynamic_slice",
+        "dynamic_update_slice", "rev",
+    }
+
+    def check(fn, *avals):
+        seen = set()
+
+        def walk(jaxpr):
+            for eq in jaxpr.eqns:
+                name = eq.primitive.name
+                if name in banned:
+                    seen.add(name)
+                if name == "iota" and len(eq.outvars[0].aval.shape) == 1:
+                    seen.add("iota(rank-1)")
+                for p in eq.params.values():
+                    if hasattr(p, "jaxpr"):
+                        walk(p.jaxpr)
+                    elif isinstance(p, (list, tuple)):
+                        for q in p:
+                            if hasattr(q, "jaxpr"):
+                                walk(q.jaxpr)
+
+        walk(jax.make_jaxpr(fn)(*avals).jaxpr)
+        return seen
+
+    i32 = jnp.int32
+    s32 = jax.ShapeDtypeStruct((32, TILE), i32)
+    s64 = jax.ShapeDtypeStruct((64, TILE), i32)
+    pt = jax.ShapeDtypeStruct((4, F.NLIMBS, TILE), i32)
+    bad = check(
+        lambda a, b, c: K._verify_tile(a, b, c, mosaic=True), s32, s64, s64
+    )
+    assert not bad, f"monolithic tile body uses {bad}"
+    bad = check(
+        lambda a, b, c: K.dual_mult_sb_minus_ka(a, b, c, mosaic=True),
+        pt, s64, s64,
+    )
+    assert not bad, f"dual-mult body uses {bad}"
