@@ -1,12 +1,10 @@
 """Round-3 focused device probes, appended to DEVICE_SESSION.json.
 
-Stages, in run order:
+Stages, in run order (Pallas LAST — a server-side Mosaic compile can
+hang 20+ min holding the claim; bank the XLA numbers first):
 
-  xla_tput3       — headline: the current default tree (scan window
-                    walk + unrolled device SHA-512) at 8192
-  pallas_probe2   — Mosaic compile retry after the scatter /
-                    dynamic_slice / iota / rev fixes (commit 86ed9fc)
-  pallas_tput2    — pallas throughput at 8192 if the probe held
+  xla_tput3       — headline: the current default tree (signed-digit
+                    half-tables, MXU B-select, device SHA-512) at 8192
   xla_mosaic_form — scan+flip vs fori+one-hot window walks as plain
                     XLA programs (regression attribution, PERF.md)
   sr_tput2        — sr25519 throughput on the current tree
@@ -14,6 +12,9 @@ Stages, in run order:
                     with the templated sign-bytes path
   xla_hostsha     — XLA throughput with host-side SHA-512 (A/B
                     against the device hash)
+  pallas_probe2   — the segmented hybrid kernel (TM_TPU_PALLAS=1 ->
+                    Pallas dual-mult, XLA around it) at bucket 128
+  pallas_tput2    — hybrid throughput at 8192 if the probe held
 
 Prior-session entries for these stages are dropped before the run (the
 stage writer merges). SIGTERM-safe, never SIGKILLs the device client
@@ -159,8 +160,21 @@ def stage_mosaic_form():
     )
     args = tuple(jnp.asarray(a) for a in (pk_b, sig_b, dig_b))
     out = {}
-    for name, mosaic in (("scan", False), ("onehot", True)):
-        fn = jax.jit(lambda a, b, c, _m=mosaic: K._verify_tile(a, b, c, mosaic=_m))
+    # 2x2: window-walk form (scan vs fori+one-hot) x fixed-base select
+    # engine (MXU einsum vs VPU one-hot) — isolates each variable
+    for name, mosaic, mxu in (
+        ("scan_mxu", False, True),
+        ("scan_vpu", False, False),
+        ("onehot_vpu", True, False),
+        ("onehot_mxu", True, True),
+    ):
+        def tile(a, b, c, _m=mosaic, _x=mxu):
+            dual = lambda A, dS, dk: K.dual_mult_sb_minus_ka(
+                A, dS, dk, mosaic=_m, mxu=_x
+            )
+            return K._verify_tile(a, b, c, dual_fn=dual)
+
+        fn = jax.jit(tile)
         r = fn(*args)
         jax.block_until_ready(r)
         assert bool(np.asarray(r).all())
@@ -214,14 +228,17 @@ def main():
     jax.config.update("jax_compilation_cache_dir", cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    # Pallas stages LAST: a server-side Mosaic compile can hang for
+    # 20+ minutes holding the claim (PERF.md session-2 findings); all
+    # XLA measurements must be banked before taking that risk.
     for st in (
         stage_xla3,
-        stage_probe2,
-        stage_tput2,
         stage_mosaic_form,
         stage_sr2,
         stage_commit_10k,
         stage_hostsha,
+        stage_probe2,
+        stage_tput2,
     ):
         st()
     print(json.dumps(_state["stages"], indent=1))

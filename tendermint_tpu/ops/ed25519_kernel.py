@@ -143,12 +143,33 @@ def _recode_signed(d: jnp.ndarray) -> jnp.ndarray:
     return t - 16 * (t >= 8).astype(d.dtype)
 
 
-def _select_signed(table9: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
+def _select_signed(
+    table9: jnp.ndarray, e: jnp.ndarray, mxu: bool = False
+) -> jnp.ndarray:
     """table9 (9, 4, L, {N|1}) cached-form entries for j*P, j = 0..8;
     e (N,) signed digit in [-8, 8] -> (4, L, N) cached |e|*P, negated
     when e < 0 (cached negation = swap (Y-X, Y+X), negate 2dT — no
-    multiplies, edwards.negate_cached's identity applied post-select)."""
-    sel = _onehot_select(table9, jnp.abs(e))
+    multiplies, edwards.negate_cached's identity applied post-select).
+
+    mxu=True (lane-shared tables only, i.e. the fixed-base B table):
+    the select is a real (9, 4L) x (9, N) contraction, so ride the MXU
+    in f32 instead of spending VPU MACs — exact because limbs < 2^24
+    and the mask is one-hot (Precision.HIGHEST carries the full f32
+    mantissa through the bf16 passes)."""
+    idx = jnp.abs(e)
+    if mxu and table9.shape[-1] == 1:
+        k = table9.shape[0]
+        js = lax.broadcasted_iota(idx.dtype, (k, idx.shape[0]), 0)
+        mask = (idx[None, :] == js).astype(jnp.float32)  # (9, N)
+        tbl = table9[..., 0].reshape(k, -1).astype(jnp.float32)  # (9, 4L)
+        sel = jnp.einsum(
+            "kc,kn->cn", tbl, mask, precision=lax.Precision.HIGHEST
+        )
+        sel = sel.reshape(
+            table9.shape[1], table9.shape[2], idx.shape[0]
+        ).astype(jnp.int32)
+    else:
+        sel = _onehot_select(table9, idx)
     sgn = (e < 0)[None, None, :]
     return jnp.where(sgn, E.negate_cached(sel), sel)
 
@@ -158,6 +179,7 @@ def dual_mult_sb_minus_ka(
     dS: jnp.ndarray,
     dk: jnp.ndarray,
     mosaic: bool = False,
+    mxu: Optional[bool] = None,
 ) -> jnp.ndarray:
     """[S]B - [k]A as a T-less (3, NLIMBS, N) projective stack.
 
@@ -176,7 +198,13 @@ def dual_mult_sb_minus_ka(
     - mosaic=True (the Pallas tile): lax.fori_loop; the window's digit
       row is picked by a one-hot masked sum because Mosaic lowers
       neither scan's xs dynamic_slice nor jnp.flip's rev. 64 extra
-      MACs/window are noise next to the point ops."""
+      MACs/window are noise next to the point ops.
+
+    `mxu` overrides the fixed-base select engine (default: MXU einsum
+    on the XLA path, VPU one-hot in the mosaic/Pallas path) — the
+    override exists for device A/B attribution (scripts/probe_r3.py)."""
+    if mxu is None:
+        mxu = not mosaic
     TA = _build_neg_a_table(A)  # (9, 4, L, N)
 
     tb0 = _tb0()  # (9, 4, L, 1)
@@ -197,7 +225,7 @@ def dual_mult_sb_minus_ka(
         acc = E.point_double(acc)  # T feeds the addition below
         acc = E.point_add_cached(acc, _select_signed(TA, dk_w))
         acc = E.point_add_cached(
-            acc, _select_signed(tb0, ds_w), with_t=False
+            acc, _select_signed(tb0, ds_w, mxu=mxu), with_t=False
         )
         return acc
 

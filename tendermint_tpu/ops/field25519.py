@@ -117,11 +117,15 @@ def _pass(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def carry(x: jnp.ndarray) -> jnp.ndarray:
-    """Loose-normalize: input limbs |x_i| < 2^17ish, output limbs in
-    [-2^11, 2^13 + 2^11). Two parallel passes suffice: after pass one all
-    limbs are <= 2^13 + (2^17 >> 13) + 608*small; after pass two the
-    slack is a few units. The loose bound (≤ ~10300) keeps schoolbook
-    products within int32: 20 * 10300 * 9000 < 2^31."""
+    """Loose-normalize: input limbs |x_i| up to ~2^28, output limbs in
+    [-2^11, 2^13 + 2^11). Two parallel passes suffice: after pass one
+    limbs are <= 2^13 + (2^28 >> 13) + 608*(2^28 >> 13) wrapped into
+    limb 0 (< 2^24); after pass two the slack is <= 608*3 on limb 0 and
+    a few units elsewhere. (_conv_tail leans on the full ~2^28 budget —
+    its folded slots reach ~2^27.3; the bound analysis lives in its
+    docstring and is pinned by tests/test_ops_field.py's envelope
+    cases.) The loose output bound (≤ ~10300) keeps schoolbook products
+    within int32: 20 * 10300 * 9000 < 2^31."""
     return _pass(_pass(x))
 
 
@@ -148,17 +152,42 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
     return carry(jnp.asarray(_2P_LIMBS) - a)
 
 
+def _conv_tail(x: jnp.ndarray) -> jnp.ndarray:
+    """(…, 39, N) raw convolution coefficients -> (…, 20, N)
+    loose-normalized product limbs. One widening pass, one fold, two
+    carry passes — shared by mul and sqr.
+
+    Bounds (both operands at the full loose-normal envelope,
+    |limbs| ≤ 10240, operand VALUES nonnegative — the program-wide
+    invariant kept by the +2p biases and pinned by
+    tests/test_ops_field.py's envelope cases):
+      conv coeffs |c| ≤ 20 * 10240^2 < 2^31                (int32 safe)
+      widening pass: d ∈ [0, 2^13), carry-in |c| ≤ 2^18    -> ≤ 2^18.02
+      fold (2^(13k) ≡ 608 * 2^(13(k-20))): |out| ≤ 2^18.02 * 608 < 2^27.3
+      carry pass A: limb0 ≤ 2^13 + 608*(2^27.3 >> 13) < 2^23.6,
+                    limbs 1..19 ≤ 2^13 + 2^14.3
+      carry pass B: limb0 ≤ 2^13 + 608*3 = 10015 < 10240,
+                    limb1 ≤ 2^13 + 1465, rest ≤ 2^13 + 3   (envelope)"""
+    c = x >> RADIX
+    d = x & MASK
+    zero = jnp.zeros_like(x[..., :1, :])
+    x = jnp.concatenate(
+        [
+            d + jnp.concatenate([zero, c[..., :-1, :]], axis=-2),
+            c[..., -1:, :],
+        ],
+        axis=-2,
+    )  # 40 slots; the full product value lives in them
+    low = x[..., :NLIMBS, :]
+    hi = x[..., NLIMBS : 2 * NLIMBS, :] * FOLD  # positions 20..39 -> 0..19
+    return carry(low + hi)
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook product as 20 shifted multiply-accumulates over 39
     convolution coefficients, carried with parallel passes, then folded
-    mod p. Batched over the minor axis.
-
-    Bounds: with loose-normalized inputs (|limbs| ≤ ~9500) conv
-    coefficients are ≤ 20 * 9500^2 < 2^31. Two widening parallel passes
-    plus one plain pass bring all 41 digit slots to ≤ 2^13 + small (the
-    product value < 2^523 fits 41 slots, so the last pass provably sheds
-    no carry). Digits at positions k ≥ 20 fold back with
-    2^(13k) ≡ 608 * 2^(13(k-20)); position 40 folds twice (608^2)."""
+    mod p (see _conv_tail for the carry schedule and its bounds).
+    Batched over the minor axis."""
     x = None  # (..., 39, N) conv accumulator
     pad_cfg_head = [(0, 0)] * (a.ndim - 2)
     for i in range(NLIMBS):
@@ -167,38 +196,7 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
             term, pad_cfg_head + [(i, NLIMBS - 1 - i), (0, 0)]
         )
         x = shifted if x is None else x + shifted
-
-    # widening parallel passes (carry out of the top slot becomes a new slot)
-    for _ in range(2):
-        c = x >> RADIX
-        d = x & MASK
-        zero = jnp.zeros_like(x[..., :1, :])
-        x = jnp.concatenate(
-            [
-                d + jnp.concatenate([zero, c[..., :-1, :]], axis=-2),
-                c[..., -1:, :],
-            ],
-            axis=-2,
-        )
-    # one plain pass (top carry is provably zero now)
-    c = x >> RADIX
-    d = x & MASK
-    zero = jnp.zeros_like(x[..., :1, :])
-    x = d + jnp.concatenate([zero, c[..., :-1, :]], axis=-2)
-
-    low = x[..., :NLIMBS, :]
-    hi = x[..., NLIMBS : 2 * NLIMBS, :] * FOLD  # positions 20..39 -> 0..19
-    out = low + hi
-    extra = x[..., 2 * NLIMBS : 2 * NLIMBS + 1, :] * (FOLD * FOLD)
-    out = jnp.concatenate(
-        [out[..., :1, :] + extra, out[..., 1:, :]], axis=-2
-    )
-    # limbs now ≤ 2^13 + 608*2^13 + small < 2^23. TWO passes are needed:
-    # after one, limbs 1..19 are ≤ 2^13 + 2^10, but limb 0 picks up the
-    # top limb's wraparound carry ×608 (≈ 610*608 ≈ 2^18.5) — outside the
-    # loose-normal envelope, and a following mul would overflow int32 on
-    # the a0*b0 coefficient. The second pass sheds it.
-    return carry(out)
+    return _conv_tail(x)
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
@@ -224,32 +222,7 @@ def sqr(a: jnp.ndarray) -> jnp.ndarray:
         )
         diag = d if diag is None else diag + d
     x = x + x - diag  # diag once, off-diagonal twice
-
-    # identical folding tail to mul()
-    for _ in range(2):
-        c = x >> RADIX
-        d = x & MASK
-        zero = jnp.zeros_like(x[..., :1, :])
-        x = jnp.concatenate(
-            [
-                d + jnp.concatenate([zero, c[..., :-1, :]], axis=-2),
-                c[..., -1:, :],
-            ],
-            axis=-2,
-        )
-    c = x >> RADIX
-    d = x & MASK
-    zero = jnp.zeros_like(x[..., :1, :])
-    x = d + jnp.concatenate([zero, c[..., :-1, :]], axis=-2)
-
-    low = x[..., :NLIMBS, :]
-    hi = x[..., NLIMBS : 2 * NLIMBS, :] * FOLD
-    out = low + hi
-    extra = x[..., 2 * NLIMBS : 2 * NLIMBS + 1, :] * (FOLD * FOLD)
-    out = jnp.concatenate(
-        [out[..., :1, :] + extra, out[..., 1:, :]], axis=-2
-    )
-    return carry(out)  # two passes — see mul() tail comment
+    return _conv_tail(x)
 
 
 def mul_const(a: jnp.ndarray, c: int) -> jnp.ndarray:

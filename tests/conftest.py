@@ -52,6 +52,25 @@ def _enable_compilation_cache() -> None:
 _enable_compilation_cache()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _release_compiled_executables():
+    """Drop compiled-executable references after every test module.
+
+    Each XLA:CPU LoadedExecutable holds many mmap'd regions; across the
+    full suite they accumulate to the kernel's vm.max_map_count limit
+    (65530 — observed 65313 maps one minute before a C-level abort in
+    backend_compile_and_load at the late test_sharding module, 4 runs
+    in a row, never in isolation). Clearing jax's caches lets the
+    executables GC and unmap, so the per-process peak stays at the
+    biggest single module, not the sum of all modules. Recompiles on
+    module boundaries are mostly persistent-cache hits."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture
 def tmp_home(tmp_path):
     from tendermint_tpu.config import Config

@@ -157,3 +157,74 @@ def test_decompress_matches_oracle():
     bad_y = 2  # x^2 = (4-1)/(4d+1): overwhelmingly non-square for y=2
     dev_pts2, ok2 = E.decompress(_pack([bad_y]), jnp.asarray(np.array([0], np.int32)))
     assert bool(np.asarray(ok2)[0]) == (em.decompress((2).to_bytes(32, "little")) is not None)
+
+
+# -- adversarial limb-envelope contract --
+#
+# The carry schedule's int32-safety argument (see F.mul/F.sqr/F.carry
+# docstrings) rests on every mul/sqr input being loose-normalized:
+# limbs in [-2^11, 2^13 + 2^11). These tests feed the EXTREMES of that
+# envelope — not just random canonical values — so any future
+# carry-pass tightening that silently narrows the accepted envelope
+# fails here instead of corrupting a rare verification.
+
+
+def _env_cases():
+    """(NLIMBS, N) batches of worst-case loose-normal limb vectors.
+
+    Individual limbs hit both envelope extremes, but every column's
+    VALUE is kept nonnegative (top limb pinned high): the carry
+    schedule's dropped-top-carry argument in F.mul/F.sqr only holds for
+    nonnegative operand values, which is the program's invariant — the
+    +2p biases in add/sub/neg/point ops keep every representative's
+    value >= 0 even when single limbs go negative."""
+    lo, hi = -(1 << 11), (1 << 13) + (1 << 11) - 1
+    rng = np.random.default_rng(7)
+    alt0 = np.where(np.arange(F.NLIMBS) % 2 == 0, hi, lo)
+    alt1 = np.where(np.arange(F.NLIMBS) % 2 == 1, hi, lo)
+    alt0[-1] = alt1[-1] = hi
+    cols = [np.full(F.NLIMBS, hi), alt0, alt1]
+    for _ in range(13):
+        c = rng.choice(np.array([lo, hi, 0, 1, -1]), F.NLIMBS)
+        c[-1] = hi  # dominates the worst negative lower-limb sum
+        cols.append(c)
+    out = np.stack(cols, axis=1).astype(np.int32)
+    assert all(_limb_value(out[:, j]) >= 0 for j in range(out.shape[1]))
+    return out
+
+
+def _limb_value(col):
+    return sum(int(v) << (F.RADIX * i) for i, v in enumerate(col))
+
+
+def test_mul_sqr_envelope_extremes():
+    batch = _env_cases()
+    vals = [_limb_value(batch[:, j]) for j in range(batch.shape[1])]
+    a = jnp.asarray(batch)
+    got_sqr = np.asarray(_field["sqr"](a))
+    got_mul = np.asarray(_field["mul"](a, a[:, ::-1].copy()))
+    for j in range(batch.shape[1]):
+        want_sq = vals[j] * vals[j] % P
+        assert _limb_value(got_sqr[:, j]) % P == want_sq, f"sqr col {j}"
+        want_mul = vals[j] * vals[batch.shape[1] - 1 - j] % P
+        assert _limb_value(got_mul[:, j]) % P == want_mul, f"mul col {j}"
+    # outputs must land back inside the loose-normal envelope, or the
+    # NEXT mul's int32-safety argument breaks
+    lo, hi = -(1 << 11), (1 << 13) + (1 << 11)
+    for out in (got_sqr, got_mul):
+        assert out.min() >= lo and out.max() < hi
+
+
+def test_carry_output_envelope():
+    """F.carry's documented output envelope over extreme raw inputs
+    (|x| < 2^17ish — the post-add/sub magnitude it claims to accept)."""
+    rng = np.random.default_rng(11)
+    x = rng.integers(-(1 << 17), 1 << 17, size=(F.NLIMBS, 64)).astype(
+        np.int32
+    )
+    out = np.asarray(jax.jit(F.carry)(jnp.asarray(x)))
+    vals_in = [_limb_value(x[:, j]) for j in range(64)]
+    lo, hi = -(1 << 11), (1 << 13) + (1 << 11)
+    assert out.min() >= lo and out.max() < hi
+    for j in range(64):
+        assert _limb_value(out[:, j]) % P == vals_in[j] % P
