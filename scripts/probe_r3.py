@@ -50,6 +50,7 @@ if os.path.exists(RESULTS):
 for _k in (
     "pallas_probe2",
     "pallas_tput2",
+    "pallas_sr",
     "xla_hostsha",
     "xla_tput3",
     "xla_mosaic_form",
@@ -99,6 +100,50 @@ def stage_tput2():
         rate = _throughput(v, pks, msgs, sigs)
         still_pallas = v._is_pallas(v._compiled.get(v._bucket(8192)))
         return {"sigs_per_s": round(rate, 1), "used_pallas": bool(still_pallas)}
+    finally:
+        os.environ.pop("TM_TPU_PALLAS", None)
+
+
+def _sr_batch(seed: int, n: int = 8192, tag: bytes = b"sr"):
+    """n (pk, msg, sig) sr25519 triples over 64 keys — shared by the
+    XLA and hybrid sr throughput stages (schnorrkel signing on host is
+    the slow part; build once per stage, not per variant)."""
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+    privs = [
+        PrivKeySr25519.from_seed(bytes([i, seed]) + b"\x00" * 30)
+        for i in range(64)
+    ]
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        p = privs[i % 64]
+        m = tag + b"-%08d" % i
+        pks.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(p.sign(m))
+    return pks, msgs, sigs
+
+
+@_stage("pallas_sr")
+def stage_pallas_sr():
+    """sr25519 hybrid (Pallas dual-mult segment) at 8192, only if the
+    ed25519 hybrid probe held — same kernel, so no point paying another
+    Mosaic compile budget if it already failed."""
+    probe = _state["stages"].get("pallas_probe2", {})
+    if not (probe.get("ok") and probe.get("used_pallas")):
+        return {"skipped": "ed25519 hybrid probe did not hold"}
+    os.environ["TM_TPU_PALLAS"] = "1"
+    try:
+        from tendermint_tpu.ops import sr25519_kernel as S
+
+        pks, msgs, sigs = _sr_batch(seed=7, tag=b"sr-hybrid")
+        v = S.Sr25519Verifier(bucket_sizes=[8192])
+        rate = _throughput(v, pks, msgs, sigs, reps=4)
+        still_hybrid = 8192 in v._pallas_proven
+        return {
+            "sigs_per_s": round(rate, 1),
+            "used_pallas": bool(still_hybrid),
+        }
     finally:
         os.environ.pop("TM_TPU_PALLAS", None)
 
@@ -187,18 +232,9 @@ def stage_mosaic_form():
 
 @_stage("sr_tput2")
 def stage_sr2():
-    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
     from tendermint_tpu.ops.sr25519_kernel import Sr25519Verifier
 
-    privs = [PrivKeySr25519.from_seed(bytes([i, 99]) + b"\x00" * 30)
-             for i in range(64)]
-    pks, msgs, sigs = [], [], []
-    for i in range(8192):
-        p = privs[i % 64]
-        m = b"sr-session-%08d" % i
-        pks.append(p.pub_key().bytes())
-        msgs.append(m)
-        sigs.append(p.sign(m))
+    pks, msgs, sigs = _sr_batch(seed=99, tag=b"sr-session")
     rate = _throughput(
         Sr25519Verifier(bucket_sizes=[8192]), pks, msgs, sigs, reps=4
     )
@@ -239,6 +275,7 @@ def main():
         stage_hostsha,
         stage_probe2,
         stage_tput2,
+        stage_pallas_sr,
     ):
         st()
     print(json.dumps(_state["stages"], indent=1))

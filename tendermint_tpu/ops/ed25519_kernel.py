@@ -461,6 +461,51 @@ def _join_cols(items: Sequence[bytes], width: int, pad: int) -> np.ndarray:
     return np.ascontiguousarray(out)
 
 
+def pallas_bucket(b: int) -> int:
+    """Round a bucket up to full Pallas tiles. Rounding small buckets
+    up costs nothing: the VPU lane tile is 128 wide, so an 8-lane XLA
+    program wastes 94% of every vector register anyway."""
+    from .ed25519_pallas import TILE
+
+    return max(TILE, -(-b // TILE) * TILE)
+
+
+def run_with_pallas_fallback(
+    prog, args, *, is_pallas, bucket, proven, compiled, xla_factory, label
+):
+    """Shared dispatch policy for programs that may contain a Pallas
+    kernel (the ed25519 tile/hybrid and the sr25519 hybrid).
+
+    Runs `prog(*args)`. JAX dispatch is asynchronous, so a Mosaic
+    *runtime* failure would surface later at gather()'s np.asarray —
+    past any fallback; block on the first call of each Pallas bucket so
+    device-side kernel failures downgrade HERE. On failure (lowering or
+    first-call runtime), log, permanently swap the bucket's entry in
+    `compiled` to `xla_factory()` (same math, same semantics), and
+    re-run. A non-Pallas program failing is a real error and re-raises."""
+    try:
+        ok = prog(*args)
+        if is_pallas and bucket not in proven:
+            jax.block_until_ready(ok)
+            proven.add(bucket)
+        return ok
+    except Exception as e:
+        if not is_pallas:
+            raise
+        import logging
+
+        logging.getLogger("tendermint_tpu.ops").warning(
+            "pallas %s kernel failed for bucket %d; "
+            "falling back to the XLA program: %s",
+            label,
+            bucket,
+            e,
+        )
+        fn = xla_factory()
+        compiled[bucket] = fn
+        return fn(*args)
+
+
 class Ed25519Verifier:
     """Compiled, bucketed batch verifier.
 
@@ -490,13 +535,7 @@ class Ed25519Verifier:
     def _bucket(self, n: int) -> int:
         b = bucket_for(n, self.bucket_sizes)
         if self._pallas_wanted():
-            # The fused Pallas kernel tiles the batch in full 128-lane
-            # blocks. Rounding small buckets up costs nothing: the VPU
-            # lane tile is 128 wide, so an 8-lane XLA program wastes
-            # 94% of every vector register anyway.
-            from .ed25519_pallas import TILE
-
-            b = max(TILE, -(-b // TILE) * TILE)
+            b = pallas_bucket(b)
         return b
 
     @staticmethod
@@ -597,36 +636,16 @@ class Ed25519Verifier:
         sig_b = _join_cols(sigs, 64, pad)
         dig_b = self._digest_rows(pubkeys, msgs, sigs, bucket)
         prog = self._program(bucket)
-        try:
-            ok = prog(
-                jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
-            )
-            if bucket not in self._pallas_proven and self._is_pallas(prog):
-                # JAX dispatch is asynchronous: a Mosaic *runtime*
-                # failure would otherwise surface later, at gather()'s
-                # np.asarray, past this fallback. Block on the first
-                # call of each Pallas bucket so device-side kernel
-                # failures downgrade to the XLA program here.
-                jax.block_until_ready(ok)
-                self._pallas_proven.add(bucket)
-        except Exception as e:
-            if not self._is_pallas(prog):
-                raise  # a non-Pallas program failing is a real error
-            # Mosaic lowering failure: permanently fall back to the XLA
-            # program for this bucket (same math, same semantics).
-            import logging
-
-            logging.getLogger("tendermint_tpu.ops").warning(
-                "pallas ed25519 kernel failed for bucket %d; "
-                "falling back to the XLA program: %s",
-                bucket,
-                e,
-            )
-            fn = _jit_verify_tile()
-            self._compiled[bucket] = fn
-            ok = fn(
-                jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)
-            )
+        ok = run_with_pallas_fallback(
+            prog,
+            (jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(dig_b)),
+            is_pallas=self._is_pallas(prog),
+            bucket=bucket,
+            proven=self._pallas_proven,
+            compiled=self._compiled,
+            xla_factory=_jit_verify_tile,
+            label="ed25519",
+        )
         return (ok, n, size_ok)
 
     def _digest_rows(self, pubkeys, msgs, sigs, bucket):

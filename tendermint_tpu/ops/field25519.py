@@ -117,15 +117,17 @@ def _pass(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def carry(x: jnp.ndarray) -> jnp.ndarray:
-    """Loose-normalize: input limbs |x_i| up to ~2^28, output limbs in
-    [-2^11, 2^13 + 2^11). Two parallel passes suffice: after pass one
-    limbs are <= 2^13 + (2^28 >> 13) + 608*(2^28 >> 13) wrapped into
-    limb 0 (< 2^24); after pass two the slack is <= 608*3 on limb 0 and
-    a few units elsewhere. (_conv_tail leans on the full ~2^28 budget —
-    its folded slots reach ~2^27.3; the bound analysis lives in its
-    docstring and is pinned by tests/test_ops_field.py's envelope
-    cases.) The loose output bound (≤ ~10300) keeps schoolbook products
-    within int32: 20 * 10300 * 9000 < 2^31."""
+    """Loose-normalize: input limbs |x_i| up to ~2^27.5, output limbs
+    in [-2^11, 2^13 + 2^11). Two parallel passes suffice: after pass
+    one, limb 0 is <= 2^13 + 608*(|x| >> 13) (the top limb's carry
+    wraps in multiplied by 608) and the rest <= 2^13 + (|x| >> 13);
+    after pass two the slack is <= 608*3 on limb 0 and a few units
+    elsewhere. The envelope proof fails above ~2^27.75 (limb 1 would
+    exceed 2^13 + 2^11 after pass two), so ~2^27.5 is the contract —
+    the heaviest caller, _conv_tail, peaks at ~2^27.3 (analysis in its
+    docstring, pinned by tests/test_ops_field.py's envelope cases).
+    The loose output bound (≤ ~10300) keeps schoolbook products within
+    int32: 20 * 10300 * 9000 < 2^31."""
     return _pass(_pass(x))
 
 

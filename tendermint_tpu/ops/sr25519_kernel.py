@@ -146,13 +146,15 @@ def _ristretto_eq_dev(p3: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     return eq1 | eq2
 
 
-def _verify_tile_sr(pk_b, sig_b, k_b) -> jnp.ndarray:
+def _verify_tile_sr(pk_b, sig_b, k_b, dual_fn=None) -> jnp.ndarray:
     """The full sr25519 device program: byte rows in, bitmap out.
 
     pk_b (32, N) ristretto pubkey bytes; sig_b (64, N) R || s with the
     schnorrkel v1 marker in bit 511; k_b (32, N) LE bytes of the
     merlin challenge already reduced mod L on host. Returns (N,) bool.
-    """
+    `dual_fn` swaps in the segmented Pallas dual-mult (the same kernel
+    the ed25519 hybrid uses — ops/ed25519_pallas.dual_mult_pallas);
+    ristretto decode and the equality stay XLA."""
     pk = pk_b.astype(jnp.int32)
     sig = sig_b.astype(jnp.int32)
     kb = k_b.astype(jnp.int32)
@@ -163,11 +165,15 @@ def _verify_tile_sr(pk_b, sig_b, k_b) -> jnp.ndarray:
     R, okR = ristretto_decode_dev(sig[:32])
     dS = _nibbles_dev(s)
     dk = _nibbles_dev(kb)
-    acc = dual_mult_sb_minus_ka(A, dS, dk)  # [s]B - [k]A, T-less
+    if dual_fn is None:
+        acc = dual_mult_sb_minus_ka(A, dS, dk)  # [s]B - [k]A, T-less
+    else:
+        acc = dual_fn(A, dS, dk)
     return _ristretto_eq_dev(acc, R) & okA & okR & s_ok & marker_ok
 
 
 _JIT_VERIFY_SR = None
+_JIT_VERIFY_SR_HYBRID = None
 
 
 def _jit_verify_tile_sr():
@@ -175,6 +181,23 @@ def _jit_verify_tile_sr():
     if _JIT_VERIFY_SR is None:
         _JIT_VERIFY_SR = jax.jit(_verify_tile_sr)
     return _JIT_VERIFY_SR
+
+
+def _jit_verify_tile_sr_hybrid():
+    """sr25519 program with the Pallas dual-mult segment (same gating
+    as the ed25519 hybrid: TM_TPU_PALLAS=1, see
+    Ed25519Verifier._pallas_wanted; falls back per-bucket in dispatch
+    if Mosaic rejects the kernel)."""
+    global _JIT_VERIFY_SR_HYBRID
+    if _JIT_VERIFY_SR_HYBRID is None:
+        import functools
+
+        from .ed25519_pallas import dual_mult_pallas
+
+        _JIT_VERIFY_SR_HYBRID = jax.jit(
+            functools.partial(_verify_tile_sr, dual_fn=dual_mult_pallas)
+        )
+    return _JIT_VERIFY_SR_HYBRID
 
 
 class Sr25519Verifier:
@@ -187,18 +210,32 @@ class Sr25519Verifier:
     def __init__(self, bucket_sizes: Optional[Sequence[int]] = None) -> None:
         self.bucket_sizes = sorted(bucket_sizes or DEFAULT_BUCKET_SIZES)
         self._compiled: dict = {}
+        # buckets whose hybrid (Pallas dual-mult) program has completed
+        # on device at least once — first calls block, see dispatch()
+        self._pallas_proven: set = set()
 
     def _bucket(self, n: int) -> int:
-        return bucket_for(n, self.bucket_sizes)
+        from .ed25519_kernel import Ed25519Verifier, pallas_bucket
+
+        b = bucket_for(n, self.bucket_sizes)
+        if Ed25519Verifier._pallas_wanted():
+            b = pallas_bucket(b)
+        return b
 
     def _program(self, size: int):
         """The compiled program for a bucket — one shape-polymorphic
         jitted function by default; the per-size dict exists for
-        overrides (ShardedSr25519Verifier's mesh-partitioned
-        programs, tendermint_tpu.parallel.sharding)."""
+        overrides (ShardedSr25519Verifier's mesh-partitioned programs,
+        tendermint_tpu.parallel.sharding; the per-bucket Pallas
+        fallback in dispatch)."""
         fn = self._compiled.get(size)
         if fn is None:
-            fn = _jit_verify_tile_sr()
+            from .ed25519_kernel import Ed25519Verifier
+
+            if Ed25519Verifier._pallas_wanted():
+                fn = _jit_verify_tile_sr_hybrid()
+            else:
+                fn = _jit_verify_tile_sr()
             self._compiled[size] = fn
         return fn
 
@@ -254,8 +291,20 @@ class Sr25519Verifier:
         sig_b = _join_cols(sigs, 64, pad)
         k_b = _join_cols(ks, 32, pad)
         prog = self._program(bucket)
-        ok = prog(
-            jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(k_b)
+        from .ed25519_kernel import run_with_pallas_fallback
+
+        ok = run_with_pallas_fallback(
+            prog,
+            (jnp.asarray(pk_b), jnp.asarray(sig_b), jnp.asarray(k_b)),
+            is_pallas=(
+                _JIT_VERIFY_SR_HYBRID is not None
+                and prog is _JIT_VERIFY_SR_HYBRID
+            ),
+            bucket=bucket,
+            proven=self._pallas_proven,
+            compiled=self._compiled,
+            xla_factory=_jit_verify_tile_sr,
+            label="sr25519",
         )
         return (ok, n, size_ok)
 

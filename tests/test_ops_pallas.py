@@ -117,3 +117,39 @@ def test_mosaic_jaxpr_clean():
         pt, s64, s64,
     )
     assert not bad, f"dual-mult body uses {bad}"
+
+
+def test_sr25519_hybrid_matches_xla_program():
+    """The sr25519 hybrid (Pallas dual-mult segment) must return the
+    exact bitmap of the pure-XLA sr25519 tile."""
+    import functools
+
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+    from tendermint_tpu.ops import sr25519_kernel as S
+    from tendermint_tpu.ops.ed25519_pallas import dual_mult_pallas
+
+    pks, msgs, sigs = [], [], []
+    for i in range(TILE):
+        priv = PrivKeySr25519.from_seed(bytes([i, 3]) + b"\x00" * 30)
+        m = b"sr-pallas-%d" % i
+        sig = priv.sign(m)
+        if i in (1, 5):
+            sig = sig[:6] + bytes([sig[6] ^ 1]) + sig[7:]
+        pks.append(priv.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(sig)
+    from tendermint_tpu.crypto.sr25519 import challenge_batch
+
+    ks = [
+        k.to_bytes(32, "little")
+        for k in challenge_batch(pks, msgs, [s[:32] for s in sigs])
+    ]
+    pk_b = jnp.asarray(K._join_cols(pks, 32, 0))
+    sig_b = jnp.asarray(K._join_cols(sigs, 64, 0))
+    k_b = jnp.asarray(K._join_cols(ks, 32, 0))
+    ref = np.asarray(S._verify_tile_sr(pk_b, sig_b, k_b))
+    dual = functools.partial(dual_mult_pallas, interpret=True, tile=TILE)
+    got = np.asarray(S._verify_tile_sr(pk_b, sig_b, k_b, dual_fn=dual))
+    assert (ref == got).all()
+    assert not got[1] and not got[5]
+    assert got.sum() == TILE - 2
