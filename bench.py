@@ -620,7 +620,10 @@ def main() -> None:
         except Exception as e:
             curve_sr = {"error": repr(e)}
     try:
-        light_rate = bench_light_sync(n_headers=10 if fallback else 50)
+        # device path: 300 headers x 150 validators — long enough that
+        # the windowed batching (one device batch per 32 hops) and not
+        # the warmup dominates; BASELINE config 4's shape at 3% length
+        light_rate = bench_light_sync(n_headers=10 if fallback else 300)
     except Exception as e:  # pragma: no cover - keep the primary line
         light_rate = None
         light_err = repr(e)

@@ -1,0 +1,92 @@
+"""Local Mosaic/XLA-TPU compile check — NO device or claim needed.
+
+libtpu ships in this image, so the real TPU compiler (including
+Mosaic's jaxpr->vreg pipeline) runs locally against a compile-only
+v5e topology. This is how the 'Invalid vector register cast' in the
+bool Kogge-Stone recode was found and fixed in minutes after weeks of
+blind 70-second remote probes and wedged claims (PERF.md session 2).
+
+Run on CPU only: env PYTHONPATH= JAX_PLATFORMS=cpu python scripts/aot_check.py
+
+Checks, each compiled under shard_map over a 4-chip v5e:2x2 mesh
+(batch axis sharded — the production layout of parallel/sharding.py):
+
+  hybrid      — verify_hybrid (Pallas dual-mult segment + XLA around)
+  sr-hybrid   — _verify_tile_sr with the same Pallas dual-mult
+  monolithic  — verify_pallas (whole tile in one kernel); known to
+                fail 'arith.trunci i8->i1' as of 2026-07-31 — tracked,
+                not load-bearing (the hybrid is the default candidate)
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+import traceback
+
+sys.path.insert(0, __import__("os").path.abspath(
+    __import__("os").path.join(__import__("os").path.dirname(__file__), "..")
+))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tendermint_tpu.ops import sr25519_kernel as S
+    from tendermint_tpu.ops.ed25519_pallas import (
+        dual_mult_pallas,
+        verify_hybrid,
+        verify_pallas,
+    )
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name="v5e:2x2"
+    )
+    mesh = topologies.make_mesh(topo, (4,), ("x",))
+
+    failures = 0
+
+    def aot(inner, name, rows):
+        nonlocal failures
+        fn = shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(None, "x"),) * 3,
+            out_specs=P("x"),
+            check_rep=False,
+        )
+        args = [
+            jax.ShapeDtypeStruct(
+                (r, 512), jnp.int32, sharding=NamedSharding(mesh, P(None, "x"))
+            )
+            for r in rows
+        ]
+        t0 = time.perf_counter()
+        try:
+            jax.jit(fn).lower(*args).compile()
+            print(f"{name}: OK in {time.perf_counter() - t0:.1f}s", flush=True)
+        except Exception:
+            failures += 1
+            print(
+                f"{name}: FAILED after {time.perf_counter() - t0:.1f}s",
+                flush=True,
+            )
+            traceback.print_exc(limit=3)
+
+    aot(verify_hybrid, "hybrid", (32, 64, 64))
+    aot(
+        functools.partial(S._verify_tile_sr, dual_fn=dual_mult_pallas),
+        "sr-hybrid",
+        (32, 64, 32),
+    )
+    aot(verify_pallas, "monolithic (known-failing)", (32, 64, 64))
+    return failures
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -127,18 +127,21 @@ def _recode_signed(d: jnp.ndarray) -> jnp.ndarray:
     happens for S >= 2^256 - 8*16^63 — such S fail the S < L
     canonicality check and are already reported invalid, so the curve
     result is irrelevant (same contract as the rest of the math on
-    malformed inputs)."""
-    g = d >= 8
-    p = d == 7
+    malformed inputs).
+
+    The generate/propagate lattice is kept in int32 0/1, not bool:
+    Mosaic cannot concatenate/shift i1 vregs (it bitcasts them to i32,
+    which fails with 'Invalid vector register cast' — found via local
+    AOT compile against a v5e topology)."""
+    g = (d >= 8).astype(d.dtype)
+    p = (d == 7).astype(d.dtype)
     shift = 1
     while shift < d.shape[0]:
         zeros = jnp.zeros_like(g[:shift])
         g = g | (p & jnp.concatenate([zeros, g[:-shift]], axis=0))
         p = p & jnp.concatenate([zeros, p[:-shift]], axis=0)
         shift *= 2
-    c = jnp.concatenate(
-        [jnp.zeros_like(g[:1]), g[:-1]], axis=0
-    ).astype(d.dtype)
+    c = jnp.concatenate([jnp.zeros_like(g[:1]), g[:-1]], axis=0)
     t = d + c
     return t - 16 * (t >= 8).astype(d.dtype)
 

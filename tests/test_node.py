@@ -161,8 +161,11 @@ def test_four_validator_localnet_memory(tmp_path):
         for n in nodes:
             await n.start()
         try:
+            # 300 s: observed a 180 s timeout flake on a 1-core box with
+            # a second compile-heavy process competing; the wait is
+            # event-driven so the slack costs nothing when healthy
             await asyncio.gather(
-                *(n.consensus.wait_for_height(4, timeout=180.0) for n in nodes)
+                *(n.consensus.wait_for_height(4, timeout=300.0) for n in nodes)
             )
             # all nodes agree on block 3
             hashes = {n.block_store.load_block(3).hash() for n in nodes}
