@@ -1,0 +1,128 @@
+"""Bisect Mosaic lowering failures by compiling tile sub-segments as
+individual Pallas kernels via the local compile-only topology.
+Throwaway-grade tool; see scripts/aot_check.py for the stable checks."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..")))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+from jax.experimental.shard_map import shard_map  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+TILE = 128
+
+
+def pallas_wrap(fn, in_shapes, out_shape):
+    """Wrap fn (pure jnp, batch-minor) in a single-tile pallas_call,
+    hoisting trace-time consts exactly like ed25519_pallas._closed."""
+    avals = [jax.ShapeDtypeStruct(s, jnp.int32) for s in in_shapes]
+    cj = jax.make_jaxpr(fn)(*avals)
+    consts = [np.asarray(c) for c in cj.consts]
+    n_in = len(in_shapes)
+
+    def kernel(*refs):
+        ins = [r[...] for r in refs[:n_in]]
+        cs = [r[...] for r in refs[n_in:-1]]
+        out = jax.core.eval_jaxpr(cj.jaxpr, cs, *ins)
+        refs[-1][...] = out[0].reshape(out_shape).astype(jnp.int32)
+
+    def spec(s):
+        return pl.BlockSpec(s, lambda *_: (0,) * len(s), memory_space=pltpu.VMEM)
+
+    def call(*args):
+        return pl.pallas_call(
+            kernel,
+            grid=(1,),
+            in_specs=[spec(s) for s in in_shapes]
+            + [spec(c.shape) for c in consts],
+            out_specs=spec(out_shape),
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.int32),
+        )(*args, *[jnp.asarray(c) for c in consts])
+
+    return call
+
+
+def main():
+    from tendermint_tpu.ops import ed25519_kernel as K
+    from tendermint_tpu.ops import edwards as E
+    from tendermint_tpu.ops import field25519 as F
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name="v5e:2x2"
+    )
+    mesh = topologies.make_mesh(topo, (4,), ("x",))
+
+    L = F.NLIMBS
+    cases = {
+        "mod_l+nibbles": (
+            lambda d: (K._nibbles_dev(K._mod_l_dev(d)),),
+            [(64, TILE)],
+            (64, TILE),
+        ),
+        "s_lt_l": (
+            lambda s: (K._s_lt_l_dev(s).astype(jnp.int32)[None, :],),
+            [(32, TILE)],
+            (1, TILE),
+        ),
+        "fe_from_bytes": (
+            lambda b: (K._fe_from_bytes_dev(b & K._TOPCLEAR),),
+            [(32, TILE)],
+            (L, TILE),
+        ),
+        "decompress": (
+            lambda y, s: (
+                E.decompress(y, s[0])[0][..., 0, :, :],
+            ),
+            [(L, TILE), (1, TILE)],
+            (L, TILE),
+        ),
+        "decompress_ok": (
+            lambda y, s: (
+                E.decompress(y, s[0])[1].astype(jnp.int32)[None, :],
+            ),
+            [(L, TILE), (1, TILE)],
+            (1, TILE),
+        ),
+    }
+    which = sys.argv[1:] or list(cases)
+    for name in which:
+        fn, ins, out = cases[name]
+        call = pallas_wrap(fn, ins, out)
+        smfn = shard_map(
+            call,
+            mesh=mesh,
+            in_specs=tuple(P() for _ in ins),
+            out_specs=P(),
+            check_rep=False,
+        )
+        args = [
+            jax.ShapeDtypeStruct(s, jnp.int32, sharding=NamedSharding(mesh, P()))
+            for s in ins
+        ]
+        t0 = time.perf_counter()
+        try:
+            jax.jit(smfn).lower(*args).compile()
+            print(f"{name}: OK in {time.perf_counter() - t0:.1f}s", flush=True)
+        except Exception as e:
+            msg = repr(e)
+            cut = msg.find("The MLIR operation")
+            print(
+                f"{name}: FAILED {time.perf_counter() - t0:.1f}s: "
+                f"{msg[:200]} ... {msg[cut:cut + 220] if cut > 0 else ''}",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,10 @@ hang 20+ min holding the claim; bank the XLA numbers first):
   pallas_probe2   — the segmented hybrid kernel (TM_TPU_PALLAS=1 ->
                     Pallas dual-mult, XLA around it) at bucket 128
   pallas_tput2    — hybrid throughput at 8192 if the probe held
+  pallas_sr       — sr25519 hybrid throughput at 8192 (gated on the
+                    ed25519 hybrid probe holding — same kernel)
+  pallas_full     — monolithic whole-tile kernel at 8192, with its own
+                    128-bucket probe gate (first-ever device compile)
 
 Prior-session entries for these stages are dropped before the run (the
 stage writer merges). SIGTERM-safe, never SIGKILLs the device client
@@ -51,6 +55,7 @@ for _k in (
     "pallas_probe2",
     "pallas_tput2",
     "pallas_sr",
+    "pallas_full",
     "xla_hostsha",
     "xla_tput3",
     "xla_mosaic_form",
@@ -91,17 +96,7 @@ def stage_tput2():
     probe = _state["stages"].get("pallas_probe2", {})
     if not (probe.get("ok") and probe.get("used_pallas")):
         return {"skipped": "pallas probe2 did not hold"}
-    os.environ["TM_TPU_PALLAS"] = "1"
-    try:
-        from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
-
-        pks, msgs, sigs = _batch(8192)
-        v = Ed25519Verifier(bucket_sizes=[8192])
-        rate = _throughput(v, pks, msgs, sigs)
-        still_pallas = v._is_pallas(v._compiled.get(v._bucket(8192)))
-        return {"sigs_per_s": round(rate, 1), "used_pallas": bool(still_pallas)}
-    finally:
-        os.environ.pop("TM_TPU_PALLAS", None)
+    return _pallas_tput_8192("1", probe_first=False)
 
 
 def _sr_batch(seed: int, n: int = 8192, tag: bytes = b"sr"):
@@ -122,6 +117,46 @@ def _sr_batch(seed: int, n: int = 8192, tag: bytes = b"sr"):
         msgs.append(m)
         sigs.append(p.sign(m))
     return pks, msgs, sigs
+
+
+def _pallas_tput_8192(mode: str, probe_first: bool):
+    """Shared body of the Pallas ed25519 throughput stages: set
+    TM_TPU_PALLAS=<mode>, optionally prove a cheap 128-bucket compile
+    first (bail before risking a long Mosaic compile at 8192 — the
+    probe's fallback already downgraded if Mosaic rejected it), then
+    time 8192. Restores the env var on exit."""
+    from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+    prev = os.environ.get("TM_TPU_PALLAS")
+    os.environ["TM_TPU_PALLAS"] = mode
+    try:
+        if probe_first:
+            pks, msgs, sigs = _batch(128, seed=5)
+            v = Ed25519Verifier(bucket_sizes=[128])
+            assert bool(v.verify(pks, msgs, sigs).all())
+            if not v._is_pallas(v._compiled.get(v._bucket(128))):
+                return {"skipped": f"{mode} probe at 128 fell back"}
+        pks, msgs, sigs = _batch(8192)
+        v = Ed25519Verifier(bucket_sizes=[8192])
+        rate = _throughput(v, pks, msgs, sigs)
+        used = v._is_pallas(v._compiled.get(v._bucket(8192)))
+        return {"sigs_per_s": round(rate, 1), "used_pallas": bool(used)}
+    finally:
+        if prev is None:
+            os.environ.pop("TM_TPU_PALLAS", None)
+        else:
+            os.environ["TM_TPU_PALLAS"] = prev
+
+
+@_stage("pallas_full")
+def stage_pallas_full():
+    """The monolithic whole-tile kernel (TM_TPU_PALLAS=full) at 8192 —
+    compiles in ~22s via the local AOT check; everything in one
+    pallas_call keeps even the prep/compare intermediates in VMEM.
+    Probes at bucket 128 first: this kernel has never compiled on the
+    real device, and a hung device-side Mosaic compile holds the claim
+    (the failure mode in this file's header)."""
+    return _pallas_tput_8192("full", probe_first=True)
 
 
 @_stage("pallas_sr")
@@ -276,6 +311,7 @@ def main():
         stage_probe2,
         stage_tput2,
         stage_pallas_sr,
+        stage_pallas_full,
     ):
         st()
     print(json.dumps(_state["stages"], indent=1))

@@ -395,16 +395,21 @@ def _mod_l_dev(d: jnp.ndarray) -> jnp.ndarray:
 def _lt_const_dev(rows: jnp.ndarray, const8: np.ndarray) -> jnp.ndarray:
     """(32, N) canonical byte rows (LE) -> (N,) bool: value < const.
     Most-significant-byte-first scan; shared by the S < L check here
-    and the ristretto s < p canonicity check (ops/sr25519_kernel.py)."""
+    and the ristretto s < p canonicity check (ops/sr25519_kernel.py).
+
+    The decided/lt lattice is int32 0/1, not bool: a scalar-True
+    jnp.where operand materializes as an i8 constant that Mosaic must
+    trunci to i1 — 'Unsupported target bitwidth for truncation'
+    (found via scripts/aot_bisect.py against the local v5e topology)."""
     cb = np.asarray(const8)[:, 0]
-    lt = jnp.zeros(rows.shape[1], dtype=bool)
-    decided = jnp.zeros(rows.shape[1], dtype=bool)
+    lt = jnp.zeros(rows.shape[1], dtype=jnp.int32)
+    decided = jnp.zeros(rows.shape[1], dtype=jnp.int32)
     for i in range(31, -1, -1):
-        lo = rows[i] < int(cb[i])
-        hi = rows[i] > int(cb[i])
-        lt = jnp.where(~decided & lo, True, lt)
+        lo = (rows[i] < int(cb[i])).astype(jnp.int32)
+        hi = (rows[i] > int(cb[i])).astype(jnp.int32)
+        lt = lt | ((1 - decided) & lo)
         decided = decided | lo | hi
-    return lt
+    return lt != 0
 
 
 def _s_lt_l_dev(s: jnp.ndarray) -> jnp.ndarray:
