@@ -23,7 +23,7 @@ import subprocess
 import tempfile
 from typing import Optional
 
-__all__ = ["load", "keccakf_lib"]
+__all__ = ["load", "keccakf_lib", "signbytes_lib"]
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIBS: dict = {}
@@ -81,6 +81,30 @@ def _build(name: str) -> Optional[ctypes.CDLL]:
             if os.path.exists(tmp):
                 os.unlink(tmp)
     return ctypes.CDLL(out)
+
+
+def signbytes_lib():
+    """The sign-bytes assembler with argtypes set, or None. Exposes
+    ``tm_vote_sign_bytes_batch`` (see signbytes.c for the contract)."""
+    lib = load("signbytes")
+    if lib is None:
+        return None
+    if not getattr(lib, "_tm_configured", False):
+        lib.tm_vote_sign_bytes_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_uint8,
+            ctypes.c_void_p,
+            ctypes.c_long,
+            ctypes.c_void_p,
+            ctypes.c_long,
+            ctypes.c_void_p,
+        ]
+        lib.tm_vote_sign_bytes_batch.restype = ctypes.c_long
+        lib._tm_configured = True
+    return lib
 
 
 def keccakf_lib():

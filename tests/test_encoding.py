@@ -190,3 +190,39 @@ def test_commit_sign_bytes_batch_matches_per_index():
             assert batch[i] is None
         else:
             assert batch[i] == commit.get_vote(i).sign_bytes("batch-chain")
+
+
+def test_native_sign_bytes_batch_matches_python():
+    """native/signbytes.c must be byte-identical to the Python splice
+    loop AND to the full per-vote marshal, across timestamp encoding
+    edge cases (zero, nanos-only, seconds-only, negative, epoch+1)."""
+    from tendermint_tpu.types.canonical import VoteSignTemplate
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.native import signbytes_lib
+
+    if signbytes_lib() is None:
+        import pytest
+
+        pytest.skip("no native toolchain")
+    bid = BlockID(
+        hash=b"\x11" * 32,
+        part_set_header=PartSetHeader(total=3, hash=b"\x22" * 32),
+    )
+    tpl = VoteSignTemplate("native-chain", 2, 77, 4, bid)
+    cases = [
+        0,
+        1,
+        999_999_999,            # nanos only
+        1_000_000_000,          # seconds only
+        1_700_000_000_123_456_789,
+        -1,                     # negative ns: floored divmod
+        -1_000_000_001,
+        2**62,
+    ]
+    native_rows = tpl._sign_bytes_batch_native(cases)
+    assert native_rows is not None
+    py_rows = [tpl.sign_bytes(ns) for ns in cases]
+    assert native_rows == py_rows
+    # out-of-int64 timestamps fall back to the Python loop
+    assert tpl._sign_bytes_batch_native([2**70]) is None
+    assert tpl.sign_bytes_batch([2**70]) == [tpl.sign_bytes(2**70)]
