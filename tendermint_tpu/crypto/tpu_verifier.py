@@ -46,16 +46,29 @@ __all__ = [
 # device roundtrip, ~100s of µs) exceeds CPU verify time; let CPU win.
 DEFAULT_MIN_BATCH = 8
 
+# lazily cached "is the backend a real accelerator" decision for
+# streaming chunk dispatch (see _TpuBatchVerifier._streaming)
+_STREAMING: Optional[bool] = None
+
 
 class _TpuBatchVerifier(BatchVerifier):
-    """Queues triples on host, verifies in one device program.
+    """Queues triples on host, verifies on device.
 
     Same verify() contract as the CPU path: (all_ok, bitmap), bitmap
     aligned with add() order, malformed entries reported invalid
     per-index rather than raising at verify time.
+
+    On a TPU backend, full STREAM_CHUNK-sized slices are dispatched
+    asynchronously AS add() fills them, so the host-side assembly loop
+    (sign-bytes, address lookups — ~2 us/sig in VerifyCommit) overlaps
+    device compute instead of serializing in front of it; verify()
+    dispatches the remainder and gathers every in-flight handle in add
+    order. The chunk matches a configured bucket so no new program
+    shapes are compiled.
     """
 
     KEY_TYPE = ""  # subclasses set
+    STREAM_CHUNK = 2048  # == a DEFAULT_BUCKET_SIZES entry
 
     def __init__(self, verifier=None) -> None:
         self._verifier = verifier
@@ -63,10 +76,43 @@ class _TpuBatchVerifier(BatchVerifier):
         self._pks: List[bytes] = []
         self._msgs: List[bytes] = []
         self._sigs: List[bytes] = []
+        self._handles: List[tuple] = []  # (backing, handle, n), add order
 
     @staticmethod
     def _kernel_module():
         raise NotImplementedError
+
+    def _backing(self):
+        return (
+            self._verifier
+            if self._verifier is not None
+            else self._kernel.default_verifier()
+        )
+
+    @staticmethod
+    def _streaming() -> bool:
+        """Chunked dispatch only pays on an accelerator (CPU 'device'
+        programs are the bottleneck themselves, and extra bucket shapes
+        would mean extra test-suite compiles). Cached after the first
+        backend query; by the time a chunk fills, a device dispatch is
+        imminent anyway, so initializing the backend here is fine."""
+        global _STREAMING
+        if _STREAMING is None:
+            import jax
+
+            _STREAMING = jax.default_backend() == "tpu"
+        return _STREAMING
+
+    def _dispatch_pending(self, v) -> None:
+        """Asynchronously launch the queued triples on `v` and clear
+        the queue; the handle is gathered in verify(). Each dispatch is
+        one device invocation for the metrics."""
+        self._handles.append(
+            (v, v.dispatch(self._pks, self._msgs, self._sigs),
+             len(self._pks))
+        )
+        self._pks, self._msgs, self._sigs = [], [], []
+        _m_batches.inc()
 
     def add(self, pub_key: PubKey, message: bytes, signature: bytes) -> None:
         if pub_key.type() != self.KEY_TYPE:
@@ -78,26 +124,44 @@ class _TpuBatchVerifier(BatchVerifier):
         self._pks.append(pub_key.bytes())
         self._msgs.append(bytes(message))
         self._sigs.append(bytes(signature))
+        if len(self._pks) >= self.STREAM_CHUNK and self._streaming():
+            v = self._backing()
+            # injected verifiers only promise verify(); stream solely
+            # when the dispatch()/gather() pair is actually there
+            if hasattr(v, "dispatch") and hasattr(v, "gather"):
+                self._dispatch_pending(v)
 
     def verify(self) -> Tuple[bool, List[bool]]:
-        if not self._pks:
+        """Drains the queue: a verifier is a one-shot batch (matching
+        the reference's use — one BatchVerifier per commit); calling
+        verify() again without new add()s reports (False, []) on every
+        backend. In streaming mode verify_seconds times the remainder
+        dispatch + gather barrier (chunk dispatches already ran inside
+        add, overlapped with the caller's assembly loop)."""
+        if not self._pks and not self._handles:
             return False, []
         with _m_verify_time.time():
-            if self._verifier is not None:
-                bitmap = self._verifier.verify(
-                    self._pks, self._msgs, self._sigs
-                )
+            total = sum(n for _, _, n in self._handles) + len(self._pks)
+            v = self._backing()
+            if self._handles:
+                if self._pks:
+                    self._dispatch_pending(v)
+                bits: List[bool] = []
+                for bv, handle, _n in self._handles:
+                    bits.extend(bool(b) for b in bv.gather(handle))
+                self._handles = []
             else:
-                bitmap = self._kernel.batch_verify_host(
-                    self._pks, self._msgs, self._sigs
-                )
-        _m_batches.inc()
-        _m_sigs.inc(len(self._pks))
-        bits = [bool(b) for b in bitmap]
+                bits = [
+                    bool(b)
+                    for b in v.verify(self._pks, self._msgs, self._sigs)
+                ]
+                self._pks, self._msgs, self._sigs = [], [], []
+                _m_batches.inc()
+        _m_sigs.inc(total)
         return all(bits), bits
 
     def __len__(self) -> int:
-        return len(self._pks)
+        return len(self._pks) + sum(n for _, _, n in self._handles)
 
 
 class TpuEd25519BatchVerifier(_TpuBatchVerifier):

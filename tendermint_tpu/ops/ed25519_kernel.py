@@ -742,9 +742,16 @@ def _jit_verify_tile():
 _DEFAULT: Optional[Ed25519Verifier] = None
 
 
-def batch_verify_host(pubkeys, msgs, sigs) -> np.ndarray:
-    """Module-level convenience using a shared verifier instance."""
+def default_verifier() -> Ed25519Verifier:
+    """The shared module verifier (compiled programs cached across the
+    process; also the dispatch/gather handle source for the streaming
+    batch seam, crypto/tpu_verifier.py)."""
     global _DEFAULT
     if _DEFAULT is None:
         _DEFAULT = Ed25519Verifier()
-    return _DEFAULT.verify(pubkeys, msgs, sigs)
+    return _DEFAULT
+
+
+def batch_verify_host(pubkeys, msgs, sigs) -> np.ndarray:
+    """Module-level convenience using the shared verifier instance."""
+    return default_verifier().verify(pubkeys, msgs, sigs)

@@ -318,9 +318,14 @@ class Sr25519Verifier:
 _DEFAULT: Optional[Sr25519Verifier] = None
 
 
-def batch_verify_host(pubkeys, msgs, sigs) -> np.ndarray:
-    """Module-level convenience using a shared verifier instance."""
+def default_verifier() -> Sr25519Verifier:
+    """The shared module verifier (see ed25519_kernel.default_verifier)."""
     global _DEFAULT
     if _DEFAULT is None:
         _DEFAULT = Sr25519Verifier()
-    return _DEFAULT.verify(pubkeys, msgs, sigs)
+    return _DEFAULT
+
+
+def batch_verify_host(pubkeys, msgs, sigs) -> np.ndarray:
+    """Module-level convenience using the shared verifier instance."""
+    return default_verifier().verify(pubkeys, msgs, sigs)

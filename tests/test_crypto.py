@@ -170,3 +170,46 @@ def test_merkle_proof_proto_roundtrip():
     assert again.total == p.total and again.index == p.index
     assert again.leaf_hash == p.leaf_hash and again.aunts == p.aunts
     again.verify(root, items[1])
+
+
+def test_streaming_chunked_dispatch(monkeypatch):
+    """The TPU batch seam's chunked streaming dispatch (overlaps host
+    assembly with device compute on real accelerators) must preserve
+    the bitmap contract exactly: add-order alignment across chunk
+    boundaries, invalids localized, __len__ counting in-flight sigs."""
+    from tendermint_tpu.crypto import tpu_verifier as T
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+    monkeypatch.setattr(T, "_STREAMING", True)
+    monkeypatch.setattr(T._TpuBatchVerifier, "STREAM_CHUNK", 4)
+    v = T.TpuEd25519BatchVerifier()
+    n = 11
+    for i in range(n):
+        priv = PrivKeyEd25519.from_seed(bytes([i + 7]) * 32)
+        msg = b"stream-%d" % i
+        sig = priv.sign(msg)
+        if i in (2, 6, 10):  # one bad index in every chunk + remainder
+            sig = sig[:3] + bytes([sig[3] ^ 1]) + sig[4:]
+        v.add(priv.pub_key(), msg, sig)
+        assert len(v) == i + 1  # in-flight chunks still counted
+    all_ok, bits = v.verify()
+    assert not all_ok
+    assert len(bits) == n
+    assert [i for i, ok in enumerate(bits) if not ok] == [2, 6, 10]
+    # a second verify on the drained verifier reports empty
+    assert v.verify() == (False, [])
+
+
+def test_batch_verifier_drains_on_every_backend(monkeypatch):
+    """verify() is one-shot on the non-streaming path too — backends
+    must not diverge on a second verify() call (review finding)."""
+    from tendermint_tpu.crypto import tpu_verifier as T
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+    monkeypatch.setattr(T, "_STREAMING", False)
+    v = T.TpuEd25519BatchVerifier()
+    priv = PrivKeyEd25519.from_seed(b"\x09" * 32)
+    v.add(priv.pub_key(), b"drain", priv.sign(b"drain"))
+    assert v.verify() == (True, [True])
+    assert v.verify() == (False, [])
+    assert len(v) == 0
