@@ -205,3 +205,33 @@ def test_host_sha512_env_knob(verifier, monkeypatch):
     monkeypatch.setenv("TM_TPU_HOST_SHA512", "1")
     pks, msgs, sigs = _sign_set(5, b"knob")
     assert verifier.verify(pks, msgs, sigs).all()
+
+
+def test_recode_signed_value_preserving():
+    """_recode_signed must re-express the radix-16 value exactly with
+    digits in [-8, 7] — including maximal carry-propagation runs (all
+    7s, all 8s, all 15s) where the Kogge-Stone lattice is stressed."""
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import ed25519_kernel as K
+
+    rng = np.random.default_rng(5)
+    cols = [
+        rng.integers(0, 16, 64) for _ in range(12)
+    ] + [
+        np.full(64, 7), np.full(64, 8), np.full(64, 15), np.zeros(64),
+        np.array([15] * 63 + [0]),  # carry run stopping at the top
+    ]
+    # keep the top digit small enough that no carry is dropped (the
+    # dropped-carry case is gated by s < L upstream — see docstring)
+    for c in cols:
+        c[-1] = min(int(c[-1]), 6)
+    d = np.stack(cols, axis=1).astype(np.int32)  # (64, N)
+    e = np.asarray(jax.jit(K._recode_signed)(jnp.asarray(d)))
+    assert e.min() >= -8 and e.max() <= 7
+    w = 16 ** np.arange(64, dtype=object)
+    for j in range(d.shape[1]):
+        orig = int(sum(int(x) * int(p) for x, p in zip(d[:, j], w)))
+        got = int(sum(int(x) * int(p) for x, p in zip(e[:, j], w)))
+        assert got == orig, j
