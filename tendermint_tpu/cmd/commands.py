@@ -59,6 +59,7 @@ def cmd_init(args) -> int:
         pv = FilePV.load_or_generate(
             cfg.base.path(cfg.priv_validator.key_file),
             cfg.base.path(cfg.priv_validator.state_file),
+            key_type=getattr(args, "key", None) or "ed25519",
         )
     if os.path.exists(genesis_path):
         print(f"found genesis file {genesis_path}")
@@ -117,14 +118,20 @@ def cmd_start(args) -> int:
 
 
 def cmd_gen_validator(args) -> int:
-    """reference: commands/gen_validator.go — prints a fresh key."""
-    from ..privval import FilePV
+    """reference: commands/gen_validator.go — prints a fresh key
+    (--key ed25519|secp256k1, matching GenFilePV's switch)."""
+    from ..crypto.keys import generate_priv_key
 
-    priv = PrivKeyEd25519.generate()
+    key_type = getattr(args, "key", None) or "ed25519"
+    try:
+        priv = generate_priv_key(key_type)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
     out = {
         "address": priv.pub_key().address().hex().upper(),
-        "pub_key": {"type": "ed25519", "value": priv.pub_key().bytes().hex()},
-        "priv_key": {"type": "ed25519", "value": priv.bytes().hex()},
+        "pub_key": {"type": key_type, "value": priv.pub_key().bytes().hex()},
+        "priv_key": {"type": key_type, "value": priv.bytes().hex()},
     }
     print(json.dumps(out, indent=2))
     return 0
@@ -1029,6 +1036,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--moniker", default="anonymous")
+    sp.add_argument(
+        "--key",
+        default="ed25519",
+        choices=["ed25519", "secp256k1"],
+        help="validator key type (reference: commands/init.go --key)",
+    )
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("start", help="run the node")
@@ -1036,6 +1049,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("gen-validator", help="print a fresh validator key")
+    sp.add_argument(
+        "--key",
+        default="ed25519",
+        choices=["ed25519", "secp256k1"],
+        help="key type (reference: commands/gen_validator.go --key)",
+    )
     sp.set_defaults(fn=cmd_gen_validator)
 
     sp = sub.add_parser("gen-node-key", help="generate a node key")

@@ -117,6 +117,31 @@ def pubkey_from_type_and_bytes(key_type: str, data: bytes) -> PubKey:
     return cls(data)
 
 
+# privval key types (reference: privval/file.go:188 GenFilePV's switch —
+# ed25519 default, secp256k1 on request). One dispatch for the three
+# consumers: FilePVKey.load, FilePV.generate, and the gen-validator CLI.
+
+
+def _privval_priv_cls(key_type: str) -> type:
+    if key_type in ("", "ed25519"):
+        from .ed25519 import PrivKeyEd25519
+
+        return PrivKeyEd25519
+    if key_type == "secp256k1":
+        from .secp256k1 import PrivKeySecp256k1
+
+        return PrivKeySecp256k1
+    raise ValueError(f"key type: {key_type} is not supported")
+
+
+def generate_priv_key(key_type: str = "ed25519") -> PrivKey:
+    return _privval_priv_cls(key_type).generate()
+
+
+def privkey_from_type_and_bytes(key_type: str, data: bytes) -> PrivKey:
+    return _privval_priv_cls(key_type)(data)
+
+
 def pubkey_to_proto(pk: PubKey) -> bytes:
     """Encode as tendermint.crypto.PublicKey (oneof: ed25519=1,
     secp256k1=2, sr25519=3 — reference: proto/tendermint/crypto/keys.pb.go).

@@ -21,6 +21,8 @@ from ..crypto.ed25519 import PrivKeyEd25519
 from ..crypto.keys import (
     PrivKey,
     PubKey,
+    generate_priv_key,
+    privkey_from_type_and_bytes,
     pubkey_from_type_and_bytes,
 )
 from ..encoding.proto import ProtoWriter, iter_fields
@@ -108,9 +110,9 @@ class FilePVKey:
         with open(path) as f:
             raw = json.load(f)
         key_type = raw["priv_key"]["type"]
-        if key_type != "ed25519":
-            raise ValueError(f"unsupported privval key type {key_type}")
-        priv = PrivKeyEd25519(bytes.fromhex(raw["priv_key"]["value"]))
+        priv = privkey_from_type_and_bytes(
+            key_type, bytes.fromhex(raw["priv_key"]["value"])
+        )
         pub = pubkey_from_type_and_bytes(
             raw["pub_key"]["type"], bytes.fromhex(raw["pub_key"]["value"])
         )
@@ -197,8 +199,15 @@ class FilePV(PrivValidator):
     # -- construction --
 
     @classmethod
-    def generate(cls, key_file_path: str, state_file_path: str) -> "FilePV":
-        priv = PrivKeyEd25519.generate()
+    def generate(
+        cls,
+        key_file_path: str,
+        state_file_path: str,
+        key_type: str = "ed25519",
+    ) -> "FilePV":
+        """reference: privval/file.go:188 GenFilePV — ed25519 default,
+        secp256k1 on request, anything else rejected."""
+        priv = generate_priv_key(key_type)
         return cls.from_priv_key(priv, key_file_path, state_file_path)
 
     @classmethod
@@ -237,12 +246,16 @@ class FilePV(PrivValidator):
 
     @classmethod
     def load_or_generate(
-        cls, key_file_path: str, state_file_path: str
+        cls,
+        key_file_path: str,
+        state_file_path: str,
+        key_type: str = "ed25519",
     ) -> "FilePV":
-        """reference: privval/file.go LoadOrGenFilePV."""
+        """reference: privval/file.go LoadOrGenFilePV (key_type applies
+        only when generating; an existing file keeps its own type)."""
         if os.path.exists(key_file_path):
             return cls.load(key_file_path, state_file_path)
-        pv = cls.generate(key_file_path, state_file_path)
+        pv = cls.generate(key_file_path, state_file_path, key_type)
         pv.save()
         return pv
 

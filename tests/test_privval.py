@@ -169,3 +169,41 @@ def test_state_file_is_json(tmp_path, pv):
         raw = json.load(f)
     assert raw["height"] == 1 and raw["step"] == STEP_PREVOTE
     assert len(bytes.fromhex(raw["signature"])) == 64
+
+
+def test_secp256k1_file_pv_round_trip(tmp_path):
+    """reference privval/file.go:188 GenFilePV supports secp256k1;
+    generate, sign a vote, persist, reload, and verify the signature
+    with the reloaded public key."""
+    from tendermint_tpu.privval.file import FilePV
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.vote import Vote, PRECOMMIT_TYPE
+
+    key_path = str(tmp_path / "pv_key.json")
+    state_path = str(tmp_path / "pv_state.json")
+    pv = FilePV.generate(key_path, state_path, key_type="secp256k1")
+    assert pv.key.pub_key.type() == "secp256k1"
+    pv.save()
+
+    reloaded = FilePV.load(key_path, state_path)
+    assert reloaded.key.pub_key.bytes() == pv.key.pub_key.bytes()
+
+    vote = Vote(
+        type=PRECOMMIT_TYPE,
+        height=5,
+        round=0,
+        block_id=BlockID(
+            hash=b"\x31" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x32" * 32),
+        ),
+        timestamp_ns=1_700_000_000_000_000_000,
+        validator_address=pv.key.address,
+        validator_index=0,
+    )
+    run(reloaded.sign_vote("secp-chain", vote))
+    assert vote.signature
+    sb = vote.sign_bytes("secp-chain")
+    assert pv.key.pub_key.verify_signature(sb, vote.signature)
+    # unsupported types still rejected
+    with pytest.raises(ValueError):
+        FilePV.generate(str(tmp_path / "x"), str(tmp_path / "y"), "sr25519x")
