@@ -139,9 +139,14 @@ class Ed25519BatchVerifier(BatchVerifier):
         self._items.append((pub_key, bytes(message), bytes(signature)))
 
     def verify(self) -> Tuple[bool, List[bool]]:
+        """One-shot: drains the queue, matching the device verifier's
+        contract (a BatchVerifier is one batch — the reference builds a
+        fresh one per commit); a second verify() without new add()s
+        returns (False, []) on every backend."""
         if not self._items:
             return False, []
-        bitmap = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
+        items, self._items = self._items, []
+        bitmap = [pk.verify_signature(msg, sig) for pk, msg, sig in items]
         return all(bitmap), bitmap
 
     def __len__(self) -> int:

@@ -86,7 +86,10 @@ class BatchVerifier(ABC):
     Implementations: CPU per-curve batchers and the TPU-backed verifier in
     tendermint_tpu.crypto.tpu_verifier. Semantics of verify() follow
     reference crypto/crypto.go:53-61: returns (every sig valid, bitmap). The
-    bitmap has one entry per add() in order.
+    bitmap has one entry per add() in order. verify() is one-shot on every
+    backend — it drains the queue, and a second call without new add()s
+    returns (False, []) (a verifier is one batch, matching the reference's
+    one-BatchVerifier-per-commit usage).
     """
 
     @abstractmethod

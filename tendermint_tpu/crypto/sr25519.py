@@ -157,9 +157,27 @@ class PubKeySr25519(PubKey):
         if bv is not None:
             if len(sig) != SIGNATURE_SIZE:
                 return False
-            bv.add(self, msg, sig)
-            _ok, bits = bv.verify()
-            return bool(bits and bits[0])
+            # Total-predicate contract: this method must never raise —
+            # it sits under per-vote and evidence verification. A device
+            # fault (XLA failure, lost tunnel, compile error) falls
+            # through to the pure-Python ristretto path below, which is
+            # semantically identical.
+            try:
+                bv.add(self, msg, sig)
+                _ok, bits = bv.verify()
+                return bool(bits and bits[0])
+            except Exception as e:
+                from ..libs.log import get_logger
+                from .tpu_verifier import trip_sr_singles
+
+                # trip the route: a faulted device must not be re-tried
+                # (seconds of error surfacing + a log line) on every
+                # subsequent vote; install() re-warms it
+                trip_sr_singles()
+                get_logger("crypto.sr25519").warning(
+                    "sr25519 device verify failed; singles tripped to CPU",
+                    err=repr(e),
+                )
         parsed = _parse_signature(sig)
         if parsed is None:
             return False
@@ -266,10 +284,13 @@ class Sr25519BatchVerifier(BatchVerifier):
         self._items.append((pub_key, bytes(message), bytes(signature)))
 
     def verify(self) -> Tuple[bool, List[bool]]:
+        """One-shot: drains the queue (same contract as the device and
+        ed25519 CPU verifiers — see Ed25519BatchVerifier.verify)."""
         if not self._items:
             return False, []
+        items, self._items = self._items, []
         bitmap = [
-            pk.verify_signature(msg, sig) for pk, msg, sig in self._items
+            pk.verify_signature(msg, sig) for pk, msg, sig in items
         ]
         return all(bitmap), bitmap
 

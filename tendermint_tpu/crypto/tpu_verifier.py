@@ -57,9 +57,13 @@ def on_accelerator() -> bool:
     CPU-only node) are answered from the config STRING without
     initializing a backend, so consensus-critical callers like
     sr25519's single-verify route never stall on backend init just to
-    learn they should use the Python path. Everything else pays one
-    backend query, cached — those processes are about to dispatch to
-    the device anyway."""
+    learn they should use the Python path. A process with no TPU
+    runtime installed at all (no libtpu wheel) is likewise answered
+    without backend init. Everything else pays one backend query,
+    cached — those processes are about to dispatch to the device
+    anyway. CPU-only deployments that leave jax_platforms unset and DO
+    ship libtpu should set jax_platforms=cpu explicitly to keep jax
+    backend initialization out of the first verify call."""
     global _STREAMING
     if _STREAMING is None:
         import jax
@@ -71,9 +75,33 @@ def on_accelerator() -> bool:
             pass
         if plats and set(plats.split(",")) == {"cpu"}:
             _STREAMING = False
+        elif not plats and not _has_tpu_runtime():
+            # only an UNSET platform string consults the runtime sniff:
+            # an explicit jax_platforms=tpu (e.g. libtpu loaded via
+            # TPU_LIBRARY_PATH, no importable module) must reach the
+            # backend query, symmetric with the explicit-cpu case
+            _STREAMING = False
         else:
             _STREAMING = jax.default_backend() == "tpu"
     return _STREAMING
+
+
+def _has_tpu_runtime() -> bool:
+    """Whether a TPU runtime could plausibly be attached, decided
+    WITHOUT initializing a jax backend: the libtpu wheel must be
+    importable (jax's own TPU discovery path). On boxes without it,
+    jax.default_backend() could only ever answer cpu/gpu — so answering
+    False here is exact, and keeps backend init out of the verify hot
+    path on CPU-only nodes with jax_platforms unset."""
+    import importlib.util
+
+    try:
+        return (
+            importlib.util.find_spec("libtpu") is not None
+            or importlib.util.find_spec("jax_plugins") is not None
+        )
+    except (ImportError, ValueError):  # pragma: no cover - spec quirks
+        return True  # unknown: fall through to the backend query
 
 
 class _TpuBatchVerifier(BatchVerifier):
@@ -165,15 +193,23 @@ class _TpuBatchVerifier(BatchVerifier):
                 if self._pks:
                     self._dispatch_pending(v)
                 bits: List[bool] = []
-                for bv, handle, _n in self._handles:
-                    bits.extend(bool(b) for b in bv.gather(handle))
-                self._handles = []
+                try:
+                    for bv, handle, _n in self._handles:
+                        bits.extend(bool(b) for b in bv.gather(handle))
+                finally:
+                    # a gather that raises mid-loop must still leave the
+                    # verifier drained: a retry would otherwise re-gather
+                    # stale handles and double-count _m_sigs, and
+                    # __len__ would keep reporting the in-flight count
+                    self._handles = []
             else:
-                bits = [
-                    bool(b)
-                    for b in v.verify(self._pks, self._msgs, self._sigs)
-                ]
-                self._pks, self._msgs, self._sigs = [], [], []
+                try:
+                    bits = [
+                        bool(b)
+                        for b in v.verify(self._pks, self._msgs, self._sigs)
+                    ]
+                finally:
+                    self._pks, self._msgs, self._sigs = [], [], []
                 _m_batches.inc()
         _m_sigs.inc(total)
         return all(bits), bits
@@ -210,6 +246,17 @@ _SHARED_VERIFIER = None
 _SHARED_VERIFIER_SR = None
 _MIN_BATCH = DEFAULT_MIN_BATCH
 _INSTALLED = False
+# Single sr25519 verifies only route to the device once the smallest
+# bucket's program is compiled (the install() warm thread flips this);
+# until then they stay on the pure-Python path, so a consensus-critical
+# per-vote verify can never block behind an XLA compile. The thread
+# handle is kept so tests (and embedders) can join before reading.
+_SR_WARM = False
+_SR_WARM_THREAD = None
+# bumped by every install(): a warm thread only publishes its result if
+# its generation is still current, so a slow warm from a superseded
+# install can never vouch for a verifier it didn't compile
+_SR_WARM_GEN = 0
 
 
 def installed() -> Optional[int]:
@@ -251,10 +298,79 @@ def single_sr_verifier() -> Optional[BatchVerifier]:
     the device path is not installed / not worthwhile (CPU backend).
     Used by PubKeySr25519.verify_signature so per-vote and evidence
     verifies ride the kernel — through the installed (possibly
-    mesh-sharded) verifier and the tpu metrics, same as batches."""
-    if not _INSTALLED:
+    mesh-sharded) verifier and the tpu metrics, same as batches.
+    Gated on the warm flag: until install()'s background thread has
+    compiled the smallest sr25519 bucket, singles stay on the CPU path
+    instead of stalling a vote behind the first XLA compile."""
+    if not (_INSTALLED and _SR_WARM):
         return None
     return _factory_sr(1)
+
+
+def trip_sr_singles() -> None:
+    """Demote single sr25519 verifies back to the CPU path after a
+    device fault (called by PubKeySr25519.verify_signature's fallback).
+    Without the trip, a persistently faulted device would be re-tried —
+    and a warning logged — on every per-vote verify. Batch verifies
+    keep their own error paths; a later install() re-warms singles."""
+    global _SR_WARM
+    _SR_WARM = False
+
+
+def _start_sr_warm_thread() -> None:
+    """Compile the smallest sr25519 bucket off the install() path, then
+    flip _SR_WARM so single verifies start routing to the device. Runs
+    on a daemon thread: install() itself must never touch the backend
+    (a wedged device claim would hang node startup — PERF.md claim
+    discipline), and a warm that stalls only delays the device upgrade
+    of single verifies, never a vote."""
+    global _SR_WARM, _SR_WARM_THREAD, _SR_WARM_GEN
+    import threading
+
+    # a re-install may have swapped in a different (uncompiled) shared
+    # verifier — e.g. a mesh-sharded one; the gate must drop until THIS
+    # install's warm pass proves a compiled program
+    _SR_WARM = False
+    _SR_WARM_GEN += 1
+    gen = _SR_WARM_GEN
+
+    def warm() -> None:
+        global _SR_WARM
+        try:
+            if not on_accelerator() and _MIN_BATCH > 1:
+                # CPU process with the min-batch gate keeping singles
+                # off the kernel: nothing to compile. (min_batch <= 1
+                # would route singles to the CPU-backend kernel, so
+                # that case falls through to the real probe below.)
+                if gen == _SR_WARM_GEN:
+                    _SR_WARM = True
+                return
+            from .sr25519 import PrivKeySr25519
+
+            priv = PrivKeySr25519.from_seed(b"\x77" * 32)
+            msg = b"sr25519-warm"
+            v = _SHARED_VERIFIER_SR
+            if v is None:
+                from ..ops import sr25519_kernel
+
+                v = sr25519_kernel.default_verifier()
+            ok = v.verify(
+                [priv.pub_key().bytes()], [msg], [priv.sign(msg)]
+            )
+            if bool(ok.all()) and gen == _SR_WARM_GEN:
+                _SR_WARM = True
+        except Exception as e:  # pragma: no cover - warm is best-effort
+            from ..libs.log import get_logger
+
+            get_logger("crypto.tpu").warning(
+                "sr25519 device warm-up failed; singles stay on CPU",
+                err=repr(e),
+            )
+
+    _SR_WARM_THREAD = threading.Thread(
+        target=warm, daemon=True, name="sr25519-warm"
+    )
+    _SR_WARM_THREAD.start()
 
 
 def install(
@@ -285,6 +401,7 @@ def install(
         _SHARED_VERIFIER_SR = None
     register_device_factory("ed25519", _factory)
     register_device_factory("sr25519", _factory_sr)
+    _start_sr_warm_thread()
     # merged multi-commit batches (light sequential windows) only pay
     # off on an accelerator; on a CPU-backed kernel the bucket padding
     # of a merged window inverts the win (measured 5x slower). The

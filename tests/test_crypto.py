@@ -78,10 +78,11 @@ def test_batch_verifier_bitmap():
     sigs[2] = keys[2].sign(b"other")  # corrupt one
     for k, m, s in zip(keys, msgs, sigs):
         bv.add(k.pub_key(), m, s)
+    assert len(bv) == 5
     ok, bitmap = bv.verify()
     assert not ok
     assert bitmap == [True, True, False, True, True]
-    assert len(bv) == 5
+    assert len(bv) == 0  # verify() drains (one-shot contract)
 
 
 def test_batch_dispatch():
@@ -213,3 +214,23 @@ def test_batch_verifier_drains_on_every_backend(monkeypatch):
     assert v.verify() == (True, [True])
     assert v.verify() == (False, [])
     assert len(v) == 0
+    # the CPU verifiers behind the same crypto.batch seam honor the
+    # identical one-shot contract (semantics must not depend on which
+    # factory wins — review finding)
+    from tendermint_tpu.crypto.ed25519 import Ed25519BatchVerifier
+    from tendermint_tpu.crypto.sr25519 import (
+        PrivKeySr25519,
+        Sr25519BatchVerifier,
+    )
+
+    cv = Ed25519BatchVerifier()
+    cv.add(priv.pub_key(), b"drain", priv.sign(b"drain"))
+    assert cv.verify() == (True, [True])
+    assert cv.verify() == (False, [])
+    assert len(cv) == 0
+    sp = PrivKeySr25519.from_seed(b"\x0a" * 32)
+    sv = Sr25519BatchVerifier()
+    sv.add(sp.pub_key(), b"drain", sp.sign(b"drain"))
+    assert sv.verify() == (True, [True])
+    assert sv.verify() == (False, [])
+    assert len(sv) == 0

@@ -208,6 +208,7 @@ def test_single_verify_device_route(monkeypatch):
 
     monkeypatch.setattr(T, "_INSTALLED", True)
     monkeypatch.setattr(T, "_STREAMING", True)  # pretend accelerator
+    monkeypatch.setattr(T, "_SR_WARM", True)  # bucket already compiled
     assert T.single_sr_verifier() is not None
     sigs_before = T.stats()["sigs"]
     assert pub.verify_signature(msg, sig)
@@ -221,3 +222,48 @@ def test_single_verify_device_route(monkeypatch):
     assert pub.verify_signature(msg, sig)
     assert not pub.verify_signature(msg, bad)
     assert T.stats()["sigs"] == sigs_before + 2
+
+
+def test_single_route_gated_on_warm(monkeypatch):
+    """Until install()'s warm thread has compiled the smallest sr25519
+    bucket, single verifies stay on the CPU path — a per-vote verify
+    must never block behind the first XLA compile (ADVICE r3)."""
+    from tendermint_tpu.crypto import tpu_verifier as T
+
+    # an earlier test's install() may have left a warm thread running;
+    # join it so its async _SR_WARM write can't land after ours
+    if T._SR_WARM_THREAD is not None:
+        T._SR_WARM_THREAD.join(timeout=30)
+    monkeypatch.setattr(T, "_INSTALLED", True)
+    monkeypatch.setattr(T, "_STREAMING", True)
+    monkeypatch.setattr(T, "_SR_WARM", False)
+    assert T.single_sr_verifier() is None
+
+
+def test_single_verify_device_fault_falls_back(monkeypatch):
+    """A device route that raises must not propagate out of
+    verify_signature (total-predicate contract — it sits under
+    per-vote and evidence verification): the pure-Python ristretto
+    path answers instead (ADVICE r3 medium)."""
+    from tendermint_tpu.crypto import tpu_verifier as T
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+    priv = PrivKeySr25519.from_seed(b"\x2b" * 32)
+    pub = priv.pub_key()
+    msg = b"fault-route"
+    sig = priv.sign(msg)
+    bad = sig[:5] + bytes([sig[5] ^ 1]) + sig[6:]
+
+    class Boom:
+        def add(self, *a):
+            raise RuntimeError("device fault")
+
+        def verify(self):  # pragma: no cover - add raises first
+            raise RuntimeError("device fault")
+
+    monkeypatch.setattr(T, "single_sr_verifier", lambda: Boom())
+    monkeypatch.setattr(T, "_SR_WARM", True)
+    assert pub.verify_signature(msg, sig)
+    # the fault trips the route so later votes skip the device retry
+    assert T._SR_WARM is False
+    assert not pub.verify_signature(msg, bad)
