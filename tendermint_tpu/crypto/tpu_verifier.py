@@ -12,6 +12,7 @@ Go.
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Tuple
 
 from ..libs import metrics as M
@@ -95,6 +96,11 @@ def _has_tpu_runtime() -> bool:
     path on CPU-only nodes with jax_platforms unset."""
     import importlib.util
 
+    import os
+
+    if os.environ.get("TPU_LIBRARY_PATH"):
+        # libtpu attached via env var, no importable module
+        return True
     try:
         return (
             importlib.util.find_spec("libtpu") is not None
@@ -253,10 +259,12 @@ _INSTALLED = False
 # handle is kept so tests (and embedders) can join before reading.
 _SR_WARM = False
 _SR_WARM_THREAD = None
-# bumped by every install(): a warm thread only publishes its result if
-# its generation is still current, so a slow warm from a superseded
-# install can never vouch for a verifier it didn't compile
+# bumped (under _SR_WARM_LOCK) by every install() BEFORE the shared
+# verifier swap: a warm thread only publishes its result if its
+# generation is still current, so a slow warm from a superseded install
+# can never vouch for a verifier it didn't compile
 _SR_WARM_GEN = 0
+_SR_WARM_LOCK = threading.Lock()
 
 
 def installed() -> Optional[int]:
@@ -311,10 +319,16 @@ def trip_sr_singles() -> None:
     """Demote single sr25519 verifies back to the CPU path after a
     device fault (called by PubKeySr25519.verify_signature's fallback).
     Without the trip, a persistently faulted device would be re-tried —
-    and a warning logged — on every per-vote verify. Batch verifies
-    keep their own error paths; a later install() re-warms singles."""
+    and a warning logged — on every per-vote verify. A fresh warm probe
+    is started immediately: if the fault was transient the probe's
+    successful device verify re-arms the route; if the device is truly
+    down the probe fails quietly and singles stay on CPU (one probe per
+    trip — no retry storm, and batches keep their own error paths)."""
     global _SR_WARM
-    _SR_WARM = False
+    with _SR_WARM_LOCK:
+        _SR_WARM = False
+    if _INSTALLED:
+        _start_sr_warm_thread()
 
 
 def _start_sr_warm_thread() -> None:
@@ -324,26 +338,29 @@ def _start_sr_warm_thread() -> None:
     (a wedged device claim would hang node startup — PERF.md claim
     discipline), and a warm that stalls only delays the device upgrade
     of single verifies, never a vote."""
-    global _SR_WARM, _SR_WARM_THREAD, _SR_WARM_GEN
-    import threading
+    global _SR_WARM_THREAD, _SR_WARM_GEN
 
-    # a re-install may have swapped in a different (uncompiled) shared
-    # verifier — e.g. a mesh-sharded one; the gate must drop until THIS
-    # install's warm pass proves a compiled program
-    _SR_WARM = False
-    _SR_WARM_GEN += 1
-    gen = _SR_WARM_GEN
+    with _SR_WARM_LOCK:
+        gen = _SR_WARM_GEN
+
+    def publish(ok: bool) -> None:
+        """Set the warm flag iff this thread's generation is still
+        current — checked and written under the gate lock so a
+        superseded install's slow warm can never vouch for a verifier
+        it didn't compile (check-then-act must be atomic)."""
+        global _SR_WARM
+        with _SR_WARM_LOCK:
+            if ok and gen == _SR_WARM_GEN:
+                _SR_WARM = True
 
     def warm() -> None:
-        global _SR_WARM
         try:
             if not on_accelerator() and _MIN_BATCH > 1:
                 # CPU process with the min-batch gate keeping singles
                 # off the kernel: nothing to compile. (min_batch <= 1
                 # would route singles to the CPU-backend kernel, so
                 # that case falls through to the real probe below.)
-                if gen == _SR_WARM_GEN:
-                    _SR_WARM = True
+                publish(True)
                 return
             from .sr25519 import PrivKeySr25519
 
@@ -357,8 +374,7 @@ def _start_sr_warm_thread() -> None:
             ok = v.verify(
                 [priv.pub_key().bytes()], [msg], [priv.sign(msg)]
             )
-            if bool(ok.all()) and gen == _SR_WARM_GEN:
-                _SR_WARM = True
+            publish(bool(ok.all()))
         except Exception as e:  # pragma: no cover - warm is best-effort
             from ..libs.log import get_logger
 
@@ -380,6 +396,14 @@ def install(
     ed25519 batches are sharded across it
     (tendermint_tpu.parallel.sharding); otherwise single-chip."""
     global _SHARED_VERIFIER, _SHARED_VERIFIER_SR, _MIN_BATCH, _INSTALLED
+    global _SR_WARM, _SR_WARM_GEN
+    # drop the single-verify gate BEFORE swapping the shared verifier:
+    # a concurrent vote must never pass the warm gate and land on the
+    # new (uncompiled) program; the bump also invalidates any in-flight
+    # warm thread from a previous install
+    with _SR_WARM_LOCK:
+        _SR_WARM = False
+        _SR_WARM_GEN += 1
     _MIN_BATCH = min_batch
     _INSTALLED = True
     # warm the native keccak library here (a subprocess cc compile on

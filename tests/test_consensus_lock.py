@@ -1,0 +1,464 @@
+"""Consensus locking cells: lock / relock / unlock / POL safety.
+
+Reference model: internal/consensus/state_test.go:449-1264
+(TestStateLockNoPOL, TestStateLockPOLRelock, TestStateLockPOLUnlock,
+TestStateLockPOLUnlockOnUnknownBlock, TestStateLockPOLSafety1/2).
+One real ConsensusState (cs1) is driven deterministically; the other
+three validators are scripted stubs whose votes are signed with MockPV
+and injected through the peer queue — the reference's randState(4) +
+signAddVotes pattern. Every assertion targets the lock/POL conditions
+in consensus/state.py _enter_precommit (+2/3-nil unlock, relock,
+lock-on-proposal, unlock-on-unknown) and _default_do_prevote's
+locked-block branch.
+"""
+
+import asyncio
+import time
+
+from tendermint_tpu.consensus import RoundStep
+from tendermint_tpu.consensus.msgs import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.privval import MockPV
+from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.types.commit import Commit
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.validator import Validator, ValidatorSet
+from tendermint_tpu.types.vote import Vote
+
+from tests.test_consensus_state import Node, fast_config
+
+CHAIN = "lock-chain"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def wait_for(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.01)
+
+
+class LockHarness:
+    """One real cs1 + three scripted vote stubs over 4 equal-power
+    validators. cs1 gets the height-1 round-0 proposer key by default
+    (the reference's cells are written from the round-0 proposer's
+    seat), so its round-0 proposal block B1 is the lock target."""
+
+    def __init__(self, seed_base: int, cs1_proposes: bool = True):
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([seed_base + i]) * 32)
+            for i in range(4)
+        ]
+        vals = ValidatorSet(
+            [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+        )
+        by_addr = {p.pub_key().address(): p for p in privs}
+        proposer_priv = by_addr[vals.get_proposer().address]
+        if cs1_proposes:
+            cs1_priv = proposer_priv
+        else:
+            cs1_priv = next(p for p in privs if p is not proposer_priv)
+        self.genesis = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10)
+                for p in privs
+            ],
+        )
+        self.node = Node(cs1_priv, self.genesis, cfg=fast_config())
+        self.cs = self.node.cs
+        self.cs1_addr = cs1_priv.pub_key().address()
+        self.stubs = [p for p in privs if p is not cs1_priv]
+
+        self.sent: list = []  # every message cs1 feeds into itself
+        self.events: list = []  # (kind, round) round-state events
+        orig_send = self.cs._send_internal
+        orig_pub = self.cs._publish_round_state_event
+
+        def record_send(msg):
+            self.sent.append(msg)
+            orig_send(msg)
+
+        def record_event(kind):
+            self.events.append((kind, self.cs.rs.round))
+            orig_pub(kind)
+
+        self.cs._send_internal = record_send
+        self.cs._publish_round_state_event = record_event
+
+    # -- cs1 observation ------------------------------------------------
+
+    def own_votes(self, vtype: int, round_: int) -> list:
+        return [
+            m.vote
+            for m in self.sent
+            if isinstance(m, VoteMessage)
+            and m.vote.type == vtype
+            and m.vote.round == round_
+            and m.vote.validator_address == self.cs1_addr
+        ]
+
+    async def wait_own_vote(self, vtype: int, round_: int) -> Vote:
+        await wait_for(
+            lambda: self.own_votes(vtype, round_),
+            what=f"cs1 vote type={vtype} round={round_}",
+        )
+        return self.own_votes(vtype, round_)[0]
+
+    # -- stub actions ---------------------------------------------------
+
+    async def stub_votes(
+        self, vtype: int, round_: int, block_id: BlockID, stubs=None
+    ) -> None:
+        """Sign and inject votes from the given stubs (default: all)."""
+        for priv in stubs if stubs is not None else self.stubs:
+            addr = priv.pub_key().address()
+            idx, _ = self.cs.rs.validators.get_by_address(addr)
+            vote = Vote(
+                type=vtype,
+                height=self.cs.rs.height,
+                round=round_,
+                block_id=block_id,
+                timestamp_ns=time.time_ns(),
+                validator_address=addr,
+                validator_index=idx,
+            )
+            await MockPV(priv).sign_vote(CHAIN, vote)
+            self.cs.send_peer_msg(
+                VoteMessage(vote=vote), f"stub-{addr.hex()[:8]}"
+            )
+
+    def make_stub_block(self, proposer_priv):
+        """A valid height-1 block as the given stub would propose it
+        (shadow executor over the same genesis — different proposer
+        address means a different block hash than cs1's B1)."""
+        shadow = Node(proposer_priv, self.genesis)
+        empty = Commit(
+            height=0, round=0, block_id=BlockID(), signatures=[]
+        )
+        return shadow.exec.create_proposal_block(
+            1,
+            shadow.state_store.load(),
+            empty,
+            proposer_priv.pub_key().address(),
+        )
+
+    async def inject_proposal(
+        self, proposer_priv, round_: int, block, parts, pol_round: int = -1
+    ) -> None:
+        proposal = Proposal(
+            height=1,
+            round=round_,
+            pol_round=pol_round,
+            block_id=BlockID(
+                hash=block.hash(), part_set_header=parts.header()
+            ),
+        )
+        await MockPV(proposer_priv).sign_proposal(CHAIN, proposal)
+        self.cs.send_peer_msg(
+            ProposalMessage(proposal=proposal), "stub-proposer"
+        )
+        for i in range(parts.total):
+            self.cs.send_peer_msg(
+                BlockPartMessage(
+                    height=1, round=round_, part=parts.get_part(i)
+                ),
+                "stub-proposer",
+            )
+
+    # -- canned sequences ------------------------------------------------
+
+    async def lock_b1_round0(self):
+        """Drive cs1 to lock its own round-0 proposal B1: two stubs
+        prevote B1 (+2/3 with cs1's own prevote), cs1 locks and
+        precommits B1. Returns cs1's round-0 prevote (carrying B1's
+        BlockID)."""
+        prevote = await self.wait_own_vote(PREVOTE_TYPE, 0)
+        assert prevote.block_id.hash, "cs1 should prevote its proposal"
+        await self.stub_votes(
+            PREVOTE_TYPE, 0, prevote.block_id, stubs=self.stubs[:2]
+        )
+        precommit = await self.wait_own_vote(PRECOMMIT_TYPE, 0)
+        assert precommit.block_id.hash == prevote.block_id.hash
+        rs = self.cs.rs
+        assert rs.locked_round == 0
+        assert rs.locked_block is not None
+        assert rs.locked_block.hash() == prevote.block_id.hash
+        assert ("lock", 0) in self.events
+        return prevote
+
+    async def push_to_round1_nil_precommits(self):
+        """Two stubs precommit nil in round 0; with cs1's block
+        precommit that is +2/3-any, so precommit-wait times out into
+        round 1."""
+        await self.stub_votes(
+            PRECOMMIT_TYPE, 0, BlockID(), stubs=self.stubs[:2]
+        )
+        await wait_for(
+            lambda: self.cs.rs.round >= 1, what="round 1",
+        )
+
+
+def test_lock_no_pol_prevotes_locked_block_and_stays_locked():
+    """TestStateLockNoPOL cell 1-2 (state_test.go:449): after locking
+    B1 in round 0, cs1 must prevote B1 in round 1 with NO proposal in
+    sight, and a nil-majority-free prevote round must precommit nil
+    WITHOUT touching the lock."""
+
+    async def go():
+        h = LockHarness(seed_base=140)
+        await h.cs.start()
+        try:
+            prevote = await h.lock_b1_round0()
+            await h.push_to_round1_nil_precommits()
+            # round 1, no proposal delivered: the locked block is
+            # prevoted (state.py _default_do_prevote locked branch)
+            rv = await h.wait_own_vote(PREVOTE_TYPE, 1)
+            assert rv.block_id.hash == prevote.block_id.hash, (
+                "locked validator must prevote its locked block"
+            )
+            # two stubs prevote nil: +2/3-any but no majority ->
+            # precommit nil, lock unchanged
+            await h.stub_votes(
+                PREVOTE_TYPE, 1, BlockID(), stubs=h.stubs[:2]
+            )
+            pc = await h.wait_own_vote(PRECOMMIT_TYPE, 1)
+            assert pc.block_id.hash == b"", (
+                "no +2/3 prevotes: precommit must be nil"
+            )
+            rs = h.cs.rs
+            assert rs.locked_round == 0, "lock must survive a no-POL round"
+            assert rs.locked_block is not None
+            assert rs.locked_block.hash() == prevote.block_id.hash
+            assert ("unlock", 1) not in h.events
+        finally:
+            await h.cs.stop()
+
+    run(go())
+
+
+def test_relock_on_new_pol_for_locked_block_commits():
+    """TestStateLockPOLRelock (state_test.go:592): a fresh +2/3
+    prevote POL for the already-locked block in round 1 relocks
+    (locked_round 0 -> 1), precommits the block, and the height
+    commits in round 1."""
+
+    async def go():
+        h = LockHarness(seed_base=150)
+        await h.cs.start()
+        try:
+            prevote = await h.lock_b1_round0()
+            b1 = prevote.block_id
+            await h.push_to_round1_nil_precommits()
+            await h.wait_own_vote(PREVOTE_TYPE, 1)  # locked prevote
+            # new POL for B1 in round 1
+            await h.stub_votes(PREVOTE_TYPE, 1, b1, stubs=h.stubs[:2])
+            pc = await h.wait_own_vote(PRECOMMIT_TYPE, 1)
+            assert pc.block_id.hash == b1.hash
+            assert h.cs.rs.locked_round == 1, "POL must update locked_round"
+            assert ("relock", 1) in h.events
+            # stubs precommit B1 -> commit at round 1
+            await h.stub_votes(PRECOMMIT_TYPE, 1, b1, stubs=h.stubs[:2])
+            await wait_for(
+                lambda: h.node.block_store.height() >= 1, what="commit",
+            )
+            block = h.node.block_store.load_block(1)
+            assert block.hash() == b1.hash
+            seen = h.node.block_store.load_seen_commit()
+            assert seen.round == 1, "commit must carry the relock round"
+        finally:
+            await h.cs.stop()
+
+    run(go())
+
+
+def test_unlock_on_nil_polka():
+    """TestStateLockPOLUnlock (state_test.go:722): +2/3 nil prevotes
+    in round 1 unlock the round-0 lock and cs1 precommits nil."""
+
+    async def go():
+        h = LockHarness(seed_base=160)
+        await h.cs.start()
+        try:
+            prevote = await h.lock_b1_round0()
+            await h.push_to_round1_nil_precommits()
+            await h.wait_own_vote(PREVOTE_TYPE, 1)
+            # ALL three stubs prevote nil: 30/40 power is a nil polka
+            await h.stub_votes(PREVOTE_TYPE, 1, BlockID())
+            pc = await h.wait_own_vote(PRECOMMIT_TYPE, 1)
+            assert pc.block_id.hash == b""
+            rs = h.cs.rs
+            assert rs.locked_round == -1, "nil polka must unlock"
+            assert rs.locked_block is None
+            assert rs.locked_block_parts is None
+            assert prevote.block_id.hash  # (B1 existed; lock was real)
+        finally:
+            await h.cs.stop()
+
+    run(go())
+
+
+def test_unlock_on_nil_polka_delivered_before_round_entry():
+    """Same cell, other code path: when the round-1 nil prevotes all
+    arrive while cs1 is still in round 0, the recent-polka unlock in
+    _add_vote cannot fire (vote.round > rs.round at add time) — the
+    +2/3-nil unlock inside _enter_precommit must do it (reference
+    state.go:1469 vs the addVote-path unlock at :2139)."""
+
+    async def go():
+        h = LockHarness(seed_base=165)
+        await h.cs.start()
+        try:
+            await h.lock_b1_round0()
+            # all three stubs prevote nil for round 1 while cs1 is
+            # still in round 0; 2/3-any pulls cs1 into round 1
+            await h.stub_votes(PREVOTE_TYPE, 1, BlockID())
+            await wait_for(lambda: h.cs.rs.round >= 1, what="round 1")
+            pc = await h.wait_own_vote(PRECOMMIT_TYPE, 1)
+            assert pc.block_id.hash == b""
+            rs = h.cs.rs
+            assert rs.locked_round == -1, (
+                "+2/3 nil at precommit entry must unlock"
+            )
+            assert rs.locked_block is None
+        finally:
+            await h.cs.stop()
+
+    run(go())
+
+
+def test_unlock_on_polka_for_unknown_block():
+    """TestStateLockPOLUnlockOnUnknownBlock (state_test.go:1037): a
+    +2/3 prevote POL for a block cs1 has never seen unlocks, precommits
+    nil, and re-arms the part set for the unknown block so it can be
+    fetched."""
+
+    async def go():
+        h = LockHarness(seed_base=170)
+        await h.cs.start()
+        try:
+            await h.lock_b1_round0()
+            await h.push_to_round1_nil_precommits()
+            await h.wait_own_vote(PREVOTE_TYPE, 1)
+            unknown = BlockID(
+                hash=b"\xc0" * 32,
+                part_set_header=PartSetHeader(total=1, hash=b"\xc1" * 32),
+            )
+            await h.stub_votes(PREVOTE_TYPE, 1, unknown)
+            pc = await h.wait_own_vote(PRECOMMIT_TYPE, 1)
+            assert pc.block_id.hash == b"", (
+                "cs1 must not precommit a block it has not validated"
+            )
+            rs = h.cs.rs
+            assert rs.locked_round == -1 and rs.locked_block is None
+            assert rs.proposal_block is None
+            assert rs.proposal_block_parts is not None
+            assert rs.proposal_block_parts.has_header(
+                unknown.part_set_header
+            ), "part set must be re-armed to fetch the polka block"
+        finally:
+            await h.cs.stop()
+
+    run(go())
+
+
+def test_lock_switches_to_new_proposal_on_higher_pol():
+    """The lock-change rule (state_test.go POLSafety family): locked on
+    B1 at round 0, cs1 still prevotes B1 in round 1 (lock discipline),
+    but a round-1 +2/3 POL for the round-1 proposer's block C — which
+    cs1 HAS and can validate — moves the lock to C and precommits C."""
+
+    async def go():
+        h = LockHarness(seed_base=180)
+        await h.cs.start()
+        try:
+            prevote = await h.lock_b1_round0()
+            await h.push_to_round1_nil_precommits()
+            await wait_for(
+                lambda: h.cs.rs.step >= RoundStep.PROPOSE,
+                what="round 1 propose",
+            )
+            proposer_addr = h.cs.rs.validators.get_proposer().address
+            assert proposer_addr != h.cs1_addr, (
+                "round-1 proposer must rotate away from cs1"
+            )
+            proposer_priv = next(
+                p
+                for p in h.stubs
+                if p.pub_key().address() == proposer_addr
+            )
+            block_c, parts_c = h.make_stub_block(proposer_priv)
+            assert block_c.hash() != prevote.block_id.hash
+            await h.inject_proposal(proposer_priv, 1, block_c, parts_c)
+            await wait_for(
+                lambda: h.cs.rs.proposal_block is not None,
+                what="proposal C assembled",
+            )
+            # lock discipline: cs1's round-1 prevote is still B1
+            rv = await h.wait_own_vote(PREVOTE_TYPE, 1)
+            assert rv.block_id.hash == prevote.block_id.hash
+            # +2/3 POL for C at round 1
+            c_id = BlockID(
+                hash=block_c.hash(), part_set_header=parts_c.header()
+            )
+            await h.stub_votes(PREVOTE_TYPE, 1, c_id)
+            pc = await h.wait_own_vote(PRECOMMIT_TYPE, 1)
+            assert pc.block_id.hash == block_c.hash(), (
+                "POL at a higher round must move the lock to C"
+            )
+            rs = h.cs.rs
+            assert rs.locked_round == 1
+            assert rs.locked_block is not None
+            assert rs.locked_block.hash() == block_c.hash()
+            assert ("lock", 1) in h.events
+        finally:
+            await h.cs.stop()
+
+    run(go())
+
+
+def test_no_lock_or_precommit_without_seen_proposal():
+    """POL safety from the non-proposer seat (state_test.go
+    TestStateLockPOLSafety1 opening cell): cs1 never saw any proposal,
+    prevotes nil, and even a +2/3 polka for an unseen block must not
+    produce a lock or a block precommit."""
+
+    async def go():
+        h = LockHarness(seed_base=190, cs1_proposes=False)
+        await h.cs.start()
+        try:
+            # no proposal is ever delivered: propose times out, nil prevote
+            prevote = await h.wait_own_vote(PREVOTE_TYPE, 0)
+            assert prevote.block_id.hash == b""
+            unseen = BlockID(
+                hash=b"\xc2" * 32,
+                part_set_header=PartSetHeader(total=2, hash=b"\xc3" * 32),
+            )
+            await h.stub_votes(PREVOTE_TYPE, 0, unseen)
+            pc = await h.wait_own_vote(PRECOMMIT_TYPE, 0)
+            assert pc.block_id.hash == b"", (
+                "polka for an unseen block must precommit nil"
+            )
+            rs = h.cs.rs
+            assert rs.locked_round == -1 and rs.locked_block is None
+            assert all(kind != "lock" for kind, _ in h.events)
+            # the part set is armed to fetch the polka block
+            assert rs.proposal_block_parts is not None
+            assert rs.proposal_block_parts.has_header(
+                unseen.part_set_header
+            )
+        finally:
+            await h.cs.stop()
+
+    run(go())
