@@ -31,10 +31,21 @@ from .msgs import (
     encode_timed_wal_message,
 )
 
-__all__ = ["WAL", "NopWAL", "WALDecodeError", "iter_wal_records"]
+__all__ = [
+    "WAL",
+    "NopWAL",
+    "WALDecodeError",
+    "iter_wal_records",
+    "iter_wal_group",
+]
 
 MAX_MSG_SIZE = 1 << 20  # 1 MB (reference: wal.go maxMsgSizeBytes)
 FLUSH_INTERVAL_S = 2.0  # reference: wal.go walDefaultFlushInterval
+# autofile-group analog (reference: internal/libs/autofile/group.go:66-100):
+# the head rotates once it crosses HEAD_SIZE_LIMIT, and the oldest rotated
+# files are pruned when the whole group exceeds TOTAL_SIZE_LIMIT
+HEAD_SIZE_LIMIT = 10 << 20  # group.go defaultHeadSizeLimit (10 MB)
+TOTAL_SIZE_LIMIT = 1 << 30  # group.go defaultTotalSizeLimit (1 GB)
 
 
 class WALDecodeError(Exception):
@@ -79,19 +90,89 @@ def iter_wal_records(path: str) -> Iterator[Tuple[int, object]]:
             yield decode_timed_wal_message(payload)
 
 
-class WAL(Service):
-    """reference: internal/consensus/wal.go BaseWAL."""
+def wal_group_files(path: str) -> list:
+    """The WAL group for head file `path`, oldest first: rotated files
+    `path.NNN` in index order, then the head (reference: autofile
+    group.go — Head plus {Head.Path}.NNN chunks)."""
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    rotated = []
+    try:
+        names = os.listdir(d)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        if name.startswith(base + "."):
+            suffix = name[len(base) + 1:]
+            if suffix.isdigit():
+                rotated.append((int(suffix), os.path.join(d, name)))
+    out = [p for _, p in sorted(rotated)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
 
-    def __init__(self, path: str) -> None:
+
+def _read_chunk(path: str) -> Tuple[list, bool]:
+    """All messages of one chunk in order plus a clean-EOF flag (False
+    when decoding stopped at a torn/corrupt record)."""
+    msgs: list = []
+    with open(path, "rb") as f:
+        while True:
+            try:
+                payload = _read_record(f)
+            except WALDecodeError:
+                return msgs, False
+            if payload is None:
+                return msgs, True
+            msgs.append(decode_timed_wal_message(payload)[1])
+
+
+def iter_wal_group(path: str) -> Iterator[Tuple[int, object]]:
+    """iter_wal_records across the whole rotated group, oldest record
+    first. Rotated files are closed at record boundaries, so only the
+    head can have a torn tail; a decode error anywhere (external
+    corruption) ends the WHOLE iteration — records after a corrupt one
+    are not trustworthy input history, same as the single-file
+    semantics."""
+    for p in wal_group_files(path):
+        with open(p, "rb") as f:
+            while True:
+                try:
+                    payload = _read_record(f)
+                except WALDecodeError:
+                    return
+                if payload is None:
+                    break
+                yield decode_timed_wal_message(payload)
+
+
+class WAL(Service):
+    """reference: internal/consensus/wal.go BaseWAL, writing through an
+    autofile-group analog (internal/libs/autofile/group.go): the head
+    file rotates to `{path}.NNN` once it crosses head_size_limit, and
+    the oldest rotated files are pruned when the group's total size
+    exceeds total_size_limit — a long-running validator's WAL is
+    size-bounded instead of growing forever."""
+
+    def __init__(
+        self,
+        path: str,
+        head_size_limit: int = HEAD_SIZE_LIMIT,
+        total_size_limit: int = TOTAL_SIZE_LIMIT,
+    ) -> None:
         super().__init__(name="wal", logger=get_logger("consensus.wal"))
         self.path = path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
         self._f: Optional[io.BufferedWriter] = None
         self._dirty = False
+        self._head_size = 0
 
     async def on_start(self) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._truncate_torn_tail()
         self._f = open(self.path, "ab")
+        self._head_size = os.path.getsize(self.path)
         self.spawn(self._flush_routine(), "wal-flush")
 
     async def on_stop(self) -> None:
@@ -127,14 +208,20 @@ class WAL(Service):
 
     def write(self, msg) -> None:
         """Buffered append (peer messages, timeouts — reference:
-        wal.go:173)."""
+        wal.go:173). Crossing the head-size limit rotates at the record
+        boundary just written (reference: group.go checkHeadSizeLimit —
+        there on a ticker; synchronous here keeps the bound exact)."""
         if self._f is None:
             return
         payload = encode_timed_wal_message(time.time_ns(), msg)
         if len(payload) > MAX_MSG_SIZE:
             raise ValueError(f"WAL message too big: {len(payload)}")
-        self._f.write(_frame(payload))
+        frame = _frame(payload)
+        self._f.write(frame)
         self._dirty = True
+        self._head_size += len(frame)
+        if self._head_size >= self.head_size_limit:
+            self._rotate()
 
     def write_sync(self, msg) -> None:
         """Append + flush + fsync. Used for own messages: the signature
@@ -158,6 +245,47 @@ class WAL(Service):
             await asyncio.sleep(FLUSH_INTERVAL_S)
             self.flush_and_sync()
 
+    # -- rotation (autofile-group analog) --
+
+    def _rotate(self) -> None:
+        """fsync + close the head, rename it to the next `.NNN` chunk,
+        open a fresh head, and prune the oldest chunks past the total
+        size cap (reference: group.go rotateFile + checkTotalSizeLimit
+        :100-160)."""
+        assert self._f is not None
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        rotated = wal_group_files(self.path)[:-1]  # exclude the head
+        next_idx = 0
+        if rotated:
+            last = os.path.basename(rotated[-1])
+            next_idx = int(last[len(os.path.basename(self.path)) + 1:]) + 1
+        target = f"{self.path}.{next_idx:03d}"
+        os.replace(self.path, target)
+        self._f = open(self.path, "ab")
+        self._head_size = 0
+        self._dirty = False
+        self.logger.info("rotated WAL head", chunk=os.path.basename(target))
+        self._enforce_total_size()
+
+    def _enforce_total_size(self) -> None:
+        """Delete oldest rotated chunks while the group exceeds
+        total_size_limit. The head is never deleted (reference:
+        group.go:129 checkTotalSizeLimit, which skips index maxIndex)."""
+        files = wal_group_files(self.path)
+        sizes = {p: os.path.getsize(p) for p in files}
+        total = sum(sizes.values())
+        for p in files[:-1]:  # oldest first; never the head
+            if total <= self.total_size_limit:
+                break
+            os.remove(p)
+            total -= sizes[p]
+            self.logger.info(
+                "pruned oldest WAL chunk over total-size limit",
+                chunk=os.path.basename(p),
+            )
+
     # -- replay support --
 
     def write_end_height(self, height: int) -> None:
@@ -170,28 +298,40 @@ class WAL(Service):
     ) -> Optional[list]:
         """All messages recorded AFTER EndHeight(height), i.e. the inputs
         of height+1 onward, or None if that marker isn't in the log
-        (reference: wal.go:202-254). height 0 means 'from the start' when
-        no EndHeight(0) exists but the log is non-empty."""
-        if not os.path.exists(self.path):
+        (reference: wal.go:202-254 — a backwards group scan). Chunks are
+        read newest-first so crash recovery touches only the tail of the
+        group (the marker is almost always in the head) and corruption
+        in an OLD chunk can never mask an intact recent tail. height 0
+        means 'from the start' when no EndHeight(0) exists but the log
+        is non-empty. Later EndHeight markers ARE returned so catchup
+        replay can detect an inconsistent store/WAL (crash between
+        EndHeight fsync and state save) instead of silently merging
+        heights."""
+        files = wal_group_files(self.path)
+        if not files:
             return None
-        out: list = []
-        found = False
-        for _ts, msg in iter_wal_records(self.path):
-            if isinstance(msg, EndHeightMessage) and msg.height == height:
-                found = True
-                out = []
-                continue
-            # Later EndHeight markers ARE returned so catchup replay can
-            # detect an inconsistent store/WAL (crash between EndHeight
-            # fsync and state save) instead of silently merging heights.
-            if found or height == 0:
-                out.append(msg)
-        if found:
-            return out
+        suffix: list = []  # records after the marker, from newer chunks
+        for p in reversed(files):
+            msgs, clean = _read_chunk(p)
+            if not clean and p != self.path:
+                # only the head may legitimately have a torn tail; a
+                # short decode of a rotated chunk is real corruption
+                self.logger.error(
+                    "corrupt record inside rotated WAL chunk; records "
+                    "after it in that chunk are lost to replay",
+                    chunk=os.path.basename(p),
+                )
+            marker = None
+            for j, m in enumerate(msgs):
+                if isinstance(m, EndHeightMessage) and m.height == height:
+                    marker = j
+            if marker is not None:
+                return msgs[marker + 1:] + suffix
+            suffix = msgs + suffix
         # Special case: a fresh WAL that never completed `height` but has
         # records (reference treats missing EndHeight(0) as start-of-file).
-        if height == 0 and out:
-            return out
+        if height == 0 and suffix:
+            return suffix
         return None
 
 

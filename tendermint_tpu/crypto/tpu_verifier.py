@@ -13,6 +13,7 @@ Go.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from ..libs import metrics as M
@@ -327,11 +328,17 @@ def trip_sr_singles() -> None:
     global _SR_WARM
     with _SR_WARM_LOCK:
         _SR_WARM = False
-    if _INSTALLED:
-        _start_sr_warm_thread()
+    if _INSTALLED and not (
+        _SR_WARM_THREAD is not None and _SR_WARM_THREAD.is_alive()
+    ):
+        # one probe at a time, and not immediately: if the fault is a
+        # wedge rather than a raising error, an instant re-touch of the
+        # device would just hang another thread (device-claim
+        # discipline: never pile onto a wedged claim)
+        _start_sr_warm_thread(delay_s=10.0)
 
 
-def _start_sr_warm_thread() -> None:
+def _start_sr_warm_thread(delay_s: float = 0.0) -> None:
     """Compile the smallest sr25519 bucket off the install() path, then
     flip _SR_WARM so single verifies start routing to the device. Runs
     on a daemon thread: install() itself must never touch the backend
@@ -341,20 +348,30 @@ def _start_sr_warm_thread() -> None:
     global _SR_WARM_THREAD, _SR_WARM_GEN
 
     with _SR_WARM_LOCK:
+        # snapshot generation AND verifier together: the probe must
+        # only ever vouch for the verifier it actually compiled, and
+        # install() swaps both under this same lock
         gen = _SR_WARM_GEN
+        snap = _SHARED_VERIFIER_SR
 
     def publish(ok: bool) -> None:
-        """Set the warm flag iff this thread's generation is still
+        """Set the warm flag iff this thread's snapshot is still
         current — checked and written under the gate lock so a
-        superseded install's slow warm can never vouch for a verifier
-        it didn't compile (check-then-act must be atomic)."""
+        superseded warm (older generation OR swapped verifier) can
+        never vouch for a verifier it didn't compile."""
         global _SR_WARM
         with _SR_WARM_LOCK:
-            if ok and gen == _SR_WARM_GEN:
+            if (
+                ok
+                and gen == _SR_WARM_GEN
+                and snap is _SHARED_VERIFIER_SR
+            ):
                 _SR_WARM = True
 
     def warm() -> None:
         try:
+            if delay_s:
+                time.sleep(delay_s)
             if not on_accelerator() and _MIN_BATCH > 1:
                 # CPU process with the min-batch gate keeping singles
                 # off the kernel: nothing to compile. (min_batch <= 1
@@ -366,7 +383,7 @@ def _start_sr_warm_thread() -> None:
 
             priv = PrivKeySr25519.from_seed(b"\x77" * 32)
             msg = b"sr25519-warm"
-            v = _SHARED_VERIFIER_SR
+            v = snap
             if v is None:
                 from ..ops import sr25519_kernel
 
@@ -401,9 +418,6 @@ def install(
     # a concurrent vote must never pass the warm gate and land on the
     # new (uncompiled) program; the bump also invalidates any in-flight
     # warm thread from a previous install
-    with _SR_WARM_LOCK:
-        _SR_WARM = False
-        _SR_WARM_GEN += 1
     _MIN_BATCH = min_batch
     _INSTALLED = True
     # warm the native keccak library here (a subprocess cc compile on
@@ -418,11 +432,20 @@ def install(
             ShardedSr25519Verifier,
         )
 
-        _SHARED_VERIFIER = ShardedEd25519Verifier(mesh)
-        _SHARED_VERIFIER_SR = ShardedSr25519Verifier(mesh)
+        new_ed = ShardedEd25519Verifier(mesh)
+        new_sr = ShardedSr25519Verifier(mesh)
     else:
-        _SHARED_VERIFIER = None
-        _SHARED_VERIFIER_SR = None
+        new_ed = None
+        new_sr = None
+    # gate drop + generation bump + verifier swap are ONE atomic step:
+    # a concurrent vote (or a trip-started warm probe) must never see
+    # the new uncompiled verifier behind a still-true warm flag, nor a
+    # current generation paired with the old verifier
+    with _SR_WARM_LOCK:
+        _SR_WARM = False
+        _SR_WARM_GEN += 1
+        _SHARED_VERIFIER = new_ed
+        _SHARED_VERIFIER_SR = new_sr
     register_device_factory("ed25519", _factory)
     register_device_factory("sr25519", _factory_sr)
     _start_sr_warm_thread()

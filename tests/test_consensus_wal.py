@@ -197,6 +197,152 @@ def test_wal_oversize_message_rejected(tmp_path):
     run(go())
 
 
+# -- rotation (autofile-group analog) --
+
+
+def test_wal_rotates_and_replays_across_boundary(tmp_path):
+    """Write more than two head-sizes of records through a small-limit
+    WAL: the head must rotate into .NNN chunks, and
+    search_for_end_height must find a marker that lives in a ROTATED
+    chunk and return the records after it across the chunk boundary
+    (reference: internal/libs/autofile/group.go:66-100 rotation;
+    wal.go:202-254 group search)."""
+    from tendermint_tpu.consensus.wal import iter_wal_group, wal_group_files
+
+    path = wal_path(tmp_path)
+
+    async def go():
+        w = WAL(path, head_size_limit=1024)
+        await w.start()
+        n_heights = 40  # ~90 bytes/record * 3 records/height >> 2 heads
+        for h in range(1, n_heights + 1):
+            w.write(MsgInfo(msg=HasVoteMessage(height=h, round=0, type=PREVOTE_TYPE, index=h % 4)))
+            w.write(TimeoutInfo(duration_s=1.0, height=h, round=0, step=3))
+            w.write_end_height(h)
+        await w.stop()
+        return w
+
+    w = run(go())
+    files = wal_group_files(path)
+    assert len(files) >= 3, f"expected rotation, group is {files}"
+    assert os.path.getsize(path) < 1024 + 200  # head stays bounded
+    # every record survives, in order, across all chunks
+    heights = [
+        m.height
+        for _, m in iter_wal_group(path)
+        if isinstance(m, EndHeightMessage)
+    ]
+    assert heights == list(range(1, 41))
+    # EndHeight(5) lives in the FIRST chunk (rotated out of the head)
+    first_chunk = [
+        m for _, m in iter_wal_records(files[0])
+        if isinstance(m, EndHeightMessage)
+    ]
+    assert 5 in [m.height for m in first_chunk]
+    tail = w.search_for_end_height(5)
+    assert tail is not None
+    hv = [m.msg.index for m in tail if isinstance(m, MsgInfo)]
+    assert hv[0] == 6 % 4, "replay must resume right after the marker"
+    # it crossed at least one boundary: records from the last height
+    # (in the head) are present too
+    assert any(
+        isinstance(m, MsgInfo) and m.msg.height == 40 for m in tail
+    )
+
+
+def test_wal_total_size_cap_prunes_oldest(tmp_path):
+    """The group never exceeds the total-size limit: oldest chunks are
+    deleted, the head survives, and a search for a pruned height
+    reports None (reference: group.go:129 checkTotalSizeLimit)."""
+    from tendermint_tpu.consensus.wal import wal_group_files
+
+    path = wal_path(tmp_path)
+
+    async def go():
+        w = WAL(path, head_size_limit=2048, total_size_limit=8192)
+        await w.start()
+        for h in range(1, 300):
+            w.write(MsgInfo(msg=HasVoteMessage(height=h, round=0, type=PREVOTE_TYPE, index=0)))
+            w.write_end_height(h)
+        await w.stop()
+        return w
+
+    w = run(go())
+    files = wal_group_files(path)
+    total = sum(os.path.getsize(p) for p in files)
+    assert total <= 8192 + 2048, f"group too big: {total}"
+    assert os.path.exists(path)  # head never pruned
+    # early heights were pruned with their chunks
+    assert w.search_for_end_height(1) is None
+    # recent heights still replayable
+    assert w.search_for_end_height(298) is not None
+
+
+def test_wal_old_chunk_corruption_does_not_mask_tail(tmp_path):
+    """Bit-rot in an OLD rotated chunk must not hide an intact recent
+    EndHeight marker from crash recovery: the group search scans
+    newest-first (reference: wal.go:202-254 backwards scan)."""
+    from tendermint_tpu.consensus.wal import wal_group_files
+
+    path = wal_path(tmp_path)
+
+    async def go():
+        w = WAL(path, head_size_limit=1024)
+        await w.start()
+        for h in range(1, 40):
+            w.write(MsgInfo(msg=HasVoteMessage(height=h, round=0, type=PREVOTE_TYPE, index=h % 4)))
+            w.write_end_height(h)
+        await w.stop()
+        return w
+
+    w = run(go())
+    files = wal_group_files(path)
+    assert len(files) >= 3
+    # corrupt a record in the OLDEST chunk
+    with open(files[0], "r+b") as f:
+        f.seek(20)
+        b = f.read(1)
+        f.seek(20)
+        f.write(bytes([b[0] ^ 0xFF]))
+    # recent-height recovery is unaffected
+    tail = w.search_for_end_height(38)
+    assert tail is not None
+    assert any(
+        isinstance(m, MsgInfo) and m.msg.height == 39 for m in tail
+    )
+
+
+def test_wal_restart_after_rotation_truncates_only_head(tmp_path):
+    """A torn tail after rotation affects only the head; restart
+    truncates it and the rotated chunks stay intact."""
+    from tendermint_tpu.consensus.wal import iter_wal_group, wal_group_files
+
+    path = wal_path(tmp_path)
+
+    async def write_phase():
+        w = WAL(path, head_size_limit=1024)
+        await w.start()
+        for h in range(1, 30):
+            w.write(MsgInfo(msg=HasVoteMessage(height=h, round=0, type=PREVOTE_TYPE, index=0)))
+            w.write_end_height(h)
+        await w.stop()
+
+    run(write_phase())
+    n_before = len(list(iter_wal_group(path)))
+    assert len(wal_group_files(path)) >= 2
+    # crash mid-write on the head
+    with open(path, "ab") as f:
+        f.write(struct.pack(">II", 0xBEEF, 50) + b"torn")
+
+    async def restart():
+        w = WAL(path, head_size_limit=1024)
+        await w.start()
+        await w.stop()
+
+    run(restart())
+    assert len(list(iter_wal_group(path))) == n_before
+
+
 # -- ticker --
 
 
