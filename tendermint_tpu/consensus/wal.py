@@ -310,28 +310,41 @@ class WAL(Service):
         files = wal_group_files(self.path)
         if not files:
             return None
-        suffix: list = []  # records after the marker, from newer chunks
+        # chunks newer than the marker, newest first (concatenated once
+        # at return — no quadratic re-copying while scanning)
+        newer: list = []
         for p in reversed(files):
             msgs, clean = _read_chunk(p)
             if not clean and p != self.path:
-                # only the head may legitimately have a torn tail; a
-                # short decode of a rotated chunk is real corruption
+                # Only the head may legitimately end short (torn tail).
+                # A short decode of a ROTATED chunk is real corruption,
+                # and the records lost after the corruption point would
+                # leave a silent hole in the replayed input history —
+                # fail the search loudly instead of replaying a gapped
+                # history into consensus.
                 self.logger.error(
-                    "corrupt record inside rotated WAL chunk; records "
-                    "after it in that chunk are lost to replay",
+                    "corrupt record inside rotated WAL chunk; refusing "
+                    "to assemble a replay history with a gap",
                     chunk=os.path.basename(p),
                 )
+                return None
             marker = None
             for j, m in enumerate(msgs):
                 if isinstance(m, EndHeightMessage) and m.height == height:
                     marker = j
             if marker is not None:
-                return msgs[marker + 1:] + suffix
-            suffix = msgs + suffix
+                out = msgs[marker + 1:]
+                for chunk_msgs in reversed(newer):
+                    out.extend(chunk_msgs)
+                return out
+            newer.append(msgs)
         # Special case: a fresh WAL that never completed `height` but has
         # records (reference treats missing EndHeight(0) as start-of-file).
-        if height == 0 and suffix:
-            return suffix
+        if height == 0 and any(newer):
+            out = []
+            for chunk_msgs in reversed(newer):
+                out.extend(chunk_msgs)
+            return out
         return None
 
 

@@ -328,17 +328,18 @@ def trip_sr_singles() -> None:
     global _SR_WARM
     with _SR_WARM_LOCK:
         _SR_WARM = False
-    if _INSTALLED and not (
-        _SR_WARM_THREAD is not None and _SR_WARM_THREAD.is_alive()
-    ):
-        # one probe at a time, and not immediately: if the fault is a
-        # wedge rather than a raising error, an instant re-touch of the
-        # device would just hang another thread (device-claim
-        # discipline: never pile onto a wedged claim)
-        _start_sr_warm_thread(delay_s=10.0)
+    if _INSTALLED:
+        # one probe at a time (enforced inside, under the gate lock),
+        # and not immediately: if the fault is a wedge rather than a
+        # raising error, an instant re-touch of the device would just
+        # hang another thread (device-claim discipline: never pile onto
+        # a wedged claim)
+        _start_sr_warm_thread(delay_s=10.0, single_flight=True)
 
 
-def _start_sr_warm_thread(delay_s: float = 0.0) -> None:
+def _start_sr_warm_thread(
+    delay_s: float = 0.0, single_flight: bool = False
+) -> None:
     """Compile the smallest sr25519 bucket off the install() path, then
     flip _SR_WARM so single verifies start routing to the device. Runs
     on a daemon thread: install() itself must never touch the backend
@@ -348,11 +349,24 @@ def _start_sr_warm_thread(delay_s: float = 0.0) -> None:
     global _SR_WARM_THREAD, _SR_WARM_GEN
 
     with _SR_WARM_LOCK:
+        if single_flight and (
+            _SR_WARM_THREAD is not None and _SR_WARM_THREAD.is_alive()
+        ):
+            # a probe is already in flight (alive-check and thread
+            # publication share this lock, so concurrent trips cannot
+            # both slip past it)
+            return
         # snapshot generation AND verifier together: the probe must
         # only ever vouch for the verifier it actually compiled, and
         # install() swaps both under this same lock
         gen = _SR_WARM_GEN
         snap = _SHARED_VERIFIER_SR
+        # publish the thread object under the same lock as the alive
+        # check above; `warm` is late-bound — defined below, before
+        # start() runs
+        _SR_WARM_THREAD = thread = threading.Thread(
+            target=lambda: warm(), daemon=True, name="sr25519-warm"
+        )
 
     def publish(ok: bool) -> None:
         """Set the warm flag iff this thread's snapshot is still
@@ -400,10 +414,7 @@ def _start_sr_warm_thread(delay_s: float = 0.0) -> None:
                 err=repr(e),
             )
 
-    _SR_WARM_THREAD = threading.Thread(
-        target=warm, daemon=True, name="sr25519-warm"
-    )
-    _SR_WARM_THREAD.start()
+    thread.start()
 
 
 def install(
@@ -414,10 +425,6 @@ def install(
     (tendermint_tpu.parallel.sharding); otherwise single-chip."""
     global _SHARED_VERIFIER, _SHARED_VERIFIER_SR, _MIN_BATCH, _INSTALLED
     global _SR_WARM, _SR_WARM_GEN
-    # drop the single-verify gate BEFORE swapping the shared verifier:
-    # a concurrent vote must never pass the warm gate and land on the
-    # new (uncompiled) program; the bump also invalidates any in-flight
-    # warm thread from a previous install
     _MIN_BATCH = min_batch
     _INSTALLED = True
     # warm the native keccak library here (a subprocess cc compile on

@@ -35,6 +35,7 @@ from ..types.light import LightBlock, SignedHeader
 from ..types.params import ConsensusParams
 from ..light.errors import LightClientError
 from ..types.validation import verify_commit_light
+from .chunks import ChunkQueue
 from .msgs import (
     ChunkRequestMessage,
     ChunkResponseMessage,
@@ -457,16 +458,16 @@ class StatesyncReactor(Service):
         if offer.result != abci.OFFER_SNAPSHOT_ACCEPT:
             raise SyncError(f"snapshot rejected by app: {offer.result}")
 
-        # 3. fetch chunks in parallel, apply in order
-        chunks = await self._fetch_chunks(snapshot)
-        for index in range(snapshot.chunks):
-            res = await self.app.apply_snapshot_chunk(
-                abci.RequestApplySnapshotChunk(
-                    index=index, chunk=chunks[index], sender=""
-                )
-            )
-            if res.result != abci.APPLY_CHUNK_ACCEPT:
-                raise SyncError(f"chunk {index} rejected: {res.result}")
+        # 3. fetch chunks in parallel into the on-disk queue, apply in
+        # order reading one chunk at a time — restore memory is bounded
+        # by a single chunk, not the snapshot (reference: chunks.go
+        # tempdir spool; syncer.go applyChunks :403-460)
+        queue = ChunkQueue(snapshot.chunks)
+        try:
+            await self._fetch_chunks(snapshot, queue)
+            await self._apply_chunks(snapshot, queue)
+        finally:
+            queue.close()
 
         # 4. verify the app landed on the trusted hash
         info = await self.app.info(abci.RequestInfo())
@@ -513,10 +514,13 @@ class StatesyncReactor(Service):
         finally:
             self._light_waiters.pop((peer, height), None)
 
-    async def _fetch_chunks(self, snapshot: _Snapshot) -> Dict[int, bytes]:
-        """Parallel chunk fetch with per-chunk retry over providers
-        (reference: syncer.go fetchChunks :464-520, chunks.go)."""
-        out: Dict[int, bytes] = {}
+    async def _fetch_chunks(
+        self, snapshot: _Snapshot, queue: ChunkQueue, indexes=None
+    ) -> None:
+        """Parallel chunk fetch with per-chunk retry over providers,
+        spooling straight to the on-disk queue (reference: syncer.go
+        fetchChunks :464-520, chunks.go). `indexes` limits the fetch to
+        a subset — the re-fetch path after the app discards chunks."""
         sem = asyncio.Semaphore(self.cfg.fetchers)
 
         async def fetch(index: int) -> None:
@@ -549,12 +553,65 @@ class StatesyncReactor(Service):
                         continue
                     if res.missing:
                         continue
-                    out[index] = res.chunk
+                    queue.put(index, res.chunk, sender=peer)
                     return
                 raise SyncError(f"failed to fetch chunk {index}")
 
-        await asyncio.gather(*(fetch(i) for i in range(snapshot.chunks)))
-        return out
+        todo = list(indexes) if indexes is not None else list(
+            range(snapshot.chunks)
+        )
+        tasks = [asyncio.ensure_future(fetch(i)) for i in todo]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            # one chunk failing must not leave sibling fetches running:
+            # they would later put() into a closed (deleted) queue and
+            # die as never-retrieved task exceptions
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+
+    async def _apply_chunks(
+        self, snapshot: _Snapshot, queue: ChunkQueue
+    ) -> None:
+        """Feed queued chunks to the app in index order, honoring the
+        app's control results (reference: syncer.go applyChunks
+        :403-460): ACCEPT marks the chunk returned and the cursor moves
+        to the lowest unreturned index; refetch_chunks are discarded
+        (file deleted + returned flag cleared, so the cursor rewinds to
+        them) and re-fetched from providers; RETRY clears the returned
+        flag without refetching; ABORT/RETRY_SNAPSHOT/REJECT_SNAPSHOT
+        fail this restore. Chunk files persist until the queue closes —
+        disk, not RAM, bounds the restore."""
+        steps = 0
+        while True:
+            index = queue.next_up()
+            if index is None:
+                return
+            steps += 1
+            if steps > 4 * snapshot.chunks + 16:
+                raise SyncError("app keeps retrying/refetching chunks")
+            res = await self.app.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(
+                    index=index,
+                    chunk=queue.get(index),
+                    sender=queue.sender(index),
+                )
+            )
+            queue.mark_returned(index)
+            if res.refetch_chunks:
+                for r in res.refetch_chunks:
+                    queue.discard(r)
+                await self._fetch_chunks(
+                    snapshot, queue, indexes=res.refetch_chunks
+                )
+            if res.result == abci.APPLY_CHUNK_ACCEPT:
+                continue
+            if res.result == abci.APPLY_CHUNK_RETRY:
+                queue.retry(index)
+                continue
+            raise SyncError(f"chunk {index} rejected: {res.result}")
 
     async def _fetch_light_block(
         self, height: int, peers: Set[str]

@@ -310,6 +310,9 @@ def test_wal_old_chunk_corruption_does_not_mask_tail(tmp_path):
     assert any(
         isinstance(m, MsgInfo) and m.msg.height == 39 for m in tail
     )
+    # a search whose suffix would CROSS the corrupt chunk fails loudly
+    # (None) instead of assembling a replay history with a silent gap
+    assert w.search_for_end_height(1) is None
 
 
 def test_wal_restart_after_rotation_truncates_only_head(tmp_path):
