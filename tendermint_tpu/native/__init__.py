@@ -23,7 +23,7 @@ import subprocess
 import tempfile
 from typing import Optional
 
-__all__ = ["load", "keccakf_lib", "signbytes_lib"]
+__all__ = ["load", "keccakf_lib", "signbytes_lib", "ed25519_batch_lib"]
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIBS: dict = {}
@@ -119,5 +119,27 @@ def keccakf_lib():
         lib.tm_keccakf.restype = None
         lib.tm_keccakf_n.argtypes = [ctypes.c_void_p, ctypes.c_long]
         lib.tm_keccakf_n.restype = None
+        lib._tm_configured = True
+    return lib
+
+
+def ed25519_batch_lib():
+    """The ed25519 batch-equation library with argtypes set, or None.
+    Exposes ``tm_ed25519_batch_verify(pk_bytes, r_bytes, zb, a_scalars,
+    z_scalars, n) -> int`` (1 accept / 0 equation-reject / -1 decode
+    failure) — see native/ed25519_batch.c for the contract."""
+    lib = load("ed25519_batch")
+    if lib is None:
+        return None
+    if not getattr(lib, "_tm_configured", False):
+        lib.tm_ed25519_batch_verify.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.tm_ed25519_batch_verify.restype = ctypes.c_int
         lib._tm_configured = True
     return lib

@@ -1,0 +1,381 @@
+/* Batched ed25519 verification via the random-linear-combination batch
+ * equation — the CPU-fallback analog of the reference's curve25519-voi
+ * batch verifier (reference: crypto/ed25519/ed25519.go:202-237, which
+ * wraps voi's ed25519.VerifyBatch).
+ *
+ * The host (crypto/ed25519.py) hashes and does all scalar arithmetic
+ * mod L in Python (fast big-int), then hands this kernel:
+ *
+ *   terms:  zb*B  +  sum a_i * (-A_i)  +  sum z_i * (-R_i)
+ *   where   zb  = sum z_i*s_i mod L,  a_i = z_i*k_i mod L,
+ *           z_i = 128-bit random,     k_i = SHA512(R|A|M) mod L
+ *
+ * and the kernel answers whether [8] * (that sum) is the identity —
+ * the cofactored (ZIP-215) batch equation. Field/point arithmetic
+ * mirrors crypto/ed25519_math.py exactly (radix-2^51 limbs; unified
+ * add-2008-hwcd-3 addition, complete for a=-1 and nonsquare d, so
+ * small-order/mixed-order ZIP-215 points are handled identically).
+ * Multi-scalar multiplication is Pippenger with 8-bit windows.
+ *
+ * Returns 1 = batch equation holds (every signature valid),
+ *         0 = equation fails (caller falls back per-signature for the
+ *             bitmap, like the reference does on batch failure),
+ *        -1 = some encoding failed ZIP-215 decoding (caller falls
+ *             back; the bad index is identified there).
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef uint64_t fe[5];
+typedef unsigned __int128 u128;
+
+#define MASK51 0x7ffffffffffffULL
+
+static const fe FE_D = {0x34dca135978a3ULL, 0x1a8283b156ebdULL, 0x5e7a26001c029ULL, 0x739c663a03cbbULL, 0x52036cee2b6ffULL};
+static const fe FE_2D = {0x69b9426b2f159ULL, 0x35050762add7aULL, 0x3cf44c0038052ULL, 0x6738cc7407977ULL, 0x2406d9dc56dffULL};
+static const fe FE_SQRTM1 = {0x61b274a0ea0b0ULL, 0x0d5a5fc8f189dULL, 0x7ef5e9cbd0c60ULL, 0x78595a6804c9eULL, 0x2b8324804fc1dULL};
+static const fe FE_BX = {0x62d608f25d51aULL, 0x412a4b4f6592aULL, 0x75b7171a4b31dULL, 0x1ff60527118feULL, 0x216936d3cd6e5ULL};
+static const fe FE_BY = {0x6666666666658ULL, 0x4ccccccccccccULL, 0x1999999999999ULL, 0x3333333333333ULL, 0x6666666666666ULL};
+static const fe FE_BT = {0x68ab3a5b7dda3ULL, 0x00eea2a5eadbbULL, 0x2af8df483c27eULL, 0x332b375274732ULL, 0x67875f0fd78b7ULL};
+
+static void fe_copy(fe r, const fe a) { memcpy(r, a, sizeof(fe)); }
+
+static void fe_zero(fe r) { memset(r, 0, sizeof(fe)); }
+
+static void fe_one(fe r) { fe_zero(r); r[0] = 1; }
+
+static void fe_add(fe r, const fe a, const fe b) {
+    for (int i = 0; i < 5; i++) r[i] = a[i] + b[i];
+}
+
+/* r = a - b, biased by 2p so limbs stay nonnegative (inputs < 2^52) */
+static void fe_sub(fe r, const fe a, const fe b) {
+    r[0] = a[0] + 0xfffffffffffdaULL - b[0];
+    r[1] = a[1] + 0xffffffffffffeULL - b[1];
+    r[2] = a[2] + 0xffffffffffffeULL - b[2];
+    r[3] = a[3] + 0xffffffffffffeULL - b[3];
+    r[4] = a[4] + 0xffffffffffffeULL - b[4];
+}
+
+static void fe_neg(fe r, const fe a) {
+    fe z;
+    fe_zero(z);
+    fe_sub(r, z, a);
+}
+
+static void fe_carry(fe r) {
+    uint64_t c;
+    c = r[0] >> 51; r[0] &= MASK51; r[1] += c;
+    c = r[1] >> 51; r[1] &= MASK51; r[2] += c;
+    c = r[2] >> 51; r[2] &= MASK51; r[3] += c;
+    c = r[3] >> 51; r[3] &= MASK51; r[4] += c;
+    c = r[4] >> 51; r[4] &= MASK51; r[0] += 19 * c;
+    c = r[0] >> 51; r[0] &= MASK51; r[1] += c;
+}
+
+static void fe_mul(fe r, const fe a, const fe b) {
+    u128 t0, t1, t2, t3, t4;
+    uint64_t b1_19 = 19 * b[1], b2_19 = 19 * b[2], b3_19 = 19 * b[3],
+             b4_19 = 19 * b[4];
+
+    t0 = (u128)a[0] * b[0] + (u128)a[1] * b4_19 + (u128)a[2] * b3_19 +
+         (u128)a[3] * b2_19 + (u128)a[4] * b1_19;
+    t1 = (u128)a[0] * b[1] + (u128)a[1] * b[0] + (u128)a[2] * b4_19 +
+         (u128)a[3] * b3_19 + (u128)a[4] * b2_19;
+    t2 = (u128)a[0] * b[2] + (u128)a[1] * b[1] + (u128)a[2] * b[0] +
+         (u128)a[3] * b4_19 + (u128)a[4] * b3_19;
+    t3 = (u128)a[0] * b[3] + (u128)a[1] * b[2] + (u128)a[2] * b[1] +
+         (u128)a[3] * b[0] + (u128)a[4] * b4_19;
+    t4 = (u128)a[0] * b[4] + (u128)a[1] * b[3] + (u128)a[2] * b[2] +
+         (u128)a[3] * b[1] + (u128)a[4] * b[0];
+
+    uint64_t c;
+    uint64_t r0 = (uint64_t)t0 & MASK51; c = (uint64_t)(t0 >> 51);
+    t1 += c;
+    uint64_t r1 = (uint64_t)t1 & MASK51; c = (uint64_t)(t1 >> 51);
+    t2 += c;
+    uint64_t r2 = (uint64_t)t2 & MASK51; c = (uint64_t)(t2 >> 51);
+    t3 += c;
+    uint64_t r3 = (uint64_t)t3 & MASK51; c = (uint64_t)(t3 >> 51);
+    t4 += c;
+    uint64_t r4 = (uint64_t)t4 & MASK51; c = (uint64_t)(t4 >> 51);
+    r0 += 19 * c;
+    c = r0 >> 51; r0 &= MASK51; r1 += c;
+    r[0] = r0; r[1] = r1; r[2] = r2; r[3] = r3; r[4] = r4;
+}
+
+static void fe_sq(fe r, const fe a) { fe_mul(r, a, a); }
+
+static uint64_t load64_le(const uint8_t *b) {
+    uint64_t v = 0;
+    for (int i = 7; i >= 0; i--) v = (v << 8) | b[i];
+    return v;
+}
+
+/* 255 low bits of the encoding (bit 255 — the x sign — is dropped);
+ * values >= p are fine: arithmetic is mod p (ZIP-215 non-canonical y) */
+static void fe_frombytes(fe r, const uint8_t *s) {
+    r[0] = load64_le(s) & MASK51;
+    r[1] = (load64_le(s + 6) >> 3) & MASK51;
+    r[2] = (load64_le(s + 12) >> 6) & MASK51;
+    r[3] = (load64_le(s + 19) >> 1) & MASK51;
+    r[4] = (load64_le(s + 24) >> 12) & MASK51;
+}
+
+/* canonical little-endian encoding (fully reduced mod p) */
+static void fe_tobytes(uint8_t *s, const fe a) {
+    fe t;
+    fe_copy(t, a);
+    fe_carry(t);
+    fe_carry(t);
+    /* q = whether t >= p, computed by propagating (t + 19) carries */
+    uint64_t q = (t[0] + 19) >> 51;
+    q = (t[1] + q) >> 51;
+    q = (t[2] + q) >> 51;
+    q = (t[3] + q) >> 51;
+    q = (t[4] + q) >> 51;
+    t[0] += 19 * q;
+    uint64_t c;
+    c = t[0] >> 51; t[0] &= MASK51; t[1] += c;
+    c = t[1] >> 51; t[1] &= MASK51; t[2] += c;
+    c = t[2] >> 51; t[2] &= MASK51; t[3] += c;
+    c = t[3] >> 51; t[3] &= MASK51; t[4] += c;
+    t[4] &= MASK51;
+    uint64_t w0 = t[0] | (t[1] << 51);
+    uint64_t w1 = (t[1] >> 13) | (t[2] << 38);
+    uint64_t w2 = (t[2] >> 26) | (t[3] << 25);
+    uint64_t w3 = (t[3] >> 39) | (t[4] << 12);
+    memcpy(s, &w0, 8);
+    memcpy(s + 8, &w1, 8);
+    memcpy(s + 16, &w2, 8);
+    memcpy(s + 24, &w3, 8);
+}
+
+static int fe_iszero(const fe a) {
+    uint8_t s[32];
+    fe_tobytes(s, a);
+    uint8_t acc = 0;
+    for (int i = 0; i < 32; i++) acc |= s[i];
+    return acc == 0;
+}
+
+static int fe_eq(const fe a, const fe b) {
+    fe d;
+    fe_sub(d, a, b);
+    return fe_iszero(d);
+}
+
+/* a^(2^252 - 3): the exponent in the combined sqrt/division trick
+ * ((p-5)/8). Binary: 250 ones, then "01". */
+static void fe_pow2523(fe r, const fe a) {
+    fe t;
+    fe_copy(t, a);
+    for (int i = 0; i < 249; i++) {
+        fe_sq(t, t);
+        fe_mul(t, t, a);
+    }
+    fe_sq(t, t);        /* the 0 bit */
+    fe_sq(t, t);
+    fe_mul(t, t, a);    /* the final 1 bit */
+    fe_copy(r, t);
+}
+
+/* extended (twisted Edwards) coordinates, mirrors ed25519_math.Point */
+typedef struct { fe X, Y, Z, T; } ge;
+
+static void ge_identity(ge *r) {
+    fe_zero(r->X);
+    fe_one(r->Y);
+    fe_one(r->Z);
+    fe_zero(r->T);
+}
+
+/* unified add-2008-hwcd-3 (complete for a=-1, d nonsquare — same
+ * formula as ed25519_math.point_add, valid for P==Q and small order) */
+static void ge_add(ge *r, const ge *p, const ge *q) {
+    fe a, b, c, d, e, f, g, h, t1, t2;
+    fe_sub(t1, p->Y, p->X);
+    fe_sub(t2, q->Y, q->X);
+    fe_carry(t1);
+    fe_carry(t2);
+    fe_mul(a, t1, t2);
+    fe_add(t1, p->Y, p->X);
+    fe_add(t2, q->Y, q->X);
+    fe_mul(b, t1, t2);
+    fe_mul(c, p->T, FE_2D);
+    fe_mul(c, c, q->T);
+    fe_mul(d, p->Z, q->Z);
+    fe_add(d, d, d);
+    fe_sub(e, b, a);
+    fe_sub(f, d, c);
+    fe_add(g, d, c);
+    fe_add(h, b, a);
+    fe_carry(e);
+    fe_carry(f);
+    fe_carry(g);
+    fe_carry(h);
+    fe_mul(r->X, e, f);
+    fe_mul(r->Y, g, h);
+    fe_mul(r->Z, f, g);
+    fe_mul(r->T, e, h);
+}
+
+/* dbl-2008-hwcd, mirrors ed25519_math.point_double */
+static void ge_dbl(ge *r, const ge *p) {
+    fe a, b, c, h, e, g, f, t;
+    fe_sq(a, p->X);
+    fe_sq(b, p->Y);
+    fe_sq(c, p->Z);
+    fe_add(c, c, c);
+    fe_carry(c);
+    fe_add(h, a, b);
+    fe_carry(h);
+    fe_add(t, p->X, p->Y);
+    fe_carry(t);
+    fe_sq(t, t);
+    fe_sub(e, h, t);
+    fe_sub(g, a, b);
+    fe_add(f, c, g);
+    fe_carry(e);
+    fe_carry(g);
+    fe_carry(f);
+    fe_mul(r->X, e, f);
+    fe_mul(r->Y, g, h);
+    fe_mul(r->Z, f, g);
+    fe_mul(r->T, e, h);
+}
+
+static void ge_neg(ge *r, const ge *p) {
+    fe_neg(r->X, p->X);
+    fe_carry(r->X);
+    fe_copy(r->Y, p->Y);
+    fe_copy(r->Z, p->Z);
+    fe_neg(r->T, p->T);
+    fe_carry(r->T);
+}
+
+/* ZIP-215 decompression, mirroring ed25519_math.decompress/_recover_x:
+ * non-canonical y accepted (reduced mod p); x recovered via the
+ * combined sqrt; "-0" (x == 0 with sign bit 1) rejected.
+ * Returns 1 on success. */
+static int ge_frombytes_zip215(ge *r, const uint8_t *s) {
+    fe y, y2, u, v, v3, x, vx2, chk;
+    int sign = s[31] >> 7;
+    fe_frombytes(y, s);
+    fe_sq(y2, y);
+    fe_one(u);
+    fe_sub(u, y2, u);
+    fe_carry(u);                 /* u = y^2 - 1 */
+    fe_mul(v, y2, FE_D);
+    fe_one(chk);
+    fe_add(v, v, chk);
+    fe_carry(v);                 /* v = d*y^2 + 1 */
+
+    fe_sq(v3, v);
+    fe_mul(v3, v3, v);           /* v^3 */
+    fe_sq(x, v3);
+    fe_mul(x, x, v);             /* v^7 */
+    fe_mul(x, x, u);             /* u*v^7 */
+    fe_pow2523(x, x);            /* (u*v^7)^((p-5)/8) */
+    fe_mul(x, x, v3);
+    fe_mul(x, x, u);             /* x = u*v^3*(u*v^7)^((p-5)/8) */
+
+    fe_sq(vx2, x);
+    fe_mul(vx2, vx2, v);         /* v*x^2 */
+    if (!fe_eq(vx2, u)) {
+        fe nu;
+        fe_neg(nu, u);
+        if (!fe_eq(vx2, nu)) return 0;  /* u/v is not a square */
+        fe_mul(x, x, FE_SQRTM1);        /* now v*x^2 == u */
+    }
+
+    uint8_t xb[32];
+    fe_tobytes(xb, x);
+    int xzero = 1;
+    for (int i = 0; i < 32; i++) xzero &= (xb[i] == 0);
+    if (xzero && sign) return 0; /* "-0" rejected (RFC 8032 + ZIP-215) */
+    if ((xb[0] & 1) != sign) {
+        fe_neg(x, x);
+        fe_carry(x);
+    }
+    fe_copy(r->X, x);
+    fe_copy(r->Y, y);
+    fe_one(r->Z);
+    fe_mul(r->T, x, y);
+    return 1;
+}
+
+/* Pippenger MSM with 8-bit windows: result = sum scalars[i] * pts[i].
+ * Scalars are 32-byte little-endian (< L < 2^253). */
+static void ge_msm(ge *result, const uint8_t *scalars, const ge *pts,
+                   size_t n) {
+    ge buckets[255]; /* ~40 KB of stack; single-threaded use */
+    ge_identity(result);
+    for (int w = 31; w >= 0; w--) {
+        if (w != 31)
+            for (int k = 0; k < 8; k++) ge_dbl(result, result);
+        for (int d = 0; d < 255; d++) ge_identity(&buckets[d]);
+        for (size_t i = 0; i < n; i++) {
+            int d = scalars[i * 32 + w];
+            if (d) ge_add(&buckets[d - 1], &buckets[d - 1], &pts[i]);
+        }
+        ge run, acc;
+        ge_identity(&run);
+        ge_identity(&acc);
+        for (int d = 254; d >= 0; d--) {
+            ge_add(&run, &run, &buckets[d]);
+            ge_add(&acc, &acc, &run);
+        }
+        ge_add(result, result, &acc);
+    }
+}
+
+/* See file header for the contract. */
+int tm_ed25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
+                            const uint8_t *zb, const uint8_t *a_scalars,
+                            const uint8_t *z_scalars, uint64_t n) {
+    size_t nterms = 2 * (size_t)n + 1;
+    ge *pts = malloc(nterms * sizeof(ge));
+    uint8_t *scalars = malloc(nterms * 32);
+    if (!pts || !scalars) {
+        free(pts);
+        free(scalars);
+        return -1;
+    }
+    int rc = -1;
+
+    /* term 0: zb * B */
+    fe_copy(pts[0].X, FE_BX);
+    fe_copy(pts[0].Y, FE_BY);
+    fe_one(pts[0].Z);
+    fe_copy(pts[0].T, FE_BT);
+    memcpy(scalars, zb, 32);
+
+    for (uint64_t i = 0; i < n; i++) {
+        ge t;
+        if (!ge_frombytes_zip215(&t, pk_bytes + 32 * i)) goto done;
+        ge_neg(&pts[1 + i], &t);
+        if (!ge_frombytes_zip215(&t, r_bytes + 32 * i)) goto done;
+        ge_neg(&pts[1 + n + i], &t);
+        memcpy(scalars + 32 * (1 + i), a_scalars + 32 * i, 32);
+        memcpy(scalars + 32 * (1 + n + i), z_scalars + 32 * i, 32);
+    }
+
+    {
+        ge sum;
+        ge_msm(&sum, scalars, pts, nterms);
+        /* cofactored: [8] * sum must be the identity */
+        ge_dbl(&sum, &sum);
+        ge_dbl(&sum, &sum);
+        ge_dbl(&sum, &sum);
+        /* identity in extended coords: X == 0 and Y == Z */
+        rc = (fe_iszero(sum.X) && fe_eq(sum.Y, sum.Z)) ? 1 : 0;
+    }
+
+done:
+    free(pts);
+    free(scalars);
+    return rc;
+}

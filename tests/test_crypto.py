@@ -85,6 +85,75 @@ def test_batch_verifier_bitmap():
     assert len(bv) == 0  # verify() drains (one-shot contract)
 
 
+def test_native_batch_equation_paths():
+    """Batches >= _NATIVE_BATCH_MIN ride the native RLC batch equation:
+    an all-valid batch returns all-True in one native call; any invalid
+    signature falls back to per-signature verification with the exact
+    bitmap (the reference's batch-failure behavior,
+    crypto/ed25519/ed25519.go:202-237)."""
+    from tendermint_tpu.crypto import ed25519 as e
+
+    if e._native_batch_fn() is None:
+        pytest.skip("no native toolchain")
+    n = max(e._NATIVE_BATCH_MIN, 48)
+    keys = [PrivKeyEd25519.from_seed(bytes([i + 1]) * 32) for i in range(8)]
+    bv = e.Ed25519BatchVerifier()
+    for i in range(n):
+        k = keys[i % 8]
+        m = b"nb-%d" % i
+        bv.add(k.pub_key(), m, k.sign(m))
+    ok, bits = bv.verify()
+    assert ok and bits == [True] * n
+
+    # one bad signature: exact per-index attribution
+    bv = e.Ed25519BatchVerifier()
+    for i in range(n):
+        k = keys[i % 8]
+        m = b"nb2-%d" % i
+        sig = k.sign(m)
+        if i == 17:
+            s = (int.from_bytes(sig[32:], "little") + 1) % em.L
+            sig = sig[:32] + s.to_bytes(32, "little")
+        bv.add(k.pub_key(), m, sig)
+    ok, bits = bv.verify()
+    assert not ok
+    assert [i for i, b in enumerate(bits) if not b] == [17]
+
+
+def test_native_batch_zip215_differential():
+    """The native batch equation agrees with the pure-Python ZIP-215
+    oracle on edge encodings: small-order R, non-canonical y, high-s —
+    packed into one batch whose expected bitmap the oracle defines."""
+    from tendermint_tpu.crypto import ed25519 as e
+
+    if e._native_batch_fn() is None:
+        pytest.skip("no native toolchain")
+    keys = [PrivKeyEd25519.from_seed(bytes([i + 31]) * 32) for i in range(4)]
+    items = []
+    expected = []
+    n = max(e._NATIVE_BATCH_MIN, 40)
+    for i in range(n):
+        k = keys[i % 4]
+        m = b"zdiff-%d" % i
+        sig = k.sign(m)
+        if i % 5 == 1:  # small-order R (identity encoding)
+            sig = (1).to_bytes(32, "little") + sig[32:]
+        elif i % 5 == 2:  # high-s (>= L): invalid under ZIP-215
+            s = int.from_bytes(sig[32:], "little") + em.L
+            if s < 2**256:
+                sig = sig[:32] + s.to_bytes(32, "little")
+        elif i % 5 == 3:  # flipped msg binding
+            m = b"zdiff-other-%d" % i
+        items.append((k.pub_key(), m, sig))
+        expected.append(em.zip215_verify(k.pub_key().bytes(), m, sig))
+    bv = e.Ed25519BatchVerifier()
+    for pk, m, sig in items:
+        bv.add(pk, m, sig)
+    ok, bits = bv.verify()
+    assert bits == expected
+    assert ok == all(expected)
+
+
 def test_batch_dispatch():
     sk = PrivKeyEd25519.generate()
     assert batch.supports_batch_verifier(sk.pub_key())
