@@ -479,27 +479,35 @@ def _inspect_serve(cfg: Config, args) -> int:
 
 def cmd_replay(args) -> int:
     """Re-execute stored blocks through a fresh builtin app (sanity /
-    debugging tool; reference: consensus/replay_file.go)."""
+    debugging tool; reference: consensus/replay_file.go). With
+    --console, drop into the interactive WAL playback console after
+    block replay (reference: replay_file.go:54,188-193)."""
     from ..abci.client import local_creator
     from ..abci.kvstore import KVStoreApplication
     from ..abci.proxy import AppConns
     from ..consensus.replay import Handshaker
     from ..state import StateStore, state_from_genesis
     from ..store.block_store import BlockStore
-    from ..store.kv import MemKV, open_db
+    from ..store.kv import open_db
     from ..types.genesis import GenesisDoc
 
     cfg = _load_home(args.home)
     db_dir = cfg.base.path(cfg.base.db_dir)
     block_db = open_db("blockstore", cfg.base.db_backend, db_dir)
+    state_db = open_db("state", cfg.base.db_backend, db_dir)
     genesis = GenesisDoc.from_file(cfg.base.path(cfg.base.genesis_file))
 
     async def run() -> None:
         block_store = BlockStore(block_db)
-        # fresh in-memory state: replay everything from genesis
-        state_store = StateStore(MemKV())
-        state = state_from_genesis(genesis)
-        state_store.save(state)
+        # the node's REAL state store (the reference's
+        # newConsensusStateForReplay does the same, replay_file.go:295):
+        # the handshake decision table assumes state tracks the store,
+        # and replays every stored block into the fresh app
+        state_store = StateStore(state_db)
+        state = state_store.load()
+        if state is None:
+            state = state_from_genesis(genesis)
+            state_store.save(state)
         proxy = AppConns(local_creator(KVStoreApplication()))
         await proxy.start()
         try:
@@ -513,6 +521,8 @@ def cmd_replay(args) -> int:
                 f"{final.last_block_height} app_hash "
                 f"{final.app_hash.hex()}"
             )
+            if getattr(args, "console", False):
+                await _replay_console(cfg, final, proxy, block_store)
         finally:
             await proxy.stop()
 
@@ -520,7 +530,146 @@ def cmd_replay(args) -> int:
         asyncio.run(run())
     finally:
         block_db.close()
+        state_db.close()
     return 0
+
+
+async def _build_replay_cs(cfg, state, proxy, block_store):
+    """A ConsensusState in replay mode over the handshaken state — no
+    privval, no live WAL, ticker started so scheduled timeouts are
+    tracked (their firings go nowhere: the receive loop never runs;
+    the console feeds recorded TimeoutInfo records instead)."""
+    from ..config import MempoolConfig
+    from ..consensus import ConsensusState
+    from ..mempool import TxMempool
+    from ..state import StateStore
+    from ..state.execution import BlockExecutor
+    from ..store.kv import MemKV
+
+    state_store = StateStore(MemKV())
+    state_store.save(state)
+    mempool = TxMempool(proxy.mempool, MempoolConfig())
+    block_exec = BlockExecutor(
+        state_store, proxy.consensus, mempool, block_store=block_store
+    )
+    cs = ConsensusState(
+        cfg.consensus, state, block_exec, block_store, privval=None,
+        replay_mode=True,
+    )
+    await cs.ticker.start()
+    return cs
+
+
+def _console_rs(cs, field: str) -> str:
+    """One rs-console view (reference: replay_file.go:259-287)."""
+    rs = cs.rs
+    if field == "short" or field == "":
+        return f"{rs.height}/{rs.round}/{rs.step}"
+    if field == "locked_round":
+        return str(rs.locked_round)
+    if field == "locked_block":
+        return (
+            rs.locked_block.hash().hex()
+            if rs.locked_block is not None
+            else "nil"
+        )
+    if field == "proposal":
+        return repr(rs.proposal)
+    if field == "validators":
+        return "\n".join(
+            f"{v.address.hex()} power={v.voting_power}"
+            for v in rs.validators.validators
+        )
+    if field == "votes":
+        out = []
+        for r in range(rs.round + 1):
+            pv = rs.votes.prevotes(r)
+            pc = rs.votes.precommits(r)
+            out.append(
+                f"round {r}: prevotes={pv.bit_array() if pv else None} "
+                f"precommits={pc.bit_array() if pc else None}"
+            )
+        return "\n".join(out)
+    return f"unknown rs field {field!r}"
+
+
+async def _replay_console(cfg, state, proxy, block_store) -> None:
+    """Interactive WAL playback (reference: replay_file.go console:
+    next [N], back [N], rs [field], n, quit). Steps the current
+    height's recorded inputs one message at a time through a
+    replay-mode ConsensusState; `back` rebuilds the state machine and
+    replays up to count-N (the reference does the same — the state
+    machine cannot run backwards)."""
+    from ..consensus.wal import WAL
+
+    wal = WAL(cfg.base.path(cfg.consensus.wal_file))
+    end_height = state.last_block_height
+    msgs = wal.search_for_end_height(end_height)
+    if msgs is None:
+        # distinct from an empty tail: the marker is absent (missing,
+        # truncated, or corrupt WAL — search refuses gapped histories)
+        print(
+            f"cannot replay: WAL has no EndHeight({end_height}) marker "
+            "(missing or corrupt WAL)"
+        )
+        return
+    print(
+        f"console: {len(msgs)} WAL records after EndHeight({end_height}); "
+        "commands: next [N] | back [N] | rs [short|locked_round|"
+        "locked_block|proposal|validators|votes] | n | quit"
+    )
+    cs = await _build_replay_cs(cfg, state, proxy, block_store)
+    pos = 0
+
+    async def apply_one() -> bool:
+        nonlocal pos
+        if pos >= len(msgs):
+            print("end of WAL")
+            return False
+        m = msgs[pos]
+        pos += 1
+        try:
+            await cs.replay_one(m)
+        except RuntimeError as e:
+            # e.g. an EndHeight mid-tail: store/WAL inconsistency —
+            # surface it, exactly like crash catchup would
+            print(f"replay error at #{pos}: {e}")
+            return False
+        print(f"#{pos}: {type(m).__name__} -> {_console_rs(cs, 'short')}")
+        return True
+
+    while True:
+        try:
+            line = input("> ")
+        except EOFError:
+            break
+        tokens = line.split()
+        if not tokens:
+            continue
+        cmd, rest = tokens[0], tokens[1:]
+        if cmd == "quit" or cmd == "q":
+            break
+        elif cmd == "next":
+            count = int(rest[0]) if rest and rest[0].isdigit() else 1
+            for _ in range(count):
+                if not await apply_one():
+                    break
+        elif cmd == "back":
+            count = int(rest[0]) if rest and rest[0].isdigit() else 1
+            target = max(0, pos - count)
+            await cs.ticker.stop()
+            cs = await _build_replay_cs(cfg, state, proxy, block_store)
+            pos = 0
+            for _ in range(target):
+                await apply_one()
+            print(f"rewound to #{pos}")
+        elif cmd == "rs":
+            print(_console_rs(cs, rest[0] if rest else ""))
+        elif cmd == "n":
+            print(pos)
+        else:
+            print(f"unknown command {cmd!r}")
+    await cs.ticker.stop()
 
 
 def cmd_debug_dump(args) -> int:
@@ -556,6 +705,19 @@ def cmd_debug_dump(args) -> int:
         wal_path = cfg.base.path(cfg.consensus.wal_file)
         if os.path.exists(wal_path):
             tar.add(wal_path, arcname="cs.wal")
+        # rotated WAL chunks (autofile-group analog) ride along too; a
+        # live node may prune a chunk between the listing and the add
+        from ..consensus.wal import wal_group_files
+
+        for chunk in wal_group_files(wal_path):
+            if chunk != wal_path:
+                try:
+                    tar.add(
+                        chunk,
+                        arcname="cs.wal." + chunk.rsplit(".", 1)[-1],
+                    )
+                except OSError:
+                    pass  # pruned mid-collection
         # store summary (opens read-only copies of the DBs)
         summary = {"collected_at": time.time()}
         try:
@@ -605,7 +767,29 @@ def cmd_debug_dump(args) -> int:
                 add_bytes(
                     tar, "metrics_error.txt", repr(e).encode()
                 )
+        # live RPC scrapes (reference debug/dump.go dumpDebugData):
+        # status, consensus state, net_info
+        if getattr(args, "rpc_url", ""):
+            for route in ("status", "consensus_state", "net_info"):
+                try:
+                    with urllib.request.urlopen(
+                        args.rpc_url.rstrip("/") + "/" + route, timeout=5
+                    ) as resp:
+                        add_bytes(tar, f"{route}.json", resp.read())
+                except Exception as e:
+                    add_bytes(
+                        tar, f"{route}_error.txt", repr(e).encode()
+                    )
     print(f"wrote debug bundle to {out_path}")
+    # kill variant (reference: cmd/tendermint/commands/debug/kill.go —
+    # collect the bundle, THEN abort the running node so its final
+    # state is captured alongside the crash)
+    pid = getattr(args, "kill", 0)
+    if pid:
+        import signal as _signal
+
+        os.kill(int(pid), _signal.SIGABRT)
+        print(f"sent SIGABRT to pid {pid}")
     return 0
 
 
@@ -1146,6 +1330,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser(
         "replay", help="re-execute stored blocks through a fresh app"
     )
+    sp.add_argument(
+        "--console",
+        action="store_true",
+        help="interactive WAL playback after block replay "
+        "(next/back/rs/n/quit)",
+    )
     sp.set_defaults(fn=cmd_replay)
 
     sp = sub.add_parser(
@@ -1162,6 +1352,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--metrics-url",
         default="",
         help="live /metrics endpoint to scrape into the bundle",
+    )
+    sp.add_argument(
+        "--rpc-url",
+        default="",
+        dest="rpc_url",
+        help="live RPC endpoint: status/consensus_state/net_info "
+        "scraped into the bundle",
+    )
+    sp.add_argument(
+        "--kill",
+        type=int,
+        default=0,
+        help="after collecting the bundle, SIGABRT this node pid "
+        "(the reference's `debug kill`)",
     )
     sp.set_defaults(fn=cmd_debug_dump)
 

@@ -97,6 +97,7 @@ class ConsensusState(Service):
         event_bus: Optional[EventBus] = None,
         wal: "WAL | NopWAL | None" = None,
         evidence_pool=None,
+        replay_mode: bool = False,
     ) -> None:
         super().__init__(name="consensus", logger=get_logger("consensus"))
         self.cfg = cfg
@@ -114,7 +115,10 @@ class ConsensusState(Service):
         self.peer_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
         self.internal_msg_queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
         self.ticker = TimeoutTicker()
-        self._replay_mode = False
+        # replay_mode=True builds a playback-only instance (replay
+        # console): signing errors are silenced and the caller feeds
+        # recorded inputs via replay_one() instead of start()
+        self._replay_mode = replay_mode
         # height of the last EndHeight marker found in the WAL on boot
         self._done_first_block = asyncio.Event()
 
@@ -1203,19 +1207,28 @@ class ConsensusState(Service):
         self._replay_mode = True
         try:
             for msg in msgs:
-                if isinstance(msg, MsgInfo):
-                    await self._handle_msg(msg)
-                elif isinstance(msg, TimeoutInfo):
-                    await self._handle_timeout(msg)
-                elif isinstance(msg, EndHeightMessage):
-                    raise RuntimeError(
-                        f"unexpected EndHeight {msg.height} during replay "
-                        f"of height {height}"
-                    )
-                # EventDataRoundStateWAL markers are informational
+                await self.replay_one(msg)
         finally:
             self._replay_mode = False
         self.logger.info("replayed WAL messages", count=len(msgs), height=height)
+
+    async def replay_one(self, msg) -> None:
+        """Feed ONE recorded WAL input through the state machine — the
+        single place replay dispatch (and its invariants) lives; used
+        by crash catchup and the replay console. An EndHeight record is
+        a store/WAL inconsistency (crash between the EndHeight fsync
+        and the state save) and raises instead of silently merging
+        heights (reference: replay.go readReplayMessage)."""
+        if isinstance(msg, MsgInfo):
+            await self._handle_msg(msg)
+        elif isinstance(msg, TimeoutInfo):
+            await self._handle_timeout(msg)
+        elif isinstance(msg, EndHeightMessage):
+            raise RuntimeError(
+                f"unexpected EndHeight {msg.height} during replay at "
+                f"height {self.rs.height}"
+            )
+        # EventDataRoundStateWAL markers are informational
 
     # ------------------------------------------------------------------
     # events

@@ -193,6 +193,81 @@ def test_debug_bundle(tmp_path, capsys):
         assert summary["state"]["chain_id"] == "dbg-chain"
 
 
+def test_replay_console(tmp_path, monkeypatch, capsys):
+    """`replay --console` steps the current height's WAL records one
+    at a time with next/back/rs/n (reference: replay_file.go console,
+    :54,188-193)."""
+    import asyncio as aio
+
+    home = str(tmp_path / "rc")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "rc-chain") == 0
+    from tendermint_tpu.config import load_config, write_config
+    from tendermint_tpu.node import make_node
+
+    cfg_path = os.path.join(home, "config", "config.toml")
+    cfg = load_config(cfg_path)
+    cfg.consensus.timeout_commit = 0.2
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    write_config(cfg, cfg_path)
+
+    async def produce():
+        cfg2 = load_config(cfg_path)
+        cfg2.base.home = home
+        node = make_node(cfg2)
+        await node.start()
+        try:
+            await node.consensus.wait_for_height(3, timeout=60.0)
+        finally:
+            await node.stop()
+
+    aio.run(produce())
+
+    script = iter(
+        ["n", "next 3", "rs", "rs locked_round", "back 1", "n", "quit"]
+    )
+    monkeypatch.setattr(
+        "builtins.input", lambda prompt="": next(script)
+    )
+    assert run_cli("--home", home, "replay", "--console") == 0
+    out = capsys.readouterr().out
+    assert "console:" in out
+    assert "WAL records after EndHeight" in out
+    # rs short prints height/round/step
+    import re
+
+    assert re.search(r"^\d+/\d+/\d+$", out, re.M), out
+    assert "rewound to" in out
+
+
+def test_debug_kill(tmp_path):
+    """`debug --kill PID` collects the bundle then SIGABRTs the target
+    (reference: cmd/tendermint/commands/debug/kill.go)."""
+    import signal as sig
+    import subprocess as sp
+    import tarfile
+
+    home = str(tmp_path / "dk")
+    assert run_cli("--home", home, "init", "validator",
+                   "--chain-id", "dk-chain") == 0
+    victim = sp.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"]
+    )
+    try:
+        out = str(tmp_path / "kill_bundle.tar.gz")
+        assert run_cli(
+            "--home", home, "debug", "-o", out, "--kill", str(victim.pid)
+        ) == 0
+        victim.wait(timeout=10)
+        assert victim.returncode == -sig.SIGABRT
+        with tarfile.open(out) as tar:
+            assert "config.toml" in tar.getnames()
+    finally:
+        if victim.poll() is None:
+            victim.terminate()
+            victim.wait()
+
+
 def test_debug_bundle_device_profile(tmp_path):
     """`debug --device-profile` packs an XLA profiler trace of a
     verify batch into the bundle (SURVEY §5 device-trace analog of the
