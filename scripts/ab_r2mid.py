@@ -1,0 +1,75 @@
+"""A/B the r2-mid tree (commit 2d191db, the 67,324 sigs/s best-ever)
+against the current tree IN THE SAME DEVICE SESSION — the regression
+attribution VERDICT r3 asked for (PERF.md "The 67k -> 45k regression":
+~18% was unattributed because the r2-mid number came from a different
+session with a 74 ms-RTT tunnel).
+
+Run AFTER the current-tree probes (scripts/probe_r3.py) have finished
+and their process has exited — two device clients must never overlap.
+Imports the r2-mid tree from the .ab_r2mid git worktree and times its
+XLA verifier with the same harness/batch shapes as probe_r3's
+xla_tput3 stage. Results land in AB_R2MID.json.
+
+SIGTERM-safe: uses device_session's handlers; never killed externally
+(device-claim discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(SCRIPTS)
+AB_DIR = os.path.join(REPO, ".ab_r2mid")
+OUT = os.path.join(REPO, "AB_R2MID.json")
+
+sys.path.insert(0, SCRIPTS)
+sys.path.insert(0, REPO)
+
+from device_session import _batch, _throughput, install_handlers  # noqa: E402
+
+
+def main() -> None:
+    install_handlers()
+    if not os.path.isdir(AB_DIR):
+        raise SystemExit(f"worktree missing: {AB_DIR}")
+
+    import jax
+
+    cache = os.path.join(REPO, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    out = {"r2mid_commit": "2d191db", "started_unix": time.time()}
+
+    def save() -> None:
+        tmp = OUT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=1)
+        os.replace(tmp, OUT)
+
+    # make absolutely sure the worktree's package wins the import
+    for mod in [m for m in sys.modules if m.startswith("tendermint_tpu")]:
+        del sys.modules[mod]
+    sys.path.insert(0, AB_DIR)
+    import tendermint_tpu
+
+    assert tendermint_tpu.__file__.startswith(AB_DIR), (
+        tendermint_tpu.__file__
+    )
+    from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+    pks, msgs, sigs = _batch(8192)
+    t0 = time.perf_counter()
+    rate = _throughput(Ed25519Verifier(bucket_sizes=[8192]), pks, msgs, sigs)
+    out["r2mid_xla_tput_8192_sigs_per_s"] = round(rate, 1)
+    out["seconds"] = round(time.perf_counter() - t0, 1)
+    save()
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
