@@ -118,25 +118,38 @@ class LockHarness:
 
     # -- stub actions ---------------------------------------------------
 
+    async def make_vote(
+        self, priv, vtype: int, round_: int, block_id: BlockID
+    ) -> Vote:
+        """One signed stub vote (reusable: redelivering the SAME object
+        models gossip redelivery byte-for-byte)."""
+        addr = priv.pub_key().address()
+        idx, _ = self.cs.rs.validators.get_by_address(addr)
+        vote = Vote(
+            type=vtype,
+            height=self.cs.rs.height,
+            round=round_,
+            block_id=block_id,
+            timestamp_ns=time.time_ns(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        await MockPV(priv).sign_vote(CHAIN, vote)
+        return vote
+
+    def send_vote(self, vote: Vote) -> None:
+        self.cs.send_peer_msg(
+            VoteMessage(vote=vote),
+            f"stub-{vote.validator_address.hex()[:8]}",
+        )
+
     async def stub_votes(
         self, vtype: int, round_: int, block_id: BlockID, stubs=None
     ) -> None:
         """Sign and inject votes from the given stubs (default: all)."""
         for priv in stubs if stubs is not None else self.stubs:
-            addr = priv.pub_key().address()
-            idx, _ = self.cs.rs.validators.get_by_address(addr)
-            vote = Vote(
-                type=vtype,
-                height=self.cs.rs.height,
-                round=round_,
-                block_id=block_id,
-                timestamp_ns=time.time_ns(),
-                validator_address=addr,
-                validator_index=idx,
-            )
-            await MockPV(priv).sign_vote(CHAIN, vote)
-            self.cs.send_peer_msg(
-                VoteMessage(vote=vote), f"stub-{addr.hex()[:8]}"
+            self.send_vote(
+                await self.make_vote(priv, vtype, round_, block_id)
             )
 
     def make_stub_block(self, proposer_priv):
