@@ -134,6 +134,28 @@ def _native_batch_fn():
     return None if lib is None else lib.tm_ed25519_batch_verify
 
 
+def _rlc_scalars(ss, ks):
+    """Marshal the random-linear-combination weights for one batch
+    equation call (shared by the ed25519 and sr25519 native paths):
+    128-bit random z_i; returns (zb, a_sc, z_sc) as the packed
+    little-endian scalars the C kernel expects — zb = sum z_i*s_i
+    mod L for the B term, a_i = z_i*k_i mod L for the -A_i terms,
+    z_i for the -R_i terms."""
+    import os as _os
+
+    n = len(ss)
+    rand = _os.urandom(16 * n)
+    zb = 0
+    a_sc = bytearray()
+    z_sc = bytearray()
+    for i in range(n):
+        z = int.from_bytes(rand[16 * i:16 * i + 16], "little")
+        zb = (zb + z * ss[i]) % ed25519_math.L
+        a_sc += ((z * ks[i]) % ed25519_math.L).to_bytes(32, "little")
+        z_sc += z.to_bytes(32, "little")
+    return zb.to_bytes(32, "little"), bytes(a_sc), bytes(z_sc)
+
+
 def _native_batch_all_valid(items) -> Optional[bool]:
     """One shot of the cofactored random-linear-combination batch
     equation in C (native/ed25519_batch.c — the CPU analog of the
@@ -146,44 +168,31 @@ def _native_batch_all_valid(items) -> Optional[bool]:
     weights, their products) stays in Python big-ints; the C side does
     only ZIP-215 point decoding and the multi-scalar multiplication."""
     import hashlib
-    import os as _os
 
     fn = _native_batch_fn()
     if fn is None:
         return None
-    n = len(items)
-    rand = _os.urandom(16 * n)
-    zb = 0
+    ss = []
+    ks = []
     pk_b = bytearray()
     r_b = bytearray()
-    a_sc = bytearray()
-    z_sc = bytearray()
-    for i, (pk, msg, sig) in enumerate(items):
+    for pk, msg, sig in items:
         s = int.from_bytes(sig[32:], "little")
         if s >= ed25519_math.L:
             return False  # non-canonical s: invalid under ZIP-215
         pkb = pk.bytes()
         r = sig[:32]
-        z = int.from_bytes(rand[16 * i:16 * i + 16], "little")
-        k = (
+        ss.append(s)
+        ks.append(
             int.from_bytes(
                 hashlib.sha512(r + pkb + msg).digest(), "little"
             )
             % ed25519_math.L
         )
-        zb = (zb + z * s) % ed25519_math.L
         pk_b += pkb
         r_b += r
-        a_sc += ((z * k) % ed25519_math.L).to_bytes(32, "little")
-        z_sc += z.to_bytes(32, "little")
-    rc = fn(
-        bytes(pk_b),
-        bytes(r_b),
-        zb.to_bytes(32, "little"),
-        bytes(a_sc),
-        bytes(z_sc),
-        n,
-    )
+    zb, a_sc, z_sc = _rlc_scalars(ss, ks)
+    rc = fn(bytes(pk_b), bytes(r_b), zb, a_sc, z_sc, len(items))
     if rc == 1:
         return True
     return False  # equation failed or an encoding didn't decode

@@ -266,12 +266,53 @@ class PrivKeySr25519(PrivKey):
         return KEY_TYPE
 
 
+# Below this size the native batch equation's fixed Pippenger cost
+# isn't worth it — but the bar is LOW here: the sequential fallback is
+# pure-Python ristretto at ~6 ms/sig, so even small batches win big.
+_NATIVE_BATCH_MIN = 4
+
+
+def _native_batch_all_valid(items) -> Optional[bool]:
+    """One shot of the schnorrkel batch equation in C
+    (native/ed25519_batch.c tm_sr25519_batch_verify — the analog of
+    schnorrkel's own RLC batch verification, which curve25519-voi wraps
+    for the reference's crypto/sr25519/batch.go). True = every
+    signature valid; False = at least one invalid or undecodable
+    (caller falls back per-signature for the bitmap); None = native
+    unavailable. Merlin challenges are batch-computed over the native
+    keccak (challenge_batch); scalar products stay in Python."""
+    from .. import native
+    from .ed25519 import _rlc_scalars
+
+    lib = native.ed25519_batch_lib()
+    if lib is None:
+        return None
+    parsed = []
+    for _pk, _msg, sig in items:
+        p = _parse_signature(sig)
+        if p is None:
+            return False  # malformed: invalid under schnorrkel rules
+        parsed.append(p)
+    pks = [pk.bytes() for pk, _m, _s in items]
+    msgs = [m for _pk, m, _s in items]
+    rs = [r for r, _s in parsed]
+    ks = challenge_batch(pks, msgs, rs)
+    zb, a_sc, z_sc = _rlc_scalars([s for _r, s in parsed], ks)
+    rc = lib.tm_sr25519_batch_verify(
+        b"".join(pks), b"".join(rs), zb, a_sc, z_sc, len(items)
+    )
+    return rc == 1
+
+
 class Sr25519BatchVerifier(BatchVerifier):
     """CPU batch verifier behind the crypto.batch seam
-    (reference: crypto/sr25519/batch.go). Sequential verification —
-    schnorrkel's randomized linear-combination batch is an
-    optimization, not a semantic change; the device path batches the
-    double-scalar multiplications instead."""
+    (reference: crypto/sr25519/batch.go, backed by curve25519-voi's
+    schnorrkel batch). Batches >= _NATIVE_BATCH_MIN go through the
+    native RLC batch equation (~36 us/sig vs ~6 ms/sig for the
+    pure-Python sequential path); on batch failure signatures are
+    re-checked one-by-one for the exact bitmap. The device path
+    (ops/sr25519_kernel.py) batches the double-scalar multiplications
+    on TPU instead."""
 
     def __init__(self) -> None:
         self._items: List[Tuple[PubKeySr25519, bytes, bytes]] = []
@@ -289,6 +330,11 @@ class Sr25519BatchVerifier(BatchVerifier):
         if not self._items:
             return False, []
         items, self._items = self._items, []
+        if len(items) >= _NATIVE_BATCH_MIN:
+            if _native_batch_all_valid(items) is True:
+                return True, [True] * len(items)
+            # invalid somewhere (or native unavailable): fall through
+            # to per-signature verification for the exact bitmap
         bitmap = [
             pk.verify_signature(msg, sig) for pk, msg, sig in items
         ]

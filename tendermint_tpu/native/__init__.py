@@ -132,14 +132,11 @@ def ed25519_batch_lib():
     if lib is None:
         return None
     if not getattr(lib, "_tm_configured", False):
-        lib.tm_ed25519_batch_verify.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_uint64,
-        ]
+        argtypes = [ctypes.c_char_p] * 5 + [ctypes.c_uint64]
+        lib.tm_ed25519_batch_verify.argtypes = argtypes
         lib.tm_ed25519_batch_verify.restype = ctypes.c_int
+        # same equation over ristretto255 decoding (sr25519/schnorrkel)
+        lib.tm_sr25519_batch_verify.argtypes = argtypes
+        lib.tm_sr25519_batch_verify.restype = ctypes.c_int
         lib._tm_configured = True
     return lib

@@ -307,6 +307,89 @@ static int ge_frombytes_zip215(ge *r, const uint8_t *s) {
     return 1;
 }
 
+/* sqrt_ratio_m1 (RFC 9496 §4.2, mirrors crypto/ristretto.py
+ * _sqrt_ratio_m1): r = |sqrt(u/v)| when it exists, else |sqrt(i*u/v)|;
+ * returns was_square. */
+static int fe_sqrt_ratio_m1(fe r, const fe u, const fe v) {
+    fe v3, v7, t, check, nu, nui;
+    fe_sq(v3, v);
+    fe_mul(v3, v3, v);           /* v^3 */
+    fe_sq(v7, v3);
+    fe_mul(v7, v7, v);           /* v^7 */
+    fe_mul(t, u, v7);
+    fe_pow2523(t, t);
+    fe_mul(t, t, v3);
+    fe_mul(t, t, u);             /* u*v^3*(u*v^7)^((p-5)/8) */
+    fe_sq(check, t);
+    fe_mul(check, check, v);     /* v*r^2 */
+    int correct = fe_eq(check, u);
+    fe_neg(nu, u);
+    fe_carry(nu);
+    int flipped = fe_eq(check, nu);
+    fe_mul(nui, nu, FE_SQRTM1);
+    int flipped_i = fe_eq(check, nui);
+    if (flipped || flipped_i) fe_mul(t, t, FE_SQRTM1);
+    uint8_t b[32];
+    fe_tobytes(b, t);
+    if (b[0] & 1) {              /* |r| */
+        fe_neg(t, t);
+        fe_carry(t);
+    }
+    fe_copy(r, t);
+    return correct || flipped;
+}
+
+/* ristretto255 decode (RFC 9496 §4.3.1, mirrors crypto/ristretto.py
+ * decode): canonical nonneg s -> extended point representative in 2E.
+ * Returns 1 on success. */
+static int ge_frombytes_ristretto(ge *r, const uint8_t *bytes) {
+    fe s;
+    uint8_t canon[32];
+    fe_frombytes(s, bytes);
+    fe_tobytes(canon, s);
+    /* canonical: no high bit, value < p (re-encode matches), even */
+    if ((bytes[31] & 0x80) || memcmp(canon, bytes, 32) != 0) return 0;
+    if (bytes[0] & 1) return 0;
+    fe one, ss, u1, u2, u2s, du1, v, vu, invsq, dx, dy, x, y, tt, s2;
+    fe_one(one);
+    fe_sq(ss, s);
+    fe_sub(u1, one, ss);
+    fe_carry(u1);                /* 1 - s^2 */
+    fe_add(u2, one, ss);
+    fe_carry(u2);                /* 1 + s^2 */
+    fe_sq(u2s, u2);
+    fe_sq(du1, u1);
+    fe_mul(du1, du1, FE_D);      /* D*u1^2 */
+    fe_neg(v, du1);
+    fe_carry(v);
+    fe_sub(v, v, u2s);
+    fe_carry(v);                 /* -D*u1^2 - u2^2 */
+    fe_mul(vu, v, u2s);
+    int was_square = fe_sqrt_ratio_m1(invsq, one, vu);
+    fe_mul(dx, invsq, u2);
+    fe_mul(dy, invsq, dx);
+    fe_mul(dy, dy, v);
+    fe_add(s2, s, s);
+    fe_carry(s2);
+    fe_mul(x, s2, dx);
+    uint8_t xb[32];
+    fe_tobytes(xb, x);
+    if (xb[0] & 1) {             /* |x| */
+        fe_neg(x, x);
+        fe_carry(x);
+    }
+    fe_mul(y, u1, dy);
+    fe_mul(tt, x, y);
+    uint8_t tb[32];
+    fe_tobytes(tb, tt);
+    if (!was_square || (tb[0] & 1) || fe_iszero(y)) return 0;
+    fe_copy(r->X, x);
+    fe_copy(r->Y, y);
+    fe_one(r->Z);
+    fe_copy(r->T, tt);
+    return 1;
+}
+
 /* Pippenger MSM with 8-bit windows: result = sum scalars[i] * pts[i].
  * Scalars are 32-byte little-endian (< L < 2^253). */
 static void ge_msm(ge *result, const uint8_t *scalars, const ge *pts,
@@ -332,10 +415,13 @@ static void ge_msm(ge *result, const uint8_t *scalars, const ge *pts,
     }
 }
 
-/* See file header for the contract. */
-int tm_ed25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
-                            const uint8_t *zb, const uint8_t *a_scalars,
-                            const uint8_t *z_scalars, uint64_t n) {
+/* Shared driver: decode all A_i/R_i with `decode`, then check
+ * [8](zb*B + sum a_i*(-A_i) + sum z_i*(-R_i)) == identity. */
+static int batch_verify_common(const uint8_t *pk_bytes,
+                               const uint8_t *r_bytes, const uint8_t *zb,
+                               const uint8_t *a_scalars,
+                               const uint8_t *z_scalars, uint64_t n,
+                               int (*decode)(ge *, const uint8_t *)) {
     size_t nterms = 2 * (size_t)n + 1;
     ge *pts = malloc(nterms * sizeof(ge));
     uint8_t *scalars = malloc(nterms * 32);
@@ -355,9 +441,9 @@ int tm_ed25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
 
     for (uint64_t i = 0; i < n; i++) {
         ge t;
-        if (!ge_frombytes_zip215(&t, pk_bytes + 32 * i)) goto done;
+        if (!decode(&t, pk_bytes + 32 * i)) goto done;
         ge_neg(&pts[1 + i], &t);
-        if (!ge_frombytes_zip215(&t, r_bytes + 32 * i)) goto done;
+        if (!decode(&t, r_bytes + 32 * i)) goto done;
         ge_neg(&pts[1 + n + i], &t);
         memcpy(scalars + 32 * (1 + i), a_scalars + 32 * i, 32);
         memcpy(scalars + 32 * (1 + n + i), z_scalars + 32 * i, 32);
@@ -378,4 +464,28 @@ done:
     free(pts);
     free(scalars);
     return rc;
+}
+
+/* See file header for the contract. */
+int tm_ed25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
+                            const uint8_t *zb, const uint8_t *a_scalars,
+                            const uint8_t *z_scalars, uint64_t n) {
+    return batch_verify_common(pk_bytes, r_bytes, zb, a_scalars, z_scalars,
+                               n, ge_frombytes_zip215);
+}
+
+/* sr25519: same batch equation over ristretto255 representatives
+ * (schnorrkel verify is s*B - k*A == R as ristretto POINTS, i.e. equal
+ * cosets mod the 4-torsion). Soundness of the cofactored check: all
+ * decoded representatives lie in 2E, and 2E ∩ E[8] is exactly the
+ * 4-torsion set ristretto quotients by — so for decoded inputs,
+ * [8]*(sum) == identity  <=>  every per-signature coset equation
+ * holds (w.h.p. over the random z_i), the same argument schnorrkel's
+ * own batch verification uses. Challenges k_i (merlin transcripts)
+ * and all scalar products arrive precomputed, like the ed25519 entry. */
+int tm_sr25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
+                            const uint8_t *zb, const uint8_t *a_scalars,
+                            const uint8_t *z_scalars, uint64_t n) {
+    return batch_verify_common(pk_bytes, r_bytes, zb, a_scalars, z_scalars,
+                               n, ge_frombytes_ristretto);
 }

@@ -13,7 +13,6 @@ add) shows up as one seed committing a different block or deadlocking.
 
 import asyncio
 import random
-import time
 
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE

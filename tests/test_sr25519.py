@@ -224,6 +224,85 @@ def test_single_verify_device_route(monkeypatch):
     assert T.stats()["sigs"] == sigs_before + 2
 
 
+def test_native_sr_batch_equation_paths():
+    """CPU sr25519 batches ride the native schnorrkel batch equation
+    (reference: crypto/sr25519/batch.go via curve25519-voi): all-valid
+    batches return all-True in one call; any invalid signature falls
+    back per-signature for the exact bitmap."""
+    from tendermint_tpu import native
+    from tendermint_tpu.crypto import sr25519 as S
+
+    if native.ed25519_batch_lib() is None:
+        pytest.skip("no native toolchain")
+    privs = [
+        S.PrivKeySr25519.from_seed(bytes([i + 61]) * 32) for i in range(6)
+    ]
+    n = max(S._NATIVE_BATCH_MIN, 24)
+    bv = S.Sr25519BatchVerifier()
+    for i in range(n):
+        p = privs[i % 6]
+        m = b"srn-%d" % i
+        bv.add(p.pub_key(), m, p.sign(m))
+    ok, bits = bv.verify()
+    assert ok and bits == [True] * n
+
+    # per-index attribution on failure
+    bv = S.Sr25519BatchVerifier()
+    for i in range(n):
+        p = privs[i % 6]
+        m = b"srn2-%d" % i
+        sig = p.sign(m)
+        if i == 7:
+            m = b"tampered"
+        bv.add(p.pub_key(), m, sig)
+    ok, bits = bv.verify()
+    assert not ok
+    assert [i for i, b in enumerate(bits) if not b] == [7]
+
+
+def test_native_sr_batch_differential_edges():
+    """Native batch agrees with the pure-Python schnorrkel path on edge
+    signatures: missing marker bit, non-canonical s, undecodable R
+    encoding, wrong message binding."""
+    from tendermint_tpu import native
+    from tendermint_tpu.crypto import ristretto as rst
+    from tendermint_tpu.crypto import sr25519 as S
+
+    if native.ed25519_batch_lib() is None:
+        pytest.skip("no native toolchain")
+    priv = S.PrivKeySr25519.from_seed(b"\x51" * 32)
+    pub = priv.pub_key()
+    n = max(S._NATIVE_BATCH_MIN, 12)
+    items = []
+    expected = []
+    for i in range(n):
+        m = b"edge-%d" % i
+        sig = priv.sign(m)
+        if i % 4 == 1:  # strip the schnorrkel v1 marker
+            sb = bytearray(sig)
+            sb[63] &= 0x7F
+            sig = bytes(sb)
+        elif i % 4 == 2:  # non-canonical s (>= L, marker kept)
+            s = int.from_bytes(
+                sig[32:63] + bytes([sig[63] & 0x7F]), "little"
+            )
+            s += rst.L
+            if s < 2**255:
+                nb = bytearray(s.to_bytes(32, "little"))
+                nb[31] |= 0x80
+                sig = sig[:32] + bytes(nb)
+        elif i % 4 == 3:  # undecodable R (odd s-field = negative)
+            sig = b"\x01" + sig[1:]
+        items.append((pub, m, sig))
+        expected.append(pub.verify_signature(m, sig))
+    bv = S.Sr25519BatchVerifier()
+    for pk, m, sig in items:
+        bv.add(pk, m, sig)
+    ok, bits = bv.verify()
+    assert bits == expected
+    assert ok == all(expected)
+
+
 def test_single_route_gated_on_warm(monkeypatch):
     """Until install()'s warm thread has compiled the smallest sr25519
     bucket, single verifies stay on the CPU path — a per-vote verify
