@@ -436,6 +436,36 @@ def bench_commit_breakdown(n_vals: int = 10_000, reps: int = 5):
     }
 
 
+def bench_merkle_proof_batch(n: int = 10_000, use_device: bool = True):
+    """The merkle half of BASELINE config 5 (types/validation.go:25 +
+    crypto/merkle/proof.go:52): verify inclusion proofs for all n
+    leaves of one tree as a batch. Returns proofs/s."""
+    from tendermint_tpu.crypto import merkle
+    from tendermint_tpu.ops import merkle_kernel
+
+    if use_device:
+        merkle_kernel.install(min_leaves=512)
+    try:
+        leaves = [b"leaf-%08d" % i for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(leaves)
+
+        def run_once():
+            bits = merkle.verify_proofs_batch(proofs, root, leaves)
+            assert all(bits)
+
+        run_once()  # warm/compile
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run_once()
+        return n / ((time.perf_counter() - t0) / reps)
+    finally:
+        if use_device:
+            # the install is module-global; later benches (mempool,
+            # localnet) must not inherit silent device offload
+            merkle_kernel.uninstall()
+
+
 def bench_mempool_checktx(n_txs: int = 2000):
     """Mempool CheckTx ingest rate against the kvstore app over the
     local ABCI client (reference harness:
@@ -642,6 +672,7 @@ def main() -> None:
     p50_mixed = None
     mixed_err = None
     p50_mixed_10k = None
+    mixed_10k_err = None
     breakdown = None
     curve_sr = None
     if fallback:
@@ -657,6 +688,19 @@ def main() -> None:
             )
         except Exception as e:
             mixed_err = repr(e)
+        try:
+            p50_mixed_10k, _ = bench_commit_latency(
+                10_000, reps=3, light=False, mixed=True, use_device=False
+            )
+        except Exception as e:
+            mixed_10k_err = repr(e)
+        try:
+            curve_sr = bench_batch_curve(
+                sizes=(1, 8, 64, 1024), key_type="sr25519",
+                use_device=False,
+            )
+        except Exception as e:
+            curve_sr = {"error": repr(e)}
     else:
         p50_10k, p95_10k = bench_commit_latency(
             10_000, reps=10, light=False
@@ -672,11 +716,14 @@ def main() -> None:
             p50_mixed, _ = bench_commit_latency(
                 1_000, reps=5, light=False, mixed=True
             )
+        except Exception as e:
+            mixed_err = repr(e)
+        try:
             p50_mixed_10k, _ = bench_commit_latency(
                 10_000, reps=3, light=False, mixed=True
             )
         except Exception as e:
-            mixed_err = repr(e)
+            mixed_10k_err = repr(e)
         try:
             curve_sr = bench_batch_curve(
                 sizes=(1, 8, 64, 1024), key_type="sr25519"
@@ -701,6 +748,15 @@ def main() -> None:
         )
     except Exception as e:  # pragma: no cover
         curve = {"error": repr(e)}
+    try:
+        merkle_rate = round(
+            bench_merkle_proof_batch(
+                2_000 if fallback else 10_000, use_device=not fallback
+            ),
+            1,
+        )
+    except Exception as e:  # pragma: no cover
+        merkle_rate = repr(e)
     try:
         mempool_rate = round(
             bench_mempool_checktx(500 if fallback else 2000), 1
@@ -754,13 +810,14 @@ def main() -> None:
                     "verify_commit_10k_mixed_keys_p50_ms": (
                         round(p50_mixed_10k, 2)
                         if p50_mixed_10k is not None
-                        else mixed_err
+                        else (mixed_10k_err or mixed_err)
                     ),
                     "sr25519_batch_verify_us_per_sig_by_batch": curve_sr,
                     "light_sync_headers_per_s_150vals": (
                         round(light_rate, 2) if light_rate else light_err
                     ),
                     "batch_verify_us_per_sig_by_batch": curve,
+                    "merkle_proof_batch_per_s": merkle_rate,
                     "mempool_checktx_per_s": mempool_rate,
                     "localnet_block_interval": block_interval,
                 },
