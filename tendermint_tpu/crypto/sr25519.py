@@ -112,6 +112,36 @@ def challenge_batch(pks, msgs, rs) -> list:
     return out
 
 
+def _native_verify_one(
+    pk_bytes: bytes, msg: bytes, sig: bytes
+) -> Optional[bool]:
+    """One schnorrkel verify through the native kernel: an n=1 "batch"
+    with weight 1 checks [8](s*B - k*A - R) == identity, which for
+    decoded (2E) representatives is exactly ristretto coset equality
+    with encode(s*B - k*A) == R — the pure-Python check below. The
+    small-batch Straus path makes this ~0.3 ms vs ~6 ms pure Python.
+    None when the native kernel is unavailable (caller falls through)."""
+    from .. import native
+
+    lib = native.ed25519_batch_lib()
+    if lib is None:
+        return None
+    parsed = _parse_signature(sig)
+    if parsed is None:
+        return False
+    r_bytes, s = parsed
+    k = _challenge(_signing_transcript(msg), pk_bytes, r_bytes)
+    rc = lib.tm_sr25519_batch_verify(
+        pk_bytes,
+        r_bytes,
+        int(s).to_bytes(32, "little"),
+        int(k).to_bytes(32, "little"),
+        (1).to_bytes(32, "little"),
+        1,
+    )
+    return rc == 1
+
+
 def _scalar_divide_by_cofactor(b: bytes) -> int:
     """schnorrkel scalars.rs divide_scalar_bytes_by_cofactor: the
     clamped ed25519-style scalar is stored right-shifted by 3 bits."""
@@ -178,6 +208,9 @@ class PubKeySr25519(PubKey):
                     "sr25519 device verify failed; singles tripped to CPU",
                     err=repr(e),
                 )
+        native = _native_verify_one(self._bytes, msg, sig)
+        if native is not None:
+            return native
         parsed = _parse_signature(sig)
         if parsed is None:
             return False

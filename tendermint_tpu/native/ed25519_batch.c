@@ -167,19 +167,38 @@ static int fe_eq(const fe a, const fe b) {
     return fe_iszero(d);
 }
 
+static void fe_sqn(fe r, const fe a, int n) {
+    fe_sq(r, a);
+    for (int i = 1; i < n; i++) fe_sq(r, r);
+}
+
 /* a^(2^252 - 3): the exponent in the combined sqrt/division trick
- * ((p-5)/8). Binary: 250 ones, then "01". */
-static void fe_pow2523(fe r, const fe a) {
-    fe t;
-    fe_copy(t, a);
-    for (int i = 0; i < 249; i++) {
-        fe_sq(t, t);
-        fe_mul(t, t, a);
-    }
-    fe_sq(t, t);        /* the 0 bit */
-    fe_sq(t, t);
-    fe_mul(t, t, a);    /* the final 1 bit */
-    fe_copy(r, t);
+ * ((p-5)/8), via the standard 2^k-1 addition chain (251 squarings +
+ * ~12 multiplies — decompression cost is dominated by this power). */
+static void fe_pow2523(fe r, const fe z) {
+    fe t0, t1, t2;
+    fe_sq(t0, z);                  /* z^2 */
+    fe_sqn(t1, t0, 2);
+    fe_mul(t1, t1, z);             /* z^9 */
+    fe_mul(t0, t1, t0);            /* z^11 */
+    fe_sq(t0, t0);                 /* z^22 */
+    fe_mul(t0, t0, t1);            /* z^31 = z^(2^5-1) */
+    fe_sqn(t1, t0, 5);
+    fe_mul(t0, t1, t0);            /* z^(2^10-1) */
+    fe_sqn(t1, t0, 10);
+    fe_mul(t1, t1, t0);            /* z^(2^20-1) */
+    fe_sqn(t2, t1, 20);
+    fe_mul(t1, t2, t1);            /* z^(2^40-1) */
+    fe_sqn(t1, t1, 10);
+    fe_mul(t0, t1, t0);            /* z^(2^50-1) */
+    fe_sqn(t1, t0, 50);
+    fe_mul(t1, t1, t0);            /* z^(2^100-1) */
+    fe_sqn(t2, t1, 100);
+    fe_mul(t1, t2, t1);            /* z^(2^200-1) */
+    fe_sqn(t1, t1, 50);
+    fe_mul(t0, t1, t0);            /* z^(2^250-1) */
+    fe_sqn(t0, t0, 2);
+    fe_mul(r, t0, z);              /* z^(2^252-3) */
 }
 
 /* extended (twisted Edwards) coordinates, mirrors ed25519_math.Point */
@@ -390,10 +409,10 @@ static int ge_frombytes_ristretto(ge *r, const uint8_t *bytes) {
     return 1;
 }
 
-/* Pippenger MSM with 8-bit windows: result = sum scalars[i] * pts[i].
- * Scalars are 32-byte little-endian (< L < 2^253). */
-static void ge_msm(ge *result, const uint8_t *scalars, const ge *pts,
-                   size_t n) {
+/* Pippenger with 8-bit windows: per-term cost ~64 adds but a fixed
+ * ~16k-add bucket-aggregation cost per call — the large-batch MSM. */
+static void ge_msm_pippenger(ge *result, const uint8_t *scalars,
+                             const ge *pts, size_t n) {
     ge buckets[255]; /* ~40 KB of stack; single-threaded use */
     ge_identity(result);
     for (int w = 31; w >= 0; w--) {
@@ -413,6 +432,43 @@ static void ge_msm(ge *result, const uint8_t *scalars, const ge *pts,
         }
         ge_add(result, result, &acc);
     }
+}
+
+/* Straus with 4-bit windows and per-term tables: ~78 adds per term
+ * with only a ~250-doubling fixed cost — wins below ~1000 terms
+ * (commit-sized batches and single verifies). */
+static int ge_msm_straus(ge *result, const uint8_t *scalars,
+                         const ge *pts, size_t n) {
+    /* tables[i][d-1] = d * pts[i] for d in 1..15 */
+    ge *tables = malloc(n * 15 * sizeof(ge));
+    if (!tables) return 0;
+    for (size_t i = 0; i < n; i++) {
+        ge *t = tables + i * 15;
+        t[0] = pts[i];
+        for (int d = 1; d < 15; d++) ge_add(&t[d], &t[d - 1], &pts[i]);
+    }
+    ge_identity(result);
+    for (int w = 63; w >= 0; w--) {
+        if (w != 63)
+            for (int k = 0; k < 4; k++) ge_dbl(result, result);
+        int byte = w >> 1;
+        for (size_t i = 0; i < n; i++) {
+            int b = scalars[i * 32 + byte];
+            int d = (w & 1) ? (b >> 4) : (b & 0x0f);
+            if (d) ge_add(result, result, &tables[i * 15 + d - 1]);
+        }
+    }
+    free(tables);
+    return 1;
+}
+
+/* MSM dispatch: Straus for small term counts, Pippenger for large.
+ * Crossover: Straus ~78n+250 adds, Pippenger ~64n+16300 — Straus wins
+ * until n ~ 1150. Scalars are 32-byte little-endian (< L < 2^253). */
+static void ge_msm(ge *result, const uint8_t *scalars, const ge *pts,
+                   size_t n) {
+    if (n < 1024 && ge_msm_straus(result, scalars, pts, n)) return;
+    ge_msm_pippenger(result, scalars, pts, n);
 }
 
 /* Shared driver: decode all A_i/R_i with `decode`, then check
