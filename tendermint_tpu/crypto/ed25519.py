@@ -75,6 +75,14 @@ class PubKeyEd25519(PubKey):
             return True
         except (InvalidSignature, ValueError):
             # OpenSSL is stricter than ZIP-215; consult the oracle.
+            # The native kernel's n=1 cofactored check IS the ZIP-215
+            # equation ([8](sB-kA-R) == identity) — ~0.12 ms vs ~5 ms
+            # for the pure-Python oracle, which matters because this
+            # path is adversarially reachable (a flood of edge-case
+            # signatures would otherwise cost milliseconds each).
+            native = _native_verify_one_zip215(self._bytes, msg, sig)
+            if native is not None:
+                return native
             return ed25519_math.zip215_verify(self._bytes, msg, sig)
 
 
@@ -132,6 +140,40 @@ def _native_batch_fn():
 
     lib = native.ed25519_batch_lib()
     return None if lib is None else lib.tm_ed25519_batch_verify
+
+
+def _native_verify_one_zip215(
+    pk_bytes: bytes, msg: bytes, sig: bytes
+) -> Optional[bool]:
+    """One ZIP-215 verify through the native kernel: an n=1 "batch"
+    with weight 1 checks [8](s*B - k*A - R) == identity — exactly the
+    cofactored ZIP-215 equation (ed25519_math.zip215_verify), via the
+    small-batch Straus path. None when native is unavailable."""
+    fn = _native_batch_fn()
+    if fn is None:
+        return None
+    s = int.from_bytes(sig[32:], "little")
+    if s >= ed25519_math.L:
+        return False
+    r = sig[:32]
+    k = ed25519_math.sha512_mod_l(r, pk_bytes, msg)
+    rc = fn(
+        pk_bytes,
+        r,
+        int(s).to_bytes(32, "little"),
+        int(k).to_bytes(32, "little"),
+        (1).to_bytes(32, "little"),
+        1,
+    )
+    if rc == 1:
+        return True
+    if rc == 0:
+        return False
+    # rc == -1: undecodable encoding OR allocation failure — let the
+    # pure-Python oracle give the authoritative answer (it rejects
+    # undecodable encodings too, so results only differ on alloc
+    # failure, where falling back is the correct move)
+    return None
 
 
 def _rlc_scalars(ss, ks):

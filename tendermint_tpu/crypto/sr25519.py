@@ -139,7 +139,11 @@ def _native_verify_one(
         (1).to_bytes(32, "little"),
         1,
     )
-    return rc == 1
+    if rc == 1:
+        return True
+    if rc == 0:
+        return False
+    return None  # undecodable or alloc failure: pure path decides
 
 
 def _scalar_divide_by_cofactor(b: bytes) -> int:
