@@ -28,6 +28,16 @@
 #include <stdlib.h>
 #include <string.h>
 
+/* IFMA path needs target-attribute + AVX-512 IFMA intrinsic support
+ * (GCC >= 7, or clang); older toolchains must still compile the
+ * scalar kernel rather than lose the whole library */
+#if defined(__x86_64__) && \
+    ((defined(__GNUC__) && !defined(__clang__) && __GNUC__ >= 7) || \
+     (defined(__clang__) && __clang_major__ >= 7))
+#define TM_HAVE_IFMA_BUILD 1
+#include <immintrin.h>
+#endif
+
 typedef uint64_t fe[5];
 typedef unsigned __int128 u128;
 
@@ -201,6 +211,217 @@ static void fe_pow2523(fe r, const fe z) {
     fe_mul(r, t0, z);              /* z^(2^252-3) */
 }
 
+/* ------------------------------------------------------------------
+ * 8-way field exponentiation with AVX-512 IFMA (radix-2^52, 5 limbs,
+ * one zmm register per limb holding 8 field elements). Only the
+ * pow2523 chain — the dominant cost of point decompression — runs
+ * vectorized; everything else stays scalar radix-2^51. Functions are
+ * target-attributed so the binary stays runnable on non-AVX-512
+ * hosts (runtime-gated via __builtin_cpu_supports).
+ * ------------------------------------------------------------------ */
+
+#define MASK52 0xfffffffffffffULL
+
+/* canonical bytes -> radix-2^52 limbs */
+static void fe52_frombytes(uint64_t l[5], const uint8_t *s) {
+    l[0] = load64_le(s) & MASK52;
+    l[1] = (load64_le(s + 6) >> 4) & MASK52;
+    l[2] = load64_le(s + 13) & MASK52;
+    l[3] = (load64_le(s + 19) >> 4) & MASK52;
+    uint64_t top = 0;
+    memcpy(&top, s + 26, 6); /* bits 208..255; input < p so < 2^47 */
+    l[4] = top;
+}
+
+/* radix-2^52 limbs (each < 2^52) -> canonical bytes */
+static void fe52_tobytes(uint8_t *s, const uint64_t l_in[5]) {
+    uint64_t l[5];
+    memcpy(l, l_in, sizeof(l));
+    uint64_t c;
+    c = l[0] >> 52; l[0] &= MASK52; l[1] += c;
+    c = l[1] >> 52; l[1] &= MASK52; l[2] += c;
+    c = l[2] >> 52; l[2] &= MASK52; l[3] += c;
+    c = l[3] >> 52; l[3] &= MASK52; l[4] += c;
+    /* top limb weight 2^208; bit 47 of it is bit 255 overall */
+    c = l[4] >> 47; l[4] &= (1ULL << 47) - 1; l[0] += 19 * c;
+    c = l[0] >> 52; l[0] &= MASK52; l[1] += c;
+    /* conditional subtract p via the (t + 19) carry trick */
+    uint64_t q = (l[0] + 19) >> 52;
+    q = (l[1] + q) >> 52;
+    q = (l[2] + q) >> 52;
+    q = (l[3] + q) >> 52;
+    q = (l[4] + q) >> 47;
+    l[0] += 19 * q;
+    c = l[0] >> 52; l[0] &= MASK52; l[1] += c;
+    c = l[1] >> 52; l[1] &= MASK52; l[2] += c;
+    c = l[2] >> 52; l[2] &= MASK52; l[3] += c;
+    c = l[3] >> 52; l[3] &= MASK52; l[4] += c;
+    l[4] &= (1ULL << 47) - 1;
+    uint64_t w0 = l[0] | (l[1] << 52);
+    uint64_t w1 = (l[1] >> 12) | (l[2] << 40);
+    uint64_t w2 = (l[2] >> 24) | (l[3] << 28);
+    uint64_t w3 = (l[3] >> 36) | (l[4] << 16);
+    memcpy(s, &w0, 8);
+    memcpy(s + 8, &w1, 8);
+    memcpy(s + 16, &w2, 8);
+    memcpy(s + 24, &w3, 8);
+}
+
+#ifdef TM_HAVE_IFMA_BUILD
+
+typedef struct { __m512i l[5]; } fe8;
+
+#define TM_IFMA_TARGET \
+    __attribute__((target("avx512f,avx512ifma,avx512dq,avx512vl")))
+
+/* r = a * b mod p over 8 lanes. Operand limbs must be < 2^52; output
+ * limbs are masked < 2^52. Schoolbook into 10 accumulators via
+ * vpmadd52{lo,hi}, then 2^260 = 608 (mod p) folding. */
+TM_IFMA_TARGET static void fe8_mul(fe8 *r, const fe8 *a, const fe8 *b) {
+    __m512i z = _mm512_setzero_si512();
+    __m512i t[10];
+    for (int k = 0; k < 10; k++) t[k] = z;
+    for (int i = 0; i < 5; i++) {
+        for (int j = 0; j < 5; j++) {
+            t[i + j] = _mm512_madd52lo_epu64(t[i + j], a->l[i], b->l[j]);
+            t[i + j + 1] =
+                _mm512_madd52hi_epu64(t[i + j + 1], a->l[i], b->l[j]);
+        }
+    }
+    const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+    const __m512i c608 = _mm512_set1_epi64(608); /* 2^260 mod p */
+    /* carry the high half so its limbs fit madd52 operands */
+    __m512i c;
+    for (int k = 5; k < 9; k++) {
+        c = _mm512_srli_epi64(t[k], 52);
+        t[k] = _mm512_and_si512(t[k], mask);
+        t[k + 1] = _mm512_add_epi64(t[k + 1], c);
+    }
+    c = _mm512_srli_epi64(t[9], 52); /* weight 2^520 = 608^2 mod p */
+    t[9] = _mm512_and_si512(t[9], mask);
+    t[0] = _mm512_add_epi64(
+        t[0], _mm512_mullo_epi64(c, _mm512_set1_epi64(608 * 608)));
+    /* fold t[5..9] into t[0..4]: value += 608 * t[5+j] * 2^(52j) */
+    for (int j = 0; j < 5; j++) {
+        t[j] = _mm512_madd52lo_epu64(t[j], t[5 + j], c608);
+        if (j < 4)
+            t[j + 1] = _mm512_madd52hi_epu64(t[j + 1], t[5 + j], c608);
+    }
+    /* hi of 608*t[9] has weight 2^260 again: one more 608 fold */
+    __m512i h = _mm512_madd52hi_epu64(z, t[9], c608);
+    t[0] = _mm512_madd52lo_epu64(t[0], h, c608);
+    /* Two carry passes, FOLD-FIRST ordering: reduce t4's overflow into
+     * t0 before t0's own carry is computed, then run the chain down to
+     * t4 (which only receives t3's small carry and is NOT re-folded in
+     * the same pass). This makes the bound provable: after pass 1 all
+     * limbs < 2^56-ish shrink to t0<2^52+2^14, t1..t3 masked, t4<2^48;
+     * after pass 2 every limb is strictly < 2^52 — the operand bound
+     * vpmadd52 requires (it reads only the low 52 bits). A mask-last
+     * ordering would leave t0 <= 2^52+18 reachable in theory. */
+    const __m512i mask47 = _mm512_set1_epi64((1LL << 47) - 1);
+    const __m512i c19 = _mm512_set1_epi64(19);
+    for (int pass = 0; pass < 2; pass++) {
+        c = _mm512_srli_epi64(t[4], 47); /* bit 255 boundary */
+        t[4] = _mm512_and_si512(t[4], mask47);
+        t[0] = _mm512_add_epi64(t[0], _mm512_mullo_epi64(c, c19));
+        for (int k = 0; k < 4; k++) {
+            c = _mm512_srli_epi64(t[k], 52);
+            t[k] = _mm512_and_si512(t[k], mask);
+            t[k + 1] = _mm512_add_epi64(t[k + 1], c);
+        }
+    }
+    for (int k = 0; k < 5; k++) r->l[k] = t[k];
+}
+
+TM_IFMA_TARGET static void fe8_sqn(fe8 *r, int n) {
+    for (int i = 0; i < n; i++) fe8_mul(r, r, r);
+}
+
+/* the fe_pow2523 addition chain, 8 lanes at once */
+TM_IFMA_TARGET static void fe8_pow2523(fe8 *r, const fe8 *zin) {
+    fe8 z = *zin, t0, t1, t2;
+    fe8_mul(&t0, &z, &z);               /* z^2 */
+    t1 = t0;
+    fe8_sqn(&t1, 2);
+    fe8_mul(&t1, &t1, &z);              /* z^9 */
+    fe8_mul(&t0, &t1, &t0);             /* z^11 */
+    fe8_mul(&t0, &t0, &t0);             /* z^22 */
+    fe8_mul(&t0, &t0, &t1);             /* z^31 */
+    t1 = t0;
+    fe8_sqn(&t1, 5);
+    fe8_mul(&t0, &t1, &t0);             /* z^(2^10-1) */
+    t1 = t0;
+    fe8_sqn(&t1, 10);
+    fe8_mul(&t1, &t1, &t0);             /* z^(2^20-1) */
+    t2 = t1;
+    fe8_sqn(&t2, 20);
+    fe8_mul(&t1, &t2, &t1);             /* z^(2^40-1) */
+    fe8_sqn(&t1, 10);
+    fe8_mul(&t0, &t1, &t0);             /* z^(2^50-1) */
+    t1 = t0;
+    fe8_sqn(&t1, 50);
+    fe8_mul(&t1, &t1, &t0);             /* z^(2^100-1) */
+    t2 = t1;
+    fe8_sqn(&t2, 100);
+    fe8_mul(&t1, &t2, &t1);             /* z^(2^200-1) */
+    fe8_sqn(&t1, 50);
+    fe8_mul(&t0, &t1, &t0);             /* z^(2^250-1) */
+    fe8_sqn(&t0, 2);
+    fe8_mul(r, &t0, &z);                /* z^(2^252-3) */
+}
+
+/* vals[0..7] (radix-51) -> pow2523 of each, in place */
+TM_IFMA_TARGET static void pow2523_x8(fe *vals) {
+    uint64_t limbs[8][5];
+    uint8_t buf[32];
+    for (int e = 0; e < 8; e++) {
+        fe_tobytes(buf, vals[e]);
+        fe52_frombytes(limbs[e], buf);
+    }
+    fe8 x;
+    for (int k = 0; k < 5; k++) {
+        uint64_t lane[8];
+        for (int e = 0; e < 8; e++) lane[e] = limbs[e][k];
+        x.l[k] = _mm512_loadu_si512((const void *)lane);
+    }
+    fe8 out;
+    fe8_pow2523(&out, &x);
+    for (int k = 0; k < 5; k++) {
+        uint64_t lane[8];
+        _mm512_storeu_si512((void *)lane, out.l[k]);
+        for (int e = 0; e < 8; e++) limbs[e][k] = lane[e];
+    }
+    for (int e = 0; e < 8; e++) {
+        fe52_tobytes(buf, limbs[e]);
+        fe_frombytes(vals[e], buf);
+    }
+}
+
+static int have_ifma(void) {
+    static int cached = -1;
+    if (cached < 0)
+        cached = __builtin_cpu_supports("avx512ifma") &&
+                 __builtin_cpu_supports("avx512f") &&
+                 __builtin_cpu_supports("avx512dq");
+    return cached;
+}
+
+#else /* !TM_HAVE_IFMA_BUILD */
+
+static int have_ifma(void) { return 0; }
+
+static void pow2523_x8(fe *vals) { (void)vals; }
+
+#endif
+
+/* pow2523 over an array: IFMA 8-way where possible, scalar remainder */
+static void pow2523_many(fe *vals, size_t n) {
+    size_t i = 0;
+    if (have_ifma())
+        for (; i + 8 <= n; i += 8) pow2523_x8(vals + i);
+    for (; i < n; i++) fe_pow2523(vals[i], vals[i]);
+}
+
 /* extended (twisted Edwards) coordinates, mirrors ed25519_math.Point */
 typedef struct { fe X, Y, Z, T; } ge;
 
@@ -277,28 +498,35 @@ static void ge_neg(ge *r, const ge *p) {
 
 /* ZIP-215 decompression, mirroring ed25519_math.decompress/_recover_x:
  * non-canonical y accepted (reduced mod p); x recovered via the
- * combined sqrt; "-0" (x == 0 with sign bit 1) rejected.
- * Returns 1 on success. */
-static int ge_frombytes_zip215(ge *r, const uint8_t *s) {
-    fe y, y2, u, v, v3, x, vx2, chk;
-    int sign = s[31] >> 7;
+ * combined sqrt; "-0" (x == 0 with sign bit 1) rejected. Split into
+ * prelude -> pow2523 -> finish so the dominant power can be computed
+ * for 8 points at once (the IFMA batch path); the scalar wrapper at
+ * the bottom preserves the one-shot form. */
+static void zip215_pre(const uint8_t *s, fe u, fe v, fe powin) {
+    fe y, y2, t;
     fe_frombytes(y, s);
     fe_sq(y2, y);
     fe_one(u);
     fe_sub(u, y2, u);
     fe_carry(u);                 /* u = y^2 - 1 */
     fe_mul(v, y2, FE_D);
-    fe_one(chk);
-    fe_add(v, v, chk);
+    fe_one(t);
+    fe_add(v, v, t);
     fe_carry(v);                 /* v = d*y^2 + 1 */
+    fe_sq(t, v);
+    fe_mul(t, t, v);             /* v^3 */
+    fe_sq(powin, t);
+    fe_mul(powin, powin, v);     /* v^7 */
+    fe_mul(powin, powin, u);     /* u*v^7 */
+}
 
+static int zip215_fin(ge *r, const uint8_t *s, const fe u, const fe v,
+                      const fe powed) {
+    fe v3, x, vx2, y;
+    int sign = s[31] >> 7;
     fe_sq(v3, v);
     fe_mul(v3, v3, v);           /* v^3 */
-    fe_sq(x, v3);
-    fe_mul(x, x, v);             /* v^7 */
-    fe_mul(x, x, u);             /* u*v^7 */
-    fe_pow2523(x, x);            /* (u*v^7)^((p-5)/8) */
-    fe_mul(x, x, v3);
+    fe_mul(x, powed, v3);
     fe_mul(x, x, u);             /* x = u*v^3*(u*v^7)^((p-5)/8) */
 
     fe_sq(vx2, x);
@@ -319,6 +547,7 @@ static int ge_frombytes_zip215(ge *r, const uint8_t *s) {
         fe_neg(x, x);
         fe_carry(x);
     }
+    fe_frombytes(y, s);
     fe_copy(r->X, x);
     fe_copy(r->Y, y);
     fe_one(r->Z);
@@ -326,50 +555,35 @@ static int ge_frombytes_zip215(ge *r, const uint8_t *s) {
     return 1;
 }
 
-/* sqrt_ratio_m1 (RFC 9496 §4.2, mirrors crypto/ristretto.py
- * _sqrt_ratio_m1): r = |sqrt(u/v)| when it exists, else |sqrt(i*u/v)|;
- * returns was_square. */
-static int fe_sqrt_ratio_m1(fe r, const fe u, const fe v) {
-    fe v3, v7, t, check, nu, nui;
-    fe_sq(v3, v);
-    fe_mul(v3, v3, v);           /* v^3 */
-    fe_sq(v7, v3);
-    fe_mul(v7, v7, v);           /* v^7 */
-    fe_mul(t, u, v7);
-    fe_pow2523(t, t);
-    fe_mul(t, t, v3);
-    fe_mul(t, t, u);             /* u*v^3*(u*v^7)^((p-5)/8) */
-    fe_sq(check, t);
-    fe_mul(check, check, v);     /* v*r^2 */
-    int correct = fe_eq(check, u);
-    fe_neg(nu, u);
-    fe_carry(nu);
-    int flipped = fe_eq(check, nu);
-    fe_mul(nui, nu, FE_SQRTM1);
-    int flipped_i = fe_eq(check, nui);
-    if (flipped || flipped_i) fe_mul(t, t, FE_SQRTM1);
-    uint8_t b[32];
-    fe_tobytes(b, t);
-    if (b[0] & 1) {              /* |r| */
-        fe_neg(t, t);
-        fe_carry(t);
-    }
-    fe_copy(r, t);
-    return correct || flipped;
+/* uniform prelude/finish adapters so the batch driver can run the
+ * pow2523 stage for the whole batch at once: slots a..d hold the
+ * per-curve intermediates (zip215: a=u, b=v; ristretto: a=u1, b=u2,
+ * c=v, d=vu) */
+typedef struct { fe a, b, c, d; } pre_t;
+
+static int zip215_pre2(const uint8_t *s, pre_t *p, fe powin) {
+    zip215_pre(s, p->a, p->b, powin);
+    return 1;
+}
+
+static int zip215_fin2(ge *r, const uint8_t *s, const pre_t *p,
+                       const fe powed) {
+    return zip215_fin(r, s, p->a, p->b, powed);
 }
 
 /* ristretto255 decode (RFC 9496 §4.3.1, mirrors crypto/ristretto.py
  * decode): canonical nonneg s -> extended point representative in 2E.
- * Returns 1 on success. */
-static int ge_frombytes_ristretto(ge *r, const uint8_t *bytes) {
-    fe s;
+ * Split into prelude -> pow2523 -> finish like the ZIP-215 decoder;
+ * the power input is vu^7 (sqrt_ratio with u=1: r = vu^3*(vu^7)^e). */
+static int rist_pre(const uint8_t *bytes, fe u1, fe u2, fe v, fe vu,
+                    fe powin) {
+    fe s, one, ss, u2s, du1;
     uint8_t canon[32];
     fe_frombytes(s, bytes);
     fe_tobytes(canon, s);
     /* canonical: no high bit, value < p (re-encode matches), even */
     if ((bytes[31] & 0x80) || memcmp(canon, bytes, 32) != 0) return 0;
     if (bytes[0] & 1) return 0;
-    fe one, ss, u1, u2, u2s, du1, v, vu, invsq, dx, dy, x, y, tt, s2;
     fe_one(one);
     fe_sq(ss, s);
     fe_sub(u1, one, ss);
@@ -384,7 +598,38 @@ static int ge_frombytes_ristretto(ge *r, const uint8_t *bytes) {
     fe_sub(v, v, u2s);
     fe_carry(v);                 /* -D*u1^2 - u2^2 */
     fe_mul(vu, v, u2s);
-    int was_square = fe_sqrt_ratio_m1(invsq, one, vu);
+    fe_sq(powin, vu);
+    fe_mul(powin, powin, vu);    /* vu^3 */
+    fe_sq(powin, powin);
+    fe_mul(powin, powin, vu);    /* vu^7 */
+    return 1;
+}
+
+static int rist_fin(ge *r, const uint8_t *bytes, const fe u1, const fe u2,
+                    const fe v, const fe vu, const fe powed) {
+    fe s, one, invsq, check, none, nonei, dx, dy, x, y, tt, s2;
+    fe_frombytes(s, bytes);
+    fe_one(one);
+    fe_sq(invsq, vu);
+    fe_mul(invsq, invsq, vu);    /* vu^3 */
+    fe_mul(invsq, invsq, powed); /* vu^3*(vu^7)^((p-5)/8) */
+    /* sqrt_ratio_m1(1, vu) checks (mirrors fe_sqrt_ratio_m1 u=1) */
+    fe_sq(check, invsq);
+    fe_mul(check, check, vu);    /* vu*r^2 */
+    int correct = fe_eq(check, one);
+    fe_neg(none, one);
+    fe_carry(none);
+    int flipped = fe_eq(check, none);
+    fe_mul(nonei, none, FE_SQRTM1);
+    int flipped_i = fe_eq(check, nonei);
+    if (flipped || flipped_i) fe_mul(invsq, invsq, FE_SQRTM1);
+    uint8_t ib[32];
+    fe_tobytes(ib, invsq);
+    if (ib[0] & 1) {             /* |r| */
+        fe_neg(invsq, invsq);
+        fe_carry(invsq);
+    }
+    int was_square = correct || flipped;
     fe_mul(dx, invsq, u2);
     fe_mul(dy, invsq, dx);
     fe_mul(dy, dy, v);
@@ -409,29 +654,57 @@ static int ge_frombytes_ristretto(ge *r, const uint8_t *bytes) {
     return 1;
 }
 
-/* Pippenger with 8-bit windows: per-term cost ~64 adds but a fixed
- * ~16k-add bucket-aggregation cost per call — the large-batch MSM. */
-static void ge_msm_pippenger(ge *result, const uint8_t *scalars,
-                             const ge *pts, size_t n) {
-    ge buckets[255]; /* ~40 KB of stack; single-threaded use */
+static int rist_pre2(const uint8_t *s, pre_t *p, fe powin) {
+    return rist_pre(s, p->a, p->b, p->c, p->d, powin);
+}
+
+static int rist_fin2(ge *r, const uint8_t *s, const pre_t *p,
+                     const fe powed) {
+    return rist_fin(r, s, p->a, p->b, p->c, p->d, powed);
+}
+
+/* little-endian bit-window extraction: `width` bits starting at
+ * `bitpos` (width <= 16, so at most 3 bytes are touched) */
+static inline unsigned get_window(const uint8_t *scalar, int bitpos,
+                                  int width) {
+    int byte = bitpos >> 3, shift = bitpos & 7;
+    unsigned v = scalar[byte];
+    if (byte + 1 < 32) v |= (unsigned)scalar[byte + 1] << 8;
+    if (shift + width > 16 && byte + 2 < 32)
+        v |= (unsigned)scalar[byte + 2] << 16;
+    return (v >> shift) & ((1u << width) - 1);
+}
+
+/* Pippenger with `width`-bit windows: per-term cost ~(256/width) adds
+ * plus a fixed 2*2^width-add bucket aggregation per window — the
+ * large-batch MSM. width 8 suits mid-size batches, width 11 the
+ * 8192-signature calls (bucket array must stay L2-resident). */
+static int ge_msm_pippenger(ge *result, const uint8_t *scalars,
+                            const ge *pts, size_t n, int width) {
+    int nbuckets = (1 << width) - 1;
+    int nwindows = (253 + width - 1) / width;
+    ge *buckets = malloc((size_t)nbuckets * sizeof(ge));
+    if (!buckets) return 0;
     ge_identity(result);
-    for (int w = 31; w >= 0; w--) {
-        if (w != 31)
-            for (int k = 0; k < 8; k++) ge_dbl(result, result);
-        for (int d = 0; d < 255; d++) ge_identity(&buckets[d]);
+    for (int w = nwindows - 1; w >= 0; w--) {
+        if (w != nwindows - 1)
+            for (int k = 0; k < width; k++) ge_dbl(result, result);
+        for (int d = 0; d < nbuckets; d++) ge_identity(&buckets[d]);
         for (size_t i = 0; i < n; i++) {
-            int d = scalars[i * 32 + w];
+            unsigned d = get_window(scalars + i * 32, w * width, width);
             if (d) ge_add(&buckets[d - 1], &buckets[d - 1], &pts[i]);
         }
         ge run, acc;
         ge_identity(&run);
         ge_identity(&acc);
-        for (int d = 254; d >= 0; d--) {
+        for (int d = nbuckets - 1; d >= 0; d--) {
             ge_add(&run, &run, &buckets[d]);
             ge_add(&acc, &acc, &run);
         }
         ge_add(result, result, &acc);
     }
+    free(buckets);
+    return 1;
 }
 
 /* Straus with 4-bit windows and per-term tables: ~78 adds per term
@@ -462,31 +735,39 @@ static int ge_msm_straus(ge *result, const uint8_t *scalars,
     return 1;
 }
 
-/* MSM dispatch: Straus for small term counts, Pippenger for large.
- * Crossover: Straus ~78n+250 adds, Pippenger ~64n+16300 — Straus wins
- * until n ~ 1150. Scalars are 32-byte little-endian (< L < 2^253). */
-static void ge_msm(ge *result, const uint8_t *scalars, const ge *pts,
-                   size_t n) {
-    if (n < 1024 && ge_msm_straus(result, scalars, pts, n)) return;
-    ge_msm_pippenger(result, scalars, pts, n);
+/* MSM dispatch by term count (total adds, ~offsets included):
+ *   Straus w4      ~78n + 250        — small batches and singles
+ *   Pippenger w8   ~64n + 16k        — mid batches
+ *   Pippenger w11  ~23n + 94k        — big batches (8192-sig calls);
+ *                  w13 models fewer adds but its 1.3 MB bucket array
+ *                  thrashes L2 and measured SLOWER — don't "fix" this
+ * Crossovers: Straus->w8 at ~1.1k terms, w8->w11 at ~3.4k terms.
+ * Scalars are 32-byte little-endian (< L < 2^253). */
+static int ge_msm(ge *result, const uint8_t *scalars, const ge *pts,
+                  size_t n) {
+    if (n < 1024 && ge_msm_straus(result, scalars, pts, n)) return 1;
+    if (n >= 3400 && ge_msm_pippenger(result, scalars, pts, n, 11))
+        return 1;
+    if (ge_msm_pippenger(result, scalars, pts, n, 8)) return 1;
+    return ge_msm_straus(result, scalars, pts, n);
 }
 
-/* Shared driver: decode all A_i/R_i with `decode`, then check
+/* Shared driver: decode all A_i/R_i (prelude pass, batched pow2523,
+ * finish pass), then check
  * [8](zb*B + sum a_i*(-A_i) + sum z_i*(-R_i)) == identity. */
-static int batch_verify_common(const uint8_t *pk_bytes,
-                               const uint8_t *r_bytes, const uint8_t *zb,
-                               const uint8_t *a_scalars,
-                               const uint8_t *z_scalars, uint64_t n,
-                               int (*decode)(ge *, const uint8_t *)) {
+static int batch_verify_common(
+    const uint8_t *pk_bytes, const uint8_t *r_bytes, const uint8_t *zb,
+    const uint8_t *a_scalars, const uint8_t *z_scalars, uint64_t n,
+    int (*pre)(const uint8_t *, pre_t *, fe),
+    int (*fin)(ge *, const uint8_t *, const pre_t *, const fe)) {
     size_t nterms = 2 * (size_t)n + 1;
+    size_t npts = 2 * (size_t)n;
     ge *pts = malloc(nterms * sizeof(ge));
     uint8_t *scalars = malloc(nterms * 32);
-    if (!pts || !scalars) {
-        free(pts);
-        free(scalars);
-        return -1;
-    }
+    pre_t *pres = malloc(npts * sizeof(pre_t));
+    fe *pows = malloc(npts * sizeof(fe));
     int rc = -1;
+    if (!pts || !scalars || !pres || !pows) goto done;
 
     /* term 0: zb * B */
     fe_copy(pts[0].X, FE_BX);
@@ -495,19 +776,32 @@ static int batch_verify_common(const uint8_t *pk_bytes,
     fe_copy(pts[0].T, FE_BT);
     memcpy(scalars, zb, 32);
 
+    /* pass 1: preludes (canonicality + everything before the power);
+     * slot i = A_i, slot n+i = R_i */
     for (uint64_t i = 0; i < n; i++) {
-        ge t;
-        if (!decode(&t, pk_bytes + 32 * i)) goto done;
-        ge_neg(&pts[1 + i], &t);
-        if (!decode(&t, r_bytes + 32 * i)) goto done;
-        ge_neg(&pts[1 + n + i], &t);
+        if (!pre(pk_bytes + 32 * i, &pres[i], pows[i])) goto done;
+        if (!pre(r_bytes + 32 * i, &pres[n + i], pows[n + i])) goto done;
         memcpy(scalars + 32 * (1 + i), a_scalars + 32 * i, 32);
         memcpy(scalars + 32 * (1 + n + i), z_scalars + 32 * i, 32);
     }
 
+    /* pass 2: the sqrt/division powers for the whole batch (8-way
+     * IFMA lanes when the host supports it) */
+    pow2523_many(pows, npts);
+
+    /* pass 3: finish decoding, negate into the term array */
+    for (uint64_t i = 0; i < n; i++) {
+        ge t;
+        if (!fin(&t, pk_bytes + 32 * i, &pres[i], pows[i])) goto done;
+        ge_neg(&pts[1 + i], &t);
+        if (!fin(&t, r_bytes + 32 * i, &pres[n + i], pows[n + i]))
+            goto done;
+        ge_neg(&pts[1 + n + i], &t);
+    }
+
     {
         ge sum;
-        ge_msm(&sum, scalars, pts, nterms);
+        if (!ge_msm(&sum, scalars, pts, nterms)) goto done; /* rc -1 */
         /* cofactored: [8] * sum must be the identity */
         ge_dbl(&sum, &sum);
         ge_dbl(&sum, &sum);
@@ -519,6 +813,8 @@ static int batch_verify_common(const uint8_t *pk_bytes,
 done:
     free(pts);
     free(scalars);
+    free(pres);
+    free(pows);
     return rc;
 }
 
@@ -527,7 +823,7 @@ int tm_ed25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
                             const uint8_t *zb, const uint8_t *a_scalars,
                             const uint8_t *z_scalars, uint64_t n) {
     return batch_verify_common(pk_bytes, r_bytes, zb, a_scalars, z_scalars,
-                               n, ge_frombytes_zip215);
+                               n, zip215_pre2, zip215_fin2);
 }
 
 /* sr25519: same batch equation over ristretto255 representatives
@@ -543,5 +839,5 @@ int tm_sr25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
                             const uint8_t *zb, const uint8_t *a_scalars,
                             const uint8_t *z_scalars, uint64_t n) {
     return batch_verify_common(pk_bytes, r_bytes, zb, a_scalars, z_scalars,
-                               n, ge_frombytes_ristretto);
+                               n, rist_pre2, rist_fin2);
 }
