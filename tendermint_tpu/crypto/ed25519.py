@@ -127,10 +127,11 @@ class PrivKeyEd25519(PrivKey):
         return KEY_TYPE
 
 
-# Below this size the native batch equation's fixed cost (Pippenger
-# bucket aggregation) outweighs the per-signature win over OpenSSL;
-# measured crossover is well under 32 on this generation of x86.
-_NATIVE_BATCH_MIN = 32
+# Measured crossover vs OpenSSL sequential: the native equation wins
+# from n=2 up (the Straus small-batch MSM has near-zero fixed cost) —
+# the same threshold the reference uses (types/validation.go:12
+# batchVerifyThreshold = 2).
+_NATIVE_BATCH_MIN = 2
 
 def _native_batch_fn():
     """ctypes handle to tm_ed25519_batch_verify, or None (no toolchain /

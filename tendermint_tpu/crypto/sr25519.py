@@ -303,10 +303,10 @@ class PrivKeySr25519(PrivKey):
         return KEY_TYPE
 
 
-# Below this size the native batch equation's fixed Pippenger cost
-# isn't worth it — but the bar is LOW here: the sequential fallback is
-# pure-Python ristretto at ~6 ms/sig, so even small batches win big.
-_NATIVE_BATCH_MIN = 4
+# The native equation wins from n=2 up (Straus small-batch MSM), and
+# the bar is LOW here anyway: the sequential fallback is pure-Python
+# ristretto at ~6 ms/sig.
+_NATIVE_BATCH_MIN = 2
 
 
 def _native_batch_all_valid(items) -> Optional[bool]:
