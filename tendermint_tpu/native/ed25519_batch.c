@@ -462,6 +462,44 @@ static void ge_add(ge *r, const ge *p, const ge *q) {
     fe_mul(r->T, e, h);
 }
 
+/* Cached-operand form of a Z=1 point (decoded/negated terms and the
+ * basepoint all have Z=1): q_cached = (Y-X, Y+X, 2d*T). Addition
+ * against it costs 7 muls instead of 9 — same hwcd-3 formula with the
+ * two operand-prep muls and the Z2 mul hoisted out (Dv = 2*Z1). */
+typedef struct { fe YmX, YpX, T2d; } ge_cached;
+
+static void ge_to_cached(ge_cached *c, const ge *p) {
+    fe_sub(c->YmX, p->Y, p->X);
+    fe_carry(c->YmX);
+    fe_add(c->YpX, p->Y, p->X);
+    fe_carry(c->YpX);
+    fe_mul(c->T2d, p->T, FE_2D);
+}
+
+static void ge_add_cached(ge *r, const ge *p, const ge_cached *q) {
+    fe a, b, c, d, e, f, g, h, t1;
+    fe_sub(t1, p->Y, p->X);
+    fe_carry(t1);
+    fe_mul(a, t1, q->YmX);
+    fe_add(t1, p->Y, p->X);
+    fe_mul(b, t1, q->YpX);
+    fe_mul(c, p->T, q->T2d);
+    fe_add(d, p->Z, p->Z);       /* Z2 == 1 */
+    fe_carry(d);
+    fe_sub(e, b, a);
+    fe_sub(f, d, c);
+    fe_add(g, d, c);
+    fe_add(h, b, a);
+    fe_carry(e);
+    fe_carry(f);
+    fe_carry(g);
+    fe_carry(h);
+    fe_mul(r->X, e, f);
+    fe_mul(r->Y, g, h);
+    fe_mul(r->Z, f, g);
+    fe_mul(r->T, e, h);
+}
+
 /* dbl-2008-hwcd, mirrors ed25519_math.point_double */
 static void ge_dbl(ge *r, const ge *p) {
     fe a, b, c, h, e, g, f, t;
@@ -684,7 +722,15 @@ static int ge_msm_pippenger(ge *result, const uint8_t *scalars,
     int nbuckets = (1 << width) - 1;
     int nwindows = (253 + width - 1) / width;
     ge *buckets = malloc((size_t)nbuckets * sizeof(ge));
-    if (!buckets) return 0;
+    /* terms are Z=1 (decoded points / the basepoint): precompute the
+     * cached form once so every bucket add costs 7 muls, not 9 */
+    ge_cached *cpts = malloc(n * sizeof(ge_cached));
+    if (!buckets || !cpts) {
+        free(buckets);
+        free(cpts);
+        return 0;
+    }
+    for (size_t i = 0; i < n; i++) ge_to_cached(&cpts[i], &pts[i]);
     ge_identity(result);
     for (int w = nwindows - 1; w >= 0; w--) {
         if (w != nwindows - 1)
@@ -692,7 +738,8 @@ static int ge_msm_pippenger(ge *result, const uint8_t *scalars,
         for (int d = 0; d < nbuckets; d++) ge_identity(&buckets[d]);
         for (size_t i = 0; i < n; i++) {
             unsigned d = get_window(scalars + i * 32, w * width, width);
-            if (d) ge_add(&buckets[d - 1], &buckets[d - 1], &pts[i]);
+            if (d)
+                ge_add_cached(&buckets[d - 1], &buckets[d - 1], &cpts[i]);
         }
         ge run, acc;
         ge_identity(&run);
@@ -704,6 +751,7 @@ static int ge_msm_pippenger(ge *result, const uint8_t *scalars,
         ge_add(result, result, &acc);
     }
     free(buckets);
+    free(cpts);
     return 1;
 }
 
