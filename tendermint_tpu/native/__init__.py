@@ -59,10 +59,15 @@ def _build(name: str) -> Optional[ctypes.CDLL]:
     src = os.path.join(_SRC_DIR, f"{name}.c")
     with open(src, "rb") as f:
         code = f.read()
-    tag = hashlib.sha256(code).hexdigest()[:16]
+    cc = os.environ.get("CC", "cc")
+    flags = [cc, "-O3", "-funroll-loops", "-shared", "-fPIC"]
+    # the cache key covers compiler AND flags, not just the source, so
+    # a flag change can never silently reuse a stale artifact
+    tag = hashlib.sha256(
+        code + b"|" + " ".join(flags).encode()
+    ).hexdigest()[:16]
     out = os.path.join(_cache_dir(), f"{name}-{tag}.so")
     if not os.path.exists(out):
-        cc = os.environ.get("CC", "cc")
         # compile to a temp name then atomically rename, so concurrent
         # processes never load a half-written .so
         fd, tmp = tempfile.mkstemp(
@@ -71,7 +76,7 @@ def _build(name: str) -> Optional[ctypes.CDLL]:
         os.close(fd)
         try:
             subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-o", tmp, src],
+                flags + ["-o", tmp, src],
                 check=True,
                 capture_output=True,
                 timeout=120,

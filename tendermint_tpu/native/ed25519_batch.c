@@ -399,10 +399,13 @@ TM_IFMA_TARGET static void pow2523_x8(fe *vals) {
 
 static int have_ifma(void) {
     static int cached = -1;
-    if (cached < 0)
-        cached = __builtin_cpu_supports("avx512ifma") &&
+    if (cached < 0) {
+        const char *off = getenv("TM_TPU_NO_IFMA");
+        cached = !(off && off[0]) &&
+                 __builtin_cpu_supports("avx512ifma") &&
                  __builtin_cpu_supports("avx512f") &&
                  __builtin_cpu_supports("avx512dq");
+    }
     return cached;
 }
 
