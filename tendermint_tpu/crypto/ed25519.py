@@ -207,38 +207,40 @@ def _native_batch_all_valid(items) -> Optional[bool]:
     False = at least one invalid (caller falls back per-signature for
     the bitmap, as the reference does); None = native unavailable.
 
-    Scalar arithmetic (SHA-512 challenges mod L, the 128-bit random
-    weights, their products) stays in Python big-ints; the C side does
-    only ZIP-215 point decoding and the multi-scalar multiplication."""
-    import hashlib
+    The whole prep — SHA-512 challenges mod L, the 128-bit random
+    weights' products — runs inside the native call too
+    (tm_ed25519_verify_full); Python only concatenates the inputs. The
+    RLC randomness is drawn here and passed in, so the weights stay
+    under the caller's control."""
+    import ctypes
+    import os as _os
 
-    fn = _native_batch_fn()
-    if fn is None:
+    from .. import native
+
+    lib = native.ed25519_batch_lib()
+    if lib is None:
         return None
-    ss = []
-    ks = []
-    pk_b = bytearray()
-    r_b = bytearray()
-    for pk, msg, sig in items:
-        s = int.from_bytes(sig[32:], "little")
-        if s >= ed25519_math.L:
-            return False  # non-canonical s: invalid under ZIP-215
-        pkb = pk.bytes()
-        r = sig[:32]
-        ss.append(s)
-        ks.append(
-            int.from_bytes(
-                hashlib.sha512(r + pkb + msg).digest(), "little"
-            )
-            % ed25519_math.L
-        )
-        pk_b += pkb
-        r_b += r
-    zb, a_sc, z_sc = _rlc_scalars(ss, ks)
-    rc = fn(bytes(pk_b), bytes(r_b), zb, a_sc, z_sc, len(items))
+    n = len(items)
+    pk_b = b"".join(pk.bytes() for pk, _m, _s in items)
+    sig_b = b"".join(sig for _pk, _m, sig in items)
+    offs = (ctypes.c_uint64 * (n + 1))()
+    pos = 0
+    chunks = []
+    for i, (_pk, msg, _sig) in enumerate(items):
+        offs[i] = pos
+        chunks.append(msg)
+        pos += len(msg)
+    offs[n] = pos
+    rc = lib.tm_ed25519_verify_full(
+        pk_b, sig_b, b"".join(chunks), offs, _os.urandom(16 * n), n
+    )
     if rc == 1:
         return True
-    return False  # equation failed or an encoding didn't decode
+    if rc == 0:
+        return False
+    # rc == -1 (undecodable or alloc failure): report invalid-somewhere
+    # so the caller's per-signature pass produces the exact bitmap
+    return False
 
 
 class Ed25519BatchVerifier(BatchVerifier):
@@ -247,10 +249,12 @@ class Ed25519BatchVerifier(BatchVerifier):
     Matches the reference CPU behavior (crypto/ed25519/ed25519.go:202-237
     wraps curve25519-voi's batch verifier): batches of
     >= _NATIVE_BATCH_MIN go through the native cofactored RLC batch
-    equation (~3x the OpenSSL sequential rate); on batch failure — or
-    when the native kernel is unavailable — signatures are checked
-    one-by-one for the exact bitmap, which is also how the reference
-    attributes failures. The TPU implementation lives in
+    equation — hashing, scalar products, and the multi-scalar multiply
+    all in one native call (see PERF.md for current rates; ~8x OpenSSL
+    sequential at large batches). On batch failure — or when the
+    native kernel is unavailable — signatures are checked one-by-one
+    for the exact bitmap, which is also how the reference attributes
+    failures. The TPU implementation lives in
     tendermint_tpu.crypto.tpu_verifier and is selected by crypto.batch
     when a device is available and the batch is large enough.
     """

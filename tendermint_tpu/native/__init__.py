@@ -143,5 +143,16 @@ def ed25519_batch_lib():
         # same equation over ristretto255 decoding (sr25519/schnorrkel)
         lib.tm_sr25519_batch_verify.argtypes = argtypes
         lib.tm_sr25519_batch_verify.restype = ctypes.c_int
+        # whole-batch entry: SHA-512 challenges + mod-L scalar products
+        # + the equation in one native call (no per-signature Python)
+        lib.tm_ed25519_verify_full.argtypes = [
+            ctypes.c_char_p,                  # pks n*32
+            ctypes.c_char_p,                  # sigs n*64
+            ctypes.c_char_p,                  # msgs blob
+            ctypes.POINTER(ctypes.c_uint64),  # n+1 offsets
+            ctypes.c_char_p,                  # rand n*16
+            ctypes.c_uint64,
+        ]
+        lib.tm_ed25519_verify_full.restype = ctypes.c_int
         lib._tm_configured = True
     return lib

@@ -3,12 +3,16 @@
  * batch verifier (reference: crypto/ed25519/ed25519.go:202-237, which
  * wraps voi's ed25519.VerifyBatch).
  *
- * The host (crypto/ed25519.py) hashes and does all scalar arithmetic
- * mod L in Python (fast big-int), then hands this kernel:
+ * The kernel checks, for terms
  *
- *   terms:  zb*B  +  sum a_i * (-A_i)  +  sum z_i * (-R_i)
+ *   zb*B  +  sum a_i * (-A_i)  +  sum z_i * (-R_i)
  *   where   zb  = sum z_i*s_i mod L,  a_i = z_i*k_i mod L,
  *           z_i = 128-bit random,     k_i = SHA512(R|A|M) mod L
+ *
+ * (tm_ed25519_verify_full computes the hashes and mod-L products
+ * natively; the older tm_*_batch_verify entries take them
+ * precomputed — the sr25519 path still preps its merlin challenges in
+ * Python),
  *
  * and the kernel answers whether [8] * (that sum) is the identity —
  * the cofactored (ZIP-215) batch equation. Field/point arithmetic
@@ -209,6 +213,256 @@ static void fe_pow2523(fe r, const fe z) {
     fe_mul(t0, t1, t0);            /* z^(2^250-1) */
     fe_sqn(t0, t0, 2);
     fe_mul(r, t0, z);              /* z^(2^252-3) */
+}
+
+/* ------------------------------------------------------------------
+ * SHA-512 (FIPS 180-4) — the k = SHA512(R|A|M) challenge hashes, so
+ * the whole ed25519 batch prep can run in one native call.
+ * ------------------------------------------------------------------ */
+
+static const uint64_t SHA512_K[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL,
+};
+
+#define ROR64(x, n) (((x) >> (n)) | ((x) << (64 - (n))))
+
+static void sha512_block(uint64_t st[8], const uint8_t *p) {
+    uint64_t w[80];
+    for (int i = 0; i < 16; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v = (v << 8) | p[i * 8 + j];
+        w[i] = v;
+    }
+    for (int i = 16; i < 80; i++) {
+        uint64_t s0 = ROR64(w[i - 15], 1) ^ ROR64(w[i - 15], 8) ^
+                      (w[i - 15] >> 7);
+        uint64_t s1 = ROR64(w[i - 2], 19) ^ ROR64(w[i - 2], 61) ^
+                      (w[i - 2] >> 6);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint64_t a = st[0], b = st[1], c = st[2], d = st[3], e = st[4],
+             f = st[5], g = st[6], h = st[7];
+    for (int i = 0; i < 80; i++) {
+        uint64_t S1 = ROR64(e, 14) ^ ROR64(e, 18) ^ ROR64(e, 41);
+        uint64_t ch = (e & f) ^ (~e & g);
+        uint64_t t1 = h + S1 + ch + SHA512_K[i] + w[i];
+        uint64_t S0 = ROR64(a, 28) ^ ROR64(a, 34) ^ ROR64(a, 39);
+        uint64_t mj = (a & b) ^ (a & c) ^ (b & c);
+        uint64_t t2 = S0 + mj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+    st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+}
+
+/* digest64 = SHA-512 of the concatenation of up to three chunks */
+static void sha512_3(uint8_t out[64], const uint8_t *c1, size_t n1,
+                     const uint8_t *c2, size_t n2, const uint8_t *c3,
+                     size_t n3) {
+    uint64_t st[8] = {
+        0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+        0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+        0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+        0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+    };
+    uint8_t buf[128];
+    size_t fill = 0;
+    uint64_t total = 0;
+    const uint8_t *chunks[3] = {c1, c2, c3};
+    size_t lens[3] = {n1, n2, n3};
+    for (int c = 0; c < 3; c++) {
+        const uint8_t *p = chunks[c];
+        size_t n = lens[c];
+        total += n;
+        while (n) {
+            size_t take = 128 - fill;
+            if (take > n) take = n;
+            memcpy(buf + fill, p, take);
+            fill += take;
+            p += take;
+            n -= take;
+            if (fill == 128) {
+                sha512_block(st, buf);
+                fill = 0;
+            }
+        }
+    }
+    /* padding: 0x80, zeros, 128-bit big-endian bit length */
+    buf[fill++] = 0x80;
+    if (fill > 112) {
+        memset(buf + fill, 0, 128 - fill);
+        sha512_block(st, buf);
+        fill = 0;
+    }
+    memset(buf + fill, 0, 128 - fill);
+    uint64_t bits = total * 8;
+    for (int j = 0; j < 8; j++)
+        buf[120 + j] = (uint8_t)(bits >> (8 * (7 - j)));
+    sha512_block(st, buf);
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++)
+            out[i * 8 + j] = (uint8_t)(st[i] >> (8 * (7 - j)));
+}
+
+/* ------------------------------------------------------------------
+ * Scalar arithmetic mod L = 2^252 + delta (delta < 2^125), for the
+ * host-prep offload: k = digest mod L, a = z*k mod L, zb = sum z*s.
+ * Reduction is Barrett with MU = floor(2^512 / L): q = (x*MU) >> 512,
+ * r = x - q*L, then at most two conditional subtracts (classic bound
+ * r < 3L). Differential-tested against Python big-ints over random
+ * and boundary inputs via the tm_sc_mod_l_test hook
+ * (tests/test_crypto.py::test_native_scalar_and_sha512_building_blocks).
+ * ------------------------------------------------------------------ */
+
+/* L as 4x64 little-endian limbs */
+static const uint64_t SC_L[4] = {
+    0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL, 0x0000000000000000ULL,
+    0x1000000000000000ULL,
+};
+
+static void sc4_frombytes(uint64_t r[4], const uint8_t *b) {
+    for (int i = 0; i < 4; i++) r[i] = load64_le(b + 8 * i);
+}
+
+static void sc4_tobytes(uint8_t *b, const uint64_t r[4]) {
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            b[8 * i + j] = (uint8_t)(r[i] >> (8 * j));
+}
+
+/* ge/lt over 4-limb little-endian */
+static int sc4_gte(const uint64_t a[4], const uint64_t b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] > b[i]) return 1;
+        if (a[i] < b[i]) return 0;
+    }
+    return 1;
+}
+
+static void sc4_sub(uint64_t r[4], const uint64_t a[4],
+                    const uint64_t b[4]) {
+    unsigned __int128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        unsigned __int128 d =
+            (unsigned __int128)a[i] - b[i] - (uint64_t)borrow;
+        r[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+/* generic little-endian multiply: r[na+nb] = a[na] * b[nb] */
+static void sc_mul_nn(uint64_t *r, const uint64_t *a, int na,
+                      const uint64_t *b, int nb) {
+    memset(r, 0, (size_t)(na + nb) * 8);
+    for (int i = 0; i < na; i++) {
+        unsigned __int128 carry = 0;
+        for (int j = 0; j < nb; j++) {
+            unsigned __int128 cur = (unsigned __int128)a[i] * b[j] +
+                                    r[i + j] + (uint64_t)carry;
+            r[i + j] = (uint64_t)cur;
+            carry = cur >> 64;
+        }
+        r[i + nb] += (uint64_t)carry;
+    }
+}
+
+/* r(4 limbs, < L) = x (nx <= 8 limbs, little-endian, < 2^512) mod L.
+ * Barrett reduction: q = floor(x * MU / 2^512) with
+ * MU = floor(2^512 / L); r = x - q*L, then at most a few conditional
+ * subtracts (classic bound r < 3L). Differential-tested against
+ * Python big-ints over random and boundary inputs. */
+static void sc_mod_l(uint64_t r[4], const uint64_t *x, int nx) {
+    static const uint64_t MU[5] = {
+        0xed9ce5a30a2c131bULL, 0x2106215d086329a7ULL,
+        0xffffffffffffffebULL, 0xffffffffffffffffULL,
+        0x000000000000000fULL,
+    };
+    uint64_t xs[8];
+    memset(xs, 0, sizeof(xs));
+    memcpy(xs, x, (size_t)nx * 8);
+    uint64_t prod[13];
+    sc_mul_nn(prod, xs, 8, MU, 5);        /* x * MU, 13 limbs */
+    uint64_t q[5];
+    memcpy(q, prod + 8, 5 * 8);           /* >> 512 */
+    uint64_t ql[9];
+    sc_mul_nn(ql, q, 5, SC_L, 4);         /* q * L */
+    /* r = x - q*L: fits comfortably in 5 limbs (< 3L < 2^254) */
+    uint64_t rem[8];
+    unsigned __int128 borrow = 0;
+    for (int i = 0; i < 8; i++) {
+        unsigned __int128 d =
+            (unsigned __int128)xs[i] - ql[i] - (uint64_t)borrow;
+        rem[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    while (sc4_gte(rem, SC_L)) sc4_sub(rem, rem, SC_L);
+    memcpy(r, rem, 32);
+}
+
+/* r = a*b mod L (a: 4 limbs < L, b: nb limbs) */
+static void sc_mulmod(uint64_t r[4], const uint64_t a[4],
+                      const uint64_t *b, int nb) {
+    uint64_t prod[8];
+    memset(prod, 0, sizeof(prod));
+    for (int i = 0; i < 4; i++) {
+        unsigned __int128 carry = 0;
+        for (int j = 0; j < nb; j++) {
+            unsigned __int128 cur = (unsigned __int128)a[i] * b[j] +
+                                    prod[i + j] + (uint64_t)carry;
+            prod[i + j] = (uint64_t)cur;
+            carry = cur >> 64;
+        }
+        int k = i + nb;
+        while (carry) {
+            unsigned __int128 cur =
+                (unsigned __int128)prod[k] + (uint64_t)carry;
+            prod[k] = (uint64_t)cur;
+            carry = cur >> 64;
+            k++;
+        }
+    }
+    sc_mod_l(r, prod, 8);
+}
+
+static void sc_addmod(uint64_t r[4], const uint64_t a[4],
+                      const uint64_t b[4]) {
+    uint64_t sum[5];
+    unsigned __int128 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        unsigned __int128 cur =
+            (unsigned __int128)a[i] + b[i] + (uint64_t)carry;
+        sum[i] = (uint64_t)cur;
+        carry = cur >> 64;
+    }
+    sum[4] = (uint64_t)carry;
+    sc_mod_l(r, sum, 5);
 }
 
 /* ------------------------------------------------------------------
@@ -875,6 +1129,79 @@ int tm_ed25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
                             const uint8_t *z_scalars, uint64_t n) {
     return batch_verify_common(pk_bytes, r_bytes, zb, a_scalars, z_scalars,
                                n, zip215_pre2, zip215_fin2);
+}
+
+/* Whole-batch ed25519 verify with the host prep done natively: the
+ * challenge hashes k_i = SHA512(R|A|M) mod L, the random-linear-
+ * combination products a_i = z_i*k_i and zb = sum z_i*s_i mod L, and
+ * the cofactored batch equation — one call, no per-signature Python.
+ * sigs = n*64 (R||s); msgs = concatenated messages with n+1 offsets;
+ * rand16 = n*16 random weights (caller-supplied so the RLC randomness
+ * stays under the caller's control). Limb loads/stores go through the
+ * endian-neutral byte helpers like the rest of the file. Returns
+ * 1/0/-1 like the others;
+ * a non-canonical s (>= L) returns 0 (invalid somewhere — caller
+ * falls back per-signature for the bitmap). */
+int tm_ed25519_verify_full(const uint8_t *pks, const uint8_t *sigs,
+                           const uint8_t *msgs, const uint64_t *moffs,
+                           const uint8_t *rand16, uint64_t n) {
+    uint8_t *a_sc = malloc(n * 32);
+    uint8_t *z_sc = malloc(n * 32);
+    uint8_t *r_b = malloc(n * 32);
+    if (!a_sc || !z_sc || !r_b) {
+        free(a_sc);
+        free(z_sc);
+        free(r_b);
+        return -1;
+    }
+    int rc;
+    uint64_t zb[4] = {0, 0, 0, 0};
+    for (uint64_t i = 0; i < n; i++) {
+        const uint8_t *sig = sigs + 64 * i;
+        uint64_t s[4];
+        sc4_frombytes(s, sig + 32);
+        if (sc4_gte(s, SC_L)) {
+            rc = 0; /* non-canonical s: invalid under ZIP-215 */
+            goto done;
+        }
+        uint8_t dig[64];
+        sha512_3(dig, sig, 32, pks + 32 * i, 32, msgs + moffs[i],
+                 (size_t)(moffs[i + 1] - moffs[i]));
+        uint64_t d8[8], k[4], z[2], a[4], zs[4];
+        for (int w = 0; w < 8; w++) d8[w] = load64_le(dig + 8 * w);
+        sc_mod_l(k, d8, 8);
+        z[0] = load64_le(rand16 + 16 * i);
+        z[1] = load64_le(rand16 + 16 * i + 8);
+        sc_mulmod(a, k, z, 2);
+        sc4_tobytes(a_sc + 32 * i, a);
+        sc_mulmod(zs, s, z, 2);
+        sc_addmod(zb, zb, zs);
+        memset(z_sc + 32 * i, 0, 32);
+        memcpy(z_sc + 32 * i, rand16 + 16 * i, 16);
+        memcpy(r_b + 32 * i, sig, 32);
+    }
+    uint8_t zb_bytes[32];
+    sc4_tobytes(zb_bytes, zb);
+    rc = batch_verify_common(pks, r_b, zb_bytes, a_sc, z_sc, n,
+                             zip215_pre2, zip215_fin2);
+done:
+    free(a_sc);
+    free(z_sc);
+    free(r_b);
+    return rc;
+}
+
+/* test hooks: differential checks of the scalar/hash building blocks
+ * against Python (tests/test_crypto.py) */
+void tm_sc_mod_l_test(const uint8_t *x64, uint8_t *out32) {
+    uint64_t xl[8], r[4];
+    for (int w = 0; w < 8; w++) xl[w] = load64_le(x64 + 8 * w);
+    sc_mod_l(r, xl, 8);
+    sc4_tobytes(out32, r);
+}
+
+void tm_sha512_test(const uint8_t *a, uint64_t na, uint8_t *out64) {
+    sha512_3(out64, a, (size_t)na, NULL, 0, NULL, 0);
 }
 
 /* sr25519: same batch equation over ristretto255 representatives

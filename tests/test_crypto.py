@@ -100,7 +100,10 @@ def test_native_batch_equation_paths():
     bv = e.Ed25519BatchVerifier()
     for i in range(n):
         k = keys[i % 8]
-        m = b"nb-%d" % i
+        # vary message length across SHA-512 block boundaries (real
+        # vote sign-bytes exceed one block; the native sha512_3 must
+        # straddle its 128-byte buffer correctly)
+        m = b"nb-%d-" % i + b"x" * ((i * 37) % 600)
         bv.add(k.pub_key(), m, k.sign(m))
     ok, bits = bv.verify()
     assert ok and bits == [True] * n
@@ -303,3 +306,44 @@ def test_batch_verifier_drains_on_every_backend(monkeypatch):
     assert sv.verify() == (True, [True])
     assert sv.verify() == (False, [])
     assert len(sv) == 0
+
+
+def test_native_scalar_and_sha512_building_blocks():
+    """Differential checks of the native host-prep building blocks
+    against Python: sc_mod_l (Barrett reduction mod L) over random and
+    boundary 512-bit inputs, and the C SHA-512 against hashlib across
+    every padding boundary. These are the pieces tm_ed25519_verify_full
+    composes for consensus signature verification."""
+    import ctypes
+    import random
+
+    from tendermint_tpu import native
+
+    lib = native.load("ed25519_batch")
+    if lib is None:
+        pytest.skip("no native toolchain")
+    lib.tm_sc_mod_l_test.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.tm_sc_mod_l_test.restype = None
+    lib.tm_sha512_test.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p
+    ]
+    lib.tm_sha512_test.restype = None
+
+    L = em.L
+    rng = random.Random(1234)
+    cases = [
+        0, 1, L - 1, L, L + 1, 2 * L, 3 * L - 1, 2**252, 2**256 - 1,
+        2**512 - 1, (L << 260) + 12345,
+    ]
+    cases += [rng.getrandbits(512) for _ in range(500)]
+    out = ctypes.create_string_buffer(32)
+    for x in cases:
+        lib.tm_sc_mod_l_test((x % 2**512).to_bytes(64, "little"), out)
+        assert int.from_bytes(out.raw, "little") == (x % 2**512) % L
+
+    out64 = ctypes.create_string_buffer(64)
+    for ln in list(range(0, 130)) + [111, 112, 113, 127, 128, 129,
+                                     239, 240, 241, 255, 256, 1000]:
+        data = bytes(rng.randrange(256) for _ in range(ln))
+        lib.tm_sha512_test(data, ln, out64)
+        assert out64.raw == hashlib.sha512(data).digest(), ln
