@@ -1,0 +1,151 @@
+"""AddressSanitizer sweep of the native batch-equation kernel.
+
+Builds an ASAN variant of native/ed25519_batch.c and drives every
+exported entry point through all three MSM paths (Straus < 1024 terms,
+Pippenger w8, Pippenger w11), multi-block SHA-512 message shapes, the
+scalar/hash test hooks, and the sr25519 ristretto path — valid and
+corrupted batches. Run after ANY change to the C kernel:
+
+    python scripts/asan_check.py
+
+Exits nonzero on an ASAN report or a wrong verification result.
+(The suite's differential tests check semantics; this checks memory.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import random
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "tendermint_tpu", "native", "ed25519_batch.c")
+
+
+def main() -> int:
+    cc = os.environ.get("CC", "cc")
+    so = os.path.join(tempfile.mkdtemp(), "ed25519_batch_asan.so")
+    subprocess.run(
+        [cc, "-O1", "-g", "-fsanitize=address", "-shared", "-fPIC",
+         "-o", so, SRC],
+        check=True,
+    )
+    asan = subprocess.run(
+        [cc, "-print-file-name=libasan.so"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    # re-exec under LD_PRELOAD so ASAN is initialized before python
+    if not os.environ.get("TM_ASAN_CHILD"):
+        env = dict(os.environ)
+        env["TM_ASAN_CHILD"] = so
+        env["LD_PRELOAD"] = asan
+        env.setdefault("ASAN_OPTIONS", "detect_leaks=0")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = ""
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env
+        ).returncode
+    return run_checks(os.environ["TM_ASAN_CHILD"])
+
+
+def run_checks(so: str) -> int:
+    sys.path.insert(0, REPO)
+    lib = ctypes.CDLL(so)
+    argtypes = [ctypes.c_char_p] * 5 + [ctypes.c_uint64]
+    lib.tm_ed25519_batch_verify.argtypes = argtypes
+    lib.tm_ed25519_batch_verify.restype = ctypes.c_int
+    lib.tm_sr25519_batch_verify.argtypes = argtypes
+    lib.tm_sr25519_batch_verify.restype = ctypes.c_int
+    lib.tm_ed25519_verify_full.argtypes = [ctypes.c_char_p] * 3 + [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_uint64
+    ]
+    lib.tm_ed25519_verify_full.restype = ctypes.c_int
+    lib.tm_sc_mod_l_test.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.tm_sha512_test.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p
+    ]
+
+    random.seed(5)
+    out32 = ctypes.create_string_buffer(32)
+    for _ in range(200):
+        lib.tm_sc_mod_l_test(random.randbytes(64), out32)
+    out64 = ctypes.create_string_buffer(64)
+    for ln in (0, 1, 111, 112, 113, 127, 128, 129, 600):
+        lib.tm_sha512_test(random.randbytes(ln), ln, out64)
+
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    keys = []
+    for i in range(8):
+        sk = Ed25519PrivateKey.from_private_bytes(bytes([i + 1]) * 32)
+        keys.append(
+            (sk, sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw))
+        )
+    # sizes hitting Straus (<512 sigs), Pippenger w8, and w11 (>1700)
+    for n in (1, 2, 7, 48, 600, 2048):
+        pks, sigs, blob = bytearray(), bytearray(), bytearray()
+        offs = (ctypes.c_uint64 * (n + 1))()
+        pos = 0
+        for i in range(n):
+            sk, pk = keys[i % 8]
+            m = b"asan-%d-" % i + b"y" * ((i * 53) % 500)
+            pks += pk
+            sigs += sk.sign(m)
+            offs[i] = pos
+            blob += m
+            pos += len(m)
+        offs[n] = pos
+        rc = lib.tm_ed25519_verify_full(
+            bytes(pks), bytes(sigs), bytes(blob), offs,
+            random.randbytes(16 * n), n,
+        )
+        assert rc == 1, (n, rc)
+        bad = bytearray(sigs)
+        bad[32] ^= 1
+        rc = lib.tm_ed25519_verify_full(
+            bytes(pks), bytes(bad), bytes(blob), offs,
+            random.randbytes(16 * n), n,
+        )
+        assert rc in (0, -1), (n, rc)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tendermint_tpu.crypto.ed25519 import _rlc_scalars
+    from tendermint_tpu.crypto.sr25519 import (
+        PrivKeySr25519,
+        _parse_signature,
+        challenge_batch,
+    )
+
+    privs = [PrivKeySr25519.from_seed(bytes([i + 3]) * 32) for i in range(4)]
+    n = 40
+    pks_l, msgs, sigs_l = [], [], []
+    for i in range(n):
+        p = privs[i % 4]
+        m = b"sr-asan-%d" % i
+        pks_l.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs_l.append(p.sign(m))
+    parsed = [_parse_signature(s) for s in sigs_l]
+    ks = challenge_batch(pks_l, msgs, [r for r, _ in parsed])
+    zb, a_sc, z_sc = _rlc_scalars([s for _, s in parsed], ks)
+    rc = lib.tm_sr25519_batch_verify(
+        b"".join(pks_l), b"".join(r for r, _ in parsed), zb, a_sc, z_sc, n
+    )
+    assert rc == 1, rc
+    print("ASAN PASS: all entry points, all MSM paths, no reports")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
