@@ -347,3 +347,69 @@ def test_native_scalar_and_sha512_building_blocks():
         data = bytes(rng.randrange(256) for _ in range(ln))
         lib.tm_sha512_test(data, ln, out64)
         assert out64.raw == hashlib.sha512(data).digest(), ln
+
+
+def test_proof_operators_chain():
+    """Multi-op proof chaining (reference: crypto/merkle/proof_op.go:
+    60-90, proof_value.go, proof_key_path.go): a value proven into a
+    substore root, the substore root proven into the app root, chained
+    through a url keypath consumed last-component-first."""
+    from tendermint_tpu.crypto.merkle import (
+        Proof,
+        ProofOperators,
+        ValueOp,
+        proofs_from_byte_slices,
+    )
+    from tendermint_tpu.encoding.proto import ProtoWriter
+
+    def kv_leaf(key: bytes, value: bytes) -> bytes:
+        w = ProtoWriter()
+        w.bytes(1, key)
+        w.bytes(2, hashlib.sha256(value).digest())
+        return w.finish()
+
+    # level 1: the substore, three keys
+    value = b"the-stored-value"
+    sub_items = [
+        kv_leaf(b"alpha", b"a-value"),
+        kv_leaf(b"key", value),
+        kv_leaf(b"zeta", b"z-value"),
+    ]
+    sub_root, sub_proofs = proofs_from_byte_slices(sub_items)
+    op1 = ValueOp(b"key", sub_proofs[1])
+
+    # level 2: the app root over store roots (substore root is the
+    # "value" the second op hashes)
+    app_items = [
+        kv_leaf(b"other", b"whatever"),
+        kv_leaf(b"store", sub_root),
+    ]
+    app_root, app_proofs = proofs_from_byte_slices(app_items)
+    op2 = ValueOp(b"store", app_proofs[1])
+
+    ops = ProofOperators([op1, op2])
+    ops.verify_value(app_root, "/store/key", value)
+    # hex-escaped path component resolves to the same key
+    ops2 = ProofOperators([op1, op2])
+    ops2.verify_value(app_root, "/store/x:" + b"key".hex(), value)
+
+    # wrong value fails
+    with pytest.raises(ValueError):
+        ProofOperators([op1, op2]).verify_value(
+            app_root, "/store/key", b"tampered"
+        )
+    # wrong root fails
+    with pytest.raises(ValueError):
+        ProofOperators([op1, op2]).verify_value(
+            b"\x00" * 32, "/store/key", value
+        )
+    # keypath order matters (outermost first in the path)
+    with pytest.raises(ValueError):
+        ProofOperators([op1, op2]).verify_value(
+            app_root, "/key/store", value
+        )
+    # unconsumed path components are rejected
+    with pytest.raises(ValueError):
+        ProofOperators([op1, op2]).verify_value(
+            app_root, "/extra/store/key", value
+        )
