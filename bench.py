@@ -770,10 +770,9 @@ def main() -> None:
             10_000, reps=3, light=False, use_device=False
         )
         try:
-            breakdown = bench_commit_breakdown_cpu(10_000, reps=3)
+            breakdown_cpu = bench_commit_breakdown_cpu(10_000, reps=3)
         except Exception as e:
-            breakdown = {"error": repr(e)}
-        breakdown_cpu = breakdown
+            breakdown_cpu = {"error": repr(e)}
         # the device-shaped key stays non-null but points at the CPU
         # split instead of impersonating its schema (dispatch/gather/
         # device_est keys do not exist on this path)

@@ -154,5 +154,30 @@ def ed25519_batch_lib():
             ctypes.c_uint64,
         ]
         lib.tm_ed25519_verify_full.restype = ctypes.c_int
+        # decoded-point cache observability (hits/misses/inserts/
+        # evictions) + reset — the repeated-validator-set optimization
+        # (reference: crypto/ed25519/ed25519.go:50-56 cacheSize 4096)
+        lib.tm_pk_cache_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64)
+        ]
+        lib.tm_pk_cache_stats.restype = None
+        lib.tm_pk_cache_clear.argtypes = []
+        lib.tm_pk_cache_clear.restype = None
         lib._tm_configured = True
     return lib
+
+
+def pk_cache_stats() -> Optional[dict]:
+    """Decoded-point cache counters from the native batch library, or
+    None when native is unavailable."""
+    lib = ed25519_batch_lib()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint64 * 4)()
+    lib.tm_pk_cache_stats(out)
+    return {
+        "hits": out[0],
+        "misses": out[1],
+        "inserts": out[2],
+        "evictions": out[3],
+    }

@@ -71,6 +71,12 @@ def _release_compiled_executables():
     gc.collect()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running gates (ASAN sweep, big e2e runs)"
+    )
+
+
 @pytest.fixture
 def tmp_home(tmp_path):
     from tendermint_tpu.config import Config

@@ -413,3 +413,69 @@ def test_proof_operators_chain():
         ProofOperators([op1, op2]).verify_value(
             app_root, "/extra/store/key", value
         )
+
+
+def test_decoded_point_cache():
+    """The native decoded-point cache (reference analog:
+    crypto/ed25519/ed25519.go:50-56 caches 4096 expanded keys):
+    re-verifying the same keys hits the cache, a cached key still
+    rejects a bad signature (only the decode is cached, never the
+    equation), and the ed25519/ristretto decoders never alias even
+    for byte-identical encodings."""
+    from tendermint_tpu import native
+    from tendermint_tpu.crypto import ed25519 as e
+    from tendermint_tpu.crypto import sr25519 as sr
+
+    if e._native_batch_fn() is None:
+        pytest.skip("no native toolchain")
+    lib = native.ed25519_batch_lib()
+    lib.tm_pk_cache_clear()
+
+    keys = [
+        PrivKeyEd25519.from_seed(bytes([i + 1, 0xC4]) + b"\x77" * 30)
+        for i in range(24)
+    ]
+    triples = [
+        (k.pub_key(), b"pkc-%d" % i, k.sign(b"pkc-%d" % i))
+        for i, k in enumerate(keys)
+    ]
+
+    def run(expect_ok=True, corrupt_at=None):
+        bv = e.Ed25519BatchVerifier()
+        for i, (pk, m, s) in enumerate(triples):
+            if i == corrupt_at:
+                s = s[:32] + bytes(
+                    ((int.from_bytes(s[32:], "little") + 1) % em.L)
+                    .to_bytes(32, "little")
+                )
+            bv.add(pk, m, s)
+        ok, bits = bv.verify()
+        assert ok is expect_ok
+        return bits
+
+    run()
+    s0 = native.pk_cache_stats()
+    assert s0["inserts"] >= 24 and s0["hits"] == 0
+    run()
+    s1 = native.pk_cache_stats()
+    assert s1["hits"] >= 24  # every A point served from cache
+    assert s1["inserts"] == s0["inserts"]
+
+    # cached keys must not weaken verification: same keys, one bad sig
+    bits = run(expect_ok=False, corrupt_at=7)
+    assert [i for i, b in enumerate(bits) if not b] == [7]
+
+    # cross-curve isolation: verifying sr25519 after ed25519 populated
+    # the cache must decode fresh ristretto points (curve-tagged keys),
+    # and both curves stay correct back-to-back
+    sks = [
+        sr.PrivKeySr25519.from_seed(bytes([i + 1, 0xD5]) + b"\x66" * 30)
+        for i in range(8)
+    ]
+    bv = sr.Sr25519BatchVerifier()
+    for i, k in enumerate(sks):
+        m = b"pkc-sr-%d" % i
+        bv.add(k.pub_key(), m, k.sign(m))
+    ok, _ = bv.verify()
+    assert ok
+    run()  # ed25519 entries still valid after sr25519 traffic
