@@ -143,6 +143,55 @@ def run_checks(so: str) -> int:
         b"".join(pks_l), b"".join(r for r, _ in parsed), zb, a_sc, z_sc, n
     )
     assert rc == 1, rc
+
+    # whole-batch sr25519 entry (merlin/STROBE in C) across STROBE
+    # rate boundaries, valid + marker-stripped + corrupted-s batches
+    lib.tm_sr25519_verify_full.argtypes = lib.tm_ed25519_verify_full.argtypes
+    lib.tm_sr25519_verify_full.restype = ctypes.c_int
+    lib.tm_sr25519_challenge_test.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_char_p,
+    ]
+    for mlen in (0, 1, 165, 166, 167, 400):
+        lib.tm_sr25519_challenge_test(
+            random.randbytes(32), random.randbytes(32),
+            random.randbytes(mlen), mlen, out32,
+        )
+    for n in (1, 2, 40, 600):
+        pks_b, sigs_b, blob = bytearray(), bytearray(), bytearray()
+        offs = (ctypes.c_uint64 * (n + 1))()
+        pos = 0
+        for i in range(n):
+            p = privs[i % 4]
+            m = b"srfull-%d-" % i + b"z" * ((i * 71) % 400)
+            pks_b += p.pub_key().bytes()
+            sigs_b += p.sign(m)
+            offs[i] = pos
+            blob += m
+            pos += len(m)
+        offs[n] = pos
+        rc = lib.tm_sr25519_verify_full(
+            bytes(pks_b), bytes(sigs_b), bytes(blob), offs,
+            random.randbytes(16 * n), n,
+        )
+        assert rc == 1, (n, rc)
+        bad = bytearray(sigs_b)
+        bad[63] &= 0x7F  # strip the v1 marker on sig 0
+        rc = lib.tm_sr25519_verify_full(
+            bytes(pks_b), bytes(bad), bytes(blob), offs,
+            random.randbytes(16 * n), n,
+        )
+        assert rc == 0, (n, rc)
+
+    # decoded-point cache hooks: stats/clear under mixed-curve traffic
+    lib.tm_pk_cache_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+    lib.tm_pk_cache_clear.argtypes = []
+    stats = (ctypes.c_uint64 * 4)()
+    lib.tm_pk_cache_stats(stats)
+    lib.tm_pk_cache_clear()
+    lib.tm_pk_cache_stats(stats)
+    assert list(stats) == [0, 0, 0, 0]
+
     print("ASAN PASS: all entry points, all MSM paths, no reports")
     return 0
 

@@ -199,27 +199,17 @@ def _rlc_scalars(ss, ks):
     return zb.to_bytes(32, "little"), bytes(a_sc), bytes(z_sc)
 
 
-def _native_batch_all_valid(items) -> Optional[bool]:
-    """One shot of the cofactored random-linear-combination batch
-    equation in C (native/ed25519_batch.c — the CPU analog of the
-    reference's curve25519-voi batch verifier,
-    crypto/ed25519/ed25519.go:202-237). True = every signature valid;
-    False = at least one invalid (caller falls back per-signature for
-    the bitmap, as the reference does); None = native unavailable.
+def _call_verify_full(fn, items) -> bool:
+    """Pack (pk, msg, sig) triples for a tm_*_verify_full native entry
+    (concatenated keys/sigs, message blob + offset table, caller-drawn
+    RLC randomness) and map its 1/0/-1 result. Shared by the ed25519
+    and sr25519 whole-batch paths — the packing contract is identical.
 
-    The whole prep — SHA-512 challenges mod L, the 128-bit random
-    weights' products — runs inside the native call too
-    (tm_ed25519_verify_full); Python only concatenates the inputs. The
-    RLC randomness is drawn here and passed in, so the weights stay
-    under the caller's control."""
+    rc == -1 (undecodable or alloc failure) reports invalid-somewhere
+    so the caller's per-signature pass produces the exact bitmap."""
     import ctypes
     import os as _os
 
-    from .. import native
-
-    lib = native.ed25519_batch_lib()
-    if lib is None:
-        return None
     n = len(items)
     pk_b = b"".join(pk.bytes() for pk, _m, _s in items)
     sig_b = b"".join(sig for _pk, _m, sig in items)
@@ -231,16 +221,29 @@ def _native_batch_all_valid(items) -> Optional[bool]:
         chunks.append(msg)
         pos += len(msg)
     offs[n] = pos
-    rc = lib.tm_ed25519_verify_full(
-        pk_b, sig_b, b"".join(chunks), offs, _os.urandom(16 * n), n
-    )
-    if rc == 1:
-        return True
-    if rc == 0:
-        return False
-    # rc == -1 (undecodable or alloc failure): report invalid-somewhere
-    # so the caller's per-signature pass produces the exact bitmap
-    return False
+    rc = fn(pk_b, sig_b, b"".join(chunks), offs, _os.urandom(16 * n), n)
+    return rc == 1
+
+
+def _native_batch_all_valid(items) -> Optional[bool]:
+    """One shot of the cofactored random-linear-combination batch
+    equation in C (native/ed25519_batch.c — the CPU analog of the
+    reference's curve25519-voi batch verifier,
+    crypto/ed25519/ed25519.go:202-237). True = every signature valid;
+    False = at least one invalid (caller falls back per-signature for
+    the bitmap, as the reference does); None = native unavailable.
+
+    The whole prep — SHA-512 challenges mod L, the 128-bit random
+    weights' products — runs inside the native call too
+    (tm_ed25519_verify_full); Python only concatenates the inputs. The
+    RLC randomness is drawn in _call_verify_full, so the weights stay
+    under this package's control."""
+    from .. import native
+
+    lib = native.ed25519_batch_lib()
+    if lib is None:
+        return None
+    return _call_verify_full(lib.tm_ed25519_verify_full, items)
 
 
 class Ed25519BatchVerifier(BatchVerifier):

@@ -310,42 +310,35 @@ _NATIVE_BATCH_MIN = 2
 
 
 def _native_batch_all_valid(items) -> Optional[bool]:
-    """One shot of the schnorrkel batch equation in C
-    (native/ed25519_batch.c tm_sr25519_batch_verify — the analog of
+    """One shot of the schnorrkel batch verification entirely in C
+    (native/ed25519_batch.c tm_sr25519_verify_full — the analog of
     schnorrkel's own RLC batch verification, which curve25519-voi wraps
-    for the reference's crypto/sr25519/batch.go). True = every
-    signature valid; False = at least one invalid or undecodable
-    (caller falls back per-signature for the bitmap); None = native
-    unavailable. Merlin challenges are batch-computed over the native
-    keccak (challenge_batch); scalar products stay in Python."""
+    for the reference's crypto/sr25519/batch.go). Signature parsing,
+    merlin transcript challenges (STROBE-128 over Keccak-f in C), the
+    random-linear-combination products, and the cofactored equation
+    over ristretto decoding all run inside the one native call —
+    Python only concatenates the inputs, mirroring the ed25519 path
+    (tm_ed25519_verify_full). The RLC randomness is drawn here and
+    passed in, so the weights stay under the caller's control.
+
+    True = every signature valid; False = at least one invalid,
+    malformed, or undecodable (caller falls back per-signature for the
+    bitmap); None = native unavailable."""
     from .. import native
-    from .ed25519 import _rlc_scalars
+    from .ed25519 import _call_verify_full
 
     lib = native.ed25519_batch_lib()
     if lib is None:
         return None
-    parsed = []
-    for _pk, _msg, sig in items:
-        p = _parse_signature(sig)
-        if p is None:
-            return False  # malformed: invalid under schnorrkel rules
-        parsed.append(p)
-    pks = [pk.bytes() for pk, _m, _s in items]
-    msgs = [m for _pk, m, _s in items]
-    rs = [r for r, _s in parsed]
-    ks = challenge_batch(pks, msgs, rs)
-    zb, a_sc, z_sc = _rlc_scalars([s for _r, s in parsed], ks)
-    rc = lib.tm_sr25519_batch_verify(
-        b"".join(pks), b"".join(rs), zb, a_sc, z_sc, len(items)
-    )
-    return rc == 1
+    return _call_verify_full(lib.tm_sr25519_verify_full, items)
 
 
 class Sr25519BatchVerifier(BatchVerifier):
     """CPU batch verifier behind the crypto.batch seam
     (reference: crypto/sr25519/batch.go, backed by curve25519-voi's
-    schnorrkel batch). Batches >= _NATIVE_BATCH_MIN go through the
-    native RLC batch equation (~36 us/sig vs ~6 ms/sig for the
+    schnorrkel batch). Batches >= _NATIVE_BATCH_MIN go through
+    tm_sr25519_verify_full — parsing, merlin challenges, RLC products,
+    and the equation all native (~13 us/sig @1024 vs ~6 ms/sig for the
     pure-Python sequential path); on batch failure signatures are
     re-checked one-by-one for the exact bitmap. The device path
     (ops/sr25519_kernel.py) batches the double-scalar multiplications

@@ -61,10 +61,16 @@ def _build(name: str) -> Optional[ctypes.CDLL]:
         code = f.read()
     cc = os.environ.get("CC", "cc")
     flags = [cc, "-O3", "-funroll-loops", "-shared", "-fPIC"]
-    # the cache key covers compiler AND flags, not just the source, so
-    # a flag change can never silently reuse a stale artifact
+    # the cache key covers compiler, flags, AND every local header
+    # (keccakf_core.h is #included by two units), so neither a flag
+    # nor a header change can silently reuse a stale artifact
+    hdr = b""
+    for h in sorted(os.listdir(_SRC_DIR)):
+        if h.endswith(".h"):
+            with open(os.path.join(_SRC_DIR, h), "rb") as f:
+                hdr += f.read()
     tag = hashlib.sha256(
-        code + b"|" + " ".join(flags).encode()
+        code + b"|" + hdr + b"|" + " ".join(flags).encode()
     ).hexdigest()[:16]
     out = os.path.join(_cache_dir(), f"{name}-{tag}.so")
     if not os.path.exists(out):
@@ -154,6 +160,21 @@ def ed25519_batch_lib():
             ctypes.c_uint64,
         ]
         lib.tm_ed25519_verify_full.restype = ctypes.c_int
+        # the sr25519 analog: schnorrkel parsing + merlin challenges
+        # (STROBE-128 in C) + RLC products + the ristretto equation
+        lib.tm_sr25519_verify_full.argtypes = (
+            lib.tm_ed25519_verify_full.argtypes
+        )
+        lib.tm_sr25519_verify_full.restype = ctypes.c_int
+        # differential hook: C merlin challenge vs crypto/sr25519.py
+        lib.tm_sr25519_challenge_test.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+        ]
+        lib.tm_sr25519_challenge_test.restype = None
         # decoded-point cache observability (hits/misses/inserts/
         # evictions) + reset — the repeated-validator-set optimization
         # (reference: crypto/ed25519/ed25519.go:50-56 cacheSize 4096)

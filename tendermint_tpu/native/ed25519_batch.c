@@ -1385,3 +1385,226 @@ int tm_sr25519_batch_verify(const uint8_t *pk_bytes, const uint8_t *r_bytes,
     return batch_verify_common(pk_bytes, r_bytes, zb, a_scalars, z_scalars,
                                n, 2, rist_pre2, rist_fin2);
 }
+
+/* ---- Keccak-f[1600] + STROBE-128 + merlin (sr25519 challenges) -----
+ *
+ * The full-native sr25519 entry needs the schnorrkel Fiat-Shamir
+ * challenge k = merlin_transcript(msg, pk, R) mod L computed here, the
+ * way tm_ed25519_verify_full owns its SHA-512 challenges — otherwise
+ * every batch pays ~3 us/sig of Python transcript work
+ * (crypto/merlin.py is the differential oracle; merlin spec
+ * merlin.cool, STROBE spec strobe.sourceforge.io; reference consumer:
+ * crypto/sr25519/batch.go via curve25519-voi's schnorrkel). Keccak
+ * round constants / rotation schedule are the published FIPS-202
+ * values (keccakf_core.h, the ONE permutation shared with keccakf.c).
+ * Lanes go through the endian-neutral byte helpers like the rest of
+ * the file. */
+
+#include "keccakf_core.h"
+
+static inline void store64_le(uint8_t *b, uint64_t v) {
+    for (int i = 0; i < 8; i++) b[i] = (uint8_t)(v >> (8 * i));
+}
+
+/* STROBE-128: rate 166, the merlin subset (meta-AD, AD, PRF).
+ * Mirrors crypto/merlin.py _Strobe128 exactly — that implementation
+ * reproduces merlin's published test vector and is the differential
+ * oracle for this one (tests/test_sr25519.py). */
+#define STROBE_R 166u
+#define SF_I 0x01u
+#define SF_A 0x02u
+#define SF_C 0x04u
+#define SF_M 0x10u
+#define SF_K 0x20u
+
+/* No cur_flags field: the Python oracle keeps it only to validate
+ * 'more'-continuations, and every STROBE call here is internal with a
+ * fixed operation pattern — there is no continuation to validate. */
+typedef struct {
+    uint8_t st[200];
+    unsigned pos, pos_begin;
+} strobe_t;
+
+static void strobe_runf(strobe_t *s) {
+    uint64_t lanes[25];
+    s->st[s->pos] ^= (uint8_t)s->pos_begin;
+    s->st[s->pos + 1] ^= 0x04;
+    s->st[STROBE_R + 1] ^= 0x80;
+    for (int i = 0; i < 25; i++) lanes[i] = load64_le(s->st + 8 * i);
+    tm_keccakf_core(lanes);
+    for (int i = 0; i < 25; i++) store64_le(s->st + 8 * i, lanes[i]);
+    s->pos = 0;
+    s->pos_begin = 0;
+}
+
+static void strobe_absorb(strobe_t *s, const uint8_t *d, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        s->st[s->pos++] ^= d[i];
+        if (s->pos == STROBE_R) strobe_runf(s);
+    }
+}
+
+static void strobe_begin(strobe_t *s, uint8_t flags) {
+    uint8_t hdr[2];
+    hdr[0] = (uint8_t)s->pos_begin;
+    hdr[1] = flags;
+    s->pos_begin = s->pos + 1;
+    strobe_absorb(s, hdr, 2);
+    if ((flags & (SF_C | SF_K)) && s->pos != 0) strobe_runf(s);
+}
+
+static void strobe_meta_ad(strobe_t *s, const uint8_t *d, size_t n,
+                           int more) {
+    if (!more) strobe_begin(s, SF_M | SF_A);
+    strobe_absorb(s, d, n);
+}
+
+static void strobe_ad(strobe_t *s, const uint8_t *d, size_t n) {
+    strobe_begin(s, SF_A);
+    strobe_absorb(s, d, n);
+}
+
+static void strobe_prf(strobe_t *s, uint8_t *out, size_t n) {
+    strobe_begin(s, SF_I | SF_A | SF_C);
+    size_t got = 0;
+    while (got < n) {
+        size_t take = n - got;
+        if (take > STROBE_R - s->pos) take = STROBE_R - s->pos;
+        memcpy(out + got, s->st + s->pos, take);
+        memset(s->st + s->pos, 0, take);
+        s->pos += take;
+        got += take;
+        if (s->pos == STROBE_R) strobe_runf(s);
+    }
+}
+
+static void merlin_append(strobe_t *s, const char *label, size_t llen,
+                          const uint8_t *msg, size_t mlen) {
+    uint8_t le[4];
+    le[0] = (uint8_t)mlen;
+    le[1] = (uint8_t)(mlen >> 8);
+    le[2] = (uint8_t)(mlen >> 16);
+    le[3] = (uint8_t)(mlen >> 24);
+    strobe_meta_ad(s, (const uint8_t *)label, llen, 0);
+    strobe_meta_ad(s, le, 4, 1);
+    strobe_ad(s, msg, mlen);
+}
+
+/* The constant schnorrkel signing-context prefix:
+ * merlin Transcript("SigningContext") + append_message("", "")
+ * (crypto/sr25519.py _signing_transcript; reference privkey.go:16).
+ * Rebuilt per batch call — 3 permutations, negligible — so there is
+ * no shared mutable state to lock. */
+static void merlin_signing_prefix(strobe_t *s) {
+    memset(s, 0, sizeof(*s));
+    s->st[0] = 1;
+    s->st[1] = STROBE_R + 2;
+    s->st[2] = 1;
+    s->st[3] = 0;
+    s->st[4] = 1;
+    s->st[5] = 96;
+    memcpy(s->st + 6, "STROBEv1.0.2", 12);
+    {
+        uint64_t lanes[25];
+        for (int i = 0; i < 25; i++) lanes[i] = load64_le(s->st + 8 * i);
+        tm_keccakf_core(lanes);
+        for (int i = 0; i < 25; i++) store64_le(s->st + 8 * i, lanes[i]);
+    }
+    strobe_meta_ad(s, (const uint8_t *)"Merlin v1.0", 11, 0);
+    merlin_append(s, "dom-sep", 7, (const uint8_t *)"SigningContext", 14);
+    merlin_append(s, "", 0, (const uint8_t *)"", 0);
+}
+
+/* k = merlin challenge mod L for one (pk, R, msg) triple, from a
+ * caller-provided copy of the signing prefix. */
+static void sr_challenge(const strobe_t *prefix, const uint8_t *pk,
+                         const uint8_t *r, const uint8_t *msg, size_t mlen,
+                         uint64_t k[4]) {
+    strobe_t t = *prefix;
+    uint8_t wide[64], le[4] = {64, 0, 0, 0};
+    uint64_t d8[8];
+    merlin_append(&t, "sign-bytes", 10, msg, mlen);
+    merlin_append(&t, "proto-name", 10, (const uint8_t *)"Schnorr-sig", 11);
+    merlin_append(&t, "sign:pk", 7, pk, 32);
+    merlin_append(&t, "sign:R", 6, r, 32);
+    strobe_meta_ad(&t, (const uint8_t *)"sign:c", 6, 0);
+    strobe_meta_ad(&t, le, 4, 1);
+    strobe_prf(&t, wide, 64);
+    for (int w = 0; w < 8; w++) d8[w] = load64_le(wide + 8 * w);
+    sc_mod_l(k, d8, 8);
+}
+
+/* differential test hook: the C challenge vs crypto/sr25519._challenge */
+void tm_sr25519_challenge_test(const uint8_t *pk, const uint8_t *r,
+                               const uint8_t *msg, uint64_t mlen,
+                               uint8_t *out32) {
+    strobe_t prefix;
+    uint64_t k[4];
+    merlin_signing_prefix(&prefix);
+    sr_challenge(&prefix, pk, r, msg, (size_t)mlen, k);
+    sc4_tobytes(out32, k);
+}
+
+/* Whole-batch sr25519 verify with the host prep done natively — the
+ * sr25519 analog of tm_ed25519_verify_full: schnorrkel signature
+ * parsing (v1 marker bit, s < L), merlin challenges, RLC products,
+ * and the cofactored equation over ristretto decoding, in one call.
+ * sigs = n*64 (R||s with the marker bit in s[31]); msgs/moffs/rand16
+ * as in the ed25519 entry. Returns 1 all-valid / 0 invalid-somewhere
+ * (incl. malformed signatures — caller falls back per-signature for
+ * the bitmap) / -1 alloc failure. */
+int tm_sr25519_verify_full(const uint8_t *pks, const uint8_t *sigs,
+                           const uint8_t *msgs, const uint64_t *moffs,
+                           const uint8_t *rand16, uint64_t n) {
+    uint8_t *a_sc = malloc(n * 32);
+    uint8_t *z_sc = malloc(n * 32);
+    uint8_t *r_b = malloc(n * 32);
+    if (!a_sc || !z_sc || !r_b) {
+        free(a_sc);
+        free(z_sc);
+        free(r_b);
+        return -1;
+    }
+    int rc;
+    uint64_t zb[4] = {0, 0, 0, 0};
+    strobe_t prefix;
+    merlin_signing_prefix(&prefix);
+    for (uint64_t i = 0; i < n; i++) {
+        const uint8_t *sig = sigs + 64 * i;
+        uint8_t sb[32];
+        uint64_t s[4], k[4], z[2], a[4], zs[4];
+        if (!(sig[63] & 0x80)) {
+            rc = 0; /* pre-v0.1.1 signature without the marker */
+            goto done;
+        }
+        memcpy(sb, sig + 32, 32);
+        sb[31] &= 0x7f;
+        sc4_frombytes(s, sb);
+        if (sc4_gte(s, SC_L)) {
+            rc = 0; /* non-canonical s */
+            goto done;
+        }
+        sr_challenge(&prefix, pks + 32 * i, sig, msgs + moffs[i],
+                     (size_t)(moffs[i + 1] - moffs[i]), k);
+        z[0] = load64_le(rand16 + 16 * i);
+        z[1] = load64_le(rand16 + 16 * i + 8);
+        sc_mulmod(a, k, z, 2);
+        sc4_tobytes(a_sc + 32 * i, a);
+        sc_mulmod(zs, s, z, 2);
+        sc_addmod(zb, zb, zs);
+        memset(z_sc + 32 * i, 0, 32);
+        memcpy(z_sc + 32 * i, rand16 + 16 * i, 16);
+        memcpy(r_b + 32 * i, sig, 32);
+    }
+    {
+        uint8_t zb_bytes[32];
+        sc4_tobytes(zb_bytes, zb);
+        rc = batch_verify_common(pks, r_b, zb_bytes, a_sc, z_sc, n, 2,
+                                 rist_pre2, rist_fin2);
+    }
+done:
+    free(a_sc);
+    free(z_sc);
+    free(r_b);
+    return rc;
+}

@@ -7,67 +7,17 @@
  * This file is compiled on demand by tendermint_tpu.native (cc -O2
  * -shared) and called through ctypes; Python remains the fallback.
  *
- * Unrolled x5 in the round body; no dependencies beyond stdint.
+ * The permutation itself lives in keccakf_core.h, shared with
+ * ed25519_batch.c's in-kernel STROBE so the two compilation units can
+ * never diverge.
  */
-#include <stdint.h>
+#include "keccakf_core.h"
 
-#define ROTL64(v, n) (((v) << (n)) | ((v) >> (64 - (n))))
-
-static const uint64_t RC[24] = {
-    0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
-    0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
-    0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
-    0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
-    0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
-    0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
-    0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
-    0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL,
-};
-
-/* lane order: st[x + 5*y] (row-major y), little-endian u64 — matches the
- * 200-byte STROBE state viewed as <25Q. */
-void tm_keccakf(uint64_t st[25]) {
-    uint64_t bc[5], t;
-    for (int round = 0; round < 24; round++) {
-        /* theta */
-        for (int i = 0; i < 5; i++)
-            bc[i] = st[i] ^ st[i + 5] ^ st[i + 10] ^ st[i + 15] ^ st[i + 20];
-        for (int i = 0; i < 5; i++) {
-            t = bc[(i + 4) % 5] ^ ROTL64(bc[(i + 1) % 5], 1);
-            for (int j = 0; j < 25; j += 5)
-                st[j + i] ^= t;
-        }
-        /* rho + pi */
-        {
-            static const int piln[24] = {10, 7,  11, 17, 18, 3,  5,  16,
-                                         8,  21, 24, 4,  15, 23, 19, 13,
-                                         12, 2,  20, 14, 22, 9,  6,  1};
-            static const int rotc[24] = {1,  3,  6,  10, 15, 21, 28, 36,
-                                         45, 55, 2,  14, 27, 41, 56, 8,
-                                         25, 43, 62, 18, 39, 61, 20, 44};
-            t = st[1];
-            for (int i = 0; i < 24; i++) {
-                int j = piln[i];
-                bc[0] = st[j];
-                st[j] = ROTL64(t, rotc[i]);
-                t = bc[0];
-            }
-        }
-        /* chi */
-        for (int j = 0; j < 25; j += 5) {
-            for (int i = 0; i < 5; i++)
-                bc[i] = st[j + i];
-            for (int i = 0; i < 5; i++)
-                st[j + i] = bc[i] ^ ((~bc[(i + 1) % 5]) & bc[(i + 2) % 5]);
-        }
-        /* iota */
-        st[0] ^= RC[round];
-    }
-}
+void tm_keccakf(uint64_t st[25]) { tm_keccakf_core(st); }
 
 /* batch variant: n contiguous 25-lane states, one call's ctypes
  * overhead amortized across a whole signature batch. */
 void tm_keccakf_n(uint64_t *st, long n) {
     for (long i = 0; i < n; i++)
-        tm_keccakf(st + 25 * i);
+        tm_keccakf_core(st + 25 * i);
 }

@@ -428,6 +428,10 @@ def test_decoded_point_cache():
 
     if e._native_batch_fn() is None:
         pytest.skip("no native toolchain")
+    import os
+
+    if os.environ.get("TM_TPU_NO_PKCACHE"):
+        pytest.skip("cache disabled via TM_TPU_NO_PKCACHE")
     lib = native.ed25519_batch_lib()
     lib.tm_pk_cache_clear()
 

@@ -346,3 +346,82 @@ def test_single_verify_device_fault_falls_back(monkeypatch):
     # the fault trips the route so later votes skip the device retry
     assert T._SR_WARM is False
     assert not pub.verify_signature(msg, bad)
+
+
+def test_native_merlin_challenge_differential():
+    """The C merlin transcript (STROBE-128 over Keccak-f in
+    native/ed25519_batch.c) must produce bit-identical challenges to
+    the pure-Python oracle (crypto/merlin.py, which reproduces
+    merlin's published test vector) across STROBE rate boundaries
+    (166-byte blocks) and the empty message."""
+    import ctypes
+
+    from tendermint_tpu import native
+    from tendermint_tpu.crypto import sr25519 as sr
+
+    lib = native.ed25519_batch_lib()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    pk = bytes(range(32))
+    r = bytes(reversed(range(32)))
+    for mlen in (0, 1, 17, 120, 165, 166, 167, 300, 1000):
+        msg = ((b"\xa5" * 97 + bytes(range(256))) * 4)[:mlen]
+        assert len(msg) == mlen
+        out = ctypes.create_string_buffer(32)
+        lib.tm_sr25519_challenge_test(pk, r, msg, mlen, out)
+        k_c = int.from_bytes(out.raw, "little")
+        k_py = sr._challenge(sr._signing_transcript(msg), pk, r)
+        assert k_c == k_py, mlen
+
+
+def test_native_sr_full_marker_and_canonicality():
+    """tm_sr25519_verify_full enforces schnorrkel signature rules
+    itself: a missing v1 marker bit or a non-canonical s (>= L) makes
+    the whole batch report invalid, and the per-signature fallback
+    attributes the exact index."""
+    from tendermint_tpu import native
+    from tendermint_tpu.crypto import sr25519 as sr
+
+    if native.ed25519_batch_lib() is None:
+        pytest.skip("no native toolchain")
+    sks = [
+        sr.PrivKeySr25519.from_seed(bytes([i + 1, 0xAB]) + b"\x13" * 30)
+        for i in range(6)
+    ]
+    items = []
+    for i, k in enumerate(sks):
+        m = b"mk-%d" % i
+        items.append((k.pub_key(), m, k.sign(m)))
+    assert sr._native_batch_all_valid(items) is True
+
+    # strip the marker bit from one signature
+    pk, m, s = items[2]
+    bad = s[:63] + bytes([s[63] & 0x7F])
+    tampered = list(items)
+    tampered[2] = (pk, m, bad)
+    assert sr._native_batch_all_valid(tampered) is False
+    bv = sr.Sr25519BatchVerifier()
+    for pk2, m2, s2 in tampered:
+        bv.add(pk2, m2, s2)
+    ok, bits = bv.verify()
+    assert not ok and [i for i, b in enumerate(bits) if not b] == [2]
+
+    # non-canonical s: s' = s + L satisfies the equation mod L, so only
+    # the explicit s < L check rejects it — the classic malleation the
+    # sc4_gte(SC_L) branch exists for. s + L fits in 255 bits, marker
+    # bit intact, so nothing else can catch a regression there.
+    pk, m, s = items[4]
+    s_val = int.from_bytes(
+        bytes([*s[32:63], s[63] & 0x7F]), "little"
+    )
+    mall = (s_val + sr.L).to_bytes(32, "little")
+    assert mall[31] & 0x80 == 0  # still leaves room for the marker
+    mall = bytes([*mall[:31], mall[31] | 0x80])
+    malleated = list(items)
+    malleated[4] = (pk, m, s[:32] + mall)
+    assert sr._native_batch_all_valid(malleated) is False
+    bv = sr.Sr25519BatchVerifier()
+    for pk2, m2, s2 in malleated:
+        bv.add(pk2, m2, s2)
+    ok, bits = bv.verify()
+    assert not ok and [i for i, b in enumerate(bits) if not b] == [4]
