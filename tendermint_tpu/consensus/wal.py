@@ -75,6 +75,17 @@ def _read_record(f: io.BufferedReader) -> Optional[bytes]:
     return payload
 
 
+def _decode_record(payload: bytes):
+    """decode_timed_wal_message, with decode failures (e.g. an unknown
+    message type from a WAL written by a newer binary) re-raised as
+    WALDecodeError so a CRC-valid-but-undecodable record degrades like
+    a torn/corrupt one instead of crashing boot/crash-recovery."""
+    try:
+        return decode_timed_wal_message(payload)
+    except ValueError as e:
+        raise WALDecodeError(f"undecodable record: {e}") from e
+
+
 def iter_wal_records(path: str) -> Iterator[Tuple[int, object]]:
     """Yield (time_ns, msg) from a WAL file, stopping at the first torn
     record (a crash mid-write leaves a torn tail; everything before it is
@@ -83,11 +94,12 @@ def iter_wal_records(path: str) -> Iterator[Tuple[int, object]]:
         while True:
             try:
                 payload = _read_record(f)
+                if payload is None:
+                    return
+                msg = _decode_record(payload)
             except WALDecodeError:
                 return
-            if payload is None:
-                return
-            yield decode_timed_wal_message(payload)
+            yield msg
 
 
 def wal_group_files(path: str) -> list:
@@ -120,11 +132,12 @@ def _read_chunk(path: str) -> Tuple[list, bool]:
         while True:
             try:
                 payload = _read_record(f)
+                if payload is None:
+                    return msgs, True
+                msg = _decode_record(payload)[1]
             except WALDecodeError:
                 return msgs, False
-            if payload is None:
-                return msgs, True
-            msgs.append(decode_timed_wal_message(payload)[1])
+            msgs.append(msg)
 
 
 def iter_wal_group(path: str) -> Iterator[Tuple[int, object]]:
@@ -139,11 +152,12 @@ def iter_wal_group(path: str) -> Iterator[Tuple[int, object]]:
             while True:
                 try:
                     payload = _read_record(f)
+                    if payload is None:
+                        break
+                    msg = _decode_record(payload)
                 except WALDecodeError:
                     return
-                if payload is None:
-                    break
-                yield decode_timed_wal_message(payload)
+                yield msg
 
 
 class WAL(Service):
@@ -167,12 +181,21 @@ class WAL(Service):
         self._f: Optional[io.BufferedWriter] = None
         self._dirty = False
         self._head_size = 0
+        self._prune_pending = False
 
     async def on_start(self) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._truncate_torn_tail()
         self._f = open(self.path, "ab")
         self._head_size = os.path.getsize(self.path)
+        # next rotation index, computed once so _rotate never listdirs
+        rotated = wal_group_files(self.path)[:-1]
+        self._next_chunk_idx = 0
+        if rotated:
+            last = os.path.basename(rotated[-1])
+            self._next_chunk_idx = (
+                int(last[len(os.path.basename(self.path)) + 1:]) + 1
+            )
         self.spawn(self._flush_routine(), "wal-flush")
 
     async def on_stop(self) -> None:
@@ -181,22 +204,32 @@ class WAL(Service):
             os.fsync(self._f.fileno())
             self._f.close()
             self._f = None
+        if self._prune_pending:
+            # settle deferred pruning so a clean shutdown leaves the
+            # group within its size bound
+            self._prune_pending = False
+            self._enforce_total_size()
 
     def _truncate_torn_tail(self) -> None:
-        """Drop a torn final record left by a crash so appends start at a
-        record boundary."""
+        """Drop a torn OR undecodable final record left by a crash (or
+        by a newer binary) so appends start after the last good record
+        — otherwise everything written after the bad record would be
+        invisible to recovery, which stops at the first corruption
+        (reference: wal.go:97-103 repair semantics)."""
         if not os.path.exists(self.path):
             return
         good_end = 0
         with open(self.path, "rb") as f:
             while True:
                 try:
-                    if _read_record(f) is None:
+                    payload = _read_record(f)
+                    if payload is None:
                         break
+                    _decode_record(payload)
                     good_end = f.tell()
                 except WALDecodeError:
                     self.logger.error(
-                        "WAL has a torn tail; truncating",
+                        "WAL has a torn/undecodable tail; truncating",
                         good_bytes=good_end,
                     )
                     break
@@ -238,36 +271,43 @@ class WAL(Service):
         self._dirty = False
 
     async def _flush_routine(self) -> None:
-        """Periodic group flush (reference: wal.go:116 processFlushTicks)."""
+        """Periodic group flush (reference: wal.go:116 processFlushTicks)
+        plus deferred group pruning — the directory scan lives here, off
+        the write path (the reference prunes on a background ticker,
+        group.go processTicks)."""
         import asyncio
 
         while True:
             await asyncio.sleep(FLUSH_INTERVAL_S)
             self.flush_and_sync()
+            if self._prune_pending:
+                self._prune_pending = False
+                self._enforce_total_size()
 
     # -- rotation (autofile-group analog) --
 
     def _rotate(self) -> None:
         """fsync + close the head, rename it to the next `.NNN` chunk,
-        open a fresh head, and prune the oldest chunks past the total
-        size cap (reference: group.go rotateFile + checkTotalSizeLimit
-        :100-160)."""
+        and open a fresh head (reference: group.go rotateFile). The
+        fsync must stay on this path: write_sync's durability promise
+        has to hold for a record that just landed in the rotated-out
+        chunk (its flush_and_sync afterwards only reaches the new
+        head). Pruning — the directory scan — is deferred to the flush
+        routine so the consensus loop doesn't pay it at every 10 MB
+        boundary (reference prunes on a ticker, checkTotalSizeLimit
+        group.go:100-160)."""
         assert self._f is not None
         self._f.flush()
         os.fsync(self._f.fileno())
         self._f.close()
-        rotated = wal_group_files(self.path)[:-1]  # exclude the head
-        next_idx = 0
-        if rotated:
-            last = os.path.basename(rotated[-1])
-            next_idx = int(last[len(os.path.basename(self.path)) + 1:]) + 1
-        target = f"{self.path}.{next_idx:03d}"
+        target = f"{self.path}.{self._next_chunk_idx:03d}"
+        self._next_chunk_idx += 1
         os.replace(self.path, target)
         self._f = open(self.path, "ab")
         self._head_size = 0
         self._dirty = False
         self.logger.info("rotated WAL head", chunk=os.path.basename(target))
-        self._enforce_total_size()
+        self._prune_pending = True
 
     def _enforce_total_size(self) -> None:
         """Delete oldest rotated chunks while the group exceeds

@@ -99,6 +99,11 @@ class ChunkQueue:
         self._check(index)
         self._returned.add(index)
 
+    def is_returned(self, index: int) -> bool:
+        """True once the chunk has been handed to the app and not since
+        discarded/retried (the apply cursor skips returned chunks)."""
+        return index in self._returned
+
     def retry(self, index: int) -> None:
         """Schedule a re-apply WITHOUT refetching (reference chunks.go
         Retry :303-308)."""

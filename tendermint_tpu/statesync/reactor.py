@@ -133,6 +133,10 @@ class StatesyncReactor(Service):
         # discovery pool
         self._snapshots: Dict[Tuple[int, int, bytes], _Snapshot] = {}
         self._rejected: Set[Tuple[int, int, bytes]] = set()
+        # peers the app flagged via ResponseApplySnapshotChunk
+        # .reject_senders — excluded from chunk fetches for the rest of
+        # the restore (reference: syncer.go:431-441)
+        self._rejected_senders: Set[str] = set()
         # in-flight response routing, keyed by (sender_peer, request key)
         self._chunk_waiters: Dict[Tuple, asyncio.Future] = {}
         self._light_waiters: Dict[Tuple[str, int], asyncio.Future] = {}
@@ -414,6 +418,7 @@ class StatesyncReactor(Service):
     ) -> State:
         """reference: syncer.go Sync :263-460."""
         h = snapshot.height
+        self._rejected_senders.clear()  # per-restore, like the syncer's
         self.logger.info(
             "restoring snapshot", height=h, format=snapshot.format,
             chunks=snapshot.chunks,
@@ -526,9 +531,13 @@ class StatesyncReactor(Service):
         async def fetch(index: int) -> None:
             async with sem:
                 for attempt in range(4):
-                    providers = sorted(snapshot.peers)
+                    providers = sorted(
+                        p for p in snapshot.peers
+                        if p not in self._rejected_senders
+                    )
                     if not providers:
-                        # all providers disconnected mid-fetch
+                        # all providers disconnected mid-fetch (or the
+                        # app rejected every remaining sender)
                         raise SyncError("no remaining snapshot providers")
                     peer = random.choice(providers)
                     fut = asyncio.get_event_loop().create_future()
@@ -592,6 +601,10 @@ class StatesyncReactor(Service):
             steps += 1
             if steps > 4 * snapshot.chunks + 16:
                 raise SyncError("app keeps retrying/refetching chunks")
+            if not queue.has(index):
+                # a hole left by a rejected sender's discarded chunks:
+                # refetch from the remaining (non-rejected) providers
+                await self._fetch_chunks(snapshot, queue, indexes=[index])
             res = await self.app.apply_snapshot_chunk(
                 abci.RequestApplySnapshotChunk(
                     index=index,
@@ -600,18 +613,44 @@ class StatesyncReactor(Service):
                 )
             )
             queue.mark_returned(index)
-            if res.refetch_chunks:
-                for r in res.refetch_chunks:
-                    queue.discard(r)
-                await self._fetch_chunks(
-                    snapshot, queue, indexes=res.refetch_chunks
-                )
-            if res.result == abci.APPLY_CHUNK_ACCEPT:
-                continue
+            # senders the app flagged as bad: ban them from further
+            # fetches this restore and drop their not-yet-applied
+            # chunks so re-fetches come from someone else (reference:
+            # syncer.go:431-441 rejectSenders)
+            for bad in res.reject_senders:
+                if not bad:
+                    continue
+                self._rejected_senders.add(bad)
+                for i in range(snapshot.chunks):
+                    if (
+                        queue.has(i)
+                        and queue.sender(i) == bad
+                        and not queue.is_returned(i)
+                    ):
+                        queue.discard(i)
+            # validate refetch indexes BEFORE acting on them: a
+            # misbehaving app must fail the restore as a SyncError,
+            # not crash the reactor with a bare IndexError
+            refetch = []
+            for r in res.refetch_chunks:
+                if not 0 <= r < snapshot.chunks:
+                    raise SyncError(
+                        f"app requested refetch of out-of-range "
+                        f"chunk {r} (snapshot has {snapshot.chunks})"
+                    )
+                refetch.append(r)
+            for r in refetch:
+                queue.discard(r)
+            # terminal results first: an ABORT/REJECT must not trigger
+            # a round of network fetches that gets thrown away
+            if res.result not in (
+                abci.APPLY_CHUNK_ACCEPT, abci.APPLY_CHUNK_RETRY
+            ):
+                raise SyncError(f"chunk {index} rejected: {res.result}")
+            if refetch:
+                await self._fetch_chunks(snapshot, queue, indexes=refetch)
             if res.result == abci.APPLY_CHUNK_RETRY:
                 queue.retry(index)
-                continue
-            raise SyncError(f"chunk {index} rejected: {res.result}")
 
     async def _fetch_light_block(
         self, height: int, peers: Set[str]

@@ -427,3 +427,52 @@ def test_wal_generator_produces_replayable_log(tmp_path):
     w = WAL(wal_path)
     tail = w.search_for_end_height(max(end_heights))
     assert tail is not None
+
+
+def test_wal_unknown_message_type_degrades_as_corruption(tmp_path):
+    """A CRC-valid record whose payload doesn't decode (e.g. a WAL
+    written by a newer binary with a new message type) must degrade
+    like a torn/corrupt record — readers stop there — instead of
+    crashing boot/crash-recovery with a ValueError (ADVICE r4)."""
+    import zlib
+
+    from tendermint_tpu.consensus.wal import _frame, iter_wal_group
+
+    path = wal_path(tmp_path)
+
+    async def go():
+        w = WAL(path)
+        await w.start()
+        w.write(MsgInfo(msg=HasVoteMessage(
+            height=1, round=0, type=PREVOTE_TYPE, index=0
+        )))
+        w.write_end_height(1)
+        await w.stop()
+        return w
+
+    w = run(go())
+    # append a CRC-valid but undecodable record (unknown type tag)
+    garbage = b"\xfe\xfd" + b"\x99" * 40
+    with open(path, "ab") as f:
+        f.write(_frame(garbage))
+    assert zlib.crc32(garbage)  # sanity: the frame really is CRC-valid
+
+    # all readers stop at the undecodable record without raising
+    msgs = list(iter_wal_records(path))
+    assert len(msgs) == 2
+    assert list(iter_wal_group(path)) == msgs
+    # group search (boot/crash recovery path) survives too
+    assert w.search_for_end_height(1) == []
+
+    # a node restart repairs the tail (truncates the undecodable
+    # record, like the reference's corruption repair) so new records
+    # land after the good prefix and stay reachable
+    async def go2():
+        w2 = WAL(path)
+        await w2.start()
+        w2.write_end_height(2)
+        await w2.stop()
+        return w2
+
+    w2 = run(go2())
+    assert w2.search_for_end_height(2) is not None

@@ -6,6 +6,8 @@ reactor_test.go)."""
 import asyncio
 import time
 
+import pytest
+
 from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
 from tendermint_tpu.p2p.p2ptest import TestNetwork
 from tendermint_tpu.statesync import (
@@ -376,5 +378,238 @@ def test_backfill_stores_prior_headers():
                 await v.stop()
             await fresh.stop()
             await net.stop()
+
+    run(go())
+
+
+def _bare_reactor():
+    from tendermint_tpu.statesync.reactor import (
+        CHUNK_CHANNEL,
+        LIGHT_BLOCK_CHANNEL,
+        PARAMS_CHANNEL,
+        SNAPSHOT_CHANNEL,
+        StatesyncReactor,
+    )
+
+    return StatesyncReactor(
+        CHAIN, None, None, None, None,
+        {
+            SNAPSHOT_CHANNEL: None, CHUNK_CHANNEL: None,
+            LIGHT_BLOCK_CHANNEL: None, PARAMS_CHANNEL: None,
+        },
+        asyncio.Queue(),
+    )
+
+
+def test_apply_chunks_terminal_result_skips_refetch():
+    """A terminal ABORT/REJECT answer fails the restore BEFORE any
+    refetch goes to the network — fetches triggered after a terminal
+    result would be thrown away (ADVICE r4; reference: syncer.go
+    applyChunks checks results before honoring refetch)."""
+
+    async def go():
+        from tendermint_tpu.abci import types as abci
+        from tendermint_tpu.statesync.chunks import ChunkQueue
+        from tendermint_tpu.statesync.reactor import SyncError, _Snapshot
+
+        reactor = _bare_reactor()
+        snapshot = _Snapshot(
+            height=5, format=1, chunks=3, hash=b"h", metadata=b"",
+            peers={"p1"},
+        )
+        fetches = []
+
+        async def fake_fetch(snap, queue, indexes=None):
+            fetches.append(list(indexes) if indexes is not None else "all")
+            for i in (indexes if indexes is not None else range(3)):
+                queue.put(i, b"c%d" % i, sender="p1")
+
+        reactor._fetch_chunks = fake_fetch
+
+        class App:
+            async def apply_snapshot_chunk(self, req):
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_CHUNK_ABORT,
+                    refetch_chunks=(0, 1),
+                )
+
+        reactor.app = App()
+        queue = ChunkQueue(3)
+        try:
+            await reactor._fetch_chunks(snapshot, queue, indexes=range(3))
+            with pytest.raises(SyncError):
+                await reactor._apply_chunks(snapshot, queue)
+        finally:
+            queue.close()
+        # only the initial fetch — the refetch after ABORT never ran
+        assert fetches == [[0, 1, 2]], fetches
+
+    run(go())
+
+
+def test_apply_chunks_out_of_range_refetch_is_sync_error():
+    """A misbehaving app naming an out-of-range refetch index fails the
+    restore as a SyncError instead of crashing the reactor with a bare
+    IndexError (ADVICE r4)."""
+
+    async def go():
+        from tendermint_tpu.abci import types as abci
+        from tendermint_tpu.statesync.chunks import ChunkQueue
+        from tendermint_tpu.statesync.reactor import SyncError, _Snapshot
+
+        reactor = _bare_reactor()
+        snapshot = _Snapshot(
+            height=5, format=1, chunks=2, hash=b"h", metadata=b"",
+            peers={"p1"},
+        )
+
+        async def fake_fetch(snap, queue, indexes=None):
+            for i in (indexes if indexes is not None else range(2)):
+                queue.put(i, b"c%d" % i, sender="p1")
+
+        reactor._fetch_chunks = fake_fetch
+
+        class App:
+            async def apply_snapshot_chunk(self, req):
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_CHUNK_ACCEPT,
+                    refetch_chunks=(7,),
+                )
+
+        reactor.app = App()
+        queue = ChunkQueue(2)
+        try:
+            await reactor._fetch_chunks(snapshot, queue, indexes=range(2))
+            with pytest.raises(SyncError, match="out-of-range"):
+                await reactor._apply_chunks(snapshot, queue)
+        finally:
+            queue.close()
+
+    run(go())
+
+
+def test_apply_chunks_reject_senders_banned_and_refetched():
+    """ResponseApplySnapshotChunk.reject_senders bans the flagged peer
+    for the rest of the restore — its pending chunks are discarded and
+    re-fetched from other providers, and the fetch path skips it
+    (ADVICE r4; reference: syncer.go:431-441)."""
+
+    async def go():
+        from tendermint_tpu.abci import types as abci
+        from tendermint_tpu.statesync.chunks import ChunkQueue
+        from tendermint_tpu.statesync.reactor import _Snapshot
+
+        reactor = _bare_reactor()
+        snapshot = _Snapshot(
+            height=5, format=1, chunks=3, hash=b"h", metadata=b"",
+            peers={"good", "bad"},
+        )
+        refetched = []
+
+        async def fake_fetch(snap, queue, indexes=None):
+            # mirrors the real fetch path's sender filter
+            providers = [
+                p for p in sorted(snap.peers)
+                if p not in reactor._rejected_senders
+            ]
+            for i in (indexes if indexes is not None else range(3)):
+                refetched.append((i, tuple(providers)))
+                queue.put(i, b"fresh-%d" % i, sender=providers[0])
+
+        reactor._fetch_chunks = fake_fetch
+
+        seen = []
+
+        class App:
+            async def apply_snapshot_chunk(self, req):
+                seen.append((req.index, req.sender, req.chunk))
+                if req.index == 0:
+                    # chunk 0 is fine but the app flags peer "bad"
+                    return abci.ResponseApplySnapshotChunk(
+                        result=abci.APPLY_CHUNK_ACCEPT,
+                        reject_senders=("bad",),
+                    )
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_CHUNK_ACCEPT
+                )
+
+        reactor.app = App()
+        queue = ChunkQueue(3)
+        try:
+            # initial state: chunk 0 from "good", 1 and 2 from "bad"
+            queue.put(0, b"ok-0", sender="good")
+            queue.put(1, b"bad-1", sender="bad")
+            queue.put(2, b"bad-2", sender="bad")
+            await reactor._apply_chunks(snapshot, queue)
+        finally:
+            queue.close()
+
+        assert "bad" in reactor._rejected_senders
+        # chunks 1 and 2 were re-fetched with "bad" excluded
+        assert refetched == [(1, ("good",)), (2, ("good",))], refetched
+        # the app never saw the rejected sender's payloads again
+        assert seen[0] == (0, "good", b"ok-0")
+        assert seen[1:] == [
+            (1, "good", b"fresh-1"), (2, "good", b"fresh-2")
+        ], seen
+
+    run(go())
+
+
+def test_fetch_chunks_real_path_skips_rejected_senders():
+    """The REAL _fetch_chunks provider loop (not a stub) excludes
+    rejected senders: with one peer banned, every chunk request goes to
+    the remaining provider; with all peers banned it raises SyncError
+    instead of asking the banned peer again."""
+
+    async def go():
+        from tendermint_tpu.statesync.reactor import SyncError, _Snapshot
+
+        reactor = _bare_reactor()
+        snapshot = _Snapshot(
+            height=5, format=1, chunks=2, hash=b"h", metadata=b"",
+            peers={"good", "bad"},
+        )
+        reactor._rejected_senders.add("bad")
+        asked = []
+
+        class ChunkCh:
+            def try_send(self, env):
+                asked.append(env.to)
+                # resolve the matching waiter like the network would
+                key = (
+                    env.to, env.message.height, env.message.format,
+                    env.message.index,
+                )
+                fut = reactor._chunk_waiters.pop(key)
+
+                class Res:
+                    missing = False
+                    chunk = b"payload-%d" % env.message.index
+
+                fut.set_result(Res())
+
+        reactor.chunk_ch = ChunkCh()
+
+        from tendermint_tpu.statesync.chunks import ChunkQueue
+
+        queue = ChunkQueue(2)
+        try:
+            await reactor._fetch_chunks(snapshot, queue)
+            assert queue.has(0) and queue.has(1)
+        finally:
+            queue.close()
+        assert asked and all(p == "good" for p in asked), asked
+
+        # all providers banned -> SyncError, no request to anyone
+        reactor._rejected_senders.add("good")
+        asked.clear()
+        queue2 = ChunkQueue(1)
+        try:
+            with pytest.raises(SyncError, match="no remaining"):
+                await reactor._fetch_chunks(snapshot, queue2)
+        finally:
+            queue2.close()
+        assert asked == []
 
     run(go())
