@@ -89,11 +89,18 @@ def cmd_init(args) -> int:
 
 
 def cmd_start(args) -> int:
+    from ..libs.log import configure
     from ..node import make_node
 
     cfg = _load_home(args.home)
     if args.moniker:
         cfg.base.moniker = args.moniker
+    # without this, a started node emits nothing below WARNING —
+    # unusable for operators and for e2e post-mortems
+    configure(
+        level=cfg.base.log_level,
+        json_format=cfg.base.log_format == "json",
+    )
 
     async def run() -> None:
         node = make_node(cfg)
@@ -864,8 +871,14 @@ def cmd_e2e(args) -> int:
     import tempfile
 
     home = args.home_dir or tempfile.mkdtemp(prefix="tt-e2e-")
-    print(f"running {m.chain_id}: {len(m.nodes)} nodes -> {home}")
-    rep = run_manifest(m, home, timeout=args.timeout)
+    mode = "processes" if args.processes else "in-process"
+    print(f"running {m.chain_id}: {len(m.nodes)} nodes ({mode}) -> {home}")
+    if args.processes:
+        from ..e2e.process_runner import run_manifest_processes
+
+        rep = run_manifest_processes(m, home, timeout=args.timeout)
+    else:
+        rep = run_manifest(m, home, timeout=args.timeout)
     print(
         json.dumps(
             {
@@ -1379,6 +1392,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--seed", type=int, default=1)
     sp.add_argument("--count", type=int, default=4)
     sp.add_argument("--output-dir", "-o", default="./e2e-manifests")
+    sp.add_argument(
+        "--processes",
+        action="store_true",
+        help="run each node as a separate OS process over TCP with a "
+        "socket ABCI app; perturbations use real signals "
+        "(SIGKILL/SIGSTOP)",
+    )
     sp.set_defaults(fn=cmd_e2e)
 
     sp = sub.add_parser(

@@ -82,7 +82,9 @@ def _decode_record(payload: bytes):
     a torn/corrupt one instead of crashing boot/crash-recovery."""
     try:
         return decode_timed_wal_message(payload)
-    except ValueError as e:
+    except (ValueError, TypeError, KeyError, IndexError, struct.error) as e:
+        # any shape of malformed-but-CRC-valid payload (wrong wire
+        # type, truncated field, unknown message tag) is corruption
         raise WALDecodeError(f"undecodable record: {e}") from e
 
 

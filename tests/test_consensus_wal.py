@@ -451,6 +451,18 @@ def test_wal_unknown_message_type_degrades_as_corruption(tmp_path):
         return w
 
     w = run(go())
+    # every shape of CRC-valid-but-undecodable payload maps to
+    # WALDecodeError: unknown type tag (ValueError) and a timestamp
+    # field with the wrong wire type (TypeError in the decoder)
+    from tendermint_tpu.consensus.wal import _decode_record
+
+    for payload in (
+        b"\xfe\xfd" + b"\x99" * 40,
+        b"\x08\x01\x12\x04\x0a\x02\x08\x01",
+    ):
+        with pytest.raises(WALDecodeError):
+            _decode_record(payload)
+
     # append a CRC-valid but undecodable record (unknown type tag)
     garbage = b"\xfe\xfd" + b"\x99" * 40
     with open(path, "ab") as f:

@@ -1,0 +1,109 @@
+"""Real-process e2e: separate OS processes, TCP p2p, socket ABCI,
+real signals (reference: test/e2e/runner/perturb.go:43-77).
+
+These spawn actual `python -m tendermint_tpu.cmd start` subprocesses —
+minutes, not seconds — so they carry the slow marker. They are the
+only tests where SIGKILL'd-for-real WAL recovery and ABCI handshake
+replay against a surviving app process are exercised end-to-end.
+"""
+
+import asyncio
+import os
+import signal
+
+import pytest
+
+from tendermint_tpu.e2e.manifest import Manifest
+from tendermint_tpu.e2e.process_runner import ProcessRunner
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.mark.slow
+def test_process_net_converges(tmp_path):
+    """A 2-validator process net reaches its target height; invariants
+    (hash agreement over RPC) and the block-interval benchmark hold."""
+    m = Manifest(
+        chain_id="proc-ci",
+        validators={"v0": 10, "v1": 10},
+        target_height=4,
+    )
+    m.validate()
+    rep = run(ProcessRunner(m, str(tmp_path), timeout=150.0).run())
+    assert rep.ok, rep.failures
+    assert rep.reached_height >= 4
+    assert rep.blocks >= 3
+
+
+@pytest.mark.slow
+def test_process_net_sigkill_recovery(tmp_path):
+    """SIGKILL one of four validators mid-run: the dead process's WAL
+    and sqlite stores are reopened by a fresh process, the ABCI
+    handshake replays against the still-running app, and the network
+    converges with no fork (the crash path the in-process runner
+    cannot exercise)."""
+    m = Manifest.parse(
+        {
+            "chain_id": "proc-kill-ci",
+            "target_height": 5,
+            "validators": {"v0": 10, "v1": 10, "v2": 10, "v3": 10},
+            "node": {"v1": {"perturb": ["kill:2"]}},
+            "load": {"tx_rate": 1, "tx_size": 48},
+        }
+    )
+    m.validate()
+    runner = ProcessRunner(m, str(tmp_path), timeout=220.0)
+    rep = run(runner.run())
+    assert rep.ok, rep.failures
+    assert rep.reached_height >= 5
+    # the kill really happened: the first node process is dead and a
+    # different pid carried the node to the end
+    log = open(
+        os.path.join(str(tmp_path), "v1", "node.log"), "rb"
+    ).read()
+    # "completed ABCI handshake" appears exactly once per successful
+    # boot (replay.py) — two completions prove the post-SIGKILL
+    # process really re-handshook ("ABCI handshake" alone would match
+    # twice in a single boot)
+    assert log.count(b"completed ABCI handshake") >= 2, (
+        "expected a second completed handshake from the post-SIGKILL "
+        "process"
+    )
+    assert rep.txs_submitted > 0 and rep.txs_committed > 0
+
+
+def test_process_runner_rejects_inprocess_only_features(tmp_path):
+    m = Manifest.parse(
+        {
+            "chain_id": "p",
+            "validators": {"v0": 10},
+            "node": {"v0": {"misbehaviors": {"double-prevote": 3}}},
+        }
+    )
+    with pytest.raises(ValueError, match="in-process"):
+        ProcessRunner(m, str(tmp_path))
+
+
+def test_child_env_strips_device_plugin():
+    """Child node processes must never touch the TPU tunnel: the axon
+    site dir is stripped and JAX_PLATFORMS pinned to cpu."""
+    from tendermint_tpu.e2e.process_runner import _child_env
+
+    env = _child_env()
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert ".axon_site" not in env.get("PYTHONPATH", "")
+
+
+def test_perturbation_signals_map():
+    """kill/restart/pause/disconnect all map to real signals in the
+    process runner (SIGKILL / SIGTERM / SIGSTOP+SIGCONT)."""
+    import inspect
+
+    from tendermint_tpu.e2e import process_runner as pr
+
+    src = inspect.getsource(pr.ProcessRunner._apply_perturbation)
+    assert "SIGKILL" in src and "SIGTERM" in src
+    assert "SIGSTOP" in src and "SIGCONT" in src
+    assert signal.SIGKILL  # the platform actually has them
