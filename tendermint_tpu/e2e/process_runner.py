@@ -369,22 +369,30 @@ class ProcessRunner:
                     and not pending_starts
                     and not schedule
                 ):
-                    # a live node whose RPC doesn't answer (-1) IS a
-                    # laggard: a restarted process that never recovers
-                    # must hold the run open until the timeout records
-                    # it, not be silently excluded
+                    # a node whose process is alive but mute — RPC not
+                    # answering (-1) or SIGSTOP'd (paused) — IS a
+                    # laggard: a process that never recovers must hold
+                    # the run open until the timeout records it, not
+                    # be silently excluded from convergence
                     laggard = False
                     for h in self.handles.values():
-                        if h.live and await self._height_of(h) < (
-                            self.m.target_height
+                        alive = (
+                            h.node_proc is not None
+                            and h.node_proc.poll() is None
+                        )
+                        if alive and (
+                            h.paused
+                            or await self._height_of(h)
+                            < self.m.target_height
                         ):
                             laggard = True
                     if not laggard:
                         break
         finally:
             load_task.cancel()
-            for t in self._resume_tasks:
-                t.cancel()
+            # resume tasks are AWAITED, not cancelled: a cancelled
+            # resume leaves its node SIGSTOP'd and invisible to the
+            # invariant checks below (their holds are bounded <=8 s)
             await asyncio.gather(
                 load_task, *self._resume_tasks, return_exceptions=True
             )

@@ -12,8 +12,8 @@ add) shows up as one seed committing a different block or deadlocking.
 """
 
 import asyncio
-import random
 
+from tendermint_tpu.libs.schedulefuzz import Schedule, explore
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
 
@@ -29,13 +29,12 @@ def test_commit_is_schedule_independent():
     orders (votes shuffled, some duplicated, prevotes/precommits
     interleaved): every schedule must commit cs1's proposal B1."""
 
-    async def one_schedule(seed: int) -> bytes:
+    async def scenario(sched: Schedule) -> bytes:
         h = LockHarness(seed_base=200)
         await h.cs.start()
         try:
             prevote = await h.wait_own_vote(PREVOTE_TYPE, 0)
             b1 = prevote.block_id
-            rng = random.Random(seed)
             # the full honest-stub schedule: every stub prevotes and
             # precommits B1. Each vote is signed ONCE; duplicated plan
             # entries redeliver the identical signed vote object —
@@ -46,28 +45,19 @@ def test_commit_is_schedule_independent():
                 plan.append(
                     await h.make_vote(priv, PRECOMMIT_TYPE, 0, b1)
                 )
-            plan += [plan[rng.randrange(len(plan))] for _ in range(4)]
-            rng.shuffle(plan)
-            for vote in plan:
+            for vote in sched.with_dups(sched.shuffled(plan), 4):
                 h.send_vote(vote)
-                if rng.random() < 0.5:
-                    await asyncio.sleep(0)  # yield: vary interleaving
+                await sched.yield_point()
             await wait_for(
                 lambda: h.node.block_store.height() >= 1,
                 timeout=30.0,
-                what=f"commit under schedule {seed}",
+                what=f"commit under schedule {sched.seed}",
             )
             return h.node.block_store.load_block(1).hash()
         finally:
             await h.cs.stop()
 
-    async def go():
-        hashes = set()
-        for seed in range(8):
-            hashes.add(await one_schedule(seed))
-        assert len(hashes) == 1, "commit depended on delivery schedule"
-
-    run(go())
+    run(explore(scenario, schedules=8, base_seed=0))
 
 
 def test_lock_outcome_schedule_independent_across_rounds():
@@ -77,13 +67,12 @@ def test_lock_outcome_schedule_independent_across_rounds():
     B1 committed at round >= 1 (timing may let a schedule slip an extra
     round; safety — same block — is what ordering must never change)."""
 
-    async def one_schedule(seed: int) -> bytes:
+    async def scenario(sched: Schedule) -> bytes:
         h = LockHarness(seed_base=210)
         await h.cs.start()
         try:
             prevote = await h.lock_b1_round0()
             b1 = prevote.block_id
-            rng = random.Random(seed)
             await h.push_to_round1_nil_precommits()
             plan = []
             for priv in h.stubs:
@@ -91,16 +80,13 @@ def test_lock_outcome_schedule_independent_across_rounds():
                 plan.append(
                     await h.make_vote(priv, PRECOMMIT_TYPE, 1, b1)
                 )
-            plan += [plan[rng.randrange(len(plan))] for _ in range(3)]
-            rng.shuffle(plan)
-            for vote in plan:
+            for vote in sched.with_dups(sched.shuffled(plan), 3):
                 h.send_vote(vote)
-                if rng.random() < 0.5:
-                    await asyncio.sleep(0)
+                await sched.yield_point()
             await wait_for(
                 lambda: h.node.block_store.height() >= 1,
                 timeout=30.0,
-                what=f"relock commit under schedule {seed}",
+                what=f"relock commit under schedule {sched.seed}",
             )
             block = h.node.block_store.load_block(1)
             assert block.hash() == b1.hash
@@ -110,11 +96,7 @@ def test_lock_outcome_schedule_independent_across_rounds():
         finally:
             await h.cs.stop()
 
-    async def go():
-        hashes = {await one_schedule(seed) for seed in range(6)}
-        assert len(hashes) == 1
-
-    run(go())
+    run(explore(scenario, schedules=6, base_seed=0))
 
 
 def test_future_round_votes_before_current_round_votes():
@@ -144,3 +126,380 @@ def test_future_round_votes_before_current_round_votes():
             await h.cs.stop()
 
     run(go())
+
+
+# ---- beyond consensus -----------------------------------------------
+# mempool update/reap/recheck, statesync chunk ingestion, peer-manager
+# lifecycles, vote-set ingestion, pubsub fan-out — all through the
+# same seeded explorer. Every failure prints the reproducing seed.
+
+
+def test_mempool_update_reap_schedule_independent():
+    """check_tx / update(commit) / reap interleaved in seeded orders:
+    the final pool content must always be exactly the un-committed
+    txs — no schedule may let a committed tx survive or re-enter."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config import MempoolConfig
+    from tendermint_tpu.mempool.mempool import TxMempool
+
+    committed = [b"c%d=1" % i for i in range(4)]
+    others = [b"o%d=1" % i for i in range(8)]
+
+    async def scenario(sched):
+        mp = TxMempool(LocalClient(KVStoreApplication()), MempoolConfig())
+
+        async def check(tx):
+            try:
+                await mp.check_tx(tx)
+            except Exception:
+                pass
+            await sched.yield_point()
+
+        async def do_update():
+            await mp.update(
+                1,
+                committed,
+                [abci.ResponseDeliverTx() for _ in committed],
+            )
+            await sched.yield_point()
+
+        async def do_reap():
+            mp.reap_max_txs(5)
+            await sched.yield_point()
+
+        # per-source FIFO: the commit sequence checks its txs before
+        # the update that commits them (as the chain would), the other
+        # txs and reaps land wherever the schedule puts them
+        plan = sched.interleave(
+            [lambda tx=tx: check(tx) for tx in committed] + [do_update],
+            [lambda tx=tx: check(tx) for tx in others],
+            [do_reap, do_reap],
+        )
+        for thunk in plan:
+            await thunk()
+        return tuple(sorted(mp.reap_max_txs(-1)))
+
+    final = run(explore(scenario, schedules=8, base_seed=300))
+    assert final == tuple(sorted(others))
+
+
+def test_mempool_recheck_schedule_independent():
+    """Same shape with recheck enabled and a second commit: rechecks
+    triggered by each update must not eat, duplicate, or resurrect
+    txs regardless of interleaving."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.config import MempoolConfig
+    from tendermint_tpu.mempool.mempool import TxMempool
+
+    batch1 = [b"r%d=1" % i for i in range(3)]
+    batch2 = [b"s%d=1" % i for i in range(3)]
+    keep = [b"k%d=1" % i for i in range(5)]
+
+    async def scenario(sched):
+        cfg = MempoolConfig()
+        cfg.recheck = True
+        mp = TxMempool(LocalClient(KVStoreApplication()), cfg)
+
+        async def check(tx):
+            try:
+                await mp.check_tx(tx)
+            except Exception:
+                pass
+            await sched.yield_point()
+
+        async def update(height, txs):
+            await mp.update(
+                height, txs, [abci.ResponseDeliverTx() for _ in txs]
+            )
+            await sched.yield_point()
+
+        plan = sched.interleave(
+            [lambda tx=tx: check(tx) for tx in batch1]
+            + [lambda: update(1, batch1)],
+            [lambda tx=tx: check(tx) for tx in batch2]
+            + [lambda: update(2, batch2)],
+            [lambda tx=tx: check(tx) for tx in keep],
+        )
+        for thunk in plan:
+            await thunk()
+        return tuple(sorted(mp.reap_max_txs(-1)))
+
+    final = run(explore(scenario, schedules=8, base_seed=310))
+    assert final == tuple(sorted(keep))
+
+
+def test_statesync_chunk_ingestion_schedule_independent():
+    """Chunks arriving in any order, with duplicates and one hole
+    filled by refetch: the app must receive indices strictly in order,
+    each exactly once."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.statesync.chunks import ChunkQueue
+    from tendermint_tpu.statesync.reactor import _Snapshot
+
+    from tests.test_statesync import _bare_reactor
+
+    async def scenario(sched):
+        reactor = _bare_reactor()
+        snapshot = _Snapshot(
+            height=7, format=1, chunks=8, hash=b"h", metadata=b"",
+            peers={"p"},
+        )
+
+        async def fake_fetch(snap, queue, indexes=None):
+            for i in (
+                indexes if indexes is not None else range(snap.chunks)
+            ):
+                queue.put(i, b"chunk-%d" % i, sender="p")
+
+        reactor._fetch_chunks = fake_fetch
+        applied = []
+
+        class App:
+            async def apply_snapshot_chunk(self, req):
+                applied.append(req.index)
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_CHUNK_ACCEPT
+                )
+
+        reactor.app = App()
+        queue = ChunkQueue(8)
+        try:
+            # arrival: shuffled, duplicated, one index withheld (the
+            # apply loop's hole-refetch must fill it)
+            hole = sched.rng.randrange(8)
+            arrivals = sched.with_dups(
+                sched.shuffled(i for i in range(8) if i != hole), 3
+            )
+            for i in arrivals:
+                queue.put(i, b"chunk-%d" % i, sender="p")
+                await sched.yield_point()
+            await reactor._apply_chunks(snapshot, queue)
+        finally:
+            queue.close()
+        return tuple(applied)
+
+    order = run(explore(scenario, schedules=8, base_seed=320))
+    assert order == tuple(range(8))
+
+
+def test_statesync_refetch_retry_schedule_independent():
+    """A deterministic app control script (RETRY chunk 2 once, refetch
+    chunk 1 once) must produce the same apply sequence no matter the
+    arrival order of the chunks."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.statesync.chunks import ChunkQueue
+    from tendermint_tpu.statesync.reactor import _Snapshot
+
+    from tests.test_statesync import _bare_reactor
+
+    async def scenario(sched):
+        reactor = _bare_reactor()
+        snapshot = _Snapshot(
+            height=7, format=1, chunks=4, hash=b"h", metadata=b"",
+            peers={"p"},
+        )
+
+        async def fake_fetch(snap, queue, indexes=None):
+            for i in (
+                indexes if indexes is not None else range(snap.chunks)
+            ):
+                queue.put(i, b"chunk-%d" % i, sender="p")
+                await sched.yield_point()
+
+        reactor._fetch_chunks = fake_fetch
+        applied = []
+        fired = set()
+
+        class App:
+            async def apply_snapshot_chunk(self, req):
+                applied.append(req.index)
+                if req.index == 2 and "retry" not in fired:
+                    fired.add("retry")
+                    return abci.ResponseApplySnapshotChunk(
+                        result=abci.APPLY_CHUNK_RETRY,
+                        refetch_chunks=(1,),
+                    )
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.APPLY_CHUNK_ACCEPT
+                )
+
+        reactor.app = App()
+        queue = ChunkQueue(4)
+        try:
+            for i in sched.shuffled(range(4)):
+                queue.put(i, b"chunk-%d" % i, sender="p")
+                await sched.yield_point()
+            await reactor._apply_chunks(snapshot, queue)
+        finally:
+            queue.close()
+        return tuple(applied)
+
+    order = run(explore(scenario, schedules=8, base_seed=330))
+    # 0,1,2 -> RETRY(2)+refetch(1) rewinds the cursor to 1 -> 1,2,3
+    assert order == (0, 1, 2, 1, 2, 3)
+
+
+def test_peermanager_lifecycles_schedule_independent():
+    """Per-peer lifecycle events (accepted -> ready -> errored ->
+    disconnected) interleaved across six peers in seeded orders: no
+    ordering may corrupt the manager (phantom connections, stuck
+    evictions, crashes)."""
+    from tendermint_tpu.p2p.peermanager import (
+        PeerManager,
+        PeerManagerOptions,
+    )
+
+    async def scenario(sched):
+        pm = PeerManager(
+            "00" * 20,
+            PeerManagerOptions(max_connected=16),
+        )
+        peers = ["%02d" % (i + 1) * 20 for i in range(6)]
+
+        def lifecycle(pid, evil):
+            steps = [
+                lambda: pm.accepted(pid),
+                lambda: pm.ready(pid),
+            ]
+            if evil:
+                steps.append(lambda: pm.errored(pid, "misbehavior"))
+            steps.append(lambda: pm.disconnected(pid))
+            return steps
+
+        seqs = [
+            lifecycle(pid, evil=(i % 2 == 0))
+            for i, pid in enumerate(peers)
+        ]
+        for step in sched.interleave(*seqs):
+            step()
+            await sched.yield_point()
+        assert pm.num_connected() == 0, "phantom connection"
+        # every errored peer's eviction was scheduled; drain them
+        drained = 0
+        while not pm._evict_queue.empty():
+            pm._evict_queue.get_nowait()
+            drained += 1
+        assert drained == 3
+        return "ok"
+
+    run(explore(scenario, schedules=10, base_seed=340))
+
+
+def test_vote_set_ingestion_schedule_independent():
+    """VoteSet ingestion (types/vote_set.go:143-300 analog): the same
+    prevotes delivered shuffled + duplicated must always yield the
+    same 2/3 majority and bit array."""
+    import time as _time
+
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    privs = [
+        PrivKeyEd25519.from_seed(bytes([i + 1, 0x77]) + b"\x31" * 30)
+        for i in range(7)
+    ]
+    vals = ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+    )
+    order = {v.address: i for i, v in enumerate(vals.validators)}
+    bid = BlockID(
+        hash=b"\x61" * 32,
+        part_set_header=PartSetHeader(total=1, hash=b"\x62" * 32),
+    )
+    now = _time.time_ns()
+    votes = []
+    for p in privs[:5]:  # 50/70 power > 2/3
+        addr = p.pub_key().address()
+        v = Vote(
+            type=PREVOTE_TYPE,
+            height=3,
+            round=0,
+            block_id=bid,
+            timestamp_ns=now,
+            validator_address=addr,
+            validator_index=order[addr],
+        )
+        v.signature = p.sign(v.sign_bytes("sf-chain"))
+        votes.append(v)
+
+    async def scenario(sched):
+        vs = VoteSet("sf-chain", 3, 0, PREVOTE_TYPE, vals)
+        for v in sched.with_dups(sched.shuffled(votes), 4):
+            vs.add_vote(v)
+            await sched.yield_point()
+        maj, ok = vs.two_thirds_majority()
+        return (ok, maj.hash, str(vs.votes_bit_array))
+
+    ok, maj_hash, _bits = run(
+        explore(scenario, schedules=10, base_seed=350)
+    )
+    assert ok and maj_hash == bid.hash
+
+
+def test_pubsub_fanout_schedule_independent():
+    """Two publishers' event streams interleaved under seeded
+    schedules: each subscriber sees its matching events with
+    per-publisher order preserved."""
+    from tendermint_tpu.pubsub import Server
+
+    async def scenario(sched):
+        srv = Server(name="sf-pubsub")
+        await srv.start()
+        try:
+            sub_a = srv.subscribe("c1", "tm.event = 'A'")
+            sub_all = srv.subscribe("c2", "tm.event EXISTS")
+            pub_a = [("A", i) for i in range(5)]
+            pub_b = [("B", i) for i in range(5)]
+            for ev, i in sched.interleave(pub_a, pub_b):
+                srv.publish((ev, i), {"tm.event": [ev]})
+                await sched.yield_point()
+            got_a = []
+            while not sub_a._queue.empty():
+                got_a.append(sub_a._queue.get_nowait().data)
+            got_all = []
+            while not sub_all._queue.empty():
+                got_all.append(sub_all._queue.get_nowait().data)
+            # subscriber A: exactly the A stream in order
+            assert got_a == pub_a, got_a
+            # subscriber ALL: both streams, each internally in order
+            assert [x for x in got_all if x[0] == "A"] == pub_a
+            assert [x for x in got_all if x[0] == "B"] == pub_b
+            return ("ok", tuple(got_a))
+        finally:
+            await srv.stop()
+
+    run(explore(scenario, schedules=8, base_seed=360))
+
+
+def test_harness_reports_reproducing_seed():
+    """The explorer's failure modes both name the seed: a scenario
+    exception, and an outcome that diverges across schedules."""
+    import pytest
+
+    from tendermint_tpu.libs.schedulefuzz import Schedule
+
+    async def crashes_on_second(sched):
+        if sched.seed == 401:
+            raise RuntimeError("boom")
+        return 1
+
+    with pytest.raises(AssertionError, match="seed=401"):
+        run(explore(crashes_on_second, schedules=4, base_seed=400))
+
+    async def schedule_dependent(sched):
+        return sched.rng.random()  # guaranteed to diverge
+
+    with pytest.raises(AssertionError, match="depends on the delivery"):
+        run(explore(schedule_dependent, schedules=2, base_seed=0))
+
+    # reproducibility: same seed -> same schedule decisions
+    a = Schedule(77).shuffled(range(20))
+    b = Schedule(77).shuffled(range(20))
+    assert a == b
