@@ -117,6 +117,7 @@ class ValidatorSet:
         self.proposer: Optional[Validator] = None
         self._total_voting_power = 0
         self._addr_index: Dict[bytes, int] = {}
+        self._hash: Optional[bytes] = None
         valz = [v.copy() for v in validators] if validators else []
         self._update_with_change_set(valz, allow_deletes=False)
         if valz:
@@ -165,12 +166,14 @@ class ValidatorSet:
         new.proposer = self.proposer.copy() if self.proposer else None
         new._total_voting_power = self._total_voting_power
         new._addr_index = dict(self._addr_index)
+        new._hash = self._hash  # same membership -> same merkle root
         return new
 
     def _reindex(self) -> None:
         self._addr_index = {
             v.address: i for i, v in enumerate(self.validators)
         }
+        self._hash = None  # membership changed; recompute lazily
 
     def _update_total_voting_power(self) -> None:
         total = 0
@@ -261,10 +264,17 @@ class ValidatorSet:
 
     def hash(self) -> bytes:
         """Merkle root of SimpleValidator leaves
-        (reference: types/validator_set.go:347-353)."""
-        return merkle.hash_from_byte_slices(
-            [v.hash_bytes() for v in self.validators]
-        )
+        (reference: types/validator_set.go:347-353). Memoized: the
+        root covers only (pub_key, voting_power) in order — NOT
+        proposer priorities — so it survives proposer rotation and is
+        invalidated by _reindex(), which every membership/power
+        mutation path calls. Light sync and consensus re-hash the
+        same 150+ validator set several times per header otherwise."""
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [v.hash_bytes() for v in self.validators]
+            )
+        return self._hash
 
     # -- change-set application (reference: validator_set.go:380-651) --
 
@@ -417,9 +427,7 @@ class ValidatorSet:
         new.validators = vals
         new.proposer = proposer
         new._total_voting_power = 0
-        new._addr_index = {
-            val.address: i for i, val in enumerate(vals)
-        }
+        new._reindex()  # one invalidation point: index + hash memo
         return new
 
     def validate_basic(self) -> None:

@@ -322,3 +322,54 @@ class TestPartSet:
         bad.bytes = b"y" + bad.bytes[1:]
         with pytest.raises(ValueError, match="invalid proof"):
             rebuilt.add_part(bad)
+
+
+def test_validator_set_hash_memo_tracks_membership():
+    """The memoized ValidatorSet.hash() must change when membership or
+    power changes, survive proposer rotation unchanged (priorities are
+    not part of the merkle leaves), and round-trip through copy() and
+    proto."""
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+
+    privs = [
+        PrivKeyEd25519.from_seed(bytes([i + 1, 0x5e]) + b"\x24" * 30)
+        for i in range(4)
+    ]
+    vals = ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+    )
+    h0 = vals.hash()
+    assert vals.hash() == h0  # memo stable
+    vals.increment_proposer_priority(3)
+    assert vals.hash() == h0  # priorities not hashed
+
+    cp = vals.copy()
+    assert cp.hash() == h0
+
+    # power change invalidates
+    vals.update_with_change_set(
+        [Validator(pub_key=privs[0].pub_key(), voting_power=25)]
+    )
+    h1 = vals.hash()
+    assert h1 != h0
+    # and matches a freshly-built set with the same membership
+    fresh = ValidatorSet(
+        [
+            Validator(
+                pub_key=p.pub_key(),
+                voting_power=25 if i == 0 else 10,
+            )
+            for i, p in enumerate(privs)
+        ]
+    )
+    assert fresh.hash() == h1
+    # removal invalidates too
+    vals.update_with_change_set(
+        [Validator(pub_key=privs[1].pub_key(), voting_power=0)]
+    )
+    assert vals.hash() != h1
+    # proto round-trip recomputes to the same root
+    from tendermint_tpu.types.validator import ValidatorSet as VS
+
+    assert VS.from_proto(vals.to_proto()).hash() == vals.hash()
