@@ -53,6 +53,13 @@ class BaseConfig:
     moniker: str = "anonymous"
     mode: str = MODE_VALIDATOR
     home: str = "~/.tendermint_tpu"
+    # sqlite | memdb. A deliberate cut from the reference's five
+    # backends (config.go:179-197 goleveldb/cleveldb/boltdb/rocksdb/
+    # badgerdb, all ordered KV stores behind tm-db): sqlite is the
+    # embedded on-disk default (store/kv.py SqliteKV implements the
+    # same ordered-KV contract), memdb serves tests/ephemeral nodes.
+    # Another backend is one KVStore subclass away — nothing above
+    # store/kv.py knows which engine is underneath.
     db_backend: str = "sqlite"  # sqlite | memdb
     db_dir: str = "data"
     log_level: str = "info"
