@@ -115,35 +115,37 @@ def challenge_batch(pks, msgs, rs) -> list:
 def _native_verify_one(
     pk_bytes: bytes, msg: bytes, sig: bytes
 ) -> Optional[bool]:
-    """One schnorrkel verify through the native kernel: an n=1 "batch"
-    with weight 1 checks [8](s*B - k*A - R) == identity, which for
-    decoded (2E) representatives is exactly ristretto coset equality
-    with encode(s*B - k*A) == R — the pure-Python check below. The
-    small-batch Straus path makes this ~0.3 ms vs ~6 ms pure Python.
-    None when the native kernel is unavailable (caller falls through)."""
+    """One schnorrkel verify through the whole-batch native entry at
+    n=1: parsing, the merlin transcript, and the cofactored equation
+    [8](s*B - k*A - R) == identity all in C — which for decoded (2E)
+    representatives is exactly ristretto coset equality with
+    encode(s*B - k*A) == R, the pure-Python check below. The
+    small-batch Straus path makes this ~0.12 ms vs ~6 ms pure Python.
+    None when the native kernel is unavailable (caller falls through).
+
+    rc == -1 (undecodable pk/R encoding OR alloc failure) also
+    returns None: unlike the batch seam, the caller here IS the
+    authoritative per-signature path, so falling through to the
+    Python oracle — which rejects undecodable encodings itself — is
+    the correct recovery for both causes."""
+    import ctypes
+
     from .. import native
 
     lib = native.ed25519_batch_lib()
     if lib is None:
         return None
-    parsed = _parse_signature(sig)
-    if parsed is None:
+    if len(sig) != SIGNATURE_SIZE:
         return False
-    r_bytes, s = parsed
-    k = _challenge(_signing_transcript(msg), pk_bytes, r_bytes)
-    rc = lib.tm_sr25519_batch_verify(
-        pk_bytes,
-        r_bytes,
-        int(s).to_bytes(32, "little"),
-        int(k).to_bytes(32, "little"),
-        (1).to_bytes(32, "little"),
-        1,
+    offs = (ctypes.c_uint64 * 2)(0, len(msg))
+    rc = lib.tm_sr25519_verify_full(
+        pk_bytes, sig, msg, offs, os.urandom(16), 1
     )
     if rc == 1:
         return True
     if rc == 0:
         return False
-    return None  # undecodable or alloc failure: pure path decides
+    return None  # undecodable encoding or alloc failure: oracle decides
 
 
 def _scalar_divide_by_cofactor(b: bytes) -> int:

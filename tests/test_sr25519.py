@@ -425,3 +425,18 @@ def test_native_sr_full_marker_and_canonicality():
         bv.add(pk2, m2, s2)
     ok, bits = bv.verify()
     assert not ok and [i for i, b in enumerate(bits) if not b] == [4]
+
+
+def test_single_verify_undecodable_r_rejected():
+    """A signature whose R bytes are not a valid ristretto encoding:
+    the native path reports undecodable (rc -1 -> None) and the
+    pure-Python oracle gives the authoritative False."""
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+    k = PrivKeySr25519.from_seed(b"\x42" * 32)
+    pub = k.pub_key()
+    sig = k.sign(b"m")
+    # high bit set makes the encoding non-canonical -> undecodable
+    bad_r = bytes([sig[0]]) + sig[1:31] + bytes([sig[31] | 0x80])
+    assert not pub.verify_signature(b"m", bad_r + sig[32:])
+    assert pub.verify_signature(b"m", sig)
