@@ -1139,7 +1139,12 @@ def cmd_abci(args) -> int:
         make_client = SocketClient
 
     async def serve_kvstore():
-        srv = make_server(args.addr, KVStoreApplication())
+        srv = make_server(
+            args.addr,
+            KVStoreApplication(
+                snapshot_interval=args.snapshot_interval
+            ),
+        )
         await srv.start()
         print(f"kvstore app listening on {args.addr}", flush=True)
         try:
@@ -1310,6 +1315,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("operand", nargs="?", default="")
     sp.add_argument("--addr", default="tcp://127.0.0.1:26658")
     sp.add_argument("--path", default="/store", help="query path")
+    sp.add_argument(
+        "--snapshot-interval",
+        type=int,
+        default=0,
+        help="kvstore server: take a state snapshot every N heights "
+        "(0 disables; needed for state-sync providers)",
+    )
     sp.add_argument(
         "--grpc",
         action="store_true",

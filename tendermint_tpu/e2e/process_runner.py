@@ -27,11 +27,21 @@ tx inclusion under load via /abci_query against the kvstore app. The
 block-interval benchmark covers the reference's 100-block window
 (benchmark.go:14-34) when asked for.
 
-Process-mode limitations (documented, not silent): `state_sync` nodes
-and `misbehaviors` (the double-prevote hook monkeypatches consensus
-internals) are in-process-runner-only; manifests using them are
-rejected here. Databases are forced to sqlite — a killed process must
-find its stores on disk when it comes back.
+`state_sync` nodes work across processes: when any node wants state
+sync, every app process serves snapshots (`abci kvstore
+--snapshot-interval`), and the late joiner's trust root is seeded the
+way an operator would — block-1 hash fetched over a live node's RPC
+and written into its config before its process starts. The end-of-run
+invariant proves a real restore: the node must be at the tip yet
+answer "no block at height 1" — a restored node never holds the FULL
+genesis block (backfill fetches headers+commits only), while a node
+that silently blocksynced from genesis does.
+
+Process-mode limitations (documented, not silent): `misbehaviors`
+(the double-prevote hook monkeypatches consensus internals) are
+in-process-runner-only; manifests using them are rejected here.
+Databases are forced to sqlite — a killed process must find its
+stores on disk when it comes back.
 """
 
 from __future__ import annotations
@@ -50,7 +60,7 @@ from ..config import Config, write_config
 from ..crypto.ed25519 import PrivKeyEd25519
 from ..node import NodeKey
 from ..privval import FilePV
-from ..rpc.client import HTTPClient
+from ..rpc.client import HTTPClient, RPCClientError
 from ..types.genesis import GenesisDoc, GenesisValidator
 from .manifest import Manifest
 from .runner import RunReport
@@ -110,10 +120,11 @@ class ProcessRunner:
         self, manifest: Manifest, home: str, timeout: float = 300.0
     ):
         for name, spec in manifest.nodes.items():
-            if spec.state_sync or spec.misbehaviors:
+            if spec.misbehaviors:
                 raise ValueError(
-                    f"{name}: state_sync/misbehaviors are only supported "
-                    "by the in-process runner"
+                    f"{name}: misbehaviors are only supported by the "
+                    "in-process runner (they monkeypatch consensus "
+                    "internals)"
                 )
         self.m = manifest
         self.home = home
@@ -158,6 +169,11 @@ class ProcessRunner:
             cfg.consensus.timeout_prevote = 1.0
             cfg.consensus.timeout_precommit = 1.0
             cfg.consensus.timeout_commit = 0.2
+            if spec.state_sync:
+                cfg.statesync.enable = True
+                cfg.statesync.discovery_time = 2.0
+                cfg.statesync.chunk_request_timeout = 10.0
+                # trust root seeded over live RPC at spawn time
             cfg.rpc.laddr = f"tcp://127.0.0.1:{_free_port()}"
             p2p_port[name] = _free_port()
             cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port[name]}"
@@ -186,14 +202,20 @@ class ProcessRunner:
 
     # -- start (reference: start.go) --
 
+    # snapshots are advertised by every app when anyone will state
+    # sync (the reference e2e app's snapshot_interval manifest knob)
+    SNAPSHOT_INTERVAL = 2
+
     def _spawn_app(self, h: _ProcHandle) -> None:
+        cmd = [
+            sys.executable, "-m", "tendermint_tpu.cmd",
+            "abci", "kvstore", "--addr", h.cfg.base.proxy_app,
+        ]
+        if any(s.state_sync for s in self.m.nodes.values()):
+            cmd += ["--snapshot-interval", str(self.SNAPSHOT_INTERVAL)]
         log = open(os.path.join(h.cfg.base.home, "app.log"), "ab")
         h.app_proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "tendermint_tpu.cmd",
-                "abci", "kvstore", "--addr", h.cfg.base.proxy_app,
-            ],
-            stdout=log, stderr=subprocess.STDOUT, env=_child_env(),
+            cmd, stdout=log, stderr=subprocess.STDOUT, env=_child_env(),
         )
         log.close()
 
@@ -211,9 +233,39 @@ class ProcessRunner:
 
     async def _start_node(self, name: str) -> None:
         h = self.handles[name]
+        spec = self.m.nodes[name]
+        if spec.state_sync and not h.cfg.statesync.trust_hash:
+            await self._seed_state_sync_trust(h)
         if h.app_proc is None or h.app_proc.poll() is not None:
             self._spawn_app(h)
         self._spawn_node(h)
+
+    async def _seed_state_sync_trust(self, h: _ProcHandle) -> None:
+        """Anchor the late joiner's trust to the live chain the way an
+        operator does: block-1 hash over a running node's RPC, written
+        into the joiner's config before its process boots (reference:
+        the runner passes trust hashes into statesync configs,
+        setup.go)."""
+        for other in self.handles.values():
+            if other is h or not other.live:
+                continue
+            try:
+                res = await other.rpc.call("block", height=1)
+                h.cfg.statesync.trust_height = 1
+                h.cfg.statesync.trust_hash = res["block_id"]["hash"]
+                write_config(
+                    h.cfg,
+                    os.path.join(
+                        h.cfg.base.home, "config", "config.toml"
+                    ),
+                )
+                return
+            except Exception:
+                continue
+        raise RuntimeError(
+            f"{h.name}: no live node answered for the state-sync "
+            "trust root"
+        )
 
     # -- load over live RPC (reference: load.go) --
 
@@ -454,8 +506,13 @@ class ProcessRunner:
                 f"{self.m.target_height}"
             )
         # one sweep over the reference node's blocks: hash agreement
-        # across nodes + committed-tx count under load
-        ref = live[0]
+        # across nodes + committed-tx count under load. The reference
+        # must hold full history, so state-sync nodes (no early
+        # blocks by design) are never the baseline.
+        full_history = [
+            h for h in live if not self.m.nodes[h.name].state_sync
+        ]
+        ref = (full_history or live)[0]
         committed = 0
         for height in range(1, rep.reached_height + 1):
             try:
@@ -463,7 +520,9 @@ class ProcessRunner:
             except Exception:
                 continue
             committed += len(want["block"]["txs"] or [])
-            for h in live[1:]:
+            for h in live:
+                if h is ref:
+                    continue
                 try:
                     got = await h.rpc.call("block", height=height)
                 except Exception:
@@ -473,6 +532,35 @@ class ProcessRunner:
                         f"fork at height {height}: {h.name} disagrees "
                         f"with {ref.name}"
                     )
+        # state-sync nodes must have RESTORED, not blocksynced from
+        # genesis: a restored node never holds the FULL genesis block
+        # (backfill fetches headers+commits only), while a node that
+        # silently blocksynced from height 1 does.
+        for name, spec in self.m.nodes.items():
+            if not spec.state_sync:
+                continue
+            h = self.handles[name]
+            synced = False
+            try:
+                res = await h.rpc.call("status")
+                if int(res["sync_info"]["latest_block_height"]) >= 1:
+                    try:
+                        await h.rpc.call("block", height=1)
+                        synced = False  # full genesis block on hand
+                    except RPCClientError as e:
+                        # only a JSON-RPC-level answer ("no block at
+                        # height 1", negative error code) proves the
+                        # restore; a transport failure proves nothing
+                        synced = e.code is not None and e.code < 0
+            except Exception:
+                pass
+            rep.state_synced[name] = synced
+            if not synced:
+                rep.failures.append(
+                    f"{name} was configured for state sync but holds "
+                    "the full genesis block (blocksynced instead?) or "
+                    "did not answer RPC"
+                )
         if self.m.load.tx_rate > 0:
             rep.txs_committed = committed
             if rep.txs_submitted > 0 and committed == 0:

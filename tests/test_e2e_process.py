@@ -31,7 +31,7 @@ def test_process_net_converges(tmp_path):
         target_height=4,
     )
     m.validate()
-    rep = run(ProcessRunner(m, str(tmp_path), timeout=150.0).run())
+    rep = run(ProcessRunner(m, str(tmp_path), timeout=240.0).run())
     assert rep.ok, rep.failures
     assert rep.reached_height >= 4
     assert rep.blocks >= 3
@@ -54,7 +54,7 @@ def test_process_net_sigkill_recovery(tmp_path):
         }
     )
     m.validate()
-    runner = ProcessRunner(m, str(tmp_path), timeout=220.0)
+    runner = ProcessRunner(m, str(tmp_path), timeout=340.0)
     rep = run(runner.run())
     assert rep.ok, rep.failures
     assert rep.reached_height >= 5
@@ -107,3 +107,30 @@ def test_perturbation_signals_map():
     assert "SIGKILL" in src and "SIGTERM" in src
     assert "SIGSTOP" in src and "SIGCONT" in src
     assert signal.SIGKILL  # the platform actually has them
+
+
+@pytest.mark.slow
+def test_process_net_state_sync(tmp_path):
+    """A late-joining full node in its own OS process state-syncs from
+    snapshot-serving app processes: trust root seeded over live RPC,
+    chunks restored via socket ABCI, and the end state proves a real
+    restore (earliest stored block above genesis)."""
+    m = Manifest.parse(
+        {
+            "chain_id": "proc-ss-ci",
+            "target_height": 8,
+            "validators": {"v0": 10, "v1": 10, "v2": 10},
+            "node": {
+                "joiner": {
+                    "mode": "full",
+                    "state_sync": True,
+                    "start_at": 5,
+                }
+            },
+            "load": {"tx_rate": 1, "tx_size": 48},
+        }
+    )
+    m.validate()
+    rep = run(ProcessRunner(m, str(tmp_path), timeout=340.0).run())
+    assert rep.ok, rep.failures
+    assert rep.state_synced.get("joiner") is True
