@@ -104,6 +104,38 @@ def bench_cpu_baseline(pks, msgs, sigs):
     return m / (time.perf_counter() - t0)
 
 
+def bench_sign_keygen(reps: int = 300):
+    """Single-key sign and keygen costs, the remaining rows of the
+    reference's crypto harness (crypto/internal/benchmarking/
+    bench.go:27-63 BenchmarkKeyGeneration/BenchmarkSigning). Returns
+    {key_type: {"sign_us": .., "keygen_us": ..}} through the
+    production key classes."""
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
+
+    out = {}
+    for name, cls in (
+        ("ed25519", PrivKeyEd25519),
+        ("sr25519", PrivKeySr25519),
+    ):
+        cls.generate()  # untimed: lazy tables (base comb, merlin prefix)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            cls.generate()
+        keygen = (time.perf_counter() - t0) / reps
+        k = cls.generate()
+        msg = b"bench-sign"
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            k.sign(msg)
+        sign = (time.perf_counter() - t0) / reps
+        out[name] = {
+            "sign_us": round(sign * 1e6, 1),
+            "keygen_us": round(keygen * 1e6, 1),
+        }
+    return out
+
+
 _COMMIT_MEMO: dict = {}
 
 
@@ -887,6 +919,10 @@ def main() -> None:
     except Exception as e:  # pragma: no cover
         curve = {"error": repr(e)}
     try:
+        sign_keygen = bench_sign_keygen()
+    except Exception as e:  # pragma: no cover
+        sign_keygen = {"error": repr(e)}
+    try:
         merkle_rate = round(
             bench_merkle_proof_batch(
                 2_000 if fallback else 10_000, use_device=not fallback
@@ -962,6 +998,7 @@ def main() -> None:
                         round(light_rate, 2) if light_rate else light_err
                     ),
                     "batch_verify_us_per_sig_by_batch": curve,
+                    "sign_keygen_us": sign_keygen,
                     "merkle_proof_batch_per_s": merkle_rate,
                     "mempool_checktx_per_s": mempool_rate,
                     "localnet_block_interval": block_interval,

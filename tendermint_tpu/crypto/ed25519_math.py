@@ -33,6 +33,7 @@ __all__ = [
     "point_add",
     "point_double",
     "scalar_mult",
+    "mul_base",
     "zip215_verify",
     "sha512_mod_l",
 ]
@@ -142,6 +143,38 @@ _B_X = _recover_x(_B_Y, 0)
 assert _B_X is not None
 B_POINT: Point = (_B_X, _B_Y, 1, _B_X * _B_Y % P)
 
+# lazy 4-bit fixed-base comb: 64 windows x 15 odd multiples of B.
+# mul_base costs 63 adds instead of ~380 double/adds — the pure-Python
+# basepoint mult is what sr25519 sign/keygen spend their time on
+# (reference gets this from curve25519-voi's precomputed tables).
+_BASE_COMB: list | None = None
+
+
+def mul_base(k: int) -> Point:
+    """k*B for any k: reduced mod L up front (B has order L, so the
+    product is identical and the 64-window comb always covers it)."""
+    global _BASE_COMB
+    k %= L
+    if _BASE_COMB is None:
+        tbl = []
+        base = B_POINT
+        for _ in range(64):
+            row = [IDENTITY]
+            for _i in range(15):
+                row.append(point_add(row[-1], base))
+            tbl.append(row)
+            base = point_add(row[15], base)  # base * 16
+        _BASE_COMB = tbl
+    q = IDENTITY
+    w = 0
+    while k:
+        d = k & 15
+        if d:
+            q = point_add(q, _BASE_COMB[w][d])
+        k >>= 4
+        w += 1
+    return q
+
 
 def sha512_mod_l(*chunks: bytes) -> int:
     h = hashlib.sha512()
@@ -166,7 +199,7 @@ def zip215_verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         return False
     k = sha512_mod_l(R_bytes, pubkey, msg)
     # [S]B - [k]A - R, then multiply by cofactor 8 and compare to identity.
-    lhs = scalar_mult(S, B_POINT)
+    lhs = mul_base(S)
     rhs = point_add(scalar_mult(k, A), R)
     diff = point_add(lhs, point_neg(rhs))
     for _ in range(3):

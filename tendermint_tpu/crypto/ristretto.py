@@ -137,4 +137,4 @@ def scalar_mult(k: int, p: Point) -> Point:
 
 
 def mul_base(k: int) -> Point:
-    return em.scalar_mult(k % L, BASE)
+    return em.mul_base(k % L)
