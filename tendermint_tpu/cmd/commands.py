@@ -88,6 +88,66 @@ def cmd_init(args) -> int:
 # -- start (reference: commands/run_node.go) --------------------------------
 
 
+def cmd_signer(args) -> int:
+    """Run the external signing process against a node's
+    [priv_validator] listen_addr: loads this home's FilePV (key +
+    last-sign double-sign protection state) and serves signing
+    requests over SecretConnection — or gRPC with --grpc (reference:
+    the tmkms/SignerServer deployment shape; privval/signer.py
+    SignerServer, signer_server.go)."""
+    from ..libs.log import configure
+    from ..privval import FilePV
+
+    cfg = _load_home(args.home)
+    configure(
+        level=cfg.base.log_level,
+        json_format=cfg.base.log_format == "json",
+    )
+    pv = FilePV.load(
+        cfg.base.path(cfg.priv_validator.key_file),
+        cfg.base.path(cfg.priv_validator.state_file),
+    )
+    print(
+        f"signer for validator {pv.key.address.hex()} -> {args.addr}",
+        flush=True,
+    )
+
+    async def run() -> None:
+        if args.grpc:
+            if args.node_id:
+                print(
+                    "--node-id applies to the socket transport only "
+                    "(no identity check exists on grpc); refusing to "
+                    "silently ignore it",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
+            from ..privval.grpc import GRPCSignerServer
+
+            srv = GRPCSignerServer(args.addr, cfg.base.chain_id, pv)
+        else:
+            from ..privval.signer import SignerServer
+
+            srv = SignerServer(
+                args.addr,
+                pv,
+                expected_node_id=args.node_id,
+                chain_id=cfg.base.chain_id,
+            )
+        loop = asyncio.get_running_loop()
+        stop = asyncio.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await srv.start()
+        try:
+            await stop.wait()
+        finally:
+            await srv.stop()
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_start(args) -> int:
     from ..libs.log import configure
     from ..node import make_node
@@ -1249,6 +1309,31 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("start", help="run the node")
     sp.add_argument("--moniker", default="")
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser(
+        "signer",
+        help="run an external signing process (dials a node's "
+        "[priv_validator] listen_addr, serves this home's FilePV)",
+    )
+    sp.add_argument(
+        "--addr",
+        default="tcp://127.0.0.1:26659",
+        help="socket mode: the node's priv_validator listen address "
+        "to DIAL; --grpc mode: the local address this signer LISTENS "
+        "on (the node dials grpc://<this>)",
+    )
+    sp.add_argument(
+        "--node-id",
+        default="",
+        help="socket mode only: expected node identity for the "
+        "SecretConnection (empty = accept any)",
+    )
+    sp.add_argument(
+        "--grpc",
+        action="store_true",
+        help="use the gRPC privval transport instead of the socket one",
+    )
+    sp.set_defaults(fn=cmd_signer)
 
     sp = sub.add_parser("gen-validator", help="print a fresh validator key")
     sp.add_argument(

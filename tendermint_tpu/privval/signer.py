@@ -365,6 +365,7 @@ class SignerServer(Service):
         signer_priv_key: Optional[PrivKey] = None,
         expected_node_id: str = "",
         redial_delay: float = 1.0,
+        chain_id: str = "",
     ) -> None:
         super().__init__(name="signer-server", logger=get_logger("signer"))
         addr = node_addr.replace("tcp://", "")
@@ -372,6 +373,12 @@ class SignerServer(Service):
         self.host = host or "127.0.0.1"
         self.port = int(port)
         self.pv = pv
+        # non-empty => sign requests for any OTHER chain are refused
+        # (reference: signer_requestHandler.go DefaultValidationRequest
+        # Handler rejects a chainID mismatch) — a misconfigured or
+        # hostile node must not be able to pull signatures for another
+        # chain or burn the last-sign HRS state with foreign votes
+        self.chain_id = chain_id
         # transport identity for the secret connection (not the
         # validator key)
         self.signer_priv_key = signer_priv_key or PrivKeyEd25519.generate()
@@ -423,6 +430,15 @@ class SignerServer(Service):
         try:
             if field == _F_PING_REQ:
                 return _msg(_F_PING_RESP)
+            if (
+                self.chain_id
+                and field in (_F_SIGN_VOTE_REQ, _F_SIGN_PROP_REQ)
+                and chain_id != self.chain_id
+            ):
+                raise ValueError(
+                    f"sign request for chain {chain_id!r}; this signer "
+                    f"serves {self.chain_id!r}"
+                )
             if field == _F_PUBKEY_REQ:
                 pk = await self.pv.get_pub_key()
                 return _msg(

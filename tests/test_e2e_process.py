@@ -134,3 +134,132 @@ def test_process_net_state_sync(tmp_path):
     rep = run(ProcessRunner(m, str(tmp_path), timeout=340.0).run())
     assert rep.ok, rep.failures
     assert rep.state_synced.get("joiner") is True
+
+
+@pytest.mark.slow
+def test_process_remote_signer_node(tmp_path):
+    """A validator whose key lives in a SEPARATE signer process (the
+    tmkms deployment shape): the node exposes [priv_validator]
+    listen_addr, `cmd signer` dials it over SecretConnection, and the
+    chain only advances once the signer is attached. SIGKILLing the
+    signer stalls signing; a restarted signer (same last-sign state on
+    disk) resumes it."""
+    import subprocess
+    import sys
+    import time as _time
+
+    from tendermint_tpu.e2e.process_runner import _child_env, _free_port
+
+    home = str(tmp_path / "val")
+    env = _child_env()
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd", "--home", home,
+         "init", "validator", "--chain-id", "proc-signer-ci"],
+        check=True, env=env, capture_output=True,
+    )
+    pv_port = _free_port()
+    rpc_port = _free_port()
+    # point the node at the remote signer + fast consensus timeouts
+    from tendermint_tpu.cmd.commands import _load_home
+    from tendermint_tpu.config import write_config
+
+    cfg = _load_home(home)
+    cfg.priv_validator.listen_addr = f"tcp://127.0.0.1:{pv_port}"
+    cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+    cfg.consensus.timeout_commit = 0.2
+    write_config(cfg, f"{home}/config/config.toml")
+
+    node_log = open(tmp_path / "node.log", "wb")
+    node = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cmd", "--home", home,
+         "start"],
+        stdout=node_log, stderr=subprocess.STDOUT, env=env,
+    )
+    signer_log = open(tmp_path / "signer.log", "wb")
+    signer = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cmd", "--home", home,
+         "signer", "--addr", f"tcp://127.0.0.1:{pv_port}"],
+        stdout=signer_log, stderr=subprocess.STDOUT, env=env,
+    )
+
+    def height() -> int:
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rpc_port}/",
+            data=json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": "status",
+                 "params": {}}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=3) as r:
+            res = json.loads(r.read())
+        return int(
+            res["result"]["sync_info"]["latest_block_height"]
+        )
+
+    try:
+        deadline = _time.monotonic() + 120
+        h = -1
+        while _time.monotonic() < deadline:
+            try:
+                h = height()
+                if h >= 3:
+                    break
+            except Exception:
+                pass
+            _time.sleep(0.5)
+        assert h >= 3, f"remote-signer chain stuck at {h}"
+
+        # kill the signer: the chain must stall (no local key at all)
+        signer.kill()
+        signer.wait()
+        _time.sleep(3.0)
+
+        def height_retry(tries=8):
+            last = None
+            for _ in range(tries):
+                try:
+                    return height()
+                except Exception as e:
+                    last = e
+                    _time.sleep(0.5)
+            raise last
+
+        stalled = height_retry()
+        _time.sleep(4.0)
+        assert height_retry() <= stalled + 1, (
+            "chain advanced without signer"
+        )
+
+        # a fresh signer process resumes from the on-disk sign state
+        signer = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd", "--home", home,
+             "signer", "--addr", f"tcp://127.0.0.1:{pv_port}"],
+            stdout=signer_log, stderr=subprocess.STDOUT, env=env,
+        )
+        deadline = _time.monotonic() + 90
+        resumed = False
+        while _time.monotonic() < deadline:
+            try:
+                if height() >= stalled + 2:
+                    resumed = True
+                    break
+            except Exception:
+                pass
+            _time.sleep(0.5)
+        assert resumed, "chain did not resume after signer restart"
+    finally:
+        for p in (signer, node):
+            if p.poll() is None:
+                p.terminate()
+        for p in (signer, node):
+            try:
+                p.wait(20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        node_log.close()
+        signer_log.close()

@@ -219,3 +219,55 @@ def test_node_with_remote_signer_produces_blocks(tmp_path):
             await signer.stop()
 
     run(go())
+
+
+def test_signer_refuses_foreign_chain_id(tmp_path):
+    """A chain-id-pinned SignerServer refuses sign requests for any
+    other chain (reference: signer_requestHandler.go
+    DefaultValidationRequestHandler chainID check) — a misconfigured
+    node cannot pull signatures for a different network or advance the
+    signer's last-sign state with foreign votes."""
+
+    async def go():
+        pv = _file_pv(tmp_path, b"\x47")
+        node_key = PrivKeyEd25519.from_seed(b"\x52" * 32)
+        listener = SignerListenerEndpoint(
+            "tcp://127.0.0.1:0", node_key, accept_timeout=10.0
+        )
+        await listener.start()
+        signer = SignerServer(
+            f"127.0.0.1:{listener.bound_port}",
+            pv,
+            redial_delay=0.1,
+            chain_id=CHAIN,
+        )
+        await signer.start()
+        try:
+            client = RetrySignerClient(listener, retries=10, delay=0.2)
+
+            def vote():
+                return Vote(
+                    type=PREVOTE_TYPE,
+                    height=5,
+                    round=0,
+                    block_id=_block_id(),
+                    timestamp_ns=time.time_ns(),
+                    validator_address=pv.key.address,
+                    validator_index=0,
+                )
+
+            v = vote()
+            with pytest.raises(Exception, match="serves"):
+                await client.sign_vote("other-chain", v)
+            assert v.signature is None or v.signature == b""
+            # the pinned chain still signs, and the refusal didn't
+            # burn the last-sign HRS state
+            v2 = vote()
+            await client.sign_vote(CHAIN, v2)
+            pk = await client.get_pub_key()
+            assert pk.verify_signature(v2.sign_bytes(CHAIN), v2.signature)
+        finally:
+            await signer.stop()
+            await listener.stop()
+
+    run(go())
