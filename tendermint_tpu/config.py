@@ -58,9 +58,12 @@ class BaseConfig:
     # badgerdb, all ordered KV stores behind tm-db): sqlite is the
     # embedded on-disk default (store/kv.py SqliteKV implements the
     # same ordered-KV contract), memdb serves tests/ephemeral nodes.
-    # Another backend is one KVStore subclass away — nothing above
-    # store/kv.py knows which engine is underneath.
-    db_backend: str = "sqlite"  # sqlite | memdb
+    # Another engine is one KVStore subclass away — register it with
+    # store.kv.register_backend(name, factory) before node start and
+    # set this knob to that name; nothing above store/kv.py knows
+    # which engine is underneath ("goleveldb"/"default" alias to
+    # sqlite so reference config.toml files work unchanged).
+    db_backend: str = "sqlite"  # sqlite | memdb | registered name
     db_dir: str = "data"
     log_level: str = "info"
     log_format: str = "plain"

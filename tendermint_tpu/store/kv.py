@@ -15,7 +15,14 @@ import threading
 from abc import ABC, abstractmethod
 from typing import Iterator, List, Optional, Tuple
 
-__all__ = ["KVStore", "MemKV", "SqliteKV", "Batch", "open_db"]
+__all__ = [
+    "KVStore",
+    "MemKV",
+    "SqliteKV",
+    "Batch",
+    "open_db",
+    "register_backend",
+]
 
 
 class Batch:
@@ -213,13 +220,52 @@ class SqliteKV(KVStore):
             self._conn.close()
 
 
+# Pluggable engine registry. The reference exposes five engines
+# selected by `db-backend` (config/config.go:179-197, goleveldb /
+# cleveldb / boltdb / rocksdb / badgerdb via build tags); here the
+# same config knob resolves through this registry. Built-ins are
+# memdb + sqlite — a DELIBERATE cut: sqlite (stdlib, transactional,
+# ordered) covers the embedded-durable role of all five Go engines on
+# one box, and nothing else ships in this image. Deployments wanting
+# a different engine register a factory before node start:
+#
+#     from tendermint_tpu.store.kv import register_backend
+#     register_backend("rocksdb", lambda name, db_dir: MyRocksKV(...))
+#
+# and set `db-backend = "rocksdb"` in config.toml.
+_BACKENDS: dict = {}
+
+
+def register_backend(name: str, factory) -> None:
+    """Register `factory(name, db_dir) -> KVStore` under a config
+    `db-backend` value. Re-registering a name replaces it (tests)."""
+    _BACKENDS[name] = factory
+
+
+register_backend("memdb", lambda _name, _db_dir: MemKV())
+register_backend("mem", _BACKENDS["memdb"])
+
+
+def _sqlite_factory(name: str, db_dir: str) -> KVStore:
+    import os
+
+    os.makedirs(db_dir, exist_ok=True)
+    return SqliteKV(os.path.join(db_dir, f"{name}.sqlite"))
+
+
+register_backend("sqlite", _sqlite_factory)
+# the reference's default engine name maps to our durable default, so
+# a config.toml written for the reference works unchanged
+register_backend("goleveldb", _sqlite_factory)
+register_backend("default", _sqlite_factory)
+
+
 def open_db(name: str, backend: str, db_dir: str) -> KVStore:
     """Backend selection (reference analog: config/config.go:179-197)."""
-    if backend in ("memdb", "mem"):
-        return MemKV()
-    if backend in ("sqlite", "goleveldb", "default"):
-        import os
-
-        os.makedirs(db_dir, exist_ok=True)
-        return SqliteKV(os.path.join(db_dir, f"{name}.sqlite"))
-    raise ValueError(f"unknown db backend {backend!r}")
+    factory = _BACKENDS.get(backend)
+    if factory is None:
+        raise ValueError(
+            f"unknown db backend {backend!r}; registered: "
+            f"{sorted(_BACKENDS)}"
+        )
+    return factory(name, db_dir)

@@ -242,3 +242,42 @@ class TestPruneAndRollback:
         bs.prune_blocks(4)
         assert bs.load_block_commit(2) is None  # commit for pruned height
         assert bs.load_block_commit(4) is not None
+
+
+class TestBackendRegistry:
+    """Pluggable engine selection (reference: config/config.go:179-197
+    selects among five engines by the db-backend knob; here the same
+    knob resolves through store.kv's registry)."""
+
+    def test_builtin_names(self, tmp_path):
+        from tendermint_tpu.store.kv import open_db
+
+        assert isinstance(open_db("a", "memdb", str(tmp_path)), MemKV)
+        assert isinstance(open_db("a", "mem", str(tmp_path)), MemKV)
+        for alias in ("sqlite", "goleveldb", "default"):
+            db = open_db(alias, alias, str(tmp_path))
+            assert isinstance(db, SqliteKV)
+            db.close()
+
+    def test_unknown_backend_lists_registered(self, tmp_path):
+        from tendermint_tpu.store.kv import open_db
+
+        with pytest.raises(ValueError, match="memdb"):
+            open_db("a", "no-such-engine", str(tmp_path))
+
+    def test_register_custom_engine(self, tmp_path):
+        from tendermint_tpu.store.kv import _BACKENDS, open_db, register_backend
+
+        calls = []
+
+        def factory(name, db_dir):
+            calls.append((name, db_dir))
+            return MemKV()
+
+        register_backend("custom-engine", factory)
+        try:
+            db = open_db("blockstore", "custom-engine", str(tmp_path))
+            assert isinstance(db, MemKV)
+            assert calls == [("blockstore", str(tmp_path))]
+        finally:
+            _BACKENDS.pop("custom-engine", None)
