@@ -674,50 +674,6 @@ def bench_device_rtt():
     return times[len(times) // 2] * 1e3
 
 
-def _device_watchdog(timeout_s: float = 0.0) -> str:
-    """Probe device availability on a side thread. A SIGKILLed former
-    client can leave the tunneled TPU claimed for hours; if the device
-    doesn't answer in time, re-exec this process on the CPU backend so
-    the bench always emits its JSON line instead of hanging the driver.
-    (Re-exec, not in-process switch: the hung probe thread holds jax's
-    backend-init lock, so flipping jax_platforms here would deadlock.)"""
-    import os
-    import sys
-    import threading
-
-    if os.environ.get("TM_BENCH_CPU_FALLBACK"):
-        return "cpu-fallback (device unreachable)"
-    if not timeout_s:
-        try:
-            timeout_s = float(
-                os.environ.get("TM_BENCH_DEVICE_TIMEOUT", "") or 300.0
-            )
-        except ValueError:
-            timeout_s = 300.0
-    result = {}
-
-    def probe():
-        import jax
-
-        result["devices"] = [str(d) for d in jax.devices()]
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devices" in result:
-        return "device"
-    env = dict(os.environ)
-    env["TM_BENCH_CPU_FALLBACK"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = os.pathsep.join(
-        p
-        for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and os.path.basename(p) != ".axon_site"
-    )
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
-    raise AssertionError("unreachable")
-
-
 def _last_device_run():
     """On the CPU fallback, surface the most recent REAL device
     measurement (BENCH_DEVICE_MIDROUND.json, recorded when the chip was
@@ -789,227 +745,465 @@ def _persist_midround(partial: dict) -> None:
         pass
 
 
+_EMIT = {"done": False, "line": None}
+
+
+def _emit_line(stall: str = "") -> None:
+    """Print the ONE JSON line the driver parses — exactly once.
+
+    Robust against a concurrent main-thread mutation of line['extra']
+    (the stall-guard thread can emit while a slow-but-alive stage is
+    still appending): serialization failures are retried, and as a
+    last resort a minimal line with the scalar headline fields is
+    emitted. done is only set after a successful print, so a failed
+    attempt never suppresses the output permanently."""
+    import threading
+
+    lock = _EMIT.setdefault("lock", threading.Lock())
+    with lock:
+        line = _EMIT["line"]
+        if _EMIT["done"] or line is None:
+            return
+        payload = None
+        for _ in range(3):
+            try:
+                snap = json.loads(json.dumps(line))
+                if stall:
+                    snap.setdefault("extra", {})["stall"] = stall
+                payload = json.dumps(snap)
+                break
+            except Exception:
+                time.sleep(0.05)
+        if payload is None:
+            minimal = {
+                "metric": line.get("metric"),
+                "value": line.get("value"),
+                "unit": line.get("unit"),
+                "vs_baseline": line.get("vs_baseline"),
+                "extra": {"stall": stall or "emit fallback: extra unserializable"},
+            }
+            payload = json.dumps(minimal)
+        print(payload, flush=True)
+        _EMIT["done"] = True
+
+
+class _StallGuard:
+    """Emit the banked line and exit if a bench stage wedges.
+
+    Motivating failure (2026-08-01, PERF.md wedge timeline): the
+    tunnel claim was GRANTED, ~24 minutes of compiles ran, then the
+    relay died mid-throughput-stage — the client blocked in recv()
+    forever and a round-end bench would have recorded NOTHING. If a
+    stage exceeds its budget the tunnel (or a hung subprocess) is
+    already lost, so emitting the banked numbers (plus every stage
+    that landed) and exiting is strictly better than hanging the
+    driver. The normal path disarms it before the final print."""
+
+    def __init__(self, budget_s: float):
+        import threading
+
+        self.budget = budget_s
+        self._deadline = time.monotonic() + budget_s
+        self._stage = "startup"
+        self._lock = threading.Lock()
+        threading.Thread(target=self._watch, daemon=True).start()
+
+    def tick(self, stage: str, budget_s: float = 0.0) -> None:
+        with self._lock:
+            self._stage = stage
+            self._deadline = time.monotonic() + (budget_s or self.budget)
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._deadline = None
+
+    def _watch(self) -> None:
+        import os
+        import sys
+
+        while True:
+            time.sleep(10)
+            with self._lock:
+                dl, stage = self._deadline, self._stage
+            if dl is None:
+                return
+            if time.monotonic() > dl:
+                _emit_line(
+                    stall=(
+                        f"stage '{stage}' exceeded its budget; "
+                        "banked line emitted by the stall guard"
+                    )
+                )
+                sys.stdout.flush()
+                os._exit(3)
+
+
+def _probe_device_subprocess(timeout_s: float) -> bool:
+    """Probe device claimability in a THROWAWAY subprocess so a wedged
+    tunnel can never poison this process's jax backend state (an
+    in-process hung jax.devices() holds the backend-init lock forever).
+    A clean subprocess exit releases its claim; an expired probe is
+    TERM'd — safe, the claim was never granted to it."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("TM_BENCH_CPU_FALLBACK"):
+        return False
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0 and b"[" in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def main() -> None:
-    backend = _device_watchdog()
-    _enable_compile_cache()
-    fallback = backend != "device"
+    import os
+
+    try:
+        budget = float(os.environ.get("TM_BENCH_STAGE_BUDGET_S", "") or 900.0)
+    except ValueError:
+        budget = 900.0
+
+    def attempt(fn):
+        try:
+            return fn()
+        except Exception as e:  # pragma: no cover - keep the line alive
+            return {"error": repr(e)}
+
+    # ---- CPU block, FIRST and before any device traffic: the
+    # production CPU path (OpenSSL singles + the native RLC batch
+    # equation), banked as a complete line so neither a mid-run tunnel
+    # stall nor a wedged claim can erase the round's record. Nothing
+    # here may initialize the jax backend — the device probe comes
+    # after, and runs in a throwaway subprocess first.
+    extra = {"backend": "cpu (pre-probe)"}
+    line = {
+        "metric": "ed25519_batch_verify_throughput",
+        "value": None,
+        "unit": "sigs/s/cpu",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+    _EMIT["line"] = line
+    guard = _StallGuard(budget)
+
+    def cpu_stage(name, fn, key, budget_s=0.0):
+        guard.tick(f"cpu:{name}", budget_s)
+        extra[key] = attempt(fn)
+
+    guard.tick("cpu:single_verify")
     pks, msgs, sigs = _make_batch(512, seed=7)
     cpu_rate = bench_cpu_baseline(pks, msgs, sigs)
-    if fallback:
-        # HONEST CPU story: the production CPU path (OpenSSL singles +
-        # the native RLC batch equation), NOT the jax-CPU XLA kernel —
-        # that kernel is neither the production CPU path nor a device
-        # number and its timings were misleading (VERDICT r3).
-        device_rate = bench_cpu_batch_throughput(8192)
-    else:
-        device_rate = bench_throughput(n=8192)
-    if not fallback:
-        _persist_midround(
-            {
-                "metric": "ed25519_batch_verify_throughput",
-                "value": round(device_rate, 1),
-                "unit": "sigs/s/chip",
-                "vs_baseline": round(device_rate / cpu_rate, 3),
-                "extra": {
-                    "backend": backend,
-                    "partial": "headline only; later stages pending",
-                    "cpu_single_verify_sigs_per_s": round(cpu_rate, 1),
-                },
-            }
-        )
-    rtt_ms = None if fallback else bench_device_rtt()
-    p50_150, p95_150 = bench_commit_latency(
-        150, reps=5 if fallback else 20, light=True,
-        use_device=not fallback,
+    cpu_tput = bench_cpu_batch_throughput(8192)
+    line["value"] = round(cpu_tput, 1)
+    line["vs_baseline"] = round(cpu_tput / cpu_rate, 3)
+    extra["cpu_single_verify_sigs_per_s"] = round(cpu_rate, 1)
+    extra["cpu_batch_backend"] = (
+        "native-rlc-batch-equation"
+        if _native_batch_available()
+        else "openssl-sequential"
     )
-    p50_mixed = None
-    mixed_err = None
-    p50_mixed_10k = None
-    mixed_10k_err = None
-    breakdown = None
-    breakdown_cpu = None
-    curve_sr = None
-    if fallback:
-        # the CPU batch path makes the big configs tractable: measure
-        # the 10k-commit and mixed-curve latencies on CPU too (labeled
-        # by `backend`), instead of reporting null
-        p50_10k, p95_10k = bench_commit_latency(
-            10_000, reps=3, light=False, use_device=False
-        )
-        try:
-            breakdown_cpu = bench_commit_breakdown_cpu(10_000, reps=3)
-        except Exception as e:
-            breakdown_cpu = {"error": repr(e)}
-        # the device-shaped key stays non-null but points at the CPU
-        # split instead of impersonating its schema (dispatch/gather/
-        # device_est keys do not exist on this path)
-        breakdown = {"skipped": "cpu fallback; see ..._cpu_ms"}
-        try:
-            p50_mixed, _ = bench_commit_latency(
-                1_000, reps=3, light=False, mixed=True, use_device=False
+    extra["cpu_batch_verify_throughput_8192_sigs_per_s"] = round(cpu_tput, 1)
+
+    def _lat_cpu(n, reps, light, mixed=False):
+        def run():
+            p50, p95 = bench_commit_latency(
+                n, reps=reps, light=light, mixed=mixed, use_device=False
             )
-        except Exception as e:
-            mixed_err = repr(e)
-        try:
-            p50_mixed_10k, _ = bench_commit_latency(
-                10_000, reps=3, light=False, mixed=True, use_device=False
-            )
-        except Exception as e:
-            mixed_10k_err = repr(e)
-        try:
-            curve_sr = bench_batch_curve(
-                sizes=(1, 8, 64, 1024), key_type="sr25519",
-                use_device=False,
-            )
-        except Exception as e:
-            curve_sr = {"error": repr(e)}
-    else:
-        p50_10k, p95_10k = bench_commit_latency(
-            10_000, reps=10, light=False
-        )
-        try:
-            breakdown = bench_commit_breakdown(10_000, reps=5)
-        except Exception as e:
-            breakdown = {"error": repr(e)}
-        # the CPU split too, so the host-side phases are auditable even
-        # when the device row exists (VERDICT r4: never-null breakdowns)
-        try:
-            breakdown_cpu = bench_commit_breakdown_cpu(10_000, reps=3)
-        except Exception as e:
-            breakdown_cpu = {"error": repr(e)}
-        # BASELINE config 5: mixed ed25519/sr25519 validator sets —
-        # both curves on device (ed25519_kernel + sr25519_kernel), the
-        # merlin challenges batched on host (native keccak)
-        try:
-            p50_mixed, _ = bench_commit_latency(
-                1_000, reps=5, light=False, mixed=True
-            )
-        except Exception as e:
-            mixed_err = repr(e)
-        try:
-            p50_mixed_10k, _ = bench_commit_latency(
-                10_000, reps=3, light=False, mixed=True
-            )
-        except Exception as e:
-            mixed_10k_err = repr(e)
-        try:
-            curve_sr = bench_batch_curve(
-                sizes=(1, 8, 64, 1024), key_type="sr25519"
-            )
-        except Exception as e:
-            curve_sr = {"error": repr(e)}
-    try:
-        # device path: 300 headers x 150 validators — long enough that
-        # the windowed batching (one device batch per 32 hops) and not
-        # the warmup dominates; BASELINE config 4's shape at 3% length.
-        # CPU fallback runs 50 headers through the native batch seam.
-        light_rate = bench_light_sync(
-            n_headers=50 if fallback else 300, use_device=not fallback
-        )
-    except Exception as e:  # pragma: no cover - keep the primary line
-        light_rate = None
-        light_err = repr(e)
-    try:
-        # 8192 on BOTH paths: the repo's north-star metric is defined at
-        # 8192, so the fallback curve must record it too (VERDICT r4 #5)
-        curve = bench_batch_curve(
-            sizes=(1, 8, 64, 1024, 8192),
-            use_device=not fallback,
-        )
-    except Exception as e:  # pragma: no cover
-        curve = {"error": repr(e)}
-    try:
-        sign_keygen = bench_sign_keygen()
-    except Exception as e:  # pragma: no cover
-        sign_keygen = {"error": repr(e)}
-    try:
-        merkle_rate = round(
-            bench_merkle_proof_batch(
-                2_000 if fallback else 10_000, use_device=not fallback
-            ),
-            1,
-        )
-    except Exception as e:  # pragma: no cover
-        merkle_rate = repr(e)
-    try:
-        mempool_rate = round(
-            bench_mempool_checktx(500 if fallback else 2000), 1
-        )
-    except Exception as e:  # pragma: no cover
-        mempool_rate = repr(e)
-    try:
-        block_interval = bench_block_interval(
-            target_height=6 if fallback else 12
-        )
-    except Exception as e:  # pragma: no cover
-        block_interval = {"error": repr(e)}
-    try:
-        # the reference-shaped 100-block window over real processes —
-        # CPU-side either way, so it runs on both backends
-        block_interval_100 = bench_block_interval_processes()
-    except Exception as e:  # pragma: no cover
-        block_interval_100 = {"error": repr(e)}
-    line = (
-            {
-                "metric": "ed25519_batch_verify_throughput",
-                "value": round(device_rate, 1),
-                # the unit names what actually ran: a fallback line must
-                # not masquerade as a per-chip device number
-                "unit": "sigs/s/cpu" if fallback else "sigs/s/chip",
-                "vs_baseline": round(device_rate / cpu_rate, 3),
-                "extra": {
-                    "backend": backend,
-                    **(
-                        {"last_device_measurement": _last_device_run()}
-                        if fallback
-                        else {}
-                    ),
-                    "cpu_single_verify_sigs_per_s": round(cpu_rate, 1),
-                    "cpu_batch_backend": (
-                        "native-rlc-batch-equation"
-                        if _native_batch_available()
-                        else "openssl-sequential"
-                    ),
-                    "device_rtt_ms_p50": (
-                        round(rtt_ms, 2) if rtt_ms is not None else None
-                    ),
-                    "verify_commit_light_150_p50_ms": round(p50_150, 2),
-                    "verify_commit_light_150_p95_ms": round(p95_150, 2),
-                    "verify_commit_10k_p50_ms": (
-                        round(p50_10k, 2) if p50_10k is not None else None
-                    ),
-                    "verify_commit_10k_p95_ms": (
-                        round(p95_10k, 2) if p95_10k is not None else None
-                    ),
-                    "verify_commit_10k_breakdown_ms": breakdown,
-                    "verify_commit_10k_breakdown_cpu_ms": breakdown_cpu,
-                    "verify_commit_1k_mixed_keys_p50_ms": (
-                        round(p50_mixed, 2)
-                        if p50_mixed is not None
-                        else mixed_err
-                    ),
-                    "verify_commit_10k_mixed_keys_p50_ms": (
-                        round(p50_mixed_10k, 2)
-                        if p50_mixed_10k is not None
-                        else (mixed_10k_err or mixed_err)
-                    ),
-                    "sr25519_batch_verify_us_per_sig_by_batch": curve_sr,
-                    "light_sync_headers_per_s_150vals": (
-                        round(light_rate, 2) if light_rate else light_err
-                    ),
-                    "batch_verify_us_per_sig_by_batch": curve,
-                    "sign_keygen_us": sign_keygen,
-                    "merkle_proof_batch_per_s": merkle_rate,
-                    "mempool_checktx_per_s": mempool_rate,
-                    "localnet_block_interval": block_interval,
-                    "localnet_block_interval_100proc": block_interval_100,
-                },
-            }
+            return {"p50_ms": round(p50, 2), "p95_ms": round(p95, 2)}
+
+        return run
+
+    cpu_stage("lat150", _lat_cpu(150, 5, True), "_lat150_cpu")
+    cpu_stage("lat10k", _lat_cpu(10_000, 3, False), "_lat10k_cpu", 1200.0)
+    cpu_stage(
+        "breakdown",
+        lambda: bench_commit_breakdown_cpu(10_000, reps=3),
+        "verify_commit_10k_breakdown_cpu_ms",
     )
-    if not fallback:
-        # final rewrite with the complete line (see _persist_midround)
+    cpu_stage("mixed1k", _lat_cpu(1_000, 3, False, mixed=True), "_mixed1k_cpu")
+    cpu_stage(
+        "mixed10k", _lat_cpu(10_000, 3, False, mixed=True), "_mixed10k_cpu",
+        1200.0,
+    )
+    cpu_stage(
+        "curve",
+        lambda: bench_batch_curve(
+            sizes=(1, 8, 64, 1024, 8192), use_device=False
+        ),
+        "batch_verify_us_per_sig_by_batch_cpu",
+    )
+    cpu_stage(
+        "curve_sr",
+        lambda: bench_batch_curve(
+            sizes=(1, 8, 64, 1024), key_type="sr25519", use_device=False
+        ),
+        "sr25519_batch_verify_us_per_sig_by_batch_cpu",
+    )
+    cpu_stage(
+        "light_sync",
+        lambda: round(bench_light_sync(n_headers=50, use_device=False), 2),
+        "light_sync_headers_per_s_150vals_cpu",
+    )
+    cpu_stage("sign_keygen", bench_sign_keygen, "sign_keygen_us")
+    cpu_stage(
+        "merkle",
+        lambda: round(bench_merkle_proof_batch(2_000, use_device=False), 1),
+        "merkle_proof_batch_per_s_cpu",
+    )
+    cpu_stage(
+        "mempool",
+        lambda: round(bench_mempool_checktx(1000), 1),
+        "mempool_checktx_per_s",
+    )
+    cpu_stage(
+        "block_interval",
+        lambda: bench_block_interval(target_height=8),
+        "localnet_block_interval",
+        900.0,
+    )
+    cpu_stage(
+        "block_interval_100proc",
+        bench_block_interval_processes,
+        "localnet_block_interval_100proc",
+        900.0,
+    )
+
+    def _cpu_pair(key, field):
+        v = extra.get(key)
+        return v.get(field) if isinstance(v, dict) and field in v else v
+
+    extra["verify_commit_light_150_p50_cpu_ms"] = _cpu_pair("_lat150_cpu", "p50_ms")
+    extra["verify_commit_light_150_p95_cpu_ms"] = _cpu_pair("_lat150_cpu", "p95_ms")
+    extra["verify_commit_10k_p50_cpu_ms"] = _cpu_pair("_lat10k_cpu", "p50_ms")
+    extra["verify_commit_10k_p95_cpu_ms"] = _cpu_pair("_lat10k_cpu", "p95_ms")
+    extra["verify_commit_1k_mixed_keys_p50_cpu_ms"] = _cpu_pair(
+        "_mixed1k_cpu", "p50_ms"
+    )
+    extra["verify_commit_10k_mixed_keys_p50_cpu_ms"] = _cpu_pair(
+        "_mixed10k_cpu", "p50_ms"
+    )
+    for k in ("_lat150_cpu", "_lat10k_cpu", "_mixed1k_cpu", "_mixed10k_cpu"):
+        extra.pop(k, None)
+
+    # ---- device probe: throwaway subprocess first (a wedged claim
+    # hangs jax backend init; in a subprocess that costs one TERM, not
+    # this process), then the real in-process claim under the guard.
+    try:
+        probe_timeout = float(
+            os.environ.get("TM_BENCH_DEVICE_TIMEOUT", "") or 300.0
+        )
+    except ValueError:
+        probe_timeout = 300.0
+    guard.tick("device_probe_subprocess", probe_timeout + 60.0)
+    have_device = _probe_device_subprocess(probe_timeout)
+    fallback = not have_device
+
+    def _canon_cpu(reason="cpu-fallback (device unreachable)"):
+        """Fallback: the CPU numbers ARE the run — canonical keys point
+        at them (schema unchanged from r4's fallback lines)."""
+        extra["backend"] = reason
+        extra["device_rtt_ms_p50"] = {"skipped": "cpu fallback"}
+        extra["verify_commit_light_150_p50_ms"] = extra[
+            "verify_commit_light_150_p50_cpu_ms"
+        ]
+        extra["verify_commit_light_150_p95_ms"] = extra[
+            "verify_commit_light_150_p95_cpu_ms"
+        ]
+        extra["verify_commit_10k_p50_ms"] = extra["verify_commit_10k_p50_cpu_ms"]
+        extra["verify_commit_10k_p95_ms"] = extra["verify_commit_10k_p95_cpu_ms"]
+        extra["verify_commit_10k_breakdown_ms"] = {
+            "skipped": "cpu fallback; see ..._cpu_ms"
+        }
+        extra["verify_commit_1k_mixed_keys_p50_ms"] = extra[
+            "verify_commit_1k_mixed_keys_p50_cpu_ms"
+        ]
+        extra["verify_commit_10k_mixed_keys_p50_ms"] = extra[
+            "verify_commit_10k_mixed_keys_p50_cpu_ms"
+        ]
+        extra["sr25519_batch_verify_us_per_sig_by_batch"] = extra[
+            "sr25519_batch_verify_us_per_sig_by_batch_cpu"
+        ]
+        extra["batch_verify_us_per_sig_by_batch"] = extra[
+            "batch_verify_us_per_sig_by_batch_cpu"
+        ]
+        extra["light_sync_headers_per_s_150vals"] = extra[
+            "light_sync_headers_per_s_150vals_cpu"
+        ]
+        extra["merkle_proof_batch_per_s"] = extra["merkle_proof_batch_per_s_cpu"]
+        extra["last_device_measurement"] = _last_device_run()
+
+    if fallback:
+        _canon_cpu()
+        guard.disarm()
+        _emit_line()
+        return
+
+    # ---- device block: escalating risk, each stage banked into the
+    # line as it lands. RTT first (trivial program), then a 128-bucket
+    # verify that proves end-to-end EXECUTION before the big 8192
+    # compile gets a multi-minute budget. BENCH_DEVICE_MIDROUND.json
+    # holds REAL device measurements only — it is written only once
+    # the device headline has landed (a CPU line here would poison
+    # last_device_measurement for every later fallback run).
+    # `backend` stays honest about the headline: it reads "device"
+    # only once the device throughput has actually replaced the CPU
+    # value (a stall-guard emission before that must not attribute the
+    # CPU number to the device).
+    extra["backend"] = "device-attempt (headline cpu until throughput lands)"
+    not_reached = {"skipped": "device stage not reached"}
+    for k in (
+        "device_rtt_ms_p50",
+        "verify_commit_light_150_p50_ms",
+        "verify_commit_light_150_p95_ms",
+        "verify_commit_10k_p50_ms",
+        "verify_commit_10k_p95_ms",
+        "verify_commit_10k_breakdown_ms",
+        "verify_commit_1k_mixed_keys_p50_ms",
+        "verify_commit_10k_mixed_keys_p50_ms",
+        "sr25519_batch_verify_us_per_sig_by_batch",
+        "batch_verify_us_per_sig_by_batch",
+        "light_sync_headers_per_s_150vals",
+        "merkle_proof_batch_per_s",
+    ):
+        extra[k] = not_reached
+
+    guard.tick("device_claim", 600.0)
+    try:
+        import jax
+
+        extra["devices"] = [str(d) for d in jax.devices()]
+        _enable_compile_cache()
+    except Exception as e:
+        # probed claimable moments ago but the in-process claim failed:
+        # treat as fallback rather than dying with no line
+        extra["device_claim_error"] = repr(e)
+        _canon_cpu("cpu (in-process device claim failed)")
+        guard.disarm()
+        _emit_line()
+        return
+
+    def dev_stage(name, fn, key, budget_s=0.0):
+        guard.tick(f"device:{name}", budget_s)
+        try:
+            extra[key] = fn()
+        except Exception as e:
+            extra[key] = {"error": repr(e)}
+        if line["unit"] == "sigs/s/chip":
+            _persist_midround(line)
+
+    dev_stage(
+        "rtt",
+        lambda: round(bench_device_rtt(), 2),
+        "device_rtt_ms_p50",
+        600.0,
+    )
+
+    def _verify_128():
+        from tendermint_tpu.ops.ed25519_kernel import Ed25519Verifier
+
+        vp, vm, vs = _make_batch(128, seed=3)
+        v = Ed25519Verifier(bucket_sizes=[128])
+        t0 = time.perf_counter()
+        ok = v.verify(vp, vm, vs)
+        assert bool(ok.all()), "128-bucket device verify failed"
+        return {"compile_plus_run_s": round(time.perf_counter() - t0, 1)}
+
+    # first big compiles: generous budgets (a cold Mosaic-free XLA
+    # compile of the 8192 program took ~2 min on a warm tunnel, but
+    # today's contended cold run needed ~24 min for the pair)
+    dev_stage("verify_128", _verify_128, "device_verify_128", 1800.0)
+    if "error" in (extra["device_verify_128"] or {}):
+        # the execution proof failed: do NOT spend hours of budget on
+        # nine more device stages a broken tunnel will also fail —
+        # fall back to the banked CPU numbers, keeping the error
+        _canon_cpu("cpu (device execution proof failed; see device_verify_128)")
+        guard.disarm()
+        _emit_line()
+        return
+
+    def _tput():
+        rate = bench_throughput(n=8192)
+        line["value"] = round(rate, 1)
+        line["unit"] = "sigs/s/chip"
+        line["vs_baseline"] = round(rate / cpu_rate, 3)
+        # only now has a device measurement actually replaced the CPU
+        # headline — the backend label follows the value
+        extra["backend"] = "device"
+        return round(rate, 1)
+
+    dev_stage(
+        "throughput_8192", _tput, "device_throughput_8192_sigs_per_s", 1800.0
+    )
+
+    def _lat_dev(n, reps, light, p95_key, mixed=False):
+        def run():
+            p50, p95 = bench_commit_latency(n, reps=reps, light=light, mixed=mixed)
+            if p95_key:
+                extra[p95_key] = round(p95, 2)
+            return round(p50, 2)
+
+        return run
+
+    dev_stage(
+        "commit_150_light",
+        _lat_dev(150, 20, True, "verify_commit_light_150_p95_ms"),
+        "verify_commit_light_150_p50_ms",
+    )
+    dev_stage(
+        "commit_10k",
+        _lat_dev(10_000, 10, False, "verify_commit_10k_p95_ms"),
+        "verify_commit_10k_p50_ms",
+        1200.0,
+    )
+    dev_stage(
+        "commit_10k_breakdown",
+        lambda: bench_commit_breakdown(10_000, reps=5),
+        "verify_commit_10k_breakdown_ms",
+    )
+    dev_stage(
+        "commit_1k_mixed",
+        _lat_dev(1_000, 5, False, None, mixed=True),
+        "verify_commit_1k_mixed_keys_p50_ms",
+    )
+    dev_stage(
+        "commit_10k_mixed",
+        _lat_dev(10_000, 3, False, None, mixed=True),
+        "verify_commit_10k_mixed_keys_p50_ms",
+        1200.0,
+    )
+    dev_stage(
+        "batch_curve",
+        lambda: bench_batch_curve(sizes=(1, 8, 64, 1024, 8192)),
+        "batch_verify_us_per_sig_by_batch",
+        1200.0,
+    )
+    dev_stage(
+        "batch_curve_sr",
+        lambda: bench_batch_curve(sizes=(1, 8, 64, 1024), key_type="sr25519"),
+        "sr25519_batch_verify_us_per_sig_by_batch",
+        1200.0,
+    )
+    dev_stage(
+        "light_sync",
+        lambda: round(bench_light_sync(n_headers=300), 2),
+        "light_sync_headers_per_s_150vals",
+        1200.0,
+    )
+    dev_stage(
+        "merkle",
+        lambda: round(bench_merkle_proof_batch(10_000), 1),
+        "merkle_proof_batch_per_s",
+    )
+    guard.disarm()
+    if line["unit"] == "sigs/s/chip":
         _persist_midround(line)
-    print(json.dumps(line))
+    _emit_line()
 
 
 if __name__ == "__main__":
