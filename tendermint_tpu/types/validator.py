@@ -118,6 +118,7 @@ class ValidatorSet:
         self._total_voting_power = 0
         self._addr_index: Dict[bytes, int] = {}
         self._hash: Optional[bytes] = None
+        self._proto_memo: Optional[tuple] = None
         valz = [v.copy() for v in validators] if validators else []
         self._update_with_change_set(valz, allow_deletes=False)
         if valz:
@@ -167,6 +168,7 @@ class ValidatorSet:
         new._total_voting_power = self._total_voting_power
         new._addr_index = dict(self._addr_index)
         new._hash = self._hash  # same membership -> same merkle root
+        new._proto_memo = None
         return new
 
     def _reindex(self) -> None:
@@ -174,6 +176,7 @@ class ValidatorSet:
             v.address: i for i, v in enumerate(self.validators)
         }
         self._hash = None  # membership changed; recompute lazily
+        self._proto_memo = None
 
     def _update_total_voting_power(self) -> None:
         total = 0
@@ -406,13 +409,42 @@ class ValidatorSet:
     # -- proto --
 
     def to_proto(self) -> bytes:
+        """Memoized: the light client saves one LightBlock per header
+        and every one of them embeds the SAME 150-validator set, so
+        without the memo the pure-Python proto writer re-serializes
+        ~150 pubkeys per header (more than half of measured sync time).
+        Unlike hash(), the wire form covers proposer priorities, which
+        mutate in place outside _reindex (increment_proposer_priority)
+        — so the memo is validated against a cheap fingerprint of
+        exactly the mutable inputs (priorities + proposer identity)
+        on every call instead of trusting an invalidation hook."""
+        key = (
+            tuple(v.proposer_priority for v in self.validators),
+            # the proposer's full mutable record, not just its address:
+            # copy()/from_proto() can leave self.proposer detached from
+            # its list entry, so its fields can change independently
+            (
+                (
+                    self.proposer.address,
+                    self.proposer.voting_power,
+                    self.proposer.proposer_priority,
+                )
+                if self.proposer is not None
+                else None
+            ),
+        )
+        memo = getattr(self, "_proto_memo", None)
+        if memo is not None and memo[0] == key:
+            return memo[1]
         w = ProtoWriter()
         for v in self.validators:
             w.message(1, v.to_proto())
         if self.proposer is not None:
             w.message(2, self.proposer.to_proto())
         w.int(3, self.total_voting_power())
-        return w.finish()
+        out = w.finish()
+        self._proto_memo = (key, out)
+        return out
 
     @classmethod
     def from_proto(cls, data: bytes) -> "ValidatorSet":

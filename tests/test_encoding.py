@@ -41,6 +41,22 @@ def test_varint_negative_int64_is_ten_bytes():
     assert v == (1 << 64) - 1
 
 
+def test_varint_fast_path_boundaries():
+    """The encoder has interned 1-byte and computed 2-byte fast paths;
+    pin their boundaries and that negatives (e.g. proposer priorities)
+    take the 10-byte two's-complement path, not a fast path."""
+    for v in (0x7F, 0x80, 0x3FFF, 0x4000, 0x4001):
+        enc = encode_varint(v)
+        dec, off = decode_varint(enc)
+        assert (dec, off) == (v, len(enc))
+    assert encode_varint(0x3FFF) == b"\xff\x7f"
+    assert encode_varint(0x4000) == b"\x80\x80\x01"
+    for v in (-2, -(1 << 30), -(1 << 62)):
+        enc = encode_varint(v)
+        assert len(enc) == 10
+        assert decode_varint(enc)[0] == (v & ((1 << 64) - 1))
+
+
 def test_zigzag_roundtrip():
     for v in (0, -1, 1, -2, 2, 2**31, -(2**31), 2**62):
         assert decode_zigzag(encode_zigzag(v)) == v

@@ -185,6 +185,30 @@ class TestValidatorSet:
             v.address for v in vals.validators
         ]
 
+    def test_to_proto_memo_tracks_priority_rotation(self):
+        """to_proto is memoized (the light store serializes the same
+        set once per header), but its wire form covers proposer
+        priorities — rotation must invalidate it even though no
+        membership changed."""
+        vals, _ = make_validators(4)
+        first = vals.to_proto()
+        assert vals.to_proto() is first  # memo hit, same object
+        rotated = vals.copy_increment_proposer_priority(1)
+        assert rotated.to_proto() != first
+        vals.increment_proposer_priority(1)
+        after = vals.to_proto()
+        assert after != first
+        # the memoized bytes equal a fresh, unmemoized serialization
+        rt = ValidatorSet.from_proto(after)
+        assert [
+            (v.address, v.voting_power, v.proposer_priority)
+            for v in rt.validators
+        ] == [
+            (v.address, v.voting_power, v.proposer_priority)
+            for v in vals.validators
+        ]
+        assert rt.proposer.address == vals.proposer.address
+
 
 class TestVoteSet:
     def test_quorum_and_commit(self):
