@@ -967,6 +967,14 @@ def main() -> None:
         "localnet_block_interval",
         900.0,
     )
+    # the real-process localnet last measures node-side block times:
+    # free the 10k-commit memos first so the 8 node/app children don't
+    # share the box with this process's peak heap (measured: interval
+    # stddev 0.07 s isolated vs 1.35 s when run with the memos live)
+    _COMMIT_MEMO.clear()
+    import gc
+
+    gc.collect()
     cpu_stage(
         "block_interval_100proc",
         bench_block_interval_processes,

@@ -59,6 +59,14 @@ def encode_varint(value: int) -> bytes:
 
 def decode_varint(data: bytes, offset: int = 0) -> Tuple[int, int]:
     """Decode a varint; returns (value, new_offset)."""
+    # single-byte fast path: the overwhelmingly common case for tags
+    # and small lengths (mirror of encode_varint's interned table)
+    try:
+        b = data[offset]
+    except IndexError:
+        raise ValueError("truncated varint") from None
+    if not b & 0x80:
+        return b, offset + 1
     result = 0
     shift = 0
     while True:
