@@ -970,7 +970,9 @@ def main() -> None:
     # the real-process localnet last measures node-side block times:
     # free the 10k-commit memos first so the 8 node/app children don't
     # share the box with this process's peak heap (measured: interval
-    # stddev 0.07 s isolated vs 1.35 s when run with the memos live)
+    # stddev 0.07 s isolated vs 1.35 s when run with the memos live).
+    # Device commit stages rebuild the memos afterwards — a few
+    # seconds of signs against their 1200 s budgets.
     _COMMIT_MEMO.clear()
     import gc
 

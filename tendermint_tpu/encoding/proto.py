@@ -67,8 +67,10 @@ def decode_varint(data: bytes, offset: int = 0) -> Tuple[int, int]:
         raise ValueError("truncated varint") from None
     if not b & 0x80:
         return b, offset + 1
-    result = 0
-    shift = 0
+    # seed the loop with the byte already fetched
+    result = b & 0x7F
+    shift = 7
+    offset += 1
     while True:
         if offset >= len(data):
             raise ValueError("truncated varint")

@@ -1,0 +1,129 @@
+"""The bench's banked-line emission machinery.
+
+The round-end driver parses exactly one JSON line from bench.py; these
+pin the guarantees that line survives the observed failure modes (a
+tunnel that dies mid-stage, an unserializable extra, a wedged claim)
+without paying for a full bench run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_emit():
+    bench._EMIT.clear()
+    bench._EMIT.update({"done": False, "line": None})
+    yield
+    bench._EMIT.clear()
+    bench._EMIT.update({"done": False, "line": None})
+
+
+def _line(extra=None):
+    return {
+        "metric": "m",
+        "value": 1.5,
+        "unit": "sigs/s/cpu",
+        "vs_baseline": 2.0,
+        "extra": extra if extra is not None else {},
+    }
+
+
+def test_emit_line_prints_exactly_once(capsys):
+    bench._EMIT["line"] = _line()
+    bench._emit_line()
+    bench._emit_line()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    assert json.loads(out[0])["value"] == 1.5
+
+
+def test_emit_line_noop_without_banked_line(capsys):
+    bench._emit_line()
+    assert capsys.readouterr().out == ""
+    assert not bench._EMIT["done"]
+
+
+def test_emit_line_stall_tag(capsys):
+    bench._EMIT["line"] = _line()
+    bench._emit_line(stall="stage 'x' exceeded its budget")
+    d = json.loads(capsys.readouterr().out)
+    assert "exceeded" in d["extra"]["stall"]
+
+
+def test_emit_line_minimal_fallback_on_unserializable_extra(capsys):
+    bench._EMIT["line"] = _line(extra={"bad": object()})
+    bench._emit_line(stall="why")
+    d = json.loads(capsys.readouterr().out)
+    # scalar headline fields survive; the poisoned extra is replaced
+    assert d["value"] == 1.5 and d["unit"] == "sigs/s/cpu"
+    assert "stall" in d["extra"]
+    assert bench._EMIT["done"]
+
+
+def test_probe_device_subprocess_honors_cpu_fallback_env(monkeypatch):
+    monkeypatch.setenv("TM_BENCH_CPU_FALLBACK", "1")
+    assert bench._probe_device_subprocess(5.0) is False
+
+
+def test_stall_guard_emits_banked_line_and_exits_3():
+    """End-to-end guard firing: a subprocess banks a line, arms the
+    guard with a tiny budget, then blocks — the watcher must print the
+    banked line with the stall tag and exit 3. (Subprocess because the
+    guard exits via os._exit.)"""
+    script = r"""
+import sys, time
+sys.path.insert(0, %r)
+import bench
+bench._EMIT["line"] = {"metric": "m", "value": 7, "unit": "u",
+                       "vs_baseline": 1, "extra": {}}
+g = bench._StallGuard(1.0)
+g.tick("wedged-stage", 1.0)
+time.sleep(60)
+print("guard never fired")
+sys.exit(0)
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=50,
+        env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 3, (r.returncode, r.stdout, r.stderr)
+    d = json.loads(r.stdout.strip().splitlines()[-1])
+    assert d["value"] == 7
+    assert "wedged-stage" in d["extra"]["stall"]
+
+
+def test_stall_guard_disarm_prevents_firing():
+    script = r"""
+import sys, time
+sys.path.insert(0, %r)
+import bench
+bench._EMIT["line"] = {"metric": "m", "value": 7, "unit": "u",
+                       "vs_baseline": 1, "extra": {}}
+g = bench._StallGuard(1.0)
+g.tick("s", 1.0)
+g.disarm()
+time.sleep(12)
+bench._emit_line()
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=40,
+        env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    d = json.loads(r.stdout.strip())
+    assert "stall" not in d["extra"]
