@@ -192,6 +192,20 @@ def run_checks(so: str) -> int:
     lib.tm_pk_cache_stats(stats)
     assert list(stats) == [0, 0, 0, 0]
 
+    # fixed-base multiply + ristretto encode (sign/keygen path):
+    # edge scalars (0, 1, L-1) and random ones
+    lib.tm_ristretto_basemul.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.tm_ristretto_basemul.restype = ctypes.c_int
+    L = 2**252 + 27742317777372353535851937790883648493
+    out32 = ctypes.create_string_buffer(32)
+    for k in [0, 1, 2, L - 1] + [
+        random.randrange(L) for _ in range(32)
+    ]:
+        rc = lib.tm_ristretto_basemul(
+            int(k).to_bytes(32, "little"), out32
+        )
+        assert rc == 0, k
+
     print("ASAN PASS: all entry points, all MSM paths, no reports")
     return 0
 

@@ -51,6 +51,19 @@ L = rst.L
 _SIGNING_PREFIX: Optional[Transcript] = None
 
 
+def _basemul_encode(k: int) -> bytes:
+    """encode(k*B): native fixed-base multiply when the batch library
+    is available (tm_ristretto_basemul — the sign/keygen hot spot),
+    else the pure-Python comb. Differential-tested against each other
+    in tests/test_sr25519.py."""
+    from .. import native
+
+    out = native.ristretto_basemul(int(k).to_bytes(32, "little"))
+    if out is not None:
+        return out
+    return rst.encode(rst.mul_base(k))
+
+
 def _signing_transcript(msg: bytes) -> Transcript:
     """signing_context([]).bytes(msg) (reference: privkey.go:16,48).
     The state after the two constant appends is identical for every
@@ -265,7 +278,7 @@ class PrivKeySr25519(PrivKey):
         key[31] |= 64
         self._key = _scalar_divide_by_cofactor(bytes(key)) % L
         self._nonce = h[32:]
-        self._pub = rst.encode(rst.mul_base(self._key))
+        self._pub = _basemul_encode(self._key)
 
     @classmethod
     def generate(cls) -> "PrivKeySr25519":
@@ -279,20 +292,29 @@ class PrivKeySr25519(PrivKey):
         return self._mini
 
     def sign(self, msg: bytes) -> bytes:
-        t = _signing_transcript(msg)
-        # witness scalar: nonce + transcript + fresh randomness (the
+        # witness scalar: nonce + message + fresh randomness (the
         # schnorrkel witness construction mixes an external RNG, so the
         # exact bytes are implementation-defined; verification only
-        # depends on R and s)
+        # depends on R and s). The message is bound directly — no
+        # transcript clone — so the same construction serves both the
+        # native and pure-Python challenge paths below.
+        from .. import native
+
         r_seed = hashlib.sha512(
-            b"sr25519-witness"
-            + self._nonce
-            + t.clone().challenge_bytes(b"witness", 32)
-            + os.urandom(32)
+            b"sr25519-witness" + self._nonce + msg + os.urandom(32)
         ).digest()
         r = int.from_bytes(r_seed, "little") % L
-        r_bytes = rst.encode(rst.mul_base(r))
-        k = _challenge(t, self._pub, r_bytes)
+        r_bytes = _basemul_encode(r)
+        lib = native.ed25519_batch_lib()
+        if lib is not None:
+            # merlin challenge (STROBE-128) in C — tm_sr25519_challenge
+            import ctypes
+
+            out = ctypes.create_string_buffer(32)
+            lib.tm_sr25519_challenge(self._pub, r_bytes, msg, len(msg), out)
+            k = int.from_bytes(out.raw, "little")
+        else:
+            k = _challenge(_signing_transcript(msg), self._pub, r_bytes)
         s = (k * self._key + r) % L
         s_bytes = bytearray(int(s).to_bytes(32, "little"))
         s_bytes[31] |= 0x80  # schnorrkel v1 marker

@@ -175,6 +175,12 @@ def ed25519_batch_lib():
             ctypes.c_char_p,
         ]
         lib.tm_sr25519_challenge_test.restype = None
+        # production sign-path challenge (same computation; the _test
+        # name is the historical differential hook)
+        lib.tm_sr25519_challenge.argtypes = (
+            lib.tm_sr25519_challenge_test.argtypes
+        )
+        lib.tm_sr25519_challenge.restype = None
         # decoded-point cache observability (hits/misses/inserts/
         # evictions) + reset — the repeated-validator-set optimization
         # (reference: crypto/ed25519/ed25519.go:50-56 cacheSize 4096)
@@ -184,8 +190,26 @@ def ed25519_batch_lib():
         lib.tm_pk_cache_stats.restype = None
         lib.tm_pk_cache_clear.argtypes = []
         lib.tm_pk_cache_clear.restype = None
+        # fixed-base multiply + ristretto encode (sr25519 sign/keygen)
+        lib.tm_ristretto_basemul.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+        ]
+        lib.tm_ristretto_basemul.restype = ctypes.c_int
         lib._tm_configured = True
     return lib
+
+
+def ristretto_basemul(scalar_le32: bytes) -> Optional[bytes]:
+    """encode(scalar*B) through the native library, or None when
+    native is unavailable. scalar: 32-byte little-endian, < L."""
+    lib = ed25519_batch_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(32)
+    if lib.tm_ristretto_basemul(scalar_le32, out) != 0:
+        return None
+    return out.raw
 
 
 def pk_cache_stats() -> Optional[dict]:

@@ -958,6 +958,98 @@ static int rist_fin2(ge *r, const uint8_t *s, const pre_t *p,
     return rist_fin(r, s, p->a, p->b, p->c, p->d, powed);
 }
 
+/* ---- ristretto255 encode (RFC 9496 §4.3.2) -------------------------
+ *
+ * The inverse of rist_pre/rist_fin, needed by the sign/keygen path
+ * (R = r*B and A = a*B leave the library as canonical 32-byte
+ * encodings). Mirrors crypto/ristretto.py encode() — that Python
+ * implementation is the differential oracle in the tests. */
+
+/* 1/sqrt(a-d) = sqrt_ratio_m1(1, a-d) for a = -1, nonneg root
+ * (value from crypto/ristretto.py _INVSQRT_A_MINUS_D) */
+static const fe FE_INVSQRT_AMD = {
+    0x0fdaa805d40eaULL, 0x2eb482e57d339ULL, 0x007610274bc58ULL,
+    0x6510b613dc8ffULL, 0x786c8905cfaffULL};
+
+static int fe_isneg(const fe a) {
+    uint8_t b[32];
+    fe_tobytes(b, a);
+    return b[0] & 1;
+}
+
+/* r = |1/sqrt(v)| via sqrt_ratio_m1(1, v): r = v^3*(v^7)^((p-5)/8)
+ * with the sqrt(-1) fixups; returns was_square. Single-shot form of
+ * the inline sequence in rist_fin (which takes a batched power). */
+static int fe_invsqrt(fe r, const fe v) {
+    fe powin, powed, check, one, none, nonei;
+    fe_sq(powin, v);
+    fe_mul(powin, powin, v);     /* v^3 */
+    fe_sq(powin, powin);
+    fe_mul(powin, powin, v);     /* v^7 */
+    fe_pow2523(powed, powin);
+    fe_sq(r, v);
+    fe_mul(r, r, v);             /* v^3 */
+    fe_mul(r, r, powed);         /* v^3*(v^7)^((p-5)/8) */
+    fe_sq(check, r);
+    fe_mul(check, check, v);     /* v*r^2 */
+    fe_one(one);
+    int correct = fe_eq(check, one);
+    fe_neg(none, one);
+    fe_carry(none);
+    int flipped = fe_eq(check, none);
+    fe_mul(nonei, none, FE_SQRTM1);
+    int flipped_i = fe_eq(check, nonei);
+    if (flipped || flipped_i) fe_mul(r, r, FE_SQRTM1);
+    if (fe_isneg(r)) {           /* |r| */
+        fe_neg(r, r);
+        fe_carry(r);
+    }
+    return correct || flipped;
+}
+
+static void rist_encode(uint8_t out[32], const ge *p) {
+    fe u1, u2, t1, invsq, den1, den2, zinv, x, y, den_inv, tmp, s;
+    fe_add(t1, p->Z, p->Y);
+    fe_carry(t1);
+    fe_sub(u1, p->Z, p->Y);
+    fe_carry(u1);
+    fe_mul(u1, t1, u1);          /* (Z+Y)(Z-Y) */
+    fe_mul(u2, p->X, p->Y);
+    fe_sq(tmp, u2);
+    fe_mul(tmp, tmp, u1);        /* u1*u2^2 */
+    fe_invsqrt(invsq, tmp);      /* square for every valid point */
+    fe_mul(den1, invsq, u1);
+    fe_mul(den2, invsq, u2);
+    fe_mul(zinv, den1, den2);
+    fe_mul(zinv, zinv, p->T);
+    fe_mul(tmp, p->T, zinv);
+    if (fe_isneg(tmp)) {         /* rotate */
+        fe ix, iy;
+        fe_mul(ix, p->X, FE_SQRTM1);
+        fe_mul(iy, p->Y, FE_SQRTM1);
+        fe_copy(x, iy);
+        fe_copy(y, ix);
+        fe_mul(den_inv, den1, FE_INVSQRT_AMD);
+    } else {
+        fe_copy(x, p->X);
+        fe_copy(y, p->Y);
+        fe_copy(den_inv, den2);
+    }
+    fe_mul(tmp, x, zinv);
+    if (fe_isneg(tmp)) {
+        fe_neg(y, y);
+        fe_carry(y);
+    }
+    fe_sub(s, p->Z, y);
+    fe_carry(s);
+    fe_mul(s, den_inv, s);
+    if (fe_isneg(s)) {           /* |s| */
+        fe_neg(s, s);
+        fe_carry(s);
+    }
+    fe_tobytes(out, s);
+}
+
 /* ---- decoded-point cache -------------------------------------------
  *
  * The reference caches 4096 expanded public keys for repeated
@@ -1535,14 +1627,26 @@ static void sr_challenge(const strobe_t *prefix, const uint8_t *pk,
 }
 
 /* differential test hook: the C challenge vs crypto/sr25519._challenge */
-void tm_sr25519_challenge_test(const uint8_t *pk, const uint8_t *r,
-                               const uint8_t *msg, uint64_t mlen,
-                               uint8_t *out32) {
+/* k = merlin challenge for (pk, R, msg) under the signing context —
+ * the production sign-path entry (crypto/sr25519.py sign()). The
+ * fixed prefix is rebuilt per call: one STROBE init + Keccak-f
+ * permutation (~1 us), not worth a locked static cache. */
+void tm_sr25519_challenge(const uint8_t *pk, const uint8_t *r,
+                          const uint8_t *msg, uint64_t mlen,
+                          uint8_t *out32) {
     strobe_t prefix;
     uint64_t k[4];
     merlin_signing_prefix(&prefix);
     sr_challenge(&prefix, pk, r, msg, (size_t)mlen, k);
     sc4_tobytes(out32, k);
+}
+
+/* differential test hook (tests/test_sr25519.py): same computation,
+ * kept under the historical name */
+void tm_sr25519_challenge_test(const uint8_t *pk, const uint8_t *r,
+                               const uint8_t *msg, uint64_t mlen,
+                               uint8_t *out32) {
+    tm_sr25519_challenge(pk, r, msg, mlen, out32);
 }
 
 /* Whole-batch sr25519 verify with the host prep done natively — the
@@ -1607,4 +1711,69 @@ done:
     free(z_sc);
     free(r_b);
     return rc;
+}
+
+/* ---- constant-time fixed-base multiply (secret-scalar path) --------
+ *
+ * The verify-side MSMs (Straus/Pippenger above) branch and index
+ * tables by scalar digits — fine there, those scalars are public
+ * (signatures, RLC weights). Sign/keygen scalars are the Schnorr
+ * witness and the private key: partial nonce leakage across many
+ * signatures is lattice-recoverable, so this path uses a branchless
+ * 16-way select and an unconditional complete addition per window —
+ * digit-independent control flow and memory access pattern. */
+
+static uint64_t ct_eq_u64(uint64_t a, uint64_t b) {
+    uint64_t d = a ^ b;
+    return 1 & ((d - 1) >> 63); /* 1 iff d == 0 */
+}
+
+static void fe_cmov(fe r, const fe a, uint64_t cond) {
+    uint64_t mask = (uint64_t)0 - cond;
+    for (int i = 0; i < 5; i++) r[i] ^= mask & (r[i] ^ a[i]);
+}
+
+static void ge_cmov(ge *r, const ge *a, uint64_t cond) {
+    fe_cmov(r->X, a->X, cond);
+    fe_cmov(r->Y, a->Y, cond);
+    fe_cmov(r->Z, a->Z, cond);
+    fe_cmov(r->T, a->T, cond);
+}
+
+/* R = k*B, 4-bit windows MSB-first; the unified ge_add is complete
+ * (a = -1 HWCD), so adding the selected entry — identity included —
+ * needs no digit-dependent branch. */
+static void ge_basemul_ct(ge *r, const uint8_t *scalar) {
+    ge table[16]; /* d*B for d = 0..15; table build is public */
+    ge_identity(&table[0]);
+    fe_copy(table[1].X, FE_BX);
+    fe_copy(table[1].Y, FE_BY);
+    fe_one(table[1].Z);
+    fe_copy(table[1].T, FE_BT);
+    for (int d = 2; d < 16; d++) ge_add(&table[d], &table[d - 1], &table[1]);
+    ge_identity(r);
+    for (int w = 63; w >= 0; w--) {
+        if (w != 63)
+            for (int k = 0; k < 4; k++) ge_dbl(r, r);
+        int byte = w >> 1;
+        uint64_t d = (w & 1) ? (uint64_t)(scalar[byte] >> 4)
+                             : (uint64_t)(scalar[byte] & 0x0f);
+        ge sel = table[0];
+        for (uint64_t j = 1; j < 16; j++)
+            ge_cmov(&sel, &table[j], ct_eq_u64(d, j));
+        ge_add(r, r, &sel);
+    }
+}
+
+/* Fixed-base scalar multiply + ristretto encode in one call:
+ * out = encode(scalar * B). Serves the sr25519 sign/keygen hot spots
+ * (R = r*B, A = a*B — schnorrkel's sign path does exactly these two
+ * basepoint multiplies; reference surface: crypto/sr25519/privkey.go).
+ * scalar: 32-byte little-endian, already reduced mod L. Returns 0
+ * (kept int-returning for ABI stability with earlier revisions). */
+int tm_ristretto_basemul(const uint8_t *scalar, uint8_t *out) {
+    ge R;
+    ge_basemul_ct(&R, scalar);
+    rist_encode(out, &R);
+    return 0;
 }

@@ -268,6 +268,8 @@ def _verify_commit_batch(
     seen_vals: dict[int, int] = {}
     # key type -> (verifier, [commit sig indexes added to it])
     groups: dict[str, tuple] = {}
+    # key type -> (bound add or None-for-inline, bound index append)
+    _adders: dict[str, tuple] = {}
     # one templated pass for all sign-bytes when every signature will
     # be checked (verify_commit): at 10k signatures the per-index
     # marshal is the dominant host cost (see Commit.sign_bytes_batch).
@@ -300,7 +302,23 @@ def _verify_commit_batch(
             else commit.vote_sign_bytes(chain_id, idx)
         )
         key_type = val.pub_key.type()
-        if not supports_batch_verifier(val.pub_key):
+        # per-key-type dispatch cached: at 10k signatures the repeated
+        # supports_batch_verifier() call and per-item bound-method
+        # creation were a measurable slice of the assemble phase
+        entry = _adders.get(key_type)
+        if entry is None:
+            if not supports_batch_verifier(val.pub_key):
+                _adders[key_type] = (None, None)
+            else:
+                bv = create_batch_verifier(
+                    val.pub_key, size_hint=len(commit.signatures)
+                )
+                idxs: list = []
+                groups[key_type] = (bv, idxs)
+                _adders[key_type] = (bv.add, idxs.append)
+            entry = _adders[key_type]
+        add_fn, idx_append = entry
+        if add_fn is None:
             # no batch support for this type: verify inline
             if not val.pub_key.verify_signature(
                 vote_sign_bytes, commit_sig.signature
@@ -310,17 +328,8 @@ def _verify_commit_batch(
                     f"{commit_sig.signature.hex()}"
                 )
         else:
-            group = groups.get(key_type)
-            if group is None:
-                group = (
-                    create_batch_verifier(
-                        val.pub_key, size_hint=len(commit.signatures)
-                    ),
-                    [],
-                )
-                groups[key_type] = group
-            group[0].add(val.pub_key, vote_sign_bytes, commit_sig.signature)
-            group[1].append(idx)
+            add_fn(val.pub_key, vote_sign_bytes, commit_sig.signature)
+            idx_append(idx)
         if count_sig(commit_sig):
             tallied += val.voting_power
         if not count_all_signatures and tallied > voting_power_needed:

@@ -440,3 +440,27 @@ def test_single_verify_undecodable_r_rejected():
     bad_r = bytes([sig[0]]) + sig[1:31] + bytes([sig[31] | 0x80])
     assert not pub.verify_signature(b"m", bad_r + sig[32:])
     assert pub.verify_signature(b"m", sig)
+
+
+def test_native_basemul_matches_python_oracle():
+    """tm_ristretto_basemul (constant-time fixed-base multiply +
+    ristretto encode, the sign/keygen hot path) against the pure-
+    Python oracle across edge scalars — 0 (identity), 1 (basepoint),
+    window boundaries, L-1 (= -B) — and seeded random ones."""
+    import random
+
+    from tendermint_tpu import native
+    from tendermint_tpu.crypto import ristretto as rst
+    from tendermint_tpu.crypto.sr25519 import L
+
+    if native.ed25519_batch_lib() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = random.Random(1307)
+    cases = [0, 1, 2, 15, 16, 17, 255, 256, 2**51, 2**252, L - 1] + [
+        rng.randrange(1, L) for _ in range(64)
+    ]
+    for k in cases:
+        nat = native.ristretto_basemul(int(k).to_bytes(32, "little"))
+        assert nat == rst.encode(rst.mul_base(k)), k
