@@ -123,6 +123,22 @@ def create_batch_verifier(
     return cpu()
 
 
+def native_cpu_affinity() -> int:
+    """Merged-window size when only CPU kernels serve batches. The
+    native RLC batch equation is exact-size (no bucket padding) and
+    its per-signature cost keeps falling through ~8k terms (PERF.md
+    batch curve: 24 us @64 -> 10.4 us @8192), so merging a light
+    client's sequential window into one call wins on CPU too. Without
+    the native kernel the OpenSSL-sequential fallback gains nothing
+    from merging — stay at 1."""
+    try:
+        from .ed25519 import _native_batch_fn
+
+        return 32 if _native_batch_fn() is not None else 1
+    except Exception:  # pragma: no cover - native probing must not raise
+        return 1
+
+
 def _register_defaults() -> None:
     from .ed25519 import KEY_TYPE as ED, Ed25519BatchVerifier
 
@@ -136,3 +152,4 @@ def _register_defaults() -> None:
 
 
 _register_defaults()
+set_group_affinity_fn(native_cpu_affinity)

@@ -305,14 +305,9 @@ class PrivKeySr25519(PrivKey):
         ).digest()
         r = int.from_bytes(r_seed, "little") % L
         r_bytes = _basemul_encode(r)
-        lib = native.ed25519_batch_lib()
-        if lib is not None:
-            # merlin challenge (STROBE-128) in C — tm_sr25519_challenge
-            import ctypes
-
-            out = ctypes.create_string_buffer(32)
-            lib.tm_sr25519_challenge(self._pub, r_bytes, msg, len(msg), out)
-            k = int.from_bytes(out.raw, "little")
+        k_bytes = native.sr25519_challenge(self._pub, r_bytes, msg)
+        if k_bytes is not None:
+            k = int.from_bytes(k_bytes, "little")
         else:
             k = _challenge(_signing_transcript(msg), self._pub, r_bytes)
         s = (k * self._key + r) % L

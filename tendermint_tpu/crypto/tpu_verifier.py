@@ -457,12 +457,15 @@ def install(
     register_device_factory("sr25519", _factory_sr)
     _start_sr_warm_thread()
     # merged multi-commit batches (light sequential windows) only pay
-    # off on an accelerator; on a CPU-backed kernel the bucket padding
-    # of a merged window inverts the win (measured 5x slower). The
-    # decision needs jax.default_backend(), which initializes the
-    # backend — deferred to first use so a wedged device claim cannot
-    # hang install() itself at node startup (PERF.md, device-claim
-    # discipline).
+    # off on an accelerator ONCE THIS FACTORY IS INSTALLED: _factory
+    # serves every >=_MIN_BATCH batch regardless of backend, and on a
+    # CPU-backed JAX kernel the bucket padding of a merged window
+    # inverts the win (measured 5x slower). Uninstalled processes get
+    # batch.native_cpu_affinity's module default instead (the native
+    # RLC equation is exact-size, so merging wins there). The decision
+    # needs jax.default_backend(), which initializes the backend —
+    # deferred to first use so a wedged device claim cannot hang
+    # install() itself at node startup (PERF.md, claim discipline).
     from .batch import set_group_affinity_fn
 
     def _affinity() -> int:

@@ -212,6 +212,18 @@ def ristretto_basemul(scalar_le32: bytes) -> Optional[bytes]:
     return out.raw
 
 
+def sr25519_challenge(pub: bytes, r: bytes, msg: bytes) -> Optional[bytes]:
+    """The merlin signing-context challenge k for (pub, R, msg) as 32
+    little-endian bytes (reduced mod L), or None when native is
+    unavailable — the sign-path twin of ristretto_basemul."""
+    lib = ed25519_batch_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(32)
+    lib.tm_sr25519_challenge(pub, r, msg, len(msg), out)
+    return out.raw
+
+
 def pk_cache_stats() -> Optional[dict]:
     """Decoded-point cache counters from the native batch library, or
     None when native is unavailable."""

@@ -1740,17 +1740,38 @@ static void ge_cmov(ge *r, const ge *a, uint64_t cond) {
     fe_cmov(r->T, a->T, cond);
 }
 
+/* d*B for d = 0..15 — basepoint multiples are compile-time-constant
+ * values, built once on first use (building them per sign call cost
+ * ~14 redundant point adds). 0=empty, 1=building, 2=ready; the table
+ * contents are public, only the SELECTION below is secret. */
+static ge BASE_TABLE16[16];
+static atomic_int base_table_state;
+
+static void base_table_init(void) {
+    if (atomic_load_explicit(&base_table_state, memory_order_acquire) == 2)
+        return;
+    int expected = 0;
+    if (atomic_compare_exchange_strong(&base_table_state, &expected, 1)) {
+        ge_identity(&BASE_TABLE16[0]);
+        fe_copy(BASE_TABLE16[1].X, FE_BX);
+        fe_copy(BASE_TABLE16[1].Y, FE_BY);
+        fe_one(BASE_TABLE16[1].Z);
+        fe_copy(BASE_TABLE16[1].T, FE_BT);
+        for (int d = 2; d < 16; d++)
+            ge_add(&BASE_TABLE16[d], &BASE_TABLE16[d - 1], &BASE_TABLE16[1]);
+        atomic_store_explicit(&base_table_state, 2, memory_order_release);
+    } else {
+        while (atomic_load_explicit(&base_table_state, memory_order_acquire)
+               != 2) {
+        }
+    }
+}
+
 /* R = k*B, 4-bit windows MSB-first; the unified ge_add is complete
  * (a = -1 HWCD), so adding the selected entry — identity included —
  * needs no digit-dependent branch. */
 static void ge_basemul_ct(ge *r, const uint8_t *scalar) {
-    ge table[16]; /* d*B for d = 0..15; table build is public */
-    ge_identity(&table[0]);
-    fe_copy(table[1].X, FE_BX);
-    fe_copy(table[1].Y, FE_BY);
-    fe_one(table[1].Z);
-    fe_copy(table[1].T, FE_BT);
-    for (int d = 2; d < 16; d++) ge_add(&table[d], &table[d - 1], &table[1]);
+    base_table_init();
     ge_identity(r);
     for (int w = 63; w >= 0; w--) {
         if (w != 63)
@@ -1758,9 +1779,9 @@ static void ge_basemul_ct(ge *r, const uint8_t *scalar) {
         int byte = w >> 1;
         uint64_t d = (w & 1) ? (uint64_t)(scalar[byte] >> 4)
                              : (uint64_t)(scalar[byte] & 0x0f);
-        ge sel = table[0];
+        ge sel = BASE_TABLE16[0];
         for (uint64_t j = 1; j < 16; j++)
-            ge_cmov(&sel, &table[j], ct_eq_u64(d, j));
+            ge_cmov(&sel, &BASE_TABLE16[j], ct_eq_u64(d, j));
         ge_add(r, r, &sel);
     }
 }
