@@ -483,3 +483,48 @@ def test_decoded_point_cache():
     ok, _ = bv.verify()
     assert ok
     run()  # ed25519 entries still valid after sr25519 traffic
+
+
+def test_group_affinity_policy():
+    """The merged-window affinity policy (light sequential windows,
+    statesync backfill):
+
+    - uninstalled process + native batch kernel -> 32 (the exact-size
+      native RLC equation gets cheaper per sig with batch size)
+    - uninstalled process, no native -> 1 (OpenSSL-sequential gains
+      nothing from merging)
+    - device factory installed but JAX backend is NOT an accelerator
+      -> 1 (merged batches would route to the padded JAX kernel,
+      measured 5x slower — the regression guard)
+    """
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto import tpu_verifier
+
+    prev = B.group_affinity_state()
+    try:
+        # module default, native present (it is in CI: built on demand)
+        B.set_group_affinity_fn(B.native_cpu_affinity)
+        from tendermint_tpu.crypto.ed25519 import _native_batch_fn
+
+        expected = 32 if _native_batch_fn() is not None else 1
+        assert B.group_affinity() == expected
+
+        # no native -> 1
+        import tendermint_tpu.crypto.ed25519 as ed
+
+        B.restore_group_affinity((None, None, False))
+        saved = ed._native_batch_fn
+        ed._native_batch_fn = lambda: None
+        try:
+            B.set_group_affinity_fn(B.native_cpu_affinity)
+            assert B.group_affinity() == 1
+        finally:
+            ed._native_batch_fn = saved
+
+        # installed on a non-accelerator backend -> 1 (tests run with
+        # JAX_PLATFORMS=cpu, so install()'s deferred fn answers 1)
+        B.restore_group_affinity((None, None, False))
+        tpu_verifier.install(min_batch=2)
+        assert B.group_affinity() == 1
+    finally:
+        B.restore_group_affinity(prev)

@@ -537,8 +537,9 @@ def test_client_update_to_latest():
 def test_client_sequential_windowed_multiwindow():
     """A sync spanning several SEQUENTIAL_BATCH_HOPS windows stores
     every interim header, exactly like the one-hop loop. Group
-    affinity is forced up (it defaults to 1 without an accelerator
-    install) so the merged-window path actually runs."""
+    affinity is pinned explicitly (the default depends on whether the
+    native batch kernel built — see test_crypto's affinity-policy
+    tests) so the merged-window path deterministically runs."""
     from tendermint_tpu.crypto.batch import (
         group_affinity_state,
         restore_group_affinity,
