@@ -41,6 +41,11 @@ def register_device_factory(
     _DEVICE_FACTORIES[key_type] = factory
 
 
+def unregister_device_factory(key_type: str) -> None:
+    """Remove a device factory (tpu_verifier.uninstall's half)."""
+    _DEVICE_FACTORIES.pop(key_type, None)
+
+
 def device_factory_installed(key_type: str) -> bool:
     return key_type in _DEVICE_FACTORIES
 

@@ -474,3 +474,32 @@ def install(
         return 32 if jax.default_backend() == "tpu" else 1
 
     set_group_affinity_fn(_affinity)
+
+
+def uninstall() -> None:
+    """Remove the device factories and reset install state — the
+    counterpart of install(), mirroring ops/merkle_kernel.uninstall()
+    (tests and embedders switching a node back to the CPU seam). The
+    generation bump retires any in-flight warm thread — it only
+    publishes under a current generation — and the merged-window
+    affinity falls back to the module default
+    (batch.native_cpu_affinity) unless an operator pinned a value
+    explicitly."""
+    global _SHARED_VERIFIER, _SHARED_VERIFIER_SR, _MIN_BATCH, _INSTALLED
+    global _SR_WARM, _SR_WARM_GEN
+    from .batch import (
+        native_cpu_affinity,
+        set_group_affinity_fn,
+        unregister_device_factory,
+    )
+
+    unregister_device_factory("ed25519")
+    unregister_device_factory("sr25519")
+    with _SR_WARM_LOCK:
+        _SR_WARM = False
+        _SR_WARM_GEN += 1
+        _SHARED_VERIFIER = None
+        _SHARED_VERIFIER_SR = None
+    _MIN_BATCH = DEFAULT_MIN_BATCH
+    _INSTALLED = False
+    set_group_affinity_fn(native_cpu_affinity)

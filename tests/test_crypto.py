@@ -524,7 +524,10 @@ def test_group_affinity_policy():
         # installed on a non-accelerator backend -> 1 (tests run with
         # JAX_PLATFORMS=cpu, so install()'s deferred fn answers 1)
         B.restore_group_affinity((None, None, False))
-        tpu_verifier.install(min_batch=2)
-        assert B.group_affinity() == 1
+        try:
+            tpu_verifier.install(min_batch=2)
+            assert B.group_affinity() == 1
+        finally:
+            tpu_verifier.uninstall()
     finally:
         B.restore_group_affinity(prev)

@@ -165,7 +165,7 @@ class TestDeviceSeam:
             assert not ok
             assert bitmap == [True, True, False, True, True, True]
         finally:
-            crypto_batch._DEVICE_FACTORIES.clear()
+            tpu_verifier.uninstall()
 
     def test_mixed_commit_on_device(self):
         from .test_sr25519 import _mixed_commit
@@ -179,4 +179,4 @@ class TestDeviceSeam:
             # both key-type groups went through device batch verifiers
             assert tpu_verifier.stats()["sigs"] >= sigs_before + 9
         finally:
-            crypto_batch._DEVICE_FACTORIES.clear()
+            tpu_verifier.uninstall()

@@ -106,7 +106,7 @@ def test_node_installs_sharded_verifier_from_config(tmp_path):
             ok, bitmap = bv.verify()
             assert ok and bitmap == [True] * 9
         finally:
-            crypto_batch._DEVICE_FACTORIES.clear()
+            tpu_verifier.uninstall()
 
     asyncio.run(go())
 
@@ -187,4 +187,4 @@ def test_mesh_install_shards_sr25519(mesh):
         ok, bitmap = bv.verify()
         assert ok and bitmap == [True] * 8
     finally:
-        crypto_batch._DEVICE_FACTORIES.clear()
+        tpu_verifier.uninstall()

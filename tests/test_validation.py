@@ -151,7 +151,7 @@ class TestDeviceCommitVerify:
 
         tpu_verifier.install(min_batch=2)
         yield
-        crypto_batch._DEVICE_FACTORIES.clear()
+        tpu_verifier.uninstall()
 
     def test_device_verify_valid_commit(self):
         vals, bid, commit = make_commit(4)
