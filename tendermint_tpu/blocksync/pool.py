@@ -178,10 +178,20 @@ class BlockPool(Service):
             ev = self._block_events.get(height)
             if ev is None:
                 return
+            # asyncio.wait, not wait_for: on Python 3.10, wait_for
+            # swallows a cancellation that races the event being set
+            # (bpo-42130 family), leaving this requester alive forever
+            # and hanging Service.stop()'s gather. wait() re-raises the
+            # outer cancel unconditionally.
+            waiter = asyncio.ensure_future(ev.wait())
             try:
-                await asyncio.wait_for(ev.wait(), timeout=REQUEST_TIMEOUT)
-            except asyncio.TimeoutError:
-                continue  # try another peer
+                done, _pending = await asyncio.wait(
+                    {waiter}, timeout=REQUEST_TIMEOUT
+                )
+            finally:
+                waiter.cancel()
+            if waiter not in done:
+                continue  # timeout: try another peer
             # block arrived (possibly from redo_request → cleared event)
             while height in self._blocks:
                 await asyncio.sleep(0.1)

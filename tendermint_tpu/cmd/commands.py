@@ -823,6 +823,13 @@ def cmd_debug_dump(args) -> int:
         add_bytes(
             tar, "summary.json", json.dumps(summary, indent=2).encode()
         )
+        # this process's span-trace ring as Chrome-trace JSON (empty
+        # traceEvents when tracing was never enabled): in-process
+        # embedders and the --device-profile capture above leave spans
+        # here the way the reference's bundle carries pprof profiles
+        from ..libs import trace as _trace
+
+        add_bytes(tar, "trace.json", _trace.to_chrome_trace().encode())
         # live metrics scrape, best effort
         if args.metrics_url:
             try:

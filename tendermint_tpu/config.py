@@ -185,6 +185,12 @@ class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
     namespace: str = "tendermint_tpu"
+    # span tracing (libs/trace.py): record the commit-verification
+    # pipeline into the in-memory ring, exportable as Chrome-trace JSON
+    # via the debug bundle. Off by default — the disabled path is a
+    # no-op. Process-wide switch (the ring is shared).
+    trace_spans: bool = False
+    trace_ring_capacity: int = 8192
 
 
 @dataclass
@@ -242,11 +248,77 @@ _SECTIONS = {
 }
 
 
-def load_config(path: str) -> Config:
-    import tomllib
+def _parse_toml_value(val: str):
+    """One scalar/list/inline-table value of the supported subset.
+    Raises ValueError on anything else."""
+    import ast
 
-    with open(path, "rb") as f:
-        raw = tomllib.load(f)
+    if val == "true":
+        return True
+    if val == "false":
+        return False
+    if val.startswith("{") and val.endswith("}"):
+        # inline table of scalars (e2e manifests: {double-prevote = 3})
+        out = {}
+        inner = val[1:-1].strip()
+        if inner:
+            for pair in inner.split(","):
+                k, eq, v = pair.partition("=")
+                if not eq:
+                    raise ValueError(f"bad inline table entry: {pair!r}")
+                out[k.strip()] = _parse_toml_value(v.strip())
+        return out
+    try:
+        # numbers, quoted strings (same escapes our writers emit),
+        # and flat lists thereof
+        return ast.literal_eval(val)
+    except (ValueError, SyntaxError) as e:
+        raise ValueError(f"unsupported TOML value: {val!r}") from e
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Fallback parser for the TOML subset our own writers emit
+    (write_config, e2e manifests: sections incl. dotted names;
+    bool/number/string/flat-list/inline-table values) — Python < 3.11
+    ships no tomllib, and the container may not carry tomli."""
+    raw: dict = {}
+    cur: dict = raw  # keys before any [section] are document-root keys
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("#"):
+            continue
+        if '"' not in line:
+            # trailing comments are only safe to strip when no string
+            # value could contain the '#'
+            line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = raw
+            for part in line[1:-1].strip().split("."):
+                cur = cur.setdefault(part.strip(), {})
+            continue
+        if "=" not in line:
+            # tomllib rejects junk lines; silently skipping would let a
+            # typo'd setting fall back to its default with no error
+            raise ValueError(f"unparseable TOML line: {line!r}")
+        key, _, val = line.partition("=")
+        cur[key.strip()] = _parse_toml_value(val.strip())
+    return raw
+
+
+def load_config(path: str) -> Config:
+    try:
+        import tomllib
+    except ImportError:
+        tomllib = None
+
+    if tomllib is not None:
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+    else:
+        with open(path, encoding="utf-8") as f:
+            raw = _parse_toml_subset(f.read())
     cfg = Config()
     for section, cls in _SECTIONS.items():
         data = raw.get(section, {})

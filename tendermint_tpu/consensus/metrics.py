@@ -1,0 +1,54 @@
+"""Consensus metrics struct (reference: internal/consensus/metrics.go).
+
+The go-kit pattern: one struct holding every consensus instrument,
+built against a Registry and threaded through the constructor. Node
+assembly passes a per-node Registry so in-process localnet nodes keep
+disjoint series; constructing without one lands on DEFAULT_REGISTRY
+(idempotent — repeated default constructions share instruments).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.metrics import DEFAULT_REGISTRY, Registry
+
+__all__ = ["ConsensusMetrics"]
+
+
+class ConsensusMetrics:
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        r = registry if registry is not None else DEFAULT_REGISTRY
+        self.height = r.gauge(
+            "consensus", "height", "Height of the chain."
+        )
+        self.rounds = r.gauge(
+            "consensus", "rounds", "Number of rounds at the current height."
+        )
+        self.validators = r.gauge(
+            "consensus", "validators", "Number of validators."
+        )
+        self.validators_power = r.gauge(
+            "consensus",
+            "validators_power",
+            "Total voting power of validators.",
+        )
+        self.block_interval = r.histogram(
+            "consensus",
+            "block_interval_seconds",
+            "Time between this and the last block.",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+        )
+        self.num_txs = r.gauge(
+            "consensus",
+            "num_txs",
+            "Number of transactions in the latest block.",
+        )
+        self.total_txs = r.counter(
+            "consensus",
+            "total_txs",
+            "Total number of transactions committed.",
+        )
+        self.block_size = r.gauge(
+            "consensus", "block_size_bytes", "Size of the latest block."
+        )

@@ -23,7 +23,7 @@ from typing import Optional
 
 from ..config import ConsensusConfig
 from ..eventbus import EventBus
-from ..libs import metrics as M
+from ..libs import trace
 from ..libs.log import get_logger
 from ..libs.service import Service
 from ..privval.types import PrivValidator
@@ -48,37 +48,10 @@ from .msgs import (
     TimeoutInfo,
     VoteMessage,
 )
+from .metrics import ConsensusMetrics
 from .ticker import TimeoutTicker
 from .types import HeightVoteSet, RoundState, RoundStep, step_name
 from .wal import WAL, NopWAL
-
-# reference: internal/consensus/metrics.go:8-9 (height, rounds,
-# validators, block interval/size/txs via go-kit prometheus)
-_m_height = M.new_gauge("consensus", "height", "Height of the chain.")
-_m_rounds = M.new_gauge(
-    "consensus", "rounds", "Number of rounds at the current height."
-)
-_m_validators = M.new_gauge(
-    "consensus", "validators", "Number of validators."
-)
-_m_validators_power = M.new_gauge(
-    "consensus", "validators_power", "Total voting power of validators."
-)
-_m_block_interval = M.new_histogram(
-    "consensus",
-    "block_interval_seconds",
-    "Time between this and the last block.",
-    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
-)
-_m_num_txs = M.new_gauge(
-    "consensus", "num_txs", "Number of transactions in the latest block."
-)
-_m_total_txs = M.new_counter(
-    "consensus", "total_txs", "Total number of transactions committed."
-)
-_m_block_size = M.new_gauge(
-    "consensus", "block_size_bytes", "Size of the latest block."
-)
 
 __all__ = ["ConsensusState"]
 
@@ -98,9 +71,13 @@ class ConsensusState(Service):
         wal: "WAL | NopWAL | None" = None,
         evidence_pool=None,
         replay_mode: bool = False,
+        metrics: Optional[ConsensusMetrics] = None,
     ) -> None:
         super().__init__(name="consensus", logger=get_logger("consensus"))
         self.cfg = cfg
+        # reference: internal/consensus/metrics.go threaded via
+        # CSMetrics; per-node registry when node assembly provides one
+        self.metrics = metrics if metrics is not None else ConsensusMetrics()
         self.block_exec = block_exec
         self.block_store = block_store
         self.privval = privval
@@ -253,10 +230,10 @@ class ConsensusState(Service):
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self.state = state
-        _m_height.set(height)
-        _m_rounds.set(0)
-        _m_validators.set(validators.size())
-        _m_validators_power.set(validators.total_voting_power())
+        self.metrics.height.set(height)
+        self.metrics.rounds.set(0)
+        self.metrics.validators.set(validators.size())
+        self.metrics.validators_power.set(validators.total_voting_power())
 
     def _reconstruct_last_commit_from_store(self, state: State) -> None:
         """On restart, rebuild LastCommit from the stored seen-commit
@@ -392,6 +369,10 @@ class ConsensusState(Service):
         widens acceptance. Failed or foreign-height votes are left
         unmarked and take the normal verify path (which produces the
         proper per-vote error)."""
+        with trace.span("preverify_votes", queued=len(batch)):
+            self._preverify_votes_impl(batch)
+
+    def _preverify_votes_impl(self, batch: list) -> None:
         from ..crypto.batch import (
             create_batch_verifier,
             supports_batch_verifier,
@@ -517,7 +498,7 @@ class ConsensusState(Service):
             rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
         ):
             return
-        _m_rounds.set(round_)
+        self.metrics.rounds.set(round_)
         self.logger.info(
             "entering new round",
             height=height,
@@ -878,11 +859,11 @@ class ConsensusState(Service):
             hash=block.hash().hex()[:16],
             num_txs=len(block.txs),
         )
-        _m_num_txs.set(len(block.txs))
-        _m_total_txs.inc(len(block.txs))
-        _m_block_size.set(block.size())
+        self.metrics.num_txs.set(len(block.txs))
+        self.metrics.total_txs.inc(len(block.txs))
+        self.metrics.block_size.set(block.size())
         if self.state.last_block_time_ns:
-            _m_block_interval.observe(
+            self.metrics.block_interval.observe(
                 max(
                     0.0,
                     (block.header.time_ns - self.state.last_block_time_ns)
@@ -1035,7 +1016,19 @@ class ConsensusState(Service):
             return False
 
     async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
-        """reference: state.go:2058-2235."""
+        """reference: state.go:2058-2235. The span is the root of the
+        commit-verification trace tree: when this vote completes a +2/3
+        precommit, finalize runs inside it, so batch_accumulate /
+        tpu_dispatch / merkle_hash all nest under addVote."""
+        with trace.span(
+            "addVote",
+            height=vote.height,
+            round=vote.round,
+            type=vote.type,
+        ):
+            return await self._add_vote_impl(vote, peer_id)
+
+    async def _add_vote_impl(self, vote: Vote, peer_id: str) -> bool:
         rs = self.rs
         height = rs.height
 

@@ -12,23 +12,35 @@ accepts canonical encodings, a subset of ZIP-215's); on OpenSSL rejection we
 re-check with the pure-Python ZIP-215 oracle to catch the edge cases
 (non-canonical A/R encodings, mixed-cofactor components) that ZIP-215
 deliberately accepts.
+
+The wheel is gated, not required: containers without it fall back to
+RFC 8032 sign/keygen on ed25519_math's comb tables and ZIP-215
+verification through the native kernel (or the pure-Python oracle) —
+same bits on the wire, slower signing.
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 from typing import List, Optional, Tuple
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    NoEncryption,
-    PrivateFormat,
-    PublicFormat,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+        PublicFormat,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # no cryptography wheel: pure-Python/native paths
+    _HAVE_OPENSSL = False
 
 from . import ed25519_math
 from .keys import (
@@ -70,20 +82,24 @@ class PubKeyEd25519(PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE:
             return False
-        try:
-            Ed25519PublicKey.from_public_bytes(self._bytes).verify(sig, msg)
-            return True
-        except (InvalidSignature, ValueError):
-            # OpenSSL is stricter than ZIP-215; consult the oracle.
-            # The native kernel's n=1 cofactored check IS the ZIP-215
-            # equation ([8](sB-kA-R) == identity) — ~0.12 ms vs ~5 ms
-            # for the pure-Python oracle, which matters because this
-            # path is adversarially reachable (a flood of edge-case
-            # signatures would otherwise cost milliseconds each).
-            native = _native_verify_one_zip215(self._bytes, msg, sig)
-            if native is not None:
-                return native
-            return ed25519_math.zip215_verify(self._bytes, msg, sig)
+        if _HAVE_OPENSSL:
+            try:
+                Ed25519PublicKey.from_public_bytes(self._bytes).verify(
+                    sig, msg
+                )
+                return True
+            except (InvalidSignature, ValueError):
+                pass
+        # OpenSSL is stricter than ZIP-215 (or absent); consult the
+        # oracle. The native kernel's n=1 cofactored check IS the
+        # ZIP-215 equation ([8](sB-kA-R) == identity) — ~0.12 ms vs
+        # ~5 ms for the pure-Python oracle, which matters because this
+        # path is adversarially reachable (a flood of edge-case
+        # signatures would otherwise cost milliseconds each).
+        native = _native_verify_one_zip215(self._bytes, msg, sig)
+        if native is not None:
+            return native
+        return ed25519_math.zip215_verify(self._bytes, msg, sig)
 
 
 class PrivKeyEd25519(PrivKey):
@@ -97,17 +113,18 @@ class PrivKeyEd25519(PrivKey):
         else:
             raise ValueError("ed25519 privkey must be 32 or 64 bytes")
         self._seed = bytes(seed)
-        sk = Ed25519PrivateKey.from_private_bytes(self._seed)
-        self._pub = sk.public_key().public_bytes(
-            Encoding.Raw, PublicFormat.Raw
-        )
+        if _HAVE_OPENSSL:
+            sk = Ed25519PrivateKey.from_private_bytes(self._seed)
+            self._pub = sk.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw
+            )
+        else:
+            a, _prefix = _expand_seed(self._seed)
+            self._pub = ed25519_math.compress(ed25519_math.mul_base(a))
 
     @classmethod
     def generate(cls) -> "PrivKeyEd25519":
-        sk = Ed25519PrivateKey.generate()
-        return cls(
-            sk.private_bytes(Encoding.Raw, PrivateFormat.Raw, NoEncryption())
-        )
+        return cls(os.urandom(32))
 
     @classmethod
     def from_seed(cls, seed: bytes) -> "PrivKeyEd25519":
@@ -118,13 +135,33 @@ class PrivKeyEd25519(PrivKey):
         return self._seed + self._pub
 
     def sign(self, msg: bytes) -> bytes:
-        return Ed25519PrivateKey.from_private_bytes(self._seed).sign(msg)
+        if _HAVE_OPENSSL:
+            return Ed25519PrivateKey.from_private_bytes(self._seed).sign(msg)
+        # RFC 8032 §5.1.6 on the comb tables — bit-identical output
+        a, prefix = _expand_seed(self._seed)
+        r = (
+            int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little")
+            % ed25519_math.L
+        )
+        R = ed25519_math.compress(ed25519_math.mul_base(r))
+        k = ed25519_math.sha512_mod_l(R, self._pub, msg)
+        s = (r + k * a) % ed25519_math.L
+        return R + s.to_bytes(32, "little")
 
     def pub_key(self) -> PubKey:
         return PubKeyEd25519(self._pub)
 
     def type(self) -> str:
         return KEY_TYPE
+
+
+def _expand_seed(seed: bytes) -> Tuple[int, bytes]:
+    """RFC 8032 §5.1.5: SHA-512(seed) → (clamped scalar, prefix)."""
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
 
 
 # Measured crossover vs OpenSSL sequential: the native equation wins

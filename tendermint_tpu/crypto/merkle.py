@@ -18,6 +18,8 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from ..libs import trace
+
 __all__ = [
     "hash_from_byte_slices",
     "verify_proofs_batch",
@@ -67,12 +69,14 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     offloaded when the device backend is installed."""
     if not items:
         return empty_hash()
-    leaf_hashes = [leaf_hash(it) for it in items]
-    if _device_root_hook is not None:
-        root = _device_root_hook(leaf_hashes)
-        if root is not None:
-            return root
-    return _reduce(leaf_hashes)
+    with trace.span("merkle_hash", leaves=len(items)):
+        leaf_hashes = [leaf_hash(it) for it in items]
+        if _device_root_hook is not None:
+            root = _device_root_hook(leaf_hashes)
+            if root is not None:
+                trace.add_attrs(device=True)
+                return root
+        return _reduce(leaf_hashes)
 
 
 def verify_proofs_batch(proofs, root_hash: bytes, leaves: Sequence[bytes]):
@@ -82,21 +86,23 @@ def verify_proofs_batch(proofs, root_hash: bytes, leaves: Sequence[bytes]):
     BatchVerifier.Verify)."""
     import numpy as _np
 
-    checked = _np.array(
-        [
-            len(p.leaf_hash) == 32 and leaf_hash(leaf) == p.leaf_hash
-            for p, leaf in zip(proofs, leaves)
-        ],
-        dtype=bool,
-    )
-    if _device_proofs_hook is not None:
-        bitmap = _device_proofs_hook(proofs, root_hash)
-        if bitmap is not None:
-            return checked & bitmap
-    cpu = _np.array(
-        [p.compute_root_hash() == root_hash for p in proofs], dtype=bool
-    )
-    return checked & cpu
+    with trace.span("merkle_verify_proofs", proofs=len(proofs)):
+        checked = _np.array(
+            [
+                len(p.leaf_hash) == 32 and leaf_hash(leaf) == p.leaf_hash
+                for p, leaf in zip(proofs, leaves)
+            ],
+            dtype=bool,
+        )
+        if _device_proofs_hook is not None:
+            bitmap = _device_proofs_hook(proofs, root_hash)
+            if bitmap is not None:
+                trace.add_attrs(device=True)
+                return checked & bitmap
+        cpu = _np.array(
+            [p.compute_root_hash() == root_hash for p in proofs], dtype=bool
+        )
+        return checked & cpu
 
 
 def _reduce(hashes: List[bytes]) -> bytes:

@@ -10,17 +10,23 @@ from __future__ import annotations
 
 import hashlib
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    PublicFormat,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    _CURVE = ec.SECP256K1()
+except ImportError:  # gated: secp256k1 requires the cryptography wheel
+    ec = None
+    _CURVE = None
 
 from .keys import Address, PrivKey, PubKey, register_key_type
 
@@ -29,8 +35,15 @@ __all__ = ["PubKeySecp256k1", "PrivKeySecp256k1", "KEY_TYPE"]
 KEY_TYPE = "secp256k1"
 PUBKEY_SIZE = 33
 SIGNATURE_LEN = 64
-_CURVE = ec.SECP256K1()
 _ORDER = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def _require_openssl() -> None:
+    if ec is None:
+        raise RuntimeError(
+            "secp256k1 requires the `cryptography` wheel, which is not "
+            "installed; ed25519/sr25519 keys work without it"
+        )
 
 
 class PubKeySecp256k1(PubKey):
@@ -62,6 +75,7 @@ class PubKeySecp256k1(PubKey):
         # (crypto/secp256k1/secp256k1.go Verify requires normalized s).
         if s > _ORDER // 2 or r == 0 or s == 0:
             return False
+        _require_openssl()
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(
                 _CURVE, self._bytes
@@ -80,12 +94,14 @@ class PrivKeySecp256k1(PrivKey):
     def __init__(self, data: bytes) -> None:
         if len(data) != 32:
             raise ValueError("secp256k1 privkey must be 32 bytes")
+        _require_openssl()
         self._sk = ec.derive_private_key(
             int.from_bytes(data, "big"), _CURVE
         )
 
     @classmethod
     def generate(cls) -> "PrivKeySecp256k1":
+        _require_openssl()
         sk = ec.generate_private_key(_CURVE)
         return cls(
             sk.private_numbers().private_value.to_bytes(32, "big")

@@ -12,18 +12,30 @@ The HChaCha20 core is pure Python; its ChaCha permutation is
 differential-tested against the `cryptography` package's ChaCha20
 keystream (tests/test_symmetric.py), so the only hand-rolled math has
 an independent oracle.
+
+The `cryptography` wheel is gated: without it, the inner
+ChaCha20-Poly1305 AEAD runs a pure-Python RFC 8439 implementation on
+the same permutation (validated against the RFC's AEAD test vector in
+tests/test_symmetric.py) — identical bytes, slower.
 """
 
 from __future__ import annotations
 
+import hmac as _hmac
 import os
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        ChaCha20Poly1305,
+    )
+except ImportError:  # no wheel: pure-Python RFC 8439 AEAD below
+    ChaCha20Poly1305 = None
 
 __all__ = [
     "KEY_SIZE",
     "NONCE_SIZE",
+    "PureChaCha20Poly1305",
     "XChaCha20Poly1305",
     "encrypt_symmetric",
     "decrypt_symmetric",
@@ -93,6 +105,73 @@ def hchacha20(key: bytes, nonce16: bytes) -> bytes:
     return struct.pack("<4I", *st[0:4]) + struct.pack("<4I", *st[12:16])
 
 
+def _chacha20_xor(key: bytes, counter: int, nonce12: bytes, data: bytes) -> bytes:
+    out = bytearray(len(data))
+    for i in range(0, len(data), 64):
+        block = chacha20_block(key, counter + i // 64, nonce12)
+        chunk = data[i : i + 64]
+        out[i : i + len(chunk)] = bytes(
+            a ^ b for a, b in zip(chunk, block)
+        )
+    return bytes(out)
+
+
+def _poly1305(key32: bytes, msg: bytes) -> bytes:
+    """RFC 8439 §2.5 one-time authenticator."""
+    r = (
+        int.from_bytes(key32[:16], "little")
+        & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    )
+    s = int.from_bytes(key32[16:32], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(msg), 16):
+        acc = (
+            (acc + int.from_bytes(msg[i : i + 16] + b"\x01", "little")) * r
+        ) % p
+    return ((acc + s) & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def _pad16(b: bytes) -> bytes:
+    return b"\x00" * ((16 - len(b) % 16) % 16)
+
+
+class PureChaCha20Poly1305:
+    """RFC 8439 §2.8 AEAD on the module's own ChaCha permutation; same
+    construct/encrypt/decrypt surface as the `cryptography` class it
+    substitutes when the wheel is absent."""
+
+    def __init__(self, key: bytes) -> None:
+        if len(key) != KEY_SIZE:
+            raise ValueError("key must be 32 bytes")
+        self._key = bytes(key)
+
+    def _mac_data(self, aad: bytes, ct: bytes) -> bytes:
+        return (
+            aad + _pad16(aad) + ct + _pad16(ct)
+            + len(aad).to_bytes(8, "little")
+            + len(ct).to_bytes(8, "little")
+        )
+
+    def encrypt(self, nonce12: bytes, data: bytes, aad=None) -> bytes:
+        aad = aad or b""
+        otk = chacha20_block(self._key, 0, nonce12)[:32]
+        ct = _chacha20_xor(self._key, 1, nonce12, data)
+        return ct + _poly1305(otk, self._mac_data(aad, ct))
+
+    def decrypt(self, nonce12: bytes, data: bytes, aad=None) -> bytes:
+        aad = aad or b""
+        if len(data) < 16:
+            raise ValueError("ciphertext too short")
+        ct, tag = data[:-16], data[-16:]
+        otk = chacha20_block(self._key, 0, nonce12)[:32]
+        if not _hmac.compare_digest(
+            tag, _poly1305(otk, self._mac_data(aad, ct))
+        ):
+            raise ValueError("authentication failed")
+        return _chacha20_xor(self._key, 1, nonce12, ct)
+
+
 class XChaCha20Poly1305:
     """AEAD with a 24-byte nonce (reference:
     crypto/xchacha20poly1305/xchachapoly.go): derive a subkey with
@@ -108,7 +187,12 @@ class XChaCha20Poly1305:
         if len(nonce) != NONCE_SIZE:
             raise ValueError("nonce must be 24 bytes")
         subkey = hchacha20(self._key, nonce[:16])
-        return ChaCha20Poly1305(subkey), b"\x00\x00\x00\x00" + nonce[16:]
+        aead_cls = (
+            ChaCha20Poly1305
+            if ChaCha20Poly1305 is not None
+            else PureChaCha20Poly1305
+        )
+        return aead_cls(subkey), b"\x00\x00\x00\x00" + nonce[16:]
 
     def encrypt(
         self, nonce: bytes, plaintext: bytes, aad: bytes | None = None

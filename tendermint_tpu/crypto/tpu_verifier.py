@@ -17,11 +17,14 @@ import time
 from typing import List, Optional, Tuple
 
 from ..libs import metrics as M
+from ..libs import trace
 from .batch import register_device_factory
 from .keys import BatchVerifier, PubKey
 
 # device-offload observability (no reference analog — this is the
-# north-star seam's instrumentation)
+# north-star seam's instrumentation). Deliberately process-global on
+# DEFAULT_REGISTRY, unlike the per-node subsystem metrics: there is one
+# device runtime per process, and multi-node embedders share it.
 _m_batches = M.new_counter(
     "tpu", "verify_batches_total", "Device batch-verify invocations."
 )
@@ -33,6 +36,40 @@ _m_verify_time = M.new_histogram(
     "verify_seconds",
     "Wall time of one batch verification.",
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+)
+# dispatch telemetry: decompose verify_seconds into the host-side
+# assembly (packing triples into device arrays + async launch) and the
+# device wall (gather barrier) — the split PERF.md demands before any
+# device number is believed — plus bucket-padding waste and
+# warm-generation hit/miss for compile-stall attribution.
+_m_host_prep = M.new_histogram(
+    "tpu",
+    "host_prep_seconds",
+    "Host-side packing + async dispatch of one batch.",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25),
+)
+_m_device_wall = M.new_histogram(
+    "tpu",
+    "device_wall_seconds",
+    "Device wall time (gather barrier) of one batch.",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5),
+)
+_m_pad_waste = M.new_counter(
+    "tpu",
+    "pad_waste_slots_total",
+    "Signature slots wasted padding batches to bucket shapes.",
+)
+_m_warm_hits = M.new_counter(
+    "tpu",
+    "warm_bucket_hits_total",
+    "Dispatches into a bucket already run this install generation.",
+)
+_m_warm_misses = M.new_counter(
+    "tpu",
+    "warm_bucket_misses_total",
+    "First dispatches into a bucket (likely paying an XLA compile).",
 )
 
 __all__ = [
@@ -50,6 +87,41 @@ DEFAULT_MIN_BATCH = 8
 
 # lazily cached "is the backend a real accelerator" decision
 _STREAMING: Optional[bool] = None
+
+# (key type, backing verifier id, bucket) triples dispatched at least
+# once since the last install()/uninstall(): first touch of a bucket
+# shape likely pays an XLA compile, so dispatch telemetry labels it a
+# warm miss. Cleared on install/uninstall — a new generation's programs
+# are cold again.
+_WARM_BUCKETS: set = set()
+
+
+def _bucket_of(verifier, n: int) -> int:
+    """The padded bucket `n` signatures land in, from the backing
+    verifier's configured sizes (without importing the jax-backed ops
+    module: telemetry must not initialize a backend)."""
+    sizes = getattr(verifier, "bucket_sizes", None)
+    if not sizes:
+        from ..config import DEFAULT_BUCKET_SIZES
+
+        sizes = DEFAULT_BUCKET_SIZES
+    for b in sorted(sizes):
+        if b >= n:
+            return b
+    return n
+
+
+def _note_bucket_warmth(key_type: str, verifier, bucket: int) -> bool:
+    """Record (and count) whether this bucket shape has been dispatched
+    before in this install generation. Returns the hit/miss verdict for
+    the span attributes."""
+    key = (key_type, id(verifier), bucket)
+    if key in _WARM_BUCKETS:
+        _m_warm_hits.inc()
+        return True
+    _WARM_BUCKETS.add(key)
+    _m_warm_misses.inc()
+    return False
 
 
 def on_accelerator() -> bool:
@@ -137,6 +209,11 @@ class _TpuBatchVerifier(BatchVerifier):
         self._msgs: List[bytes] = []
         self._sigs: List[bytes] = []
         self._handles: List[tuple] = []  # (backing, handle, n), add order
+        # dispatch telemetry accumulated across THIS one-shot batch
+        # (streaming chunks launch from add(), before verify() runs)
+        self._last_bucket = 0
+        self._pad_waste = 0
+        self._cold_dispatch = False
 
     @staticmethod
     def _kernel_module():
@@ -156,10 +233,25 @@ class _TpuBatchVerifier(BatchVerifier):
         would mean extra test-suite compiles)."""
         return on_accelerator()
 
+    def _account_dispatch(self, v, n: int) -> None:
+        """Telemetry for ONE device dispatch of n triples: bucket
+        padding waste and warm-generation hit/miss. Called on every
+        launch — streaming chunks from add() included, since that is
+        exactly where a first-touch XLA compile stalls the hot path."""
+        bucket = _bucket_of(v, n)
+        waste = bucket - n
+        self._last_bucket = bucket
+        if waste:
+            self._pad_waste += waste
+            _m_pad_waste.inc(waste)
+        if not _note_bucket_warmth(self.KEY_TYPE, v, bucket):
+            self._cold_dispatch = True
+
     def _dispatch_pending(self, v) -> None:
         """Asynchronously launch the queued triples on `v` and clear
         the queue; the handle is gathered in verify(). Each dispatch is
         one device invocation for the metrics."""
+        self._account_dispatch(v, len(self._pks))
         self._handles.append(
             (v, v.dispatch(self._pks, self._msgs, self._sigs),
              len(self._pks))
@@ -190,15 +282,27 @@ class _TpuBatchVerifier(BatchVerifier):
         verify() again without new add()s reports (False, []) on every
         backend. In streaming mode verify_seconds times the remainder
         dispatch + gather barrier (chunk dispatches already ran inside
-        add, overlapped with the caller's assembly loop)."""
+        add, overlapped with the caller's assembly loop).
+
+        The tpu_dispatch span (and the host_prep/device_wall
+        histograms) split the wall time at the async-launch boundary:
+        everything before the handle exists is host packing, everything
+        after is the device barrier. Backings without the
+        dispatch()/gather() pair (injected test verifiers) report one
+        undivided wall time."""
         if not self._pks and not self._handles:
             return False, []
-        with _m_verify_time.time():
+        t0 = time.perf_counter()
+        with trace.span(
+            "tpu_dispatch", hist=_m_verify_time, key=self.KEY_TYPE
+        ):
             total = sum(n for _, _, n in self._handles) + len(self._pks)
             v = self._backing()
+            host_prep: Optional[float] = None
             if self._handles:
                 if self._pks:
                     self._dispatch_pending(v)
+                host_prep = time.perf_counter() - t0
                 bits: List[bool] = []
                 try:
                     for bv, handle, _n in self._handles:
@@ -209,7 +313,19 @@ class _TpuBatchVerifier(BatchVerifier):
                     # stale handles and double-count _m_sigs, and
                     # __len__ would keep reporting the in-flight count
                     self._handles = []
+            elif hasattr(v, "dispatch") and hasattr(v, "gather"):
+                # split verify() at the same boundary the streaming path
+                # uses (gather(dispatch()) is exactly v.verify())
+                self._account_dispatch(v, len(self._pks))
+                try:
+                    handle = v.dispatch(self._pks, self._msgs, self._sigs)
+                    host_prep = time.perf_counter() - t0
+                    bits = [bool(b) for b in v.gather(handle)]
+                finally:
+                    self._pks, self._msgs, self._sigs = [], [], []
+                _m_batches.inc()
             else:
+                self._account_dispatch(v, len(self._pks))
                 try:
                     bits = [
                         bool(b)
@@ -218,6 +334,20 @@ class _TpuBatchVerifier(BatchVerifier):
                 finally:
                     self._pks, self._msgs, self._sigs = [], [], []
                 _m_batches.inc()
+            if host_prep is not None:
+                device_wall = time.perf_counter() - t0 - host_prep
+                _m_host_prep.observe(host_prep)
+                _m_device_wall.observe(device_wall)
+                trace.add_attrs(
+                    host_prep_s=round(host_prep, 6),
+                    device_wall_s=round(device_wall, 6),
+                )
+            trace.add_attrs(
+                batch=total,
+                bucket=self._last_bucket,
+                pad_waste=self._pad_waste,
+                warm=not self._cold_dispatch,
+            )
         _m_sigs.inc(total)
         return all(bits), bits
 
@@ -453,6 +583,7 @@ def install(
         _SR_WARM_GEN += 1
         _SHARED_VERIFIER = new_ed
         _SHARED_VERIFIER_SR = new_sr
+    _WARM_BUCKETS.clear()  # new generation: every bucket is cold again
     register_device_factory("ed25519", _factory)
     register_device_factory("sr25519", _factory_sr)
     _start_sr_warm_thread()
@@ -500,6 +631,7 @@ def uninstall() -> None:
         _SR_WARM_GEN += 1
         _SHARED_VERIFIER = None
         _SHARED_VERIFIER_SR = None
+    _WARM_BUCKETS.clear()
     _MIN_BATCH = DEFAULT_MIN_BATCH
     _INSTALLED = False
     set_group_affinity_fn(native_cpu_affinity)

@@ -32,7 +32,10 @@ Manifest shape (TOML; all sections optional except validators):
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: the config fallback parser reads
+    tomllib = None  # the same subset our generator writes
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
@@ -135,8 +138,13 @@ class Manifest:
 
     @classmethod
     def from_toml(cls, path: str) -> "Manifest":
-        with open(path, "rb") as f:
-            return cls.parse(tomllib.load(f))
+        if tomllib is not None:
+            with open(path, "rb") as f:
+                return cls.parse(tomllib.load(f))
+        from ..config import _parse_toml_subset
+
+        with open(path, encoding="utf-8") as f:
+            return cls.parse(_parse_toml_subset(f.read()))
 
     def validate(self) -> None:
         if not self.validators:

@@ -8,14 +8,15 @@ docs/nodes/metrics.md:21-53) and the node-served endpoint
 use: Counter, Gauge, Histogram with static label names, rendered in the
 Prometheus text exposition format (version 0.0.4).
 
-Known limitation vs the reference: instruments register on a
-process-global registry (module-level definitions at each subsystem),
-where the reference threads a per-node Metrics struct. One node per
-process — the production deployment — is exact; multiple in-process
-nodes (the in-memory localnet test harness) interleave writes to the
-same series, so scrape values are only meaningful for single-node
-processes. Threading per-node registries through the constructors is
-the follow-up if embedding several nodes becomes a served use case.
+Per-node registries, matching the reference's threading: each subsystem
+exposes a go-kit-style Metrics struct (consensus/metrics.py,
+mempool/metrics.py, p2p/metrics.py, state/metrics.py) built against a
+Registry. Node assembly (node/node.py) constructs one Registry per node
+and threads the structs through the constructors, so in-process
+localnet nodes scrape disjoint series. DEFAULT_REGISTRY remains the
+default for subsystems constructed without an explicit registry (and
+for genuinely process-global instruments like the device verifier's),
+so call sites outside the constructors are unchanged.
 """
 
 from __future__ import annotations
@@ -101,6 +102,12 @@ class Counter(_Metric):
         self._values: Dict[Tuple[str, ...], float] = {}
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            # Prometheus counters are monotonic; a negative inc would
+            # silently corrupt every rate() over the series
+            raise ValueError(
+                f"counter {self.name} cannot decrease (inc by {amount})"
+            )
         key = tuple(str(labels[n]) for n in self.label_names)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
@@ -261,54 +268,97 @@ class Registry:
         self._lock = threading.Lock()
 
     def register(self, metric: _Metric) -> _Metric:
+        """Idempotent for an identical spec (node restarts in-process
+        return the live instrument); a CONFLICTING re-registration —
+        same name, different kind, label names, or buckets — raises,
+        because the typo'd duplicate would silently record into the
+        wrong series."""
         with self._lock:
             existing = self._metrics.get(metric.name)
             if existing is not None:
-                return existing  # idempotent (node restarts in-process)
+                if (
+                    existing.kind != metric.kind
+                    or existing.label_names != metric.label_names
+                    or getattr(existing, "buckets", None)
+                    != getattr(metric, "buckets", None)
+                ):
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered as "
+                        f"{existing.kind}{existing.label_names} "
+                        f"(conflicts with {metric.kind}"
+                        f"{metric.label_names})"
+                    )
+                return existing
             self._metrics[metric.name] = metric
             return metric
 
     def get(self, name: str) -> Optional[_Metric]:
         return self._metrics.get(name)
 
-    def render(self) -> str:
+    def names(self) -> frozenset:
+        with self._lock:
+            return frozenset(self._metrics)
+
+    def full_name(self, subsystem: str, name: str) -> str:
+        return f"{self.namespace}_{subsystem}_{name}"
+
+    def counter(
+        self, subsystem: str, name: str, help_: str, label_names=()
+    ) -> Counter:
+        return self.register(
+            Counter(self.full_name(subsystem, name), help_, label_names)
+        )
+
+    def gauge(
+        self, subsystem: str, name: str, help_: str, label_names=()
+    ) -> Gauge:
+        return self.register(
+            Gauge(self.full_name(subsystem, name), help_, label_names)
+        )
+
+    def histogram(
+        self, subsystem: str, name: str, help_: str, label_names=(),
+        buckets=None,
+    ) -> Histogram:
+        return self.register(
+            Histogram(
+                self.full_name(subsystem, name),
+                help_,
+                label_names,
+                buckets=buckets or _DEFAULT_BUCKETS,
+            )
+        )
+
+    def render(self, exclude=frozenset()) -> str:
+        """The exposition document; `exclude` skips series by full name
+        (node/node.py merges the per-node registry with the
+        process-global one without emitting duplicate series)."""
         lines: List[str] = []
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         for m in metrics:
+            if m.name in exclude:
+                continue
             lines.extend(m.render())
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n" if lines else ""
 
 
 DEFAULT_REGISTRY = Registry()
 
 
-def _full_name(subsystem: str, name: str) -> str:
-    return f"{DEFAULT_REGISTRY.namespace}_{subsystem}_{name}"
-
-
 def new_counter(
     subsystem: str, name: str, help_: str, label_names=()
 ) -> Counter:
-    return DEFAULT_REGISTRY.register(
-        Counter(_full_name(subsystem, name), help_, label_names)
-    )
+    return DEFAULT_REGISTRY.counter(subsystem, name, help_, label_names)
 
 
 def new_gauge(subsystem: str, name: str, help_: str, label_names=()) -> Gauge:
-    return DEFAULT_REGISTRY.register(
-        Gauge(_full_name(subsystem, name), help_, label_names)
-    )
+    return DEFAULT_REGISTRY.gauge(subsystem, name, help_, label_names)
 
 
 def new_histogram(
     subsystem: str, name: str, help_: str, label_names=(), buckets=None
 ) -> Histogram:
-    return DEFAULT_REGISTRY.register(
-        Histogram(
-            _full_name(subsystem, name),
-            help_,
-            label_names,
-            buckets=buckets or _DEFAULT_BUCKETS,
-        )
+    return DEFAULT_REGISTRY.histogram(
+        subsystem, name, help_, label_names, buckets=buckets
     )

@@ -74,10 +74,21 @@ class Service:
             self._done.set()
 
     async def _cancel_tasks(self) -> None:
-        for task in self._tasks:
-            task.cancel()
+        pending = [t for t in self._tasks if not t.done()]
+        while pending:
+            for task in pending:
+                task.cancel()
+            # Python 3.10's asyncio.wait_for can swallow a cancellation
+            # that races its inner future completing (bpo-42130 family,
+            # rewritten in 3.11) — a task parked in such a wait_for
+            # survives one cancel and its retry loop runs forever, so a
+            # single cancel+gather would hang stop(). Re-cancel until
+            # every task actually finishes.
+            _done, pending_set = await asyncio.wait(pending, timeout=1.0)
+            pending = list(pending_set)
         # return_exceptions keeps a cancellation of stop() itself
-        # propagating while swallowing the tasks' own CancelledErrors.
+        # propagating while swallowing the tasks' own CancelledErrors
+        # (and retrieving real exceptions so none log as unretrieved).
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
 

@@ -1,0 +1,245 @@
+"""Span tracing: nestable context managers over a bounded in-memory ring.
+
+Zero-dependency sibling of libs/metrics.py. Where metrics answer "how
+often / how long on average", spans answer "what happened inside THIS
+call": each `span(name, **attrs)` records one timed interval with its
+parent (nesting follows the asyncio task / thread via contextvars), so
+a single commit verification decomposes into
+addVote -> batch_accumulate -> tpu_dispatch -> merkle_hash with
+per-stage attributes (batch size, pad waste, host-prep vs device-wall
+split). PERF.md's claim discipline is the motivation: device sessions
+die mid-run, so every surviving number must be attributable to a stage.
+
+Completed spans land in a bounded ring (old spans are evicted, never
+blocked on) and export as Chrome-trace JSON (chrome://tracing /
+Perfetto "traceEvents" format). Spans can additionally feed an existing
+metrics Histogram (`span(..., hist=h)`), replacing `h.time()` at the
+call site; the histogram is observed whether or not tracing is enabled.
+
+Tracing is OFF by default. The disabled path is consensus-grade cheap:
+`span()` returns a shared no-op singleton — no Span object, no ring
+write, no contextvar touch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from contextvars import ContextVar
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "NOOP_SPAN",
+    "Span",
+    "add_attrs",
+    "current",
+    "disable",
+    "enable",
+    "is_enabled",
+    "reset",
+    "set_capacity",
+    "snapshot",
+    "span",
+    "to_chrome_trace",
+]
+
+DEFAULT_CAPACITY = 8192
+
+_enabled = False
+# deque.append is atomic in CPython — writers never take a lock; the
+# lock only guards ring replacement (set_capacity/reset vs export).
+_ring: deque = deque(maxlen=DEFAULT_CAPACITY)
+_ring_lock = threading.Lock()
+_next_id = itertools.count(1).__next__
+_current: ContextVar[Optional["Span"]] = ContextVar(
+    "tt_trace_current", default=None
+)
+# perf_counter epoch: Chrome-trace ts is relative anyway, and
+# perf_counter is the only clock monotonic enough to nest spans.
+_EPOCH = time.perf_counter()
+
+
+class Span:
+    """One timed interval. Use as a context manager; re-entry is not
+    supported (spans are one-shot, like the histograms they feed)."""
+
+    __slots__ = (
+        "name",
+        "attrs",
+        "span_id",
+        "parent_id",
+        "tid",
+        "start_us",
+        "dur_us",
+        "_hist",
+        "_hist_labels",
+        "_t0",
+        "_token",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        hist=None,
+        hist_labels: Optional[Dict[str, str]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.name = name
+        self.attrs: Dict[str, Any] = attrs if attrs is not None else {}
+        self.span_id = _next_id()
+        self.parent_id = 0
+        self.tid = 0
+        self.start_us = 0.0
+        self.dur_us = 0.0
+        self._hist = hist
+        self._hist_labels = hist_labels
+        self._t0 = 0.0
+        self._token = None
+
+    def set(self, **attrs: Any) -> "Span":
+        """Attach attributes mid-span (batch sizes known only after
+        accumulation, device timings known only after gather)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        parent = _current.get()
+        if parent is not None:
+            self.parent_id = parent.span_id
+        self.tid = threading.get_ident()
+        self._token = _current.set(self)
+        self._t0 = time.perf_counter()
+        self.start_us = (self._t0 - _EPOCH) * 1e6
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = time.perf_counter()
+        self.dur_us = (t1 - self._t0) * 1e6
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        if self._hist is not None:
+            self._hist.observe(
+                t1 - self._t0, **(self._hist_labels or {})
+            )
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        if _enabled:
+            _ring.append(self)
+        return False
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled path allocates no Span and
+    touches neither the ring nor the contextvar."""
+
+    __slots__ = ()
+
+    def set(self, **attrs: Any) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def span(name: str, hist=None, hist_labels=None, **attrs: Any):
+    """A nestable timed span. With `hist`, the elapsed seconds are also
+    observed into that Histogram — so instrumented call sites keep
+    their metrics series when tracing is off (the span then degrades to
+    exactly `hist.time()`)."""
+    if not _enabled:
+        if hist is not None:
+            return hist.time(**(hist_labels or {}))
+        return NOOP_SPAN
+    return Span(name, hist, hist_labels, attrs)
+
+
+def add_attrs(**attrs: Any) -> None:
+    """Attach attributes to the innermost live span, if any. A no-op
+    when tracing is disabled or no span is open — hot paths call this
+    unconditionally."""
+    s = _current.get()
+    if s is not None:
+        s.attrs.update(attrs)
+
+
+def current() -> Optional[Span]:
+    """The innermost live span of this task/thread (None if tracing is
+    off or no span is open)."""
+    return _current.get()
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    """Turn the recorder on (optionally resizing the ring first)."""
+    global _enabled
+    if capacity is not None:
+        set_capacity(capacity)
+    _enabled = True
+
+
+def disable() -> None:
+    """Kill switch: spans created after this return the no-op
+    singleton; spans already open stop recording at exit."""
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def set_capacity(capacity: int) -> None:
+    """Resize the ring, keeping the most recent spans."""
+    global _ring
+    if capacity < 1:
+        raise ValueError(f"trace ring capacity must be >= 1: {capacity}")
+    with _ring_lock:
+        _ring = deque(_ring, maxlen=capacity)
+
+
+def reset() -> None:
+    """Drop every recorded span (tests; debug-dump isolation)."""
+    with _ring_lock:
+        _ring.clear()
+
+
+def snapshot() -> List[Span]:
+    """The recorded spans, oldest first."""
+    with _ring_lock:
+        return list(_ring)
+
+
+def to_chrome_trace() -> str:
+    """Export the ring as Chrome-trace JSON ("traceEvents" complete
+    events, loadable in chrome://tracing and Perfetto). `span_id` /
+    `parent_id` ride in args so the exact nesting survives export even
+    across interleaved asyncio tasks on one thread."""
+    events = []
+    for s in snapshot():
+        args = dict(s.attrs)
+        args["span_id"] = s.span_id
+        args["parent_id"] = s.parent_id
+        events.append(
+            {
+                "name": s.name,
+                "ph": "X",
+                "ts": round(s.start_us, 3),
+                "dur": round(s.dur_us, 3),
+                "pid": 0,
+                "tid": s.tid,
+                "args": args,
+            }
+        )
+    return json.dumps(
+        {"traceEvents": events, "displayTimeUnit": "ms"}, default=str
+    )

@@ -16,9 +16,9 @@ from typing import Dict, List, Optional, Sequence
 from ..abci import types as abci
 from ..abci.client import ABCIClient
 from ..config import MempoolConfig
-from ..libs import metrics as M
 from ..libs.log import get_logger
 from .cache import LRUTxCache, NopTxCache
+from .metrics import MempoolMetrics
 from .types import (
     Mempool,
     MempoolError,
@@ -40,6 +40,7 @@ class TxMempool(Mempool):
         app_conn: ABCIClient,
         cfg: Optional[MempoolConfig] = None,
         height: int = 0,
+        metrics: Optional[MempoolMetrics] = None,
     ) -> None:
         self.cfg = cfg or MempoolConfig()
         self.logger = get_logger("mempool")
@@ -55,12 +56,7 @@ class TxMempool(Mempool):
         )
         self._lock = asyncio.Lock()  # held by consensus across Commit+Update
         self._tx_available = asyncio.Event()
-        self._m_size = M.new_gauge(
-            "mempool", "size", "Number of uncommitted transactions."
-        )
-        self._m_failed = M.new_counter(
-            "mempool", "failed_txs_total", "Transactions rejected by CheckTx."
-        )
+        self.metrics = metrics if metrics is not None else MempoolMetrics()
 
     # -- sizes --
 
@@ -92,7 +88,7 @@ class TxMempool(Mempool):
         self._txs.clear()
         self._senders.clear()
         self._bytes = 0
-        self._m_size.set(0)
+        self.metrics.size.set(0)
         self.cache.reset()
 
     # -- ingestion --
@@ -134,7 +130,7 @@ class TxMempool(Mempool):
 
         res = await self._app.check_tx(abci.RequestCheckTx(tx=tx))
         if not res.is_ok:
-            self._m_failed.inc()
+            self.metrics.failed_txs.inc()
             if not self.cfg.keep_invalid_txs_in_cache:
                 self.cache.remove(tx)
             return res
@@ -188,7 +184,7 @@ class TxMempool(Mempool):
         if wtx.sender:
             self._senders[wtx.sender] = wtx.key
         self._bytes += wtx.size()
-        self._m_size.set(len(self._txs))
+        self.metrics.size.set(len(self._txs))
         self._tx_available.set()
         return True
 
@@ -199,7 +195,7 @@ class TxMempool(Mempool):
         if wtx.sender:
             self._senders.pop(wtx.sender, None)
         self._bytes -= wtx.size()
-        self._m_size.set(len(self._txs))
+        self.metrics.size.set(len(self._txs))
         if remove_from_cache:
             self.cache.remove_by_key(key)
 

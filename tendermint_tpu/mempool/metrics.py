@@ -1,0 +1,25 @@
+"""Mempool metrics struct (reference: internal/mempool/metrics.go),
+per-node when threaded from node assembly — see consensus/metrics.py
+for the pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.metrics import DEFAULT_REGISTRY, Registry
+
+__all__ = ["MempoolMetrics"]
+
+
+class MempoolMetrics:
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        r = registry if registry is not None else DEFAULT_REGISTRY
+        self.size = r.gauge(
+            "mempool", "size", "Number of uncommitted transactions."
+        )
+        self.failed_txs = r.counter(
+            "mempool",
+            "failed_txs_total",
+            "Transactions rejected by CheckTx.",
+        )

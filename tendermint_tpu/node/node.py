@@ -36,15 +36,19 @@ from ..consensus.replay import Handshaker
 from ..consensus.wal import WAL
 from ..crypto import tpu_verifier
 from ..eventbus import EventBus
+from ..consensus.metrics import ConsensusMetrics
 from ..evidence import (
     EvidencePool,
     EvidenceReactor,
     evidence_channel_descriptor,
 )
 from ..libs.log import get_logger
+from ..libs.metrics import Registry
 from ..libs.service import Service
 from ..mempool import TxMempool
+from ..mempool.metrics import MempoolMetrics
 from ..mempool.reactor import MempoolReactor, mempool_channel_descriptor
+from ..p2p.metrics import P2PMetrics
 from ..p2p.peermanager import PeerManager, PeerManagerOptions
 from ..p2p.router import Router, RouterOptions
 from ..p2p.transport import TCPTransport, Transport
@@ -53,6 +57,7 @@ from ..privval import FilePV
 from ..state import StateStore, state_from_genesis
 from ..state.execution import BlockExecutor
 from ..state.indexer import IndexerService, KVSink, NullSink
+from ..state.metrics import StateMetrics
 from ..store.block_store import BlockStore
 from ..store.kv import open_db
 from ..types.genesis import GenesisDoc
@@ -81,6 +86,21 @@ class Node(Service):
         self.cfg = cfg
         self.genesis = genesis
         genesis.validate_and_complete()
+
+        # -- per-node metrics registry (reference: each subsystem's
+        # go-kit Metrics struct threaded from node/setup.go). Every node
+        # gets its own registry so in-process localnet embeddings scrape
+        # disjoint series; process-global instruments (the device
+        # verifier's tpu_* family) stay on DEFAULT_REGISTRY and are
+        # merged into the scrape without duplication.
+        self.metrics_registry = Registry()
+
+        # span tracing is process-wide (one ring); any node asking for
+        # it turns it on
+        if cfg.instrumentation.trace_spans:
+            from ..libs import trace
+
+            trace.enable(capacity=cfg.instrumentation.trace_ring_capacity)
 
         # -- device verifier install (the north-star seam) --
         # Done first so every later verification dispatches through it.
@@ -260,6 +280,7 @@ class Node(Service):
                     cfg.p2p.max_incoming_connection_attempts
                 ),
             ),
+            metrics=P2PMetrics(self.metrics_registry),
         )
 
         # reactors are built in on_start, after the ABCI handshake
@@ -369,7 +390,10 @@ class Node(Service):
 
         # -- build reactors against the post-handshake state --
         self.mempool = TxMempool(
-            self.proxy.mempool, cfg.mempool, height=state.last_block_height
+            self.proxy.mempool,
+            cfg.mempool,
+            height=state.last_block_height,
+            metrics=MempoolMetrics(self.metrics_registry),
         )
         self.evidence_pool = EvidencePool(
             self._evidence_db, self.state_store, self.block_store
@@ -381,6 +405,7 @@ class Node(Service):
             evidence_pool=self.evidence_pool,
             block_store=self.block_store,
             event_bus=self.event_bus,
+            metrics=StateMetrics(self.metrics_registry),
         )
         wal = WAL(cfg.base.path(cfg.consensus.wal_file))
         self.consensus = ConsensusState(
@@ -392,6 +417,7 @@ class Node(Service):
             event_bus=self.event_bus,
             wal=wal,
             evidence_pool=self.evidence_pool,
+            metrics=ConsensusMetrics(self.metrics_registry),
         )
 
         # sync orchestration flags (reference: node/node.go:230
@@ -519,9 +545,35 @@ class Node(Service):
             tpu="installed" if cfg.tpu.enable else "disabled",
         )
 
-    async def _start_metrics_server(self, addr: str) -> None:
-        """Plain-text Prometheus exposition on /metrics."""
+    def _render_metrics(self) -> str:
+        """Per-node series first, then the process-global registry
+        (device verifier, any subsystem constructed without a per-node
+        registry) minus names the per-node registry already rendered —
+        one exposition document with no duplicate series."""
         from ..libs.metrics import DEFAULT_REGISTRY
+
+        text = self.metrics_registry.render()
+        return text + DEFAULT_REGISTRY.render(
+            exclude=self.metrics_registry.names()
+        )
+
+    def _health_payload(self) -> dict:
+        """/healthz: node height + sync status (block height from the
+        store; syncing while the consensus reactor still waits on
+        state/block sync)."""
+        syncing = False
+        if self.consensus_reactor is not None:
+            syncing = bool(self.consensus_reactor.wait_sync)
+        return {
+            "node_id": self.node_key.node_id,
+            "height": self.block_store.height(),
+            "syncing": syncing,
+        }
+
+    async def _start_metrics_server(self, addr: str) -> None:
+        """Plain-text Prometheus exposition on /metrics, JSON liveness
+        on /healthz (reference: node/node.go:606)."""
+        import json as _json
 
         host, _, port = addr.replace("tcp://", "").rpartition(":")
 
@@ -546,17 +598,35 @@ class Node(Service):
                         break
                 else:
                     raise asyncio.TimeoutError
-                body = DEFAULT_REGISTRY.render().encode()
-                status = (
-                    b"200 OK" if b"/metrics" in line else b"404 Not Found"
-                )
-                if status != b"200 OK":
-                    body = b"see /metrics\n"
+                # parse the request line properly: an arbitrary request
+                # merely CONTAINING "/metrics" (a query param, a longer
+                # path) must not scrape
+                try:
+                    method, target, _version = (
+                        line.decode("latin-1").strip().split(" ", 2)
+                    )
+                except (ValueError, UnicodeDecodeError):
+                    method, target = "", ""
+                path = target.split("?", 1)[0]
+                ctype = b"text/plain; version=0.0.4"
+                if method not in ("GET", "HEAD"):
+                    status, body = b"405 Method Not Allowed", b"GET only\n"
+                elif path == "/metrics":
+                    status = b"200 OK"
+                    body = self._render_metrics().encode()
+                elif path == "/healthz":
+                    status = b"200 OK"
+                    ctype = b"application/json"
+                    body = _json.dumps(self._health_payload()).encode()
+                else:
+                    status = b"404 Not Found"
+                    body = b"see /metrics or /healthz\n"
                 writer.write(
                     b"HTTP/1.1 " + status + b"\r\n"
-                    b"Content-Type: text/plain; version=0.0.4\r\n"
+                    b"Content-Type: " + ctype + b"\r\n"
                     b"Content-Length: " + str(len(body)).encode() + b"\r\n"
-                    b"Connection: close\r\n\r\n" + body
+                    b"Connection: close\r\n\r\n"
+                    + (b"" if method == "HEAD" else body)
                 )
                 await writer.drain()
             except (

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..libs import trace
 from . import sha256_kernel as S
 
 __all__ = [
@@ -218,13 +219,15 @@ def install(min_leaves: int = 512) -> None:
             return None
         _stats["roots"] += 1
         _stats["leaves"] += len(leaf_hashes)
-        return tree_root(leaf_hashes)
+        with trace.span("merkle_device_root", leaves=len(leaf_hashes)):
+            return tree_root(leaf_hashes)
 
     def _proofs_hook(proofs, root_hash: bytes):
         if len(proofs) < max(min_leaves // 8, 2):
             return None
         _stats["proofs"] += len(proofs)
-        return verify_proofs(proofs, root_hash)
+        with trace.span("merkle_device_proofs", proofs=len(proofs)):
+            return verify_proofs(proofs, root_hash)
 
     cm._device_root_hook = _root_hook
     cm._device_proofs_hook = _proofs_hook

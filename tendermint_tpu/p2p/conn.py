@@ -24,20 +24,38 @@ cross-checked there against an independent HMAC-based HKDF.
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac as _hmac
+import os
 import struct
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    PublicFormat,
-)
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        ChaCha20Poly1305,
+    )
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # no wheel: RFC 7748/5869/8439 fallbacks below
+    _HAVE_OPENSSL = False
+    from ..crypto.symmetric import (
+        PureChaCha20Poly1305 as ChaCha20Poly1305,
+    )
+
+    class _RawOnly:  # stands in for Encoding/PublicFormat in the call
+        Raw = None
+
+    Encoding = PublicFormat = _RawOnly
 
 from ..crypto.keys import PrivKey, PubKey, pubkey_from_type_and_bytes
 from ..encoding.proto import FieldReader, ProtoWriter
@@ -47,21 +65,113 @@ __all__ = ["SecretConnection", "HandshakeError"]
 MAX_FRAME = 1 << 22  # 4 MiB ciphertext cap per frame
 _HKDF_INFO = b"TENDERMINT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
 
+_X25519_P = 2**255 - 19
+
+
+def _x25519_scalarmult(k: bytes, u: bytes) -> bytes:
+    """RFC 7748 §5 X25519 (Montgomery ladder), the gated stand-in for
+    the wheel's native implementation — both sides of a localnet
+    handshake agree either way; the conn-vectors test pins the bytes."""
+    k_int = int.from_bytes(
+        bytes([k[0] & 248]) + k[1:31] + bytes([(k[31] & 127) | 64]),
+        "little",
+    )
+    x1 = int.from_bytes(u, "little") & ((1 << 255) - 1)
+    x2, z2, x3, z3 = 1, 0, x1, 1
+    swap = 0
+    for t in reversed(range(255)):
+        k_t = (k_int >> t) & 1
+        if swap ^ k_t:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        a = (x2 + z2) % _X25519_P
+        aa = a * a % _X25519_P
+        b = (x2 - z2) % _X25519_P
+        bb = b * b % _X25519_P
+        e = (aa - bb) % _X25519_P
+        c = (x3 + z3) % _X25519_P
+        d = (x3 - z3) % _X25519_P
+        da = d * a % _X25519_P
+        cb = c * b % _X25519_P
+        x3 = (da + cb) % _X25519_P
+        x3 = x3 * x3 % _X25519_P
+        z3 = (da - cb) % _X25519_P
+        z3 = x1 * (z3 * z3) % _X25519_P
+        x2 = aa * bb % _X25519_P
+        z2 = e * ((aa + 121665 * e) % _X25519_P) % _X25519_P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = x2 * pow(z2, _X25519_P - 2, _X25519_P) % _X25519_P
+    return out.to_bytes(32, "little")
+
+
+if not _HAVE_OPENSSL:
+
+    class X25519PublicKey:  # type: ignore[no-redef]
+        def __init__(self, data: bytes) -> None:
+            if len(data) != 32:
+                raise ValueError("x25519 pubkey must be 32 bytes")
+            self._data = bytes(data)
+
+        @classmethod
+        def from_public_bytes(cls, data: bytes) -> "X25519PublicKey":
+            return cls(data)
+
+        def public_bytes(self, *_args) -> bytes:
+            return self._data
+
+    class X25519PrivateKey:  # type: ignore[no-redef]
+        def __init__(self, k: bytes) -> None:
+            self._k = k
+
+        @classmethod
+        def generate(cls) -> "X25519PrivateKey":
+            return cls(os.urandom(32))
+
+        def public_key(self) -> X25519PublicKey:
+            return X25519PublicKey(
+                _x25519_scalarmult(self._k, (9).to_bytes(32, "little"))
+            )
+
+        def exchange(self, peer: X25519PublicKey) -> bytes:
+            out = _x25519_scalarmult(self._k, peer._data)
+            if out == b"\x00" * 32:
+                raise ValueError("x25519: low-order point")
+            return out
+
 
 class HandshakeError(Exception):
     pass
+
+
+def _hkdf_sha256(ikm: bytes, length: int, info: bytes) -> bytes:
+    if _HAVE_OPENSSL:
+        return HKDF(
+            algorithm=hashes.SHA256(), length=length, salt=None, info=info
+        ).derive(ikm)
+    # RFC 5869 with the zero salt the wheel defaults to
+    prk = _hmac.new(b"\x00" * 32, ikm, hashlib.sha256).digest()
+    okm = b""
+    t = b""
+    i = 1
+    while len(okm) < length:
+        t = _hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
 
 
 def _derive(shared: bytes, local_eph: bytes, remote_eph: bytes):
     """→ (send_key, recv_key, challenge). Key order is fixed by sorting
     the ephemeral pubkeys, so both sides agree without a role bit
     (reference: secret_connection.go deriveSecrets + sort32)."""
-    okm = HKDF(
-        algorithm=hashes.SHA256(),
-        length=96,
-        salt=None,
-        info=_HKDF_INFO,
-    ).derive(shared + min(local_eph, remote_eph) + max(local_eph, remote_eph))
+    okm = _hkdf_sha256(
+        shared + min(local_eph, remote_eph) + max(local_eph, remote_eph),
+        96,
+        _HKDF_INFO,
+    )
     key_a, key_b, challenge = okm[:32], okm[32:64], okm[64:]
     if local_eph < remote_eph:
         return key_a, key_b, challenge

@@ -1,0 +1,30 @@
+"""P2P metrics struct (reference: internal/p2p/metrics.go), per-node
+when threaded from node assembly — see consensus/metrics.py for the
+pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.metrics import DEFAULT_REGISTRY, Registry
+
+__all__ = ["P2PMetrics"]
+
+
+class P2PMetrics:
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        r = registry if registry is not None else DEFAULT_REGISTRY
+        self.peers = r.gauge("p2p", "peers", "Number of connected peers.")
+        self.bytes_sent = r.counter(
+            "p2p",
+            "message_send_bytes_total",
+            "Bytes sent, by channel.",
+            label_names=("ch",),
+        )
+        self.bytes_recv = r.counter(
+            "p2p",
+            "message_receive_bytes_total",
+            "Bytes received, by channel.",
+            label_names=("ch",),
+        )

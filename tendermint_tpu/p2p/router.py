@@ -23,10 +23,10 @@ from collections import deque
 from typing import Deque, Dict, Optional, Tuple
 
 from ..crypto.keys import PrivKey
-from ..libs import metrics as M
 from ..libs.log import get_logger
 from ..libs.service import Service
 from .channel import Channel
+from .metrics import P2PMetrics
 from .peermanager import AlreadyConnectedError, PeerManager
 from .transport import Connection, Transport
 from .types import ChannelDescriptor, Envelope, NodeID, NodeInfo
@@ -38,17 +38,6 @@ __all__ = ["Router", "RouterOptions", "PING_CHANNEL_ID"]
 PING_CHANNEL_ID = 0xFF
 _PING = b"\x01"
 _PONG = b"\x02"
-
-# reference: internal/p2p/metrics.go (peers, message bytes by channel)
-_m_peers = M.new_gauge("p2p", "peers", "Number of connected peers.")
-_m_bytes_sent = M.new_counter(
-    "p2p", "message_send_bytes_total", "Bytes sent, by channel.",
-    label_names=("ch",),
-)
-_m_bytes_recv = M.new_counter(
-    "p2p", "message_receive_bytes_total", "Bytes received, by channel.",
-    label_names=("ch",),
-)
 
 
 class RouterOptions:
@@ -178,8 +167,11 @@ class Router(Service):
         transport: Transport,
         listen_addr: str = "",
         options: Optional[RouterOptions] = None,
+        metrics: Optional[P2PMetrics] = None,
     ) -> None:
         super().__init__(name="router", logger=get_logger("p2p.router"))
+        # reference: internal/p2p/metrics.go, threaded per node
+        self.metrics = metrics if metrics is not None else P2PMetrics()
         self.node_info = node_info
         self.priv_key = priv_key
         self.peer_manager = peer_manager
@@ -436,7 +428,7 @@ class Router(Service):
         recv_t = self.spawn(self._recv_peer(node_id, conn), f"recv-{node_id[:8]}")
         ping_t = self.spawn(self._ping_peer(node_id, q), f"ping-{node_id[:8]}")
         self._peer_tasks[node_id] = [send_t, recv_t, ping_t]
-        _m_peers.set(len(self._peer_conns))
+        self.metrics.peers.set(len(self._peer_conns))
         self.peer_manager.ready(node_id)
         self.logger.info("peer connected", peer=node_id[:12], addr=conn.remote_addr)
 
@@ -447,7 +439,7 @@ class Router(Service):
         while True:
             channel_id, payload = await queue.get()
             await limiter.wait(len(payload))
-            _m_bytes_sent.inc(len(payload), ch=channel_id)
+            self.metrics.bytes_sent.inc(len(payload), ch=channel_id)
             try:
                 await conn.send(channel_id, payload)
             except asyncio.CancelledError:
@@ -493,7 +485,7 @@ class Router(Service):
             while True:
                 channel_id, payload = await conn.receive()
                 self._peer_last_recv[node_id] = _time.monotonic()
-                _m_bytes_recv.inc(len(payload), ch=channel_id)
+                self.metrics.bytes_recv.inc(len(payload), ch=channel_id)
                 await limiter.wait(len(payload))
                 if channel_id == PING_CHANNEL_ID:
                     if payload == _PING:
@@ -543,7 +535,7 @@ class Router(Service):
             conn.close()
         self._peer_queues.pop(node_id, None)
         self._peer_last_recv.pop(node_id, None)
-        _m_peers.set(len(self._peer_conns))
+        self.metrics.peers.set(len(self._peer_conns))
         for t in self._peer_tasks.pop(node_id, []):
             if not t.done() and t is not asyncio.current_task():
                 t.cancel()

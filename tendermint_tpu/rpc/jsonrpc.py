@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Any, Awaitable, Callable, Dict, Optional
 from urllib.parse import parse_qsl, urlsplit
 
+from ..libs import trace
 from ..libs.log import get_logger
 
 __all__ = [
@@ -382,28 +383,29 @@ class JSONRPCServer:
                     METHOD_NOT_FOUND, f"unknown method {req.method!r}"
                 ).to_obj(),
             )
-        try:
-            result = await handler(req)
-        except RPCError as e:
-            return _response(req.req_id, error=e.to_obj())
-        except (TypeError, ValueError, KeyError) as e:
-            # int()/decode failures on client-supplied params; logged so
-            # a genuine server bug surfacing here stays visible
-            self.logger.info(
-                "rpc invalid params", method=req.method, err=repr(e)
-            )
-            return _response(
-                req.req_id,
-                error=RPCError(INVALID_PARAMS, str(e)).to_obj(),
-            )
-        except Exception as e:
-            self.logger.error(
-                "rpc handler error", method=req.method, err=repr(e)
-            )
-            return _response(
-                req.req_id,
-                error=RPCError(INTERNAL_ERROR, repr(e)).to_obj(),
-            )
+        with trace.span("rpc_request", method=req.method):
+            try:
+                result = await handler(req)
+            except RPCError as e:
+                return _response(req.req_id, error=e.to_obj())
+            except (TypeError, ValueError, KeyError) as e:
+                # int()/decode failures on client-supplied params; logged
+                # so a genuine server bug surfacing here stays visible
+                self.logger.info(
+                    "rpc invalid params", method=req.method, err=repr(e)
+                )
+                return _response(
+                    req.req_id,
+                    error=RPCError(INVALID_PARAMS, str(e)).to_obj(),
+                )
+            except Exception as e:
+                self.logger.error(
+                    "rpc handler error", method=req.method, err=repr(e)
+                )
+                return _response(
+                    req.req_id,
+                    error=RPCError(INTERNAL_ERROR, repr(e)).to_obj(),
+                )
         return _response(req.req_id, result=result)
 
     # -- websocket --

@@ -21,7 +21,7 @@ from ..crypto.keys import pubkey_from_type_and_bytes
 from ..crypto.merkle import hash_from_byte_slices
 from ..encoding.proto import ProtoWriter
 from ..eventbus import EventBus
-from ..libs import metrics as M
+from ..libs import trace
 from ..libs.log import get_logger
 from ..mempool.types import Mempool
 from ..types.block import Block
@@ -36,6 +36,7 @@ from ..types import events as E
 from ..types.tx import tx_hash
 from ..types.validation import verify_commit
 from ..types.validator import Validator, ValidatorSet
+from .metrics import StateMetrics
 from .store import ABCIResponses, StateStore
 from .types import State, median_time
 
@@ -47,14 +48,6 @@ __all__ = [
     "validate_block",
     "validator_updates_from_abci",
 ]
-
-# reference: internal/state/metrics.go (block processing histogram)
-_m_block_processing = M.new_histogram(
-    "state",
-    "block_processing_seconds",
-    "Time spent processing a block (validate + execute + commit).",
-    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
-)
 
 
 def build_last_commit_info(
@@ -242,6 +235,7 @@ class BlockExecutor:
         evidence_pool=None,
         block_store=None,
         event_bus: Optional[EventBus] = None,
+        metrics: Optional[StateMetrics] = None,
     ) -> None:
         self.store = state_store
         self.app = app_conn
@@ -249,6 +243,7 @@ class BlockExecutor:
         self.evpool = evidence_pool or EmptyEvidencePool()
         self.block_store = block_store
         self.event_bus = event_bus
+        self.metrics = metrics if metrics is not None else StateMetrics()
         self.logger = get_logger("state.executor")
 
     # -- proposal --
@@ -284,7 +279,12 @@ class BlockExecutor:
     ) -> State:
         """Validate, execute against the app, update state, commit
         (reference: internal/state/execution.go:151-237)."""
-        with _m_block_processing.time():
+        with trace.span(
+            "block_execute",
+            hist=self.metrics.block_processing,
+            height=block.header.height,
+            txs=len(block.txs),
+        ):
             return await self._apply_block_timed(state, block_id, block)
 
     async def _apply_block_timed(

@@ -1,0 +1,23 @@
+"""State (block execution) metrics struct
+(reference: internal/state/metrics.go), per-node when threaded from
+node assembly — see consensus/metrics.py for the pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.metrics import DEFAULT_REGISTRY, Registry
+
+__all__ = ["StateMetrics"]
+
+
+class StateMetrics:
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        r = registry if registry is not None else DEFAULT_REGISTRY
+        self.block_processing = r.histogram(
+            "state",
+            "block_processing_seconds",
+            "Time spent processing a block (validate + execute + commit).",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )

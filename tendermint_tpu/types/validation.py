@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..crypto.batch import create_batch_verifier, supports_batch_verifier
+from ..libs import trace
 from .block_id import BlockID
 from .commit import Commit, CommitSig
 from .validator import ValidatorSet
@@ -201,21 +202,26 @@ def verify_triples_grouped(triples) -> None:
     within one commit. Raises InvalidCommitError on any failure with no
     index attribution: callers re-verify per commit for the precise
     error (light/client.py sequential window fallback)."""
-    groups: dict = {}
-    for pk, sb, sig in triples:
-        if not supports_batch_verifier(pk):
-            if not pk.verify_signature(sb, sig):
+    with trace.span(
+        "batch_accumulate", sigs=len(triples), merged=True
+    ):
+        groups: dict = {}
+        for pk, sb, sig in triples:
+            if not supports_batch_verifier(pk):
+                if not pk.verify_signature(sb, sig):
+                    raise InvalidCommitError(
+                        "wrong signature in merged batch"
+                    )
+                continue
+            bv = groups.get(pk.type())
+            if bv is None:
+                bv = create_batch_verifier(pk, size_hint=len(triples))
+                groups[pk.type()] = bv
+            bv.add(pk, sb, sig)
+        for bv in groups.values():
+            ok, _bits = bv.verify()
+            if not ok:
                 raise InvalidCommitError("wrong signature in merged batch")
-            continue
-        bv = groups.get(pk.type())
-        if bv is None:
-            bv = create_batch_verifier(pk, size_hint=len(triples))
-            groups[pk.type()] = bv
-        bv.add(pk, sb, sig)
-    for bv in groups.values():
-        ok, _bits = bv.verify()
-        if not ok:
-            raise InvalidCommitError("wrong signature in merged batch")
 
 
 def _verify_basic(
@@ -246,6 +252,31 @@ def _verify_basic(
 
 
 def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """Span-wrapped shim: the accumulate loop AND the verifier drains
+    run under one `batch_accumulate` span, so the tpu_dispatch spans
+    opened by BatchVerifier.verify() nest inside it — the trace shape
+    PERF.md needs to split host assembly from device time per commit."""
+    with trace.span(
+        "batch_accumulate",
+        sigs=len(commit.signatures),
+        height=commit.height,
+    ):
+        _verify_commit_batch_impl(
+            chain_id, vals, commit, voting_power_needed,
+            ignore_sig, count_sig, count_all_signatures, look_up_by_index,
+        )
+
+
+def _verify_commit_batch_impl(
     chain_id: str,
     vals: ValidatorSet,
     commit: Commit,

@@ -186,6 +186,10 @@ def test_debug_bundle(tmp_path, capsys):
         assert "genesis.json" in names
         assert "summary.json" in names
         assert "cs.wal" in names
+        # span-trace ring rides along as valid Chrome-trace JSON
+        assert "trace.json" in names
+        chrome = json.loads(tar.extractfile("trace.json").read())
+        assert "traceEvents" in chrome
         summary = json.loads(
             tar.extractfile("summary.json").read()
         )

@@ -162,6 +162,7 @@ def test_batch_dispatch():
     assert batch.supports_batch_verifier(sk.pub_key())
     bv = batch.create_batch_verifier(sk.pub_key(), size_hint=4)
     assert isinstance(bv, Ed25519BatchVerifier)
+    pytest.importorskip("cryptography")  # secp256k1 is gated on the wheel
     sk2 = PrivKeySecp256k1.generate()
     assert not batch.supports_batch_verifier(sk2.pub_key())
     with pytest.raises(ValueError):
@@ -169,6 +170,7 @@ def test_batch_dispatch():
 
 
 def test_secp256k1_roundtrip():
+    pytest.importorskip("cryptography")  # secp256k1 is gated on the wheel
     sk = PrivKeySecp256k1.generate()
     pk = sk.pub_key()
     assert len(pk.bytes()) == 33
@@ -186,6 +188,7 @@ def test_secp256k1_roundtrip():
 
 
 def test_pubkey_proto_roundtrip():
+    pytest.importorskip("cryptography")  # secp256k1 is gated on the wheel
     for sk in (PrivKeyEd25519.generate(), PrivKeySecp256k1.generate()):
         pk = sk.pub_key()
         enc = pubkey_to_proto(pk)
@@ -531,3 +534,89 @@ def test_group_affinity_policy():
             tpu_verifier.uninstall()
     finally:
         B.restore_group_affinity(prev)
+
+
+def test_ed25519_rfc8032_vector():
+    """RFC 8032 §7.1 TEST 3 pins keygen + signing bit-for-bit, whether
+    the OpenSSL wheel or the gated pure-Python path produced them."""
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+    seed = bytes.fromhex(
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7"
+    )
+    pub = bytes.fromhex(
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025"
+    )
+    sig = bytes.fromhex(
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"
+    )
+    msg = bytes.fromhex("af82")
+    priv = PrivKeyEd25519.from_seed(seed)
+    assert priv.pub_key().bytes() == pub
+    assert priv.sign(msg) == sig
+    assert priv.pub_key().verify_signature(msg, sig)
+    assert not priv.pub_key().verify_signature(msg + b"x", sig)
+
+
+def test_pure_chacha20poly1305_rfc8439_vector():
+    """The gated pure-Python AEAD (used when the cryptography wheel is
+    absent) against RFC 8439 §2.8.2 — the full known-answer vector."""
+    from tendermint_tpu.crypto.symmetric import PureChaCha20Poly1305
+
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    pt = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    want_ct = bytes.fromhex(
+        "d31a8d34648e60db7b86afbc53ef7ec2"
+        "a4aded51296e08fea9e2b5a736ee62d6"
+        "3dbea45e8ca9671282fafb69da92728b"
+        "1a71de0a9e060b2905d6a5b67ecd3b36"
+        "92ddbd7f2d778b8c9803aee328091b58"
+        "fab324e4fad675945585808b4831d7bc"
+        "3ff4def08e4b7a9de576d26586cec64b"
+        "6116"
+    )
+    want_tag = bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+    aead = PureChaCha20Poly1305(key)
+    out = aead.encrypt(nonce, pt, aad)
+    assert out == want_ct + want_tag
+    assert aead.decrypt(nonce, out, aad) == pt
+    tampered = out[:-1] + bytes([out[-1] ^ 1])
+    with pytest.raises(ValueError):
+        aead.decrypt(nonce, tampered, aad)
+
+
+def test_x25519_rfc7748_vector():
+    """The gated pure-Python X25519 ladder against RFC 7748 §5.2
+    vector 1 and the §6.1 Diffie-Hellman vector."""
+    from tendermint_tpu.p2p.conn import _x25519_scalarmult
+
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+    )
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+    )
+    want = bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+    assert _x25519_scalarmult(k, u) == want
+    alice_priv = bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+    )
+    bob_priv = bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+    )
+    base = (9).to_bytes(32, "little")
+    alice_pub = _x25519_scalarmult(alice_priv, base)
+    bob_pub = _x25519_scalarmult(bob_priv, base)
+    shared = bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+    )
+    assert _x25519_scalarmult(alice_priv, bob_pub) == shared
+    assert _x25519_scalarmult(bob_priv, alice_pub) == shared

@@ -1,9 +1,14 @@
-"""Metrics tests: instrument semantics, exposition format, and a live
-node serving real values on /metrics (reference model:
+"""Metrics tests: instrument semantics, exposition format (with a
+round-trip parser), registry conflict detection, per-node registry
+isolation across an in-process localnet, and a live node serving real
+values on /metrics + /healthz (reference model:
 internal/consensus/metrics.go + docs/nodes/metrics.md catalog)."""
 
 import asyncio
+import json
 import time
+
+import pytest
 
 from tendermint_tpu.libs.metrics import (
     Counter,
@@ -11,6 +16,33 @@ from tendermint_tpu.libs.metrics import (
     Histogram,
     Registry,
 )
+
+
+def parse_exposition(text: str) -> dict:
+    """Minimal Prometheus text-format (0.0.4) parser: series name with
+    sorted labels → float value. Raises on lines it cannot parse, so a
+    malformed scrape fails the round-trip loudly."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        metric, _, value = line.rpartition(" ")
+        assert metric, f"unparseable exposition line: {line!r}"
+        if "{" in metric:
+            name, _, rest = metric.partition("{")
+            labels_raw = rest.rstrip("}")
+            labels = []
+            for pair in labels_raw.split(","):
+                k, _, v = pair.partition("=")
+                assert v.startswith('"') and v.endswith('"'), line
+                labels.append((k, v[1:-1]))
+            key = name + "{" + ",".join(
+                f"{k}={v}" for k, v in sorted(labels)
+            ) + "}"
+        else:
+            key = metric
+        out[key] = float(value) if value != "+Inf" else float("inf")
+    return out
 
 
 class TestInstruments:
@@ -61,6 +93,202 @@ class TestInstruments:
         r.register(Gauge("ns_b", "y"))
         text = r.render()
         assert "ns_a_total" in text and "ns_b" in text
+
+    def test_register_conflict_raises(self):
+        r = Registry("ns")
+        r.counter("sub", "x_total", "help")
+        # same spec: idempotent
+        assert r.counter("sub", "x_total", "help") is r.get(
+            "ns_sub_x_total"
+        )
+        with pytest.raises(ValueError):  # kind conflict
+            r.gauge("sub", "x_total", "help")
+        with pytest.raises(ValueError):  # label-name conflict
+            r.counter("sub", "x_total", "help", label_names=("ch",))
+        r.histogram("sub", "h_seconds", "help", buckets=(0.1, 1.0))
+        with pytest.raises(ValueError):  # bucket conflict
+            r.histogram("sub", "h_seconds", "help", buckets=(0.2, 1.0))
+
+    def test_counter_rejects_negative_inc(self):
+        c = Counter("t_mono", "help")
+        c.inc(2)
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        assert c.value() == 2
+
+    def test_exposition_round_trip(self):
+        r = Registry("rt")
+        c = r.counter("sub", "events_total", "e", label_names=("kind",))
+        c.inc(3, kind="a")
+        c.inc(kind='quo"te')  # escaping must survive the round trip
+        g = r.gauge("sub", "level", "l")
+        g.set(2.5)
+        h = r.histogram("sub", "lat_seconds", "h", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        parsed = parse_exposition(r.render())
+        assert parsed['rt_sub_events_total{kind=a}'] == 3
+        assert parsed['rt_sub_events_total{kind=quo\\"te}'] == 1
+        assert parsed["rt_sub_level"] == 2.5
+        assert parsed['rt_sub_lat_seconds_bucket{le=0.1}'] == 1
+        assert parsed['rt_sub_lat_seconds_bucket{le=1}'] == 2
+        assert parsed['rt_sub_lat_seconds_bucket{le=+Inf}'] == 3
+        assert parsed["rt_sub_lat_seconds_count"] == 3
+        assert abs(parsed["rt_sub_lat_seconds_sum"] - 5.55) < 1e-9
+
+
+async def _http_get(port: int, path: str):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read(-1)
+    writer.close()
+    head, _, body = data.decode().partition("\r\n\r\n")
+    return head.splitlines()[0], body
+
+
+def test_per_node_registry_isolation_localnet(tmp_path):
+    """Acceptance: a 3-node in-process localnet yields three
+    non-interleaved /metrics scrapes — each node's consensus_height is
+    its OWN series on its OWN registry, every scrape parses cleanly,
+    and /healthz + request-line parsing behave (a request merely
+    containing the substring '/metrics' is NOT a scrape)."""
+    pytest.importorskip("jax")
+    from tendermint_tpu.config import Config
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.node import NodeKey, make_node
+    from tendermint_tpu.p2p.transport import MemoryNetwork, MemoryTransport
+    from tendermint_tpu.privval import FilePV
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    n_nodes = 3
+    privs = [
+        PrivKeyEd25519.from_seed(bytes([i + 170]) * 32)
+        for i in range(n_nodes)
+    ]
+    genesis = GenesisDoc(
+        chain_id="iso-chain",
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator(pub_key=p.pub_key(), power=10) for p in privs
+        ],
+    )
+    net = MemoryNetwork()
+    cfgs = []
+    for i, priv in enumerate(privs):
+        cfg = Config()
+        cfg.base.home = str(tmp_path / f"iso{i}")
+        cfg.base.chain_id = "iso-chain"
+        cfg.base.db_backend = "memdb"
+        cfg.consensus.timeout_propose = 2.0
+        cfg.consensus.timeout_prevote = 1.0
+        cfg.consensus.timeout_precommit = 1.0
+        cfg.consensus.timeout_commit = 0.2
+        cfg.consensus.peer_gossip_sleep_duration = 0.01
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = f"iso{i}:26656"
+        cfg.instrumentation.prometheus = True
+        cfg.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        cfg.ensure_dirs()
+        genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+        FilePV.from_priv_key(
+            priv,
+            cfg.base.path(cfg.priv_validator.key_file),
+            cfg.base.path(cfg.priv_validator.state_file),
+        ).save()
+        cfgs.append(cfg)
+    node_ids = [
+        NodeKey.load_or_generate(
+            c.base.path(c.base.node_key_file)
+        ).node_id
+        for c in cfgs
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.p2p.persistent_peers = ",".join(
+            f"{node_ids[j]}@iso{j}:26656"
+            for j in range(n_nodes)
+            if j != i
+        )
+
+    async def go():
+        nodes = [
+            make_node(c, transport=MemoryTransport(net, f"iso{i}:26656"))
+            for i, c in enumerate(cfgs)
+        ]
+        for n in nodes:
+            await n.start()
+        try:
+            await asyncio.gather(
+                *(
+                    n.consensus.wait_for_height(2, timeout=120.0)
+                    for n in nodes
+                )
+            )
+            scrapes = []
+            for n in nodes:
+                status, body = await _http_get(n.metrics_port, "/metrics")
+                assert "200 OK" in status
+                scrapes.append(body)
+            # every scrape parses cleanly and carries exactly ONE
+            # consensus_height series — its own
+            for n, body in zip(nodes, scrapes):
+                parsed = parse_exposition(body)
+                heights = [
+                    k
+                    for k in parsed
+                    if k == "tendermint_tpu_consensus_height"
+                ]
+                assert len(heights) == 1
+                # the chain may advance between scrape and assert
+                assert (
+                    1
+                    <= parsed["tendermint_tpu_consensus_height"]
+                    <= n.consensus.rs.height
+                )
+                # merged exposition must not duplicate series
+                assert (
+                    body.count(
+                        "# TYPE tendermint_tpu_consensus_height "
+                    )
+                    == 1
+                )
+
+            # /healthz: height + sync status as JSON
+            status, body = await _http_get(nodes[0].metrics_port, "/healthz")
+            assert "200 OK" in status
+            health = json.loads(body)
+            assert health["height"] >= 1
+            assert health["syncing"] is False
+            assert health["node_id"] == node_ids[0]
+
+            # proper request-line matching: substring tricks are 404
+            for path in ("/foo?x=/metrics", "/metricsfoo", "/nope"):
+                status, _ = await _http_get(nodes[0].metrics_port, path)
+                assert "404" in status, path
+            status, _ = await _http_get(
+                nodes[0].metrics_port, "/metrics?x=1"
+            )
+            assert "200 OK" in status
+
+            # the registries are truly disjoint objects: a sentinel
+            # write on node0 never shows up in node1's scrape
+            regs = [n.metrics_registry for n in nodes]
+            assert len({id(r) for r in regs}) == n_nodes
+            nodes[0].consensus.metrics.height.set(99999)
+            assert (
+                "tendermint_tpu_consensus_height 99999"
+                in regs[0].render()
+            )
+            for other in regs[1:]:
+                assert (
+                    "tendermint_tpu_consensus_height 99999"
+                    not in other.render()
+                )
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(go())
 
 
 def test_node_serves_live_metrics(tmp_path):

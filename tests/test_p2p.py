@@ -97,19 +97,18 @@ def test_secret_connection_wrong_key_rejected():
 
         import struct as _s
 
-        from cryptography.hazmat.primitives.asymmetric.x25519 import (
-            X25519PrivateKey,
-            X25519PublicKey,
-        )
-        from cryptography.hazmat.primitives.ciphers.aead import (
+        # conn's own primitives: the wheel's classes when installed,
+        # the gated RFC fallbacks otherwise — the MITM speaks whichever
+        # dialect the server does
+        from tendermint_tpu.p2p.conn import (
             ChaCha20Poly1305,
-        )
-        from cryptography.hazmat.primitives.serialization import (
             Encoding,
             PublicFormat,
+            X25519PrivateKey,
+            X25519PublicKey,
+            _auth_sig_bytes,
+            _derive,
         )
-
-        from tendermint_tpu.p2p.conn import _auth_sig_bytes, _derive
 
         async def on_client(reader, writer):
             # speak the handshake but sign garbage instead of the challenge

@@ -175,6 +175,7 @@ def test_secp256k1_file_pv_round_trip(tmp_path):
     """reference privval/file.go:188 GenFilePV supports secp256k1;
     generate, sign a vote, persist, reload, and verify the signature
     with the reloaded public key."""
+    pytest.importorskip("cryptography")  # secp256k1 is gated on the wheel
     from tendermint_tpu.privval.file import FilePV
     from tendermint_tpu.types.block_id import BlockID, PartSetHeader
     from tendermint_tpu.types.vote import Vote, PRECOMMIT_TYPE

@@ -1,0 +1,235 @@
+"""Span-tracing tests: nesting + attributes, the allocation-free
+disabled path, histogram feeding, Chrome-trace export, and the
+commit-pipeline span tree (addVote → batch_accumulate → tpu_dispatch
+with merkle_hash in the same tree) from a live 4-validator consensus
+run with the device batch-verifier seam installed."""
+
+import asyncio
+import json
+
+import pytest
+
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.metrics import Histogram
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    """Every test starts and ends with tracing off and an empty ring."""
+    trace.disable()
+    trace.reset()
+    yield
+    trace.disable()
+    trace.reset()
+
+
+class TestSpans:
+    def test_nesting_records_parent_ids(self):
+        trace.enable()
+        with trace.span("outer", layer=1):
+            with trace.span("middle"):
+                with trace.span("inner"):
+                    trace.add_attrs(deep=True)
+        spans = trace.snapshot()
+        # children exit (and record) before their parents
+        assert [s.name for s in spans] == ["inner", "middle", "outer"]
+        inner, middle, outer = spans
+        assert inner.parent_id == middle.span_id
+        assert middle.parent_id == outer.span_id
+        assert outer.parent_id == 0
+        assert inner.attrs["deep"] is True
+        assert outer.attrs["layer"] == 1
+        assert all(s.dur_us >= 0 for s in spans)
+
+    def test_sibling_spans_share_parent(self):
+        trace.enable()
+        with trace.span("root"):
+            with trace.span("a"):
+                pass
+            with trace.span("b"):
+                pass
+        a, b, root = trace.snapshot()
+        assert a.parent_id == root.span_id
+        assert b.parent_id == root.span_id
+
+    def test_exception_recorded_and_context_restored(self):
+        trace.enable()
+        with pytest.raises(ValueError):
+            with trace.span("boom"):
+                raise ValueError("x")
+        (s,) = trace.snapshot()
+        assert s.attrs["error"] == "ValueError"
+        assert trace.current() is None
+
+    def test_disabled_path_allocates_nothing(self):
+        """Kill switch: span() hands back the shared no-op singleton —
+        no Span object, no ring entry, no live-span context."""
+        assert not trace.is_enabled()
+        s1 = trace.span("hot")
+        s2 = trace.span("hot2")
+        assert s1 is s2 is trace.NOOP_SPAN
+        with s1:
+            trace.add_attrs(ignored=1)  # no live span: no-op
+            assert trace.current() is None
+        assert trace.snapshot() == []
+
+    def test_span_feeds_histogram_enabled_and_disabled(self):
+        h = Histogram("t_span_h", "help", buckets=(0.5, 10.0))
+        # disabled: degrades to exactly hist.time()
+        with trace.span("timed", hist=h):
+            pass
+        assert h.count() == 1
+        assert trace.snapshot() == []
+        # enabled: observes AND records
+        trace.enable()
+        with trace.span("timed", hist=h):
+            pass
+        assert h.count() == 2
+        assert [s.name for s in trace.snapshot()] == ["timed"]
+
+    def test_ring_bounded_and_resizable(self):
+        trace.enable(capacity=4)
+        for i in range(10):
+            with trace.span(f"s{i}"):
+                pass
+        names = [s.name for s in trace.snapshot()]
+        assert names == ["s6", "s7", "s8", "s9"]
+        trace.set_capacity(2)
+        assert [s.name for s in trace.snapshot()] == ["s8", "s9"]
+        # restore default for other tests
+        trace.set_capacity(trace.DEFAULT_CAPACITY)
+
+    def test_chrome_trace_export_is_valid(self):
+        trace.enable()
+        with trace.span("parent", kind="test"):
+            with trace.span("child"):
+                pass
+        doc = json.loads(trace.to_chrome_trace())
+        events = doc["traceEvents"]
+        assert len(events) == 2
+        by_name = {e["name"]: e for e in events}
+        assert (
+            by_name["child"]["args"]["parent_id"]
+            == by_name["parent"]["args"]["span_id"]
+        )
+        for e in events:
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], float)
+            assert isinstance(e["dur"], float)
+
+
+class _FakeKernel:
+    """Backing device verifier with the dispatch()/gather() pair and
+    bucket shapes, minus the XLA program — the spans and telemetry in
+    _TpuBatchVerifier.verify() are what's under test, and the inputs
+    are honestly signed (see the consensus run below)."""
+
+    bucket_sizes = (8, 32, 128)
+
+    def dispatch(self, pks, msgs, sigs):
+        return [True] * len(pks)
+
+    def gather(self, handle):
+        return handle
+
+
+def _ancestor_names(span, by_id):
+    names = []
+    cur = span
+    while cur.parent_id:
+        cur = by_id.get(cur.parent_id)
+        if cur is None:
+            break
+        names.append(cur.name)
+    return names
+
+
+def test_commit_pipeline_span_tree():
+    """Acceptance: a commit verification emits a span tree rooted at
+    addVote containing batch_accumulate → tpu_dispatch (with batch-size
+    and pad-waste attributes) and merkle_hash, exportable as valid
+    Chrome-trace JSON."""
+    pytest.importorskip("jax")
+    from tendermint_tpu.crypto import batch as cbatch
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.crypto.tpu_verifier import TpuEd25519BatchVerifier
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    from .test_consensus_state import CHAIN, Node, RelayNet
+
+    fake = _FakeKernel()
+    cbatch.register_device_factory(
+        "ed25519",
+        lambda hint: TpuEd25519BatchVerifier(fake) if hint >= 2 else None,
+    )
+    trace.enable(capacity=65536)
+
+    async def go():
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([i + 140]) * 32)
+            for i in range(4)
+        ]
+        genesis = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10)
+                for p in privs
+            ],
+        )
+        nodes = [Node(p, genesis) for p in privs]
+        RelayNet(nodes)
+        for n in nodes:
+            await n.cs.start()
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout=60.0) for n in nodes)
+            )
+        finally:
+            for n in nodes:
+                await n.cs.stop()
+
+    try:
+        asyncio.run(go())
+        spans = trace.snapshot()
+        by_id = {s.span_id: s for s in spans}
+
+        dispatches = [s for s in spans if s.name == "tpu_dispatch"]
+        assert dispatches, "no tpu_dispatch spans recorded"
+        # full chain: tpu_dispatch under batch_accumulate under addVote
+        chained = [
+            s
+            for s in dispatches
+            if "batch_accumulate" in _ancestor_names(s, by_id)
+            and "addVote" in _ancestor_names(s, by_id)
+        ]
+        assert chained, "no tpu_dispatch nested under addVote"
+        d = chained[0]
+        assert d.attrs["batch"] >= 2  # a 4-validator LastCommit
+        assert d.attrs["bucket"] == 8  # smallest fake bucket
+        assert d.attrs["pad_waste"] == 8 - d.attrs["batch"]
+        assert "warm" in d.attrs
+        assert d.attrs["host_prep_s"] >= 0.0
+        # batch_accumulate carries the commit's signature count
+        acc = by_id[d.parent_id]
+        while acc.name != "batch_accumulate":
+            acc = by_id[acc.parent_id]
+        assert acc.attrs["sigs"] == 4
+        # merkle hashing appears in the same addVote-rooted tree
+        merkles = [
+            s
+            for s in spans
+            if s.name == "merkle_hash"
+            and "addVote" in _ancestor_names(s, by_id)
+        ]
+        assert merkles, "no merkle_hash in an addVote tree"
+        # the whole ring exports as valid Chrome-trace JSON
+        doc = json.loads(trace.to_chrome_trace())
+        assert any(
+            e["name"] == "tpu_dispatch" for e in doc["traceEvents"]
+        )
+        assert any(
+            e["name"] == "block_execute" for e in doc["traceEvents"]
+        )
+    finally:
+        cbatch.unregister_device_factory("ed25519")
