@@ -20,6 +20,11 @@ batch harness, so the measured OpenSSL rate is the baseline and the
     fallback that key is a skipped-marker and the CPU-path split
     (sign-bytes / assemble / verify) is always recorded under
     verify_commit_10k_breakdown_cpu_ms, on every backend
+  - verify_commit_10k_warm: the same commit through the verified-
+    signature cache (crypto/sigcache) after one priming run, plus the
+    measured hit rate — the steady-state LastCommit shape. The cold
+    rows above run under sigcache.disabled() (equivalent to
+    TM_TPU_NO_SIGCACHE=1), so they stay comparable round over round
   - the full config-5 mixed ed25519/sr25519 commits at 1k and 10k
     validators — both curves on device (ops/{ed25519,sr25519}_kernel)
   - per-signature batch curves for both key types at the reference
@@ -226,11 +231,14 @@ def bench_commit_latency(
     n_vals: int, reps: int, light: bool, mixed: bool = False,
     use_device: bool = True,
 ):
-    """p50/p95 wall latency of a full commit verification. With
-    use_device=False the device factory is NOT installed, so this times
-    the production CPU seam (native batch equation + OpenSSL) — the
-    honest CPU-only number."""
-    from tendermint_tpu.crypto import tpu_verifier
+    """p50/p95 wall latency of a full commit verification, with the
+    verified-signature cache DISABLED — the honest cold number (the
+    bench reps re-verify one commit, which the cache would otherwise
+    turn warm after rep 1; production's warm path is measured by
+    bench_commit_warm). With use_device=False the device factory is NOT
+    installed, so this times the production CPU seam (native batch
+    equation + OpenSSL)."""
+    from tendermint_tpu.crypto import sigcache, tpu_verifier
     from tendermint_tpu.types import validation
 
     if use_device:
@@ -240,18 +248,59 @@ def bench_commit_latency(
     fn = (
         validation.verify_commit_light if light else validation.verify_commit
     )
-    # warm-up compiles the bucket
-    fn(chain_id, vals, commit.block_id, 1, commit)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
+    with sigcache.disabled():
+        # warm-up compiles the bucket
         fn(chain_id, vals, commit.block_id, 1, commit)
-        times.append(time.perf_counter() - t0)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(chain_id, vals, commit.block_id, 1, commit)
+            times.append(time.perf_counter() - t0)
     times.sort()
     return (
         times[len(times) // 2] * 1e3,
         times[int(len(times) * 0.95)] * 1e3,
     )
+
+
+def bench_commit_warm(
+    n_vals: int = 10_000, reps: int = 5, use_device: bool = True,
+):
+    """Warm-path verify_commit: one priming verification populates the
+    verified-signature cache (crypto/sigcache), then every rep is the
+    steady-state LastCommit shape — a digest scan plus tally, zero
+    crypto calls. Reported next to the cold row with the measured cache
+    hit rate, so BENCH_*.json records the warm/cold split."""
+    from tendermint_tpu.crypto import sigcache, tpu_verifier
+    from tendermint_tpu.types import validation
+
+    if use_device:
+        tpu_verifier.install(min_batch=2)
+    chain_id = f"bench-{n_vals}"
+    vals, commit = _make_commit(n_vals, chain_id)
+    fn = validation.verify_commit
+    sigcache.reset()
+    with sigcache.disabled():
+        # compile/warm the bucket without touching the cache
+        fn(chain_id, vals, commit.block_id, 1, commit)
+    fn(chain_id, vals, commit.block_id, 1, commit)  # priming run
+    s0 = sigcache.stats()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn(chain_id, vals, commit.block_id, 1, commit)
+        times.append(time.perf_counter() - t0)
+    s1 = sigcache.stats()
+    times.sort()
+    hits = s1["hits"] - s0["hits"]
+    misses = s1["misses"] - s0["misses"]
+    return {
+        "p50_ms": round(times[len(times) // 2] * 1e3, 2),
+        "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 2),
+        "sigcache_hits": hits,
+        "sigcache_misses": misses,
+        "sigcache_hit_rate": round(hits / max(hits + misses, 1), 4),
+    }
 
 
 def _build_light_chain(chain_id: str, n_heights: int, n_vals: int):
@@ -922,6 +971,12 @@ def main() -> None:
     cpu_stage("lat150", _lat_cpu(150, 5, True), "_lat150_cpu")
     cpu_stage("lat10k", _lat_cpu(10_000, 3, False), "_lat10k_cpu", 1200.0)
     cpu_stage(
+        "warm10k",
+        lambda: bench_commit_warm(10_000, reps=3, use_device=False),
+        "verify_commit_10k_warm_cpu",
+        1200.0,
+    )
+    cpu_stage(
         "breakdown",
         lambda: bench_commit_breakdown_cpu(10_000, reps=3),
         "verify_commit_10k_breakdown_cpu_ms",
@@ -1027,6 +1082,7 @@ def main() -> None:
         ]
         extra["verify_commit_10k_p50_ms"] = extra["verify_commit_10k_p50_cpu_ms"]
         extra["verify_commit_10k_p95_ms"] = extra["verify_commit_10k_p95_cpu_ms"]
+        extra["verify_commit_10k_warm"] = extra["verify_commit_10k_warm_cpu"]
         extra["verify_commit_10k_breakdown_ms"] = {
             "skipped": "cpu fallback; see ..._cpu_ms"
         }
@@ -1073,6 +1129,7 @@ def main() -> None:
         "verify_commit_light_150_p95_ms",
         "verify_commit_10k_p50_ms",
         "verify_commit_10k_p95_ms",
+        "verify_commit_10k_warm",
         "verify_commit_10k_breakdown_ms",
         "verify_commit_1k_mixed_keys_p50_ms",
         "verify_commit_10k_mixed_keys_p50_ms",
@@ -1169,6 +1226,12 @@ def main() -> None:
         "commit_10k",
         _lat_dev(10_000, 10, False, "verify_commit_10k_p95_ms"),
         "verify_commit_10k_p50_ms",
+        1200.0,
+    )
+    dev_stage(
+        "commit_10k_warm",
+        lambda: bench_commit_warm(10_000, reps=5),
+        "verify_commit_10k_warm",
         1200.0,
     )
     dev_stage(

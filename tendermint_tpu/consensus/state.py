@@ -362,22 +362,32 @@ class ConsensusState(Service):
 
     def _preverify_votes(self, batch: list) -> None:
         """Batch-verify signatures of queued votes for the CURRENT
-        height in one device call; valid ones are marked so
-        VoteSet.add_vote skips its per-vote CPU verify. Runs inside the
+        height in one device call; valid triples populate the process-
+        wide verified-signature cache (crypto.sigcache), so
+        VoteSet.add_vote's Vote.verify — and the NEXT height's
+        verify_commit of the LastCommit assembled from these very
+        precommits — skip the per-signature CPU verify. Runs inside the
         single-writer loop against rs.validators — the exact set every
-        HeightVoteSet of this height verifies with — so the marker never
-        widens acceptance. Failed or foreign-height votes are left
-        unmarked and take the normal verify path (which produces the
-        proper per-vote error)."""
+        HeightVoteSet of this height verifies with — and the cache key
+        binds the exact triple bytes, so it never widens acceptance.
+        Failed or foreign-height votes are left uncached and take the
+        normal verify path (which produces the proper per-vote
+        error)."""
         with trace.span("preverify_votes", queued=len(batch)):
             self._preverify_votes_impl(batch)
 
     def _preverify_votes_impl(self, batch: list) -> None:
+        from ..crypto import sigcache
         from ..crypto.batch import (
             create_batch_verifier,
+            drain_and_cache,
             supports_batch_verifier,
         )
 
+        if not sigcache.enabled():
+            # nowhere to record the result: the per-vote path in
+            # add_vote does the work (and produces identical behavior)
+            return
         rs = self.rs
         # one candidate group per key type: a mixed ed25519/sr25519
         # validator set pre-verifies every type, each through its own
@@ -393,7 +403,6 @@ class ConsensusState(Service):
                 vote.height != rs.height
                 or not vote.signature
                 or len(vote.signature) != 64
-                or getattr(vote, "_pre_verified", False)
             ):
                 # malformed entries go to the per-vote path; they must
                 # not make bv.add throw and kill the whole batch (one
@@ -408,31 +417,37 @@ class ConsensusState(Service):
             groups.setdefault(val.pub_key.type(), []).append(
                 (vote, val.pub_key)
             )
+        chain_id = self.state.chain_id
         for candidates in groups.values():
-            if len(candidates) < 2 or not supports_batch_verifier(
-                candidates[0][1]
-            ):
+            if not supports_batch_verifier(candidates[0][1]):
+                continue
+            # assemble only cache misses (duplicates of an earlier
+            # burst, or re-gossiped votes, are already proven)
+            triples = []
+            for vote, pk in candidates:
+                sign_bytes = vote.sign_bytes(chain_id)
+                ckey = sigcache.key_for(
+                    pk.bytes(), sign_bytes, vote.signature
+                )
+                if not sigcache.seen_key(ckey):
+                    triples.append((pk, sign_bytes, vote.signature, ckey))
+            if len(triples) < 2:
                 continue
             try:
                 bv = create_batch_verifier(
-                    candidates[0][1], size_hint=len(candidates)
+                    triples[0][0], size_hint=len(triples)
                 )
-                for vote, pk in candidates:
-                    bv.add(
-                        pk,
-                        vote.sign_bytes(self.state.chain_id),
-                        vote.signature,
-                    )
-                _all_ok, bitmap = bv.verify()
+                for pk, sign_bytes, sig, _ckey in triples:
+                    bv.add(pk, sign_bytes, sig)
+                # valid triples land in the cache; failures stay out,
+                # so add_vote re-verifies them for the proper error
+                drain_and_cache(bv, [t[3] for t in triples])
             except Exception as e:
                 # a device hiccup: fall back to the per-vote path for
                 # this group (candidate filtering already excluded
                 # malformed signatures)
                 self.logger.debug("verify-ahead batch failed", err=str(e))
                 continue
-            for (vote, _pk), ok in zip(candidates, bitmap):
-                if ok:
-                    vote._pre_verified = True
 
     async def _handle_msg(self, mi: MsgInfo) -> None:
         """reference: state.go:891-960 handleMsg."""

@@ -2,7 +2,9 @@
 
 The public surface mirrors the reference's crypto package family
 (crypto/, crypto/batch, crypto/merkle, crypto/tmhash) with a TPU offload
-seam behind BatchVerifier (see tendermint_tpu.crypto.tpu_verifier).
+seam behind BatchVerifier (see tendermint_tpu.crypto.tpu_verifier) and a
+process-wide verified-signature cache (sigcache) that dedups signature
+checks across gossip, commit, replay, and light-client stages.
 """
 
 from .keys import (  # noqa: F401
@@ -26,4 +28,4 @@ from .symmetric import (  # noqa: F401
     decrypt_symmetric,
     encrypt_symmetric,
 )
-from . import batch, merkle, tmhash  # noqa: F401
+from . import batch, merkle, sigcache, tmhash  # noqa: F401

@@ -16,6 +16,7 @@ from .keys import BatchVerifier, PubKey
 
 __all__ = [
     "create_batch_verifier",
+    "drain_and_cache",
     "supports_batch_verifier",
     "register_device_factory",
     "device_factory_installed",
@@ -126,6 +127,27 @@ def create_batch_verifier(
     if cpu is None:
         raise ValueError(f"key type {key_type!r} does not support batching")
     return cpu()
+
+
+def drain_and_cache(verifier: BatchVerifier, cache_keys) -> tuple:
+    """Drain a batch verifier, populating the verified-signature cache
+    (crypto.sigcache) for every triple whose bitmap bit is True — the
+    drain half of the cross-stage cache: whatever a batch proves here,
+    no later stage re-proves. cache_keys aligns with add() order; None
+    entries (cache disabled at assembly time) are skipped. Returns
+    verify()'s (all_ok, bitmap) unchanged."""
+    from . import sigcache
+
+    ok, bits = verifier.verify()
+    if ok:
+        for key in cache_keys:
+            if key is not None:
+                sigcache.add_key(key)
+    else:
+        for key, bit in zip(cache_keys, bits):
+            if bit and key is not None:
+                sigcache.add_key(key)
+    return ok, bits
 
 
 def native_cpu_affinity() -> int:

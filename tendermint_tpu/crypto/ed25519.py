@@ -63,15 +63,21 @@ JSON_PRIVKEY_NAME = "tendermint/PrivKeyEd25519"
 
 
 class PubKeyEd25519(PubKey):
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_addr")
 
     def __init__(self, data: bytes) -> None:
         if len(data) != PUBKEY_SIZE:
             raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes")
         self._bytes = bytes(data)
+        self._addr: Optional[bytes] = None
 
     def address(self) -> Address:
-        return address_hash(self._bytes)
+        # memoized: Vote.verify hashes the address on every gossiped
+        # vote, against long-lived validator-set key objects
+        addr = self._addr
+        if addr is None:
+            addr = self._addr = address_hash(self._bytes)
+        return addr
 
     def bytes(self) -> bytes:
         return self._bytes

@@ -1,0 +1,232 @@
+"""Process-wide verified-signature cache — cross-stage dedup of crypto.
+
+The hot path pays for every signature at least twice: a precommit is
+verified at gossip time (consensus verify-ahead / VoteSet.add_vote), then
+the identical (pubkey, sign_bytes, signature) triple is re-verified from
+scratch when verify_commit processes the next height's LastCommit — and
+again in replay, blocksync, and light-client re-checks. The committee
+signer set is stable across heights, so the re-checks are pure waste
+("Performance of EdDSA and BLS Signatures in Committee-Based Consensus",
+arXiv:2302.00418, makes the same observation; PERF.md's decoded-point
+cache proved the shape one level down). This module remembers which exact
+triples have already verified, so every later stage skips the curve math
+and the batch paths assemble only cache misses — which also shrinks the
+padded device bucket.
+
+Safety model:
+
+- The key is the EXACT (pubkey bytes, sign_bytes, signature) triple — a
+  tuple in a set, so a hit requires full byte equality of all three
+  components. Any byte difference — forged signature, mutated
+  sign-bytes, an equivocating vote's different block ID — is a miss by
+  construction; unlike a digest key there is no collision to find, even
+  in theory. (The tuple also beats a 128-bit BLAKE2b digest on speed:
+  set membership is SipHash — keyed per process, so not
+  flood-precomputable — and the pubkey/signature objects are usually
+  the same interned bytes across heights, whose hashes CPython caches;
+  at 10k signatures the digest alone cost ~10 ms per warm commit.)
+- Only SUCCESSFUL verifications are cached; failures are never inserted,
+  so a hit can only ever skip work that a fresh verify would repeat.
+- The cache carries no acceptance semantics of its own: callers still run
+  every address/index/height/double-sign check; only the raw signature
+  equation is skipped.
+
+Memory is bounded by two-generation rotation: inserts land in the young
+generation; when it fills, the old generation is dropped (counted by
+sigcache_evictions) and the young one takes its place. Hits in the old
+generation are promoted, so a stable validator set survives rotation
+indefinitely. The default per-generation capacity is sized to ~2 heights
+of MAX_VOTES_COUNT (types/vote_set.py) precommits, so one rotation spans
+several heights even at the 10k-validator stress shape: total resident
+keys <= 2 generations x 20k triples; sign-bytes dominate at ~120 bytes
+each (pubkeys and signatures are references into live commit/validator
+objects), so the full cache tops out around 10 MB.
+
+`TM_TPU_NO_SIGCACHE=1` disables the cache at runtime (lookups miss,
+inserts are dropped) with no behavior difference except speed — the A/B
+switch idiom of TM_TPU_NO_PKCACHE / TM_TPU_NO_NATIVE. Note the
+consensus verify-ahead batch (consensus/state.py _preverify_votes) is
+BUILT ON this cache — its results are recorded here — so the gate also
+returns gossiped votes to sequential per-vote verification, not just
+commits to cold batches.
+
+Instruments (process-global on DEFAULT_REGISTRY, like the tpu_* family —
+one cache per process): tendermint_tpu_sigcache_hits_total /
+sigcache_misses_total / sigcache_evictions_total.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from ..libs import metrics as M
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "add",
+    "add_key",
+    "disabled",
+    "enabled",
+    "key_for",
+    "observe",
+    "reset",
+    "seen",
+    "seen_key",
+    "set_capacity",
+    "stats",
+]
+
+# ~2 heights x MAX_VOTES_COUNT (types/vote_set.py) precommits per
+# generation: a full rotation spans several heights even at the
+# 10k-validator stress shape, so LastCommit triples verified at gossip
+# time are still resident when the next height's block arrives.
+DEFAULT_CAPACITY = 20_000
+
+_m_hits = M.new_counter(
+    "sigcache", "hits_total",
+    "Verified-signature cache hits (signature checks skipped).",
+)
+_m_misses = M.new_counter(
+    "sigcache", "misses_total",
+    "Verified-signature cache misses (full verification performed).",
+)
+_m_evictions = M.new_counter(
+    "sigcache", "evictions_total",
+    "Verified-signature triples dropped by generation rotation.",
+)
+
+_capacity = DEFAULT_CAPACITY
+_gen0: set = set()  # young generation: inserts and promotions land here
+_gen1: set = set()  # old generation: dropped wholesale on rotation
+_lock = threading.Lock()  # guards rotation only; set ops are GIL-atomic
+_force_off = False  # tests/bench override, same effect as the env gate
+
+
+def enabled() -> bool:
+    """False under TM_TPU_NO_SIGCACHE=1 (or a disabled() scope): every
+    lookup misses and every insert is dropped — behavior identical to
+    the cache never existing, minus the speed."""
+    return not (_force_off or os.environ.get("TM_TPU_NO_SIGCACHE"))
+
+
+@contextlib.contextmanager
+def disabled():
+    """Scope with the cache forced off (bench cold rows, A/B tests)."""
+    global _force_off
+    prev = _force_off
+    _force_off = True
+    try:
+        yield
+    finally:
+        _force_off = prev
+
+
+def key_for(pk_bytes: bytes, sign_bytes: bytes, signature: bytes) -> tuple:
+    """The exact triple IS the key (a tuple): a hit requires full byte
+    equality of all three components, so distinct triples can never
+    alias. Hot loops may build the tuple inline instead of paying this
+    call — the representation is part of the module contract."""
+    return (pk_bytes, sign_bytes, signature)
+
+
+def seen_key(key: tuple) -> bool:
+    """Membership check for a precomputed key — no metrics, no enabled()
+    gate: batch callers check enabled() once per commit, account hits
+    and misses in bulk via observe(), and keep the per-triple cost to
+    one tuple build + one set lookup."""
+    if key in _gen0:
+        return True
+    if key in _gen1:
+        # promote: a stable signer set's triples survive rotation. The
+        # old-generation copy is discarded so entries() never double-
+        # counts and rotation's eviction count covers only triples that
+        # actually leave the cache.
+        _gen1.discard(key)
+        _insert(key)
+        return True
+    return False
+
+
+def add_key(key: tuple) -> None:
+    """Record a precomputed key as verified (caller gates on enabled()
+    and MUST only call after a successful verification)."""
+    _insert(key)
+
+
+def _insert(key: tuple) -> None:
+    _gen0.add(key)
+    if len(_gen0) >= _capacity:
+        _rotate()
+
+
+def _rotate() -> None:
+    global _gen0, _gen1
+    with _lock:
+        if len(_gen0) < _capacity:  # lost the race: already rotated
+            return
+        if _gen1:
+            _m_evictions.inc(len(_gen1))
+        _gen1 = _gen0
+        _gen0 = set()
+
+
+def seen(pk_bytes: bytes, sign_bytes: bytes, signature: bytes) -> bool:
+    """Single-triple convenience (Vote.verify, evidence): False when
+    disabled; counts one hit or miss."""
+    if not enabled():
+        return False
+    if seen_key(key_for(pk_bytes, sign_bytes, signature)):
+        _m_hits.inc()
+        return True
+    _m_misses.inc()
+    return False
+
+
+def add(pk_bytes: bytes, sign_bytes: bytes, signature: bytes) -> None:
+    """Single-triple insert after a SUCCESSFUL verification."""
+    if not enabled():
+        return
+    _insert(key_for(pk_bytes, sign_bytes, signature))
+
+
+def observe(hits: int, misses: int) -> None:
+    """Bulk metric accounting for batch callers (one counter touch per
+    commit instead of one per signature)."""
+    if hits:
+        _m_hits.inc(hits)
+    if misses:
+        _m_misses.inc(misses)
+
+
+def stats() -> dict:
+    return {
+        "hits": int(_m_hits.value()),
+        "misses": int(_m_misses.value()),
+        "evictions": int(_m_evictions.value()),
+        "entries": len(_gen0) + len(_gen1),
+        "capacity": _capacity,
+    }
+
+
+def set_capacity(n: int) -> None:
+    """Resize the per-generation capacity (tests; operators with bigger
+    validator sets). Existing entries are kept until normal rotation."""
+    global _capacity
+    if n < 1:
+        raise ValueError(f"sigcache capacity must be >= 1: {n}")
+    _capacity = int(n)
+
+
+def reset() -> None:
+    """Drop every cached triple (tests, bench cold rows)."""
+    global _gen0, _gen1
+    with _lock:
+        _gen0 = set()
+        _gen1 = set()
+
+
+def entries() -> int:
+    """Resident triple count across both generations (bound checks)."""
+    return len(_gen0) + len(_gen1)

@@ -15,8 +15,10 @@ Registry. Node assembly (node/node.py) constructs one Registry per node
 and threads the structs through the constructors, so in-process
 localnet nodes scrape disjoint series. DEFAULT_REGISTRY remains the
 default for subsystems constructed without an explicit registry (and
-for genuinely process-global instruments like the device verifier's),
-so call sites outside the constructors are unchanged.
+for genuinely process-global instruments: the device verifier's tpu_*
+family and the verified-signature cache's sigcache_* family — one
+device runtime and one cache per process), so call sites outside the
+constructors are unchanged.
 """
 
 from __future__ import annotations

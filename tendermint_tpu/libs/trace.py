@@ -7,7 +7,9 @@ parent (nesting follows the asyncio task / thread via contextvars), so
 a single commit verification decomposes into
 addVote -> batch_accumulate -> tpu_dispatch -> merkle_hash with
 per-stage attributes (batch size, pad waste, host-prep vs device-wall
-split). PERF.md's claim discipline is the motivation: device sessions
+split, and the verified-signature cache's sigcache_hits /
+sigcache_misses on batch_accumulate — the count of triples that skipped
+crypto entirely vs. those actually assembled into the batch). PERF.md's claim discipline is the motivation: device sessions
 die mid-run, so every surviving number must be attributable to a stage.
 
 Completed spans land in a bounded ring (old spans are evicted, never

@@ -8,6 +8,15 @@ Both verification modes bottom out in the commit-verification family
 (types/validation.py), which dispatches whole commits through the
 device BatchVerifier when installed — a 10k-header sync is 10-20k
 batched device verifies (BASELINE config 4).
+
+Those paths consult the process-wide verified-signature cache
+(crypto.sigcache), which matters here twice over: verify_non_adjacent
+checks the SAME commit against two validator sets (the trusted set's
+trust-level check, then 2/3 of its own set) — the second pass re-meets
+every triple the first pass just proved; and the sequential window
+fallback (light/client.py re-verifying per commit after a merged-batch
+failure) only re-pays for the actually-bad commit, since the good
+commits' triples were cached by the merged attempt.
 """
 
 from __future__ import annotations

@@ -8,6 +8,13 @@ whole Commit's (pubkey, sign-bytes, signature) triples into one
 crypto.batch verifier — on TPU that is a single device program over the
 padded batch (tendermint_tpu.ops.ed25519_kernel), sharded across the
 mesh for large validator sets (tendermint_tpu.parallel.sharding).
+
+Every path here consults the process-wide verified-signature cache
+(crypto.sigcache) BEFORE batch assembly and populates it on success:
+only cache misses are assembled, so a LastCommit whose precommits were
+gossip-verified re-verifies with zero crypto calls, and device buckets
+pad to the real miss count. TM_TPU_NO_SIGCACHE=1 restores the uncached
+behavior exactly (same errors, same tallies — just slower).
 """
 
 from __future__ import annotations
@@ -15,7 +22,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from ..crypto.batch import create_batch_verifier, supports_batch_verifier
+from ..crypto import sigcache
+from ..crypto.batch import (
+    create_batch_verifier,
+    drain_and_cache,
+    supports_batch_verifier,
+)
 from ..libs import trace
 from .block_id import BlockID
 from .commit import Commit, CommitSig
@@ -199,27 +211,50 @@ def verify_triples_grouped(triples) -> None:
     """One merged signature check over (pub_key, sign_bytes, signature)
     triples collected from MANY commits (collect_commit_light), grouped
     per key type — the same grouping _verify_commit_batch applies
-    within one commit. Raises InvalidCommitError on any failure with no
-    index attribution: callers re-verify per commit for the precise
-    error (light/client.py sequential window fallback)."""
+    within one commit. Triples already proven by the verified-signature
+    cache (crypto.sigcache) are skipped before assembly; the rest
+    populate it on success, so the per-commit re-verify after a merged
+    failure only pays for the actually-bad commit. Raises
+    InvalidCommitError on any failure with no index attribution:
+    callers re-verify per commit for the precise error
+    (light/client.py sequential window fallback)."""
     with trace.span(
         "batch_accumulate", sigs=len(triples), merged=True
     ):
-        groups: dict = {}
+        use_cache = sigcache.enabled()
+        hits = misses = 0
+        # key type -> [(pk, sign_bytes, signature, cache key)]: assembly
+        # is deferred so each group's size_hint is its OWN miss count —
+        # previously every group got size_hint=len(triples), so in mixed
+        # sets each device bucket padded to the merged total
+        pending: dict = {}
         for pk, sb, sig in triples:
+            ckey = None
+            if use_cache:
+                ckey = sigcache.key_for(pk.bytes(), sb, sig)
+                if sigcache.seen_key(ckey):
+                    hits += 1
+                    continue
+                misses += 1
             if not supports_batch_verifier(pk):
                 if not pk.verify_signature(sb, sig):
+                    if use_cache:  # keep the scanned hit/miss counts
+                        sigcache.observe(hits, misses)
                     raise InvalidCommitError(
                         "wrong signature in merged batch"
                     )
+                if ckey is not None:
+                    sigcache.add_key(ckey)
                 continue
-            bv = groups.get(pk.type())
-            if bv is None:
-                bv = create_batch_verifier(pk, size_hint=len(triples))
-                groups[pk.type()] = bv
-            bv.add(pk, sb, sig)
-        for bv in groups.values():
-            ok, _bits = bv.verify()
+            pending.setdefault(pk.type(), []).append((pk, sb, sig, ckey))
+        if use_cache:
+            sigcache.observe(hits, misses)
+            trace.add_attrs(sigcache_hits=hits, sigcache_misses=misses)
+        for items in pending.values():
+            bv = create_batch_verifier(items[0][0], size_hint=len(items))
+            for pk, sb, sig, _ckey in items:
+                bv.add(pk, sb, sig)
+            ok, _bits = drain_and_cache(bv, [it[3] for it in items])
             if not ok:
                 raise InvalidCommitError("wrong signature in merged batch")
 
@@ -288,19 +323,34 @@ def _verify_commit_batch_impl(
 ) -> None:
     """reference: types/validation.go:152-262, extended for mixed-key
     validator sets (the BASELINE mixed ed25519/sr25519 stress shape):
-    one batch verifier PER KEY TYPE, created lazily, so ed25519
-    signatures ride the device path while other types use their own CPU
-    batch verifiers. The reference's single-verifier form errors out of
-    mixed sets (its BatchVerifier.Add rejects foreign key types with no
-    fallback); grouping by type preserves its semantics for uniform
-    sets and makes mixed sets first-class. A key type with no batch
-    support at all (secp256k1) verifies inline."""
+    one batch verifier PER KEY TYPE so ed25519 signatures ride the
+    device path while other types use their own CPU batch verifiers.
+    The reference's single-verifier form errors out of mixed sets (its
+    BatchVerifier.Add rejects foreign key types with no fallback);
+    grouping by type preserves its semantics for uniform sets and makes
+    mixed sets first-class. A key type with no batch support at all
+    (secp256k1) verifies inline.
+
+    Cache-aware batch assembly: each triple is first checked against
+    the verified-signature cache (crypto.sigcache); hits skip crypto
+    entirely and only MISSES are assembled, deferred until after the
+    scan so every group's batch verifier gets size_hint = its own miss
+    count — the padded device bucket shrinks to the real work instead
+    of the whole commit (and, per key type, to the group rather than
+    the merged total). In steady state a node that gossip-verified a
+    commit's precommits verifies its LastCommit with zero crypto calls:
+    a tuple-set scan plus the unchanged tally/double-sign logic."""
+    use_cache = sigcache.enabled()
+    _seen_key = sigcache.seen_key  # hoisted: called once per signature
     tallied = 0
+    hits = misses = 0
     seen_vals: dict[int, int] = {}
-    # key type -> (verifier, [commit sig indexes added to it])
-    groups: dict[str, tuple] = {}
-    # key type -> (bound add or None-for-inline, bound index append)
-    _adders: dict[str, tuple] = {}
+    # key type -> [(pub_key, sign_bytes, signature, commit idx, cache
+    # key)]: the cache misses awaiting batch verification
+    pending: dict[str, list] = {}
+    # key type -> supports_batch_verifier (cached: at 10k signatures the
+    # repeated registry lookup was a measurable slice of the scan)
+    batchable: dict[str, bool] = {}
     # one templated pass for all sign-bytes when every signature will
     # be checked (verify_commit): at 10k signatures the per-index
     # marshal is the dominant host cost (see Commit.sign_bytes_batch).
@@ -332,48 +382,67 @@ def _verify_commit_batch_impl(
             if all_sign_bytes is not None
             else commit.vote_sign_bytes(chain_id, idx)
         )
-        key_type = val.pub_key.type()
-        # per-key-type dispatch cached: at 10k signatures the repeated
-        # supports_batch_verifier() call and per-item bound-method
-        # creation were a measurable slice of the assemble phase
-        entry = _adders.get(key_type)
-        if entry is None:
-            if not supports_batch_verifier(val.pub_key):
-                _adders[key_type] = (None, None)
-            else:
-                bv = create_batch_verifier(
-                    val.pub_key, size_hint=len(commit.signatures)
-                )
-                idxs: list = []
-                groups[key_type] = (bv, idxs)
-                _adders[key_type] = (bv.add, idxs.append)
-            entry = _adders[key_type]
-        add_fn, idx_append = entry
-        if add_fn is None:
+        pub_key = val.pub_key
+        ckey = None
+        if use_cache:
+            # inline sigcache.key_for — the tuple IS the key, and the
+            # call overhead is measurable at 10k signatures
+            ckey = (
+                pub_key.bytes(), vote_sign_bytes, commit_sig.signature
+            )
+            if _seen_key(ckey):
+                hits += 1
+                if count_sig(commit_sig):
+                    tallied += val.voting_power
+                if (
+                    not count_all_signatures
+                    and tallied > voting_power_needed
+                ):
+                    break
+                continue
+            misses += 1
+        key_type = pub_key.type()
+        can_batch = batchable.get(key_type)
+        if can_batch is None:
+            can_batch = batchable[key_type] = supports_batch_verifier(
+                pub_key
+            )
+        if not can_batch:
             # no batch support for this type: verify inline
-            if not val.pub_key.verify_signature(
+            if not pub_key.verify_signature(
                 vote_sign_bytes, commit_sig.signature
             ):
+                if use_cache:  # keep the scanned hit/miss counts
+                    sigcache.observe(hits, misses)
                 raise InvalidCommitError(
                     f"wrong signature (#{idx}): "
                     f"{commit_sig.signature.hex()}"
                 )
+            if ckey is not None:
+                sigcache.add_key(ckey)
         else:
-            add_fn(val.pub_key, vote_sign_bytes, commit_sig.signature)
-            idx_append(idx)
+            pending.setdefault(key_type, []).append(
+                (pub_key, vote_sign_bytes, commit_sig.signature, idx, ckey)
+            )
         if count_sig(commit_sig):
             tallied += val.voting_power
         if not count_all_signatures and tallied > voting_power_needed:
             break
+    if use_cache:
+        sigcache.observe(hits, misses)
+        trace.add_attrs(sigcache_hits=hits, sigcache_misses=misses)
     if tallied <= voting_power_needed:
         raise NotEnoughVotingPowerError(tallied, voting_power_needed)
     first_bad: Optional[int] = None
-    for bv, batch_sig_idxs in groups.values():
-        ok, valid_sigs = bv.verify()
+    for items in pending.values():
+        bv = create_batch_verifier(items[0][0], size_hint=len(items))
+        for pub_key, sb, sig, _idx, _ckey in items:
+            bv.add(pub_key, sb, sig)
+        ok, valid_sigs = drain_and_cache(bv, [it[4] for it in items])
         if ok:
             continue
         bad = [
-            batch_sig_idxs[i]
+            items[i][3]
             for i, sig_ok in enumerate(valid_sigs)
             if not sig_ok
         ]
@@ -400,8 +469,12 @@ def _verify_commit_single(
     count_all_signatures: bool,
     look_up_by_index: bool,
 ) -> None:
-    """reference: types/validation.go:265-328."""
+    """reference: types/validation.go:265-328. Consults the verified-
+    signature cache before each verify and populates it on success, so
+    the single path and the batch path warm each other."""
+    use_cache = sigcache.enabled()
     tallied = 0
+    hits = misses = 0
     seen_vals: dict[int, int] = {}
     for idx, commit_sig in enumerate(commit.signatures):
         if ignore_sig(commit_sig):
@@ -421,7 +494,24 @@ def _verify_commit_single(
                 )
             seen_vals[val_idx] = idx
         vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
-        if not val.pub_key.verify_signature(
+        if use_cache:
+            ckey = (
+                val.pub_key.bytes(), vote_sign_bytes, commit_sig.signature
+            )
+            if sigcache.seen_key(ckey):
+                hits += 1
+            else:
+                misses += 1
+                if not val.pub_key.verify_signature(
+                    vote_sign_bytes, commit_sig.signature
+                ):
+                    sigcache.observe(hits, misses)
+                    raise InvalidCommitError(
+                        f"wrong signature (#{idx}): "
+                        f"{commit_sig.signature.hex()}"
+                    )
+                sigcache.add_key(ckey)
+        elif not val.pub_key.verify_signature(
             vote_sign_bytes, commit_sig.signature
         ):
             raise InvalidCommitError(
@@ -431,6 +521,8 @@ def _verify_commit_single(
         if count_sig(commit_sig):
             tallied += val.voting_power
         if not count_all_signatures and tallied > voting_power_needed:
+            sigcache.observe(hits, misses)
             return
+    sigcache.observe(hits, misses)
     if tallied <= voting_power_needed:
         raise NotEnoughVotingPowerError(tallied, voting_power_needed)

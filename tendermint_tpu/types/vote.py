@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
 
+from ..crypto import sigcache
 from ..crypto.keys import PubKey
 from ..encoding.proto import FieldReader, ProtoWriter
 from .block_id import BlockID
@@ -35,8 +36,27 @@ class Vote:
     validator_index: int = -1
     signature: bytes = b""
 
+    # fields sign_bytes encodes: assigning any of them (the dataclass
+    # __init__ included) drops the encode memo below
+    _SB_FIELDS = frozenset(
+        {"type", "height", "round", "block_id", "timestamp_ns"}
+    )
+
+    def __setattr__(self, name: str, value) -> None:
+        if name in self._SB_FIELDS:
+            self.__dict__.pop("_sb_memo", None)
+        object.__setattr__(self, name, value)
+
     def sign_bytes(self, chain_id: str) -> bytes:
-        return vote_sign_bytes(
+        """Canonical sign-bytes, memoized per chain_id: one vote is
+        encoded up to three times on the hot path (sign/verify-ahead,
+        VoteSet.add_vote's cache consult, evidence), always with
+        identical inputs. The memo is invalidated by __setattr__ on any
+        encoded field, so mutation can never serve stale bytes."""
+        memo = self.__dict__.get("_sb_memo")
+        if memo is not None and memo[0] == chain_id:
+            return memo[1]
+        sb = vote_sign_bytes(
             chain_id,
             self.type,
             self.height,
@@ -44,16 +64,27 @@ class Vote:
             self.block_id,
             self.timestamp_ns,
         )
+        self.__dict__["_sb_memo"] = (chain_id, sb)
+        return sb
 
     def verify(self, chain_id: str, pub_key: PubKey) -> None:
         """Raises ValueError on mismatch/invalid signature
-        (reference: types/vote.go:147-157)."""
+        (reference: types/vote.go:147-157).
+
+        Consults the verified-signature cache after the address check:
+        a triple already proven — by the consensus verify-ahead batch
+        (consensus/state.py _preverify_votes), a commit verification,
+        or an earlier call here — skips the curve math. Successful
+        fresh verifies populate the cache, so evidence and LastCommit
+        re-checks of this exact vote are free."""
         if pub_key.address() != self.validator_address:
             raise ValueError("invalid validator address")
-        if not pub_key.verify_signature(
-            self.sign_bytes(chain_id), self.signature
-        ):
+        sign_bytes = self.sign_bytes(chain_id)
+        if sigcache.seen(pub_key.bytes(), sign_bytes, self.signature):
+            return
+        if not pub_key.verify_signature(sign_bytes, self.signature):
             raise ValueError("invalid signature")
+        sigcache.add(pub_key.bytes(), sign_bytes, self.signature)
 
     def validate_basic(self) -> None:
         if not is_vote_type_valid(self.type):

@@ -152,11 +152,13 @@ class VoteSet:
             raise ValueError("non-deterministic signature")
         # Check signature (raises on failure). The verify-ahead queue
         # (consensus/state.py _preverify_votes) may have already batch-
-        # verified this exact vote on device against THIS height's
-        # validator set; the marker is set only after the same
-        # address+signature checks passed there.
-        if not getattr(vote, "_pre_verified", False):
-            vote.verify(self.chain_id, val.pub_key)
+        # verified this exact (pubkey, sign-bytes, signature) triple on
+        # device against THIS height's validator set — Vote.verify then
+        # hits the verified-signature cache (crypto.sigcache) instead of
+        # re-running the curve math. The cache key binds the triple's
+        # exact bytes, so it can never widen acceptance; the address/
+        # index/HRS checks above run unconditionally either way.
+        vote.verify(self.chain_id, val.pub_key)
         added, conflicting = self._add_verified_vote(
             vote, block_key, val.voting_power
         )

@@ -82,6 +82,20 @@ def pytest_configure(config):
     )
 
 
+@pytest.fixture(autouse=True)
+def _fresh_sigcache():
+    """Start every test with a cold verified-signature cache: the test
+    fixtures are deterministic (fixed seeds/timestamps), so identical
+    triples recur across modules and the process-global cache would
+    otherwise make crypto-call-count and device-dispatch assertions
+    order-dependent. The cache is pure speed — resetting never changes
+    behavior."""
+    from tendermint_tpu.crypto import sigcache
+
+    sigcache.reset()
+    yield
+
+
 @pytest.fixture
 def tmp_home(tmp_path):
     from tendermint_tpu.config import Config

@@ -12,7 +12,7 @@ import time
 import pytest
 
 from tendermint_tpu.config import Config
-from tendermint_tpu.crypto import tpu_verifier
+from tendermint_tpu.crypto import sigcache, tpu_verifier
 from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
 from tendermint_tpu.node import NodeKey, make_node
 from tendermint_tpu.p2p.transport import MemoryNetwork, MemoryTransport
@@ -148,7 +148,10 @@ def test_four_validator_localnet_memory(tmp_path):
     """4 make_node validators over memory transports produce blocks
     together, with commit verification running through the installed
     device batch verifier (the VERDICT round-1 'TPU in the served path'
-    requirement)."""
+    requirement). Runs with the verified-signature cache disabled: a
+    warm LastCommit legitimately performs zero device dispatches (the
+    sigcache tests cover that), and this test asserts the device
+    WIRING."""
 
     async def go():
         privs = [
@@ -176,7 +179,8 @@ def test_four_validator_localnet_memory(tmp_path):
         # the served path used the device verifier
         assert tpu_verifier.stats()["sigs"] > sigs_before
 
-    run(go())
+    with sigcache.disabled():
+        run(go())
 
 
 def test_two_validator_localnet_tcp(tmp_path):

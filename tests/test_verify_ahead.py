@@ -1,5 +1,6 @@
 """Verify-ahead vote queue tests: queued votes are batch-verified in
-one call before the single-writer loop processes them, and the marker
+one call before the single-writer loop processes them — valid triples
+land in the verified-signature cache (crypto/sigcache) — and the cache
 never widens acceptance (SURVEY §7 verify-ahead design; reference hot
 path: internal/consensus/state.go:2010,2058 + types/vote_set.go:203).
 """
@@ -10,7 +11,7 @@ import time
 import pytest
 
 from tendermint_tpu.consensus.msgs import MsgInfo, VoteMessage
-from tendermint_tpu.crypto import tpu_verifier
+from tendermint_tpu.crypto import sigcache, tpu_verifier
 from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
 from tendermint_tpu.types.block_id import BlockID, PartSetHeader
 from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
@@ -54,6 +55,16 @@ def _genesis(privs):
     )
 
 
+def _cached(vote, pk) -> bool:
+    """Whether the vote's exact triple is in the verified-signature
+    cache (what _preverify_votes populates instead of a marker)."""
+    return sigcache.seen_key(
+        sigcache.key_for(
+            pk.bytes(), vote.sign_bytes(CHAIN), vote.signature
+        )
+    )
+
+
 def test_preverify_marks_valid_and_skips_invalid():
     async def go():
         privs = [PrivKeyEd25519.from_seed(bytes([i + 1]) * 32)
@@ -74,8 +85,10 @@ def test_preverify_marks_valid_and_skips_invalid():
         )
         batch = [MsgInfo(msg=VoteMessage(vote=v), peer_id="p") for v in votes]
         cs._preverify_votes(batch)
-        marked = [getattr(v, "_pre_verified", False) for v in votes]
-        assert marked == [True, True, True, False, True, True]
+        cached = [
+            _cached(v, p.pub_key()) for p, v in zip(privs, votes)
+        ]
+        assert cached == [True, True, True, False, True, True]
 
         # the corrupted vote still fails through the normal path
         vs = VoteSet(CHAIN, cs.rs.height, 0, PREVOTE_TYPE, vals)
@@ -110,17 +123,18 @@ def test_preverify_ignores_foreign_heights_and_bad_indexes():
         ]
         cs._preverify_votes(batch)
         assert not any(
-            getattr(v, "_pre_verified", False)
-            for v in future + wrong_index
+            _cached(v, p.pub_key())
+            for p, v in zip(privs + privs, future + wrong_index)
         )
 
     asyncio.run(go())
 
 
-def test_marker_does_not_bypass_address_or_hrs_checks():
-    """A hostile peer cannot smuggle a vote past VoteSet by setting the
-    attribute name externally: add_vote still enforces index/address/
-    HRS and duplicate checks before the signature step."""
+def test_cache_does_not_bypass_address_or_hrs_checks():
+    """A cached (even legitimately verified) triple cannot smuggle a
+    vote past VoteSet: add_vote still enforces index/address/HRS and
+    duplicate checks before the signature step ever consults the
+    cache."""
 
     async def go():
         privs = [PrivKeyEd25519.from_seed(bytes([i + 60]) * 32)
@@ -132,8 +146,13 @@ def test_marker_does_not_bypass_address_or_hrs_checks():
             hash=b"\x62" * 32,
             part_set_header=PartSetHeader(total=1, hash=b"\x63" * 32),
         )
-        vote = _votes(privs, vals, cs.rs.height, bid)[0]
-        vote._pre_verified = True
+        votes = _votes(privs, vals, cs.rs.height, bid)
+        # the whole burst verifies ahead: every triple is now cached
+        cs._preverify_votes(
+            [MsgInfo(msg=VoteMessage(vote=v), peer_id="p") for v in votes]
+        )
+        vote = votes[0]
+        assert _cached(vote, privs[0].pub_key())
         # point at a DIFFERENT validator's slot than the vote's address
         vote.validator_index = (vote.validator_index + 1) % 4
         vs = VoteSet(CHAIN, cs.rs.height, 0, PREVOTE_TYPE, vals)
@@ -228,9 +247,12 @@ def test_preverify_mixed_key_types_batches_both_groups():
             MsgInfo(msg=VoteMessage(vote=v), peer_id="p") for v in votes
         ]
         cs._preverify_votes(batch)
+        pk_by_vote = {
+            id(v): p.pub_key() for p, v in zip(privs, votes)
+        }
         for kt, vs in by_type.items():
-            marked = [getattr(v, "_pre_verified", False) for v in vs]
+            cached = [_cached(v, pk_by_vote[id(v)]) for v in vs]
             want = [v is not bad[kt] for v in vs]
-            assert marked == want, (kt, marked)
+            assert cached == want, (kt, cached)
 
     asyncio.run(go())
