@@ -303,6 +303,84 @@ def bench_commit_warm(
     }
 
 
+def bench_commit_fallback(n_vals: int = 10_000, reps: int = 3):
+    """verify_commit with the ed25519 circuit breaker held OPEN — the
+    degraded route a device fault leaves behind (crypto/breaker.py):
+    every batch is declined by the device factory at creation (one
+    breaker consult) and served by the CPU factory instead. Recorded
+    next to the device row so BENCH_*.json tracks the COST OF
+    DEGRADATION round over round; device_batches_during asserts the
+    tripped route really kept all work off the device."""
+    from tendermint_tpu.crypto import breaker, sigcache, tpu_verifier
+    from tendermint_tpu.types import validation
+
+    tpu_verifier.install(min_batch=2)
+    chain_id = f"bench-{n_vals}"
+    vals, commit = _make_commit(n_vals, chain_id)
+    b = breaker.breaker_for("ed25519")
+    b.open_now()
+    try:
+        batches0 = tpu_verifier.stats()["batches"]
+        with sigcache.disabled():
+            validation.verify_commit(
+                chain_id, vals, commit.block_id, 1, commit
+            )  # warm the CPU path (native lib compile)
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                validation.verify_commit(
+                    chain_id, vals, commit.block_id, 1, commit
+                )
+                times.append(time.perf_counter() - t0)
+        times.sort()
+        return {
+            "p50_ms": round(times[len(times) // 2] * 1e3, 2),
+            "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 2),
+            "device_batches_during": (
+                tpu_verifier.stats()["batches"] - batches0
+            ),
+        }
+    finally:
+        b.close_now()
+
+
+def bench_breaker_probe_overhead(reps: int = 20_000):
+    """What the containment layer itself costs (crypto/breaker.py):
+    the per-call allow() consult on the hot path with the breaker
+    closed (every batch pays this once) and open (every degraded batch
+    pays this instead of a device dispatch), plus the wall time of one
+    full trip -> timer-scheduled single-flight probe -> re-close cycle
+    with a trivial probe — the floor of re-arm latency on top of the
+    configured backoff."""
+    from tendermint_tpu.crypto.breaker import CircuitBreaker
+
+    b = CircuitBreaker("bench-closed", backoff_base_s=3600.0)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        b.allow()
+    closed_ns = (time.perf_counter() - t0) / reps * 1e9
+    b.record_failure()  # OPEN, hour-long backoff: no ticket handed out
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        b.allow()
+    open_ns = (time.perf_counter() - t0) / reps * 1e9
+    cyc = CircuitBreaker(
+        "bench-cycle", backoff_base_s=0.001, probe=lambda: True
+    )
+    t0 = time.perf_counter()
+    cyc.record_failure()
+    deadline = t0 + 5.0
+    while cyc.state() != "closed" and time.perf_counter() < deadline:
+        time.sleep(0.0002)
+    cycle_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "allow_closed_ns": round(closed_ns, 1),
+        "allow_open_ns": round(open_ns, 1),
+        "trip_to_rearm_ms": round(cycle_ms, 2),
+        "rearm_backoff_s_used": 0.001,
+    }
+
+
 def _build_light_chain(chain_id: str, n_heights: int, n_vals: int):
     """A verifiable chain of LightBlocks 1..n_heights with a static
     n_vals validator set (the BASELINE config-4 shape)."""
@@ -1012,6 +1090,11 @@ def main() -> None:
         "merkle_proof_batch_per_s_cpu",
     )
     cpu_stage(
+        "breaker_overhead",
+        bench_breaker_probe_overhead,
+        "breaker_probe_overhead",
+    )
+    cpu_stage(
         "mempool",
         lambda: round(bench_mempool_checktx(1000), 1),
         "mempool_checktx_per_s",
@@ -1086,6 +1169,10 @@ def main() -> None:
         extra["verify_commit_10k_breakdown_ms"] = {
             "skipped": "cpu fallback; see ..._cpu_ms"
         }
+        extra["verify_commit_10k_fallback"] = {
+            "skipped": "cpu fallback run: the whole line IS the degraded "
+            "path; see verify_commit_10k_p50_cpu_ms"
+        }
         extra["verify_commit_1k_mixed_keys_p50_ms"] = extra[
             "verify_commit_1k_mixed_keys_p50_cpu_ms"
         ]
@@ -1131,6 +1218,7 @@ def main() -> None:
         "verify_commit_10k_p95_ms",
         "verify_commit_10k_warm",
         "verify_commit_10k_breakdown_ms",
+        "verify_commit_10k_fallback",
         "verify_commit_1k_mixed_keys_p50_ms",
         "verify_commit_10k_mixed_keys_p50_ms",
         "sr25519_batch_verify_us_per_sig_by_batch",
@@ -1238,6 +1326,12 @@ def main() -> None:
         "commit_10k_breakdown",
         lambda: bench_commit_breakdown(10_000, reps=5),
         "verify_commit_10k_breakdown_ms",
+    )
+    dev_stage(
+        "commit_10k_fallback",
+        lambda: bench_commit_fallback(10_000, reps=3),
+        "verify_commit_10k_fallback",
+        1200.0,
     )
     dev_stage(
         "commit_1k_mixed",

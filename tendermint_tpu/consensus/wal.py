@@ -23,6 +23,7 @@ import time
 import zlib
 from typing import Iterator, Optional, Tuple
 
+from ..crypto import faults
 from ..libs.log import get_logger
 from ..libs.service import Service
 from .msgs import (
@@ -252,6 +253,14 @@ class WAL(Service):
         if len(payload) > MAX_MSG_SIZE:
             raise ValueError(f"WAL message too big: {len(payload)}")
         frame = _frame(payload)
+        if faults.armed():
+            # crypto/faults.py "wal.write" short_write rule: persist
+            # only a prefix of the frame — the on-disk shape a crash
+            # mid-write leaves, normally only reachable by killing the
+            # process at exactly the wrong instruction. Recovery
+            # (_truncate_torn_tail + search_for_end_height) must treat
+            # it exactly like a hand-truncated file.
+            frame = faults.clip("wal.write", frame)
         self._f.write(frame)
         self._dirty = True
         self._head_size += len(frame)
@@ -269,6 +278,8 @@ class WAL(Service):
         if self._f is None or not self._dirty:
             return
         self._f.flush()
+        if faults.armed():
+            faults.fire("wal.fsync")  # io_error rule -> OSError
         os.fsync(self._f.fileno())
         self._dirty = False
 
@@ -300,6 +311,12 @@ class WAL(Service):
         group.go:100-160)."""
         assert self._f is not None
         self._f.flush()
+        if faults.armed():
+            # the rotation fsync is the durability hinge: write_sync's
+            # promise for a record that just landed in the rotating
+            # chunk holds ONLY if this fsync really reached disk, so an
+            # injected failure here must propagate (never be swallowed)
+            faults.fire("wal.fsync")
         os.fsync(self._f.fileno())
         self._f.close()
         target = f"{self.path}.{self._next_chunk_idx:03d}"

@@ -16,6 +16,7 @@ from .keys import BatchVerifier, PubKey
 
 __all__ = [
     "create_batch_verifier",
+    "cpu_factory",
     "drain_and_cache",
     "supports_batch_verifier",
     "register_device_factory",
@@ -49,6 +50,14 @@ def unregister_device_factory(key_type: str) -> None:
 
 def device_factory_installed(key_type: str) -> bool:
     return key_type in _DEVICE_FACTORIES
+
+
+def cpu_factory(key_type: str) -> Optional[Callable[[], BatchVerifier]]:
+    """The registered CPU factory for a key type, or None. This is the
+    mandatory software fallback of the device-fault containment layer:
+    crypto/tpu_verifier.py re-verifies a faulted device batch through
+    it with the identical (all_ok, bitmap) contract."""
+    return _CPU_FACTORIES.get(key_type)
 
 
 # How many independent commits' signatures callers should merge into
@@ -135,10 +144,17 @@ def drain_and_cache(verifier: BatchVerifier, cache_keys) -> tuple:
     drain half of the cross-stage cache: whatever a batch proves here,
     no later stage re-proves. cache_keys aligns with add() order; None
     entries (cache disabled at assembly time) are skipped. Returns
-    verify()'s (all_ok, bitmap) unchanged."""
+    verify()'s (all_ok, bitmap) unchanged.
+
+    A batch the device faulted under (verifier.faulted — see
+    crypto/tpu_verifier.py) never populates the cache, even though its
+    CPU re-verify answered correctly: nothing learned while a device
+    was misbehaving is allowed to outlive the batch."""
     from . import sigcache
 
     ok, bits = verifier.verify()
+    if getattr(verifier, "faulted", False):
+        return ok, bits
     if ok:
         for key in cache_keys:
             if key is not None:

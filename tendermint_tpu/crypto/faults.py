@@ -1,0 +1,291 @@
+"""Injectable fault plane — seeded, scoped chaos for unreliable edges.
+
+The north star puts consensus-critical crypto on an accelerator, which
+makes the dispatch/gather boundary of crypto/tpu_verifier.py a new
+Byzantine surface: the XLA runtime can raise, the device (or its
+tunnel) can wedge, and a mis-compiled or mis-sharded program can return
+wrong-shaped or bit-flipped results. Tendermint tolerates 1/3 Byzantine
+validators; this module exists so the test suite can prove the port
+tolerates Byzantine *devices* too — the same treat-the-offload-engine-
+as-unreliable stance as the FPGA ECDSA engine (arXiv:2112.02229) and
+the committee-consensus measurements (arXiv:2302.00418), both of which
+keep a mandatory software fallback.
+
+Fault points are NAMED strings consulted at the boundary they model:
+
+    tpu.dispatch   crypto/tpu_verifier.py, before every device launch
+    tpu.gather     crypto/tpu_verifier.py, inside the gather barrier
+    wal.write      consensus/wal.py, the framed append (short writes)
+    wal.fsync      consensus/wal.py, every fsync (rotation included)
+
+Modes (the fault taxonomy, docs/resilience.md):
+
+    raise       the point raises DeviceFault (an XlaRuntimeError-alike)
+    hang        the point sleeps `hang_s` — under the gather deadline
+                watchdog this surfaces as DeviceTimeout
+    misshape    mangle() drops a result lane (wrong-shaped output)
+    bitflip     mangle() inverts one result lane (silent corruption)
+    io_error    the point raises OSError (fsync failure)
+    short_write clip() truncates the buffer (torn record on crash)
+
+Every rule owns a `random.Random(seed)`, so whether a given consult
+fires is a pure function of (seed, consult index) — chaos runs
+reproduce exactly, the same way libs/schedulefuzz.py seeds orderings.
+Rules are scoped: the `inject()` context manager removes its rule on
+exit, and `TM_TPU_FAULT` arms rules process-wide for black-box runs:
+
+    TM_TPU_FAULT="tpu.dispatch:raise:p=0.3:seed=7;tpu.gather:hang:hang_s=0.5"
+
+The hot path pays one module-global boolean (`armed()`) when the plane
+is empty — production traffic never touches a rule list.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from typing import List, Optional
+
+__all__ = [
+    "DeviceFault",
+    "DeviceTimeout",
+    "Rule",
+    "armed",
+    "clip",
+    "fire",
+    "inject",
+    "load_env",
+    "mangle",
+    "reset",
+    "rules",
+]
+
+
+class DeviceFault(RuntimeError):
+    """A device dispatch/gather failed — the XlaRuntimeError-alike the
+    fault plane raises, and the type crypto/tpu_verifier.py uses for
+    faults it detects itself (mis-shaped results, disproven lanes)."""
+
+
+class DeviceTimeout(DeviceFault):
+    """A gather exceeded its deadline (hung device / lost tunnel)."""
+
+
+_RAISE_MODES = {"raise", "io_error"}
+_DATA_MODES = {"misshape", "bitflip"}
+_CLIP_MODES = {"short_write"}
+_ALL_MODES = _RAISE_MODES | _DATA_MODES | _CLIP_MODES | {"hang"}
+
+
+class Rule:
+    """One armed fault: a point pattern, a mode, and a seeded RNG that
+    decides — reproducibly — which consults fire."""
+
+    def __init__(
+        self,
+        point: str,
+        mode: str,
+        p: float = 1.0,
+        seed: int = 0,
+        times: Optional[int] = None,
+        hang_s: float = 30.0,
+        key: Optional[str] = None,
+    ) -> None:
+        if mode not in _ALL_MODES:
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self.point = point
+        self.mode = mode
+        self.p = float(p)
+        self.seed = int(seed)
+        self.times = times  # None = unlimited
+        self.hang_s = float(hang_s)
+        self.key = key  # key-type filter for tpu points (None = any)
+        self.rng = random.Random(self.seed)
+        self.fired = 0  # consults that actually faulted
+
+    def _matches(self, point: str, key: Optional[str]) -> bool:
+        if self.point != point:
+            return False
+        if self.key is not None and key is not None and self.key != key:
+            return False
+        return True
+
+    def _roll(self) -> bool:
+        """One seeded decision. The RNG advances on every matching
+        consult — fired or not — so the fire pattern depends only on
+        (seed, consult index), never on wall time."""
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.p < 1.0 and self.rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+    def __repr__(self) -> str:  # failure messages name the seed
+        return (
+            f"Rule({self.point}:{self.mode} p={self.p} seed={self.seed} "
+            f"fired={self.fired})"
+        )
+
+
+_RULES: List[Rule] = []
+_LOCK = threading.Lock()
+_ARMED = False  # mirrors bool(_RULES); read lock-free on hot paths
+_ENV_LOADED = False
+
+
+def armed() -> bool:
+    """Cheap hot-path gate: False means no rule is armed and no fault
+    code runs at all. The env var is parsed on the first call so test
+    processes that set TM_TPU_FAULT after import still arm."""
+    global _ENV_LOADED
+    if not _ENV_LOADED:
+        _ENV_LOADED = True
+        load_env()
+    return _ARMED
+
+
+def load_env() -> None:
+    """(Re-)parse TM_TPU_FAULT into armed rules. Idempotent per value:
+    clears previously env-loaded rules first (inject() rules survive)."""
+    spec = os.environ.get("TM_TPU_FAULT", "")
+    with _LOCK:
+        _RULES[:] = [r for r in _RULES if not getattr(r, "_from_env", False)]
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            rule = _parse_rule(part)
+            rule._from_env = True
+            _RULES.append(rule)
+        _refresh_armed()
+
+
+def _parse_rule(spec: str) -> Rule:
+    """`point:mode[:p=..][:seed=..][:times=..][:hang_s=..][:key=..]`"""
+    fields = spec.split(":")
+    if len(fields) < 2:
+        raise ValueError(f"bad TM_TPU_FAULT rule {spec!r} (want point:mode)")
+    kwargs = {}
+    for opt in fields[2:]:
+        if "=" not in opt:
+            raise ValueError(f"bad fault option {opt!r} in {spec!r}")
+        k, v = opt.split("=", 1)
+        if k == "p":
+            kwargs["p"] = float(v)
+        elif k == "seed":
+            kwargs["seed"] = int(v)
+        elif k == "times":
+            kwargs["times"] = int(v)
+        elif k == "hang_s":
+            kwargs["hang_s"] = float(v)
+        elif k == "key":
+            kwargs["key"] = v
+        else:
+            raise ValueError(f"unknown fault option {k!r} in {spec!r}")
+    return Rule(fields[0], fields[1], **kwargs)
+
+
+def _refresh_armed() -> None:
+    global _ARMED
+    _ARMED = bool(_RULES)
+
+
+@contextlib.contextmanager
+def inject(
+    point: str,
+    mode: str,
+    p: float = 1.0,
+    seed: int = 0,
+    times: Optional[int] = None,
+    hang_s: float = 30.0,
+    key: Optional[str] = None,
+):
+    """Arm one rule for the duration of the scope (chaos tests). Yields
+    the Rule so the test can assert how often it actually fired."""
+    rule = Rule(point, mode, p=p, seed=seed, times=times,
+                hang_s=hang_s, key=key)
+    with _LOCK:
+        _RULES.append(rule)
+        _refresh_armed()
+    try:
+        yield rule
+    finally:
+        with _LOCK:
+            try:
+                _RULES.remove(rule)
+            except ValueError:  # pragma: no cover - double-removal
+                pass
+            _refresh_armed()
+
+
+def reset() -> None:
+    """Disarm everything (tests)."""
+    with _LOCK:
+        _RULES.clear()
+        _refresh_armed()
+
+
+def rules() -> List[Rule]:
+    """Snapshot of the armed rules (diagnostics/tests)."""
+    with _LOCK:
+        return list(_RULES)
+
+
+def fire(point: str, key: Optional[str] = None) -> None:
+    """Consult the plane at a control-flow fault point. May raise
+    (`raise` → DeviceFault, `io_error` → OSError) or stall (`hang`);
+    data modes are left to mangle()/clip(). Callers gate on armed()."""
+    with _LOCK:
+        actions = [
+            r for r in _RULES
+            if r.mode in ("raise", "hang", "io_error")
+            and r._matches(point, key) and r._roll()
+        ]
+    for r in actions:
+        if r.mode == "raise":
+            raise DeviceFault(
+                f"injected device fault at {point} (seed={r.seed})"
+            )
+        if r.mode == "io_error":
+            raise OSError(
+                f"injected I/O fault at {point} (seed={r.seed})"
+            )
+        if r.mode == "hang":
+            time.sleep(r.hang_s)
+
+
+def mangle(point: str, bits: list, key: Optional[str] = None) -> list:
+    """Apply data faults to a gather result: `misshape` drops the last
+    lane (wrong-shaped device output), `bitflip` inverts one seeded
+    lane (silent result corruption). Returns the (possibly) mangled
+    bitmap; the containment layer must detect and recover."""
+    with _LOCK:
+        actions = [
+            r for r in _RULES
+            if r.mode in _DATA_MODES and r._matches(point, key) and r._roll()
+        ]
+    for r in actions:
+        if r.mode == "misshape" and bits:
+            bits = bits[:-1]
+        elif r.mode == "bitflip" and bits:
+            i = r.rng.randrange(len(bits))
+            bits = list(bits)
+            bits[i] = not bits[i]
+    return bits
+
+
+def clip(point: str, data: bytes) -> bytes:
+    """Apply a `short_write` fault: return a strict seeded prefix of
+    `data` — the shape a crash mid-write leaves on disk."""
+    with _LOCK:
+        actions = [
+            r for r in _RULES
+            if r.mode in _CLIP_MODES and r._matches(point, None) and r._roll()
+        ]
+    for r in actions:
+        data = data[: r.rng.randrange(len(data))] if data else data
+    return data

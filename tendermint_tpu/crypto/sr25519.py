@@ -214,19 +214,45 @@ class PubKeySr25519(PubKey):
             try:
                 bv.add(self, msg, sig)
                 _ok, bits = bv.verify()
+                # report the DEVICE outcome to the single route's own
+                # breaker (verify() contains faults and reports only to
+                # the batch "sr25519" breaker): without this, a
+                # half-open admission ticket would never be paid back
+                # and the route would wedge half-open
+                from .tpu_verifier import sr_single_breaker
+
+                if getattr(bv, "faulted", False):
+                    sr_single_breaker().record_failure()
+                else:
+                    sr_single_breaker().record_success()
                 return bool(bits and bits[0])
             except Exception as e:
                 from ..libs.log import get_logger
-                from .tpu_verifier import trip_sr_singles
+                from .tpu_verifier import sr_single_breaker
 
-                # trip the route: a faulted device must not be re-tried
-                # (seconds of error surfacing + a log line) on every
-                # subsequent vote; install() re-warms it
-                trip_sr_singles()
+                # trip the route's breaker: a faulted device must not
+                # be re-tried (seconds of error surfacing + a log
+                # line) on every subsequent vote. The breaker's
+                # single-flight probe re-arms the route after backoff
+                # if the fault was transient; a dead device converges
+                # to one quiet probe per backoff cap. (verify() itself
+                # contains device faults and answers from the CPU
+                # factory, so this only fires on failures outside that
+                # containment — the total-predicate belt under it.)
+                sr_single_breaker().record_failure()
                 get_logger("crypto.sr25519").warning(
                     "sr25519 device verify failed; singles tripped to CPU",
                     err=repr(e),
                 )
+        return self.verify_signature_cpu(msg, sig)
+
+    def verify_signature_cpu(self, msg: bytes, sig: bytes) -> bool:
+        """The host-only verify (native C batch entry at n=1, else pure
+        Python ristretto) — never touches the device. This is both the
+        tail of verify_signature and the oracle the device-fault
+        containment layer uses to DISPROVE a device verdict
+        (crypto/tpu_verifier.py): an oracle that routed back to the
+        device could never catch the device lying."""
         native = _native_verify_one(self._bytes, msg, sig)
         if native is not None:
             return native

@@ -8,17 +8,33 @@ crypto.batch.create_batch_verifier return it for ed25519 keys when the
 batch is large enough to beat host latency. CPU remains the default
 until install() is called, exactly like the reference defaults to pure
 Go.
+
+Device-fault containment: the device is treated as an UNRELIABLE
+coprocessor (docs/resilience.md). Every dispatch/gather is a fault
+point of crypto/faults.py; gathers run under a deadline watchdog
+(a hung device surfaces as DeviceTimeout instead of wedging consensus);
+a faulted batch is transparently re-verified through the registered CPU
+factory with byte-identical result semantics (same bitmap alignment,
+so the same wrong-signature index) and is never allowed to populate the
+verified-signature cache. Each route consults a named circuit breaker
+(crypto/breaker.py): a tripped breaker sends new work straight to the
+CPU factories — zero per-call device touches, zero per-call warnings —
+until a single-flight background probe proves the device again.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import List, Optional, Tuple
 
 from ..libs import metrics as M
 from ..libs import trace
-from .batch import register_device_factory
+from . import breaker as _breaker_mod
+from . import faults
+from .batch import cpu_factory, register_device_factory
+from .faults import DeviceFault, DeviceTimeout
 from .keys import BatchVerifier, PubKey
 
 # device-offload observability (no reference analog — this is the
@@ -30,6 +46,11 @@ _m_batches = M.new_counter(
 )
 _m_sigs = M.new_counter(
     "tpu", "verify_sigs_total", "Signatures verified on device."
+)
+_m_device_faults = M.new_counter(
+    "tpu",
+    "device_faults_total",
+    "Device faults contained (raise/timeout/mis-shape/disproven result).",
 )
 _m_verify_time = M.new_histogram(
     "tpu",
@@ -75,15 +96,25 @@ _m_warm_misses = M.new_counter(
 __all__ = [
     "TpuEd25519BatchVerifier",
     "TpuSr25519BatchVerifier",
+    "DeviceFault",
+    "DeviceTimeout",
     "install",
     "installed",
     "stats",
     "DEFAULT_MIN_BATCH",
+    "DEFAULT_GATHER_DEADLINE_S",
 ]
 
 # Below this many signatures the fixed dispatch cost (host packing +
 # device roundtrip, ~100s of µs) exceeds CPU verify time; let CPU win.
 DEFAULT_MIN_BATCH = 8
+
+# Gather deadline when none is configured. XLA compiles block in
+# dispatch() (tracing + compile are synchronous), so the gather barrier
+# of an already-launched program on a healthy chip is sub-second plus
+# the ~50 ms tunnel RTT; 60 s of silence at the barrier means a wedged
+# claim or a dead relay, not a slow batch.
+DEFAULT_GATHER_DEADLINE_S = 60.0
 
 # lazily cached "is the backend a real accelerator" decision
 _STREAMING: Optional[bool] = None
@@ -183,6 +214,156 @@ def _has_tpu_runtime() -> bool:
         return True  # unknown: fall through to the backend query
 
 
+# -- fault containment plumbing --------------------------------------
+
+
+# (env string, parsed deadline) — the string is still read per call so
+# tests can flip the env var, but the float parse is paid once per value
+_DEADLINE_CACHE: tuple = (None, None)
+
+
+def gather_deadline() -> Optional[float]:
+    """The gather watchdog deadline, or None (direct call, no watchdog
+    thread). TM_TPU_GATHER_DEADLINE_S pins it explicitly (0 disables);
+    otherwise the default applies only where a gather can actually
+    wedge — a real accelerator behind a claim/tunnel — or while the
+    fault plane is armed (chaos tests exercise the hang mode). Plain
+    CPU-backed processes keep a thread-free hot path."""
+    global _DEADLINE_CACHE
+    env = os.environ.get("TM_TPU_GATHER_DEADLINE_S")
+    if env is not None:
+        if _DEADLINE_CACHE[0] != env:
+            try:
+                dl = float(env)
+            except ValueError:
+                dl = DEFAULT_GATHER_DEADLINE_S
+            _DEADLINE_CACHE = (env, dl if dl > 0 else None)
+        return _DEADLINE_CACHE[1]
+    if faults.armed() or on_accelerator():
+        return DEFAULT_GATHER_DEADLINE_S
+    return None
+
+
+# Abandoned watchdog workers still blocked inside a wedged gather.
+# Bounded: once the cap is hit, further deadline calls fail fast with
+# DeviceTimeout instead of stacking another forever-blocked thread —
+# otherwise the breaker's periodic probes against a dead device would
+# leak one thread per probe for the life of the process. Healthy
+# workers are recycled through a small free-list, so the steady-state
+# hot path pays one Event set/wait per gather, not a thread spawn.
+_MAX_WEDGED_GATHERS = 8
+_MAX_IDLE_WATCHDOGS = 4
+_IDLE_WATCHDOGS: list = []  # guarded by _wedged_lock
+_wedged_gathers = 0
+_wedged_lock = threading.Lock()
+
+
+class _Watchdog:
+    """One reusable daemon worker: runs one job at a time, parks on an
+    Event between jobs. A worker whose job wedged is abandoned (never
+    returned to the free-list) and retires itself if the job ever
+    finishes; a daemon thread cannot block process exit either way."""
+
+    __slots__ = ("_job", "_wake", "thread")
+
+    def __init__(self) -> None:
+        self._job = None
+        self._wake = threading.Event()
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True, name="tpu-gather-watchdog"
+        )
+        self.thread.start()
+
+    def run(self, job: tuple) -> None:
+        self._job = job
+        self._wake.set()
+
+    def _loop(self) -> None:
+        global _wedged_gathers
+        while True:
+            self._wake.wait()
+            self._wake.clear()
+            fn, result, done, state = self._job
+            self._job = None
+            try:
+                result["val"] = fn()
+            except BaseException as e:  # delivered to the caller
+                result["exc"] = e
+            with _wedged_lock:
+                done.set()  # inside the lock: atomic vs timeout path
+                if state["abandoned"]:
+                    # the wedge finally resolved; the slot frees but
+                    # this worker retires (its result was discarded)
+                    _wedged_gathers -= 1
+                    return
+                if len(_IDLE_WATCHDOGS) >= _MAX_IDLE_WATCHDOGS:
+                    return
+                _IDLE_WATCHDOGS.append(self)
+
+
+def _deadline_call(fn, deadline_s: float):
+    """Run fn on a watchdog worker, bounded by deadline_s. On expiry
+    the worker is ABANDONED (a blocked gather cannot be interrupted
+    from Python) and DeviceTimeout raises in the caller — the breaker
+    then keeps everyone else off the wedged claim. Abandoned-but-
+    still-blocked workers are counted and capped (_MAX_WEDGED_GATHERS):
+    at the cap, calls fail fast, so a permanently dead device costs a
+    fixed number of parked threads, not one per probe."""
+    global _wedged_gathers
+    with _wedged_lock:
+        if _wedged_gathers >= _MAX_WEDGED_GATHERS:
+            raise DeviceTimeout(
+                f"device gather skipped: {_wedged_gathers} wedged "
+                f"gathers already outstanding"
+            )
+        w = _IDLE_WATCHDOGS.pop() if _IDLE_WATCHDOGS else None
+    if w is None:
+        w = _Watchdog()
+    result: dict = {}
+    state = {"abandoned": False}
+    done = threading.Event()
+    w.run((fn, result, done, state))
+    if not done.wait(deadline_s):
+        with _wedged_lock:
+            if not done.is_set():  # really wedged, not a photo finish
+                state["abandoned"] = True
+                _wedged_gathers += 1
+        if state["abandoned"]:
+            raise DeviceTimeout(
+                f"device gather exceeded its {deadline_s}s deadline"
+            )
+    if "exc" in result:
+        raise result["exc"]
+    return result["val"]
+
+
+def _gather_guarded(v, handle, key_type: str) -> List[bool]:
+    """One gather with the full containment stack: fault-plane hooks
+    (raise/hang fire inside the watchdog so a hang surfaces as
+    DeviceTimeout), the deadline, and data-fault mangling applied to
+    the bitmap exactly where a broken device would corrupt it."""
+
+    def call():
+        if faults.armed():
+            faults.fire("tpu.gather", key=key_type)
+        return v.gather(handle)
+
+    dl = gather_deadline()
+    out = call() if dl is None else _deadline_call(call, dl)
+    bits = [bool(b) for b in out]
+    if faults.armed():
+        bits = faults.mangle("tpu.gather", bits, key=key_type)
+    return bits
+
+
+def _breaker(key_type: str):
+    return _breaker_mod.breaker_for(key_type)
+
+
+class _RoutedToCpu(Exception):
+    """Internal: the breaker is open — reroute silently, no fault."""
+
+
 class _TpuBatchVerifier(BatchVerifier):
     """Queues triples on host, verifies on device.
 
@@ -197,7 +378,16 @@ class _TpuBatchVerifier(BatchVerifier):
     dispatches the remainder and gathers every in-flight handle in add
     order. The chunk matches a configured bucket so no new program
     shapes are compiled.
-    """
+
+    Fault containment: every triple is retained (as references) until
+    verify() returns, so ANY device failure — a raising dispatch, a
+    gather past its deadline, a mis-shaped bitmap, a device-invalidated
+    lane the CPU disproves — drains the batch through the registered
+    CPU factory instead. The CPU bitmap is add-order aligned, so
+    callers see the same wrong-signature index either way; `faulted`
+    is left True so crypto.batch.drain_and_cache refuses to populate
+    the verified-signature cache from a batch the device touched and
+    lied about (or died under)."""
 
     KEY_TYPE = ""  # subclasses set
     STREAM_CHUNK = 2048  # == a DEFAULT_BUCKET_SIZES entry
@@ -205,10 +395,16 @@ class _TpuBatchVerifier(BatchVerifier):
     def __init__(self, verifier=None) -> None:
         self._verifier = verifier
         self._kernel = self._kernel_module()
+        # authoritative add-order record, kept until verify() returns
+        # (the CPU re-verify fallback needs the PubKey objects)
+        self._all: List[Tuple[PubKey, bytes, bytes]] = []
+        # pending window awaiting dispatch (bytes for the kernel)
         self._pks: List[bytes] = []
         self._msgs: List[bytes] = []
         self._sigs: List[bytes] = []
         self._handles: List[tuple] = []  # (backing, handle, n), add order
+        self._stream_fault: Optional[BaseException] = None
+        self.faulted = False  # True once a device fault was contained
         # dispatch telemetry accumulated across THIS one-shot batch
         # (streaming chunks launch from add(), before verify() runs)
         self._last_bucket = 0
@@ -251,6 +447,8 @@ class _TpuBatchVerifier(BatchVerifier):
         """Asynchronously launch the queued triples on `v` and clear
         the queue; the handle is gathered in verify(). Each dispatch is
         one device invocation for the metrics."""
+        if faults.armed():
+            faults.fire("tpu.dispatch", key=self.KEY_TYPE)
         self._account_dispatch(v, len(self._pks))
         self._handles.append(
             (v, v.dispatch(self._pks, self._msgs, self._sigs),
@@ -266,15 +464,36 @@ class _TpuBatchVerifier(BatchVerifier):
             )
         if len(signature) != 64:
             raise ValueError("malformed signature size")
+        message = bytes(message)
+        signature = bytes(signature)
+        self._all.append((pub_key, message, signature))
         self._pks.append(pub_key.bytes())
-        self._msgs.append(bytes(message))
-        self._sigs.append(bytes(signature))
-        if len(self._pks) >= self.STREAM_CHUNK and self._streaming():
+        self._msgs.append(message)
+        self._sigs.append(signature)
+        if (
+            len(self._pks) >= self.STREAM_CHUNK
+            and self._streaming()
+            and self._stream_fault is None
+        ):
             v = self._backing()
             # injected verifiers only promise verify(); stream solely
-            # when the dispatch()/gather() pair is actually there
-            if hasattr(v, "dispatch") and hasattr(v, "gather"):
-                self._dispatch_pending(v)
+            # when the dispatch()/gather() pair is actually there —
+            # and only onto a fully healthy route (state(), not
+            # allow(): a chunk launch must never consume the one
+            # half-open admission ticket the factory gate hands out)
+            if (
+                hasattr(v, "dispatch")
+                and hasattr(v, "gather")
+                and _breaker(self.KEY_TYPE).state() == _breaker_mod.CLOSED
+            ):
+                try:
+                    self._dispatch_pending(v)
+                except Exception as e:
+                    # a faulted async launch must not raise out of
+                    # add() — its contract is malformed-input errors
+                    # only. The window stays queued; verify() sees the
+                    # recorded fault and drains everything on CPU.
+                    self._stream_fault = e
 
     def verify(self) -> Tuple[bool, List[bool]]:
         """Drains the queue: a verifier is a one-shot batch (matching
@@ -289,51 +508,115 @@ class _TpuBatchVerifier(BatchVerifier):
         everything before the handle exists is host packing, everything
         after is the device barrier. Backings without the
         dispatch()/gather() pair (injected test verifiers) report one
-        undivided wall time."""
-        if not self._pks and not self._handles:
+        undivided wall time.
+
+        Any device fault — including a mis-shaped bitmap or a lane the
+        device invalidated that the CPU disproves — re-verifies the
+        WHOLE batch through the CPU factory (a faulted device's earlier
+        answers are not trusted either), records the fault on this key
+        type's breaker, and marks the batch `faulted` so its results
+        never reach the verified-signature cache. tpu_verify_sigs_total
+        counts only work the device actually completed."""
+        if not self._all and not self._handles:
             return False, []
         t0 = time.perf_counter()
         with trace.span(
             "tpu_dispatch", hist=_m_verify_time, key=self.KEY_TYPE
         ):
-            total = sum(n for _, _, n in self._handles) + len(self._pks)
+            work = self._all
+            total = len(work)
             v = self._backing()
+            bits: Optional[List[bool]] = None
+            fault: Optional[BaseException] = None
+            device_sigs = 0  # lanes with a COMPLETED device verdict
             host_prep: Optional[float] = None
-            if self._handles:
-                if self._pks:
-                    self._dispatch_pending(v)
-                host_prep = time.perf_counter() - t0
-                bits: List[bool] = []
-                try:
-                    for bv, handle, _n in self._handles:
-                        bits.extend(bool(b) for b in bv.gather(handle))
-                finally:
-                    # a gather that raises mid-loop must still leave the
-                    # verifier drained: a retry would otherwise re-gather
-                    # stale handles and double-count _m_sigs, and
-                    # __len__ would keep reporting the in-flight count
-                    self._handles = []
-            elif hasattr(v, "dispatch") and hasattr(v, "gather"):
-                # split verify() at the same boundary the streaming path
-                # uses (gather(dispatch()) is exactly v.verify())
-                self._account_dispatch(v, len(self._pks))
-                try:
+            try:
+                if self._stream_fault is not None:
+                    raise self._stream_fault
+                # side-effect-free OPEN check (not allow(): this
+                # verifier was already admitted at creation — possibly
+                # holding the route's one half-open ticket, which a
+                # second allow() here would have burned, wedging the
+                # breaker in HALF_OPEN forever). A HALF_OPEN attempt
+                # proceeds and reports its outcome below: the admitted
+                # verifier IS the probe on probe-less breakers.
+                if (
+                    not self._handles
+                    and _breaker(self.KEY_TYPE).state() == _breaker_mod.OPEN
+                ):
+                    raise _RoutedToCpu()
+                if self._handles:
+                    if self._pks:
+                        self._dispatch_pending(v)
+                    host_prep = time.perf_counter() - t0
+                    got: List[bool] = []
+                    try:
+                        for bv, handle, n in self._handles:
+                            lane = _gather_guarded(bv, handle, self.KEY_TYPE)
+                            if len(lane) != n:
+                                raise DeviceFault(
+                                    f"mis-shaped device result: "
+                                    f"{len(lane)} lanes for {n} signatures"
+                                )
+                            got.extend(lane)
+                            device_sigs += n
+                    finally:
+                        # a gather that raises mid-loop must still
+                        # leave the verifier drained: a retry would
+                        # otherwise re-gather stale handles, and
+                        # __len__ would keep reporting in-flight work
+                        self._handles = []
+                    bits = got
+                elif hasattr(v, "dispatch") and hasattr(v, "gather"):
+                    # split verify() at the same boundary the streaming
+                    # path uses (gather(dispatch()) is v.verify())
+                    self._account_dispatch(v, len(self._pks))
+                    if faults.armed():
+                        faults.fire("tpu.dispatch", key=self.KEY_TYPE)
                     handle = v.dispatch(self._pks, self._msgs, self._sigs)
                     host_prep = time.perf_counter() - t0
-                    bits = [bool(b) for b in v.gather(handle)]
-                finally:
-                    self._pks, self._msgs, self._sigs = [], [], []
-                _m_batches.inc()
-            else:
-                self._account_dispatch(v, len(self._pks))
-                try:
-                    bits = [
-                        bool(b)
-                        for b in v.verify(self._pks, self._msgs, self._sigs)
-                    ]
-                finally:
-                    self._pks, self._msgs, self._sigs = [], [], []
-                _m_batches.inc()
+                    _m_batches.inc()
+                    bits = _gather_guarded(v, handle, self.KEY_TYPE)
+                    if len(bits) != total:
+                        raise DeviceFault(
+                            f"mis-shaped device result: {len(bits)} "
+                            f"lanes for {total} signatures"
+                        )
+                    device_sigs = total
+                else:
+                    self._account_dispatch(v, len(self._pks))
+                    if faults.armed():
+                        faults.fire("tpu.dispatch", key=self.KEY_TYPE)
+                    raw = v.verify(self._pks, self._msgs, self._sigs)
+                    _m_batches.inc()
+                    bits = [bool(b) for b in raw]
+                    if faults.armed():
+                        bits = faults.mangle(
+                            "tpu.gather", bits, key=self.KEY_TYPE
+                        )
+                    if len(bits) != total:
+                        raise DeviceFault(
+                            f"mis-shaped device result: {len(bits)} "
+                            f"lanes for {total} signatures"
+                        )
+                    device_sigs = total
+                if not all(bits):
+                    self._disprove_invalid_lanes(work, bits)
+            except _RoutedToCpu:
+                bits = None  # silent reroute: breaker already open
+            except Exception as e:
+                bits = None
+                fault = e
+            finally:
+                # one-shot on every path: success, fault, or reroute
+                self._handles = []
+                self._pks, self._msgs, self._sigs = [], [], []
+                self._all = []
+                self._stream_fault = None
+            if bits is None:
+                _m_sigs.inc(device_sigs)
+                return self._cpu_fallback(work, fault, total)
+            _breaker(self.KEY_TYPE).record_success()
             if host_prep is not None:
                 device_wall = time.perf_counter() - t0 - host_prep
                 _m_host_prep.observe(host_prep)
@@ -348,11 +631,71 @@ class _TpuBatchVerifier(BatchVerifier):
                 pad_waste=self._pad_waste,
                 warm=not self._cold_dispatch,
             )
-        _m_sigs.inc(total)
+        _m_sigs.inc(device_sigs)
         return all(bits), bits
 
+    def _disprove_invalid_lanes(self, work, bits: List[bool]) -> None:
+        """Cross-examine every lane the device called invalid against a
+        CPU verify. A genuinely wrong signature fails both ways (the
+        normal cost: one CPU verify per bad lane, on an exceptional
+        path); a lane the CPU verifies is a device lie — a bit-flipped
+        result — and the whole batch is escalated to a fault. The
+        asymmetric flip (bad signature reported GOOD) cannot be caught
+        without re-verifying everything; it is excluded by the batch
+        equation itself on a correct program, and chaos coverage pins
+        the symmetric case (tests/test_faults.py).
+
+        The oracle must be HOST-ONLY: key types whose verify_signature
+        routes singles back to the device (sr25519) expose
+        verify_signature_cpu for exactly this — an oracle that asked
+        the device about the device's own verdict could never catch it
+        lying (and would recurse through the single route)."""
+        for i, ok in enumerate(bits):
+            if ok:
+                continue
+            pub_key, msg, sig = work[i]
+            oracle = getattr(
+                pub_key, "verify_signature_cpu", pub_key.verify_signature
+            )
+            if oracle(msg, sig):
+                raise DeviceFault(
+                    f"device invalidated lane {i} but the CPU verifies "
+                    f"it: result disproven"
+                )
+
+    def _cpu_fallback(self, work, fault, total: int) -> Tuple[bool, List[bool]]:
+        """Drain `work` through the registered CPU factory. With
+        `fault` set this is containment (breaker notified, fault
+        counted, batch marked so the sigcache never learns from it);
+        with fault=None the breaker was already open and this is just
+        the quiet degraded route."""
+        if fault is not None:
+            self.faulted = True
+            _m_device_faults.inc()
+            _breaker(self.KEY_TYPE).record_failure()
+            from ..libs.log import get_logger
+
+            get_logger("crypto.tpu").warning(
+                "device batch fault contained; re-verifying on CPU",
+                key=self.KEY_TYPE,
+                sigs=total,
+                err=repr(fault),
+            )
+        trace.add_attrs(batch=total, fallback="cpu")
+        cpu = cpu_factory(self.KEY_TYPE)
+        if cpu is None:  # no CPU fallback registered: surface the fault
+            if fault is not None:
+                raise fault
+            raise RuntimeError(
+                f"no CPU batch factory for {self.KEY_TYPE!r}"
+            )
+        bv = cpu()
+        for pub_key, msg, sig in work:
+            bv.add(pub_key, msg, sig)
+        return bv.verify()
+
     def __len__(self) -> int:
-        return len(self._pks) + sum(n for _, _, n in self._handles)
+        return len(self._all)
 
 
 class TpuEd25519BatchVerifier(_TpuBatchVerifier):
@@ -383,19 +726,20 @@ _SHARED_VERIFIER = None
 _SHARED_VERIFIER_SR = None
 _MIN_BATCH = DEFAULT_MIN_BATCH
 _INSTALLED = False
-# Single sr25519 verifies only route to the device once the smallest
-# bucket's program is compiled (the install() warm thread flips this);
-# until then they stay on the pure-Python path, so a consensus-critical
-# per-vote verify can never block behind an XLA compile. The thread
-# handle is kept so tests (and embedders) can join before reading.
-_SR_WARM = False
-_SR_WARM_THREAD = None
-# bumped (under _SR_WARM_LOCK) by every install() BEFORE the shared
-# verifier swap: a warm thread only publishes its result if its
-# generation is still current, so a slow warm from a superseded install
-# can never vouch for a verifier it didn't compile
-_SR_WARM_GEN = 0
-_SR_WARM_LOCK = threading.Lock()
+
+# The route breakers (crypto/breaker.py), by name:
+#   "ed25519" / "sr25519"     the batch factories + streaming dispatch
+#   "sr25519-single"          the per-vote single-verify device route
+# The single route's breaker starts OPEN — "cold" and "tripped" are the
+# same state: not currently proven. install() arms a probe that
+# compiles/verifies the smallest bucket off the critical path and
+# closes the breaker, replacing the old _SR_WARM flag; a device fault
+# re-opens it with the same never-pile-onto-a-wedged-claim backoff the
+# old trip_sr_singles delay implemented by hand.
+_SR_SINGLE = "sr25519-single"
+
+# cached self-signed probe triples, one per key type
+_PROBE_TRIPLES: dict = {}
 
 
 def installed() -> Optional[int]:
@@ -412,12 +756,15 @@ def stats() -> dict:
     return {
         "batches": int(_m_batches.value()),
         "sigs": int(_m_sigs.value()),
+        "faults": int(_m_device_faults.value()),
     }
 
 
 def _factory(size_hint: int) -> Optional[BatchVerifier]:
     if 0 < size_hint < _MIN_BATCH:
         return None  # CPU fallback for tiny batches
+    if not _breaker("ed25519").allow():
+        return None  # tripped breaker: CPU, silently
     return TpuEd25519BatchVerifier(_SHARED_VERIFIER)
 
 
@@ -429,6 +776,8 @@ def _factory_sr(size_hint: int) -> Optional[BatchVerifier]:
     min_b = 1 if on_accelerator() else _MIN_BATCH
     if 0 < size_hint < min_b:
         return None
+    if not _breaker("sr25519").allow():
+        return None  # tripped breaker: CPU, silently
     return TpuSr25519BatchVerifier(_SHARED_VERIFIER_SR)
 
 
@@ -438,113 +787,84 @@ def single_sr_verifier() -> Optional[BatchVerifier]:
     Used by PubKeySr25519.verify_signature so per-vote and evidence
     verifies ride the kernel — through the installed (possibly
     mesh-sharded) verifier and the tpu metrics, same as batches.
-    Gated on the warm flag: until install()'s background thread has
-    compiled the smallest sr25519 bucket, singles stay on the CPU path
-    instead of stalling a vote behind the first XLA compile."""
-    if not (_INSTALLED and _SR_WARM):
+    Gated on the single-route breaker: until install()'s probe has
+    compiled and proven the smallest sr25519 bucket the breaker stays
+    open and singles stay on the CPU path — a vote can never stall
+    behind the first XLA compile or pile onto a wedged claim."""
+    if not _INSTALLED:
+        return None
+    if not sr_single_breaker().allow():
         return None
     return _factory_sr(1)
 
 
-def trip_sr_singles() -> None:
-    """Demote single sr25519 verifies back to the CPU path after a
-    device fault (called by PubKeySr25519.verify_signature's fallback).
-    Without the trip, a persistently faulted device would be re-tried —
-    and a warning logged — on every per-vote verify. A fresh warm probe
-    is started immediately: if the fault was transient the probe's
-    successful device verify re-arms the route; if the device is truly
-    down the probe fails quietly and singles stay on CPU (one probe per
-    trip — no retry storm, and batches keep their own error paths)."""
-    global _SR_WARM
-    with _SR_WARM_LOCK:
-        _SR_WARM = False
-    if _INSTALLED:
-        # one probe at a time (enforced inside, under the gate lock),
-        # and not immediately: if the fault is a wedge rather than a
-        # raising error, an instant re-touch of the device would just
-        # hang another thread (device-claim discipline: never pile onto
-        # a wedged claim)
-        _start_sr_warm_thread(delay_s=10.0, single_flight=True)
+def sr_single_breaker():
+    """The breaker guarding the sr25519 single-verify device route
+    (created cold/OPEN if install() has not armed it yet)."""
+    return _breaker_mod.breaker_for(_SR_SINGLE, start_open=True)
 
 
-def _start_sr_warm_thread(
-    delay_s: float = 0.0, single_flight: bool = False
-) -> None:
-    """Compile the smallest sr25519 bucket off the install() path, then
-    flip _SR_WARM so single verifies start routing to the device. Runs
-    on a daemon thread: install() itself must never touch the backend
-    (a wedged device claim would hang node startup — PERF.md claim
-    discipline), and a warm that stalls only delays the device upgrade
-    of single verifies, never a vote."""
-    global _SR_WARM_THREAD, _SR_WARM_GEN
+def _probe_triple(key_type: str) -> tuple:
+    cached = _PROBE_TRIPLES.get(key_type)
+    if cached is None:
+        if key_type == "sr25519":
+            from .sr25519 import PrivKeySr25519 as Priv
+        else:
+            from .ed25519 import PrivKeyEd25519 as Priv
+        priv = Priv.from_seed(b"\x77" * 32)
+        msg = b"breaker-probe-" + key_type.encode()
+        cached = (priv.pub_key().bytes(), msg, priv.sign(msg))
+        _PROBE_TRIPLES[key_type] = cached
+    return cached
 
-    with _SR_WARM_LOCK:
-        if single_flight and (
-            _SR_WARM_THREAD is not None and _SR_WARM_THREAD.is_alive()
-        ):
-            # a probe is already in flight (alive-check and thread
-            # publication share this lock, so concurrent trips cannot
-            # both slip past it)
-            return
-        # snapshot generation AND verifier together: the probe must
-        # only ever vouch for the verifier it actually compiled, and
-        # install() swaps both under this same lock
-        gen = _SR_WARM_GEN
-        snap = _SHARED_VERIFIER_SR
-        # publish the thread object under the same lock as the alive
-        # check above; `warm` is late-bound — defined below, before
-        # start() runs
-        _SR_WARM_THREAD = thread = threading.Thread(
-            target=lambda: warm(), daemon=True, name="sr25519-warm"
-        )
 
-    def publish(ok: bool) -> None:
-        """Set the warm flag iff this thread's snapshot is still
-        current — checked and written under the gate lock so a
-        superseded warm (older generation OR swapped verifier) can
-        never vouch for a verifier it didn't compile."""
-        global _SR_WARM
-        with _SR_WARM_LOCK:
-            if (
-                ok
-                and gen == _SR_WARM_GEN
-                and snap is _SHARED_VERIFIER_SR
-            ):
-                _SR_WARM = True
+def _device_probe(key_type: str, backing) -> bool:
+    """One self-signed signature end-to-end through the device path,
+    with the SAME fault hooks and gather deadline as production
+    traffic — so a probe against a still-faulty device fails exactly
+    like the traffic it stands in for, and a probe against a healed
+    one proves the route. Used single-flight by the breakers; never
+    called from consensus threads."""
+    pk, msg, sig = _probe_triple(key_type)
+    v = backing()
+    if faults.armed():
+        faults.fire("tpu.dispatch", key=key_type)
+    if hasattr(v, "dispatch") and hasattr(v, "gather"):
+        handle = v.dispatch([pk], [msg], [sig])
+        bits = _gather_guarded(v, handle, key_type)
+    else:
+        raw = v.verify([pk], [msg], [sig])
+        bits = [bool(b) for b in raw]
+        if faults.armed():
+            bits = faults.mangle("tpu.gather", bits, key=key_type)
+    return len(bits) == 1 and bool(bits[0])
 
-    def warm() -> None:
-        try:
-            if delay_s:
-                time.sleep(delay_s)
-            if not on_accelerator() and _MIN_BATCH > 1:
-                # CPU process with the min-batch gate keeping singles
-                # off the kernel: nothing to compile. (min_batch <= 1
-                # would route singles to the CPU-backend kernel, so
-                # that case falls through to the real probe below.)
-                publish(True)
-                return
-            from .sr25519 import PrivKeySr25519
 
-            priv = PrivKeySr25519.from_seed(b"\x77" * 32)
-            msg = b"sr25519-warm"
-            v = snap
-            if v is None:
-                from ..ops import sr25519_kernel
+def _ed_backing():
+    if _SHARED_VERIFIER is not None:
+        return _SHARED_VERIFIER
+    from ..ops import ed25519_kernel
 
-                v = sr25519_kernel.default_verifier()
-            ok = v.verify(
-                [priv.pub_key().bytes()], [msg], [priv.sign(msg)]
-            )
-            publish(bool(ok.all()))
-        except Exception as e:  # pragma: no cover - warm is best-effort
-            from ..libs.log import get_logger
+    return ed25519_kernel.default_verifier()
 
-            get_logger("crypto.tpu").warning(
-                "sr25519 device warm-up failed; singles stay on CPU",
-                err=repr(e),
-            )
 
-    thread.start()
+def _sr_backing():
+    if _SHARED_VERIFIER_SR is not None:
+        return _SHARED_VERIFIER_SR
+    from ..ops import sr25519_kernel
+
+    return sr25519_kernel.default_verifier()
+
+
+def _sr_single_probe() -> bool:
+    """The single-route warm/re-arm probe: on a CPU process with the
+    min-batch gate keeping singles off the kernel there is nothing to
+    compile or prove — close immediately (the factory gate returns
+    None for singles there anyway). Otherwise one real device verify
+    of the smallest sr25519 bucket."""
+    if not on_accelerator() and _MIN_BATCH > 1:
+        return True
+    return _device_probe("sr25519", _sr_backing)
 
 
 def install(
@@ -552,9 +872,13 @@ def install(
 ) -> None:
     """Register the device factories (ed25519 + sr25519). With a mesh,
     ed25519 batches are sharded across it
-    (tendermint_tpu.parallel.sharding); otherwise single-chip."""
+    (tendermint_tpu.parallel.sharding); otherwise single-chip.
+
+    Each install is a new breaker generation: fresh instances replace
+    the registered ones, so a probe still in flight from a superseded
+    install publishes into an orphaned object nobody consults — the
+    atomicity the old _SR_WARM_GEN counter provided by hand."""
     global _SHARED_VERIFIER, _SHARED_VERIFIER_SR, _MIN_BATCH, _INSTALLED
-    global _SR_WARM, _SR_WARM_GEN
     _MIN_BATCH = min_batch
     _INSTALLED = True
     # warm the native keccak library here (a subprocess cc compile on
@@ -574,19 +898,22 @@ def install(
     else:
         new_ed = None
         new_sr = None
-    # gate drop + generation bump + verifier swap are ONE atomic step:
-    # a concurrent vote (or a trip-started warm probe) must never see
-    # the new uncompiled verifier behind a still-true warm flag, nor a
-    # current generation paired with the old verifier
-    with _SR_WARM_LOCK:
-        _SR_WARM = False
-        _SR_WARM_GEN += 1
-        _SHARED_VERIFIER = new_ed
-        _SHARED_VERIFIER_SR = new_sr
+    _SHARED_VERIFIER = new_ed
+    _SHARED_VERIFIER_SR = new_sr
     _WARM_BUCKETS.clear()  # new generation: every bucket is cold again
+    b_ed = _breaker_mod.fresh("ed25519")
+    b_ed.set_probe(lambda: _device_probe("ed25519", _ed_backing))
+    b_sr = _breaker_mod.fresh("sr25519")
+    b_sr.set_probe(lambda: _device_probe("sr25519", _sr_backing))
+    b_single = _breaker_mod.fresh(_SR_SINGLE, start_open=True)
+    b_single.set_probe(_sr_single_probe)
+    # warm the single route off the install path: install() itself must
+    # never touch the backend (a wedged device claim would hang node
+    # startup — PERF.md claim discipline); a probe that stalls only
+    # delays the device upgrade of single verifies, never a vote
+    b_single.probe_now()
     register_device_factory("ed25519", _factory)
     register_device_factory("sr25519", _factory_sr)
-    _start_sr_warm_thread()
     # merged multi-commit batches (light sequential windows) only pay
     # off on an accelerator ONCE THIS FACTORY IS INSTALLED: _factory
     # serves every >=_MIN_BATCH batch regardless of backend, and on a
@@ -611,13 +938,11 @@ def uninstall() -> None:
     """Remove the device factories and reset install state — the
     counterpart of install(), mirroring ops/merkle_kernel.uninstall()
     (tests and embedders switching a node back to the CPU seam). The
-    generation bump retires any in-flight warm thread — it only
-    publishes under a current generation — and the merged-window
-    affinity falls back to the module default
-    (batch.native_cpu_affinity) unless an operator pinned a value
-    explicitly."""
+    breakers are discarded — an in-flight probe publishes into an
+    orphaned object — and the merged-window affinity falls back to the
+    module default (batch.native_cpu_affinity) unless an operator
+    pinned a value explicitly."""
     global _SHARED_VERIFIER, _SHARED_VERIFIER_SR, _MIN_BATCH, _INSTALLED
-    global _SR_WARM, _SR_WARM_GEN
     from .batch import (
         native_cpu_affinity,
         set_group_affinity_fn,
@@ -626,12 +951,11 @@ def uninstall() -> None:
 
     unregister_device_factory("ed25519")
     unregister_device_factory("sr25519")
-    with _SR_WARM_LOCK:
-        _SR_WARM = False
-        _SR_WARM_GEN += 1
-        _SHARED_VERIFIER = None
-        _SHARED_VERIFIER_SR = None
+    _SHARED_VERIFIER = None
+    _SHARED_VERIFIER_SR = None
     _WARM_BUCKETS.clear()
     _MIN_BATCH = DEFAULT_MIN_BATCH
     _INSTALLED = False
+    for name in ("ed25519", "sr25519", _SR_SINGLE):
+        _breaker_mod.discard(name)
     set_group_affinity_fn(native_cpu_affinity)

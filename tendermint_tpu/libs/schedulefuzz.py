@@ -72,6 +72,17 @@ class Schedule:
                 pools.pop(i)
         return out
 
+    def subseed(self, label: str) -> int:
+        """A deterministic child seed for a sibling source of seeded
+        randomness (e.g. a crypto.faults rule riding along with this
+        delivery schedule): a pure function of (seed, label), so the
+        combined exploration still reproduces from the one seed the
+        failure message names — and independent of how much of THIS
+        schedule's rng was consumed before the sibling was armed."""
+        import zlib
+
+        return (self.seed << 16) ^ zlib.crc32(label.encode())
+
     async def yield_point(self, p: float = 0.5) -> None:
         """With probability p, yield the event loop 1-2 times so other
         tasks interleave here."""

@@ -83,6 +83,20 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
+def _fresh_fault_plane():
+    """Disarm the fault plane and drop every circuit breaker after each
+    test: a chaos test that tripped a route breaker must not silently
+    reroute a later test's device-path assertions to the CPU factory.
+    Breakers are created on demand (closed) so non-fault tests see the
+    exact pre-breaker behavior."""
+    yield
+    from tendermint_tpu.crypto import breaker, faults
+
+    faults.reset()
+    breaker.reset_all()
+
+
+@pytest.fixture(autouse=True)
 def _fresh_sigcache():
     """Start every test with a cold verified-signature cache: the test
     fixtures are deterministic (fixed seeds/timestamps), so identical

@@ -1,0 +1,287 @@
+"""Chaos tests: BFT consensus under injected device faults.
+
+The containment acceptance criteria (ISSUE 3 / docs/resilience.md):
+
+- a multi-height consensus pipeline driven under 100% and intermittent
+  injected device faults (raise + hang variants) produces commit
+  hashes IDENTICAL to a fault-free run — the device is allowed to cost
+  latency, never correctness;
+- the breaker is observed cycling open -> half-open -> closed as
+  faults clear, with at most one re-arm probe in flight at any moment
+  and a bounded total probe count (no retry storm);
+- a live 4-validator network keeps committing identical blocks at
+  every height while faults fire mid-flight.
+
+The device seam runs the REAL containment stack
+(crypto/tpu_verifier._TpuBatchVerifier + crypto/breaker) over a
+host-CPU backing, so the chaos schedule — not a jax compile — is what
+these tests spend their time on; the fault points sit at the
+dispatch/gather boundary, exactly where an XLA runtime would fail.
+"""
+
+import asyncio
+import hashlib
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import breaker as B
+from tendermint_tpu.crypto import faults, sigcache
+from tendermint_tpu.crypto import tpu_verifier as T
+from tendermint_tpu.crypto.batch import (
+    register_device_factory,
+    unregister_device_factory,
+)
+from tendermint_tpu.crypto.ed25519 import Ed25519BatchVerifier
+from tendermint_tpu.crypto.keys import pubkey_from_type_and_bytes
+from tendermint_tpu.types import PRECOMMIT_TYPE, VoteSet, verify_commit
+
+from .test_types import CHAIN_ID, make_block_id, make_validators, signed_vote
+
+
+class HostBacking:
+    """dispatch/gather pair answering from the CPU batch verifier: the
+    containment layer above it cannot tell it from a device, and the
+    fault plane intercepts at exactly the same two points."""
+
+    bucket_sizes = (8, 32, 128)
+
+    def dispatch(self, pks, msgs, sigs):
+        bv = Ed25519BatchVerifier()
+        for pk, m, s in zip(pks, msgs, sigs):
+            bv.add(pubkey_from_type_and_bytes("ed25519", pk), m, s)
+        return bv.verify()[1]
+
+    def gather(self, handle):
+        return handle
+
+
+class BreakerScope:
+    """Wire the ed25519 route the way install() does — fresh breaker,
+    single-flight probe against the backing — but with test-speed
+    backoff, and record every state transition plus probe concurrency."""
+
+    def __init__(self, backing, backoff_s=0.05):
+        self.states = []
+        self.probe_peak = 0
+        self._in_flight = 0
+        self._lock = threading.Lock()
+        self.breaker = B.fresh("ed25519", backoff_base_s=backoff_s)
+        self._record(self.breaker.state())
+
+        def probe():
+            with self._lock:
+                self._in_flight += 1
+                self.probe_peak = max(self.probe_peak, self._in_flight)
+            self._record(self.breaker.state())  # HALF_OPEN at probe time
+            try:
+                return T._device_probe("ed25519", lambda: backing)
+            finally:
+                with self._lock:
+                    self._in_flight -= 1
+
+        self.breaker.set_probe(probe)
+
+    def _record(self, state):
+        if not self.states or self.states[-1] != state:
+            self.states.append(state)
+
+    def note(self):
+        self._record(self.breaker.state())
+
+
+@pytest.fixture
+def device_seam(monkeypatch):
+    """The TPU factory served by a HostBacking, min_batch=2, with the
+    breaker scope armed — every >=2-signature batch rides the full
+    containment stack."""
+    backing = HostBacking()
+    monkeypatch.setattr(T, "_SHARED_VERIFIER", backing)
+    monkeypatch.setattr(T, "_MIN_BATCH", 2)
+    monkeypatch.setattr(T, "_INSTALLED", True)
+    register_device_factory("ed25519", T._factory)
+    scope = BreakerScope(backing)
+    yield scope
+    unregister_device_factory("ed25519")
+
+
+def _drive_chain(n_heights, n_vals=4):
+    """n_heights of the addVote -> verify_commit pipeline over
+    DETERMINISTIC votes (fixed timestamps, block IDs chained on the
+    previous commit hash): vote batches drain through the device seam
+    the way consensus verify-ahead does, each height's commit is
+    verified through verify_commit, and the returned hash chain is a
+    pure function of the inputs — any fault that leaked into
+    verification (a dropped vote, a mis-attributed signature, a
+    commit accepted that should fail) changes it."""
+    vals, privs = make_validators(n_vals)
+    from tendermint_tpu.crypto.batch import (
+        create_batch_verifier,
+        drain_and_cache,
+    )
+
+    chain = []
+    prev = b"\x01"
+    for h in range(1, n_heights + 1):
+        bid = make_block_id(prev[:1] or b"\x01")
+        votes = [
+            signed_vote(p, vals, i, bid, height=h)
+            for i, p in enumerate(privs)
+        ]
+        # the verify-ahead shape: one device batch over the height's
+        # precommits (faults fire here), results recorded in sigcache
+        bv = create_batch_verifier(privs[0].pub_key(), size_hint=len(votes))
+        keys = []
+        for v, p in zip(votes, privs):
+            sb = v.sign_bytes(CHAIN_ID)
+            bv.add(p.pub_key(), sb, v.signature)
+            keys.append(
+                sigcache.key_for(p.pub_key().bytes(), sb, v.signature)
+            )
+        ok, bits = drain_and_cache(bv, keys)
+        assert ok and all(bits), f"height {h}: valid votes rejected"
+        vs = VoteSet(CHAIN_ID, h, 0, PRECOMMIT_TYPE, vals)
+        for v in votes:
+            assert vs.add_vote(v)
+        commit = vs.make_commit()
+        # the next height's LastCommit check (faults fire here too)
+        verify_commit(CHAIN_ID, vals, bid, h, commit)
+        digest = hashlib.sha256(
+            commit.hash() + bid.hash + prev
+        ).digest()
+        chain.append(digest)
+        prev = digest
+    return chain
+
+
+def test_20_height_chain_identical_under_faults(device_seam):
+    """The headline acceptance: 20 heights, clean vs 100% faults vs
+    intermittent raise+hang faults — identical commit-hash chains."""
+    sigcache.reset()
+    clean = _drive_chain(20)
+
+    sigcache.reset()  # force every height back onto the device seam
+    with faults.inject("tpu.dispatch", mode="raise"):  # 100% faults
+        all_faulted = _drive_chain(20)
+
+    sigcache.reset()
+    B.breaker_for("ed25519").close_now()
+    with faults.inject("tpu.dispatch", mode="raise", p=0.3, seed=11), \
+            faults.inject("tpu.gather", mode="hang", p=0.2, seed=12,
+                          hang_s=0.25):
+        # a short deadline so injected hangs surface as DeviceTimeout
+        import os
+
+        os.environ["TM_TPU_GATHER_DEADLINE_S"] = "0.1"
+        try:
+            intermittent = _drive_chain(20)
+        finally:
+            del os.environ["TM_TPU_GATHER_DEADLINE_S"]
+
+    assert clean == all_faulted == intermittent
+    assert len(clean) == 20
+    assert T.stats()["faults"] > 0  # the chaos actually happened
+
+
+def test_breaker_cycles_and_probe_bounded_under_intermittent_faults(
+    device_seam,
+):
+    """Breaker lifecycle under a fault burst that then clears:
+    open -> half-open -> closed observed, <=1 probe in flight ever,
+    probe count bounded (no retry storm)."""
+    scope = device_seam
+    sigcache.reset()
+    with sigcache.disabled():
+        with faults.inject("tpu.dispatch", mode="raise"):
+            _drive_chain(3)  # every batch faults; breaker trips
+            scope.note()
+        assert B.OPEN in scope.states
+        # faults cleared: the timer-scheduled single-flight probe must
+        # re-arm the route with no traffic at all
+        deadline = time.monotonic() + 10.0
+        while (
+            scope.breaker.state() != B.CLOSED
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        scope.note()
+    assert scope.states[0] == B.CLOSED
+    seq = scope.states
+    assert seq.index(B.OPEN) < seq.index(B.HALF_OPEN) <= len(seq) - 2
+    assert seq[-1] == B.CLOSED
+    assert scope.probe_peak <= 1
+    # bounded probing: a 3-height fault burst plus recovery needs a
+    # handful of probes, not one per faulted call
+    assert scope.breaker.stats()["probes"] <= 8
+    # and the re-armed route serves the device again, uncontained
+    sigcache.reset()
+    chain = _drive_chain(2)
+    assert len(chain) == 2
+
+
+# -- live consensus under chaos ---------------------------------------
+
+
+def test_live_consensus_commits_identically_under_faults(device_seam):
+    """A real 4-validator network (in-process gossip) runs 8 heights
+    while raise+hang faults fire mid-flight on the device seam: every
+    node commits the IDENTICAL block at every height and nobody stalls
+    — degraded means slower, never wrong (the safety half the
+    deterministic chain test can't cover: live vote interleaving,
+    verify-ahead batches, replay of LastCommit inside block
+    validation)."""
+    from .test_consensus_state import Node, RelayNet, fast_config
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+    target = 8
+
+    async def go():
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([i + 80]) * 32)
+            for i in range(4)
+        ]
+        genesis = GenesisDoc(
+            chain_id="chaos-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10)
+                for p in privs
+            ],
+        )
+        nodes = [Node(p, genesis, cfg=fast_config()) for p in privs]
+        RelayNet(nodes)
+        for n in nodes:
+            await n.cs.start()
+        try:
+            await asyncio.gather(
+                *(
+                    n.cs.wait_for_height(target + 1, timeout=90.0)
+                    for n in nodes
+                )
+            )
+        finally:
+            for n in nodes:
+                await n.cs.stop()
+        return nodes
+
+    import os
+
+    os.environ["TM_TPU_GATHER_DEADLINE_S"] = "0.1"
+    try:
+        with sigcache.disabled(), \
+                faults.inject("tpu.dispatch", mode="raise", p=0.25,
+                              seed=21), \
+                faults.inject("tpu.gather", mode="hang", p=0.1, seed=22,
+                              hang_s=0.2):
+            nodes = asyncio.run(go())
+    finally:
+        del os.environ["TM_TPU_GATHER_DEADLINE_S"]
+
+    for h in range(1, target + 1):
+        hashes = {n.block_store.load_block(h).hash() for n in nodes}
+        assert len(hashes) == 1, f"divergent block at height {h}"
+    device_seam.note()
+    # liveness held AND the chaos was real
+    assert min(n.block_store.height() for n in nodes) >= target

@@ -593,3 +593,132 @@ def test_evidence_pool_intake_schedule_independent():
 
     final = run(explore(scenario, schedules=8, base_seed=370))
     assert final == tuple(sorted(e.hash() for e in (evs[0], evs[2])))
+
+
+def test_vote_ingest_with_device_faults_schedule_independent():
+    """ISSUE 3 satellite: duplicated/reordered vote delivery through
+    the device seam WHILE seeded device faults (raise + bit-flip) fire
+    at the dispatch/gather boundary — verify-ahead batches drain
+    between deliveries exactly like consensus _preverify_votes, the
+    ed25519 breaker trips (and ticket-re-arms in-band) at whatever
+    point each schedule's fault seed dictates, and the vote-set
+    outcome must be byte-identical across every schedule. The fault
+    seeds derive from the schedule seed (Schedule.subseed); the
+    breaker deliberately has NO background probe here, so every fault
+    rule consult happens in scenario order (a timer-driven probe
+    would advance the shared seeded RNGs at wall-clock-dependent
+    points). Backoff expiry is still wall-clock, so the exact
+    device-vs-CPU routing per burst may vary — the assertion is the
+    invariant that must NOT vary: the vote-set outcome."""
+    import time as _time
+
+    from tendermint_tpu.crypto import breaker as B
+    from tendermint_tpu.crypto import faults, sigcache
+    from tendermint_tpu.crypto import tpu_verifier as T
+    from tendermint_tpu.crypto.batch import (
+        create_batch_verifier,
+        drain_and_cache,
+        register_device_factory,
+        unregister_device_factory,
+    )
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    from tests.test_chaos_consensus import HostBacking
+
+    privs = [
+        PrivKeyEd25519.from_seed(bytes([i + 1, 0x99]) + b"\x27" * 30)
+        for i in range(7)
+    ]
+    vals = ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+    )
+    order = {v.address: i for i, v in enumerate(vals.validators)}
+    bid = BlockID(
+        hash=b"\x71" * 32,
+        part_set_header=PartSetHeader(total=1, hash=b"\x72" * 32),
+    )
+    now = _time.time_ns()
+    votes = []
+    by_key = {}
+    for p in privs[:5]:  # 50/70 power > 2/3
+        addr = p.pub_key().address()
+        v = Vote(
+            type=PRECOMMIT_TYPE,
+            height=5,
+            round=0,
+            block_id=bid,
+            timestamp_ns=now,
+            validator_address=addr,
+            validator_index=order[addr],
+        )
+        v.signature = p.sign(v.sign_bytes("sf-chain"))
+        votes.append(v)
+        by_key[addr] = p.pub_key()
+
+    backing = HostBacking()
+
+    async def scenario(sched):
+        sigcache.reset()
+        T._SHARED_VERIFIER, shared0 = backing, T._SHARED_VERIFIER
+        T._MIN_BATCH, min0 = 2, T._MIN_BATCH
+        register_device_factory("ed25519", T._factory)
+        B.fresh("ed25519", backoff_base_s=0.01)  # probe-less: in-band re-arm
+        try:
+            with faults.inject(
+                "tpu.dispatch", mode="raise", p=0.4,
+                seed=sched.subseed("dispatch"),
+            ), faults.inject(
+                "tpu.gather", mode="bitflip", p=0.3,
+                seed=sched.subseed("gather"),
+            ):
+                vs = VoteSet("sf-chain", 5, 0, PRECOMMIT_TYPE, vals)
+                buffer = []
+                plan = sched.with_dups(sched.shuffled(votes), 4)
+                for i, v in enumerate(plan):
+                    buffer.append(v)
+                    if len(buffer) >= 3 or i == len(plan) - 1:
+                        # the verify-ahead shape: one device batch over
+                        # the queued burst, cache misses only — faults
+                        # land here, containment must keep the answers
+                        triples, keys = [], []
+                        for bv_vote in buffer:
+                            pk = by_key[bv_vote.validator_address]
+                            sb = bv_vote.sign_bytes("sf-chain")
+                            key = sigcache.key_for(
+                                pk.bytes(), sb, bv_vote.signature
+                            )
+                            if not sigcache.seen_key(key):
+                                triples.append(
+                                    (pk, sb, bv_vote.signature)
+                                )
+                                keys.append(key)
+                        if len(triples) >= 2:
+                            bv = create_batch_verifier(
+                                triples[0][0], size_hint=len(triples)
+                            )
+                            for pk, sb, sig in triples:
+                                bv.add(pk, sb, sig)
+                            ok, bits = drain_and_cache(bv, keys)
+                            assert ok and all(bits), (
+                                "valid votes rejected under faults"
+                            )
+                        for bv_vote in buffer:
+                            vs.add_vote(bv_vote)
+                        buffer = []
+                    await sched.yield_point()
+            maj, ok = vs.two_thirds_majority()
+            return (ok, maj.hash, str(vs.votes_bit_array))
+        finally:
+            unregister_device_factory("ed25519")
+            T._SHARED_VERIFIER = shared0
+            T._MIN_BATCH = min0
+            B.reset_all()
+
+    ok, maj_hash, _bits = run(
+        explore(scenario, schedules=10, base_seed=500)
+    )
+    assert ok and maj_hash == bid.hash

@@ -208,7 +208,7 @@ def test_single_verify_device_route(monkeypatch):
 
     monkeypatch.setattr(T, "_INSTALLED", True)
     monkeypatch.setattr(T, "_STREAMING", True)  # pretend accelerator
-    monkeypatch.setattr(T, "_SR_WARM", True)  # bucket already compiled
+    T.sr_single_breaker().close_now()  # route proven (bucket compiled)
     assert T.single_sr_verifier() is not None
     sigs_before = T.stats()["sigs"]
     assert pub.verify_signature(msg, sig)
@@ -304,18 +304,17 @@ def test_native_sr_batch_differential_edges():
 
 
 def test_single_route_gated_on_warm(monkeypatch):
-    """Until install()'s warm thread has compiled the smallest sr25519
-    bucket, single verifies stay on the CPU path — a per-vote verify
-    must never block behind the first XLA compile (ADVICE r3)."""
-    from tendermint_tpu.crypto import tpu_verifier as T
+    """Until install()'s probe has compiled and proven the smallest
+    sr25519 bucket, single verifies stay on the CPU path — a per-vote
+    verify must never block behind the first XLA compile (ADVICE r3).
+    The gate is the single-route breaker, which starts OPEN (cold and
+    tripped are the same state: not currently proven)."""
+    from tendermint_tpu.crypto import breaker, tpu_verifier as T
 
-    # an earlier test's install() may have left a warm thread running;
-    # join it so its async _SR_WARM write can't land after ours
-    if T._SR_WARM_THREAD is not None:
-        T._SR_WARM_THREAD.join(timeout=30)
+    breaker.reset_all()  # fresh cold breaker, no probe armed
     monkeypatch.setattr(T, "_INSTALLED", True)
     monkeypatch.setattr(T, "_STREAMING", True)
-    monkeypatch.setattr(T, "_SR_WARM", False)
+    assert T.sr_single_breaker().state() == breaker.OPEN
     assert T.single_sr_verifier() is None
 
 
@@ -340,11 +339,15 @@ def test_single_verify_device_fault_falls_back(monkeypatch):
         def verify(self):  # pragma: no cover - add raises first
             raise RuntimeError("device fault")
 
+    from tendermint_tpu.crypto import breaker
+
+    breaker.reset_all()
+    T.sr_single_breaker().close_now()
     monkeypatch.setattr(T, "single_sr_verifier", lambda: Boom())
-    monkeypatch.setattr(T, "_SR_WARM", True)
     assert pub.verify_signature(msg, sig)
-    # the fault trips the route so later votes skip the device retry
-    assert T._SR_WARM is False
+    # the fault trips the route's breaker so later votes skip the
+    # device retry (and its warning) entirely
+    assert T.sr_single_breaker().state() == breaker.OPEN
     assert not pub.verify_signature(msg, bad)
 
 
