@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""tmlint CLI — run the consensus-invariant static analyzer.
+
+Usage:
+    python scripts/lint.py                    # full package vs baseline
+    python scripts/lint.py --rule det-float   # one rule class only
+    python scripts/lint.py --no-baseline      # every violation, raw
+    python scripts/lint.py --baseline-update  # re-accept current state
+    python scripts/lint.py --list-rules       # rule catalog
+    python scripts/lint.py path/to/file.py    # specific files (paths
+                                              # inside tendermint_tpu/)
+
+Exit codes (the contract tests/test_lint.py and CI rely on):
+    0  clean — no violations beyond the checked-in baseline
+    1  new violations found (or any violation under --no-baseline)
+    2  usage or internal error
+
+The baseline lives at tendermint_tpu/analysis/baseline.json and is
+fingerprinted by source-line content, so unrelated edits never shift
+it. docs/static_analysis.md documents the workflow and the
+suppression policy (`# tmlint: disable=<rule>` with a justification).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.analysis import tmlint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files to check (default: the whole tendermint_tpu package)",
+    )
+    ap.add_argument(
+        "--rule", action="append", dest="rules", metavar="ID",
+        help="only run this rule id (repeatable)",
+    )
+    ap.add_argument(
+        "--baseline", default=tmlint.BASELINE_PATH,
+        help="baseline file (default: tendermint_tpu/analysis/baseline.json)",
+    )
+    ap.add_argument(
+        "--baseline-update", action="store_true",
+        help="accept the current violation set as the new baseline",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report and fail on every violation",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="print per-rule counts and timing",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in tmlint.all_rules():
+            print(f"{rule.id}: {rule.title}")
+            print(f"    {rule.rationale}")
+        return 0
+
+    if args.baseline_update and (args.rules or args.paths):
+        # a filtered scan would overwrite the whole baseline with its
+        # subset, silently deleting every other grandfathered entry
+        print(
+            "error: --baseline-update requires a full-package, "
+            "all-rules run (drop --rule and path arguments)",
+            file=sys.stderr,
+        )
+        return 2
+
+    t0 = time.monotonic()
+    try:
+        if args.paths:
+            root = tmlint.package_root()
+            violations = []
+            for p in args.paths:
+                abspath = os.path.abspath(p)
+                rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+                if rel.startswith(".."):
+                    print(
+                        f"error: {p} is outside the package root {root}",
+                        file=sys.stderr,
+                    )
+                    return 2
+                violations.extend(tmlint.check_file(abspath, rel, args.rules))
+        else:
+            violations = tmlint.check_package(rules=args.rules)
+    except (ValueError, OSError, SyntaxError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - t0
+
+    if args.baseline_update:
+        counts = tmlint.save_baseline(violations, args.baseline)
+        print(
+            f"baseline updated: {len(counts)} fingerprints covering "
+            f"{len(violations)} accepted violations -> {args.baseline}"
+        )
+        return 0
+
+    if args.no_baseline:
+        new = violations
+    else:
+        baseline = tmlint.load_baseline(args.baseline)
+        new = tmlint.new_violations(violations, baseline)
+
+    for v in new:
+        print(v.render())
+
+    if args.stats:
+        per_rule: dict = {}
+        for v in violations:
+            per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+        print(
+            f"-- {len(violations)} total violations "
+            f"({len(new)} new), {elapsed:.2f}s --"
+        )
+        for rid in sorted(per_rule):
+            print(f"   {rid}: {per_rule[rid]}")
+
+    if new:
+        print(
+            f"\n{len(new)} new violation(s). Fix them, add a justified "
+            "`# tmlint: disable=<rule>` suppression, or (for accepted "
+            "debt) run scripts/lint.py --baseline-update.",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""Correctness tooling: tmlint (AST static analyzer) + lockwatch
+(runtime lock-order observer).
+
+The reference enforces its concurrency and determinism invariants
+mechanically — `go test -race` in CI plus `go vet` on every target.
+This package is the reproduction's equivalent, built for THIS
+codebase's hazard surface:
+
+- `tmlint` — stdlib-`ast` static rules over three invariant classes:
+  determinism of consensus-critical byte streams (sign-bytes, hashes,
+  proto encodings must be replica-identical), lock discipline in the
+  threaded device path, and device hygiene on the JAX hot path
+  (implicit host syncs, recompile-forcing shape leaks). Run via
+  `python scripts/lint.py`; gated in tier-1 by tests/test_lint.py.
+
+- `lockwatch` — wraps `threading.Lock`/`RLock` during tests, records
+  the per-thread lock-acquisition graph, and reports ordering cycles
+  (Go-lockrank style), rank-table violations, and holds that exceed
+  the fast-path budget. Enabled for the chaos/fault/fuzz suites by an
+  autouse conftest fixture.
+
+docs/static_analysis.md has the rule catalog, baseline workflow, and
+suppression policy.
+"""
+
+from . import lockwatch, tmlint  # noqa: F401
+
+__all__ = ["tmlint", "lockwatch"]

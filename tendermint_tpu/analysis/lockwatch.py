@@ -1,0 +1,449 @@
+"""lockwatch — runtime lock-order observer (the `-race` analog tmlint
+can't be).
+
+Static rules can prove a mutation is inside *a* lock; they cannot
+prove two threads take two locks in a consistent *order*. The
+reference solves this with Go's race detector plus a hand-maintained
+lockrank table; this module is the same idea sized for this codebase:
+
+- every watched lock acquisition records an edge `held -> acquiring`
+  in a process-global directed graph, keyed by lock *name* (creation
+  site), with the first witnessing thread and location kept for the
+  report;
+- `cycles()` finds ordering cycles in that graph — a witnessed
+  A->B edge in one thread plus B->A in another is a latent deadlock
+  even if the run happened not to interleave them fatally;
+- `RANK` is the declared order (Go-lockrank style) for the crypto
+  path's named locks; `order_violations()` reports witnessed edges
+  that contradict it;
+- holds longer than the fast-path budget (`TM_TPU_LOCKWATCH_BUDGET_S`,
+  default 0.25 s) are recorded — consensus must never park behind a
+  slow device interaction holding a shared lock.
+
+Instrumentation has two halves, because locks are born two ways:
+
+- `instrument_creation(module)` swaps the module's `threading`
+  reference for a proxy whose Lock()/RLock() return watched locks —
+  catches locks created *during* the test (e.g. per-CircuitBreaker
+  instance locks, rebuilt every test by the breaker-reset fixture);
+- `instrument_attr(module, attr, name)` wraps a module-level lock
+  that already exists at import time (sigcache._lock,
+  tpu_verifier._wedged_lock, breaker._REG_LOCK).
+
+`enable()` applies both to the known crypto-path modules and
+`disable()` restores them, returning a `Report`. tests/conftest.py
+turns this on (autouse) for the chaos/fault/fuzz suites and asserts
+zero cycles and zero rank violations at teardown.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+__all__ = [
+    "LockWatch",
+    "Report",
+    "RANK",
+    "enable",
+    "disable",
+    "active",
+    "instrument_creation",
+    "instrument_attr",
+]
+
+DEFAULT_HOLD_BUDGET_S = 0.25
+
+
+def _hold_budget() -> float:
+    try:
+        return float(os.environ.get("TM_TPU_LOCKWATCH_BUDGET_S", ""))
+    except ValueError:
+        return DEFAULT_HOLD_BUDGET_S
+
+
+# The declared acquisition order for the crypto path's named locks
+# (lower rank first). Proven acyclic by running the chaos and fault
+# suites under lockwatch; the witnessed edges are a subset of this
+# partial order:
+#
+#   breaker.registry -> breaker.instance  (fresh() retires the old
+#       instance's probe timer under _REG_LOCK)
+#   breaker.registry -> metrics.metric    (CircuitBreaker.__init__
+#       publishes its state gauge while breaker_for holds _REG_LOCK)
+#   breaker.instance -> metrics.metric    (state transitions publish
+#       gauges/counters under the instance lock)
+#   sigcache.rotate  -> metrics.metric    (_rotate bumps the eviction
+#       counter under the rotation lock)
+#   trace.ring       -> metrics.metric    (span close feeds latency
+#       histograms while appending to the ring)
+#   tpu_verifier.wedged and metrics.* are leaves: nothing is acquired
+#   while they are held.
+RANK: Dict[str, int] = {
+    "breaker.registry": 10,
+    "breaker.instance": 20,
+    "sigcache.rotate": 30,
+    "trace.ring": 35,
+    "tpu_verifier.wedged": 40,
+    "metrics.metric": 50,
+    "metrics.registry": 55,
+}
+
+
+class Report:
+    """Frozen result of one watch window."""
+
+    def __init__(
+        self,
+        edges: Dict[Tuple[str, str], dict],
+        long_holds: List[dict],
+        acquisitions: int,
+    ) -> None:
+        self.edges = edges
+        self.long_holds = long_holds
+        self.acquisitions = acquisitions
+        self.cycles = _find_cycles(set(edges))
+
+    def order_violations(
+        self, rank: Optional[Dict[str, int]] = None
+    ) -> List[dict]:
+        rank = RANK if rank is None else rank
+        out = []
+        for (a, b), info in sorted(self.edges.items()):
+            ra, rb = rank.get(a), rank.get(b)
+            if ra is not None and rb is not None and ra > rb:
+                out.append({"edge": (a, b), "rank": (ra, rb), **info})
+        return out
+
+    def render(self) -> str:
+        lines = [
+            f"lockwatch: {self.acquisitions} acquisitions, "
+            f"{len(self.edges)} distinct edges"
+        ]
+        for cyc in self.cycles:
+            lines.append("  CYCLE: " + " -> ".join(cyc + [cyc[0]]))
+        for v in self.order_violations():
+            a, b = v["edge"]
+            lines.append(
+                f"  RANK VIOLATION: {a} (rank {v['rank'][0]}) held while "
+                f"acquiring {b} (rank {v['rank'][1]}) at {v['where']}"
+            )
+        for h in self.long_holds:
+            lines.append(
+                f"  LONG HOLD: {h['name']} held {h['held_s']:.3f}s "
+                f"(budget {h['budget_s']:.3f}s) by {h['thread']}"
+            )
+        return "\n".join(lines)
+
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Simple cycles in the witnessed-order graph (includes self-loops:
+    two distinct instances of the same lock class acquired nested is
+    reported as name->name). Colored DFS; each cycle reported once."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in sorted(edges):
+        graph.setdefault(a, []).append(b)
+    seen_cycles: List[List[str]] = []
+    found: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+        for nxt in graph.get(node, ()):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):]
+                # canonical rotation so each cycle reports once
+                i = cyc.index(min(cyc))
+                canon = tuple(cyc[i:] + cyc[:i])
+                if canon not in found:
+                    found.add(canon)
+                    seen_cycles.append(list(canon))
+            else:
+                on_stack.add(nxt)
+                stack.append(nxt)
+                dfs(nxt, stack, on_stack)
+                stack.pop()
+                on_stack.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return seen_cycles
+
+
+class LockWatch:
+    """The recording core. Thread-safe; all graph state behind one
+    internal (unwatched) lock."""
+
+    def __init__(self, hold_budget_s: Optional[float] = None) -> None:
+        self.hold_budget_s = (
+            _hold_budget() if hold_budget_s is None else hold_budget_s
+        )
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        self._long_holds: List[dict] = []
+        self._acquisitions = 0
+
+    # -- per-thread held stack --
+
+    def _stack(self) -> List[list]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def on_acquired(self, name: str, where: str) -> None:
+        st = self._stack()
+        held = [h[0] for h in st]
+        with self._mu:
+            self._acquisitions += 1
+            # a held->acquiring edge per lock currently held. h == name
+            # is NOT skipped: RLock reentry is filtered by the caller,
+            # so a same-name edge means two *instances* of one lock
+            # class nested — a real instance-order hazard, reported as
+            # a self-loop cycle.
+            for h in held:
+                edge = (h, name)
+                if edge not in self._edges:
+                    self._edges[edge] = {
+                        "where": where,
+                        "thread": threading.current_thread().name,
+                    }
+        st.append([name, time.monotonic()])
+
+    def on_released(self, name: str) -> None:
+        st = self._stack()
+        # release is not always LIFO (Condition.wait releases from the
+        # middle): pop the most recent entry with this name
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == name:
+                _, t0 = st.pop(i)
+                held = time.monotonic() - t0
+                if held > self.hold_budget_s:
+                    with self._mu:
+                        self._long_holds.append(
+                            {
+                                "name": name,
+                                "held_s": held,
+                                "budget_s": self.hold_budget_s,
+                                "thread": threading.current_thread().name,
+                            }
+                        )
+                return
+
+    def report(self) -> Report:
+        with self._mu:
+            return Report(
+                dict(self._edges),
+                list(self._long_holds),
+                self._acquisitions,
+            )
+
+
+class _WatchedLock:
+    """Wraps one real lock. Proxies the full Lock/RLock surface so it
+    can stand in anywhere (including inside threading.Condition);
+    records only *successful* acquisitions. Recording routes through
+    the process's ACTIVE watch when one exists, falling back to the
+    bound one (direct unit-test use): a proxy-created lock that
+    outlives its window (an object registered process-globally during
+    a watched test) then reports into the next window instead of a
+    dead report."""
+
+    def __init__(self, watch: LockWatch, inner, name: str) -> None:
+        self._watch = watch
+        self._inner = inner
+        self._name = name
+        self._reentrant = hasattr(inner, "_is_owned") or type(
+            inner
+        ).__name__ in ("RLock", "_RLock")
+        self._owner: Optional[int] = None
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._reentrant and self._owner == me:
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                self._depth += 1
+            return ok
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._owner = me
+            self._depth = 1
+            where = _caller()
+            (_ACTIVE or self._watch).on_acquired(self._name, where)
+        return ok
+
+    def release(self) -> None:
+        if self._reentrant and self._depth > 1:
+            self._depth -= 1
+            self._inner.release()
+            return
+        self._owner = None
+        self._depth = 0
+        self._inner.release()
+        # release may land in a different window than the acquire;
+        # on_released pops by name and no-ops when it isn't found
+        (_ACTIVE or self._watch).on_released(self._name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, item):  # _at_fork_reinit, _is_owned, ...
+        return getattr(self._inner, item)
+
+    def __repr__(self) -> str:
+        return f"<lockwatch {self._name} wrapping {self._inner!r}>"
+
+
+def _caller() -> str:
+    """file:line of the acquisition site outside this module."""
+    f = sys._getframe(2)
+    here = os.path.dirname(__file__)
+    while f is not None and f.f_code.co_filename.startswith(here):
+        f = f.f_back
+    if f is None:  # pragma: no cover
+        return "?"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+class _ThreadingProxy:
+    """Stands in for a module's `threading` global: Lock()/RLock()
+    return watched locks named by a `namer` over the creating frame
+    (one name per lock *class*, exactly how Go ranks lock classes,
+    not instances); everything else delegates to real threading —
+    Timer/Thread/Event keep their unwatched internals."""
+
+    def __init__(
+        self, watch: LockWatch, namer: Callable[..., str]
+    ) -> None:
+        self._watch = watch
+        self._namer = namer
+
+    def _name(self) -> str:
+        f = sys._getframe(2)
+        owner = type(f.f_locals.get("self", None)).__name__
+        site = f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+        return self._namer(owner, site)
+
+    def Lock(self):
+        return _WatchedLock(self._watch, threading.Lock(), self._name())
+
+    def RLock(self):
+        return _WatchedLock(self._watch, threading.RLock(), self._name())
+
+    def __getattr__(self, item):
+        return getattr(threading, item)
+
+
+# -- module instrumentation -------------------------------------------------
+
+_ACTIVE: Optional[LockWatch] = None
+_UNDO: List[Callable[[], None]] = []
+# guards _UNDO and enable/disable transitions (instrumentation is
+# driven from the test main thread, but the lint tool holds itself to
+# its own lock-global-mutation rule)
+_undo_lock = threading.Lock()
+
+
+def active() -> Optional[LockWatch]:
+    return _ACTIVE
+
+
+def instrument_creation(
+    watch: LockWatch, module, namer: Optional[Callable[..., str]] = None
+) -> None:
+    """Future Lock()/RLock() calls inside `module` produce watched
+    locks. `namer(owner_class_name, site)` maps a creation to its
+    stable rank-table name; default names by creation site."""
+    if getattr(module, "threading", None) is None:
+        raise ValueError(f"{module.__name__} has no `threading` global")
+    orig = module.threading
+    module.threading = _ThreadingProxy(
+        watch, namer or (lambda owner, site: site)
+    )
+    with _undo_lock:
+        _UNDO.append(lambda: setattr(module, "threading", orig))
+
+
+def instrument_attr(watch: LockWatch, obj, attr: str, name: str) -> None:
+    """Wrap a lock that already exists as `obj.attr` (module-level
+    locks, but also per-object locks born before the window — e.g.
+    DEFAULT_REGISTRY's import-time metric instruments)."""
+    inner = getattr(obj, attr)
+    if isinstance(inner, _WatchedLock):  # already watched
+        return
+    setattr(obj, attr, _WatchedLock(watch, inner, name))
+    with _undo_lock:
+        _UNDO.append(lambda: setattr(obj, attr, inner))
+
+
+def enable(hold_budget_s: Optional[float] = None) -> LockWatch:
+    """Instrument the crypto-path modules and start recording. Import
+    is deferred so `analysis` never drags the jax stack in."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        return _ACTIVE
+    watch = LockWatch(hold_budget_s)
+
+    from ..crypto import breaker, sigcache, tpu_verifier
+    from ..libs import metrics, trace
+
+    # locks created during the watch window: per-CircuitBreaker
+    # instance locks are rebuilt every test by the breaker-reset
+    # fixture, per-Metric/Registry locks by any new registry. Named
+    # by owning class, not creation line, so edits don't break ranks.
+    instrument_creation(
+        watch,
+        breaker,
+        namer=lambda owner, site: (
+            "breaker.instance" if owner == "CircuitBreaker" else site
+        ),
+    )
+    instrument_creation(
+        watch,
+        metrics,
+        namer=lambda owner, site: (
+            "metrics.registry" if owner == "Registry" else "metrics.metric"
+        ),
+    )
+    # module-level locks that already exist at import time
+    instrument_attr(watch, breaker, "_REG_LOCK", "breaker.registry")
+    instrument_attr(watch, sigcache, "_lock", "sigcache.rotate")
+    instrument_attr(watch, tpu_verifier, "_wedged_lock", "tpu_verifier.wedged")
+    instrument_attr(watch, trace, "_ring_lock", "trace.ring")
+    # DEFAULT_REGISTRY's instruments (breaker gauges, sigcache/tpu
+    # counters) were created at import, long before any window — wrap
+    # their per-metric locks in place so the RANK-documented
+    # *->metrics.metric edges are actually witnessed, not assumed
+    instrument_attr(watch, metrics.DEFAULT_REGISTRY, "_lock", "metrics.registry")
+    for m in list(metrics.DEFAULT_REGISTRY._metrics.values()):
+        instrument_attr(watch, m, "_lock", "metrics.metric")
+
+    _ACTIVE = watch
+    return watch
+
+
+def disable() -> Report:
+    """Restore every instrumented module and return the report."""
+    global _ACTIVE
+    watch = _ACTIVE
+    _ACTIVE = None
+    while True:
+        with _undo_lock:
+            if not _UNDO:
+                break
+            undo = _UNDO.pop()
+        undo()
+    if watch is None:
+        return Report({}, [], 0)
+    return watch.report()

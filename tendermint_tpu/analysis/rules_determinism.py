@@ -1,0 +1,276 @@
+"""Determinism rules: consensus-critical byte streams must be
+replica-identical.
+
+Every rule here protects the same invariant: the bytes a validator
+signs (`types/canonical.py` sign-bytes), the hashes it computes
+(`crypto/merkle.py`, `crypto/tmhash.py`, header/commit hashes in
+`types/`), and the proto encodings it gossips (`encoding/proto.py`)
+must come out byte-identical on every replica, every run, every
+platform — or replicas sign conflicting byte streams and the chain
+forks or halts (SURVEY.md "Determinism & safety"; the EdDSA-in-
+committee-consensus batching literature assumes the same property).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from .tmlint import (
+    Module,
+    Rule,
+    Violation,
+    dotted_name,
+    is_consensus_critical,
+    is_replay_scope,
+    register,
+)
+
+# wall-clock reads: each replica gets a different answer, so any use
+# in a hash/sign-bytes input diverges replicas instantly
+_WALLCLOCK = {
+    "time.time",
+    "time.time_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.today",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+    "date.today",
+}
+
+# the global (unseeded / OS-entropy) randomness surface
+_RANDOM_MODULE_FNS = {
+    "random",
+    "choice",
+    "choices",
+    "shuffle",
+    "sample",
+    "randint",
+    "randrange",
+    "getrandbits",
+    "uniform",
+    "betavariate",
+    "gauss",
+    "normalvariate",
+    "expovariate",
+    "triangular",
+    "randbytes",
+}
+_ENTROPY_CALLS = {
+    "os.urandom",
+    "uuid.uuid1",
+    "uuid.uuid4",
+    "secrets.token_bytes",
+    "secrets.token_hex",
+    "secrets.randbits",
+    "secrets.choice",
+}
+
+
+def _resolved_call_name(mod: Module, node: ast.Call) -> str:
+    """The call target as a dotted module path, resolving from-imports:
+    `time.time()` and `from time import time as now; now()` both
+    resolve to 'time.time' — the lint gate must not be evadable by
+    import style."""
+    name = dotted_name(node.func)
+    if name and "." not in name:
+        orig = mod.from_import_orig.get(name)
+        if orig is not None:
+            return f"{orig[0]}.{orig[1]}"
+    return name
+
+
+@register
+class DetWallclock(Rule):
+    id = "det-wallclock"
+    title = "wall-clock read in a consensus-critical module"
+    rationale = (
+        "time.time()/datetime.now() differ across replicas; a "
+        "wall-clock value flowing into sign-bytes or a hash forks the "
+        "chain. Protocol-required timestamps (BFT time) must come in "
+        "through the one blessed entry point (types/timestamp.now_ns) "
+        "or a suppressed, justified site."
+    )
+
+    def applies(self, mod: Module) -> bool:
+        return is_consensus_critical(mod.path)
+
+    def check(self, mod: Module) -> Iterator[Violation]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _resolved_call_name(mod, node)
+            if name in _WALLCLOCK:
+                yield self.violation(
+                    mod,
+                    node,
+                    f"wall-clock read `{name}()` in a consensus-critical "
+                    "module; replicas will disagree — plumb the value in "
+                    "from the caller or use the blessed timestamp entry "
+                    "point",
+                )
+
+
+@register
+class DetRandom(Rule):
+    id = "det-random"
+    title = "unseeded/global randomness in replay-critical code"
+    rationale = (
+        "The module-global `random.*` functions and OS entropy "
+        "(os.urandom, uuid4, secrets) are unseeded: consensus-critical "
+        "uses fork replicas, and uses anywhere in the message-driven "
+        "state machines (consensus/, blocksync/, statesync/) break "
+        "seed-exact schedulefuzz replay. Use an injected "
+        "`random.Random(seed)` — gossip picks go through "
+        "libs/rng.py's seedable instance."
+    )
+
+    def applies(self, mod: Module) -> bool:
+        return is_replay_scope(mod.path)
+
+    def check(self, mod: Module) -> Iterator[Violation]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _resolved_call_name(mod, node)
+            if not name:
+                continue
+            if name in _ENTROPY_CALLS:
+                yield self.violation(
+                    mod,
+                    node,
+                    f"OS-entropy call `{name}()` in replay-critical code; "
+                    "not reproducible from a seed",
+                )
+                continue
+            parts = name.split(".")
+            # `random.choice(...)` / `_random.shuffle(...)` — the
+            # module-global unseeded RNG under its conventional import
+            # names. Instance calls (`rng.choice`, `self.rng.choice`,
+            # `GOSSIP.choice`) are the approved pattern and don't match.
+            if (
+                len(parts) == 2
+                and parts[0] in ("random", "_random")
+                and parts[1] in _RANDOM_MODULE_FNS
+            ):
+                yield self.violation(
+                    mod,
+                    node,
+                    f"unseeded global RNG call `{name}()`; route through "
+                    "an injectable seeded random.Random (libs/rng.py) so "
+                    "fuzz failures replay from their seed",
+                )
+
+
+@register
+class DetFloat(Rule):
+    id = "det-float"
+    title = "float arithmetic in a consensus-critical module"
+    rationale = (
+        "IEEE-754 results vary with evaluation order, compiler, and "
+        "platform; a float flowing into sign-bytes/hash/encode input "
+        "is nondeterministic across the fleet. Consensus math is "
+        "integer math (nanoseconds, not fractional seconds)."
+    )
+
+    def applies(self, mod: Module) -> bool:
+        return is_consensus_critical(mod.path)
+
+    def check(self, mod: Module) -> Iterator[Violation]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, float
+            ):
+                yield self.violation(
+                    mod,
+                    node,
+                    f"float literal `{node.value!r}` in a "
+                    "consensus-critical module",
+                )
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.Div
+            ):
+                yield self.violation(
+                    mod,
+                    node,
+                    "true division `/` produces a float; use `//` "
+                    "integer division in consensus-critical code",
+                )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name == "float":
+                    yield self.violation(
+                        mod,
+                        node,
+                        "float() conversion in a consensus-critical module",
+                    )
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in ("set", "frozenset")
+    return False
+
+
+@register
+class DetSetIter(Rule):
+    id = "det-set-iter"
+    title = "unordered set iteration in a consensus-critical module"
+    rationale = (
+        "CPython set iteration order depends on element hashes — for "
+        "str/bytes keys that's randomized per process "
+        "(PYTHONHASHSEED), so two replicas walking the same set feed "
+        "their hash/sign-bytes/encode functions different byte "
+        "orders. Iterate `sorted(s)` or keep an ordered structure "
+        "(dicts preserve insertion order and are fine)."
+    )
+
+    def applies(self, mod: Module) -> bool:
+        return is_consensus_critical(mod.path)
+
+    def check(self, mod: Module) -> Iterator[Violation]:
+        # names bound to set expressions, per enclosing function (or
+        # module scope for top-level code)
+        set_names: dict = {}  # scope node -> set of names
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and _is_set_expr(node.value):
+                scope = mod.enclosing_function(node) or mod.tree
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        set_names.setdefault(scope, set()).add(tgt.id)
+
+        def iter_is_set(it: ast.AST, at: ast.AST) -> bool:
+            if _is_set_expr(it):
+                return True
+            if isinstance(it, ast.Name):
+                scope = mod.enclosing_function(at) or mod.tree
+                return it.id in set_names.get(scope, ())
+            return False
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                if iter_is_set(node.iter, node):
+                    yield self.violation(
+                        mod,
+                        node,
+                        "iterating a set in a consensus-critical module; "
+                        "order is hash-dependent — iterate sorted(...) "
+                        "instead",
+                    )
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+            ):
+                for gen in node.generators:
+                    if iter_is_set(gen.iter, node):
+                        yield self.violation(
+                            mod,
+                            node,
+                            "comprehension over a set in a "
+                            "consensus-critical module; order is "
+                            "hash-dependent — iterate sorted(...) instead",
+                        )

@@ -1,0 +1,149 @@
+"""Device-hygiene rules for the JAX hot path.
+
+The throughput story (PERF.md) depends on two properties of the
+dispatch path: the host never *implicitly* blocks on the device (the
+gather is the one deliberate sync point, guarded by a deadline
+watchdog), and program shapes stay inside the padded bucket set so
+XLA never recompiles mid-round. Both properties die silently — an
+`.item()` in a loop or a Python-int shape argument works fine and
+just makes the hot path 100x slower — so they're lint rules, not
+review notes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .tmlint import Module, Rule, Violation, dotted_name, is_device_scope, register
+
+_NP_TRANSFER = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "onp.asarray",
+    "onp.array",
+}
+
+_JNP_SHAPED_CTORS = {
+    "jnp.zeros",
+    "jnp.ones",
+    "jnp.full",
+    "jnp.empty",
+    "jnp.arange",
+    "jax.numpy.zeros",
+    "jax.numpy.ones",
+    "jax.numpy.full",
+    "jax.numpy.empty",
+    "jax.numpy.arange",
+}
+
+
+def _is_static_shape(node: ast.AST) -> bool:
+    """Shape arguments that cannot leak a per-call Python scalar:
+    constants, tuples/lists of constants, attribute reads (self.BUCKET,
+    cls.SIZE) and SCREAMING_CASE names — configuration, not data."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_static_shape(e) for e in node.elts)
+    if isinstance(node, ast.Attribute):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id == node.id.upper()
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_shape(node.operand)
+    return False
+
+
+@register
+class DevHostSync(Rule):
+    id = "dev-host-sync"
+    title = "implicit device→host sync on the JAX hot path"
+    rationale = (
+        "`.item()`, `float(device_val)`, and np.asarray/np.array on a "
+        "device array each block the host until the device catches "
+        "up, serializing the async dispatch pipeline that overlaps "
+        "host assembly with device compute. The gather is the ONE "
+        "deliberate sync point (deadline-guarded); any other sync is "
+        "either a bug or needs a suppression naming why it's "
+        "host-side data."
+    )
+
+    def applies(self, mod: Module) -> bool:
+        return is_device_scope(mod.path)
+
+    def check(self, mod: Module) -> Iterator[Violation]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+                and not node.keywords
+            ):
+                yield self.violation(
+                    mod,
+                    node,
+                    "`.item()` forces a blocking device→host transfer; "
+                    "gather whole arrays at the deliberate sync point "
+                    "instead",
+                )
+            elif name == "float" and node.args and not isinstance(
+                node.args[0], ast.Constant
+            ):
+                yield self.violation(
+                    mod,
+                    node,
+                    "float(x) on a non-literal blocks if x is a device "
+                    "value; keep scalars on device or convert at the "
+                    "gather",
+                )
+            elif name in _NP_TRANSFER:
+                yield self.violation(
+                    mod,
+                    node,
+                    f"`{name}(...)` copies through host memory and "
+                    "synchronizes if handed a device array; use jnp ops "
+                    "or move the conversion to the gather",
+                )
+
+
+@register
+class DevShapeLeak(Rule):
+    id = "dev-shape-leak"
+    title = "dynamic Python shape argument forces XLA recompiles"
+    rationale = (
+        "jnp.zeros(n)/arange(n) with a per-call Python int compiles "
+        "one XLA program per distinct n — a mid-round recompile costs "
+        "more than the whole batch saves. Shapes must come from the "
+        "padded bucket configuration (constants / class attributes), "
+        "never from data-dependent scalars like len(batch)."
+    )
+
+    def applies(self, mod: Module) -> bool:
+        return is_device_scope(mod.path)
+
+    def check(self, mod: Module) -> Iterator[Violation]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in _JNP_SHAPED_CTORS:
+                continue
+            if not node.args:
+                continue
+            shape = node.args[0]
+            if _is_static_shape(shape):
+                continue
+            yield self.violation(
+                mod,
+                node,
+                f"`{name}` called with a dynamic shape argument "
+                f"(`{ast.unparse(shape)}`); every distinct value "
+                "compiles a new XLA program — pad to a configured "
+                "bucket size instead",
+            )

@@ -1,0 +1,426 @@
+"""tmlint — AST-based static analyzer for consensus invariants.
+
+BFT safety rests on every replica computing byte-identical sign-bytes
+and block hashes (SURVEY.md "Determinism & safety"): a replica whose
+hash input depends on wall-clock time, an unseeded RNG, float
+rounding, or set iteration order will sign a different byte stream
+than its peers and the network forks or halts. Those are
+consensus-failure bugs, not style issues — so they are enforced
+mechanically here, the way the reference leans on `go vet` and
+`go test -race`.
+
+Architecture:
+
+- A `Rule` inspects one parsed `Module` (AST + source lines +
+  precomputed parent links) and yields `Violation`s. Rules declare
+  their own path scope — determinism rules only fire in
+  consensus-critical modules, device rules only on the JAX hot path,
+  lock rules in any module that imports `threading`.
+- Per-line suppressions: `# tmlint: disable=<rule>[,<rule>...]` on
+  the offending line, or alone on the line directly above it. A
+  suppression is a reviewed, justified exception — the comment should
+  say why (docs/static_analysis.md has the policy).
+- A checked-in baseline (analysis/baseline.json) records accepted
+  pre-existing violations by content fingerprint (rule + path + the
+  offending source line's hash), so unrelated edits never shift it
+  and NEW violations fail while grandfathered ones pass.
+  `python scripts/lint.py --baseline-update` regenerates it.
+
+The analyzer is pure stdlib (`ast`, `json`, `hashlib`) and must stay
+fast: tests/test_lint.py budgets the full-package run at 10 s on CPU.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+__all__ = [
+    "Violation",
+    "Module",
+    "Rule",
+    "all_rules",
+    "rule_ids",
+    "check_source",
+    "check_file",
+    "check_package",
+    "load_baseline",
+    "save_baseline",
+    "baseline_counts",
+    "new_violations",
+    "package_root",
+    "BASELINE_PATH",
+]
+
+# ---------------------------------------------------------------------------
+# scopes
+
+# Modules whose output feeds sign-bytes / block hashes / proto
+# encodings directly: any nondeterminism here IS a consensus fork.
+CONSENSUS_CRITICAL_PREFIXES = ("types/", "encoding/")
+CONSENSUS_CRITICAL_FILES = {
+    "crypto/merkle.py",
+    "crypto/tmhash.py",
+    "consensus/state.py",
+}
+
+# Message-driven state machines replayed by the schedulefuzz suites:
+# an unseeded global RNG here breaks seed-exact replay of a failure.
+REPLAY_PREFIXES = ("consensus/", "blocksync/", "statesync/")
+
+# The JAX device hot path: implicit host syncs and recompile-forcing
+# shape leaks hide here.
+DEVICE_FILES = {"crypto/batch.py", "crypto/tpu_verifier.py"}
+DEVICE_PREFIXES = ("parallel/",)
+
+
+def is_consensus_critical(path: str) -> bool:
+    return path in CONSENSUS_CRITICAL_FILES or path.startswith(
+        CONSENSUS_CRITICAL_PREFIXES
+    )
+
+
+def is_replay_scope(path: str) -> bool:
+    return is_consensus_critical(path) or path.startswith(REPLAY_PREFIXES)
+
+
+def is_device_scope(path: str) -> bool:
+    return path in DEVICE_FILES or path.startswith(DEVICE_PREFIXES)
+
+
+# ---------------------------------------------------------------------------
+# data model
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # posix path relative to the package root
+    line: int
+    col: int
+    message: str
+    source: str = ""  # stripped offending source line (fingerprint input)
+
+    def fingerprint(self) -> str:
+        """Content-addressed identity: stable across unrelated edits
+        (line numbers don't participate), distinct per offending line
+        text. Identical lines in one file share a fingerprint and are
+        baseline-counted, so duplicating a grandfathered bad line is
+        still caught as new."""
+        h = hashlib.sha1(
+            self.source.strip().encode("utf-8", "replace")
+        ).hexdigest()[:12]
+        return f"{self.rule}:{self.path}:{h}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"[{self.rule}] {self.message}"
+        )
+
+
+_SUPPRESS_RE = re.compile(r"#\s*tmlint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+class Module:
+    """One parsed source file plus the per-module indexes every rule
+    needs: source lines, suppression map, parent links, and the
+    imported-module set (lock rules scope on `import threading`)."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.imports: Set[str] = set()
+        self.from_imports: Dict[str, str] = {}  # local name -> module
+        # local name -> (module, original name): lets rules match
+        # `from time import time as now` as time.time
+        self.from_import_orig: Dict[str, tuple] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports.add(a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                self.imports.add(node.module.split(".")[0])
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.from_imports[local] = node.module
+                    self.from_import_orig[local] = (node.module, a.name)
+        self.suppressed: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self.suppressed.setdefault(i, set()).update(rules)
+            # a suppression inside a comment block also covers the
+            # first code line below it — justification comments are
+            # encouraged to span several lines
+            if text.lstrip().startswith("#"):
+                j = i + 1
+                while j <= len(self.lines) and (
+                    not self.lines[j - 1].strip()
+                    or self.lines[j - 1].lstrip().startswith("#")
+                ):
+                    j += 1
+                if j <= len(self.lines):
+                    self.suppressed.setdefault(j, set()).update(rules)
+
+    @property
+    def imports_threading(self) -> bool:
+        return "threading" in self.imports
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def is_suppressed(self, rule_id: str, lineno: int) -> bool:
+        rules = self.suppressed.get(lineno)
+        return bool(rules) and (rule_id in rules or "all" in rules)
+
+    def enclosing(self, node: ast.AST, *types) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, types):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_function(self, node: ast.AST):
+        return self.enclosing(
+            node, ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda
+        )
+
+
+class Rule:
+    """One invariant check. Subclasses set `id`, `title`, `rationale`
+    (surfaced by --list-rules and the docs catalog) and implement
+    `applies()` + `check()`."""
+
+    id = ""
+    title = ""
+    rationale = ""
+
+    def applies(self, mod: Module) -> bool:
+        raise NotImplementedError
+
+    def check(self, mod: Module) -> Iterator[Violation]:
+        raise NotImplementedError
+
+    def violation(self, mod: Module, node: ast.AST, message: str) -> Violation:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Violation(
+            rule=self.id,
+            path=mod.path,
+            line=lineno,
+            col=col,
+            message=message,
+            source=mod.line_text(lineno).strip(),
+        )
+
+
+def dotted_name(node: ast.AST) -> str:
+    """`a.b.c` for Name/Attribute chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# registry + runner
+
+_RULES: List[Rule] = []
+
+
+def register(rule_cls):
+    _RULES.append(rule_cls())
+    return rule_cls
+
+
+def all_rules() -> List[Rule]:
+    if not _RULES:  # pragma: no cover - import cycle guard
+        raise RuntimeError("rule modules not imported")
+    return list(_RULES)
+
+
+def rule_ids() -> List[str]:
+    return [r.id for r in all_rules()]
+
+
+def select_rules(only: Optional[Sequence[str]]) -> List[Rule]:
+    rules = all_rules()
+    if not only:
+        return rules
+    wanted = set(only)
+    unknown = wanted - {r.id for r in rules}
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+    return [r for r in rules if r.id in wanted]
+
+
+def check_source(
+    source: str,
+    path: str,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    """Analyze one source string as if it lived at `path` (posix,
+    relative to the package root — the path drives rule scoping, which
+    is how the fixture tests exercise scoped rules on synthetic
+    files)."""
+    mod = Module(path, source)
+    out: List[Violation] = []
+    for rule in select_rules(rules):
+        if not rule.applies(mod):
+            continue
+        for v in rule.check(mod):
+            if not mod.is_suppressed(v.rule, v.line):
+                out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+def check_file(
+    abspath: str,
+    relpath: str,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    with open(abspath, "r", encoding="utf-8") as f:
+        source = f.read()
+    return check_source(source, relpath, rules)
+
+
+def package_root() -> str:
+    """The tendermint_tpu package directory (the default analysis
+    root)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def iter_py_files(root: str) -> Iterator[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        )
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def check_package(
+    root: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    root = root or package_root()
+    out: List[Violation] = []
+    for abspath in iter_py_files(root):
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        try:
+            out.extend(check_file(abspath, rel, rules))
+        except SyntaxError as e:  # pragma: no cover - broken tree
+            out.append(
+                Violation(
+                    rule="parse-error",
+                    path=rel,
+                    line=e.lineno or 1,
+                    col=e.offset or 0,
+                    message=f"could not parse: {e.msg}",
+                    source="",
+                )
+            )
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def baseline_counts(violations: Iterable[Violation]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        fp = v.fingerprint()
+        counts[fp] = counts.get(fp, 0) + 1
+    return counts
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, int]:
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("entries", {})
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def save_baseline(
+    violations: Iterable[Violation], path: Optional[str] = None
+) -> Dict[str, int]:
+    path = path or BASELINE_PATH
+    counts = baseline_counts(violations)
+    data = {
+        "version": 1,
+        "generated_by": "scripts/lint.py --baseline-update",
+        "note": (
+            "Accepted pre-existing violations, fingerprinted by "
+            "rule:path:sha1(source_line)[:12]. New violations are "
+            "anything over these counts. Do not hand-edit counts to "
+            "sneak a new violation in — fix it or suppress it with a "
+            "justified '# tmlint: disable=<rule>' comment."
+        ),
+        "entries": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return counts
+
+
+def new_violations(
+    violations: Sequence[Violation], baseline: Dict[str, int]
+) -> List[Violation]:
+    """Violations exceeding their fingerprint's baseline allowance.
+    When a fingerprint's current count is over budget, every
+    occurrence is reported (content-identical lines are
+    indistinguishable; the report notes the allowance)."""
+    counts = baseline_counts(violations)
+    out: List[Violation] = []
+    for v in violations:
+        fp = v.fingerprint()
+        allowed = baseline.get(fp, 0)
+        if counts[fp] > allowed:
+            if allowed:
+                v = dataclasses.replace(
+                    v,
+                    message=(
+                        f"{v.message} [{counts[fp]} occurrences, "
+                        f"baseline allows {allowed}]"
+                    ),
+                )
+            out.append(v)
+    return out
+
+
+# rule modules self-register on import; importing them here keeps
+# `import tmlint` sufficient for every caller (CLI, tests, conftest)
+from . import rules_determinism  # noqa: E402,F401
+from . import rules_device  # noqa: E402,F401
+from . import rules_locks  # noqa: E402,F401

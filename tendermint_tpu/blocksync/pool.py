@@ -9,11 +9,11 @@ H with the LastCommit carried in block H+1.
 from __future__ import annotations
 
 import asyncio
-import random
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Set, Tuple
 
+from ..libs import rng
 from ..libs.log import get_logger
 from ..libs.service import Service
 from ..types.block import Block
@@ -212,4 +212,4 @@ class BlockPool(Service):
         ]
         if not candidates:
             return None
-        return random.choice(candidates)
+        return rng.choice(candidates)

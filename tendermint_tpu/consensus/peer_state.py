@@ -8,11 +8,11 @@ messages and from everything we send the peer.
 
 from __future__ import annotations
 
-import random
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
+from ..libs import rng
 from ..libs.bits import BitArray
 from ..types.block_id import PartSetHeader
 from ..types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
@@ -228,5 +228,5 @@ class PeerState:
         candidates = list(missing.indices())
         if not candidates:
             return None
-        index = random.choice(candidates)
+        index = rng.choice(candidates)
         return votes.get_by_index(index)

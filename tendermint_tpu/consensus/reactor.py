@@ -14,6 +14,7 @@ from typing import Dict, Optional
 
 from ..config import ConsensusConfig
 from ..eventbus import EventBus
+from ..libs import rng
 from ..libs.log import get_logger
 from ..libs.service import Service
 from ..p2p.channel import Channel
@@ -458,13 +459,11 @@ class ConsensusReactor(Service):
                 await asyncio.sleep(0)  # yield
 
     def _pick_part_to_send(self, our_parts, peer_bits):
-        import random as _random
-
         missing = our_parts.parts_bit_array.sub(peer_bits)
         candidates = list(missing.indices())
         if not candidates:
             return None
-        return our_parts.get_part(_random.choice(candidates))
+        return our_parts.get_part(rng.choice(candidates))
 
     def _gossip_catchup_part(self, ps: PeerState) -> bool:
         """reference: reactor.go gossipDataForCatchup."""
@@ -499,9 +498,7 @@ class ConsensusReactor(Service):
                 ps.prs.proposal_block_parts = None
             return False
         ps.catchup_stall = 0
-        import random as _random
-
-        index = _random.choice(missing)
+        index = rng.choice(missing)
         part = self.cs.block_store.load_block_part(prs.height, index)
         if part is None:
             return False
@@ -615,8 +612,6 @@ class ConsensusReactor(Service):
         against the SAME bit array (_get_vote_bits), like the reference's
         PickSendVote — checking one array but marking another loops
         forever (reference: peer_state.go PickSendVote/SetHasVote)."""
-        import random as _random
-
         peer_bits = ps._get_vote_bits(
             commit.height, commit.round, PRECOMMIT_TYPE
         )
@@ -631,7 +626,7 @@ class ConsensusReactor(Service):
         ]
         if not missing:
             return False
-        index = _random.choice(missing)
+        index = rng.choice(missing)
         vote = commit.get_vote(index)
         return self._send_vote(ps, vote)
 

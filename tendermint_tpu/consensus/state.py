@@ -201,6 +201,9 @@ class ConsensusState(Service):
         if height == 1:
             height = state.initial_height
 
+        # tmlint: disable=det-wallclock — protocol-required: height
+        # start time derives from local clock + timeout_commit
+        # (reference: state.go updateToState)
         now_ns = time.time_ns()
         if rs.commit_time_ns == 0:
             start_time_ns = now_ns + int(self.cfg.timeout_commit * 1e9)
@@ -261,6 +264,8 @@ class ConsensusState(Service):
 
     def _schedule_round_0(self) -> None:
         """reference: state.go scheduleRound0."""
+        # tmlint: disable=det-wallclock — local timeout scheduling;
+        # never enters sign-bytes or hashes
         sleep_s = max(0.0, (self.rs.start_time_ns - time.time_ns()) / 1e9)
         self._schedule_timeout(
             sleep_s, self.rs.height, 0, RoundStep.NEW_HEIGHT
@@ -796,6 +801,8 @@ class ConsensusState(Service):
         )
         rs.step = RoundStep.COMMIT
         rs.commit_round = commit_round
+        # tmlint: disable=det-wallclock — local commit-time anchor
+        # for the next height's start (reference: state.go enterCommit)
         rs.commit_time_ns = time.time_ns()
         self._new_step()
 
@@ -1191,6 +1198,9 @@ class ConsensusState(Service):
     def _vote_time(self) -> int:
         """Monotonic vote time: now, but never before lastBlockTime+1ms
         (reference: state.go voteTime)."""
+        # tmlint: disable=det-wallclock — protocol-required vote
+        # timestamp (reference: state.go voteTime); monotonicity is
+        # enforced against lastBlockTime below
         now = time.time_ns()
         min_vote_time = now
         if self.state is not None and self.state.last_block_time_ns > 0:

@@ -10,6 +10,7 @@ default, exactly like the reference keeps pure-Go as the default.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Optional
 
 from .keys import BatchVerifier, PubKey
@@ -34,17 +35,24 @@ _DEVICE_FACTORIES: dict[
 def register_cpu_factory(
     key_type: str, factory: Callable[[], BatchVerifier]
 ) -> None:
+    # tmlint: disable=lock-global-mutation — single GIL-atomic dict
+    # write from import-time defaults / main-thread embedder setup
     _CPU_FACTORIES[key_type] = factory
 
 
 def register_device_factory(
     key_type: str, factory: Callable[[int], Optional[BatchVerifier]]
 ) -> None:
+    # tmlint: disable=lock-global-mutation — single GIL-atomic dict
+    # write from install(), a main-thread seam (PERF.md claim
+    # discipline keeps install off worker threads)
     _DEVICE_FACTORIES[key_type] = factory
 
 
 def unregister_device_factory(key_type: str) -> None:
     """Remove a device factory (tpu_verifier.uninstall's half)."""
+    # tmlint: disable=lock-global-mutation — single GIL-atomic pop
+    # from uninstall(), a main-thread test/embedder seam
     _DEVICE_FACTORIES.pop(key_type, None)
 
 
@@ -72,32 +80,54 @@ def cpu_factory(key_type: str) -> Optional[Callable[[], BatchVerifier]]:
 _GROUP_AFFINITY: Optional[int] = 1
 _GROUP_AFFINITY_FN: Optional[Callable[[], int]] = None
 _GROUP_AFFINITY_EXPLICIT = False
+# guards the affinity triple: group_affinity()'s lazy init is a
+# check-then-act on module state, and verify paths on probe threads
+# race the first consensus caller (tmlint: lock-global-mutation)
+_affinity_lock = threading.Lock()
 
 
 def set_group_affinity(n: int) -> None:
     """Operator override — wins over any install-provided default
     (set_group_affinity_fn will not replace it)."""
     global _GROUP_AFFINITY, _GROUP_AFFINITY_FN, _GROUP_AFFINITY_EXPLICIT
-    _GROUP_AFFINITY = max(1, int(n))
-    _GROUP_AFFINITY_FN = None
-    _GROUP_AFFINITY_EXPLICIT = True
+    with _affinity_lock:
+        _GROUP_AFFINITY = max(1, int(n))
+        _GROUP_AFFINITY_FN = None
+        _GROUP_AFFINITY_EXPLICIT = True
 
 
 def set_group_affinity_fn(fn: Callable[[], int]) -> None:
     """Defer the affinity decision until the first caller needs it.
     A no-op if an operator already pinned a value explicitly."""
     global _GROUP_AFFINITY, _GROUP_AFFINITY_FN
-    if _GROUP_AFFINITY_EXPLICIT:
-        return
-    _GROUP_AFFINITY = None
-    _GROUP_AFFINITY_FN = fn
+    with _affinity_lock:
+        if _GROUP_AFFINITY_EXPLICIT:
+            return
+        _GROUP_AFFINITY = None
+        _GROUP_AFFINITY_FN = fn
 
 
 def group_affinity() -> int:
     global _GROUP_AFFINITY
-    if _GROUP_AFFINITY is None:
-        _GROUP_AFFINITY = max(1, int(_GROUP_AFFINITY_FN()))
-    return _GROUP_AFFINITY
+    while True:
+        # consistent (value, fn) snapshot: all writers hold the lock
+        with _affinity_lock:
+            value = _GROUP_AFFINITY
+            fn = _GROUP_AFFINITY_FN
+        if value is not None:
+            return value
+        # resolve the deferred fn OUTSIDE the lock: it may initialize
+        # the jax backend (slow, possibly wedged) and must never park
+        # every verify path behind one device claim
+        computed = max(1, int(fn())) if fn is not None else 1
+        with _affinity_lock:
+            if _GROUP_AFFINITY is not None:
+                return _GROUP_AFFINITY
+            if _GROUP_AFFINITY_FN is fn:
+                _GROUP_AFFINITY = computed
+                return computed
+            # the fn changed while we computed (install landed mid-
+            # flight) — loop and resolve the new one
 
 
 def group_affinity_state() -> tuple:
@@ -110,7 +140,8 @@ def group_affinity_state() -> tuple:
 
 def restore_group_affinity(state: tuple) -> None:
     global _GROUP_AFFINITY, _GROUP_AFFINITY_FN, _GROUP_AFFINITY_EXPLICIT
-    _GROUP_AFFINITY, _GROUP_AFFINITY_FN, _GROUP_AFFINITY_EXPLICIT = state
+    with _affinity_lock:
+        _GROUP_AFFINITY, _GROUP_AFFINITY_FN, _GROUP_AFFINITY_EXPLICIT = state
 
 
 def supports_batch_verifier(pk: Optional[PubKey]) -> bool:

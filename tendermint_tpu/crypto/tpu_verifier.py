@@ -150,6 +150,8 @@ def _note_bucket_warmth(key_type: str, verifier, bucket: int) -> bool:
     if key in _WARM_BUCKETS:
         _m_warm_hits.inc()
         return True
+    # tmlint: disable=lock-global-mutation — telemetry-only set;
+    # a racing probe thread at worst double-counts one warm miss
     _WARM_BUCKETS.add(key)
     _m_warm_misses.inc()
     return False
@@ -234,7 +236,7 @@ def gather_deadline() -> Optional[float]:
     if env is not None:
         if _DEADLINE_CACHE[0] != env:
             try:
-                dl = float(env)
+                dl = float(env)  # tmlint: disable=dev-host-sync — env-var string, host data
             except ValueError:
                 dl = DEFAULT_GATHER_DEADLINE_S
             _DEADLINE_CACHE = (env, dl if dl > 0 else None)
@@ -814,6 +816,8 @@ def _probe_triple(key_type: str) -> tuple:
         priv = Priv.from_seed(b"\x77" * 32)
         msg = b"breaker-probe-" + key_type.encode()
         cached = (priv.pub_key().bytes(), msg, priv.sign(msg))
+        # tmlint: disable=lock-global-mutation — idempotent memo;
+        # racing fills compute byte-identical values
         _PROBE_TRIPLES[key_type] = cached
     return cached
 
@@ -900,7 +904,10 @@ def install(
         new_sr = None
     _SHARED_VERIFIER = new_ed
     _SHARED_VERIFIER_SR = new_sr
-    _WARM_BUCKETS.clear()  # new generation: every bucket is cold again
+    # new generation: every bucket is cold again
+    # tmlint: disable=lock-global-mutation — install() runs on the
+    # startup/main thread before traffic
+    _WARM_BUCKETS.clear()
     b_ed = _breaker_mod.fresh("ed25519")
     b_ed.set_probe(lambda: _device_probe("ed25519", _ed_backing))
     b_sr = _breaker_mod.fresh("sr25519")
@@ -953,6 +960,8 @@ def uninstall() -> None:
     unregister_device_factory("sr25519")
     _SHARED_VERIFIER = None
     _SHARED_VERIFIER_SR = None
+    # tmlint: disable=lock-global-mutation — uninstall() is a
+    # main-thread test/embedder seam, never concurrent with traffic
     _WARM_BUCKETS.clear()
     _MIN_BATCH = DEFAULT_MIN_BATCH
     _INSTALLED = False

@@ -6,8 +6,9 @@ including the proto form (bits count + little-endian uint64 words).
 
 from __future__ import annotations
 
-import secrets
 from typing import Iterator, List, Optional
+
+from . import rng
 
 __all__ = ["BitArray"]
 
@@ -87,11 +88,12 @@ class BitArray:
     def pick_random(self) -> Optional[int]:
         """Return a uniformly random set index, or None if empty
         (reference: libs/bits/bit_array.go PickRandom — used to choose which
-        block part / vote to gossip next)."""
+        block part / vote to gossip next). Draws from the seedable
+        gossip RNG, not OS entropy, so fuzz runs replay from a seed."""
         idxs = list(self.indices())
         if not idxs:
             return None
-        return idxs[secrets.randbelow(len(idxs))]
+        return idxs[rng.randbelow(len(idxs))]
 
     def copy(self) -> "BitArray":
         out = BitArray(self.size)

@@ -1,0 +1,56 @@
+"""The injectable, seedable RNG for gossip randomness.
+
+The gossip routines pick WHICH part/vote to send a peer at random
+(reference: PickSendVote / BitArray.PickRandom) — that randomness is
+load-balancing, not security, so it does not need OS entropy. What it
+DOES need is seedability: the schedulefuzz suites replay a failing
+interleaving from one named seed, and an unseeded `random.choice` in
+the delivery path breaks seed-exact replay (tmlint rule `det-random`
+enforces this — see docs/static_analysis.md).
+
+Production behavior is unchanged: the module RNG self-seeds from OS
+entropy at import, exactly like the global `random` module. Fuzz
+scenarios pin it per schedule:
+
+    from tendermint_tpu.libs import rng
+    rng.reseed(sched.subseed("gossip"))
+
+and key-generation / cookie / nonce code keeps using `secrets` — this
+module is for protocol-visible *choices*, never secrets.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional, Sequence, TypeVar
+
+__all__ = ["gossip", "reseed", "choice", "shuffle", "randbelow"]
+
+T = TypeVar("T")
+
+_GOSSIP = random.Random()  # self-seeds from OS entropy, like `random`
+
+
+def gossip() -> random.Random:
+    """The shared gossip RNG instance (inject by reseeding, or swap a
+    Random-compatible stand-in in tests via monkeypatch)."""
+    return _GOSSIP
+
+
+def reseed(seed: Optional[int]) -> None:
+    """Reseed the gossip RNG — schedulefuzz calls this with
+    `sched.subseed("gossip")` so gossip picks replay with the
+    schedule; `None` restores OS-entropy self-seeding."""
+    _GOSSIP.seed(seed)
+
+
+def choice(seq: Sequence[T]) -> T:
+    return _GOSSIP.choice(seq)
+
+
+def shuffle(seq: list) -> None:
+    _GOSSIP.shuffle(seq)
+
+
+def randbelow(n: int) -> int:
+    return _GOSSIP.randrange(n)

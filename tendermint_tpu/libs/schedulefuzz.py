@@ -83,6 +83,16 @@ class Schedule:
 
         return (self.seed << 16) ^ zlib.crc32(label.encode())
 
+    def seed_gossip(self) -> None:
+        """Pin the process-wide gossip RNG (libs/rng.py — part/vote
+        pick order in the reactors and BitArray.pick_random) to this
+        schedule, so a scenario that drives real gossip replays its
+        picks from the one named seed. explore() calls this before
+        every scenario run; standalone scenarios call it themselves."""
+        from . import rng
+
+        rng.reseed(self.subseed("gossip"))
+
     async def yield_point(self, p: float = 0.5) -> None:
         """With probability p, yield the event loop 1-2 times so other
         tasks interleave here."""
@@ -100,22 +110,31 @@ async def explore(
     """Run `scenario` under `schedules` seeded schedules; every outcome
     must be equal (use a constant return + internal asserts for
     invariant-style scenarios). Failures name the seed that triggered
-    them — rerun with `Schedule(seed)` to reproduce. Returns the
-    common outcome."""
+    them — to reproduce standalone, build `Schedule(seed)` AND call
+    its `seed_gossip()` (explore() does both; the gossip RNG is part
+    of the schedule). Returns the common outcome."""
+    from . import rng
+
     outcomes: List[tuple] = []
-    for i in range(schedules):
-        seed = base_seed + i
-        sched = Schedule(seed)
-        try:
-            out = await scenario(sched)
-        except Exception as e:  # not BaseException: cancellation and
-            # KeyboardInterrupt must propagate as themselves, not
-            # masquerade as seed-reproducible scenario failures
-            raise AssertionError(
-                f"schedule-fuzz scenario failed under seed={seed} "
-                f"(reproduce with Schedule({seed})): {e!r}"
-            ) from e
-        outcomes.append((seed, out))
+    try:
+        for i in range(schedules):
+            seed = base_seed + i
+            sched = Schedule(seed)
+            sched.seed_gossip()
+            try:
+                out = await scenario(sched)
+            except Exception as e:  # not BaseException: cancellation and
+                # KeyboardInterrupt must propagate as themselves, not
+                # masquerade as seed-reproducible scenario failures
+                raise AssertionError(
+                    f"schedule-fuzz scenario failed under seed={seed} "
+                    f"(reproduce with sched = Schedule({seed}); "
+                    f"sched.seed_gossip() — the gossip RNG is part of "
+                    f"the schedule): {e!r}"
+                ) from e
+            outcomes.append((seed, out))
+    finally:
+        rng.reseed(None)  # hand the gossip RNG back to OS entropy
     ref_seed, ref = outcomes[0]
     for seed, out in outcomes[1:]:
         if out != ref:

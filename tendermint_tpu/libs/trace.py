@@ -131,6 +131,9 @@ class Span:
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
         if _enabled:
+            # tmlint: disable=lock-global-mutation — deque.append is
+            # GIL-atomic; _ring_lock guards ring *replacement* only
+            # (module docstring, line ~55)
             _ring.append(self)
         return False
 

@@ -44,6 +44,8 @@ def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     goroutines (internal/consensus/reactor.go:752).
     """
     devs = list(devices) if devices is not None else jax.devices()
+    # tmlint: disable=dev-host-sync — devs is a host-side list of
+    # Device handles (mesh topology), not a device array
     return Mesh(np.array(devs), (SIG_AXIS,))
 
 

@@ -18,12 +18,12 @@ chaining.
 from __future__ import annotations
 
 import asyncio
-import random
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..abci import types as abci
 from ..config import StateSyncConfig
+from ..libs import rng
 from ..libs.log import get_logger
 from ..libs.service import Service
 from ..p2p.channel import Channel
@@ -539,7 +539,7 @@ class StatesyncReactor(Service):
                         # all providers disconnected mid-fetch (or the
                         # app rejected every remaining sender)
                         raise SyncError("no remaining snapshot providers")
-                    peer = random.choice(providers)
+                    peer = rng.choice(providers)
                     fut = asyncio.get_event_loop().create_future()
                     self._chunk_waiters[
                         (peer, snapshot.height, snapshot.format, index)

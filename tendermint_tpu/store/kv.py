@@ -239,6 +239,8 @@ _BACKENDS: dict = {}
 def register_backend(name: str, factory) -> None:
     """Register `factory(name, db_dir) -> KVStore` under a config
     `db-backend` value. Re-registering a name replaces it (tests)."""
+    # tmlint: disable=lock-global-mutation — registration happens at
+    # import / before node start, single-threaded by contract
     _BACKENDS[name] = factory
 
 

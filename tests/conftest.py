@@ -82,6 +82,52 @@ def pytest_configure(config):
     )
 
 
+# The suites that exercise real cross-thread lock interleavings
+# (breaker probes, gather watchdogs, fault-plane chaos, schedule
+# fuzzing) run under the lockwatch observer; everything else skips the
+# wrapping overhead.
+_LOCKWATCH_FILES = {
+    "test_chaos_consensus.py",
+    "test_faults.py",
+    "test_fuzz.py",
+    "test_schedule_fuzz.py",
+}
+
+
+@pytest.fixture(autouse=True)
+def _lockwatch_guard(request):
+    """Record the lock-acquisition graph during the chaos/fault/fuzz
+    suites and fail the test on any witnessed lock-order cycle or
+    rank-table violation — the runtime analog of `go test -race`
+    plus Go's lockrank (tendermint_tpu/analysis/lockwatch.py; the
+    proven-acyclic order is documented in its RANK table). Long holds
+    are reported as warnings, not failures: a loaded CI box parks
+    threads for unpredictable stretches."""
+    if os.path.basename(str(request.node.fspath)) not in _LOCKWATCH_FILES:
+        yield
+        return
+    from tendermint_tpu.analysis import lockwatch
+
+    lockwatch.enable()
+    try:
+        yield
+    finally:
+        report = lockwatch.disable()
+        assert not report.cycles, (
+            "lockwatch: lock-order cycle witnessed\n" + report.render()
+        )
+        assert not report.order_violations(), (
+            "lockwatch: rank-table violation\n" + report.render()
+        )
+        if report.long_holds:
+            import warnings
+
+            warnings.warn(
+                "lockwatch: hold-time budget exceeded\n" + report.render(),
+                stacklevel=1,
+            )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_fault_plane():
     """Disarm the fault plane and drop every circuit breaker after each

@@ -1,0 +1,13 @@
+"""Fixture: float arithmetic the det-float rule flags."""
+
+
+def timeout_ns(seconds):
+    return seconds * 1e9
+
+
+def ratio(a: int, b: int):
+    return a / b
+
+
+def widen(x: int):
+    return float(x)

@@ -1,0 +1,11 @@
+"""Clean twin: integer nanosecond math only."""
+
+NS_PER_S = 1_000_000_000
+
+
+def timeout_ns(seconds: int) -> int:
+    return seconds * NS_PER_S
+
+
+def ratio_floor(a: int, b: int) -> int:
+    return a // b

@@ -1,0 +1,22 @@
+"""Fixture: unseeded/global randomness the det-random rule flags."""
+import os
+import random
+import uuid
+from random import choice
+
+
+def pick(candidates):
+    return random.choice(candidates)
+
+
+def shuffle_plan(items):
+    random.shuffle(items)
+    return items
+
+
+def nonce():
+    return os.urandom(8), uuid.uuid4()
+
+
+def from_import_evasion(candidates):
+    return choice(candidates)

@@ -1,0 +1,16 @@
+"""Clean twin: injected seeded RNG instances."""
+import random
+
+
+def pick(rng: random.Random, candidates):
+    return rng.choice(candidates)
+
+
+def make_rng(seed: int) -> random.Random:
+    return random.Random(seed)
+
+
+def pick_gossip(candidates):
+    from tendermint_tpu.libs import rng
+
+    return rng.choice(candidates)

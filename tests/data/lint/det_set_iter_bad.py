@@ -1,0 +1,13 @@
+"""Fixture: hash-order set iteration the det-set-iter rule flags."""
+
+
+def hash_addresses(addrs):
+    seen = set(addrs)
+    out = b""
+    for a in seen:
+        out += a
+    return out
+
+
+def encode_parts(parts):
+    return [p.index for p in {p for p in parts}]

@@ -1,0 +1,14 @@
+"""Clean twin: sorted iteration and ordered structures."""
+
+
+def hash_addresses(addrs):
+    seen = set(addrs)
+    out = b""
+    for a in sorted(seen):
+        out += a
+    return out
+
+
+def encode_parts(parts):
+    by_index = {p.index: p for p in parts}
+    return [by_index[i] for i in sorted(by_index)]

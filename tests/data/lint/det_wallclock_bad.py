@@ -1,0 +1,18 @@
+"""Fixture: wall-clock reads a determinism rule must flag."""
+import time
+from datetime import datetime
+from time import time as now
+
+
+def header_time():
+    return time.time()
+
+
+def sign_bytes_time():
+    stamp = datetime.now()
+    ns = time.time_ns()
+    return stamp, ns
+
+
+def from_import_evasion():
+    return now()

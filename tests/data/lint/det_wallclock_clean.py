@@ -1,0 +1,10 @@
+"""Clean twin: timestamps plumbed in; monotonic is local-only."""
+import time
+
+
+def header_time(now_ns: int):
+    return now_ns
+
+
+def elapsed(t0: int) -> int:
+    return time.monotonic_ns() - t0

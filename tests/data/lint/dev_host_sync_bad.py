@@ -1,0 +1,17 @@
+"""Fixture: implicit device->host syncs the rule flags."""
+import numpy as np
+
+
+def count_ok(bitmap):
+    total = 0
+    for lane in bitmap:
+        total += int(lane.item())
+    return total
+
+
+def first_lane(bitmap):
+    return float(bitmap[0])
+
+
+def to_host(arr):
+    return np.asarray(arr)

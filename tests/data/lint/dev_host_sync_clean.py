@@ -1,0 +1,10 @@
+"""Clean twin: reductions on device, one deliberate gather."""
+import jax.numpy as jnp
+
+
+def count_ok(bitmap):
+    return jnp.sum(bitmap)
+
+
+def all_ok(bitmap):
+    return jnp.all(bitmap)

@@ -1,0 +1,11 @@
+"""Fixture: data-dependent shapes the dev-shape-leak rule flags."""
+import jax.numpy as jnp
+
+
+def pad_batch(sigs):
+    n = len(sigs)
+    return jnp.zeros(n)
+
+
+def lane_ids(batch):
+    return jnp.arange(len(batch))

@@ -1,0 +1,19 @@
+"""Clean twin: shapes from the padded bucket configuration."""
+import jax.numpy as jnp
+
+BUCKET = 2048
+
+
+class Verifier:
+    BUCKET = 2048
+
+    def empty(self):
+        return jnp.zeros(self.BUCKET)
+
+
+def pad_batch():
+    return jnp.zeros(BUCKET)
+
+
+def lane_ids():
+    return jnp.arange(2048)

@@ -1,0 +1,14 @@
+"""Fixture: non-daemon workers the lock-daemon rule flags."""
+import threading
+
+
+def spawn_probe(fn):
+    t = threading.Thread(target=fn, name="probe")
+    t.start()
+    return t
+
+
+def schedule(fn, delay):
+    timer = threading.Timer(delay, fn)
+    timer.start()
+    return timer

@@ -1,0 +1,15 @@
+"""Clean twin: daemon kwarg, or daemon assigned before start()."""
+import threading
+
+
+def spawn_probe(fn):
+    t = threading.Thread(target=fn, name="probe", daemon=True)
+    t.start()
+    return t
+
+
+def schedule(fn, delay):
+    timer = threading.Timer(delay, fn)
+    timer.daemon = True
+    timer.start()
+    return timer

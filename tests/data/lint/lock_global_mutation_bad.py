@@ -1,0 +1,19 @@
+"""Fixture: unguarded shared-state mutations the rule flags."""
+import threading
+
+_CACHE: dict = {}
+_PENDING: list = []
+_lock = threading.Lock()
+
+
+def remember(key, value):
+    _CACHE[key] = value
+
+
+def enqueue(item):
+    _PENDING.append(item)
+
+
+def reset():
+    global _CACHE
+    _CACHE = {}

@@ -1,0 +1,26 @@
+"""Clean twin: every mutation under the lock (or *_locked helper)."""
+import threading
+
+_CACHE: dict = {}
+_PENDING: list = []
+_lock = threading.Lock()
+
+
+def remember(key, value):
+    with _lock:
+        _CACHE[key] = value
+
+
+def enqueue(item):
+    with _lock:
+        _pending_push_locked(item)
+
+
+def _pending_push_locked(item):
+    _PENDING.append(item)
+
+
+def reset():
+    global _CACHE
+    with _lock:
+        _CACHE = {}

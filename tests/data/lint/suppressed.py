@@ -1,0 +1,16 @@
+"""Fixture: real violations silenced by justified suppressions."""
+import time
+
+
+def header_time():
+    return time.time()  # tmlint: disable=det-wallclock — fixture: same-line form
+
+
+def sign_time():
+    # tmlint: disable=det-wallclock — fixture: comment-above form,
+    # justification may span several comment lines before the code
+    return time.time()
+
+
+def unsuppressed():
+    return time.time()

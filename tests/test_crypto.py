@@ -620,3 +620,63 @@ def test_x25519_rfc7748_vector():
     )
     assert _x25519_scalarmult(alice_priv, bob_pub) == shared
     assert _x25519_scalarmult(bob_priv, alice_pub) == shared
+
+
+def test_group_affinity_deferred_fn_never_lost_to_racing_reader():
+    """Regression (advisor round 5 + review): a reader interleaving
+    with set_group_affinity_fn() must never permanently cache the
+    fallback affinity of 1 — the locked snapshot plus the fn identity
+    re-check inside the lock retries until it resolves the installed
+    fn."""
+    import threading
+
+    from tendermint_tpu.crypto import batch
+
+    state0 = batch.group_affinity_state()
+    try:
+        for _ in range(50):
+            batch.restore_group_affinity((None, None, False))
+            go = threading.Event()
+
+            def read():
+                go.wait(1.0)
+                batch.group_affinity()
+
+            readers = [
+                threading.Thread(target=read, daemon=True) for _ in range(4)
+            ]
+            for t in readers:
+                t.start()
+            go.set()
+            batch.set_group_affinity_fn(lambda: 8)
+            for t in readers:
+                t.join(5.0)
+            # whatever the interleaving, the installed fn must win for
+            # every later caller (a reader that cached 1 pre-install
+            # would have been invalidated by set_group_affinity_fn)
+            assert batch.group_affinity() == 8
+    finally:
+        batch.restore_group_affinity(state0)
+
+
+def test_group_affinity_fn_swapped_mid_compute_retries():
+    """The fn identity check: a compute based on a stale fn must not
+    publish over a newer install."""
+    from tendermint_tpu.crypto import batch
+
+    state0 = batch.group_affinity_state()
+    try:
+        calls = []
+
+        def slow_fn():
+            calls.append("old")
+            # a newer install lands while the old fn is mid-compute
+            batch.set_group_affinity_fn(lambda: 32)
+            return 2
+
+        batch.restore_group_affinity((None, None, False))
+        batch.set_group_affinity_fn(slow_fn)
+        assert batch.group_affinity() == 32
+        assert calls == ["old"]
+    finally:
+        batch.restore_group_affinity(state0)

@@ -1,0 +1,441 @@
+"""tmlint + lockwatch: the correctness-tooling gate.
+
+Two jobs: (1) run the consensus-invariant static analyzer over the
+whole package on every tier-1 invocation, so a new nondeterminism /
+lock-discipline / device-hygiene violation fails CI the way `-race`
+and `go vet` gate the reference; (2) unit-test the analyzer and the
+lock-order observer themselves against the fixture corpus in
+tests/data/lint/.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.analysis import lockwatch, tmlint
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "lint")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fixture_src(name: str) -> str:
+    with open(os.path.join(FIXTURES, name), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def run_on_fixture(name: str, as_path: str, rule: str):
+    return tmlint.check_source(fixture_src(name), as_path, rules=[rule])
+
+
+# ---------------------------------------------------------------------------
+# THE gate: whole package against the checked-in baseline
+
+
+def test_package_clean_against_baseline():
+    """Every rule over every package file; anything beyond
+    analysis/baseline.json fails this tier-1 test — fix it, suppress
+    it with a justification, or consciously re-baseline (see
+    docs/static_analysis.md)."""
+    violations = tmlint.check_package()
+    new = tmlint.new_violations(violations, tmlint.load_baseline())
+    assert not new, "new tmlint violations:\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_full_package_run_under_budget():
+    """Bench-guard-style cost ceiling: the analyzer must stay cheap
+    enough to run on every tier-1 invocation (10 s on CPU; measured
+    ~1 s for ~150 files)."""
+    t0 = time.monotonic()
+    tmlint.check_package()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"tmlint full-package run took {elapsed:.1f}s"
+
+
+def test_seeded_violation_in_consensus_module_fails_gate():
+    """A new wall-clock read seeded into a consensus-critical module
+    must surface as a NEW violation against the real baseline."""
+    bad = "import time\n\n\ndef stamp():\n    return time.time()\n"
+    violations = tmlint.check_source(bad, "types/seeded_fixture.py")
+    assert any(v.rule == "det-wallclock" for v in violations)
+    new = tmlint.new_violations(violations, tmlint.load_baseline())
+    assert any(v.rule == "det-wallclock" for v in new)
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixture corpus: each rule flags its bad snippet and passes
+# the clean twin
+
+_CASES = [
+    # (rule, bad fixture, clean fixture, synthetic in-package path)
+    ("det-wallclock", "det_wallclock_bad.py", "det_wallclock_clean.py",
+     "types/fixture.py"),
+    ("det-random", "det_random_bad.py", "det_random_clean.py",
+     "consensus/fixture.py"),
+    ("det-float", "det_float_bad.py", "det_float_clean.py",
+     "encoding/fixture.py"),
+    ("det-set-iter", "det_set_iter_bad.py", "det_set_iter_clean.py",
+     "crypto/merkle.py"),
+    ("lock-daemon", "lock_daemon_bad.py", "lock_daemon_clean.py",
+     "crypto/fixture.py"),
+    ("lock-global-mutation", "lock_global_mutation_bad.py",
+     "lock_global_mutation_clean.py", "crypto/fixture.py"),
+    ("dev-host-sync", "dev_host_sync_bad.py", "dev_host_sync_clean.py",
+     "parallel/fixture.py"),
+    ("dev-shape-leak", "dev_shape_leak_bad.py", "dev_shape_leak_clean.py",
+     "crypto/batch.py"),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,bad,clean,path", _CASES, ids=[c[0] for c in _CASES]
+)
+def test_rule_flags_bad_and_passes_clean(rule, bad, clean, path):
+    flagged = run_on_fixture(bad, path, rule)
+    assert flagged, f"{rule} missed every violation in {bad}"
+    assert all(v.rule == rule for v in flagged)
+    assert run_on_fixture(clean, path, rule) == [], (
+        f"{rule} false-positived on {clean}"
+    )
+
+
+def test_every_rule_class_covered():
+    """The acceptance criterion, mechanically: every registered rule
+    has a bad fixture it flags and a clean twin it passes."""
+    assert {c[0] for c in _CASES} == set(tmlint.rule_ids())
+
+
+@pytest.mark.parametrize(
+    "rule,bad,path",
+    [(c[0], c[1], c[3]) for c in _CASES if c[0].startswith("det-")],
+    ids=[c[0] for c in _CASES if c[0].startswith("det-")],
+)
+def test_determinism_rules_scoped_to_consensus_critical(rule, bad, path):
+    """The same hazardous source outside the consensus-critical (or
+    replay) scope is NOT flagged — p2p jitter may use wall clock and
+    floats freely."""
+    assert tmlint.check_source(fixture_src(bad), "p2p/fixture.py",
+                               rules=[rule]) == []
+
+
+def test_device_rules_scoped_to_device_modules():
+    assert tmlint.check_source(
+        fixture_src("dev_host_sync_bad.py"), "state/fixture.py",
+        rules=["dev-host-sync"],
+    ) == []
+
+
+def test_lock_rules_scoped_to_threading_importers():
+    src = "_CACHE: dict = {}\n\n\ndef remember(k, v):\n    _CACHE[k] = v\n"
+    assert tmlint.check_source(
+        src, "crypto/fixture.py", rules=["lock-global-mutation"]
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+
+def test_suppression_same_line_and_comment_above():
+    violations = tmlint.check_source(
+        fixture_src("suppressed.py"), "types/fixture.py",
+        rules=["det-wallclock"],
+    )
+    # only the deliberately unsuppressed site survives
+    assert len(violations) == 1
+    line = violations[0].source
+    assert "time.time()" in line
+    src = fixture_src("suppressed.py")
+    assert src.splitlines()[violations[0].line - 2].strip() == (
+        "def unsuppressed():"
+    )
+
+
+def test_suppression_only_silences_named_rule():
+    src = (
+        "import time\n\n\ndef f():\n"
+        "    return time.time()  # tmlint: disable=det-float\n"
+    )
+    violations = tmlint.check_source(src, "types/fixture.py")
+    assert any(v.rule == "det-wallclock" for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+
+
+def test_baseline_round_trip(tmp_path):
+    bad = fixture_src("det_wallclock_bad.py")
+    violations = tmlint.check_source(bad, "types/fixture.py")
+    assert violations
+    path = str(tmp_path / "baseline.json")
+    tmlint.save_baseline(violations, path)
+    # accepted: same violations are not "new"
+    assert tmlint.new_violations(violations, tmlint.load_baseline(path)) == []
+    # a NEW violation (textually distinct source line) is flagged;
+    # identical lines would instead trip the counting path below
+    grown = bad + "\n\ndef more():\n    later = time.time()\n    return later\n"
+    regrown = tmlint.check_source(grown, "types/fixture.py")
+    new = tmlint.new_violations(regrown, tmlint.load_baseline(path))
+    assert len(new) == 1 and "later" in new[0].source
+    assert new[0].line > len(bad.splitlines())
+
+
+def test_baseline_counts_duplicate_lines():
+    """Duplicating a grandfathered bad line is itself a new violation:
+    fingerprints are counted, not just present/absent."""
+    one = "import time\n\n\ndef f():\n    return time.time()\n"
+    v1 = tmlint.check_source(one, "types/fixture.py")
+    base = tmlint.baseline_counts(v1)
+    two = one + "\n\ndef g():\n    return time.time()\n"
+    v2 = tmlint.check_source(two, "types/fixture.py")
+    new = tmlint.new_violations(v2, base)
+    assert len(new) == 2  # both occurrences reported, allowance noted
+    assert "baseline allows 1" in new[0].message
+
+
+def test_baseline_file_is_checked_in_and_loads():
+    assert os.path.exists(tmlint.BASELINE_PATH)
+    with open(tmlint.BASELINE_PATH) as f:
+        data = json.load(f)
+    assert data["version"] == 1
+    assert isinstance(data["entries"], dict)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_clean_exit_zero():
+    r = _run_cli("--stats")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_unknown_rule_exit_two():
+    r = _run_cli("--rule", "no-such-rule")
+    assert r.returncode == 2
+    assert "no-such-rule" in r.stderr
+
+
+def test_cli_list_rules():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rid in tmlint.rule_ids():
+        assert rid in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# lockwatch
+
+
+def _watched_pair(watch):
+    a = lockwatch._WatchedLock(watch, threading.Lock(), "A")
+    b = lockwatch._WatchedLock(watch, threading.Lock(), "B")
+    return a, b
+
+
+def test_lockwatch_detects_ab_ba_cycle():
+    """The deliberate A->B / B->A construction: two threads witness
+    opposite orders (sequenced so the test itself can't deadlock) and
+    the report must name the cycle."""
+    watch = lockwatch.LockWatch(hold_budget_s=10.0)
+    a, b = _watched_pair(watch)
+    t1_done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        t1_done.set()
+
+    def t2():
+        t1_done.wait(5.0)
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1, daemon=True)
+    th2 = threading.Thread(target=t2, daemon=True)
+    th1.start(); th2.start()
+    th1.join(5.0); th2.join(5.0)
+    report = watch.report()
+    assert ("A", "B") in report.edges and ("B", "A") in report.edges
+    assert report.cycles, report.render()
+    assert sorted(report.cycles[0]) == ["A", "B"]
+    assert "CYCLE" in report.render()
+
+
+def test_lockwatch_consistent_order_is_clean():
+    watch = lockwatch.LockWatch(hold_budget_s=10.0)
+    a, b = _watched_pair(watch)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    report = watch.report()
+    assert report.edges == {("A", "B"): report.edges[("A", "B")]}
+    assert report.cycles == []
+    assert report.order_violations({"A": 1, "B": 2}) == []
+
+
+def test_lockwatch_rank_violation():
+    watch = lockwatch.LockWatch(hold_budget_s=10.0)
+    a, b = _watched_pair(watch)
+    with b:
+        with a:  # declared order says A before B
+            pass
+    report = watch.report()
+    bad = report.order_violations({"A": 1, "B": 2})
+    assert len(bad) == 1 and bad[0]["edge"] == ("B", "A")
+
+
+def test_lockwatch_hold_budget():
+    watch = lockwatch.LockWatch(hold_budget_s=0.01)
+    a, _ = _watched_pair(watch)
+    with a:
+        time.sleep(0.05)
+    report = watch.report()
+    assert len(report.long_holds) == 1
+    assert report.long_holds[0]["name"] == "A"
+    assert report.long_holds[0]["held_s"] >= 0.01
+
+
+def test_lockwatch_rlock_reentry_is_not_a_self_cycle():
+    watch = lockwatch.LockWatch(hold_budget_s=10.0)
+    r = lockwatch._WatchedLock(watch, threading.RLock(), "R")
+    with r:
+        with r:
+            pass
+    report = watch.report()
+    assert report.cycles == []
+    assert ("R", "R") not in report.edges
+
+
+def test_lockwatch_enable_disable_restores_modules():
+    from tendermint_tpu.crypto import breaker, sigcache, tpu_verifier
+
+    orig_sig = sigcache._lock
+    orig_wedged = tpu_verifier._wedged_lock
+    orig_threading = breaker.threading
+    watch = lockwatch.enable()
+    try:
+        assert lockwatch.active() is watch
+        assert isinstance(sigcache._lock, lockwatch._WatchedLock)
+        # locks born during the window are watched and class-named
+        br = breaker.CircuitBreaker("lint-fixture")
+        assert isinstance(br._lock, lockwatch._WatchedLock)
+        assert br._lock._name == "breaker.instance"
+        br.record_failure()
+        br.close_now()
+    finally:
+        report = lockwatch.disable()
+    assert lockwatch.active() is None
+    assert sigcache._lock is orig_sig
+    assert tpu_verifier._wedged_lock is orig_wedged
+    assert breaker.threading is orig_threading
+    assert report.acquisitions > 0
+    assert report.cycles == []
+    assert report.order_violations() == []
+
+
+def test_lockwatch_breaker_registry_order_witnessed():
+    """fresh() takes breaker.registry then the retired instance's
+    lock — the canonical declared edge; the chaos suites must witness
+    it in THIS order only."""
+    from tendermint_tpu.crypto import breaker
+
+    lockwatch.enable()
+    try:
+        breaker.breaker_for("lint-order-fixture")
+        breaker.fresh("lint-order-fixture")
+        breaker.discard("lint-order-fixture")
+    finally:
+        report = lockwatch.disable()
+    edge = ("breaker.registry", "breaker.instance")
+    assert edge in report.edges
+    assert report.cycles == []
+    assert report.order_violations() == []
+
+
+def test_cli_baseline_update_refuses_filtered_runs(tmp_path):
+    """--baseline-update over a --rule or path subset would overwrite
+    the whole baseline with the filtered slice, deleting every other
+    grandfathered entry — refused with the usage exit code."""
+    r = _run_cli("--rule", "det-float", "--baseline-update")
+    assert r.returncode == 2 and "full-package" in r.stderr
+    r = _run_cli("tendermint_tpu/crypto/batch.py", "--baseline-update")
+    assert r.returncode == 2
+    # and the real baseline was not touched
+    assert tmlint.new_violations(
+        tmlint.check_package(), tmlint.load_baseline()
+    ) == []
+
+
+def test_lockwatch_witnesses_import_time_metric_locks():
+    """DEFAULT_REGISTRY's instruments were created at import, before
+    any watch window — enable() must wrap their locks in place so the
+    RANK-documented *->metrics.metric edges are witnessed, not
+    assumed. sigcache._rotate bumps its eviction counter under the
+    rotation lock: that edge must appear."""
+    from tendermint_tpu.crypto import sigcache
+
+    lockwatch.enable()
+    try:
+        with sigcache._lock:
+            sigcache._m_evictions.inc(0)
+    finally:
+        report = lockwatch.disable()
+    assert ("sigcache.rotate", "metrics.metric") in report.edges
+    assert report.cycles == []
+    assert report.order_violations() == []
+    # restored: the registry's instruments carry real locks again
+    assert not isinstance(
+        sigcache._m_evictions._lock, lockwatch._WatchedLock
+    )
+
+
+def test_lockwatch_window_survivor_reports_to_active_watch():
+    """A lock created inside one window but still alive in the next
+    must record into the ACTIVE watch, not its dead creator."""
+    w1 = lockwatch.LockWatch(hold_budget_s=10.0)
+    survivor = lockwatch._WatchedLock(w1, threading.Lock(), "S")
+    w2 = lockwatch.enable()
+    try:
+        other = lockwatch._WatchedLock(w2, threading.Lock(), "T")
+        with survivor:
+            with other:
+                pass
+    finally:
+        report = lockwatch.disable()
+    assert ("S", "T") in report.edges
+    assert w1.report().edges == {}
+
+
+def test_determinism_rules_catch_from_import_style():
+    """The gate must not be evadable by import style: `from random
+    import choice` / `from time import time as now` resolve to the
+    same banned targets as the dotted forms."""
+    src = (
+        "from random import choice\n"
+        "from time import time as now\n\n\n"
+        "def pick(xs):\n    return choice(xs)\n\n\n"
+        "def stamp():\n    return now()\n"
+    )
+    violations = tmlint.check_source(src, "consensus/fixture.py")
+    assert any(v.rule == "det-random" for v in violations)
+    violations = tmlint.check_source(src, "types/fixture.py")
+    assert {v.rule for v in violations} >= {"det-random", "det-wallclock"}
