@@ -874,6 +874,62 @@ def _persist_midround(partial: dict) -> None:
 
 _EMIT = {"done": False, "line": None}
 
+_CPU_SIDE_FILE = "BENCH_CPU_SIDE.json"
+
+
+def _split_cpu_aliases(extra: dict) -> dict:
+    """Pop the `_cpu` ALIAS keys out of an extra dict, returning them.
+
+    An alias is a key whose plain twin (the key with the `_cpu`
+    segment removed) is present AND holds a real measurement — on
+    device runs both exist and the duplication made the r5 result
+    line overflow the driver's tail window (`parsed: null`, VERDICT
+    weak #6). A twin that is only a placeholder ({'skipped': ...}
+    stubs pre-seeded before device stages, {'error': ...} from a
+    failed stage) does NOT evict: in that case the `_cpu` key holds
+    the run's only real number and must stay in the line. CPU-only
+    primaries (`cpu_single_verify_sigs_per_s`) have no twin and stay
+    too."""
+
+    def is_real(v) -> bool:
+        return not (
+            isinstance(v, dict) and ("skipped" in v or "error" in v)
+        )
+
+    moved = {}
+    for key in list(extra):
+        if key.endswith("_cpu"):
+            twin = key[: -len("_cpu")]
+        elif "_cpu_" in key:
+            twin = key.replace("_cpu_", "_")
+        else:
+            continue
+        if twin in extra and is_real(extra[twin]):
+            moved[key] = extra.pop(key)
+    return moved
+
+
+def _write_cpu_side_file(moved: dict) -> "str | None":
+    """The popped alias rows land in BENCH_CPU_SIDE.json next to this
+    file, keyed like the old inline names. Returns an error string on
+    failure (read-only checkout, full disk) so the caller can put the
+    rows back in the line rather than silently losing the round's only
+    CPU-vs-device comparison data."""
+    if not moved:
+        return None
+    import os
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), _CPU_SIDE_FILE
+        )
+        with open(path, "w") as f:
+            json.dump(moved, f, indent=1)
+            f.write("\n")
+        return None
+    except (OSError, TypeError, ValueError) as e:
+        return repr(e)
+
 
 def _emit_line(stall: str = "") -> None:
     """Print the ONE JSON line the driver parses — exactly once.
@@ -883,7 +939,11 @@ def _emit_line(stall: str = "") -> None:
     still appending): serialization failures are retried, and as a
     last resort a minimal line with the scalar headline fields is
     emitted. done is only set after a successful print, so a failed
-    attempt never suppresses the output permanently."""
+    attempt never suppresses the output permanently.
+
+    Duplicated `_cpu` alias keys are split out of the line into
+    BENCH_CPU_SIDE.json (see _split_cpu_aliases) so the line stays
+    inside the driver's tail window."""
     import threading
 
     lock = _EMIT.setdefault("lock", threading.Lock())
@@ -895,6 +955,12 @@ def _emit_line(stall: str = "") -> None:
         for _ in range(3):
             try:
                 snap = json.loads(json.dumps(line))
+                moved = _split_cpu_aliases(snap.get("extra", {}))
+                err = _write_cpu_side_file(moved)
+                if err is not None:
+                    # keep the data over keeping the line small
+                    snap.setdefault("extra", {}).update(moved)
+                    snap["extra"]["cpu_side_file_error"] = err
                 if stall:
                     snap.setdefault("extra", {})["stall"] = stall
                 payload = json.dumps(snap)

@@ -1,24 +1,35 @@
 #!/usr/bin/env python
-"""tmlint CLI — run the consensus-invariant static analyzer.
+"""tmlint + tmcheck CLI — the consensus-invariant static analyzers.
 
 Usage:
-    python scripts/lint.py                    # full package vs baseline
-    python scripts/lint.py --rule det-float   # one rule class only
+    python scripts/lint.py                    # full gate: tmlint + tmcheck
+    python scripts/lint.py --rule det-float   # one tmlint rule class only
+    python scripts/lint.py --taint            # tmcheck taint pass only
+    python scripts/lint.py --schema           # tmcheck schema gate only
     python scripts/lint.py --no-baseline      # every violation, raw
     python scripts/lint.py --baseline-update  # re-accept current state
+                                              # (tmlint AND taint baselines)
+    python scripts/lint.py --schema-update    # regenerate the golden
+                                              # wire-schema table
     python scripts/lint.py --list-rules       # rule catalog
-    python scripts/lint.py path/to/file.py    # specific files (paths
-                                              # inside tendermint_tpu/)
+    python scripts/lint.py path/to/file.py    # specific files (tmlint
+                                              # only; tmcheck is
+                                              # whole-program)
 
-Exit codes (the contract tests/test_lint.py and CI rely on):
-    0  clean — no violations beyond the checked-in baseline
+Exit codes (the contract tests/test_lint.py, tests/test_tmcheck.py and
+CI rely on):
+    0  clean — no violations beyond the checked-in baselines/golden
     1  new violations found (or any violation under --no-baseline)
     2  usage or internal error
 
-The baseline lives at tendermint_tpu/analysis/baseline.json and is
-fingerprinted by source-line content, so unrelated edits never shift
-it. docs/static_analysis.md documents the workflow and the
-suppression policy (`# tmlint: disable=<rule>` with a justification).
+Baselines: tendermint_tpu/analysis/baseline.json (tmlint),
+tendermint_tpu/analysis/tmcheck/taint_baseline.json (taint), and the
+golden wire schema tendermint_tpu/analysis/tmcheck/schema.json.
+--baseline-update / --schema-update refuse filtered runs (a subset
+scan would silently overwrite the whole file).
+docs/static_analysis.md documents the workflow and the suppression
+policy (`# tmlint: disable=<rule>`, `# tmcheck: taint-ok/taint-break`,
+`# tmcheck: unparsed=N/unwritten=N`).
 """
 
 from __future__ import annotations
@@ -30,7 +41,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tendermint_tpu.analysis import tmlint  # noqa: E402
+from tendermint_tpu.analysis import tmcheck, tmlint  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -44,19 +55,34 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--rule", action="append", dest="rules", metavar="ID",
-        help="only run this rule id (repeatable)",
+        help="only run this tmlint rule id (repeatable; skips tmcheck)",
     )
     ap.add_argument(
         "--baseline", default=tmlint.BASELINE_PATH,
-        help="baseline file (default: tendermint_tpu/analysis/baseline.json)",
+        help="tmlint baseline file "
+             "(default: tendermint_tpu/analysis/baseline.json)",
     )
     ap.add_argument(
         "--baseline-update", action="store_true",
-        help="accept the current violation set as the new baseline",
+        help="accept the current violation set as the new baseline "
+             "(tmlint and taint)",
     )
     ap.add_argument(
         "--no-baseline", action="store_true",
-        help="ignore the baseline: report and fail on every violation",
+        help="ignore the baselines: report and fail on every violation",
+    )
+    ap.add_argument(
+        "--taint", action="store_true",
+        help="run only the tmcheck interprocedural taint pass",
+    )
+    ap.add_argument(
+        "--schema", action="store_true",
+        help="run only the tmcheck wire-schema conformance gate",
+    )
+    ap.add_argument(
+        "--schema-update", action="store_true",
+        help="regenerate the golden wire-schema table "
+             "(tendermint_tpu/analysis/tmcheck/schema.json)",
     )
     ap.add_argument(
         "--list-rules", action="store_true",
@@ -72,9 +98,12 @@ def main(argv=None) -> int:
         for rule in tmlint.all_rules():
             print(f"{rule.id}: {rule.title}")
             print(f"    {rule.rationale}")
+        for rid, title in tmcheck.RULES:
+            print(f"{rid}: {title}")
         return 0
 
-    if args.baseline_update and (args.rules or args.paths):
+    filtered = bool(args.rules or args.paths)
+    if args.baseline_update and filtered:
         # a filtered scan would overwrite the whole baseline with its
         # subset, silently deleting every other grandfathered entry
         print(
@@ -83,42 +112,109 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.baseline_update and args.schema:
+        # the schema gate has no counted baseline — its accepted state
+        # IS the golden table; silently succeeding here would let an
+        # operator believe a red gate was accepted when nothing ran
+        print(
+            "error: --baseline-update has nothing to update for the "
+            "schema section (use --schema-update for the golden table)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.schema_update and (filtered or args.taint):
+        # same hazard: the golden table covers EVERY codec module
+        print(
+            "error: --schema-update requires a full-package run "
+            "(drop --rule/--taint and path arguments)",
+            file=sys.stderr,
+        )
+        return 2
+
+    run_tmlint = not (args.taint or args.schema)
+    run_taint = (args.taint or not (args.schema or filtered))
+    run_schema = (args.schema or not (args.taint or filtered))
+    # update modes run ONLY the sections they update: computing (then
+    # discarding) the other gates' violations would both waste ~2 s
+    # and return 0 past a red gate the operator never saw
+    if args.baseline_update:
+        run_schema = False
+    if args.schema_update:
+        run_tmlint = False
+        run_taint = False
 
     t0 = time.monotonic()
+    violations = []
+    new = []
     try:
-        if args.paths:
-            root = tmlint.package_root()
-            violations = []
-            for p in args.paths:
-                abspath = os.path.abspath(p)
-                rel = os.path.relpath(abspath, root).replace(os.sep, "/")
-                if rel.startswith(".."):
-                    print(
-                        f"error: {p} is outside the package root {root}",
-                        file=sys.stderr,
+        if run_tmlint:
+            if args.paths:
+                root = tmlint.package_root()
+                for p in args.paths:
+                    abspath = os.path.abspath(p)
+                    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+                    if rel.startswith(".."):
+                        print(
+                            f"error: {p} is outside the package root {root}",
+                            file=sys.stderr,
+                        )
+                        return 2
+                    violations.extend(
+                        tmlint.check_file(abspath, rel, args.rules)
                     )
-                    return 2
-                violations.extend(tmlint.check_file(abspath, rel, args.rules))
-        else:
-            violations = tmlint.check_package(rules=args.rules)
+            else:
+                violations.extend(tmlint.check_package(rules=args.rules))
+            if args.baseline_update:
+                counts = tmlint.save_baseline(violations, args.baseline)
+                print(
+                    f"tmlint baseline updated: {len(counts)} fingerprints "
+                    f"covering {len(violations)} accepted violations -> "
+                    f"{args.baseline}"
+                )
+            elif args.no_baseline:
+                new.extend(violations)
+            else:
+                new.extend(
+                    tmlint.new_violations(
+                        violations, tmlint.load_baseline(args.baseline)
+                    )
+                )
+
+        pkg = None
+        if run_taint:
+            pkg = tmcheck.build_package()
+            taint_v = tmcheck.taint_violations(pkg)
+            violations.extend(taint_v)
+            if args.baseline_update:
+                counts = tmcheck.update_taint_baseline(pkg)
+                print(
+                    f"taint baseline updated: {len(counts)} fingerprints "
+                    f"-> {tmcheck.TAINT_BASELINE_PATH}"
+                )
+            elif args.no_baseline:
+                new.extend(taint_v)
+            else:
+                new.extend(tmcheck.new_taint_violations(pkg))
+
+        if args.schema_update:
+            data = tmcheck.update_schema_golden()
+            print(
+                f"golden schema updated: {len(data['messages'])} messages "
+                f"-> {tmcheck.GOLDEN_PATH}"
+            )
+        elif run_schema:
+            # the golden table IS the schema baseline: drift always
+            # fails, --no-baseline changes nothing here
+            schema_v = tmcheck.schema_violations()
+            violations.extend(schema_v)
+            new.extend(schema_v)
     except (ValueError, OSError, SyntaxError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     elapsed = time.monotonic() - t0
 
-    if args.baseline_update:
-        counts = tmlint.save_baseline(violations, args.baseline)
-        print(
-            f"baseline updated: {len(counts)} fingerprints covering "
-            f"{len(violations)} accepted violations -> {args.baseline}"
-        )
+    if args.baseline_update or args.schema_update:
         return 0
-
-    if args.no_baseline:
-        new = violations
-    else:
-        baseline = tmlint.load_baseline(args.baseline)
-        new = tmlint.new_violations(violations, baseline)
 
     for v in new:
         print(v.render())
@@ -127,8 +223,17 @@ def main(argv=None) -> int:
         per_rule: dict = {}
         for v in violations:
             per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+        sections = [
+            s
+            for s, on in (
+                ("tmlint", run_tmlint),
+                ("taint", run_taint),
+                ("schema", run_schema),
+            )
+            if on
+        ]
         print(
-            f"-- {len(violations)} total violations "
+            f"-- [{'+'.join(sections)}] {len(violations)} total violations "
             f"({len(new)} new), {elapsed:.2f}s --"
         )
         for rid in sorted(per_rule):
@@ -137,8 +242,10 @@ def main(argv=None) -> int:
     if new:
         print(
             f"\n{len(new)} new violation(s). Fix them, add a justified "
-            "`# tmlint: disable=<rule>` suppression, or (for accepted "
-            "debt) run scripts/lint.py --baseline-update.",
+            "suppression/annotation (# tmlint: disable=..., # tmcheck: "
+            "taint-ok/taint-break/unparsed=N), or for consciously "
+            "accepted changes run scripts/lint.py --baseline-update / "
+            "--schema-update.",
             file=sys.stderr,
         )
         return 1

@@ -1,0 +1,136 @@
+"""tmcheck — whole-program analyses on top of tmlint.
+
+Two machine-checked invariants that were previously trust-me:
+
+1. **Taint** (`taint.py` on the call graph from `callgraph.py`): no
+   nondeterminism source (wall clock, unseeded RNG, float arithmetic,
+   set iteration, `id()`, `os.urandom` outside keygen) is reachable,
+   through any interprocedural call path, from the sign-bytes/hash
+   construction region (`types/canonical.py`, `crypto/tmhash.py`,
+   `crypto/merkle.py`, `encoding/proto.py`, and every
+   to_proto/sign_bytes/hash_bytes/hash in `types/`). Findings carry
+   the full offending call chain; accepted debt lives in a counted
+   fingerprint baseline (`taint_baseline.json`) and reviewed
+   exceptions are in-file `# tmcheck: taint-ok` / `taint-break`
+   suppressions.
+
+2. **Wire schema** (`schema.py`): the statically-extracted
+   (tag, wire type, order, repeated/conditional) table of every
+   encoder plus each decoder's parsed-tag set, diffed against the
+   golden `schema.json` and checked for encode/decode symmetry and
+   ascending-tag emission. Any drift is a tier-1 failure;
+   `scripts/lint.py --schema-update` is the reviewed update path.
+
+Run both via `scripts/lint.py` (--taint / --schema) or the tier-1
+gates in tests/test_tmcheck.py. docs/static_analysis.md documents the
+source/sink catalogs and the suppression/baseline/golden policies.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ..tmlint import (
+    Violation,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from . import callgraph, schema, taint
+from .callgraph import Package, build_package
+from .schema import (
+    GOLDEN_PATH,
+    extract_package,
+    load_golden,
+    save_golden,
+    schema_violations,
+)
+from .taint import analyze as taint_analyze
+from .taint import taint_violations
+
+__all__ = [
+    "Package",
+    "RULES",
+    "build_package",
+    "taint_analyze",
+    "taint_violations",
+    "new_taint_violations",
+    "schema_violations",
+    "extract_package",
+    "load_golden",
+    "save_golden",
+    "update_schema_golden",
+    "update_taint_baseline",
+    "TAINT_BASELINE_PATH",
+    "GOLDEN_PATH",
+]
+
+TAINT_BASELINE_PATH = os.path.join(
+    os.path.dirname(__file__), "taint_baseline.json"
+)
+
+# the tmcheck rule catalog — the single source --list-rules and the
+# docs table mirror (ids are emitted by taint.py / schema.py)
+RULES = [
+    (
+        "taint-wallclock",
+        "wall-clock read reachable from sign-bytes/hash construction",
+    ),
+    (
+        "taint-random",
+        "unseeded RNG / OS entropy reachable from sign-bytes/hash "
+        "construction",
+    ),
+    (
+        "taint-float",
+        "float arithmetic reachable from sign-bytes/hash construction",
+    ),
+    (
+        "taint-set-iter",
+        "set iteration reachable from sign-bytes/hash construction",
+    ),
+    (
+        "taint-id",
+        "id() reachable from sign-bytes/hash construction",
+    ),
+    (
+        "schema-drift",
+        "extracted wire schema differs from the golden schema.json",
+    ),
+    (
+        "schema-order",
+        "non-ascending field emission order in an encoder",
+    ),
+    (
+        "schema-symmetry",
+        "field written but not parsed (or parsed but not written)",
+    ),
+]
+
+
+def new_taint_violations(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+) -> List[Violation]:
+    """Taint findings beyond the checked-in baseline (same counted
+    fingerprint semantics as tmlint)."""
+    violations = taint_violations(pkg)
+    baseline = load_baseline(baseline_path or TAINT_BASELINE_PATH)
+    return new_violations(violations, baseline)
+
+
+def update_taint_baseline(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+) -> Dict[str, int]:
+    return save_baseline(
+        taint_violations(pkg), baseline_path or TAINT_BASELINE_PATH
+    )
+
+
+def update_schema_golden(
+    root: Optional[str] = None, path: Optional[str] = None
+) -> dict:
+    messages, _ = extract_package(root)
+    return save_golden(messages, path)

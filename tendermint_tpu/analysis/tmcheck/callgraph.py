@@ -1,0 +1,654 @@
+"""Package-wide call-graph construction for tmcheck.
+
+tmlint proves per-module, per-line facts; the two deepest invariants
+need whole-program reach: "no nondeterminism source can reach a
+sign-bytes/hash sink through ANY call path" is a property of the call
+graph, not of one file. This module builds that graph with stdlib
+`ast` only — every function/method in the package becomes a node, and
+call sites are resolved through the real import structure (absolute
+and relative imports, `import x as y` aliases, from-imports via the
+same machinery tmlint's `Module.from_import_orig` uses per-module),
+plus the small amount of local type inference the codebase's idiom
+makes reliable:
+
+- `f(...)` — module-level function or from-imported function/class
+- `self.m(...)` / `cls.m(...)` — methods of the enclosing class (and
+  same-module / imported base classes)
+- `mod.f(...)` — attribute call through an imported module
+- `x.m(...)` where `x = SomeClass(...)` locally — the ProtoWriter /
+  FieldReader idiom
+- `self.attr.m(...)` where `attr` is annotated on the class (dataclass
+  fields, `self.x: T = ...` in __init__)
+- `v.m(...)` where `v` iterates a List[T]/Sequence[T]-annotated
+  attribute — the `for v in self.validators: v.hash_bytes()` idiom
+
+Unresolvable calls (dynamic hooks, higher-order functions) produce no
+edge: the analysis is deliberately under-approximate on edges and
+over-approximate on sources, and the docs say so. Calls that resolve
+to nothing inside the package are returned as *external* dotted names
+("time.time", "os.urandom") for the taint pass to classify.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tmlint import dotted_name as _dotted
+from ..tmlint import iter_py_files
+
+__all__ = ["CallSite", "FuncInfo", "ModuleIndex", "Package", "build_package"]
+
+
+_CONTAINER_GENERICS = {
+    "List",
+    "Sequence",
+    "Tuple",
+    "Optional",
+    "Iterable",
+    "Set",
+    "FrozenSet",
+    "list",
+    "tuple",
+    "set",
+}
+
+
+def _annotation_type_name(node: Optional[ast.AST]) -> str:
+    """The bare class name of an annotation, unwrapping one layer of
+    Optional[T] / List[T] / "T" string forms. Returns "" when the
+    annotation isn't a simple type."""
+    if node is None:
+        return ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        # string annotation: 'BlockID' or "Optional[Validator]"
+        try:
+            return _annotation_type_name(
+                ast.parse(node.value, mode="eval").body
+            )
+        except SyntaxError:
+            return ""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        base = _annotation_type_name(node.value)
+        if base in _CONTAINER_GENERICS:
+            inner = node.slice
+            if isinstance(inner, ast.Tuple) and inner.elts:
+                inner = inner.elts[0]
+            return _annotation_type_name(inner)
+        return base
+    return ""
+
+
+def _element_type_name(node: Optional[ast.AST]) -> str:
+    """Element type of a container annotation (List[T] -> T); "" when
+    not a container."""
+    if node is None:
+        return ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            return _element_type_name(ast.parse(node.value, mode="eval").body)
+        except SyntaxError:
+            return ""
+    if isinstance(node, ast.Subscript):
+        base = ""
+        if isinstance(node.value, ast.Name):
+            base = node.value.id
+        elif isinstance(node.value, ast.Attribute):
+            base = node.value.attr
+        if base in _CONTAINER_GENERICS and base not in (
+            "Optional",
+        ):
+            inner = node.slice
+            if isinstance(inner, ast.Tuple) and inner.elts:
+                inner = inner.elts[0]
+            return _annotation_type_name(inner)
+        if base == "Optional":
+            return _element_type_name(
+                node.slice.elts[0]
+                if isinstance(node.slice, ast.Tuple) and node.slice.elts
+                else node.slice
+            )
+    return ""
+
+
+class CallSite:
+    """One call expression inside a function body.
+
+    Exactly one of `target` (an in-package FuncInfo key) or `external`
+    (a resolved dotted name like "time.time") is set; both are None
+    for calls the resolver cannot identify."""
+
+    __slots__ = ("target", "external", "lineno", "col")
+
+    def __init__(
+        self,
+        target: Optional[Tuple[str, str]],
+        external: Optional[str],
+        lineno: int,
+        col: int,
+    ) -> None:
+        self.target = target
+        self.external = external
+        self.lineno = lineno
+        self.col = col
+
+
+class FuncInfo:
+    """One function or method: (path, qualname) identity, its AST node,
+    and the resolved calls in its body (nested defs excluded — they
+    are their own nodes)."""
+
+    __slots__ = (
+        "path",
+        "qualname",
+        "node",
+        "lineno",
+        "class_name",
+        "calls",
+    )
+
+    def __init__(self, path, qualname, node, class_name):
+        self.path = path
+        self.qualname = qualname
+        self.node = node
+        self.lineno = node.lineno
+        self.class_name = class_name
+        self.calls: List[CallSite] = []
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.path, self.qualname)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.qualname}"
+
+
+class ModuleIndex:
+    """Per-module name tables: defs, classes (methods, base names,
+    attribute annotations), and the import environment resolved to
+    package-relative paths."""
+
+    def __init__(self, path: str, source: str, pkg_name: str) -> None:
+        self.path = path  # posix path relative to the package root
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.pkg_name = pkg_name
+        # dotted module of this file inside the package, e.g.
+        # "types.vote" for types/vote.py, "types" for types/__init__.py,
+        # "" for the package root __init__.py (so `from <pkg> import X`
+        # / `from . import X` re-exports through the root resolve)
+        mod = path[:-3].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        elif mod == "__init__":
+            mod = ""
+        self.dotted = mod
+        self.functions: Dict[str, ast.AST] = {}  # module-level defs
+        self.classes: Dict[str, dict] = {}  # name -> class record
+        self.import_alias: Dict[str, str] = {}  # local -> dotted module
+        # local -> (internal module path | None, external dotted | None,
+        #           original name)
+        self.from_imports: Dict[str, Tuple[Optional[str], Optional[str], str]] = {}
+        self._index()
+
+    # -- import resolution --
+
+    def _resolve_relative(self, module: Optional[str], level: int) -> str:
+        """Absolute dotted target of a (possibly relative) from-import,
+        WITHOUT the package prefix when internal; e.g. in types/vote.py,
+        `from ..encoding.proto import X` -> "encoding.proto"."""
+        if level == 0:
+            mod = module or ""
+            prefix = self.pkg_name + "."
+            if mod == self.pkg_name:
+                return ""
+            if mod.startswith(prefix):
+                return mod[len(prefix):]
+            return "!" + mod  # external, tagged
+        # relative: climb from this module's package
+        parts = self.dotted.split(".")[:-1] if "." in self.dotted else []
+        if self.path.endswith("__init__.py"):
+            parts = self.dotted.split(".") if self.dotted else []
+        drop = level - 1
+        if drop > len(parts):
+            return "!" + (module or "")
+        base = parts[: len(parts) - drop]
+        if module:
+            base = base + module.split(".")
+        return ".".join(base)
+
+    def _index(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    self.import_alias[local] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                target = self._resolve_relative(node.module, node.level)
+                for a in node.names:
+                    local = a.asname or a.name
+                    if target.startswith("!"):
+                        self.from_imports[local] = (None, target[1:], a.name)
+                    else:
+                        self.from_imports[local] = (target, None, a.name)
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                methods: Dict[str, ast.AST] = {}
+                attrs: Dict[str, str] = {}
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        methods[item.name] = item
+                    elif isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name
+                    ):
+                        t = _annotation_type_name(item.annotation)
+                        if t:
+                            attrs[item.target.id] = t
+                        et = _element_type_name(item.annotation)
+                        if et:
+                            attrs["*" + item.target.id] = et
+                # `self.x: T = ...` annotations inside methods
+                for item in node.body:
+                    if not isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    for sub in ast.walk(item):
+                        if (
+                            isinstance(sub, ast.AnnAssign)
+                            and isinstance(sub.target, ast.Attribute)
+                            and isinstance(sub.target.value, ast.Name)
+                            and sub.target.value.id == "self"
+                        ):
+                            t = _annotation_type_name(sub.annotation)
+                            if t:
+                                attrs.setdefault(sub.target.attr, t)
+                            et = _element_type_name(sub.annotation)
+                            if et:
+                                attrs.setdefault("*" + sub.target.attr, et)
+                self.classes[node.name] = {
+                    "node": node,
+                    "methods": methods,
+                    "bases": [_dotted(b) for b in node.bases],
+                    "attrs": attrs,
+                }
+
+
+class Package:
+    """The whole-package call graph."""
+
+    def __init__(self, root: str, pkg_name: str) -> None:
+        self.root = root
+        self.pkg_name = pkg_name
+        self.modules: Dict[str, ModuleIndex] = {}
+        self.functions: Dict[Tuple[str, str], FuncInfo] = {}
+        # dotted module -> path for internal modules
+        self._by_dotted: Dict[str, str] = {}
+
+    # -- lookups --
+
+    def module_for_dotted(self, dotted: str) -> Optional[ModuleIndex]:
+        path = self._by_dotted.get(dotted)
+        return self.modules.get(path) if path else None
+
+    def find_class(
+        self, mod: ModuleIndex, name: str
+    ) -> Optional[Tuple[ModuleIndex, dict]]:
+        """Resolve a class name visible in `mod` (local or imported)."""
+        rec = mod.classes.get(name)
+        if rec is not None:
+            return mod, rec
+        fi = mod.from_imports.get(name)
+        if fi is not None and fi[0] is not None:
+            target = self.module_for_dotted(fi[0])
+            if target is not None:
+                rec = target.classes.get(fi[2])
+                if rec is not None:
+                    return target, rec
+                # re-exported through an __init__: chase one more hop
+                fi2 = target.from_imports.get(fi[2])
+                if fi2 is not None and fi2[0] is not None:
+                    t2 = self.module_for_dotted(fi2[0])
+                    if t2 is not None and fi2[2] in t2.classes:
+                        return t2, t2.classes[fi2[2]]
+        return None
+
+    def _method_key(
+        self, mod: ModuleIndex, class_name: str, method: str, _depth: int = 0
+    ) -> Optional[Tuple[str, str]]:
+        """(path, qualname) of class_name.method, following same/
+        cross-module base classes a few levels deep."""
+        if _depth > 4:
+            return None
+        found = self.find_class(mod, class_name)
+        if found is None:
+            return None
+        owner, rec = found
+        if method in rec["methods"]:
+            return (owner.path, f"{_class_name(rec)}.{method}")
+        for base in rec["bases"]:
+            base = base.split(".")[-1]
+            key = self._method_key(owner, base, method, _depth + 1)
+            if key is not None:
+                return key
+        return None
+
+    # -- construction --
+
+    def build(self) -> None:
+        for abspath in iter_py_files(self.root):
+            rel = os.path.relpath(abspath, self.root).replace(os.sep, "/")
+            try:
+                with open(abspath, "r", encoding="utf-8") as f:
+                    source = f.read()
+                mod = ModuleIndex(rel, source, self.pkg_name)
+            except (SyntaxError, OSError):
+                continue
+            self.modules[rel] = mod
+            self._by_dotted[mod.dotted] = rel
+        for mod in self.modules.values():
+            self._collect_functions(mod)
+        for mod in self.modules.values():
+            self._resolve_module_calls(mod)
+
+    def _collect_functions(self, mod: ModuleIndex) -> None:
+        def visit(node, prefix, class_name):
+            for item in getattr(node, "body", []):
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{item.name}"
+                    fi = FuncInfo(mod.path, qual, item, class_name)
+                    self.functions[fi.key] = fi
+                    visit(item, qual + ".", class_name)
+                elif isinstance(item, ast.ClassDef):
+                    visit(item, f"{prefix}{item.name}.", item.name)
+
+        visit(mod.tree, "", None)
+
+    # -- call resolution --
+
+    def _local_types(self, mod: ModuleIndex, fn: ast.AST) -> Dict[str, str]:
+        """varname -> class name for `x = SomeClass(...)` assignments
+        (and `for v in self.<attr>` / comprehensions over annotated
+        container attributes)."""
+        out: Dict[str, str] = {}
+        class_attrs: Dict[str, str] = {}
+        # class attr annotations visible through `self`
+        for rec in mod.classes.values():
+            for m in rec["methods"].values():
+                if m is fn:
+                    class_attrs = rec["attrs"]
+        for node in _body_walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                cname = _dotted(node.value.func).split(".")[-1]
+                if cname and (
+                    cname in mod.classes
+                    or cname in mod.from_imports
+                ):
+                    if cname[:1].isupper():
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                out[tgt.id] = cname
+            it = None
+            tgt = None
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                it, tgt = node.iter, node.target
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+            ):
+                for gen in node.generators:
+                    et = self._iter_elem_type(mod, class_attrs, gen.iter)
+                    if et and isinstance(gen.target, ast.Name):
+                        out[gen.target.id] = et
+            if it is not None and isinstance(tgt, ast.Name):
+                et = self._iter_elem_type(mod, class_attrs, it)
+                if et:
+                    out[tgt.id] = et
+        return out
+
+    def _iter_elem_type(
+        self, mod: ModuleIndex, class_attrs: Dict[str, str], it: ast.AST
+    ) -> str:
+        if (
+            isinstance(it, ast.Attribute)
+            and isinstance(it.value, ast.Name)
+            and it.value.id == "self"
+        ):
+            return class_attrs.get("*" + it.attr, "")
+        return ""
+
+    def _resolve_module_calls(self, mod: ModuleIndex) -> None:
+        for fi in self.functions.values():
+            if fi.path != mod.path:
+                continue
+            local_types = self._local_types(mod, fi.node)
+            class_attrs: Dict[str, str] = {}
+            if fi.class_name and fi.class_name in mod.classes:
+                class_attrs = mod.classes[fi.class_name]["attrs"]
+            for node in _body_walk(fi.node):
+                if isinstance(node, ast.Call):
+                    site = self._resolve_call(
+                        mod, fi, node, local_types, class_attrs
+                    )
+                    if site is not None:
+                        fi.calls.append(site)
+
+    def _resolve_call(
+        self,
+        mod: ModuleIndex,
+        fi: FuncInfo,
+        node: ast.Call,
+        local_types: Dict[str, str],
+        class_attrs: Dict[str, str],
+    ) -> Optional[CallSite]:
+        func = node.func
+        lineno = node.lineno
+        col = node.col_offset
+
+        def internal(key):
+            if key is not None and key in self.functions:
+                return CallSite(key, None, lineno, col)
+            return None
+
+        def external(name):
+            return CallSite(None, name, lineno, col)
+
+        # plain name call: local function, from-import, or builtin
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mod.functions:
+                return internal((mod.path, name))
+            if name in mod.classes:
+                return internal((mod.path, f"{name}.__init__"))
+            fi_entry = mod.from_imports.get(name)
+            if fi_entry is not None:
+                tgt_mod, ext, orig = fi_entry
+                if ext is not None:
+                    return external(f"{ext}.{orig}" if ext else orig)
+                # tgt_mod == "" is the package ROOT __init__ — a valid
+                # internal module, not an absent one
+                target = (
+                    self.module_for_dotted(tgt_mod)
+                    if tgt_mod is not None
+                    else None
+                )
+                if target is not None:
+                    if orig in target.functions:
+                        return internal((target.path, orig))
+                    if orig in target.classes:
+                        return internal(
+                            (target.path, f"{orig}.__init__")
+                        )
+                    # re-export chase (package __init__)
+                    fi2 = target.from_imports.get(orig)
+                    if fi2 is not None and fi2[0] is not None:
+                        t2 = self.module_for_dotted(fi2[0])
+                        if t2 is not None:
+                            if fi2[2] in t2.functions:
+                                return internal((t2.path, fi2[2]))
+                            if fi2[2] in t2.classes:
+                                return internal(
+                                    (t2.path, f"{fi2[2]}.__init__")
+                                )
+                return None
+            # builtin or unknown bare name: report as external so the
+            # taint pass can catch id()/float()/etc.
+            return external(name)
+
+        if not isinstance(func, ast.Attribute):
+            return None
+
+        dotted = _dotted(func)
+        if not dotted:
+            # something.method() on a non-name expression; try
+            # `self.attr.m()` shape below via structure
+            return self._resolve_attr_chain(
+                mod, fi, func, class_attrs, lineno, col
+            )
+        parts = dotted.split(".")
+        head, method = parts[0], parts[-1]
+
+        # self.m() / cls.m()
+        if head in ("self", "cls") and len(parts) == 2 and fi.class_name:
+            key = self._method_key(mod, fi.class_name, method)
+            if key is not None:
+                return CallSite(key, None, lineno, col)
+            return None
+
+        # self.attr.m()
+        if head == "self" and len(parts) == 3:
+            attr_type = class_attrs.get(parts[1])
+            if attr_type:
+                key = self._method_key(mod, attr_type, method)
+                if key is not None:
+                    return CallSite(key, None, lineno, col)
+            return None
+
+        # x.m() where x has a locally inferred class type
+        if len(parts) == 2 and head in local_types:
+            key = self._method_key(mod, local_types[head], method)
+            if key is not None:
+                return CallSite(key, None, lineno, col)
+            return None
+
+        # mod.f() through an import alias (possibly dotted alias)
+        alias = mod.import_alias.get(head)
+        if alias is not None:
+            full = ".".join([alias] + parts[1:])
+            prefix = self.pkg_name + "."
+            if full.startswith(prefix) or alias == self.pkg_name:
+                inner = full[len(prefix):] if full.startswith(prefix) else ""
+                return self._resolve_internal_dotted(inner, lineno, col)
+            return CallSite(None, full, lineno, col)
+
+        # module object via from-import: `from ..crypto import merkle`
+        fi_entry = mod.from_imports.get(head)
+        if fi_entry is not None and fi_entry[0] is not None:
+            base = (
+                fi_entry[0] + "." + fi_entry[2]
+                if fi_entry[0]
+                else fi_entry[2]
+            )
+            target = self.module_for_dotted(base)
+            if target is not None and len(parts) == 2:
+                if method in target.functions:
+                    return CallSite(
+                        (target.path, method), None, lineno, col
+                    )
+                if method in target.classes:
+                    return internal((target.path, f"{method}.__init__"))
+                return None
+            # class method through imported class: Cls.m()
+            found = self.find_class(mod, head)
+            if found is not None and len(parts) == 2:
+                key = self._method_key(mod, head, method)
+                if key is not None:
+                    return CallSite(key, None, lineno, col)
+            return None
+
+        # ClassName.method() on a local class
+        if head in mod.classes and len(parts) == 2:
+            key = self._method_key(mod, head, method)
+            if key is not None:
+                return CallSite(key, None, lineno, col)
+            return None
+
+        # unknown receiver — external dotted name for catalog matching
+        return CallSite(None, dotted, lineno, col)
+
+    def _resolve_internal_dotted(
+        self, inner: str, lineno: int, col: int
+    ) -> Optional[CallSite]:
+        """Resolve "types.vote.Vote" style fully-dotted internal refs."""
+        if not inner:
+            return None
+        parts = inner.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            modname = ".".join(parts[:split])
+            target = self.module_for_dotted(modname)
+            if target is None:
+                continue
+            rest = parts[split:]
+            if len(rest) == 1:
+                if rest[0] in target.functions:
+                    return CallSite(
+                        (target.path, rest[0]), None, lineno, col
+                    )
+                if rest[0] in target.classes:
+                    key = (target.path, f"{rest[0]}.__init__")
+                    if key in self.functions:
+                        return CallSite(key, None, lineno, col)
+                    return None
+            elif len(rest) == 2 and rest[0] in target.classes:
+                key = self._method_key(target, rest[0], rest[1])
+                if key is not None:
+                    return CallSite(key, None, lineno, col)
+            return None
+        return None
+
+    def _resolve_attr_chain(
+        self, mod, fi, func, class_attrs, lineno, col
+    ) -> Optional[CallSite]:
+        # `self.conflicting_block.signed_header.hash()` — too dynamic;
+        # give up (documented limitation)
+        return None
+
+
+def _class_name(rec: dict) -> str:
+    return rec["node"].name
+
+
+def _body_walk(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested function or
+    class definitions (they are separate graph nodes)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def build_package(root: Optional[str] = None) -> Package:
+    from ..tmlint import package_root
+
+    root = root or package_root()
+    pkg = Package(root, os.path.basename(os.path.abspath(root)))
+    pkg.build()
+    return pkg

@@ -1,0 +1,800 @@
+"""Static wire-schema extraction + conformance checking.
+
+The hand codec (`encoding/proto.py` + per-type `to_proto`/`from_proto`)
+replaces ~33k LoC of generated gogo-proto; its field numbers, wire
+types, and emission order ARE the protocol. This module recovers that
+schema statically — no imports, no execution — from every encoder/
+decoder in the codec-bearing modules, checks it for internal
+consistency, and diffs it against the checked-in golden table
+(`analysis/tmcheck/schema.json`, derived from the reference .proto
+files; each entry records which reference message it mirrors).
+
+Per message the extractor recovers, from the encoder:
+    [ {tag, method, wire, repeated, conditional} ... ]  in emission order
+(`repeated`: the write sits in a loop; `conditional`: under an `if` —
+mutually-exclusive oneof arms and nullable submessages), and from the
+decoder the set of parsed tags. Three checks:
+
+- **schema-drift** — extracted encoder schema differs from the golden
+  table (tag, wire type, writer method, order, flags) or a message
+  appeared/disappeared. Canonical bytes changed ⇒ tier-1 failure; the
+  reviewed update path is `scripts/lint.py --schema-update`.
+- **schema-order** — a writer emits a higher tag before a lower one on
+  one control-flow path (ProtoWriter would raise at runtime; this
+  catches it before any test constructs the message). Writes in
+  disjoint branches of one `if`/`elif` chain are exempt (oneofs).
+- **schema-symmetry** — a tag written but never parsed (or parsed but
+  never written) by the paired decoder. Deliberate asymmetries are
+  annotated in-source: `# tmcheck: unparsed=N — why` inside the
+  encoder/decoder pair's bodies (e.g. ValidatorSet total_voting_power
+  is recomputed, not trusted from the wire), `# tmcheck: unwritten=N
+  — why` for read-only legacy tags.
+
+Encoder recognition: a function in a scoped module that instantiates
+`ProtoWriter()` and whose name is `to_proto`/`to_proto_bytes`/
+`encode_*`/`_enc_*`/one of the canonical sign-bytes builders. Only the
+*primary* writer's fields (the one whose `.finish()` is returned) form
+the message; nested inline writers are separate messages only when
+they live in their own function (the codebase's dominant idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..tmlint import Violation, dotted_name, iter_py_files, package_root
+
+__all__ = [
+    "GOLDEN_PATH",
+    "SCHEMA_SCOPE_PREFIXES",
+    "SCHEMA_SCOPE_FILES",
+    "extract_module",
+    "extract_package",
+    "load_golden",
+    "save_golden",
+    "schema_violations",
+    "check_package_schema",
+]
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "schema.json")
+
+# the codec-bearing layers the extractor indexes
+SCHEMA_SCOPE_PREFIXES = ("types/",)
+SCHEMA_SCOPE_FILES = {
+    "abci/codec.py",
+    "consensus/msgs.py",
+    "blocksync/msgs.py",
+    "statesync/msgs.py",
+    "mempool/reactor.py",
+    "evidence/reactor.py",
+    "p2p/types.py",
+    "crypto/keys.py",
+    "crypto/merkle.py",
+}
+
+
+def in_schema_scope(path: str) -> bool:
+    return path in SCHEMA_SCOPE_FILES or path.startswith(
+        SCHEMA_SCOPE_PREFIXES
+    )
+
+
+# writer method -> proto wire type name
+_WIRE = {
+    "uint": "varint",
+    "int": "varint",
+    "sint": "varint",
+    "bool": "varint",
+    "sfixed64": "fixed64",
+    "fixed64": "fixed64",
+    "double": "fixed64",
+    "sfixed32": "fixed32",
+    "bytes": "bytes",
+    "string": "bytes",
+    "message": "bytes",
+}
+
+# FieldReader accessors / iter_fields loops mark a tag as parsed
+_READER_METHODS = {
+    "get",
+    "get_all",
+    "uint",
+    "int64",
+    "sfixed64",
+    "bytes",
+    "string",
+    "bool",
+}
+
+_ENCODER_NAME_RE = re.compile(
+    r"^(to_proto|to_proto_bytes|\w+_to_proto|encode_\w+|_enc_\w+"
+    r"|hash_bytes|canonical_\w+|\w*_sign_bytes)$"
+)
+_DECODER_NAME_RE = re.compile(
+    r"^(from_proto|from_proto_bytes|\w+_from_proto|decode_\w+|_dec_\w+)$"
+)
+
+_ANNOT_RE = re.compile(
+    r"#\s*tmcheck:\s*(unparsed|unwritten)=([0-9, ]+)"
+)
+
+
+# ---------------------------------------------------------------------------
+# extraction
+
+
+class FieldWrite:
+    __slots__ = ("tag", "method", "lineno", "repeated", "conditional", "node")
+
+    def __init__(self, tag, method, lineno, repeated, conditional, node):
+        self.tag = tag
+        self.method = method
+        self.lineno = lineno
+        self.repeated = repeated
+        self.conditional = conditional
+        self.node = node
+
+    def as_json(self) -> dict:
+        return {
+            "tag": self.tag,
+            "method": self.method,
+            "wire": _WIRE[self.method],
+            "repeated": self.repeated,
+            "conditional": self.conditional,
+        }
+
+
+class MessageSchema:
+    """One extracted message: encoder field list + decoder tag set."""
+
+    def __init__(self, name: str, path: str) -> None:
+        self.name = name  # "types/vote.py::Vote"
+        self.path = path
+        self.enc_func: Optional[str] = None
+        self.enc_lineno: int = 0
+        self.dec_func: Optional[str] = None
+        self.dec_lineno: int = 0
+        self.fields: List[FieldWrite] = []
+        self.parsed: Set[int] = set()
+        self.unparsed_ok: Set[int] = set()
+        self.unwritten_ok: Set[int] = set()
+        self.reference: str = ""
+
+    def as_json(self) -> dict:
+        out = {
+            "fields": [f.as_json() for f in self.fields],
+            "parsed": sorted(self.parsed) if self.dec_func else None,
+        }
+        if self.reference:
+            out["reference"] = self.reference
+        if self.unparsed_ok:
+            out["unparsed_ok"] = sorted(self.unparsed_ok)
+        if self.unwritten_ok:
+            out["unwritten_ok"] = sorted(self.unwritten_ok)
+        return out
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    out: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            out[child] = parent
+    return out
+
+
+def _docstring_reference(node: ast.AST) -> str:
+    """First `reference:`-citing line of a docstring — the provenance
+    link to the reference .proto recorded in schema.json. Callers fall
+    back def -> class -> module, so a module-level citation (the
+    dominant style in types/ and abci/codec.py) covers every message
+    in the file unless a closer one exists."""
+    doc = ast.get_docstring(node) or ""
+    for line in doc.splitlines():
+        if "reference:" in line.lower() or ".pb.go" in line or ".proto" in line:
+            return line.strip()
+    return ""
+
+
+def _annotations(
+    lines: Sequence[str], lo: int, hi: int
+) -> Tuple[Set[int], Set[int]]:
+    unparsed: Set[int] = set()
+    unwritten: Set[int] = set()
+    for i in range(max(lo - 1, 0), min(hi, len(lines))):
+        m = _ANNOT_RE.search(lines[i])
+        if not m:
+            continue
+        tags = {
+            int(t) for t in m.group(2).replace(" ", "").split(",") if t
+        }
+        (unparsed if m.group(1) == "unparsed" else unwritten).update(tags)
+    return unparsed, unwritten
+
+
+def _func_end(node: ast.AST) -> int:
+    return getattr(node, "end_lineno", node.lineno) or node.lineno
+
+
+def _writer_vars(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and dotted_name(node.value.func).split(".")[-1] == "ProtoWriter"
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _primary_writer(fn: ast.AST, writers: Set[str]) -> Optional[str]:
+    """The writer whose .finish() the function returns (possibly inside
+    a wrapping call like length_prefixed(w.finish()))."""
+    if len(writers) == 1:
+        return next(iter(writers))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "finish"
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id in writers
+                ):
+                    return sub.func.value.id
+    return None
+
+
+def _collect_writes(
+    fn: ast.AST, writer: str, parents: Dict[ast.AST, ast.AST]
+) -> List[FieldWrite]:
+    writes: List[FieldWrite] = []
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == writer
+            and node.func.attr in _WIRE
+        ):
+            continue
+        if not node.args:
+            continue
+        tags: List[int] = []
+        oneof = False
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.Constant) and isinstance(arg0.value, int):
+            tags = [arg0.value]
+        elif isinstance(arg0, ast.Name):
+            # the oneof idiom: `fieldno = {...: 1, ...: 2}[key]` — the
+            # write emits exactly one of the dict's value tags
+            tags = sorted(_dict_subscript_values(fn, arg0.id))
+            oneof = bool(tags)
+        if not tags:
+            continue
+        repeated = False
+        conditional = False
+        cur = parents.get(node)
+        while cur is not None and cur is not fn:
+            if isinstance(
+                cur,
+                (ast.For, ast.AsyncFor, ast.While, ast.comprehension),
+            ):
+                repeated = True
+            if isinstance(cur, ast.If):
+                conditional = True
+            cur = parents.get(cur)
+        for tag in tags:
+            writes.append(
+                FieldWrite(
+                    tag,
+                    node.func.attr,
+                    node.lineno,
+                    repeated,
+                    conditional or oneof,
+                    node,
+                )
+            )
+    writes.sort(key=lambda w: (w.lineno, w.tag))
+    return writes
+
+
+def _dict_subscript_values(fn: ast.AST, name: str) -> Set[int]:
+    """Int values of `name = {<...>: 1, <...>: 2}[<expr>]` assignments
+    in `fn` — the computed-tag oneof idiom."""
+    out: Set[int] = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        )):
+            continue
+        val = node.value
+        if isinstance(val, ast.Subscript) and isinstance(
+            val.value, ast.Dict
+        ):
+            for v in val.value.values:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    out.add(v.value)
+    return out
+
+
+def _branch_path(
+    node: ast.AST, fn: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> List[Tuple[int, str]]:
+    """The chain of (if-node-id, arm) pairs enclosing `node` — two
+    writes whose paths diverge at a common If are mutually exclusive."""
+    path: List[Tuple[int, str]] = []
+    cur = node
+    while cur is not None and cur is not fn:
+        parent = parents.get(cur)
+        if isinstance(parent, ast.If):
+            arm = "body" if cur in parent.body else "orelse"
+            path.append((id(parent), arm))
+        cur = parent
+    path.reverse()
+    return path
+
+
+def _mutually_exclusive(
+    a: FieldWrite, b: FieldWrite, fn: ast.AST, parents
+) -> bool:
+    pa = _branch_path(a.node, fn, parents)
+    pb = _branch_path(b.node, fn, parents)
+    for (ia, arma), (ib, armb) in zip(pa, pb):
+        if ia == ib and arma != armb:
+            return True
+        if ia != ib:
+            break
+    return False
+
+
+def _is_iter_fields_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and dotted_name(node.func).split(".")[-1] == "iter_fields"
+    )
+
+
+def _collect_reads(fn: ast.AST) -> Set[int]:
+    """Tags a decoder consumes: FieldReader accessor calls with literal
+    tags (through a reader variable or chained directly off
+    `FieldReader(data)`), and `if f == N` / `elif f in <literal
+    container>` comparisons on an iter_fields loop variable (For loops
+    and comprehensions). Readers created INSIDE an iter_fields loop
+    parse a nested submessage and do not count toward this message."""
+    reads: Set[int] = set()
+    # nodes living inside an iter_fields For body (nested submessage
+    # parsing region)
+    nested: Set[int] = set()
+    loop_vars: Set[str] = set()
+    for node in ast.walk(fn):
+        it = None
+        tgt = None
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            it, tgt = node.iter, node.target
+            if _is_iter_fields_call(it):
+                for sub in ast.walk(node):
+                    nested.add(id(sub))
+        elif isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            for gen in node.generators:
+                if _is_iter_fields_call(gen.iter):
+                    t = gen.target
+                    if isinstance(t, ast.Tuple) and t.elts:
+                        t = t.elts[0]
+                    if isinstance(t, ast.Name):
+                        loop_vars.add(t.id)
+        if it is not None and _is_iter_fields_call(it):
+            if isinstance(tgt, ast.Tuple) and tgt.elts:
+                first = tgt.elts[0]
+                if isinstance(first, ast.Name):
+                    loop_vars.add(first.id)
+    # reader vars: r = FieldReader(...) — outside nested regions only
+    readers: Set[str] = set()
+    local_containers: Dict[str, Set[int]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            cname = dotted_name(node.value.func).split(".")[-1]
+            if cname == "FieldReader" and id(node) not in nested:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        readers.add(tgt.id)
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, (ast.Dict, ast.Set, ast.Tuple, ast.List)
+        ):
+            keys: Set[int] = set()
+            elems = (
+                node.value.keys
+                if isinstance(node.value, ast.Dict)
+                else node.value.elts
+            )
+            for e in elems:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    keys.add(e.value)
+            if keys:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local_containers[tgt.id] = keys
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _READER_METHODS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, int)
+        ):
+            recv = node.func.value
+            via_var = (
+                isinstance(recv, ast.Name) and recv.id in readers
+            )
+            chained = (
+                isinstance(recv, ast.Call)
+                and dotted_name(recv.func).split(".")[-1] == "FieldReader"
+                and id(node) not in nested
+            )
+            if via_var or chained:
+                reads.add(node.args[0].value)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+            left = node.left
+            if not (isinstance(left, ast.Name) and left.id in loop_vars):
+                continue
+            comp = node.comparators[0]
+            if isinstance(node.ops[0], ast.Eq):
+                if isinstance(comp, ast.Constant) and isinstance(
+                    comp.value, int
+                ):
+                    reads.add(comp.value)
+            elif isinstance(node.ops[0], ast.In):
+                if isinstance(comp, ast.Name) and comp.id in local_containers:
+                    reads.update(local_containers[comp.id])
+                elif isinstance(comp, (ast.Tuple, ast.Set, ast.List)):
+                    for e in comp.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                            e.value, int
+                        ):
+                            reads.add(e.value)
+    return reads
+
+
+def _pair_key(path: str, class_name: Optional[str], fname: str) -> str:
+    """Message identity an encoder/decoder pair shares."""
+    if class_name:
+        return f"{path}::{class_name}"
+    m = re.match(r"^(?:_enc_|encode_)(\w+)$", fname)
+    if m:
+        return f"{path}::{m.group(1)}"
+    m = re.match(r"^(?:_dec_|decode_)(\w+)$", fname)
+    if m:
+        return f"{path}::{m.group(1)}"
+    m = re.match(r"^(\w+)_(?:to|from)_proto$", fname)
+    if m:
+        return f"{path}::{m.group(1)}"
+    return f"{path}::{fname}"
+
+
+def extract_module(
+    source: str, path: str
+) -> Tuple[Dict[str, MessageSchema], List[Violation]]:
+    """Extract every message schema from one module; also returns
+    schema-order violations found during extraction."""
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    parents = _parents(tree)
+    module_ref = _docstring_reference(tree)
+    messages: Dict[str, MessageSchema] = {}
+    order_violations: List[Violation] = []
+
+    def visit(body, class_name, class_node):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                visit(node.body, node.name, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                handle(node, class_name, class_node)
+
+    def handle(fn, class_name, class_node):
+        is_enc = bool(_ENCODER_NAME_RE.match(fn.name))
+        is_dec = bool(_DECODER_NAME_RE.match(fn.name))
+        if not (is_enc or is_dec):
+            return
+        # only the canonical method pair (and encode_X/_enc_X name
+        # pairs) share a message; other encoders (hash_bytes, the
+        # canonical sign-bytes builders) are distinct encode-only
+        # messages — a hash-leaf schema is not the wire schema
+        pairable = fn.name in (
+            "to_proto",
+            "to_proto_bytes",
+            "from_proto",
+            "from_proto_bytes",
+        ) or not class_name
+        key = _pair_key(path, class_name, fn.name)
+        if not pairable:
+            key = f"{key}.{fn.name}"
+        if is_enc:
+            writers = _writer_vars(fn)
+            if not writers:
+                return
+            primary = _primary_writer(fn, writers)
+            if primary is None:
+                return
+            msg = messages.setdefault(key, MessageSchema(key, path))
+            msg.enc_func = fn.name
+            msg.enc_lineno = fn.lineno
+            msg.fields = _collect_writes(fn, primary, parents)
+            ref = (
+                _docstring_reference(fn)
+                or (_docstring_reference(class_node) if class_node else "")
+                or module_ref
+            )
+            if ref and not msg.reference:
+                msg.reference = ref
+            up, uw = _annotations(lines, fn.lineno, _func_end(fn))
+            msg.unparsed_ok |= up
+            msg.unwritten_ok |= uw
+            # ascending-tag check on each control-flow path
+            flat = msg.fields
+            for i in range(len(flat)):
+                for j in range(i + 1, len(flat)):
+                    a, b = flat[i], flat[j]
+                    if a.tag <= b.tag:
+                        continue
+                    if _mutually_exclusive(a, b, fn, parents):
+                        continue
+                    order_violations.append(
+                        Violation(
+                            rule="schema-order",
+                            path=path,
+                            line=b.lineno,
+                            col=0,
+                            message=(
+                                f"{key}: field {b.tag} written after field "
+                                f"{a.tag} (line {a.lineno}) — non-canonical "
+                                "emission order; ProtoWriter will raise at "
+                                "runtime"
+                            ),
+                            source=(
+                                lines[b.lineno - 1].strip()
+                                if b.lineno <= len(lines)
+                                else ""
+                            ),
+                        )
+                    )
+                    break
+        if is_dec:
+            msg = messages.setdefault(key, MessageSchema(key, path))
+            msg.dec_func = fn.name
+            msg.dec_lineno = fn.lineno
+            msg.parsed |= _collect_reads(fn)
+            if not msg.reference:
+                msg.reference = (
+                    _docstring_reference(fn)
+                    or (
+                        _docstring_reference(class_node)
+                        if class_node
+                        else ""
+                    )
+                    or module_ref
+                )
+            up, uw = _annotations(lines, fn.lineno, _func_end(fn))
+            msg.unparsed_ok |= up
+            msg.unwritten_ok |= uw
+
+    visit(tree.body, None, None)
+    # prune entries with nothing statically extractable: decoder-only
+    # passthroughs, and registry-driven codecs whose tags are runtime
+    # values on both sides (pubkey_to_proto/_from_proto — the ABCI
+    # _enc_pub_key twin with literal tags covers that oneof's schema)
+    for key in [
+        k
+        for k, m in messages.items()
+        if not m.fields and not m.parsed
+    ]:
+        del messages[key]
+    return messages, order_violations
+
+
+def extract_package(
+    root: Optional[str] = None,
+) -> Tuple[Dict[str, MessageSchema], List[Violation]]:
+    root = root or package_root()
+    messages: Dict[str, MessageSchema] = {}
+    violations: List[Violation] = []
+    for abspath in iter_py_files(root):
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        if not in_schema_scope(rel):
+            continue
+        try:
+            with open(abspath, "r", encoding="utf-8") as f:
+                source = f.read()
+            msgs, ov = extract_module(source, rel)
+        except (SyntaxError, OSError):
+            continue
+        messages.update(msgs)
+        violations.extend(ov)
+    return messages, violations
+
+
+# ---------------------------------------------------------------------------
+# symmetry
+
+
+def symmetry_violations(
+    messages: Dict[str, MessageSchema]
+) -> List[Violation]:
+    out: List[Violation] = []
+    for key in sorted(messages):
+        msg = messages[key]
+        if msg.enc_func is None or msg.dec_func is None:
+            continue
+        written = {f.tag for f in msg.fields}
+        for tag in sorted(written - msg.parsed - msg.unparsed_ok):
+            out.append(
+                Violation(
+                    rule="schema-symmetry",
+                    path=msg.path,
+                    line=msg.enc_lineno,
+                    col=0,
+                    message=(
+                        f"{key}: field {tag} is written by {msg.enc_func} "
+                        f"but never parsed by {msg.dec_func}; annotate "
+                        "`# tmcheck: unparsed={t} — why` if deliberate"
+                    ).replace("{t}", str(tag)),
+                    source=f"{key} field {tag} unparsed",
+                )
+            )
+        for tag in sorted(msg.parsed - written - msg.unwritten_ok):
+            out.append(
+                Violation(
+                    rule="schema-symmetry",
+                    path=msg.path,
+                    line=msg.dec_lineno,
+                    col=0,
+                    message=(
+                        f"{key}: field {tag} is parsed by {msg.dec_func} "
+                        f"but never written by {msg.enc_func}; annotate "
+                        "`# tmcheck: unwritten={t} — why` if deliberate"
+                    ).replace("{t}", str(tag)),
+                    source=f"{key} field {tag} unwritten",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# golden table
+
+
+def load_golden(path: Optional[str] = None) -> Optional[dict]:
+    path = path or GOLDEN_PATH
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def save_golden(
+    messages: Dict[str, MessageSchema], path: Optional[str] = None
+) -> dict:
+    path = path or GOLDEN_PATH
+    data = {
+        "version": 1,
+        "generated_by": "scripts/lint.py --schema-update",
+        "note": (
+            "Golden wire schema for every hand-codec message: field "
+            "tags, wire types, writer methods, emission order, "
+            "repeated/conditional flags, and the decoder's parsed-tag "
+            "set. Each entry's `reference` records the reference "
+            ".proto/.pb.go message it mirrors (from the codec's "
+            "docstring citation). ANY diff against this table is a "
+            "tier-1 failure; after a reviewed protocol change, "
+            "regenerate with scripts/lint.py --schema-update and "
+            "review the diff like a .proto change."
+        ),
+        "messages": {k: messages[k].as_json() for k in sorted(messages)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return data
+
+
+def diff_golden(
+    messages: Dict[str, MessageSchema], golden: dict
+) -> List[Violation]:
+    out: List[Violation] = []
+    gmsgs = golden.get("messages", {})
+    for key in sorted(set(gmsgs) - set(messages)):
+        out.append(
+            Violation(
+                rule="schema-drift",
+                path=key.split("::")[0],
+                line=1,
+                col=0,
+                message=(
+                    f"{key}: message present in golden schema.json but no "
+                    "longer extracted — codec deleted or renamed; if "
+                    "intended, run scripts/lint.py --schema-update"
+                ),
+                source=f"missing message {key}",
+            )
+        )
+    for key in sorted(messages):
+        msg = messages[key]
+        gold = gmsgs.get(key)
+        if gold is None:
+            out.append(
+                Violation(
+                    rule="schema-drift",
+                    path=msg.path,
+                    line=msg.enc_lineno or msg.dec_lineno or 1,
+                    col=0,
+                    message=(
+                        f"{key}: new codec message not in the golden "
+                        "schema.json — add it via scripts/lint.py "
+                        "--schema-update (and cite the reference .proto "
+                        "in the docstring)"
+                    ),
+                    source=f"new message {key}",
+                )
+            )
+            continue
+        cur = msg.as_json()
+        for field_name in ("fields", "parsed"):
+            if cur.get(field_name) != gold.get(field_name):
+                out.append(
+                    Violation(
+                        rule="schema-drift",
+                        path=msg.path,
+                        line=msg.enc_lineno or msg.dec_lineno or 1,
+                        col=0,
+                        message=(
+                            f"{key}: {field_name} drifted from golden "
+                            f"schema.json\n    golden:    "
+                            f"{json.dumps(gold.get(field_name))}\n"
+                            f"    extracted: "
+                            f"{json.dumps(cur.get(field_name))}"
+                        ),
+                        source=f"{key} {field_name} drift",
+                    )
+                )
+    return out
+
+
+def schema_violations(
+    root: Optional[str] = None, golden_path: Optional[str] = None
+) -> List[Violation]:
+    """The full schema gate: extraction (order check) + symmetry +
+    golden diff."""
+    messages, violations = extract_package(root)
+    violations.extend(symmetry_violations(messages))
+    golden = load_golden(golden_path)
+    if golden is None:
+        violations.append(
+            Violation(
+                rule="schema-drift",
+                path="analysis/tmcheck/schema.json",
+                line=1,
+                col=0,
+                message=(
+                    "golden schema.json missing — generate it with "
+                    "scripts/lint.py --schema-update"
+                ),
+                source="missing schema.json",
+            )
+        )
+    else:
+        violations.extend(diff_golden(messages, golden))
+    violations.sort(key=lambda v: (v.path, v.line, v.rule, v.message))
+    return violations
+
+
+def check_package_schema(root: Optional[str] = None) -> List[Violation]:
+    return schema_violations(root)

@@ -1,0 +1,372 @@
+"""Interprocedural nondeterminism-taint analysis.
+
+The invariant (SURVEY.md "Determinism & safety", docs/static_analysis.md):
+every byte that enters sign-bytes or a consensus hash must be
+replica-identical. tmlint enforces that *syntactically inside* the
+consensus-critical modules; this pass enforces it *transitively*: no
+function reachable by calls from the sign-bytes/hash construction
+region may contain a nondeterminism source.
+
+Sink roots (where the protected byte streams are assembled):
+- every function in `types/canonical.py` (canonical sign-bytes),
+  `crypto/tmhash.py`, `crypto/merkle.py` (hash leaves/inner nodes),
+  and `encoding/proto.py` (the ProtoWriter all encoders feed);
+- every `to_proto` / `to_proto_bytes` / `sign_bytes` / `hash_bytes` /
+  `hash` function or method in `types/` (the encode direction — what
+  replicas hash and sign).
+
+Sources (what must never be reachable from a root):
+- wall-clock reads (`time.time`, `time.time_ns`, `datetime.now`, ...)
+- unseeded/global RNG (`random.*` module functions) and OS entropy
+  (`uuid1/4`, `secrets.*`); `os.urandom` outside the key-generation
+  modules
+- float arithmetic: float literals, `/` true division, `float()`
+- set iteration (order is PYTHONHASHSEED-dependent); dict iteration is
+  insertion-ordered in CPython >= 3.7 and deliberately exempt — the
+  codebase relies on that, same call as tmlint's det-set-iter
+- `id()` (per-process addresses)
+
+Suppressions (both require an in-file justification, policy in
+docs/static_analysis.md):
+- `# tmcheck: taint-ok — why` on (or in the comment block above) a
+  source line: the value provably never enters the protected bytes
+  (e.g. telemetry attributes).
+- `# tmcheck: taint-break — why` on a call line: taint does not
+  propagate through THIS edge (e.g. a tracing span whose timings go to
+  the metrics ring, never into the hash input).
+
+Remaining accepted findings live in a counted, content-fingerprinted
+baseline (taint_baseline.json) exactly like tmlint's.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..tmlint import Violation, dotted_name
+from .callgraph import CallSite, FuncInfo, Package, _body_walk, build_package
+
+__all__ = [
+    "SourceHit",
+    "TaintFinding",
+    "analyze",
+    "taint_violations",
+    "SINK_ROOT_MODULES",
+    "SINK_ROOT_NAMES",
+]
+
+# ---------------------------------------------------------------------------
+# catalogs
+
+_WALLCLOCK = {
+    "time.time",
+    "time.time_ns",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.today",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+    "date.today",
+}
+
+_RANDOM_MODULE_FNS = {
+    "random",
+    "choice",
+    "choices",
+    "shuffle",
+    "sample",
+    "randint",
+    "randrange",
+    "getrandbits",
+    "uniform",
+    "betavariate",
+    "gauss",
+    "normalvariate",
+    "expovariate",
+    "triangular",
+    "randbytes",
+}
+
+_ENTROPY = {
+    "uuid.uuid1",
+    "uuid.uuid4",
+    "secrets.token_bytes",
+    "secrets.token_hex",
+    "secrets.randbits",
+    "secrets.randbelow",
+    "secrets.choice",
+}
+
+# os.urandom is legitimate exactly where keys and nonces are born
+KEYGEN_MODULES = (
+    "crypto/keys.py",
+    "crypto/ed25519.py",
+    "crypto/sr25519.py",
+    "crypto/secp256k1.py",
+    "crypto/aead.py",
+    "crypto/merlin.py",
+)
+
+# where the protected byte streams are assembled
+SINK_ROOT_MODULES = (
+    "types/canonical.py",
+    "crypto/tmhash.py",
+    "crypto/merkle.py",
+    "encoding/proto.py",
+)
+SINK_ROOT_NAMES = (
+    "to_proto",
+    "to_proto_bytes",
+    "sign_bytes",
+    "hash_bytes",
+    "hash",
+)
+
+_SUPPRESS_RE = re.compile(r"#\s*tmcheck:\s*(taint-ok|taint-break)\b")
+
+
+# ---------------------------------------------------------------------------
+# source detection
+
+
+class SourceHit:
+    __slots__ = ("rule", "lineno", "detail")
+
+    def __init__(self, rule: str, lineno: int, detail: str) -> None:
+        self.rule = rule
+        self.lineno = lineno
+        self.detail = detail
+
+
+def _suppressed_lines(lines: List[str], kind: str) -> Set[int]:
+    """1-based line numbers carrying `# tmcheck: <kind>` — on the line
+    itself, or covering the first code line below a comment block
+    (same convention as tmlint suppressions)."""
+    out: Set[int] = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m or m.group(1) != kind:
+            continue
+        out.add(i)
+        if text.lstrip().startswith("#"):
+            j = i + 1
+            while j <= len(lines) and (
+                not lines[j - 1].strip()
+                or lines[j - 1].lstrip().startswith("#")
+            ):
+                j += 1
+            if j <= len(lines):
+                out.add(j)
+    return out
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in ("set", "frozenset")
+    return False
+
+
+def _classify_external(name: str, path: str) -> Optional[Tuple[str, str]]:
+    """(rule, detail) when a resolved external call is a source."""
+    if name in _WALLCLOCK:
+        return ("taint-wallclock", f"wall-clock read `{name}()`")
+    if name in _ENTROPY:
+        return ("taint-random", f"OS-entropy call `{name}()`")
+    if name == "os.urandom" and not path.startswith(KEYGEN_MODULES):
+        return ("taint-random", "`os.urandom()` outside keygen modules")
+    parts = name.split(".")
+    if (
+        len(parts) == 2
+        and parts[0] in ("random", "_random")
+        and parts[1] in _RANDOM_MODULE_FNS
+    ):
+        return ("taint-random", f"unseeded global RNG `{name}()`")
+    if name == "id":
+        return ("taint-id", "`id()` is a per-process address")
+    if name == "float":
+        return ("taint-float", "`float()` conversion")
+    return None
+
+
+def function_sources(fi: FuncInfo, lines: List[str]) -> List[SourceHit]:
+    """Nondeterminism sources syntactically inside one function body
+    (nested defs excluded), before suppression filtering."""
+    hits: List[SourceHit] = []
+    set_names: Set[str] = set()
+    for node in _body_walk(fi.node):
+        if isinstance(node, ast.Assign) and _is_set_expr(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    set_names.add(tgt.id)
+    for node in _body_walk(fi.node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, float):
+            hits.append(
+                SourceHit(
+                    "taint-float", node.lineno, f"float literal `{node.value!r}`"
+                )
+            )
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            hits.append(
+                SourceHit(
+                    "taint-float",
+                    node.lineno,
+                    "true division `/` produces a float",
+                )
+            )
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            it = node.iter
+            if _is_set_expr(it) or (
+                isinstance(it, ast.Name) and it.id in set_names
+            ):
+                hits.append(
+                    SourceHit(
+                        "taint-set-iter",
+                        node.lineno,
+                        "iteration over a set (hash-order dependent)",
+                    )
+                )
+        elif isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            for gen in node.generators:
+                it = gen.iter
+                if _is_set_expr(it) or (
+                    isinstance(it, ast.Name) and it.id in set_names
+                ):
+                    hits.append(
+                        SourceHit(
+                            "taint-set-iter",
+                            node.lineno,
+                            "comprehension over a set (hash-order dependent)",
+                        )
+                    )
+    # external source calls come from resolved CallSites so import
+    # aliasing can't hide them
+    for site in fi.calls:
+        if site.external:
+            cls = _classify_external(site.external, fi.path)
+            if cls is not None:
+                hits.append(SourceHit(cls[0], site.lineno, cls[1]))
+    return hits
+
+
+# ---------------------------------------------------------------------------
+# reachability
+
+
+class TaintFinding:
+    """One source site reachable from a sink root, with the witness
+    call chain (shortest, by BFS)."""
+
+    __slots__ = ("hit", "func", "chain")
+
+    def __init__(
+        self, hit: SourceHit, func: FuncInfo, chain: List[FuncInfo]
+    ) -> None:
+        self.hit = hit
+        self.func = func
+        self.chain = chain  # [root, ..., func]
+
+    def render_chain(self) -> str:
+        return " -> ".join(f.render() for f in self.chain)
+
+
+def _is_sink_root(fi: FuncInfo) -> bool:
+    if fi.path in SINK_ROOT_MODULES:
+        return True
+    if fi.path.startswith("types/"):
+        leaf = fi.qualname.split(".")[-1]
+        return leaf in SINK_ROOT_NAMES
+    return False
+
+
+def analyze(pkg: Optional[Package] = None) -> List[TaintFinding]:
+    pkg = pkg or build_package()
+    lines_by_path: Dict[str, List[str]] = {
+        path: mod.lines for path, mod in pkg.modules.items()
+    }
+    break_lines: Dict[str, Set[int]] = {
+        path: _suppressed_lines(lines, "taint-break")
+        for path, lines in lines_by_path.items()
+    }
+    ok_lines: Dict[str, Set[int]] = {
+        path: _suppressed_lines(lines, "taint-ok")
+        for path, lines in lines_by_path.items()
+    }
+
+    # multi-source BFS from every sink root, shortest chains
+    parents: Dict[Tuple[str, str], Optional[Tuple[str, str]]] = {}
+    queue: deque = deque()
+    for key, fi in pkg.functions.items():
+        if _is_sink_root(fi):
+            parents[key] = None
+            queue.append(key)
+    while queue:
+        key = queue.popleft()
+        fi = pkg.functions[key]
+        for site in fi.calls:
+            if site.target is None or site.target not in pkg.functions:
+                continue
+            if site.lineno in break_lines.get(fi.path, ()):
+                continue
+            if site.target not in parents:
+                parents[site.target] = key
+                queue.append(site.target)
+
+    findings: List[TaintFinding] = []
+    for key in parents:
+        fi = pkg.functions[key]
+        hits = function_sources(fi, lines_by_path.get(fi.path, []))
+        if not hits:
+            continue
+        chain: List[FuncInfo] = []
+        cur: Optional[Tuple[str, str]] = key
+        while cur is not None:
+            chain.append(pkg.functions[cur])
+            cur = parents[cur]
+        chain.reverse()
+        for hit in hits:
+            if hit.lineno in ok_lines.get(fi.path, ()):
+                continue
+            findings.append(TaintFinding(hit, fi, chain))
+    findings.sort(
+        key=lambda f: (f.func.path, f.hit.lineno, f.hit.rule)
+    )
+    return findings
+
+
+def taint_violations(pkg: Optional[Package] = None) -> List[Violation]:
+    """Findings as tmlint Violations so the fingerprint/baseline
+    machinery applies unchanged. The fingerprint covers the SOURCE
+    line only (rule:path:sha1(line)) — chains shift with unrelated
+    refactors, offending lines don't."""
+    pkg = pkg or build_package()
+    out: List[Violation] = []
+    for f in analyze(pkg):
+        lines = pkg.modules[f.func.path].lines
+        text = (
+            lines[f.hit.lineno - 1].strip()
+            if 1 <= f.hit.lineno <= len(lines)
+            else ""
+        )
+        out.append(
+            Violation(
+                rule=f.hit.rule,
+                path=f.func.path,
+                line=f.hit.lineno,
+                col=0,
+                message=(
+                    f"{f.hit.detail} is reachable from sign-bytes/hash "
+                    f"construction via: {f.render_chain()}"
+                ),
+                source=text,
+            )
+        )
+    return out

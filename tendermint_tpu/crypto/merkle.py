@@ -69,6 +69,8 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     offloaded when the device backend is installed."""
     if not items:
         return empty_hash()
+    # tmcheck: taint-break — telemetry edge: span timing floats feed
+    # the trace ring/metrics only and never enter the hash input
     with trace.span("merkle_hash", leaves=len(items)):
         leaf_hashes = [leaf_hash(it) for it in items]
         if _device_root_hook is not None:
@@ -86,6 +88,8 @@ def verify_proofs_batch(proofs, root_hash: bytes, leaves: Sequence[bytes]):
     BatchVerifier.Verify)."""
     import numpy as _np
 
+    # tmcheck: taint-break — telemetry edge: span timing floats feed
+    # the trace ring/metrics only and never enter proof bytes
     with trace.span("merkle_verify_proofs", proofs=len(proofs)):
         checked = _np.array(
             [

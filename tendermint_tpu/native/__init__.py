@@ -203,6 +203,12 @@ def ed25519_batch_lib():
 def ristretto_basemul(scalar_le32: bytes) -> Optional[bytes]:
     """encode(scalar*B) through the native library, or None when
     native is unavailable. scalar: 32-byte little-endian, < L."""
+    # the C side unconditionally reads 32 bytes — a shorter buffer
+    # from a future caller would be an out-of-bounds read (ADVICE r5)
+    if len(scalar_le32) != 32:
+        raise ValueError(
+            f"scalar must be exactly 32 bytes, got {len(scalar_le32)}"
+        )
     lib = ed25519_batch_lib()
     if lib is None:
         return None
@@ -216,6 +222,11 @@ def sr25519_challenge(pub: bytes, r: bytes, msg: bytes) -> Optional[bytes]:
     """The merlin signing-context challenge k for (pub, R, msg) as 32
     little-endian bytes (reduced mod L), or None when native is
     unavailable — the sign-path twin of ristretto_basemul."""
+    # C reads exactly 32 bytes of pub and R (msg carries its length)
+    if len(pub) != 32:
+        raise ValueError(f"pub must be exactly 32 bytes, got {len(pub)}")
+    if len(r) != 32:
+        raise ValueError(f"R must be exactly 32 bytes, got {len(r)}")
     lib = ed25519_batch_lib()
     if lib is None:
         return None

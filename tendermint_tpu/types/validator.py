@@ -416,16 +416,34 @@ class ValidatorSet:
         Unlike hash(), the wire form covers proposer priorities, which
         mutate in place outside _reindex (increment_proposer_priority)
         — so the memo is validated against a cheap fingerprint of
-        exactly the mutable inputs (priorities + proposer identity)
-        on every call instead of trusting an invalidation hook."""
+        the mutable inputs on every call instead of trusting an
+        invalidation hook. The fingerprint covers EVERY field the wire
+        form reads per validator — priority, voting_power, pub_key
+        identity, address — because this class hands out live
+        Validator references (validators list, get_by_address): an
+        embedder mutating a validator's power or key in place must get
+        fresh bytes, not the memo (ADVICE r5)."""
         key = (
-            tuple(v.proposer_priority for v in self.validators),
+            tuple(
+                (
+                    v.address,
+                    v.pub_key.bytes() if v.pub_key is not None else b"",
+                    v.voting_power,
+                    v.proposer_priority,
+                )
+                for v in self.validators
+            ),
             # the proposer's full mutable record, not just its address:
             # copy()/from_proto() can leave self.proposer detached from
             # its list entry, so its fields can change independently
             (
                 (
                     self.proposer.address,
+                    (
+                        self.proposer.pub_key.bytes()
+                        if self.proposer.pub_key is not None
+                        else b""
+                    ),
                     self.proposer.voting_power,
                     self.proposer.proposer_priority,
                 )
@@ -448,6 +466,9 @@ class ValidatorSet:
 
     @classmethod
     def from_proto(cls, data: bytes) -> "ValidatorSet":
+        # tmcheck: unparsed=3 — total_voting_power is recomputed from
+        # the validators (reference ValidatorSetFromProto does the
+        # same); trusting the wire value would let a peer lie about it
         vals: List[Validator] = []
         proposer = None
         for f, _wt, v in iter_fields(data):

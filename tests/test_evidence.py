@@ -148,3 +148,331 @@ def test_consensus_reported_conflicting_votes_become_evidence():
             await stop_cluster(net, nodes)
 
     run(go())
+
+
+# ---------------------------------------------------------------------------
+# verification edge cases — table-driven, mirroring the reference
+# internal/evidence/verify_test.go (TestVerifyDuplicateVoteEvidence,
+# TestVerifyLightClientAttack*, the expiry corners of TestVerify)
+
+
+from types import SimpleNamespace
+
+from tendermint_tpu.evidence.verify import (
+    verify_duplicate_vote,
+    verify_evidence,
+    verify_light_client_attack,
+)
+from tendermint_tpu.state.types import State
+from tendermint_tpu.types.evidence import LightClientAttackEvidence
+from tendermint_tpu.types.header import Header
+from tendermint_tpu.types.params import ConsensusParams, EvidenceParams
+
+from .test_light import CHAIN as LIGHT_CHAIN
+from .test_light import build_chain, make_set
+from .test_types import make_validators
+
+NS = 1_000_000_000
+
+
+def _vals_one():
+    priv = PrivKeyEd25519.from_seed(bytes([7]) * 32)
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+
+    vals = ValidatorSet(
+        [Validator(pub_key=priv.pub_key(), voting_power=10)]
+    )
+    return vals, priv
+
+
+class TestDuplicateVoteValidateBasic:
+    """reference: types/evidence_test.go TestDuplicateVoteEvidence
+    ValidateBasic corners, via the table in verify_test.go:202."""
+
+    def _good(self):
+        vals, priv = _vals_one()
+        return make_double_sign(priv, 10, vals, 5 * NS), vals, priv
+
+    def test_good_evidence_passes(self):
+        ev, _, _ = self._good()
+        ev.validate_basic()
+
+    def test_missing_vote_rejected(self):
+        ev, _, _ = self._good()
+        ev.vote_a = None
+        with pytest.raises(ValueError, match="empty duplicate vote"):
+            ev.validate_basic()
+
+    def test_votes_in_wrong_order_rejected(self):
+        """from_votes sorts by BlockID key; hand-built evidence with
+        the order flipped must not validate."""
+        ev, _, _ = self._good()
+        ev.vote_a, ev.vote_b = ev.vote_b, ev.vote_a
+        with pytest.raises(ValueError, match="invalid order"):
+            ev.validate_basic()
+
+    def test_identical_votes_rejected(self):
+        ev, _, _ = self._good()
+        ev.vote_b = ev.vote_a
+        with pytest.raises(ValueError, match="invalid order|same block"):
+            ev.validate_basic()
+
+    def test_unsigned_vote_rejected(self):
+        ev, _, _ = self._good()
+        ev.vote_a.signature = b""
+        with pytest.raises(ValueError, match="signature is missing"):
+            ev.validate_basic()
+
+    def test_from_votes_orders_by_block_id_key(self):
+        """NewDuplicateVoteEvidence's canonical ordering: whichever
+        argument order, vote_a gets the smaller BlockID key."""
+        vals, priv = _vals_one()
+        ev1 = make_double_sign(priv, 10, vals, 5 * NS)
+        ev2 = DuplicateVoteEvidence.from_votes(
+            ev1.vote_b, ev1.vote_a, block_time_ns=5 * NS, val_set=vals
+        )
+        assert ev2.vote_a.block_id == ev1.vote_a.block_id
+        assert ev2.vote_b.block_id == ev1.vote_b.block_id
+
+
+class TestVerifyDuplicateVote:
+    """reference: internal/evidence/verify_test.go:202-263 table."""
+
+    def _setup(self):
+        vals, priv = _vals_one()
+        ev = make_double_sign(priv, 10, vals, 5 * NS)
+        return ev, vals, priv
+
+    def test_valid_evidence_verifies(self):
+        ev, vals, _ = self._setup()
+        verify_duplicate_vote(ev, CHAIN, vals)
+
+    @pytest.mark.parametrize(
+        "mutate,err",
+        [
+            (lambda ev: setattr(ev.vote_b, "height", 11), "does not match"),
+            (lambda ev: setattr(ev.vote_b, "round", 1), "does not match"),
+            (
+                lambda ev: setattr(
+                    ev.vote_b,
+                    "type",
+                    1,  # PREVOTE vs vote_a's PRECOMMIT
+                ),
+                "does not match",
+            ),
+            (
+                lambda ev: setattr(
+                    ev.vote_b, "validator_address", b"\x42" * 20
+                ),
+                "addresses do not match",
+            ),
+            (
+                lambda ev: setattr(ev.vote_b, "block_id", ev.vote_a.block_id),
+                "same",
+            ),
+            (
+                lambda ev: setattr(ev, "validator_power", 3),
+                "validator power",
+            ),
+            (
+                lambda ev: setattr(ev, "total_voting_power", 1),
+                "total voting power",
+            ),
+        ],
+        ids=[
+            "height-mismatch",
+            "round-mismatch",
+            "type-mismatch",
+            "address-mismatch",
+            "same-block-id",
+            "validator-power-mismatch",
+            "total-power-mismatch",
+        ],
+    )
+    def test_mismatches_rejected(self, mutate, err):
+        ev, vals, _ = self._setup()
+        mutate(ev)
+        with pytest.raises(ValueError, match=err):
+            verify_duplicate_vote(ev, CHAIN, vals)
+
+    def test_validator_not_in_set_rejected(self):
+        ev, _, _ = self._setup()
+        from tendermint_tpu.types.validator import Validator, ValidatorSet
+
+        stranger = PrivKeyEd25519.from_seed(bytes([9]) * 32)
+        vals2 = ValidatorSet(
+            [Validator(pub_key=stranger.pub_key(), voting_power=10)]
+        )
+        with pytest.raises(ValueError, match="was not a validator"):
+            verify_duplicate_vote(ev, CHAIN, vals2)
+
+    def test_forged_signature_rejected(self):
+        ev, vals, _ = self._setup()
+        sig = bytearray(ev.vote_b.signature)
+        sig[0] ^= 0xFF
+        ev.vote_b.signature = bytes(sig)
+        with pytest.raises(ValueError, match="invalid signature"):
+            verify_duplicate_vote(ev, CHAIN, vals)
+
+
+def _lca_fixture(common_height=10, attack_height=10, n_heights=10):
+    """Trusted chain + a conflicting chain (different app_hash, same
+    validators) and the assembled LightClientAttackEvidence."""
+    base = 1_700_000_000 * NS
+    trusted = build_chain(n_heights, base_time_ns=base)
+    conflicting = build_chain(
+        n_heights, base_time_ns=base, app_hash=b"\x66" * 32
+    )
+    vals = trusted[common_height].validator_set
+    ev = LightClientAttackEvidence(
+        conflicting_block=conflicting[attack_height],
+        common_height=common_height,
+        total_voting_power=vals.total_voting_power(),
+        timestamp_ns=trusted[common_height].signed_header.header.time_ns,
+    )
+    return ev, vals, trusted
+
+
+class TestVerifyLightClientAttack:
+    """reference: internal/evidence/verify_test.go:159-200 —
+    including the equivocation corner where CommonHeight == the
+    conflicting block's Height (no forward lunatic gap)."""
+
+    def test_common_height_equals_height_verifies(self):
+        ev, vals, trusted = _lca_fixture(10, 10)
+        assert ev.conflicting_block.signed_header.header.height == (
+            ev.common_height
+        )
+        verify_light_client_attack(
+            ev, LIGHT_CHAIN, vals, trusted[10].signed_header.header
+        )
+        # ValidateBasic holds for the same shape
+        ev.validate_basic()
+
+    def test_conflicting_equals_trusted_is_not_attack(self):
+        ev, vals, trusted = _lca_fixture(10, 10)
+        same = trusted[10]
+        ev.conflicting_block = same
+        with pytest.raises(ValueError, match="not an attack"):
+            verify_light_client_attack(
+                ev, LIGHT_CHAIN, vals, trusted[10].signed_header.header
+            )
+
+    def test_total_voting_power_mismatch_rejected(self):
+        ev, vals, trusted = _lca_fixture(10, 10)
+        ev.total_voting_power += 1
+        with pytest.raises(ValueError, match="total voting power"):
+            verify_light_client_attack(
+                ev, LIGHT_CHAIN, vals, trusted[10].signed_header.header
+            )
+
+    def test_incomplete_conflicting_block_rejected(self):
+        ev, vals, trusted = _lca_fixture(10, 10)
+        ev.conflicting_block = SimpleNamespace(signed_header=None)
+        with pytest.raises(ValueError, match="incomplete"):
+            verify_light_client_attack(
+                ev, LIGHT_CHAIN, vals, trusted[10].signed_header.header
+            )
+
+    def test_commit_without_trusted_third_rejected(self):
+        """The conflicting commit must carry 1/3 of the common-height
+        set: a disjoint signer set fails the trusting verify."""
+        ev, _, trusted = _lca_fixture(10, 10)
+        stranger_vals, _ = make_set([21, 22, 23, 24])
+        ev.total_voting_power = stranger_vals.total_voting_power()
+        with pytest.raises(ValueError):
+            verify_light_client_attack(
+                ev,
+                LIGHT_CHAIN,
+                stranger_vals,
+                trusted[10].signed_header.header,
+            )
+
+    def test_common_height_must_be_positive(self):
+        ev, _, _ = _lca_fixture(10, 10)
+        ev.common_height = 0
+        with pytest.raises(ValueError, match="common height"):
+            ev.validate_basic()
+
+
+class _StateStore:
+    def __init__(self, vals):
+        self._vals = vals
+
+    def load_validators(self, height):
+        return self._vals
+
+
+class _BlockStore:
+    def __init__(self, headers):
+        self._headers = headers  # height -> Header
+
+    def load_block_meta(self, height):
+        h = self._headers.get(height)
+        return SimpleNamespace(header=h) if h is not None else None
+
+
+def _expiry_fixture(age_blocks, age_ns):
+    """Evidence at height 1 with state advanced by (age_blocks,
+    age_ns) past it, expiry params 10 blocks / 100 s."""
+    vals, priv = _vals_one()
+    t0 = 1_700_000_000 * NS
+    ev = make_double_sign(priv, 1, vals, t0)
+    header = Header(chain_id=CHAIN, height=1, time_ns=t0)
+    state = State(
+        chain_id=CHAIN,
+        last_block_height=1 + age_blocks,
+        last_block_time_ns=t0 + age_ns,
+        consensus_params=ConsensusParams(
+            evidence=EvidenceParams(
+                max_age_num_blocks=10,
+                max_age_duration_ns=100 * NS,
+            )
+        ),
+    )
+    return ev, state, _StateStore(vals), _BlockStore({1: header})
+
+
+class TestEvidenceExpiry:
+    """reference verify.go:24-61: evidence expires only when BOTH the
+    block-count and duration bounds are exceeded — expired on one
+    bound but not the other must still verify (the corner VERDICT
+    next #9 asks for)."""
+
+    def test_fresh_on_both_bounds_verifies(self):
+        ev, state, ss, bs = _expiry_fixture(age_blocks=5, age_ns=50 * NS)
+        verify_evidence(ev, state, ss, bs)
+
+    def test_expired_blocks_but_fresh_duration_verifies(self):
+        ev, state, ss, bs = _expiry_fixture(age_blocks=50, age_ns=50 * NS)
+        verify_evidence(ev, state, ss, bs)
+
+    def test_expired_duration_but_fresh_blocks_verifies(self):
+        ev, state, ss, bs = _expiry_fixture(age_blocks=5, age_ns=500 * NS)
+        verify_evidence(ev, state, ss, bs)
+
+    def test_expired_on_both_bounds_rejected(self):
+        ev, state, ss, bs = _expiry_fixture(
+            age_blocks=50, age_ns=500 * NS
+        )
+        with pytest.raises(ValueError, match="too old"):
+            verify_evidence(ev, state, ss, bs)
+
+    def test_exactly_at_both_bounds_verifies(self):
+        """Go uses strict `>` on both comparisons: exactly at the
+        bounds is NOT expired."""
+        ev, state, ss, bs = _expiry_fixture(
+            age_blocks=10, age_ns=100 * NS
+        )
+        verify_evidence(ev, state, ss, bs)
+
+    def test_missing_header_rejected(self):
+        ev, state, ss, _ = _expiry_fixture(5, 50 * NS)
+        with pytest.raises(ValueError, match="don't have header"):
+            verify_evidence(ev, state, ss, _BlockStore({}))
+
+    def test_timestamp_mismatch_with_block_rejected(self):
+        ev, state, ss, bs = _expiry_fixture(5, 50 * NS)
+        ev.timestamp_ns += 1
+        with pytest.raises(ValueError, match="different time"):
+            verify_evidence(ev, state, ss, bs)

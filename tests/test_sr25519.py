@@ -467,3 +467,28 @@ def test_native_basemul_matches_python_oracle():
     for k in cases:
         nat = native.ristretto_basemul(int(k).to_bytes(32, "little"))
         assert nat == rst.encode(rst.mul_base(k)), k
+
+
+def test_native_entry_points_reject_short_buffers():
+    """ADVICE r5: the C side unconditionally reads 32 bytes from
+    scalar/pub/R — a shorter buffer from a future caller would be an
+    out-of-bounds read, so the Python wrappers must reject it BEFORE
+    the ctypes call (native library not required: the check comes
+    first)."""
+    import pytest
+
+    from tendermint_tpu import native
+
+    for bad in (b"", b"\x01" * 31, b"\x01" * 33):
+        with pytest.raises(ValueError, match="32 bytes"):
+            native.ristretto_basemul(bad)
+        with pytest.raises(ValueError, match="32 bytes"):
+            native.sr25519_challenge(bad, b"\x02" * 32, b"msg")
+        with pytest.raises(ValueError, match="32 bytes"):
+            native.sr25519_challenge(b"\x02" * 32, bad, b"msg")
+    # exact 32-byte inputs still go through (or return None without
+    # a toolchain) — the guard must not reject valid calls
+    try:
+        native.sr25519_challenge(b"\x02" * 32, b"\x03" * 32, b"msg")
+    except ValueError as e:  # pragma: no cover - guard regression
+        raise AssertionError(f"valid 32-byte input rejected: {e}")

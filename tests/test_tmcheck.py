@@ -1,0 +1,671 @@
+"""tmcheck: whole-program sign-bytes taint analysis + wire-schema
+conformance — the tier-1 gates and the analyzer's own unit tests.
+
+Two package-wide gates run on every tier-1 invocation, alongside the
+tmlint gate in test_lint.py:
+
+- taint: no nondeterminism source reachable (interprocedurally) from
+  sign-bytes/hash construction beyond the checked-in baseline;
+- schema: the statically-extracted wire schema of every codec matches
+  the golden analysis/tmcheck/schema.json, encode/decode are
+  symmetric, and emission order is ascending.
+
+The seeded-violation tests copy the real package to a temp tree and
+inject the exact failure modes the gates exist to catch (a wall-clock
+read in a helper transitively called from types/canonical.py; a
+swapped field write / changed tag in a to_proto) and assert they are
+reported — with the full call chain for taint.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.analysis import tmcheck, tmlint
+from tendermint_tpu.analysis.tmcheck import callgraph, schema, taint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_ROOT = tmlint.package_root()
+
+
+@pytest.fixture(scope="module")
+def pkg():
+    return tmcheck.build_package()
+
+
+# ---------------------------------------------------------------------------
+# THE gates
+
+
+def test_package_taint_clean_against_baseline(pkg):
+    """No nondeterminism source reachable from sign-bytes/hash
+    construction beyond taint_baseline.json. Fix it, suppress it with
+    a justified `# tmcheck: taint-ok`/`taint-break`, or consciously
+    re-baseline (docs/static_analysis.md)."""
+    new = tmcheck.new_taint_violations(pkg)
+    assert not new, "new taint violations:\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_package_schema_conforms_to_golden():
+    """Extracted wire schema == golden schema.json, symmetric, and
+    ascending. ANY drift in tags/wire types/order is a consensus wire
+    break until reviewed via scripts/lint.py --schema-update."""
+    violations = tmcheck.schema_violations()
+    assert not violations, "schema violations:\n" + "\n".join(
+        v.render() for v in violations
+    )
+
+
+def test_whole_package_run_under_budget():
+    """The full tmcheck run (call graph + taint + schema extraction +
+    golden diff) must stay cheap enough for every tier-1 invocation:
+    <10 s on CPU (measured ~2 s)."""
+    t0 = time.monotonic()
+    p = tmcheck.build_package()
+    tmcheck.taint_violations(p)
+    tmcheck.schema_violations()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"tmcheck full run took {elapsed:.1f}s"
+
+
+def test_golden_schema_is_checked_in_with_provenance():
+    golden = tmcheck.load_golden()
+    assert golden is not None and golden["version"] == 1
+    msgs = golden["messages"]
+    assert len(msgs) >= 80
+    # provenance: every entry records which reference .proto/.pb.go it
+    # mirrors (ISSUE: recorded inline)
+    missing = [k for k, m in msgs.items() if not m.get("reference")]
+    assert not missing, f"messages without provenance: {missing}"
+    # the core consensus messages are present
+    for key in (
+        "types/vote.py::Vote",
+        "types/commit.py::Commit",
+        "types/header.py::Header",
+        "types/canonical.py::canonical_vote_bytes",
+        "types/validator.py::ValidatorSet",
+        "consensus/msgs.py::VoteMessage",
+        "abci/codec.py::pub_key",
+    ):
+        assert key in msgs, key
+
+
+def test_taint_baseline_is_checked_in_and_empty():
+    """The taint gate carries NO accepted debt: every exception is an
+    in-file justified suppression, so the baseline must stay empty —
+    if this fails, someone re-baselined instead of justifying."""
+    assert os.path.exists(tmcheck.TAINT_BASELINE_PATH)
+    with open(tmcheck.TAINT_BASELINE_PATH) as f:
+        data = json.load(f)
+    assert data["entries"] == {}
+
+
+# ---------------------------------------------------------------------------
+# seeded violations against a copy of the REAL package
+
+
+@pytest.fixture()
+def pkg_copy(tmp_path):
+    dst = tmp_path / "tendermint_tpu"
+    shutil.copytree(
+        PKG_ROOT, dst, ignore=shutil.ignore_patterns("__pycache__")
+    )
+    return dst
+
+
+def _analyze_copy(dst):
+    p = callgraph.Package(str(dst), "tendermint_tpu")
+    p.build()
+    return p
+
+
+def test_seeded_wallclock_in_helper_reports_full_chain(pkg_copy):
+    """Acceptance: a time.time() injected into a helper transitively
+    called from types/canonical.py sign-bytes construction is reported
+    with its full call chain, and fails the gate as NEW."""
+    ts = pkg_copy / "types" / "timestamp.py"
+    src = ts.read_text()
+    seeded = src.replace(
+        "def encode_timestamp(ns: int) -> bytes:\n"
+        '    """google.protobuf.Timestamp wire encoding."""\n',
+        "def _skew_helper():\n"
+        "    return _time.time()\n"
+        "\n"
+        "\n"
+        "def encode_timestamp(ns: int) -> bytes:\n"
+        '    """google.protobuf.Timestamp wire encoding."""\n'
+        "    _skew_helper()\n",
+    )
+    assert seeded != src, "injection anchor moved; update the test"
+    ts.write_text(seeded)
+    p = _analyze_copy(pkg_copy)
+    new = tmcheck.new_taint_violations(p)
+    hits = [v for v in new if v.rule == "taint-wallclock"]
+    assert hits, "seeded wall-clock read not reported"
+    msg = hits[0].message
+    # the full offending call chain, root first
+    assert "types/canonical.py:canonical_vote_bytes" in msg
+    assert "types/timestamp.py:encode_timestamp" in msg
+    assert "types/timestamp.py:_skew_helper" in msg
+    assert hits[0].path == "types/timestamp.py"
+
+
+def test_seeded_float_in_reachable_helper_is_reported(pkg_copy):
+    """Same route, float arithmetic: a division seeded into
+    encode_timestamp surfaces as taint-float with the chain."""
+    ts = pkg_copy / "types" / "timestamp.py"
+    src = ts.read_text()
+    seeded = src.replace(
+        "    seconds, nanos = divmod(ns, NS)\n    w = ProtoWriter()",
+        "    seconds, nanos = divmod(ns, NS)\n"
+        "    _skew = ns / NS\n"
+        "    w = ProtoWriter()",
+    )
+    assert seeded != src
+    ts.write_text(seeded)
+    p = _analyze_copy(pkg_copy)
+    new = tmcheck.new_taint_violations(p)
+    hits = [v for v in new if v.rule == "taint-float"]
+    assert any(
+        "encode_timestamp" in v.message and "canonical" in v.message
+        for v in hits
+    ), "\n".join(v.render() for v in new)
+
+
+def test_seeded_field_swap_fails_schema_gate(pkg_copy):
+    """Acceptance: swapping two field writes in a to_proto fails the
+    schema diff (order + drift)."""
+    vote = pkg_copy / "types" / "vote.py"
+    src = vote.read_text()
+    seeded = src.replace(
+        "w.int(2, self.height)\n        w.int(3, self.round)",
+        "w.int(3, self.round)\n        w.int(2, self.height)",
+    )
+    assert seeded != src
+    vote.write_text(seeded)
+    violations = schema.schema_violations(str(pkg_copy))
+    rules = {v.rule for v in violations}
+    assert "schema-order" in rules
+    assert "schema-drift" in rules
+    drift = [v for v in violations if v.rule == "schema-drift"]
+    assert any("types/vote.py::Vote" in v.message for v in drift)
+
+
+def test_seeded_tag_change_fails_schema_gate(pkg_copy):
+    """Acceptance: changing a tag number in any to_proto fails the
+    schema diff."""
+    commit = pkg_copy / "types" / "commit.py"
+    src = commit.read_text()
+    # bump one literal tag in Commit.to_proto's writer calls
+    import re
+
+    m = re.search(r"w\.int\(1, self\.height\)", src)
+    assert m, "anchor moved; update the test"
+    seeded = src.replace("w.int(1, self.height)", "w.int(7, self.height)", 1)
+    commit.write_text(seeded)
+    violations = schema.schema_violations(str(pkg_copy))
+    drift = [v for v in violations if v.rule == "schema-drift"]
+    assert any("types/commit.py" in v.path for v in drift)
+
+
+def test_seeded_dropped_parse_fails_symmetry(pkg_copy):
+    """Deleting a decoder's read of a written field is caught by the
+    symmetry check (silent codec drift: bytes written, value lost)."""
+    vote = pkg_copy / "types" / "vote.py"
+    src = vote.read_text()
+    seeded = src.replace("validator_address=r.bytes(6),\n", "")
+    assert seeded != src
+    vote.write_text(seeded)
+    violations = schema.schema_violations(str(pkg_copy))
+    sym = [v for v in violations if v.rule == "schema-symmetry"]
+    assert any(
+        "field 6" in v.message and "Vote" in v.message for v in sym
+    ), "\n".join(v.render() for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# call-graph resolution units (synthetic two-module package)
+
+
+@pytest.fixture()
+def tiny_pkg(tmp_path):
+    root = tmp_path / "tinypkg"
+    (root / "types").mkdir(parents=True)
+    (root / "libs").mkdir()
+    (root / "__init__.py").write_text("")
+    (root / "types" / "__init__.py").write_text("")
+    (root / "libs" / "__init__.py").write_text("")
+    (root / "libs" / "helpers.py").write_text(
+        "import time as clock\n"
+        "\n"
+        "\n"
+        "def leaky():\n"
+        "    return clock.time()\n"
+        "\n"
+        "\n"
+        "def clean():\n"
+        "    return 7\n"
+    )
+    (root / "types" / "canonical.py").write_text(
+        "from ..libs.helpers import leaky\n"
+        "from ..libs import helpers\n"
+        "\n"
+        "\n"
+        "class Writer:\n"
+        "    def emit(self):\n"
+        "        return leaky()\n"
+        "\n"
+        "\n"
+        "def build():\n"
+        "    w = Writer()\n"
+        "    return w.emit()\n"
+        "\n"
+        "\n"
+        "def build_via_module():\n"
+        "    return helpers.clean()\n"
+    )
+    p = callgraph.Package(str(root), "tinypkg")
+    p.build()
+    return p
+
+
+def test_callgraph_resolves_from_import_and_alias(tiny_pkg):
+    emit = tiny_pkg.functions[("types/canonical.py", "Writer.emit")]
+    assert any(
+        s.target == ("libs/helpers.py", "leaky") for s in emit.calls
+    )
+    leaky = tiny_pkg.functions[("libs/helpers.py", "leaky")]
+    # `import time as clock; clock.time()` resolves to the real name
+    assert any(s.external == "time.time" for s in leaky.calls)
+
+
+def test_callgraph_resolves_local_instance_and_module_attr(tiny_pkg):
+    build = tiny_pkg.functions[("types/canonical.py", "build")]
+    assert any(
+        s.target == ("types/canonical.py", "Writer.emit")
+        for s in build.calls
+    )
+    via = tiny_pkg.functions[("types/canonical.py", "build_via_module")]
+    assert any(
+        s.target == ("libs/helpers.py", "clean") for s in via.calls
+    )
+
+
+def test_taint_chain_through_synthetic_package(tiny_pkg):
+    vs = taint.taint_violations(tiny_pkg)
+    assert len(vs) == 1
+    v = vs[0]
+    assert v.rule == "taint-wallclock"
+    # shortest chain from a types/canonical.py root
+    assert "types/canonical.py" in v.message
+    assert "libs/helpers.py:leaky" in v.message
+
+
+def test_callgraph_resolves_root_init_reexport(tmp_path):
+    """Re-exports through the package ROOT __init__.py must resolve —
+    a source behind `from <pkg> import helper` (or `from . import x`
+    at the root) is otherwise invisible to the gate (false negative)."""
+    root = tmp_path / "rootpkg"
+    (root / "types").mkdir(parents=True)
+    (root / "__init__.py").write_text(
+        "from .libsy import leaky\n"
+    )
+    (root / "libsy.py").write_text(
+        "import time\n\n\ndef leaky():\n    return time.time()\n"
+    )
+    (root / "types" / "__init__.py").write_text("")
+    (root / "types" / "canonical.py").write_text(
+        "from rootpkg import leaky\n"
+        "\n"
+        "\n"
+        "def build():\n"
+        "    return leaky()\n"
+    )
+    p = callgraph.Package(str(root), "rootpkg")
+    p.build()
+    build = p.functions[("types/canonical.py", "build")]
+    assert any(
+        s.target == ("libsy.py", "leaky") for s in build.calls
+    ), [(s.target, s.external) for s in build.calls]
+    vs = taint.taint_violations(p)
+    assert [v.rule for v in vs] == ["taint-wallclock"]
+    assert "libsy.py:leaky" in vs[0].message
+
+
+def test_taint_edge_break_suppression(tmp_path):
+    root = tmp_path / "brkpkg"
+    (root / "types").mkdir(parents=True)
+    (root / "__init__.py").write_text("")
+    (root / "types" / "__init__.py").write_text("")
+    (root / "types" / "canonical.py").write_text(
+        "import time\n"
+        "\n"
+        "\n"
+        "def telemetry():\n"
+        "    return time.time()\n"
+        "\n"
+        "\n"
+        "def build():\n"
+        "    # tmcheck: taint-break — telemetry only, never hashed\n"
+        "    telemetry()\n"
+        "    return b''\n"
+    )
+    p = callgraph.Package(str(root), "brkpkg")
+    p.build()
+    vs = taint.taint_violations(p)
+    # the edge is broken, but telemetry() itself is ALSO a sink-root
+    # function (it lives in types/canonical.py) — verify the breaking
+    # removed build()'s chain by checking chains never pass through
+    # build
+    assert all("build" not in v.message for v in vs)
+
+
+def test_taint_source_ok_suppression(tmp_path):
+    root = tmp_path / "okpkg"
+    (root / "types").mkdir(parents=True)
+    (root / "__init__.py").write_text("")
+    (root / "types" / "__init__.py").write_text("")
+    (root / "types" / "canonical.py").write_text(
+        "import time\n"
+        "\n"
+        "\n"
+        "def build():\n"
+        "    t = time.time()  # tmcheck: taint-ok — log line only\n"
+        "    return b''\n"
+    )
+    p = callgraph.Package(str(root), "okpkg")
+    p.build()
+    assert taint.taint_violations(p) == []
+
+
+def test_taint_urandom_keygen_exemption(tmp_path):
+    root = tmp_path / "kgpkg"
+    for d in ("types", "crypto"):
+        (root / d).mkdir(parents=True)
+        (root / d / "__init__.py").write_text("")
+    (root / "__init__.py").write_text("")
+    (root / "crypto" / "keys.py").write_text(
+        "import os\n\n\ndef gen_seed():\n    return os.urandom(32)\n"
+    )
+    (root / "types" / "canonical.py").write_text(
+        "import os\n\n\ndef build():\n    return os.urandom(8)\n"
+    )
+    p = callgraph.Package(str(root), "kgpkg")
+    p.build()
+    vs = taint.taint_violations(p)
+    assert len(vs) == 1
+    assert vs[0].path == "types/canonical.py"
+    assert vs[0].rule == "taint-random"
+
+
+def test_taint_set_iteration_detected(tmp_path):
+    root = tmp_path / "setpkg"
+    (root / "types").mkdir(parents=True)
+    (root / "__init__.py").write_text("")
+    (root / "types" / "__init__.py").write_text("")
+    (root / "types" / "canonical.py").write_text(
+        "def build(items):\n"
+        "    s = set(items)\n"
+        "    out = b''\n"
+        "    for x in s:\n"
+        "        out += x\n"
+        "    return out\n"
+    )
+    p = callgraph.Package(str(root), "setpkg")
+    p.build()
+    vs = taint.taint_violations(p)
+    assert [v.rule for v in vs] == ["taint-set-iter"]
+
+
+# ---------------------------------------------------------------------------
+# schema extractor units
+
+
+def test_extract_fields_order_repeated_conditional():
+    src = (
+        "from ..encoding.proto import ProtoWriter, FieldReader\n"
+        "\n"
+        "\n"
+        "class Msg:\n"
+        "    def to_proto(self):\n"
+        "        w = ProtoWriter()\n"
+        "        w.int(1, self.a)\n"
+        "        for x in self.xs:\n"
+        "            w.message(2, x)\n"
+        "        if self.b:\n"
+        "            w.bytes(3, self.b)\n"
+        "        w.sfixed64(4, self.c)\n"
+        "        return w.finish()\n"
+        "\n"
+        "    @classmethod\n"
+        "    def from_proto(cls, data):\n"
+        "        r = FieldReader(data)\n"
+        "        return cls(r.uint(1), r.get_all(2), r.bytes(3),\n"
+        "                   r.sfixed64(4))\n"
+    )
+    msgs, ov = schema.extract_module(src, "types/fixture.py")
+    assert ov == []
+    m = msgs["types/fixture.py::Msg"]
+    got = [(f.tag, f.method, f.repeated, f.conditional) for f in m.fields]
+    assert got == [
+        (1, "int", False, False),
+        (2, "message", True, False),
+        (3, "bytes", False, True),
+        (4, "sfixed64", False, False),
+    ]
+    assert m.parsed == {1, 2, 3, 4}
+    assert schema.symmetry_violations(msgs) == []
+
+
+def test_extract_chained_reader_and_iter_fields_comprehension():
+    src = (
+        "from ..encoding.proto import ProtoWriter, FieldReader, iter_fields\n"
+        "\n"
+        "\n"
+        "class A:\n"
+        "    def to_proto(self):\n"
+        "        w = ProtoWriter()\n"
+        "        w.uint(1, self.h)\n"
+        "        return w.finish()\n"
+        "\n"
+        "    @classmethod\n"
+        "    def from_proto(cls, data):\n"
+        "        return cls(FieldReader(data).uint(1))\n"
+        "\n"
+        "\n"
+        "class B:\n"
+        "    def to_proto(self):\n"
+        "        w = ProtoWriter()\n"
+        "        for t in self.ts:\n"
+        "            w.string(1, t)\n"
+        "        return w.finish()\n"
+        "\n"
+        "    @classmethod\n"
+        "    def from_proto(cls, data):\n"
+        "        return cls([v for f, _w, v in iter_fields(data) if f == 1])\n"
+    )
+    msgs, _ = schema.extract_module(src, "types/fixture.py")
+    assert msgs["types/fixture.py::A"].parsed == {1}
+    assert msgs["types/fixture.py::B"].parsed == {1}
+    assert schema.symmetry_violations(msgs) == []
+
+
+def test_extract_nested_submessage_reader_not_counted():
+    src = (
+        "from ..encoding.proto import ProtoWriter, FieldReader, iter_fields\n"
+        "\n"
+        "\n"
+        "class Outer:\n"
+        "    def to_proto(self):\n"
+        "        w = ProtoWriter()\n"
+        "        w.string(1, self.name)\n"
+        "        for a in self.attrs:\n"
+        "            w.message(2, a)\n"
+        "        return w.finish()\n"
+        "\n"
+        "    @classmethod\n"
+        "    def from_proto(cls, data):\n"
+        "        name = ''\n"
+        "        attrs = []\n"
+        "        for f, _wt, v in iter_fields(data):\n"
+        "            if f == 1:\n"
+        "                name = v.decode()\n"
+        "            elif f == 2:\n"
+        "                r = FieldReader(v)\n"
+        "                attrs.append((r.bytes(1), r.bytes(2), r.uint(3)))\n"
+        "        return cls(name, attrs)\n"
+    )
+    msgs, _ = schema.extract_module(src, "types/fixture.py")
+    m = msgs["types/fixture.py::Outer"]
+    # fields 1,2,3 of the NESTED reader must not leak into Outer
+    assert m.parsed == {1, 2}
+    assert schema.symmetry_violations(msgs) == []
+
+
+def test_extract_oneof_dict_tag():
+    src = (
+        "from ..encoding.proto import ProtoWriter, FieldReader\n"
+        "\n"
+        "\n"
+        "def _enc_key(pk):\n"
+        "    w = ProtoWriter()\n"
+        "    fieldno = {'a': 1, 'b': 2}[pk.kind]\n"
+        "    w.bytes(fieldno, pk.data)\n"
+        "    return w.finish()\n"
+        "\n"
+        "\n"
+        "def _dec_key(data):\n"
+        "    names = {1: 'a', 2: 'b'}\n"
+        "    from ..encoding.proto import iter_fields\n"
+        "    for f, _wt, v in iter_fields(data):\n"
+        "        if f in names:\n"
+        "            return (names[f], v)\n"
+        "    raise ValueError('empty')\n"
+    )
+    msgs, _ = schema.extract_module(src, "abci/codec.py")
+    m = msgs["abci/codec.py::key"]
+    assert [(f.tag, f.conditional) for f in m.fields] == [
+        (1, True),
+        (2, True),
+    ]
+    assert m.parsed == {1, 2}
+    assert schema.symmetry_violations(msgs) == []
+
+
+def test_symmetry_annotation_suppresses():
+    src = (
+        "from ..encoding.proto import ProtoWriter, FieldReader\n"
+        "\n"
+        "\n"
+        "class M:\n"
+        "    def to_proto(self):\n"
+        "        w = ProtoWriter()\n"
+        "        w.int(1, self.a)\n"
+        "        w.int(2, self.derived)\n"
+        "        return w.finish()\n"
+        "\n"
+        "    @classmethod\n"
+        "    def from_proto(cls, data):\n"
+        "        # tmcheck: unparsed=2 — recomputed from field 1\n"
+        "        return cls(FieldReader(data).uint(1))\n"
+    )
+    msgs, _ = schema.extract_module(src, "types/fixture.py")
+    assert schema.symmetry_violations(msgs) == []
+    # and without the annotation it IS a violation
+    bare = src.replace(
+        "        # tmcheck: unparsed=2 — recomputed from field 1\n", ""
+    )
+    msgs2, _ = schema.extract_module(bare, "types/fixture.py")
+    sym = schema.symmetry_violations(msgs2)
+    assert len(sym) == 1 and "field 2" in sym[0].message
+
+
+def test_oneof_branches_exempt_from_order_check():
+    src = (
+        "from ..encoding.proto import ProtoWriter\n"
+        "\n"
+        "\n"
+        "def encode_ev(ev):\n"
+        "    w = ProtoWriter()\n"
+        "    if ev.kind == 'b':\n"
+        "        w.message(2, ev.body)\n"
+        "    else:\n"
+        "        w.message(1, ev.body)\n"
+        "    return w.finish()\n"
+    )
+    msgs, ov = schema.extract_module(src, "types/fixture.py")
+    assert ov == [], [v.render() for v in ov]
+
+
+def test_golden_round_trip(tmp_path):
+    msgs, _ = schema.extract_package()
+    path = str(tmp_path / "golden.json")
+    schema.save_golden(msgs, path)
+    golden = schema.load_golden(path)
+    assert schema.diff_golden(msgs, golden) == []
+    # removing a message from the extraction is reported
+    key = "types/vote.py::Vote"
+    smaller = {k: v for k, v in msgs.items() if k != key}
+    dv = schema.diff_golden(smaller, golden)
+    assert any(key in v.message for v in dv)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_taint_and_schema_sections_exit_zero():
+    r = _run_cli("--taint", "--stats")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[taint]" in r.stdout
+    r = _run_cli("--schema", "--stats")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[schema]" in r.stdout
+
+
+def test_cli_full_run_includes_tmcheck_sections():
+    r = _run_cli("--stats")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[tmlint+taint+schema]" in r.stdout
+
+
+def test_cli_schema_update_refuses_filtered_runs():
+    r = _run_cli("--schema-update", "--rule", "det-float")
+    assert r.returncode == 2 and "full-package" in r.stderr
+    r = _run_cli("--schema-update", "tendermint_tpu/types/vote.py")
+    assert r.returncode == 2
+    r = _run_cli("--schema-update", "--taint")
+    assert r.returncode == 2
+    # and the golden table was not touched
+    assert tmcheck.schema_violations() == []
+
+
+def test_cli_baseline_update_refuses_schema_section():
+    """`--schema --baseline-update` has nothing to update (the golden
+    table is the schema baseline) — silently exiting 0 would let an
+    operator believe a red gate was accepted."""
+    r = _run_cli("--schema", "--baseline-update")
+    assert r.returncode == 2 and "schema-update" in r.stderr
+
+
+def test_cli_list_rules_includes_tmcheck():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    # the whole catalog from the single source of truth
+    for rid, _title in tmcheck.RULES:
+        assert rid in r.stdout

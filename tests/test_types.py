@@ -209,6 +209,30 @@ class TestValidatorSet:
         ]
         assert rt.proposer.address == vals.proposer.address
 
+    def test_to_proto_memo_tracks_inplace_power_mutation(self):
+        """ADVICE r5: ValidatorSet hands out live Validator references
+        (the validators list itself), so an embedder mutating
+        voting_power or the pub_key in place — without going through
+        the change-set API — must still get fresh wire bytes, not the
+        memo's stale ones."""
+        vals, _ = make_validators(4)
+        first = vals.to_proto()
+        assert vals.to_proto() is first
+        # in-place power mutation: no _reindex, no priority change
+        vals.validators[0].voting_power += 5
+        mutated = vals.to_proto()
+        assert mutated != first
+        rt = ValidatorSet.from_proto(mutated)
+        assert rt.validators[0].voting_power == (
+            vals.validators[0].voting_power
+        )
+        # pub_key identity swap on a detached proposer record
+        assert vals.to_proto() is vals.to_proto()  # memo re-established
+        other = PrivKeyEd25519.from_seed(b"\x99" * 32).pub_key()
+        before = vals.to_proto()
+        vals.proposer.pub_key = other
+        assert vals.to_proto() != before
+
 
 class TestVoteSet:
     def test_quorum_and_commit(self):
