@@ -1,35 +1,41 @@
 #!/usr/bin/env python
-"""tmlint + tmcheck CLI — the consensus-invariant static analyzers.
+"""tmlint + tmcheck + tmrace CLI — the consensus-invariant static
+analyzers.
 
 Usage:
-    python scripts/lint.py                    # full gate: tmlint + tmcheck
+    python scripts/lint.py                    # full gate: tmlint +
+                                              # tmcheck + tmrace
     python scripts/lint.py --rule det-float   # one tmlint rule class only
     python scripts/lint.py --taint            # tmcheck taint pass only
     python scripts/lint.py --schema           # tmcheck schema gate only
+    python scripts/lint.py --race             # tmrace data-race +
+                                              # lock-order pass only
     python scripts/lint.py --no-baseline      # every violation, raw
     python scripts/lint.py --baseline-update  # re-accept current state
-                                              # (tmlint AND taint baselines)
+                                              # (tmlint, taint AND race
+                                              # baselines)
     python scripts/lint.py --schema-update    # regenerate the golden
                                               # wire-schema table
     python scripts/lint.py --list-rules       # rule catalog
     python scripts/lint.py path/to/file.py    # specific files (tmlint
-                                              # only; tmcheck is
+                                              # only; tmcheck/tmrace are
                                               # whole-program)
 
-Exit codes (the contract tests/test_lint.py, tests/test_tmcheck.py and
-CI rely on):
+Exit codes (the contract tests/test_lint.py, tests/test_tmcheck.py,
+tests/test_tmrace.py and CI rely on):
     0  clean — no violations beyond the checked-in baselines/golden
     1  new violations found (or any violation under --no-baseline)
     2  usage or internal error
 
 Baselines: tendermint_tpu/analysis/baseline.json (tmlint),
-tendermint_tpu/analysis/tmcheck/taint_baseline.json (taint), and the
+tendermint_tpu/analysis/tmcheck/taint_baseline.json (taint),
+tendermint_tpu/analysis/tmrace/race_baseline.json (race), and the
 golden wire schema tendermint_tpu/analysis/tmcheck/schema.json.
 --baseline-update / --schema-update refuse filtered runs (a subset
 scan would silently overwrite the whole file).
 docs/static_analysis.md documents the workflow and the suppression
 policy (`# tmlint: disable=<rule>`, `# tmcheck: taint-ok/taint-break`,
-`# tmcheck: unparsed=N/unwritten=N`).
+`# tmcheck: unparsed=N/unwritten=N`, `# tmrace: race-ok/guarded-by`).
 """
 
 from __future__ import annotations
@@ -41,7 +47,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tendermint_tpu.analysis import tmcheck, tmlint  # noqa: E402
+from tendermint_tpu.analysis import tmcheck, tmlint, tmrace  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -80,6 +86,10 @@ def main(argv=None) -> int:
         help="run only the tmcheck wire-schema conformance gate",
     )
     ap.add_argument(
+        "--race", action="store_true",
+        help="run only the tmrace data-race + lock-order pass",
+    )
+    ap.add_argument(
         "--schema-update", action="store_true",
         help="regenerate the golden wire-schema table "
              "(tendermint_tpu/analysis/tmcheck/schema.json)",
@@ -99,6 +109,8 @@ def main(argv=None) -> int:
             print(f"{rule.id}: {rule.title}")
             print(f"    {rule.rationale}")
         for rid, title in tmcheck.RULES:
+            print(f"{rid}: {title}")
+        for rid, title in tmrace.RULES:
             print(f"{rid}: {title}")
         return 0
 
@@ -122,18 +134,22 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.schema_update and (filtered or args.taint):
-        # same hazard: the golden table covers EVERY codec module
+    if args.schema_update and (filtered or args.taint or args.race):
+        # same hazard: the golden table covers EVERY codec module (and
+        # combining with --taint/--race would silently skip that gate
+        # while returning 0 — the update mode below disables them)
         print(
             "error: --schema-update requires a full-package run "
-            "(drop --rule/--taint and path arguments)",
+            "(drop --rule/--taint/--race and path arguments)",
             file=sys.stderr,
         )
         return 2
 
-    run_tmlint = not (args.taint or args.schema)
-    run_taint = (args.taint or not (args.schema or filtered))
-    run_schema = (args.schema or not (args.taint or filtered))
+    sections = args.taint or args.schema or args.race
+    run_tmlint = not sections
+    run_taint = (args.taint or not (args.schema or args.race or filtered))
+    run_schema = (args.schema or not (args.taint or args.race or filtered))
+    run_race = (args.race or not (args.taint or args.schema or filtered))
     # update modes run ONLY the sections they update: computing (then
     # discarding) the other gates' violations would both waste ~2 s
     # and return 0 past a red gate the operator never saw
@@ -142,6 +158,7 @@ def main(argv=None) -> int:
     if args.schema_update:
         run_tmlint = False
         run_taint = False
+        run_race = False
 
     t0 = time.monotonic()
     violations = []
@@ -196,6 +213,33 @@ def main(argv=None) -> int:
             else:
                 new.extend(tmcheck.new_taint_violations(pkg))
 
+        if run_race:
+            # one analyze() pass serves report, baseline diff AND
+            # baseline update — the race pass dominates gate runtime,
+            # so it must never run twice
+            race_pkg = pkg or tmcheck.build_package()
+            race_v = tmrace.race_violations(race_pkg)
+            violations.extend(race_v)
+            if args.baseline_update:
+                counts = tmlint.save_baseline(
+                    race_v,
+                    tmrace.RACE_BASELINE_PATH,
+                    note=tmrace.RACE_BASELINE_NOTE,
+                )
+                print(
+                    f"race baseline updated: {len(counts)} fingerprints "
+                    f"-> {tmrace.RACE_BASELINE_PATH}"
+                )
+            elif args.no_baseline:
+                new.extend(race_v)
+            else:
+                new.extend(
+                    tmlint.new_violations(
+                        race_v,
+                        tmlint.load_baseline(tmrace.RACE_BASELINE_PATH),
+                    )
+                )
+
         if args.schema_update:
             data = tmcheck.update_schema_golden()
             print(
@@ -229,6 +273,7 @@ def main(argv=None) -> int:
                 ("tmlint", run_tmlint),
                 ("taint", run_taint),
                 ("schema", run_schema),
+                ("race", run_race),
             )
             if on
         ]
@@ -243,8 +288,9 @@ def main(argv=None) -> int:
         print(
             f"\n{len(new)} new violation(s). Fix them, add a justified "
             "suppression/annotation (# tmlint: disable=..., # tmcheck: "
-            "taint-ok/taint-break/unparsed=N), or for consciously "
-            "accepted changes run scripts/lint.py --baseline-update / "
+            "taint-ok/taint-break/unparsed=N, # tmrace: "
+            "race-ok/guarded-by=...), or for consciously accepted "
+            "changes run scripts/lint.py --baseline-update / "
             "--schema-update.",
             file=sys.stderr,
         )

@@ -92,6 +92,32 @@ RANK: Dict[str, int] = {
     "metrics.registry": 55,
 }
 
+# The expected edges of the partial order above, classified by how
+# they are PROVEN. "static": tmrace's lock-order pass must derive the
+# edge from source on every gate run — if the code stops producing it,
+# the gate fails until this table is updated, so RANK can never
+# silently drift from the code. "runtime-only": the edge exists only
+# through dynamic dispatch the static call graph cannot resolve (say
+# why); lockwatch still witnesses it at runtime.
+RANK_EDGES: Dict[Tuple[str, str], str] = {
+    # fresh() retires the old instance's probe timer under _REG_LOCK
+    ("breaker.registry", "breaker.instance"): "static",
+    # CircuitBreaker.__init__ publishes its state gauge while
+    # breaker_for/fresh hold _REG_LOCK
+    ("breaker.registry", "metrics.metric"): "static",
+    # state transitions publish gauges/counters under the instance lock
+    ("breaker.instance", "metrics.metric"): "static",
+    # _rotate bumps the eviction counter under the rotation lock
+    ("sigcache.rotate", "metrics.metric"): "static",
+    # witnessed under the chaos suites when a span closes while a ring
+    # maintenance call (set_capacity/reset/snapshot) holds the ring
+    # lock on another thread's stack above a metric touch; the span
+    # close itself observes its histogram BEFORE the lock-free ring
+    # append, so no static path holds trace.ring across a metric
+    # acquisition — lockwatch alone can prove this one
+    ("trace.ring", "metrics.metric"): "runtime-only",
+}
+
 
 class Report:
     """Frozen result of one watch window."""

@@ -21,6 +21,14 @@ makes reliable:
   fields, `self.x: T = ...` in __init__)
 - `v.m(...)` where `v` iterates a List[T]/Sequence[T]-annotated
   attribute — the `for v in self.validators: v.hash_bytes()` idiom
+- `g.m(...)` where `g` is a module-level `g = SomeClass(...)` or
+  `g = factory(...)` whose factory has a `-> SomeClass` return
+  annotation — the `_m_state = M.new_gauge(...); _m_state.set(...)`
+  metric-instrument idiom (tmrace needs these edges to see the
+  lock acquisitions inside metric methods)
+- `v.m(...)` where `v = G.pop(...)` / `G.get(...)` / `G[...]` and `G`
+  is a module-level global annotated `Dict[K, V]` — the registry
+  idiom (`old = _REGISTRY.pop(name); old._cancel_timer_locked()`)
 
 Unresolvable calls (dynamic hooks, higher-order functions) produce no
 edge: the analysis is deliberately under-approximate on edges and
@@ -116,6 +124,35 @@ def _element_type_name(node: Optional[ast.AST]) -> str:
     return ""
 
 
+def _value_type_name(node: Optional[ast.AST]) -> str:
+    """Value type of a mapping annotation (Dict[K, V] -> V); "" when
+    not a mapping."""
+    if node is None:
+        return ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            return _value_type_name(ast.parse(node.value, mode="eval").body)
+        except SyntaxError:
+            return ""
+    if isinstance(node, ast.Subscript):
+        base = ""
+        if isinstance(node.value, ast.Name):
+            base = node.value.id
+        elif isinstance(node.value, ast.Attribute):
+            base = node.value.attr
+        if base in ("Dict", "dict", "Mapping", "MutableMapping"):
+            inner = node.slice
+            if isinstance(inner, ast.Tuple) and len(inner.elts) == 2:
+                return _annotation_type_name(inner.elts[1])
+        if base == "Optional":
+            return _value_type_name(
+                node.slice.elts[0]
+                if isinstance(node.slice, ast.Tuple) and node.slice.elts
+                else node.slice
+            )
+    return ""
+
+
 class CallSite:
     """One call expression inside a function body.
 
@@ -195,6 +232,16 @@ class ModuleIndex:
         # local -> (internal module path | None, external dotted | None,
         #           original name)
         self.from_imports: Dict[str, Tuple[Optional[str], Optional[str], str]] = {}
+        # module-level `x = SomeCall(...)` assignments, resolved to
+        # their concrete class by Package._infer_module_vars (the
+        # resolver needs cross-module return annotations):
+        # name -> (owner ModuleIndex, class name)
+        self.var_class: Dict[str, Tuple["ModuleIndex", str]] = {}
+        # module-level globals annotated Dict[K, V]: name -> V (the
+        # value class name, resolvable in THIS module's namespace)
+        self.var_value_types: Dict[str, str] = {}
+        # raw module-level `x = <Call>` sites awaiting inference
+        self._var_assigns: List[Tuple[str, ast.Call]] = []
         self._index()
 
     # -- import resolution --
@@ -239,6 +286,19 @@ class ModuleIndex:
                         self.from_imports[local] = (None, target[1:], a.name)
                     else:
                         self.from_imports[local] = (target, None, a.name)
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._var_assigns.append((tgt.id, node.value))
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                vt = _value_type_name(node.annotation)
+                if vt:
+                    self.var_value_types[node.target.id] = vt
         for node in self.tree.body:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self.functions[node.name] = node
@@ -296,6 +356,10 @@ class Package:
         self.functions: Dict[Tuple[str, str], FuncInfo] = {}
         # dotted module -> path for internal modules
         self._by_dotted: Dict[str, str] = {}
+        # class name -> paths defining it (find_class falls back to a
+        # UNIQUELY-named class for unimported references: factory
+        # return annotations name classes their caller never imports)
+        self._class_homes: Dict[str, List[str]] = {}
 
     # -- lookups --
 
@@ -323,6 +387,14 @@ class Package:
                     t2 = self.module_for_dotted(fi2[0])
                     if t2 is not None and fi2[2] in t2.classes:
                         return t2, t2.classes[fi2[2]]
+        # a name `mod` neither defines nor imports, defined by exactly
+        # ONE module in the package: a factory's `-> CircuitBreaker`
+        # seen from a caller that only imports the factory's module
+        if name not in mod.from_imports and name not in mod.import_alias:
+            homes = self._class_homes.get(name)
+            if homes is not None and len(homes) == 1:
+                owner = self.modules[homes[0]]
+                return owner, owner.classes[name]
         return None
 
     def _method_key(
@@ -359,13 +431,100 @@ class Package:
             self.modules[rel] = mod
             self._by_dotted[mod.dotted] = rel
         for mod in self.modules.values():
+            for cname in mod.classes:
+                self._class_homes.setdefault(cname, []).append(mod.path)
+        for mod in self.modules.values():
             self._collect_functions(mod)
+        for mod in self.modules.values():
+            self._infer_module_vars(mod)
         for mod in self.modules.values():
             self._resolve_module_calls(mod)
 
+    def _returned_class(
+        self, owner: ModuleIndex, fn_node: ast.AST
+    ) -> Optional[Tuple[ModuleIndex, str]]:
+        """The concrete class a function's `-> T` annotation names,
+        resolved in the DEFINING module's namespace."""
+        tname = _annotation_type_name(getattr(fn_node, "returns", None))
+        if not tname:
+            return None
+        found = self.find_class(owner, tname)
+        if found is None:
+            return None
+        fmod, rec = found
+        return (fmod, rec["node"].name)
+
+    def _call_result_class(
+        self, mod: ModuleIndex, call: ast.Call
+    ) -> Optional[Tuple[ModuleIndex, str]]:
+        """The concrete class an `<expr>(...)` call produces: a direct
+        constructor, or a factory through its `-> T` return annotation
+        (`M.new_gauge(...)`, `breaker.fresh(...)`)."""
+        func = call.func
+        resolved: Optional[Tuple[ModuleIndex, str]] = None
+        if isinstance(func, ast.Name):
+            n = func.id
+            found = self.find_class(mod, n)
+            if found is not None:
+                resolved = (found[0], found[1]["node"].name)
+            elif n in mod.functions:
+                resolved = self._returned_class(mod, mod.functions[n])
+            else:
+                fi_entry = mod.from_imports.get(n)
+                if fi_entry is not None and fi_entry[0] is not None:
+                    target = self.module_for_dotted(fi_entry[0])
+                    if (
+                        target is not None
+                        and fi_entry[2] in target.functions
+                    ):
+                        resolved = self._returned_class(
+                            target, target.functions[fi_entry[2]]
+                        )
+        elif isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ):
+            target = None
+            alias = mod.import_alias.get(func.value.id)
+            if alias is not None:
+                prefix = self.pkg_name + "."
+                if alias.startswith(prefix):
+                    target = self.module_for_dotted(alias[len(prefix):])
+                elif alias == self.pkg_name:
+                    target = self.module_for_dotted("")
+            else:
+                fi_entry = mod.from_imports.get(func.value.id)
+                if fi_entry is not None and fi_entry[0] is not None:
+                    base = (
+                        fi_entry[0] + "." + fi_entry[2]
+                        if fi_entry[0]
+                        else fi_entry[2]
+                    )
+                    target = self.module_for_dotted(base)
+            if target is not None:
+                if func.attr in target.classes:
+                    resolved = (target, func.attr)
+                elif func.attr in target.functions:
+                    resolved = self._returned_class(
+                        target, target.functions[func.attr]
+                    )
+        return resolved
+
+    def _infer_module_vars(self, mod: ModuleIndex) -> None:
+        """Resolve module-level `x = <Call>(...)` globals to concrete
+        classes: direct constructors, and factory calls through a
+        `-> T` return annotation (`_m_state = M.new_gauge(...)`)."""
+        for name, call in mod._var_assigns:
+            resolved = self._call_result_class(mod, call)
+            if resolved is not None:
+                mod.var_class[name] = resolved
+
     def _collect_functions(self, mod: ModuleIndex) -> None:
+        # defs are collected at ANY statement depth — a worker spawned
+        # from inside an `if`/`with`/`try` block (the cmd stdin-reader
+        # idiom) is still a graph node; only defs nested in OTHER defs
+        # get the dotted qualname prefix
         def visit(node, prefix, class_name):
-            for item in getattr(node, "body", []):
+            for item in ast.iter_child_nodes(node):
                 if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     qual = f"{prefix}{item.name}"
                     fi = FuncInfo(mod.path, qual, item, class_name)
@@ -373,6 +532,8 @@ class Package:
                     visit(item, qual + ".", class_name)
                 elif isinstance(item, ast.ClassDef):
                     visit(item, f"{prefix}{item.name}.", item.name)
+                elif not isinstance(item, ast.Lambda):
+                    visit(item, prefix, class_name)
 
         visit(mod.tree, "", None)
 
@@ -402,6 +563,39 @@ class Package:
                         for tgt in node.targets:
                             if isinstance(tgt, ast.Name):
                                 out[tgt.id] = cname
+                else:
+                    # x = factory(...) / x = mod.factory(...) through
+                    # the factory's `-> T` return annotation — the
+                    # `b = breaker.fresh(name); b.set_probe(fn)` idiom
+                    res = self._call_result_class(mod, node.value)
+                    if res is not None:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                out[tgt.id] = res[1]
+                # y = G.pop(...) / G.get(...) where G is a module-level
+                # Dict[K, V] global — the registry idiom
+                f = node.value.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.attr in ("pop", "get", "setdefault")
+                    and f.value.id in mod.var_value_types
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out[tgt.id] = mod.var_value_types[f.value.id]
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Subscript
+            ):
+                # y = G[...] on a Dict[K, V]-annotated global
+                sub = node.value.value
+                if (
+                    isinstance(sub, ast.Name)
+                    and sub.id in mod.var_value_types
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out[tgt.id] = mod.var_value_types[sub.id]
             it = None
             tgt = None
             if isinstance(node, (ast.For, ast.AsyncFor)):
@@ -540,6 +734,15 @@ class Package:
         # x.m() where x has a locally inferred class type
         if len(parts) == 2 and head in local_types:
             key = self._method_key(mod, local_types[head], method)
+            if key is not None:
+                return CallSite(key, None, lineno, col)
+            return None
+
+        # g.m() where g is a module-level instance global with an
+        # inferred class (ctor or `-> T`-annotated factory assignment)
+        if len(parts) == 2 and head in mod.var_class:
+            owner, cname = mod.var_class[head]
+            key = self._method_key(owner, cname, method)
             if key is not None:
                 return CallSite(key, None, lineno, col)
             return None

@@ -372,14 +372,19 @@ def load_baseline(path: Optional[str] = None) -> Dict[str, int]:
 
 
 def save_baseline(
-    violations: Iterable[Violation], path: Optional[str] = None
+    violations: Iterable[Violation],
+    path: Optional[str] = None,
+    note: Optional[str] = None,
 ) -> Dict[str, int]:
     path = path or BASELINE_PATH
     counts = baseline_counts(violations)
     data = {
         "version": 1,
         "generated_by": "scripts/lint.py --baseline-update",
-        "note": (
+        # the note names the suppression syntax for THIS tool's
+        # findings — tmrace passes its own (race-ok / guarded-by)
+        "note": note
+        or (
             "Accepted pre-existing violations, fingerprinted by "
             "rule:path:sha1(source_line)[:12]. New violations are "
             "anything over these counts. Do not hand-edit counts to "

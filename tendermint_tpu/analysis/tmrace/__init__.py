@@ -1,0 +1,397 @@
+"""tmrace — whole-program static data-race and lock-order analysis.
+
+The Go reference leans on `go test -race` (dynamic happens-before) and
+the lockrank build tag; lockwatch (PR 4) replicates the runtime half
+but only witnesses what the suite executes. tmrace is the static half,
+on the same substrate tmcheck's taint pass uses (the PR-5 call graph):
+
+1. **Thread roots** (`threadroots.py`): every concurrent entry point —
+   `threading.Thread`/`Timer`/`run_in_executor` targets, the asyncio
+   main loop (all coroutines: consensus receive loop, RPC/WS
+   handlers), and the tests/ hammer spawns — and the *concurrent
+   region*: functions reachable from ≥2 root identities (or one
+   self-concurrent one).
+2. **Lockset dataflow** (`lockset.py`): MUST-held locksets propagated
+   along every call path (recognizing `with <lock>:`, the `*_locked`
+   convention, and tmlint's justified exemptions); writes to module
+   globals and shared instance fields whose write-lockset intersection
+   is empty are flagged. Per-site `# tmrace: race-ok` /
+   `# tmrace: guarded-by=<lock>` suppressions and a counted
+   fingerprint baseline (`race_baseline.json`) in the tmlint/tmcheck
+   style.
+3. **Static lock order** (`lockorder.py`): held->acquiring edges along
+   all static paths, checked for cycles and diffed against lockwatch's
+   RANK table and its `RANK_EDGES` classification — rank acyclicity is
+   proven over paths no test executes, and the table cannot silently
+   drift from the code.
+
+Run via `scripts/lint.py --race` (or the default full gate); tier-1
+gates live in tests/test_tmrace.py. docs/static_analysis.md documents
+the root catalog, the lockset rules, the suppression/baseline policy,
+and the static-vs-lockwatch division of labor.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..tmlint import (
+    Violation,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from ..tmcheck.callgraph import Package, build_package
+from . import lockorder, lockset, threadroots
+from .lockorder import rank_drift, rank_violations
+from .lockset import WILDCARD, FuncSummary, Summarizer, propagate
+from .threadroots import (
+    ThreadRoot,
+    discover_roots,
+    discover_test_roots,
+    reach,
+    witness_chain,
+)
+
+__all__ = [
+    "RULES",
+    "RACE_BASELINE_PATH",
+    "RACE_BASELINE_NOTE",
+    "RaceReport",
+    "analyze",
+    "race_violations",
+    "new_race_violations",
+    "update_race_baseline",
+]
+
+FuncKey = Tuple[str, str]
+
+RACE_BASELINE_PATH = os.path.join(
+    os.path.dirname(__file__), "race_baseline.json"
+)
+
+# written into race_baseline.json so the artifact's own instructions
+# name tmrace's suppression forms, not tmlint's
+RACE_BASELINE_NOTE = (
+    "Accepted pre-existing race findings, fingerprinted by "
+    "rule:path:sha1(source_line)[:12]. New findings are anything over "
+    "these counts. Do not hand-edit counts to sneak a new finding in "
+    "— fix it, or suppress it with a justified '# tmrace: race-ok — "
+    "why' / '# tmrace: guarded-by=<lock>' (or, for lock-discipline "
+    "sites, a justified '# tmlint: disable=lock-global-mutation')."
+)
+
+# the tmrace rule catalog (mirrored by --list-rules and the docs table)
+RULES = [
+    (
+        "race-unguarded-global",
+        "module global written from the concurrent region with an "
+        "empty write-lockset intersection",
+    ),
+    (
+        "race-unguarded-field",
+        "shared instance field written from the concurrent region "
+        "with an empty write-lockset intersection",
+    ),
+    (
+        "race-lock-order",
+        "static held->acquiring edge contradicting lockwatch RANK, or "
+        "a cycle in the static lock graph",
+    ),
+    (
+        "race-rank-drift",
+        "lockwatch RANK_EDGES entry declared static but no longer "
+        "derivable from source",
+    ),
+]
+
+
+class RaceReport:
+    """Everything one analysis run produced (the CLI and the tests
+    read different slices)."""
+
+    def __init__(self) -> None:
+        self.roots: List[ThreadRoot] = []
+        self.identities: Dict[FuncKey, Set[str]] = {}
+        self.self_concurrent: Set[str] = set()
+        self.concurrent_region: Set[FuncKey] = set()
+        self.edges: Dict[Tuple[str, str], lockset.LockEdge] = {}
+        self.truncated_contexts = 0
+        self.violations: List[Violation] = []
+
+
+def _effective_degree(ids: Set[str], self_conc: Set[str]) -> int:
+    return len(ids) + (1 if any(i in self_conc for i in ids) else 0)
+
+
+def analyze(
+    pkg: Optional[Package] = None,
+    tests_root: Optional[str] = None,
+    rank: Optional[Dict[str, int]] = None,
+    rank_edges: Optional[Dict[Tuple[str, str], str]] = None,
+    rank_names: Optional[Dict[str, str]] = None,
+    include_test_roots: bool = True,
+) -> RaceReport:
+    pkg = pkg or build_package()
+    report = RaceReport()
+
+    # -- roots and the concurrent region --
+    roots = discover_roots(pkg)
+    if include_test_roots:
+        roots += discover_test_roots(pkg, tests_root)
+    # callback escape (the breaker set_probe idiom) can expose new
+    # sink-reaching functions, which can expose new callbacks: iterate
+    # to fixpoint (bounded: each round adds ≥1 root from a finite set)
+    while True:
+        extra = threadroots.callback_roots(pkg, roots)
+        if not extra:
+            break
+        roots += extra
+    report.roots = roots
+    report.self_concurrent = {
+        r.identity for r in roots if r.self_concurrent
+    }
+    identities, parents = reach(pkg, roots)
+    report.identities = identities
+    report.concurrent_region = {
+        k
+        for k, ids in identities.items()
+        if _effective_degree(ids, report.self_concurrent) >= 2
+    }
+
+    # -- summaries + lockset propagation --
+    summarizer = Summarizer(pkg)
+    summaries: Dict[FuncKey, FuncSummary] = {}
+    for key in identities:
+        summaries[key] = summarizer.summarize_function(pkg.functions[key])
+    root_keys = sorted({r.key for r in roots})
+    entry_contexts, edges, truncated = propagate(pkg, summaries, root_keys)
+    report.edges = edges
+    report.truncated_contexts = truncated
+
+    known_locks: Set[str] = set()
+    for a, b in edges:
+        known_locks.update((a, b))
+    for s in summaries.values():
+        for w in s.with_sites:
+            known_locks.add(w.lock)
+        known_locks |= set(s.convention)
+
+    # -- suppression maps --
+    race_ok: Dict[str, Set[int]] = {}
+    guarded_by: Dict[str, Dict[int, Set[str]]] = {}
+    for path, mod in pkg.modules.items():
+        ok, gb = lockset.suppression_maps(mod.lines)
+        race_ok[path] = ok
+        guarded_by[path] = {
+            ln: {
+                lockset.resolve_guard_name(a, known_locks)
+                for a in asserted
+            }
+            for ln, asserted in gb.items()
+        }
+
+    # -- collect shared-state accesses --
+    class _Site:
+        __slots__ = ("key", "lineno", "write", "locks", "what")
+
+        def __init__(self, key, lineno, write, locks, what):
+            self.key = key
+            self.lineno = lineno
+            self.write = write
+            self.locks = locks
+            self.what = what
+
+    # collect from EVERY rooted function, not just the concurrent
+    # region: a race pairs sites across identities, and each endpoint
+    # may itself be reachable from only ONE root (main-loop-only write
+    # vs probe-thread-only write) — the per-variable degree cut below,
+    # over the union of the sites' identities, is the concurrency
+    # filter. Iterating `identities` (insertion-ordered dict) rather
+    # than the region set also keeps site order hash-seed-independent.
+    by_var: Dict[tuple, List[_Site]] = {}
+    for key in identities:
+        summary = summaries[key]
+        ctxs = entry_contexts.get(key)
+        must_entry: FrozenSet[str] = (
+            frozenset.intersection(*ctxs) if ctxs else frozenset()
+        )
+        base = must_entry | summary.convention
+        path = key[0]
+        for acc in summary.accesses:
+            if acc.lineno in race_ok.get(path, ()):
+                continue
+            locks = acc.locks | base | frozenset(
+                guarded_by.get(path, {}).get(acc.lineno, ())
+            )
+            by_var.setdefault(acc.var, []).append(
+                _Site(key, acc.lineno, acc.write, locks, acc.what)
+            )
+
+    violations: List[Violation] = []
+
+    def _line_text(path: str, lineno: int) -> str:
+        lines = pkg.modules[path].lines
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1].strip()
+        return ""
+
+    for var, sites in sorted(by_var.items(), key=lambda kv: str(kv[0])):
+        ids: Set[str] = set()
+        for s in sites:
+            ids |= identities.get(s.key, set())
+        if _effective_degree(ids, report.self_concurrent) < 2:
+            continue
+        writes = [s for s in sites if s.write]
+        # a write under a wildcard lock is audited-guarded: skip it
+        real_writes = [w for w in writes if WILDCARD not in w.locks]
+        if not real_writes:
+            continue
+        candidate = frozenset.intersection(
+            *[w.locks for w in real_writes]
+        )
+        if candidate:
+            continue
+        if var[0] == "g":
+            rule = "race-unguarded-global"
+            what = f"module global `{var[2]}`"
+        else:
+            rule = "race-unguarded-field"
+            what = f"shared field `{var[2]}.{var[3]}`"
+        id_list = ", ".join(sorted(ids)[:4]) + (
+            f" (+{len(ids) - 4} more)" if len(ids) > 4 else ""
+        )
+        others = "; ".join(
+            f"{w.key[0]}:{w.lineno} holds "
+            f"{{{', '.join(sorted(w.locks)) or ''}}}"
+            for w in real_writes[:4]
+        )
+        for w in real_writes:
+            if w.locks:
+                # guarded by SOMETHING, just inconsistently: still a
+                # finding, but anchor the message on the inconsistency
+                detail = (
+                    f"write locksets never intersect "
+                    f"(this site holds {{{', '.join(sorted(w.locks))}}})"
+                )
+            else:
+                detail = "written with no lock held on any path"
+            chains = []
+            for ident in sorted(identities.get(w.key, set()))[:2]:
+                chains.append(
+                    " -> ".join(
+                        witness_chain(pkg, parents, ident, w.key)
+                    )
+                )
+            violations.append(
+                Violation(
+                    rule=rule,
+                    path=w.key[0],
+                    line=w.lineno,
+                    col=0,
+                    message=(
+                        f"{what} {w.what}: {detail}; concurrent roots: "
+                        f"{id_list}; write sites: {others}; witness: "
+                        + " | ".join(chains)
+                    ),
+                    source=_line_text(w.key[0], w.lineno),
+                )
+            )
+
+    # -- lock order --
+    for v in rank_violations(edges, rank=rank, names=rank_names):
+        path, _, line = v["where"].partition(":")
+        a, b = v["edge"]
+        violations.append(
+            Violation(
+                rule="race-lock-order",
+                path=path,
+                line=int(line or 1),
+                col=0,
+                message=(
+                    f"static lock-order edge {a} (rank {v['rank'][0]}) "
+                    f"held while acquiring {b} (rank {v['rank'][1]}) "
+                    f"in {v['func']} contradicts lockwatch RANK"
+                ),
+                source=_line_text(path, int(line or 1)),
+            )
+        )
+    for cyc in lockorder.cycles(edges):
+        # every consecutive pair in a reported cycle (including the
+        # canonical rotation's first pair) is an edge of the input
+        first = edges[(cyc[0], cyc[1 % len(cyc)])]
+        path, _, line = first.where.partition(":")
+        violations.append(
+            Violation(
+                rule="race-lock-order",
+                path=path,
+                line=int(line or 1),
+                col=0,
+                message=(
+                    "static lock-order cycle "
+                    + " -> ".join(cyc + [cyc[0]])
+                    + f" (first edge in {first.func}) — latent deadlock "
+                    "even if no test interleaves it"
+                ),
+                source=_line_text(path, int(line or 1)),
+            )
+        )
+    drift_path = "analysis/lockwatch.py"
+    drift_line = _find_rank_edges_line(pkg, drift_path)
+    for d in rank_drift(edges, rank_edges=rank_edges, names=rank_names):
+        a, b = d["edge"]
+        violations.append(
+            Violation(
+                rule="race-rank-drift",
+                path=drift_path,
+                line=drift_line,
+                col=0,
+                message=f"RANK_EDGES ({a} -> {b}): {d['reason']}",
+                source=f"RANK_EDGES[({a!r}, {b!r})]",
+            )
+        )
+
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    report.violations = violations
+    return report
+
+
+def _find_rank_edges_line(pkg: Package, path: str) -> int:
+    mod = pkg.modules.get(path)
+    if mod is None:
+        return 1
+    for i, text in enumerate(mod.lines, start=1):
+        if text.startswith("RANK_EDGES"):
+            return i
+    return 1
+
+
+def race_violations(
+    pkg: Optional[Package] = None, **kwargs
+) -> List[Violation]:
+    return analyze(pkg, **kwargs).violations
+
+
+def new_race_violations(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+    **kwargs,
+) -> List[Violation]:
+    """Race findings beyond the checked-in baseline (same counted
+    fingerprint semantics as tmlint/tmcheck)."""
+    violations = race_violations(pkg, **kwargs)
+    baseline = load_baseline(baseline_path or RACE_BASELINE_PATH)
+    return new_violations(violations, baseline)
+
+
+def update_race_baseline(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+    **kwargs,
+) -> Dict[str, int]:
+    return save_baseline(
+        race_violations(pkg, **kwargs),
+        baseline_path or RACE_BASELINE_PATH,
+        note=RACE_BASELINE_NOTE,
+    )

@@ -1,0 +1,138 @@
+"""Static lock-order graph, diffed against lockwatch's RANK table.
+
+lockwatch (PR 4) witnesses held->acquiring edges on the paths the test
+suite happens to execute; this pass derives them along EVERY static
+path from every thread root, so:
+
+- rank acyclicity is proven over paths no test executes: a static
+  edge between two RANKED locks must go low -> high, and the full
+  static graph (ranked or not) must be acyclic — a witnessed A->B in
+  one function plus B->A in another is a latent deadlock even if no
+  test interleaves them;
+- the RANK table can never silently drift from the code: every edge
+  lockwatch documents in `RANK_EDGES` as "static" must actually be
+  derivable from the source, and edges only observable at runtime
+  (through dynamic dispatch the call graph cannot resolve) must say
+  so with "runtime-only". Deleting the code that creates a static
+  edge without updating the table fails the gate.
+
+Static lock identities map onto lockwatch's rank names through
+`STATIC_RANK_NAMES` below — the same class-not-instance naming both
+systems use. A same-name edge (lock class nested inside itself) on a
+non-reentrant lock is reported as a cycle: lockwatch treats witnessed
+self-loops as instance-order hazards, and statically they are either
+a self-deadlock (same instance) or an unordered instance pair.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..lockwatch import RANK, RANK_EDGES, _find_cycles
+from .lockset import LockEdge
+
+__all__ = [
+    "STATIC_RANK_NAMES",
+    "rank_violations",
+    "cycles",
+    "rank_drift",
+]
+
+# static lock identity -> lockwatch RANK name. The left side is the
+# `<path>:<name>` / `<path>:<Class>.<attr>` identity lockset.py
+# assigns; keep this in lockstep with lockwatch.enable()'s
+# instrument_attr/namer calls (test_tmrace pins the round trip).
+STATIC_RANK_NAMES: Dict[str, str] = {
+    "crypto/breaker.py:_REG_LOCK": "breaker.registry",
+    "crypto/breaker.py:CircuitBreaker._lock": "breaker.instance",
+    "crypto/sigcache.py:_lock": "sigcache.rotate",
+    "crypto/tpu_verifier.py:_wedged_lock": "tpu_verifier.wedged",
+    "libs/trace.py:_ring_lock": "trace.ring",
+    "libs/metrics.py:_Metric._lock": "metrics.metric",
+    "libs/metrics.py:Registry._lock": "metrics.registry",
+}
+
+
+def ranked_edges(
+    edges: Dict[Tuple[str, str], LockEdge],
+    names: Optional[Dict[str, str]] = None,
+) -> Dict[Tuple[str, str], LockEdge]:
+    """The statically derived edges translated into RANK-name space
+    (edges with an unranked endpoint are dropped)."""
+    names = STATIC_RANK_NAMES if names is None else names
+    out: Dict[Tuple[str, str], LockEdge] = {}
+    for (a, b), e in edges.items():
+        na, nb = names.get(a), names.get(b)
+        if na is not None and nb is not None:
+            out.setdefault((na, nb), e)
+    return out
+
+
+def rank_violations(
+    edges: Dict[Tuple[str, str], LockEdge],
+    rank: Optional[Dict[str, int]] = None,
+    names: Optional[Dict[str, str]] = None,
+) -> List[dict]:
+    """Static edges contradicting the declared order: a ranked lock
+    held while acquiring a lower-ranked one."""
+    rank = RANK if rank is None else rank
+    out: List[dict] = []
+    for (na, nb), e in sorted(ranked_edges(edges, names).items()):
+        ra, rb = rank.get(na), rank.get(nb)
+        if ra is not None and rb is not None and ra > rb:
+            out.append(
+                {
+                    "edge": (na, nb),
+                    "rank": (ra, rb),
+                    "where": e.where,
+                    "func": e.func,
+                }
+            )
+    return out
+
+
+def cycles(edges: Dict[Tuple[str, str], LockEdge]) -> List[List[str]]:
+    """Simple cycles (self-loops included) in the full static graph —
+    the same detector as lockwatch's witnessed-order graph, so the
+    static and runtime gates can never diverge on what counts as a
+    cycle."""
+    return _find_cycles(set(edges))
+
+
+def rank_drift(
+    edges: Dict[Tuple[str, str], LockEdge],
+    rank_edges: Optional[Dict[Tuple[str, str], str]] = None,
+    names: Optional[Dict[str, str]] = None,
+) -> List[dict]:
+    """RANK_EDGES entries declared "static" that the source no longer
+    produces — the table drifted from the code. "runtime-only" entries
+    are exempt by declaration; anything else in the classification
+    column is itself an error."""
+    rank_edges = RANK_EDGES if rank_edges is None else rank_edges
+    derived = ranked_edges(edges, names)
+    out: List[dict] = []
+    for (a, b), cls in sorted(rank_edges.items()):
+        if cls == "runtime-only":
+            continue
+        if cls != "static":
+            out.append(
+                {
+                    "edge": (a, b),
+                    "reason": f"unknown RANK_EDGES class {cls!r} "
+                    "(use 'static' or 'runtime-only')",
+                }
+            )
+            continue
+        if (a, b) not in derived:
+            out.append(
+                {
+                    "edge": (a, b),
+                    "reason": (
+                        "declared static in lockwatch.RANK_EDGES but not "
+                        "derivable from any call path — the code moved; "
+                        "update the table (or mark the edge runtime-only "
+                        "with a reason)"
+                    ),
+                }
+            )
+    return out

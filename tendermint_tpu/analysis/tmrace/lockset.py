@@ -1,0 +1,738 @@
+"""Interprocedural lockset dataflow — the static half of `-race`.
+
+RacerD-style over-approximation sized for this codebase: for every
+function in the *concurrent region* (reachable from ≥2 thread roots,
+or from one self-concurrent root — see `threadroots`), compute the set
+of locks MUST-held at each shared-state access, and flag writes whose
+lockset intersection across all write sites is empty.
+
+**Lock identity is the lock CLASS, not the instance** (exactly how
+lockwatch and Go's lockrank name locks): `self._lock` inside any
+`CircuitBreaker` method is `crypto/breaker.py:CircuitBreaker._lock`,
+attributed to the class (or base class) whose `__init__` creates it,
+so `Counter.inc`'s `with self._lock:` names the shared
+`libs/metrics.py:_Metric._lock` class. Module-level locks are
+`<path>:<name>`. The same attribution applies to the shared state
+itself: instance fields are `(path, Class, attr)`, module globals
+`(path, name)`.
+
+What counts as holding a lock at a site:
+
+- an enclosing `with <lock>:` in the same function (a context whose
+  dotted expression names a lock born from `threading.Lock/RLock/
+  Condition`, or whose name contains "lock" — tmlint's heuristic);
+- the function's MUST-entry lockset: the *intersection* of locks held
+  at every call path from every thread root (computed by the
+  context-sensitive traversal shared with the lock-order pass);
+- the `*_locked` naming convention (tmlint's exemption): a method
+  `foo_locked` of class C is by contract called with C's `_lock`
+  held; a module-level `*_locked` function is treated as guarded by
+  an unknowable caller lock (wildcard);
+- a `# tmrace: guarded-by=<lock>` annotation on the line (an audited
+  claim the dataflow cannot see, e.g. a lock acquired through an
+  indirection).
+
+Exemptions, in the established suppression style:
+
+- `# tmrace: race-ok — why` on the line (or the comment block above):
+  the access is intentionally unsynchronized and the comment says why;
+- `# tmlint: disable=lock-global-mutation` sites: those carry a
+  justified GIL-atomicity argument already (sigcache's set ops, the
+  trace ring append) — one audited claim should not need two tags;
+- writes inside `__init__`/`__new__` (single-threaded construction);
+- import-time (module body) statements.
+
+Known over/under-approximations (documented in
+docs/static_analysis.md): lock-free READS of lock-guarded state are
+not flagged (the codebase's deliberate GIL fast-path idiom, same call
+as tmlint's mutation-only rule); per-instance locks collapsing onto
+the class identity means a global guarded by *different instances'*
+locks would falsely pass; unresolved call edges hide whatever runs
+behind them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..tmlint import dotted_name as _dotted
+from ..tmcheck.callgraph import FuncInfo, ModuleIndex, Package
+
+__all__ = [
+    "Access",
+    "FuncSummary",
+    "LockEdge",
+    "WILDCARD",
+    "summarize",
+    "propagate",
+    "born_locks",
+]
+
+FuncKey = Tuple[str, str]
+
+# a lock the analysis cannot name: holding it satisfies guardedness
+# (under-approximate on findings, never a false positive), but it
+# contributes no lock-order edges
+WILDCARD = "?"
+
+# one entry lockset context per (function, held-set) pair; beyond the
+# cap further contexts are dropped (the report counts them)
+MAX_CONTEXTS = 16
+
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "add", "discard", "update", "setdefault", "appendleft", "popleft",
+    "sort", "reverse",
+}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+_RACE_OK_RE = re.compile(r"#\s*tmrace:\s*race-ok\b")
+_GUARDED_BY_RE = re.compile(r"#\s*tmrace:\s*guarded-by=([A-Za-z0-9_.\-]+)")
+_TMLINT_LOCK_RE = re.compile(
+    r"#\s*tmlint:\s*disable=[^#]*\block-global-mutation\b"
+)
+
+
+# ---------------------------------------------------------------------------
+# lock birth sites and owner attribution
+
+
+def born_locks(pkg: Package):
+    """(instance_locks, global_locks): where locks are created.
+    instance_locks: (path, class, attr) -> ctor kind;
+    global_locks: (path, name) -> ctor kind."""
+    instance: Dict[Tuple[str, str, str], str] = {}
+    global_: Dict[Tuple[str, str], str] = {}
+
+    def ctor_kind(mod: ModuleIndex, value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        d = _dotted(value.func)
+        if d.startswith("threading.") and d.split(".")[1] in _LOCK_CTORS:
+            return d.split(".")[1]
+        if d in _LOCK_CTORS:
+            entry = mod.from_imports.get(d)
+            if entry is not None and entry[1] == "threading":
+                return d
+        return None
+
+    for mod in pkg.modules.values():
+        for node in mod.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                kind = ctor_kind(mod, node.value) if node.value else None
+                if kind:
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            global_[(mod.path, t.id)] = kind
+        for cname, rec in mod.classes.items():
+            for m in rec["methods"].values():
+                for node in ast.walk(m):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    kind = ctor_kind(mod, node.value)
+                    if not kind:
+                        continue
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            instance[(mod.path, cname, t.attr)] = kind
+    return instance, global_
+
+
+class _Attribution:
+    """Resolves `self.<attr>` (and typed receivers) to the class that
+    OWNS the attribute — the class in the MRO whose methods assign it —
+    so subclass uses share one identity."""
+
+    def __init__(self, pkg: Package) -> None:
+        self.pkg = pkg
+        self._assign_cache: Dict[Tuple[str, str], Set[str]] = {}
+        self._owner_cache: Dict[Tuple[str, str, str], Optional[Tuple[str, str]]] = {}
+
+    def _assigned_attrs(self, mod: ModuleIndex, cname: str) -> Set[str]:
+        key = (mod.path, cname)
+        got = self._assign_cache.get(key)
+        if got is not None:
+            return got
+        attrs: Set[str] = set()
+        rec = mod.classes.get(cname)
+        if rec is not None:
+            for m in rec["methods"].values():
+                for node in ast.walk(m):
+                    tgts: List[ast.AST] = []
+                    if isinstance(node, ast.Assign):
+                        tgts = node.targets
+                    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                        tgts = [node.target]
+                    for t in tgts:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            attrs.add(t.attr)
+            for item in rec["node"].body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                    item.target, ast.Name
+                ):
+                    attrs.add(item.target.id)
+        self._assign_cache[key] = attrs
+        return attrs
+
+    def owner(
+        self, mod: ModuleIndex, cname: str, attr: str, _depth: int = 0
+    ) -> Optional[Tuple[str, str]]:
+        """(path, class) owning `attr` for class `cname` visible in
+        `mod`, walking base classes; None when nothing assigns it."""
+        ck = (mod.path, cname, attr)
+        if ck in self._owner_cache:
+            return self._owner_cache[ck]
+        out: Optional[Tuple[str, str]] = None
+        if _depth <= 4:
+            found = self.pkg.find_class(mod, cname)
+            if found is not None:
+                owner_mod, rec = found
+                real = rec["node"].name
+                # prefer the deepest BASE that assigns it (shared
+                # identity); fall back to this class
+                for base in rec["bases"]:
+                    base = base.split(".")[-1]
+                    got = self.owner(owner_mod, base, attr, _depth + 1)
+                    if got is not None:
+                        out = got
+                        break
+                if out is None and attr in self._assigned_attrs(
+                    owner_mod, real
+                ):
+                    out = (owner_mod.path, real)
+        self._owner_cache[ck] = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# per-function syntactic summaries
+
+
+class Access:
+    """One shared-state touch: a module global or a `self.` field."""
+
+    __slots__ = ("var", "write", "lineno", "locks", "what")
+
+    def __init__(self, var, write, lineno, locks, what) -> None:
+        self.var = var  # ("g", path, name) | ("f", path, class, attr)
+        self.write = write
+        self.lineno = lineno
+        self.locks: FrozenSet[str] = locks  # syntactic (with-enclosed)
+        self.what = what  # rendered access form for the message
+
+
+class WithSite:
+    __slots__ = ("lineno", "lock", "outer", "kind")
+
+    def __init__(self, lineno, lock, outer, kind) -> None:
+        self.lineno = lineno
+        self.lock = lock
+        self.outer: FrozenSet[str] = outer
+        self.kind = kind  # Lock | RLock | Condition | "" (heuristic)
+
+
+class FuncSummary:
+    __slots__ = (
+        "key", "with_sites", "call_locks", "accesses", "convention"
+    )
+
+    def __init__(self, key) -> None:
+        self.key = key
+        self.with_sites: List[WithSite] = []
+        # (lineno, col) of a call -> locks syntactically held there
+        self.call_locks: Dict[Tuple[int, int], FrozenSet[str]] = {}
+        self.accesses: List[Access] = []
+        self.convention: FrozenSet[str] = frozenset()
+
+
+class LockEdge:
+    """One held -> acquiring edge derived along some static path."""
+
+    __slots__ = ("held", "acquired", "where", "func")
+
+    def __init__(self, held, acquired, where, func) -> None:
+        self.held = held
+        self.acquired = acquired
+        self.where = where
+        self.func = func
+
+
+class Summarizer:
+    """Builds per-function summaries: with-site lock names, per-call
+    held sets, and shared-state accesses, with lock names attributed
+    per the module docstring."""
+
+    def __init__(self, pkg: Package) -> None:
+        self.pkg = pkg
+        self.attribution = _Attribution(pkg)
+        self.instance_locks, self.global_locks = born_locks(pkg)
+        # per-module name sets
+        self._module_globals: Dict[str, Set[str]] = {}
+        for mod in pkg.modules.values():
+            names: Set[str] = set()
+            for node in mod.tree.body:
+                tgts: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    tgts = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    tgts = [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            self._module_globals[mod.path] = names
+
+    def module_globals(self, path: str) -> Set[str]:
+        return self._module_globals.get(path, set())
+
+    # -- lock naming --
+
+    def _is_lock_ctx(self, mod, fi, expr, local_types) -> Optional[str]:
+        """The stable lock name for a with-context expression, or None
+        when it isn't a lock."""
+        d = _dotted(expr)
+        if not d and isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+        if not d:
+            return None
+        parts = d.split(".")
+        head, attr = parts[0], parts[-1]
+        lockish = "lock" in d.lower()
+        if len(parts) == 1:
+            # bare name: module-level lock global or a local alias
+            if (mod.path, head) in self.global_locks:
+                return f"{mod.path}:{head}"
+            if lockish and head in self.module_globals(mod.path):
+                return f"{mod.path}:{head}"
+            return WILDCARD if lockish else None
+        cname: Optional[str] = None
+        if head in ("self", "cls") and len(parts) == 2 and fi.class_name:
+            cname = fi.class_name
+            cmod = mod
+        elif len(parts) == 2 and head in local_types:
+            cname = local_types[head]
+            cmod = mod
+        elif len(parts) == 2 and head in mod.var_class:
+            owner, oc = mod.var_class[head]
+            cname, cmod = oc, owner
+        else:
+            # mod-attr: `sigcache._lock` through an import
+            entry = mod.from_imports.get(head)
+            target = None
+            if entry is not None and entry[0] is not None:
+                base = entry[0] + "." + entry[2] if entry[0] else entry[2]
+                target = self.pkg.module_for_dotted(base)
+            if target is not None and len(parts) == 2:
+                if (target.path, attr) in self.global_locks or (
+                    lockish and attr in self.module_globals(target.path)
+                ):
+                    return f"{target.path}:{attr}"
+            return WILDCARD if lockish else None
+        owner = self.attribution.owner(cmod, cname, attr)
+        if owner is not None:
+            if (owner[0], owner[1], attr) in self.instance_locks:
+                return f"{owner[0]}:{owner[1]}.{attr}"
+            if lockish:
+                return f"{owner[0]}:{owner[1]}.{attr}"
+            return None
+        return WILDCARD if lockish else None
+
+    def lock_kind(self, name: str) -> str:
+        if ":" not in name:
+            return ""
+        path, rest = name.split(":", 1)
+        if "." in rest:
+            cname, attr = rest.rsplit(".", 1)
+            return self.instance_locks.get((path, cname, attr), "")
+        return self.global_locks.get((path, rest), "")
+
+    def _convention(self, mod, fi) -> FrozenSet[str]:
+        """`*_locked` naming: the owner's `_lock` is held by contract."""
+        leaf = fi.qualname.split(".")[-1]
+        if not leaf.endswith("_locked"):
+            return frozenset()
+        if fi.class_name:
+            owner = self.attribution.owner(mod, fi.class_name, "_lock")
+            if owner is not None:
+                return frozenset({f"{owner[0]}:{owner[1]}._lock"})
+        return frozenset({WILDCARD})
+
+    # -- the walker --
+
+    def summarize_function(self, fi: FuncInfo) -> FuncSummary:
+        mod = self.pkg.modules[fi.path]
+        local_types = self.pkg._local_types(mod, fi.node)
+        summary = FuncSummary(fi.key)
+        summary.convention = self._convention(mod, fi)
+        globals_here = self.module_globals(fi.path)
+        is_init = fi.qualname.split(".")[-1] in ("__init__", "__new__")
+        methods = (
+            set(mod.classes[fi.class_name]["methods"])
+            if fi.class_name and fi.class_name in mod.classes
+            else set()
+        )
+
+        # names bound locally (shadowing module globals for reads).
+        # Scope-correct: nested defs/classes are separate scopes (and
+        # separate graph nodes, like the access walker treats them) —
+        # a nested `global X` must not turn the enclosing function's
+        # plain local X into a global write, and a name bound only
+        # inside a nested def must not hide the outer function's reads
+        # of the same-named module global
+        def body_nodes(root: ast.AST):
+            stack = list(ast.iter_child_nodes(root))
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                yield node
+                stack.extend(ast.iter_child_nodes(node))
+
+        declared_global: Set[str] = set()
+        bound: Set[str] = set()
+        for node in body_nodes(fi.node):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+        args = fi.node.args
+        for a in (
+            list(args.args)
+            + list(args.posonlyargs)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            bound.add(a.arg)
+        for node in body_nodes(fi.node):
+            tgts: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                tgts = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                tgts = [node.target]
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                tgts = [node.target]
+            elif isinstance(node, (ast.withitem,)) and node.optional_vars:
+                tgts = [node.optional_vars]
+            elif isinstance(node, ast.comprehension):
+                tgts = [node.target]
+            for t in tgts:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        bound.add(n.id)
+        shadowed = bound - declared_global
+
+        def global_var(name: str, for_write: bool) -> Optional[tuple]:
+            if name not in globals_here:
+                return None
+            if for_write and name not in declared_global:
+                return None  # a plain assignment makes it local
+            if not for_write and name in shadowed:
+                return None
+            return ("g", fi.path, name)
+
+        def field_var(attr: str) -> Optional[tuple]:
+            if not fi.class_name or attr in methods:
+                return None
+            owner = self.attribution.owner(mod, fi.class_name, attr)
+            if owner is None:
+                owner = (fi.path, fi.class_name)
+            return ("f", owner[0], owner[1], attr)
+
+        def add_access(var, write, node, locks, what):
+            if var is None:
+                return
+            if write and is_init and var[0] == "f":
+                return  # single-threaded construction
+            summary.accesses.append(
+                Access(var, write, node.lineno, locks, what)
+            )
+
+        def walk(node: ast.AST, held: FrozenSet[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue  # separate graph nodes
+                walk_node(child, held)
+
+        def walk_with(child: ast.AST, held: FrozenSet[str]) -> None:
+            inner = held
+            for item in child.items:
+                name = self._is_lock_ctx(
+                    mod, fi, item.context_expr, local_types
+                )
+                walk(item.context_expr, inner)
+                if name is not None:
+                    kind = (
+                        self.lock_kind(name) if name != WILDCARD else ""
+                    )
+                    summary.with_sites.append(
+                        WithSite(
+                            item.context_expr.lineno, name, inner, kind
+                        )
+                    )
+                    inner = inner | {name}
+            for stmt in child.body:
+                walk_node(stmt, inner)
+
+        def walk_node(child: ast.AST, held: FrozenSet[str]) -> None:
+            # dispatched for DIRECT and nested statements alike, so a
+            # `with b:` inside a `with a:` body still records its site
+            # (and the a->b order edge)
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                return walk_with(child, held)
+            if isinstance(child, ast.Call):
+                summary.call_locks[(child.lineno, child.col_offset)] = held
+                f = child.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATING_METHODS
+                ):
+                    recv = f.value
+                    if isinstance(recv, ast.Name):
+                        add_access(
+                            global_var(recv.id, False)
+                            if recv.id in globals_here
+                            and recv.id not in shadowed
+                            else None,
+                            True,
+                            child,
+                            held,
+                            f"`{recv.id}.{f.attr}()`",
+                        )
+                    elif (
+                        isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"
+                    ):
+                        add_access(
+                            field_var(recv.attr),
+                            True,
+                            child,
+                            held,
+                            f"`self.{recv.attr}.{f.attr}()`",
+                        )
+            elif isinstance(child, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if isinstance(child, ast.AnnAssign) and child.value is None:
+                    return walk(child, held)  # bare annotation, no write
+                tgts = (
+                    child.targets
+                    if isinstance(child, ast.Assign)
+                    else [child.target]
+                )
+                for t in tgts:
+                    if isinstance(t, ast.Name):
+                        add_access(
+                            global_var(t.id, True),
+                            True,
+                            child,
+                            held,
+                            f"`{t.id} = ...`",
+                        )
+                    elif (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        add_access(
+                            field_var(t.attr),
+                            True,
+                            child,
+                            held,
+                            f"`self.{t.attr} = ...`",
+                        )
+                    elif isinstance(t, ast.Subscript):
+                        v = t.value
+                        if isinstance(v, ast.Name):
+                            add_access(
+                                global_var(v.id, False)
+                                if v.id in globals_here
+                                and v.id not in shadowed
+                                else None,
+                                True,
+                                child,
+                                held,
+                                f"`{v.id}[...] = ...`",
+                            )
+                        elif (
+                            isinstance(v, ast.Attribute)
+                            and isinstance(v.value, ast.Name)
+                            and v.value.id == "self"
+                        ):
+                            add_access(
+                                field_var(v.attr),
+                                True,
+                                child,
+                                held,
+                                f"`self.{v.attr}[...] = ...`",
+                            )
+            elif isinstance(child, ast.Delete):
+                for t in child.targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name
+                    ):
+                        add_access(
+                            global_var(t.value.id, False)
+                            if t.value.id in globals_here
+                            and t.value.id not in shadowed
+                            else None,
+                            True,
+                            child,
+                            held,
+                            f"`del {t.value.id}[...]`",
+                        )
+            elif isinstance(child, ast.Name) and isinstance(
+                child.ctx, ast.Load
+            ):
+                add_access(
+                    global_var(child.id, False),
+                    False,
+                    child,
+                    held,
+                    f"`{child.id}` read",
+                )
+            elif (
+                isinstance(child, ast.Attribute)
+                and isinstance(child.ctx, ast.Load)
+                and isinstance(child.value, ast.Name)
+                and child.value.id == "self"
+            ):
+                add_access(
+                    field_var(child.attr),
+                    False,
+                    child,
+                    held,
+                    f"`self.{child.attr}` read",
+                )
+            walk(child, held)
+
+        walk(fi.node, frozenset())
+        return summary
+
+
+# ---------------------------------------------------------------------------
+# context-sensitive propagation (entry locksets + lock-order edges)
+
+
+def propagate(
+    pkg: Package,
+    summaries: Dict[FuncKey, FuncSummary],
+    root_keys: List[FuncKey],
+):
+    """Walk the call graph from every root, tracking the exact lockset
+    held at each call. Returns (entry_contexts, edges, truncated):
+    entry_contexts maps a function to the distinct entry locksets seen
+    (MUST-entry is their intersection); edges are held->acquiring pairs
+    along every explored static path."""
+    entry_contexts: Dict[FuncKey, List[FrozenSet[str]]] = {}
+    edges: Dict[Tuple[str, str], LockEdge] = {}
+    truncated = 0
+    stack: List[Tuple[FuncKey, FrozenSet[str]]] = [
+        (k, frozenset()) for k in root_keys if k in pkg.functions
+    ]
+    while stack:
+        key, held = stack.pop()
+        ctxs = entry_contexts.setdefault(key, [])
+        if held in ctxs:
+            continue
+        if len(ctxs) >= MAX_CONTEXTS:
+            truncated += 1
+            continue
+        ctxs.append(held)
+        summary = summaries.get(key)
+        if summary is None:
+            continue
+        effective = held | summary.convention
+        for site in summary.with_sites:
+            held_at = effective | site.outer
+            acq = site.lock
+            if acq == WILDCARD:
+                continue
+            for h in held_at:
+                if h == WILDCARD:
+                    continue
+                if h == acq and site.kind == "RLock":
+                    continue  # reentrant re-acquire, not an order edge
+                edge = (h, acq)
+                if edge not in edges:
+                    fi = pkg.functions[key]
+                    edges[edge] = LockEdge(
+                        h,
+                        acq,
+                        f"{fi.path}:{site.lineno}",
+                        f"{fi.path}:{fi.qualname}",
+                    )
+        for call in pkg.functions[key].calls:
+            if call.target is None or call.target not in pkg.functions:
+                continue
+            at = summary.call_locks.get(
+                (call.lineno, call.col), frozenset()
+            )
+            stack.append((call.target, effective | at))
+    return entry_contexts, edges, truncated
+
+
+# ---------------------------------------------------------------------------
+# suppression maps
+
+
+def suppression_maps(lines: List[str]):
+    """(race_ok_lines, guarded_by): 1-based line numbers carrying
+    `# tmrace: race-ok` (or a justified tmlint lock-global-mutation
+    disable), and lineno -> asserted lock-name strings for
+    `# tmrace: guarded-by=`. Comment-block-above placement covers the
+    first code line below, same convention as tmlint/tmcheck."""
+    race_ok: Set[int] = set()
+    guarded: Dict[int, Set[str]] = {}
+
+    def covered(i: int, text: str) -> List[int]:
+        out = [i]
+        if text.lstrip().startswith("#"):
+            j = i + 1
+            while j <= len(lines) and (
+                not lines[j - 1].strip()
+                or lines[j - 1].lstrip().startswith("#")
+            ):
+                j += 1
+            if j <= len(lines):
+                out.append(j)
+        return out
+
+    for i, text in enumerate(lines, start=1):
+        if _RACE_OK_RE.search(text) or _TMLINT_LOCK_RE.search(text):
+            race_ok.update(covered(i, text))
+        m = _GUARDED_BY_RE.search(text)
+        if m:
+            for ln in covered(i, text):
+                guarded.setdefault(ln, set()).add(m.group(1))
+    return race_ok, guarded
+
+
+def resolve_guard_name(asserted: str, known: Set[str]) -> str:
+    """Match a guarded-by annotation against the known lock universe by
+    suffix (`_REG_LOCK`, `CircuitBreaker._lock`); unknown names pass
+    through as written so consistent annotations still intersect."""
+    for name in sorted(known):
+        if name == asserted or name.endswith(":" + asserted) or name.endswith(
+            "." + asserted
+        ):
+            return name
+    return asserted

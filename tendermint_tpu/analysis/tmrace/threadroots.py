@@ -1,0 +1,586 @@
+"""Static thread-root discovery — who runs concurrently with whom.
+
+`go test -race` sees every goroutine the suite actually spawns; a
+static analysis has to *enumerate* the concurrent entry points
+instead. This module finds them all over tmcheck's call graph:
+
+- **Spawned roots** — the target of every `threading.Thread(...)` /
+  `threading.Timer(...)` construction and every
+  `loop.run_in_executor(...)` submission in the package: the breaker's
+  probe thread and retry timer, the gather-watchdog daemon, the cmd
+  reader, etc. Each distinct target function is one *identity*, and a
+  spawned identity is self-concurrent (nothing statically bounds how
+  many instances run at once — two watchdogs race each other just as
+  well as a watchdog races the main loop).
+- **The main loop** — every `async def` in the package. All coroutines
+  run on the process's single asyncio event-loop thread (the consensus
+  receive loop, every RPC/WS handler, the reactors), so they share ONE
+  identity, `main-loop`, which is NOT self-concurrent: two handlers
+  interleave only at awaits, never preempt mid-bytecode. RPC handler
+  registration tables (string-keyed dict literals of bound methods,
+  rpc/core.py `routes()`) and the consensus receive loop are detected
+  and labeled in the catalog, but they fold into the same identity.
+- **Test-harness spawns** — `threading.Thread(target=...)` sites in
+  the repo's tests/ tree (the chaos/hammer suites). The target's body
+  is scanned for calls into the package through its imports; each
+  spawn site is its own self-concurrent identity, because the hammer
+  tests exist precisely to drive package functions from many threads.
+
+A function reachable (through the call graph) from two different
+identities — or from one self-concurrent identity — executes
+concurrently with itself or others: that set is the *concurrent
+region* the lockset analysis checks. Unresolvable spawn targets
+(lambdas, functools.partial) produce no root: like tmcheck's edges,
+roots are deliberately under-approximate and the docs say so.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tmlint import dotted_name as _dotted
+from ..tmcheck.callgraph import FuncInfo, ModuleIndex, Package, _body_walk
+
+__all__ = [
+    "MAIN_IDENTITY",
+    "ThreadRoot",
+    "discover_roots",
+    "discover_test_roots",
+    "reach",
+]
+
+MAIN_IDENTITY = "main-loop"
+
+# a spawned identity reaching this many functions is normal; identity
+# count is small, so per-identity BFS stays cheap
+FuncKey = Tuple[str, str]
+
+
+class ThreadRoot:
+    """One concurrent entry point.
+
+    `identity` groups roots that run on the same thread (every async
+    def shares `main-loop`); `self_concurrent` marks identities whose
+    code races *itself* (spawned threads/timers, test hammers)."""
+
+    __slots__ = ("key", "kind", "identity", "self_concurrent", "where")
+
+    def __init__(
+        self,
+        key: FuncKey,
+        kind: str,
+        identity: str,
+        self_concurrent: bool,
+        where: str,
+    ) -> None:
+        self.key = key
+        self.kind = kind
+        self.identity = identity
+        self.self_concurrent = self_concurrent
+        self.where = where
+
+    def render(self) -> str:
+        flag = " [self-concurrent]" if self.self_concurrent else ""
+        return f"{self.kind:12s} {self.key[0]}:{self.key[1]}{flag} ({self.where})"
+
+
+# ---------------------------------------------------------------------------
+# spawn-site detection
+
+
+def _is_threading_name(mod: ModuleIndex, func: ast.AST, names) -> bool:
+    d = _dotted(func)
+    if d in {f"threading.{n}" for n in names}:
+        return True
+    if isinstance(func, ast.Name) and func.id in names:
+        fi = mod.from_imports.get(func.id)
+        return fi is not None and fi[1] == "threading"
+    return False
+
+
+def spawn_target(mod: ModuleIndex, call: ast.Call):
+    """(kind, target_expr) for a concurrency-spawning call, else
+    (None, None). Thread takes `target=`, Timer its second positional
+    (or `function=`), run_in_executor its second positional."""
+    if _is_threading_name(mod, call.func, ("Thread",)):
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return "thread", kw.value
+        return "thread", None  # Thread subclass-less, no target: noop
+    if _is_threading_name(mod, call.func, ("Timer",)):
+        if len(call.args) >= 2:
+            return "timer", call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "function":
+                return "timer", kw.value
+        return "timer", None
+    d = _dotted(call.func)
+    if d.endswith(".run_in_executor") and len(call.args) >= 2:
+        return "executor", call.args[1]
+    return None, None
+
+
+def _resolve_ref(
+    pkg: Package,
+    mod: ModuleIndex,
+    fi: FuncInfo,
+    expr: ast.AST,
+    local_types: Dict[str, str],
+) -> Optional[FuncKey]:
+    """Resolve a *function reference* (not a call) — `self._loop`,
+    `_reader`, `mod.fn` — to an in-package function key."""
+    if isinstance(expr, ast.Name):
+        name = expr.id
+        # nested def in the enclosing function
+        nested = (fi.path, f"{fi.qualname}.{name}")
+        if nested in pkg.functions:
+            return nested
+        if name in mod.functions:
+            return (mod.path, name)
+        entry = mod.from_imports.get(name)
+        if entry is not None and entry[0] is not None:
+            target = pkg.module_for_dotted(entry[0])
+            if target is not None and entry[2] in target.functions:
+                return (target.path, entry[2])
+        return None
+    if isinstance(expr, ast.Attribute):
+        dotted = _dotted(expr)
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        head, attr = parts[0], parts[-1]
+        if head in ("self", "cls") and len(parts) == 2 and fi.class_name:
+            return pkg._method_key(mod, fi.class_name, attr)
+        if len(parts) == 2 and head in local_types:
+            return pkg._method_key(mod, local_types[head], attr)
+        if len(parts) == 2 and head in mod.var_class:
+            owner, cname = mod.var_class[head]
+            return pkg._method_key(owner, cname, attr)
+        # module attr through an import
+        entry = mod.from_imports.get(head)
+        if entry is not None and entry[0] is not None and len(parts) == 2:
+            base = entry[0] + "." + entry[2] if entry[0] else entry[2]
+            target = pkg.module_for_dotted(base)
+            if target is not None and attr in target.functions:
+                return (target.path, attr)
+        alias = mod.import_alias.get(head)
+        if alias is not None:
+            prefix = pkg.pkg_name + "."
+            if alias.startswith(prefix):
+                target = pkg.module_for_dotted(alias[len(prefix):])
+                if target is not None and attr in target.functions:
+                    return (target.path, attr)
+    return None
+
+
+def discover_roots(pkg: Package) -> List[ThreadRoot]:
+    """Every in-package concurrent entry point; see module docstring
+    for the catalog semantics."""
+    roots: Dict[Tuple[FuncKey, str], ThreadRoot] = {}
+
+    def add(key, kind, identity, self_conc, where):
+        cur = roots.get((key, identity))
+        if cur is None:
+            roots[(key, identity)] = ThreadRoot(
+                key, kind, identity, self_conc, where
+            )
+
+    for fi in pkg.functions.values():
+        mod = pkg.modules[fi.path]
+        # main-loop identity: every coroutine runs on the event loop
+        if isinstance(fi.node, ast.AsyncFunctionDef):
+            kind = "async"
+            if "receive" in fi.qualname.split(".")[-1] and fi.path.startswith(
+                "consensus/"
+            ):
+                kind = "receive-loop"
+            add(
+                fi.key, kind, MAIN_IDENTITY, False,
+                f"{fi.path}:{fi.lineno}",
+            )
+        local_types = pkg._local_types(mod, fi.node)
+        for node in _body_walk(fi.node):
+            # spawned threads / timers / executor jobs
+            if isinstance(node, ast.Call):
+                kind, target = spawn_target(mod, node)
+                if kind is not None and target is not None:
+                    key = _resolve_ref(pkg, mod, fi, target, local_types)
+                    if key is not None:
+                        add(
+                            key, kind,
+                            f"{kind}:{key[0]}:{key[1]}", True,
+                            f"{fi.path}:{node.lineno}",
+                        )
+            # RPC/WS registration tables: a string-keyed dict literal
+            # of bound methods (rpc/core.py routes()); handlers are
+            # coroutines on the event loop — catalog them explicitly
+            elif isinstance(node, ast.Dict) and len(node.keys) >= 3:
+                if not all(
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    for k in node.keys
+                    if k is not None
+                ):
+                    continue
+                for v in node.values:
+                    key = _resolve_ref(pkg, mod, fi, v, local_types)
+                    if key is not None:
+                        add(
+                            key, "rpc", MAIN_IDENTITY, False,
+                            f"{fi.path}:{node.lineno}",
+                        )
+    return sorted(
+        roots.values(), key=lambda r: (r.identity, r.key)
+    )
+
+
+# ---------------------------------------------------------------------------
+# callback escape: function refs that run on someone else's thread
+
+
+def _param_names(fn_node: ast.AST, is_method: bool) -> List[str]:
+    args = fn_node.args
+    names = [a.arg for a in list(args.posonlyargs) + list(args.args)]
+    if is_method and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def callback_roots(
+    pkg: Package, roots: List["ThreadRoot"]
+) -> List["ThreadRoot"]:
+    """Function references that escape into a *dynamic-call sink*
+    executing under a spawned identity — the breaker-probe idiom:
+    `b.set_probe(fn)` stores `fn` on the instance, and the probe
+    thread later calls `self._probe_fn()`. Statically: find parameters
+    whose value is (a) called directly inside an identity-reachable
+    function, or (b) stored into a `self.<attr>` that such a function
+    calls; then every function reference (or `lambda: f(...)` body
+    call) passed for that parameter anywhere in the package becomes a
+    root under that identity. Iterated to fixpoint by analyze()."""
+    identities, _ = reach(pkg, roots)
+    self_conc = {r.identity for r in roots if r.self_concurrent}
+    existing = {(r.key, r.identity) for r in roots}
+
+    # (path, class, attr) -> [(method key, param name)] for
+    # `self.<attr> = <param>` assignments
+    attr_params: Dict[Tuple[str, str, str], List[Tuple[FuncKey, str]]] = {}
+    for fi in pkg.functions.values():
+        if not fi.class_name:
+            continue
+        params = set(_param_names(fi.node, True))
+        for node in _body_walk(fi.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Name)
+                and node.value.id in params
+            ):
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    attr_params.setdefault(
+                        (fi.path, fi.class_name, t.attr), []
+                    ).append((fi.key, node.value.id))
+
+    # sinks: (function key, param name) -> identities the value runs on
+    sinks: Dict[Tuple[FuncKey, str], Set[str]] = {}
+    for key, ids in identities.items():
+        fi = pkg.functions[key]
+        params = set(_param_names(fi.node, bool(fi.class_name)))
+        for node in _body_walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in params:
+                sinks.setdefault((key, f.id), set()).update(ids)
+            elif (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+                and fi.class_name
+            ):
+                for mkey, pname in attr_params.get(
+                    (fi.path, fi.class_name, f.attr), ()
+                ):
+                    sinks.setdefault((mkey, pname), set()).update(ids)
+    if not sinks:
+        return []
+    sink_funcs = {k for k, _ in sinks}
+
+    out: List[ThreadRoot] = []
+
+    def add_root(key: FuncKey, ids: Set[str], where: str) -> None:
+        for identity in ids:
+            if (key, identity) in existing:
+                continue
+            existing.add((key, identity))
+            out.append(
+                ThreadRoot(
+                    key,
+                    "callback",
+                    identity,
+                    identity in self_conc,
+                    where,
+                )
+            )
+
+    for fi in pkg.functions.values():
+        mod = pkg.modules[fi.path]
+        local_types = pkg._local_types(mod, fi.node)
+        site_by_pos = {(c.lineno, c.col): c for c in fi.calls}
+        for node in _body_walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            site = site_by_pos.get((node.lineno, node.col_offset))
+            if site is None or site.target not in sink_funcs:
+                continue
+            target_fi = pkg.functions[site.target]
+            pnames = _param_names(target_fi.node, bool(target_fi.class_name))
+            # map positional and keyword args onto parameter names
+            bound: List[Tuple[str, ast.AST]] = []
+            for pos, arg in enumerate(node.args):
+                if pos < len(pnames):
+                    bound.append((pnames[pos], arg))
+            for kw in node.keywords:
+                if kw.arg:
+                    bound.append((kw.arg, kw.value))
+            for pname, arg in bound:
+                ids = sinks.get((site.target, pname))
+                if not ids:
+                    continue
+                where = f"{fi.path}:{node.lineno}"
+                if isinstance(arg, ast.Lambda):
+                    for sub in ast.walk(arg.body):
+                        if isinstance(sub, ast.Call):
+                            s2 = site_by_pos.get(
+                                (sub.lineno, sub.col_offset)
+                            )
+                            if s2 is not None and s2.target is not None:
+                                add_root(s2.target, ids, where)
+                else:
+                    key = _resolve_ref(pkg, mod, fi, arg, local_types)
+                    if key is not None:
+                        add_root(key, ids, where)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# test-harness spawns (tests/ is outside the package root)
+
+
+def discover_test_roots(
+    pkg: Package, tests_root: Optional[str] = None
+) -> List[ThreadRoot]:
+    """Thread spawns in the repo's tests/ tree whose targets call into
+    the package: each spawn site is its own self-concurrent identity
+    (the hammer/chaos suites drive package functions from N threads).
+    Resolution is import-map based — `from tendermint_tpu.crypto
+    import sigcache` then `sigcache.seen_key(...)` inside the spawned
+    function body. Unresolvable targets are skipped (documented
+    under-approximation)."""
+    if tests_root is None:
+        # package root layout: <repo>/tendermint_tpu — tests live at
+        # <repo>/tests
+        tests_root = os.path.join(os.path.dirname(pkg.root), "tests")
+    if not os.path.isdir(tests_root):
+        return []
+    out: List[ThreadRoot] = []
+    for name in sorted(os.listdir(tests_root)):
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(tests_root, name)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (SyntaxError, OSError):
+            continue
+        out.extend(_test_file_roots(pkg, name, tree))
+    return out
+
+
+def _test_file_roots(
+    pkg: Package, filename: str, tree: ast.Module
+) -> List[ThreadRoot]:
+    pkg_prefix = pkg.pkg_name + "."
+    # local name -> internal dotted module ("" = package root)
+    mod_alias: Dict[str, str] = {}
+    # local name -> (module path, function name)
+    fn_alias: Dict[str, FuncKey] = {}
+    # local name -> (module path, class name)
+    cls_alias: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith(pkg_prefix) or a.name == pkg.pkg_name:
+                    local = a.asname or a.name.split(".")[0]
+                    inner = (
+                        a.name[len(pkg_prefix):]
+                        if a.name.startswith(pkg_prefix)
+                        else ""
+                    )
+                    mod_alias[local] = inner
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            m = node.module
+            if not (m == pkg.pkg_name or m.startswith(pkg_prefix)):
+                continue
+            inner = m[len(pkg_prefix):] if m.startswith(pkg_prefix) else ""
+            for a in node.names:
+                local = a.asname or a.name
+                sub = inner + "." + a.name if inner else a.name
+                target = pkg.module_for_dotted(sub)
+                if target is not None:
+                    mod_alias[local] = sub
+                    continue
+                owner = pkg.module_for_dotted(inner)
+                if owner is None:
+                    continue
+                if a.name in owner.functions:
+                    fn_alias[local] = (owner.path, a.name)
+                elif a.name in owner.classes:
+                    cls_alias[local] = (owner.path, a.name)
+
+    # local defs by name (nested defs included: hammers live inside
+    # test functions)
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    def pkg_calls(fn_node: ast.AST) -> Set[FuncKey]:
+        found: Set[FuncKey] = set()
+        # local `x = Cls(...)` over imported package classes
+        local_cls: Dict[str, Tuple[str, str]] = {}
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                d = _dotted(n.value.func)
+                cname = d.split(".")[-1]
+                resolved = cls_alias.get(cname)
+                if resolved is None and "." in d:
+                    # `watch = lockwatch.LockWatch()` through a module
+                    # import
+                    head = d.split(".")[0]
+                    if head in mod_alias:
+                        owner = pkg.module_for_dotted(mod_alias[head])
+                        if owner is not None and cname in owner.classes:
+                            resolved = (owner.path, cname)
+                if resolved is not None:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            local_cls[t.id] = resolved
+        for n in ast.walk(fn_node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Name):
+                if f.id in fn_alias:
+                    found.add(fn_alias[f.id])
+            elif isinstance(f, ast.Attribute) and isinstance(
+                f.value, ast.Name
+            ):
+                head = f.value.id
+                if head in mod_alias:
+                    owner = pkg.module_for_dotted(mod_alias[head])
+                    if owner is not None and f.attr in owner.functions:
+                        found.add((owner.path, f.attr))
+                elif head in local_cls:
+                    mpath, cname = local_cls[head]
+                    owner = pkg.modules.get(mpath)
+                    if owner is not None:
+                        key = pkg._method_key(owner, cname, f.attr)
+                        if key is not None:
+                            found.add(key)
+        return found
+
+    out: List[ThreadRoot] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        target = None
+        if d in ("threading.Thread", "Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+        elif d in ("threading.Timer", "Timer") and len(node.args) >= 2:
+            target = node.args[1]
+        if target is None:
+            continue
+        identity = f"test-spawn:{filename}:{node.lineno}"
+        reached: Set[FuncKey] = set()
+        if isinstance(target, ast.Name) and target.id in defs:
+            reached = pkg_calls(defs[target.id])
+        elif isinstance(target, ast.Name) and target.id in fn_alias:
+            reached = {fn_alias[target.id]}
+        elif isinstance(target, ast.Attribute):
+            # obj.method where obj = Cls(...) locally in the file
+            pass  # handled through pkg_calls of enclosing defs only
+        for key in sorted(reached):
+            out.append(
+                ThreadRoot(
+                    key, "test-spawn", identity, True,
+                    f"tests/{filename}:{node.lineno}",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reachability
+
+
+def reach(
+    pkg: Package, roots: List[ThreadRoot]
+) -> Tuple[Dict[FuncKey, Set[str]], Dict[str, Dict[FuncKey, Optional[FuncKey]]]]:
+    """(identities, parents): per-function set of root identities that
+    reach it, plus per-identity BFS parent maps for witness chains
+    (shortest path from a root, exactly like tmcheck's taint pass)."""
+    by_identity: Dict[str, List[FuncKey]] = {}
+    for r in roots:
+        if r.key in pkg.functions:
+            by_identity.setdefault(r.identity, []).append(r.key)
+    identities: Dict[FuncKey, Set[str]] = {}
+    parents: Dict[str, Dict[FuncKey, Optional[FuncKey]]] = {}
+    for identity, keys in by_identity.items():
+        parent: Dict[FuncKey, Optional[FuncKey]] = {}
+        queue = []
+        for k in keys:
+            if k not in parent:
+                parent[k] = None
+                queue.append(k)
+        i = 0
+        while i < len(queue):
+            key = queue[i]
+            i += 1
+            identities.setdefault(key, set()).add(identity)
+            for site in pkg.functions[key].calls:
+                t = site.target
+                if t is not None and t in pkg.functions and t not in parent:
+                    parent[t] = key
+                    queue.append(t)
+        parents[identity] = parent
+    return identities, parents
+
+
+def witness_chain(
+    pkg: Package,
+    parents: Dict[str, Dict[FuncKey, Optional[FuncKey]]],
+    identity: str,
+    key: FuncKey,
+) -> List[str]:
+    """Rendered shortest call chain root -> ... -> key for one
+    identity."""
+    chain: List[str] = []
+    cur: Optional[FuncKey] = key
+    pmap = parents.get(identity, {})
+    while cur is not None:
+        fi = pkg.functions[cur]
+        chain.append(f"{fi.path}:{fi.qualname}")
+        cur = pmap.get(cur)
+    chain.reverse()
+    return chain

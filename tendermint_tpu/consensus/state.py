@@ -25,6 +25,7 @@ from ..config import ConsensusConfig
 from ..eventbus import EventBus
 from ..libs import trace
 from ..libs.log import get_logger
+from ..libs.timeutil import NS_PER_S, ns_to_s, s_to_ns
 from ..libs.service import Service
 from ..privval.types import PrivValidator
 from ..state.execution import BlockExecutor
@@ -54,6 +55,11 @@ from .types import HeightVoteSet, RoundState, RoundStep, step_name
 from .wal import WAL, NopWAL
 
 __all__ = ["ConsensusState"]
+
+# wait_for_height poll interval — integer nanoseconds, like all time
+# math in this module (det-float); converted to float seconds only at
+# the asyncio.sleep boundary via libs.timeutil
+_WAIT_POLL_NS = 10 * NS_PER_S // 1000
 
 
 class ConsensusState(Service):
@@ -154,15 +160,15 @@ class ConsensusState(Service):
             else None
         )
 
-    async def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
+    async def wait_for_height(self, height: int, timeout: float = 30) -> None:
         """Test/RPC helper: block until consensus reaches `height`."""
-        deadline = time.monotonic() + timeout
+        deadline_ns = time.monotonic_ns() + s_to_ns(timeout)
         while self.rs.height < height:
-            if time.monotonic() > deadline:
+            if time.monotonic_ns() > deadline_ns:
                 raise TimeoutError(
                     f"height {height} not reached (at {self.rs.height})"
                 )
-            await asyncio.sleep(0.01)
+            await asyncio.sleep(ns_to_s(_WAIT_POLL_NS))
 
     # ------------------------------------------------------------------
     # state transitions between heights
@@ -206,10 +212,10 @@ class ConsensusState(Service):
         # (reference: state.go updateToState)
         now_ns = time.time_ns()
         if rs.commit_time_ns == 0:
-            start_time_ns = now_ns + int(self.cfg.timeout_commit * 1e9)
+            start_time_ns = now_ns + s_to_ns(self.cfg.timeout_commit)
         else:
-            start_time_ns = rs.commit_time_ns + int(
-                self.cfg.timeout_commit * 1e9
+            start_time_ns = rs.commit_time_ns + s_to_ns(
+                self.cfg.timeout_commit
             )
 
         validators = state.validators
@@ -266,9 +272,9 @@ class ConsensusState(Service):
         """reference: state.go scheduleRound0."""
         # tmlint: disable=det-wallclock — local timeout scheduling;
         # never enters sign-bytes or hashes
-        sleep_s = max(0.0, (self.rs.start_time_ns - time.time_ns()) / 1e9)
+        delay_ns = max(0, self.rs.start_time_ns - time.time_ns())
         self._schedule_timeout(
-            sleep_s, self.rs.height, 0, RoundStep.NEW_HEIGHT
+            ns_to_s(delay_ns), self.rs.height, 0, RoundStep.NEW_HEIGHT
         )
 
     def _schedule_timeout(
@@ -885,13 +891,10 @@ class ConsensusState(Service):
         self.metrics.total_txs.inc(len(block.txs))
         self.metrics.block_size.set(block.size())
         if self.state.last_block_time_ns:
-            self.metrics.block_interval.observe(
-                max(
-                    0.0,
-                    (block.header.time_ns - self.state.last_block_time_ns)
-                    / 1e9,
-                )
+            interval_ns = max(
+                0, block.header.time_ns - self.state.last_block_time_ns
             )
+            self.metrics.block_interval.observe(ns_to_s(interval_ns))
 
         if self.block_store.height() < block.header.height:
             seen_commit = precommits.make_commit()

@@ -141,9 +141,13 @@ def armed() -> bool:
     """Cheap hot-path gate: False means no rule is armed and no fault
     code runs at all. The env var is parsed on the first call so test
     processes that set TM_TPU_FAULT after import still arm."""
-    global _ENV_LOADED
     if not _ENV_LOADED:
-        _ENV_LOADED = True
+        # load_env sets the latch under _LOCK only AFTER the rules are
+        # parsed and _ARMED refreshed: a racing caller either sees the
+        # latch down and blocks on _LOCK itself, or sees it up with the
+        # armed state already published (tmrace found the old
+        # flag-first ordering, where a racer could answer False between
+        # the flag write and the parse)
         load_env()
     return _ARMED
 
@@ -151,17 +155,29 @@ def armed() -> bool:
 def load_env() -> None:
     """(Re-)parse TM_TPU_FAULT into armed rules. Idempotent per value:
     clears previously env-loaded rules first (inject() rules survive)."""
+    global _ENV_LOADED
     spec = os.environ.get("TM_TPU_FAULT", "")
     with _LOCK:
         _RULES[:] = [r for r in _RULES if not getattr(r, "_from_env", False)]
-        for part in spec.split(";"):
-            part = part.strip()
-            if not part:
-                continue
-            rule = _parse_rule(part)
-            rule._from_env = True
-            _RULES.append(rule)
-        _refresh_armed()
+        try:
+            parsed = []
+            for part in spec.split(";"):
+                part = part.strip()
+                if not part:
+                    continue
+                rule = _parse_rule(part)
+                rule._from_env = True
+                parsed.append(rule)
+            _RULES.extend(parsed)
+        finally:
+            # latch + refresh even when a malformed spec raises: the
+            # ValueError surfaces ONCE (from the first armed() call),
+            # after which the plane runs disarmed — without this, every
+            # hot-path armed() check re-enters the parse and re-raises
+            # forever. parsed is appended all-or-nothing so a spec
+            # that fails mid-list arms none of its rules.
+            _refresh_armed()
+            _ENV_LOADED = True
 
 
 def _parse_rule(spec: str) -> Rule:

@@ -181,14 +181,19 @@ def on_accelerator() -> bool:
         except AttributeError:  # pragma: no cover - very old jax
             pass
         if plats and set(plats.split(",")) == {"cpu"}:
+            # tmrace: race-ok — idempotent latch: every racer computes
+            # the same value from process-wide config; bool store is
+            # GIL-atomic
             _STREAMING = False
         elif not plats and not _has_tpu_runtime():
             # only an UNSET platform string consults the runtime sniff:
             # an explicit jax_platforms=tpu (e.g. libtpu loaded via
             # TPU_LIBRARY_PATH, no importable module) must reach the
             # backend query, symmetric with the explicit-cpu case
-            _STREAMING = False
+            _STREAMING = False  # tmrace: race-ok — same idempotent latch
         else:
+            # tmrace: race-ok — same idempotent latch (jax backend init
+            # is internally synchronized)
             _STREAMING = jax.default_backend() == "tpu"
     return _STREAMING
 
@@ -239,6 +244,8 @@ def gather_deadline() -> Optional[float]:
                 dl = float(env)  # tmlint: disable=dev-host-sync — env-var string, host data
             except ValueError:
                 dl = DEFAULT_GATHER_DEADLINE_S
+            # tmrace: race-ok — idempotent per env value; racers
+            # parse the same string and the tuple store is GIL-atomic
             _DEADLINE_CACHE = (env, dl if dl > 0 else None)
         return _DEADLINE_CACHE[1]
     if faults.armed() or on_accelerator():
@@ -277,6 +284,10 @@ class _Watchdog:
         self.thread.start()
 
     def run(self, job: tuple) -> None:
+        # tmrace: race-ok — Event handshake: the _job store
+        # happens-before _wake.set(), and a worker is owned by exactly
+        # one caller between its free-list pop (under _wedged_lock) and
+        # its requeue, so no second run() can interleave
         self._job = job
         self._wake.set()
 
@@ -284,9 +295,12 @@ class _Watchdog:
         global _wedged_gathers
         while True:
             self._wake.wait()
+            # tmrace: race-ok — other half of the run() Event
+            # handshake: wait() returned, so the owner's _job store is
+            # visible, and nobody re-runs this worker until it requeues
             self._wake.clear()
             fn, result, done, state = self._job
-            self._job = None
+            self._job = None  # tmrace: race-ok — same handshake
             try:
                 result["val"] = fn()
             except BaseException as e:  # delivered to the caller
@@ -883,8 +897,12 @@ def install(
     install publishes into an orphaned object nobody consults — the
     atomicity the old _SR_WARM_GEN counter provided by hand."""
     global _SHARED_VERIFIER, _SHARED_VERIFIER_SR, _MIN_BATCH, _INSTALLED
+    # tmrace: race-ok — install() runs on the startup/main thread; the
+    # only cross-thread readers are breaker probes, and a probe from a
+    # superseded generation publishes into an orphaned breaker (see
+    # docstring), so a GIL-atomic old-or-new read mid-install is benign
     _MIN_BATCH = min_batch
-    _INSTALLED = True
+    _INSTALLED = True  # tmrace: race-ok — same generation protocol
     # warm the native keccak library here (a subprocess cc compile on
     # first use) so the first consensus-critical sr25519 verify never
     # stalls behind a compiler
@@ -902,8 +920,11 @@ def install(
     else:
         new_ed = None
         new_sr = None
+    # tmrace: race-ok — same generation protocol: a stale probe
+    # reading the new verifier mid-swap still reports into an
+    # orphaned breaker nobody consults
     _SHARED_VERIFIER = new_ed
-    _SHARED_VERIFIER_SR = new_sr
+    _SHARED_VERIFIER_SR = new_sr  # tmrace: race-ok — same protocol
     # new generation: every bucket is cold again
     # tmlint: disable=lock-global-mutation — install() runs on the
     # startup/main thread before traffic

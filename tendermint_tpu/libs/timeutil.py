@@ -1,0 +1,24 @@
+"""Integer-nanosecond <-> float-second conversion, OUTSIDE the
+consensus-critical tree.
+
+Consensus code does its time math in integer nanoseconds (tmlint's
+det-float rule: IEEE-754 results vary with evaluation order and
+platform, so floats may never feed sign-bytes/hash/encode input).
+Floats only exist at the process boundaries — asyncio timeouts,
+metrics observations, config files — and the conversions live here so
+a consensus module never contains float arithmetic of its own.
+"""
+
+from __future__ import annotations
+
+NS_PER_S = 1_000_000_000
+
+
+def ns_to_s(ns: int) -> float:
+    """Nanoseconds -> float seconds (asyncio/metrics boundary)."""
+    return ns / NS_PER_S
+
+
+def s_to_ns(s: float) -> int:
+    """Float seconds (config/API boundary) -> integer nanoseconds."""
+    return int(round(s * NS_PER_S))

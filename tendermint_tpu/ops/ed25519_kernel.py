@@ -43,6 +43,7 @@ handful of programs once and reuses them for every Commit size.
 from __future__ import annotations
 
 import hashlib
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -740,6 +741,7 @@ def _jit_verify_tile():
 
 
 _DEFAULT: Optional[Ed25519Verifier] = None
+_DEFAULT_LOCK = threading.Lock()
 
 
 def default_verifier() -> Ed25519Verifier:
@@ -748,7 +750,14 @@ def default_verifier() -> Ed25519Verifier:
     batch seam, crypto/tpu_verifier.py)."""
     global _DEFAULT
     if _DEFAULT is None:
-        _DEFAULT = Ed25519Verifier()
+        # double-checked: the first calls race in from the asyncio loop
+        # AND the breaker probe thread (tmrace), and a losing duplicate
+        # construction is not just waste — each instance carries its
+        # own compiled-program cache, so consensus traffic landing on a
+        # discarded instance would recompile every bucket
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Ed25519Verifier()
     return _DEFAULT
 
 

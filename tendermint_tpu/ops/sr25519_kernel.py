@@ -30,6 +30,7 @@ vectors) through crypto/sr25519.py's verify_signature.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
@@ -316,13 +317,21 @@ class Sr25519Verifier:
 
 
 _DEFAULT: Optional[Sr25519Verifier] = None
+_DEFAULT_LOCK = threading.Lock()
 
 
 def default_verifier() -> Sr25519Verifier:
     """The shared module verifier (see ed25519_kernel.default_verifier)."""
     global _DEFAULT
     if _DEFAULT is None:
-        _DEFAULT = Sr25519Verifier()
+        # double-checked: the first calls race in from the asyncio loop
+        # AND the breaker probe thread (tmrace), and a losing duplicate
+        # construction is not just waste — each instance carries its
+        # own compiled-program cache, so consensus traffic landing on a
+        # discarded instance would recompile every bucket
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Sr25519Verifier()
     return _DEFAULT
 
 

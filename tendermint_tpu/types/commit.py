@@ -151,6 +151,10 @@ class Commit:
     _sign_templates: Optional[dict] = field(
         default=None, repr=False, compare=False
     )
+    # np.uint8 BlockIDFlags per signature; see block_id_flags_array
+    _flags_memo: Optional[object] = field(
+        default=None, repr=False, compare=False
+    )
 
     def size(self) -> int:
         return len(self.signatures)
@@ -163,6 +167,39 @@ class Commit:
         for i, cs in enumerate(self.signatures):
             ba.set(i, not cs.is_absent())
         return ba
+
+    def block_id_flags_array(self):
+        """Per-signature BlockIDFlags as a read-only np.uint8 array,
+        memoized — a Commit's signature list never changes after
+        construction (the same property _hash and _sign_templates rely
+        on). The vectorized VerifyCommit tally masks validator powers
+        with it. Returns None when any flag is outside uint8 range
+        (from_proto reads an unbounded varint): callers must fall back
+        to the scalar loop so a hostile commit gets the reference
+        InvalidCommitError, not an OverflowError from the memo."""
+        if self._flags_memo is None:
+            import numpy as np
+
+            try:
+                # widen to int64 and range-check explicitly: fromiter
+                # straight into uint8 raises on out-of-range only on
+                # numpy >= 2 — numpy 1.x wraps modulo 256, which would
+                # silently reclassify flag 257 as ABSENT and skip its
+                # signature. int64 still overflows (and raises on both
+                # majors) for varints past 2**63, hence the except.
+                arr = np.fromiter(
+                    (cs.block_id_flag for cs in self.signatures),
+                    dtype=np.int64,
+                    count=len(self.signatures),
+                )
+            except (OverflowError, ValueError):
+                return None
+            if arr.size and (arr.min() < 0 or arr.max() > 0xFF):
+                return None
+            arr = arr.astype(np.uint8)
+            arr.setflags(write=False)
+            self._flags_memo = arr
+        return self._flags_memo
 
     def get_vote(self, val_idx: int) -> Vote:
         """Reconstruct the precommit vote at a validator index

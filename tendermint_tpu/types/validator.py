@@ -156,6 +156,29 @@ class ValidatorSet:
         v = self.validators[index]
         return v.address, v.copy()
 
+    def powers_array(self):
+        """Voting powers as a read-only np.int64 array aligned with
+        self.validators, rebuilt on every call — NOT memoized. This
+        class hands out live Validator references (validators list),
+        so an invalidation-hook memo goes stale on in-place power
+        mutation, the exact class of bug the to_proto memo was rebuilt
+        around (ADVICE r5) — and here staleness would split the
+        vectorized VerifyCommit tally from the scalar paths, which
+        read val.voting_power live. Any validating fingerprint of the
+        powers IS this array, so rebuilding is the fingerprint: one
+        C-level fromiter pass, while the vectorized tally's win (the
+        masked sum replacing a 10k-iteration Python loop,
+        types/validation.py) is untouched."""
+        import numpy as np
+
+        arr = np.fromiter(
+            (v.voting_power for v in self.validators),
+            dtype=np.int64,
+            count=len(self.validators),
+        )
+        arr.setflags(write=False)
+        return arr
+
     def total_voting_power(self) -> int:
         if self._total_voting_power == 0:
             self._update_total_voting_power()

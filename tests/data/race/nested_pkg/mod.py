@@ -1,0 +1,38 @@
+"""Nested-def scoping: `outer_local` assigns a plain LOCAL `N` while
+its nested `helper_n` declares `global N` — the enclosing write must
+NOT be reclassified as a module-global write. And `reader`'s nested
+`helper_m` binds `M` only in its own scope — that must not hide the
+outer function's read of the module global `M`, which pairs with
+`writer_handler`'s unguarded main-loop write into a real race."""
+
+import threading
+
+N = 0
+M = 0
+
+
+def outer_local() -> None:
+    N = 1
+
+    def helper_n() -> None:
+        global N
+        N = 2
+
+    helper_n()
+
+
+def reader() -> None:
+    def helper_m(M) -> None:
+        return M
+
+    if M:
+        pass
+
+
+def start() -> None:
+    threading.Thread(target=reader, daemon=True).start()
+
+
+async def writer_handler() -> None:
+    global M
+    M = 3

@@ -1,0 +1,38 @@
+"""Seeded lock-order hazards: low_then_high contradicts the RANK the
+test supplies (a_lock outranks b_lock there), and ab/ba together form
+a two-lock cycle no single path shows."""
+
+import threading
+
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+c_lock = threading.Lock()
+d_lock = threading.Lock()
+
+
+def low_then_high() -> None:
+    with a_lock:
+        with b_lock:
+            pass
+
+
+def ab() -> None:
+    with c_lock:
+        with d_lock:
+            pass
+
+
+def ba() -> None:
+    with d_lock:
+        with c_lock:
+            pass
+
+
+def worker() -> None:
+    low_then_high()
+    ab()
+    ba()
+
+
+def start() -> None:
+    threading.Thread(target=worker, daemon=True).start()

@@ -1,0 +1,38 @@
+"""Seeded cross-identity race: SPLIT is written by `handler` (the
+main-loop identity ONLY) and by `worker_write` (a worker-thread
+identity ONLY) — each endpoint function has root degree 1, so only
+the union of the sites' identities reveals the race (regression: the
+collector once only looked inside the per-function concurrent region
+and missed this class entirely). SPLIT_GUARDED is the locked twin:
+same two single-identity endpoints, every write under _lock."""
+
+import threading
+
+SPLIT = 0
+SPLIT_GUARDED = 0
+_lock = threading.Lock()
+
+
+def worker_write() -> None:
+    global SPLIT
+    SPLIT = 1
+
+
+def worker_write_guarded() -> None:
+    global SPLIT_GUARDED
+    with _lock:
+        SPLIT_GUARDED = 1
+
+
+def start() -> None:
+    t = threading.Thread(target=worker_write, daemon=True)
+    t.start()
+    t2 = threading.Thread(target=worker_write_guarded, daemon=True)
+    t2.start()
+
+
+async def handler() -> None:
+    global SPLIT, SPLIT_GUARDED
+    SPLIT = 2
+    with _lock:
+        SPLIT_GUARDED = 2

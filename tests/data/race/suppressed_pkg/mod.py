@@ -1,0 +1,43 @@
+"""The unguarded_pkg shapes with every suppression form applied: this
+package MUST analyze clean."""
+
+import threading
+
+LATCH = 0
+ASSERTED = 0
+JUSTIFIED: set = set()
+_side_lock = threading.Lock()
+
+
+def set_latch() -> None:
+    global LATCH
+    # tmrace: race-ok — idempotent latch, fixture twin of the
+    # tpu_verifier._STREAMING idiom
+    LATCH = 1
+
+
+def indirect() -> None:
+    global ASSERTED
+    ASSERTED = 1  # tmrace: guarded-by=_side_lock
+
+
+def justified_mutation() -> None:
+    # tmlint: disable=lock-global-mutation — GIL-atomic set add,
+    # fixture twin of the sigcache idiom
+    JUSTIFIED.add(1)
+
+
+def worker() -> None:
+    set_latch()
+    indirect()
+    justified_mutation()
+
+
+def start() -> None:
+    threading.Thread(target=worker, daemon=True).start()
+
+
+async def handler() -> None:
+    set_latch()
+    indirect()
+    justified_mutation()

@@ -1,0 +1,35 @@
+"""Seeded race: COUNTER is written from two concurrent roots (the
+spawned worker thread and the asyncio handler) with no common lock.
+GUARDED takes the same two paths but every write holds _lock."""
+
+import threading
+
+COUNTER = 0
+GUARDED = 0
+_lock = threading.Lock()
+
+
+def bump() -> None:
+    global COUNTER
+    COUNTER += 1
+
+
+def bump_guarded() -> None:
+    global GUARDED
+    with _lock:
+        GUARDED += 1
+
+
+def worker() -> None:
+    bump()
+    bump_guarded()
+
+
+def start() -> None:
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+
+
+async def handler() -> None:
+    bump()
+    bump_guarded()

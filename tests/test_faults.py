@@ -104,6 +104,30 @@ def test_env_spec_parses_and_arms(monkeypatch):
     assert not faults.armed()
 
 
+def test_malformed_env_spec_raises_once_then_disarmed(monkeypatch):
+    """A bad TM_TPU_FAULT must surface ONCE, not turn every hot-path
+    armed() check into a re-parse + re-raise: the latch rises (and
+    _ARMED refreshes) even when the parse fails, all-or-nothing so a
+    spec that dies mid-list arms none of its rules."""
+    monkeypatch.setenv(
+        "TM_TPU_FAULT", "tpu.dispatch:raise;tpu.gather:bogus-mode"
+    )
+    monkeypatch.setattr(faults, "_ENV_LOADED", False)
+    with pytest.raises(ValueError):
+        faults.armed()
+    # second call: latched, disarmed, no re-raise
+    assert not faults.armed()
+    assert all(
+        not getattr(r, "_from_env", False) for r in faults.rules()
+    )
+    # a corrected spec re-arms via the explicit reload path
+    monkeypatch.setenv("TM_TPU_FAULT", "tpu.dispatch:raise")
+    faults.load_env()
+    assert faults.armed()
+    monkeypatch.setenv("TM_TPU_FAULT", "")
+    faults.load_env()
+
+
 def test_mangle_and_clip_modes():
     bits = [True, True, True, True]
     with faults.inject("g", mode="misshape"):

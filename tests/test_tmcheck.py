@@ -641,7 +641,7 @@ def test_cli_taint_and_schema_sections_exit_zero():
 def test_cli_full_run_includes_tmcheck_sections():
     r = _run_cli("--stats")
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "[tmlint+taint+schema]" in r.stdout
+    assert "[tmlint+taint+schema+race]" in r.stdout
 
 
 def test_cli_schema_update_refuses_filtered_runs():
@@ -651,6 +651,10 @@ def test_cli_schema_update_refuses_filtered_runs():
     assert r.returncode == 2
     r = _run_cli("--schema-update", "--taint")
     assert r.returncode == 2
+    # --race would be silently disabled by the update mode (run_race
+    # = False) while the command still exited 0 — refuse it too
+    r = _run_cli("--schema-update", "--race")
+    assert r.returncode == 2 and "full-package" in r.stderr
     # and the golden table was not touched
     assert tmcheck.schema_violations() == []
 

@@ -140,6 +140,78 @@ class TestVerifyCommit:
                 CHAIN_ID, vals2, commit2, Fraction(2, 3)
             )
 
+    def test_out_of_range_flag_keeps_reference_error(self):
+        # from_proto reads block_id_flag as an unbounded varint, so a
+        # hostile commit can carry a flag > 255. The vectorized-tally
+        # memo must not turn that into an OverflowError: the flags
+        # memo returns None and verify_commit stays on the scalar
+        # loop, failing with the reference error type.
+        from dataclasses import replace
+
+        vals, bid, commit = make_commit(4)
+        sigs = list(commit.signatures)
+        sigs[1] = replace(sigs[1], block_id_flag=300)
+        from tendermint_tpu.types import Commit
+
+        bad = Commit(
+            height=commit.height, round=commit.round,
+            block_id=bid, signatures=sigs,
+        )
+        assert bad.block_id_flags_array() is None
+        with pytest.raises(InvalidCommitError):
+            verify_commit(CHAIN_ID, vals, bid, 1, bad)
+
+    def test_tally_memo_arrays_are_read_only(self):
+        # block_id_flags_array hands out a live memo; powers_array is
+        # rebuilt per call but stays read-only for a uniform contract:
+        # writes must raise, not silently corrupt a tally.
+        import numpy as np
+
+        vals, _bid, commit = make_commit(4)
+        with pytest.raises(ValueError):
+            vals.powers_array()[0] = 0
+        with pytest.raises(ValueError):
+            commit.block_id_flags_array()[0] = 0
+        assert int(vals.powers_array().sum()) == vals.total_voting_power()
+        assert np.all(commit.block_id_flags_array() >= 0)
+
+    def test_powers_array_sees_in_place_power_mutation(self):
+        # ValidatorSet hands out live Validator references, so an
+        # embedder can mutate voting_power in place without running
+        # _reindex. The scalar verify paths read val.voting_power
+        # live; powers_array must not serve a stale memo or the
+        # vectorized tally diverges from them (same staleness class
+        # as the to_proto ADVICE-r5 fix).
+        vals, _bid, _commit = make_commit(4)
+        before = vals.powers_array().copy()
+        vals.validators[0].voting_power += 7
+        after = vals.powers_array()
+        assert after[0] == before[0] + 7
+        # and a copy() taken before the mutation reports its own
+        # (un-mutated) powers, not a shared array
+        vals2, _b2, _c2 = make_commit(4)
+        snap = vals2.copy()
+        vals2.validators[1].voting_power += 11
+        assert snap.powers_array()[1] + 11 == vals2.powers_array()[1]
+
+    def test_flag_just_past_uint8_rejected_without_numpy_overflow(self):
+        # 256 wraps to 0 under numpy 1.x's modulo conversion (numpy 2
+        # raises): the explicit range check must return None on both,
+        # keeping verify_commit on the scalar loop / reference error.
+        from dataclasses import replace
+        from tendermint_tpu.types import Commit
+
+        vals, bid, commit = make_commit(4)
+        sigs = list(commit.signatures)
+        sigs[2] = replace(sigs[2], block_id_flag=256)
+        bad = Commit(
+            height=commit.height, round=commit.round,
+            block_id=bid, signatures=sigs,
+        )
+        assert bad.block_id_flags_array() is None
+        with pytest.raises(InvalidCommitError):
+            verify_commit(CHAIN_ID, vals, bid, 1, bad)
+
 
 class TestDeviceCommitVerify:
     """Device parity: the TPU kernel path must agree with CPU on every
