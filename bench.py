@@ -235,9 +235,12 @@ def bench_commit_latency(
     verified-signature cache DISABLED — the honest cold number (the
     bench reps re-verify one commit, which the cache would otherwise
     turn warm after rep 1; production's warm path is measured by
-    bench_commit_warm). With use_device=False the device factory is NOT
-    installed, so this times the production CPU seam (native batch
-    equation + OpenSSL)."""
+    bench_commit_warm). Every rep also drops the commit's own memos
+    (sign-bytes rows, flags array — Commit.invalidate_memos) so the
+    splice/encode cost a node pays for a NEVER-SEEN commit stays in
+    the cold number instead of silently amortizing after rep 1. With
+    use_device=False the device factory is NOT installed, so this
+    times the production CPU seam (native batch equation + OpenSSL)."""
     from tendermint_tpu.crypto import sigcache, tpu_verifier
     from tendermint_tpu.types import validation
 
@@ -253,6 +256,7 @@ def bench_commit_latency(
         fn(chain_id, vals, commit.block_id, 1, commit)
         times = []
         for _ in range(reps):
+            commit.invalidate_memos()
             t0 = time.perf_counter()
             fn(chain_id, vals, commit.block_id, 1, commit)
             times.append(time.perf_counter() - t0)
@@ -265,12 +269,28 @@ def bench_commit_latency(
 
 def bench_commit_warm(
     n_vals: int = 10_000, reps: int = 5, use_device: bool = True,
+    rounds: int = 4,
 ):
     """Warm-path verify_commit: one priming verification populates the
     verified-signature cache (crypto/sigcache), then every rep is the
-    steady-state LastCommit shape — a digest scan plus tally, zero
-    crypto calls. Reported next to the cold row with the measured cache
-    hit rate, so BENCH_*.json records the warm/cold split."""
+    steady-state LastCommit shape — zero encoding (commit-scoped
+    sign-bytes memo), zero crypto.
+
+    Two arms, INTERLEAVED A/B within every round so drift on this
+    shared box (the old single-arm form swung p95 by +/-10 ms across
+    identical runs) hits both equally:
+
+      A  the production steady state: the commit-level memo
+         short-circuits to the tally in O(1) probes — the headline
+         p50_ms
+      B  the same verify with only the commit-level memo bypassed
+         (sigcache.commit_memo_disabled): the bulk triple-probe path a
+         first warm pass takes — p50_bulk_probe_ms
+
+    Reported as the median across `rounds` per-round medians (plus the
+    overall p95 of each arm), with the measured triple hit rate of the
+    B arm and the A arm's commit-memo hit count, so BENCH_*.json
+    records the warm/cold split per operating point."""
     from tendermint_tpu.crypto import sigcache, tpu_verifier
     from tendermint_tpu.types import validation
 
@@ -285,21 +305,133 @@ def bench_commit_warm(
         fn(chain_id, vals, commit.block_id, 1, commit)
     fn(chain_id, vals, commit.block_id, 1, commit)  # priming run
     s0 = sigcache.stats()
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        fn(chain_id, vals, commit.block_id, 1, commit)
-        times.append(time.perf_counter() - t0)
+    a_rounds, b_rounds = [], []
+    a_all, b_all = [], []
+    for _ in range(max(rounds, 1)):
+        a_times, b_times = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(chain_id, vals, commit.block_id, 1, commit)
+            a_times.append(time.perf_counter() - t0)
+            with sigcache.commit_memo_disabled():
+                t0 = time.perf_counter()
+                fn(chain_id, vals, commit.block_id, 1, commit)
+                b_times.append(time.perf_counter() - t0)
+        a_times.sort()
+        b_times.sort()
+        a_rounds.append(a_times[len(a_times) // 2])
+        b_rounds.append(b_times[len(b_times) // 2])
+        a_all.extend(a_times)
+        b_all.extend(b_times)
     s1 = sigcache.stats()
-    times.sort()
+    a_rounds.sort()
+    b_rounds.sort()
+    a_all.sort()
+    b_all.sort()
     hits = s1["hits"] - s0["hits"]
     misses = s1["misses"] - s0["misses"]
     return {
-        "p50_ms": round(times[len(times) // 2] * 1e3, 2),
-        "p95_ms": round(times[int(len(times) * 0.95)] * 1e3, 2),
+        "p50_ms": round(a_rounds[len(a_rounds) // 2] * 1e3, 2),
+        "p95_ms": round(a_all[int(len(a_all) * 0.95)] * 1e3, 2),
+        "p50_bulk_probe_ms": round(
+            b_rounds[len(b_rounds) // 2] * 1e3, 2
+        ),
+        "p95_bulk_probe_ms": round(b_all[int(len(b_all) * 0.95)] * 1e3, 2),
+        "interleave": f"A/B x{reps} reps x{rounds} rounds, "
+        "median-of-round-medians",
         "sigcache_hits": hits,
         "sigcache_misses": misses,
         "sigcache_hit_rate": round(hits / max(hits + misses, 1), 4),
+        "sigcache_commit_hits": s1["commit_hits"] - s0["commit_hits"],
+    }
+
+
+def bench_commit_warm_breakdown(n_vals: int = 10_000, reps: int = 7):
+    """Phase split of the warm verify_commit scan — the auditability
+    half of the <= 2 ms warm target (ISSUE 7): each phase is timed
+    standalone against the same primed commit, so the claim "warm does
+    zero encoding" is a measured row, not prose.
+
+      encode_ms        commit.sign_bytes_batch on the warm path (memo
+                       hit — must be ~0; the cold splice cost lives in
+                       verify_commit_10k_breakdown_cpu_ms)
+      key_build_ms     assembling the 10k (pk, sign_bytes, sig) cache
+                       keys from the memoized rows/pubkey bytes
+      probe_ms         sigcache.seen_keys_bulk over all keys (one
+                       set-intersection per generation)
+      tally_ms         powers_array rebuild + masked sum + flatnonzero
+                       (the only per-call numpy work)
+      commit_probe_ms  the commit-level memo key build + probe — the
+                       ENTIRE steady-state scan once a commit is known
+                       good (the A arm of bench_commit_warm)
+
+    Phases are medians of `reps` standalone timings; the warm path is
+    host-only by definition (zero crypto), so one row serves every
+    backend."""
+    from tendermint_tpu.crypto import sigcache
+    from tendermint_tpu.types import validation
+    from tendermint_tpu.types.commit import (
+        BLOCK_ID_FLAG_ABSENT,
+        BLOCK_ID_FLAG_COMMIT,
+    )
+
+    chain_id = f"bench-{n_vals}"
+    vals, commit = _make_commit(n_vals, chain_id)
+    validation.verify_commit(chain_id, vals, commit.block_id, 1, commit)
+    sigs = commit.signatures
+
+    def median_ms(f):
+        f()  # warm
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            f()
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return round(times[len(times) // 2] * 1e3, 3)
+
+    encode_ms = median_ms(lambda: commit.sign_bytes_batch(chain_id))
+    rows = commit.sign_bytes_batch(chain_id)
+    pkb = vals.pubkeys_bytes()
+
+    def build_keys():
+        return [
+            (b, r, cs.signature)
+            for b, r, cs in zip(pkb, rows, sigs)
+            if r is not None
+        ]
+
+    key_build_ms = median_ms(build_keys)
+    keys = build_keys()
+    probe_ms = median_ms(lambda: sigcache.seen_keys_bulk(keys))
+
+    def tally():
+        flags = commit.block_id_flags_array()
+        powers = vals.powers_array()
+        t = int(powers[flags == BLOCK_ID_FLAG_COMMIT].sum())
+        np.flatnonzero(flags != BLOCK_ID_FLAG_ABSENT).tolist()
+        return t
+
+    tally_ms = median_ms(tally)
+    powers = vals.powers_array()
+    needed = vals.total_voting_power() * 2 // 3
+
+    def commit_probe():
+        # the production key builder, not a hand-copied shape: a key-
+        # format change can't silently turn this into a miss probe
+        key = validation._commit_memo_key(
+            chain_id, vals, commit, needed, True, True, powers
+        )
+        return sigcache.seen_key(key)
+
+    commit_probe_ms = median_ms(commit_probe)
+    return {
+        "encode_ms": encode_ms,
+        "key_build_ms": key_build_ms,
+        "probe_ms": probe_ms,
+        "tally_ms": tally_ms,
+        "commit_probe_ms": commit_probe_ms,
+        "n_keys": len(keys),
     }
 
 
@@ -451,10 +583,18 @@ def _build_light_chain(chain_id: str, n_heights: int, n_vals: int):
 
 
 def bench_light_sync(
-    n_vals: int = 150, n_headers: int = 50, use_device: bool = True
+    n_vals: int = 150, n_headers: int = 50, use_device: bool = True,
+    warm_pass: bool = False,
 ):
     """Light-client sequential sync rate (BASELINE config 4 at reduced
-    header count; reported as headers/s)."""
+    header count; reported as headers/s). With warm_pass=True a SECOND
+    fresh client syncs the same chain in the same process and the
+    return value is {"cold": .., "warm": ..}: the second client's
+    verifications hit the populated sigcache — triple hits per
+    signature and the commit-level memo per header (crypto/sigcache) —
+    which is the fleet-serving shape from ROADMAP item 5 (one node
+    re-verifying the same headers for many bisecting clients) and the
+    light-client half of ISSUE 7's warm-path target."""
     import asyncio
 
     from tendermint_tpu.crypto import tpu_verifier
@@ -477,7 +617,7 @@ def bench_light_sync(
         async def report_evidence(self, ev):
             pass
 
-    async def go():
+    async def one_pass():
         lc = Client(
             chain_id,
             TrustOptions(
@@ -493,6 +633,12 @@ def bench_light_sync(
         t0 = time.perf_counter()
         await lc.verify_light_block_at_height(n_headers + 1, time.time_ns())
         return n_headers / (time.perf_counter() - t0)
+
+    async def go():
+        cold = await one_pass()
+        if not warm_pass:
+            return cold
+        return {"cold": round(cold, 2), "warm": round(await one_pass(), 2)}
 
     return asyncio.run(go())
 
@@ -580,6 +726,11 @@ def bench_commit_breakdown(n_vals: int = 10_000, reps: int = 5):
     rtt_ms = bench_device_rtt()
 
     def phases():
+        # drop the commit's sign-bytes memo so sign_bytes_ms times the
+        # real splice work each rep (same honesty fix as
+        # bench_commit_latency; the warm memo-hit cost is its own row,
+        # bench_commit_warm_breakdown's encode_ms)
+        commit.invalidate_memos()
         t0 = time.perf_counter()
         all_sb = commit.sign_bytes_batch(chain_id)
         pks, msgs, sigs = [], [], []
@@ -630,6 +781,9 @@ def bench_commit_breakdown_cpu(n_vals: int = 10_000, reps: int = 5):
     by_addr = {v.address: v for v in vals.validators}
 
     def phases():
+        # see bench_commit_breakdown: sign_bytes_ms must time a real
+        # encode, not a memo hit
+        commit.invalidate_memos()
         t0 = time.perf_counter()
         all_sb = commit.sign_bytes_batch(chain_id)
         t1 = time.perf_counter()
@@ -868,6 +1022,30 @@ def _persist_midround(partial: dict) -> None:
         )
         with open(path, "w") as f:
             json.dump({"recorded_unix": time.time(), **partial}, f, indent=1)
+    except OSError:
+        pass
+
+
+def _persist_warmpath(record: dict) -> None:
+    """Write BENCH_WARMPATH.json — the warm-path record ISSUE 7's
+    <= 2 ms acceptance criterion is audited against: the interleaved
+    A/B warm row plus the encode/probe/tally phase breakdown. Written
+    as the warm stages land (same rationale as _persist_midround: a
+    later stall must not erase them) and kept out of the driver's
+    one-line budget."""
+    import os
+    import time as _time
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_WARMPATH.json",
+        )
+        with open(path, "w") as f:
+            json.dump(
+                {"recorded_unix": _time.time(), **record}, f, indent=1
+            )
+            f.write("\n")
     except OSError:
         pass
 
@@ -1121,6 +1299,40 @@ def main() -> None:
         1200.0,
     )
     cpu_stage(
+        "warm10k_breakdown",
+        lambda: bench_commit_warm_breakdown(10_000),
+        "verify_commit_10k_warm_breakdown_ms",
+        600.0,
+    )
+    _persist_warmpath(
+        {
+            "verify_commit_10k_warm": extra.get(
+                "verify_commit_10k_warm_cpu"
+            ),
+            "verify_commit_10k_warm_breakdown_ms": extra.get(
+                "verify_commit_10k_warm_breakdown_ms"
+            ),
+        }
+    )
+
+    def _persist_warmpath_light():
+        _persist_warmpath(
+            {
+                "verify_commit_10k_warm": extra.get(
+                    "verify_commit_10k_warm_cpu"
+                ),
+                "verify_commit_10k_warm_breakdown_ms": extra.get(
+                    "verify_commit_10k_warm_breakdown_ms"
+                ),
+                "light_sync_headers_per_s_150vals": extra.get(
+                    "light_sync_headers_per_s_150vals_cpu"
+                ),
+                "light_sync_warm_headers_per_s_150vals": extra.get(
+                    "light_sync_warm_headers_per_s_150vals_cpu"
+                ),
+            }
+        )
+    cpu_stage(
         "breakdown",
         lambda: bench_commit_breakdown_cpu(10_000, reps=3),
         "verify_commit_10k_breakdown_cpu_ms",
@@ -1144,11 +1356,17 @@ def main() -> None:
         ),
         "sr25519_batch_verify_us_per_sig_by_batch_cpu",
     )
+    def _light_sync_rows():
+        r = bench_light_sync(n_headers=50, use_device=False, warm_pass=True)
+        extra["light_sync_warm_headers_per_s_150vals_cpu"] = r["warm"]
+        return r["cold"]
+
     cpu_stage(
         "light_sync",
-        lambda: round(bench_light_sync(n_headers=50, use_device=False), 2),
+        _light_sync_rows,
         "light_sync_headers_per_s_150vals_cpu",
     )
+    _persist_warmpath_light()
     cpu_stage("sign_keygen", bench_sign_keygen, "sign_keygen_us")
     cpu_stage(
         "merkle",
@@ -1254,6 +1472,9 @@ def main() -> None:
         extra["light_sync_headers_per_s_150vals"] = extra[
             "light_sync_headers_per_s_150vals_cpu"
         ]
+        extra["light_sync_warm_headers_per_s_150vals"] = extra.get(
+            "light_sync_warm_headers_per_s_150vals_cpu"
+        )
         extra["merkle_proof_batch_per_s"] = extra["merkle_proof_batch_per_s_cpu"]
         extra["last_device_measurement"] = _last_device_run()
 

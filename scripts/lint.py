@@ -10,6 +10,9 @@ Usage:
     python scripts/lint.py --schema           # tmcheck schema gate only
     python scripts/lint.py --race             # tmrace data-race +
                                               # lock-order pass only
+    python scripts/lint.py --memo-audit       # memo-soundness audit
+                                              # only (prints the full
+                                              # memoized-function list)
     python scripts/lint.py --no-baseline      # every violation, raw
     python scripts/lint.py --baseline-update  # re-accept current state
                                               # (tmlint, taint AND race
@@ -90,6 +93,11 @@ def main(argv=None) -> int:
         help="run only the tmrace data-race + lock-order pass",
     )
     ap.add_argument(
+        "--memo-audit", action="store_true", dest="memo_audit",
+        help="run only the memo-soundness audit and print the full "
+             "memoized-function listing (tmcheck.memoaudit)",
+    )
+    ap.add_argument(
         "--schema-update", action="store_true",
         help="regenerate the golden wire-schema table "
              "(tendermint_tpu/analysis/tmcheck/schema.json)",
@@ -124,41 +132,58 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.baseline_update and args.schema:
+    if args.baseline_update and (args.schema or args.memo_audit):
         # the schema gate has no counted baseline — its accepted state
-        # IS the golden table; silently succeeding here would let an
-        # operator believe a red gate was accepted when nothing ran
+        # IS the golden table — and the memo audit ships with zero
+        # accepted debt by design; silently succeeding here would let
+        # an operator believe a red gate was accepted when nothing ran
         print(
             "error: --baseline-update has nothing to update for the "
-            "schema section (use --schema-update for the golden table)",
+            "schema/memo-audit sections (use --schema-update for the "
+            "golden table; the memo audit has no baseline)",
             file=sys.stderr,
         )
         return 2
-    if args.schema_update and (filtered or args.taint or args.race):
+    if args.schema_update and (
+        filtered or args.taint or args.race or args.memo_audit
+    ):
         # same hazard: the golden table covers EVERY codec module (and
-        # combining with --taint/--race would silently skip that gate
-        # while returning 0 — the update mode below disables them)
+        # combining with --taint/--race/--memo-audit would silently
+        # skip that gate while returning 0 — the update mode below
+        # disables them)
         print(
             "error: --schema-update requires a full-package run "
-            "(drop --rule/--taint/--race and path arguments)",
+            "(drop --rule/--taint/--race/--memo-audit and path "
+            "arguments)",
             file=sys.stderr,
         )
         return 2
 
-    sections = args.taint or args.schema or args.race
+    sections = args.taint or args.schema or args.race or args.memo_audit
     run_tmlint = not sections
-    run_taint = (args.taint or not (args.schema or args.race or filtered))
-    run_schema = (args.schema or not (args.taint or args.race or filtered))
-    run_race = (args.race or not (args.taint or args.schema or filtered))
+    run_taint = args.taint or not (
+        args.schema or args.race or args.memo_audit or filtered
+    )
+    run_schema = args.schema or not (
+        args.taint or args.race or args.memo_audit or filtered
+    )
+    run_race = args.race or not (
+        args.taint or args.schema or args.memo_audit or filtered
+    )
+    run_memo = args.memo_audit or not (
+        args.taint or args.schema or args.race or filtered
+    )
     # update modes run ONLY the sections they update: computing (then
     # discarding) the other gates' violations would both waste ~2 s
     # and return 0 past a red gate the operator never saw
     if args.baseline_update:
         run_schema = False
+        run_memo = False
     if args.schema_update:
         run_tmlint = False
         run_taint = False
         run_race = False
+        run_memo = False
 
     t0 = time.monotonic()
     violations = []
@@ -218,6 +243,7 @@ def main(argv=None) -> int:
             # baseline update — the race pass dominates gate runtime,
             # so it must never run twice
             race_pkg = pkg or tmcheck.build_package()
+            pkg = race_pkg
             race_v = tmrace.race_violations(race_pkg)
             violations.extend(race_v)
             if args.baseline_update:
@@ -239,6 +265,21 @@ def main(argv=None) -> int:
                         tmlint.load_baseline(tmrace.RACE_BASELINE_PATH),
                     )
                 )
+
+        if run_memo:
+            # no baseline: every memo-audit finding is a new violation
+            memo_pkg = pkg or tmcheck.build_package()
+            pkg = memo_pkg
+            report, memo_findings = tmcheck.memoaudit.audit(memo_pkg)
+            memo_v = tmcheck.memoaudit.findings_to_violations(
+                memo_findings
+            )
+            violations.extend(memo_v)
+            new.extend(memo_v)
+            if args.memo_audit:
+                # the listing IS the point of --memo-audit: every
+                # memoized function, its inputs, and its audit outcome
+                print(tmcheck.memoaudit.render_report(report))
 
         if args.schema_update:
             data = tmcheck.update_schema_golden()
@@ -274,6 +315,7 @@ def main(argv=None) -> int:
                 ("taint", run_taint),
                 ("schema", run_schema),
                 ("race", run_race),
+                ("memo", run_memo),
             )
             if on
         ]

@@ -21,9 +21,20 @@ Two machine-checked invariants that were previously trust-me:
    ascending-tag emission. Any drift is a tier-1 failure;
    `scripts/lint.py --schema-update` is the reviewed update path.
 
-Run both via `scripts/lint.py` (--taint / --schema) or the tier-1
-gates in tests/test_tmcheck.py. docs/static_analysis.md documents the
-source/sink catalogs and the suppression/baseline/golden policies.
+3. **Memo audit** (`memoaudit.py`): every memoized function in the hot
+   path (commit-scoped sign-bytes rows, flags arrays, validator-set
+   pubkey bytes/wire bytes/roots — the machinery behind the warm
+   commit path and the commit-level sigcache memo) is enumerated in a
+   reviewed catalog and re-proved taint-clean with the same
+   interprocedural source scan, so "the memo is sound by construction"
+   is a gate. Uncataloged memo writers and taint-reachable memoized
+   functions both fail; `scripts/lint.py --memo-audit` prints the full
+   listing.
+
+Run them via `scripts/lint.py` (--taint / --schema / --memo-audit) or
+the tier-1 gates in tests/test_tmcheck.py. docs/static_analysis.md
+documents the source/sink catalogs and the
+suppression/baseline/golden policies.
 """
 
 from __future__ import annotations
@@ -37,8 +48,9 @@ from ..tmlint import (
     new_violations,
     save_baseline,
 )
-from . import callgraph, schema, taint
+from . import callgraph, memoaudit, schema, taint
 from .callgraph import Package, build_package
+from .memoaudit import memo_audit_violations
 from .schema import (
     GOLDEN_PATH,
     extract_package,
@@ -56,6 +68,7 @@ __all__ = [
     "taint_analyze",
     "taint_violations",
     "new_taint_violations",
+    "memo_audit_violations",
     "schema_violations",
     "extract_package",
     "load_golden",
@@ -105,6 +118,16 @@ RULES = [
     (
         "schema-symmetry",
         "field written but not parsed (or parsed but not written)",
+    ),
+    (
+        "memo-uncataloged",
+        "memoizing function missing from the reviewed memo catalog "
+        "(tmcheck.memoaudit.CATALOG)",
+    ),
+    (
+        "memo-taint",
+        "nondeterminism source reachable from a memoized function "
+        "(a memo over a non-pure computation is unsound)",
     ),
 ]
 

@@ -433,15 +433,21 @@ class ConsensusState(Service):
             if not supports_batch_verifier(candidates[0][1]):
                 continue
             # assemble only cache misses (duplicates of an earlier
-            # burst, or re-gossiped votes, are already proven)
-            triples = []
-            for vote, pk in candidates:
-                sign_bytes = vote.sign_bytes(chain_id)
-                ckey = sigcache.key_for(
-                    pk.bytes(), sign_bytes, vote.signature
+            # burst, or re-gossiped votes, are already proven) — one
+            # bulk set-intersection over the burst instead of a
+            # per-vote generation probe (sigcache.seen_keys_bulk)
+            keys = [
+                sigcache.key_for(
+                    pk.bytes(), vote.sign_bytes(chain_id), vote.signature
                 )
-                if not sigcache.seen_key(ckey):
-                    triples.append((pk, sign_bytes, vote.signature, ckey))
+                for vote, pk in candidates
+            ]
+            hit_set = sigcache.seen_keys_bulk(keys)
+            triples = [
+                (pk, vote.sign_bytes(chain_id), vote.signature, ckey)
+                for (vote, pk), ckey in zip(candidates, keys)
+                if ckey not in hit_set
+            ]
             if len(triples) < 2:
                 continue
             try:

@@ -187,13 +187,17 @@ def drain_and_cache(verifier: BatchVerifier, cache_keys) -> tuple:
     if getattr(verifier, "faulted", False):
         return ok, bits
     if ok:
-        for key in cache_keys:
-            if key is not None:
-                sigcache.add_key(key)
+        sigcache.add_keys_bulk(
+            [key for key in cache_keys if key is not None]
+        )
     else:
-        for key, bit in zip(cache_keys, bits):
-            if bit and key is not None:
-                sigcache.add_key(key)
+        sigcache.add_keys_bulk(
+            [
+                key
+                for key, bit in zip(cache_keys, bits)
+                if bit and key is not None
+            ]
+        )
     return ok, bits
 
 

@@ -30,6 +30,19 @@ Safety model:
 - The cache carries no acceptance semantics of its own: callers still run
   every address/index/height/double-sign check; only the raw signature
   equation is skipped.
+- A second key shape rides the same generations: COMMIT-LEVEL keys
+  (seen_commit/add_commit) that record a whole commit verification's
+  success, so a fully-warm re-verification short-circuits to the tally
+  in O(1) probes. The key binds the verification mode, chain_id, a
+  commit content-identity token (Commit.fingerprint_token — replaced on
+  any in-place mutation), the validator-set hash, a live fingerprint of
+  the voting powers, and the power threshold; the sign-bytes these keys
+  implicitly vouch for are machine-proved deterministic in their inputs
+  by tmcheck's taint gate, and `scripts/lint.py --memo-audit` re-proves
+  that argument for every memoized function on each run — the full
+  soundness chain is written up in docs/static_analysis.md
+  ("Memo soundness"). Commit keys are 5+-tuples starting with a mode
+  string, triples are 3-tuples of bytes: the namespaces cannot collide.
 
 Memory is bounded by two-generation rotation: inserts land in the young
 generation; when it fills, the old generation is dropped (counted by
@@ -66,14 +79,20 @@ from ..libs import metrics as M
 __all__ = [
     "DEFAULT_CAPACITY",
     "add",
+    "add_commit",
     "add_key",
+    "add_keys_bulk",
+    "commit_memo_disabled",
+    "commit_memo_enabled",
     "disabled",
     "enabled",
     "key_for",
     "observe",
     "reset",
     "seen",
+    "seen_commit",
     "seen_key",
+    "seen_keys_bulk",
     "set_capacity",
     "stats",
 ]
@@ -96,12 +115,23 @@ _m_evictions = M.new_counter(
     "sigcache", "evictions_total",
     "Verified-signature triples dropped by generation rotation.",
 )
+_m_commit_hits = M.new_counter(
+    "sigcache", "commit_hits_total",
+    "Commit-level verification memo hits (whole commits short-"
+    "circuited to the tally).",
+)
+_m_commit_misses = M.new_counter(
+    "sigcache", "commit_misses_total",
+    "Commit-level verification memo misses (per-triple probing "
+    "performed).",
+)
 
 _capacity = DEFAULT_CAPACITY
 _gen0: set = set()  # young generation: inserts and promotions land here
 _gen1: set = set()  # old generation: dropped wholesale on rotation
 _lock = threading.Lock()  # guards rotation only; set ops are GIL-atomic
 _force_off = False  # tests/bench override, same effect as the env gate
+_force_commit_off = False  # bench A/B arm: triple probes only
 
 
 def enabled() -> bool:
@@ -152,10 +182,55 @@ def seen_key(key: tuple) -> bool:
     return False
 
 
+def seen_keys_bulk(keys) -> set:
+    """Bulk membership: returns the subset of `keys` already proven, as
+    a set. One set-intersection per generation replaces the per-triple
+    probe loop — at 10k signatures the warm scan's dominant Python cost
+    after the sign-bytes memo (PERF.md warm-path breakdown). Old-
+    generation hits are promoted exactly like seen_key. No metrics and
+    no enabled() gate, same contract as seen_key: batch callers check
+    enabled() once and account via observe()."""
+    if not keys:
+        return set()
+    ks = keys if isinstance(keys, set) else set(keys)
+    # tmlint: disable=lock-global-mutation — GIL-atomic set ops by
+    # design (module docstring); _lock guards only generation rotation
+    hits = ks & _gen0
+    old = (ks - hits) & _gen1
+    if old:
+        # promote survivors of a stable signer set, discarding the
+        # old-generation copies so entries()/evictions stay honest —
+        # the bulk form of seen_key's promotion
+        _gen1.difference_update(old)  # tmlint: disable=lock-global-mutation
+        _gen0.update(old)  # tmlint: disable=lock-global-mutation
+        hits |= old
+        if len(_gen0) >= _capacity:
+            _rotate()
+    return hits
+
+
 def add_key(key: tuple) -> None:
     """Record a precomputed key as verified (caller gates on enabled()
     and MUST only call after a successful verification)."""
     _insert(key)
+
+
+def add_keys_bulk(keys) -> None:
+    """Record many precomputed keys as verified (same caller contract
+    as add_key). Inserts are chunked to the remaining generation
+    capacity so the documented bound — at most 2 generations x
+    capacity resident triples — holds even for a 10k-key drain into a
+    nearly-full young generation."""
+    keys = list(keys)
+    pos = 0
+    while pos < len(keys):
+        room = max(_capacity - len(_gen0), 1)
+        chunk = keys[pos:pos + room]
+        pos += room
+        # tmlint: disable=lock-global-mutation — GIL-atomic set update
+        _gen0.update(chunk)
+        if len(_gen0) >= _capacity:
+            _rotate()
 
 
 def _insert(key: tuple) -> None:
@@ -175,6 +250,57 @@ def _rotate() -> None:
             _m_evictions.inc(len(_gen1))
         _gen1 = _gen0
         _gen0 = set()
+
+
+def commit_memo_enabled() -> bool:
+    """The commit-level verification memo rides the same generations
+    but has its own off-switch (TM_TPU_NO_COMMIT_MEMO=1, or a
+    commit_memo_disabled() scope) on top of the cache-wide gate — the
+    bench's interleaved A/B arm measures the bulk triple-probe path
+    with only this half disabled."""
+    return enabled() and not (
+        _force_commit_off or os.environ.get("TM_TPU_NO_COMMIT_MEMO")
+    )
+
+
+@contextlib.contextmanager
+def commit_memo_disabled():
+    """Scope with only the commit-level memo off (bench B arm, tests):
+    triple probes still hit, so this isolates what the O(1) commit
+    short-circuit buys over the bulk probe."""
+    global _force_commit_off
+    prev = _force_commit_off
+    _force_commit_off = True
+    try:
+        yield
+    finally:
+        _force_commit_off = prev
+
+
+def seen_commit(key: tuple) -> bool:
+    """Probe the commit-level verification memo: True iff this exact
+    (mode, chain_id, commit fingerprint token, validator-set
+    fingerprint, threshold) tuple completed a fully-successful
+    verification before (types/validation.py builds the key; failures
+    are never recorded, so a hit can only skip work a fresh run would
+    repeat). Lives in the same two-generation rotation as the triples
+    — promotion keeps a live chain's commit memos resident. Counts
+    sigcache_commit_{hits,misses}_total; False when disabled."""
+    if not commit_memo_enabled():
+        return False
+    if seen_key(key):
+        _m_commit_hits.inc()
+        return True
+    _m_commit_misses.inc()
+    return False
+
+
+def add_commit(key: tuple) -> None:
+    """Record a commit-level key after a FULLY successful commit
+    verification (every required signature proven, tally crossed)."""
+    if not commit_memo_enabled():
+        return
+    _insert(key)
 
 
 def seen(pk_bytes: bytes, sign_bytes: bytes, signature: bytes) -> bool:
@@ -210,6 +336,8 @@ def stats() -> dict:
         "hits": int(_m_hits.value()),
         "misses": int(_m_misses.value()),
         "evictions": int(_m_evictions.value()),
+        "commit_hits": int(_m_commit_hits.value()),
+        "commit_misses": int(_m_commit_misses.value()),
         "entries": len(_gen0) + len(_gen1),
         "capacity": _capacity,
     }

@@ -38,6 +38,25 @@ MAX_COMMIT_SIG_BYTES = 109  # reference: types/block.go:600
 
 MAX_SIGNATURE_SIZE = 64
 
+# Process-wide commit-mutation epoch. Every Commit memo (sign-bytes
+# rows, flags array, hash, splice templates, fingerprint token) is
+# pinned to the token stored here when it was built; any POST-INIT
+# assignment to a Commit or CommitSig wire field replaces the token
+# (one atomic STORE_SUBSCR — no read-modify-write), so every memo in
+# the process re-validates lazily on next access. In production commits
+# are immutable after construction (nothing in the package assigns a
+# CommitSig field post-init), so the token never moves and the check is
+# one `is` comparison; tests that mutate in place (forged-signature /
+# mutated-timestamp safety tests) invalidate conservatively across ALL
+# commits, which is always sound — a cleared memo is just rebuilt.
+# In-place mutation of the `signatures` LIST (append/slice assignment)
+# is not observable here and remains unsupported, exactly as the
+# pre-existing _hash/_sign_templates memos already assumed.
+# tmrace: race-ok — single atomic list-slot store of a fresh token;
+# concurrent bumps each publish a token unequal to every pinned memo,
+# so any interleaving invalidates (the conservative direction)
+_MUT_EPOCH = [object()]
+
 
 def max_commit_bytes(val_count: int) -> int:
     """reference: types/block.go:621-625."""
@@ -53,6 +72,15 @@ class CommitSig:
     validator_address: bytes = b""
     timestamp_ns: int = 0
     signature: bytes = b""
+
+    def __setattr__(self, name: str, value) -> None:
+        # a RE-assignment (the attribute already exists — dataclass
+        # __init__ sets each field exactly once on a fresh instance)
+        # mutates a signed record: bump the process-wide epoch so every
+        # commit memo derived from CommitSig content re-validates
+        if name in self.__dict__:
+            _MUT_EPOCH[0] = object()
+        object.__setattr__(self, name, value)
 
     @classmethod
     def absent(cls) -> "CommitSig":
@@ -155,6 +183,69 @@ class Commit:
     _flags_memo: Optional[object] = field(
         default=None, repr=False, compare=False
     )
+    # chain_id -> list of Optional[bytes] sign-bytes rows (None at
+    # absent or not-yet-encoded indexes); see sign_bytes_batch
+    _sb_rows: Optional[dict] = field(
+        default=None, repr=False, compare=False
+    )
+    # chain_ids whose _sb_rows entry covers every non-absent index
+    _sb_complete: Optional[set] = field(
+        default=None, repr=False, compare=False
+    )
+    # content-identity token; see fingerprint_token
+    _fp_token: Optional[object] = field(
+        default=None, repr=False, compare=False
+    )
+    # the _MUT_EPOCH token the memos above were built under
+    _memo_epoch: Optional[object] = field(
+        default=None, repr=False, compare=False
+    )
+
+    # wire fields: a post-init assignment to one of these mutates the
+    # signed record the memos were derived from
+    _WIRE_FIELDS = frozenset({"height", "round", "block_id", "signatures"})
+
+    def __setattr__(self, name: str, value) -> None:
+        if name in self._WIRE_FIELDS and name in self.__dict__:
+            _MUT_EPOCH[0] = object()
+        object.__setattr__(self, name, value)
+
+    def _memos_fresh(self) -> None:
+        """Pin the memos to the current mutation epoch, dropping them
+        all when ANY commit/sig field was re-assigned since they were
+        built (see _MUT_EPOCH). Called at the top of every memoized
+        accessor; the warm-path cost is one `is` comparison."""
+        epoch = _MUT_EPOCH[0]
+        if self._memo_epoch is not epoch:
+            self._hash = None
+            self._sign_templates = None
+            self._flags_memo = None
+            self._sb_rows = None
+            self._sb_complete = None
+            self._fp_token = None
+            self._memo_epoch = epoch
+
+    def invalidate_memos(self) -> None:
+        """Drop every memo on THIS commit (bench cold rows, tests).
+        Production code never needs this — memos self-invalidate on
+        field mutation via the epoch."""
+        self._memo_epoch = None
+        self._memos_fresh()
+
+    def fingerprint_token(self):
+        """Content-identity token for the commit-level verification
+        memo (types/validation.py): a unique object created lazily and
+        REPLACED whenever any commit/sig field mutates, so a sigcache
+        entry keyed on it can never alias different commit contents —
+        unlike id(), a dead token is unreachable rather than reusable,
+        and unlike a content digest it costs nothing to compare. The
+        soundness argument is the same immutability-after-construction
+        property every other memo here relies on, machine-checked by
+        `scripts/lint.py --memo-audit` (docs/static_analysis.md)."""
+        self._memos_fresh()
+        if self._fp_token is None:
+            self._fp_token = object()
+        return self._fp_token
 
     def size(self) -> int:
         return len(self.signatures)
@@ -177,6 +268,7 @@ class Commit:
         (from_proto reads an unbounded varint): callers must fall back
         to the scalar loop so a hostile commit gets the reference
         InvalidCommitError, not an OverflowError from the memo."""
+        self._memos_fresh()
         if self._flags_memo is None:
             import numpy as np
 
@@ -223,6 +315,7 @@ class Commit:
         cost of a large VerifyCommit (types/validation.go:152 analog)."""
         from .canonical import VoteSignTemplate
 
+        self._memos_fresh()
         if self._sign_templates is None:
             self._sign_templates = {}
         tpl = self._sign_templates.get((chain_id, for_block))
@@ -237,29 +330,73 @@ class Commit:
             self._sign_templates[(chain_id, for_block)] = tpl
         return tpl
 
+    def _rows_for(self, chain_id: str) -> List[Optional[bytes]]:
+        """The per-chain sign-bytes row memo, allocated on first use.
+        Callers must have run _memos_fresh() this access."""
+        if self._sb_rows is None:
+            self._sb_rows = {}
+            self._sb_complete = set()
+        rows = self._sb_rows.get(chain_id)
+        if rows is None:
+            rows = self._sb_rows[chain_id] = [None] * len(self.signatures)
+        return rows
+
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """Sign-bytes of the vote at a validator index. Byte-identical
-        to get_vote(i).sign_bytes(chain_id) (tests/test_encoding.py)."""
+        to get_vote(i).sign_bytes(chain_id) (tests/test_encoding.py).
+
+        Memoized per (chain_id, index) in the same rows list
+        sign_bytes_batch fills: a commit's sign-bytes are a pure
+        function of (type, height, round, block_id, timestamp,
+        chain_id) — machine-proved deterministic by tmcheck's taint
+        gate (docs/static_analysis.md) — and the inputs are frozen
+        after construction (mutation drops the memo via _MUT_EPOCH).
+        gossip-verify, LastCommit re-verification, and the light
+        client's double-verify each re-encoded the same rows before;
+        now only the first pass pays, and only for the indexes it
+        actually visits (early-exit variants never encode discarded
+        rows)."""
+        self._memos_fresh()
         cs = self.signatures[val_idx]
-        tpl = self._sign_template(
-            chain_id, cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
-        )
-        return tpl.sign_bytes(cs.timestamp_ns)
+        if cs.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            # not memoized: sign_bytes_batch's contract keeps absent
+            # rows None, and no verification path requests them
+            tpl = self._sign_template(chain_id, False)
+            return tpl.sign_bytes(cs.timestamp_ns)
+        rows = self._rows_for(chain_id)
+        row = rows[val_idx]
+        if row is None:
+            tpl = self._sign_template(
+                chain_id, cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
+            )
+            row = rows[val_idx] = tpl.sign_bytes(cs.timestamp_ns)
+        return row
 
     def sign_bytes_batch(self, chain_id: str) -> List[Optional[bytes]]:
         """Sign-bytes for every non-absent signature in one pass
         (None at absent indexes). The batch VerifyCommit path uses
         this instead of per-index vote_sign_bytes: template splicing
         plus the tight per-timestamp loop beats the full marshal ~10x
-        at 10k signatures."""
+        at 10k signatures.
+
+        Memoized per chain_id (see vote_sign_bytes for the soundness
+        argument): the returned list is SHARED with the memo and must
+        be treated read-only by callers. Warm verification paths
+        (steady-state LastCommit, light-client double-verify) hit this
+        memo and perform zero canonical encodes — the tier-1
+        counting-stub guard in tests/test_sigcache.py pins that."""
+        self._memos_fresh()
         sigs = self.signatures
-        out: List[Optional[bytes]] = [None] * len(sigs)
+        if self._sb_complete is not None and chain_id in self._sb_complete:
+            return self._sb_rows[chain_id]
+        out = self._rows_for(chain_id)
         for for_block in (True, False):
             idxs = [
                 i
                 for i, cs in enumerate(sigs)
                 if not cs.is_absent()
                 and (cs.block_id_flag == BLOCK_ID_FLAG_COMMIT) == for_block
+                and out[i] is None
             ]
             if not idxs:
                 continue
@@ -269,6 +406,7 @@ class Commit:
             )
             for i, row in zip(idxs, rows):
                 out[i] = row
+        self._sb_complete.add(chain_id)
         return out
 
     def validate_basic(self) -> None:
@@ -290,6 +428,7 @@ class Commit:
     def hash(self) -> bytes:
         """Merkle root over marshalled CommitSigs
         (reference: types/block.go:902-921)."""
+        self._memos_fresh()
         if self._hash is None:
             self._hash = merkle.hash_from_byte_slices(
                 [cs.to_proto() for cs in self.signatures]

@@ -15,6 +15,20 @@ only cache misses are assembled, so a LastCommit whose precommits were
 gossip-verified re-verifies with zero crypto calls, and device buckets
 pad to the real miss count. TM_TPU_NO_SIGCACHE=1 restores the uncached
 behavior exactly (same errors, same tallies — just slower).
+
+The WARM path additionally does zero encoding and (near-)zero per-vote
+Python work (PERF.md "Warm path"): sign-bytes come from the commit-
+scoped memo (Commit.sign_bytes_batch / vote_sign_bytes), the cache
+scan is one bulk set-intersection (sigcache.seen_keys_bulk) instead of
+a per-triple probe loop, tallies are masked-numpy sums / prefix-sums
+over ValidatorSet.powers_array(), and a commit that verified fully
+before short-circuits to the tally via the commit-level memo
+(sigcache.seen_commit) in O(1) probes. Every vectorized plan computes
+the SAME processed-index set and error as the scalar reference loop
+(_verify_commit_batch_scalar — kept as the fallback for hostile
+flag encodings and locked byte-identical by the property tests in
+tests/test_warmpath.py); the memo-soundness argument is machine-
+checked by `scripts/lint.py --memo-audit` (docs/static_analysis.md).
 """
 
 from __future__ import annotations
@@ -130,7 +144,7 @@ def verify_commit_light(
     if _should_batch_verify(vals, commit):
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed,
-            ignore, count, False, True,
+            ignore, count, False, True, vector_tally=True,
         )
     else:
         _verify_commit_single(
@@ -164,7 +178,7 @@ def verify_commit_light_trusting(
     if _should_batch_verify(vals, commit):
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed,
-            ignore, count, False, False,
+            ignore, count, False, False, vector_tally=True,
         )
     else:
         _verify_commit_single(
@@ -191,11 +205,37 @@ def collect_commit_light(
     semantics of types/validation.go:55-85."""
     _verify_basic(vals, commit, height, block_id)
     voting_power_needed = vals.total_voting_power() * 2 // 3
+    flags = commit.block_id_flags_array()
+    if flags is not None:
+        # prefix-sum form of the early-exit tally (the same
+        # _prefix_crossing plan as the vectorized verify_commit_light):
+        # the crossing index is the exact vote the reference loop below
+        # returns after, so the collected triples are identical — and
+        # the per-index encodes hit the commit-scoped sign-bytes memo
+        powers = vals.powers_array()
+        tallied, end = _prefix_crossing(
+            np.where(flags == BLOCK_ID_FLAG_COMMIT, powers, 0),
+            voting_power_needed,
+        )
+        if end is None:
+            raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+        validators = vals.validators
+        signatures = commit.signatures
+        return [
+            (
+                validators[i].pub_key,
+                commit.vote_sign_bytes(chain_id, i),
+                signatures[i].signature,
+            )
+            for i in np.flatnonzero(
+                flags[:end] == BLOCK_ID_FLAG_COMMIT
+            ).tolist()
+        ]
+    # scalar reference loop (kept for hostile flag encodings); lazy
+    # per-index encode: this early-exit variant skips nil votes and
+    # stops at 2/3, so a full precompute would pay for rows it discards
     tallied = 0
     out = []
-    # lazy per-index encode (template-cached): this early-exit variant
-    # skips nil votes and stops at 2/3, so a full precompute would pay
-    # for rows it discards — same policy as _verify_commit_batch
     for idx, commit_sig in enumerate(commit.signatures):
         if not commit_sig.is_for_block():
             continue
@@ -235,11 +275,22 @@ def verify_triples_grouped(triples) -> None:
         # previously every group got size_hint=len(triples), so in mixed
         # sets each device bucket padded to the merged total
         pending: dict = {}
-        for pk, sb, sig in triples:
+        # one bulk set-intersection over the whole merged window
+        # replaces the per-triple generation probes (the light client's
+        # 32-hop sequential windows are ~5k triples)
+        keys: list = []
+        hit_set: set = set()
+        if use_cache:
+            keys = [
+                sigcache.key_for(pk.bytes(), sb, sig)
+                for pk, sb, sig in triples
+            ]
+            hit_set = sigcache.seen_keys_bulk(keys)
+        for n, (pk, sb, sig) in enumerate(triples):
             ckey = None
             if use_cache:
-                ckey = sigcache.key_for(pk.bytes(), sb, sig)
-                if sigcache.seen_key(ckey):
+                ckey = keys[n]
+                if ckey in hit_set:
                     hits += 1
                     continue
                 misses += 1
@@ -341,15 +392,330 @@ def _verify_commit_batch_impl(
     mixed sets first-class. A key type with no batch support at all
     (secp256k1) verifies inline.
 
+    `vector_tally=True` asserts that ignore_sig/count_sig are the
+    STANDARD predicates for this (count_all_signatures,
+    look_up_by_index) combination — absent-skip/commit-count for
+    verify_commit, for-block-only/count-all for the light and trusting
+    variants — and routes through the vectorized plans in
+    _verify_commit_batch_vector, which compute the same processed-index
+    set, tally, and errors as the scalar reference loop below (pinned
+    by the property tests in tests/test_warmpath.py). A commit whose
+    BlockIDFlags don't fit uint8 (hostile from_proto input) falls back
+    to the scalar loop so the failure surfaces as the reference
+    InvalidCommitError."""
+    if vector_tally:
+        flags = commit.block_id_flags_array()
+        if flags is not None:
+            _verify_commit_batch_vector(
+                chain_id, vals, commit, voting_power_needed,
+                count_all_signatures, look_up_by_index, flags,
+            )
+            return
+    _verify_commit_batch_scalar(
+        chain_id, vals, commit, voting_power_needed,
+        ignore_sig, count_sig, count_all_signatures, look_up_by_index,
+    )
+
+
+def _prefix_crossing(masked_powers, voting_power_needed: int):
+    """(tallied, end) of the reference early-exit scan over
+    `masked_powers` — the per-position powers the scalar loop would ADD
+    (zeros where it skips). The reference breaks AFTER the vote whose
+    running total crosses the threshold, i.e. at the first index where
+    the prefix sum exceeds it; `end` is that index + 1 (the exclusive
+    scan bound), or None when the whole array is scanned without
+    crossing. Single home for the cum/argmax subtlety shared by the
+    vectorized light/trusting plans and collect_commit_light."""
+    cum = masked_powers.cumsum()
+    total = int(cum[-1]) if cum.size else 0
+    if total > voting_power_needed:
+        cross = int(np.argmax(cum > voting_power_needed))
+        return int(cum[cross]), cross + 1
+    return total, None
+
+
+def _commit_memo_key(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+    powers,
+) -> tuple:
+    """The commit-level sigcache key (crypto/sigcache seen_commit /
+    add_commit): binds the verification mode, threshold, a content-
+    identity token per commit and validator set, the process-wide
+    validator-mutation epoch (so an in-place pub_key/address swap —
+    which moves neither fingerprint token nor the powers bytes — can
+    never serve a stale success; types/validator.py _VAL_MUT_EPOCH),
+    and the live powers bytes as defense in depth. Single home shared
+    with bench_commit_warm_breakdown's commit_probe phase so the
+    measured probe can't drift from the production key shape."""
+    from .validator import _VAL_MUT_EPOCH
+
+    return (
+        "commit-memo",
+        chain_id,
+        count_all_signatures,
+        look_up_by_index,
+        voting_power_needed,
+        commit.fingerprint_token(),
+        vals.fingerprint_token(),
+        _VAL_MUT_EPOCH[0],
+        powers.tobytes(),
+    )
+
+
+def _verify_commit_batch_vector(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+    flags,
+) -> None:
+    """The warm-path engine: zero encoding (commit-scoped sign-bytes
+    memo), one bulk cache probe (sigcache.seen_keys_bulk) instead of a
+    per-triple loop, a masked-sum / prefix-sum tally instead of
+    per-vote predicate calls, and an O(1) commit-level short-circuit
+    (sigcache.seen_commit) for a commit this process fully verified
+    before. Behavior — processed indexes, early-exit points, error
+    strings — is byte-identical to _verify_commit_batch_scalar by
+    construction and by property test:
+
+    - verify_commit (count_all, by index): processes every non-absent
+      index; tally = sum of powers where flag == COMMIT.
+    - verify_commit_light (early exit, by index): the reference loop
+      counts every for-block vote in index order and breaks after the
+      vote that crosses 2/3 — exactly the first index where the
+      prefix-sum of COMMIT-masked powers exceeds the threshold. The
+      processed set is the for-block prefix through that crossing.
+    - verify_commit_light_trusting (early exit, by address): same
+      prefix-sum over powers resolved through the trusted set's address
+      index (missing addresses contribute 0, exactly like the
+      reference's skip). Duplicate addresses can only INFLATE the
+      prefix-sum, so the computed crossing k never lies beyond the
+      reference's scan end: a duplicate at index j <= k is re-detected
+      by the per-index replay below and raises the reference's double-
+      vote error; a duplicate at j > k was never reached by the
+      reference loop either, and then the prefix through k is
+      duplicate-free so its sums agree exactly.
+
+    Only the inline (non-batchable key) failure path accounts cache
+    metrics differently: the scalar loop observes the counts scanned so
+    far, this path observes the full plan's counts up front. Errors and
+    verification work are identical."""
+    use_cache = sigcache.enabled()
+    sigs = commit.signatures
+    powers = vals.powers_array()
+
+    # --- the plan: processed indexes (ascending) + precomputed tally
+    if count_all_signatures:
+        tallied = int(powers[flags == BLOCK_ID_FLAG_COMMIT].sum())
+        idx_list = np.flatnonzero(flags != BLOCK_ID_FLAG_ABSENT).tolist()
+    elif look_up_by_index:
+        tallied, end = _prefix_crossing(
+            np.where(flags == BLOCK_ID_FLAG_COMMIT, powers, 0),
+            voting_power_needed,
+        )
+        idx_list = np.flatnonzero(
+            (flags if end is None else flags[:end]) == BLOCK_ID_FLAG_COMMIT
+        ).tolist()
+    else:
+        fb = np.flatnonzero(flags == BLOCK_ID_FLAG_COMMIT)
+        addr_index = vals._addr_index
+        vi = np.fromiter(
+            (
+                addr_index.get(sigs[i].validator_address, -1)
+                for i in fb.tolist()
+            ),
+            dtype=np.int64,
+            count=fb.size,
+        )
+        tallied, end = _prefix_crossing(
+            np.where(vi >= 0, powers[np.maximum(vi, 0)], 0),
+            voting_power_needed,
+        )
+        idx_list = (fb if end is None else fb[:end]).tolist()
+
+    # --- commit-level memo: a commit this process fully verified
+    # before, in this mode, against this exact set composition and
+    # these exact live powers, short-circuits to the (deterministic)
+    # success in O(1) probes. Failures are never recorded, the token
+    # components die with any mutation, and TM_TPU_NO_SIGCACHE /
+    # TM_TPU_NO_COMMIT_MEMO disable the whole consult.
+    ckey_commit = None
+    if use_cache and sigcache.commit_memo_enabled():
+        ckey_commit = _commit_memo_key(
+            chain_id, vals, commit, voting_power_needed,
+            count_all_signatures, look_up_by_index, powers,
+        )
+        if sigcache.seen_commit(ckey_commit):
+            trace.add_attrs(sigcache_commit_hit=True, sigs_warm=len(idx_list))
+            return
+
+    # key type -> [(pub_key, sign_bytes, signature, commit idx, cache
+    # key)]: the cache misses awaiting batch verification
+    pending: dict[str, list] = {}
+    # key type -> supports_batch_verifier (cached: at 10k signatures the
+    # repeated registry lookup was a measurable slice of the scan)
+    batchable: dict[str, bool] = {}
+
+    if look_up_by_index:
+        validators = vals.validators
+        if count_all_signatures:
+            rows = commit.sign_bytes_batch(chain_id)
+        else:
+            # early-exit variant: encode only the processed prefix,
+            # lazily and memoized — no discarded rows are paid for
+            rows = None
+            vsb = commit.vote_sign_bytes
+        misses = idx_list
+        hits_n = 0
+        if use_cache:
+            pkb = vals.pubkeys_bytes()
+            if rows is not None:
+                # rows is None exactly at absent indexes, i.e. exactly
+                # the complement of idx_list — the zip form skips three
+                # indexed lookups per signature vs iterating idx_list
+                keys = [
+                    (b, r, cs.signature)
+                    for b, r, cs in zip(pkb, rows, sigs)
+                    if r is not None
+                ]
+            else:
+                keys = [
+                    (pkb[i], vsb(chain_id, i), sigs[i].signature)
+                    for i in idx_list
+                ]
+            hit_set = sigcache.seen_keys_bulk(keys)
+            hits_n = len(hit_set)
+            if hits_n == len(keys):
+                misses = []
+            else:
+                misses = [
+                    i
+                    for i, k in zip(idx_list, keys)
+                    if k not in hit_set
+                ]
+            sigcache.observe(hits_n, len(misses))
+            trace.add_attrs(
+                sigcache_hits=hits_n, sigcache_misses=len(misses)
+            )
+        for i in misses:
+            pub_key = validators[i].pub_key
+            sb = rows[i] if rows is not None else vsb(chain_id, i)
+            sig = sigs[i].signature
+            key_type = pub_key.type()
+            can_batch = batchable.get(key_type)
+            if can_batch is None:
+                can_batch = batchable[key_type] = supports_batch_verifier(
+                    pub_key
+                )
+            if not can_batch:
+                if not pub_key.verify_signature(sb, sig):
+                    raise InvalidCommitError(
+                        f"wrong signature (#{i}): {sig.hex()}"
+                    )
+                if use_cache:
+                    sigcache.add_key((pub_key.bytes(), sb, sig))
+            else:
+                pending.setdefault(key_type, []).append(
+                    (
+                        pub_key, sb, sig, i,
+                        (pub_key.bytes(), sb, sig) if use_cache else None,
+                    )
+                )
+    else:
+        # trusting: per-index replay of the reference body over the
+        # precomputed prefix — the double-vote ordering machinery stays
+        # scalar, only ignore/count/early-exit bookkeeping is gone
+        _seen_key = sigcache.seen_key
+        hits_n = misses_n = 0
+        seen_vals: dict[int, int] = {}
+        for idx in idx_list:
+            commit_sig = sigs[idx]
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise InvalidCommitError(
+                    f"double vote from {val.address.hex()} "
+                    f"({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+            vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+            pub_key = val.pub_key
+            ckey = None
+            if use_cache:
+                ckey = (
+                    pub_key.bytes(), vote_sign_bytes, commit_sig.signature
+                )
+                if _seen_key(ckey):
+                    hits_n += 1
+                    continue
+                misses_n += 1
+            key_type = pub_key.type()
+            can_batch = batchable.get(key_type)
+            if can_batch is None:
+                can_batch = batchable[key_type] = supports_batch_verifier(
+                    pub_key
+                )
+            if not can_batch:
+                if not pub_key.verify_signature(
+                    vote_sign_bytes, commit_sig.signature
+                ):
+                    if use_cache:  # keep the scanned hit/miss counts
+                        sigcache.observe(hits_n, misses_n)
+                    raise InvalidCommitError(
+                        f"wrong signature (#{idx}): "
+                        f"{commit_sig.signature.hex()}"
+                    )
+                if ckey is not None:
+                    sigcache.add_key(ckey)
+            else:
+                pending.setdefault(key_type, []).append(
+                    (
+                        pub_key, vote_sign_bytes, commit_sig.signature,
+                        idx, ckey,
+                    )
+                )
+        if use_cache:
+            sigcache.observe(hits_n, misses_n)
+            trace.add_attrs(sigcache_hits=hits_n, sigcache_misses=misses_n)
+
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+    _drain_pending(commit, pending)
+    if ckey_commit is not None:
+        sigcache.add_commit(ckey_commit)
+
+
+def _verify_commit_batch_scalar(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """The reference scan loop (types/validation.go:152-262): per-vote
+    predicates, incremental tally, early exit by running total. The
+    vectorized plans above must stop at the same vote and raise the
+    same errors as this loop — it is both the fallback for hostile
+    flag encodings and the oracle the property tests compare against.
+
     Cache-aware batch assembly: each triple is first checked against
     the verified-signature cache (crypto.sigcache); hits skip crypto
     entirely and only MISSES are assembled, deferred until after the
     scan so every group's batch verifier gets size_hint = its own miss
     count — the padded device bucket shrinks to the real work instead
     of the whole commit (and, per key type, to the group rather than
-    the merged total). In steady state a node that gossip-verified a
-    commit's precommits verifies its LastCommit with zero crypto calls:
-    a tuple-set scan plus the unchanged tally/double-sign logic."""
+    the merged total)."""
     use_cache = sigcache.enabled()
     _seen_key = sigcache.seen_key  # hoisted: called once per signature
     tallied = 0
@@ -358,42 +724,17 @@ def _verify_commit_batch_impl(
     # key type -> [(pub_key, sign_bytes, signature, commit idx, cache
     # key)]: the cache misses awaiting batch verification
     pending: dict[str, list] = {}
-    # key type -> supports_batch_verifier (cached: at 10k signatures the
-    # repeated registry lookup was a measurable slice of the scan)
+    # key type -> supports_batch_verifier
     batchable: dict[str, bool] = {}
     # one templated pass for all sign-bytes when every signature will
-    # be checked (verify_commit): at 10k signatures the per-index
-    # marshal is the dominant host cost (see Commit.sign_bytes_batch).
-    # Early-exit variants (light/trusting stop at 2/3 and ignore nil
-    # votes) encode lazily per index instead — still template-cached —
-    # so no discarded rows are paid for.
+    # be checked (verify_commit); early-exit variants encode lazily per
+    # index (memoized) so no discarded rows are paid for
     all_sign_bytes = (
         commit.sign_bytes_batch(chain_id) if count_all_signatures else None
     )
-    # vectorized tally (ROADMAP item 1 down-payment): verify_commit's
-    # ignore/count predicates are pure flag tests over data that never
-    # changes during the scan, so the whole per-vote Python tally
-    # (two lambda calls + attribute walk + int add, x10k votes)
-    # collapses to one masked numpy sum over the validator powers.
-    # The scan below then only builds cache keys / batch rows, skipping
-    # absent indexes via one flatnonzero instead of per-vote calls.
-    # Early-exit variants (light/trusting) keep the incremental loop:
-    # their break point IS the reference semantics.
-    indices = None
-    if vector_tally and count_all_signatures and look_up_by_index:
-        # flags is None on an out-of-uint8-range BlockIDFlag (invalid
-        # commit): stay on the scalar loop so the failure surfaces as
-        # the reference InvalidCommitError, not a memo OverflowError
-        flags = commit.block_id_flags_array()
-        if flags is not None:
-            tallied = int(
-                vals.powers_array()[flags == BLOCK_ID_FLAG_COMMIT].sum()
-            )
-            indices = np.flatnonzero(flags != BLOCK_ID_FLAG_ABSENT).tolist()
     signatures = commit.signatures
-    for idx in (indices if indices is not None else range(len(signatures))):
-        commit_sig = signatures[idx]
-        if indices is None and ignore_sig(commit_sig):
+    for idx, commit_sig in enumerate(signatures):
+        if ignore_sig(commit_sig):
             continue
         if look_up_by_index:
             val = vals.validators[idx]
@@ -424,14 +765,13 @@ def _verify_commit_batch_impl(
             )
             if _seen_key(ckey):
                 hits += 1
-                if indices is None:
-                    if count_sig(commit_sig):
-                        tallied += val.voting_power
-                    if (
-                        not count_all_signatures
-                        and tallied > voting_power_needed
-                    ):
-                        break
+                if count_sig(commit_sig):
+                    tallied += val.voting_power
+                if (
+                    not count_all_signatures
+                    and tallied > voting_power_needed
+                ):
+                    break
                 continue
             misses += 1
         key_type = pub_key.type()
@@ -457,16 +797,22 @@ def _verify_commit_batch_impl(
             pending.setdefault(key_type, []).append(
                 (pub_key, vote_sign_bytes, commit_sig.signature, idx, ckey)
             )
-        if indices is None:
-            if count_sig(commit_sig):
-                tallied += val.voting_power
-            if not count_all_signatures and tallied > voting_power_needed:
-                break
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
     if use_cache:
         sigcache.observe(hits, misses)
         trace.add_attrs(sigcache_hits=hits, sigcache_misses=misses)
     if tallied <= voting_power_needed:
         raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+    _drain_pending(commit, pending)
+
+
+def _drain_pending(commit: Commit, pending: dict) -> None:
+    """Drain the per-key-type miss batches, populating the cache for
+    proven triples, and raise the reference error for the LOWEST bad
+    commit index across groups."""
     first_bad: Optional[int] = None
     for items in pending.values():
         bv = create_batch_verifier(items[0][0], size_hint=len(items))

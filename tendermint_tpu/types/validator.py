@@ -37,6 +37,27 @@ def _clip(v: int) -> int:
     return INT64_MAX if v > INT64_MAX else INT64_MIN if v < INT64_MIN else v
 
 
+# Process-wide validator-mutation epoch, the ValidatorSet twin of
+# commit.py's _MUT_EPOCH: every epoch-pinned set memo (powers_array,
+# pubkeys_bytes) is built under the token stored here, and any
+# POST-INIT assignment to a Validator field those memos read
+# (voting_power, pub_key, address) replaces the token, so the memos
+# re-validate lazily on next access. ValidatorSet hands out live
+# Validator references, so in-place `v.voting_power = x` without
+# _reindex() is a SUPPORTED mutation (the scalar verify paths read it
+# live); the epoch hook is what keeps the vectorized tally in lockstep
+# with them — the ADVICE-r5 staleness class, closed by invalidation
+# instead of rebuild-per-call. proposer_priority writes (every proposer
+# rotation) deliberately do not bump: no epoch-pinned memo reads it.
+# tmrace: race-ok — single atomic list-slot store of a fresh token;
+# concurrent bumps each publish a token unequal to every pinned memo,
+# so any interleaving invalidates (the conservative direction)
+_VAL_MUT_EPOCH = [object()]
+
+# the Validator fields the epoch-pinned ValidatorSet memos read
+_EPOCH_FIELDS = frozenset({"voting_power", "pub_key", "address"})
+
+
 @dataclass
 class Validator:
     pub_key: PubKey
@@ -44,9 +65,19 @@ class Validator:
     proposer_priority: int = 0
     address: bytes = b""
 
+    def __setattr__(self, name: str, value) -> None:
+        # a RE-assignment (the attribute already exists — dataclass
+        # __init__ sets each field exactly once on a fresh instance)
+        # of a memo-read field invalidates every epoch-pinned set memo
+        if name in _EPOCH_FIELDS and name in self.__dict__:
+            _VAL_MUT_EPOCH[0] = object()
+        object.__setattr__(self, name, value)
+
     def __post_init__(self) -> None:
         if not self.address and self.pub_key is not None:
-            self.address = self.pub_key.address()
+            # first derivation on a fresh instance, not a mutation of
+            # anything a memo could have read yet: skip the epoch hook
+            object.__setattr__(self, "address", self.pub_key.address())
 
     def copy(self) -> "Validator":
         return replace(self)
@@ -119,6 +150,9 @@ class ValidatorSet:
         self._addr_index: Dict[bytes, int] = {}
         self._hash: Optional[bytes] = None
         self._proto_memo: Optional[tuple] = None
+        self._fp_token: Optional[object] = None
+        self._pkb_memo: Optional[tuple] = None
+        self._powers_memo: Optional[tuple] = None
         valz = [v.copy() for v in validators] if validators else []
         self._update_with_change_set(valz, allow_deletes=False)
         if valz:
@@ -158,17 +192,23 @@ class ValidatorSet:
 
     def powers_array(self):
         """Voting powers as a read-only np.int64 array aligned with
-        self.validators, rebuilt on every call — NOT memoized. This
-        class hands out live Validator references (validators list),
-        so an invalidation-hook memo goes stale on in-place power
-        mutation, the exact class of bug the to_proto memo was rebuilt
-        around (ADVICE r5) — and here staleness would split the
-        vectorized VerifyCommit tally from the scalar paths, which
-        read val.voting_power live. Any validating fingerprint of the
-        powers IS this array, so rebuilding is the fingerprint: one
-        C-level fromiter pass, while the vectorized tally's win (the
-        masked sum replacing a 10k-iteration Python loop,
-        types/validation.py) is untouched."""
+        self.validators, memoized under the process-wide validator-
+        mutation epoch (_VAL_MUT_EPOCH). This class hands out live
+        Validator references, so in-place power mutation without
+        _reindex() is supported and the scalar verify paths see it
+        immediately; a plain memo here would split the vectorized
+        VerifyCommit tally from them (the to_proto ADVICE-r5 staleness
+        class). Validator.__setattr__ replaces the epoch token on any
+        post-init voting_power/pub_key/address write, so the memo
+        re-validates with one `is` comparison on the warm path — the
+        10k-attribute fromiter walk this replaces was the single
+        largest slice of the warm verify_commit scan (PERF.md
+        warm-path breakdown) — and membership changes clear it through
+        _reindex() like every other set memo."""
+        epoch = _VAL_MUT_EPOCH[0]
+        memo = self._powers_memo
+        if memo is not None and memo[0] is epoch:
+            return memo[1]
         import numpy as np
 
         arr = np.fromiter(
@@ -177,7 +217,43 @@ class ValidatorSet:
             count=len(self.validators),
         )
         arr.setflags(write=False)
+        self._powers_memo = (epoch, arr)
         return arr
+
+    def fingerprint_token(self):
+        """Membership-identity token for the commit-level verification
+        memo (types/validation.py): a unique object, replaced by
+        _reindex() — the single choke point every membership mutation
+        path runs through — and never shared with copies (copy() mints
+        its own), so a sigcache commit key holding it can only ever hit
+        for this exact set composition. In-place voting_power mutation
+        does NOT move the token; the commit-memo key covers powers
+        separately with the powers_array() bytes, which the epoch hook
+        keeps live under in-place mutation (the ADVICE-r5 staleness
+        class). An in-place pub_key swap that bypasses
+        update_with_change_set is not covered — the same unsupported
+        mutation that already leaves hash() and _addr_index stale."""
+        if self._fp_token is None:
+            self._fp_token = object()
+        return self._fp_token
+
+    def pubkeys_bytes(self) -> List[bytes]:
+        """Raw pubkey encodings aligned with self.validators, memoized
+        under the validator-mutation epoch and treated read-only by
+        callers — the warm VerifyCommit scan builds 10k cache keys from
+        these and the per-call `v.pub_key.bytes()` walk was a dominant
+        slice of its Python cost (PERF.md warm-path breakdown).
+        Invalidated by _reindex() like hash(), and additionally by the
+        epoch hook on an in-place pub_key re-assignment — a mutation
+        that still leaves _addr_index and hash() stale (unsupported as
+        before), but can no longer serve this memo stale bytes."""
+        epoch = _VAL_MUT_EPOCH[0]
+        memo = self._pkb_memo
+        if memo is not None and memo[0] is epoch:
+            return memo[1]
+        pkb = [v.pub_key.bytes() for v in self.validators]
+        self._pkb_memo = (epoch, pkb)
+        return pkb
 
     def total_voting_power(self) -> int:
         if self._total_voting_power == 0:
@@ -192,6 +268,9 @@ class ValidatorSet:
         new._addr_index = dict(self._addr_index)
         new._hash = self._hash  # same membership -> same merkle root
         new._proto_memo = None
+        new._fp_token = None  # copies diverge independently: own token
+        new._pkb_memo = None
+        new._powers_memo = None
         return new
 
     def _reindex(self) -> None:
@@ -200,6 +279,9 @@ class ValidatorSet:
         }
         self._hash = None  # membership changed; recompute lazily
         self._proto_memo = None
+        self._fp_token = None
+        self._pkb_memo = None
+        self._powers_memo = None
 
     def _update_total_voting_power(self) -> None:
         total = 0

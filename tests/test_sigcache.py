@@ -131,6 +131,60 @@ def test_disabled_scope():
     assert sigcache.enabled()
 
 
+# -- bulk API: one set-intersection replaces the per-triple probes --
+
+
+def test_bulk_probe_hits_and_promotes():
+    keys = [(b"\x01" * 32, b"msg-%d" % i, b"\x02" * 64) for i in range(6)]
+    for k in keys[:3]:
+        sigcache.add_key(k)
+    hits = sigcache.seen_keys_bulk(keys)
+    assert hits == set(keys[:3])
+    # old-generation hits are promoted, like seen_key
+    sigcache.set_capacity(4)
+    sigcache.reset()
+    hot = (b"\x07" * 32, b"hot", b"\x08" * 64)
+    sigcache.add_key(hot)
+    for i in range(20):
+        sigcache.add_key((b"\x01" * 32, b"churn-%d" % i, b"\x02" * 64))
+        assert sigcache.seen_keys_bulk([hot]) == {hot}  # re-promoted
+    assert sigcache.seen_keys_bulk([]) == set()
+
+
+def test_bulk_add_respects_generation_bound():
+    sigcache.set_capacity(100)
+    base = sigcache.stats()["evictions"]
+    for start in range(0, 1000, 250):
+        sigcache.add_keys_bulk(
+            (b"\x01" * 32, b"bulk-%d" % i, b"\x02" * 64)
+            for i in range(start, start + 250)
+        )
+        # the documented bound survives bulk drains bigger than a
+        # whole generation: at most 2 x capacity resident
+        assert sigcache.entries() <= 200
+    assert sigcache.stats()["evictions"] > base
+
+
+def test_commit_memo_gates():
+    key = ("commit-memo", "chain", True, True, 1, object(), object(), b"")
+    sigcache.add_commit(key)
+    assert sigcache.seen_commit(key)
+    with sigcache.commit_memo_disabled():
+        assert not sigcache.commit_memo_enabled()
+        assert not sigcache.seen_commit(key)  # probe disabled
+        sigcache.add_commit(key)  # insert dropped silently
+    assert sigcache.seen_commit(key)
+    with sigcache.disabled():  # the cache-wide gate covers commit keys
+        assert not sigcache.commit_memo_enabled()
+        assert not sigcache.seen_commit(key)
+
+
+def test_commit_memo_env_gate(monkeypatch):
+    monkeypatch.setenv("TM_TPU_NO_COMMIT_MEMO", "1")
+    assert sigcache.enabled()  # triples unaffected
+    assert not sigcache.commit_memo_enabled()
+
+
 # -- safety: failures never cached, errors identical warm/cold/disabled --
 
 

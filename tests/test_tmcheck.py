@@ -641,7 +641,7 @@ def test_cli_taint_and_schema_sections_exit_zero():
 def test_cli_full_run_includes_tmcheck_sections():
     r = _run_cli("--stats")
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "[tmlint+taint+schema+race]" in r.stdout
+    assert "[tmlint+taint+schema+race+memo]" in r.stdout
 
 
 def test_cli_schema_update_refuses_filtered_runs():
@@ -673,3 +673,135 @@ def test_cli_list_rules_includes_tmcheck():
     # the whole catalog from the single source of truth
     for rid, _title in tmcheck.RULES:
         assert rid in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# memo-soundness audit (ISSUE 7: the machine-checked argument for the
+# warm-path memos)
+
+
+def test_memo_audit_clean_on_head(pkg):
+    """Every memoized function is cataloged and taint-clean — the gate
+    form of "the memo is sound by construction". The audit ships with
+    ZERO accepted debt (no baseline file exists)."""
+    v = tmcheck.memo_audit_violations(pkg)
+    assert not v, "memo audit violations:\n" + "\n".join(
+        x.render() for x in v
+    )
+
+
+def test_memo_audit_catalog_covers_warm_path(pkg):
+    """The warm-path memos named in ISSUE 7 are all under audit, and
+    the consensus-class entries really get the taint scan."""
+    report, findings = tmcheck.memoaudit.audit(pkg)
+    assert not findings
+    by_fn = {row["function"]: row for row in report}
+    for fn in (
+        "types/commit.py:Commit.sign_bytes_batch",
+        "types/commit.py:Commit.vote_sign_bytes",
+        "types/commit.py:Commit.block_id_flags_array",
+        "types/validator.py:ValidatorSet.pubkeys_bytes",
+        "types/vote.py:Vote.sign_bytes",
+    ):
+        assert fn in by_fn, fn
+        assert by_fn[fn]["taint"] == "clean"
+    # identity tokens are audited for catalog presence, exempt from
+    # the source scan by declared justification
+    assert by_fn["types/commit.py:Commit.fingerprint_token"][
+        "taint"
+    ].startswith("exempt")
+
+
+def test_memo_audit_run_under_budget(pkg):
+    """Like the other analysis sections: <10 s so the full gate stays
+    cheap for every tier-1 invocation (measured well under 1 s on the
+    shared package build)."""
+    t0 = time.monotonic()
+    tmcheck.memo_audit_violations(pkg)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"memo audit took {elapsed:.1f}s"
+
+
+def test_seeded_taint_in_memoized_function_fails_audit(pkg_copy):
+    """A wall-clock read injected into a helper called from a cataloged
+    memoized function (Commit._sign_template -> VoteSignTemplate's
+    module) must be reported as memo-taint with the witness chain."""
+    from tendermint_tpu.analysis.tmcheck import memoaudit
+
+    ts = pkg_copy / "types" / "timestamp.py"
+    src = ts.read_text()
+    assert "def encode_timestamp" in src
+    src = src.replace(
+        "def encode_timestamp(ns: int) -> bytes:",
+        "def _memo_skew():\n"
+        "    import time\n"
+        "    return time.time()\n\n\n"
+        "def encode_timestamp(ns: int) -> bytes:\n"
+        "    _memo_skew()",
+        1,
+    )
+    ts.write_text(src)
+    p = _analyze_copy(pkg_copy)
+    v = memoaudit.memo_audit_violations(p)
+    assert any(
+        x.rule == "memo-taint" and "time.time" in x.message for x in v
+    ), "\n".join(x.render() for x in v)
+
+
+def test_seeded_uncataloged_memoizer_fails_audit(pkg_copy):
+    """A new function that lazily caches into a memo-named attribute
+    without a CATALOG entry must fail the completeness check."""
+    from tendermint_tpu.analysis.tmcheck import memoaudit
+
+    commit = pkg_copy / "types" / "commit.py"
+    src = commit.read_text()
+    src = src.replace(
+        "    def size(self) -> int:",
+        "    def rogue_cached(self):\n"
+        "        if self._rogue_memo is None:\n"
+        "            self._rogue_memo = 1\n"
+        "        return self._rogue_memo\n\n"
+        "    def size(self) -> int:",
+        1,
+    )
+    commit.write_text(src)
+    p = _analyze_copy(pkg_copy)
+    v = memoaudit.memo_audit_violations(p)
+    assert any(
+        x.rule == "memo-uncataloged" and "rogue_cached" in x.message
+        for x in v
+    ), "\n".join(x.render() for x in v)
+
+
+def test_catalog_rename_detected(pkg):
+    """A cataloged function that no longer exists (rename/move) is a
+    violation — the audit cannot silently shrink."""
+    from tendermint_tpu.analysis.tmcheck import memoaudit
+
+    entry = memoaudit.MemoEntry(
+        "types/commit.py", "Commit.gone_function", "consensus", "test"
+    )
+    memoaudit.CATALOG.append(entry)
+    try:
+        v = tmcheck.memo_audit_violations(pkg)
+    finally:
+        memoaudit.CATALOG.remove(entry)
+    assert any(
+        x.rule == "memo-uncataloged" and "gone_function" in x.message
+        for x in v
+    )
+
+
+def test_cli_memo_audit_prints_listing_and_exits_zero():
+    r = _run_cli("--memo-audit")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "memoized-function audit" in r.stdout
+    assert "Commit.sign_bytes_batch" in r.stdout
+    assert "taint=clean" in r.stdout
+
+
+def test_cli_memo_audit_update_mode_refusals():
+    r = _run_cli("--baseline-update", "--memo-audit")
+    assert r.returncode == 2 and "memo audit has no baseline" in r.stderr
+    r = _run_cli("--schema-update", "--memo-audit")
+    assert r.returncode == 2 and "full-package" in r.stderr

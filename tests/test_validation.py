@@ -162,9 +162,9 @@ class TestVerifyCommit:
             verify_commit(CHAIN_ID, vals, bid, 1, bad)
 
     def test_tally_memo_arrays_are_read_only(self):
-        # block_id_flags_array hands out a live memo; powers_array is
-        # rebuilt per call but stays read-only for a uniform contract:
-        # writes must raise, not silently corrupt a tally.
+        # block_id_flags_array and powers_array both hand out live
+        # memos, read-only for a uniform contract: writes must raise,
+        # not silently corrupt a tally.
         import numpy as np
 
         vals, _bid, commit = make_commit(4)
@@ -181,7 +181,8 @@ class TestVerifyCommit:
         # _reindex. The scalar verify paths read val.voting_power
         # live; powers_array must not serve a stale memo or the
         # vectorized tally diverges from them (same staleness class
-        # as the to_proto ADVICE-r5 fix).
+        # as the to_proto ADVICE-r5 fix — closed by the
+        # Validator.__setattr__ epoch hook invalidating the memo).
         vals, _bid, _commit = make_commit(4)
         before = vals.powers_array().copy()
         vals.validators[0].voting_power += 7

@@ -211,6 +211,10 @@ def test_batched_votes_flow_through_receive_loop():
             assert tpu_verifier.stats()["sigs"] > sigs_before
         finally:
             await cs.stop()
+            # don't leak the installed factory into later test files:
+            # create_batch_verifier would keep routing through the
+            # device seam and break their counting stubs
+            tpu_verifier.uninstall()
 
     asyncio.run(go())
 
