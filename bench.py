@@ -939,6 +939,62 @@ def _native_batch_available() -> bool:
     return _native_batch_fn() is not None
 
 
+def _trace_budget_s() -> float:
+    """The full-sweep budget (seconds): ONE reader for both the sweep
+    itself and the stall-guard stage deadline in main(), so an
+    operator raising it cannot outrun the guard."""
+    import os
+
+    try:
+        return float(
+            os.environ.get("TM_BENCH_TRACE_BUDGET_S", "") or 480.0
+        )
+    except ValueError:
+        return 480.0
+
+
+def bench_trace_all_buckets():
+    """The device-campaign pre-flight cost: tmtrace's FULL eval_shape
+    sweep — every declared jit root × bucket traced abstractly (no
+    backend work, so the number is the same wedged or granted) — plus
+    jit-cache-size stats. Run this (or read the freshest row) before
+    `device_wait` gets a claim so the granted hour starts at
+    compilation, not at a trace error; `scripts/lint.py --trace-full`
+    is the interactive equivalent. TM_BENCH_TRACE_BUDGET_S caps the
+    sweep (default 480 s); whatever the budget cut is listed, never
+    silently dropped."""
+    from tendermint_tpu.analysis import tmtrace
+    from tendermint_tpu.analysis.tmtrace import tracegate
+
+    budget = _trace_budget_s()
+    pkg = tmtrace.build_package()
+    roots = tmtrace.discover(pkg)
+    violations, stats = tracegate.run(roots, full=True, budget_s=budget)
+    slowest = sorted(
+        stats["per_case_ms"].items(), key=lambda kv: -kv[1]
+    )[:5]
+    return {
+        "total_s": stats["total_s"],
+        "cases_traced": stats["traced"],
+        "roots_declared": len(roots),
+        "trace_failures": [v.message[:160] for v in violations[:8]],
+        "skipped_budget": stats["skipped_budget"],
+        "slowest_cases_ms": dict(slowest),
+        "jit_cache": stats["jit_cache"],
+    }
+
+
+def bench_mosaic_probe():
+    """Toolchain capability verdict (ops/toolchain.mosaic_probe):
+    whether jaxpr-level Mosaic-cleanliness checks are decidable under
+    the installed jax — recorded so every BENCH_* line names the
+    capability it was measured under (and why
+    test_mosaic_jaxpr_clean may have skipped)."""
+    from tendermint_tpu.ops.toolchain import mosaic_probe
+
+    return mosaic_probe()
+
+
 def bench_device_rtt():
     import jax
     import jax.numpy as jnp
@@ -1435,6 +1491,25 @@ def main() -> None:
     guard.tick("device_probe_subprocess", probe_timeout + 60.0)
     have_device = _probe_device_subprocess(probe_timeout)
     fallback = not have_device
+
+    # ---- campaign pre-flight: the full trace sweep IS the pre-flight
+    # checklist's cost, and the mosaic probe names the toolchain
+    # capability this line was measured under. Both land in the line
+    # before any in-process device risk. eval_shape is abstract, but
+    # tracing still materializes trace-time constants on the default
+    # backend — so on the fallback path pin this process to CPU FIRST
+    # (the backend is not initialized yet; the probe ran in a
+    # subprocess) or the sweep would hang on the very wedged claim
+    # the subprocess probe just protected us from.
+    if fallback:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    guard.tick("mosaic_probe", 120.0)
+    extra["mosaic_probe"] = attempt(bench_mosaic_probe)
+    # the stage deadline derives from the SAME reader the sweep uses:
+    # an operator raising TM_BENCH_TRACE_BUDGET_S must not outrun the
+    # stall guard and get the line force-emitted mid-sweep
+    guard.tick("trace_all_buckets", _trace_budget_s() + 120.0)
+    extra["trace_all_buckets"] = attempt(bench_trace_all_buckets)
 
     def _canon_cpu(reason="cpu-fallback (device unreachable)"):
         """Fallback: the CPU numbers ARE the run — canonical keys point

@@ -1,10 +1,11 @@
 #!/usr/bin/env python
-"""tmlint + tmcheck + tmrace CLI — the consensus-invariant static
-analyzers.
+"""tmlint + tmcheck + tmrace + tmtrace CLI — the consensus-invariant
+static analyzers.
 
 Usage:
     python scripts/lint.py                    # full gate: tmlint +
-                                              # tmcheck + tmrace
+                                              # tmcheck + tmrace +
+                                              # tmtrace
     python scripts/lint.py --rule det-float   # one tmlint rule class only
     python scripts/lint.py --taint            # tmcheck taint pass only
     python scripts/lint.py --schema           # tmcheck schema gate only
@@ -13,32 +14,46 @@ Usage:
     python scripts/lint.py --memo-audit       # memo-soundness audit
                                               # only (prints the full
                                               # memoized-function list)
+    python scripts/lint.py --trace            # tmtrace device-dispatch
+                                              # proof only (static +
+                                              # fast-tier compile gate)
+    python scripts/lint.py --trace-full       # ... with the FULL
+                                              # root × bucket eval_shape
+                                              # sweep (the device-
+                                              # campaign pre-flight;
+                                              # minutes, not seconds)
     python scripts/lint.py --no-baseline      # every violation, raw
     python scripts/lint.py --baseline-update  # re-accept current state
-                                              # (tmlint, taint AND race
-                                              # baselines)
+                                              # (tmlint, taint, race AND
+                                              # trace baselines)
     python scripts/lint.py --schema-update    # regenerate the golden
                                               # wire-schema table
+    python scripts/lint.py --signatures-update  # regenerate the golden
+                                              # jit-signature table
     python scripts/lint.py --list-rules       # rule catalog
     python scripts/lint.py path/to/file.py    # specific files (tmlint
-                                              # only; tmcheck/tmrace are
+                                              # only; tmcheck/tmrace/
+                                              # tmtrace are
                                               # whole-program)
 
 Exit codes (the contract tests/test_lint.py, tests/test_tmcheck.py,
-tests/test_tmrace.py and CI rely on):
-    0  clean — no violations beyond the checked-in baselines/golden
+tests/test_tmrace.py, tests/test_tmtrace.py and CI rely on):
+    0  clean — no violations beyond the checked-in baselines/goldens
     1  new violations found (or any violation under --no-baseline)
     2  usage or internal error
 
 Baselines: tendermint_tpu/analysis/baseline.json (tmlint),
 tendermint_tpu/analysis/tmcheck/taint_baseline.json (taint),
-tendermint_tpu/analysis/tmrace/race_baseline.json (race), and the
-golden wire schema tendermint_tpu/analysis/tmcheck/schema.json.
---baseline-update / --schema-update refuse filtered runs (a subset
-scan would silently overwrite the whole file).
-docs/static_analysis.md documents the workflow and the suppression
-policy (`# tmlint: disable=<rule>`, `# tmcheck: taint-ok/taint-break`,
-`# tmcheck: unparsed=N/unwritten=N`, `# tmrace: race-ok/guarded-by`).
+tendermint_tpu/analysis/tmrace/race_baseline.json (race),
+tendermint_tpu/analysis/tmtrace/trace_baseline.json (trace), and the
+golden tables tendermint_tpu/analysis/tmcheck/schema.json +
+tendermint_tpu/analysis/tmtrace/jit_signatures.json.
+--baseline-update / --schema-update / --signatures-update refuse
+filtered runs (a subset scan would silently overwrite the whole
+file). docs/static_analysis.md documents the workflow and the
+suppression policy (`# tmlint: disable=<rule>`, `# tmcheck:
+taint-ok/taint-break`, `# tmcheck: unparsed=N/unwritten=N`,
+`# tmrace: race-ok/guarded-by`, `# tmtrace: trace-ok`).
 """
 
 from __future__ import annotations
@@ -50,7 +65,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tendermint_tpu.analysis import tmcheck, tmlint, tmrace  # noqa: E402
+from tendermint_tpu.analysis import tmcheck, tmlint, tmrace, tmtrace  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -98,9 +113,26 @@ def main(argv=None) -> int:
              "memoized-function listing (tmcheck.memoaudit)",
     )
     ap.add_argument(
+        "--trace", action="store_true",
+        help="run only the tmtrace device-dispatch proof (static "
+             "passes + fast-tier compile gate)",
+    )
+    ap.add_argument(
+        "--trace-full", action="store_true", dest="trace_full",
+        help="with the tmtrace section: run the FULL root × bucket "
+             "eval_shape sweep (the device-campaign pre-flight; "
+             "minutes of tracing, not seconds)",
+    )
+    ap.add_argument(
         "--schema-update", action="store_true",
         help="regenerate the golden wire-schema table "
              "(tendermint_tpu/analysis/tmcheck/schema.json)",
+    )
+    ap.add_argument(
+        "--signatures-update", action="store_true",
+        dest="signatures_update",
+        help="regenerate the golden jit-signature table "
+             "(tendermint_tpu/analysis/tmtrace/jit_signatures.json)",
     )
     ap.add_argument(
         "--list-rules", action="store_true",
@@ -120,9 +152,12 @@ def main(argv=None) -> int:
             print(f"{rid}: {title}")
         for rid, title in tmrace.RULES:
             print(f"{rid}: {title}")
+        for rid, title in tmtrace.RULES:
+            print(f"{rid}: {title}")
         return 0
 
     filtered = bool(args.rules or args.paths)
+    trace_selected = args.trace or args.trace_full
     if args.baseline_update and filtered:
         # a filtered scan would overwrite the whole baseline with its
         # subset, silently deleting every other grandfathered entry
@@ -145,34 +180,70 @@ def main(argv=None) -> int:
         )
         return 2
     if args.schema_update and (
-        filtered or args.taint or args.race or args.memo_audit
+        filtered
+        or args.taint
+        or args.race
+        or args.memo_audit
+        or trace_selected
     ):
         # same hazard: the golden table covers EVERY codec module (and
-        # combining with --taint/--race/--memo-audit would silently
-        # skip that gate while returning 0 — the update mode below
-        # disables them)
+        # combining with --taint/--race/--memo-audit/--trace would
+        # silently skip that gate while returning 0 — the update mode
+        # below disables them)
         print(
             "error: --schema-update requires a full-package run "
-            "(drop --rule/--taint/--race/--memo-audit and path "
-            "arguments)",
+            "(drop --rule/--taint/--race/--memo-audit/--trace and "
+            "path arguments)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.signatures_update and (
+        filtered
+        or args.taint
+        or args.schema
+        or args.race
+        or args.memo_audit
+        or trace_selected
+        or args.schema_update
+        or args.baseline_update
+    ):
+        # the golden covers EVERY jit root in the package; a combined
+        # run would silently skip the named gate while returning 0
+        print(
+            "error: --signatures-update requires a full-package run "
+            "(drop --rule/--taint/--schema/--race/--memo-audit/"
+            "--trace/other update modes and path arguments)",
             file=sys.stderr,
         )
         return 2
 
-    sections = args.taint or args.schema or args.race or args.memo_audit
+    sections = (
+        args.taint
+        or args.schema
+        or args.race
+        or args.memo_audit
+        or trace_selected
+    )
     run_tmlint = not sections
-    run_taint = args.taint or not (
-        args.schema or args.race or args.memo_audit or filtered
-    )
-    run_schema = args.schema or not (
-        args.taint or args.race or args.memo_audit or filtered
-    )
-    run_race = args.race or not (
-        args.taint or args.schema or args.memo_audit or filtered
-    )
-    run_memo = args.memo_audit or not (
-        args.taint or args.schema or args.race or filtered
-    )
+    others = {
+        "taint": args.taint,
+        "schema": args.schema,
+        "race": args.race,
+        "memo": args.memo_audit,
+        "trace": trace_selected,
+    }
+
+    def _only(section: str) -> bool:
+        return others[section] or not (
+            any(on for name, on in others.items() if name != section)
+            or filtered
+        )
+
+    run_taint = _only("taint")
+    run_schema = _only("schema")
+    run_race = _only("race")
+    run_memo = _only("memo")
+    run_trace = _only("trace")
     # update modes run ONLY the sections they update: computing (then
     # discarding) the other gates' violations would both waste ~2 s
     # and return 0 past a red gate the operator never saw
@@ -184,6 +255,14 @@ def main(argv=None) -> int:
         run_taint = False
         run_race = False
         run_memo = False
+        run_trace = False
+    if args.signatures_update:
+        run_tmlint = False
+        run_taint = False
+        run_schema = False
+        run_race = False
+        run_memo = False
+        run_trace = False
 
     t0 = time.monotonic()
     violations = []
@@ -281,6 +360,67 @@ def main(argv=None) -> int:
                 # memoized function, its inputs, and its audit outcome
                 print(tmcheck.memoaudit.render_report(report))
 
+        if run_trace:
+            trace_pkg = pkg or tmcheck.build_package()
+            pkg = trace_pkg
+            # one analyze() pass serves report, baseline diff AND
+            # baseline update (same single-pass rule as tmrace)
+            trace_report = tmtrace.analyze(
+                trace_pkg, full=args.trace_full
+            )
+            trace_v = trace_report.violations
+            violations.extend(trace_v)
+            if args.stats and trace_report.stats.get("tier"):
+                st = trace_report.stats
+                print(
+                    f"-- tmtrace live tier={st.get('tier')}: "
+                    f"{st.get('traced')} cases in "
+                    f"{st.get('total_s')}s, skipped_heavy="
+                    f"{len(st.get('skipped_heavy', []))}, "
+                    f"jit_cache={st.get('jit_cache')} --"
+                )
+            # golden-gated rules (signature drift / unknown root /
+            # compile failure) can NEVER be absorbed by the counted
+            # baseline — their accepted state is jit_signatures.json
+            trace_base, trace_gated = tmtrace.split_baselineable(trace_v)
+            if args.baseline_update:
+                counts = tmlint.save_baseline(
+                    trace_base,
+                    tmtrace.TRACE_BASELINE_PATH,
+                    note=tmtrace.TRACE_BASELINE_NOTE,
+                )
+                print(
+                    f"trace baseline updated: {len(counts)} fingerprints "
+                    f"-> {tmtrace.TRACE_BASELINE_PATH}"
+                )
+                if trace_gated:
+                    print(
+                        f"note: {len(trace_gated)} golden-gated tmtrace "
+                        "finding(s) were NOT baselined (fix them or run "
+                        "--signatures-update):",
+                        file=sys.stderr,
+                    )
+                    new.extend(trace_gated)
+            elif args.no_baseline:
+                new.extend(trace_v)
+            else:
+                new.extend(
+                    tmlint.new_violations(
+                        trace_base,
+                        tmlint.load_baseline(tmtrace.TRACE_BASELINE_PATH),
+                    )
+                )
+                new.extend(trace_gated)
+
+        if args.signatures_update:
+            sig_pkg = pkg or tmcheck.build_package()
+            pkg = sig_pkg
+            data = tmtrace.update_signatures_golden(sig_pkg)
+            print(
+                f"golden jit signatures updated: "
+                f"{len(data['roots'])} roots -> {tmtrace.GOLDEN_PATH}"
+            )
+
         if args.schema_update:
             data = tmcheck.update_schema_golden()
             print(
@@ -298,8 +438,13 @@ def main(argv=None) -> int:
         return 2
     elapsed = time.monotonic() - t0
 
-    if args.baseline_update or args.schema_update:
-        return 0
+    if args.baseline_update or args.schema_update or args.signatures_update:
+        # `new` is non-empty here only for golden-gated tmtrace
+        # findings an update mode refused to absorb: surface them and
+        # fail so the operator can't mistake the update for acceptance
+        for v in new:
+            print(v.render())
+        return 1 if new else 0
 
     for v in new:
         print(v.render())
@@ -316,6 +461,7 @@ def main(argv=None) -> int:
                 ("schema", run_schema),
                 ("race", run_race),
                 ("memo", run_memo),
+                ("trace", run_trace),
             )
             if on
         ]
@@ -331,9 +477,9 @@ def main(argv=None) -> int:
             f"\n{len(new)} new violation(s). Fix them, add a justified "
             "suppression/annotation (# tmlint: disable=..., # tmcheck: "
             "taint-ok/taint-break/unparsed=N, # tmrace: "
-            "race-ok/guarded-by=...), or for consciously accepted "
-            "changes run scripts/lint.py --baseline-update / "
-            "--schema-update.",
+            "race-ok/guarded-by=..., # tmtrace: trace-ok), or for "
+            "consciously accepted changes run scripts/lint.py "
+            "--baseline-update / --schema-update / --signatures-update.",
             file=sys.stderr,
         )
         return 1

@@ -1,4 +1,4 @@
-"""Device-hygiene rules for the JAX hot path.
+"""Device-hygiene node engines for the JAX hot path.
 
 The throughput story (PERF.md) depends on two properties of the
 dispatch path: the host never *implicitly* blocks on the device (the
@@ -6,8 +6,19 @@ gather is the one deliberate sync point, guarded by a deadline
 watchdog), and program shapes stay inside the padded bucket set so
 XLA never recompiles mid-round. Both properties die silently — an
 `.item()` in a loop or a Python-int shape argument works fine and
-just makes the hot path 100x slower — so they're lint rules, not
+just makes the hot path 100x slower — so they're machine checks, not
 review notes.
+
+Since PR 8 these rules are NOT registered with tmlint: tmtrace's
+whole-program pass (analysis/tmtrace/shapeflow.py) owns them — same
+rule ids, same `# tmlint: disable=` suppressions honored, but
+evaluated interprocedurally over the widened device scope (ops/
+included, bucket-provenance dataflow for shapes, ARRAY taint for the
+traced region) so one site is never reported by two tools. The
+DevHostSync class stays here as the shared node-level engine
+(shapeflow evaluates it over the legacy dispatch scope); the old
+DevShapeLeak node check is fully superseded by shapeflow's
+bucket-provenance dataflow and was removed.
 """
 
 from __future__ import annotations
@@ -15,7 +26,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from .tmlint import Module, Rule, Violation, dotted_name, is_device_scope, register
+from .tmlint import Module, Rule, Violation, dotted_name, is_device_scope
 
 _NP_TRANSFER = {
     "np.asarray",
@@ -40,24 +51,6 @@ _JNP_SHAPED_CTORS = {
 }
 
 
-def _is_static_shape(node: ast.AST) -> bool:
-    """Shape arguments that cannot leak a per-call Python scalar:
-    constants, tuples/lists of constants, attribute reads (self.BUCKET,
-    cls.SIZE) and SCREAMING_CASE names — configuration, not data."""
-    if isinstance(node, ast.Constant):
-        return True
-    if isinstance(node, (ast.Tuple, ast.List)):
-        return all(_is_static_shape(e) for e in node.elts)
-    if isinstance(node, ast.Attribute):
-        return True
-    if isinstance(node, ast.Name):
-        return node.id == node.id.upper()
-    if isinstance(node, ast.UnaryOp):
-        return _is_static_shape(node.operand)
-    return False
-
-
-@register
 class DevHostSync(Rule):
     id = "dev-host-sync"
     title = "implicit device→host sync on the JAX hot path"
@@ -110,40 +103,3 @@ class DevHostSync(Rule):
                     "synchronizes if handed a device array; use jnp ops "
                     "or move the conversion to the gather",
                 )
-
-
-@register
-class DevShapeLeak(Rule):
-    id = "dev-shape-leak"
-    title = "dynamic Python shape argument forces XLA recompiles"
-    rationale = (
-        "jnp.zeros(n)/arange(n) with a per-call Python int compiles "
-        "one XLA program per distinct n — a mid-round recompile costs "
-        "more than the whole batch saves. Shapes must come from the "
-        "padded bucket configuration (constants / class attributes), "
-        "never from data-dependent scalars like len(batch)."
-    )
-
-    def applies(self, mod: Module) -> bool:
-        return is_device_scope(mod.path)
-
-    def check(self, mod: Module) -> Iterator[Violation]:
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            name = dotted_name(node.func)
-            if name not in _JNP_SHAPED_CTORS:
-                continue
-            if not node.args:
-                continue
-            shape = node.args[0]
-            if _is_static_shape(shape):
-                continue
-            yield self.violation(
-                mod,
-                node,
-                f"`{name}` called with a dynamic shape argument "
-                f"(`{ast.unparse(shape)}`); every distinct value "
-                "compiles a new XLA program — pad to a configured "
-                "bucket size instead",
-            )

@@ -1,0 +1,26 @@
+"""Seeded use-after-donate: `run` reads the buffer it donated to the
+jit program; `run_clean` donates and never touches it again."""
+
+import jax
+
+
+def f(x):
+    return x + 1
+
+
+def g(x):
+    return x * 2
+
+
+_step = jax.jit(f, donate_argnums=(0,))
+_step_clean = jax.jit(g, donate_argnums=(0,))
+
+
+def run(buf):
+    out = _step(buf)
+    return out + buf  # flagged: buf's buffer was donated
+
+
+def run_clean(buf):
+    out = _step_clean(buf)
+    return out + 1

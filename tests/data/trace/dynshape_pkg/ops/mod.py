@@ -1,0 +1,35 @@
+"""Seeded dynamic shape: `prep` hands a len()-derived dimension to a
+jnp constructor (one XLA program per distinct batch size); the twin
+launders it through the bucket table and must pass."""
+
+import jax
+import jax.numpy as jnp
+
+BUCKETS = (8, 32, 128)
+
+
+def bucket_for(n, sizes):
+    for s in sizes:
+        if s >= n:
+            return s
+    return n
+
+
+def prep(batch):
+    n = len(batch)
+    return jnp.zeros((32, n), dtype=jnp.int32)  # dynamic: flagged
+
+
+def prep_clean(batch):
+    b = bucket_for(len(batch), BUCKETS)
+    pad = jnp.zeros((32, b), dtype=jnp.int32)  # bucket-derived: fine
+    rows, cols = pad.shape
+    tail = jnp.zeros((rows, cols), dtype=jnp.int32)  # shape-derived
+    return pad + tail
+
+
+def body(x):
+    return x * 2
+
+
+_JIT = jax.jit(body)

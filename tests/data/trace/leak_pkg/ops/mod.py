@@ -1,0 +1,31 @@
+"""Seeded tracer leaks: `tile` branches on a traced value and leaks
+`float()` through an interprocedural call; `tile_clean` does the same
+math with jnp.where / shape reads and must pass."""
+
+import jax
+import jax.numpy as jnp
+
+
+def helper(v):
+    return float(v)  # leak: concretizes a traced value
+
+
+def tile(x, y):
+    if x.sum() > 0:  # leak: Python branch on a traced value
+        return x + y
+    return x + helper(y)
+
+
+def tile_clean(x, y, dual_fn=None):
+    n = x.shape[1]  # shape reads are trace-static
+    if n > 8:  # static branch: fine
+        y = y * 2
+    if dual_fn is None:  # None-check on a config param: fine
+        z = jnp.where(x > 0, x + y, x - y)  # device select: fine
+    else:
+        z = dual_fn(x, y)
+    return z
+
+
+_JIT = jax.jit(tile)
+_JIT_CLEAN = jax.jit(tile_clean)

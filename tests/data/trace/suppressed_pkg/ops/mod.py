@@ -1,0 +1,29 @@
+"""The seeded leak/shape sites from leak_pkg/dynshape_pkg, silenced
+by every suppression form tmtrace honors: inline trace-ok, rule-named
+trace-ok, comment-block-above, and the legacy tmlint disable for a
+migrated rule. tmtrace must report NOTHING here."""
+
+import jax
+import jax.numpy as jnp
+
+
+def helper(v):
+    return float(v)  # tmtrace: trace-ok — fixture: host-side scalar by contract
+
+
+def tile(x, y):
+    # tmtrace: trace-ok=trace-tracer-leak — fixture: the justification
+    # comment block above the offending line also covers it
+    if x.sum() > 0:
+        return x + y
+    return x + helper(y)
+
+
+def prep(batch):
+    n = len(batch)
+    # tmlint: disable=dev-shape-leak — fixture: legacy form for a
+    # migrated rule must keep working
+    return jnp.zeros((32, n), dtype=jnp.int32)
+
+
+_JIT = jax.jit(tile)

@@ -86,10 +86,8 @@ _CASES = [
      "crypto/fixture.py"),
     ("lock-global-mutation", "lock_global_mutation_bad.py",
      "lock_global_mutation_clean.py", "crypto/fixture.py"),
-    ("dev-host-sync", "dev_host_sync_bad.py", "dev_host_sync_clean.py",
-     "parallel/fixture.py"),
-    ("dev-shape-leak", "dev_shape_leak_bad.py", "dev_shape_leak_clean.py",
-     "crypto/batch.py"),
+    # dev-host-sync / dev-shape-leak migrated to tmtrace (PR 8):
+    # their fixture-corpus tests live in tests/test_tmtrace.py now
 ]
 
 
@@ -124,11 +122,14 @@ def test_determinism_rules_scoped_to_consensus_critical(rule, bad, path):
                                rules=[rule]) == []
 
 
-def test_device_rules_scoped_to_device_modules():
-    assert tmlint.check_source(
-        fixture_src("dev_host_sync_bad.py"), "state/fixture.py",
-        rules=["dev-host-sync"],
-    ) == []
+def test_device_rules_no_longer_registered():
+    """dev-host-sync / dev-shape-leak moved to tmtrace (PR 8) so one
+    site is never double-reported; tmlint must not know the ids."""
+    assert "dev-host-sync" not in tmlint.rule_ids()
+    assert "dev-shape-leak" not in tmlint.rule_ids()
+    with pytest.raises(ValueError):
+        tmlint.check_source("x = 1\n", "parallel/f.py",
+                            rules=["dev-host-sync"])
 
 
 def test_lock_rules_scoped_to_threading_importers():

@@ -73,50 +73,54 @@ def test_mosaic_jaxpr_clean():
     """The mosaic-path bodies must stay free of primitives Mosaic
     cannot lower (scatter, gather, dynamic_slice, rev, rank-1 iota) —
     each was found the hard way on hardware (PERF.md). Guards the
-    kernels' lowerability without needing a TPU in CI."""
-    import jax
+    kernels' lowerability without needing a TPU in CI.
 
+    Capability-gated per primitive: some jax versions (0.4.37:
+    zero-width-ellipsis static slices lower to `gather`) introduce a
+    banned primitive for constructs that are *semantically* clean, so
+    that primitive is undecidable at the jaxpr level there —
+    `toolchain.mosaic_probe()` names exactly which
+    (bench.py records the verdict in every BENCH_* line, and the AOT
+    check on real hardware remains its ground truth). Coverage for
+    every NON-laundered primitive (scatter, rev, dynamic_slice,
+    rank-1 iota…) is kept: a kernel edit introducing one of those
+    still fails here, on every toolchain. Only a toolchain that
+    launders everything would skip outright."""
     from tendermint_tpu.ops import field25519 as F
+    from tendermint_tpu.ops import toolchain
 
-    banned = {
-        "scatter", "scatter-add", "gather", "dynamic_slice",
-        "dynamic_update_slice", "rev",
-    }
-
-    def check(fn, *avals):
-        seen = set()
-
-        def walk(jaxpr):
-            for eq in jaxpr.eqns:
-                name = eq.primitive.name
-                if name in banned:
-                    seen.add(name)
-                if name == "iota" and len(eq.outvars[0].aval.shape) == 1:
-                    seen.add("iota(rank-1)")
-                for p in eq.params.values():
-                    if hasattr(p, "jaxpr"):
-                        walk(p.jaxpr)
-                    elif isinstance(p, (list, tuple)):
-                        for q in p:
-                            if hasattr(q, "jaxpr"):
-                                walk(q.jaxpr)
-
-        walk(jax.make_jaxpr(fn)(*avals).jaxpr)
-        return seen
+    probe = toolchain.mosaic_probe()
+    laundered = set()
+    for prims in probe["introduced"].values():
+        laundered.update(prims)
+    decidable = (set(toolchain.BANNED) | {"iota(rank-1)"}) - laundered
+    if not decidable:  # pragma: no cover - no known toolchain does this
+        pytest.skip(
+            "toolchain lowers known-clean constructs to EVERY banned "
+            f"primitive (jax {probe['jax_version']}: "
+            f"{probe['introduced']}); jaxpr-level cleanliness is "
+            "undecidable here — AOT check on hardware is the gate"
+        )
 
     i32 = jnp.int32
     s32 = jax.ShapeDtypeStruct((32, TILE), i32)
     s64 = jax.ShapeDtypeStruct((64, TILE), i32)
     pt = jax.ShapeDtypeStruct((4, F.NLIMBS, TILE), i32)
-    bad = check(
+    bad = toolchain.banned_prims_of(
         lambda a, b, c: K._verify_tile(a, b, c, mosaic=True), s32, s64, s64
+    ) - laundered
+    assert not bad, (
+        f"monolithic tile body uses {bad} "
+        f"(toolchain-laundered and excluded: {sorted(laundered)})"
     )
-    assert not bad, f"monolithic tile body uses {bad}"
-    bad = check(
+    bad = toolchain.banned_prims_of(
         lambda a, b, c: K.dual_mult_sb_minus_ka(a, b, c, mosaic=True),
         pt, s64, s64,
+    ) - laundered
+    assert not bad, (
+        f"dual-mult body uses {bad} "
+        f"(toolchain-laundered and excluded: {sorted(laundered)})"
     )
-    assert not bad, f"dual-mult body uses {bad}"
 
 
 def test_sr25519_hybrid_matches_xla_program():
