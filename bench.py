@@ -513,6 +513,33 @@ def bench_breaker_probe_overhead(reps: int = 20_000):
     }
 
 
+def bench_tmlive_gate():
+    """Full tmlive liveness/boundedness gate (scripts/lint.py --live):
+    wall time plus per-rule finding and suppression counts, recorded
+    in every BENCH_* line so a gate-runtime regression (or a finding
+    slipping into the serving path) shows up next to the numbers it
+    guards. Pure stdlib AST over the package — it must NEVER
+    initialize the jax backend, which is why it lives in the banked
+    CPU block before the device probe (pinned by
+    tests/test_bench_guard.py)."""
+    from tendermint_tpu.analysis import tmlive
+
+    t0 = time.perf_counter()
+    rep = tmlive.analyze()
+    wall = time.perf_counter() - t0
+    per_rule: dict = {rid: 0 for rid, _ in tmlive.RULES}
+    for v in rep.violations:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+    return {
+        "wall_s": round(wall, 2),
+        "findings": per_rule,
+        "suppressed": rep.stats.get("suppressed", 0),
+        "sites_unbounded": rep.stats.get("sites_unbounded", 0),
+        "containers_growing": rep.stats.get("containers_growing", 0),
+        "containers_bounded": rep.stats.get("containers_bounded", 0),
+    }
+
+
 def _build_light_chain(chain_id: str, n_heights: int, n_vals: int):
     """A verifiable chain of LightBlocks 1..n_heights with a static
     n_vals validator set (the BASELINE config-4 shape)."""
@@ -1433,6 +1460,12 @@ def main() -> None:
         "breaker_overhead",
         bench_breaker_probe_overhead,
         "breaker_probe_overhead",
+    )
+    cpu_stage(
+        "tmlive_gate",
+        bench_tmlive_gate,
+        "tmlive_gate",
+        120.0,
     )
     cpu_stage(
         "mempool",

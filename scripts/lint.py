@@ -1,16 +1,18 @@
 #!/usr/bin/env python
-"""tmlint + tmcheck + tmrace + tmtrace CLI — the consensus-invariant
-static analyzers.
+"""tmlint + tmcheck + tmrace + tmtrace + tmlive CLI — the
+consensus-invariant static analyzers.
 
 Usage:
     python scripts/lint.py                    # full gate: tmlint +
                                               # tmcheck + tmrace +
-                                              # tmtrace
+                                              # tmtrace + tmlive
     python scripts/lint.py --rule det-float   # one tmlint rule class only
     python scripts/lint.py --taint            # tmcheck taint pass only
     python scripts/lint.py --schema           # tmcheck schema gate only
     python scripts/lint.py --race             # tmrace data-race +
                                               # lock-order pass only
+    python scripts/lint.py --live             # tmlive liveness +
+                                              # boundedness pass only
     python scripts/lint.py --memo-audit       # memo-soundness audit
                                               # only (prints the full
                                               # memoized-function list)
@@ -24,8 +26,8 @@ Usage:
                                               # minutes, not seconds)
     python scripts/lint.py --no-baseline      # every violation, raw
     python scripts/lint.py --baseline-update  # re-accept current state
-                                              # (tmlint, taint, race AND
-                                              # trace baselines)
+                                              # (tmlint, taint, race,
+                                              # trace AND live baselines)
     python scripts/lint.py --schema-update    # regenerate the golden
                                               # wire-schema table
     python scripts/lint.py --signatures-update  # regenerate the golden
@@ -45,7 +47,8 @@ tests/test_tmrace.py, tests/test_tmtrace.py and CI rely on):
 Baselines: tendermint_tpu/analysis/baseline.json (tmlint),
 tendermint_tpu/analysis/tmcheck/taint_baseline.json (taint),
 tendermint_tpu/analysis/tmrace/race_baseline.json (race),
-tendermint_tpu/analysis/tmtrace/trace_baseline.json (trace), and the
+tendermint_tpu/analysis/tmtrace/trace_baseline.json (trace),
+tendermint_tpu/analysis/tmlive/live_baseline.json (live), and the
 golden tables tendermint_tpu/analysis/tmcheck/schema.json +
 tendermint_tpu/analysis/tmtrace/jit_signatures.json.
 --baseline-update / --schema-update / --signatures-update refuse
@@ -53,7 +56,8 @@ filtered runs (a subset scan would silently overwrite the whole
 file). docs/static_analysis.md documents the workflow and the
 suppression policy (`# tmlint: disable=<rule>`, `# tmcheck:
 taint-ok/taint-break`, `# tmcheck: unparsed=N/unwritten=N`,
-`# tmrace: race-ok/guarded-by`, `# tmtrace: trace-ok`).
+`# tmrace: race-ok/guarded-by`, `# tmtrace: trace-ok`,
+`# tmlive: block-ok/grow-ok/bounded=`).
 """
 
 from __future__ import annotations
@@ -65,7 +69,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tendermint_tpu.analysis import tmcheck, tmlint, tmrace, tmtrace  # noqa: E402
+from tendermint_tpu.analysis import (  # noqa: E402
+    tmcheck,
+    tmlint,
+    tmlive,
+    tmrace,
+    tmtrace,
+)
 
 
 def main(argv=None) -> int:
@@ -106,6 +116,10 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--race", action="store_true",
         help="run only the tmrace data-race + lock-order pass",
+    )
+    ap.add_argument(
+        "--live", action="store_true",
+        help="run only the tmlive liveness + boundedness pass",
     )
     ap.add_argument(
         "--memo-audit", action="store_true", dest="memo_audit",
@@ -154,6 +168,8 @@ def main(argv=None) -> int:
             print(f"{rid}: {title}")
         for rid, title in tmtrace.RULES:
             print(f"{rid}: {title}")
+        for rid, title in tmlive.RULES:
+            print(f"{rid}: {title}")
         return 0
 
     filtered = bool(args.rules or args.paths)
@@ -183,17 +199,18 @@ def main(argv=None) -> int:
         filtered
         or args.taint
         or args.race
+        or args.live
         or args.memo_audit
         or trace_selected
     ):
         # same hazard: the golden table covers EVERY codec module (and
-        # combining with --taint/--race/--memo-audit/--trace would
-        # silently skip that gate while returning 0 — the update mode
-        # below disables them)
+        # combining with --taint/--race/--live/--memo-audit/--trace
+        # would silently skip that gate while returning 0 — the update
+        # mode below disables them)
         print(
             "error: --schema-update requires a full-package run "
-            "(drop --rule/--taint/--race/--memo-audit/--trace and "
-            "path arguments)",
+            "(drop --rule/--taint/--race/--live/--memo-audit/--trace "
+            "and path arguments)",
             file=sys.stderr,
         )
         return 2
@@ -202,6 +219,7 @@ def main(argv=None) -> int:
         or args.taint
         or args.schema
         or args.race
+        or args.live
         or args.memo_audit
         or trace_selected
         or args.schema_update
@@ -211,7 +229,7 @@ def main(argv=None) -> int:
         # run would silently skip the named gate while returning 0
         print(
             "error: --signatures-update requires a full-package run "
-            "(drop --rule/--taint/--schema/--race/--memo-audit/"
+            "(drop --rule/--taint/--schema/--race/--live/--memo-audit/"
             "--trace/other update modes and path arguments)",
             file=sys.stderr,
         )
@@ -221,6 +239,7 @@ def main(argv=None) -> int:
         args.taint
         or args.schema
         or args.race
+        or args.live
         or args.memo_audit
         or trace_selected
     )
@@ -229,6 +248,7 @@ def main(argv=None) -> int:
         "taint": args.taint,
         "schema": args.schema,
         "race": args.race,
+        "live": args.live,
         "memo": args.memo_audit,
         "trace": trace_selected,
     }
@@ -242,6 +262,7 @@ def main(argv=None) -> int:
     run_taint = _only("taint")
     run_schema = _only("schema")
     run_race = _only("race")
+    run_live = _only("live")
     run_memo = _only("memo")
     run_trace = _only("trace")
     # update modes run ONLY the sections they update: computing (then
@@ -254,6 +275,7 @@ def main(argv=None) -> int:
         run_tmlint = False
         run_taint = False
         run_race = False
+        run_live = False
         run_memo = False
         run_trace = False
     if args.signatures_update:
@@ -261,6 +283,7 @@ def main(argv=None) -> int:
         run_taint = False
         run_schema = False
         run_race = False
+        run_live = False
         run_memo = False
         run_trace = False
 
@@ -342,6 +365,33 @@ def main(argv=None) -> int:
                     tmlint.new_violations(
                         race_v,
                         tmlint.load_baseline(tmrace.RACE_BASELINE_PATH),
+                    )
+                )
+
+        if run_live:
+            # same single-pass rule as tmrace: one analyze() serves
+            # report, baseline diff AND baseline update
+            live_pkg = pkg or tmcheck.build_package()
+            pkg = live_pkg
+            live_v = tmlive.live_violations(live_pkg)
+            violations.extend(live_v)
+            if args.baseline_update:
+                counts = tmlint.save_baseline(
+                    live_v,
+                    tmlive.LIVE_BASELINE_PATH,
+                    note=tmlive.LIVE_BASELINE_NOTE,
+                )
+                print(
+                    f"live baseline updated: {len(counts)} fingerprints "
+                    f"-> {tmlive.LIVE_BASELINE_PATH}"
+                )
+            elif args.no_baseline:
+                new.extend(live_v)
+            else:
+                new.extend(
+                    tmlint.new_violations(
+                        live_v,
+                        tmlint.load_baseline(tmlive.LIVE_BASELINE_PATH),
                     )
                 )
 
@@ -460,6 +510,7 @@ def main(argv=None) -> int:
                 ("taint", run_taint),
                 ("schema", run_schema),
                 ("race", run_race),
+                ("live", run_live),
                 ("memo", run_memo),
                 ("trace", run_trace),
             )
@@ -477,7 +528,8 @@ def main(argv=None) -> int:
             f"\n{len(new)} new violation(s). Fix them, add a justified "
             "suppression/annotation (# tmlint: disable=..., # tmcheck: "
             "taint-ok/taint-break/unparsed=N, # tmrace: "
-            "race-ok/guarded-by=..., # tmtrace: trace-ok), or for "
+            "race-ok/guarded-by=..., # tmtrace: trace-ok, "
+            "# tmlive: block-ok/grow-ok/bounded=...), or for "
             "consciously accepted changes run scripts/lint.py "
             "--baseline-update / --schema-update / --signatures-update.",
             file=sys.stderr,

@@ -48,6 +48,8 @@ __all__ = [
     "LockWatch",
     "Report",
     "RANK",
+    "HOLD_LOG",
+    "HOLD_LOG_CAP",
     "enable",
     "disable",
     "active",
@@ -56,6 +58,21 @@ __all__ = [
 ]
 
 DEFAULT_HOLD_BUDGET_S = 0.25
+
+# Process-wide structured record of every hold-budget overrun ever
+# witnessed (across watch windows): the runtime half of tmlive's
+# block-under-lock cross-check — tests/test_tmlive.py asserts every
+# entry here is statically explained (a flagged/suppressed blocking
+# site under that lock, or holdflow.OVERRUN_OK scheduler-noise
+# rationale). Bounded at HOLD_LOG_CAP; overflow increments
+# HOLD_LOG_DROPPED instead of growing (the cross-check needs lock
+# NAMES, which repeat, not an unbounded event stream).
+HOLD_LOG: List[dict] = []
+HOLD_LOG_CAP = 256
+HOLD_LOG_DROPPED = 0
+# guards HOLD_LOG/HOLD_LOG_DROPPED: the log is cross-window global, so
+# a per-watch lock would not serialize two concurrent watches
+_hold_log_lock = threading.Lock()
 
 
 def _hold_budget() -> float:
@@ -236,7 +253,7 @@ class LockWatch:
                         "where": where,
                         "thread": threading.current_thread().name,
                     }
-        st.append([name, time.monotonic()])
+        st.append([name, time.monotonic(), where])
 
     def on_released(self, name: str) -> None:
         st = self._stack()
@@ -244,18 +261,31 @@ class LockWatch:
         # middle): pop the most recent entry with this name
         for i in range(len(st) - 1, -1, -1):
             if st[i][0] == name:
-                _, t0 = st.pop(i)
+                _, t0, where = st.pop(i)
                 held = time.monotonic() - t0
                 if held > self.hold_budget_s:
+                    record = {
+                        "name": name,
+                        "where": where,
+                        "held_s": held,
+                        "budget_s": self.hold_budget_s,
+                        "thread": threading.current_thread().name,
+                    }
                     with self._mu:
-                        self._long_holds.append(
-                            {
-                                "name": name,
-                                "held_s": held,
-                                "budget_s": self.hold_budget_s,
-                                "thread": threading.current_thread().name,
-                            }
-                        )
+                        self._long_holds.append(record)
+                    # process-global structured record for the tmlive
+                    # cross-check (bounded; separate lock, never
+                    # nested inside _mu). Only the process-ACTIVE
+                    # watch feeds it: standalone unit-test watches
+                    # with synthetic lock names must not demand
+                    # OVERRUN_OK entries
+                    if _ACTIVE is self:
+                        global HOLD_LOG_DROPPED
+                        with _hold_log_lock:
+                            if len(HOLD_LOG) < HOLD_LOG_CAP:
+                                HOLD_LOG.append(record)
+                            else:
+                                HOLD_LOG_DROPPED += 1
                 return
 
     def report(self) -> Report:

@@ -44,6 +44,7 @@ __all__ = [
     "Violation",
     "Module",
     "Rule",
+    "comment_cover_lines",
     "all_rules",
     "rule_ids",
     "check_source",
@@ -127,6 +128,26 @@ class Violation:
 _SUPPRESS_RE = re.compile(r"#\s*tmlint:\s*disable=([A-Za-z0-9_\-, ]+)")
 
 
+def comment_cover_lines(lines, i: int, text: str):
+    """Line numbers an annotation at 1-based line `i` covers: itself,
+    plus — when it sits inside a comment block — the first code line
+    below the block. This is the comment-block-above suppression
+    convention shared by EVERY analyzer in the family
+    (tmlint/tmcheck/tmrace/tmtrace/tmlive); one implementation so they
+    can never drift on what a suppression comment reaches."""
+    out = [i]
+    if text.lstrip().startswith("#"):
+        j = i + 1
+        while j <= len(lines) and (
+            not lines[j - 1].strip()
+            or lines[j - 1].lstrip().startswith("#")
+        ):
+            j += 1
+        if j <= len(lines):
+            out.append(j)
+    return out
+
+
 class Module:
     """One parsed source file plus the per-module indexes every rule
     needs: source lines, suppression map, parent links, and the
@@ -162,19 +183,11 @@ class Module:
             if not m:
                 continue
             rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-            self.suppressed.setdefault(i, set()).update(rules)
             # a suppression inside a comment block also covers the
             # first code line below it — justification comments are
             # encouraged to span several lines
-            if text.lstrip().startswith("#"):
-                j = i + 1
-                while j <= len(self.lines) and (
-                    not self.lines[j - 1].strip()
-                    or self.lines[j - 1].lstrip().startswith("#")
-                ):
-                    j += 1
-                if j <= len(self.lines):
-                    self.suppressed.setdefault(j, set()).update(rules)
+            for ln in comment_cover_lines(self.lines, i, text):
+                self.suppressed.setdefault(ln, set()).update(rules)
 
     @property
     def imports_threading(self) -> bool:

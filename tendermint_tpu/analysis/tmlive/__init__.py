@@ -1,0 +1,361 @@
+"""tmlive — whole-program static liveness & boundedness proof for the
+serving path.
+
+ROADMAP's north star is a node serving heavy traffic from millions of
+users. The two failure modes that actually kill such a node under load
+— a *stall* (a blocking call on the event loop or under a hot lock)
+and an *OOM* (a shared container that only grows) — were guarded only
+by runtime sampling (lockwatch's 0.25 s hold budget sees executed
+paths) and by convention. tmlive turns both into machine-checked
+tier-1 gates over the PR-5 call graph and PR-6 thread roots:
+
+1. **Blocking catalog + reachability** (`blockcat.py`): a reviewed
+   catalog of blocking primitives (socket verbs, fsync/flush,
+   subprocess, `time.sleep`, `Lock.acquire`/`Queue.get`/`Event.wait`/
+   `join` with and without timeouts, device sync points), each call
+   site classified bounded/unbounded through the same from-import/
+   alias machinery tmcheck uses — `from time import sleep as nap`
+   cannot evade it.
+2. **`live-block-under-lock`** (`holdflow.py`): tmrace's MUST-held
+   lockset propagated to every blocking site; an unbounded site under
+   a named lock is flagged with the full witness (lock class, call
+   path, primitive). Turns lockwatch's sampled hold budget into a
+   proof over all paths, and backs the runtime cross-check: every
+   witnessed hold-budget overrun must be statically explained.
+3. **`live-block-in-main-loop`** / **`live-unbounded-blocking`**
+   (`loopflow.py`): no unbounded blocking call reachable from the
+   asyncio `main-loop` identity without an executor hop — the static
+   form of "the serving path never stalls on disk, peer, or device";
+   spawned-thread residual sites form the review-and-annotate family.
+4. **`live-grow-unbounded`** (`growth.py`): every shared container a
+   rooted function grows must be provably bounded — ring
+   (deque maxlen), rotation/eviction/reset recognized structurally, or
+   a reviewed `# tmlive: bounded=<reason>` annotation.
+
+Suppressions (same comment-block-above convention as the rest of the
+family): `# tmlive: block-ok — why` for the blocking rules,
+`# tmlive: grow-ok — why` for a grow site, `# tmlive:
+bounded=<reason>` on a container birth or grow line. Counted
+fingerprint baseline `live_baseline.json` ships (and is pinned) EMPTY.
+Run via `scripts/lint.py --live` (in the default full gate); tier-1
+tests in tests/test_tmlive.py; docs/static_analysis.md has the
+catalog, the boundedness idioms, and the static-vs-lockwatch division
+of labor.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..tmlint import (
+    Violation,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from ..tmcheck.callgraph import Package, build_package
+from ..tmrace import threadroots
+from ..tmrace.lockset import FuncSummary, Summarizer, propagate
+from ..tmrace.threadroots import discover_roots, reach
+from . import blockcat, growth, holdflow, loopflow
+from .blockcat import HARNESS_PREFIXES, UNBOUNDED, collect_sites
+from .holdflow import crosscheck_overruns  # re-export (conftest/tests)
+
+__all__ = [
+    "RULES",
+    "LIVE_BASELINE_PATH",
+    "LIVE_BASELINE_NOTE",
+    "LiveReport",
+    "analyze",
+    "live_violations",
+    "new_live_violations",
+    "update_live_baseline",
+    "crosscheck_overruns",
+]
+
+FuncKey = Tuple[str, str]
+
+LIVE_BASELINE_PATH = os.path.join(
+    os.path.dirname(__file__), "live_baseline.json"
+)
+
+LIVE_BASELINE_NOTE = (
+    "Accepted pre-existing liveness/boundedness findings, fingerprinted "
+    "by rule:path:sha1(source_line)[:12]. New findings are anything "
+    "over these counts. Do not hand-edit counts to sneak a finding in "
+    "— fix it, or suppress it with a justified '# tmlive: block-ok — "
+    "why' / '# tmlive: grow-ok — why' / '# tmlive: bounded=<reason>'."
+)
+
+RULES = [
+    (
+        "live-block-under-lock",
+        "unbounded blocking primitive reachable while a named lock is "
+        "held (MUST-held lockset over all static paths)",
+    ),
+    (
+        "live-block-in-main-loop",
+        "unbounded blocking primitive reachable from the asyncio "
+        "main-loop identity without an executor hop",
+    ),
+    (
+        "live-unbounded-blocking",
+        "unbounded blocking primitive on a spawned thread: reviewed "
+        "residual — fix it or write down why blocking is that "
+        "thread's job",
+    ),
+    (
+        "live-grow-unbounded",
+        "shared container grown from the serving path with no "
+        "boundedness proof (ring / rotation / eviction / reviewed "
+        "bounded= annotation)",
+    ),
+]
+
+_BLOCK_OK_RE = re.compile(r"#\s*tmlive:\s*block-ok\b")
+_GROW_OK_RE = re.compile(r"#\s*tmlive:\s*grow-ok\b")
+_BOUNDED_RE = re.compile(r"#\s*tmlive:\s*bounded=([^#]+?)\s*(?:#|$)")
+
+
+def suppression_maps(lines: List[str]):
+    """(block_ok, grow_ok, bounded): line-number sets/maps for the
+    three tmlive annotations, with the comment-block-above convention
+    implemented once in tmlint.comment_cover_lines (shared with
+    tmlint/tmcheck/tmrace so the analyzers can never drift on what a
+    suppression comment reaches)."""
+    from ..tmlint import comment_cover_lines
+
+    block_ok: Set[int] = set()
+    grow_ok: Set[int] = set()
+    bounded: Dict[int, str] = {}
+    for i, text in enumerate(lines, start=1):
+        if _BLOCK_OK_RE.search(text):
+            block_ok.update(comment_cover_lines(lines, i, text))
+        if _GROW_OK_RE.search(text):
+            grow_ok.update(comment_cover_lines(lines, i, text))
+        m = _BOUNDED_RE.search(text)
+        if m:
+            for ln in comment_cover_lines(lines, i, text):
+                bounded.setdefault(ln, m.group(1).strip())
+    return block_ok, grow_ok, bounded
+
+
+class LiveReport:
+    """Everything one analyze() run produced."""
+
+    def __init__(self) -> None:
+        self.sites: List[blockcat.BlockSite] = []
+        self.containers: Dict[tuple, growth.Container] = {}
+        self.identities: Dict[FuncKey, Set[str]] = {}
+        self.violations: List[Violation] = []
+        # lock names (static identity) with a flagged blocking site
+        self.flagged_locks: Set[str] = set()
+        # lock names with a statically-KNOWN blocking site that is not
+        # a finding: a suppressed unbounded site, or a BOUNDED site
+        # (wait(0.5) under a lock is green here — lockwatch owns
+        # "bounded but too long" — but its overrun is still explained
+        # by this set, not by an OVERRUN_OK "pure memory ops" claim
+        # that would then be false)
+        self.suppressed_locks: Set[str] = set()
+        self.stats: Dict[str, int] = {}
+
+
+def analyze(
+    pkg: Optional[Package] = None,
+    include_test_roots: bool = False,
+) -> LiveReport:
+    pkg = pkg or build_package()
+    report = LiveReport()
+
+    # -- roots: the serving path's concurrent entry points (package
+    # roots only by default; the tests/ hammers drive the package from
+    # pytest, not from a serving node) --
+    roots = discover_roots(pkg)
+    if include_test_roots:
+        roots += threadroots.discover_test_roots(pkg)
+    while True:
+        extra = threadroots.callback_roots(pkg, roots)
+        if not extra:
+            break
+        roots += extra
+    identities, parents = reach(pkg, roots)
+    report.identities = identities
+
+    # -- locksets (tmrace's machinery, MUST direction) --
+    summarizer = Summarizer(pkg)
+    summaries: Dict[FuncKey, FuncSummary] = {}
+    for key in identities:
+        summaries[key] = summarizer.summarize_function(pkg.functions[key])
+    root_keys = sorted({r.key for r in roots})
+    entry_contexts, _edges, _trunc = propagate(pkg, summaries, root_keys)
+
+    # -- suppression maps --
+    block_ok: Dict[str, Set[int]] = {}
+    grow_ok: Dict[str, Set[int]] = {}
+    bounded_ann: Dict[str, Dict[int, str]] = {}
+    for path, mod in pkg.modules.items():
+        b, g, ba = suppression_maps(mod.lines)
+        block_ok[path] = b
+        grow_ok[path] = g
+        bounded_ann[path] = ba
+
+    def _line_text(path: str, lineno: int) -> str:
+        lines = pkg.modules[path].lines
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1].strip()
+        return ""
+
+    violations: List[Violation] = []
+
+    # -- blocking rules --
+    sites = collect_sites(pkg)
+    report.sites = sites
+    n_bounded = n_unbounded = n_unreachable = n_suppressed = 0
+    for site in sites:
+        in_harness = site.path.startswith(HARNESS_PREFIXES)
+        summary = summaries.get(site.key)
+        locks: FrozenSet[str] = frozenset()
+        if summary is not None:
+            locks = holdflow.site_locks(
+                summary, entry_contexts, site.key, site.lineno, site.col
+            )
+        named = holdflow.named_locks(locks)
+        if site.kind != UNBOUNDED:
+            n_bounded += 1
+            if site.kind == blockcat.BOUNDED and not in_harness:
+                # a bounded wait under a named lock is not a finding,
+                # but a hold-budget overrun on that lock is explained
+                # by it — record for the lockwatch cross-check. A
+                # NONBLOCKING site (get_nowait, acquire(False)) cannot
+                # stall and must NOT explain anything.
+                report.suppressed_locks.update(named)
+            continue
+        n_unbounded += 1
+        if in_harness:
+            continue
+        rule = loopflow.pick_rule(identities, site.key, bool(named))
+        if rule is None:
+            n_unreachable += 1
+            continue
+        if site.lineno in block_ok.get(site.path, ()):
+            n_suppressed += 1
+            report.suppressed_locks.update(named)
+            continue
+        report.flagged_locks.update(named)
+        witness = loopflow.main_witness(pkg, parents, identities, site.key)
+        if rule == "live-block-under-lock":
+            detail = (
+                f"holds {holdflow.describe_locks(named)} across "
+                f"{site.primitive} ({site.detail})"
+            )
+        elif rule == "live-block-in-main-loop":
+            detail = (
+                f"{site.primitive} ({site.detail}) reachable from the "
+                "asyncio main-loop identity — one call stalls every "
+                "handler, subscriber and vote in flight"
+            )
+        else:
+            detail = (
+                f"{site.primitive} ({site.detail}) on a spawned "
+                "thread: fix it or write down why blocking is this "
+                "thread's job"
+            )
+        violations.append(
+            Violation(
+                rule=rule,
+                path=site.path,
+                line=site.lineno,
+                col=site.col,
+                message=detail + (f"; witness: {witness}" if witness else ""),
+                source=_line_text(site.path, site.lineno),
+            )
+        )
+
+    # -- growth rule --
+    containers = growth.collect_growth(pkg, summarizer.attribution)
+    report.containers = containers
+    n_growers = n_bounded_containers = 0
+    for var, c in sorted(containers.items(), key=lambda kv: str(kv[0])):
+        rooted_grows = [g for g in c.grows if g.key in identities]
+        if not rooted_grows:
+            continue
+        n_growers += 1
+        reason = bounded_ann.get(c.path, {}).get(c.lineno)
+        if reason:
+            c.bounded_reason = reason
+        if c.ring:
+            c.bounded_reason = c.bounded_reason or "ring (deque maxlen)"
+        elif c.shrinks:
+            c.bounded_reason = c.bounded_reason or (
+                "rotation/eviction/reset present"
+            )
+        if c.bounded_reason:
+            n_bounded_containers += 1
+            continue
+        for g in rooted_grows:
+            site_reason = bounded_ann.get(g.path, {}).get(g.lineno)
+            if site_reason or g.lineno in grow_ok.get(g.path, ()):
+                n_suppressed += 1
+                continue
+            ids = sorted(identities.get(g.key, set()))[:3]
+            violations.append(
+                Violation(
+                    rule="live-grow-unbounded",
+                    path=g.path,
+                    line=g.lineno,
+                    col=g.col,
+                    message=(
+                        f"{c.render_var()} grows via {g.what} on the "
+                        f"serving path (roots: {', '.join(ids)}) with no "
+                        "boundedness proof — no ring, no eviction/reset "
+                        "site, no `# tmlive: bounded=` annotation: an "
+                        "OOM at serving scale"
+                    ),
+                    source=_line_text(g.path, g.lineno),
+                )
+            )
+
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    report.violations = violations
+    report.stats = {
+        "sites_total": len(sites),
+        "sites_bounded": n_bounded,
+        "sites_unbounded": n_unbounded,
+        "sites_unreachable": n_unreachable,
+        "suppressed": n_suppressed,
+        "containers": len(containers),
+        "containers_growing": n_growers,
+        "containers_bounded": n_bounded_containers,
+        "roots": len(roots),
+    }
+    return report
+
+
+def live_violations(
+    pkg: Optional[Package] = None, **kwargs
+) -> List[Violation]:
+    return analyze(pkg, **kwargs).violations
+
+
+def new_live_violations(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+    **kwargs,
+) -> List[Violation]:
+    violations = live_violations(pkg, **kwargs)
+    baseline = load_baseline(baseline_path or LIVE_BASELINE_PATH)
+    return new_violations(violations, baseline)
+
+
+def update_live_baseline(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+    **kwargs,
+) -> Dict[str, int]:
+    return save_baseline(
+        live_violations(pkg, **kwargs),
+        baseline_path or LIVE_BASELINE_PATH,
+        note=LIVE_BASELINE_NOTE,
+    )

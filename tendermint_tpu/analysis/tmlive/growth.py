@@ -1,0 +1,444 @@
+"""`live-grow-unbounded` — every shared container on the serving path
+must have a boundedness story.
+
+The OOM twin of the stall rules: a node serving millions of users dies
+just as dead from a dict that gains an entry per request as from a
+blocked event loop. This pass enumerates every *shared* container —
+module globals and instance fields born as list/dict/set/deque (or
+annotated as one) — that some root-reachable function grows
+(`append`/`add`/`extend`/`insert`/`update`/`setdefault`/`[k] = v`/
+`+=`), and demands a boundedness proof:
+
+- **ring** — born `deque(maxlen=...)`: structurally bounded, done;
+- **rotation / eviction / reset** — the same container identity has a
+  shrink site anywhere in the package: `pop`/`popitem`/`popleft`/
+  `remove`/`discard`/`clear`/`del x[k]`/plain reassignment (the
+  sigcache two-generation rotate, PR-7's epoch-invalidated memo
+  rebuilds, registry eviction, per-height resets all look like this);
+- **reviewed annotation** — `# tmlive: bounded=<reason>` on the birth
+  line or the grow site, for containers whose bound is a protocol or
+  configuration fact the AST cannot see (a registry keyed by a fixed
+  instrument-name set, a map capped by max-peers config).
+
+Anything else is an OOM-at-scale finding. The structural recognizers
+are deliberately generous — ANY shrink site anywhere counts, because
+the gate's job is the container that *only ever grows*; a wrong or
+insufficient eviction policy is a review problem, not a grep problem.
+Per-site `# tmlive: grow-ok — why` suppressions exist for the rare
+intentional case, same style as every other analyzer in the family.
+
+Import-time grows (module-body statements) and grows inside
+`__init__`/`__new__` on the object's OWN fields are construction, not
+growth, and are skipped.
+
+Receiver resolution covers bare names (scope-correct: function-local
+bindings shadow), `self.<attr>` fields (owner-class attribution,
+base classes walked), from-imported globals born in another module,
+and module-attr receivers through import aliases/from-imports
+(`sigcache._gen0.add(k)`). Receivers the resolver cannot type —
+containers passed as arguments, elements pulled out of other
+containers, dynamic attribute chains — produce NO grow site: like
+blockcat and tmcheck's edges, the pass is deliberately
+under-approximate and docs/static_analysis.md says so.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tmlint import dotted_name as _dotted
+from ..tmcheck.callgraph import FuncInfo, ModuleIndex, Package, _body_walk
+
+__all__ = ["Container", "GrowSite", "collect_growth"]
+
+FuncKey = Tuple[str, str]
+
+_GROW_METHODS = {
+    "append", "add", "extend", "insert", "update", "setdefault",
+    "appendleft", "push",
+}
+_SHRINK_METHODS = {
+    "pop", "popitem", "popleft", "remove", "discard", "clear",
+    "difference_update", "truncate",
+}
+
+_CONTAINER_CTORS = {"list", "dict", "set", "frozenset", "deque",
+                    "defaultdict", "OrderedDict", "Counter"}
+_CONTAINER_ANNOTATIONS = {
+    "List", "Dict", "Set", "MutableMapping", "DefaultDict", "Deque",
+    "list", "dict", "set",
+}
+
+
+def _container_birth(mod: ModuleIndex, value: Optional[ast.AST]):
+    """("kind", ring: bool) when `value` births a container: a literal
+    [] / {} / set() / comprehension, or a ctor call (deque with a
+    non-None maxlen is a ring). None otherwise."""
+    if value is None:
+        return None
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return (type(value).__name__.lower(), False)
+    if isinstance(value, ast.Call):
+        name = _dotted(value.func).split(".")[-1]
+        if name in _CONTAINER_CTORS:
+            ring = False
+            if name == "deque":
+                for kw in value.keywords:
+                    if kw.arg == "maxlen" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None
+                    ):
+                        ring = True
+            return (name, ring)
+    return None
+
+
+class Container:
+    """One shared container identity."""
+
+    __slots__ = (
+        "var", "path", "lineno", "kind", "ring", "grows", "shrinks",
+        "bounded_reason",
+    )
+
+    def __init__(self, var, path, lineno, kind, ring) -> None:
+        self.var = var  # ("g", path, name) | ("f", path, class, attr)
+        self.path = path  # birth path (for the annotation lookup)
+        self.lineno = lineno  # birth line
+        self.kind = kind
+        self.ring = ring
+        self.grows: List[GrowSite] = []
+        self.shrinks: List[Tuple[str, int]] = []
+        self.bounded_reason: Optional[str] = None
+
+    def render_var(self) -> str:
+        if self.var[0] == "g":
+            return f"module global `{self.var[2]}`"
+        return f"shared field `{self.var[2]}.{self.var[3]}`"
+
+
+class GrowSite:
+    __slots__ = ("key", "path", "lineno", "col", "what")
+
+    def __init__(self, key, path, lineno, col, what) -> None:
+        self.key = key
+        self.path = path
+        self.lineno = lineno
+        self.col = col
+        self.what = what  # rendered op, e.g. "`_REGISTRY[name] = ...`"
+
+
+def _class_attrs_with_containers(mod: ModuleIndex, cname: str, rec):
+    """(attr -> (kind, ring, birth lineno)) for fields born as
+    containers in this class's methods or annotated as one."""
+    out: Dict[str, Tuple[str, bool, int]] = {}
+    for m in rec["methods"].values():
+        for node in ast.walk(m):
+            if not isinstance(node, ast.Assign):
+                continue
+            birth = _container_birth(mod, node.value)
+            if birth is None:
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.setdefault(t.attr, (*birth, node.lineno))
+    for item in rec["node"].body:
+        if isinstance(item, ast.AnnAssign) and isinstance(
+            item.target, ast.Name
+        ):
+            base = item.annotation
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            name = _dotted(base).split(".")[-1]
+            if name in _CONTAINER_ANNOTATIONS:
+                out.setdefault(
+                    item.target.id, (name.lower(), False, item.lineno)
+                )
+    return out
+
+
+def _refs_target(value: ast.AST, t: ast.AST) -> bool:
+    """Does `value` reference the same identity `t` names?"""
+    if isinstance(t, ast.Name):
+        return any(
+            isinstance(n, ast.Name) and n.id == t.id
+            for n in ast.walk(value)
+        )
+    return any(
+        isinstance(n, ast.Attribute)
+        and n.attr == t.attr
+        and isinstance(n.value, ast.Name)
+        and n.value.id == "self"
+        for n in ast.walk(value)
+    )
+
+
+def _additive_rebuild(value: ast.AST, t: ast.AST) -> bool:
+    """True for the strictly-additive reassignment shapes — a spread of
+    the old contents plus new elements (`{**X, k: v}`, `[*X, e]`,
+    `{*X, e}`) or a concat/union (`X + [...]`, `X | {...}`). These are
+    growth, not eviction, and must not count as a reset site."""
+    if isinstance(value, ast.Dict):
+        return any(
+            k is None and _refs_target(v, t)
+            for k, v in zip(value.keys, value.values)
+        )
+    if isinstance(value, (ast.List, ast.Set, ast.Tuple)):
+        return any(
+            isinstance(e, ast.Starred) and _refs_target(e.value, t)
+            for e in value.elts
+        )
+    if isinstance(value, ast.BinOp) and isinstance(
+        value.op, (ast.Add, ast.BitOr)
+    ):
+        return _refs_target(value.left, t) or _refs_target(value.right, t)
+    return False
+
+
+def collect_growth(pkg: Package, attribution) -> Dict[tuple, Container]:
+    """All shared containers with their grow/shrink sites.
+    `attribution` is tmrace's lockset._Attribution (owner-class
+    resolution, so a subclass's `self.items.append` lands on the base
+    class's container identity)."""
+    containers: Dict[tuple, Container] = {}
+
+    # -- births --
+    for mod in pkg.modules.values():
+        for node in mod.tree.body:
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            birth = _container_birth(mod, node.value)
+            if birth is None and isinstance(node, ast.AnnAssign):
+                base = node.annotation
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                nm = _dotted(base).split(".")[-1]
+                if nm in _CONTAINER_ANNOTATIONS:
+                    birth = (nm.lower(), False)
+            if birth is None:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    var = ("g", mod.path, t.id)
+                    containers.setdefault(
+                        var,
+                        Container(var, mod.path, node.lineno, *birth),
+                    )
+        for cname, rec in mod.classes.items():
+            for attr, (kind, ring, ln) in _class_attrs_with_containers(
+                mod, cname, rec
+            ).items():
+                owner = attribution.owner(mod, cname, attr) or (
+                    mod.path, cname
+                )
+                var = ("f", owner[0], owner[1], attr)
+                containers.setdefault(
+                    var, Container(var, mod.path, ln, kind, ring)
+                )
+
+    # -- grow/shrink sites --
+    for fi in pkg.functions.values():
+        mod = pkg.modules[fi.path]
+        globals_here = {
+            v[2] for v in containers if v[0] == "g" and v[1] == fi.path
+        }
+        is_init = fi.qualname.split(".")[-1] in ("__init__", "__new__")
+        # scope-correct name resolution, same discipline tmrace's
+        # lockset walker uses: a plain `X = ...` (or arg/for/with
+        # binding) WITHOUT `global X` makes X a local — its grows must
+        # not count against the module container and, critically, its
+        # assignment must not register as a fake "reset" that proves a
+        # genuinely unbounded global bounded
+        declared_global: Set[str] = set()
+        bound: Set[str] = set()
+        for node in _body_walk(fi.node):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+        args = fi.node.args
+        for a in (
+            list(args.args)
+            + list(args.posonlyargs)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            bound.add(a.arg)
+        def binding_names(t: ast.AST):
+            # only targets that BIND a name: `X = ...` binds X, but
+            # `X[k] = ...` / `X.attr = ...` mutate without binding —
+            # their receiver must stay resolvable as the module global
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    yield from binding_names(e)
+            elif isinstance(t, ast.Starred):
+                yield from binding_names(t.value)
+
+        for node in _body_walk(fi.node):
+            tgts: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                tgts = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                tgts = [node.target]
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                tgts = [node.target]
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                tgts = [node.optional_vars]
+            elif isinstance(node, ast.comprehension):
+                tgts = [node.target]
+            for t in tgts:
+                bound.update(binding_names(t))
+        shadowed = bound - declared_global
+
+        def var_of(recv: ast.AST) -> Optional[tuple]:
+            if isinstance(recv, ast.Name) and recv.id not in shadowed:
+                if recv.id in globals_here:
+                    return ("g", fi.path, recv.id)
+                # from-imported container global born in ANOTHER
+                # module: `from ..crypto.sigcache import _gen0;
+                # _gen0.add(k)` must grow sigcache's identity
+                entry = mod.from_imports.get(recv.id)
+                if entry is not None and entry[0] is not None:
+                    target = pkg.module_for_dotted(entry[0])
+                    if target is not None:
+                        v = ("g", target.path, entry[2])
+                        if v in containers:
+                            return v
+                return None
+            if (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+            ):
+                head = recv.value.id
+                if head == "self" and fi.class_name:
+                    owner = attribution.owner(
+                        mod, fi.class_name, recv.attr
+                    )
+                    if owner is None:
+                        owner = (fi.path, fi.class_name)
+                    v = ("f", owner[0], owner[1], recv.attr)
+                    return v if v in containers else None
+                # module-attr receiver: `sigcache._gen0.add(k)` /
+                # `trace._ring.append(e)` through an imported module
+                target = None
+                entry = mod.from_imports.get(head)
+                if entry is not None and entry[0] is not None:
+                    base = (
+                        entry[0] + "." + entry[2]
+                        if entry[0]
+                        else entry[2]
+                    )
+                    target = pkg.module_for_dotted(base)
+                else:
+                    alias = mod.import_alias.get(head)
+                    if alias is not None:
+                        prefix = pkg.pkg_name + "."
+                        if alias.startswith(prefix):
+                            target = pkg.module_for_dotted(
+                                alias[len(prefix):]
+                            )
+                if target is not None:
+                    v = ("g", target.path, recv.attr)
+                    if v in containers:
+                        return v
+            return None
+
+        def record(var, node, what, grow: bool):
+            c = containers.get(var)
+            if c is None:
+                return
+            if grow:
+                if is_init and var[0] == "f":
+                    return  # construction, not growth
+                c.grows.append(
+                    GrowSite(fi.key, fi.path, node.lineno,
+                             node.col_offset, what)
+                )
+            else:
+                c.shrinks.append((fi.path, node.lineno))
+
+        for node in _body_walk(fi.node):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                m = node.func.attr
+                if m in _GROW_METHODS or m in _SHRINK_METHODS:
+                    var = var_of(node.func.value)
+                    if var is not None:
+                        recv = _dotted(node.func.value) or "<recv>"
+                        record(
+                            var, node, f"`{recv}.{m}(...)`",
+                            m in _GROW_METHODS,
+                        )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        var = var_of(t.value)
+                        if var is None:
+                            continue
+                        recv = _dotted(t.value) or "<recv>"
+                        if isinstance(t.slice, ast.Slice):
+                            # slice assignment replaces content: reset
+                            record(var, node, "", False)
+                        else:
+                            record(
+                                var, node, f"`{recv}[...] = ...`", True
+                            )
+                    elif isinstance(t, ast.Name) or (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        # plain reassignment of the identity = reset
+                        # (rotation / epoch rebuild / filtered copy);
+                        # augmented assign (`x += [..]`) is growth —
+                        # and so is an ADDITIVE self-rebuild
+                        # (`X = {**X, k: v}` / `X = X + [e]` /
+                        # `X = X | {e}`): growth spelled as assignment
+                        # must not double as its own boundedness proof.
+                        # A comprehension referencing X (a filtered
+                        # copy) stays a reset — that IS eviction.
+                        var = var_of(t)
+                        if var is None:
+                            continue
+                        nm = (
+                            t.id
+                            if isinstance(t, ast.Name)
+                            else f"self.{t.attr}"
+                        )
+                        if isinstance(node, ast.AugAssign):
+                            record(var, node, f"`{nm} += ...`", True)
+                        elif _additive_rebuild(node.value, t):
+                            record(
+                                var, node,
+                                f"`{nm} = ...{nm}...` additive rebuild",
+                                True,
+                            )
+                        elif not (is_init and var[0] == "f"):
+                            # the birth assignment in __init__ is
+                            # construction, not an eviction/reset site
+                            record(var, node, "", False)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        var = var_of(t.value)
+                        if var is not None:
+                            record(var, node, "", False)
+    return containers

@@ -1,0 +1,158 @@
+"""`live-block-under-lock` — no unbounded blocking call while a lock
+is held, proven over all static paths.
+
+lockwatch's hold-time budget (TM_TPU_LOCKWATCH_BUDGET_S, 0.25 s)
+watches the paths the suite happens to execute; this rule is the proof
+over ALL paths: tmrace's MUST-held lockset machinery is propagated to
+every blocking site blockcat catalogs, and any *unbounded* site whose
+lockset contains a named lock is flagged with the full witness — lock
+class, shortest call path from a thread root, and the blocking
+primitive. A bounded site (a `wait(0.1)`, a constant sleep) under a
+lock is recorded in stats but not flagged: lockwatch's runtime budget
+owns the "bounded but too long" half.
+
+The lockset at a site is the same three-part union tmrace uses, all
+MUST-direction (never a false "held"):
+
+- locks syntactically held at the call (`with lock:` enclosure);
+- the function's MUST-entry lockset (intersection over every explored
+  call path from every thread root);
+- the `*_locked` naming convention.
+
+A WILDCARD lock (one the analysis cannot name) does NOT trigger the
+rule — an audited-unknowable guard should not conjure findings — but
+named locks always do, ranked or not; the message names the lockwatch
+RANK entry when one exists, because a ranked lock is by definition on
+the crypto hot path where a stall is a serving outage.
+
+## The lockwatch cross-check (`crosscheck_overruns`)
+
+Runtime hold-budget overruns are promoted from warnings to a
+structured record (lockwatch.HOLD_LOG); every witnessed overrun must
+be *explained*: either tmlive flagged (or carries a suppression for) a
+blocking site under that lock class, or the lock appears in
+OVERRUN_OK below — the reviewed list of locks whose critical sections
+are pure memory operations, where an overrun can only mean the host
+scheduler parked the holder (a loaded CI box), not that the code
+blocks. That list is itself backed by this rule: if someone adds a
+blocking call under one of these locks, the static gate goes red
+before the runtime budget ever fires.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from ..tmrace.lockorder import STATIC_RANK_NAMES
+from ..tmrace.lockset import WILDCARD
+
+__all__ = ["OVERRUN_OK", "site_locks", "named_locks", "crosscheck_overruns"]
+
+FuncKey = Tuple[str, str]
+
+# lockwatch rank name -> why a hold-budget overrun on it is scheduler
+# noise, not a blocking call. Every entry is a claim tmlive's
+# block-under-lock gate machine-checks on each run: the moment a
+# blocking call becomes reachable under one of these locks, the static
+# gate fails and the entry must be removed.
+OVERRUN_OK: Dict[str, str] = {
+    "breaker.registry": (
+        "registry get/pop + CircuitBreaker construction; pure memory "
+        "ops — tmlive proves no blocking call is reachable under it"
+    ),
+    "breaker.instance": (
+        "state-machine transitions and gauge publishes; the probe fn "
+        "runs OUTSIDE the lock by design (tmlive-proven)"
+    ),
+    "sigcache.rotate": (
+        "set rotation/promotion; pure memory ops on bounded "
+        "generations"
+    ),
+    "trace.ring": (
+        "ring replacement/snapshot only (appends are lock-free); "
+        "bounded copies of a bounded deque"
+    ),
+    "tpu_verifier.wedged": (
+        "counter/free-list bookkeeping around the watchdog handshake; "
+        "the gather itself runs outside the lock"
+    ),
+    "metrics.metric": "counter/gauge/histogram arithmetic only",
+    "metrics.registry": "name-table insert/lookup only",
+}
+
+
+def site_locks(
+    summary,
+    entry_contexts: Dict[FuncKey, List[FrozenSet[str]]],
+    key: FuncKey,
+    lineno: int,
+    col: int,
+) -> FrozenSet[str]:
+    """MUST-held lockset at one call position inside `key`."""
+    ctxs = entry_contexts.get(key)
+    must_entry: FrozenSet[str] = (
+        frozenset.intersection(*ctxs) if ctxs else frozenset()
+    )
+    syntactic = summary.call_locks.get((lineno, col), frozenset())
+    return syntactic | must_entry | summary.convention
+
+
+def named_locks(locks: Iterable[str]) -> List[str]:
+    """The flaggable subset: everything but the wildcard."""
+    return sorted(l for l in locks if l != WILDCARD)
+
+
+def rank_name(lock: str) -> Optional[str]:
+    return STATIC_RANK_NAMES.get(lock)
+
+
+def describe_locks(locks: List[str]) -> str:
+    out = []
+    for l in locks:
+        rn = rank_name(l)
+        out.append(f"{l} (rank {rn})" if rn else l)
+    return ", ".join(out)
+
+
+# ---------------------------------------------------------------------------
+# runtime cross-check
+
+
+def _static_names(rank: str) -> Set[str]:
+    return {s for s, r in STATIC_RANK_NAMES.items() if r == rank}
+
+
+def crosscheck_overruns(
+    long_holds: Iterable[dict],
+    flagged_locks: Set[str],
+    suppressed_locks: Set[str],
+    overrun_ok: Optional[Dict[str, str]] = None,
+) -> List[dict]:
+    """Witnessed hold-budget overruns with NO explanation: the lock is
+    neither statically flagged/suppressed as holding over a blocking
+    call (so the overrun is the known, reviewed stall) nor in
+    OVERRUN_OK (so it cannot be dismissed as scheduler noise). Each
+    returned entry is the original overrun record plus a `why` telling
+    the operator what would explain it."""
+    overrun_ok = OVERRUN_OK if overrun_ok is None else overrun_ok
+    unexplained: List[dict] = []
+    for h in long_holds:
+        name = h.get("name", "")
+        if name in overrun_ok:
+            continue
+        statics = _static_names(name) or {name}
+        if statics & (flagged_locks | suppressed_locks):
+            continue
+        unexplained.append(
+            {
+                **h,
+                "why": (
+                    f"lock {name!r} overran the hold budget but tmlive "
+                    "knows no blocking site under it and OVERRUN_OK has "
+                    "no scheduler-noise rationale for it — add the "
+                    "blocking call to the catalog, suppress the site "
+                    "with a reason, or extend OVERRUN_OK"
+                ),
+            }
+        )
+    return unexplained

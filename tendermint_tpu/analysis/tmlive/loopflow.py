@@ -1,0 +1,69 @@
+"""`live-block-in-main-loop` / `live-unbounded-blocking` — the serving
+path never stalls on disk, peer, or device.
+
+Every `async def` in the package shares tmrace's single `main-loop`
+identity: RPC and websocket handlers, the consensus receive loop, the
+reactors, the mempool — one OS thread runs them all, so ONE unbounded
+blocking call anywhere reachable from that identity stalls every
+subscriber, every /healthz probe, and every vote in flight. This rule
+is the static form of the chaos heartbeat test: prove no unbounded
+blocking primitive (blockcat's catalog) is reachable from the
+main-loop identity *without an executor hop*.
+
+The executor hop comes for free from the substrate: `run_in_executor`
+targets are their own spawned identities in tmrace's root catalog, and
+the call graph records no direct edge through the executor — so
+reachability from `main-loop` simply never crosses one. Awaited calls
+were already excluded at catalog time (an awaited `.wait()` parks a
+task, not the thread).
+
+Unbounded sites reachable ONLY from spawned identities (a watchdog
+thread parked on its wake Event, a probe thread inside a device call)
+are the residual family `live-unbounded-blocking`: blocking there
+stalls one worker, not the serving path, but it must still be a
+*reviewed* decision — the fix-or-suppress pass is where "blocking is
+this thread's job" gets written down next to the code. Sites flagged
+by block-under-lock are excluded here (most-specific rule wins; one
+site, one finding), as are blockcat's harness prefixes and sites not
+reachable from any root at all (cold CLI/utility code — recorded in
+stats, not findings).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tmrace.threadroots import MAIN_IDENTITY, witness_chain
+
+__all__ = ["MAIN_IDENTITY", "pick_rule", "main_witness"]
+
+FuncKey = Tuple[str, str]
+
+
+def pick_rule(
+    identities: Dict[FuncKey, Set[str]],
+    key: FuncKey,
+    under_lock: bool,
+) -> Optional[str]:
+    """Most-specific rule for one unbounded blocking site (None when
+    the enclosing function is unreachable from every thread root)."""
+    if under_lock:
+        return "live-block-under-lock"
+    ids = identities.get(key, set())
+    if MAIN_IDENTITY in ids:
+        return "live-block-in-main-loop"
+    if ids:
+        return "live-unbounded-blocking"
+    return None
+
+
+def main_witness(pkg, parents, identities, key: FuncKey) -> str:
+    """Rendered shortest root->site chain, preferring the main-loop
+    identity (the one the finding is about)."""
+    ids = identities.get(key, set())
+    ident = MAIN_IDENTITY if MAIN_IDENTITY in ids else (
+        sorted(ids)[0] if ids else None
+    )
+    if ident is None:
+        return ""
+    return " -> ".join(witness_chain(pkg, parents, ident, key))

@@ -699,29 +699,18 @@ def suppression_maps(lines: List[str]):
     `# tmrace: race-ok` (or a justified tmlint lock-global-mutation
     disable), and lineno -> asserted lock-name strings for
     `# tmrace: guarded-by=`. Comment-block-above placement covers the
-    first code line below, same convention as tmlint/tmcheck."""
+    first code line below — the family-wide convention implemented
+    once in tmlint.comment_cover_lines."""
+    from ..tmlint import comment_cover_lines
+
     race_ok: Set[int] = set()
     guarded: Dict[int, Set[str]] = {}
-
-    def covered(i: int, text: str) -> List[int]:
-        out = [i]
-        if text.lstrip().startswith("#"):
-            j = i + 1
-            while j <= len(lines) and (
-                not lines[j - 1].strip()
-                or lines[j - 1].lstrip().startswith("#")
-            ):
-                j += 1
-            if j <= len(lines):
-                out.append(j)
-        return out
-
     for i, text in enumerate(lines, start=1):
         if _RACE_OK_RE.search(text) or _TMLINT_LOCK_RE.search(text):
-            race_ok.update(covered(i, text))
+            race_ok.update(comment_cover_lines(lines, i, text))
         m = _GUARDED_BY_RE.search(text)
         if m:
-            for ln in covered(i, text):
+            for ln in comment_cover_lines(lines, i, text):
                 guarded.setdefault(ln, set()).add(m.group(1))
     return race_ok, guarded
 

@@ -627,6 +627,42 @@ async def _build_replay_cs(cfg, state, proxy, block_store):
     return cs
 
 
+def _stdin_reader_queue(loop, prompt: str = "") -> "asyncio.Queue":
+    """Feed stdin lines into an asyncio.Queue from a daemon thread —
+    the one sanctioned way a console coroutine reads the operator.
+    Reading inline would park the event loop it shares with the
+    proxy/ABCI clients (tmlive: live-block-in-main-loop); a
+    default-executor hop would make asyncio.run's teardown join a
+    thread still parked in input(), hanging Ctrl-C until the operator
+    pressed Enter. A daemon thread is joined by nobody. EOF (or a
+    loop that closed while the thread was parked) ends the stream
+    with a None sentinel."""
+    import threading
+
+    lines: asyncio.Queue = asyncio.Queue()
+
+    def _post(item) -> None:
+        try:
+            loop.call_soon_threadsafe(lines.put_nowait, item)
+        except RuntimeError:
+            pass  # loop already closed; the console is gone
+
+    def _reader() -> None:
+        while True:
+            try:
+                # tmlive: block-ok — dedicated stdin reader: waiting
+                # for the operator is this daemon thread's whole job;
+                # parking HERE is what keeps the event loop free
+                raw = input(prompt)
+            except Exception:  # EOFError / closed or broken stdin
+                _post(None)
+                return
+            _post(raw)
+
+    threading.Thread(target=_reader, daemon=True).start()
+    return lines
+
+
 def _console_rs(cs, field: str) -> str:
     """One rs-console view (reference: replay_file.go:259-287)."""
     rs = cs.rs
@@ -705,10 +741,10 @@ async def _replay_console(cfg, state, proxy, block_store) -> None:
         print(f"#{pos}: {type(m).__name__} -> {_console_rs(cs, 'short')}")
         return True
 
+    lines = _stdin_reader_queue(asyncio.get_running_loop(), prompt="> ")
     while True:
-        try:
-            line = input("> ")
-        except EOFError:
+        line = await lines.get()
+        if line is None:  # EOF
             break
         tokens = line.split()
         if not tokens:
@@ -1232,22 +1268,7 @@ def cmd_abci(args) -> int:
                     "commit|query <operand>  (ctrl-d to exit)",
                     flush=True,
                 )
-                # stdin is read on a daemon thread: a thread parked in
-                # readline would otherwise block asyncio.run's executor
-                # shutdown on ctrl-c until the user pressed Enter
-                import threading
-
-                lines: asyncio.Queue = asyncio.Queue()
-                loop = asyncio.get_running_loop()
-
-                def _reader() -> None:
-                    for raw in sys.stdin:
-                        loop.call_soon_threadsafe(
-                            lines.put_nowait, raw
-                        )
-                    loop.call_soon_threadsafe(lines.put_nowait, None)
-
-                threading.Thread(target=_reader, daemon=True).start()
+                lines = _stdin_reader_queue(asyncio.get_running_loop())
                 while True:
                     line = await lines.get()
                     if line is None:

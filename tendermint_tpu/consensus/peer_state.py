@@ -186,6 +186,24 @@ class PeerState:
         prs.catchup_commit_round = round_
         prs.catchup_commit = BitArray(num_validators)
 
+    def reset_catchup_precommits(
+        self, height: int, round_: int, num_validators: int
+    ) -> None:
+        """Forget our delivered-marks for the stored-commit precommits
+        of (height, round_) so catchup gossip resends them. The marks
+        are optimistic — a vote sent while the peer's reactor was
+        still in wait_sync (block-syncing) was dropped unseen — and a
+        fully-marked array with a peer that never advances means the
+        marks lied; dup votes are idempotent on the receiver
+        (HeightVoteSet dedups by validator index)."""
+        prs = self.prs
+        if prs.height != height:
+            return
+        if prs.round == round_:
+            prs.precommits = BitArray(num_validators)
+        elif prs.catchup_commit_round == round_:
+            prs.catchup_commit = BitArray(num_validators)
+
     def _get_vote_bits(
         self, height: int, round_: int, vote_type: int
     ) -> Optional[BitArray]:

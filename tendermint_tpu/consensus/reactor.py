@@ -547,6 +547,29 @@ class ConsensusReactor(Service):
                         prs.height, commit.round, n
                     )
                     sent = self._send_commit_vote(ps, commit)
+                    if sent:
+                        ps.vote_catchup_stall = 0
+                    else:
+                        # Same optimistic-marks hazard _gossip_catchup_
+                        # part documents for block parts, on the votes
+                        # side: precommits streamed while the peer's
+                        # reactor was still in wait_sync (its blocksync
+                        # grace window) were dropped unseen, yet our
+                        # bits say delivered — the peer then wedges at
+                        # prs.height FOREVER with nobody resending
+                        # (witnessed: process-net SIGKILL recovery, the
+                        # restarted validator stuck at its boot height
+                        # while the net ran 270 heights ahead). After a
+                        # stall with no progress, forget and resend —
+                        # dup votes are idempotent on the receiver.
+                        ps.vote_catchup_stall = (
+                            getattr(ps, "vote_catchup_stall", 0) + 1
+                        )
+                        if ps.vote_catchup_stall * sleep > 1.0:
+                            ps.vote_catchup_stall = 0
+                            ps.reset_catchup_precommits(
+                                prs.height, commit.round, n
+                            )
 
             if not sent:
                 await asyncio.sleep(sleep)

@@ -89,7 +89,10 @@ class ConsensusState(Service):
         self.privval = privval
         self.privval_pub_key = None
         self.event_bus = event_bus
-        self.wal = wal if wal is not None else NopWAL()
+        # annotated with the real WAL so whole-program analyses
+        # (tmcheck/tmlive) resolve write_sync/fsync edges on the
+        # consensus path; NopWAL (tests/replay) is a no-op duck twin
+        self.wal: WAL = wal if wal is not None else NopWAL()
         self.evpool = evidence_pool
 
         self.rs = RoundState()

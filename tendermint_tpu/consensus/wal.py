@@ -204,6 +204,10 @@ class WAL(Service):
     async def on_stop(self) -> None:
         if self._f is not None:
             self._f.flush()
+            # tmlive: block-ok — final durability barrier at shutdown:
+            # the last signed messages must hit disk before the file
+            # closes; the node is stopping, there is no serving path
+            # left to stall (reference: wal.go Stop -> FlushAndSync)
             os.fsync(self._f.fileno())
             self._f.close()
             self._f = None
@@ -280,6 +284,12 @@ class WAL(Service):
         self._f.flush()
         if faults.armed():
             faults.fire("wal.fsync")  # io_error rule -> OSError
+        # tmlive: block-ok — protocol-required durability: an own
+        # vote/proposal must be on disk BEFORE it leaves the process,
+        # or a crash double-signs (reference: state.go:861 fsyncs on
+        # the consensus goroutine too). The stall cost is bounded by
+        # group commit — peer messages ride the 2 s flush ticker, only
+        # own-message records pay a synchronous fsync.
         os.fsync(self._f.fileno())
         self._dirty = False
 
@@ -317,6 +327,11 @@ class WAL(Service):
             # chunk holds ONLY if this fsync really reached disk, so an
             # injected failure here must propagate (never be swallowed)
             faults.fire("wal.fsync")
+        # tmlive: block-ok — rotation durability hinge: write_sync's
+        # promise for a record that just landed in the rotating-out
+        # chunk holds only if this fsync reached disk before the
+        # rename; amortized once per 10 MB of WAL (reference:
+        # group.go rotateFile)
         os.fsync(self._f.fileno())
         self._f.close()
         target = f"{self.path}.{self._next_chunk_idx:03d}"

@@ -271,6 +271,11 @@ def fire(point: str, key: Optional[str] = None) -> None:
                 f"injected I/O fault at {point} (seed={r.seed})"
             )
         if r.mode == "hang":
+            # tmlive: block-ok — the injected hang IS the fault under
+            # test: it simulates a wedged device/disk so the watchdog,
+            # breaker and chaos suites can prove containment; duration
+            # is the rule's hang_s, chosen by the test, and the plane
+            # is never armed in production (TM_TPU_FAULT unset)
             time.sleep(r.hang_s)
 
 

@@ -294,6 +294,10 @@ class _Watchdog:
     def _loop(self) -> None:
         global _wedged_gathers
         while True:
+            # tmlive: block-ok — parked watchdog worker between jobs:
+            # blocking HERE is this daemon thread's whole job (it
+            # exists so the *caller* can bound its wait with
+            # done.wait(deadline_s)); an idle worker must cost zero CPU
             self._wake.wait()
             # tmrace: race-ok — other half of the run() Event
             # handshake: wait() returned, so the owner's _job store is
@@ -832,6 +836,8 @@ def _probe_triple(key_type: str) -> tuple:
         cached = (priv.pub_key().bytes(), msg, priv.sign(msg))
         # tmlint: disable=lock-global-mutation — idempotent memo;
         # racing fills compute byte-identical values
+        # tmlive: bounded=keyed by key_type, a fixed two-element set
+        # (ed25519/sr25519); one cached probe triple per key type
         _PROBE_TRIPLES[key_type] = cached
     return cached
 

@@ -51,6 +51,8 @@ def load(name: str) -> Optional[ctypes.CDLL]:
             lib = _build(name)
         except Exception:
             lib = None
+    # tmlive: bounded=keyed by native library name — a fixed in-tree
+    # set (one .c source per kernel); one CDLL handle per name
     _LIBS[name] = lib
     return lib
 

@@ -101,8 +101,13 @@ def _lockwatch_guard(request):
     rank-table violation — the runtime analog of `go test -race`
     plus Go's lockrank (tendermint_tpu/analysis/lockwatch.py; the
     proven-acyclic order is documented in its RANK table). Long holds
-    are reported as warnings, not failures: a loaded CI box parks
-    threads for unpredictable stretches."""
+    are reported as warnings, not failures — a loaded CI box parks
+    threads for unpredictable stretches — but every overrun also lands
+    in the structured lockwatch.HOLD_LOG record, and
+    tests/test_tmlive.py::test_witnessed_overruns_statically_explained
+    asserts each one is either a tmlive-flagged/suppressed blocking
+    site under that lock or covered by holdflow.OVERRUN_OK's reviewed
+    scheduler-noise rationale."""
     if os.path.basename(str(request.node.fspath)) not in _LOCKWATCH_FILES:
         yield
         return

@@ -1,0 +1,29 @@
+"""Seeded block-under-lock: the worker thread fsyncs while holding a
+module lock; the timed twin (`wait(0.5)`) under the same lock is
+bounded and must NOT be flagged."""
+
+import os
+import threading
+
+_lock = threading.Lock()
+_ev = threading.Event()
+
+
+def flush_locked_bad(fd: int) -> None:
+    with _lock:
+        os.fsync(fd)
+
+
+def wait_locked_ok() -> None:
+    with _lock:
+        _ev.wait(0.5)
+
+
+def worker() -> None:
+    flush_locked_bad(3)
+    wait_locked_ok()
+
+
+def start() -> None:
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()

@@ -1,0 +1,21 @@
+"""Seeded block-in-main-loop: an async handler reaches a sync sleep
+through a from-import alias (`nap`) — the alias machinery must see
+through it. The awaited asyncio sleep and the constant-duration sync
+sleep are not findings."""
+
+import asyncio
+from time import sleep as nap
+
+
+def slow_helper(delay: float) -> None:
+    nap(delay)
+
+
+def quick_helper() -> None:
+    nap(0.01)
+
+
+async def handler(delay: float) -> None:
+    slow_helper(delay)
+    quick_helper()
+    await asyncio.sleep(0.1)

@@ -1,0 +1,48 @@
+"""Seeded spawned-thread residual: an untimed queue.get() on a worker
+thread is `live-unbounded-blocking`; the suppressed twin carries the
+reviewed block-ok rationale and passes. The producer pins put()'s
+shifted (item, block, timeout) signature — `put(x, True)` blocks
+forever and must flag, `put(x, True, 5.0)` is bounded — and the
+subprocess worker pins Popen's positional-timeout forms."""
+
+import queue
+import subprocess
+import threading
+
+_q: queue.Queue = queue.Queue()
+_q2: queue.Queue = queue.Queue()
+_q3: queue.Queue = queue.Queue(maxsize=4)
+
+
+def worker_bad() -> None:
+    while True:
+        _q.get()
+
+
+def worker_ok() -> None:
+    while True:
+        # tmlive: block-ok — dedicated consumer thread: parking on the
+        # queue is its whole job
+        _q2.get()
+
+
+def producer_bad(item) -> None:
+    _q3.put(item, True)  # block=True, NO timeout: parks forever
+
+
+def producer_ok(item) -> None:
+    _q3.put(item, True, 5.0)  # positional timeout bounds it
+
+
+def child_ok(cmd) -> None:
+    p = subprocess.Popen(cmd)
+    p.wait(30)  # positional timeout bounds the wait
+    p.communicate(None, 30)
+
+
+def start() -> None:
+    threading.Thread(target=worker_bad, daemon=True).start()
+    threading.Thread(target=worker_ok, daemon=True).start()
+    threading.Thread(target=producer_bad, daemon=True).start()
+    threading.Thread(target=producer_ok, daemon=True).start()
+    threading.Thread(target=child_ok, daemon=True).start()

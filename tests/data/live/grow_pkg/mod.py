@@ -1,0 +1,51 @@
+"""Seeded grow-unbounded: SEEN grows per request with no eviction.
+The three bounded twins — ring (deque maxlen), rotation (reassigned),
+reviewed annotation — must pass. SHADOWED pins the scoping rule: a
+LOCAL `SHADOWED = []` binding elsewhere must not register as a fake
+reset of the module global (the false-negative class tmrace's lockset
+walker fixed for lock scoping)."""
+
+from collections import deque
+from typing import Dict
+
+SEEN: Dict[str, int] = {}
+RING: deque = deque(maxlen=64)
+ROTATED: set = set()
+# tmlive: bounded=keyed by a fixed route-name set
+REGISTRY: Dict[str, int] = {}
+SHADOWED: Dict[str, int] = {}
+REBUILT: Dict[str, int] = {}
+FILTERED: Dict[str, int] = {}
+CROSS: Dict[str, int] = {}  # grown only from other.py
+
+
+async def handler(key: str) -> None:
+    global REBUILT
+    SEEN[key] = SEEN.get(key, 0) + 1
+    RING.append(key)
+    ROTATED.add(key)
+    REGISTRY[key] = 1
+    SHADOWED[key] = 1
+    # growth spelled as assignment: an additive self-rebuild must not
+    # double as its own reset proof
+    REBUILT = {**REBUILT, key: 1}
+    FILTERED[key] = 1
+
+
+def rotate() -> None:
+    global ROTATED
+    ROTATED = set()
+
+
+def evict_stale() -> None:
+    # a filtered copy references itself but IS eviction: a reset site
+    global FILTERED
+    FILTERED = {k: v for k, v in FILTERED.items() if v > 0}
+
+
+def unrelated_local() -> list:
+    # a plain local that happens to share the global's name: NOT a
+    # reset site for the module container
+    SHADOWED = []
+    SHADOWED.append(1)
+    return SHADOWED

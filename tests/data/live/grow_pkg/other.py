@@ -1,0 +1,15 @@
+"""Cross-module growth: CROSS is born in mod.py but only ever grown
+HERE — through both the from-import and the module-attr receiver
+shapes. Both must resolve onto mod.py's container identity and flag
+(no eviction site exists anywhere)."""
+
+from . import mod
+from .mod import CROSS
+
+
+async def cross_handler(key: str) -> None:
+    CROSS[key] = 1
+
+
+async def cross_attr_handler(key: str) -> None:
+    mod.CROSS[key] = 2

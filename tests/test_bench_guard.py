@@ -223,3 +223,33 @@ bench._emit_line()
     assert r.returncode == 0, (r.returncode, r.stderr)
     d = json.loads(r.stdout.strip())
     assert "stall" not in d["extra"]
+
+
+def test_tmlive_gate_row_never_initializes_jax():
+    """The tmlive_gate row lives in the banked CPU block BEFORE the
+    device probe: running it must never import jax (a wedged claim
+    hangs backend init — the whole reason the CPU block is banked
+    first). Run in a clean subprocess so this file's own imports don't
+    mask a violation."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+row = bench.bench_tmlive_gate()
+assert row["wall_s"] > 0 and "findings" in row and "suppressed" in row
+assert set(row["findings"]) == {
+    "live-block-under-lock", "live-block-in-main-loop",
+    "live-unbounded-blocking", "live-grow-unbounded",
+}
+assert "jax" not in sys.modules, "tmlive_gate dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout

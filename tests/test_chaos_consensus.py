@@ -223,6 +223,112 @@ def test_breaker_cycles_and_probe_bounded_under_intermittent_faults(
 # -- live consensus under chaos ---------------------------------------
 
 
+def test_rpc_heartbeat_responsive_under_gather_hang(device_seam):
+    """The dynamic twin of tmlive's `live-block-in-main-loop` proof: a
+    live 4-validator net serves RPC while `tpu.gather` HANG faults (5 s
+    hangs — fifty times the 0.1 s deadline) fire on the device seam.
+    The gather watchdog + breaker must contain every hang off the
+    event loop, so the HTTP /health and websocket heartbeats stay
+    responsive — bounded p99, no multi-second stall — while the chain
+    keeps committing. A wedge that parked the loop for even one raw
+    hang would blow the bound by an order of magnitude."""
+    import os
+
+    from tendermint_tpu.rpc.client import HTTPClient, WSClient
+    from tendermint_tpu.rpc.core import Environment
+    from tendermint_tpu.rpc.jsonrpc import JSONRPCServer
+    from .test_consensus_state import Node, RelayNet, fast_config
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+    target = 12
+
+    async def go():
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([i + 140]) * 32)
+            for i in range(4)
+        ]
+        genesis = GenesisDoc(
+            chain_id="heartbeat-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=10)
+                for p in privs
+            ],
+        )
+        nodes = [Node(p, genesis, cfg=fast_config()) for p in privs]
+        RelayNet(nodes)
+        env = Environment(
+            chain_id="heartbeat-chain",
+            block_store=nodes[0].block_store,
+            state_store=nodes[0].state_store,
+            consensus=nodes[0].cs,
+        )
+        srv = JSONRPCServer(env.routes())
+        await srv.start("127.0.0.1", 0)
+        addr = f"tcp://127.0.0.1:{srv.bound_port}"
+        http = HTTPClient(addr, timeout=5.0)
+        ws = WSClient(addr, timeout=5.0)
+        await ws.connect()
+        http_lat: list = []
+        ws_lat: list = []
+        stop = asyncio.Event()
+
+        async def heartbeat(client, out):
+            while not stop.is_set():
+                t0 = time.monotonic()
+                await client.call("health")
+                out.append(time.monotonic() - t0)
+                await asyncio.sleep(0.01)
+
+        for n in nodes:
+            await n.cs.start()
+        hb = [
+            asyncio.ensure_future(heartbeat(http, http_lat)),
+            asyncio.ensure_future(heartbeat(ws, ws_lat)),
+        ]
+        try:
+            await asyncio.gather(
+                *(
+                    n.cs.wait_for_height(target + 1, timeout=90.0)
+                    for n in nodes
+                )
+            )
+        finally:
+            stop.set()
+            await asyncio.gather(*hb, return_exceptions=True)
+            for n in nodes:
+                await n.cs.stop()
+            await ws.close()
+            await http.close()
+            await srv.stop()
+        return nodes, http_lat, ws_lat
+
+    os.environ["TM_TPU_GATHER_DEADLINE_S"] = "0.1"
+    try:
+        with sigcache.disabled(), \
+                faults.inject("tpu.gather", mode="hang", p=0.25, seed=31,
+                              hang_s=5.0):
+            nodes, http_lat, ws_lat = asyncio.run(go())
+    finally:
+        del os.environ["TM_TPU_GATHER_DEADLINE_S"]
+
+    # the chaos was real and contained: the chain lived through it
+    assert min(n.block_store.height() for n in nodes) >= target
+    assert T.stats()["faults"] > 0
+    # bounded heartbeat: both transports kept answering, p99 far below
+    # the 5 s hang the watchdog swallowed (each faulted gather may park
+    # the loop for at most the 0.1 s deadline, never the hang)
+    for name, lat in (("http", http_lat), ("ws", ws_lat)):
+        # beat count: a 12-height fast-config run spans a couple of
+        # seconds; a loop that swallowed even one raw 5 s hang would
+        # deliver a fraction of this
+        assert len(lat) >= 10, f"{name} heartbeat starved: {len(lat)} beats"
+        lat_sorted = sorted(lat)
+        p99 = lat_sorted[max(0, int(len(lat_sorted) * 0.99) - 1)]
+        assert p99 < 1.0, f"{name} heartbeat p99 {p99:.3f}s under faults"
+
+
 def test_live_consensus_commits_identically_under_faults(device_seam):
     """A real 4-validator network (in-process gossip) runs 8 heights
     while raise+hang faults fire mid-flight on the device seam: every

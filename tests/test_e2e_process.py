@@ -43,7 +43,19 @@ def test_process_net_sigkill_recovery(tmp_path):
     and sqlite stores are reopened by a fresh process, the ABCI
     handshake replays against the still-running app, and the network
     converges with no fork (the crash path the in-process runner
-    cannot exercise)."""
+    cannot exercise).
+
+    History: this test stalled on the seed (the restarted validator
+    wedged at its boot height while the net ran ~270 heights ahead).
+    Root cause — diagnosed with tmlive's thread-root/reachability
+    substrate and debug-level process logs — was NOT a blocking site
+    but catchup-vote loss: the reborn node announces its height while
+    its consensus reactor is still in wait_sync (blocksync grace), the
+    peers stream the stored-commit precommits into the void and mark
+    them delivered, and nothing ever resends. Fixed by the gossip-votes
+    stall-reset in consensus/reactor.py (`vote_catchup_stall`); the
+    deterministic regression lives at tests/test_reactors.py::
+    test_catchup_votes_dropped_during_wait_sync_are_resent."""
     m = Manifest.parse(
         {
             "chain_id": "proc-kill-ci",

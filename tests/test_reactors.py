@@ -224,6 +224,59 @@ def test_tx_gossip_and_commit_over_p2p():
     run(go())
 
 
+def test_catchup_votes_dropped_during_wait_sync_are_resent():
+    """Regression for the process-net SIGKILL wedge: a restarted
+    validator announces its height while its consensus reactor is
+    still in wait_sync (the blocksync grace window), the peers stream
+    the stored-commit precommits for that height into the void —
+    marking them delivered — and when the node finally switches to
+    consensus nobody ever resends, wedging it at its boot height
+    forever while the net runs ahead. The gossip-votes stall-reset
+    (reactor.py `vote_catchup_stall`, the votes-side twin of
+    `_gossip_catchup_part`'s forget-and-resend) must recover it.
+
+    Deterministic form of the race: the laggard joins in wait_sync
+    with its blocksync switch HELD for long enough that the peers
+    exhaust (and mark) every catchup precommit, then switches."""
+
+    async def go():
+        net, nodes = make_cluster(4)
+        laggard = nodes[3]
+        for node in nodes[:3]:
+            await node.start()
+        await net.start()
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout=60.0) for n in nodes[:3])
+            )
+            # hold the laggard's blocksync: never caught up, nothing to
+            # apply — its consensus reactor stays wait_sync, DROPPING
+            # every catchup vote/part the peers now stream and mark
+            laggard.cs_reactor.wait_sync = True
+            laggard.bs_reactor.block_sync = True
+            laggard.bs_reactor.pool.is_caught_up = lambda: False
+            laggard.bs_reactor.pool.peek_two_blocks = lambda: (None, None)
+            await laggard.start()
+            # long enough for the peers' gossip (tick 0.01 s) to drain
+            # all 4 precommits of the laggard's height into the void
+            await asyncio.sleep(1.5)
+            assert laggard.cs.rs.height <= 2  # still parked
+            # blocksync "finishes" (its pool saw nothing): switch
+            await laggard.bs_reactor._switch_to_consensus()
+            # without the stall-reset this wedges forever; with it the
+            # peers forget their delivered-marks after ~1 s and resend
+            await laggard.cs.wait_for_height(3, timeout=30.0)
+        finally:
+            await stop_cluster(net, nodes)
+        for height in range(1, 3):
+            assert (
+                laggard.block_store.load_block(height).hash()
+                == nodes[0].block_store.load_block(height).hash()
+            )
+
+    run(go())
+
+
 def test_lagging_node_catches_up():
     async def go():
         net, nodes = make_cluster(4)

@@ -1,0 +1,482 @@
+"""tmlive: the whole-program liveness & boundedness gate.
+
+Four jobs: (1) run tmlive over the whole package on every tier-1
+invocation, failing on anything beyond the (empty) live baseline —
+the static form of "the serving path never stalls and never grows
+without bound"; (2) unit-test the analysis against the seeded
+mini-packages in tests/data/live/ (each proven to turn the gate red);
+(3) pin the blocking catalog's resolution machinery (alias evasion,
+await exclusion, receiver-birth typing) and the boundedness
+recognizers; (4) cross-check lockwatch's witnessed hold-budget
+overruns against the static proof — every overrun must be explained.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.analysis import lockwatch, tmlive
+from tendermint_tpu.analysis.tmlint import (
+    Violation,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from tendermint_tpu.analysis.tmcheck.callgraph import build_package
+from tendermint_tpu.analysis.tmlive import blockcat, holdflow
+from tendermint_tpu.analysis.tmlive.holdflow import (
+    OVERRUN_OK,
+    crosscheck_overruns,
+)
+from tendermint_tpu.analysis.tmrace.lockorder import STATIC_RANK_NAMES
+from tendermint_tpu.analysis.tmrace.threadroots import MAIN_IDENTITY
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "live")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fixture_report(name: str):
+    pkg = build_package(os.path.join(FIXTURES, name))
+    return tmlive.analyze(pkg)
+
+
+# ---------------------------------------------------------------------------
+# THE gate: whole package against the checked-in (empty) baseline
+
+
+@pytest.fixture(scope="module")
+def head_report():
+    t0 = time.monotonic()
+    rep = tmlive.analyze()
+    rep.elapsed_s = time.monotonic() - t0
+    return rep
+
+
+def test_package_clean_against_baseline(head_report):
+    """tmlive over the whole package; anything beyond
+    tmlive/live_baseline.json fails tier-1 — fix it, suppress it with
+    a justified `# tmlive: block-ok`/`grow-ok`/`bounded=`, or
+    consciously re-baseline (docs/static_analysis.md)."""
+    new = new_violations(
+        head_report.violations, load_baseline(tmlive.LIVE_BASELINE_PATH)
+    )
+    assert not new, "new tmlive violations:\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_live_baseline_is_checked_in_and_empty():
+    """Every true positive the first full run surfaced was fixed (the
+    replay console's input() on the event loop now takes an executor
+    hop) or carries an in-file justified annotation (WAL fsync
+    protocol rationale, watchdog park, probe-triple/native-lib
+    bounded= keys), so the baseline must stay empty — new findings
+    fail loudly, not silently grandfather."""
+    assert os.path.exists(tmlive.LIVE_BASELINE_PATH)
+    assert load_baseline(tmlive.LIVE_BASELINE_PATH) == {}
+
+
+def test_full_package_run_under_budget(head_report):
+    """Runtime budget: the live pass runs on every tier-1 invocation
+    and must stay under 10 s for the whole package (measured ~7 s,
+    call-graph build + lockset propagation included). Times the
+    module fixture's run rather than paying a second full analyze."""
+    assert head_report.elapsed_s < 10.0, (
+        f"tmlive full-package run took {head_report.elapsed_s:.1f}s"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the head catalog covers the sites the gate exists for
+
+
+def test_head_catalog_covers_known_delicate_sites(head_report):
+    """The reviewed catalog actually sees the sites ROADMAP's serving
+    story hinges on: the WAL fsyncs (suppressed with protocol
+    rationale, still cataloged unbounded), and the gather watchdog's
+    park (suppressed residual)."""
+    by_site = {
+        (s.path, s.primitive): s
+        for s in head_report.sites
+    }
+    wal_fsyncs = [
+        s for s in head_report.sites
+        if s.path == "consensus/wal.py" and s.primitive == "os.fsync"
+    ]
+    assert len(wal_fsyncs) >= 3  # flush_and_sync, on_stop, _rotate
+    assert all(s.kind == blockcat.UNBOUNDED for s in wal_fsyncs)
+    assert ("crypto/tpu_verifier.py", "threading.Event.wait") in by_site
+    # the fault plane's injected hang is cataloged (and suppressed)
+    assert ("crypto/faults.py", "time.sleep") in by_site
+    # suppressions were exercised, not vacuous
+    assert head_report.stats["suppressed"] >= 5
+
+
+def test_head_wal_fsync_reachable_from_main_loop(head_report):
+    """The consensus WAL's flush routine is a main-loop root and its
+    fsync edges resolve — the suppression is covering a REAL reachable
+    site, not dead code (the `self.wal: WAL` annotation in state.py
+    exists for this)."""
+    ids = head_report.identities.get(
+        ("consensus/wal.py", "WAL.flush_and_sync"), set()
+    )
+    assert MAIN_IDENTITY in ids
+    ids = head_report.identities.get(
+        ("consensus/wal.py", "WAL.write_sync"), set()
+    )
+    assert MAIN_IDENTITY in ids
+
+
+def test_head_growth_catalog_sees_bounded_idioms(head_report):
+    """The boundedness recognizers classify the in-tree idioms: the
+    trace ring (deque maxlen), the sigcache generations (rotation),
+    and the annotated probe-triple/native-lib registries."""
+    containers = head_report.containers
+    ring = containers.get(("g", "libs/trace.py", "_ring"))
+    assert ring is not None and ring.ring
+    gen0 = containers.get(("g", "crypto/sigcache.py", "_gen0"))
+    assert gen0 is not None and gen0.shrinks
+    probe = containers.get(("g", "crypto/tpu_verifier.py", "_PROBE_TRIPLES"))
+    assert probe is not None
+    # annotated bounded= (grow line) — rooted grows but no finding
+    assert any(g.key in head_report.identities for g in probe.grows)
+
+
+def test_replay_console_does_not_block_the_loop():
+    """Regression for the first-run finding tmlive fixed: the WAL
+    replay console reads stdin on a daemon thread (the abci-console
+    idiom) — never input() on the event loop, and never a
+    default-executor hop whose teardown would make Ctrl-C hang until
+    the operator pressed Enter."""
+    import ast
+
+    path = os.path.join(REPO, "tendermint_tpu", "cmd", "commands.py")
+    src = open(path).read()
+    tree = ast.parse(src)
+    fn = next(
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.AsyncFunctionDef)
+        and n.name == "_replay_console"
+    )
+
+    def body_calls(node):
+        # the coroutine's OWN statements: nested defs (the reader
+        # thread target, where input() is allowed) are separate scopes
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Call):
+                yield ast.unparse(n.func)
+            stack.extend(ast.iter_child_nodes(n))
+
+    calls = list(body_calls(fn))
+    assert "input" not in calls
+    assert not any("run_in_executor" in c for c in calls)
+    # the console reads through the shared daemon-reader helper…
+    assert "_stdin_reader_queue" in calls
+    # …which spawns a daemon thread (one implementation serves both
+    # the replay and abci consoles)
+    helper = next(
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+        and n.name == "_stdin_reader_queue"
+    )
+    threads = [
+        c for c in ast.walk(helper)
+        if isinstance(c, ast.Call)
+        and ast.unparse(c.func).endswith("Thread")
+    ]
+    assert threads and any(
+        kw.arg == "daemon"
+        and isinstance(kw.value, ast.Constant)
+        and kw.value.value is True
+        for kw in threads[0].keywords
+    )
+
+
+# ---------------------------------------------------------------------------
+# seeded fixtures: each family proven to turn the gate red
+
+
+def test_fixture_block_under_lock_flagged():
+    rep = _fixture_report("block_lock_pkg")
+    assert [v.rule for v in rep.violations] == ["live-block-under-lock"]
+    v = rep.violations[0]
+    assert v.line == 14 and "os.fsync" in v.message
+    assert "_lock" in v.message  # names the held lock
+    # the timed wait under the same lock is bounded, not a finding —
+    # but its lock IS recorded so a runtime hold-budget overrun on it
+    # has a truthful static explanation (not a false "pure memory
+    # ops" OVERRUN_OK claim)
+    assert rep.stats["sites_bounded"] == 1
+    assert "mod.py:_lock" in rep.suppressed_locks
+
+
+def test_fixture_block_in_main_loop_flagged_through_alias():
+    """`from time import sleep as nap` cannot evade the catalog, and
+    the finding lands on the helper the async handler reaches — with
+    the main-loop witness chain."""
+    rep = _fixture_report("block_loop_pkg")
+    assert [v.rule for v in rep.violations] == ["live-block-in-main-loop"]
+    v = rep.violations[0]
+    assert v.line == 11 and "time.sleep" in v.message
+    assert "handler" in v.message and "slow_helper" in v.message
+    # constant-duration sleep is bounded; awaited asyncio.sleep is not
+    # even a site
+    assert rep.stats["sites_total"] == 2
+    assert rep.stats["sites_bounded"] == 1
+
+
+def test_fixture_unbounded_blocking_residual_and_suppression():
+    rep = _fixture_report("block_thread_pkg")
+    assert [v.rule for v in rep.violations] == [
+        "live-unbounded-blocking",  # untimed get
+        "live-unbounded-blocking",  # put(item, True) — shifted args
+    ]
+    assert "queue.Queue.get" in rep.violations[0].message
+    # put()'s leading item must not be misread as the block flag nor
+    # its block flag as a timeout
+    assert "queue.Queue.put" in rep.violations[1].message
+    # bounded twins: put(x, True, 5.0), Popen.wait(30),
+    # Popen.communicate(None, 30) — positional timeouts all recognized
+    assert rep.stats["sites_bounded"] == 3
+    # the block-ok twin passed and was counted
+    assert rep.stats["suppressed"] == 1
+
+
+def test_fixture_grow_unbounded_flagged_with_bounded_twins():
+    rep = _fixture_report("grow_pkg")
+    assert [v.rule for v in rep.violations] == [
+        "live-grow-unbounded"
+    ] * 5
+    assert "`SEEN`" in rep.violations[0].message
+    # the scoping rule: a LOCAL `SHADOWED = []` binding in an
+    # unrelated function is neither a reset of the module global nor a
+    # grow site against it — the global still flags
+    assert "`SHADOWED`" in rep.violations[1].message
+    # growth spelled as assignment: `REBUILT = {**REBUILT, k: 1}` is
+    # an additive rebuild, not a reset that proves itself bounded
+    assert "`REBUILT`" in rep.violations[2].message
+    assert "additive rebuild" in rep.violations[2].message
+    # cross-module growth resolves onto the birthing module's
+    # identity through BOTH receiver shapes (from-import, module-attr)
+    assert rep.violations[3].path == "other.py"
+    assert "`CROSS`" in rep.violations[3].message
+    assert rep.violations[4].path == "other.py"
+    assert "mod.CROSS" in rep.violations[4].message
+    # ring + rotation + annotation + filtered-copy twins all bounded
+    # (a self-referential COMPREHENSION is eviction, not growth)
+    assert rep.stats["containers_bounded"] == 4
+    reasons = {
+        c.var[2]: c.bounded_reason
+        for c in rep.containers.values()
+        if c.bounded_reason
+    }
+    assert reasons.get("RING") == "ring (deque maxlen)"
+    assert "rotation" in reasons.get("ROTATED", "")
+    assert "route-name set" in reasons.get("REGISTRY", "")
+    assert "rotation" in reasons.get("FILTERED", "")
+
+
+def test_fixture_baseline_round_trip(tmp_path):
+    """save_baseline over a red fixture turns the diff green without
+    touching the real baseline; a NEW (different-line) finding still
+    fails."""
+    rep = _fixture_report("grow_pkg")
+    path = str(tmp_path / "live_baseline.json")
+    save_baseline(rep.violations, path, note=tmlive.LIVE_BASELINE_NOTE)
+    assert new_violations(rep.violations, load_baseline(path)) == []
+    extra = rep.violations + [
+        Violation(
+            rule="live-grow-unbounded", path="mod.py", line=99, col=0,
+            message="seeded new finding", source="OTHER[k] = v",
+        )
+    ]
+    assert len(new_violations(extra, load_baseline(path))) == 1
+
+
+# ---------------------------------------------------------------------------
+# the lockwatch cross-check: witnessed overruns must be explained
+
+
+def test_overrun_ok_names_only_ranked_locks():
+    """OVERRUN_OK's scheduler-noise claims are per RANK name; a typo'd
+    or stale entry (a lock that no longer exists in the rank table)
+    would silently explain nothing."""
+    assert set(OVERRUN_OK) <= set(lockwatch.RANK)
+
+
+def test_crosscheck_explains_known_locks_and_flags_unknown():
+    holds = [
+        {"name": "sigcache.rotate", "held_s": 0.5, "budget_s": 0.25,
+         "thread": "T", "where": "sigcache.py:1"},
+        {"name": "mystery.lock", "held_s": 0.5, "budget_s": 0.25,
+         "thread": "T", "where": "x.py:1"},
+    ]
+    out = crosscheck_overruns(holds, set(), set())
+    assert len(out) == 1 and out[0]["name"] == "mystery.lock"
+    assert "OVERRUN_OK" in out[0]["why"]
+
+
+def test_crosscheck_accepts_statically_flagged_and_suppressed():
+    """An overrun on a lock tmlive flagged (or suppressed) a blocking
+    site under IS explained: the stall is known and reviewed."""
+    holds = [
+        {"name": "mystery.lock", "held_s": 1.0, "budget_s": 0.25,
+         "thread": "T", "where": "x.py:1"},
+    ]
+    assert crosscheck_overruns(holds, {"mystery.lock"}, set()) == []
+    assert crosscheck_overruns(holds, set(), {"mystery.lock"}) == []
+    # a RANK-named overrun maps through STATIC_RANK_NAMES onto the
+    # static lock identity the flag set uses
+    static_name = next(
+        s for s, r in STATIC_RANK_NAMES.items() if r == "breaker.instance"
+    )
+    holds = [
+        {"name": "breaker.instance", "held_s": 1.0, "budget_s": 0.25,
+         "thread": "T", "where": "breaker.py:1"},
+    ]
+    assert crosscheck_overruns(
+        holds, {static_name}, set(), overrun_ok={}
+    ) == []
+
+
+def test_witnessed_overruns_statically_explained(head_report):
+    """The live cross-check: every hold-budget overrun lockwatch has
+    witnessed in THIS process (the chaos/fault/fuzz suites run under
+    it) is either a tmlive-known blocking site or covered by a
+    reviewed OVERRUN_OK rationale. An unexplained overrun means the
+    catalog is missing a blocking primitive — fail loudly."""
+    unexplained = crosscheck_overruns(
+        lockwatch.HOLD_LOG,
+        head_report.flagged_locks,
+        head_report.suppressed_locks,
+    )
+    assert not unexplained, unexplained
+
+
+def test_hold_log_records_structured_overruns(monkeypatch):
+    """The runtime half produces records the cross-check can consume
+    (name, acquisition site, durations, thread) — and feeds the
+    process-global HOLD_LOG only when the watch is the ACTIVE one, so
+    standalone unit-test watches with synthetic lock names never
+    demand OVERRUN_OK entries."""
+    import threading
+
+    watch = lockwatch.LockWatch(hold_budget_s=0.0)
+    standalone = lockwatch._WatchedLock(
+        watch, threading.Lock(), "test.overrun"
+    )
+    before = len(lockwatch.HOLD_LOG)
+    with standalone:
+        time.sleep(0.002)
+    report = watch.report()
+    assert report.long_holds and report.long_holds[0]["name"] == "test.overrun"
+    rec = report.long_holds[0]
+    assert {"name", "where", "held_s", "budget_s", "thread"} <= set(rec)
+    # standalone watch: per-watch record only, global log untouched
+    assert len(lockwatch.HOLD_LOG) == before
+    # the ACTIVE watch DOES feed the global log
+    active = lockwatch.LockWatch(hold_budget_s=0.0)
+    monkeypatch.setattr(lockwatch, "_ACTIVE", active)
+    lock2 = lockwatch._WatchedLock(active, threading.Lock(), "test.overrun")
+    with lock2:
+        time.sleep(0.002)
+    assert len(lockwatch.HOLD_LOG) == before + 1
+    assert lockwatch.HOLD_LOG[-1]["name"] == "test.overrun"
+    # keep the global log clean for the cross-check test: this
+    # synthetic overrun names a lock OVERRUN_OK doesn't know
+    lockwatch.HOLD_LOG.pop()
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (scripts/lint.py --live)
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _load_lint_module():
+    spec = importlib.util.spec_from_file_location(
+        "lint_cli_live", os.path.join(REPO, "scripts", "lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_cli_live_clean_exit_zero():
+    r = _run_cli("--live", "--stats")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[live]" in r.stdout
+
+
+def test_cli_live_seeded_violation_exit_one(monkeypatch):
+    """The exit contract end to end: a live finding beyond the (empty)
+    baseline exits 1 through the real main()."""
+    lint = _load_lint_module()
+    seeded = [
+        Violation(
+            rule="live-block-in-main-loop",
+            path="rpc/fake.py",
+            line=1,
+            col=0,
+            message="seeded blocking call on the event loop",
+            source="time.sleep(x)",
+        )
+    ]
+    monkeypatch.setattr(
+        lint.tmlive, "live_violations", lambda pkg=None, **kw: seeded
+    )
+    monkeypatch.setattr(
+        lint.tmcheck, "build_package", lambda root=None: None
+    )
+    assert lint.main(["--live"]) == 1
+    seeded[0] = Violation(
+        rule="live-grow-unbounded",
+        path="rpc/fake.py",
+        line=1,
+        col=0,
+        message="seeded unbounded growth",
+        source="SEEN[k] = v",
+    )
+    assert lint.main(["--live"]) == 1
+
+
+def test_cli_live_baseline_update_refuses_filtered_runs():
+    r = _run_cli("--live", "--baseline-update", "--rule", "det-float")
+    assert r.returncode == 2
+    assert "full-package" in r.stderr
+    r = _run_cli(
+        "--live", "--baseline-update", "tendermint_tpu/crypto/faults.py"
+    )
+    assert r.returncode == 2
+
+
+def test_cli_update_modes_refuse_live():
+    """--schema-update / --signatures-update combined with --live would
+    silently skip the live gate while exiting 0 — same laundering class
+    the PR-5/PR-8 refusal matrix closed."""
+    r = _run_cli("--schema-update", "--live")
+    assert r.returncode == 2 and "--live" in r.stderr
+    r = _run_cli("--signatures-update", "--live")
+    assert r.returncode == 2 and "--live" in r.stderr
+
+
+def test_cli_list_rules_includes_live():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rid, _title in tmlive.RULES:
+        assert rid in r.stdout
