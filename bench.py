@@ -540,6 +540,35 @@ def bench_tmlive_gate():
     }
 
 
+def bench_tmsafe_gate():
+    """Full tmsafe adversarial-input gate (scripts/lint.py --adv):
+    wall time plus per-rule finding and suppression counts, recorded
+    in every BENCH_* line so a gate-runtime regression (or a decode
+    sink slipping into the wire path) shows up next to the numbers it
+    guards. Pure stdlib AST over the package — banked CPU block,
+    never initializes jax (pinned by tests/test_bench_guard.py)."""
+    from tendermint_tpu.analysis import tmsafe
+
+    t0 = time.perf_counter()
+    rep = tmsafe.analyze()
+    wall = time.perf_counter() - t0
+    # the gate already publishes per-rule counts in its stats — read
+    # them rather than re-deriving, so this row can never diverge from
+    # the gate's own numbers
+    per_rule = {
+        rid: rep.stats.get(f"findings[{rid}]", 0)
+        for rid, _ in tmsafe.RULES
+    }
+    return {
+        "wall_s": round(wall, 2),
+        "findings": per_rule,
+        "suppressed": rep.stats.get("suppressed", 0),
+        "entries": rep.stats.get("entries", 0),
+        "region": rep.stats.get("region", 0),
+        "sinks_cataloged": rep.stats.get("sinks_cataloged", 0),
+    }
+
+
 def _build_light_chain(chain_id: str, n_heights: int, n_vals: int):
     """A verifiable chain of LightBlocks 1..n_heights with a static
     n_vals validator set (the BASELINE config-4 shape)."""
@@ -1465,6 +1494,12 @@ def main() -> None:
         "tmlive_gate",
         bench_tmlive_gate,
         "tmlive_gate",
+        120.0,
+    )
+    cpu_stage(
+        "tmsafe_gate",
+        bench_tmsafe_gate,
+        "tmsafe_gate",
         120.0,
     )
     cpu_stage(

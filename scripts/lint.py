@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""tmlint + tmcheck + tmrace + tmtrace + tmlive CLI — the
+"""tmlint + tmcheck + tmrace + tmtrace + tmlive + tmsafe CLI — the
 consensus-invariant static analyzers.
 
 Usage:
     python scripts/lint.py                    # full gate: tmlint +
                                               # tmcheck + tmrace +
-                                              # tmtrace + tmlive
+                                              # tmtrace + tmlive +
+                                              # tmsafe
     python scripts/lint.py --rule det-float   # one tmlint rule class only
     python scripts/lint.py --taint            # tmcheck taint pass only
     python scripts/lint.py --schema           # tmcheck schema gate only
@@ -13,6 +14,8 @@ Usage:
                                               # lock-order pass only
     python scripts/lint.py --live             # tmlive liveness +
                                               # boundedness pass only
+    python scripts/lint.py --adv              # tmsafe adversarial-input
+                                              # safety pass only
     python scripts/lint.py --memo-audit       # memo-soundness audit
                                               # only (prints the full
                                               # memoized-function list)
@@ -27,7 +30,8 @@ Usage:
     python scripts/lint.py --no-baseline      # every violation, raw
     python scripts/lint.py --baseline-update  # re-accept current state
                                               # (tmlint, taint, race,
-                                              # trace AND live baselines)
+                                              # trace, live AND safe
+                                              # baselines)
     python scripts/lint.py --schema-update    # regenerate the golden
                                               # wire-schema table
     python scripts/lint.py --signatures-update  # regenerate the golden
@@ -48,7 +52,8 @@ Baselines: tendermint_tpu/analysis/baseline.json (tmlint),
 tendermint_tpu/analysis/tmcheck/taint_baseline.json (taint),
 tendermint_tpu/analysis/tmrace/race_baseline.json (race),
 tendermint_tpu/analysis/tmtrace/trace_baseline.json (trace),
-tendermint_tpu/analysis/tmlive/live_baseline.json (live), and the
+tendermint_tpu/analysis/tmlive/live_baseline.json (live),
+tendermint_tpu/analysis/tmsafe/safe_baseline.json (adv), and the
 golden tables tendermint_tpu/analysis/tmcheck/schema.json +
 tendermint_tpu/analysis/tmtrace/jit_signatures.json.
 --baseline-update / --schema-update / --signatures-update refuse
@@ -57,7 +62,12 @@ file). docs/static_analysis.md documents the workflow and the
 suppression policy (`# tmlint: disable=<rule>`, `# tmcheck:
 taint-ok/taint-break`, `# tmcheck: unparsed=N/unwritten=N`,
 `# tmrace: race-ok/guarded-by`, `# tmtrace: trace-ok`,
-`# tmlive: block-ok/grow-ok/bounded=`).
+`# tmlive: block-ok/grow-ok/bounded=`, `# tmsafe: <rule>-ok`).
+
+The full gate parses the package ONCE: the tmcheck call-graph build is
+the shared substrate every section (including tmlint's syntactic rules
+and the schema extraction) reads its module trees from; --stats
+reports the full-gate wall and the substrate build time.
 """
 
 from __future__ import annotations
@@ -74,6 +84,7 @@ from tendermint_tpu.analysis import (  # noqa: E402
     tmlint,
     tmlive,
     tmrace,
+    tmsafe,
     tmtrace,
 )
 
@@ -120,6 +131,10 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--live", action="store_true",
         help="run only the tmlive liveness + boundedness pass",
+    )
+    ap.add_argument(
+        "--adv", action="store_true",
+        help="run only the tmsafe adversarial-input safety pass",
     )
     ap.add_argument(
         "--memo-audit", action="store_true", dest="memo_audit",
@@ -170,6 +185,8 @@ def main(argv=None) -> int:
             print(f"{rid}: {title}")
         for rid, title in tmlive.RULES:
             print(f"{rid}: {title}")
+        for rid, title in tmsafe.RULES:
+            print(f"{rid}: {title}")
         return 0
 
     filtered = bool(args.rules or args.paths)
@@ -200,17 +217,18 @@ def main(argv=None) -> int:
         or args.taint
         or args.race
         or args.live
+        or args.adv
         or args.memo_audit
         or trace_selected
     ):
         # same hazard: the golden table covers EVERY codec module (and
-        # combining with --taint/--race/--live/--memo-audit/--trace
-        # would silently skip that gate while returning 0 — the update
-        # mode below disables them)
+        # combining with --taint/--race/--live/--adv/--memo-audit/
+        # --trace would silently skip that gate while returning 0 —
+        # the update mode below disables them)
         print(
             "error: --schema-update requires a full-package run "
-            "(drop --rule/--taint/--race/--live/--memo-audit/--trace "
-            "and path arguments)",
+            "(drop --rule/--taint/--race/--live/--adv/--memo-audit/"
+            "--trace and path arguments)",
             file=sys.stderr,
         )
         return 2
@@ -220,6 +238,7 @@ def main(argv=None) -> int:
         or args.schema
         or args.race
         or args.live
+        or args.adv
         or args.memo_audit
         or trace_selected
         or args.schema_update
@@ -229,8 +248,9 @@ def main(argv=None) -> int:
         # run would silently skip the named gate while returning 0
         print(
             "error: --signatures-update requires a full-package run "
-            "(drop --rule/--taint/--schema/--race/--live/--memo-audit/"
-            "--trace/other update modes and path arguments)",
+            "(drop --rule/--taint/--schema/--race/--live/--adv/"
+            "--memo-audit/--trace/other update modes and path "
+            "arguments)",
             file=sys.stderr,
         )
         return 2
@@ -240,6 +260,7 @@ def main(argv=None) -> int:
         or args.schema
         or args.race
         or args.live
+        or args.adv
         or args.memo_audit
         or trace_selected
     )
@@ -249,6 +270,7 @@ def main(argv=None) -> int:
         "schema": args.schema,
         "race": args.race,
         "live": args.live,
+        "adv": args.adv,
         "memo": args.memo_audit,
         "trace": trace_selected,
     }
@@ -263,6 +285,7 @@ def main(argv=None) -> int:
     run_schema = _only("schema")
     run_race = _only("race")
     run_live = _only("live")
+    run_adv = _only("adv")
     run_memo = _only("memo")
     run_trace = _only("trace")
     # update modes run ONLY the sections they update: computing (then
@@ -276,6 +299,7 @@ def main(argv=None) -> int:
         run_taint = False
         run_race = False
         run_live = False
+        run_adv = False
         run_memo = False
         run_trace = False
     if args.signatures_update:
@@ -284,13 +308,33 @@ def main(argv=None) -> int:
         run_schema = False
         run_race = False
         run_live = False
+        run_adv = False
         run_memo = False
         run_trace = False
 
     t0 = time.monotonic()
     violations = []
     new = []
+    # the shared substrate: ONE parse of the package serves the call
+    # graph, tmlint's syntactic rules, and the schema extraction —
+    # with 8 sections, re-parsing per section was the gate's single
+    # largest fixed cost
+    pkg = None
+    substrate_s = 0.0
+    needs_graph = (
+        run_taint
+        or run_race
+        or run_live
+        or run_adv
+        or run_memo
+        or run_trace
+        or args.signatures_update
+    )
     try:
+        if needs_graph:
+            t_sub = time.monotonic()
+            pkg = tmcheck.build_package()
+            substrate_s = time.monotonic() - t_sub
         if run_tmlint:
             if args.paths:
                 root = tmlint.package_root()
@@ -307,7 +351,9 @@ def main(argv=None) -> int:
                         tmlint.check_file(abspath, rel, args.rules)
                     )
             else:
-                violations.extend(tmlint.check_package(rules=args.rules))
+                violations.extend(
+                    tmlint.check_package(rules=args.rules, pkg=pkg)
+                )
             if args.baseline_update:
                 counts = tmlint.save_baseline(violations, args.baseline)
                 print(
@@ -324,9 +370,8 @@ def main(argv=None) -> int:
                     )
                 )
 
-        pkg = None
         if run_taint:
-            pkg = tmcheck.build_package()
+            pkg = pkg or tmcheck.build_package()
             taint_v = tmcheck.taint_violations(pkg)
             violations.extend(taint_v)
             if args.baseline_update:
@@ -392,6 +437,32 @@ def main(argv=None) -> int:
                     tmlint.new_violations(
                         live_v,
                         tmlint.load_baseline(tmlive.LIVE_BASELINE_PATH),
+                    )
+                )
+
+        if run_adv:
+            # same single-pass rule as tmrace/tmlive
+            adv_pkg = pkg or tmcheck.build_package()
+            pkg = adv_pkg
+            adv_v = tmsafe.safe_violations(adv_pkg)
+            violations.extend(adv_v)
+            if args.baseline_update:
+                counts = tmlint.save_baseline(
+                    adv_v,
+                    tmsafe.SAFE_BASELINE_PATH,
+                    note=tmsafe.SAFE_BASELINE_NOTE,
+                )
+                print(
+                    f"safe baseline updated: {len(counts)} fingerprints "
+                    f"-> {tmsafe.SAFE_BASELINE_PATH}"
+                )
+            elif args.no_baseline:
+                new.extend(adv_v)
+            else:
+                new.extend(
+                    tmlint.new_violations(
+                        adv_v,
+                        tmlint.load_baseline(tmsafe.SAFE_BASELINE_PATH),
                     )
                 )
 
@@ -472,7 +543,7 @@ def main(argv=None) -> int:
             )
 
         if args.schema_update:
-            data = tmcheck.update_schema_golden()
+            data = tmcheck.update_schema_golden(pkg=pkg)
             print(
                 f"golden schema updated: {len(data['messages'])} messages "
                 f"-> {tmcheck.GOLDEN_PATH}"
@@ -480,7 +551,7 @@ def main(argv=None) -> int:
         elif run_schema:
             # the golden table IS the schema baseline: drift always
             # fails, --no-baseline changes nothing here
-            schema_v = tmcheck.schema_violations()
+            schema_v = tmcheck.schema_violations(pkg=pkg)
             violations.extend(schema_v)
             new.extend(schema_v)
     except (ValueError, OSError, SyntaxError) as e:
@@ -511,6 +582,7 @@ def main(argv=None) -> int:
                 ("schema", run_schema),
                 ("race", run_race),
                 ("live", run_live),
+                ("adv", run_adv),
                 ("memo", run_memo),
                 ("trace", run_trace),
             )
@@ -518,7 +590,14 @@ def main(argv=None) -> int:
         ]
         print(
             f"-- [{'+'.join(sections)}] {len(violations)} total violations "
-            f"({len(new)} new), {elapsed:.2f}s --"
+            f"({len(new)} new), full-gate wall {elapsed:.2f}s"
+            + (
+                f" (substrate: {len(pkg.modules)} modules parsed once, "
+                f"{substrate_s:.2f}s)"
+                if pkg is not None
+                else ""
+            )
+            + " --"
         )
         for rid in sorted(per_rule):
             print(f"   {rid}: {per_rule[rid]}")
@@ -529,7 +608,8 @@ def main(argv=None) -> int:
             "suppression/annotation (# tmlint: disable=..., # tmcheck: "
             "taint-ok/taint-break/unparsed=N, # tmrace: "
             "race-ok/guarded-by=..., # tmtrace: trace-ok, "
-            "# tmlive: block-ok/grow-ok/bounded=...), or for "
+            "# tmlive: block-ok/grow-ok/bounded=..., "
+            "# tmsafe: <rule>-ok), or for "
             "consciously accepted changes run scripts/lint.py "
             "--baseline-update / --schema-update / --signatures-update.",
             file=sys.stderr,

@@ -53,6 +53,10 @@ def _dec_event(data: bytes) -> T.Event:
     attrs = []
     for f, _wt, v in iter_fields(data):
         if f == 1:
+            if not isinstance(v, bytes):
+                # wire-type flip: sanctioned parse error, not an
+                # AttributeError escaping the handler stack
+                raise ValueError("Event.type: expected length-delimited")
             etype = v.decode()
         elif f == 2:
             r = FieldReader(v)

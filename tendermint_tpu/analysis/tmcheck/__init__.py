@@ -153,7 +153,9 @@ def update_taint_baseline(
 
 
 def update_schema_golden(
-    root: Optional[str] = None, path: Optional[str] = None
+    root: Optional[str] = None,
+    path: Optional[str] = None,
+    pkg: Optional[Package] = None,
 ) -> dict:
-    messages, _ = extract_package(root)
+    messages, _ = extract_package(root, pkg=pkg)
     return save_golden(messages, path)

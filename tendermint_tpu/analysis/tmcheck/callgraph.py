@@ -319,6 +319,9 @@ class ModuleIndex:
                         et = _element_type_name(item.annotation)
                         if et:
                             attrs["*" + item.target.id] = et
+                        vt = _value_type_name(item.annotation)
+                        if vt:
+                            attrs["@" + item.target.id] = vt
                 # `self.x: T = ...` annotations inside methods
                 for item in node.body:
                     if not isinstance(
@@ -338,6 +341,9 @@ class ModuleIndex:
                             et = _element_type_name(sub.annotation)
                             if et:
                                 attrs.setdefault("*" + sub.target.attr, et)
+                            vt = _value_type_name(sub.annotation)
+                            if vt:
+                                attrs.setdefault("@" + sub.target.attr, vt)
                 self.classes[node.name] = {
                     "node": node,
                     "methods": methods,
@@ -545,11 +551,13 @@ class Package:
         container attributes)."""
         out: Dict[str, str] = {}
         class_attrs: Dict[str, str] = {}
+        class_methods: Dict[str, ast.AST] = {}
         # class attr annotations visible through `self`
         for rec in mod.classes.values():
             for m in rec["methods"].values():
                 if m is fn:
                     class_attrs = rec["attrs"]
+                    class_methods = rec["methods"]
         for node in _body_walk(fn):
             if isinstance(node, ast.Assign) and isinstance(
                 node.value, ast.Call
@@ -572,6 +580,24 @@ class Package:
                         for tgt in node.targets:
                             if isinstance(tgt, ast.Name):
                                 out[tgt.id] = res[1]
+                    else:
+                        # x = self.helper() through the enclosing
+                        # class's own `-> T`-annotated method — the
+                        # `mp = self._require_mempool()` guard idiom
+                        f0 = node.value.func
+                        if (
+                            isinstance(f0, ast.Attribute)
+                            and isinstance(f0.value, ast.Name)
+                            and f0.value.id == "self"
+                            and f0.attr in class_methods
+                        ):
+                            rc = self._returned_class(
+                                mod, class_methods[f0.attr]
+                            )
+                            if rc is not None:
+                                for tgt in node.targets:
+                                    if isinstance(tgt, ast.Name):
+                                        out[tgt.id] = rc[1]
                 # y = G.pop(...) / G.get(...) where G is a module-level
                 # Dict[K, V] global — the registry idiom
                 f = node.value.func
@@ -584,6 +610,20 @@ class Package:
                     for tgt in node.targets:
                         if isinstance(tgt, ast.Name):
                             out[tgt.id] = mod.var_value_types[f.value.id]
+                # y = self.attr.get(...) on a Dict[K, V]-annotated
+                # instance attribute — the per-object registry idiom
+                # (`ps = self.peers.get(peer_id)` in every reactor)
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id == "self"
+                    and f.attr in ("pop", "get", "setdefault")
+                    and "@" + f.value.attr in class_attrs
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out[tgt.id] = class_attrs["@" + f.value.attr]
             elif isinstance(node, ast.Assign) and isinstance(
                 node.value, ast.Subscript
             ):
@@ -596,6 +636,16 @@ class Package:
                     for tgt in node.targets:
                         if isinstance(tgt, ast.Name):
                             out[tgt.id] = mod.var_value_types[sub.id]
+                # y = self.attr[...] on a Dict[K, V]-annotated attribute
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self"
+                    and "@" + sub.attr in class_attrs
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out[tgt.id] = class_attrs["@" + sub.attr]
             it = None
             tgt = None
             if isinstance(node, (ast.For, ast.AsyncFor)):

@@ -482,11 +482,14 @@ def _pair_key(path: str, class_name: Optional[str], fname: str) -> str:
 
 
 def extract_module(
-    source: str, path: str
+    source: str, path: str, tree: Optional[ast.AST] = None
 ) -> Tuple[Dict[str, MessageSchema], List[Violation]]:
     """Extract every message schema from one module; also returns
-    schema-order violations found during extraction."""
-    tree = ast.parse(source, filename=path)
+    schema-order violations found during extraction. `tree` reuses an
+    already-parsed AST (the shared lint.py substrate) — extraction
+    only reads it."""
+    if tree is None:
+        tree = ast.parse(source, filename=path)
     lines = source.splitlines()
     parents = _parents(tree)
     module_ref = _docstring_reference(tree)
@@ -602,11 +605,23 @@ def extract_module(
 
 
 def extract_package(
-    root: Optional[str] = None,
+    root: Optional[str] = None, pkg=None
 ) -> Tuple[Dict[str, MessageSchema], List[Violation]]:
-    root = root or package_root()
+    """`pkg`: an already-built tmcheck callgraph Package — its modules
+    carry the parsed trees, so a full-gate run parses the package
+    exactly once across all sections."""
+    root = root or (pkg.root if pkg is not None else package_root())
     messages: Dict[str, MessageSchema] = {}
     violations: List[Violation] = []
+    if pkg is not None:
+        for rel in sorted(pkg.modules):
+            if not in_schema_scope(rel):
+                continue
+            mod = pkg.modules[rel]
+            msgs, ov = extract_module(mod.source, rel, tree=mod.tree)
+            messages.update(msgs)
+            violations.extend(ov)
+        return messages, violations
     for abspath in iter_py_files(root):
         rel = os.path.relpath(abspath, root).replace(os.sep, "/")
         if not in_schema_scope(rel):
@@ -769,11 +784,13 @@ def diff_golden(
 
 
 def schema_violations(
-    root: Optional[str] = None, golden_path: Optional[str] = None
+    root: Optional[str] = None,
+    golden_path: Optional[str] = None,
+    pkg=None,
 ) -> List[Violation]:
     """The full schema gate: extraction (order check) + symmetry +
-    golden diff."""
-    messages, violations = extract_package(root)
+    golden diff. `pkg` reuses the shared parsed-module substrate."""
+    messages, violations = extract_package(root, pkg=pkg)
     violations.extend(symmetry_violations(messages))
     golden = load_golden(golden_path)
     if golden is None:

@@ -153,11 +153,17 @@ class Module:
     needs: source lines, suppression map, parent links, and the
     imported-module set (lock rules scope on `import threading`)."""
 
-    def __init__(self, path: str, source: str) -> None:
+    def __init__(
+        self, path: str, source: str, tree: Optional[ast.AST] = None
+    ) -> None:
         self.path = path
         self.source = source
         self.lines = source.splitlines()
-        self.tree = ast.parse(source, filename=path)
+        # `tree` reuses an already-parsed AST (the full-gate substrate
+        # shared with tmcheck's call graph); rules only read it
+        self.tree = tree if tree is not None else ast.parse(
+            source, filename=path
+        )
         self.parents: Dict[ast.AST, ast.AST] = {}
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
@@ -292,12 +298,13 @@ def check_source(
     source: str,
     path: str,
     rules: Optional[Sequence[str]] = None,
+    tree: Optional[ast.AST] = None,
 ) -> List[Violation]:
     """Analyze one source string as if it lived at `path` (posix,
     relative to the package root — the path drives rule scoping, which
     is how the fixture tests exercise scoped rules on synthetic
     files)."""
-    mod = Module(path, source)
+    mod = Module(path, source, tree=tree)
     out: List[Violation] = []
     for rule in select_rules(rules):
         if not rule.applies(mod):
@@ -338,13 +345,27 @@ def iter_py_files(root: str) -> Iterator[str]:
 def check_package(
     root: Optional[str] = None,
     rules: Optional[Sequence[str]] = None,
+    pkg=None,
 ) -> List[Violation]:
-    root = root or package_root()
+    """`pkg`: an already-built tmcheck callgraph Package — the shared
+    full-gate substrate. Files it skipped (unparseable) still go
+    through the file path so parse-error reporting is unchanged."""
+    root = root or (pkg.root if pkg is not None else package_root())
     out: List[Violation] = []
     for abspath in iter_py_files(root):
         rel = os.path.relpath(abspath, root).replace(os.sep, "/")
         try:
-            out.extend(check_file(abspath, rel, rules))
+            shared = pkg.modules.get(rel) if pkg is not None else None
+            if shared is not None:
+                # full-gate substrate: reuse the call-graph build's
+                # source AND parsed tree (one parse per module per gate)
+                out.extend(
+                    check_source(
+                        shared.source, rel, rules, tree=shared.tree
+                    )
+                )
+            else:
+                out.extend(check_file(abspath, rel, rules))
         except SyntaxError as e:  # pragma: no cover - broken tree
             out.append(
                 Violation(

@@ -1,0 +1,253 @@
+"""tmsafe — whole-program adversarial-input safety proof.
+
+Every gate before this one (tmlint/tmcheck/tmrace/tmtrace/tmlive,
+PRs 4–9) proves properties of *our own* code. tmsafe proves properties
+of our code **under attacker-chosen input**: a public p2p/RPC port is
+hostile by definition, and the cheapest Byzantine attack is not a
+forged signature but a message whose *decode-time* cost is asymmetric
+— an over-allocation, a steered index, or superlinear work, all before
+`validate_basic` (let alone a signature check) ever runs.
+
+Four rules over the PR-5 call graph, sources machine-derived from the
+same schema extraction whose output is pinned in tmcheck's golden
+`schema.json` (see sources.py for the entry families):
+
+- `safe-alloc-unbounded` (taintflow.py) — allocation or loop bound
+  derived from an unbounded parsed integer (VAL taint) with no
+  `MAX_*`/`len()` clamp between parse and use; includes tainted
+  recursion depth.
+- `safe-index-unchecked` (taintflow.py) — plain subscript with an
+  unclamped parsed integer: signed wire fields make this silent
+  negative-index aliasing.
+- `safe-unvalidated-use` (validate.py) — a synchronous call chain
+  from a p2p/RPC entry to a consensus-mutation sink (MUTATION_SINKS
+  catalog) that does not pass a `validate_basic` call first.
+- `safe-quadratic-decode` (amplify.py + taintflow.py) — nested
+  iteration where BOTH bounds are attacker-sized, in decode/validate
+  paths, with no clamp on either.
+
+Suppressions: `# tmsafe: <rule>-ok — why` on the offending line or in
+the comment block above it (comment_cover_lines, shared with the whole
+family). Counted fingerprint baseline `safe_baseline.json` ships — and
+is pinned by test — EMPTY.
+
+Run via `scripts/lint.py --adv` (in the default full gate). The
+dynamic twin is tests/test_decoder_fuzz.py: deterministic schema-
+seeded mutations proving every decoder raises only sanctioned errors
+within a byte budget. Static gate = no *reachable* unclamped sink;
+fuzzer = no *observed* unclamped behavior; the division of labor is
+documented in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+from ..tmlint import (
+    Violation,
+    comment_cover_lines,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from ..tmcheck.callgraph import Package, build_package
+from . import amplify, sources, taintflow, validate  # noqa: F401
+from .sources import derive_entries
+from .taintflow import TaintEngine
+from .validate import MUTATION_SINKS, check as validate_check
+
+__all__ = [
+    "RULES",
+    "SAFE_BASELINE_PATH",
+    "SAFE_BASELINE_NOTE",
+    "SafeReport",
+    "analyze",
+    "safe_violations",
+    "new_safe_violations",
+    "update_safe_baseline",
+    "suppressed_lines",
+]
+
+SAFE_BASELINE_PATH = os.path.join(
+    os.path.dirname(__file__), "safe_baseline.json"
+)
+
+SAFE_BASELINE_NOTE = (
+    "Accepted pre-existing adversarial-input findings, fingerprinted by "
+    "rule:path:sha1(source_line)[:12]. New findings are anything over "
+    "these counts. Do not hand-edit counts to sneak a finding in — fix "
+    "it, or suppress it in-file with a justified "
+    "'# tmsafe: <rule>-ok — why'."
+)
+
+RULES = [
+    (
+        "safe-alloc-unbounded",
+        "allocation or loop bound derived from an unbounded parsed "
+        "integer with no MAX_*/len() clamp between parse and use",
+    ),
+    (
+        "safe-index-unchecked",
+        "plain subscript indexed by an unclamped parsed integer "
+        "(signed wire fields alias negatively, silently)",
+    ),
+    (
+        "safe-unvalidated-use",
+        "synchronous path from a p2p/RPC entry to a consensus-mutation "
+        "sink with no validate_basic call before the sink",
+    ),
+    (
+        "safe-quadratic-decode",
+        "nested iteration with both bounds attacker-sized in "
+        "decode/validate paths and no MAX_* clamp on either",
+    ),
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tmsafe:\s*(safe-[a-z\-]+)-ok\b"
+)
+
+
+def suppressed_lines(lines: List[str]) -> Dict[str, Set[int]]:
+    """rule -> covered line numbers for `# tmsafe: <rule>-ok — why`
+    annotations (same comment-block-above convention as the family)."""
+    out: Dict[str, Set[int]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rule = m.group(1)
+        out.setdefault(rule, set()).update(
+            comment_cover_lines(lines, i, text)
+        )
+    return out
+
+
+class SafeReport:
+    def __init__(self) -> None:
+        self.entries: List[sources.Entry] = []
+        self.taint_findings: List[taintflow.Finding] = []
+        self.unvalidated: List[validate.UnvalidatedUse] = []
+        self.violations: List[Violation] = []
+        self.stats: Dict[str, int] = {}
+        # (rule, path, line) of findings dropped by an in-file
+        # suppression — the head-catalog test pins this set
+        self.suppressed: List[tuple] = []
+
+
+def analyze(pkg: Optional[Package] = None) -> SafeReport:
+    pkg = pkg or build_package()
+    report = SafeReport()
+
+    supp: Dict[str, Dict[str, Set[int]]] = {}
+    for path, mod in pkg.modules.items():
+        m = suppressed_lines(mod.lines)
+        if m:
+            supp[path] = m
+
+    def is_suppressed(rule: str, path: str, lineno: int) -> bool:
+        return lineno in supp.get(path, {}).get(rule, ())
+
+    def line_text(path: str, lineno: int) -> str:
+        lines = pkg.modules[path].lines
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1].strip()
+        return ""
+
+    violations: List[Violation] = []
+
+    # -- taint + amplification --
+    report.entries = derive_entries(pkg)
+    engine = TaintEngine(pkg, report.entries)
+    findings = engine.run()
+    report.taint_findings = findings
+    n_supp = 0
+    for f in findings:
+        if is_suppressed(f.rule, f.path, f.lineno):
+            n_supp += 1
+            report.suppressed.append((f.rule, f.path, f.lineno))
+            continue
+        chain = engine.chain(f.key)
+        witness = " -> ".join(chain)
+        violations.append(
+            Violation(
+                rule=f.rule,
+                path=f.path,
+                line=f.lineno,
+                col=f.col,
+                message=f"{f.detail}; witness: {witness}",
+                source=line_text(f.path, f.lineno),
+            )
+        )
+
+    # -- validate-before-use --
+    unval_supp = {
+        path: m.get("safe-unvalidated-use", set())
+        for path, m in supp.items()
+    }
+    uses, unval_hits = validate_check(pkg, unval_supp)
+    report.unvalidated = uses
+    for path, lineno, _sink in unval_hits:
+        n_supp += 1
+        report.suppressed.append(("safe-unvalidated-use", path, lineno))
+    for u in uses:
+        sink_fi = pkg.functions[u.sink]
+        chain = " -> ".join(
+            pkg.functions[k].render() for k in u.chain
+        )
+        violations.append(
+            Violation(
+                rule="safe-unvalidated-use",
+                path=u.caller[0],
+                line=u.lineno,
+                col=u.col,
+                message=(
+                    f"reaches {sink_fi.render()} ({u.why}) with no "
+                    f"validate_basic on the path {chain} -> "
+                    f"{sink_fi.qualname}"
+                ),
+                source=line_text(u.caller[0], u.lineno),
+            )
+        )
+
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    report.violations = violations
+    per_rule: Dict[str, int] = {rid: 0 for rid, _ in RULES}
+    for v in violations:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+    report.stats = {
+        "entries": len(report.entries),
+        "region": sum(
+            1 for st in engine.states.values() if st.analyzed
+        ),
+        "suppressed": n_supp,
+        "sinks_cataloged": len(MUTATION_SINKS),
+        **{f"findings[{rid}]": n for rid, n in per_rule.items()},
+    }
+    return report
+
+
+def safe_violations(pkg: Optional[Package] = None) -> List[Violation]:
+    return analyze(pkg).violations
+
+
+def new_safe_violations(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+) -> List[Violation]:
+    violations = safe_violations(pkg)
+    baseline = load_baseline(baseline_path or SAFE_BASELINE_PATH)
+    return new_violations(violations, baseline)
+
+
+def update_safe_baseline(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+) -> Dict[str, int]:
+    return save_baseline(
+        safe_violations(pkg),
+        baseline_path or SAFE_BASELINE_PATH,
+        note=SAFE_BASELINE_NOTE,
+    )

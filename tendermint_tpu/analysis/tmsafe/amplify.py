@@ -1,0 +1,78 @@
+"""Amplification helpers for the quadratic-decode rule.
+
+A Byzantine peer's cheapest lever is not a forged signature — it is a
+message shaped so that *pre-verification* work is superlinear in the
+message's own size (arxiv 2302.00418 frames the multiplier: at 10k
+validators, per-message decode cost is paid committee-many times).
+The structural pattern is two nested iterations whose bounds BOTH come
+from attacker-sized collections: duplicate scans, pairwise
+intersection checks, per-part re-walks of the whole set.
+
+`taintflow._BodyWalker` owns the traversal and taint facts; this
+module owns the loop bookkeeping: the frame stack, and the clamp
+recognition that keeps an explicitly bounded loop green:
+
+- `for x in items[:MAX_...]` — clamped slice
+- `for x in items[:16]` / any literal upper bound
+- `range(min(n, MAX_...))` / `min(...)` anywhere in the iterable
+- iterating a `MAX_*`-named object itself
+
+One clamped bound is enough — n * MAX is linear in n.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+__all__ = ["LoopFrame", "iter_clamped", "enclosing_tainted"]
+
+_CLAMP_NAME_MARKERS = ("MAX_", "_MAX", "LIMIT", "_CAP")
+
+
+class LoopFrame:
+    __slots__ = ("node", "tainted", "clamped")
+
+    def __init__(self, node: ast.AST, tainted: bool, clamped: bool) -> None:
+        self.node = node
+        self.tainted = tainted
+        self.clamped = clamped
+
+
+def _is_clamp_name(node: ast.AST) -> bool:
+    name = ""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    return name.isupper() and any(m in name for m in _CLAMP_NAME_MARKERS)
+
+
+def iter_clamped(iter_node: ast.AST) -> bool:
+    """True when the iterable carries an explicit upper clamp."""
+    for node in ast.walk(iter_node):
+        # items[:MAX] / items[:literal]
+        if isinstance(node, ast.Slice) and node.upper is not None:
+            up = node.upper
+            if isinstance(up, ast.Constant) and isinstance(up.value, int):
+                return True
+            if _is_clamp_name(up):
+                return True
+        # min(n, MAX) — the clamp expression
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "min"
+        ):
+            return True
+        if _is_clamp_name(node):
+            return True
+    return False
+
+
+def enclosing_tainted(stack: List[LoopFrame]) -> Optional[LoopFrame]:
+    """Innermost enclosing loop frame that is tainted and unclamped."""
+    for frame in reversed(stack):
+        if frame.tainted and not frame.clamped:
+            return frame
+    return None

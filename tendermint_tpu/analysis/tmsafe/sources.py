@@ -1,0 +1,230 @@
+"""Attacker-input entry derivation for tmsafe.
+
+The whole point of the gate is that its source catalog cannot rot by
+hand: the entries are machine-derived from the same extraction that
+pins the wire protocol.
+
+Entry families (each yields (FuncInfo key, tainted params, rule mask)):
+
+1. **Wire decoders** — every decoder tmcheck's schema extraction finds
+   (the same extraction whose output is pinned golden in
+   `analysis/tmcheck/schema.json`): all 90+ `from_proto`/`decode_*`
+   functions across types/, abci/codec, the reactor codecs, crypto
+   keys and merkle proofs. Every non-self parameter is attacker bytes.
+2. **RPC/WS param parsing** — every function in the package with an
+   `RPCRequest`-annotated parameter (the JSON-RPC route handlers in
+   rpc/core.py), plus the server-side parse functions in
+   rpc/jsonrpc.py that turn raw HTTP/WS bytes into request objects.
+3. **WAL reads** — the consensus WAL replay iterators. A WAL is
+   written locally, but replay-after-crash must tolerate torn/corrupt
+   records, and statesync'd nodes replay files they did not write;
+   the bytes are treated as hostile like any wire input.
+4. **P2P framing** — functions in the connection/transport layer that
+   consume socket bytes (`recv`/`read`/`readexactly` results), before
+   any message-level decode runs.
+5. **Message validators** — every `validate_basic` in the package.
+   These run BEFORE signature checks on attacker messages, so their
+   loop structure is attacker-amplifiable; they participate in the
+   quadratic-decode rule only (their field values are checked by the
+   very comparisons the taint rules would misread as unsanitized
+   sources, so alloc/index taint is owned by the decode entries).
+
+Taint kinds (see taintflow.py): every decoder byte parameter seeds as
+LEN taint (attacker-chosen content, but its size is already capped by
+the transport's MAX_MSG_SIZE / MAX_FRAME before the decoder runs);
+VAL taint — unbounded attacker-chosen integers — is born at the parse
+primitives (decode_varint, FieldReader int accessors, iter_fields
+values), not at the entries.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..tmcheck.callgraph import FuncInfo, Package
+from ..tmcheck.schema import extract_package
+
+__all__ = [
+    "Entry",
+    "RULE_TAINT",
+    "RULE_QUADRATIC",
+    "RULE_ALL",
+    "derive_entries",
+    "P2P_FRAMING_MODULES",
+    "RPC_PARSE_FUNCS",
+    "WAL_ENTRY_FUNCS",
+]
+
+FuncKey = Tuple[str, str]
+
+# rule-participation mask
+RULE_TAINT = 1  # safe-alloc-unbounded + safe-index-unchecked
+RULE_QUADRATIC = 2  # safe-quadratic-decode
+RULE_ALL = RULE_TAINT | RULE_QUADRATIC
+
+# socket-byte consumers: every function in these modules that binds a
+# `.recv(...)` / `.read(...)` / `.readexactly(...)` result handles raw
+# peer bytes before any decoder runs
+P2P_FRAMING_MODULES = ("p2p/conn.py", "p2p/transport.py")
+
+# the server-side HTTP/WS parse path in rpc/jsonrpc.py: raw body/query
+# bytes -> params dict (the route handlers themselves are found by
+# their RPCRequest annotation)
+RPC_PARSE_FUNCS = (
+    "JSONRPCServer._handle_post_body",
+    "JSONRPCServer._handle_uri",
+    "JSONRPCServer._dispatch_obj",
+)
+
+WAL_ENTRY_FUNCS = (
+    ("consensus/wal.py", "iter_wal_records"),
+    ("consensus/wal.py", "iter_wal_group"),
+)
+
+_READ_ATTRS = {"recv", "read", "readexactly", "recv_into"}
+
+
+class Entry:
+    """One attacker-input entry point."""
+
+    __slots__ = ("key", "tainted_params", "rules", "family")
+
+    def __init__(
+        self,
+        key: FuncKey,
+        tainted_params: FrozenSet[str],
+        rules: int,
+        family: str,
+    ) -> None:
+        self.key = key
+        self.tainted_params = tainted_params
+        self.rules = rules
+        self.family = family
+
+    def render(self) -> str:
+        return f"{self.key[0]}:{self.key[1]} [{self.family}]"
+
+
+def _fn_params(fi: FuncInfo) -> List[str]:
+    args = fi.node.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names.extend(a.arg for a in args.kwonlyargs)
+    return [n for n in names if n not in ("self", "cls")]
+
+import ast  # noqa: E402  (used below; kept near first use for clarity)
+
+
+def _annotated_params(fi: FuncInfo, type_name: str) -> List[str]:
+    """Parameter names annotated with `type_name` (bare or quoted)."""
+    out: List[str] = []
+    args = fi.node.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        ann = a.annotation
+        name = ""
+        if isinstance(ann, ast.Name):
+            name = ann.id
+        elif isinstance(ann, ast.Attribute):
+            name = ann.attr
+        elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.strip("'\"").split("[")[0].split(".")[-1]
+        elif isinstance(ann, ast.Subscript):
+            # Optional[RPCRequest] etc.
+            inner = ann.slice
+            if isinstance(inner, ast.Name):
+                name = inner.id
+        if name == type_name:
+            out.append(a.arg)
+    return out
+
+
+def _schema_decoder_keys(pkg: Package) -> List[FuncKey]:
+    """(path, qualname) of every decoder the wire-schema extraction
+    recognizes — the machine-derived core of the source catalog."""
+    messages, _ = extract_package(pkg.root, pkg=pkg)
+    keys: Set[FuncKey] = set()
+    for mkey, msg in messages.items():
+        if not msg.dec_func:
+            continue
+        path, _, tail = mkey.partition("::")
+        # class-paired messages: "types/vote.py::Vote" + dec "from_proto"
+        # -> Vote.from_proto; module-level: decode function by own name
+        cand = [f"{tail}.{msg.dec_func}", msg.dec_func]
+        # encode-only suffixed keys ("::Cls.hash_bytes") never decode
+        for qual in cand:
+            if (path, qual) in pkg.functions:
+                keys.add((path, qual))
+                break
+    return sorted(keys)
+
+
+_VALIDATE_RE = re.compile(r"(^|\.)validate_basic$")
+
+
+def derive_entries(pkg: Package) -> List[Entry]:
+    entries: Dict[FuncKey, Entry] = {}
+
+    def add(key, params, rules, family):
+        if key in entries:
+            old = entries[key]
+            entries[key] = Entry(
+                key,
+                old.tainted_params | frozenset(params),
+                old.rules | rules,
+                old.family,
+            )
+        else:
+            entries[key] = Entry(key, frozenset(params), rules, family)
+
+    # 1. wire decoders (schema-derived)
+    for key in _schema_decoder_keys(pkg):
+        fi = pkg.functions[key]
+        add(key, _fn_params(fi), RULE_ALL, "decoder")
+
+    # 2a. RPC route handlers: RPCRequest-annotated params, anywhere
+    for key, fi in pkg.functions.items():
+        params = _annotated_params(fi, "RPCRequest")
+        if params:
+            add(key, params, RULE_ALL, "rpc")
+
+    # 2b. the raw HTTP/WS parse path
+    for qual in RPC_PARSE_FUNCS:
+        key = ("rpc/jsonrpc.py", qual)
+        if key in pkg.functions:
+            add(key, _fn_params(pkg.functions[key]), RULE_ALL, "rpc-parse")
+
+    # 3. WAL replay iterators
+    for key in WAL_ENTRY_FUNCS:
+        if key in pkg.functions:
+            add(key, _fn_params(pkg.functions[key]), RULE_ALL, "wal")
+
+    # 4. p2p framing: any function in the framing modules that binds a
+    # socket-read result (the taint engine seeds those results too;
+    # listing the function as an entry puts it in the scanned region)
+    for key, fi in pkg.functions.items():
+        if fi.path not in P2P_FRAMING_MODULES:
+            continue
+        if _binds_socket_read(fi):
+            add(key, (), RULE_ALL, "p2p-framing")
+
+    # 5. validators: quadratic-decode scope only, `self` tainted
+    for key, fi in pkg.functions.items():
+        if _VALIDATE_RE.search(fi.qualname):
+            add(key, ("self",), RULE_QUADRATIC, "validate")
+
+    return [entries[k] for k in sorted(entries)]
+
+
+def _binds_socket_read(fi: FuncInfo) -> bool:
+    from ..tmcheck.callgraph import _body_walk
+
+    for node in _body_walk(fi.node):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _READ_ATTRS
+        ):
+            return True
+    return False

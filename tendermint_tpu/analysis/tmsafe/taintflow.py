@@ -1,0 +1,1045 @@
+"""Attacker-taint dataflow: decode-bound allocation, unchecked
+indexing, and tainted recursion over the wire-input region.
+
+Two taint kinds, because the bound matters more than the bit:
+
+- **LEN** — attacker-chosen *content* whose size is already capped by
+  the byte stream it arrived in (the transport rejects frames over
+  MAX_MSG_SIZE before any decoder runs). Copying, slicing, or hashing
+  LEN data is work proportional to bytes the peer actually sent —
+  self-limiting, never flagged.
+- **VAL** — an unbounded attacker-chosen *integer*: the result of
+  parsing a varint/fixed field, `int()` of attacker text, or a
+  JSON-decoded number. Ten wire bytes encode 2**63; any allocation or
+  loop bound derived from VAL without a clamp is an asymmetric-cost
+  lever (amplification in the arxiv 2302.00418 sense: one cheap
+  message, unbounded server work).
+
+VAL is born at the parse primitives (`decode_varint`, FieldReader int
+accessors, `iter_fields` values, `struct.unpack`, `json.loads`), not
+at the entries — entry byte parameters seed as LEN.
+
+Sinks (rules):
+- `safe-alloc-unbounded`: `bytes(v)` / `bytearray(v)` / sequence
+  repetition `lit * v` / `range(v)` loop bounds with VAL `v`; plus
+  recursion on tainted input (stack is an allocation too).
+- `safe-index-unchecked`: a plain (non-slice) subscript whose index is
+  VAL — in Python that is not memory-unsafe but it IS
+  attacker-steered aliasing: an int64 field is signed, so `-1` reads
+  the *last* element with no error raised. Slices are exempt by
+  design: Python slices clamp, and the result is bounded by the
+  source's length.
+
+Sanitizers (what turns VAL back off):
+- a comparison (`if`/`while`/`assert`/ternary test) between the
+  tainted name and any untainted expression — the in-tree `MAX_*`
+  constants, int literals, `len(...)` calls, `.size()` results. After
+  the test the name is clean for the rest of the function (lexical,
+  not path-sensitive: the codebase's universal idiom is
+  guard-then-raise).
+- `min(v, bound)` — the clamp expression itself.
+- an enclosing `try` that catches IndexError/KeyError/LookupError
+  (or everything) sanitizes index sinks inside it: the decoder's
+  deliberate probe-and-translate idiom.
+- `% nonzero-untainted` bounds the value.
+
+The interprocedural half is a monotone fixpoint over the PR-5 call
+graph: one merged context per function (joined parameter taint),
+return-taint summaries propagated caller-ward until stable. Taint
+does not flow through object attributes across functions (a decoded
+message handed to a handler is the validate-before-use gate's job,
+not this pass's) nor into nested `def`s; both under-approximations
+are documented in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tmcheck.callgraph import (
+    CallSite,
+    FuncInfo,
+    Package,
+    _body_walk,
+)
+from . import amplify
+from .sources import Entry, RULE_QUADRATIC, RULE_TAINT
+
+__all__ = ["TaintEngine", "Finding", "NONE", "LEN", "VAL"]
+
+FuncKey = Tuple[str, str]
+
+NONE = 0
+LEN = 1
+VAL = 2
+
+# FieldReader accessors by result kind
+_READER_INT = {"uint", "int64", "sfixed64"}
+_READER_LEN = {"bytes", "string", "get"}
+_READER_VAL_COLLECTION = {"get_all"}
+
+# parse primitives that mint VAL from LEN bytes
+_PARSE_VAL_FNS = {
+    "decode_varint",
+    "decode_zigzag",
+    "iter_fields",
+}
+# wrappers that re-bound their result internally
+_PARSE_LEN_FNS = {"read_length_prefixed"}
+
+# the one shared catalog of socket/file read methods: sources.py uses
+# it to discover p2p-framing entries, the engine to seed/check reads —
+# a single set so the entry region and the taint model cannot drift
+from .sources import _READ_ATTRS as _SOCKET_READ_ATTRS  # noqa: E402
+
+# external calls whose result is bounded regardless of args
+_CLEAN_EXTERNALS = {
+    "str",
+    "repr",
+    "bool",
+    "float",
+    "hex",
+    "isinstance",
+    "hasattr",
+    "getattr",
+    "print",
+    "type",
+    "format",
+}
+
+# exception names whose handlers sanitize index sinks inside the try:
+# they actually CATCH IndexError. `except ValueError` deliberately
+# does NOT qualify — it would not catch the IndexError, and a negative
+# wire index raises nothing at all (the aliasing the rule exists for)
+_INDEX_GUARD_EXCS = {
+    "IndexError",
+    "KeyError",
+    "LookupError",
+    "Exception",
+    "BaseException",
+}
+
+
+class Finding:
+    __slots__ = ("rule", "path", "lineno", "col", "detail", "key")
+
+    def __init__(self, rule, path, lineno, col, detail, key):
+        self.rule = rule
+        self.path = path
+        self.lineno = lineno
+        self.col = col
+        self.detail = detail
+        self.key = key  # FuncKey where the sink sits
+
+
+class _FnState:
+    """Per-function joined analysis state."""
+
+    __slots__ = ("param_taint", "ret", "rules", "analyzed")
+
+    def __init__(self) -> None:
+        self.param_taint: Dict[str, int] = {}
+        self.ret: int = NONE
+        self.rules: int = 0
+        self.analyzed = False
+
+
+class TaintEngine:
+    def __init__(self, pkg: Package, entries: List[Entry]) -> None:
+        self.pkg = pkg
+        self.entries = entries
+        self.states: Dict[FuncKey, _FnState] = {}
+        self.callers: Dict[FuncKey, Set[FuncKey]] = {}
+        self.parent: Dict[FuncKey, Tuple[FuncKey, int]] = {}
+        self.findings: Dict[Tuple[str, str, int, int], Finding] = {}
+        self._work: List[FuncKey] = []
+        self._queued: Set[FuncKey] = set()
+
+    # -- public --
+
+    def run(self) -> List[Finding]:
+        for e in self.entries:
+            if e.key not in self.pkg.functions:
+                continue
+            st = self._state(e.key)
+            st.rules |= e.rules
+            for p in e.tainted_params:
+                st.param_taint[p] = max(st.param_taint.get(p, NONE), LEN)
+            self._enqueue(e.key)
+        while self._work:
+            key = self._work.pop()
+            self._queued.discard(key)
+            self._analyze(key)
+        out = sorted(
+            self.findings.values(),
+            key=lambda f: (f.path, f.lineno, f.col, f.rule),
+        )
+        return out
+
+    def chain(self, key: FuncKey) -> List[str]:
+        """Entry -> ... -> key witness (function identities)."""
+        seen: Set[FuncKey] = set()
+        chain: List[str] = []
+        cur: Optional[FuncKey] = key
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            fi = self.pkg.functions.get(cur)
+            chain.append(fi.render() if fi else f"{cur[0]}:{cur[1]}")
+            nxt = self.parent.get(cur)
+            cur = nxt[0] if nxt else None
+        chain.reverse()
+        return chain
+
+    # -- machinery --
+
+    def _state(self, key: FuncKey) -> _FnState:
+        st = self.states.get(key)
+        if st is None:
+            st = _FnState()
+            self.states[key] = st
+        return st
+
+    def _enqueue(self, key: FuncKey) -> None:
+        if key not in self._queued:
+            self._queued.add(key)
+            self._work.append(key)
+
+    def _flow_into(
+        self,
+        caller: FuncKey,
+        callee: FuncKey,
+        taints: Dict[str, int],
+        rules: int,
+        lineno: int,
+    ) -> int:
+        """Join `taints` into callee's params; (re)enqueue on growth.
+        Returns the callee's current return summary."""
+        st = self._state(callee)
+        grew = False
+        for name, kind in taints.items():
+            if kind > st.param_taint.get(name, NONE):
+                st.param_taint[name] = kind
+                grew = True
+        if rules & ~st.rules:
+            st.rules |= rules
+            grew = True
+        if grew or not st.analyzed:
+            self.parent.setdefault(callee, (caller, lineno))
+            self._enqueue(callee)
+        self.callers.setdefault(callee, set()).add(caller)
+        return st.ret
+
+    def _ret_update(self, key: FuncKey, ret: int) -> None:
+        st = self._state(key)
+        if ret > st.ret:
+            st.ret = ret
+            for c in self.callers.get(key, ()):
+                self._enqueue(c)
+
+    def report(self, rule, key, node, detail) -> None:
+        fi = self.pkg.functions[key]
+        k = (rule, fi.path, node.lineno, node.col_offset)
+        if k not in self.findings:
+            self.findings[k] = Finding(
+                rule, fi.path, node.lineno, node.col_offset, detail, key
+            )
+
+    def _analyze(self, key: FuncKey) -> None:
+        fi = self.pkg.functions.get(key)
+        if fi is None:
+            return
+        st = self._state(key)
+        st.analyzed = True
+        walker = _BodyWalker(self, fi, st)
+        walker.run()
+        self._ret_update(key, walker.ret)
+
+
+class _BodyWalker:
+    """One function body, statements in program order, operands always
+    evaluated (never short-circuited — a stack-order walk produced a
+    vacuously-clean gate once already, see tests/test_tmtrace.py)."""
+
+    def __init__(self, eng: TaintEngine, fi: FuncInfo, st: _FnState) -> None:
+        self.eng = eng
+        self.fi = fi
+        self.key = fi.key
+        self.rules = st.rules
+        self.env: Dict[str, int] = dict(st.param_taint)
+        self.sanitized: Set[str] = set()
+        self.set_names: Set[str] = set()
+        # locals bound to non-empty all-constant container literals —
+        # a fixed membership universe (`names = {1: "ed25519", ...}`),
+        # as opposed to a growing accumulator (`seen = []`)
+        self.fixed_containers: Set[str] = set()
+        # locals that are dicts (literal, dict() call, or dict/Dict
+        # annotation): subscripting a dict cannot negative-alias
+        # (KeyError is a sanctioned error, there is no index
+        # arithmetic) and membership is O(1) — both rules exempt them
+        self.dict_names: Set[str] = set()
+        self.ret: int = NONE
+        self.index_guard = 0
+        self.loops: List[amplify.LoopFrame] = []
+        self.sites: Dict[Tuple[int, int], CallSite] = {
+            (s.lineno, s.col): s for s in fi.calls
+        }
+
+    def run(self) -> None:
+        # two passes over loop bodies happen inside stmt(); the body
+        # itself runs once (top-level straight-line code)
+        for node in self.fi.node.body:
+            self.stmt(node)
+
+    # -- helpers --
+
+    def _taint_of_name(self, name: str) -> int:
+        if name in self.sanitized:
+            return NONE
+        return self.env.get(name, NONE)
+
+    def _assign_name(self, name: str, kind: int) -> None:
+        self.sanitized.discard(name)
+        if kind:
+            self.env[name] = kind
+        else:
+            self.env.pop(name, None)
+
+    def _assign_target(self, tgt: ast.AST, kind: int, value=None) -> None:
+        if isinstance(tgt, ast.Name):
+            if value is not None and _is_set_expr(value):
+                self.set_names.add(tgt.id)
+            else:
+                self.set_names.discard(tgt.id)
+            if value is not None and _is_fixed_literal(value):
+                self.fixed_containers.add(tgt.id)
+            else:
+                self.fixed_containers.discard(tgt.id)
+            if value is not None and _is_dict_expr(value):
+                self.dict_names.add(tgt.id)
+            elif value is not None:
+                self.dict_names.discard(tgt.id)
+            self._assign_name(tgt.id, kind)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            parts = None
+            if isinstance(value, (ast.Tuple, ast.List)) and len(
+                value.elts
+            ) == len(tgt.elts):
+                parts = value.elts
+            for i, elt in enumerate(tgt.elts):
+                if parts is not None:
+                    self._assign_target(elt, self.expr(parts[i]))
+                else:
+                    self._assign_target(elt, kind)
+        elif isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            # store into a container/field: the container becomes at
+            # least as tainted as the stored value
+            if isinstance(tgt, ast.Subscript):
+                self.expr(tgt.slice)
+            base = tgt.value
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if isinstance(base, ast.Name) and kind:
+                cur = self.env.get(base.id, NONE)
+                if kind > cur and base.id not in self.sanitized:
+                    self.env[base.id] = kind
+        elif isinstance(tgt, ast.Starred):
+            self._assign_target(tgt.value, kind)
+
+    # -- statements --
+
+    def stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Assign):
+            kind = self.expr(node.value)
+            for tgt in node.targets:
+                self._assign_target(tgt, kind, node.value)
+        elif isinstance(node, ast.AnnAssign):
+            kind = self.expr(node.value) if node.value else NONE
+            self._assign_target(node.target, kind, node.value)
+            if isinstance(node.target, ast.Name) and _is_dict_annotation(
+                node.annotation
+            ):
+                self.dict_names.add(node.target.id)
+        elif isinstance(node, ast.AugAssign):
+            kind = self.expr(node.value)
+            if isinstance(node.target, ast.Name):
+                cur = self._taint_of_name(node.target.id)
+                self._assign_name(node.target.id, max(cur, kind))
+            else:
+                self._assign_target(node.target, kind)
+        elif isinstance(node, ast.Expr):
+            self.expr(node.value)
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                self.ret = max(self.ret, self.expr(node.value))
+        elif isinstance(node, ast.If):
+            self._branch(node.test, node.body, node.orelse)
+        elif isinstance(node, (ast.While,)):
+            self._sanitize_test(node.test)
+            self.expr(node.test)
+            self._loop_body(node.body)
+            for s in node.orelse:
+                self.stmt(s)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._for(node)
+        elif isinstance(node, ast.Try):
+            guards = _try_guards_index(node)
+            if guards:
+                self.index_guard += 1
+            for s in node.body:
+                self.stmt(s)
+            if guards:
+                self.index_guard -= 1
+            for h in node.handlers:
+                for s in h.body:
+                    self.stmt(s)
+            for s in node.orelse:
+                self.stmt(s)
+            for s in node.finalbody:
+                self.stmt(s)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.expr(item.context_expr)
+            for s in node.body:
+                self.stmt(s)
+        elif isinstance(node, ast.Assert):
+            self._sanitize_test(node.test)
+            self.expr(node.test)
+            if node.msg is not None:
+                self.expr(node.msg)
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self.expr(node.exc)
+        elif isinstance(node, (ast.Delete,)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.env.pop(t.id, None)
+                else:
+                    self.expr(t)
+        elif isinstance(node, (ast.Global, ast.Nonlocal, ast.Pass, ast.Break,
+                               ast.Continue, ast.Import, ast.ImportFrom)):
+            return
+        else:
+            # anything with an expression payload we didn't special-case
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.expr(child)
+                elif isinstance(child, ast.stmt):
+                    self.stmt(child)
+
+    def _branch(self, test, body, orelse) -> None:
+        self.expr(test)
+        self._sanitize_test(test)
+        snap_env = dict(self.env)
+        snap_san = set(self.sanitized)
+        for s in body:
+            self.stmt(s)
+        env_b, san_b = self.env, self.sanitized
+        self.env, self.sanitized = dict(snap_env), set(snap_san)
+        for s in orelse:
+            self.stmt(s)
+        # join: taint survives if either branch leaves it tainted
+        for name, kind in env_b.items():
+            if kind > self.env.get(name, NONE):
+                self.env[name] = kind
+        self.sanitized &= san_b
+
+    def _loop_body(self, body) -> None:
+        # two joined passes so a name tainted late in the body is seen
+        # by uses earlier in it on the next iteration
+        for _ in range(2):
+            for s in body:
+                self.stmt(s)
+
+    def _for(self, node) -> None:
+        iter_kind = self.expr(node.iter)
+        elem = _element_kind(node.iter, iter_kind, self)
+        frame = amplify.LoopFrame(
+            node,
+            tainted=iter_kind != NONE,
+            clamped=amplify.iter_clamped(node.iter),
+        )
+        if (
+            self.rules & RULE_QUADRATIC
+            and frame.tainted
+            and not frame.clamped
+        ):
+            outer = amplify.enclosing_tainted(self.loops)
+            if outer is not None:
+                self.report_quadratic(node, outer)
+        self.loops.append(frame)
+        self._bind_loop_target(node.target, node.iter, elem)
+        self._loop_body(node.body)
+        self.loops.pop()
+        for s in node.orelse:
+            self.stmt(s)
+
+    def _bind_loop_target(self, target, iter_node, elem: int) -> None:
+        # `for i, x in enumerate(tainted)`: the index is bounded by the
+        # collection's length (LEN), only the element carries its kind
+        if (
+            elem
+            and isinstance(iter_node, ast.Call)
+            and isinstance(iter_node.func, ast.Name)
+            and iter_node.func.id == "enumerate"
+            and isinstance(target, ast.Tuple)
+            and len(target.elts) == 2
+        ):
+            self._assign_target(target.elts[0], LEN)
+            self._assign_target(target.elts[1], elem)
+            return
+        self._assign_target(target, elem)
+
+    def report_quadratic(self, node, outer) -> None:
+        self.eng.report(
+            "safe-quadratic-decode",
+            self.key,
+            node,
+            "nested loop over attacker-sized collections (outer at "
+            f"line {outer.node.lineno}) with no MAX_* clamp on either "
+            "bound — one message buys O(n^2) work",
+        )
+
+    # -- sanitization --
+
+    def _sanitize_test(self, test: ast.AST) -> None:
+        """A comparison between a tainted name and any expression that
+        is not itself VAL-tainted sanitizes that name for the rest of
+        the function. `len(data)` is LEN even when `data` is attacker
+        bytes — `if offset + n > len(data): raise` is THE canonical
+        decoder guard and bounds n by bytes actually received."""
+        for node in ast.walk(test):
+            if not isinstance(node, ast.Compare):
+                continue
+            if any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                # identity tests (`data is None`) bound nothing — and
+                # treating them as guards silently un-taints the whole
+                # decoder (the tmtrace is-exemption lesson, again)
+                continue
+            if any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                # membership pins a value only against a FIXED universe
+                # (`f in {1, 2}`, `k in ALLOWED`); `x in seen` against a
+                # growing local accumulator bounds nothing — and it is
+                # exactly the quadratic-scan shape the amplification
+                # rule must keep seeing
+                comp = node.comparators[0]
+                fixed = isinstance(
+                    comp, (ast.Set, ast.Tuple, ast.List, ast.Dict,
+                           ast.Constant)
+                ) or (
+                    isinstance(comp, ast.Name)
+                    and (
+                        comp.id.isupper()
+                        or comp.id in self.fixed_containers
+                    )
+                )
+                if not fixed:
+                    continue
+            sides = [node.left] + list(node.comparators)
+            names: Set[str] = set()
+            has_bound_side = False
+            for side in sides:
+                # only VAL names need (or deserve) sanitizing: LEN
+                # values are never flagged, and stripping their taint
+                # would cut propagation into everything derived from
+                # the payload
+                side_names = {
+                    n.id
+                    for n in ast.walk(side)
+                    if isinstance(n, ast.Name)
+                    and self._taint_of_name(n.id) == VAL
+                }
+                names |= side_names
+                if self.expr(side) != VAL:
+                    has_bound_side = True
+            if names and has_bound_side:
+                self.sanitized |= names
+
+    # -- expressions --
+
+    def expr(self, node: Optional[ast.AST]) -> int:
+        if node is None:
+            return NONE
+        if isinstance(node, ast.Name):
+            return self._taint_of_name(node.id)
+        if isinstance(node, ast.Constant):
+            return NONE
+        if isinstance(node, ast.Attribute):
+            return self.expr(node.value)
+        if isinstance(node, ast.Await):
+            return self.expr(node.value)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.BinOp):
+            left = self.expr(node.left)
+            right = self.expr(node.right)
+            if isinstance(node.op, ast.Mult) and self.rules & RULE_TAINT:
+                self._check_repeat_sink(node, left, right)
+            if (
+                isinstance(node.op, ast.LShift)
+                and right == VAL
+                and self.rules & RULE_TAINT
+            ):
+                # `1 << size` materializes a size-bit Python bigint —
+                # the allocation hides inside the shift operator
+                self.eng.report(
+                    "safe-alloc-unbounded",
+                    self.key,
+                    node,
+                    "left shift by an unclamped attacker-controlled "
+                    "integer — `1 << size` IS a size-bit allocation",
+                )
+            if isinstance(node.op, ast.Mod):
+                # v % bound pins v into [0, bound)
+                if left and not right:
+                    return NONE
+            return max(left, right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return max(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # inner comparisons still evaluate operands; membership
+            # checks against tainted lists inside tainted loops are the
+            # classic quadratic decode
+            kinds = [self.expr(node.left)]
+            kinds.extend(self.expr(c) for c in node.comparators)
+            if (
+                self.rules & RULE_QUADRATIC
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+            ):
+                comp = node.comparators[0]
+                if (
+                    isinstance(comp, ast.Name)
+                    and self._taint_of_name(comp.id)
+                    and comp.id not in self.set_names
+                    and comp.id not in self.dict_names
+                ):
+                    outer = amplify.enclosing_tainted(self.loops)
+                    if outer is not None:
+                        self.eng.report(
+                            "safe-quadratic-decode",
+                            self.key,
+                            node,
+                            f"membership scan of `{comp.id}` (a tainted "
+                            "list, not a set) inside a loop over "
+                            "attacker-sized input (outer at line "
+                            f"{outer.node.lineno}) — O(n^2) duplicate "
+                            "check",
+                        )
+                return NONE
+            return NONE
+        if isinstance(node, ast.IfExp):
+            self.expr(node.test)
+            self._sanitize_test(node.test)
+            return max(self.expr(node.body), self.expr(node.orelse))
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            kinds = [self.expr(e) for e in node.elts]
+            return max(kinds) if kinds else NONE
+        if isinstance(node, ast.Dict):
+            kinds = [self.expr(k) for k in node.keys if k is not None]
+            kinds += [self.expr(v) for v in node.values]
+            return max(kinds) if kinds else NONE
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            return self._comprehension(node)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                self.expr(v)
+            return NONE
+        if isinstance(node, ast.FormattedValue):
+            self.expr(node.value)
+            return NONE
+        if isinstance(node, ast.Lambda):
+            return NONE
+        if isinstance(node, ast.Slice):
+            self.expr(node.lower)
+            self.expr(node.upper)
+            self.expr(node.step)
+            return NONE
+        if isinstance(node, ast.NamedExpr):
+            kind = self.expr(node.value)
+            self._assign_target(node.target, kind)
+            return kind
+        # fallback: evaluate children
+        kinds = [
+            self.expr(c)
+            for c in ast.iter_child_nodes(node)
+            if isinstance(c, ast.expr)
+        ]
+        return max(kinds) if kinds else NONE
+
+    def _subscript(self, node: ast.Subscript) -> int:
+        base = self.expr(node.value)
+        if isinstance(node.slice, ast.Slice):
+            # slices clamp and the result is bounded by the source —
+            # evaluate the bounds (for nested sinks) but no index sink
+            self.expr(node.slice)
+            return base
+        idx_kind = self.expr(node.slice)
+        if (
+            self.rules & RULE_TAINT
+            and idx_kind == VAL
+            and self.index_guard == 0
+            and isinstance(node.ctx, ast.Load)
+            and not (
+                isinstance(node.value, ast.Name)
+                and node.value.id in self.dict_names
+            )
+        ):
+            self.eng.report(
+                "safe-index-unchecked",
+                self.key,
+                node,
+                "subscript with an unclamped attacker-controlled "
+                "integer — a signed wire field makes this silent "
+                "negative-index aliasing, not just IndexError",
+            )
+        return base
+
+    def _comprehension(self, node) -> int:
+        result = NONE
+        for gen in node.generators:
+            iter_kind = self.expr(gen.iter)
+            elem = _element_kind(gen.iter, iter_kind, self)
+            frame = amplify.LoopFrame(
+                gen.iter,
+                tainted=iter_kind != NONE,
+                clamped=amplify.iter_clamped(gen.iter),
+            )
+            if (
+                self.rules & RULE_QUADRATIC
+                and frame.tainted
+                and not frame.clamped
+            ):
+                outer = amplify.enclosing_tainted(self.loops)
+                if outer is not None:
+                    self.report_quadratic(gen.iter, outer)
+            self.loops.append(frame)
+            self._bind_loop_target(gen.target, gen.iter, elem)
+            for cond in gen.ifs:
+                self.expr(cond)
+                self._sanitize_test(cond)
+        try:
+            if isinstance(node, ast.DictComp):
+                result = max(self.expr(node.key), self.expr(node.value))
+            else:
+                result = self.expr(node.elt)
+        finally:
+            for _ in node.generators:
+                self.loops.pop()
+        return result
+
+    # -- calls --
+
+    def _call(self, node: ast.Call) -> int:
+        func = node.func
+        # evaluate the receiver FIRST (never skip operand evaluation)
+        recv_kind = NONE
+        attr = ""
+        if isinstance(func, ast.Attribute):
+            recv_kind = self.expr(func.value)
+            attr = func.attr
+        arg_kinds = [self.expr(a) for a in node.args]
+        kw_kinds = {}
+        spread_kind = NONE  # a tainted `**kwargs` can land anywhere;
+        for kw in node.keywords:  # it joins max_arg, never a position
+            k = self.expr(kw.value)
+            if kw.arg is not None:
+                kw_kinds[kw.arg] = k
+            else:
+                spread_kind = max(spread_kind, k)
+        max_arg = max(
+            [NONE, spread_kind] + arg_kinds + list(kw_kinds.values())
+        )
+
+        name = ""
+        if isinstance(func, ast.Name):
+            name = func.id
+
+        # mutating a container with tainted elements taints the
+        # container (`seen.append(x)` — the list the membership scan
+        # will walk); the two-pass loop body makes the later uses see it
+        if (
+            attr in ("append", "extend", "add", "insert", "appendleft",
+                     "update", "setdefault")
+            and max_arg
+            and isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+        ):
+            recv_name = func.value.id
+            if recv_name not in self.sanitized:
+                cur = self.env.get(recv_name, NONE)
+                if max_arg > cur:
+                    self.env[recv_name] = max_arg
+
+        # builtins and parse primitives (checked BEFORE graph
+        # resolution: decode_varint etc. are in-package, but their
+        # semantics — LEN bytes in, VAL int out — are the model)
+        if name == "len":
+            return LEN if max_arg else NONE
+        if name in ("int", "abs", "ord", "round"):
+            return VAL if max_arg else NONE
+        if name == "min" and arg_kinds:
+            return min(arg_kinds)
+        if name == "max" and arg_kinds:
+            return max(arg_kinds)
+        if name in _CLEAN_EXTERNALS:
+            return NONE
+        if name == "range":
+            bound = max([NONE] + arg_kinds)
+            if bound == VAL and self.rules & RULE_TAINT:
+                self.eng.report(
+                    "safe-alloc-unbounded",
+                    self.key,
+                    node,
+                    "`range()` bound is an unclamped attacker-controlled "
+                    "integer — ten wire bytes buy 2**63 iterations",
+                )
+            return bound
+        if name in ("bytes", "bytearray") and arg_kinds:
+            if arg_kinds[0] == VAL and self.rules & RULE_TAINT:
+                self.eng.report(
+                    "safe-alloc-unbounded",
+                    self.key,
+                    node,
+                    f"`{name}()` sized by an unclamped attacker-"
+                    "controlled integer — an over-allocation before any "
+                    "validation runs",
+                )
+            return LEN if max_arg else NONE
+        if name in _PARSE_VAL_FNS:
+            return VAL if max_arg else NONE
+        if name in _PARSE_LEN_FNS:
+            return LEN if max_arg else NONE
+        if name in ("set", "frozenset", "dict", "list", "tuple", "sorted",
+                    "reversed", "enumerate", "zip", "sum"):
+            return max_arg
+
+        # attribute-call families
+        if attr:
+            if attr in _SOCKET_READ_ATTRS:
+                if (
+                    arg_kinds
+                    and arg_kinds[0] == VAL
+                    and self.rules & RULE_TAINT
+                ):
+                    # read(n)/readexactly(n) with a parsed, unclamped
+                    # size: the buffer IS the allocation
+                    self.eng.report(
+                        "safe-alloc-unbounded",
+                        self.key,
+                        node,
+                        f"`.{attr}()` sized by an unclamped attacker-"
+                        "controlled integer — the receive buffer is "
+                        "allocated before any bound is checked",
+                    )
+                return LEN
+            if attr in _PARSE_VAL_FNS:
+                return VAL if max(recv_kind, max_arg) else NONE
+            if attr in _PARSE_LEN_FNS:
+                return LEN if max(recv_kind, max_arg) else NONE
+            if attr in ("unpack", "unpack_from", "from_bytes"):
+                return VAL if max_arg else NONE
+            if attr == "loads":
+                return VAL if max_arg else NONE
+            if recv_kind:
+                if attr in _READER_INT or attr in _READER_VAL_COLLECTION:
+                    return VAL
+                if attr in _READER_LEN:
+                    return max(recv_kind, LEN)
+
+        # resolved in-package call
+        site = self.sites.get((node.lineno, node.col_offset))
+        if site is not None and site.target is not None:
+            return self._internal_call(node, site, arg_kinds, kw_kinds,
+                                       recv_kind, max_arg)
+        if site is not None and site.external is not None:
+            leaf = site.external.split(".")[-1]
+            if leaf in _PARSE_VAL_FNS or leaf in ("loads", "unpack",
+                                                  "unpack_from"):
+                return VAL if max(recv_kind, max_arg) else NONE
+            if leaf in _PARSE_LEN_FNS:
+                return LEN if max(recv_kind, max_arg) else NONE
+            if leaf in _CLEAN_EXTERNALS:
+                return NONE
+        # unknown/external: attacker data in, assume attacker data out —
+        # EXCEPT through an opaque method on an untainted receiver (a
+        # store/index lookup keyed by attacker input): the attacker
+        # selects which of OUR values comes back, they don't inject an
+        # unbounded integer, so VAL decays to LEN across the call
+        result = max(recv_kind, max_arg)
+        if attr and not recv_kind and result == VAL:
+            result = LEN
+        return result
+
+    def _internal_call(
+        self, node, site, arg_kinds, kw_kinds, recv_kind, max_arg
+    ) -> int:
+        target: FuncKey = site.target
+        callee = self.eng.pkg.functions.get(target)
+        if callee is None:
+            return max_arg
+        # map taints onto callee parameter names (keyword lookup covers
+        # keyword-only params too — dropping them silently discarded
+        # taint passed as `count=parsed_varint` into a kwonly arg)
+        taints: Dict[str, int] = {}
+        args = callee.node.args
+        positional = [a.arg for a in args.posonlyargs + args.args]
+        params = positional + [a.arg for a in args.kwonlyargs]
+        pos = list(positional)
+        if pos and pos[0] in ("self", "cls"):
+            if recv_kind:
+                taints[pos[0]] = recv_kind
+            pos = pos[1:]
+        for i, kind in enumerate(arg_kinds):
+            if kind and i < len(pos):
+                taints[pos[i]] = max(taints.get(pos[i], NONE), kind)
+        for kname, kind in kw_kinds.items():
+            if kind and kname in params:
+                taints[kname] = max(taints.get(kname, NONE), kind)
+        if not taints and not max(recv_kind, max_arg):
+            return NONE
+        if target == self.key:
+            # recursion is a VAL-only sink: depth driven by a parsed
+            # integer is unbounded; depth driven by nested structure
+            # (LEN) costs the attacker bytes per level and is already
+            # capped by the transport's message-size limit
+            if (
+                max_arg == VAL
+                and self.rules & RULE_TAINT
+                and self.index_guard == 0
+            ):
+                self.eng.report(
+                    "safe-alloc-unbounded",
+                    self.key,
+                    node,
+                    "recursion depth driven by an unclamped attacker-"
+                    "controlled integer — the Python stack is the "
+                    "allocation",
+                )
+            return max_arg
+        ret = self.eng._flow_into(
+            self.key, target, taints, self.rules, node.lineno
+        )
+        if target[1].endswith(".__init__"):
+            # a constructor call evaluates to the INSTANCE, not to
+            # __init__'s (None) return: the object wraps its tainted
+            # arguments, so reader/message objects built over attacker
+            # bytes stay tainted for the accessor special-cases
+            return max(recv_kind, max_arg)
+        return max(ret, NONE)
+
+    def _check_repeat_sink(self, node, left, right) -> None:
+        for seq_side, n_side, n_kind in (
+            (node.left, node.right, right),
+            (node.right, node.left, left),
+        ):
+            if n_kind != VAL:
+                continue
+            if isinstance(seq_side, ast.Constant) and isinstance(
+                seq_side.value, (str, bytes)
+            ):
+                seq = True
+            elif isinstance(seq_side, (ast.List, ast.Tuple)):
+                seq = True
+            else:
+                seq = False
+            if seq:
+                self.eng.report(
+                    "safe-alloc-unbounded",
+                    self.key,
+                    node,
+                    "sequence repetition sized by an unclamped attacker-"
+                    "controlled integer — an over-allocation before any "
+                    "validation runs",
+                )
+                return
+
+
+def _is_set_expr(node) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("set", "frozenset")
+    return False
+
+
+def _is_dict_expr(node) -> bool:
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id == "dict"
+    return False
+
+
+def _is_dict_annotation(ann) -> bool:
+    base = ann
+    if isinstance(base, ast.Subscript):
+        base = base.value
+    name = ""
+    if isinstance(base, ast.Name):
+        name = base.id
+    elif isinstance(base, ast.Attribute):
+        name = base.attr
+    return name in ("dict", "Dict", "Mapping", "MutableMapping",
+                    "defaultdict", "OrderedDict", "Counter")
+
+
+def _is_fixed_literal(node) -> bool:
+    """Non-empty container literal whose members are all constants —
+    a fixed membership/dispatch table, not an accumulator."""
+    if isinstance(node, ast.Dict):
+        return bool(node.keys) and all(
+            isinstance(k, ast.Constant) for k in node.keys if k is not None
+        )
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        return bool(node.elts) and all(
+            isinstance(e, ast.Constant) for e in node.elts
+        )
+    return False
+
+
+def _element_kind(iter_node, iter_kind: int, walker: _BodyWalker) -> int:
+    """What iterating this expression binds: iter_fields and
+    FieldReader.get_all yield parsed values (VAL); everything else
+    yields elements no worse than the collection itself."""
+    if iter_kind == NONE:
+        return NONE
+    if isinstance(iter_node, ast.Call):
+        fn = iter_node.func
+        leaf = ""
+        if isinstance(fn, ast.Name):
+            leaf = fn.id
+        elif isinstance(fn, ast.Attribute):
+            leaf = fn.attr
+        if leaf in _PARSE_VAL_FNS or leaf in _READER_VAL_COLLECTION:
+            return VAL
+    return iter_kind
+
+
+def _try_guards_index(node: ast.Try) -> bool:
+    for h in node.handlers:
+        if h.type is None:
+            return True
+        names: List[str] = []
+        t = h.type
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        for e in elts:
+            if isinstance(e, ast.Name):
+                names.append(e.id)
+            elif isinstance(e, ast.Attribute):
+                names.append(e.attr)
+        if any(n in _INDEX_GUARD_EXCS for n in names):
+            return True
+    return False

@@ -1,0 +1,243 @@
+"""Validate-before-use ordering gate (`safe-unvalidated-use`).
+
+The reference codebase's discipline is a convention: every reactor
+handler calls `msg.validate_basic()` before letting the message touch
+consensus state. Conventions rot; this pass makes the 25 in-tree
+sites a checked catalog.
+
+Model: a guarded breadth-first search over the PR-5 call graph.
+
+- **Entries** — where attacker messages first meet domain logic:
+  every function with an `Envelope`-annotated parameter (the p2p
+  reactor handlers across consensus/blocksync/statesync/mempool/
+  evidence/pex) and every `RPCRequest`-annotated route handler.
+- **Sinks** — the consensus-mutation catalog (`MUTATION_SINKS`):
+  VoteSet.add_vote, PartSet.add_part, the evidence pool's
+  add_evidence, mempool check_tx, and the PeerState.apply_*/set_has_*
+  family. Adding a new sink name here is a reviewed change.
+- **Guard** — a call whose callee is `validate_basic` (resolved or
+  syntactic `<recv>.validate_basic()` — receivers of decoded messages
+  are dynamically typed, so the unresolved form counts too).
+
+State at each function is a single bit: has SOME validate_basic call
+already happened on this path? An outgoing edge at line L from
+function F is guarded when F contains a validate_basic call at a line
+before L (the universal `msg.validate_basic(); apply(msg)` shape), or
+when F itself was entered validated. Reaching a sink unvalidated is a
+finding, with the full entry -> ... -> sink witness chain.
+
+Precision notes (documented, deliberate):
+- The guard is not message-type-aware — any validate_basic before the
+  sink-ward call counts. The codebase validates the envelope's own
+  message at the top of each handler, so type confusion would require
+  validating one message and applying another inside a single handler;
+  the fuzzer half of tmsafe covers that corner dynamically.
+- Queue hand-offs (send_peer_msg -> consumer loops) break the static
+  call chain by design; the gate's contract is the HANDLER boundary:
+  nothing may cross from an entry to a sink in one synchronous call
+  chain unvalidated.
+- Lexical before/after stands in for dominance. An `elif` arm's
+  validate call cannot guard a different arm's sink in practice
+  because every arm validates first — and removing any arm's validate
+  WILL flip that arm's sink red, which is the regression the gate
+  exists to catch.
+
+Suppression: `# tmsafe: safe-unvalidated-use-ok — why` on (or in the
+comment block above) the sink-calling line, for sinks whose validation
+is definitionally elsewhere (an opaque tx has no validate_basic — the
+app's CheckTx IS its validation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tmcheck.callgraph import FuncInfo, Package, _body_walk
+
+__all__ = ["MUTATION_SINKS", "UnvalidatedUse", "check"]
+
+FuncKey = Tuple[str, str]
+
+# (path, qualname) -> why this is consensus mutation
+MUTATION_SINKS: Dict[FuncKey, str] = {
+    ("types/vote_set.py", "VoteSet.add_vote"): (
+        "admits a vote into the tally that decides commits"
+    ),
+    ("types/part_set.py", "PartSet.add_part"): (
+        "admits a block part into proposal assembly"
+    ),
+    ("evidence/pool.py", "EvidencePool.add_evidence"): (
+        "admits evidence that can slash a validator"
+    ),
+    ("mempool/mempool.py", "TxMempool.check_tx"): (
+        "admits a transaction into the mempool"
+    ),
+    ("mempool/nop.py", "NopMempool.check_tx"): (
+        "mempool admission (nop backend)"
+    ),
+    ("mempool/types.py", "Mempool.check_tx"): (
+        "mempool admission (abstract protocol — what the RPC "
+        "broadcast routes resolve to)"
+    ),
+    ("consensus/peer_state.py", "PeerState.apply_new_round_step"): (
+        "rewrites our model of the peer's round state"
+    ),
+    ("consensus/peer_state.py", "PeerState.apply_new_valid_block"): (
+        "rewrites our model of the peer's proposal block"
+    ),
+    ("consensus/peer_state.py", "PeerState.apply_proposal_pol"): (
+        "rewrites the peer's proposal POL bits"
+    ),
+    ("consensus/peer_state.py", "PeerState.apply_has_vote"): (
+        "marks votes as held by the peer (gossip suppression)"
+    ),
+    ("consensus/peer_state.py", "PeerState.apply_vote_set_bits"): (
+        "rewrites the peer's vote bitmaps (gossip suppression)"
+    ),
+    ("consensus/peer_state.py", "PeerState.set_has_proposal"): (
+        "marks the proposal as held by the peer"
+    ),
+    ("consensus/peer_state.py", "PeerState.set_has_proposal_block_part"): (
+        "marks block parts as held by the peer"
+    ),
+    ("consensus/peer_state.py", "PeerState.set_has_vote"): (
+        "marks a single vote as held by the peer"
+    ),
+}
+
+
+class UnvalidatedUse:
+    __slots__ = ("sink", "caller", "lineno", "col", "chain", "why")
+
+    def __init__(self, sink, caller, lineno, col, chain, why):
+        self.sink = sink  # FuncKey of the mutation sink
+        self.caller = caller  # FuncKey of the function calling it
+        self.lineno = lineno
+        self.col = col
+        self.chain = chain  # [entry, ..., caller] FuncKeys
+        self.why = why
+
+
+def _entry_keys(pkg: Package) -> List[FuncKey]:
+    from .sources import _annotated_params
+
+    out = []
+    for key, fi in sorted(pkg.functions.items()):
+        if _annotated_params(fi, "Envelope") or _annotated_params(
+            fi, "RPCRequest"
+        ):
+            out.append(key)
+        elif _has_envelope_loop(fi):
+            out.append(key)
+    return out
+
+
+def _has_envelope_loop(fi: FuncInfo) -> bool:
+    """The inline receive-loop shape: `async for envelope in
+    <channel>` — the evidence/mempool reactors consume their channel
+    directly instead of registering per-envelope handler methods, and
+    those loops are entry points exactly like an Envelope-annotated
+    handler."""
+    for node in _body_walk(fi.node):
+        if (
+            isinstance(node, ast.AsyncFor)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == "envelope"
+        ):
+            return True
+    return False
+
+
+def _validate_call_lines(fi: FuncInfo) -> List[int]:
+    """Line numbers of `*.validate_basic(...)` calls in this body —
+    syntactic, because decoded-message receivers rarely resolve."""
+    out = []
+    for node in _body_walk(fi.node):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "validate_basic"
+        ):
+            out.append(node.lineno)
+    return sorted(out)
+
+
+def check(
+    pkg: Package, suppressed: Dict[str, Set[int]]
+) -> Tuple[List[UnvalidatedUse], List[Tuple[str, int, FuncKey]]]:
+    """`suppressed`: path -> line numbers carrying the
+    safe-unvalidated-use-ok annotation (caller-side sink lines).
+    Returns (findings, suppressed sink sites actually hit) — the
+    second list feeds the head-catalog test that pins every accepted
+    suppression to a finding it really covers."""
+    entries = _entry_keys(pkg)
+    validate_lines: Dict[FuncKey, List[int]] = {}
+
+    def v_lines(key: FuncKey) -> List[int]:
+        if key not in validate_lines:
+            validate_lines[key] = _validate_call_lines(pkg.functions[key])
+        return validate_lines[key]
+
+    # BFS over (function, validated) states. parent links for witness.
+    State = Tuple[FuncKey, bool]
+    parent: Dict[State, Optional[State]] = {}
+    queue: List[State] = []
+    for e in entries:
+        s = (e, False)
+        if s not in parent:
+            parent[s] = None
+            queue.append(s)
+
+    findings: Dict[Tuple[FuncKey, FuncKey, int], UnvalidatedUse] = {}
+    hits: List[Tuple[str, int, FuncKey]] = []
+    qi = 0
+    while qi < len(queue):
+        key, validated = queue[qi]
+        qi += 1
+        fi = pkg.functions[key]
+        vlines = v_lines(key)
+        for site in fi.calls:
+            if site.target is None:
+                continue
+            guarded = validated or any(
+                ln < site.lineno for ln in vlines
+            )
+            if site.target in MUTATION_SINKS:
+                if guarded:
+                    continue
+                if site.lineno in suppressed.get(fi.path, ()):
+                    hit = (fi.path, site.lineno, site.target)
+                    if hit not in hits:
+                        hits.append(hit)
+                    continue
+                fk = (site.target, key, site.lineno)
+                if fk not in findings:
+                    chain: List[FuncKey] = []
+                    cur: Optional[State] = (key, validated)
+                    while cur is not None:
+                        chain.append(cur[0])
+                        cur = parent[cur]
+                    chain.reverse()
+                    findings[fk] = UnvalidatedUse(
+                        site.target,
+                        key,
+                        site.lineno,
+                        site.col,
+                        chain,
+                        MUTATION_SINKS[site.target],
+                    )
+                continue
+            if site.target not in pkg.functions:
+                continue
+            nxt = (site.target, guarded)
+            if nxt not in parent:
+                parent[nxt] = (key, validated)
+                queue.append(nxt)
+    return (
+        sorted(
+            findings.values(),
+            key=lambda f: (f.caller[0], f.lineno, f.sink),
+        ),
+        hits,
+    )

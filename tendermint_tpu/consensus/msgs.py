@@ -14,7 +14,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..encoding.proto import FieldReader, ProtoWriter
+from ..encoding.proto import (
+    FieldReader,
+    ProtoWriter,
+    decode_varint,
+    encode_varint,
+)
 from ..libs.bits import BitArray
 from ..types.block_id import BlockID, PartSetHeader
 from ..types.part_set import Part
@@ -46,14 +51,25 @@ __all__ = [
 
 
 # -- BitArray proto (reference: libs/bits/types.pb.go: bits=1, elems=2) --
+#
+# `elems` is `repeated uint64` and proto3 packs repeated scalars: ONE
+# length-delimited field holding concatenated varints. Packing is not
+# just fidelity — the earlier per-word `w.uint(2, word)` form reused
+# the SINGULAR writer, whose proto3 zero-omission dropped all-zero
+# middle words, shifting every later word down on decode (bit 190
+# silently became bit 126 once a validator set crossed 128 and a word
+# went quiet). Packed varints have no zero-omission.
+
 
 def encode_bit_array(ba: Optional[BitArray]) -> Optional[bytes]:
     if ba is None:
         return None
     w = ProtoWriter()
     w.int(1, ba.size)
+    packed = bytearray()
     for word in ba.to_words():
-        w.uint(2, word)
+        packed += encode_varint(word)
+    w.bytes(2, bytes(packed))
     return w.finish()
 
 
@@ -62,7 +78,19 @@ def decode_bit_array(data: Optional[bytes]) -> Optional[BitArray]:
         return None
     r = FieldReader(data)
     size = r.int64(1)
-    words = list(r.get_all(2))
+    words: list = []
+    for v in r.get_all(2):
+        if isinstance(v, bytes):
+            # packed (canonical): concatenated varints
+            off = 0
+            while off < len(v):
+                word, off = decode_varint(v, off)
+                words.append(word)
+        else:
+            # legacy unpacked record (pre-packed WAL entries); zero
+            # words were dropped by the old writer, so trailing
+            # placement is best-effort — packed is the canonical form
+            words.append(v)
     return BitArray.from_words(size, words)
 
 
@@ -241,10 +269,15 @@ class BlockPartMessage:
     def from_proto(cls, data: bytes) -> "BlockPartMessage":
         r = FieldReader(data)
         p = r.get(3)
+        if p is None:
+            # the old `else Part()` fallback ALWAYS crashed (Part has
+            # no field defaults) — a missing part is a parse error,
+            # same as the reference's nil-Part FromProto failure
+            raise ValueError("BlockPartMessage: missing part field")
         return cls(
             height=r.int64(1),
             round=r.int64(2),
-            part=Part.from_proto(p) if p is not None else Part(),
+            part=Part.from_proto(p),
         )
 
     def validate_basic(self) -> None:

@@ -60,11 +60,16 @@ def encode_varint(value: int) -> bytes:
 def decode_varint(data: bytes, offset: int = 0) -> Tuple[int, int]:
     """Decode a varint; returns (value, new_offset)."""
     # single-byte fast path: the overwhelmingly common case for tags
-    # and small lengths (mirror of encode_varint's interned table)
+    # and small lengths (mirror of encode_varint's interned table).
+    # TypeError covers hostile type confusion (an int smuggled where
+    # bytes belong by a wire-type flip): parse errors are ValueError,
+    # the sanctioned decode-failure contract.
     try:
         b = data[offset]
     except IndexError:
         raise ValueError("truncated varint") from None
+    except TypeError:
+        raise ValueError("varint input is not bytes") from None
     if not b & 0x80:
         return b, offset + 1
     # seed the loop with the byte already fetched
@@ -222,6 +227,13 @@ def iter_fields(data: bytes) -> Iterator[Tuple[int, int, "int | bytes"]]:
 
     Varint/fixed fields yield ints; length-delimited yield bytes.
     """
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        # a nested decoder handed a wire-type-confused value (int where
+        # a submessage's bytes belong): sanctioned parse error, not a
+        # TypeError three frames later
+        raise ValueError(
+            f"message input is not bytes (got {type(data).__name__})"
+        )
     offset = 0
     while offset < len(data):
         key, offset = decode_varint(data, offset)
@@ -246,7 +258,18 @@ def iter_fields(data: bytes) -> Iterator[Tuple[int, int, "int | bytes"]]:
 
 
 class FieldReader:
-    """Random-access view over a single encoded message's fields."""
+    """Random-access view over a single encoded message's fields.
+
+    The typed accessors ENFORCE the wire type: a peer that sends field
+    N as a varint where the schema says length-delimited (or vice
+    versa) gets a ValueError from the accessor, not an int leaking
+    into code that calls `.decode()`/`len()` on it and dies with an
+    AttributeError three frames later. This is the sanctioned-error
+    contract the WAL corruption handler, the RPC error mapper and the
+    decoder fuzzer (tests/test_decoder_fuzz.py) rely on: malformed
+    wire input fails as a *parse error*, never as a type confusion.
+    `get`/`get_all` stay raw for callers that handle both shapes
+    (packed-vs-unpacked repeated fields, nested submessage bytes)."""
 
     def __init__(self, data: bytes) -> None:
         self._fields: dict[int, list] = {}
@@ -261,25 +284,66 @@ class FieldReader:
         return self._fields.get(field, [])
 
     def uint(self, field: int, default: int = 0) -> int:
-        return int(self.get(field, default))
+        vals = self._fields.get(field)
+        if not vals:
+            return default
+        v = vals[-1]
+        if not isinstance(v, int):
+            raise ValueError(
+                f"field {field}: expected varint, got length-delimited"
+            )
+        return int(v)
 
     def int64(self, field: int, default: int = 0) -> int:
-        v = int(self.get(field, default))
+        vals = self._fields.get(field)
+        if not vals:
+            return default
+        v = vals[-1]
+        if not isinstance(v, int):
+            raise ValueError(
+                f"field {field}: expected varint, got length-delimited"
+            )
+        v = int(v)
         return v - (1 << 64) if v >= 1 << 63 else v
 
     def sfixed64(self, field: int, default: int = 0) -> int:
         v = self.get(field)
         if v is None:
             return default
+        if not isinstance(v, int):
+            raise ValueError(
+                f"field {field}: expected fixed64, got length-delimited"
+            )
         return v - (1 << 64) if v >= 1 << 63 else v
 
     def bytes(self, field: int, default: bytes = b"") -> bytes:
-        v = self.get(field, default)
+        vals = self._fields.get(field)
+        if not vals:
+            return default
+        v = vals[-1]
+        if not isinstance(v, (bytes, bytearray, memoryview)):
+            raise ValueError(
+                f"field {field}: expected length-delimited, got varint"
+            )
         return v
 
     def string(self, field: int, default: str = "") -> str:
         v = self.get(field)
-        return v.decode("utf-8") if v is not None else default
+        if v is None:
+            return default
+        if not isinstance(v, (bytes, bytearray, memoryview)):
+            raise ValueError(
+                f"field {field}: expected length-delimited, got varint"
+            )
+        return bytes(v).decode("utf-8")
 
     def bool(self, field: int) -> bool:
-        return bool(self.get(field, 0))
+        vals = self._fields.get(field)
+        if not vals:
+            return False
+        v = vals[-1]
+        if not isinstance(v, int):
+            raise ValueError(
+                f"field {field}: expected varint, got length-delimited"
+            )
+        return bool(v)

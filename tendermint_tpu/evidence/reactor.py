@@ -70,7 +70,7 @@ class EvidenceReactor(Service):
         peer_updates: asyncio.Queue,
     ) -> None:
         super().__init__(name="evidence.reactor", logger=get_logger("evidence.reactor"))
-        self.pool = pool
+        self.pool: EvidencePool = pool
         self.channel = channel
         self.peer_updates = peer_updates
         self._peer_tasks: Dict[str, asyncio.Task] = {}
@@ -98,7 +98,21 @@ class EvidenceReactor(Service):
         async for envelope in self.channel:
             for ev in envelope.message.evidence:
                 try:
+                    # validate-before-use (tmsafe safe-unvalidated-use):
+                    # shape checks run before the pool touches state or
+                    # store, same discipline as the consensus handlers
+                    ev.validate_basic()
                     self.pool.add_evidence(ev)
+                except ValueError as e:
+                    self.logger.info(
+                        "peer sent malformed evidence",
+                        peer=envelope.from_peer[:12],
+                        err=str(e),
+                    )
+                    await self.channel.send_error(
+                        PeerError(node_id=envelope.from_peer, err=str(e))
+                    )
+                    break
                 except EvidenceError as e:
                     # A lagging node can't verify future-height evidence:
                     # that is not peer misbehavior (reference gates sends

@@ -10,7 +10,16 @@ from typing import Iterator, List, Optional
 
 from . import rng
 
-__all__ = ["BitArray"]
+__all__ = ["BitArray", "MAX_BIT_ARRAY_SIZE"]
+
+# DoS bound on wire-decoded bit arrays. The protocol's real maxima are
+# MAX_VOTES_COUNT (10_000) and MAX_BLOCK_PARTS_COUNT (1_601); 2**20
+# bits (a 128 KiB mask int) leaves two orders of magnitude of headroom
+# while keeping the `(1 << size)` masks every BitArray op builds
+# allocation-bounded. A varint `bits` field costs the attacker ten
+# bytes to claim 2**63 — without this clamp, from_words would try to
+# materialize that as a Python bigint.
+MAX_BIT_ARRAY_SIZE = 1 << 20
 
 
 class BitArray:
@@ -108,10 +117,30 @@ class BitArray:
 
     @classmethod
     def from_words(cls, size: int, words: List[int]) -> "BitArray":
+        # wire entry (decode_bit_array): `size` is an attacker-chosen
+        # varint; every BitArray op masks with `(1 << size) - 1`, so an
+        # unclamped size is a ten-byte bigint-allocation lever
+        if size > MAX_BIT_ARRAY_SIZE:
+            raise ValueError(
+                f"BitArray size {size} exceeds MAX_BIT_ARRAY_SIZE "
+                f"{MAX_BIT_ARRAY_SIZE}"
+            )
+        # the word COUNT must be bounded too: our encoder emits exactly
+        # ceil(size/64) words (legacy unpacked records DROPPED zero
+        # words, so fewer is tolerated — never more), and the assembly
+        # below must be linear in the words actually admitted, not a
+        # per-word `|=` that reallocates a growing bigint (measured
+        # 9.5 s for 512 KiB of hostile packed words under the old loop)
+        if len(words) > (size + 63) // 64:
+            raise ValueError(
+                f"BitArray: {len(words)} words exceed size {size}"
+            )
         out = cls(size)
-        bits = 0
-        for w, word in enumerate(words):
-            bits |= word << (64 * w)
+        try:
+            buf = b"".join(w.to_bytes(8, "little") for w in words)
+        except (OverflowError, AttributeError):
+            raise ValueError("BitArray word out of uint64 range") from None
+        bits = int.from_bytes(buf, "little")
         out._bits = bits & ((1 << size) - 1) if size else 0
         return out
 

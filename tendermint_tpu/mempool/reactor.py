@@ -66,7 +66,7 @@ class MempoolReactor(Service):
         broadcast: bool = True,
     ) -> None:
         super().__init__(name="mempool.reactor", logger=get_logger("mempool.reactor"))
-        self.mempool = mempool
+        self.mempool: TxMempool = mempool
         self.channel = channel
         self.peer_updates = peer_updates
         self.broadcast = broadcast
@@ -97,6 +97,10 @@ class MempoolReactor(Service):
             info = TxInfo(sender_id=envelope.from_peer)
             for tx in msg.txs:
                 try:
+                    # tmsafe: safe-unvalidated-use-ok — a tx is opaque
+                    # app bytes with no validate_basic of its own;
+                    # CheckTx IS the validation (size caps enforced by
+                    # the channel descriptor's max_tx_bytes upstream)
                     await self.mempool.check_tx(tx, info)
                 except MempoolError:
                     pass  # dup/full/invalid: normal gossip noise

@@ -120,14 +120,14 @@ class Environment:
         self.chain_id = chain_id
         self.block_store = block_store
         self.state_store = state_store
-        self.mempool = mempool
+        self.mempool: Optional[Mempool] = mempool
         self.event_bus = event_bus
         self.consensus = consensus
         self.consensus_reactor = consensus_reactor
         self.peer_manager = peer_manager
         self.proxy = proxy
         self.genesis = genesis
-        self.evidence_pool = evidence_pool
+        self.evidence_pool: Optional["EvidencePool"] = evidence_pool
         self.event_sinks = event_sinks or []
         self.node_info = node_info
         self.privval_pub_key = privval_pub_key
@@ -301,8 +301,11 @@ class Environment:
         min_h = max(int(req.params.get("min_height", base) or base), base)
         min_h = max(min_h, max_h - 19)
         metas = []
-        for h in range(max_h, min_h - 1, -1):
-            m = self.block_store.load_block_meta(h)
+        # descending page, count explicitly capped at 20: both bounds
+        # are client-chosen ints, so the loop bound must be a clamp
+        # expression, not a subtraction of two attacker values
+        for off in range(min(max_h - min_h + 1, 20)):
+            m = self.block_store.load_block_meta(max_h - off)
             if m is not None:
                 metas.append(encode(m))
         return {
@@ -520,6 +523,10 @@ class Environment:
         mp = self._require_mempool()
         tx = _decode_tx_param(req.params)
         try:
+            # tmsafe: safe-unvalidated-use-ok — a tx is opaque app
+            # bytes with no validate_basic of its own; CheckTx IS the
+            # validation (and _decode_tx_param already bounds the
+            # base64 payload by the HTTP body limit)
             res = await mp.check_tx(tx, TxInfo())
         except MempoolError as e:
             raise RPCError(INTERNAL_ERROR, f"tx rejected: {e}")
@@ -573,6 +580,8 @@ class Environment:
             raise RPCError(INTERNAL_ERROR, str(e))
         try:
             try:
+                # tmsafe: safe-unvalidated-use-ok — opaque app bytes;
+                # CheckTx IS the validation (same as broadcast_tx_sync)
                 check = await mp.check_tx(tx, TxInfo())
             except MempoolError as e:
                 raise RPCError(INTERNAL_ERROR, f"tx rejected: {e}")
@@ -674,6 +683,9 @@ class Environment:
             )
         try:
             ev = evidence_from_proto(bytes.fromhex(raw))
+            # validate-before-use (tmsafe safe-unvalidated-use): basic
+            # shape checks run before the pool is touched
+            ev.validate_basic()
         except Exception as e:
             raise RPCError(INVALID_PARAMS, f"invalid evidence: {e}")
         try:

@@ -96,11 +96,16 @@ class ValidatorParams:
 
     @classmethod
     def from_proto(cls, data: bytes) -> "ValidatorParams":
-        types = [
-            v.decode("utf-8")
-            for f, _wt, v in iter_fields(data)
-            if f == 1
-        ]
+        types = []
+        for f, _wt, v in iter_fields(data):
+            if f == 1:
+                if not isinstance(v, bytes):
+                    # wire-type flip: sanctioned parse error
+                    raise ValueError(
+                        "ValidatorParams.pub_key_types: expected "
+                        "length-delimited"
+                    )
+                types.append(v.decode("utf-8"))
         return cls(pub_key_types=types)
 
 

@@ -74,6 +74,9 @@ class _BlockVotes:
         i = vote.validator_index
         if self.votes[i] is None:
             self.bit_array.set(i, True)
+            # tmlive: bounded=fixed-size slot list: new() allocates
+            # [None] * num_validators and this only fills slot i in
+            # range — never appends
             self.votes[i] = vote
             self.sum += voting_power
 

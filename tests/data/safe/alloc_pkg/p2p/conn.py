@@ -1,0 +1,23 @@
+"""Seeded framing-family twin: a length-prefixed socket read with the
+claimed length unclamped (BAD) next to the MAX_FRAME-guarded shape the
+real connection layer uses (OK). Lives at p2p/conn.py inside the
+fixture so the framing-module entry family discovers it, exactly like
+the real tree."""
+
+import struct
+
+MAX_FRAME = 1 << 22
+
+
+async def read_frame_bad(reader):
+    hdr = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", hdr)
+    return await reader.readexactly(length)  # BAD: unclamped claimed size
+
+
+async def read_frame_guarded(reader):
+    hdr = await reader.readexactly(4)
+    (length,) = struct.unpack(">I", hdr)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    return await reader.readexactly(length)  # OK: clamped first

@@ -1,0 +1,66 @@
+"""Seeded safe-alloc-unbounded: decoders sizing allocations from
+unclamped parsed varints, with clamped / guarded / suppressed twins
+that must stay green. The decoders are discovered by the same schema
+extraction that feeds the real gate (FieldReader reads of literal
+tags), not by a hand catalog."""
+
+from tendermint_tpu.encoding.proto import FieldReader
+
+MAX_THING_BYTES = 1024
+
+
+def decode_bad_bytes(data: bytes):
+    r = FieldReader(data)
+    n = r.uint(1)
+    return bytes(n)  # BAD: unclamped parsed size
+
+
+def decode_bad_range(data: bytes):
+    r = FieldReader(data)
+    count = r.uint(1)
+    out = []
+    for _ in range(count):  # BAD: unclamped parsed loop bound
+        out.append(0)
+    return out
+
+
+def decode_bad_repeat(data: bytes):
+    r = FieldReader(data)
+    n = r.uint(1)
+    return b"\x00" * n  # BAD: repetition sized by parsed int
+
+
+def decode_bad_shift(data: bytes):
+    r = FieldReader(data)
+    size = r.uint(1)
+    return (1 << size) - 1  # BAD: bigint allocation via shift
+
+
+def decode_clamped(data: bytes):
+    r = FieldReader(data)
+    n = r.uint(1)
+    if n > MAX_THING_BYTES:
+        raise ValueError("too large")
+    return bytes(n)  # OK: clamped against MAX_*
+
+
+def decode_len_guarded(data: bytes):
+    r = FieldReader(data)
+    n = r.uint(1)
+    if n > len(data):
+        raise ValueError("length field exceeds payload")
+    return bytes(n)  # OK: bounded by bytes actually received
+
+
+def decode_min_clamped(data: bytes):
+    r = FieldReader(data)
+    n = r.uint(1)
+    return bytes(min(n, MAX_THING_BYTES))  # OK: min() clamp
+
+
+def decode_suppressed(data: bytes):
+    r = FieldReader(data)
+    n = r.uint(1)
+    # tmsafe: safe-alloc-unbounded-ok — fixture twin: proves the
+    # in-file suppression form reaches the line below
+    return bytes(n)

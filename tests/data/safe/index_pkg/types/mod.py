@@ -1,0 +1,37 @@
+"""Seeded safe-index-unchecked: a decoder steering a subscript with an
+unclamped parsed (signed!) integer, with range-checked / try-guarded /
+suppressed twins staying green."""
+
+from tendermint_tpu.encoding.proto import FieldReader
+
+LOOKUP = ["a", "b", "c"]
+
+
+def decode_bad_index(data: bytes):
+    r = FieldReader(data)
+    i = r.int64(1)
+    return LOOKUP[i]  # BAD: int64 is signed; -1 aliases the last entry
+
+
+def decode_checked_index(data: bytes):
+    r = FieldReader(data)
+    i = r.int64(1)
+    if i < 0 or i >= len(LOOKUP):
+        raise ValueError("index out of range")
+    return LOOKUP[i]  # OK: range-checked
+
+
+def decode_guarded_index(data: bytes):
+    r = FieldReader(data)
+    i = r.int64(1)
+    try:
+        return LOOKUP[i]  # OK: probe-and-translate idiom
+    except IndexError:
+        raise ValueError("index out of range") from None
+
+
+def decode_suppressed_index(data: bytes):
+    r = FieldReader(data)
+    i = r.int64(1)
+    # tmsafe: safe-index-unchecked-ok — fixture twin: suppression form
+    return LOOKUP[i]

@@ -1,0 +1,61 @@
+"""Seeded safe-quadratic-decode: nested iteration over attacker-sized
+collections in a decoder and in a validate_basic, with a clamped twin
+and a set-membership twin staying green."""
+
+from tendermint_tpu.encoding.proto import FieldReader
+
+MAX_ITEMS = 100
+
+
+def decode_bad_nested(data: bytes):
+    r = FieldReader(data)
+    items = r.get_all(1)
+    pairs = []
+    for a in items:  # BAD outer: attacker-sized
+        for b in items:  # BAD inner: attacker-sized, no clamp
+            pairs.append((a, b))
+    return pairs
+
+
+def decode_bad_membership(data: bytes):
+    r = FieldReader(data)
+    items = r.get_all(1)
+    seen = []
+    for x in items:  # attacker-sized loop ...
+        if x in seen:  # BAD: O(n) list scan per element
+            raise ValueError("duplicate")
+        seen.append(x)
+    return seen
+
+
+def decode_clamped_nested(data: bytes):
+    r = FieldReader(data)
+    items = r.get_all(1)
+    pairs = []
+    for a in items[:MAX_ITEMS]:  # OK: one bound clamped
+        for b in items:
+            pairs.append((a, b))
+    return pairs
+
+
+def decode_set_membership(data: bytes):
+    r = FieldReader(data)
+    items = r.get_all(1)
+    seen = set()
+    for x in items:
+        if x in seen:  # OK: set membership is O(1)
+            raise ValueError("duplicate")
+        seen.add(x)
+    return list(seen)
+
+
+class Thing:
+    def __init__(self) -> None:
+        self.parts = []
+        self.names = []
+
+    def validate_basic(self) -> None:
+        for p in self.parts:  # validator loops are amplification
+            for q in self.parts:  # BAD: quadratic pre-verification
+                if p is not q and p == q:
+                    raise ValueError("duplicate part")

@@ -1,0 +1,40 @@
+"""Seeded safe-unvalidated-use: an Envelope handler that reaches
+VoteSet.add_vote without calling validate_basic first, with validated
+/ transitively-validated / suppressed twins staying green."""
+
+from .types.vote_set import VoteSet
+
+
+class Envelope:
+    def __init__(self, message=None) -> None:
+        self.message = message
+
+
+class Reactor:
+    votes: VoteSet
+
+    def __init__(self) -> None:
+        self.votes = VoteSet()
+
+    async def handle_bad(self, envelope: Envelope) -> None:
+        msg = envelope.message
+        self.votes.add_vote(msg)  # BAD: no validate_basic on the path
+
+    async def handle_validated(self, envelope: Envelope) -> None:
+        msg = envelope.message
+        msg.validate_basic()
+        self.votes.add_vote(msg)  # OK: validated first
+
+    async def handle_transitive(self, envelope: Envelope) -> None:
+        msg = envelope.message
+        msg.validate_basic()
+        self._apply(msg)  # OK: the guard covers the callee's sink too
+
+    def _apply(self, msg) -> None:
+        self.votes.add_vote(msg)
+
+    async def handle_suppressed(self, envelope: Envelope) -> None:
+        msg = envelope.message
+        # tmsafe: safe-unvalidated-use-ok — fixture twin: validation is
+        # definitionally elsewhere for this message kind
+        self.votes.add_vote(msg)

@@ -1,0 +1,13 @@
+"""Fixture mirror of the real mutation-sink identity: the gate's
+MUTATION_SINKS catalog keys on (path, qualname), so a fixture package
+that defines types/vote_set.py::VoteSet.add_vote exercises the real
+sink matching, not a test-only shim."""
+
+
+class VoteSet:
+    def __init__(self) -> None:
+        self.votes = []
+
+    def add_vote(self, vote) -> bool:
+        self.votes.append(vote)
+        return True

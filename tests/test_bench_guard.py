@@ -253,3 +253,31 @@ print("OK")
     )
     assert r.returncode == 0, (r.returncode, r.stderr)
     assert "OK" in r.stdout
+
+
+def test_tmsafe_gate_row_never_initializes_jax():
+    """Same contract for the tmsafe_gate row: banked CPU block, pure
+    stdlib AST, jax must never load."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+row = bench.bench_tmsafe_gate()
+assert row["wall_s"] > 0 and "findings" in row and "suppressed" in row
+assert set(row["findings"]) == {
+    "safe-alloc-unbounded", "safe-index-unchecked",
+    "safe-unvalidated-use", "safe-quadratic-decode",
+}
+assert row["entries"] >= 100 and row["sinks_cataloged"] >= 10
+assert "jax" not in sys.modules, "tmsafe_gate dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout

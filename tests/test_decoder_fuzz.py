@@ -1,0 +1,255 @@
+"""Schema-seeded decoder fuzzer — the dynamic twin of the tmsafe
+static gate.
+
+tmsafe proves no *reachable* unclamped sink exists on decode paths;
+this suite proves no *observed* unclamped behavior exists: golden wire
+bytes are derived from the SAME schema extraction that pins tmcheck's
+`schema.json`, then deterministically mutated (truncate, tag-swap,
+varint-inflate, length-field inflation, byte flips), and every decoder
+must
+
+- raise only SANCTIONED error types (ValueError and subclasses — the
+  contract the WAL's corruption handling and the RPC error mapper
+  already rely on), never a TypeError/struct.error/AttributeError
+  that would escape those handlers;
+- never allocate past a byte budget proportional to the bytes the
+  "attacker" actually sent (tracemalloc peak — the dynamic form of
+  "no allocation from an unclamped parsed integer");
+- never hang (per-message wall budget).
+
+Replayability: every mutation is derived from
+`random.Random(crc32(message_key) ^ FUZZ_SEED)` plus the mutation
+index printed in the failure message — rerun with the same seed to
+get the identical byte string (the schedulefuzz convention)."""
+
+import importlib
+import inspect
+import time
+import tracemalloc
+import zlib
+
+import pytest
+
+from tendermint_tpu.analysis.tmcheck.schema import extract_package
+from tendermint_tpu.encoding.proto import ProtoWriter, encode_varint
+
+FUZZ_SEED = 0x7E4D
+MUTATIONS_PER_MESSAGE = 14
+MIN_MESSAGE_TYPES = 20
+MIN_TOTAL_MUTATIONS = 1000
+
+# the sanctioned decode-failure contract: everything downstream
+# (WAL _decode_record, RPC dispatch, reactor error paths) catches
+# ValueError; UnicodeDecodeError (garbage in a string field) is a
+# ValueError subclass by design
+SANCTIONED = (ValueError,)
+
+# bytes a decoder may allocate per byte of attacker input, plus slack
+# for fixed per-message object overhead (dataclass instances, the
+# FieldReader dict). The point is the SHAPE — linear in input, never
+# keyed off a parsed integer — not a tight constant.
+BYTES_PER_INPUT_BYTE = 64
+BYTE_BUDGET_SLACK = 512 * 1024
+
+
+def _dummy_value(method: str):
+    if method in ("uint", "int", "sint", "sfixed64", "fixed64", "sfixed32"):
+        return 1
+    if method == "bool":
+        return True
+    if method == "double":
+        return 1.0
+    if method == "bytes":
+        return b"\x01\x02\x03"
+    if method == "string":
+        return "x"
+    if method == "message":
+        return b""
+    raise AssertionError(f"unknown writer method {method}")
+
+
+def _build_golden(msg) -> bytes:
+    """Golden bytes straight from the extracted encoder schema: one
+    write per field, ascending tags only (a oneof contributes its
+    first arm), dummy values per writer method."""
+    w = ProtoWriter()
+    last = 0
+    for f in msg.fields:
+        if f.tag <= last:
+            continue  # oneof sibling arm / duplicate
+        getattr(w, f.method)(f.tag, _dummy_value(f.method))
+        last = f.tag
+    return w.finish()
+
+
+def _resolve_decoder(path: str, qualname: str):
+    mod_name = "tendermint_tpu." + path[:-3].replace("/", ".")
+    mod = importlib.import_module(mod_name)
+    obj = mod
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _single_bytes_param(fn) -> bool:
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    required = [
+        p
+        for p in sig.parameters.values()
+        if p.default is inspect.Parameter.empty
+        and p.kind
+        in (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        )
+        and p.name not in ("self", "cls")
+    ]
+    return len(required) == 1
+
+
+def _mutations(golden: bytes, rng) -> list:
+    """Deterministic mutation set for one message. Index order is part
+    of the replay recipe."""
+    out = []
+    n = len(golden)
+    # 1-3: truncations
+    for frac in (0.25, 0.5, 0.9):
+        out.append(golden[: int(n * frac)])
+    # 4: tag swap — rewrite the leading tag byte
+    if n:
+        out.append(bytes([rng.randrange(256)]) + golden[1:])
+    else:
+        out.append(b"\xff")
+    # 5: varint-inflate — append a field-1 varint of 2**64 - 1
+    out.append(golden + b"\x08" + encode_varint((1 << 64) - 1))
+    # 6: length-field x1000 — claim a huge length-delimited field
+    out.append(golden + b"\x12" + encode_varint(1000 * max(n, 1)) + b"\x00")
+    # 7: claimed length FAR past the payload (the classic over-alloc
+    # lever if a decoder trusts it)
+    out.append(b"\x0a" + encode_varint(1 << 40) + golden)
+    # 8: wire-type corruption — same field numbers, wire type 7
+    if n:
+        out.append(bytes([golden[0] | 0x07]) + golden[1:])
+    else:
+        out.append(b"\x07")
+    # 9-14: seeded byte flips / splices
+    for _ in range(6):
+        if not n:
+            out.append(bytes([rng.randrange(256)]))
+            continue
+        b = bytearray(golden)
+        for _ in range(rng.randrange(1, 4)):
+            b[rng.randrange(n)] = rng.randrange(256)
+        out.append(bytes(b))
+    return out
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """(key, decoder callable, golden bytes) for every schema-derived
+    message whose decoder takes a single bytes argument."""
+    messages, _ = extract_package()
+    out = []
+    for key in sorted(messages):
+        msg = messages[key]
+        if not msg.dec_func or not msg.fields:
+            continue
+        path, _, tail = key.partition("::")
+        for qual in (f"{tail}.{msg.dec_func}", msg.dec_func):
+            try:
+                fn = _resolve_decoder(path, qual)
+            except (AttributeError, ImportError):
+                continue
+            if _single_bytes_param(fn):
+                out.append((key, fn, _build_golden(msg)))
+            break
+    return out
+
+
+def test_corpus_is_broad_enough(corpus):
+    """The acceptance floor: >= 20 message types, >= 1000 deterministic
+    mutations per full run."""
+    assert len(corpus) >= MIN_MESSAGE_TYPES, (
+        f"only {len(corpus)} fuzzable decoders"
+    )
+    assert len(corpus) * MUTATIONS_PER_MESSAGE >= MIN_TOTAL_MUTATIONS
+
+
+def test_decoders_raise_only_sanctioned_errors(corpus):
+    """Every mutation either decodes or raises a sanctioned error —
+    never a TypeError/struct.error/KeyError that would escape the
+    WAL/RPC/reactor error handlers, never a hang, never an allocation
+    past the input-proportional byte budget."""
+    total = 0
+    failures = []
+    for key, fn, golden in corpus:
+        rng_seed = zlib.crc32(key.encode()) ^ FUZZ_SEED
+        import random
+
+        rng = random.Random(rng_seed)
+        muts = _mutations(golden, rng)
+        assert len(muts) == MUTATIONS_PER_MESSAGE
+        t0 = time.monotonic()
+        for i, data in enumerate(muts):
+            total += 1
+            budget = BYTES_PER_INPUT_BYTE * len(data) + BYTE_BUDGET_SLACK
+            tracemalloc.start()
+            try:
+                fn(data)
+            except SANCTIONED:
+                pass
+            except Exception as e:  # noqa: BLE001 - the point
+                failures.append(
+                    f"{key} mutation #{i} (seed {rng_seed:#x}): "
+                    f"unsanctioned {type(e).__name__}: {e}"
+                )
+            finally:
+                _, peak = tracemalloc.get_traced_memory()
+                tracemalloc.stop()
+            if peak > budget:
+                failures.append(
+                    f"{key} mutation #{i} (seed {rng_seed:#x}): "
+                    f"allocated {peak} bytes for {len(data)} input "
+                    f"bytes (budget {budget})"
+                )
+        elapsed = time.monotonic() - t0
+        if elapsed > 5.0:
+            failures.append(
+                f"{key}: {MUTATIONS_PER_MESSAGE} mutations took "
+                f"{elapsed:.1f}s — a decode hang or superlinear cost"
+            )
+    assert total >= MIN_TOTAL_MUTATIONS
+    assert not failures, (
+        f"{len(failures)} fuzz failures:\n" + "\n".join(failures[:25])
+    )
+
+
+def test_golden_bytes_decode_or_fail_sanctioned(corpus):
+    """The unmutated goldens themselves: dummy field values are not
+    semantically valid (a 3-byte pubkey), so decoders may reject them
+    — but only with sanctioned errors."""
+    for key, fn, golden in corpus:
+        try:
+            fn(golden)
+        except SANCTIONED:
+            pass
+        except Exception as e:  # noqa: BLE001
+            pytest.fail(
+                f"{key}: golden decode raised unsanctioned "
+                f"{type(e).__name__}: {e}"
+            )
+
+
+def test_mutations_are_deterministic(corpus):
+    """Replayability: the same (message, seed) yields byte-identical
+    mutations — the schedulefuzz convention for this suite."""
+    import random
+
+    key, fn, golden = corpus[0]
+    seed = zlib.crc32(key.encode()) ^ FUZZ_SEED
+    a = _mutations(golden, random.Random(seed))
+    b = _mutations(golden, random.Random(seed))
+    assert a == b

@@ -39,6 +39,86 @@ def test_bitarray_words_roundtrip():
     assert again == ba
 
 
+def test_bitarray_wire_roundtrip_with_zero_middle_word():
+    """Regression (tmsafe PR): the old per-word `w.uint(2, word)`
+    encoding reused the SINGULAR writer, whose proto3 zero-omission
+    dropped all-zero middle words — bit 190 silently became bit 126
+    once a word went quiet. Packed elems have no zero-omission."""
+    from tendermint_tpu.consensus.msgs import (
+        decode_bit_array,
+        encode_bit_array,
+    )
+
+    ba = BitArray(200)
+    ba.set(3)
+    ba.set(190)  # word 2; word 1 stays all-zero
+    dec = decode_bit_array(encode_bit_array(ba))
+    assert dec == ba
+    assert dec.get(190) and not dec.get(126)
+    # all-zero and empty arrays round-trip too
+    for size in (0, 100):
+        z = BitArray(size)
+        assert decode_bit_array(encode_bit_array(z)) == z
+
+
+def test_bitarray_legacy_unpacked_words_still_decode():
+    """Pre-packed WAL records carry per-word varint fields; the decoder
+    keeps accepting them."""
+    from tendermint_tpu.consensus.msgs import decode_bit_array
+    from tendermint_tpu.encoding.proto import ProtoWriter
+
+    w = ProtoWriter()
+    w.int(1, 128)
+    w.uint(2, 5)
+    w.uint(2, 7)
+    leg = decode_bit_array(w.finish())
+    assert leg.to_words() == [5, 7]
+
+
+def test_bitarray_from_words_rejects_unclamped_wire_size():
+    """Regression (tmsafe first-run true positive): `bits` is an
+    attacker-chosen varint and every BitArray op masks with
+    `(1 << size) - 1` — ten wire bytes must not buy a 2**60-bit
+    bigint allocation."""
+    from tendermint_tpu.consensus.msgs import decode_bit_array
+    from tendermint_tpu.encoding.proto import ProtoWriter
+    from tendermint_tpu.libs.bits import MAX_BIT_ARRAY_SIZE
+
+    with pytest.raises(ValueError, match="MAX_BIT_ARRAY_SIZE"):
+        BitArray.from_words(MAX_BIT_ARRAY_SIZE + 1, [])
+    w = ProtoWriter()
+    w.int(1, 1 << 60)
+    with pytest.raises(ValueError, match="MAX_BIT_ARRAY_SIZE"):
+        decode_bit_array(w.finish())
+    # the bound itself is fine
+    assert BitArray.from_words(MAX_BIT_ARRAY_SIZE, []).size == (
+        MAX_BIT_ARRAY_SIZE
+    )
+
+
+def test_bitarray_from_words_rejects_word_flood_and_stays_linear():
+    """Review finding (this PR): clamping `size` alone still let a
+    hostile packed elems field buy quadratic bigint work — 52k words
+    against bits=100 cost ~9.5 s under the old per-word `|=` loop.
+    The word count is now bounded by ceil(size/64) and assembly is a
+    single linear int.from_bytes."""
+    import time
+
+    from tendermint_tpu.libs.bits import MAX_BIT_ARRAY_SIZE
+
+    with pytest.raises(ValueError, match="words exceed size"):
+        BitArray.from_words(100, [1] * 52_000)
+    # legal worst case — a full MAX-size array — assembles fast
+    n_words = (MAX_BIT_ARRAY_SIZE + 63) // 64
+    t0 = time.monotonic()
+    out = BitArray.from_words(MAX_BIT_ARRAY_SIZE, [1] * n_words)
+    assert time.monotonic() - t0 < 1.0
+    assert out.get(0) and out.get(64 * (n_words - 1))
+    # words past uint64 are a parse error, not an OverflowError
+    with pytest.raises(ValueError, match="uint64"):
+        BitArray.from_words(128, [1 << 64])
+
+
 class _Svc(Service):
     def __init__(self):
         super().__init__("test")
