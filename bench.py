@@ -899,6 +899,214 @@ def bench_merkle_proof_batch(n: int = 10_000, use_device: bool = True):
             merkle_kernel.uninstall()
 
 
+def bench_merkle_multiproof(
+    n: int = 10_000, k: int = 256, reps: int = 5, rounds: int = 3
+):
+    """ISSUE 11's merkle half, interleaved A/B within every round so
+    box drift hits all arms equally (the bench_commit_warm convention):
+
+      A  per-proof baseline: a K-proof request served the only way the
+         recursive API can — proofs_from_byte_slices builds aunts for
+         ALL n leaves, the K asked-for proofs are selected out
+      B  vectorized cold: multiproofs_from_byte_slices — one
+         level-order schedule, inner nodes hashed once, aunts gathered
+         for the K requested indices only
+      W  vectorized warm: the fleet-serving steady state — the
+         per-block MerkleMultiTree is held and each request is pure
+         aunt gathering, zero hashing
+
+    plus the verification twin over ALL n proofs (verify_proofs_batch
+    vs verify_multiproofs_batch, whose shared-node memo turns
+    O(n log n) hashes into O(n)). Results are medians of round
+    medians; every rep's proofs are asserted byte-identical to the
+    oracle before being timed rows. Pure hashlib/numpy — banked CPU
+    block, never initializes jax (tests/test_bench_guard.py)."""
+    from tendermint_tpu.crypto import merkle
+
+    leaves = [b"leaf-%08d" % i for i in range(n)]
+    idxs = list(range(0, n, max(1, n // k)))[:k]
+    tree = merkle.MerkleMultiTree.from_byte_slices(leaves)
+    # correctness pin before any timing: vectorized == oracle
+    root_o, all_o = merkle.proofs_from_byte_slices(leaves)
+    root_v, sel_v = merkle.multiproofs_from_byte_slices(leaves, idxs)
+    assert root_v == root_o == tree.root
+    for i, pv in zip(idxs, sel_v):
+        po = all_o[i]
+        assert (pv.total, pv.index, pv.leaf_hash, pv.aunts) == (
+            po.total, po.index, po.leaf_hash, po.aunts
+        )
+    a_r, b_r, w_r = [], [], []
+    for _ in range(max(rounds, 1)):
+        a_t, b_t, w_t = [], [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _root, allp = merkle.proofs_from_byte_slices(leaves)
+            _sel = [allp[i] for i in idxs]
+            a_t.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            merkle.multiproofs_from_byte_slices(leaves, idxs)
+            b_t.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            tree.proofs(idxs)
+            w_t.append(time.perf_counter() - t0)
+        for times, acc in ((a_t, a_r), (b_t, b_r), (w_t, w_r)):
+            times.sort()
+            acc.append(times[len(times) // 2])
+    a_r.sort(), b_r.sort(), w_r.sort()
+    a = a_r[len(a_r) // 2]
+    b = b_r[len(b_r) // 2]
+    w = w_r[len(w_r) // 2]
+    # verification twin: all n proofs of one tree as a batch
+    pv_t, mv_t = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        bits = merkle.verify_proofs_batch(all_o, root_o, leaves)
+        pv_t.append(time.perf_counter() - t0)
+        assert bool(bits.all())
+        t0 = time.perf_counter()
+        bits = merkle.verify_multiproofs_batch(all_o, root_o, leaves)
+        mv_t.append(time.perf_counter() - t0)
+        assert bool(bits.all())
+    pv_t.sort(), mv_t.sort()
+    pv, mv = pv_t[len(pv_t) // 2], mv_t[len(mv_t) // 2]
+    return {
+        "leaves": n,
+        "k": k,
+        "per_proof_build_ms": round(a * 1e3, 2),
+        "vector_build_ms": round(b * 1e3, 2),
+        "vector_serve_ms": round(w * 1e3, 3),
+        "speedup_cold": round(a / b, 2),
+        "speedup_serving": round(a / w, 1),
+        "amortized_8req_speedup": round(8 * a / (b + 7 * w), 1),
+        "verify_per_proof_per_s": round(n / pv, 1),
+        "verify_multiproof_per_s": round(n / mv, 1),
+        "verify_speedup": round(pv / mv, 2),
+        "interleave": f"A/B/W x{reps} reps x{rounds} rounds, "
+        "median-of-round-medians",
+    }
+
+
+def bench_light_sync_bulk(
+    n_vals: int = 150, n_headers: int = 150, reps: int = 2,
+    rounds: int = 3,
+):
+    """ISSUE 11's light half: warm fleet serving, interleaved A/B.
+
+      A  the pre-bulk warm shape (the 435 headers/s row): a fresh
+         light client sequentially re-syncs a chain this process has
+         already verified — per-hop verify_adjacent, per-hop store
+         saves, every commit a commit-memo hit
+      B  bulk serving: the same M headers re-verified from memory in
+         ONE verify_adjacent_batch call (the light proxy's serving
+         path once blocks are fetched/decoded) — M commit-memo probes
+         + M tallies, no per-hop client machinery
+
+    Both arms run against the same primed sigcache (one cold bulk
+    pass populates triples AND commit memos — the memo keys are
+    shared with verify_commit_light, so the arms warm each other);
+    headers/s medians of round medians. CPU-only: no device verifier
+    is installed, so arm A keeps the reference's one-hop loop shape
+    (group_affinity() == 1)."""
+    import asyncio
+
+    from tendermint_tpu.crypto import sigcache
+    from tendermint_tpu.light import Client, LightStore, TrustOptions
+    from tendermint_tpu.light.provider import Provider
+    from tendermint_tpu.light.verifier import verify_adjacent_batch
+    from tendermint_tpu.store.kv import MemKV
+
+    chain_id = "bench-light-bulk"
+    lbs = _build_light_chain(chain_id, n_headers + 1, n_vals)
+    blocks = [lbs[h] for h in range(2, n_headers + 2)]
+    now_ns = time.time_ns()
+    period = 10**18
+
+    class P(Provider):
+        def id(self):
+            return "bench-bulk"
+
+        async def light_block(self, height):
+            return lbs[height if height > 0 else max(lbs)]
+
+        async def report_evidence(self, ev):
+            pass
+
+    async def client_pass():
+        lc = Client(
+            chain_id,
+            TrustOptions(
+                period_ns=period,
+                height=1,
+                hash=lbs[1].signed_header.hash(),
+            ),
+            P(),
+            [],
+            LightStore(MemKV()),
+            sequential=True,
+        )
+        t0 = time.perf_counter()
+        await lc.verify_light_block_at_height(n_headers + 1, now_ns)
+        return time.perf_counter() - t0
+
+    def bulk_pass():
+        t0 = time.perf_counter()
+        verify_adjacent_batch(
+            chain_id, lbs[1].signed_header, blocks, period, now_ns
+        )
+        return time.perf_counter() - t0
+
+    sigcache.reset()
+    cold_s = bulk_pass()  # priming run: triples + commit memos
+    s0 = sigcache.stats()
+    a_r, b_r = [], []
+    for _ in range(max(rounds, 1)):
+        a_t, b_t = [], []
+        for _ in range(reps):
+            a_t.append(asyncio.run(client_pass()))
+            b_t.append(bulk_pass())
+        a_t.sort(), b_t.sort()
+        a_r.append(a_t[len(a_t) // 2])
+        b_r.append(b_t[len(b_t) // 2])
+    s1 = sigcache.stats()
+    a_r.sort(), b_r.sort()
+    a = a_r[len(a_r) // 2]
+    b = b_r[len(b_r) // 2]
+    return {
+        "validators": n_vals,
+        "headers": n_headers,
+        "cold_bulk_headers_per_s": round(n_headers / cold_s, 1),
+        "warm_client_headers_per_s": round(n_headers / a, 1),
+        "warm_bulk_headers_per_s": round(n_headers / b, 1),
+        "speedup_warm": round(a / b, 2),
+        "commit_memo_hits": s1["commit_hits"] - s0["commit_hits"],
+        "interleave": f"A/B x{reps} reps x{rounds} rounds, "
+        "median-of-round-medians",
+    }
+
+
+def _persist_stateless(record: dict) -> None:
+    """Write BENCH_STATELESS.json — the bulk stateless-serving record
+    ISSUE 11's acceptance criteria are audited against: the
+    interleaved A/B multi-proof construction row and the warm bulk
+    light-serving row. Written as the stages land (same rationale as
+    _persist_midround) and kept out of the driver's one-line budget."""
+    import os
+    import time as _time
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_STATELESS.json",
+        )
+        with open(path, "w") as f:
+            json.dump(
+                {"recorded_unix": _time.time(), **record}, f, indent=1
+            )
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def bench_mempool_checktx(n_txs: int = 2000):
     """Mempool CheckTx ingest rate against the kvstore app over the
     local ABCI client (reference harness:
@@ -1479,11 +1687,31 @@ def main() -> None:
         "light_sync_headers_per_s_150vals_cpu",
     )
     _persist_warmpath_light()
+    cpu_stage(
+        "light_sync_bulk",
+        lambda: bench_light_sync_bulk(),
+        "light_sync_bulk_150vals",
+        600.0,
+    )
     cpu_stage("sign_keygen", bench_sign_keygen, "sign_keygen_us")
     cpu_stage(
         "merkle",
         lambda: round(bench_merkle_proof_batch(2_000, use_device=False), 1),
         "merkle_proof_batch_per_s_cpu",
+    )
+    cpu_stage(
+        "merkle_multiproof",
+        lambda: bench_merkle_multiproof(),
+        "merkle_multiproof_10k",
+        600.0,
+    )
+    _persist_stateless(
+        {
+            "merkle_multiproof_10k": extra.get("merkle_multiproof_10k"),
+            "light_sync_bulk_150vals": extra.get(
+                "light_sync_bulk_150vals"
+            ),
+        }
     )
     cpu_stage(
         "breaker_overhead",

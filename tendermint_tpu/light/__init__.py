@@ -20,6 +20,7 @@ from .verifier import (
     header_expired,
     verify,
     verify_adjacent,
+    verify_adjacent_batch,
     verify_backwards,
     verify_non_adjacent,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "MAX_CLOCK_DRIFT_NS",
     "verify",
     "verify_adjacent",
+    "verify_adjacent_batch",
     "verify_non_adjacent",
     "verify_backwards",
     "header_expired",

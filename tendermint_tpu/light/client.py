@@ -19,11 +19,7 @@ from typing import List, Optional
 from ..libs.log import get_logger
 from ..types.evidence import LightClientAttackEvidence
 from ..types.light import LightBlock
-from ..types.validation import (
-    Fraction,
-    collect_commit_light,
-    verify_triples_grouped,
-)
+from ..types.validation import Fraction
 from .errors import (
     DivergenceError,
     InvalidHeaderError,
@@ -36,9 +32,9 @@ from .store import LightStore
 from .verifier import (
     DEFAULT_TRUST_LEVEL,
     MAX_CLOCK_DRIFT_NS,
-    adjacent_header_checks,
     header_expired,
     verify,
+    verify_adjacent_batch,
     verify_backwards,
 )
 
@@ -54,10 +50,6 @@ _DEFAULT_PRUNING_SIZE = 1000  # reference: client.go defaultPruningSize
 # accelerator-backed verifier is installed, so CPU-only deployments
 # keep the reference's one-hop loop shape.
 SEQUENTIAL_BATCH_HOPS = 32
-
-
-# merged multi-commit signature check shared with types/validation.py
-_batch_verify_triples = verify_triples_grouped
 
 
 @dataclass
@@ -228,8 +220,6 @@ class Client:
         chip finishes in milliseconds). Any window failure falls back
         to the reference's one-hop-at-a-time loop for the exact error
         and store state."""
-        import asyncio
-
         from ..crypto.batch import group_affinity
 
         window = max(1, min(SEQUENTIAL_BATCH_HOPS, group_affinity()))
@@ -251,47 +241,26 @@ class Client:
             first = cur.height + 1
             last = min(first + window - 1, target.height)
             try:
-                # return_exceptions so one failed fetch does not leave
-                # the window's other in-flight fetches orphaned (gather
-                # would otherwise raise immediately and abandon them)
-                fetched = await asyncio.gather(
-                    *(
-                        self._from_primary(h)
-                        for h in range(first, min(last + 1, target.height))
-                    ),
-                    return_exceptions=True,
+                chunk = await self._fetch_range(
+                    first, min(last, target.height - 1)
                 )
-                for f in fetched:
-                    if isinstance(f, BaseException):
-                        raise f
-                chunk = list(fetched)
                 if last == target.height:
                     chunk.append(target)
-                prev = cur
-                triples: list = []
                 for b in chunk:
                     if b.height < target.height:
                         b.validate_basic(self.chain_id)
-                    adjacent_header_checks(
-                        self.chain_id,
-                        prev.signed_header,
-                        b.signed_header,
-                        b.validator_set,
-                        self.trust_options.period_ns,
-                        now_ns,
-                        self.max_clock_drift_ns,
-                    )
-                    triples.extend(
-                        collect_commit_light(
-                            self.chain_id,
-                            b.validator_set,
-                            b.signed_header.commit.block_id,
-                            b.height,
-                            b.signed_header.commit,
-                        )
-                    )
-                    prev = b
-                _batch_verify_triples(triples)
+                # all header-chain checks in hop order, then every
+                # commit through ONE sigcache-aware bulk verification
+                # (merged probe + grouped batch cold, M memo probes
+                # warm — types/validation.verify_commit_light_bulk)
+                verify_adjacent_batch(
+                    self.chain_id,
+                    cur.signed_header,
+                    chunk,
+                    self.trust_options.period_ns,
+                    now_ns,
+                    self.max_clock_drift_ns,
+                )
             except Exception as e:
                 # reference-exact fallback: refetch and verify one hop
                 # at a time so the first failing height raises its own
@@ -320,6 +289,43 @@ class Client:
                     self.store.save_light_block(b)
             cur = chunk[-1]
         return target
+
+    async def _fetch_range(self, first: int, last: int) -> List[LightBlock]:
+        """Fetch heights [first, last] ascending: ONE bulk
+        `light_blocks` round-trip from the primary when it serves the
+        range (Provider.light_blocks — the rpc bulk route for HTTP
+        providers), else the per-height failover fetch with witness
+        promotion. A bulk reply with wrong/missing heights is treated
+        like a failed fetch, never trusted."""
+        import asyncio
+
+        if last < first:
+            return []
+        try:
+            got = list(await self.primary.light_blocks(first, last))
+            if [b.height for b in got] == list(range(first, last + 1)):
+                return got
+            self.logger.info(
+                "bulk light_blocks returned wrong heights; refetching",
+                primary=self.primary.id(), first=first, last=last,
+            )
+        except Exception as e:
+            self.logger.info(
+                "bulk light_blocks fetch failed; per-height fallback",
+                primary=self.primary.id(), first=first, last=last,
+                err=repr(e),
+            )
+        # return_exceptions so one failed fetch does not leave the
+        # window's other in-flight fetches orphaned (gather would
+        # otherwise raise immediately and abandon them)
+        fetched = await asyncio.gather(
+            *(self._from_primary(h) for h in range(first, last + 1)),
+            return_exceptions=True,
+        )
+        for f in fetched:
+            if isinstance(f, BaseException):
+                raise f
+        return list(fetched)
 
     async def _verify_skipping(
         self, trusted: LightBlock, target: LightBlock, now_ns: int

@@ -25,6 +25,7 @@ from ..types.light import SignedHeader
 from ..types.validation import (
     Fraction,
     verify_commit_light,
+    verify_commit_light_bulk,
     verify_commit_light_trusting,
 )
 from ..types.validator import ValidatorSet
@@ -40,6 +41,7 @@ __all__ = [
     "MAX_CLOCK_DRIFT_NS",
     "verify",
     "verify_adjacent",
+    "verify_adjacent_batch",
     "verify_non_adjacent",
     "verify_backwards",
     "header_expired",
@@ -213,6 +215,54 @@ def verify_adjacent(
             untrusted_header.header.height,
             untrusted_header.commit,
         )
+    except Exception as e:
+        raise InvalidHeaderError(str(e)) from e
+
+
+def verify_adjacent_batch(
+    chain_id: str,
+    trusted_header: SignedHeader,
+    blocks,
+    trusting_period_ns: int,
+    now_ns: int,
+    max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS,
+) -> None:
+    """Sequential verification of M height-chained light blocks in ONE
+    sigcache-aware call — the bulk form of verify_adjacent and the
+    light half of the stateless fleet-serving path.
+
+    `blocks` is an ascending run of LightBlocks starting at
+    trusted_header.height + 1. All header-chain checks run first, in
+    hop order, with verify_adjacent's exact per-hop errors (the shared
+    adjacent_header_checks); every commit's signatures then go through
+    verify_commit_light_bulk: a warm fleet pass (a node re-serving
+    headers it has verified before) is one commit-memo probe + one
+    tally per commit — no sign-bytes encoding, no per-triple cache
+    keys, no crypto — and a cold pass is one merged bulk sigcache
+    probe + one grouped batch verify for ALL M commits instead of M
+    independent verifies. Signature failures surface as
+    InvalidHeaderError without hop attribution; callers needing the
+    reference's exact failing hop fall back to the per-hop
+    verify_adjacent loop (light/client.py's sequential window does)."""
+    blocks = list(blocks)
+    prev = trusted_header
+    rows = []
+    for b in blocks:
+        adjacent_header_checks(
+            chain_id, prev, b.signed_header, b.validator_set,
+            trusting_period_ns, now_ns, max_clock_drift_ns,
+        )
+        rows.append(
+            (
+                b.validator_set,
+                b.signed_header.commit.block_id,
+                b.signed_header.header.height,
+                b.signed_header.commit,
+            )
+        )
+        prev = b.signed_header
+    try:
+        verify_commit_light_bulk(chain_id, rows)
     except Exception as e:
         raise InvalidHeaderError(str(e)) from e
 

@@ -502,6 +502,7 @@ class Node(Service):
         # (rpc.LocalClient) need it even when the network listener is
         # disabled; only the server is gated on rpc.laddr --
         from ..rpc import Environment, RPCServer
+        from ..rpc.metrics import RPCMetrics
 
         self.rpc_env = Environment(
             chain_id=self.genesis.chain_id,
@@ -519,6 +520,7 @@ class Node(Service):
             node_info=self.node_info,
             privval_pub_key=self.privval_pub_key,
             cfg=cfg,
+            metrics=RPCMetrics(self.metrics_registry),
         )
         if cfg.rpc.laddr:
             self.rpc_server = RPCServer(

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 from ..crypto.keys import PubKey
 from ..types.validator import ValidatorSet
 from ..eventbus import EventBus
+from ..libs import trace
 from ..libs.log import get_logger
 from ..mempool import Mempool, MempoolError, TxInfo
 from ..pubsub import SubscriptionError
@@ -35,10 +36,17 @@ from .jsonrpc import (
     RPCError,
     RPCRequest,
 )
+from .metrics import RPCMetrics
 
-__all__ = ["Environment", "GENESIS_CHUNK_SIZE"]
+__all__ = ["Environment", "GENESIS_CHUNK_SIZE", "LIGHT_BLOCKS_PAGE_CAP"]
 
 GENESIS_CHUNK_SIZE = 16 * 1024 * 1024  # reference: env.go:51
+
+# hard server-side page bound for the bulk light_blocks route (the
+# reference's BlockchainInfo page size; a 150-validator LightBlock is
+# ~15 KB of proto, so a full page stays well under typical client
+# frame limits). Clients page past it (light/provider.py light_blocks).
+LIGHT_BLOCKS_PAGE_CAP = 20
 
 
 def encode(obj: Any) -> Any:
@@ -116,6 +124,7 @@ class Environment:
         node_info=None,
         privval_pub_key: Optional[PubKey] = None,
         cfg=None,
+        metrics: Optional[RPCMetrics] = None,
     ) -> None:
         self.chain_id = chain_id
         self.block_store = block_store
@@ -132,6 +141,7 @@ class Environment:
         self.node_info = node_info
         self.privval_pub_key = privval_pub_key
         self.cfg = cfg
+        self.metrics = metrics if metrics is not None else RPCMetrics()
         self.logger = get_logger("rpc.core")
         # ws client_id -> set of query strings (for unsubscribe_all)
         self._ws_subs: Dict[str, set] = {}
@@ -173,6 +183,7 @@ class Environment:
             "tx_search": self.tx_search,
             "block_search": self.block_search,
             "light_block": self.light_block,
+            "light_blocks": self.light_blocks,
             "subscribe": self.subscribe,
             "unsubscribe": self.unsubscribe,
             "unsubscribe_all": self.unsubscribe_all,
@@ -775,14 +786,11 @@ class Environment:
                 )
         return {"blocks": blocks, "total_count": len(heights)}
 
-    async def light_block(self, req: RPCRequest):
-        """SignedHeader + ValidatorSet as proto hex — the light
-        client's HTTP provider surface (reference: light/provider/http
-        assembles the same from /commit + /validators; one proto blob
-        round-trips exactly)."""
+    def _light_block_at(self, height: int):
+        """Assemble the LightBlock at height from the stores, or None
+        when any part (meta, commit, validator set) is missing."""
         from ..types.light import LightBlock, SignedHeader
 
-        height = self._height_param(req.params)
         meta = self.block_store.load_block_meta(height)
         commit = self.block_store.load_block_commit(height)
         if commit is None and height == self.block_store.height():
@@ -791,14 +799,68 @@ class Environment:
                 commit = seen
         vals = self.state_store.load_validators(height)
         if meta is None or commit is None or vals is None:
-            raise RPCError(
-                INVALID_PARAMS, f"no light block at height {height}"
-            )
-        lb = LightBlock(
+            return None
+        return LightBlock(
             signed_header=SignedHeader(header=meta.header, commit=commit),
             validator_set=vals,
         )
+
+    async def light_block(self, req: RPCRequest):
+        """SignedHeader + ValidatorSet as proto hex — the light
+        client's HTTP provider surface (reference: light/provider/http
+        assembles the same from /commit + /validators; one proto blob
+        round-trips exactly)."""
+        height = self._height_param(req.params)
+        lb = self._light_block_at(height)
+        if lb is None:
+            raise RPCError(
+                INVALID_PARAMS, f"no light block at height {height}"
+            )
         return {"height": height, "light_block": lb.to_proto().hex()}
+
+    async def light_blocks(self, req: RPCRequest):
+        """Bulk stateless serving: consecutive LightBlocks for
+        [min_height, max_height] ascending, as one proto-hex
+        LightBlocksResponse page. The page is hard-clamped at
+        LIGHT_BLOCKS_PAGE_CAP server-side (an optional `max_blocks`
+        param may shrink it, never grow it); a height whose parts are
+        missing ends the page — a bulk reply never has gaps, so
+        bisecting clients can trust consecutive heights. `last_height`
+        carries the store tip so a clamped client knows whether to ask
+        for the next page (framework route; the reference serves this
+        shape one height at a time via /commit + /validators)."""
+        from ..types.light import LightBlocksResponse
+
+        top = self.block_store.height()
+        base = self.block_store.base()
+        max_h = min(int(req.params.get("max_height", top) or top), top)
+        min_h = max(int(req.params.get("min_height", base) or base), base)
+        cap = LIGHT_BLOCKS_PAGE_CAP
+        max_blocks = int(req.params.get("max_blocks", 0) or 0)
+        if 0 < max_blocks < cap:
+            cap = max_blocks
+        blocks = []
+        # ascending page, count explicitly capped: both bounds are
+        # client-chosen ints, so the loop bound must be a clamp
+        # expression, not a subtraction of two attacker values (same
+        # rule the blockchain route pins)
+        with trace.span("light_blocks", min_height=min_h):
+            for off in range(min(max_h - min_h + 1, cap)):
+                lb = self._light_block_at(min_h + off)
+                if lb is None:
+                    break
+                blocks.append(lb)
+            self.metrics.light_blocks_requests.inc()
+            self.metrics.light_blocks_batch_size.observe(len(blocks))
+            trace.add_attrs(count=len(blocks))
+            resp = LightBlocksResponse(
+                light_blocks=blocks, last_height=top
+            )
+            return {
+                "count": len(blocks),
+                "last_height": top,
+                "light_blocks": resp.to_proto().hex(),
+            }
 
     # -- subscriptions (websocket only; reference: events.go) --
 

@@ -1,0 +1,32 @@
+"""RPC metrics struct (go-kit pattern, like consensus/metrics.py).
+
+One struct holding the rpc-layer instruments, built against a Registry
+and threaded through Environment construction. Node assembly passes a
+per-node Registry so in-process localnet nodes keep disjoint series;
+constructing without one lands on DEFAULT_REGISTRY (idempotent —
+repeated default constructions share instruments).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.metrics import DEFAULT_REGISTRY, Registry
+
+__all__ = ["RPCMetrics"]
+
+
+class RPCMetrics:
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        r = registry if registry is not None else DEFAULT_REGISTRY
+        self.light_blocks_requests = r.counter(
+            "rpc",
+            "light_blocks_requests",
+            "Bulk light_blocks requests served.",
+        )
+        self.light_blocks_batch_size = r.histogram(
+            "rpc",
+            "light_blocks_batch_size",
+            "Light blocks returned per bulk light_blocks request.",
+            buckets=(1.0, 2.0, 5.0, 10.0, 20.0, 50.0),
+        )

@@ -6,15 +6,20 @@ proto/tendermint/types/types.pb.go:800-801,852-853.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import List, Optional
 
 from ..encoding.proto import FieldReader, ProtoWriter
 from .commit import Commit
 from .header import Header
 from .validator import ValidatorSet
 
-__all__ = ["SignedHeader", "LightBlock"]
+__all__ = [
+    "SignedHeader",
+    "LightBlock",
+    "LightBlocksRequest",
+    "LightBlocksResponse",
+]
 
 
 @dataclass
@@ -110,4 +115,61 @@ class LightBlock:
             validator_set=(
                 ValidatorSet.from_proto(vs) if vs is not None else None
             ),
+        )
+
+
+@dataclass
+class LightBlocksRequest:
+    """Bulk light-block fetch: an ascending height range plus the
+    client's own page bound (framework message — the reference has no
+    bulk form; the JSON-RPC `light_blocks` route carries the same
+    fields as params, and the server clamps the page regardless of
+    what the request asks for)."""
+
+    min_height: int = 0
+    max_height: int = 0
+    max_blocks: int = 0
+
+    def to_proto(self) -> bytes:
+        w = ProtoWriter()
+        w.int(1, self.min_height)
+        w.int(2, self.max_height)
+        w.int(3, self.max_blocks)
+        return w.finish()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "LightBlocksRequest":
+        r = FieldReader(data)
+        return cls(
+            min_height=r.int64(1),
+            max_height=r.int64(2),
+            max_blocks=r.int64(3),
+        )
+
+
+@dataclass
+class LightBlocksResponse:
+    """One served page of the bulk fetch: consecutive LightBlocks in
+    ascending height order plus the serving store's current tip, so a
+    clamped client knows whether another page exists without a status
+    round-trip."""
+
+    light_blocks: List[LightBlock] = field(default_factory=list)
+    last_height: int = 0
+
+    def to_proto(self) -> bytes:
+        w = ProtoWriter()
+        for lb in self.light_blocks:
+            w.message(1, lb.to_proto())
+        w.int(2, self.last_height)
+        return w.finish()
+
+    @classmethod
+    def from_proto(cls, data: bytes) -> "LightBlocksResponse":
+        r = FieldReader(data)
+        return cls(
+            light_blocks=[
+                LightBlock.from_proto(b) for b in r.get_all(1)
+            ],
+            last_height=r.int64(2),
         )

@@ -62,6 +62,7 @@ __all__ = [
     "collect_commit_light",
     "verify_commit",
     "verify_commit_light",
+    "verify_commit_light_bulk",
     "verify_commit_light_trusting",
     "verify_triples_grouped",
 ]
@@ -315,6 +316,61 @@ def verify_triples_grouped(triples) -> None:
             ok, _bits = drain_and_cache(bv, [it[3] for it in items])
             if not ok:
                 raise InvalidCommitError("wrong signature in merged batch")
+
+
+def verify_commit_light_bulk(chain_id: str, rows) -> None:
+    """One sigcache-aware pass over M commits' light verifications —
+    the fleet-serving form of verify_commit_light. `rows` is a
+    sequence of (vals, block_id, height, commit), verified in order.
+
+    Extends the PR-7 warm machinery ACROSS commits instead of within
+    one: each row first probes the commit-level memo (the SAME
+    `_commit_memo_key` verify_commit_light's vectorized path writes,
+    so the two paths warm each other) — a warm fleet pass is M O(1)
+    probes plus M basic checks, zero key building and zero crypto.
+    Misses run collect_commit_light (the reference tally with its
+    exact NotEnoughVotingPowerError / _verify_basic errors) and the
+    collected triples from ALL cold commits are proven in ONE merged
+    call (verify_triples_grouped: one bulk sigcache set-intersection,
+    one grouped batch verify); only then is each cold commit's memo
+    recorded. A signature failure raises InvalidCommitError with no
+    index attribution — callers needing the reference's exact
+    per-commit error re-verify per commit (the same contract as
+    verify_triples_grouped, used by light/client.py's window
+    fallback)."""
+    rows = list(rows)
+    with trace.span("verify_commit_light_bulk", commits=len(rows)):
+        use_memo = sigcache.enabled() and sigcache.commit_memo_enabled()
+        triples: list = []
+        cold_keys: list = []
+        hits = 0
+        for vals, block_id, height, commit in rows:
+            _verify_basic(vals, commit, height, block_id)
+            ckey = None
+            if use_memo:
+                needed = vals.total_voting_power() * 2 // 3
+                ckey = _commit_memo_key(
+                    chain_id, vals, commit, needed, False, True,
+                    vals.powers_array(),
+                )
+                if sigcache.seen_commit(ckey):
+                    hits += 1
+                    continue
+            triples.extend(
+                collect_commit_light(
+                    chain_id, vals, block_id, height, commit
+                )
+            )
+            if ckey is not None:
+                cold_keys.append(ckey)
+        if use_memo:
+            trace.add_attrs(
+                sigcache_commit_hits=hits, commits_cold=len(cold_keys)
+            )
+        if triples:
+            verify_triples_grouped(triples)
+        for ckey in cold_keys:
+            sigcache.add_commit(ckey)
 
 
 def _verify_basic(

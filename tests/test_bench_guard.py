@@ -281,3 +281,39 @@ print("OK")
     )
     assert r.returncode == 0, (r.returncode, r.stderr)
     assert "OK" in r.stdout
+
+
+def test_stateless_bulk_rows_never_initialize_jax():
+    """The ISSUE-11 rows (merkle_multiproof_10k,
+    light_sync_bulk_150vals) live in the banked CPU block BEFORE the
+    device probe: pure hashlib/numpy + the CPU light client, jax must
+    never load. Tiny shapes — the full-size A/B medians land in
+    BENCH_STATELESS.json on real runs."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+row = bench.bench_merkle_multiproof(n=200, k=16, reps=1, rounds=1)
+assert row["leaves"] == 200 and row["k"] == 16
+for key in ("per_proof_build_ms", "vector_build_ms", "vector_serve_ms",
+            "speedup_cold", "speedup_serving", "verify_speedup"):
+    assert key in row, key
+row = bench.bench_light_sync_bulk(
+    n_vals=4, n_headers=6, reps=1, rounds=1
+)
+assert row["headers"] == 6 and row["commit_memo_hits"] >= 1
+for key in ("warm_client_headers_per_s", "warm_bulk_headers_per_s",
+            "speedup_warm", "cold_bulk_headers_per_s"):
+    assert row[key] > 0, key
+assert "jax" not in sys.modules, "stateless bulk rows dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout

@@ -22,6 +22,23 @@ from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
 CHAIN = "node-chain"
 
 
+@pytest.fixture(autouse=True)
+def _clean_crypto_install_state():
+    """Node boots install the process-global device batch verifier and
+    create/trip circuit breakers (make_node); teardown does not always
+    unwind that state, and a later test FILE then sees the seam routed
+    through this file's install (observed: test_node.py followed by
+    test_sr25519.py fails test_batch_verifier_seam). Uninstall
+    defensively after every test — the same pattern as test_warmpath's
+    autouse fixture."""
+    yield
+    tpu_verifier.uninstall()
+    from tendermint_tpu.crypto import breaker
+
+    breaker.reset_all()
+    sigcache.reset()
+
+
 def run(coro):
     return asyncio.run(coro)
 

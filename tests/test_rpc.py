@@ -118,6 +118,38 @@ def test_info_and_block_routes(tmp_path):
             cp = await c.call("consensus_params", height=h)
             assert cp["consensus_params"]["block"]["max_bytes"] > 0
 
+            # bulk stateless serving: light_blocks serves a verifiable
+            # ascending page that agrees with the single-height route
+            from tendermint_tpu.types.light import (
+                LightBlock,
+                LightBlocksResponse,
+            )
+
+            single = await c.call("light_block", height=h)
+            lb_single = LightBlock.from_proto(
+                bytes.fromhex(single["light_block"])
+            )
+            bulk = await c.call("light_blocks", min_height=1, max_height=h)
+            page = LightBlocksResponse.from_proto(
+                bytes.fromhex(bulk["light_blocks"])
+            )
+            assert bulk["count"] == len(page.light_blocks) >= 1
+            assert [b.height for b in page.light_blocks] == list(
+                range(1, 1 + bulk["count"])
+            )
+            for b in page.light_blocks:
+                b.validate_basic(CHAIN)
+            if bulk["count"] >= h:
+                assert (
+                    page.light_blocks[h - 1].signed_header.hash()
+                    == lb_single.signed_header.hash()
+                )
+            # the node's registry carries the bulk-route series
+            assert (
+                node.rpc_env.metrics.light_blocks_requests._values[()]
+                >= 1.0
+            )
+
             cs = await c.call("consensus_state")
             assert cs["round_state"]["height"] >= h
             dump = await c.call("dump_consensus_state")

@@ -1,0 +1,573 @@
+"""Bulk stateless serving (ISSUE 11): vectorized merkle multi-proofs,
+batched light-client verification, and the bulk `light_blocks` route.
+
+The property tests here are the oracle pins the vectorized paths are
+allowed to exist under: multi-proof construction and verification must
+be byte-identical (aunts, total/index fields, root, bitmap) to the
+recursive per-proof reference in crypto/merkle.py for randomized tree
+sizes — non-power-of-two, K=1 and K=N corners included — warm (held
+MerkleMultiTree) and cold; verify_commit_light_bulk and
+verify_adjacent_batch must raise the reference errors and share the
+PR-7 commit memo with the per-commit paths.
+"""
+
+import asyncio
+import copy
+import random
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import merkle, sigcache
+from tendermint_tpu.light.provider import Provider
+from tendermint_tpu.light.verifier import verify_adjacent, verify_adjacent_batch
+from tendermint_tpu.light.errors import (
+    InvalidHeaderError,
+    LightBlockNotFoundError,
+)
+from tendermint_tpu.types.light import (
+    LightBlock,
+    LightBlocksRequest,
+    LightBlocksResponse,
+)
+from tendermint_tpu.types.validation import (
+    InvalidCommitError,
+    NotEnoughVotingPowerError,
+    verify_commit_light,
+    verify_commit_light_bulk,
+)
+
+from .test_light import CHAIN, DictProvider, build_chain, make_client
+
+HOUR_NS = 3600 * 1_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sigcache():
+    sigcache.reset()
+    yield
+    sigcache.reset()
+
+
+# ---------------------------------------------------------------------------
+# vectorized merkle multi-proofs vs the recursive oracle
+
+
+def _items(n, rng):
+    return [bytes([rng.randrange(256)]) * (1 + i % 7) for i in range(n)]
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_property_multiproofs_byte_identical_to_reference(seed):
+    """Randomized sizes (non-power-of-two included) and index sets
+    (K=1 and K=N corners forced): the vectorized construction must
+    produce the recursion's exact proofs — total, index, leaf_hash and
+    every aunt byte — and the same root, cold and warm."""
+    rng = random.Random(0xBEEF + seed)
+    sizes = {1, 2, 3, rng.randrange(4, 70), rng.randrange(70, 200)}
+    for n in sorted(sizes):
+        items = _items(n, rng)
+        root_o, proofs_o = merkle.proofs_from_byte_slices(items)
+        tree = merkle.MerkleMultiTree.from_byte_slices(items)
+        assert tree.root == root_o
+        assert tree.total == n
+        for idxs in (
+            [rng.randrange(n)],  # K=1
+            list(range(n)),  # K=N
+            sorted(rng.sample(range(n), min(n, 5))),
+            [n - 1, 0, n // 2],  # unsorted, duplicates allowed below
+            [0, 0, n - 1],
+        ):
+            root_v, proofs_v = merkle.multiproofs_from_byte_slices(
+                items, idxs
+            )
+            assert root_v == root_o
+            warm = tree.proofs(idxs)
+            for i, pv, pw in zip(idxs, proofs_v, warm):
+                po = proofs_o[i]
+                for p in (pv, pw):
+                    assert p.total == po.total
+                    assert p.index == po.index
+                    assert p.leaf_hash == po.leaf_hash
+                    assert p.aunts == po.aunts
+                po.verify(root_o, items[i])  # oracle accepts its twin
+
+
+def test_multiproofs_empty_tree_and_range_errors():
+    root, proofs = merkle.multiproofs_from_byte_slices([], [])
+    assert root == merkle.empty_hash() and proofs == []
+    with pytest.raises(ValueError, match="out of range"):
+        merkle.multiproofs_from_byte_slices([b"a"], [1])
+    with pytest.raises(ValueError, match="out of range"):
+        merkle.multiproofs_from_byte_slices([b"a", b"b"], [0, -1])
+    tree = merkle.MerkleMultiTree.from_byte_slices([b"a", b"b"])
+    with pytest.raises(ValueError, match="out of range"):
+        tree.proof(2)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_property_verify_multiproofs_bitmap_matches_reference(seed):
+    """The batched verifier's bitmap equals verify_proofs_batch's for
+    intact proofs AND for every mutation class the per-proof verifier
+    rejects (corrupt aunt, corrupt leaf hash, extra/missing aunt,
+    wrong total/index) — the shared-node memo may never flip a
+    verdict."""
+    rng = random.Random(0xFACE + seed)
+    n = rng.randrange(2, 90)
+    items = _items(n, rng)
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    bits_ref = merkle.verify_proofs_batch(proofs, root, items)
+    bits_new = merkle.verify_multiproofs_batch(proofs, root, items)
+    assert bits_ref.all() and (bits_ref == bits_new).all()
+
+    mutated = [copy.deepcopy(p) for p in proofs]
+    leaves = list(items)
+    for k, p in enumerate(mutated):
+        mode = k % 6
+        if mode == 1 and p.aunts:
+            p.aunts[rng.randrange(len(p.aunts))] = b"\x00" * 32
+        elif mode == 2:
+            p.leaf_hash = b"\x13" * 32
+        elif mode == 3:
+            p.aunts = p.aunts + [b"\x17" * 32]
+        elif mode == 4 and p.aunts:
+            p.aunts = p.aunts[:-1]
+        elif mode == 5:
+            p.total += 1
+        # mode 0: left intact
+    bits_ref = merkle.verify_proofs_batch(mutated, root, leaves)
+    bits_new = merkle.verify_multiproofs_batch(mutated, root, leaves)
+    assert (bits_ref == bits_new).all()
+
+
+# ---------------------------------------------------------------------------
+# verify_commit_light_bulk: reference errors + shared commit memo
+
+
+def _rows(blocks, heights):
+    return [
+        (
+            blocks[h].validator_set,
+            blocks[h].signed_header.commit.block_id,
+            h,
+            blocks[h].signed_header.commit,
+        )
+        for h in heights
+    ]
+
+
+def test_bulk_commit_light_verifies_and_warms_the_commit_memo():
+    blocks = build_chain(6)
+    rows = _rows(blocks, range(1, 7))
+    s0 = sigcache.stats()
+    verify_commit_light_bulk(CHAIN, rows)
+    s1 = sigcache.stats()
+    assert s1["misses"] - s0["misses"] > 0  # cold: real probes
+    # warm fleet pass: every commit short-circuits on the memo
+    verify_commit_light_bulk(CHAIN, rows)
+    s2 = sigcache.stats()
+    assert s2["commit_hits"] - s1["commit_hits"] == 6
+    assert s2["misses"] == s1["misses"]
+
+
+def test_bulk_commit_light_memo_interops_with_per_commit_path():
+    """The bulk pass writes the SAME memo key verify_commit_light's
+    vectorized path probes — each warms the other."""
+    blocks = build_chain(2)
+    (vals, bid, h, commit) = _rows(blocks, [2])[0]
+    verify_commit_light_bulk(CHAIN, [(vals, bid, h, commit)])
+    s0 = sigcache.stats()
+    verify_commit_light(CHAIN, vals, bid, h, commit)
+    s1 = sigcache.stats()
+    assert s1["commit_hits"] - s0["commit_hits"] == 1
+    # and the reverse direction
+    sigcache.reset()
+    verify_commit_light(CHAIN, vals, bid, h, commit)
+    s0 = sigcache.stats()
+    verify_commit_light_bulk(CHAIN, [(vals, bid, h, commit)])
+    s1 = sigcache.stats()
+    assert s1["commit_hits"] - s0["commit_hits"] == 1
+
+
+def test_bulk_commit_light_reference_errors():
+    blocks = build_chain(3)
+    vals, bid, h, commit = _rows(blocks, [2])[0]
+    # _verify_basic errors surface per commit, reference text
+    with pytest.raises(InvalidCommitError, match="wrong height"):
+        verify_commit_light_bulk(CHAIN, [(vals, bid, 99, commit)])
+    # tally failure raises the reference NotEnoughVotingPowerError
+    from tendermint_tpu.types.commit import BLOCK_ID_FLAG_ABSENT
+
+    starved = copy.deepcopy(commit)
+    for cs in starved.signatures[1:]:
+        cs.block_id_flag = BLOCK_ID_FLAG_ABSENT
+        cs.signature = b""
+    starved.invalidate_memos()
+    with pytest.raises(NotEnoughVotingPowerError):
+        verify_commit_light_bulk(CHAIN, [(vals, bid, h, starved)])
+    # a bad signature fails the merged check (no index attribution)
+    bad = copy.deepcopy(commit)
+    bad.signatures[0].signature = b"\x00" * 64
+    bad.invalidate_memos()
+    with pytest.raises(InvalidCommitError):
+        verify_commit_light_bulk(CHAIN, [(vals, bid, h, bad)])
+    # and a failed bulk pass must not have memoized anything
+    s = sigcache.stats()
+    verify_commit_light_bulk(CHAIN, [(vals, bid, h, commit)])
+    assert sigcache.stats()["commit_hits"] == s["commit_hits"]
+
+
+def test_bulk_commit_light_cache_disabled_still_verifies():
+    blocks = build_chain(2)
+    rows = _rows(blocks, [1, 2])
+    with sigcache.disabled():
+        verify_commit_light_bulk(CHAIN, rows)
+        bad = copy.deepcopy(rows[0][3])
+        bad.signatures[0].signature = b"\x00" * 64
+        bad.invalidate_memos()
+        with pytest.raises(InvalidCommitError):
+            verify_commit_light_bulk(
+                CHAIN, [(rows[0][0], rows[0][1], 1, bad)]
+            )
+
+
+# ---------------------------------------------------------------------------
+# verify_adjacent_batch
+
+
+def test_adjacent_batch_matches_per_hop_loop():
+    blocks = build_chain(8)
+    now = time.time_ns()
+    chain = [blocks[h] for h in range(2, 9)]
+    verify_adjacent_batch(
+        CHAIN, blocks[1].signed_header, chain, 200 * HOUR_NS, now
+    )
+    # warm second pass: commit memos only
+    s0 = sigcache.stats()
+    verify_adjacent_batch(
+        CHAIN, blocks[1].signed_header, chain, 200 * HOUR_NS, now
+    )
+    assert sigcache.stats()["commit_hits"] - s0["commit_hits"] == 7
+    # and the per-hop reference accepts the same chain
+    prev = blocks[1]
+    for b in chain:
+        verify_adjacent(
+            CHAIN, prev.signed_header, b.signed_header,
+            b.validator_set, 200 * HOUR_NS, now,
+        )
+        prev = b
+
+
+def test_adjacent_batch_per_hop_header_errors():
+    blocks = build_chain(5)
+    now = time.time_ns()
+    # a gap in the run is a per-hop header error (adjacent_header_checks)
+    with pytest.raises(ValueError, match="must be adjacent"):
+        verify_adjacent_batch(
+            CHAIN,
+            blocks[1].signed_header,
+            [blocks[2], blocks[4]],
+            200 * HOUR_NS,
+            now,
+        )
+    # a corrupted signature mid-run surfaces as InvalidHeaderError
+    chain = [copy.deepcopy(blocks[h]) for h in range(2, 6)]
+    c = chain[2].signed_header.commit
+    c.signatures[0].signature = b"\x00" * 64
+    c.invalidate_memos()
+    with pytest.raises(InvalidHeaderError):
+        verify_adjacent_batch(
+            CHAIN, blocks[1].signed_header, chain, 200 * HOUR_NS, now
+        )
+
+
+# ---------------------------------------------------------------------------
+# client integration: bulk fetch + windowed bulk verify
+
+
+class CountingBulkProvider(DictProvider):
+    def __init__(self, blocks, id_="bulk"):
+        super().__init__(blocks, id_)
+        self.bulk_calls = 0
+        self.single_calls = 0
+        self.fail_bulk = False
+
+    async def light_block(self, height):
+        self.single_calls += 1
+        return await super().light_block(height)
+
+    async def light_blocks(self, first, last):
+        self.bulk_calls += 1
+        if self.fail_bulk:
+            raise LightBlockNotFoundError("bulk disabled")
+        return [self.blocks[h] for h in range(first, last + 1)]
+
+
+def test_client_sequential_window_uses_bulk_fetch_and_verify():
+    from tendermint_tpu.crypto.batch import (
+        group_affinity_state,
+        restore_group_affinity,
+        set_group_affinity,
+    )
+    from tendermint_tpu.light.client import SEQUENTIAL_BATCH_HOPS
+
+    blocks = build_chain(2 * SEQUENTIAL_BATCH_HOPS + 5)
+    provider = CountingBulkProvider(blocks, "primary")
+    client = make_client(blocks, sequential=True)
+    client.primary = provider
+    prev = group_affinity_state()
+    set_group_affinity(SEQUENTIAL_BATCH_HOPS)
+    try:
+        lb = asyncio.run(
+            client.verify_light_block_at_height(
+                2 * SEQUENTIAL_BATCH_HOPS + 5, time.time_ns()
+            )
+        )
+    finally:
+        restore_group_affinity(prev)
+    assert lb.height == 2 * SEQUENTIAL_BATCH_HOPS + 5
+    # windows fetched in bulk; the target fetch is the only extra
+    assert client.store.light_block(SEQUENTIAL_BATCH_HOPS) is not None
+    assert provider.bulk_calls >= 2
+    assert provider.single_calls <= 2  # the target/height-0 fetches
+
+
+def test_client_bulk_fetch_failure_falls_back_per_height():
+    from tendermint_tpu.crypto.batch import (
+        group_affinity_state,
+        restore_group_affinity,
+        set_group_affinity,
+    )
+    from tendermint_tpu.light.client import SEQUENTIAL_BATCH_HOPS
+
+    blocks = build_chain(10)
+    provider = CountingBulkProvider(blocks, "primary")
+    provider.fail_bulk = True
+    client = make_client(blocks, sequential=True)
+    client.primary = provider
+    prev = group_affinity_state()
+    set_group_affinity(SEQUENTIAL_BATCH_HOPS)
+    try:
+        lb = asyncio.run(
+            client.verify_light_block_at_height(10, time.time_ns())
+        )
+    finally:
+        restore_group_affinity(prev)
+    assert lb.height == 10
+    assert provider.bulk_calls >= 1  # tried the bulk surface first
+    assert provider.single_calls >= 8  # served per height
+
+
+def test_default_provider_bulk_is_the_per_height_loop():
+    blocks = build_chain(5)
+    p = DictProvider(blocks)
+    got = asyncio.run(p.light_blocks(2, 4))
+    assert [b.height for b in got] == [2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# codecs: golden round-trip + hostile pages
+
+
+def test_light_blocks_codecs_roundtrip():
+    blocks = build_chain(3)
+    req = LightBlocksRequest(min_height=2, max_height=9, max_blocks=4)
+    again = LightBlocksRequest.from_proto(req.to_proto())
+    assert again == req
+    resp = LightBlocksResponse(
+        light_blocks=[blocks[2], blocks[3]], last_height=3
+    )
+    decoded = LightBlocksResponse.from_proto(resp.to_proto())
+    assert decoded.last_height == 3
+    assert [b.height for b in decoded.light_blocks] == [2, 3]
+    assert (
+        decoded.light_blocks[0].signed_header.hash()
+        == blocks[2].signed_header.hash()
+    )
+    decoded.light_blocks[0].validate_basic(CHAIN)
+    # empty page still round-trips
+    empty = LightBlocksResponse.from_proto(
+        LightBlocksResponse(last_height=7).to_proto()
+    )
+    assert empty.light_blocks == [] and empty.last_height == 7
+    # wire-type confusion fails as the sanctioned parse error
+    from tendermint_tpu.encoding.proto import ProtoWriter
+
+    w = ProtoWriter()
+    w.uint(1, 5)  # varint where the repeated message belongs
+    with pytest.raises(ValueError):
+        LightBlocksResponse.from_proto(w.finish())
+
+
+class _StubRPC:
+    """Stands in for HTTPProvider._client: serves scripted pages."""
+
+    def __init__(self, pages):
+        self.pages = list(pages)
+        self.calls = []
+
+    async def call(self, method, **params):
+        assert method == "light_blocks"
+        self.calls.append(params)
+        resp = self.pages.pop(0)
+        return {
+            "count": len(resp.light_blocks),
+            "last_height": resp.last_height,
+            "light_blocks": resp.to_proto().hex(),
+        }
+
+
+def _http_provider_with(pages):
+    from tendermint_tpu.light.provider import HTTPProvider
+
+    p = HTTPProvider.__new__(HTTPProvider)
+    p.addr = "stub:0"
+    p._client = _StubRPC(pages)
+    return p
+
+
+def test_http_provider_pages_past_the_server_clamp():
+    blocks = build_chain(7)
+    pages = [
+        LightBlocksResponse(
+            light_blocks=[blocks[2], blocks[3], blocks[4]], last_height=7
+        ),
+        LightBlocksResponse(
+            light_blocks=[blocks[5], blocks[6]], last_height=7
+        ),
+    ]
+    p = _http_provider_with(pages)
+    got = asyncio.run(p.light_blocks(2, 6))
+    assert [b.height for b in got] == [2, 3, 4, 5, 6]
+    assert p._client.calls == [
+        {"min_height": 2, "max_height": 6},
+        {"min_height": 5, "max_height": 6},
+    ]
+
+
+def test_http_provider_rejects_hostile_pages():
+    blocks = build_chain(6)
+    # out-of-order page
+    p = _http_provider_with(
+        [LightBlocksResponse(light_blocks=[blocks[4]], last_height=6)]
+    )
+    with pytest.raises(LightBlockNotFoundError, match="out of order"):
+        asyncio.run(p.light_blocks(2, 4))
+    # empty page (no progress possible)
+    p = _http_provider_with([LightBlocksResponse(last_height=6)])
+    with pytest.raises(LightBlockNotFoundError, match="empty"):
+        asyncio.run(p.light_blocks(2, 4))
+    # over-full page: surplus beyond the asked range is ignored
+    p = _http_provider_with(
+        [
+            LightBlocksResponse(
+                light_blocks=[blocks[2], blocks[3], blocks[4]],
+                last_height=6,
+            )
+        ]
+    )
+    got = asyncio.run(p.light_blocks(2, 3))
+    assert [b.height for b in got] == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# the rpc route itself (in-process Environment; the live-node path is
+# covered by tests/test_rpc.py)
+
+
+class _BS:
+    def __init__(self, blocks, gap_at=None):
+        self.blocks = blocks
+        self.gap_at = gap_at
+
+    def height(self):
+        return max(self.blocks)
+
+    def base(self):
+        return min(self.blocks)
+
+    def load_block_meta(self, h):
+        if h == self.gap_at or h not in self.blocks:
+            return None
+
+        class M:
+            pass
+
+        m = M()
+        m.header = self.blocks[h].signed_header.header
+        return m
+
+    def load_block_commit(self, h):
+        lb = self.blocks.get(h)
+        return lb.signed_header.commit if lb else None
+
+    def load_seen_commit(self):
+        return None
+
+
+class _SS:
+    def __init__(self, blocks):
+        self.blocks = blocks
+
+    def load_validators(self, h):
+        lb = self.blocks.get(h)
+        return lb.validator_set if lb else None
+
+
+def _env(blocks, gap_at=None):
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.rpc.core import Environment
+    from tendermint_tpu.rpc.metrics import RPCMetrics
+
+    return Environment(
+        chain_id=CHAIN,
+        block_store=_BS(blocks, gap_at=gap_at),
+        state_store=_SS(blocks),
+        metrics=RPCMetrics(Registry()),
+    )
+
+
+def _call(env, **params):
+    from tendermint_tpu.rpc.jsonrpc import RPCRequest
+
+    return asyncio.run(
+        env.light_blocks(
+            RPCRequest(method="light_blocks", params=params, req_id=1)
+        )
+    )
+
+
+def test_light_blocks_route_serves_clamped_ascending_pages():
+    from tendermint_tpu.rpc.core import LIGHT_BLOCKS_PAGE_CAP
+
+    blocks = build_chain(LIGHT_BLOCKS_PAGE_CAP + 10)
+    env = _env(blocks)
+    res = _call(env, min_height=3)
+    page = LightBlocksResponse.from_proto(bytes.fromhex(res["light_blocks"]))
+    assert res["count"] == LIGHT_BLOCKS_PAGE_CAP
+    assert [b.height for b in page.light_blocks] == list(
+        range(3, 3 + LIGHT_BLOCKS_PAGE_CAP)
+    )
+    assert res["last_height"] == LIGHT_BLOCKS_PAGE_CAP + 10
+    # every served block is verifiable material
+    page.light_blocks[0].validate_basic(CHAIN)
+    # max_blocks shrinks the page, never grows it
+    assert _call(env, min_height=1, max_blocks=3)["count"] == 3
+    assert (
+        _call(env, min_height=1, max_blocks=10_000)["count"]
+        == LIGHT_BLOCKS_PAGE_CAP
+    )
+    # out-of-store ranges clamp to the store; empty range serves zero
+    assert _call(env, min_height=10**9)["count"] == 0
+    assert _call(env, max_height=-5)["count"] == 0
+    # metrics: one counter bump per request, batch sizes observed
+    m = env.metrics
+    assert m.light_blocks_requests._values[()] == 5.0
+
+
+def test_light_blocks_route_gap_ends_the_page():
+    blocks = build_chain(10)
+    env = _env(blocks, gap_at=5)
+    res = _call(env, min_height=2, max_height=9)
+    page = LightBlocksResponse.from_proto(bytes.fromhex(res["light_blocks"]))
+    assert [b.height for b in page.light_blocks] == [2, 3, 4]

@@ -215,6 +215,27 @@ def test_seeded_unclamped_from_words_turns_alloc_red(pkg_copy):
     assert "decode_bit_array" in hits[0].message
 
 
+def test_seeded_unclamped_light_blocks_page_turns_alloc_red(pkg_copy):
+    """ISSUE 11 satellite: stripping the bulk light_blocks route's
+    page clamp re-opens the exact class PR 10's blockchain fix pinned
+    — a range() bound built from attacker-chosen heights instead of a
+    clamp expression — and the witness names the route handler."""
+    core = pkg_copy / "rpc" / "core.py"
+    src = core.read_text()
+    needle = "for off in range(min(max_h - min_h + 1, cap)):"
+    assert needle in src
+    core.write_text(
+        src.replace(needle, "for off in range(max_h - min_h + 1):")
+    )
+    rep = _analyze_copy(pkg_copy)
+    hits = [
+        v for v in rep.violations
+        if v.rule == "safe-alloc-unbounded" and v.path == "rpc/core.py"
+    ]
+    assert hits, "unclamped light_blocks page not flagged"
+    assert "light_blocks" in hits[0].message
+
+
 def test_seeded_dropped_validate_turns_unvalidated_red(pkg_copy):
     """Acceptance: deleting the vote handler's validate_basic() call
     makes the path to VoteSet.set_has_vote-family state unvalidated —
