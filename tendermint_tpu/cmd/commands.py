@@ -866,6 +866,14 @@ def cmd_debug_dump(args) -> int:
         from ..libs import trace as _trace
 
         add_bytes(tar, "trace.json", _trace.to_chrome_trace().encode())
+        # SLO-breach exemplars: each slow request's span tree (empty
+        # list when exemplar capture was never enabled) — the flame
+        # decomposition behind a p99 outlier, see docs/load.md
+        add_bytes(
+            tar,
+            "slow_requests.json",
+            _trace.exemplars_to_json().encode(),
+        )
         # live metrics scrape, best effort
         if args.metrics_url:
             try:

@@ -191,6 +191,12 @@ class InstrumentationConfig:
     # no-op. Process-wide switch (the ring is shared).
     trace_spans: bool = False
     trace_ring_capacity: int = 8192
+    # slow-request exemplars (libs/trace.py): requests exceeding their
+    # per-route SLO (rpc/metrics.py slo_for) capture their span tree
+    # into a second bounded ring, exported in the debug bundle as
+    # slow_requests.json. Off by default; process-wide like the ring.
+    slo_exemplars: bool = False
+    slo_exemplar_capacity: int = 64
 
 
 @dataclass

@@ -17,6 +17,11 @@ Fault points are NAMED strings consulted at the boundary they model:
     tpu.gather     crypto/tpu_verifier.py, inside the gather barrier
     wal.write      consensus/wal.py, the framed append (short writes)
     wal.fsync      consensus/wal.py, every fsync (rotation included)
+    rpc.route      rpc/jsonrpc.py _dispatch, keyed by method name —
+                   inside the per-route latency measurement, so an
+                   injected hang produces an honest SLO-breach
+                   exemplar and an injected raise exercises the
+                   error-counting path (loadgen smoke tests)
 
 Modes (the fault taxonomy, docs/resilience.md):
 

@@ -9,11 +9,53 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional
 
+from ..libs.metrics import DEFAULT_REGISTRY, Registry
 from ..libs.service import Service
 from ..pubsub import Query, Server, Subscription, compile_query
 from ..types import events as E
 
-__all__ = ["EventBus"]
+__all__ = ["EventBus", "EventBusMetrics"]
+
+
+class EventBusMetrics:
+    """Fanout saturation instruments (go-kit pattern; node assembly
+    threads the per-node Registry). The headline series is
+    `eventbus_fanout_lag`: the deepest subscriber queue observed at the
+    latest publish — the signal the ROADMAP's fanout-batching follow-on
+    will be judged against (a healthy bus sits near 0; a bus whose
+    subscribers can't drain climbs toward the per-subscription queue
+    limit and starts dropping them)."""
+
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        r = registry if registry is not None else DEFAULT_REGISTRY
+        self.published = r.counter(
+            "eventbus",
+            "published_total",
+            "Events published onto the bus.",
+        )
+        self.deliveries = r.counter(
+            "eventbus",
+            "deliveries_total",
+            "Per-subscriber deliveries (one publish fans out to every "
+            "matching subscription).",
+        )
+        self.fanout_lag = r.gauge(
+            "eventbus",
+            "fanout_lag",
+            "Deepest subscriber queue after the latest publish — how "
+            "far the slowest live subscriber lags the publisher.",
+        )
+        self.subscriptions = r.gauge(
+            "eventbus",
+            "subscriptions",
+            "Live subscriptions on the bus.",
+        )
+        self.dropped_subscriptions = r.counter(
+            "eventbus",
+            "dropped_subscriptions_total",
+            "Subscriptions terminated because their bounded queue "
+            "overflowed (slow consumer).",
+        )
 
 
 def _flatten_abci_events(abci_events: Iterable) -> Dict[str, List[str]]:
@@ -30,9 +72,10 @@ def _flatten_abci_events(abci_events: Iterable) -> Dict[str, List[str]]:
 
 
 class EventBus(Service):
-    def __init__(self) -> None:
+    def __init__(self, metrics: Optional[EventBusMetrics] = None) -> None:
         super().__init__(name="eventbus")
         self._server = Server(name="eventbus.pubsub")
+        self.metrics = metrics
 
     async def on_start(self) -> None:
         await self._server.start()
@@ -42,19 +85,34 @@ class EventBus(Service):
 
     # -- subscription --
 
+    def _sync_sub_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.subscriptions.set(
+                self._server.num_subscriptions()
+            )
+
     def subscribe(
         self, client_id: str, query: "Query | str", limit: int = 100
     ) -> Subscription:
-        return self._server.subscribe(client_id, query, limit)
+        sub = self._server.subscribe(client_id, query, limit)
+        self._sync_sub_gauge()
+        return sub
 
     def unsubscribe(self, client_id: str, query: "Query | str") -> None:
         self._server.unsubscribe(client_id, query)
+        self._sync_sub_gauge()
 
     def unsubscribe_all(self, client_id: str) -> None:
         self._server.unsubscribe_all(client_id)
+        self._sync_sub_gauge()
 
     def num_clients(self) -> int:
         return self._server.num_clients()
+
+    def max_subscriber_lag(self) -> int:
+        """Deepest subscriber queue right now (scrape-time view of the
+        same signal `eventbus_fanout_lag` tracks per publish)."""
+        return self._server.max_queue_depth()
 
     # -- publishing --
 
@@ -66,7 +124,19 @@ class EventBus(Service):
     ) -> None:
         tags = dict(extra_tags or {})
         tags.setdefault(E.EVENT_TYPE_KEY, []).append(event_value)
-        self._server.publish(data, tags)
+        matched, max_depth, dropped = self._server.publish(data, tags)
+        m = self.metrics
+        if m is not None:
+            m.published.inc()
+            # deliveries = messages actually enqueued: a matched
+            # subscriber whose queue overflowed (or was already dead)
+            # never received this message
+            if matched > dropped:
+                m.deliveries.inc(matched - dropped)
+            m.fanout_lag.set(max_depth)
+            if dropped:
+                m.dropped_subscriptions.inc(dropped)
+                self._sync_sub_gauge()
 
     def publish_new_block(self, data: E.EventDataNewBlock) -> None:
         tags = _flatten_abci_events(

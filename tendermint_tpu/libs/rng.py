@@ -24,11 +24,20 @@ from __future__ import annotations
 import random
 from typing import Optional, Sequence, TypeVar
 
-__all__ = ["gossip", "reseed", "choice", "shuffle", "randbelow"]
+__all__ = ["derive", "gossip", "reseed", "choice", "shuffle", "randbelow"]
 
 T = TypeVar("T")
 
 _GOSSIP = random.Random()  # self-seeds from OS entropy, like `random`
+
+
+def derive(seed: int, label: str) -> random.Random:
+    """An INDEPENDENT seeded stream for (seed, label) — the loadgen
+    harness derives one per concern (arrival schedule, op mix, payload
+    bytes) so adding a consumer never shifts another's draws, the same
+    property schedulefuzz gets from Schedule.subseed. Does not touch
+    the shared gossip RNG."""
+    return random.Random(f"{seed}/{label}")
 
 
 def gossip() -> random.Random:

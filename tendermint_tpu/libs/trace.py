@@ -35,21 +35,31 @@ from typing import Any, Dict, List, Optional
 
 __all__ = [
     "DEFAULT_CAPACITY",
+    "DEFAULT_EXEMPLAR_CAPACITY",
     "NOOP_SPAN",
     "Span",
     "add_attrs",
     "current",
     "disable",
+    "disable_exemplars",
     "enable",
+    "enable_exemplars",
+    "exemplar_snapshot",
+    "exemplars_enabled",
+    "exemplars_to_json",
     "is_enabled",
+    "record_slow_request",
     "reset",
+    "reset_exemplars",
     "set_capacity",
+    "set_exemplar_capacity",
     "snapshot",
     "span",
     "to_chrome_trace",
 ]
 
 DEFAULT_CAPACITY = 8192
+DEFAULT_EXEMPLAR_CAPACITY = 64
 
 _enabled = False
 # deque.append is atomic in CPython — writers never take a lock; the
@@ -222,6 +232,115 @@ def snapshot() -> List[Span]:
     """The recorded spans, oldest first."""
     with _ring_lock:
         return list(_ring)
+
+
+# -- slow-request SLO exemplars ------------------------------------------
+#
+# When an RPC request blows past its per-route SLO threshold
+# (rpc/metrics.py slo_for), the server captures the request's span
+# subtree from the ring into this second bounded ring — so a p99
+# outlier in the latency sketch arrives with its own flame
+# decomposition instead of a bare number. Kill-switched exactly like
+# the span recorder itself (off by default; `record_slow_request` is a
+# cheap boolean check when disabled), and capacity-bounded (old
+# exemplars are evicted, never blocked on). With span tracing disabled
+# the exemplar still records route/duration/threshold — just with an
+# empty span tree.
+
+_exemplars_enabled = False
+_exemplars: deque = deque(maxlen=DEFAULT_EXEMPLAR_CAPACITY)
+_exemplar_lock = threading.Lock()
+
+
+def enable_exemplars(capacity: Optional[int] = None) -> None:
+    """Turn slow-request exemplar capture on (optionally resizing)."""
+    global _exemplars_enabled
+    if capacity is not None:
+        set_exemplar_capacity(capacity)
+    _exemplars_enabled = True
+
+
+def disable_exemplars() -> None:
+    """Kill switch: record_slow_request becomes a no-op."""
+    global _exemplars_enabled
+    _exemplars_enabled = False
+
+
+def exemplars_enabled() -> bool:
+    return _exemplars_enabled
+
+
+def set_exemplar_capacity(capacity: int) -> None:
+    """Resize the exemplar ring, keeping the most recent entries."""
+    global _exemplars
+    if capacity < 1:
+        raise ValueError(
+            f"exemplar ring capacity must be >= 1: {capacity}"
+        )
+    with _exemplar_lock:
+        _exemplars = deque(_exemplars, maxlen=capacity)
+
+
+def reset_exemplars() -> None:
+    """Drop every captured exemplar (tests; debug-dump isolation)."""
+    with _exemplar_lock:
+        _exemplars.clear()
+
+
+def _span_dict(s: Span) -> Dict[str, Any]:
+    return {
+        "name": s.name,
+        "span_id": s.span_id,
+        "parent_id": s.parent_id,
+        "start_us": round(s.start_us, 3),
+        "dur_us": round(s.dur_us, 3),
+        "attrs": dict(s.attrs),
+    }
+
+
+def record_slow_request(
+    route: str, dur_s: float, threshold_s: float, root=None
+) -> None:
+    """Capture one SLO-breach exemplar. `root` is the request's Span
+    (anything else — the no-op singleton, a histogram timer — yields an
+    exemplar without a tree). The root's recorded descendants are
+    collected from the span ring; children exit before their parent, so
+    the newest-first walk sees the root, then its children, then their
+    children. O(ring) per capture — SLO breaches are rare by
+    definition, and the disabled path is one boolean check."""
+    if not _exemplars_enabled:
+        return
+    spans = []
+    if isinstance(root, Span):
+        ids = {root.span_id}
+        for s in reversed(snapshot()):
+            if s.span_id in ids or s.parent_id in ids:
+                ids.add(s.span_id)
+                spans.append(_span_dict(s))
+        spans.reverse()  # chronological (oldest first)
+    exemplar = {
+        "route": route,
+        "dur_ms": round(dur_s * 1e3, 3),
+        "slo_ms": round(threshold_s * 1e3, 3),
+        "spans": spans,
+    }
+    # tmlint: disable=lock-global-mutation — deque.append is
+    # GIL-atomic; _exemplar_lock guards ring *replacement* only (same
+    # contract as the span ring above)
+    _exemplars.append(exemplar)
+
+
+def exemplar_snapshot() -> List[Dict[str, Any]]:
+    """The captured exemplars, oldest first."""
+    with _exemplar_lock:
+        return list(_exemplars)
+
+
+def exemplars_to_json() -> str:
+    """Export the exemplar ring (debug bundle `slow_requests.json`)."""
+    return json.dumps(
+        {"slow_requests": exemplar_snapshot()}, default=str
+    )
 
 
 def to_chrome_trace() -> str:

@@ -1,0 +1,46 @@
+"""tmload — production load harness + per-route SLO measurement.
+
+The serving path is statically proven stall-free and bounded (tmlive,
+docs/static_analysis.md) and batch is the API (PR 11) — this package
+measures what those guarantees buy under production-shaped traffic:
+sustained txs/s, per-route p50/p99/p999 from the mergeable latency
+sketch (libs/metrics.py LatencySketch), error/timeout counts, and how
+many concurrent websocket subscribers a node holds, against a live
+multi-node localnet. docs/load.md is the operator manual (scenario
+spec, open- vs closed-loop semantics, coordinated-omission rationale,
+SLO/exemplar policy); bench.py's `load_smoke` row emits the
+BENCH_LOAD.json trajectory.
+
+Layout:
+    scenario.py  the declarative workload spec (rate, mix, duration,
+                 ramp, subscriber count) — one seed reproduces one run
+    localnet.py  in-process multi-validator net with live RPC listeners
+    driver.py    open-loop (fixed/Poisson arrival, latency from the
+                 *intended* send time) and closed-loop drivers, the
+                 HTTP client pool, and the websocket subscriber pool
+    scrape.py    mid-run registry snapshots from every node (mempool /
+                 eventbus / inflight saturation)
+    report.py    merge the per-worker sketches into the BENCH_LOAD row
+    run.py       orchestration: run_scenario / run_localnet_scenario
+"""
+
+from .driver import ClientPool, RouteStats, SubscriberPool  # noqa: F401
+from .localnet import Localnet, start_localnet  # noqa: F401
+from .report import build_report  # noqa: F401
+from .run import run_localnet_scenario, run_scenario  # noqa: F401
+from .scenario import OPS, Scenario  # noqa: F401
+from .scrape import Scraper  # noqa: F401
+
+__all__ = [
+    "OPS",
+    "ClientPool",
+    "Localnet",
+    "RouteStats",
+    "Scenario",
+    "Scraper",
+    "SubscriberPool",
+    "build_report",
+    "run_localnet_scenario",
+    "run_scenario",
+    "start_localnet",
+]

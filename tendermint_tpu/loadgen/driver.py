@@ -1,0 +1,385 @@
+"""Open/closed-loop traffic drivers, the client pool, the subscriber
+pool, and the client-observed per-route statistics.
+
+Latency accounting is the load-bearing design point (docs/load.md):
+
+* closed-loop: each worker times its own request — `done - sent`.
+* open-loop: requests arrive on a seeded schedule and latency is
+  `done - INTENDED` arrival time. A server that stalls for a second
+  does not pause the schedule; the requests that should have been sent
+  during the stall are still issued and each carries the queueing
+  delay it actually suffered. Measuring from the actual (delayed) send
+  time instead — the coordinated-omission mistake — would report a
+  stalled server as fast because the victim requests were never timed.
+
+Every per-route observation lands in a mergeable LatencySketch
+(libs/metrics.py): workers keep private sketches (no contended lock on
+the hot path) and the report merges them, which is exactly the
+cross-process shape a fleet-scale harness needs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..libs import rng as tmrng
+from ..libs.metrics import LatencySketch
+from ..rpc.client import HTTPClient, RPCClientError, WSClient
+from .scenario import Scenario
+
+__all__ = [
+    "ClientPool",
+    "RouteStats",
+    "SubscriberPool",
+    "run_closed_loop",
+    "run_open_loop",
+]
+
+
+class RouteStats:
+    """Client-observed outcome of one route: latency sketch + result
+    counters. Mergeable, like its sketch."""
+
+    __slots__ = ("sketch", "ok", "errors", "timeouts")
+
+    def __init__(self, relative_error: float = 0.01) -> None:
+        self.sketch = LatencySketch(relative_error=relative_error)
+        self.ok = 0
+        self.errors = 0
+        self.timeouts = 0
+
+    def record(self, latency_s: float, outcome: str) -> None:
+        self.sketch.record(latency_s)
+        if outcome == "ok":
+            self.ok += 1
+        elif outcome == "timeout":
+            self.timeouts += 1
+        else:
+            self.errors += 1
+
+    def merge(self, other: "RouteStats") -> "RouteStats":
+        self.sketch.merge(other.sketch)
+        self.ok += other.ok
+        self.errors += other.errors
+        self.timeouts += other.timeouts
+        return self
+
+    @property
+    def count(self) -> int:
+        return self.ok + self.errors + self.timeouts
+
+    def to_dict(self) -> dict:
+        ms = 1e3
+        return {
+            "count": self.count,
+            "ok": self.ok,
+            "errors": self.errors,
+            "timeouts": self.timeouts,
+            "p50_ms": self.sketch.quantile(0.5) * ms,
+            "p99_ms": self.sketch.quantile(0.99) * ms,
+            "p999_ms": self.sketch.quantile(0.999) * ms,
+            "max_ms": self.sketch.max * ms,
+        }
+
+
+def merge_route_stats(
+    parts: Sequence[Dict[str, RouteStats]],
+) -> Dict[str, RouteStats]:
+    """Fold per-worker stat maps into one per-route map."""
+    out: Dict[str, RouteStats] = {}
+    for part in parts:
+        for route, st in part.items():
+            if route in out:
+                out[route].merge(st)
+            else:
+                out[route] = st
+    return out
+
+
+class ClientPool:
+    """N keep-alive HTTP connections to one node behind a free-list.
+
+    HTTPClient serializes calls on its single connection; the pool is
+    what turns `max_inflight` client-side concurrency into real
+    parallel requests. Waiting for a free connection counts into the
+    caller's latency window — for open-loop traffic that wait IS
+    queueing delay and must be measured, not hidden."""
+
+    def __init__(
+        self, addr: str, size: int, timeout_s: float = 10.0
+    ) -> None:
+        self.addr = addr
+        self._clients = [
+            HTTPClient(addr, timeout=timeout_s) for _ in range(size)
+        ]
+        self._free: asyncio.Queue = asyncio.Queue()
+        for c in self._clients:
+            self._free.put_nowait(c)
+
+    async def call(self, method: str, **params):
+        c = await self._free.get()
+        try:
+            return await c.call(method, **params)
+        finally:
+            self._free.put_nowait(c)
+
+    async def close(self) -> None:
+        for c in self._clients:
+            await c.close()
+
+
+class _Workload:
+    """Executes one op of the mix against a pool, with seeded payloads.
+
+    Tx keys are unique per (seed, stream, sequence) so the mempool's
+    dedup cache never silently absorbs the flood; queries read back
+    keys the same run already wrote (a read mix that always misses
+    measures the error path, not serving)."""
+
+    def __init__(
+        self, scn: Scenario, pools: Sequence[ClientPool], stream: int
+    ) -> None:
+        self._pools = pools
+        self._stream = stream
+        self._seq = 0
+        self._rng = tmrng.derive(scn.seed, f"payload-{stream}")
+        self._value = b"v" * max(1, scn.tx_value_bytes)
+        self._last_key: Optional[bytes] = None
+        self._pick = 0
+        self._seed = scn.seed
+
+    def _pool(self) -> ClientPool:
+        # round-robin across nodes: every node serves its share
+        self._pick += 1
+        return self._pools[self._pick % len(self._pools)]
+
+    def _next_key(self) -> bytes:
+        self._seq += 1
+        return b"ld-%d-%d-%d" % (self._seed, self._stream, self._seq)
+
+    def _tx_b64(self) -> str:
+        key = self._next_key()
+        self._last_key = key
+        return base64.b64encode(key + b"=" + self._value).decode()
+
+    async def do(self, op: str):
+        pool = self._pool()
+        if op == "broadcast_tx_sync":
+            return await pool.call("broadcast_tx_sync", tx=self._tx_b64())
+        if op == "broadcast_tx_async":
+            return await pool.call("broadcast_tx_async", tx=self._tx_b64())
+        if op == "abci_query":
+            key = self._last_key or b"ld-none"
+            return await pool.call("abci_query", data=key.hex())
+        if op == "block":
+            return await pool.call("block")  # latest
+        if op == "light_blocks":
+            return await pool.call("light_blocks", max_blocks=10)
+        if op == "status":
+            return await pool.call("status")
+        raise ValueError(f"unknown op {op!r}")
+
+
+def _pick_op(scn: Scenario, r) -> Callable[[], str]:
+    ops = [op for op, _ in scn.mix]
+    weights = [w for _, w in scn.mix]
+    total = sum(weights)
+    cum = []
+    acc = 0.0
+    for w in weights:
+        acc += w
+        cum.append(acc / total)
+
+    def pick() -> str:
+        x = r.random()
+        for op, edge in zip(ops, cum):
+            if x <= edge:
+                return op
+        return ops[-1]
+
+    return pick
+
+
+async def _timed_op(
+    work: _Workload,
+    op: str,
+    stats: Dict[str, RouteStats],
+    t_intended: float,
+    sem: Optional[asyncio.Semaphore] = None,
+) -> None:
+    """One measured request. `t_intended` is the perf_counter instant
+    the request was SCHEDULED to leave; open-loop passes the schedule
+    slot, closed-loop passes now. Semaphore wait (connection budget)
+    happens inside the window by design."""
+    outcome = "ok"
+    try:
+        if sem is not None:
+            async with sem:
+                await work.do(op)
+        else:
+            await work.do(op)
+    except asyncio.TimeoutError:
+        outcome = "timeout"
+    except (RPCClientError, ConnectionError, OSError):
+        outcome = "error"
+    st = stats.get(op)
+    if st is None:
+        st = stats[op] = RouteStats()
+    st.record(time.perf_counter() - t_intended, outcome)
+
+
+async def run_closed_loop(
+    scn: Scenario,
+    pools: Sequence[ClientPool],
+    stop: asyncio.Event,
+    stream_base: int = 0,
+) -> Dict[str, RouteStats]:
+    """`concurrency` workers issuing back-to-back requests until
+    `stop`. Returns the merged per-route stats. `stream_base` keeps
+    concurrent phases (warmup vs measurement) on disjoint tx-key
+    streams — overlapping streams replay keys into the mempool dedup
+    cache and the "load" measures rejections."""
+
+    async def worker(i: int) -> Dict[str, RouteStats]:
+        stats: Dict[str, RouteStats] = {}
+        work = _Workload(scn, pools, stream=stream_base + i)
+        pick = _pick_op(scn, tmrng.derive(scn.seed, f"mix-{i}"))
+        while not stop.is_set():
+            await _timed_op(work, pick(), stats, time.perf_counter())
+        return stats
+
+    parts = await asyncio.gather(
+        *(worker(i) for i in range(scn.concurrency))
+    )
+    return merge_route_stats(parts)
+
+
+def arrival_offsets(scn: Scenario) -> List[float]:
+    """The seeded open-loop schedule: request offsets (seconds from
+    run start) over `duration_s`. Poisson draws exponential gaps at
+    the instantaneous rate; "fixed" spaces them evenly. A linear ramp
+    scales the rate from ~0 to `rate` over `ramp_s`."""
+    r = tmrng.derive(scn.seed, "arrivals")
+    offsets: List[float] = []
+    t = 0.0
+    while True:
+        frac = 1.0 if scn.ramp_s <= 0 else min(1.0, t / scn.ramp_s)
+        # the ramp floors at 10% of the target rate: a floor near zero
+        # makes the FIRST gap huge (mean 1/rate(0)) and the schedule
+        # starts with a dead window instead of a ramp
+        inst_rate = max(scn.rate * frac, scn.rate * 0.1)
+        if scn.arrival == "poisson":
+            t += r.expovariate(inst_rate)
+        else:
+            t += 1.0 / inst_rate
+        if t >= scn.duration_s:
+            return offsets
+        offsets.append(t)
+
+
+async def run_open_loop(
+    scn: Scenario,
+    pools: Sequence[ClientPool],
+) -> Tuple[Dict[str, RouteStats], int]:
+    """Issue the seeded arrival schedule; every request is timed from
+    its intended arrival instant. Returns (per-route stats, number of
+    scheduled arrivals). The dispatcher never blocks on the server:
+    when the connection budget is exhausted, requests queue inside
+    their own measurement window."""
+    stats: Dict[str, RouteStats] = {}
+    work = _Workload(scn, pools, stream=0)
+    pick = _pick_op(scn, tmrng.derive(scn.seed, "mix"))
+    sem = asyncio.Semaphore(scn.max_inflight)
+    offsets = arrival_offsets(scn)
+    t0 = time.perf_counter()
+    pending: set = set()
+    for off in offsets:
+        delay = (t0 + off) - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        task = asyncio.ensure_future(
+            _timed_op(work, pick(), stats, t0 + off, sem=sem)
+        )
+        pending.add(task)
+        task.add_done_callback(pending.discard)
+    if pending:
+        await asyncio.gather(*pending, return_exceptions=True)
+    return stats, len(offsets)
+
+
+class SubscriberPool:
+    """N concurrent websocket subscribers held for the run.
+
+    Each subscriber is a real WSClient on its own TCP connection,
+    subscribed to `scn.subscribe_query`, draining pushed events. The
+    node-side saturation signals (`rpc_ws_send_queue_depth`,
+    `eventbus_fanout_lag`) are what the scrape loop reads while these
+    hold their connections; the pool itself reports how many
+    subscribers connected, how many survived the run, and how many
+    events they drained."""
+
+    def __init__(self, scn: Scenario, addrs: Sequence[str]) -> None:
+        self._scn = scn
+        self._addrs = list(addrs)
+        # tmlive: bounded= at most scn.subscribers entries (start()'s
+        # loop bound); drained and cleared by stop()
+        self._clients: List[WSClient] = []
+        # tmlive: bounded= one drain task per connected subscriber
+        self._drains: List[asyncio.Task] = []
+        self.connected = 0
+        self.events = 0
+
+    async def start(self) -> None:
+        for i in range(self._scn.subscribers):
+            ws = WSClient(
+                self._addrs[i % len(self._addrs)],
+                timeout=self._scn.timeout_s,
+            )
+            try:
+                await ws.connect()
+                await ws.call(
+                    "subscribe", query=self._scn.subscribe_query
+                )
+            except (
+                RPCClientError,
+                ConnectionError,
+                OSError,
+                asyncio.TimeoutError,
+            ):
+                await ws.close()
+                continue
+            self._clients.append(ws)
+            self.connected += 1
+            self._drains.append(
+                asyncio.ensure_future(self._drain(ws))
+            )
+
+    async def _drain(self, ws: WSClient) -> None:
+        try:
+            while True:
+                await ws.next_event(timeout=60.0)
+                self.events += 1
+        except (
+            RPCClientError,
+            ConnectionError,
+            asyncio.TimeoutError,
+            asyncio.CancelledError,
+        ):
+            pass
+
+    def held(self) -> int:
+        """Subscribers still draining (not dead) right now."""
+        return sum(1 for t in self._drains if not t.done())
+
+    async def stop(self) -> Tuple[int, int]:
+        held = self.held()
+        for t in self._drains:
+            t.cancel()
+        if self._drains:
+            await asyncio.gather(*self._drains, return_exceptions=True)
+        for ws in self._clients:
+            await ws.close()
+        return held, self.events

@@ -1,0 +1,149 @@
+"""In-process multi-validator localnet with live RPC listeners.
+
+The harness target: N validator Nodes over a MemoryNetwork (the e2e
+runner's transport), each with a REAL TCP JSON-RPC listener on an
+ephemeral 127.0.0.1 port — load flows over actual HTTP/websocket so the
+per-route metrics recorded in rpc/jsonrpc.py measure the same code path
+production traffic takes. The device verifier stays OFF
+(`tpu.enable=false`): the load harness must never initialize the jax
+backend (bench.py's banked CPU block runs it before the device probe —
+a wedged claim hangs backend init), and single-validator-scale commits
+never reach the batch threshold anyway.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..config import Config
+from ..crypto.ed25519 import PrivKeyEd25519
+from ..node import NodeKey, make_node
+from ..p2p.transport import MemoryNetwork, MemoryTransport
+from ..privval import FilePV
+from ..types.genesis import GenesisDoc, GenesisValidator
+
+__all__ = ["Localnet", "start_localnet"]
+
+
+@dataclass
+class Localnet:
+    nodes: List[object]
+    chain_id: str
+
+    @property
+    def rpc_addrs(self) -> List[str]:
+        return [
+            f"127.0.0.1:{n.rpc_server.bound_port}" for n in self.nodes
+        ]
+
+    async def wait_for_height(self, height: int, timeout: float = 60.0):
+        await asyncio.gather(
+            *(
+                n.consensus.wait_for_height(height, timeout=timeout)
+                for n in self.nodes
+            )
+        )
+
+    async def stop(self) -> None:
+        for n in self.nodes:
+            await n.stop()
+
+
+async def start_localnet(
+    n_nodes: int,
+    home: str,
+    chain_id: str = "loadnet",
+    seed: int = 2026,
+    timeout_commit: float = 0.2,
+    trace_spans: bool = False,
+    slo_exemplars: bool = False,
+    genesis_time_ns: Optional[int] = None,
+) -> Localnet:
+    """Boot an N-validator in-process net and wait for height 1 on
+    every node (traffic against a chain that hasn't committed yet
+    measures boot, not serving)."""
+    if n_nodes < 1:
+        raise ValueError(f"n_nodes must be >= 1: {n_nodes}")
+    privs = [
+        PrivKeyEd25519.from_seed(
+            seed.to_bytes(8, "big") + bytes([i]) * 24
+        )
+        for i in range(n_nodes)
+    ]
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=(
+            genesis_time_ns
+            if genesis_time_ns is not None
+            else time.time_ns()
+        ),
+        validators=[
+            GenesisValidator(pub_key=p.pub_key(), power=10)
+            for p in privs
+        ],
+    )
+    net = MemoryNetwork()
+    cfgs = []
+    for i, priv in enumerate(privs):
+        cfg = Config()
+        cfg.base.home = os.path.join(home, f"load{i}")
+        cfg.base.chain_id = chain_id
+        cfg.base.db_backend = "memdb"
+        cfg.tpu.enable = False  # the jax-free guarantee (module doc)
+        cfg.consensus.timeout_propose = 2.0
+        cfg.consensus.timeout_prevote = 1.0
+        cfg.consensus.timeout_precommit = 1.0
+        cfg.consensus.timeout_commit = timeout_commit
+        cfg.consensus.peer_gossip_sleep_duration = 0.01
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = f"load{i}:26656"
+        cfg.instrumentation.trace_spans = trace_spans
+        cfg.instrumentation.slo_exemplars = slo_exemplars
+        cfg.ensure_dirs()
+        genesis.save_as(cfg.base.path(cfg.base.genesis_file))
+        FilePV.from_priv_key(
+            priv,
+            cfg.base.path(cfg.priv_validator.key_file),
+            cfg.base.path(cfg.priv_validator.state_file),
+        ).save()
+        cfgs.append(cfg)
+    node_ids = [
+        NodeKey.load_or_generate(
+            c.base.path(c.base.node_key_file)
+        ).node_id
+        for c in cfgs
+    ]
+    for i, cfg in enumerate(cfgs):
+        cfg.p2p.persistent_peers = ",".join(
+            f"{node_ids[j]}@load{j}:26656"
+            for j in range(n_nodes)
+            if j != i
+        )
+    nodes = [
+        make_node(
+            c, transport=MemoryTransport(net, f"load{i}:26656")
+        )
+        for i, c in enumerate(cfgs)
+    ]
+    started = []
+    try:
+        for n in nodes:
+            await n.start()
+            started.append(n)
+        ln = Localnet(nodes=nodes, chain_id=chain_id)
+        # consensus height 2 = block 1 committed and stored everywhere
+        # (height 1 is where consensus STARTS — waiting for it returns
+        # immediately and load would then measure boot, not serving)
+        await ln.wait_for_height(2, timeout=60.0)
+        return ln
+    except BaseException:
+        for n in started:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+        raise

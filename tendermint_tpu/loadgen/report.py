@@ -1,0 +1,74 @@
+"""Fold one run's artifacts into the BENCH_LOAD row.
+
+The row is the operator's first-questions answer sheet: sustained
+txs/s (client-observed accepted writes AND chain-committed), per-route
+p50/p99/p999 from the merged latency sketches, error/timeout counts,
+concurrent subscribers held, and the scrape-derived saturation peaks.
+bench.py persists it as BENCH_LOAD.json.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .driver import RouteStats
+from .scenario import Scenario
+from .scrape import Scraper
+
+__all__ = ["build_report"]
+
+_TX_OPS = ("broadcast_tx_sync", "broadcast_tx_async")
+
+
+def build_report(
+    scn: Scenario,
+    route_stats: Dict[str, RouteStats],
+    wall_s: float,
+    n_nodes: int,
+    subscribers_connected: int = 0,
+    subscribers_held: int = 0,
+    subscriber_events: int = 0,
+    scraper: Optional[Scraper] = None,
+    scheduled_arrivals: int = 0,
+) -> dict:
+    routes = {op: st.to_dict() for op, st in sorted(route_stats.items())}
+    total = sum(st.count for st in route_stats.values())
+    errors = sum(st.errors for st in route_stats.values())
+    timeouts = sum(st.timeouts for st in route_stats.values())
+    tx_ok = sum(
+        route_stats[op].ok for op in _TX_OPS if op in route_stats
+    )
+    sat = scraper.saturation() if scraper is not None else {}
+    committed = sat.get("consensus_total_txs_delta", 0.0)
+    report = {
+        "schema": "bench_load/v1",
+        "scenario": scn.to_dict(),
+        "nodes": n_nodes,
+        "wall_s": round(wall_s, 3),
+        "requests_total": total,
+        "requests_per_s": round(total / wall_s, 2) if wall_s else 0.0,
+        "errors_total": errors,
+        "timeouts_total": timeouts,
+        # client-observed accepted writes per second — the "sustained"
+        # number: requests the mempool took, at the offered rate
+        "sustained_txs_per_s": (
+            round(tx_ok / wall_s, 2) if wall_s else 0.0
+        ),
+        # chain-side confirmation from the scrape delta (0.0 when the
+        # scraper was off): txs that actually landed in blocks
+        "committed_txs_per_s": (
+            round(committed / wall_s, 2) if wall_s else 0.0
+        ),
+        "routes": routes,
+        "subscribers": {
+            "requested": scn.subscribers,
+            "connected": subscribers_connected,
+            "held": subscribers_held,
+            "events_received": subscriber_events,
+        },
+        "saturation": sat,
+    }
+    if scn.mode == "open":
+        report["scheduled_arrivals"] = scheduled_arrivals
+        report["offered_rate_per_s"] = scn.rate
+    return report

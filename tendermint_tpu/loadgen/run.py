@@ -1,0 +1,139 @@
+"""Run orchestration: scenario → live net → report.
+
+`run_scenario` drives an already-running net (any list of RPC
+addresses; pass the Node objects too and the scraper samples their
+registries mid-run). `run_localnet_scenario` is the batteries-included
+entry: boot an in-process N-validator localnet, run the scenario,
+tear down, return the report — what bench.py's `load_smoke` row and
+the tier-1 smoke test call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional, Sequence
+
+from .driver import (
+    ClientPool,
+    SubscriberPool,
+    run_closed_loop,
+    run_open_loop,
+)
+from .localnet import start_localnet
+from .report import build_report
+from .scenario import Scenario
+from .scrape import Scraper
+
+__all__ = ["run_scenario", "run_localnet_scenario"]
+
+
+async def run_scenario(
+    scn: Scenario,
+    rpc_addrs: Sequence[str],
+    nodes: Optional[Sequence[object]] = None,
+) -> dict:
+    """Apply `scn` against live RPC endpoints and return the report.
+
+    Phases: subscribers connect → warmup traffic (unmeasured) →
+    measured window with the scrape loop sampling each node's registry
+    → teardown → merged report."""
+    scn.validate()
+    if not rpc_addrs:
+        raise ValueError("need at least one RPC address")
+    per_pool = max(1, scn.max_inflight // len(rpc_addrs))
+    pools = [
+        ClientPool(addr, size=per_pool, timeout_s=scn.timeout_s)
+        for addr in rpc_addrs
+    ]
+    subs = SubscriberPool(scn, rpc_addrs)
+    scraper = (
+        Scraper(nodes, interval_s=scn.scrape_interval_s)
+        if nodes
+        else None
+    )
+    scrape_task = None
+    stop = asyncio.Event()
+    try:
+        await subs.start()
+        if scn.warmup_s > 0:
+            warm_stop = asyncio.Event()
+            warm = asyncio.ensure_future(
+                run_closed_loop(
+                    scn.with_(concurrency=min(scn.concurrency, 2)),
+                    pools,
+                    warm_stop,
+                    stream_base=1_000_000,  # disjoint from measured keys
+                )
+            )
+            await asyncio.sleep(scn.warmup_s)
+            warm_stop.set()
+            await warm
+
+        scrape_task = (
+            asyncio.ensure_future(scraper.run(stop))
+            if scraper is not None
+            else None
+        )
+        t0 = time.perf_counter()
+        scheduled = 0
+        if scn.mode == "open":
+            stats, scheduled = await run_open_loop(scn, pools)
+        else:
+            stopper = asyncio.get_event_loop().call_later(
+                scn.duration_s, stop.set
+            )
+            stats = await run_closed_loop(scn, pools, stop)
+            stopper.cancel()
+        wall = time.perf_counter() - t0
+        held = subs.held()
+        stop.set()
+        if scrape_task is not None:
+            await scrape_task
+            scrape_task = None
+        _, events = await subs.stop()
+        return build_report(
+            scn,
+            stats,
+            wall,
+            n_nodes=len(rpc_addrs),
+            subscribers_connected=subs.connected,
+            subscribers_held=held,
+            subscriber_events=events,
+            scraper=scraper,
+            scheduled_arrivals=scheduled,
+        )
+    finally:
+        # unconditional teardown: a driver or scraper exception must
+        # not orphan the WS drain tasks / scrape task (asyncio.run
+        # would otherwise destroy them pending and bury the real error)
+        stop.set()
+        if scrape_task is not None:
+            scrape_task.cancel()
+            await asyncio.gather(scrape_task, return_exceptions=True)
+        await subs.stop()
+        for p in pools:
+            await p.close()
+
+
+async def run_localnet_scenario(
+    scn: Scenario,
+    n_nodes: int,
+    home: str,
+    chain_id: str = "loadnet",
+    timeout_commit: float = 0.2,
+) -> dict:
+    """Boot an in-process localnet, run the scenario, tear down."""
+    net = await start_localnet(
+        n_nodes,
+        home,
+        chain_id=chain_id,
+        seed=scn.seed,
+        timeout_commit=timeout_commit,
+    )
+    try:
+        return await run_scenario(
+            scn, net.rpc_addrs, nodes=net.nodes
+        )
+    finally:
+        await net.stop()

@@ -1,0 +1,129 @@
+"""The declarative load-scenario spec.
+
+A Scenario is everything one run needs: the traffic model (open- or
+closed-loop), the arrival process, the route mix, the subscriber
+count, and ONE seed — `libs/rng.derive(seed, label)` hands every
+concern (arrival schedule, op mix, payload bytes) its own independent
+stream, so the same Scenario replays the same request sequence.
+docs/load.md explains the open-vs-closed distinction and why open-loop
+latency is measured from the intended send time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, Tuple
+
+__all__ = ["OPS", "Scenario"]
+
+# the route vocabulary the driver knows how to exercise: the write
+# flood, the three read shapes, and the cheap liveness probe
+OPS = (
+    "broadcast_tx_sync",
+    "broadcast_tx_async",
+    "abci_query",
+    "block",
+    "light_blocks",
+    "status",
+)
+
+# a production-ish default: write-heavy with a read tail
+DEFAULT_MIX: Tuple[Tuple[str, float], ...] = (
+    ("broadcast_tx_sync", 4.0),
+    ("abci_query", 2.0),
+    ("block", 2.0),
+    ("light_blocks", 1.0),
+    ("status", 1.0),
+)
+
+
+@dataclass
+class Scenario:
+    """One reproducible load run.
+
+    mode="closed": `concurrency` workers issue requests back-to-back —
+    throughput finds its own level, latency excludes queueing you
+    didn't create. mode="open": requests arrive on a seeded schedule
+    (`arrival` = "poisson" or "fixed") at `rate`/s (linearly ramped
+    over `ramp_s`), and latency is measured from the *intended* arrival
+    time — a stalled server keeps accruing latency for requests it
+    hasn't absorbed yet (coordinated-omission correction).
+    `max_inflight` is the client-side connection budget, not a
+    throttle: arrivals past it queue with their intended timestamps
+    intact.
+    """
+
+    seed: int = 2026
+    mode: str = "open"  # "open" | "closed"
+    duration_s: float = 10.0
+    warmup_s: float = 0.0  # traffic before measurement starts
+    # open-loop arrival process
+    rate: float = 200.0  # target arrivals/s after the ramp
+    ramp_s: float = 0.0  # linear 0 -> rate ramp at run start
+    arrival: str = "poisson"  # "poisson" | "fixed"
+    max_inflight: int = 64
+    # closed-loop shape
+    concurrency: int = 8
+    # route mix: (op, weight) — weights need not sum to anything
+    mix: Tuple[Tuple[str, float], ...] = DEFAULT_MIX
+    # websocket subscribers held for the whole run
+    subscribers: int = 8
+    subscribe_query: str = "tm.event='NewBlock'"
+    # per-request client timeout (timeouts are counted, not fatal)
+    timeout_s: float = 5.0
+    tx_value_bytes: int = 32
+    scrape_interval_s: float = 0.5
+
+    def validate(self) -> "Scenario":
+        if self.mode not in ("open", "closed"):
+            raise ValueError(f"mode must be open|closed: {self.mode!r}")
+        if self.arrival not in ("poisson", "fixed"):
+            raise ValueError(
+                f"arrival must be poisson|fixed: {self.arrival!r}"
+            )
+        if self.duration_s <= 0:
+            raise ValueError(f"duration_s must be > 0: {self.duration_s}")
+        if self.mode == "open" and self.rate <= 0:
+            raise ValueError(f"open-loop rate must be > 0: {self.rate}")
+        if self.mode == "closed" and self.concurrency < 1:
+            raise ValueError(
+                f"closed-loop concurrency must be >= 1: {self.concurrency}"
+            )
+        if not self.mix:
+            raise ValueError("mix must name at least one op")
+        for op, w in self.mix:
+            if op not in OPS:
+                raise ValueError(f"unknown op {op!r} (known: {OPS})")
+            if w <= 0:
+                raise ValueError(f"mix weight for {op!r} must be > 0: {w}")
+        if self.subscribers < 0 or self.max_inflight < 1:
+            raise ValueError("subscribers >= 0, max_inflight >= 1")
+        return self
+
+    def with_(self, **kw) -> "Scenario":
+        return replace(self, **kw).validate()
+
+    def mix_ops(self) -> Tuple[str, ...]:
+        return tuple(op for op, _ in self.mix)
+
+    def to_dict(self) -> Dict:
+        return {
+            "seed": self.seed,
+            "mode": self.mode,
+            "duration_s": self.duration_s,
+            "warmup_s": self.warmup_s,
+            "rate": self.rate,
+            "ramp_s": self.ramp_s,
+            "arrival": self.arrival,
+            "max_inflight": self.max_inflight,
+            "concurrency": self.concurrency,
+            "mix": [list(m) for m in self.mix],
+            "subscribers": self.subscribers,
+            "subscribe_query": self.subscribe_query,
+            "timeout_s": self.timeout_s,
+            "tx_value_bytes": self.tx_value_bytes,
+            # part of the recipe: coarser sampling misses saturation
+            # peaks, so an A/B row must name its scrape cadence
+            "scrape_interval_s": self.scrape_interval_s,
+        }
+

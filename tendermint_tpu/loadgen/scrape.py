@@ -1,0 +1,145 @@
+"""Mid-run registry scrape loop.
+
+While the drivers apply load, the scraper snapshots every node's
+metrics registry (node._render_metrics(), the same document /metrics
+serves) on a fixed interval, parses it, and keeps a bounded ring of
+samples. The client-side sketches say how slow requests WERE; the
+scrape series say WHY — mempool depth, eventbus fanout lag, websocket
+queue depth, in-flight request counts — the saturation signals the
+ROADMAP's follow-on work (async RPC, sharded CheckTx, fanout batching)
+will be judged against.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Dict, List, Sequence
+
+__all__ = ["Scraper", "parse_exposition"]
+
+
+def parse_exposition(text: str) -> Dict[str, float]:
+    """Prometheus text-format (0.0.4) → {series-with-sorted-labels:
+    value}. Strict on data lines: a malformed scrape should fail the
+    harness loudly, not silently drop the saturation signal."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        metric, _, value = line.rpartition(" ")
+        if not metric:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        if "{" in metric:
+            name, _, rest = metric.partition("{")
+            pairs = []
+            for pair in rest.rstrip("}").split(","):
+                k, _, v = pair.partition("=")
+                pairs.append((k, v.strip('"')))
+            key = (
+                name
+                + "{"
+                + ",".join(f"{k}={v}" for k, v in sorted(pairs))
+                + "}"
+            )
+        else:
+            key = metric
+        out[key] = (
+            float("inf") if value == "+Inf" else float(value)
+        )
+    return out
+
+
+_NS = "tendermint_tpu_"
+
+# gauges tracked as run maxima (saturation peaks), by series prefix —
+# label-bearing series (rpc_inflight_requests{route=...}) are summed
+# per sample before the max
+_MAX_GAUGES = (
+    "mempool_size",
+    "eventbus_fanout_lag",
+    "eventbus_subscriptions",
+    "rpc_ws_connections",
+    "rpc_inflight_requests",
+)
+
+# counters reported as whole-run deltas (first vs last sample)
+_DELTA_COUNTERS = (
+    "consensus_total_txs",
+    "eventbus_deliveries_total",
+    "eventbus_dropped_subscriptions_total",
+    "rpc_ws_slow_clients_dropped_total",
+    "mempool_failed_txs_total",
+)
+
+
+class Scraper:
+    """Samples every node's registry on `interval_s` until stopped."""
+
+    def __init__(
+        self,
+        nodes: Sequence[object],
+        interval_s: float = 0.5,
+        keep: int = 256,
+    ) -> None:
+        self._nodes = list(nodes)
+        self._interval = interval_s
+        # tmlive: bounded= ring (deque maxlen=keep)
+        self._samples: deque = deque(maxlen=keep)
+        self.scrapes = 0
+
+    def sample_once(self) -> List[Dict[str, float]]:
+        """One parsed snapshot per node; also appended to the ring."""
+        snap = [
+            parse_exposition(n._render_metrics()) for n in self._nodes
+        ]
+        self._samples.append(snap)
+        self.scrapes += 1
+        return snap
+
+    async def run(self, stop: asyncio.Event) -> None:
+        while not stop.is_set():
+            self.sample_once()
+            try:
+                await asyncio.wait_for(stop.wait(), self._interval)
+            except asyncio.TimeoutError:
+                pass
+        self.sample_once()  # closing sample: the run's final state
+
+    # -- aggregation --
+
+    @staticmethod
+    def _series_sum(parsed: Dict[str, float], name: str) -> float:
+        """Sum of every series for `name` (labeled children fold)."""
+        full = _NS + name
+        total = 0.0
+        seen = False
+        for k, v in parsed.items():
+            if k == full or k.startswith(full + "{"):
+                total += v
+                seen = True
+        return total if seen else 0.0
+
+    def saturation(self) -> Dict[str, float]:
+        """Run maxima of the saturation gauges (summed across each
+        node per sample, max over samples) plus whole-run counter
+        deltas — the scrape-derived half of the BENCH_LOAD row."""
+        out: Dict[str, float] = {}
+        samples = list(self._samples)
+        if not samples:
+            return out
+        for name in _MAX_GAUGES:
+            out[name + "_max"] = max(
+                sum(self._series_sum(p, name) for p in snap)
+                for snap in samples
+            )
+        first, last = samples[0], samples[-1]
+        for name in _DELTA_COUNTERS:
+            # max across nodes: counters like consensus_total_txs move
+            # together on a healthy net; max tolerates a lagging node
+            out[name + "_delta"] = max(
+                self._series_sum(lp, name) - self._series_sum(fp, name)
+                for fp, lp in zip(first, last)
+            )
+        out["scrapes"] = float(self.scrapes)
+        return out

@@ -101,8 +101,16 @@ class TxMempool(Mempool):
         mempool lock, so ingestion is excluded while consensus holds it
         across Commit+Update — a tx can never be validated against
         pre-commit app state and inserted post-commit."""
-        async with self._lock:
-            return await self._check_tx_locked(tx, tx_info)
+        t0 = time.perf_counter()
+        try:
+            async with self._lock:
+                return await self._check_tx_locked(tx, tx_info)
+        finally:
+            # lock wait included on purpose: under load the wait for
+            # consensus to release the pool IS the ingest latency
+            self.metrics.checktx_seconds.observe(
+                time.perf_counter() - t0
+            )
 
     async def _check_tx_locked(
         self, tx: bytes, tx_info: Optional[TxInfo]

@@ -23,3 +23,13 @@ class MempoolMetrics:
             "failed_txs_total",
             "Transactions rejected by CheckTx.",
         )
+        # the ingest-latency baseline the ROADMAP's sharded-CheckTx
+        # follow-on will be judged against (mergeable sketch — see
+        # docs/metrics.md "Latency sketches"); includes the mempool
+        # lock wait, which is the contention signal under load
+        self.checktx_seconds = r.sketch(
+            "mempool",
+            "checktx_seconds",
+            "End-to-end CheckTx ingest latency (lock wait + app "
+            "round-trip + pool insert).",
+        )

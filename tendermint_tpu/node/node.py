@@ -35,7 +35,7 @@ from ..consensus.reactor import (
 from ..consensus.replay import Handshaker
 from ..consensus.wal import WAL
 from ..crypto import tpu_verifier
-from ..eventbus import EventBus
+from ..eventbus import EventBus, EventBusMetrics
 from ..consensus.metrics import ConsensusMetrics
 from ..evidence import (
     EvidencePool,
@@ -101,6 +101,14 @@ class Node(Service):
             from ..libs import trace
 
             trace.enable(capacity=cfg.instrumentation.trace_ring_capacity)
+        # ditto the slow-request exemplar ring (SLO-breach span trees,
+        # surfaced in the debug bundle; see docs/load.md)
+        if cfg.instrumentation.slo_exemplars:
+            from ..libs import trace
+
+            trace.enable_exemplars(
+                capacity=cfg.instrumentation.slo_exemplar_capacity
+            )
 
         # -- device verifier install (the north-star seam) --
         # Done first so every later verification dispatches through it.
@@ -158,7 +166,9 @@ class Node(Service):
         self.proxy = AppConns(creator)
 
         # -- event bus + indexer --
-        self.event_bus = EventBus()
+        self.event_bus = EventBus(
+            metrics=EventBusMetrics(self.metrics_registry)
+        )
         sinks = []
         for kind in cfg.tx_index.indexer:
             if kind == "kv":

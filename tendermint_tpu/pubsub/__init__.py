@@ -139,17 +139,47 @@ class Server(Service):
     def num_clients(self) -> int:
         return len({cid for cid, _ in self._subs})
 
-    def publish(self, data: object, events: Optional[Dict[str, List[str]]] = None):
+    def num_subscriptions(self) -> int:
+        return len(self._subs)
+
+    def publish(
+        self, data: object, events: Optional[Dict[str, List[str]]] = None
+    ) -> Tuple[int, int, int]:
         """Synchronous fan-out: delivery is put_nowait into bounded queues,
-        so publishing never blocks the caller (the consensus hot loop)."""
+        so publishing never blocks the caller (the consensus hot loop).
+
+        Returns `(matched, max_depth, dropped)` — subscriptions the
+        message matched, the deepest subscriber queue after delivery
+        (the fanout-lag signal: how far the slowest live subscriber is
+        behind the publisher), and subscriptions terminated by overflow
+        on this publish. Computed inside the fan-out loop the publisher
+        already pays for, so the saturation signal costs one qsize()
+        per matched subscriber."""
         events = events or {}
         dead: List[Tuple[str, str]] = []
+        matched = 0
+        max_depth = 0
         for key, sub in self._subs.items():
             if sub.query.matches(events):
+                matched += 1
                 if not sub._deliver(Message(data=data, events=events)):
                     dead.append(key)
+                else:
+                    depth = sub._queue.qsize()
+                    if depth > max_depth:
+                        max_depth = depth
         for key in dead:
             self._subs.pop(key, None)
+        return matched, max_depth, len(dead)
+
+    def max_queue_depth(self) -> int:
+        """Deepest subscriber queue right now (scrape-time gauge)."""
+        depth = 0
+        for sub in self._subs.values():
+            d = sub._queue.qsize()
+            if d > depth:
+                depth = d
+        return depth
 
     async def on_stop(self) -> None:
         for sub in self._subs.values():

@@ -25,7 +25,7 @@ from ..eventbus import EventBus
 from ..libs import trace
 from ..libs.log import get_logger
 from ..mempool import Mempool, MempoolError, TxInfo
-from ..pubsub import SubscriptionError
+from ..pubsub import ERR_TERMINATED, SubscriptionError
 from ..state.indexer import EventSink
 from ..types import events as tme
 from ..types.genesis import GenesisDoc
@@ -924,8 +924,24 @@ class Environment:
                         },
                     }
                 )
-        except SubscriptionError:
-            pass  # cancelled or terminated
+        except SubscriptionError as e:
+            # a subscriber dropped for lagging (queue overflow) is told
+            # WHY its feed died — a fleet client (and the load harness)
+            # must distinguish "no events matched" from "you were shed"
+            # (clean unsubscribes stay silent: the client asked)
+            if str(e) == ERR_TERMINATED and not ws.closed.is_set():
+                await ws.send_json(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": req_id,
+                        "error": RPCError(
+                            INTERNAL_ERROR,
+                            ERR_TERMINATED,
+                            data=query,
+                        ).to_obj(),
+                    }
+                )
+            self._ws_subs.get(ws.client_id, set()).discard(query)
         except asyncio.CancelledError:
             pass
 

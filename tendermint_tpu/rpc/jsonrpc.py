@@ -15,10 +15,12 @@ import base64
 import hashlib
 import json
 import struct
+import time
 from dataclasses import dataclass
 from typing import Any, Awaitable, Callable, Dict, Optional
 from urllib.parse import parse_qsl, urlsplit
 
+from ..crypto import faults
 from ..libs import trace
 from ..libs.log import get_logger
 
@@ -93,7 +95,7 @@ class WSConn:
     rpc/jsonrpc/server/ws_handler.go OnDisconnect).
     """
 
-    def __init__(self, reader, writer, remote: str) -> None:
+    def __init__(self, reader, writer, remote: str, metrics=None) -> None:
         self.reader = reader
         self.writer = writer
         self.remote = remote
@@ -101,6 +103,7 @@ class WSConn:
         self._sendq: asyncio.Queue = asyncio.Queue(maxsize=512)
         self.closed = asyncio.Event()
         self.on_close: Optional[Callable[["WSConn"], None]] = None
+        self._metrics = metrics  # RPCMetrics or None
 
     async def send_json(self, obj: Any) -> None:
         if self.closed.is_set():
@@ -110,7 +113,16 @@ class WSConn:
         except asyncio.QueueFull:
             # slow client: drop the connection rather than buffer
             # unboundedly (reference pubsub terminates slow subscribers)
+            if self._metrics is not None:
+                self._metrics.ws_slow_clients_dropped.inc()
             self._close()
+            return
+        if self._metrics is not None:
+            # depth AFTER the enqueue: the subscriber's lag right now —
+            # a climbing distribution is the fanout-saturation signal
+            self._metrics.ws_send_queue_depth.observe(
+                self._sendq.qsize()
+            )
 
     def _close(self) -> None:
         if not self.closed.is_set():
@@ -204,9 +216,11 @@ class JSONRPCServer:
         self,
         routes: Dict[str, Handler],
         max_body_bytes: int = 1_000_000,
+        metrics=None,
     ) -> None:
         self.routes = routes
         self.max_body_bytes = max_body_bytes
+        self.metrics = metrics  # rpc.metrics.RPCMetrics or None
         self.logger = get_logger("rpc.server")
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
@@ -376,37 +390,73 @@ class JSONRPCServer:
 
     async def _dispatch(self, req: RPCRequest):
         handler = self.routes.get(req.method)
+        m = self.metrics
         if handler is None:
+            if m is not None:
+                # NOT labeled by method: route labels must stay a
+                # server-known set, or a client mints unbounded series
+                m.unknown_methods.inc()
             return _response(
                 req.req_id,
                 error=RPCError(
                     METHOD_NOT_FOUND, f"unknown method {req.method!r}"
                 ).to_obj(),
             )
-        with trace.span("rpc_request", method=req.method):
-            try:
-                result = await handler(req)
-            except RPCError as e:
-                return _response(req.req_id, error=e.to_obj())
-            except (TypeError, ValueError, KeyError) as e:
-                # int()/decode failures on client-supplied params; logged
-                # so a genuine server bug surfacing here stays visible
-                self.logger.info(
-                    "rpc invalid params", method=req.method, err=repr(e)
-                )
-                return _response(
-                    req.req_id,
-                    error=RPCError(INVALID_PARAMS, str(e)).to_obj(),
-                )
-            except Exception as e:
-                self.logger.error(
-                    "rpc handler error", method=req.method, err=repr(e)
-                )
-                return _response(
-                    req.req_id,
-                    error=RPCError(INTERNAL_ERROR, repr(e)).to_obj(),
-                )
-        return _response(req.req_id, result=result)
+        if m is not None:
+            m.requests_total.inc(route=req.method)
+            m.inflight.add(1, route=req.method)
+        failed = False
+        sp = trace.span("rpc_request", method=req.method)
+        t0 = time.perf_counter()
+        try:
+            with sp:
+                try:
+                    if faults.armed():
+                        # chaos seam: `rpc.route` keyed by method — an
+                        # injected hang/raise lands INSIDE the timed
+                        # region (latency sketch + SLO exemplar see
+                        # it), and an injected raise maps to the same
+                        # INTERNAL_ERROR a crashing handler would
+                        faults.fire("rpc.route", req.method)
+                    result = await handler(req)
+                except RPCError as e:
+                    failed = True
+                    return _response(req.req_id, error=e.to_obj())
+                except (TypeError, ValueError, KeyError) as e:
+                    # int()/decode failures on client-supplied params;
+                    # logged so a genuine server bug surfacing here
+                    # stays visible
+                    failed = True
+                    self.logger.info(
+                        "rpc invalid params", method=req.method, err=repr(e)
+                    )
+                    return _response(
+                        req.req_id,
+                        error=RPCError(INVALID_PARAMS, str(e)).to_obj(),
+                    )
+                except Exception as e:
+                    failed = True
+                    self.logger.error(
+                        "rpc handler error", method=req.method, err=repr(e)
+                    )
+                    return _response(
+                        req.req_id,
+                        error=RPCError(INTERNAL_ERROR, repr(e)).to_obj(),
+                    )
+            return _response(req.req_id, result=result)
+        finally:
+            if m is not None:
+                dur = time.perf_counter() - t0
+                m.inflight.add(-1, route=req.method)
+                m.request_latency.observe(dur, route=req.method)
+                if failed:
+                    m.request_errors.inc(route=req.method)
+                slo = m.slo_for(req.method)
+                if dur > slo:
+                    m.slow_requests.inc(route=req.method)
+                    trace.record_slow_request(
+                        req.method, dur, slo, root=sp
+                    )
 
     # -- websocket --
 
@@ -430,8 +480,10 @@ class JSONRPCServer:
 
         peer = writer.get_extra_info("peername")
         remote = f"{peer[0]}:{peer[1]}" if peer else "unknown"
-        ws = WSConn(reader, writer, remote)
+        ws = WSConn(reader, writer, remote, metrics=self.metrics)
         self._ws_conns.add(ws)
+        if self.metrics is not None:
+            self.metrics.ws_connections.add(1)
         wtask = asyncio.ensure_future(ws._writer_loop())
         msg = bytearray()
         try:
@@ -469,4 +521,6 @@ class JSONRPCServer:
         finally:
             ws._close()
             self._ws_conns.discard(ws)
+            if self.metrics is not None:
+                self.metrics.ws_connections.add(-1)
             wtask.cancel()

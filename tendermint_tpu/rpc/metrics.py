@@ -1,24 +1,112 @@
 """RPC metrics struct (go-kit pattern, like consensus/metrics.py).
 
 One struct holding the rpc-layer instruments, built against a Registry
-and threaded through Environment construction. Node assembly passes a
-per-node Registry so in-process localnet nodes keep disjoint series;
-constructing without one lands on DEFAULT_REGISTRY (idempotent —
+and threaded through Environment construction (node assembly passes the
+per-node Registry, so in-process localnet nodes keep disjoint series;
+constructing without one lands on DEFAULT_REGISTRY — idempotent,
 repeated default constructions share instruments).
+
+Every JSON-RPC route gets the same per-route family, recorded by the
+transport layer (rpc/jsonrpc.py _dispatch) so HTTP, URI-GET and
+websocket requests all land in one place:
+
+    rpc_requests_total{route=}        counter
+    rpc_request_errors_total{route=}  counter (RPCError + handler crash)
+    rpc_request_latency_seconds{route=,quantile=}  mergeable sketch
+    rpc_inflight_requests{route=}     gauge
+
+`route` label values are always server-known route names — an unknown
+method increments the unlabeled `rpc_unknown_methods_total` instead,
+so a client cannot mint unbounded label cardinality.
+
+The struct also owns the per-route SLO policy: a request slower than
+`slo_for(route)` captures a slow-request exemplar (libs/trace.py
+`record_slow_request`; see docs/load.md for the policy rationale).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 from ..libs.metrics import DEFAULT_REGISTRY, Registry
 
-__all__ = ["RPCMetrics"]
+__all__ = ["RPCMetrics", "DEFAULT_SLO_S", "ROUTE_SLO_S"]
+
+# default per-request SLO: anything over this is an outlier worth a
+# captured exemplar on an interactive serving path
+DEFAULT_SLO_S = 1.0
+
+# per-route overrides for routes that are slow BY DESIGN — their SLO is
+# their documented contract, not the interactive default
+ROUTE_SLO_S: Dict[str, float] = {
+    # waits for the tx to be committed in a block (cfg
+    # rpc.timeout_broadcast_tx_commit bounds it at 10 s by default)
+    "broadcast_tx_commit": 15.0,
+}
 
 
 class RPCMetrics:
     def __init__(self, registry: Optional[Registry] = None) -> None:
         r = registry if registry is not None else DEFAULT_REGISTRY
+        self.requests_total = r.counter(
+            "rpc",
+            "requests_total",
+            "JSON-RPC requests dispatched, by route.",
+            label_names=("route",),
+        )
+        self.request_errors = r.counter(
+            "rpc",
+            "request_errors_total",
+            "JSON-RPC requests answered with an error, by route.",
+            label_names=("route",),
+        )
+        self.request_latency = r.sketch(
+            "rpc",
+            "request_latency_seconds",
+            "Per-route request latency (mergeable log-bucketed sketch; "
+            "1% relative-error bound, see docs/metrics.md).",
+            label_names=("route",),
+        )
+        self.inflight = r.gauge(
+            "rpc",
+            "inflight_requests",
+            "JSON-RPC requests currently executing, by route.",
+            label_names=("route",),
+        )
+        self.unknown_methods = r.counter(
+            "rpc",
+            "unknown_methods_total",
+            "Requests for methods with no route (not labeled: method "
+            "names are client-chosen).",
+        )
+        self.ws_connections = r.gauge(
+            "rpc",
+            "ws_connections",
+            "Live websocket connections.",
+        )
+        self.ws_send_queue_depth = r.histogram(
+            "rpc",
+            "ws_send_queue_depth",
+            "Websocket subscriber send-queue depth sampled at each "
+            "enqueue (the per-subscriber lag signal; the queue cap is "
+            "512, overflow drops the subscriber).",
+            buckets=(0.0, 1.0, 4.0, 16.0, 64.0, 128.0, 256.0, 512.0),
+        )
+        self.ws_slow_clients_dropped = r.counter(
+            "rpc",
+            "ws_slow_clients_dropped_total",
+            "Websocket subscribers disconnected because their send "
+            "queue overflowed.",
+        )
+        self.slow_requests = r.counter(
+            "rpc",
+            "slow_requests_total",
+            "Requests that exceeded their per-route SLO threshold "
+            "(each also captures a trace exemplar when enabled).",
+            label_names=("route",),
+        )
+        # bulk light_blocks keeps its route-specific instruments
+        # (batch size has no generic analog)
         self.light_blocks_requests = r.counter(
             "rpc",
             "light_blocks_requests",
@@ -30,3 +118,11 @@ class RPCMetrics:
             "Light blocks returned per bulk light_blocks request.",
             buckets=(1.0, 2.0, 5.0, 10.0, 20.0, 50.0),
         )
+        # SLO policy is per-struct (per-node): harnesses and tests
+        # tighten thresholds without touching process-global state
+        self.default_slo_s = DEFAULT_SLO_S
+        self.slo_s: Dict[str, float] = dict(ROUTE_SLO_S)
+
+    def slo_for(self, route: str) -> float:
+        """The SLO threshold (seconds) for one route."""
+        return self.slo_s.get(route, self.default_slo_s)

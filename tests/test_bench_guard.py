@@ -283,6 +283,44 @@ print("OK")
     assert "OK" in r.stdout
 
 
+def test_load_smoke_row_never_initializes_jax():
+    """The ISSUE-12 load row boots a live multi-node localnet and
+    drives real HTTP/websocket traffic — all of it must stay off the
+    jax backend (loadgen/localnet.py pins tpu.enable=false): the row
+    lives in the banked CPU block BEFORE the device probe, where a
+    wedged claim would hang backend init. Tiny shape here; the real
+    BENCH_LOAD.json run uses the defaults."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+row, report = bench.bench_load_smoke(
+    n_nodes=2, duration_s=1.5, rate=40, subscribers=2, warmup_s=0.0
+)
+assert row["nodes"] == 2 and row["wall_s"] > 0
+for key in ("requests_per_s", "sustained_txs_per_s",
+            "committed_txs_per_s", "errors_total", "timeouts_total",
+            "subscribers_held", "routes_p99_ms", "mempool_size_max"):
+    assert key in row, key
+assert row["subscribers_held"] == 2
+assert report["schema"] == "bench_load/v1"
+assert report["scenario"]["seed"] == 2026
+for op, d in report["routes"].items():
+    assert d["count"] > 0 and d["p999_ms"] >= d["p50_ms"] > 0, op
+assert "jax" not in sys.modules, "load smoke dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout
+
+
 def test_stateless_bulk_rows_never_initialize_jax():
     """The ISSUE-11 rows (merkle_multiproof_10k,
     light_sync_bulk_150vals) live in the banked CPU block BEFORE the

@@ -1,0 +1,361 @@
+"""tmload harness tests (ISSUE 12).
+
+Tier-1: scenario/schedule determinism, the coordinated-omission
+property of the open-loop driver (against a stub server — no net),
+a seconds-scale seeded closed-loop smoke against a LIVE in-process
+node over real HTTP/websocket asserting nonzero per-route sketch
+counts node-side, slow-request exemplar capture on an injected-slow
+route (crypto/faults `rpc.route` hang), and a tmlive boundedness gate
+scoped to the new package. The full sustained multi-node open-loop
+run is `@pytest.mark.slow`.
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto import faults
+from tendermint_tpu.libs import trace
+from tendermint_tpu.loadgen import (
+    Scenario,
+    run_localnet_scenario,
+    run_scenario,
+    start_localnet,
+)
+from tendermint_tpu.loadgen.driver import arrival_offsets, run_open_loop
+from tendermint_tpu.rpc import HTTPClient
+
+
+def run(coro, timeout=240.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class TestScenario:
+    def test_validation(self):
+        Scenario().validate()
+        with pytest.raises(ValueError):
+            Scenario(mode="sideways").validate()
+        with pytest.raises(ValueError):
+            Scenario(arrival="uniform").validate()
+        with pytest.raises(ValueError):
+            Scenario(duration_s=0).validate()
+        with pytest.raises(ValueError):
+            Scenario(mode="open", rate=0).validate()
+        with pytest.raises(ValueError):
+            Scenario(mix=(("teleport", 1.0),)).validate()
+        with pytest.raises(ValueError):
+            Scenario(mix=(("block", -1.0),)).validate()
+        scn = Scenario().with_(rate=50.0)
+        assert scn.rate == 50.0
+
+    def test_arrival_schedule_is_seeded_and_shaped(self):
+        scn = Scenario(
+            seed=42, mode="open", duration_s=4.0, rate=100.0
+        )
+        a = arrival_offsets(scn)
+        b = arrival_offsets(scn)
+        assert a == b  # one seed, one schedule
+        assert a != arrival_offsets(scn.with_(seed=43))
+        # poisson at rate R over D seconds lands near R*D arrivals
+        assert 0.7 * 400 <= len(a) <= 1.3 * 400
+        assert all(0 <= t < scn.duration_s for t in a)
+        assert a == sorted(a)
+        # fixed spacing is exact
+        fixed = arrival_offsets(
+            scn.with_(arrival="fixed", duration_s=2.0)
+        )
+        assert len(fixed) == 199  # 2s at 100/s, t=0 excluded
+        gaps = {
+            round(y - x, 9) for x, y in zip(fixed, fixed[1:])
+        }
+        assert gaps == {0.01}
+        # the ramp thins the head, not the tail
+        ramped = arrival_offsets(
+            scn.with_(arrival="fixed", ramp_s=2.0)
+        )
+        head = sum(1 for t in ramped if t < 1.0)
+        tail = sum(1 for t in ramped if t >= 3.0)
+        assert head < tail
+
+
+class _StallPool:
+    """Duck-typed ClientPool: the first `stall_n` requests hang
+    `stall_s`, the rest answer instantly — a server that freezes under
+    its opening burst."""
+
+    def __init__(self, stall_s: float, stall_n: int) -> None:
+        self.calls = 0
+        self._stall_s = stall_s
+        self._stall_n = stall_n
+
+    async def call(self, method, **params):
+        self.calls += 1
+        if self.calls <= self._stall_n:
+            await asyncio.sleep(self._stall_s)
+        return {}
+
+
+def test_open_loop_measures_from_intended_time():
+    """Coordinated-omission correction: when the server stalls, the
+    requests scheduled DURING the stall must each report the queueing
+    delay they suffered (latency from intended arrival), so the p99
+    reflects the stall even though only one request touched it."""
+    scn = Scenario(
+        seed=9,
+        mode="open",
+        duration_s=0.5,
+        rate=100.0,
+        arrival="fixed",
+        max_inflight=1,  # one connection: the stall queues everyone
+        mix=(("status", 1.0),),
+        timeout_s=5.0,
+    )
+    pool = _StallPool(stall_s=0.3, stall_n=1)
+    stats, scheduled = run(run_open_loop(scn, [pool]))
+    st = stats["status"]
+    assert scheduled == len(arrival_offsets(scn))
+    assert st.count == scheduled
+    # ~30 requests were scheduled during the 0.3 s stall; with the
+    # single connection each of them queued — the sketch must show a
+    # fat tail even though the "slow" call was a single one
+    delayed = scheduled * 0.3 / 0.5 * 0.66  # conservative floor
+    over_100ms = sum(
+        c
+        for i, c in st.sketch.snapshot()._counts.items()
+        if 2.0 * st.sketch._gamma ** i / (st.sketch._gamma + 1) > 0.1
+    )
+    assert over_100ms >= delayed, (over_100ms, delayed)
+    assert st.sketch.quantile(0.5) > 0.05
+
+
+@pytest.fixture
+def _trace_off_after():
+    yield
+    trace.disable()
+    trace.reset()
+    trace.disable_exemplars()
+    trace.reset_exemplars()
+
+
+def test_load_smoke_closed_loop_live_node(tmp_path):
+    """The deterministic tier-1 smoke: a seconds-scale seeded
+    closed-loop run against a live in-process node over real HTTP +
+    websocket. Every route in the mix must land nonzero counts in the
+    harness sketches AND in the node's per-route registry family
+    (requests_total / latency sketch / inflight gauge present)."""
+
+    async def go():
+        net = await start_localnet(1, str(tmp_path / "smoke"), seed=21)
+        try:
+            scn = Scenario(
+                seed=21,
+                mode="closed",
+                duration_s=1.5,
+                concurrency=3,
+                subscribers=2,
+                timeout_s=10.0,
+            )
+            rep = await run_scenario(
+                scn, net.rpc_addrs, nodes=net.nodes
+            )
+            mixed = set(scn.mix_ops())
+            assert set(rep["routes"]) == mixed
+            for op, row in rep["routes"].items():
+                assert row["count"] > 0, op
+                assert row["p50_ms"] > 0.0, op
+                assert row["p999_ms"] >= row["p99_ms"] >= row["p50_ms"]
+            assert rep["errors_total"] == 0, rep["routes"]
+            assert rep["timeouts_total"] == 0
+            assert rep["sustained_txs_per_s"] > 0
+            assert rep["subscribers"]["connected"] == 2
+            assert rep["subscribers"]["held"] == 2
+            # node-side per-route family recorded the same traffic
+            m = net.nodes[0].rpc_env.metrics
+            for op in mixed:
+                assert m.requests_total.value(route=op) > 0, op
+                assert m.request_latency.count(route=op) > 0, op
+                assert m.inflight.value(route=op) == 0, op  # drained
+            # saturation scrape ran and saw the websocket holders
+            assert rep["saturation"]["scrapes"] >= 2
+            assert rep["saturation"]["rpc_ws_connections_max"] == 2
+            # registry exposition carries the route-labeled summary
+            text = net.nodes[0]._render_metrics()
+            assert (
+                'tendermint_tpu_rpc_request_latency_seconds_count'
+                '{route="broadcast_tx_sync"}'
+            ) in text
+            return rep
+        finally:
+            await net.stop()
+
+    rep1 = run(go())
+    # the scenario spec round-trips through the report (reproducibility
+    # contract: the row names its own recipe)
+    assert rep1["scenario"]["seed"] == 21
+    assert rep1["scenario"]["mode"] == "closed"
+
+
+def test_slow_route_exemplar_capture(tmp_path, _trace_off_after):
+    """A route pushed past its SLO by an injected `rpc.route` hang
+    (crypto/faults) must capture a bounded, kill-switched exemplar
+    carrying its span tree, and increment rpc_slow_requests_total."""
+
+    async def go():
+        trace.enable()
+        trace.reset()
+        trace.enable_exemplars(capacity=4)
+        trace.reset_exemplars()
+        net = await start_localnet(1, str(tmp_path / "slo"), seed=5)
+        try:
+            env_metrics = net.nodes[0].rpc_env.metrics
+            env_metrics.slo_s["abci_query"] = 0.02
+            c = HTTPClient(net.rpc_addrs[0])
+            with faults.inject(
+                "rpc.route", "hang", hang_s=0.06, key="abci_query"
+            ):
+                await c.call("abci_query", data="00ff")
+            await c.call("status")  # under SLO: no exemplar
+            exs = trace.exemplar_snapshot()
+            assert len(exs) == 1
+            ex = exs[0]
+            assert ex["route"] == "abci_query"
+            assert ex["dur_ms"] > ex["slo_ms"] == 20.0
+            names = [s["name"] for s in ex["spans"]]
+            assert "rpc_request" in names
+            root = next(
+                s for s in ex["spans"] if s["name"] == "rpc_request"
+            )
+            assert root["attrs"]["method"] == "abci_query"
+            assert (
+                env_metrics.slow_requests.value(route="abci_query") == 1
+            )
+            assert env_metrics.slow_requests.value(route="status") == 0
+
+            # bounded: capacity 4 evicts oldest, never grows
+            env_metrics.slo_s["status"] = 0.0
+            for _ in range(7):
+                await c.call("status")
+            assert len(trace.exemplar_snapshot()) == 4
+
+            # kill switch: no captures while disabled
+            trace.disable_exemplars()
+            before = len(trace.exemplar_snapshot())
+            await c.call("status")
+            assert len(trace.exemplar_snapshot()) == before
+            # ... but the slow-request counter still counts
+            assert env_metrics.slow_requests.value(route="status") == 8
+            await c.close()
+        finally:
+            await net.stop()
+
+    run(go())
+
+
+def test_debug_bundle_carries_slow_request_exemplars(
+    tmp_path, _trace_off_after
+):
+    """cmd debug packs the exemplar ring as slow_requests.json."""
+    import json
+    import tarfile
+
+    from tendermint_tpu.cmd.commands import main as cmd_main
+
+    trace.enable_exemplars(capacity=8)
+    trace.reset_exemplars()
+    trace.record_slow_request("block", 1.5, 1.0)
+    home = tmp_path / "dbg-home"
+    rc = cmd_main(["--home", str(home), "init", "validator"])
+    assert rc == 0
+    out = tmp_path / "bundle.tar.gz"
+    rc = cmd_main(
+        ["--home", str(home), "debug", "--output", str(out)]
+    )
+    assert rc == 0
+    with tarfile.open(out) as tar:
+        data = json.load(tar.extractfile("slow_requests.json"))
+    assert data["slow_requests"][0]["route"] == "block"
+    assert data["slow_requests"][0]["dur_ms"] == 1500.0
+
+
+def test_shed_subscriber_is_notified_and_quota_freed():
+    """A websocket subscriber dropped for lagging (eventbus queue
+    overflow) receives a final ERR_TERMINATED error frame naming its
+    query, and its slot in the per-client subscription quota is freed
+    so it can re-subscribe — silence was the old behavior, and a fleet
+    client can't tell silence from 'no events matched'."""
+    from tendermint_tpu.pubsub import ERR_TERMINATED, SubscriptionError
+    from tendermint_tpu.rpc.core import Environment
+
+    env = Environment(
+        chain_id="shed", block_store=None, state_store=None
+    )
+
+    class _Sub:
+        async def next(self):
+            raise SubscriptionError(ERR_TERMINATED)
+
+    class _WS:
+        client_id = "ws-shed"
+
+        def __init__(self):
+            self.sent = []
+            self.closed = asyncio.Event()
+
+        async def send_json(self, obj):
+            self.sent.append(obj)
+
+    ws = _WS()
+    env._ws_subs[ws.client_id] = {"q1"}
+    run(env._pump_events(ws, _Sub(), "q1", req_id=7))
+    assert len(ws.sent) == 1
+    err = ws.sent[0]["error"]
+    assert err["message"] == ERR_TERMINATED
+    assert err["data"] == "q1"
+    assert env._ws_subs[ws.client_id] == set()  # quota freed
+
+
+def test_loadgen_package_is_tmlive_clean():
+    """Zero liveness/boundedness findings on the new package: the
+    whole-program tmlive pass must neither flag nor need new
+    suppressions under tendermint_tpu/loadgen/ (bounded= annotations
+    are reviewed in-file)."""
+    from tendermint_tpu.analysis import tmcheck, tmlive
+
+    pkg = tmcheck.build_package()
+    violations = tmlive.live_violations(pkg)
+    mine = [v for v in violations if "loadgen/" in v.path]
+    assert mine == [], [v.render() for v in mine]
+
+
+@pytest.mark.slow
+def test_sustained_open_loop_multi_node(tmp_path):
+    """The BENCH_LOAD-shaped sustained run: open-loop Poisson arrivals
+    against a 3-validator localnet with subscribers held throughout.
+    Asserts the serving-side health the smoke can't: sustained
+    committed throughput, bounded error fraction, full subscriber
+    retention."""
+    scn = Scenario(
+        seed=2026,
+        mode="open",
+        duration_s=10.0,
+        warmup_s=1.0,
+        rate=250.0,
+        ramp_s=1.0,
+        subscribers=16,
+        max_inflight=64,
+        timeout_s=10.0,
+    )
+    rep = run(
+        run_localnet_scenario(scn, 3, str(tmp_path / "sustained")),
+        timeout=300.0,
+    )
+    total = rep["requests_total"]
+    assert total >= 0.7 * scn.rate * (scn.duration_s - scn.ramp_s / 2)
+    assert rep["errors_total"] + rep["timeouts_total"] <= 0.02 * total
+    assert rep["sustained_txs_per_s"] > 50
+    assert rep["committed_txs_per_s"] > 10
+    assert rep["subscribers"]["held"] == 16
+    assert rep["subscribers"]["events_received"] > 0
+    assert rep["saturation"]["consensus_total_txs_delta"] > 0
+    for op in scn.mix_ops():
+        assert rep["routes"][op]["p999_ms"] > 0

@@ -6,6 +6,8 @@ internal/consensus/metrics.go + docs/nodes/metrics.md catalog)."""
 
 import asyncio
 import json
+import math
+import random
 import time
 
 import pytest
@@ -14,6 +16,7 @@ from tendermint_tpu.libs.metrics import (
     Counter,
     Gauge,
     Histogram,
+    LatencySketch,
     Registry,
 )
 
@@ -135,6 +138,157 @@ class TestInstruments:
         assert parsed['rt_sub_lat_seconds_bucket{le=+Inf}'] == 3
         assert parsed["rt_sub_lat_seconds_count"] == 3
         assert abs(parsed["rt_sub_lat_seconds_sum"] - 5.55) < 1e-9
+
+
+class TestLatencySketch:
+    """The mergeable log-bucketed sketch behind per-route latency
+    (docs/metrics.md documents the bound these tests pin)."""
+
+    EPS = 0.01  # the documented relative-error bound
+
+    DISTRIBUTIONS = {
+        # name -> generator over a seeded random.Random: the bound must
+        # hold regardless of shape (uniform, heavy-tailed, spiky)
+        "uniform": lambda r: r.uniform(1e-4, 2.0),
+        "lognormal": lambda r: r.lognormvariate(-5.0, 2.0),
+        "exponential": lambda r: r.expovariate(100.0),
+        "bimodal": lambda r: (
+            r.uniform(1e-3, 2e-3) if r.random() < 0.9 else r.uniform(0.5, 1.5)
+        ),
+    }
+
+    @staticmethod
+    def _oracle(sorted_vals, q):
+        """Nearest-rank quantile — the same rank rule the sketch uses,
+        so the comparison isolates bucketing error."""
+        rank = max(1, math.ceil(q * len(sorted_vals)))
+        return sorted_vals[rank - 1]
+
+    @pytest.mark.parametrize("dist", sorted(DISTRIBUTIONS))
+    @pytest.mark.parametrize("seed", [11, 23, 47])
+    def test_quantile_accuracy_vs_sorted_oracle(self, dist, seed):
+        r = random.Random(seed)
+        gen = self.DISTRIBUTIONS[dist]
+        vals = [gen(r) for _ in range(5000)]
+        sk = LatencySketch(relative_error=self.EPS)
+        for v in vals:
+            sk.record(v)
+        sv = sorted(vals)
+        checked = 0
+        for q in (0.0, 0.25, 0.5, 0.9, 0.99, 0.999, 1.0):
+            oracle = self._oracle(sv, q)
+            if not sk.min_value <= oracle <= sk.max_value:
+                continue  # the bound is documented for in-range values
+            est = sk.quantile(q)
+            rel = abs(est - oracle) / oracle
+            assert rel <= self.EPS + 1e-9, (dist, seed, q, est, oracle)
+            checked += 1
+        assert checked >= 5  # the skip must not hollow out the test
+        assert sk.count == len(vals)
+        assert abs(sk.sum - sum(vals)) < 1e-6
+
+    def test_merge_associative_and_matches_single_sketch(self):
+        r = random.Random(5)
+        vals = [r.expovariate(50.0) for _ in range(6000)]
+        whole = LatencySketch(relative_error=self.EPS)
+        parts = [LatencySketch(relative_error=self.EPS) for _ in range(3)]
+        for i, v in enumerate(vals):
+            whole.record(v)
+            parts[i % 3].record(v)
+        a, b, c = parts
+        left = a.snapshot().merge(b.snapshot()).merge(c.snapshot())
+        right = a.snapshot().merge(b.snapshot().merge(c.snapshot()))
+        # bucket counts are exactly associative (sums differ only by
+        # float addition order)
+        dl, dr = left.to_dict(), right.to_dict()
+        assert dl["counts"] == dr["counts"]
+        assert dl["count"] == dr["count"] == len(vals)
+        assert abs(dl["sum"] - dr["sum"]) < 1e-6
+        # a merged sketch answers exactly like the sketch that saw
+        # everything — the property that makes per-worker recording
+        # legitimate
+        for q in (0.5, 0.9, 0.99, 0.999):
+            assert left.quantile(q) == whole.quantile(q)
+        assert left.min == whole.min and left.max == whole.max
+
+    def test_merge_rejects_incompatible_parameters(self):
+        a = LatencySketch(relative_error=0.01)
+        b = LatencySketch(relative_error=0.02)
+        with pytest.raises(ValueError):
+            a.merge(b)
+        c = LatencySketch(relative_error=0.01, min_value=1e-3)
+        with pytest.raises(ValueError):
+            a.merge(c)
+
+    def test_bounded_memory_and_dict_round_trip(self):
+        sk = LatencySketch(relative_error=self.EPS)
+        r = random.Random(3)
+        for _ in range(50_000):
+            sk.record(r.expovariate(1.0))
+        # bucket count is bounded by the index range, not by N
+        max_buckets = (
+            math.ceil(
+                math.log(sk.max_value / sk.min_value)
+                / math.log((1 + self.EPS) / (1 - self.EPS))
+            )
+            + 2
+        )
+        assert len(sk._counts) <= max_buckets
+        rt = LatencySketch.from_dict(
+            json.loads(json.dumps(sk.to_dict()))
+        )
+        for q in (0.5, 0.99, 0.999):
+            assert rt.quantile(q) == sk.quantile(q)
+        assert rt.count == sk.count
+
+    def test_empty_and_out_of_range(self):
+        sk = LatencySketch()
+        assert sk.quantile(0.99) == 0.0
+        assert sk.count == 0 and sk.min == 0.0 and sk.max == 0.0
+        sk.record(0.0)  # clamps into the lowest bucket, never raises
+        sk.record(1e12)  # clamps into the highest
+        assert sk.count == 2
+
+    def test_sketch_exposition_round_trip(self):
+        """The registry instrument renders a summary the /metrics
+        parser round-trips: per-label quantile series + _sum/_count."""
+        r = Registry("rt")
+        s = r.sketch(
+            "rpc",
+            "request_latency_seconds",
+            "lat",
+            label_names=("route",),
+        )
+        lat = [0.001, 0.002, 0.004, 0.008, 0.1]
+        for v in lat:
+            s.observe(v, route="block")
+        s.observe(0.5, route="status")
+        parsed = parse_exposition(r.render())
+        name = "rt_rpc_request_latency_seconds"
+        assert parsed[name + "_count{route=block}"] == len(lat)
+        assert abs(
+            parsed[name + "_sum{route=block}"] - sum(lat)
+        ) < 1e-9
+        p50 = parsed[name + "{quantile=0.5,route=block}"]
+        assert abs(p50 - 0.004) / 0.004 <= self.EPS
+        p999 = parsed[name + "{quantile=0.999,route=block}"]
+        assert abs(p999 - 0.1) / 0.1 <= self.EPS
+        assert parsed[name + "_count{route=status}"] == 1
+        # live child is the real mergeable sketch
+        merged = s.merged()
+        assert merged.count == len(lat) + 1
+
+    def test_registry_sketch_conflict_detection(self):
+        r = Registry("ns")
+        r.sketch("rpc", "lat", "h", relative_error=0.01)
+        assert (
+            r.sketch("rpc", "lat", "h", relative_error=0.01)
+            is r.get("ns_rpc_lat")
+        )
+        with pytest.raises(ValueError):  # error-bound conflict
+            r.sketch("rpc", "lat", "h", relative_error=0.05)
+        with pytest.raises(ValueError):  # kind conflict
+            r.counter("rpc", "lat", "h")
 
 
 async def _http_get(port: int, path: str):
