@@ -1195,6 +1195,79 @@ def _persist_load(report: dict) -> None:
         pass
 
 
+def bench_chaos_smoke(
+    n_nodes: int = 4,
+    seed: int = 2026,
+    rate: float = 40.0,
+    scenarios=None,
+):
+    """ISSUE 13: the chaos-campaign row — the shipped scenario catalog
+    (minority/majority partition + heal, asymmetric link loss,
+    high-latency links, rolling crash-restarts, churn) run against
+    fresh in-process localnets under seeded open-loop traffic, with
+    the safety verdict (byte-identical stored commit hashes at every
+    common height across all nodes) and the recovery verdict
+    (time-to-first-commit-after-heal under each scenario's SLO)
+    machine-checked per scenario. Jax-free by the same construction as
+    load_smoke (loadgen/localnet.py pins tpu.enable=false; guard:
+    tests/test_bench_guard.py) — it lives in the banked CPU block
+    BEFORE the device probe. Seeded: rerunning with the same seed
+    re-arms the identical fault schedule (crypto/faults.py contract)."""
+    import asyncio
+    import tempfile
+
+    from tendermint_tpu.loadgen import run_campaign
+
+    with tempfile.TemporaryDirectory(prefix="tt-bench-chaos-") as home:
+        report = asyncio.run(
+            run_campaign(
+                home,
+                scenarios=scenarios,
+                n_nodes=n_nodes,
+                seed=seed,
+                rate=rate,
+            )
+        )
+    row = {
+        "scenarios": len(report["scenarios"]),
+        "all_passed": report["all_passed"],
+        "ttfc_after_heal_s": {
+            r["name"]: r["ttfc_after_heal_s"]
+            for r in report["scenarios"]
+        },
+        "safety_ok": all(
+            r["safety_ok"] for r in report["scenarios"]
+        ),
+        "heights_checked_total": sum(
+            r["heights_checked"] for r in report["scenarios"]
+        ),
+    }
+    return row, report
+
+
+def _persist_chaos(report: dict) -> None:
+    """Write BENCH_CHAOS.json — the chaos-campaign trajectory row the
+    ISSUE 13 acceptance criteria are audited against (per-scenario
+    safety/recovery verdicts, seeds, fault schedules applied). Same
+    side-file rationale as _persist_load: the full per-scenario report
+    would blow the driver's one-line budget."""
+    import os
+    import time as _time
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_CHAOS.json",
+        )
+        with open(path, "w") as f:
+            json.dump(
+                {"recorded_unix": _time.time(), **report}, f, indent=1
+            )
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def bench_mempool_checktx(n_txs: int = 2000):
     """Mempool CheckTx ingest rate against the kvstore app over the
     local ABCI client (reference harness:
@@ -1833,6 +1906,18 @@ def main() -> None:
         "load_smoke",
         _load_smoke_row,
         "load_smoke",
+        600.0,
+    )
+
+    def _chaos_smoke_row():
+        row, report = bench_chaos_smoke()
+        _persist_chaos(report)
+        return row
+
+    cpu_stage(
+        "chaos_smoke",
+        _chaos_smoke_row,
+        "chaos_smoke",
         600.0,
     )
     cpu_stage(

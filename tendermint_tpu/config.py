@@ -110,6 +110,18 @@ class P2PConfig:
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
     queue_type: str = "priority"  # fifo | priority
+    # jittered capped exponential dial backoff (peermanager)
+    min_retry_time: float = 0.25
+    max_retry_time: float = 600.0
+    max_retry_time_persistent: float = 20.0
+    # keepalive liveness (router; any received traffic counts)
+    ping_interval: float = 30.0
+    pong_timeout: float = 15.0
+    # slow-peer shedding: this many send-queue drops inside the window
+    # evicts the peer with reason slow_peer and bans it for the sit-out
+    slow_peer_drop_threshold: int = 64
+    slow_peer_window: float = 10.0
+    slow_peer_ban: float = 30.0
 
 
 @dataclass

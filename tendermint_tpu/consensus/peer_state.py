@@ -204,6 +204,23 @@ class PeerState:
         elif prs.catchup_commit_round == round_:
             prs.catchup_commit = BitArray(num_validators)
 
+    def reset_live_votes(self) -> None:
+        """Forget our delivered-marks for the CURRENT height's prevotes
+        and precommits (and the POL bits) so live-height gossip resends
+        them. Same rationale as reset_catchup_precommits one branch up:
+        set_has_vote marks are optimistic — on a lossy or partitioned
+        link the connection survives while the frame doesn't, and a
+        fully-marked bit array with a peer that never advances means
+        the marks lied. Dup votes are idempotent on the receiver
+        (HeightVoteSet dedups by validator index)."""
+        prs = self.prs
+        if prs.prevotes is not None:
+            prs.prevotes = BitArray(prs.prevotes.size)
+        if prs.precommits is not None:
+            prs.precommits = BitArray(prs.precommits.size)
+        if prs.proposal_pol is not None:
+            prs.proposal_pol = BitArray(prs.proposal_pol.size)
+
     def _get_vote_bits(
         self, height: int, round_: int, vote_type: int
     ) -> Optional[BitArray]:

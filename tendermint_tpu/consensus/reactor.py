@@ -524,6 +524,21 @@ class ConsensusReactor(Service):
 
             if rs.height == prs.height:
                 sent = self._gossip_votes_same_height(ps)
+                if not sent:
+                    # The optimistic-marks hazard of the two catchup
+                    # branches below, at the LIVE height (ISSUE 13):
+                    # a partitioned or lossy link drops the frame
+                    # while the connection survives, our bits claim
+                    # delivery, and with < 2/3 prevotes delivered no
+                    # timeout ever fires — the whole net parks at
+                    # (height, round, PREVOTE) forever (witnessed:
+                    # 2|2 partition heal in the chaos campaign).
+                    # After a sustained both-sides-frozen stall with
+                    # nothing to send, forget the live-height marks
+                    # and resend — dup votes are idempotent on the
+                    # receiver, and the burst is bounded to one
+                    # vote-set resend per stall window.
+                    self._vote_stall_tick(ps, ps.reset_live_votes)
             elif (
                 prs.height != 0
                 and rs.height == prs.height + 1
@@ -531,6 +546,15 @@ class ConsensusReactor(Service):
             ):
                 # peer one behind us: send them our last commit precommits
                 sent = self._send_vote(ps, ps.pick_vote_to_send(rs.last_commit))
+                if not sent:
+                    # same hazard, one height back: when the partition
+                    # straddles a commit boundary, the lagging side is
+                    # exactly one behind and the marks these sends
+                    # left (they land in the peer's CURRENT-height
+                    # precommit bits via _get_vote_bits) are the lying
+                    # ones (witnessed: the 2|2 campaign scenario
+                    # wedged here after the live-height reset landed)
+                    self._vote_stall_tick(ps, ps.reset_live_votes)
             elif (
                 prs.height != 0
                 and rs.height >= prs.height + 2
@@ -574,7 +598,32 @@ class ConsensusReactor(Service):
             if not sent:
                 await asyncio.sleep(sleep)
             else:
+                ps.live_vote_stall = 0
                 await asyncio.sleep(0)
+
+    def _vote_stall_tick(self, ps: PeerState, reset) -> None:
+        """Count a nothing-to-send gossip tick while BOTH sides'
+        round states are frozen; past the stall window, run `reset`
+        (forget the optimistic delivered-marks so gossip resends).
+        Any progress — a successful send, or either side moving —
+        zeroes the counter, so healthy nets pay one integer bump per
+        idle tick and never reset."""
+        rs = self.cs.rs
+        prs = ps.prs
+        snap = (
+            rs.height, rs.round, rs.step,
+            prs.height, prs.round, prs.step,
+        )
+        if getattr(ps, "live_stall_snap", None) != snap:
+            ps.live_stall_snap = snap
+            ps.live_vote_stall = 0
+        ps.live_vote_stall = getattr(ps, "live_vote_stall", 0) + 1
+        if (
+            ps.live_vote_stall * self.cfg.peer_gossip_sleep_duration
+            > 2.0
+        ):
+            ps.live_vote_stall = 0
+            reset()
 
     def _validators_size_at(self, height: int) -> int:
         vals = self.cs.block_exec.store.load_validators(height)

@@ -22,6 +22,13 @@ Fault points are NAMED strings consulted at the boundary they model:
                    injected hang produces an honest SLO-breach
                    exemplar and an injected raise exercises the
                    error-counting path (loadgen smoke tests)
+    p2p.send       p2p/router.py _send_peer, keyed (src, dst, ch) —
+                   outbound link faults per asymmetric direction and
+                   channel
+    p2p.recv       p2p/router.py _recv_peer, keyed (src, dst, ch) —
+                   inbound link faults (src = the remote peer)
+    p2p.dial       p2p/transport.py dial(), keyed (src, dst) — the
+                   connection-establishment boundary
 
 Modes (the fault taxonomy, docs/resilience.md):
 
@@ -32,6 +39,34 @@ Modes (the fault taxonomy, docs/resilience.md):
     bitflip     mangle() inverts one result lane (silent corruption)
     io_error    the point raises OSError (fsync failure)
     short_write clip() truncates the buffer (torn record on crash)
+
+Network modes (consulted via net_plan(), interpreted by the p2p
+router/transport — the plane never sleeps the event loop itself):
+
+    drop        the message / dial is discarded (packet loss)
+    delay       the caller sleeps `delay_s` before proceeding (latency)
+    duplicate   the message is delivered `dup` extra times (gossip echo)
+    reorder     the message is held and swapped behind its successor
+                (the send side only parks a frame when a successor is
+                already queued; a recv-side hold is flushed after
+                0.5 s if no successor arrives — so on an idle link
+                reorder delays, it never silently drops)
+
+Network rules take extra (src, dst, ch) filters so asymmetric links
+and channel-targeted loss are expressible:
+
+    TM_TPU_FAULT="p2p.send:drop:p=0.4:seed=7:src=load0:dst=load1:ch=34"
+
+`src`/`dst` match a node's net labels (moniker, node ID, listen host)
+exactly, or as a prefix when the member is >= 8 chars (node-ID
+prefixes). On top of per-message rules, named PARTITION SETS cut whole
+links: `TM_TPU_PARTITION="load0,load1|load2,load3"` blocks every
+send/recv/dial between members of different groups (members in no
+group are unaffected). The partition is runtime-mutable —
+`set_partition()` in-process, or point TM_TPU_PARTITION_FILE at a
+file whose content is re-read on change (throttled stat), so a chaos
+scenario can HEAL a partition mid-run, including across process
+boundaries (the e2e process-net runner uses the file form).
 
 Every rule owns a `random.Random(seed)`, so whether a given consult
 fires is a pure function of (seed, consult index) — chaos runs
@@ -57,6 +92,7 @@ from typing import List, Optional
 __all__ = [
     "DeviceFault",
     "DeviceTimeout",
+    "NetPlan",
     "Rule",
     "armed",
     "clip",
@@ -64,8 +100,13 @@ __all__ = [
     "inject",
     "load_env",
     "mangle",
+    "net_armed",
+    "net_plan",
+    "partition_blocked",
+    "partition_spec",
     "reset",
     "rules",
+    "set_partition",
 ]
 
 
@@ -82,7 +123,10 @@ class DeviceTimeout(DeviceFault):
 _RAISE_MODES = {"raise", "io_error"}
 _DATA_MODES = {"misshape", "bitflip"}
 _CLIP_MODES = {"short_write"}
-_ALL_MODES = _RAISE_MODES | _DATA_MODES | _CLIP_MODES | {"hang"}
+_NET_MODES = {"drop", "delay", "duplicate", "reorder"}
+_ALL_MODES = (
+    _RAISE_MODES | _DATA_MODES | _CLIP_MODES | _NET_MODES | {"hang"}
+)
 
 
 class Rule:
@@ -98,6 +142,11 @@ class Rule:
         times: Optional[int] = None,
         hang_s: float = 30.0,
         key: Optional[str] = None,
+        src: Optional[str] = None,
+        dst: Optional[str] = None,
+        ch: Optional[int] = None,
+        delay_s: float = 0.05,
+        dup: int = 1,
     ) -> None:
         if mode not in _ALL_MODES:
             raise ValueError(f"unknown fault mode {mode!r}")
@@ -108,6 +157,12 @@ class Rule:
         self.times = times  # None = unlimited
         self.hang_s = float(hang_s)
         self.key = key  # key-type filter for tpu points (None = any)
+        # network filters/knobs (p2p.* points; None = match any)
+        self.src = src
+        self.dst = dst
+        self.ch = int(ch) if ch is not None else None
+        self.delay_s = float(delay_s)
+        self.dup = int(dup)
         self.rng = random.Random(self.seed)
         self.fired = 0  # consults that actually faulted
 
@@ -115,6 +170,23 @@ class Rule:
         if self.point != point:
             return False
         if self.key is not None and key is not None and self.key != key:
+            return False
+        return True
+
+    def _matches_net(
+        self,
+        point: str,
+        src_labels: tuple,
+        dst_labels: tuple,
+        ch: Optional[int],
+    ) -> bool:
+        if self.point != point:
+            return False
+        if self.ch is not None and ch is not None and self.ch != ch:
+            return False
+        if self.src is not None and not _label_match(self.src, src_labels):
+            return False
+        if self.dst is not None and not _label_match(self.dst, dst_labels):
             return False
         return True
 
@@ -136,10 +208,59 @@ class Rule:
         )
 
 
+_HEX_DIGITS = frozenset("0123456789abcdef")
+
+
+def _label_match(member: str, labels: tuple) -> bool:
+    """A spec member names a node if it equals one of the node's net
+    labels exactly, or — ONLY when the member looks like a node-ID
+    prefix (>= 8 lowercase hex chars) — prefixes one. Monikers and
+    hosts match exactly, so "validator1" can never swallow
+    "validator10"; node IDs are 40-char hex and an 8+-char prefix is
+    unambiguous in any real deployment."""
+    id_prefix = len(member) >= 8 and all(c in _HEX_DIGITS for c in member)
+    for label in labels:
+        if member == label:
+            return True
+        if id_prefix and label.startswith(member):
+            return True
+    return False
+
+
+class NetPlan:
+    """The combined verdict of every fired network rule at one consult:
+    what the router should do with this message/dial."""
+
+    __slots__ = ("drop", "delay_s", "dup", "reorder")
+
+    def __init__(self) -> None:
+        self.drop = False
+        self.delay_s = 0.0
+        self.dup = 0  # EXTRA copies to deliver
+        self.reorder = False
+
+    def __repr__(self) -> str:
+        return (
+            f"NetPlan(drop={self.drop} delay_s={self.delay_s} "
+            f"dup={self.dup} reorder={self.reorder})"
+        )
+
+
 _RULES: List[Rule] = []
 _LOCK = threading.Lock()
 _ARMED = False  # mirrors bool(_RULES); read lock-free on hot paths
+_NET_ARMED = False  # p2p rules or a live/file partition; ditto
 _ENV_LOADED = False
+# named partition sets: groups of net-label members; links between
+# members of DIFFERENT groups are cut, everything else flows.
+# tmlive: bounded= replaced wholesale by set_partition (size = the
+# operator's parsed spec), never grown incrementally
+_PARTITION: List[List[str]] = []
+_PARTITION_SPEC = ""
+_PARTITION_FILE: Optional[str] = None
+_PARTITION_FILE_SIG: Optional[tuple] = None  # (mtime_ns, size)
+_PARTITION_NEXT_POLL = 0.0
+_PARTITION_POLL_S = 0.2  # stat() throttle for the file form
 
 
 def armed() -> bool:
@@ -157,14 +278,39 @@ def armed() -> bool:
     return _ARMED
 
 
+def net_armed() -> bool:
+    """Cheap hot-path gate for the p2p fault points: False means no
+    network rule or partition is live and the router/transport run
+    fault-free code only (same contract as armed())."""
+    if not _ENV_LOADED:
+        load_env()
+    return _NET_ARMED
+
+
 def load_env() -> None:
-    """(Re-)parse TM_TPU_FAULT into armed rules. Idempotent per value:
-    clears previously env-loaded rules first (inject() rules survive)."""
-    global _ENV_LOADED
+    """(Re-)parse TM_TPU_FAULT into armed rules (and TM_TPU_PARTITION /
+    TM_TPU_PARTITION_FILE into the partition state). Idempotent per
+    value: clears previously env-loaded rules first (inject() rules
+    survive)."""
+    global _ENV_LOADED, _PARTITION_SPEC, _PARTITION_FILE
+    global _PARTITION_FILE_SIG, _PARTITION_NEXT_POLL
     spec = os.environ.get("TM_TPU_FAULT", "")
     with _LOCK:
         _RULES[:] = [r for r in _RULES if not getattr(r, "_from_env", False)]
         try:
+            # partition env FIRST: a malformed TM_TPU_FAULT must not
+            # strip the partition plane as collateral (an e2e child
+            # whose partition file silently never armed would measure
+            # an un-partitioned net)
+            _PARTITION[:] = _parse_partition(
+                os.environ.get("TM_TPU_PARTITION", "")
+            )
+            _PARTITION_SPEC = os.environ.get("TM_TPU_PARTITION", "")
+            _PARTITION_FILE = (
+                os.environ.get("TM_TPU_PARTITION_FILE") or None
+            )
+            _PARTITION_FILE_SIG = None
+            _PARTITION_NEXT_POLL = 0.0
             parsed = []
             for part in spec.split(";"):
                 part = part.strip()
@@ -186,7 +332,8 @@ def load_env() -> None:
 
 
 def _parse_rule(spec: str) -> Rule:
-    """`point:mode[:p=..][:seed=..][:times=..][:hang_s=..][:key=..]`"""
+    """`point:mode[:p=..][:seed=..][:times=..][:hang_s=..][:key=..]
+    [:src=..][:dst=..][:ch=..][:delay_s=..][:dup=..]`"""
     fields = spec.split(":")
     if len(fields) < 2:
         raise ValueError(f"bad TM_TPU_FAULT rule {spec!r} (want point:mode)")
@@ -205,14 +352,39 @@ def _parse_rule(spec: str) -> Rule:
             kwargs["hang_s"] = float(v)
         elif k == "key":
             kwargs["key"] = v
+        elif k == "src":
+            kwargs["src"] = v
+        elif k == "dst":
+            kwargs["dst"] = v
+        elif k == "ch":
+            kwargs["ch"] = int(v)
+        elif k == "delay_s":
+            kwargs["delay_s"] = float(v)
+        elif k == "dup":
+            kwargs["dup"] = int(v)
         else:
             raise ValueError(f"unknown fault option {k!r} in {spec!r}")
     return Rule(fields[0], fields[1], **kwargs)
 
 
+def _parse_partition(spec: str) -> List[List[str]]:
+    """`"a,b|c,d"` → [[a, b], [c, d]]. Empty spec = no partition."""
+    groups: List[List[str]] = []
+    for part in spec.split("|"):
+        members = [m.strip() for m in part.split(",") if m.strip()]
+        if members:
+            groups.append(members)
+    return groups
+
+
 def _refresh_armed() -> None:
-    global _ARMED
+    global _ARMED, _NET_ARMED
     _ARMED = bool(_RULES)
+    _NET_ARMED = (
+        bool(_PARTITION)
+        or _PARTITION_FILE is not None
+        or any(r.point.startswith("p2p.") for r in _RULES)
+    )
 
 
 @contextlib.contextmanager
@@ -224,11 +396,17 @@ def inject(
     times: Optional[int] = None,
     hang_s: float = 30.0,
     key: Optional[str] = None,
+    src: Optional[str] = None,
+    dst: Optional[str] = None,
+    ch: Optional[int] = None,
+    delay_s: float = 0.05,
+    dup: int = 1,
 ):
     """Arm one rule for the duration of the scope (chaos tests). Yields
     the Rule so the test can assert how often it actually fired."""
     rule = Rule(point, mode, p=p, seed=seed, times=times,
-                hang_s=hang_s, key=key)
+                hang_s=hang_s, key=key, src=src, dst=dst, ch=ch,
+                delay_s=delay_s, dup=dup)
     with _LOCK:
         _RULES.append(rule)
         _refresh_armed()
@@ -244,10 +422,122 @@ def inject(
 
 
 def reset() -> None:
-    """Disarm everything (tests)."""
+    """Disarm everything — rules AND partition state (tests)."""
+    global _PARTITION_SPEC, _PARTITION_FILE, _PARTITION_FILE_SIG
     with _LOCK:
         _RULES.clear()
+        _PARTITION.clear()
+        _PARTITION_SPEC = ""
+        _PARTITION_FILE = None
+        _PARTITION_FILE_SIG = None
         _refresh_armed()
+
+
+def set_partition(spec: str) -> None:
+    """Install (or with "" heal) the named partition sets at runtime —
+    the in-process half of the runtime-mutable contract; process nets
+    mutate via TM_TPU_PARTITION_FILE instead."""
+    global _PARTITION_SPEC
+    if not _ENV_LOADED:
+        # latch the env first or a later lazy load_env() would clobber
+        # the runtime spec with the (stale) env value
+        load_env()
+    groups = _parse_partition(spec)
+    with _LOCK:
+        _PARTITION[:] = groups
+        _PARTITION_SPEC = spec
+        _refresh_armed()
+
+
+def partition_spec() -> str:
+    """The currently installed spec (diagnostics/tests)."""
+    with _LOCK:
+        return _PARTITION_SPEC
+
+
+def _poll_partition_file_locked() -> None:
+    """File form of the runtime-mutable partition: re-read the spec
+    when the file changes, stat()ing at most every _PARTITION_POLL_S.
+    Callers hold _LOCK."""
+    global _PARTITION_FILE_SIG, _PARTITION_NEXT_POLL, _PARTITION_SPEC
+    now = time.monotonic()
+    if now < _PARTITION_NEXT_POLL:
+        return
+    _PARTITION_NEXT_POLL = now + _PARTITION_POLL_S
+    try:
+        st = os.stat(_PARTITION_FILE)
+        sig = (st.st_mtime_ns, st.st_size)
+        if sig == _PARTITION_FILE_SIG:
+            return
+        with open(_PARTITION_FILE, "r") as f:
+            spec = f.read().strip()
+        _PARTITION_FILE_SIG = sig
+    except OSError:
+        # missing/unreadable file = no partition (a scenario that
+        # deletes the file heals the net)
+        _PARTITION_FILE_SIG = None
+        spec = ""
+    _PARTITION[:] = _parse_partition(spec)
+    _PARTITION_SPEC = spec
+
+
+def _group_of(labels: tuple) -> Optional[int]:
+    for i, group in enumerate(_PARTITION):
+        for member in group:
+            if _label_match(member, labels):
+                return i
+    return None
+
+
+def partition_blocked(src_labels: tuple, dst_labels: tuple) -> bool:
+    """True when the live partition cuts the src→dst link: both
+    endpoints are named, in different groups. Callers gate on
+    net_armed()."""
+    with _LOCK:
+        if _PARTITION_FILE is not None:
+            _poll_partition_file_locked()
+        if not _PARTITION:
+            return False
+        a = _group_of(src_labels)
+        if a is None:
+            return False
+        b = _group_of(dst_labels)
+        return b is not None and a != b
+
+
+def net_plan(
+    point: str,
+    src: tuple = (),
+    dst: tuple = (),
+    ch: Optional[int] = None,
+) -> Optional[NetPlan]:
+    """Consult the network rules at a p2p fault point. Returns None
+    when nothing fired (the common armed-but-filtered case), else the
+    combined NetPlan. The plane never sleeps or raises here — the
+    router/transport interpret the plan (delay via asyncio.sleep, so
+    the event loop is never blocked). Each matching rule's seeded RNG
+    advances exactly once per consult, fired or not, so the fault
+    schedule is a pure function of (seed, consult index)."""
+    plan: Optional[NetPlan] = None
+    with _LOCK:
+        for r in _RULES:
+            if r.mode not in _NET_MODES:
+                continue
+            if not r._matches_net(point, src, dst, ch):
+                continue
+            if not r._roll():
+                continue
+            if plan is None:
+                plan = NetPlan()
+            if r.mode == "drop":
+                plan.drop = True
+            elif r.mode == "delay":
+                plan.delay_s = max(plan.delay_s, r.delay_s)
+            elif r.mode == "duplicate":
+                plan.dup += max(r.dup, 0)
+            elif r.mode == "reorder":
+                plan.reorder = True
+    return plan
 
 
 def rules() -> List[Rule]:

@@ -42,7 +42,11 @@ from typing import Dict, List, Tuple
 __all__ = ["Manifest", "NodeSpec", "LoadSpec", "Perturbation"]
 
 MODES = ("validator", "full", "seed")
-PERTURBATIONS = ("kill", "restart", "disconnect", "pause")
+# partition/heal are real p2p-level cuts (crypto/faults.py partition
+# sets via TM_TPU_PARTITION_FILE — every child polls the shared file),
+# unlike `disconnect`'s SIGSTOP approximation: the process keeps
+# running and serving RPC while its links drop everything.
+PERTURBATIONS = ("kill", "restart", "disconnect", "pause", "partition", "heal")
 MISBEHAVIORS = ("double-prevote",)
 
 

@@ -134,6 +134,13 @@ class ProcessRunner:
         self._tx_seq = 0
         self._sent_keys: List[bytes] = []
         self._resume_tasks: List[asyncio.Task] = []
+        # runtime-mutable partition shared with every child via
+        # TM_TPU_PARTITION_FILE (crypto/faults.py polls it): partition
+        # and heal perturbations rewrite this file mid-run. Tracked as
+        # a SET of isolated nodes so partitioning a second node
+        # composes with (instead of silently healing) the first.
+        self._partition_file = os.path.join(home, "partition.spec")
+        self._partitioned: set = set()
 
     # -- setup (reference: setup.go; same genesis/keys as cmd testnet) --
 
@@ -154,6 +161,9 @@ class ProcessRunner:
                 for n, p in sorted(m.validators.items())
             ],
         )
+        os.makedirs(self.home, exist_ok=True)
+        with open(self._partition_file, "w") as f:
+            f.write("")  # no partition at boot
         node_ids: Dict[str, str] = {}
         p2p_port: Dict[str, int] = {}
         for name, spec in self.m.sorted_nodes():
@@ -161,6 +171,11 @@ class ProcessRunner:
             cfg.base.home = os.path.join(self.home, name)
             cfg.base.chain_id = m.chain_id
             cfg.base.mode = spec.mode
+            # the moniker is the node's net-fault-plane label — what a
+            # partition.spec member names (TCP hosts are all 127.0.0.1
+            # here, so only the moniker/node-ID labels can tell the
+            # children apart)
+            cfg.base.moniker = name
             # stores must survive SIGKILL: force the on-disk backend
             cfg.base.db_backend = "sqlite"
             cfg.base.abci = "socket"
@@ -221,12 +236,17 @@ class ProcessRunner:
 
     def _spawn_node(self, h: _ProcHandle) -> None:
         log = open(os.path.join(h.cfg.base.home, "node.log"), "ab")
+        env = _child_env()
+        # arm the (initially empty) runtime-mutable partition plane in
+        # every node child — partition/heal perturbations mutate the
+        # shared file and the children re-read it on change
+        env["TM_TPU_PARTITION_FILE"] = self._partition_file
         h.node_proc = subprocess.Popen(
             [
                 sys.executable, "-m", "tendermint_tpu.cmd",
                 "--home", h.cfg.base.home, "start",
             ],
-            stdout=log, stderr=subprocess.STDOUT, env=_child_env(),
+            stdout=log, stderr=subprocess.STDOUT, env=env,
         )
         log.close()
         h.paused = False
@@ -339,6 +359,16 @@ class ProcessRunner:
                     h.node_proc.kill()
                     h.node_proc.wait()
             await self._start_node(name)
+        elif action == "partition":
+            # cut the node from everyone else at the p2p fault plane:
+            # its process keeps running and answering RPC, its links
+            # drop every frame (unlike `disconnect`'s SIGSTOP
+            # approximation, which also freezes RPC)
+            self._partitioned.add(name)
+            self._write_partition_spec()
+        elif action == "heal":
+            self._partitioned.discard(name)
+            self._write_partition_spec()
         elif action in ("pause", "disconnect"):
             if h.node_proc.poll() is None:
                 h.node_proc.send_signal(signal.SIGSTOP)
@@ -355,6 +385,19 @@ class ProcessRunner:
                         resume(3.0 if action == "pause" else 8.0)
                     )
                 )
+
+    def _write_partition_spec(self) -> None:
+        """Render the isolated-node set as partition groups: each
+        isolated node is its OWN group (cut from each other too), the
+        remainder one connected group. Empty set = healed net."""
+        isolated = sorted(self._partitioned)
+        rest = [n for n in self.handles if n not in self._partitioned]
+        groups = [[n] for n in isolated]
+        if isolated and rest:
+            groups.append(rest)
+        spec = "|".join(",".join(g) for g in groups) if isolated else ""
+        with open(self._partition_file, "w") as f:
+            f.write(spec)
 
     # -- orchestration --
 

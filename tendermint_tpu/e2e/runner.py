@@ -105,6 +105,9 @@ class Runner:
         self._node_ids: Dict[str, str] = {}
         self._tx_seq = 0
         self._resume_tasks: List[asyncio.Task] = []
+        # nodes currently isolated by the partition perturbation
+        # (composable: each isolated node is its own group)
+        self._partitioned: set = set()
         self.report = RunReport()
 
     # -- setup (reference: test/e2e/runner/setup.go) --
@@ -300,6 +303,19 @@ class Runner:
                 router = h.node.router
                 for pid in list(router._peer_conns):
                     router._peer_down(pid)
+        elif action == "partition":
+            # real p2p-level cut via the runtime-mutable partition
+            # sets (crypto/faults.py): the node keeps running and
+            # serving RPC while every link to the rest drops frames.
+            # Tracked as a SET of isolated nodes (same shape as the
+            # process runner's partition.spec writer) so a second
+            # partition composes with — instead of silently healing —
+            # the first, and heal releases only ITS node.
+            self._partitioned.add(name)
+            self._set_partition_groups()
+        elif action == "heal":
+            self._partitioned.discard(name)
+            self._set_partition_groups()
         elif action == "pause":
             if h.live:
                 await h.node.stop()
@@ -313,6 +329,24 @@ class Runner:
                 self._resume_tasks.append(
                     asyncio.get_running_loop().create_task(resume())
                 )
+
+    def _set_partition_groups(self) -> None:
+        """Render the isolated-node set: each isolated node its OWN
+        group (cut from each other too), the remainder one connected
+        group; empty set heals. Labels are node IDs."""
+        from ..crypto import faults
+
+        def nid(name):
+            return self.handles[name].node.node_info.node_id
+
+        isolated = sorted(self._partitioned)
+        rest = [n for n in self.handles if n not in self._partitioned]
+        groups = [[nid(n)] for n in isolated]
+        if isolated and rest:
+            groups.append([nid(n) for n in rest])
+        faults.set_partition(
+            "|".join(",".join(g) for g in groups) if isolated else ""
+        )
 
     def _run_post_start(self, name: str) -> None:
         hook = getattr(self, "_post_start", {}).get(name)

@@ -24,7 +24,10 @@ from __future__ import annotations
 import random
 from typing import Optional, Sequence, TypeVar
 
-__all__ = ["derive", "gossip", "reseed", "choice", "shuffle", "randbelow"]
+__all__ = [
+    "derive", "gossip", "reseed", "subseed",
+    "choice", "shuffle", "randbelow",
+]
 
 T = TypeVar("T")
 
@@ -38,6 +41,18 @@ def derive(seed: int, label: str) -> random.Random:
     property schedulefuzz gets from Schedule.subseed. Does not touch
     the shared gossip RNG."""
     return random.Random(f"{seed}/{label}")
+
+
+def subseed(seed: int, label: str) -> int:
+    """A deterministic child SEED for (seed, label) — for consumers
+    that need an integer seed to hand a sibling source of seeded
+    randomness (a crypto.faults rule, a chaos scenario), where
+    derive()'s ready-made stream doesn't fit. One definition shared by
+    Schedule.subseed and the chaos campaign so 'the same seed replays
+    the same schedule' means the same thing on both planes."""
+    import zlib
+
+    return (int(seed) << 16) ^ zlib.crc32(label.encode())
 
 
 def gossip() -> random.Random:

@@ -79,9 +79,9 @@ class Schedule:
         combined exploration still reproduces from the one seed the
         failure message names — and independent of how much of THIS
         schedule's rng was consumed before the sibling was armed."""
-        import zlib
+        from . import rng
 
-        return (self.seed << 16) ^ zlib.crc32(label.encode())
+        return rng.subseed(self.seed, label)
 
     def seed_gossip(self) -> None:
         """Pin the process-wide gossip RNG (libs/rng.py — part/vote

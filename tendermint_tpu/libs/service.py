@@ -84,8 +84,13 @@ class Service:
             # survives one cancel and its retry loop runs forever, so a
             # single cancel+gather would hang stop(). Re-cancel until
             # every task actually finishes.
-            _done, pending_set = await asyncio.wait(pending, timeout=1.0)
-            pending = list(pending_set)
+            await asyncio.wait(pending, timeout=1.0)
+            # re-derive from _tasks, not the wait() leftovers: a task
+            # that slipped through an await completing during this
+            # sweep can spawn NEW tasks (e.g. an accept finishing its
+            # handshake mid-stop) — the final gather below must never
+            # wait on a task nothing cancelled
+            pending = [t for t in self._tasks if not t.done()]
         # return_exceptions keeps a cancellation of stop() itself
         # propagating while swallowing the tasks' own CancelledErrors
         # (and retrieving real exceptions so none log as unretrieved).

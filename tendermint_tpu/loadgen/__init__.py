@@ -15,6 +15,11 @@ Layout:
     scenario.py  the declarative workload spec (rate, mix, duration,
                  ramp, subscriber count) — one seed reproduces one run
     localnet.py  in-process multi-validator net with live RPC listeners
+    chaos.py     the chaos campaign runner (ISSUE 13): staged seeded
+                 network-fault scenarios (partitions, asymmetric loss,
+                 latency, crash-restarts, churn) under open-loop
+                 traffic, with machine-checked safety + recovery
+                 verdicts — BENCH_CHAOS.json is its trajectory
     driver.py    open-loop (fixed/Poisson arrival, latency from the
                  *intended* send time) and closed-loop drivers, the
                  HTTP client pool, and the websocket subscriber pool
@@ -24,6 +29,12 @@ Layout:
     run.py       orchestration: run_scenario / run_localnet_scenario
 """
 
+from .chaos import (  # noqa: F401
+    ChaosScenario,
+    run_campaign,
+    run_chaos_scenario,
+    shipped_scenarios,
+)
 from .driver import ClientPool, RouteStats, SubscriberPool  # noqa: F401
 from .localnet import Localnet, start_localnet  # noqa: F401
 from .report import build_report  # noqa: F401
@@ -33,6 +44,7 @@ from .scrape import Scraper  # noqa: F401
 
 __all__ = [
     "OPS",
+    "ChaosScenario",
     "ClientPool",
     "Localnet",
     "RouteStats",
@@ -40,7 +52,10 @@ __all__ = [
     "Scraper",
     "SubscriberPool",
     "build_report",
+    "run_campaign",
+    "run_chaos_scenario",
     "run_localnet_scenario",
     "run_scenario",
+    "shipped_scenarios",
     "start_localnet",
 ]

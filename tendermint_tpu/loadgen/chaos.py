@@ -1,0 +1,421 @@
+"""Chaos campaign runner — staged network faults under production load,
+with machine-checked safety and recovery verdicts.
+
+ISSUE 13: PR 12 built the traffic gun; this module points it at a net
+that is actively being partitioned, delayed and crash-restarted. Each
+`ChaosScenario` boots a FRESH in-process localnet (loadgen/localnet.py
+— real RPC listeners, per-node registries), drives seeded open-loop
+traffic at it for the whole run, and walks one fault arc:
+
+    baseline → arm faults → hold → heal → measure recovery
+
+and then renders two verdicts, both machine-checked:
+
+* **safety** — at every height the nodes have in common, the stored
+  block-ID hashes are byte-identical across ALL nodes (read straight
+  from each node's block store, not over RPC). ANY divergence fails
+  the scenario: "tolerates up to 1/3 Byzantine voting power" means the
+  chain may stall under a partition, but two correct nodes must never
+  commit different blocks at the same height.
+* **recovery** — after the heal instant, the SLOWEST node commits a
+  block past the heal-time network height within the scenario's SLO;
+  the time-to-first-commit-after-heal is recorded either way.
+
+Reproducibility rides the PR-3 fault-plane contract: every per-message
+rule owns a `random.Random(seed)` derived from the campaign seed
+(`crypto/faults.py` — whether consult k fires is a pure function of
+(seed, k)), partitions are deterministic set specs, and the traffic
+arrival schedule is the seeded tmload open-loop schedule. Re-running a
+scenario with the same seed re-arms the identical fault schedule.
+
+bench.py's `chaos_smoke` row runs the shipped catalog in the banked
+jax-free CPU block and persists the full trajectory as
+BENCH_CHAOS.json. docs/resilience.md documents the scenario catalog
+and the SLO policy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..crypto import faults
+from ..libs.rng import subseed as _subseed
+from .driver import ClientPool, run_open_loop
+from .localnet import Localnet, start_localnet
+from .scenario import Scenario
+from .scrape import parse_exposition
+
+__all__ = [
+    "ChaosScenario",
+    "run_campaign",
+    "run_chaos_scenario",
+    "shipped_scenarios",
+]
+
+
+@dataclass
+class ChaosScenario:
+    """One staged fault arc. `kind` picks the arm/heal machinery:
+
+    partition  spec={"isolate": [node indexes]} — the named minority
+               (or half) is cut from the rest via TM_TPU_PARTITION-
+               style sets for `fault_s`, then healed
+    rules      spec={"rules": [ {point, mode, p, src, dst, ch,
+               delay_s, dup} ]} — seeded per-message rules (asymmetric
+               loss, latency) armed for `fault_s`; src/dst name node
+               monikers (load0, load1, ...)
+    crash      spec={"victims": [indexes], "gap_s": s} — rolling
+               crash-restarts (sqlite stores survive, like a SIGKILL'd
+               process); heal = the last victim back up
+    flap       spec={"victims": [indexes], "hold_s": s} — churn: each
+               victim is isolated for hold_s then healed, in turn
+    """
+
+    name: str
+    kind: str
+    fault_s: float = 3.0
+    recovery_slo_s: float = 15.0
+    baseline_s: float = 2.0
+    spec: dict = field(default_factory=dict)
+
+    def db_backend(self) -> str:
+        # crash-restarts need stores that survive the node instance
+        return "sqlite" if self.kind == "crash" else "memdb"
+
+
+def shipped_scenarios() -> List[ChaosScenario]:
+    """The shipped catalog (4-node nets; docs/resilience.md): minority
+    and majority partitions with heal, asymmetric link loss on the
+    vote channel, high-latency links, rolling crash-restarts, and
+    partition churn."""
+    vote_ch = 0x22  # consensus VOTE_CHANNEL
+    return [
+        ChaosScenario(
+            name="minority_partition",
+            kind="partition",
+            spec={"isolate": [3]},
+            fault_s=3.0,
+            recovery_slo_s=15.0,
+        ),
+        ChaosScenario(
+            name="majority_partition",
+            kind="partition",
+            # 2|2: NEITHER side holds 2/3 — the whole chain must stall
+            # (safety) and resume after heal (recovery)
+            spec={"isolate": [0, 1]},
+            fault_s=3.0,
+            recovery_slo_s=20.0,
+        ),
+        ChaosScenario(
+            name="asym_link_loss",
+            kind="rules",
+            spec={
+                "rules": [
+                    # one DIRECTION of one link loses 60% of votes —
+                    # the asymmetric case a symmetric partition model
+                    # cannot express
+                    {
+                        "point": "p2p.send",
+                        "mode": "drop",
+                        "p": 0.6,
+                        "src": "load0",
+                        "dst": "load1",
+                        "ch": vote_ch,
+                    },
+                    {
+                        "point": "p2p.recv",
+                        "mode": "drop",
+                        "p": 0.4,
+                        "src": "load2",
+                        "dst": "load3",
+                    },
+                ]
+            },
+            fault_s=4.0,
+            recovery_slo_s=15.0,
+        ),
+        ChaosScenario(
+            name="high_latency",
+            kind="rules",
+            spec={
+                "rules": [
+                    {
+                        "point": "p2p.send",
+                        "mode": "delay",
+                        "p": 0.5,
+                        "delay_s": 0.05,
+                    },
+                    {
+                        "point": "p2p.recv",
+                        "mode": "delay",
+                        "p": 0.3,
+                        "delay_s": 0.05,
+                    },
+                    # gossip echo + adjacent swaps ride along
+                    {"point": "p2p.recv", "mode": "duplicate", "p": 0.2},
+                    {"point": "p2p.send", "mode": "reorder", "p": 0.2},
+                ]
+            },
+            fault_s=4.0,
+            recovery_slo_s=15.0,
+        ),
+        ChaosScenario(
+            name="rolling_crash",
+            kind="crash",
+            spec={"victims": [1, 2], "gap_s": 1.0},
+            fault_s=0.0,  # the restarts ARE the fault stage
+            recovery_slo_s=30.0,
+        ),
+        ChaosScenario(
+            name="churn",
+            kind="flap",
+            spec={"victims": [1, 2, 3], "hold_s": 0.8},
+            fault_s=0.0,  # the flap loop is the fault stage
+            recovery_slo_s=15.0,
+        ),
+    ]
+
+
+def _partition_spec(ln: Localnet, isolate: Sequence[int]) -> str:
+    monikers = ln.monikers()
+    a = [monikers[i] for i in isolate]
+    b = [m for i, m in enumerate(monikers) if i not in set(isolate)]
+    return ",".join(a) + "|" + ",".join(b)
+
+
+def _heights(ln: Localnet) -> List[int]:
+    return [n.block_store.height() for n in ln.nodes]
+
+
+async def _wait_heights_above(
+    ln: Localnet, floor: int, timeout_s: float
+) -> Optional[float]:
+    """Poll until EVERY node's stored height exceeds `floor`; returns
+    the wall seconds it took, or None on timeout."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    while time.monotonic() < deadline:
+        if min(_heights(ln)) > floor:
+            return time.monotonic() - t0
+        await asyncio.sleep(0.1)
+    return None
+
+
+def _safety_check(ln: Localnet) -> Dict:
+    """Byte-identical stored block-ID hashes at every common height.
+    Divergence = a fork between correct nodes = hard fail."""
+    heights = _heights(ln)
+    common = min(heights)
+    divergences: List[Dict] = []
+    for h in range(1, common + 1):
+        hashes = []
+        for n in ln.nodes:
+            meta = n.block_store.load_block_meta(h)
+            hashes.append(meta.block_id.hash if meta is not None else None)
+        ref = hashes[0]
+        if any(x != ref for x in hashes[1:]):
+            divergences.append(
+                {
+                    "height": h,
+                    "hashes": [
+                        x.hex() if x is not None else None for x in hashes
+                    ],
+                }
+            )
+    return {
+        "safety_ok": not divergences and common >= 1,
+        "heights_checked": common,
+        "node_heights": heights,
+        "divergences": divergences,
+    }
+
+
+def _p2p_counters(ln: Localnet, prefix: str) -> Dict[str, float]:
+    """Sum a labeled p2p counter family across nodes, keyed by its
+    label suffix — the lifecycle evidence in each scenario row."""
+    out: Dict[str, float] = {}
+    for n in ln.nodes:
+        parsed = parse_exposition(n._render_metrics())
+        for k, v in parsed.items():
+            if k.startswith(prefix):
+                label = k[len(prefix):].strip("{}")
+                out[label] = out.get(label, 0.0) + v
+    return out
+
+
+async def _arm_and_heal(cs: ChaosScenario, ln: Localnet, seed: int):
+    """Run the scenario's fault stage; returns when the net is healed.
+    (The caller stamps the heal instant immediately after.)"""
+    if cs.kind == "partition":
+        faults.set_partition(_partition_spec(ln, cs.spec["isolate"]))
+        try:
+            await asyncio.sleep(cs.fault_s)
+        finally:
+            faults.set_partition("")
+    elif cs.kind == "rules":
+        with contextlib.ExitStack() as stack:
+            for i, r in enumerate(cs.spec["rules"]):
+                stack.enter_context(
+                    faults.inject(
+                        r["point"],
+                        r["mode"],
+                        p=r.get("p", 1.0),
+                        seed=_subseed(seed, f"{cs.name}-rule{i}"),
+                        src=r.get("src"),
+                        dst=r.get("dst"),
+                        ch=r.get("ch"),
+                        delay_s=r.get("delay_s", 0.05),
+                        dup=r.get("dup", 1),
+                    )
+                )
+            await asyncio.sleep(cs.fault_s)
+    elif cs.kind == "crash":
+        for idx in cs.spec["victims"]:
+            await ln.restart(idx)
+            await asyncio.sleep(cs.spec.get("gap_s", 1.0))
+    elif cs.kind == "flap":
+        for idx in cs.spec["victims"]:
+            faults.set_partition(_partition_spec(ln, [idx]))
+            try:
+                await asyncio.sleep(cs.spec.get("hold_s", 0.8))
+            finally:
+                faults.set_partition("")
+            await asyncio.sleep(0.3)
+    else:
+        raise ValueError(f"unknown chaos kind {cs.kind!r}")
+
+
+async def run_chaos_scenario(
+    cs: ChaosScenario,
+    home: str,
+    n_nodes: int = 4,
+    seed: int = 2026,
+    rate: float = 50.0,
+) -> dict:
+    """Boot a fresh localnet, run the scenario arc under open-loop
+    traffic, tear down, return the verdict row."""
+    scenario_seed = _subseed(seed, cs.name)
+    ln = await start_localnet(
+        n_nodes,
+        os.path.join(home, cs.name),
+        chain_id=f"chaos-{cs.name}",
+        seed=scenario_seed,
+        db_backend=cs.db_backend(),
+    )
+    traffic: Optional[asyncio.Future] = None
+    pools: List[ClientPool] = []
+    try:
+        # traffic covers baseline + fault + the early recovery window;
+        # the verdict never waits for it longer than that
+        duration = cs.baseline_s + cs.fault_s + 6.0
+        scn = Scenario(
+            seed=scenario_seed,
+            mode="open",
+            duration_s=duration,
+            rate=rate,
+            ramp_s=0.5,
+            subscribers=0,
+            max_inflight=32,
+            timeout_s=3.0,
+            mix=(("broadcast_tx_async", 3.0), ("status", 1.0)),
+        ).validate()
+        per_pool = max(1, scn.max_inflight // len(ln.rpc_addrs))
+        pools = [
+            ClientPool(a, size=per_pool, timeout_s=scn.timeout_s)
+            for a in ln.rpc_addrs
+        ]
+        traffic = asyncio.ensure_future(run_open_loop(scn, pools))
+
+        # baseline: the chain must be committing before we break it
+        base_ok = await _wait_heights_above(
+            ln, min(_heights(ln)), timeout_s=20.0
+        )
+        await asyncio.sleep(cs.baseline_s)
+
+        await _arm_and_heal(cs, ln, seed)
+        heal_height = max(_heights(ln))
+
+        ttfc = await _wait_heights_above(
+            ln, heal_height, timeout_s=cs.recovery_slo_s * 2 + 5.0
+        )
+        recovered = ttfc is not None and ttfc <= cs.recovery_slo_s
+
+        stats, scheduled = await traffic
+        traffic = None
+        safety = _safety_check(ln)
+        row = {
+            "name": cs.name,
+            "kind": cs.kind,
+            "seed": scenario_seed,
+            "fault_s": cs.fault_s,
+            "recovery_slo_s": cs.recovery_slo_s,
+            "baseline_commit_ok": base_ok is not None,
+            "heal_height": heal_height,
+            "ttfc_after_heal_s": (
+                round(ttfc, 3) if ttfc is not None else None
+            ),
+            "recovered_within_slo": recovered,
+            **safety,
+            "requests_total": sum(st.count for st in stats.values()),
+            "request_errors": sum(st.errors for st in stats.values()),
+            "request_timeouts": sum(
+                st.timeouts for st in stats.values()
+            ),
+            "scheduled_arrivals": scheduled,
+            "p2p_disconnects": _p2p_counters(
+                ln, "tendermint_tpu_p2p_peer_disconnects_total"
+            ),
+            "net_faults_applied": _p2p_counters(
+                ln, "tendermint_tpu_p2p_net_faults_total"
+            ),
+            "passed": bool(
+                safety["safety_ok"]
+                and base_ok is not None
+                and recovered
+            ),
+        }
+        return row
+    finally:
+        # the plane must be disarmed before teardown even when a stage
+        # raised mid-arc — a leaked partition would wedge the NEXT
+        # scenario's boot
+        faults.set_partition("")
+        if traffic is not None:
+            traffic.cancel()
+            await asyncio.gather(traffic, return_exceptions=True)
+        for p in pools:
+            await p.close()
+        await ln.stop()
+
+
+async def run_campaign(
+    home: str,
+    scenarios: Optional[Sequence[ChaosScenario]] = None,
+    n_nodes: int = 4,
+    seed: int = 2026,
+    rate: float = 50.0,
+) -> dict:
+    """Run the catalog; returns the BENCH_CHAOS.json document."""
+    scenarios = (
+        list(scenarios) if scenarios is not None else shipped_scenarios()
+    )
+    rows = []
+    for cs in scenarios:
+        rows.append(
+            await run_chaos_scenario(
+                cs, home, n_nodes=n_nodes, seed=seed, rate=rate
+            )
+        )
+    return {
+        "schema": "bench_chaos/v1",
+        "seed": seed,
+        "nodes": n_nodes,
+        "offered_rate_per_s": rate,
+        "scenarios": rows,
+        "all_passed": all(r["passed"] for r in rows),
+    }

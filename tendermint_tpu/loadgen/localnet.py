@@ -33,12 +33,19 @@ __all__ = ["Localnet", "start_localnet"]
 class Localnet:
     nodes: List[object]
     chain_id: str
+    cfgs: List[Config]
+    net: MemoryNetwork
 
     @property
     def rpc_addrs(self) -> List[str]:
         return [
             f"127.0.0.1:{n.rpc_server.bound_port}" for n in self.nodes
         ]
+
+    def monikers(self) -> List[str]:
+        """The nodes' net-fault-plane labels — what TM_TPU_PARTITION
+        members name (loadgen nodes are load0, load1, ...)."""
+        return [c.base.moniker for c in self.cfgs]
 
     async def wait_for_height(self, height: int, timeout: float = 60.0):
         await asyncio.gather(
@@ -47,6 +54,30 @@ class Localnet:
                 for n in self.nodes
             )
         )
+
+    async def restart(self, i: int, start_timeout: float = 60.0):
+        """Crash-restart node i in place: tear the old instance down,
+        boot a fresh Node from the same home + a fresh memory
+        transport. With the default memdb backend the reborn node has
+        EMPTY stores (crash with disk loss — it must blocksync-catch-up
+        from its peers); with db_backend="sqlite" its stores survive
+        like a real SIGKILL'd process. Returns the new node once
+        started (NOT once caught up — that is the scenario's recovery
+        measurement)."""
+        cfg = self.cfgs[i]
+        try:
+            await self.nodes[i].stop()
+        except Exception:
+            pass  # a crashed node crashes; the restart is the point
+        node = make_node(
+            cfg,
+            transport=MemoryTransport(self.net, cfg.p2p.laddr),
+        )
+        await asyncio.wait_for(node.start(), timeout=start_timeout)
+        # tmlive: bounded= in-place replacement of slot i — the list
+        # stays exactly n_nodes long for the Localnet's lifetime
+        self.nodes[i] = node
+        return node
 
     async def stop(self) -> None:
         for n in self.nodes:
@@ -62,6 +93,9 @@ async def start_localnet(
     trace_spans: bool = False,
     slo_exemplars: bool = False,
     genesis_time_ns: Optional[int] = None,
+    db_backend: str = "memdb",
+    ping_interval: float = 30.0,
+    pong_timeout: float = 15.0,
 ) -> Localnet:
     """Boot an N-validator in-process net and wait for height 1 on
     every node (traffic against a chain that hasn't committed yet
@@ -92,7 +126,10 @@ async def start_localnet(
         cfg = Config()
         cfg.base.home = os.path.join(home, f"load{i}")
         cfg.base.chain_id = chain_id
-        cfg.base.db_backend = "memdb"
+        # the moniker is the node's net-fault-plane label: what
+        # TM_TPU_PARTITION members and p2p rule src=/dst= filters name
+        cfg.base.moniker = f"load{i}"
+        cfg.base.db_backend = db_backend
         cfg.tpu.enable = False  # the jax-free guarantee (module doc)
         cfg.consensus.timeout_propose = 2.0
         cfg.consensus.timeout_prevote = 1.0
@@ -101,6 +138,13 @@ async def start_localnet(
         cfg.consensus.peer_gossip_sleep_duration = 0.01
         cfg.rpc.laddr = "tcp://127.0.0.1:0"
         cfg.p2p.laddr = f"load{i}:26656"
+        # snappy self-healing for an in-process net: boot dials race
+        # node startup and chaos scenarios measure recovery in seconds
+        # — a 20 s persistent-peer backoff cap would dominate both
+        cfg.p2p.min_retry_time = 0.1
+        cfg.p2p.max_retry_time_persistent = 2.0
+        cfg.p2p.ping_interval = ping_interval
+        cfg.p2p.pong_timeout = pong_timeout
         cfg.instrumentation.trace_spans = trace_spans
         cfg.instrumentation.slo_exemplars = slo_exemplars
         cfg.ensure_dirs()
@@ -134,7 +178,9 @@ async def start_localnet(
         for n in nodes:
             await n.start()
             started.append(n)
-        ln = Localnet(nodes=nodes, chain_id=chain_id)
+        ln = Localnet(
+            nodes=nodes, chain_id=chain_id, cfgs=cfgs, net=net
+        )
         # consensus height 2 = block 1 committed and stored everywhere
         # (height 1 is where consensus STARTS — waiting for it returns
         # immediately and load would then measure boot, not serving)

@@ -70,6 +70,10 @@ _DELTA_COUNTERS = (
     "eventbus_dropped_subscriptions_total",
     "rpc_ws_slow_clients_dropped_total",
     "mempool_failed_txs_total",
+    # the chaos plane's lifecycle signals (labeled children fold)
+    "p2p_peer_disconnects_total",
+    "p2p_send_queue_dropped_total",
+    "p2p_net_faults_total",
 )
 
 

@@ -261,13 +261,20 @@ class Node(Service):
             for p in cfg.p2p.persistent_peers.split(",")
             if p.strip()
         ]
+        p2p_metrics = P2PMetrics(self.metrics_registry)
         self.peer_manager = PeerManager(
             self.node_key.node_id,
             PeerManagerOptions(
                 persistent_peers=persistent,
                 max_connected=cfg.p2p.max_connections,
+                min_retry_time=cfg.p2p.min_retry_time,
+                max_retry_time=cfg.p2p.max_retry_time,
+                max_retry_time_persistent=(
+                    cfg.p2p.max_retry_time_persistent
+                ),
             ),
             store=_db("peerstore"),
+            metrics=p2p_metrics,
         )
         for addr in (
             a.strip() for a in cfg.p2p.bootstrap_peers.split(",")
@@ -286,11 +293,18 @@ class Node(Service):
                 dial_timeout=cfg.p2p.dial_timeout,
                 send_rate=cfg.p2p.send_rate,
                 recv_rate=cfg.p2p.recv_rate,
+                ping_interval=cfg.p2p.ping_interval,
+                pong_timeout=cfg.p2p.pong_timeout,
                 max_incoming_per_ip=(
                     cfg.p2p.max_incoming_connection_attempts
                 ),
+                slow_peer_drop_threshold=(
+                    cfg.p2p.slow_peer_drop_threshold
+                ),
+                slow_peer_window_s=cfg.p2p.slow_peer_window,
+                slow_peer_ban_s=cfg.p2p.slow_peer_ban,
             ),
-            metrics=P2PMetrics(self.metrics_registry),
+            metrics=p2p_metrics,
         )
 
         # reactors are built in on_start, after the ABCI handshake

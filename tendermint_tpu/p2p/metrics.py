@@ -12,6 +12,12 @@ from ..libs.metrics import DEFAULT_REGISTRY, Registry
 __all__ = ["P2PMetrics"]
 
 
+# dial-backoff delays span "retry immediately" to the 10-minute cap
+_BACKOFF_BUCKETS = (
+    0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 60.0, 180.0, 600.0
+)
+
+
 class P2PMetrics:
     def __init__(self, registry: Optional[Registry] = None) -> None:
         r = registry if registry is not None else DEFAULT_REGISTRY
@@ -27,4 +33,32 @@ class P2PMetrics:
             "message_receive_bytes_total",
             "Bytes received, by channel.",
             label_names=("ch",),
+        )
+        # -- self-healing lifecycle (ISSUE 13) --
+        # reason values come from the router's FIXED vocabulary
+        # (router._PEER_REASONS; remote-reported reasons are sanitized
+        # against it before becoming labels), never from the wire
+        self.peer_disconnects = r.counter(
+            "p2p",
+            "peer_disconnects_total",
+            "Peer disconnects, by reason (remote/* = peer-reported).",
+            label_names=("reason",),
+        )
+        self.dial_backoff = r.histogram(
+            "p2p",
+            "dial_backoff_seconds",
+            "Backoff delay scheduled after each failed dial.",
+            buckets=_BACKOFF_BUCKETS,
+        )
+        self.send_queue_dropped = r.counter(
+            "p2p",
+            "send_queue_dropped_total",
+            "Outbound messages shed by full per-peer channel queues.",
+            label_names=("ch",),
+        )
+        self.net_faults = r.counter(
+            "p2p",
+            "net_faults_total",
+            "Injected network faults applied (chaos runs only).",
+            label_names=("point", "mode"),
         )

@@ -10,8 +10,10 @@ import asyncio
 from typing import Dict, List, Optional
 
 from ..crypto.ed25519 import PrivKeyEd25519
+from ..libs.metrics import Registry
+from .metrics import P2PMetrics
 from .peermanager import PeerManager, PeerManagerOptions
-from .router import Router
+from .router import Router, RouterOptions
 from .transport import MemoryNetwork, MemoryTransport
 from .types import ChannelDescriptor, NodeInfo, node_id_from_pubkey
 
@@ -21,7 +23,13 @@ __all__ = ["TestNetwork", "TestNode"]
 class TestNode:
     __test__ = False  # not a pytest class
 
-    def __init__(self, network: MemoryNetwork, index: int, chain_id: str) -> None:
+    def __init__(
+        self,
+        network: MemoryNetwork,
+        index: int,
+        chain_id: str,
+        router_options: Optional[RouterOptions] = None,
+    ) -> None:
         self.priv_key = PrivKeyEd25519.from_seed(
             index.to_bytes(2, "big") * 16
         )
@@ -34,11 +42,22 @@ class TestNode:
             moniker=f"node{index}",
         )
         self.transport = MemoryTransport(network, self.addr)
+        # per-node registry so multi-node tests scrape disjoint series
+        # (the same shape node assembly wires)
+        self.registry = Registry()
+        self.metrics = P2PMetrics(self.registry)
         self.peer_manager = PeerManager(
-            self.node_id, PeerManagerOptions(max_connected=64)
+            self.node_id,
+            PeerManagerOptions(max_connected=64),
+            metrics=self.metrics,
         )
         self.router = Router(
-            self.node_info, self.priv_key, self.peer_manager, self.transport
+            self.node_info,
+            self.priv_key,
+            self.peer_manager,
+            self.transport,
+            options=router_options,
+            metrics=self.metrics,
         )
 
     def open_channel(self, descriptor: ChannelDescriptor):
@@ -50,9 +69,19 @@ class TestNetwork:
 
     __test__ = False  # not a pytest class
 
-    def __init__(self, n: int, chain_id: str = "test-chain") -> None:
+    def __init__(
+        self,
+        n: int,
+        chain_id: str = "test-chain",
+        router_options: Optional[RouterOptions] = None,
+    ) -> None:
         self.memory = MemoryNetwork()
-        self.nodes = [TestNode(self.memory, i, chain_id) for i in range(n)]
+        self.nodes = [
+            TestNode(
+                self.memory, i, chain_id, router_options=router_options
+            )
+            for i in range(n)
+        ]
 
     async def start(self) -> None:
         for node in self.nodes:

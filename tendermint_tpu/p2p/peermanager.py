@@ -59,10 +59,29 @@ class PeerManagerOptions:
     max_connected: int = 16
     max_connected_upgrade: int = 4
     max_peers: int = 1000
-    min_retry_time: float = 0.5
+    min_retry_time: float = 0.25
     max_retry_time: float = 600.0
     max_retry_time_persistent: float = 20.0
-    retry_time_jitter: float = 0.1
+
+
+def backoff_delay(
+    attempts: int, opts: PeerManagerOptions, persistent: bool, rng=random
+) -> float:
+    """Jittered capped exponential dial backoff: base · 2^(n−1) capped,
+    then FULL jitter over [d/2, d] — concurrent boots (every localnet
+    node dialing every other) decorrelate instead of retrying in
+    lockstep, and the expected delay halves versus the old
+    +10%-jitter schedule, which is what fixed the
+    occasionally-tens-of-seconds in-process localnet boot (PR 12
+    note). Computed ONCE per failure (stored as retry_at), so the
+    schedule a failure message names is the schedule that ran."""
+    if attempts <= 0:
+        return 0.0
+    cap = (
+        opts.max_retry_time_persistent if persistent else opts.max_retry_time
+    )
+    delay = min(opts.min_retry_time * (2 ** min(attempts - 1, 16)), cap)
+    return delay * (0.5 + 0.5 * rng.random())
 
 
 @dataclass
@@ -72,25 +91,17 @@ class _Peer:
     persistent: bool = False
     dial_attempts: int = 0
     last_dial_failure: float = 0.0
+    retry_at: float = 0.0  # next dial not before this instant
+    retry_delay_s: float = 0.0  # the delay behind retry_at (metrics)
+    banned_until: float = 0.0  # shed/misbehaving peers sit out a window
     dialing: bool = False
     connected: bool = False
     ready: bool = False
     inbound: bool = False
     evicting: bool = False
+    evict_reason: str = ""
     score: int = 0
     connected_at: float = 0.0
-
-    def retry_delay(self, opts: PeerManagerOptions) -> float:
-        if self.dial_attempts == 0:
-            return 0.0
-        cap = (
-            opts.max_retry_time_persistent
-            if self.persistent
-            else opts.max_retry_time
-        )
-        delay = opts.min_retry_time * (2 ** min(self.dial_attempts - 1, 16))
-        delay = min(delay, cap)
-        return delay * (1 + random.random() * opts.retry_time_jitter)
 
 
 class PeerManager:
@@ -99,9 +110,13 @@ class PeerManager:
         self_id: NodeID,
         options: Optional[PeerManagerOptions] = None,
         store=None,  # optional KVStore for address-book persistence
+        metrics=None,  # optional P2PMetrics (dial-backoff histogram)
+        clock=time.monotonic,  # injectable for backoff-schedule tests
     ) -> None:
         self.self_id = self_id
         self.opts = options or PeerManagerOptions()
+        self.metrics = metrics
+        self._clock = clock
         self.logger = get_logger("p2p.peermanager")
         self._peers: Dict[NodeID, _Peer] = {}
         self._subscribers: List[asyncio.Queue] = []
@@ -201,12 +216,12 @@ class PeerManager:
     def _next_dial_candidate(self) -> Optional[Tuple[_Peer, Tuple[str, int]]]:
         if self.num_connected() >= self.opts.max_connected:
             return None
-        now = time.monotonic()
+        now = self._clock()
         best: Optional[_Peer] = None
         for peer in self._peers.values():
             if peer.connected or peer.dialing or not peer.addresses:
                 continue
-            if now - peer.last_dial_failure < peer.retry_delay(self.opts):
+            if now < peer.retry_at or now < peer.banned_until:
                 continue
             if best is None or (
                 peer.persistent, peer.score, -peer.dial_attempts
@@ -232,13 +247,21 @@ class PeerManager:
     def dial_failed(self, node_id: NodeID) -> None:
         """reference: peermanager.go:499-530. Only clears the dialing
         reservation — a live inbound connection accepted during the dial
-        (crossover) must keep its connected state."""
+        (crossover) must keep its connected state. Schedules the next
+        retry on the jittered capped exponential schedule (computed
+        once, here, so the recorded delay is the one that runs)."""
         peer = self._peers.get(node_id)
         if peer is None:
             return
         peer.dialing = False
-        peer.last_dial_failure = time.monotonic()
+        peer.last_dial_failure = self._clock()
+        peer.retry_delay_s = backoff_delay(
+            peer.dial_attempts, self.opts, peer.persistent
+        )
+        peer.retry_at = peer.last_dial_failure + peer.retry_delay_s
         peer.score = max(peer.score - 1, -100)
+        if self.metrics is not None:
+            self.metrics.dial_backoff.observe(peer.retry_delay_s)
         self._wakeup.set()
 
     def dialed(self, node_id: NodeID) -> None:
@@ -255,6 +278,7 @@ class PeerManager:
             )
         peer.dialing = False
         peer.dial_attempts = 0
+        peer.retry_at = 0.0
         peer.connected = True
         peer.inbound = False
 
@@ -267,6 +291,10 @@ class PeerManager:
         if peer is None:
             peer = _Peer(node_id=node_id)
             self._peers[node_id] = peer
+        if self._clock() < peer.banned_until:
+            # a shed peer sits out its ban window on BOTH paths: we
+            # neither dial it nor let it immediately reconnect inbound
+            raise ValueError(f"peer {node_id} is banned")
         if peer.connected:
             raise AlreadyConnectedError(
                 f"peer {node_id} is already connected"
@@ -297,6 +325,11 @@ class PeerManager:
             raise ValueError("already connected to maximum number of peers")
         peer.connected = True
         peer.inbound = True
+        # a live inbound proves the peer is up: future dials (e.g.
+        # after this connection drops) start from a fresh schedule
+        # instead of inheriting backoff accrued while it was down
+        peer.dial_attempts = 0
+        peer.retry_at = 0.0
         if self.num_connected() > self.opts.max_connected:
             self._schedule_eviction()
 
@@ -307,7 +340,7 @@ class PeerManager:
         if peer is None or not peer.connected:
             return
         peer.ready = True
-        peer.connected_at = time.monotonic()
+        peer.connected_at = self._clock()
         self._notify(PeerUpdate(node_id=node_id, status=PeerStatus.UP))
 
     def disconnected(self, node_id: NodeID) -> None:
@@ -327,18 +360,23 @@ class PeerManager:
             was_ready
             and not was_evicting
             and peer.connected_at
-            and time.monotonic() - peer.connected_at >= 600.0
+            and self._clock() - peer.connected_at >= 600.0
         ):
             peer.score = min(peer.score + 1, 100)
         peer.connected_at = 0.0
         peer.connected = False
         peer.ready = False
         peer.evicting = False
+        peer.evict_reason = ""
         if was_evicting:
             # evicted for misbehavior: apply dial backoff so we don't
             # immediately re-establish the same bad peer
             peer.dial_attempts += 1
-            peer.last_dial_failure = time.monotonic()
+            peer.last_dial_failure = self._clock()
+            peer.retry_delay_s = backoff_delay(
+                peer.dial_attempts, self.opts, peer.persistent
+            )
+            peer.retry_at = peer.last_dial_failure + peer.retry_delay_s
         if was_ready:
             self._notify(PeerUpdate(node_id=node_id, status=PeerStatus.DOWN))
         self._wakeup.set()
@@ -351,8 +389,40 @@ class PeerManager:
             return
         self.logger.info("evicting peer", peer=node_id, err=err)
         peer.evicting = True
+        peer.evict_reason = "misbehavior"
         peer.score -= 10
         self._evict_queue.put_nowait(node_id)
+
+    def shed_slow(self, node_id: NodeID, ban_s: float = 30.0) -> None:
+        """The router detected a slow consumer (its send queues shed
+        past the threshold): evict with reason `slow_peer` and sit the
+        peer out for `ban_s` — an immediate redial/reconnect would
+        rebuild the exact queue that just overflowed."""
+        peer = self._peers.get(node_id)
+        if peer is None or not peer.connected or peer.evicting:
+            return
+        self.logger.info(
+            "shedding slow peer", peer=node_id, ban_s=ban_s
+        )
+        peer.evicting = True
+        peer.evict_reason = "slow_peer"
+        peer.score = max(peer.score - 2, -100)
+        peer.banned_until = self._clock() + max(ban_s, 0.0)
+        self._evict_queue.put_nowait(node_id)
+
+    def ban(self, node_id: NodeID, duration_s: float) -> None:
+        """Refuse to dial or accept this peer for `duration_s`."""
+        peer = self._peers.get(node_id)
+        if peer is None:
+            return
+        peer.banned_until = self._clock() + max(duration_s, 0.0)
+
+    def evict_reason(self, node_id: NodeID) -> str:
+        """Why the peer is being evicted ("" when not evicting) — the
+        router stamps this on the disconnect metric and the goodbye
+        frame so BOTH sides can attribute the drop."""
+        peer = self._peers.get(node_id)
+        return peer.evict_reason if peer is not None else ""
 
     async def evict_next(self) -> NodeID:
         """Next peer the router should disconnect
@@ -369,6 +439,7 @@ class PeerManager:
             return
         victim = min(victims, key=lambda p: p.score)
         victim.evicting = True
+        victim.evict_reason = "capacity"
         self._evict_queue.put_nowait(victim.node_id)
 
     # -- subscriptions --

@@ -22,6 +22,7 @@ import time as _time
 from collections import deque
 from typing import Deque, Dict, Optional, Tuple
 
+from ..crypto import faults
 from ..crypto.keys import PrivKey
 from ..libs.log import get_logger
 from ..libs.service import Service
@@ -38,6 +39,29 @@ __all__ = ["Router", "RouterOptions", "PING_CHANNEL_ID"]
 PING_CHANNEL_ID = 0xFF
 _PING = b"\x01"
 _PONG = b"\x02"
+# goodbye control frame: 0x03 + utf-8 reason. Sent best-effort before a
+# LOCALLY-decided disconnect (eviction, shed, shutdown) so the other
+# side's logs/metrics carry the reason instead of a bare reset — a shed
+# slow peer used to look identical to a crashed one from the far side.
+_BYE = b"\x03"
+
+# the FIXED disconnect-reason vocabulary. Metrics labels only ever come
+# from this set (a remote-reported reason outside it becomes "other"),
+# so a hostile peer cannot mint label cardinality through BYE frames.
+_PEER_REASONS = frozenset(
+    {
+        "misbehavior",  # reactor/decoder reported bad messages
+        "slow_peer",  # send queues shed past the slow-peer threshold
+        "capacity",  # evicted to make room (over max_connected)
+        "evicted",  # eviction with no recorded reason
+        "unresponsive",  # keepalive deadline passed with no traffic
+        "send_error",  # transport send failed mid-write
+        "recv_error",  # transport receive failed / connection lost
+        "crossover",  # replaced by the canonical crossover connection
+        "shutdown",  # local node stopping
+        "other",
+    }
+)
 
 
 class RouterOptions:
@@ -53,6 +77,9 @@ class RouterOptions:
         pong_timeout: float = 15.0,
         max_incoming_per_ip: int = 100,  # attempts per tracking window
         incoming_window: float = 10.0,
+        slow_peer_drop_threshold: int = 64,  # queue sheds per window...
+        slow_peer_window_s: float = 10.0,  # ...before the peer is shed
+        slow_peer_ban_s: float = 30.0,  # sit-out window after a shed
     ) -> None:
         self.handshake_timeout = handshake_timeout
         self.dial_timeout = dial_timeout
@@ -64,6 +91,23 @@ class RouterOptions:
         self.pong_timeout = pong_timeout
         self.max_incoming_per_ip = max_incoming_per_ip
         self.incoming_window = incoming_window
+        self.slow_peer_drop_threshold = slow_peer_drop_threshold
+        self.slow_peer_window_s = slow_peer_window_s
+        self.slow_peer_ban_s = slow_peer_ban_s
+
+
+def _peer_net_labels(peer_info: NodeInfo) -> tuple:
+    """The labels TM_TPU_PARTITION members / p2p rule filters match a
+    PEER against: moniker + node ID (and the self-reported listen
+    host, the same identity the memory transport dials)."""
+    host = (
+        peer_info.listen_addr.rsplit(":", 1)[0]
+        if peer_info.listen_addr
+        else ""
+    )
+    return tuple(
+        x for x in (peer_info.moniker, peer_info.node_id, host) if x
+    )
 
 
 class _RateLimiter:
@@ -138,6 +182,13 @@ class _PeerSendQueue:
         entry[2].append(payload)
         self._ready.set()
 
+    def pending(self) -> bool:
+        """Any frame queued on any channel? (The reorder fault only
+        parks a frame when a successor is actually waiting to swap
+        with — holding the LAST frame of a burst would turn reorder
+        into a drop.)"""
+        return any(q for _p, _c, q in self._queues.values())
+
     async def get(self) -> Tuple[int, bytes]:
         while True:
             best = None
@@ -183,6 +234,20 @@ class Router(Service):
         self._peer_conns: Dict[NodeID, Connection] = {}
         self._peer_tasks: Dict[NodeID, list] = {}
         self._peer_last_recv: Dict[NodeID, float] = {}
+        # net-fault-plane identities: what TM_TPU_PARTITION members and
+        # p2p.* rule src=/dst= filters match against
+        self._net_labels = _peer_net_labels(node_info)
+        # the transport consults the dial fault point with our labels
+        self.transport.local_labels = self._net_labels
+        # per-peer labels (moniker, node_id), learned at handshake;
+        # entries removed in _close_peer
+        self._peer_labels: Dict[NodeID, tuple] = {}
+        # remote-reported disconnect reasons (BYE frames), consumed by
+        # the _peer_down that follows the peer's close
+        self._peer_bye: Dict[NodeID, str] = {}
+        # slow-peer detection: recent send-queue drop instants per peer
+        # (each deque pruned to slow_peer_window_s; removed on close)
+        self._send_drops: Dict[NodeID, Deque[float]] = {}
         # per-IP connection-attempt tracking
         # (reference: internal/p2p/conn_tracker.go)
         self._conn_tracker: Dict[str, Deque[float]] = {}
@@ -231,6 +296,19 @@ class Router(Service):
         self.spawn(self._evict_loop(), "evict")
 
     async def on_stop(self) -> None:
+        # announce the shutdown to every peer and AWAIT the goodbyes
+        # here — a task spawned mid-stop can be cancelled before its
+        # first tick (on_stop's remaining awaits never yield), which
+        # would both swallow the frame and leak the conn. Bounded:
+        # 0.5 s per frame, sent concurrently.
+        if self._peer_conns:
+            await asyncio.gather(
+                *(
+                    self._send_bye(conn, "shutdown")
+                    for conn in self._peer_conns.values()
+                ),
+                return_exceptions=True,
+            )
         for node_id in list(self._peer_conns):
             self._close_peer(node_id)
         self.peer_manager.flush()  # write any debounced address book state
@@ -278,6 +356,13 @@ class Router(Service):
                     raise ConnectionError(
                         f"expected {node_id}, got {peer_info.node_id}"
                     )
+                if faults.net_armed() and faults.partition_blocked(
+                    self._net_labels, _peer_net_labels(peer_info)
+                ):
+                    # the moniker learned at handshake put the peer on
+                    # the far side of the partition (host-level labels
+                    # alone — TCP nets — can't tell nodes apart)
+                    raise ConnectionError("injected partition")
             except Exception as e:
                 self.logger.info(
                     "peer handshake failed", peer=node_id, err=str(e)
@@ -302,7 +387,7 @@ class Router(Service):
                 conn.close()
                 self.peer_manager.dial_failed(node_id)
                 return
-            self._start_peer(peer_info.node_id, conn)
+            self._start_peer(peer_info, conn)
         finally:
             sem.release()
 
@@ -347,6 +432,15 @@ class Router(Service):
             self.logger.debug("inbound handshake failed", err=str(e))
             conn.close()
             return
+        if faults.net_armed() and faults.partition_blocked(
+            _peer_net_labels(peer_info), self._net_labels
+        ):
+            self.logger.debug(
+                "rejecting inbound: injected partition",
+                peer=peer_info.node_id[:12],
+            )
+            conn.close()
+            return
         nid = peer_info.node_id
         try:
             self.peer_manager.accepted(nid)
@@ -369,7 +463,7 @@ class Router(Service):
                     "crossover: replacing outbound with canonical "
                     "inbound", peer=nid[:12],
                 )
-                self._peer_down(nid)
+                self._peer_down(nid, reason="crossover")
                 try:
                     self.peer_manager.accepted(nid)
                 except Exception as e:
@@ -395,7 +489,7 @@ class Router(Service):
                 )
             except ValueError:
                 pass  # unparseable self-report: ignore
-        self._start_peer(peer_info.node_id, conn)
+        self._start_peer(peer_info, conn)
 
     async def _handshake(self, conn: Connection) -> NodeInfo:
         peer_info, _peer_pub = await asyncio.wait_for(
@@ -410,7 +504,15 @@ class Router(Service):
 
     # -- per-peer routines (reference: router.go routePeer) --
 
-    def _start_peer(self, node_id: NodeID, conn: Connection) -> None:
+    def _start_peer(self, peer_info: NodeInfo, conn: Connection) -> None:
+        node_id = peer_info.node_id
+        if not self.is_running:
+            # a dial/accept that finished its handshake while stop()
+            # was tearing the router down must not spawn fresh peer
+            # tasks — they would outlive the cancel sweep and park
+            # stop() forever on their queues
+            conn.close()
+            return
         if node_id in self._peer_conns:
             # duplicate connection: keep the existing one. No
             # disconnected() — the live peer's state must not be torn
@@ -419,6 +521,7 @@ class Router(Service):
             conn.close()
             return
         self._peer_conns[node_id] = conn
+        self._peer_labels[node_id] = _peer_net_labels(peer_info)
         q = _PeerSendQueue(default_capacity=self.opts.peer_queue_size)
         for ch in self._channels.values():
             q.register(ch.descriptor)
@@ -432,29 +535,101 @@ class Router(Service):
         self.peer_manager.ready(node_id)
         self.logger.info("peer connected", peer=node_id[:12], addr=conn.remote_addr)
 
+    def _link_labels(self, point: str, node_id: NodeID):
+        labels = self._peer_labels.get(node_id, (node_id,))
+        if point == "p2p.send":
+            return self._net_labels, labels
+        return labels, self._net_labels
+
+    def _partition_cut(self, point: str, node_id: NodeID) -> bool:
+        """Is this link cut by the live partition? Counted per frame.
+        Callers gate on faults.net_armed()."""
+        src, dst = self._link_labels(point, node_id)
+        if faults.partition_blocked(src, dst):
+            self.metrics.net_faults.inc(point=point, mode="partition")
+            return True
+        return False
+
+    async def _consult_net_rules(
+        self, point: str, node_id: NodeID, channel_id: int
+    ):
+        """One p2p.send / p2p.recv per-message RULE consult (the
+        partition check is separate — on the recv side it must run
+        BEFORE the liveness stamp, rules after). Returns
+        (drop, extra_copies, reorder) after paying any injected delay.
+        Callers gate on faults.net_armed() so the unarmed hot path
+        never reaches here."""
+        src, dst = self._link_labels(point, node_id)
+        plan = faults.net_plan(point, src=src, dst=dst, ch=channel_id)
+        if plan is None:
+            return False, 0, False
+        if plan.delay_s > 0:
+            self.metrics.net_faults.inc(point=point, mode="delay")
+            await asyncio.sleep(plan.delay_s)
+        if plan.drop:
+            self.metrics.net_faults.inc(point=point, mode="drop")
+            return True, 0, False
+        if plan.dup:
+            self.metrics.net_faults.inc(point=point, mode="duplicate")
+        if plan.reorder:
+            self.metrics.net_faults.inc(point=point, mode="reorder")
+        return False, plan.dup, plan.reorder
+
     async def _send_peer(
         self, node_id: NodeID, conn: Connection, queue: _PeerSendQueue
     ) -> None:
         limiter = _RateLimiter(self.opts.send_rate)
+        held = None  # reorder fault: message parked behind its successor
         while True:
             channel_id, payload = await queue.get()
-            await limiter.wait(len(payload))
-            self.metrics.bytes_sent.inc(len(payload), ch=channel_id)
-            try:
-                await conn.send(channel_id, payload)
-            except asyncio.CancelledError:
-                raise
-            except ValueError as e:
-                # our own oversized/bad payload: drop it, keep the peer
-                self.logger.error(
-                    "dropping unsendable message", ch=channel_id, err=str(e)
+            batch = [(channel_id, payload)]
+            if faults.net_armed():
+                if self._partition_cut("p2p.send", node_id):
+                    held = None  # the cut link eats the parked frame too
+                    continue
+                drop, dup, reorder = await self._consult_net_rules(
+                    "p2p.send", node_id, channel_id
                 )
-            except Exception:
-                # any transport failure means the connection is done; it
-                # must never escape into Service fail-fast and kill the
-                # whole router (single-peer failure ≠ node failure)
-                self._peer_down(node_id)
-                return
+                if drop:
+                    if held is None:
+                        continue
+                    # the dropped frame dies but the PARKED one was
+                    # only reordered: flush it now, or a dropped
+                    # successor at the end of a burst would turn
+                    # reorder into a silent drop
+                    batch, held = [held], None
+                else:
+                    if reorder and held is None and queue.pending():
+                        # park ONLY when a successor is already queued
+                        # — holding the last frame of a burst would
+                        # await a successor that never comes
+                        # (reorder ≠ drop)
+                        held = (channel_id, payload)
+                        continue
+                    batch += [(channel_id, payload)] * dup
+            if held is not None:
+                batch.append(held)  # swapped behind its successor
+                held = None
+            for cid, pl in batch:
+                await limiter.wait(len(pl))
+                self.metrics.bytes_sent.inc(len(pl), ch=cid)
+                try:
+                    await conn.send(cid, pl)
+                except asyncio.CancelledError:
+                    raise
+                except ValueError as e:
+                    # our own oversized/bad payload: drop it, keep the
+                    # peer
+                    self.logger.error(
+                        "dropping unsendable message", ch=cid, err=str(e)
+                    )
+                except Exception:
+                    # any transport failure means the connection is
+                    # done; it must never escape into Service fail-fast
+                    # and kill the whole router (single-peer failure ≠
+                    # node failure)
+                    self._peer_down(node_id, reason="send_error")
+                    return
 
     async def _ping_peer(self, node_id: NodeID, queue: _PeerSendQueue) -> None:
         """Keepalive: ping on the reserved channel; ANY received traffic
@@ -474,44 +649,110 @@ class Router(Service):
                     "peer unresponsive; disconnecting",
                     peer=node_id[:12], idle=round(idle, 1),
                 )
-                self._peer_down(node_id)
+                self._peer_down(node_id, reason="unresponsive")
                 return
             if idle > interval / 2:
                 queue.put_keepalive(_PING)
 
+    def _deliver_inbound(
+        self, node_id: NodeID, channel_id: int, payload: bytes
+    ) -> bool:
+        """Demux one received frame into its reactor queue. Returns
+        False when the peer must be dropped (invalid message)."""
+        if channel_id == PING_CHANNEL_ID:
+            if payload == _PING:
+                q = self._peer_queues.get(node_id)
+                if q is not None:
+                    q.put_keepalive(_PONG)
+            elif payload[:1] == _BYE:
+                # the peer told us WHY it is about to hang up; stash it
+                # so the imminent _peer_down attributes the close.
+                # Sanitized against the fixed vocabulary: wire bytes
+                # never become a metrics label.
+                said = payload[1:64].decode("utf-8", "replace")
+                reason = said if said in _PEER_REASONS else "other"
+                self._peer_bye[node_id] = f"remote/{reason}"
+                self.logger.info(
+                    "peer announced disconnect",
+                    peer=node_id[:12], reason=reason,
+                )
+            # pongs need no action: any traffic is liveness
+            return True
+        ch = self._channels.get(channel_id)
+        if ch is None:
+            return True  # unknown channel: drop
+        try:
+            msg = ch.descriptor.decode(payload)
+        except Exception as e:
+            self.logger.info(
+                "peer sent invalid message; evicting",
+                peer=node_id[:12], ch=channel_id, err=str(e),
+            )
+            self.peer_manager.errored(node_id, f"bad message: {e}")
+            return False
+        if not ch.deliver(Envelope(message=msg, from_peer=node_id)):
+            self.logger.debug(
+                "reactor queue full; dropping message", ch=channel_id
+            )
+        return True
+
     async def _recv_peer(self, node_id: NodeID, conn: Connection) -> None:
         limiter = _RateLimiter(self.opts.recv_rate)
+        held = None  # reorder fault: frame parked behind its successor
+
+        def flush_held() -> None:
+            # timer-driven flush for a parked frame whose successor
+            # never came: reorder delays, it never silently drops.
+            # Runs as a loop callback so conn.receive() is never
+            # cancelled mid-read (a cancel there loses the racing
+            # frame on the memory transport and desyncs the
+            # length-prefixed TCP stream). The send side guards with
+            # queue.pending() instead; inbound traffic can't be
+            # peeked, hence the deadline.
+            nonlocal held
+            if held is None:
+                return
+            cid, pl = held
+            held = None
+            self.metrics.bytes_recv.inc(len(pl), ch=cid)
+            self._deliver_inbound(node_id, cid, pl)
+
         try:
             while True:
                 channel_id, payload = await conn.receive()
-                self._peer_last_recv[node_id] = _time.monotonic()
-                self.metrics.bytes_recv.inc(len(payload), ch=channel_id)
-                await limiter.wait(len(payload))
-                if channel_id == PING_CHANNEL_ID:
-                    if payload == _PING:
-                        q = self._peer_queues.get(node_id)
-                        if q is not None:
-                            q.put_keepalive(_PONG)
-                    continue  # pong needs no action: any traffic is liveness
-                ch = self._channels.get(channel_id)
-                if ch is None:
-                    continue  # unknown channel: drop
-                try:
-                    msg = ch.descriptor.decode(payload)
-                except Exception as e:
-                    self.logger.info(
-                        "peer sent invalid message; evicting",
-                        peer=node_id[:12], ch=channel_id, err=str(e),
-                    )
-                    self.peer_manager.errored(node_id, f"bad message: {e}")
-                    return
-                if not ch.deliver(
-                    Envelope(message=msg, from_peer=node_id)
+                # ONLY the partition check runs before the liveness
+                # stamp: a fully-cut peer must go stale and trip the
+                # keepalive deadline, exactly like a real one. A
+                # rule-dropped/held frame still ARRIVED — a lossy link
+                # delivers bytes, so it must not fake unresponsiveness
+                if faults.net_armed() and self._partition_cut(
+                    "p2p.recv", node_id
                 ):
-                    self.logger.debug(
-                        "reactor queue full; dropping message",
-                        ch=channel_id,
+                    held = None  # the cut link eats a parked frame too
+                    continue
+                self._peer_last_recv[node_id] = _time.monotonic()
+                batch = [(channel_id, payload)]
+                if faults.net_armed():
+                    drop, dup, reorder = await self._consult_net_rules(
+                        "p2p.recv", node_id, channel_id
                     )
+                    if drop:
+                        continue  # held (if any) flushes on its timer
+                    if reorder and held is None:
+                        held = (channel_id, payload)
+                        asyncio.get_running_loop().call_later(
+                            0.5, flush_held
+                        )
+                        continue
+                    batch += [(channel_id, payload)] * dup
+                if held is not None:
+                    batch.append(held)
+                    held = None
+                for cid, pl in batch:
+                    self.metrics.bytes_recv.inc(len(pl), ch=cid)
+                    await limiter.wait(len(pl))
+                    if not self._deliver_inbound(node_id, cid, pl):
+                        return
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -520,21 +761,69 @@ class Router(Service):
             self.logger.debug(
                 "peer receive failed", peer=node_id[:12], err=str(e)
             )
-            self._peer_down(node_id)
+            self._peer_down(node_id, reason="recv_error")
 
-    def _peer_down(self, node_id: NodeID) -> None:
+    def _peer_down(
+        self,
+        node_id: NodeID,
+        reason: str = "other",
+        notify: bool = False,
+    ) -> None:
+        """Tear down a peer. `reason` labels the disconnect metric and
+        the log line; a BYE the peer sent first wins the attribution.
+        `notify=True` sends the reason to the peer (best-effort) for
+        LOCALLY-decided disconnects (evictions, shed, shutdown)."""
         if node_id not in self._peer_conns:
             return
-        self._close_peer(node_id)
+        reason = self._peer_bye.pop(node_id, reason)
+        self._close_peer(node_id, bye_reason=reason if notify else None)
+        self.metrics.peer_disconnects.inc(reason=reason)
         self.peer_manager.disconnected(node_id)
-        self.logger.info("peer disconnected", peer=node_id[:12])
+        self.logger.info(
+            "peer disconnected", peer=node_id[:12], reason=reason
+        )
 
-    def _close_peer(self, node_id: NodeID) -> None:
+    async def _send_bye(self, conn: Connection, reason: str) -> None:
+        """Best-effort goodbye frame — bounded so a wedged transport
+        can't hold the caller open."""
+        try:
+            await asyncio.wait_for(
+                conn.send(PING_CHANNEL_ID, _BYE + reason.encode()),
+                timeout=0.5,
+            )
+        except Exception:
+            pass
+
+    async def _say_bye(self, conn: Connection, reason: str) -> None:
+        """Goodbye frame, then close (the eviction path's spawned
+        teardown)."""
+        try:
+            await self._send_bye(conn, reason)
+        finally:
+            conn.close()
+
+    def _close_peer(
+        self, node_id: NodeID, bye_reason: Optional[str] = None
+    ) -> None:
         conn = self._peer_conns.pop(node_id, None)
         if conn is not None:
-            conn.close()
+            if bye_reason is not None and self.is_running:
+                # eviction path: the loop keeps running, the spawned
+                # bye gets its tick. During stop, spawning is unsafe
+                # (a task cancelled before its first tick never runs
+                # its finally and would leak the conn) — on_stop sends
+                # its shutdown byes inline instead.
+                self.spawn(
+                    self._say_bye(conn, bye_reason),
+                    f"bye-{node_id[:8]}",
+                )
+            else:
+                conn.close()
         self._peer_queues.pop(node_id, None)
         self._peer_last_recv.pop(node_id, None)
+        self._peer_labels.pop(node_id, None)
+        self._peer_bye.pop(node_id, None)
+        self._send_drops.pop(node_id, None)
         self.metrics.peers.set(len(self._peer_conns))
         for t in self._peer_tasks.pop(node_id, []):
             if not t.done() and t is not asyncio.current_task():
@@ -568,6 +857,29 @@ class Router(Service):
                         "peer channel queue full; dropping message",
                         peer=node_id[:12], ch=ch.id,
                     )
+                    self.metrics.send_queue_dropped.inc(ch=ch.id)
+                    self._note_send_drop(node_id)
+
+    def _note_send_drop(self, node_id: NodeID) -> None:
+        """Slow-peer detection: a peer whose queues shed more than
+        `slow_peer_drop_threshold` messages inside
+        `slow_peer_window_s` is not consuming — evict it with reason
+        `slow_peer` and ban it for the sit-out window rather than
+        letting its queues shed forever (bounded memory was already
+        guaranteed; bounded USELESS WORK was not)."""
+        if node_id not in self._peer_conns:
+            return
+        now = _time.monotonic()
+        window = self._send_drops.setdefault(node_id, deque())
+        cutoff = now - self.opts.slow_peer_window_s
+        while window and window[0] < cutoff:
+            window.popleft()
+        window.append(now)
+        if len(window) >= self.opts.slow_peer_drop_threshold:
+            window.clear()
+            self.peer_manager.shed_slow(
+                node_id, ban_s=self.opts.slow_peer_ban_s
+            )
 
     async def _route_channel_errors(self, ch: Channel) -> None:
         while True:
@@ -578,4 +890,5 @@ class Router(Service):
         """reference: router.go evictPeers."""
         while True:
             node_id = await self.peer_manager.evict_next()
-            self._peer_down(node_id)
+            reason = self.peer_manager.evict_reason(node_id) or "evicted"
+            self._peer_down(node_id, reason=reason, notify=True)

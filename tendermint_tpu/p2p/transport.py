@@ -14,6 +14,7 @@ import struct
 from abc import ABC, abstractmethod
 from typing import Dict, Optional, Tuple
 
+from ..crypto import faults
 from ..crypto.keys import PrivKey, PubKey
 from ..encoding.proto import decode_varint, encode_varint
 from ..libs.log import get_logger
@@ -54,8 +55,34 @@ class Connection(ABC):
     def remote_addr(self) -> str: ...
 
 
+async def consult_dial_plane(src_labels: tuple, host: str, port: int):
+    """The `p2p.dial` fault point, shared by every transport: a `drop`
+    rule or a live partition turns the dial into ConnectionError (the
+    same failure a dead peer produces, so the dial-backoff machinery
+    is exercised, not bypassed), a `delay` rule slows it. Callers gate
+    on faults.net_armed() — unarmed dials never reach here."""
+    dst = (host, f"{host}:{port}")
+    if faults.partition_blocked(src_labels, dst):
+        raise ConnectionError(
+            f"injected partition: dial to {host}:{port} blocked"
+        )
+    plan = faults.net_plan("p2p.dial", src=src_labels, dst=dst)
+    if plan is not None:
+        if plan.delay_s > 0:
+            await asyncio.sleep(plan.delay_s)
+        if plan.drop:
+            raise ConnectionError(
+                f"injected dial drop: {host}:{port}"
+            )
+
+
 class Transport(ABC):
     """reference: transport.go Transport."""
+
+    # net-fault-plane identity of the dialing node (moniker, node ID,
+    # listen host) — the router stamps this so `p2p.dial` rules and
+    # partitions can match the SOURCE side
+    local_labels: tuple = ()
 
     @abstractmethod
     async def listen(self, addr: str) -> None: ...
@@ -106,11 +133,18 @@ class _MemoryConnection(Connection):
     async def receive(self) -> Tuple[int, bytes]:
         get = asyncio.ensure_future(self._recv_q.get())
         closed = asyncio.ensure_future(self._closed.wait())
-        done, pending = await asyncio.wait(
-            {get, closed}, return_when=asyncio.FIRST_COMPLETED
-        )
-        for p in pending:
-            p.cancel()
+        try:
+            done, _pending = await asyncio.wait(
+                {get, closed}, return_when=asyncio.FIRST_COMPLETED
+            )
+        finally:
+            # also runs when THIS task is cancelled mid-wait: the two
+            # inner futures must not outlive the call (they used to
+            # leak as destroyed-but-pending Queue.get tasks at loop
+            # close)
+            for p in (get, closed):
+                if not p.done():
+                    p.cancel()
         if get in done:
             item = get.result()
             if item == ("_close", None):
@@ -159,6 +193,13 @@ class MemoryTransport(Transport):
         return await self._accept_q.get()
 
     async def dial(self, host: str, port: int) -> Connection:
+        if faults.net_armed():
+            await consult_dial_plane(
+                self.local_labels
+                or (self.addr, self.addr.rsplit(":", 1)[0]),
+                host,
+                port,
+            )
         target = self.network.transports.get(f"{host}:{port}")
         if target is None:
             raise ConnectionError(f"no memory transport at {host}:{port}")
@@ -261,6 +302,8 @@ class TCPTransport(Transport):
         return await self._accept_q.get()
 
     async def dial(self, host: str, port: int) -> Connection:
+        if faults.net_armed():
+            await consult_dial_plane(self.local_labels, host, port)
         reader, writer = await asyncio.open_connection(host, port)
         return _TCPConnection(reader, writer)
 

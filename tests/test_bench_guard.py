@@ -321,6 +321,47 @@ print("OK")
     assert "OK" in r.stdout
 
 
+def test_chaos_smoke_row_never_initializes_jax():
+    """The ISSUE-13 chaos row boots live localnets, partitions and
+    heals them, and reads the safety/recovery verdicts — all in the
+    banked CPU block BEFORE the device probe, so none of it may touch
+    the jax backend (loadgen/localnet.py pins tpu.enable=false; the
+    fault plane is pure stdlib). One tiny 3-node minority-partition
+    scenario here; the real BENCH_CHAOS.json run uses the shipped
+    catalog."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+from tendermint_tpu.loadgen import ChaosScenario
+cs = ChaosScenario(
+    name="minority_partition", kind="partition",
+    spec={"isolate": [2]}, fault_s=1.0, baseline_s=0.5,
+    recovery_slo_s=20.0,
+)
+row, report = bench.bench_chaos_smoke(
+    n_nodes=3, seed=11, rate=25.0, scenarios=[cs]
+)
+assert row["scenarios"] == 1
+assert report["schema"] == "bench_chaos/v1"
+r = report["scenarios"][0]
+assert r["safety_ok"] and r["heights_checked"] >= 1, r
+assert r["recovered_within_slo"] and r["passed"], r
+assert r["net_faults_applied"], "partition applied no faults"
+assert "jax" not in sys.modules, "chaos smoke dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout
+
+
 def test_stateless_bulk_rows_never_initialize_jax():
     """The ISSUE-11 rows (merkle_multiproof_10k,
     light_sync_bulk_150vals) live in the banked CPU block BEFORE the

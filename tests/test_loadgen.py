@@ -359,3 +359,60 @@ def test_sustained_open_loop_multi_node(tmp_path):
     assert rep["saturation"]["consensus_total_txs_delta"] > 0
     for op in scn.mix_ops():
         assert rep["routes"][op]["p999_ms"] > 0
+
+
+def test_localnet_boot_reaches_height2_fast(tmp_path):
+    """ISSUE 13 satellite regression: in-process localnet boot used to
+    OCCASIONALLY take tens of seconds — every node's first dials race
+    peer startup, each refused dial fed the old +10%-jitter schedule
+    (0.5 s base doubling toward the 20 s persistent cap), and a few
+    early failures parked a link for most of a minute. The jittered
+    capped exponential backoff with FULL [d/2, d] jitter plus the
+    localnet's snappy retry caps (min 0.1 s, persistent cap 2 s) bound
+    the worst link at ~2 s between attempts, so a 3-node boot must
+    reach height 2 (block 1 committed everywhere) well inside the
+    budget."""
+    import time as _time
+
+    from tendermint_tpu.loadgen.localnet import start_localnet
+
+    async def go():
+        t0 = _time.monotonic()
+        net = await start_localnet(3, str(tmp_path), chain_id="bootnet")
+        try:
+            return _time.monotonic() - t0
+        finally:
+            await net.stop()
+
+    wall = run(go(), timeout=90.0)
+    # typical is 4-10 s on this box; the bound is the regression line
+    # between "jitter schedule healthy" and "a link parked on backoff"
+    assert wall < 30.0, f"localnet boot took {wall:.1f}s"
+
+
+def test_chaos_scenario_smoke(tmp_path):
+    """One end-to-end chaos arc in tier-1 (the full shipped catalog is
+    the bench chaos_smoke row): minority partition under open-loop
+    traffic — safety verdict from the scraped stores, recovery within
+    SLO, and the fault plane left disarmed afterwards."""
+    from tendermint_tpu.loadgen import ChaosScenario, run_chaos_scenario
+
+    cs = ChaosScenario(
+        name="minority_partition",
+        kind="partition",
+        spec={"isolate": [2]},
+        fault_s=1.5,
+        baseline_s=1.0,
+        recovery_slo_s=20.0,
+    )
+    row = run(
+        run_chaos_scenario(
+            cs, str(tmp_path), n_nodes=3, seed=5, rate=25.0
+        ),
+        timeout=180.0,
+    )
+    assert row["passed"], row
+    assert row["safety_ok"] and row["heights_checked"] >= 1
+    assert row["recovered_within_slo"]
+    assert row["net_faults_applied"], "partition applied no faults"
+    assert not faults.net_armed()  # the arc disarmed the plane

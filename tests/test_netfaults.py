@@ -1,0 +1,650 @@
+"""Network fault plane + self-healing peer lifecycle (ISSUE 13).
+
+Three layers under test, mirroring tests/test_faults.py one level up:
+
+- the plane itself: the p2p.send / p2p.recv / p2p.dial points, the
+  drop / delay / duplicate / reorder modes, (src, dst, ch) keying, and
+  the runtime-mutable partition sets — every mode seed-replayable
+  (whether consult k fires is a pure function of (seed, k));
+- the router under injected faults: messages dropped / duplicated /
+  reordered / delayed per plan, partitions cutting links until the
+  keepalive deadline evicts the peer, and the net healing afterwards;
+- the self-healing lifecycle: jittered capped exponential dial
+  backoff (computed once per failure, stored, observable), slow-peer
+  shedding with eviction + ban window, and the disconnect REASON
+  propagating to both sides' logs and metrics via the goodbye frame.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tendermint_tpu.consensus import msgs as cmsgs
+from tendermint_tpu.crypto import faults
+from tendermint_tpu.loadgen.scrape import parse_exposition
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    Envelope,
+    PeerManager,
+    PeerManagerOptions,
+)
+from tendermint_tpu.p2p.p2ptest import TestNetwork
+from tendermint_tpu.p2p.peermanager import backoff_delay
+from tendermint_tpu.p2p.router import RouterOptions
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+ECHO = ChannelDescriptor(
+    channel_id=0x42, message_type=cmsgs.HasVoteMessage, name="echo"
+)
+
+
+def _msg(h):
+    return cmsgs.HasVoteMessage(height=h, round=0, type=1, index=0)
+
+
+def _counter(node, name, **labels):
+    """Read one counter series from a TestNode's registry."""
+    parsed = parse_exposition(node.registry.render())
+    key = "tendermint_tpu_" + name
+    if labels:
+        key += (
+            "{"
+            + ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            + "}"
+        )
+    return parsed.get(key, 0.0)
+
+
+# -- the plane ---------------------------------------------------------
+
+
+def test_net_rule_env_grammar(monkeypatch):
+    """The TM_TPU_FAULT grammar extends verbatim: network modes with
+    src/dst/ch filters and delay_s/dup knobs parse from the env, and
+    TM_TPU_PARTITION arms partition sets."""
+    monkeypatch.setenv(
+        "TM_TPU_FAULT",
+        "p2p.send:drop:p=0.4:seed=9:src=load0:dst=load1:ch=34;"
+        "p2p.recv:delay:delay_s=0.2;p2p.send:duplicate:dup=3",
+    )
+    monkeypatch.setenv("TM_TPU_PARTITION", "a,b|c")
+    faults.load_env()
+    try:
+        rules = {(r.point, r.mode): r for r in faults.rules()}
+        r = rules[("p2p.send", "drop")]
+        assert (r.src, r.dst, r.ch, r.p) == ("load0", "load1", 34, 0.4)
+        assert rules[("p2p.recv", "delay")].delay_s == 0.2
+        assert rules[("p2p.send", "duplicate")].dup == 3
+        assert faults.net_armed() and faults.armed()
+        assert faults.partition_spec() == "a,b|c"
+        assert faults.partition_blocked(("a",), ("c",))
+    finally:
+        monkeypatch.setenv("TM_TPU_FAULT", "")
+        monkeypatch.delenv("TM_TPU_PARTITION")
+        faults.load_env()
+    assert not faults.net_armed()
+
+
+@pytest.mark.parametrize("mode", ["drop", "delay", "duplicate", "reorder"])
+def test_net_modes_seed_replayable(mode):
+    """Every new mode rides the PR-3 seeding contract: which consults
+    fire is a pure function of (seed, consult index)."""
+
+    def pattern(seed):
+        fired = []
+        with faults.inject("p2p.send", mode=mode, p=0.5, seed=seed):
+            for i in range(60):
+                plan = faults.net_plan(
+                    "p2p.send", src=("a",), dst=("b",), ch=1
+                )
+                if plan is not None:
+                    fired.append(i)
+        return fired
+
+    a, b, c = pattern(5), pattern(5), pattern(6)
+    assert a == b and a and a != c
+
+
+def test_net_plan_src_dst_ch_filters():
+    with faults.inject(
+        "p2p.send", mode="drop", src="load0", dst="load1", ch=7
+    ):
+        hit = faults.net_plan(
+            "p2p.send", src=("load0",), dst=("load1",), ch=7
+        )
+        assert hit is not None and hit.drop
+        # wrong direction, wrong channel, wrong point: all filtered
+        assert faults.net_plan(
+            "p2p.send", src=("load1",), dst=("load0",), ch=7
+        ) is None
+        assert faults.net_plan(
+            "p2p.send", src=("load0",), dst=("load1",), ch=8
+        ) is None
+        assert faults.net_plan(
+            "p2p.recv", src=("load0",), dst=("load1",), ch=7
+        ) is None
+
+
+def test_label_match_exact_vs_prefix():
+    """Monikers/hosts match labels exactly ("load1" must not swallow
+    "load10", and neither may "validator1" swallow "validator10" just
+    by being >= 8 chars); ONLY hex node-ID prefixes (>= 8 hex chars)
+    match as prefixes."""
+    faults.set_partition("load1|load10")
+    try:
+        assert faults.partition_blocked(("load1",), ("load10",))
+        # "load1" is in group 0 ONLY — exact matching kept them apart
+        assert not faults.partition_blocked(("load10",), ("load10",))
+        # a LONG non-hex moniker still matches exactly, never as a
+        # prefix: validator10 must land in ITS group, not validator1's
+        faults.set_partition("validator1|validator10")
+        assert faults.partition_blocked(
+            ("validator1",), ("validator10",)
+        )
+        nid = "ab" * 20
+        faults.set_partition(f"{nid[:12]}|other-node")
+        assert faults.partition_blocked((nid,), ("other-node",))
+    finally:
+        faults.set_partition("")
+
+
+def test_partition_runtime_mutable_and_unnamed_unaffected():
+    faults.set_partition("a|b,c")
+    try:
+        assert faults.net_armed()
+        assert faults.partition_blocked(("a",), ("b",))
+        assert faults.partition_blocked(("c",), ("a",))
+        assert not faults.partition_blocked(("b",), ("c",))
+        # nodes the spec does not name keep every link
+        assert not faults.partition_blocked(("z",), ("a",))
+        assert not faults.partition_blocked(("a",), ("z",))
+        faults.set_partition("")  # heal mid-run
+        assert not faults.partition_blocked(("a",), ("b",))
+    finally:
+        faults.set_partition("")
+    assert not faults.net_armed()
+
+
+def test_partition_file_is_runtime_mutable(tmp_path, monkeypatch):
+    """The file form (process nets): the spec re-reads on change, so an
+    external orchestrator can partition and heal children mid-run."""
+    pf = tmp_path / "partition"
+    pf.write_text("v1|v0,v2")
+    monkeypatch.setenv("TM_TPU_PARTITION_FILE", str(pf))
+    faults.load_env()
+    try:
+        assert faults.net_armed()
+        assert faults.partition_blocked(("v1",), ("v0",))
+        time.sleep(0.25)  # past the stat() throttle
+        pf.write_text("")
+        time.sleep(0.25)
+        assert not faults.partition_blocked(("v1",), ("v0",))
+    finally:
+        monkeypatch.delenv("TM_TPU_PARTITION_FILE")
+        faults.load_env()
+
+
+def test_malformed_fault_spec_keeps_partition_armed(monkeypatch):
+    """A bad TM_TPU_FAULT raises once (the PR-6 latch) but must NOT
+    strip TM_TPU_PARTITION as collateral — an e2e child whose
+    partition silently never armed would measure an un-partitioned
+    net."""
+    monkeypatch.setenv("TM_TPU_FAULT", "p2p.send:bogus-mode")
+    monkeypatch.setenv("TM_TPU_PARTITION", "a|b")
+    monkeypatch.setattr(faults, "_ENV_LOADED", False)
+    with pytest.raises(ValueError):
+        faults.armed()
+    try:
+        assert faults.net_armed()
+        assert faults.partition_blocked(("a",), ("b",))
+    finally:
+        monkeypatch.setenv("TM_TPU_FAULT", "")
+        monkeypatch.delenv("TM_TPU_PARTITION")
+        faults.load_env()
+
+
+def test_net_armed_is_cheap_when_unarmed():
+    """The zero-overhead contract: unarmed, the p2p hot path reads one
+    module bool — and the plane reports unarmed."""
+    assert not faults.net_armed()
+    # tpu rules alone must not arm the NET plane (and vice versa)
+    with faults.inject("tpu.dispatch", mode="raise"):
+        assert faults.armed() and not faults.net_armed()
+    with faults.inject("p2p.send", mode="drop"):
+        assert faults.net_armed()
+    assert not faults.net_armed()
+
+
+# -- the router under the plane ---------------------------------------
+
+
+async def _connected_pair(router_options=None):
+    net = TestNetwork(2, router_options=router_options)
+    channels = [n.open_channel(ECHO) for n in net.nodes]
+    await net.start()
+    return net, channels
+
+
+def test_send_drop_rule_blocks_delivery():
+    async def go():
+        net, channels = await _connected_pair()
+        try:
+            with faults.inject(
+                "p2p.send", mode="drop", src="node0", ch=ECHO.channel_id
+            ):
+                await channels[0].send(
+                    Envelope(message=_msg(1), broadcast=True)
+                )
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(channels[1].receive(), 0.5)
+                assert (
+                    _counter(
+                        net.nodes[0],
+                        "p2p_net_faults_total",
+                        point="p2p.send",
+                        mode="drop",
+                    )
+                    >= 1
+                )
+            # disarmed: traffic flows again on the SAME connection
+            await channels[0].send(
+                Envelope(message=_msg(2), broadcast=True)
+            )
+            env = await asyncio.wait_for(channels[1].receive(), 5)
+            assert env.message.height == 2
+        finally:
+            await net.stop()
+
+    run(go())
+
+
+def test_duplicate_and_reorder_modes_at_router():
+    async def go():
+        net, channels = await _connected_pair()
+        try:
+            with faults.inject(
+                "p2p.send", mode="duplicate", dup=1, times=1,
+                ch=ECHO.channel_id,
+            ):
+                await channels[0].send(
+                    Envelope(message=_msg(7), broadcast=True)
+                )
+                a = await asyncio.wait_for(channels[1].receive(), 5)
+                b = await asyncio.wait_for(channels[1].receive(), 5)
+                assert a.message.height == b.message.height == 7
+
+            # reorder: the first message is parked and delivered
+            # BEHIND its successor
+            with faults.inject(
+                "p2p.recv", mode="reorder", times=1, ch=ECHO.channel_id
+            ):
+                t_before = time.monotonic()
+                await channels[0].send(
+                    Envelope(message=_msg(10), broadcast=True)
+                )
+                await asyncio.sleep(0.3)  # held, not yet delivered...
+                # ...but the frame ARRIVED: it must count as liveness
+                # (a held ping must not fake an unresponsive peer)
+                assert (
+                    net.nodes[1].router._peer_last_recv[
+                        net.nodes[0].node_id
+                    ]
+                    >= t_before
+                )
+                await channels[0].send(
+                    Envelope(message=_msg(11), broadcast=True)
+                )
+                first = await asyncio.wait_for(channels[1].receive(), 5)
+                second = await asyncio.wait_for(channels[1].receive(), 5)
+                assert (first.message.height, second.message.height) == (
+                    11,
+                    10,
+                )
+        finally:
+            await net.stop()
+
+    run(go())
+
+
+def test_recv_delay_mode_adds_latency():
+    async def go():
+        net, channels = await _connected_pair()
+        try:
+            with faults.inject(
+                "p2p.recv", mode="delay", delay_s=0.3, times=1,
+                ch=ECHO.channel_id,
+            ):
+                t0 = time.monotonic()
+                await channels[0].send(
+                    Envelope(message=_msg(3), broadcast=True)
+                )
+                env = await asyncio.wait_for(channels[1].receive(), 5)
+                assert env.message.height == 3
+                assert time.monotonic() - t0 >= 0.25
+        finally:
+            await net.stop()
+
+    run(go())
+
+
+def test_partition_evicts_unresponsive_then_heals():
+    """The full arc at router level: a partition cuts every frame
+    (keepalives included) → the liveness deadline evicts the peer with
+    reason `unresponsive` → the heal lets the dial machinery rebuild
+    the connection on its jittered backoff schedule."""
+
+    async def go():
+        net, channels = await _connected_pair(
+            router_options=RouterOptions(
+                ping_interval=0.15, pong_timeout=0.15
+            )
+        )
+        try:
+            faults.set_partition("node0|node1")
+            down = time.monotonic()
+            while any(n.peer_manager.peers() for n in net.nodes):
+                if time.monotonic() - down > 10:
+                    raise AssertionError(
+                        "partitioned peers never evicted"
+                    )
+                await asyncio.sleep(0.05)
+            assert (
+                _counter(
+                    net.nodes[0],
+                    "p2p_peer_disconnects_total",
+                    reason="unresponsive",
+                )
+                + _counter(
+                    net.nodes[1],
+                    "p2p_peer_disconnects_total",
+                    reason="unresponsive",
+                )
+                >= 1
+            )
+            faults.set_partition("")  # heal
+            await net.wait_connected(timeout=20.0)
+            await channels[0].send(
+                Envelope(message=_msg(9), broadcast=True)
+            )
+            env = await asyncio.wait_for(channels[1].receive(), 5)
+            assert env.message.height == 9
+        finally:
+            faults.set_partition("")
+            await net.stop()
+
+    run(go())
+
+
+def test_slow_peer_shed_reason_lands_on_both_sides():
+    """ISSUE 13 satellite: a shed slow peer used to be a silent
+    queue-full debug line. Now the shedder evicts with reason
+    `slow_peer` (counter + ban window) and the victim learns WHY via
+    the goodbye frame (reason `remote/slow_peer` on ITS counter)."""
+
+    narrow = ChannelDescriptor(
+        channel_id=0x43,
+        message_type=cmsgs.HasVoteMessage,
+        name="narrow",
+        send_queue_capacity=2,
+    )
+
+    async def go():
+        net = TestNetwork(
+            2,
+            router_options=RouterOptions(
+                slow_peer_drop_threshold=5,
+                slow_peer_window_s=5.0,
+                slow_peer_ban_s=0.8,
+            ),
+        )
+        channels = [n.open_channel(narrow) for n in net.nodes]
+        await net.start()
+        try:
+            shedder, victim = net.nodes
+            vid = victim.node_id
+            # park the shedder's send loop on an injected one-shot
+            # delay: its 2-slot channel queue fills, and every further
+            # broadcast is a send-queue shed
+            with faults.inject(
+                "p2p.send", mode="delay", delay_s=30.0, times=1,
+                src="node0", ch=narrow.channel_id,
+            ):
+                for h in range(12):
+                    await channels[0].send(
+                        Envelope(message=_msg(h + 1), broadcast=True)
+                    )
+                    await asyncio.sleep(0.01)
+            deadline = time.monotonic() + 10
+            while (
+                _counter(
+                    shedder,
+                    "p2p_peer_disconnects_total",
+                    reason="slow_peer",
+                )
+                < 1
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            assert (
+                _counter(
+                    shedder,
+                    "p2p_peer_disconnects_total",
+                    reason="slow_peer",
+                )
+                == 1
+            )
+            assert (
+                _counter(
+                    shedder,
+                    "p2p_send_queue_dropped_total",
+                    ch=narrow.channel_id,
+                )
+                >= 5
+            )
+            # the victim's side: reason arrived over the wire, got
+            # sanitized against the fixed vocabulary, landed labeled
+            deadline = time.monotonic() + 10
+            while (
+                _counter(
+                    victim,
+                    "p2p_peer_disconnects_total",
+                    reason="remote/slow_peer",
+                )
+                < 1
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            assert (
+                _counter(
+                    victim,
+                    "p2p_peer_disconnects_total",
+                    reason="remote/slow_peer",
+                )
+                == 1
+            )
+            # ban window: the shed peer sits out, then the pair heals
+            peer = shedder.peer_manager._peers[vid]
+            assert peer.banned_until > 0
+            await net.wait_connected(timeout=20.0)
+        finally:
+            await net.stop()
+
+    run(go())
+
+
+def test_shutdown_reason_propagates_to_peer():
+    """A clean local stop announces itself: the surviving side records
+    `remote/shutdown` instead of a bare recv_error — a clean shutdown
+    must be distinguishable from a crash (the goodbye frame is sent
+    from on_stop, where the service already reads as not-running)."""
+
+    async def go():
+        net, _channels = await _connected_pair()
+        victim = net.nodes[1]
+        try:
+            await net.nodes[0].router.stop()
+            deadline = time.monotonic() + 10
+            while (
+                _counter(
+                    victim,
+                    "p2p_peer_disconnects_total",
+                    reason="remote/shutdown",
+                )
+                < 1
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            assert (
+                _counter(
+                    victim,
+                    "p2p_peer_disconnects_total",
+                    reason="remote/shutdown",
+                )
+                >= 1
+            )
+        finally:
+            await net.stop()
+
+    run(go())
+
+
+def test_dial_drop_rule_keeps_net_apart_then_heals():
+    """`p2p.dial:drop` at the transport boundary produces the same
+    ConnectionError a dead peer would — the backoff machinery runs
+    (dial_backoff histogram advances), and removing the rule lets the
+    mesh form."""
+
+    async def go():
+        net = TestNetwork(2)
+        for n in net.nodes:
+            n.open_channel(ECHO)
+        with faults.inject("p2p.dial", mode="drop"):
+            await net.nodes[0].router.start()
+            await net.nodes[1].router.start()
+            net.nodes[0].peer_manager.add(
+                f"{net.nodes[1].node_id}@{net.nodes[1].addr}"
+            )
+            await asyncio.sleep(1.0)
+            assert not net.nodes[0].peer_manager.peers()
+            parsed = parse_exposition(net.nodes[0].registry.render())
+            assert (
+                parsed.get(
+                    "tendermint_tpu_p2p_dial_backoff_seconds_count", 0
+                )
+                >= 1
+            )
+        try:
+            await net.wait_connected(timeout=20.0)
+        finally:
+            await net.stop()
+
+    run(go())
+
+
+# -- the backoff schedule ---------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_backoff_delay_is_jittered_and_capped():
+    opts = PeerManagerOptions(
+        min_retry_time=0.25, max_retry_time=600.0,
+        max_retry_time_persistent=20.0,
+    )
+    for attempts in range(1, 14):
+        d = min(0.25 * (2 ** (attempts - 1)), 600.0)
+        samples = [
+            backoff_delay(attempts, opts, persistent=False)
+            for _ in range(50)
+        ]
+        assert all(d / 2 <= s <= d for s in samples), (attempts, d)
+    # full jitter actually jitters
+    assert len({backoff_delay(6, opts, False) for _ in range(20)}) > 1
+    # persistent peers cap earlier
+    assert backoff_delay(12, opts, persistent=True) <= 20.0
+    assert backoff_delay(0, opts, persistent=False) == 0.0
+
+
+def test_refused_dial_retries_on_backoff_schedule():
+    """ISSUE 13 satellite regression: a refused dial reschedules on
+    the stored jittered-exponential schedule — not a fixed cadence —
+    and the candidate stays unavailable exactly until retry_at."""
+
+    async def go():
+        clk = FakeClock()
+        pm = PeerManager(
+            "aa" * 20,
+            PeerManagerOptions(min_retry_time=0.25),
+            clock=clk,
+        )
+        nid = "bb" * 20
+        pm.add(f"{nid}@h:1")
+        delays = []
+        for attempt in range(1, 7):
+            node_id, _, _ = await asyncio.wait_for(pm.dial_next(), 2)
+            assert node_id == nid
+            pm.dial_failed(nid)
+            peer = pm._peers[nid]
+            d = min(0.25 * (2 ** (attempt - 1)), 600.0)
+            assert d / 2 <= peer.retry_delay_s <= d, (
+                attempt, peer.retry_delay_s,
+            )
+            delays.append(peer.retry_delay_s)
+            # one tick before expiry: no candidate
+            clk.now = peer.retry_at - 0.01
+            assert pm._next_dial_candidate() is None
+            clk.now = peer.retry_at + 0.01
+        assert delays == sorted(delays)  # the schedule grows
+        # an inbound connection proves liveness: schedule resets
+        pm.accepted(nid)
+        assert pm._peers[nid].dial_attempts == 0
+        assert pm._peers[nid].retry_at == 0.0
+
+    run(go())
+
+
+def test_banned_peer_rejected_on_both_paths():
+    async def go():
+        clk = FakeClock()
+        pm = PeerManager("aa" * 20, clock=clk)
+        nid = "bb" * 20
+        pm.add(f"{nid}@h:1")
+        pm.ban(nid, 30.0)
+        with pytest.raises(ValueError, match="banned"):
+            pm.accepted(nid)
+        assert pm._next_dial_candidate() is None
+        clk.now += 31.0  # window over: the peer is dialable again
+        assert pm._next_dial_candidate() is not None
+
+    run(go())
+
+
+def test_shed_slow_sets_reason_ban_and_evicts():
+    async def go():
+        clk = FakeClock()
+        pm = PeerManager("aa" * 20, clock=clk)
+        nid = "bb" * 20
+        pm.add(f"{nid}@h:1")
+        node_id, _, _ = await pm.dial_next()
+        pm.dialed(node_id)
+        pm.ready(node_id)
+        pm.shed_slow(nid, ban_s=12.0)
+        assert pm.evict_reason(nid) == "slow_peer"
+        victim = await asyncio.wait_for(pm.evict_next(), 1)
+        assert victim == nid
+        assert pm._peers[nid].banned_until == clk.now + 12.0
+        pm.disconnected(nid)
+        assert pm.evict_reason(nid) == ""
+
+    run(go())

@@ -277,6 +277,54 @@ def test_catchup_votes_dropped_during_wait_sync_are_resent():
     run(go())
 
 
+def test_live_votes_dropped_by_partition_are_resent():
+    """Regression for the majority-partition-heal wedge (ISSUE 13,
+    witnessed in the chaos campaign): a 2|2 partition drops in-flight
+    prevotes while every connection SURVIVES, so `_send_vote`'s
+    optimistic `set_has_vote` marks claim delivery; after heal, no
+    side holds 2/3 prevotes, no timeout is scheduled without a +2/3
+    majority, and same-height gossip finds nothing "missing" to send —
+    all four nodes park at (height, round 0, prevote) forever. The
+    live-height gossip stall-reset (reactor.py `live_vote_stall` →
+    `PeerState.reset_live_votes`, the same-height twin of
+    `vote_catchup_stall`) must forget the marks and resend."""
+    from tendermint_tpu.crypto import faults
+
+    async def go():
+        net, nodes = make_cluster(4)
+        await start_cluster(net, nodes)
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout=60.0) for n in nodes)
+            )
+            # p2ptest monikers are node0..node3: cut 2|2 — neither
+            # side can assemble 2/3, and every vote gossiped during
+            # the window is dropped ON a live connection (the exact
+            # shape TCP can't produce but a partitioned WAN can)
+            faults.set_partition("node0,node1|node2,node3")
+            await asyncio.sleep(3.0)  # gossip drains into the void
+            heal_at = max(n.cs.rs.height for n in nodes)
+            faults.set_partition("")
+            # without the stall-reset this times out at heal_at
+            await asyncio.gather(
+                *(
+                    n.cs.wait_for_height(heal_at + 1, timeout=30.0)
+                    for n in nodes
+                )
+            )
+        finally:
+            faults.set_partition("")
+            await stop_cluster(net, nodes)
+        common = min(n.block_store.height() for n in nodes)
+        for height in range(1, common + 1):
+            assert (
+                nodes[1].block_store.load_block(height).hash()
+                == nodes[0].block_store.load_block(height).hash()
+            )
+
+    run(go())
+
+
 def test_lagging_node_catches_up():
     async def go():
         net, nodes = make_cluster(4)

@@ -760,3 +760,127 @@ def test_gossip_rng_replays_from_schedule_seed():
         )
     assert picks[7][0] == picks[7][1], "explore() must pin gossip picks"
     rng.reseed(None)
+
+
+def test_vote_delivery_with_net_faults_schedule_independent():
+    """ISSUE 13 satellite, mirroring the PR-3 device-fault scenario one
+    layer up: duplicated/reordered vote DELIVERY (the schedule)
+    composed with seeded NETWORK faults — drop + reorder rules on the
+    consensus vote channel, armed through a real 2-node router pair —
+    with the fault seeds derived from the schedule seed via
+    Schedule.subseed, so the combined exploration replays from the one
+    seed a failure message names. The sender keeps resending votes the
+    receiver's VoteSet still lacks (the gossip-retry shape; a dropped
+    frame on a live connection is exactly what the stall-reset exists
+    for), so the OUTCOME — the receiver's 2/3-majority decision — must
+    be identical under every schedule."""
+    import time as _time
+
+    from tendermint_tpu.consensus import msgs as cmsgs
+    from tendermint_tpu.consensus.reactor import (
+        VOTE_CHANNEL,
+        consensus_channel_descriptors,
+    )
+    from tendermint_tpu.crypto import faults
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+    from tendermint_tpu.p2p.p2ptest import TestNetwork
+    from tendermint_tpu.p2p.types import Envelope
+    from tendermint_tpu.types.block_id import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator import Validator, ValidatorSet
+    from tendermint_tpu.types.vote import Vote
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    privs = [
+        PrivKeyEd25519.from_seed(bytes([i + 1, 0x77]) + b"\x35" * 30)
+        for i in range(5)
+    ]
+    vals = ValidatorSet(
+        [Validator(pub_key=p.pub_key(), voting_power=10) for p in privs]
+    )
+    order = {v.address: i for i, v in enumerate(vals.validators)}
+    bid = BlockID(
+        hash=b"\x55" * 32,
+        part_set_header=PartSetHeader(total=1, hash=b"\x56" * 32),
+    )
+    now = 1_700_000_000_000_000_000
+    votes = []
+    for p in privs[:4]:  # 40/50 power > 2/3
+        addr = p.pub_key().address()
+        v = Vote(
+            type=PRECOMMIT_TYPE,
+            height=9,
+            round=0,
+            block_id=bid,
+            timestamp_ns=now,
+            validator_address=addr,
+            validator_index=order[addr],
+        )
+        v.signature = p.sign(v.sign_bytes("nf-chain"))
+        votes.append(v)
+
+    vote_desc = consensus_channel_descriptors()[VOTE_CHANNEL]
+
+    async def scenario(sched: Schedule):
+        net = TestNetwork(2, chain_id="nf-chain")
+        chans = [n.open_channel(vote_desc) for n in net.nodes]
+        await net.start()
+        vs = VoteSet("nf-chain", 9, 0, PRECOMMIT_TYPE, vals)
+        stop = asyncio.Event()
+
+        async def ingest():
+            while not stop.is_set():
+                try:
+                    env = await asyncio.wait_for(chans[1].receive(), 0.2)
+                except asyncio.TimeoutError:
+                    continue
+                if isinstance(env.message, cmsgs.VoteMessage):
+                    vs.add_vote(env.message.vote)
+
+        ingester = asyncio.ensure_future(ingest())
+        try:
+            with faults.inject(
+                "p2p.send", mode="drop", p=0.3,
+                seed=sched.subseed("net-drop"), ch=VOTE_CHANNEL,
+            ), faults.inject(
+                "p2p.recv", mode="reorder", p=0.3,
+                seed=sched.subseed("net-reorder"), ch=VOTE_CHANNEL,
+            ), faults.inject(
+                "p2p.recv", mode="duplicate", p=0.2,
+                seed=sched.subseed("net-dup"), ch=VOTE_CHANNEL,
+            ):
+                plan = sched.with_dups(sched.shuffled(votes), 3)
+                for v in plan:
+                    await chans[0].send(
+                        Envelope(
+                            message=cmsgs.VoteMessage(vote=v),
+                            to=net.nodes[1].node_id,
+                        )
+                    )
+                    await sched.yield_point()
+                # gossip-retry: resend whatever the drops ate until
+                # the receiver's set is complete (bounded)
+                deadline = _time.monotonic() + 20.0
+                while (
+                    len(list(vs.bit_array().indices())) < len(votes)
+                    and _time.monotonic() < deadline
+                ):
+                    for v in votes:
+                        await chans[0].send(
+                            Envelope(
+                                message=cmsgs.VoteMessage(vote=v),
+                                to=net.nodes[1].node_id,
+                            )
+                        )
+                    await asyncio.sleep(0.05)
+            maj, ok = vs.two_thirds_majority()
+            return (ok, maj.hash, str(vs.votes_bit_array))
+        finally:
+            stop.set()
+            ingester.cancel()
+            await asyncio.gather(ingester, return_exceptions=True)
+            await net.stop()
+
+    ok, maj_hash, _bits = run(
+        explore(scenario, schedules=6, base_seed=900)
+    )
+    assert ok and maj_hash == bid.hash
