@@ -569,6 +569,153 @@ def bench_tmsafe_gate():
     }
 
 
+def bench_tmcost_gate():
+    """Full tmcost per-request cost-bound gate (scripts/lint.py
+    --cost): wall time plus per-rule finding, suppression, and budget
+    counts, recorded in every BENCH_* line so a gate-runtime
+    regression (or an unbudgeted route slipping into the serving
+    surface) shows up next to the numbers it guards. Pure stdlib AST
+    over the package — banked CPU block, never initializes jax
+    (pinned by tests/test_bench_guard.py)."""
+    from tendermint_tpu.analysis import tmcost
+
+    t0 = time.perf_counter()
+    rep = tmcost.analyze()
+    wall = time.perf_counter() - t0
+    # read the gate's own stats so this row can never diverge from it
+    per_rule = {
+        rid: rep.stats.get(f"findings[{rid}]", 0)
+        for rid, _ in tmcost.RULES
+    }
+    return {
+        "wall_s": round(wall, 2),
+        "findings": per_rule,
+        "suppressed": rep.stats.get("suppressed", 0),
+        "roots": rep.stats.get("roots", 0),
+        "region": rep.stats.get("region", 0),
+        "budgeted": rep.stats.get("budgeted", 0),
+    }
+
+
+def bench_serving_cache_page(
+    n_vals: int = 150, page: int = 20, reps: int = 3, rounds: int = 3
+):
+    """ISSUE 14's serving half: warm `light_blocks` page serving,
+    interleaved A/B.
+
+      A  warm serving cache: the page is assembled from held
+         per-block `LightBlock.to_proto()` blobs (rpc/servingcache.py
+         — the tmcost cost-recompute fix)
+      B  the pre-fix shape (`servingcache.disabled()`): every request
+         re-loads each block from the store (a decode per artifact,
+         like the real KV-backed store pays) and re-encodes it
+
+    Both arms call the REAL route handler against the same
+    proto-backed stub stores; ms per page serve, medians of round
+    medians. Banked CPU block: no jax anywhere near this path."""
+    import asyncio
+
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.rpc import servingcache
+    from tendermint_tpu.rpc.core import Environment
+    from tendermint_tpu.rpc.jsonrpc import RPCRequest
+    from tendermint_tpu.rpc.metrics import RPCMetrics
+    from tendermint_tpu.types.commit import Commit
+    from tendermint_tpu.types.header import Header
+    from tendermint_tpu.types.validator import ValidatorSet
+
+    chain_id = "bench-servingcache"
+    lbs = _build_light_chain(chain_id, page + 2, n_vals)
+    headers = {
+        h: lb.signed_header.header.to_proto() for h, lb in lbs.items()
+    }
+    commits = {
+        h: lb.signed_header.commit.to_proto() for h, lb in lbs.items()
+    }
+    valsets = {h: lb.validator_set.to_proto() for h, lb in lbs.items()}
+    top = max(lbs)
+
+    class _BS:
+        # a real store decodes fresh objects from KV bytes per load —
+        # the stub must too, or arm B undercounts the re-assembly
+        def height(self):
+            return top
+
+        def base(self):
+            return min(lbs)
+
+        def load_block_meta(self, h):
+            raw = headers.get(h)
+            if raw is None:
+                return None
+
+            class M:
+                pass
+
+            m = M()
+            m.header = Header.from_proto(raw)
+            return m
+
+        def load_block_commit(self, h):
+            raw = commits.get(h)
+            return Commit.from_proto(raw) if raw is not None else None
+
+        def load_seen_commit(self):
+            return None
+
+    class _SS:
+        def load_validators(self, h):
+            raw = valsets.get(h)
+            return (
+                ValidatorSet.from_proto(raw) if raw is not None else None
+            )
+
+    env = Environment(
+        chain_id=chain_id,
+        block_store=_BS(),
+        state_store=_SS(),
+        metrics=RPCMetrics(Registry()),
+    )
+    req = RPCRequest(
+        method="light_blocks",
+        params={"min_height": 2, "max_height": 2 + page - 1},
+        req_id=1,
+    )
+
+    def serve() -> float:
+        t0 = time.perf_counter()
+        res = asyncio.run(env.light_blocks(req))
+        dt = time.perf_counter() - t0
+        assert res["count"] == page
+        return dt
+
+    serve()  # prime the cache for arm A
+    a_r, b_r = [], []
+    for _ in range(max(rounds, 1)):
+        a_t, b_t = [], []
+        for _ in range(reps):
+            a_t.append(serve())
+            with servingcache.disabled():
+                b_t.append(serve())
+        a_t.sort(), b_t.sort()
+        a_r.append(a_t[len(a_t) // 2])
+        b_r.append(b_t[len(b_t) // 2])
+    a_r.sort(), b_r.sort()
+    a = a_r[len(a_r) // 2]
+    b = b_r[len(b_r) // 2]
+    hits = env.metrics.servingcache_hits._values.get((), 0.0)
+    return {
+        "validators": n_vals,
+        "page": page,
+        "warm_serve_ms": round(a * 1e3, 2),
+        "uncached_serve_ms": round(b * 1e3, 2),
+        "speedup_warm": round(b / a, 1),
+        "cache_hits": int(hits),
+        "interleave": f"A/B x{reps} reps x{rounds} rounds, "
+        "median-of-round-medians",
+    }
+
+
 def _build_light_chain(chain_id: str, n_heights: int, n_vals: int):
     """A verifiable chain of LightBlocks 1..n_heights with a static
     n_vals validator set (the BASELINE config-4 shape)."""
@@ -1866,11 +2013,20 @@ def main() -> None:
         "merkle_multiproof_10k",
         600.0,
     )
+    cpu_stage(
+        "serving_cache",
+        lambda: bench_serving_cache_page(),
+        "light_blocks_page_serve",
+        600.0,
+    )
     _persist_stateless(
         {
             "merkle_multiproof_10k": extra.get("merkle_multiproof_10k"),
             "light_sync_bulk_150vals": extra.get(
                 "light_sync_bulk_150vals"
+            ),
+            "light_blocks_page_serve": extra.get(
+                "light_blocks_page_serve"
             ),
         }
     )
@@ -1889,6 +2045,12 @@ def main() -> None:
         "tmsafe_gate",
         bench_tmsafe_gate,
         "tmsafe_gate",
+        120.0,
+    )
+    cpu_stage(
+        "tmcost_gate",
+        bench_tmcost_gate,
+        "tmcost_gate",
         120.0,
     )
     cpu_stage(

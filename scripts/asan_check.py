@@ -51,6 +51,72 @@ def main() -> int:
     return run_checks(os.environ["TM_ASAN_CHILD"])
 
 
+def _ed25519_keygen():
+    """(make_signer(seed) -> obj with .sign(msg), pub_bytes(signer))
+    for the sweep's test signatures.
+
+    Prefers the OpenSSL-backed `cryptography` wheel; a container
+    without the wheel (this box — PR 1 gated the dependency) falls
+    back to the repo's pure-Python RFC-8032 signer. The fallback is
+    a TOOLCHAIN substitution, not a weakening: both paths produce the
+    identical deterministic RFC-8032 signatures, and the fallback is
+    pinned against RFC 8032 test vector 1 here before anything trusts
+    it — a broken signer would otherwise launder wrong-signature
+    results into the memory sweep."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        def make(seed: bytes):
+            return Ed25519PrivateKey.from_private_bytes(seed)
+
+        def pub(sk) -> bytes:
+            return sk.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw
+            )
+
+        return make, pub
+    except ImportError:
+        from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+        def make(seed: bytes):
+            return PrivKeyEd25519(seed)
+
+        def pub(sk) -> bytes:
+            return sk.pub_key().bytes()
+
+        # RFC 8032 §7.1 TEST 1: seed -> pub key and empty-message
+        # signature must match bit-for-bit before the sweep runs.
+        # Explicit raises, not asserts: `python -O` must not compile
+        # the guard away
+        vec = make(bytes.fromhex(
+            "9d61b19deffd5a60ba844af492ec2cc4"
+            "4449c5697b326919703bac031cae7f60"
+        ))
+        if pub(vec) != bytes.fromhex(
+            "d75a980182b10ab7d54bfed3c964073a"
+            "0ee172f3daa62325af021a68f707511a"
+        ):
+            raise RuntimeError(
+                "fallback ed25519 keygen diverges from RFC 8032"
+            )
+        if vec.sign(b"") != bytes.fromhex(
+            "e5564300c360ac729086e2cc806e828a"
+            "84877f1eb8e5d974d873e06522490155"
+            "5fb8821590a33bacc61e39701cf9b46b"
+            "d25bf5f0595bbe24655141438e7a100b"
+        ):
+            raise RuntimeError(
+                "fallback ed25519 signer diverges from RFC 8032"
+            )
+        return make, pub
+
+
 def run_checks(so: str) -> int:
     sys.path.insert(0, REPO)
     lib = ctypes.CDLL(so)
@@ -76,20 +142,11 @@ def run_checks(so: str) -> int:
     for ln in (0, 1, 111, 112, 113, 127, 128, 129, 600):
         lib.tm_sha512_test(random.randbytes(ln), ln, out64)
 
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
-    from cryptography.hazmat.primitives.serialization import (
-        Encoding,
-        PublicFormat,
-    )
-
+    make_signer, pub_bytes = _ed25519_keygen()
     keys = []
     for i in range(8):
-        sk = Ed25519PrivateKey.from_private_bytes(bytes([i + 1]) * 32)
-        keys.append(
-            (sk, sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw))
-        )
+        sk = make_signer(bytes([i + 1]) * 32)
+        keys.append((sk, pub_bytes(sk)))
     # sizes hitting Straus (<512 sigs), Pippenger w8, and w11 (>1700)
     for n in (1, 2, 7, 48, 600, 2048):
         pks, sigs, blob = bytearray(), bytearray(), bytearray()

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""tmlint + tmcheck + tmrace + tmtrace + tmlive + tmsafe CLI — the
-consensus-invariant static analyzers.
+"""tmlint + tmcheck + tmrace + tmtrace + tmlive + tmsafe + tmcost CLI
+— the consensus-invariant static analyzers.
 
 Usage:
     python scripts/lint.py                    # full gate: tmlint +
@@ -16,6 +16,10 @@ Usage:
                                               # boundedness pass only
     python scripts/lint.py --adv              # tmsafe adversarial-input
                                               # safety pass only
+    python scripts/lint.py --cost             # tmcost per-request
+                                              # cost-bound pass only
+    python scripts/lint.py --cost-update      # regenerate the reviewed
+                                              # per-request budget table
     python scripts/lint.py --memo-audit       # memo-soundness audit
                                               # only (prints the full
                                               # memoized-function list)
@@ -53,16 +57,19 @@ tendermint_tpu/analysis/tmcheck/taint_baseline.json (taint),
 tendermint_tpu/analysis/tmrace/race_baseline.json (race),
 tendermint_tpu/analysis/tmtrace/trace_baseline.json (trace),
 tendermint_tpu/analysis/tmlive/live_baseline.json (live),
-tendermint_tpu/analysis/tmsafe/safe_baseline.json (adv), and the
+tendermint_tpu/analysis/tmsafe/safe_baseline.json (adv),
+tendermint_tpu/analysis/tmcost/cost_baseline.json (cost), and the
 golden tables tendermint_tpu/analysis/tmcheck/schema.json +
-tendermint_tpu/analysis/tmtrace/jit_signatures.json.
---baseline-update / --schema-update / --signatures-update refuse
-filtered runs (a subset scan would silently overwrite the whole
-file). docs/static_analysis.md documents the workflow and the
-suppression policy (`# tmlint: disable=<rule>`, `# tmcheck:
-taint-ok/taint-break`, `# tmcheck: unparsed=N/unwritten=N`,
-`# tmrace: race-ok/guarded-by`, `# tmtrace: trace-ok`,
-`# tmlive: block-ok/grow-ok/bounded=`, `# tmsafe: <rule>-ok`).
+tendermint_tpu/analysis/tmtrace/jit_signatures.json +
+tendermint_tpu/analysis/tmcost/cost_budgets.json.
+--baseline-update / --schema-update / --signatures-update /
+--cost-update refuse filtered runs (a subset scan would silently
+overwrite the whole file). docs/static_analysis.md documents the
+workflow and the suppression policy (`# tmlint: disable=<rule>`,
+`# tmcheck: taint-ok/taint-break`, `# tmcheck:
+unparsed=N/unwritten=N`, `# tmrace: race-ok/guarded-by`,
+`# tmtrace: trace-ok`, `# tmlive: block-ok/grow-ok/bounded=`,
+`# tmsafe: <rule>-ok`, `# tmcost: <rule>-ok`).
 
 The full gate parses the package ONCE: the tmcheck call-graph build is
 the shared substrate every section (including tmlint's syntactic rules
@@ -81,6 +88,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tendermint_tpu.analysis import (  # noqa: E402
     tmcheck,
+    tmcost,
     tmlint,
     tmlive,
     tmrace,
@@ -137,6 +145,15 @@ def main(argv=None) -> int:
         help="run only the tmsafe adversarial-input safety pass",
     )
     ap.add_argument(
+        "--cost", action="store_true",
+        help="run only the tmcost per-request cost-bound pass",
+    )
+    ap.add_argument(
+        "--cost-update", action="store_true", dest="cost_update",
+        help="regenerate the reviewed per-request cost budget table "
+             "(tendermint_tpu/analysis/tmcost/cost_budgets.json)",
+    )
+    ap.add_argument(
         "--memo-audit", action="store_true", dest="memo_audit",
         help="run only the memo-soundness audit and print the full "
              "memoized-function listing (tmcheck.memoaudit)",
@@ -187,6 +204,8 @@ def main(argv=None) -> int:
             print(f"{rid}: {title}")
         for rid, title in tmsafe.RULES:
             print(f"{rid}: {title}")
+        for rid, title in tmcost.RULES:
+            print(f"{rid}: {title}")
         return 0
 
     filtered = bool(args.rules or args.paths)
@@ -218,6 +237,7 @@ def main(argv=None) -> int:
         or args.race
         or args.live
         or args.adv
+        or args.cost
         or args.memo_audit
         or trace_selected
     ):
@@ -227,8 +247,8 @@ def main(argv=None) -> int:
         # the update mode below disables them)
         print(
             "error: --schema-update requires a full-package run "
-            "(drop --rule/--taint/--race/--live/--adv/--memo-audit/"
-            "--trace and path arguments)",
+            "(drop --rule/--taint/--race/--live/--adv/--cost/"
+            "--memo-audit/--trace and path arguments)",
             file=sys.stderr,
         )
         return 2
@@ -239,6 +259,7 @@ def main(argv=None) -> int:
         or args.race
         or args.live
         or args.adv
+        or args.cost
         or args.memo_audit
         or trace_selected
         or args.schema_update
@@ -248,6 +269,30 @@ def main(argv=None) -> int:
         # run would silently skip the named gate while returning 0
         print(
             "error: --signatures-update requires a full-package run "
+            "(drop --rule/--taint/--schema/--race/--live/--adv/--cost/"
+            "--memo-audit/--trace/other update modes and path "
+            "arguments)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.cost_update and (
+        filtered
+        or args.taint
+        or args.schema
+        or args.race
+        or args.live
+        or args.adv
+        or args.memo_audit
+        or trace_selected
+        or args.schema_update
+        or args.signatures_update
+        or args.baseline_update
+    ):
+        # the budget table covers EVERY serving root in the package; a
+        # combined run would silently skip the named gate while
+        # returning 0 (same hazard class as --schema-update)
+        print(
+            "error: --cost-update requires a full-package run "
             "(drop --rule/--taint/--schema/--race/--live/--adv/"
             "--memo-audit/--trace/other update modes and path "
             "arguments)",
@@ -261,6 +306,7 @@ def main(argv=None) -> int:
         or args.race
         or args.live
         or args.adv
+        or args.cost
         or args.memo_audit
         or trace_selected
     )
@@ -271,6 +317,7 @@ def main(argv=None) -> int:
         "race": args.race,
         "live": args.live,
         "adv": args.adv,
+        "cost": args.cost,
         "memo": args.memo_audit,
         "trace": trace_selected,
     }
@@ -286,6 +333,7 @@ def main(argv=None) -> int:
     run_race = _only("race")
     run_live = _only("live")
     run_adv = _only("adv")
+    run_cost = _only("cost")
     run_memo = _only("memo")
     run_trace = _only("trace")
     # update modes run ONLY the sections they update: computing (then
@@ -300,6 +348,7 @@ def main(argv=None) -> int:
         run_race = False
         run_live = False
         run_adv = False
+        run_cost = False
         run_memo = False
         run_trace = False
     if args.signatures_update:
@@ -309,6 +358,17 @@ def main(argv=None) -> int:
         run_race = False
         run_live = False
         run_adv = False
+        run_cost = False
+        run_memo = False
+        run_trace = False
+    if args.cost_update:
+        run_tmlint = False
+        run_taint = False
+        run_schema = False
+        run_race = False
+        run_live = False
+        run_adv = False
+        run_cost = False
         run_memo = False
         run_trace = False
 
@@ -326,9 +386,11 @@ def main(argv=None) -> int:
         or run_race
         or run_live
         or run_adv
+        or run_cost
         or run_memo
         or run_trace
         or args.signatures_update
+        or args.cost_update
     )
     try:
         if needs_graph:
@@ -466,6 +528,55 @@ def main(argv=None) -> int:
                     )
                 )
 
+        if run_cost:
+            # one analyze() pass serves report, baseline diff AND the
+            # budget gate (same single-pass rule as tmrace/tmtrace)
+            cost_pkg = pkg or tmcheck.build_package()
+            pkg = cost_pkg
+            cost_v = tmcost.cost_violations(cost_pkg)
+            violations.extend(cost_v)
+            # golden-gated cost-budget findings can NEVER be absorbed
+            # by the counted baseline — their accepted state is
+            # cost_budgets.json (--cost-update)
+            cost_base, cost_gated = tmcost.split_baselineable(cost_v)
+            if args.baseline_update:
+                counts = tmlint.save_baseline(
+                    cost_base,
+                    tmcost.COST_BASELINE_PATH,
+                    note=tmcost.COST_BASELINE_NOTE,
+                )
+                print(
+                    f"cost baseline updated: {len(counts)} fingerprints "
+                    f"-> {tmcost.COST_BASELINE_PATH}"
+                )
+                if cost_gated:
+                    print(
+                        f"note: {len(cost_gated)} golden-gated tmcost "
+                        "finding(s) were NOT baselined (fix them or run "
+                        "--cost-update):",
+                        file=sys.stderr,
+                    )
+                    new.extend(cost_gated)
+            elif args.no_baseline:
+                new.extend(cost_v)
+            else:
+                new.extend(
+                    tmlint.new_violations(
+                        cost_base,
+                        tmlint.load_baseline(tmcost.COST_BASELINE_PATH),
+                    )
+                )
+                new.extend(cost_gated)
+
+        if args.cost_update:
+            cost_pkg = pkg or tmcheck.build_package()
+            pkg = cost_pkg
+            data = tmcost.update_budgets(cost_pkg)
+            print(
+                f"cost budgets updated: {len(data['roots'])} serving "
+                f"roots -> {tmcost.BUDGETS_PATH}"
+            )
+
         if run_memo:
             # no baseline: every memo-audit finding is a new violation
             memo_pkg = pkg or tmcheck.build_package()
@@ -559,7 +670,12 @@ def main(argv=None) -> int:
         return 2
     elapsed = time.monotonic() - t0
 
-    if args.baseline_update or args.schema_update or args.signatures_update:
+    if (
+        args.baseline_update
+        or args.schema_update
+        or args.signatures_update
+        or args.cost_update
+    ):
         # `new` is non-empty here only for golden-gated tmtrace
         # findings an update mode refused to absorb: surface them and
         # fail so the operator can't mistake the update for acceptance
@@ -583,6 +699,7 @@ def main(argv=None) -> int:
                 ("race", run_race),
                 ("live", run_live),
                 ("adv", run_adv),
+                ("cost", run_cost),
                 ("memo", run_memo),
                 ("trace", run_trace),
             )
@@ -609,9 +726,10 @@ def main(argv=None) -> int:
             "taint-ok/taint-break/unparsed=N, # tmrace: "
             "race-ok/guarded-by=..., # tmtrace: trace-ok, "
             "# tmlive: block-ok/grow-ok/bounded=..., "
-            "# tmsafe: <rule>-ok), or for "
+            "# tmsafe: <rule>-ok, # tmcost: <rule>-ok), or for "
             "consciously accepted changes run scripts/lint.py "
-            "--baseline-update / --schema-update / --signatures-update.",
+            "--baseline-update / --schema-update / --signatures-update "
+            "/ --cost-update.",
             file=sys.stderr,
         )
         return 1

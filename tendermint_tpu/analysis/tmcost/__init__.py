@@ -1,0 +1,354 @@
+"""tmcost — whole-program per-request cost-bound proof.
+
+The six analyzers before this one (PRs 4–10) prove what serving code
+*does*; tmcost proves how much a single request is ALLOWED TO COST.
+Stateless-client workloads hammer a node with repeated proof/header
+requests (arxiv 2504.14069), and commit-verification cost as a
+function of committee size is the paper's central trade (arxiv
+2302.00418) — so every serving root (RPC route handler, p2p recv
+handler, per-block consensus entry point) gets a symbolic per-request
+cost class derived by an interprocedural loop-bound **provenance**
+dataflow (boundflow.py) and checked against the reviewed golden budget
+table `cost_budgets.json`.
+
+Rules:
+
+- ``cost-superlinear`` — a request's cost term acquires two
+  lin-or-worse factors (nested unbounded bounds); the static twin of
+  tmsafe's quadratic-decode, over OUR loops, not just attacker taint.
+- ``cost-recompute`` — known-expensive pure work (to_proto / hash /
+  merkle-tree / page assembly) on a store-derived per-block-immutable
+  value inside the serving region: cacheable work paid per request.
+  The serving cache (rpc/servingcache.py) is the sanctioned memo
+  layer and is exempt (its miss path is where that work belongs).
+- ``cost-unclamped-alloc`` — allocation proportional to a
+  store-or-worse bound with no clamp.
+- ``cost-budget`` — GOLDEN-GATED (never baselineable, the tmtrace
+  drift-rule class): a serving root missing from `cost_budgets.json`,
+  a computed cost differing from the reviewed budget (either
+  direction — a cheaper route is also a reviewed change), or a stale
+  table entry. Reviewed update via `scripts/lint.py --cost-update`
+  (refused on filtered/combined runs, the established matrix).
+
+Suppressions: ``# tmcost: <rule>-ok — why`` on the offending line or
+in the comment block above (comment_cover_lines, shared family-wide).
+Counted fingerprint baseline `cost_baseline.json` ships — and is
+pinned by test — EMPTY.
+
+Run via `scripts/lint.py --cost` (in the default full gate). The
+dynamic twin is the tmload harness (docs/load.md): tmcost bounds what
+a request MAY cost by construction; tmload measures what it DOES cost
+under production traffic. The division of labor is documented in
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tmlint import (
+    Violation,
+    comment_cover_lines,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from ..tmcheck.callgraph import Package, build_package
+from . import boundflow, roots as roots_mod  # noqa: F401
+from .boundflow import CostEngine
+from .roots import CONSENSUS_ROOTS, Root, discover_roots, root_id
+
+__all__ = [
+    "RULES",
+    "NON_BASELINE_RULES",
+    "BUDGETS_PATH",
+    "COST_BASELINE_PATH",
+    "COST_BASELINE_NOTE",
+    "CostReport",
+    "analyze",
+    "cost_violations",
+    "new_cost_violations",
+    "update_cost_baseline",
+    "load_budgets",
+    "update_budgets",
+    "split_baselineable",
+    "suppressed_lines",
+]
+
+BUDGETS_PATH = os.path.join(os.path.dirname(__file__), "cost_budgets.json")
+COST_BASELINE_PATH = os.path.join(
+    os.path.dirname(__file__), "cost_baseline.json"
+)
+
+COST_BASELINE_NOTE = (
+    "Accepted pre-existing per-request cost findings, fingerprinted by "
+    "rule:path:sha1(source_line)[:12]. New findings are anything over "
+    "these counts. Do not hand-edit counts to sneak a finding in — fix "
+    "it, or suppress it in-file with a justified "
+    "'# tmcost: <rule>-ok — why'. cost-budget findings can NEVER land "
+    "here: their accepted state is cost_budgets.json "
+    "(scripts/lint.py --cost-update)."
+)
+
+BUDGETS_NOTE = (
+    "Reviewed per-request cost budgets for every serving root. The "
+    "cost strings are boundflow terms (provenance classes joined by "
+    "'*'); the gate fails on ANY drift — a new root, a removed root, "
+    "or a changed cost in either direction. Update via scripts/lint.py "
+    "--cost-update and REVIEW the diff: a budget raise is a product "
+    "decision, not a formality."
+)
+
+RULES = [
+    (
+        "cost-superlinear",
+        "a per-request cost term with two known-unbounded "
+        "(vset-or-worse) factors: nested unbounded iteration paid per "
+        "request",
+    ),
+    (
+        "cost-recompute",
+        "known-expensive pure work (to_proto/hash/merkle/page assembly) "
+        "on per-block-immutable store content, recomputed per request "
+        "instead of held in the serving cache",
+    ),
+    (
+        "cost-unclamped-alloc",
+        "allocation proportional to a store-or-worse bound with no "
+        "clamp between derivation and use",
+    ),
+    (
+        "cost-budget",
+        "serving root missing from cost_budgets.json, computed cost "
+        "drifting from the reviewed budget, or a stale budget entry "
+        "(golden-gated: fix or --cost-update, never baselineable)",
+    ),
+]
+
+NON_BASELINE_RULES = frozenset({"cost-budget"})
+
+_SUPPRESS_RE = re.compile(r"#\s*tmcost:\s*(cost-[a-z\-]+)-ok\b")
+
+
+def suppressed_lines(lines: List[str]) -> Dict[str, Set[int]]:
+    """rule -> covered line numbers for `# tmcost: <rule>-ok — why`
+    annotations (same comment-block-above convention as the family)."""
+    out: Dict[str, Set[int]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        out.setdefault(m.group(1), set()).update(
+            comment_cover_lines(lines, i, text)
+        )
+    return out
+
+
+def split_baselineable(violations: List[Violation]):
+    """(baselineable, golden_gated): cost-budget findings can never be
+    absorbed by the counted baseline — their accepted state is the
+    budget table itself (same class as tmtrace's drift rules)."""
+    base = [v for v in violations if v.rule not in NON_BASELINE_RULES]
+    gated = [v for v in violations if v.rule in NON_BASELINE_RULES]
+    return base, gated
+
+
+def load_budgets(path: Optional[str] = None) -> Dict[str, dict]:
+    path = path or BUDGETS_PATH
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return data.get("roots", {})
+
+
+class CostReport:
+    def __init__(self) -> None:
+        self.roots: List[Root] = []
+        self.engine: Optional[CostEngine] = None
+        self.findings: List[boundflow.Finding] = []
+        self.costs: Dict[str, dict] = {}  # root_id -> {family, cost}
+        self.violations: List[Violation] = []
+        self.stats: Dict[str, int] = {}
+        # (rule, path, line) of findings dropped by an in-file
+        # suppression — the head-catalog test pins this set
+        self.suppressed: List[tuple] = []
+
+
+def _computed_costs(
+    engine: CostEngine, roots: List[Root]
+) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for r in roots:
+        out[root_id(r.key)] = {
+            "family": r.family,
+            "cost": engine.cost_of(r.key),
+        }
+    return out
+
+
+def analyze(
+    pkg: Optional[Package] = None,
+    budgets_path: Optional[str] = None,
+) -> CostReport:
+    pkg = pkg or build_package()
+    report = CostReport()
+    report.roots = discover_roots(pkg)
+    engine = CostEngine(pkg, report.roots)
+    report.engine = engine
+    findings = engine.run()
+    report.findings = findings
+    report.costs = _computed_costs(engine, report.roots)
+
+    supp: Dict[str, Dict[str, Set[int]]] = {}
+    for path, mod in pkg.modules.items():
+        m = suppressed_lines(mod.lines)
+        if m:
+            supp[path] = m
+
+    def line_text(path: str, lineno: int) -> str:
+        lines = pkg.modules[path].lines
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1].strip()
+        return ""
+
+    violations: List[Violation] = []
+    n_supp = 0
+    for f in findings:
+        if f.lineno in supp.get(f.path, {}).get(f.rule, ()):
+            n_supp += 1
+            report.suppressed.append((f.rule, f.path, f.lineno))
+            continue
+        chain = engine.chain(f.key)
+        violations.append(
+            Violation(
+                rule=f.rule,
+                path=f.path,
+                line=f.lineno,
+                col=f.col,
+                message=f"{f.detail}; witness: {' -> '.join(chain)}",
+                source=line_text(f.path, f.lineno),
+            )
+        )
+
+    # -- the budget gate (golden; drift in either direction is red) --
+    budgets = load_budgets(budgets_path)
+    for rid, rec in sorted(report.costs.items()):
+        key = tuple(rid.split(":", 1))
+        fi = pkg.functions.get(key)  # roots always resolve
+        lineno = fi.lineno if fi is not None else 1
+        src = line_text(key[0], lineno) if fi is not None else ""
+        golden = budgets.get(rid)
+        if golden is None:
+            violations.append(
+                Violation(
+                    rule="cost-budget",
+                    path=key[0],
+                    line=lineno,
+                    col=0,
+                    message=(
+                        f"serving root {rid} [{rec['family']}] has no "
+                        "reviewed cost budget (computed: "
+                        f"{rec['cost']}); a new route cannot ship "
+                        "unbudgeted — review and run scripts/lint.py "
+                        "--cost-update"
+                    ),
+                    source=src,
+                )
+            )
+        elif golden.get("cost") != rec["cost"] or golden.get(
+            "family"
+        ) != rec["family"]:
+            violations.append(
+                Violation(
+                    rule="cost-budget",
+                    path=key[0],
+                    line=lineno,
+                    col=0,
+                    message=(
+                        f"cost drift at {rid}: computed {rec['cost']} "
+                        f"[{rec['family']}] vs budgeted "
+                        f"{golden.get('cost')} [{golden.get('family')}]"
+                        " — fix the regression or review with "
+                        "--cost-update"
+                    ),
+                    source=src,
+                )
+            )
+    for rid in sorted(set(budgets) - set(report.costs)):
+        violations.append(
+            Violation(
+                rule="cost-budget",
+                path=rid.split(":", 1)[0],
+                line=1,
+                col=0,
+                message=(
+                    f"stale budget entry {rid}: no such serving root "
+                    "in the package — remove it via --cost-update"
+                ),
+                source=rid,
+            )
+        )
+
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    report.violations = violations
+    per_rule: Dict[str, int] = {rid: 0 for rid, _ in RULES}
+    for v in violations:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+    report.stats = {
+        "roots": len(report.roots),
+        "region": sum(
+            1 for st in engine.states.values() if st.analyzed
+        ),
+        "suppressed": n_supp,
+        "budgeted": len(budgets),
+        **{f"findings[{rid}]": n for rid, n in per_rule.items()},
+    }
+    return report
+
+
+def cost_violations(pkg: Optional[Package] = None) -> List[Violation]:
+    return analyze(pkg).violations
+
+
+def new_cost_violations(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+) -> List[Violation]:
+    """Counted-baseline diff for the dataflow rules, PLUS every
+    golden-gated cost-budget finding (those are always new)."""
+    violations = cost_violations(pkg)
+    base, gated = split_baselineable(violations)
+    baseline = load_baseline(baseline_path or COST_BASELINE_PATH)
+    return new_violations(base, baseline) + gated
+
+
+def update_cost_baseline(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+) -> Dict[str, int]:
+    base, _gated = split_baselineable(cost_violations(pkg))
+    return save_baseline(
+        base,
+        baseline_path or COST_BASELINE_PATH,
+        note=COST_BASELINE_NOTE,
+    )
+
+
+def update_budgets(
+    pkg: Optional[Package] = None,
+    path: Optional[str] = None,
+) -> Dict[str, dict]:
+    """Regenerate the golden budget table from the live analysis —
+    the reviewed-update half of the cost-budget gate."""
+    report = analyze(pkg, budgets_path=path)
+    data = {"note": BUDGETS_NOTE, "roots": report.costs}
+    out = path or BUDGETS_PATH
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data

@@ -1,0 +1,1012 @@
+"""Loop-bound provenance dataflow: the per-request cost engine.
+
+Every loop and comprehension bound in the serving region is classified
+into a provenance lattice — not "how big" but "who controls it":
+
+- ``const``    — literal / SCREAMING config constant / fixed container
+- ``clamped``  — explicitly bounded by a config clamp: ``min(n, MAX_*)``,
+  ``items[:CAP]``, ``range(min(...))`` (tmsafe amplify's recognizers,
+  widened to any SCREAMING-name slice bound)
+- ``lin``      — an unknown in-process collection (peers, sinks,
+  subscriptions): linear in node-local state
+- ``vset``     — validator-set-size-proportional (validators,
+  signatures, powers — the committee-size axis of arxiv 2302.00418)
+- ``block``    — block-content-proportional (txs, parts, evidence,
+  events)
+- ``store``    — store-height-range-proportional (``height() - base()``
+  walks: grows without bound over the chain's life)
+- ``attacker`` — derived from request params / peer message fields with
+  no clamp between parse and use (the tmsafe VAL class, seen from the
+  cost side)
+
+The interprocedural half is the tmsafe shape: a monotone fixpoint over
+the PR-5 call graph with one joined context per function. Each
+function's **cost summary** is a set of *terms* — multisets of bound
+classes, e.g. ``('vset',)`` for verify_commit's tally loop or
+``('clamped', 'block')`` for a capped page of per-block work — and a
+call site folds the callee's terms into the caller under the caller's
+enclosing loop context, so a per-validator helper called inside a
+per-part loop correctly costs ``block*vset``. Program-order walk, no
+operand short-circuit (the PR-8/PR-10 vacuous-clean lesson, re-pinned
+by tests/test_tmcost.py).
+
+Three rules fire during the walk:
+
+- ``cost-superlinear`` — a term acquires its second KNOWN-unbounded
+  (``vset``-or-worse) factor: nested unbounded iteration per request.
+  One clamp is enough (``clamped`` factors never count), same calculus
+  as tmsafe's amplification rule but over OUR bounds, not just
+  attacker taint; ``lin`` factors stay visible in budget terms (drift
+  guards them) without firing the rule.
+- ``cost-recompute`` — a known-expensive pure call (``to_proto`` /
+  ``hash`` / merkle-tree construction; the EXPENSIVE catalogs) on a
+  *stable* input — a value derived from a block/state-store load, i.e.
+  per-block-immutable content whose encoding is recomputed per
+  request. Functions living in a recognized serving-cache module
+  (CACHE_MODULE_NAMES) are exempt: their miss path IS the one place
+  that work belongs.
+- ``cost-unclamped-alloc`` — ``bytes(n)``/``bytearray(n)``/sequence
+  repetition sized by a ``store``-or-worse bound with no clamp.
+
+Stability is a second boolean dataflow riding the same fixpoint:
+born at ``*store.load_*`` calls, propagated through attributes,
+subscripts, container accumulation, constructor wrapping, and callee
+RETURN summaries (``_light_block_at`` returns stable because its body
+assembles store loads). It deliberately does NOT propagate through
+parameters: a context-insensitive param join marks a value stable for
+EVERY caller once ANY caller passes store content (evidence objects
+are store-derived in the block path but request content in the RPC
+path), and that contamination produced six false recompute findings
+on the first development run. The cost is an under-approximation —
+a handler that loads a block and hands it to a helper for encoding is
+seen only if the helper's own body touches the store — documented
+here and in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..tmcheck.callgraph import CallSite, FuncInfo, Package
+from ..tmsafe import amplify
+from .roots import Root
+
+__all__ = [
+    "CONST",
+    "CLAMPED",
+    "LIN",
+    "VSET",
+    "BLOCK",
+    "STORE",
+    "ATTACKER",
+    "CLASS_NAMES",
+    "CostEngine",
+    "Finding",
+    "render_term",
+]
+
+FuncKey = Tuple[str, str]
+
+CONST = 0
+CLAMPED = 1
+LIN = 2
+VSET = 3
+BLOCK = 4
+STORE = 5
+ATTACKER = 6
+
+CLASS_NAMES = [
+    "const", "clamped", "lin", "vset", "block", "store", "attacker",
+]
+
+# attribute/name markers for protocol-shaped collections. Reviewed:
+# widening a marker set changes what the whole gate sees.
+VSET_MARKERS = frozenset({
+    "validators", "signatures", "powers", "pub_keys", "pubkeys",
+    "voting_powers", "precommits_list",
+})
+BLOCK_MARKERS = frozenset({
+    "txs", "parts", "evidence", "events", "deliver_tx_objs",
+    "tx_results", "leaves", "chunks",
+})
+
+# known-expensive pure methods: receiver content fully determines the
+# result, and the work is proportional to the receiver's size
+EXPENSIVE_ATTRS = frozenset({
+    "to_proto", "to_proto_bytes", "hash_bytes", "sign_bytes", "hash",
+})
+# known-expensive in-package functions (path, qualname): merkle tree /
+# page assembly — the stateless-serving constructors
+EXPENSIVE_TARGETS = frozenset({
+    ("crypto/merkle.py", "MerkleMultiTree.__init__"),
+    ("crypto/merkle.py", "MerkleMultiTree.from_byte_slices"),
+    ("crypto/merkle.py", "multiproofs_from_byte_slices"),
+    ("crypto/merkle.py", "proofs_from_byte_slices"),
+    ("crypto/merkle.py", "hash_from_byte_slices"),
+    ("types/tx.py", "txs_hash"),
+    ("types/tx.py", "txs_proofs"),
+})
+
+# modules whose functions ARE the sanctioned memo layer: expensive
+# calls inside them are the cache's miss path, not a recompute.
+# Matched by basename so fixture packages can model the shape.
+CACHE_MODULE_NAMES = frozenset({"servingcache.py"})
+
+_STORE_LOAD_PREFIXES = ("load_",)
+_MAX_FACTORS = 4
+_MAX_TERMS = 12
+
+
+def _is_screaming(name: str) -> bool:
+    return bool(name) and name.isupper() and len(name) > 1
+
+
+def _is_store_recv(node: ast.AST) -> bool:
+    """`self.block_store`, `env.state_store`, bare `store` — the
+    receiver shape of a store load/height call."""
+    name = ""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    return "store" in name
+
+
+def _iter_clamped(iter_node: ast.AST) -> bool:
+    """tmsafe's clamp recognizers plus: a slice bounded by ANY
+    SCREAMING name (`[:_RECENT_SNAPSHOTS]` is a config clamp even
+    without a MAX_/LIMIT/CAP marker)."""
+    if amplify.iter_clamped(iter_node):
+        return True
+    for node in ast.walk(iter_node):
+        if isinstance(node, ast.Slice) and node.upper is not None:
+            up = node.upper
+            upname = ""
+            if isinstance(up, ast.Name):
+                upname = up.id
+            elif isinstance(up, ast.Attribute):
+                upname = up.attr
+            if _is_screaming(upname):
+                return True
+    return False
+
+
+def render_term(term: Tuple[int, ...]) -> str:
+    return "*".join(CLASS_NAMES[c] for c in term)
+
+
+def _lin_count(term: Tuple[int, ...]) -> int:
+    """Factors of KNOWN-unbounded provenance (vset and up). A `lin`
+    factor — an unknown node-local collection — participates in the
+    budget terms (drift still guards it) but does not fire the
+    superlinear rule: counting every label-tuple or key-type-group
+    micro-iteration as a potential quadratic drowned the signal in 50+
+    benign findings on the first development run."""
+    return sum(1 for c in term if c >= VSET)
+
+
+def _mk_term(factors: List[int]) -> Tuple[int, ...]:
+    fs = sorted((c for c in factors if c >= CLAMPED), reverse=True)
+    return tuple(fs[:_MAX_FACTORS])
+
+
+def _cap_terms(terms: Set[Tuple[int, ...]]) -> Set[Tuple[int, ...]]:
+    if len(terms) <= _MAX_TERMS:
+        return terms
+    ranked = sorted(
+        terms, key=lambda t: (_lin_count(t), sum(t), t), reverse=True
+    )
+    return set(ranked[:_MAX_TERMS])
+
+
+class Finding:
+    __slots__ = ("rule", "path", "lineno", "col", "detail", "key")
+
+    def __init__(self, rule, path, lineno, col, detail, key):
+        self.rule = rule
+        self.path = path
+        self.lineno = lineno
+        self.col = col
+        self.detail = detail
+        self.key = key
+
+
+class _FnState:
+    __slots__ = (
+        "param_class",
+        "ret_class",
+        "ret_stable",
+        "terms",
+        "analyzed",
+        "is_p2p_root",
+    )
+
+    def __init__(self) -> None:
+        self.param_class: Dict[str, int] = {}
+        self.ret_class: int = CONST
+        self.ret_stable: bool = False
+        self.terms: Set[Tuple[int, ...]] = set()
+        self.analyzed = False
+        self.is_p2p_root = False
+
+
+class CostEngine:
+    """Monotone fixpoint over the call graph; findings + per-function
+    cost summaries (the root summaries feed the budget gate)."""
+
+    def __init__(self, pkg: Package, roots: List[Root]) -> None:
+        self.pkg = pkg
+        self.roots = roots
+        self.states: Dict[FuncKey, _FnState] = {}
+        self.callers: Dict[FuncKey, Set[FuncKey]] = {}
+        self.parent: Dict[FuncKey, Tuple[FuncKey, int]] = {}
+        self.findings: Dict[Tuple[str, str, int, int], Finding] = {}
+        self._work: List[FuncKey] = []
+        self._queued: Set[FuncKey] = set()
+
+    # -- public --
+
+    def run(self) -> List[Finding]:
+        for r in self.roots:
+            if r.key not in self.pkg.functions:
+                continue
+            st = self._state(r.key)
+            if r.family == "p2p":
+                st.is_p2p_root = True
+            for p in r.attacker_params:
+                st.param_class[p] = max(
+                    st.param_class.get(p, CONST), ATTACKER
+                )
+            self._enqueue(r.key)
+        while self._work:
+            key = self._work.pop()
+            self._queued.discard(key)
+            self._analyze(key)
+        return sorted(
+            self.findings.values(),
+            key=lambda f: (f.path, f.lineno, f.col, f.rule),
+        )
+
+    def cost_of(self, key: FuncKey) -> List[str]:
+        """Canonical rendered cost of a function: its term strings,
+        sorted; ['const'] when no non-const work was found."""
+        st = self.states.get(key)
+        if st is None or not st.terms:
+            return ["const"]
+        return sorted(render_term(t) for t in st.terms)
+
+    def chain(self, key: FuncKey) -> List[str]:
+        seen: Set[FuncKey] = set()
+        chain: List[str] = []
+        cur: Optional[FuncKey] = key
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            fi = self.pkg.functions.get(cur)
+            chain.append(fi.render() if fi else f"{cur[0]}:{cur[1]}")
+            nxt = self.parent.get(cur)
+            cur = nxt[0] if nxt else None
+        chain.reverse()
+        return chain
+
+    # -- machinery --
+
+    def _state(self, key: FuncKey) -> _FnState:
+        st = self.states.get(key)
+        if st is None:
+            st = _FnState()
+            self.states[key] = st
+        return st
+
+    def _enqueue(self, key: FuncKey) -> None:
+        if key not in self._queued:
+            self._queued.add(key)
+            self._work.append(key)
+
+    def _flow_into(
+        self,
+        caller: FuncKey,
+        callee: FuncKey,
+        classes: Dict[str, int],
+        lineno: int,
+    ) -> "_FnState":
+        st = self._state(callee)
+        grew = False
+        for name, cls in classes.items():
+            if cls > st.param_class.get(name, CONST):
+                st.param_class[name] = cls
+                grew = True
+        if grew or not st.analyzed:
+            self.parent.setdefault(callee, (caller, lineno))
+            self._enqueue(callee)
+        self.callers.setdefault(callee, set()).add(caller)
+        return st
+
+    def _summary_update(
+        self,
+        key: FuncKey,
+        ret_class: int,
+        ret_stable: bool,
+        terms: Set[Tuple[int, ...]],
+    ) -> None:
+        st = self._state(key)
+        grew = False
+        if ret_class > st.ret_class:
+            st.ret_class = ret_class
+            grew = True
+        if ret_stable and not st.ret_stable:
+            st.ret_stable = True
+            grew = True
+        new_terms = _cap_terms(st.terms | terms)
+        if new_terms != st.terms:
+            st.terms = new_terms
+            grew = True
+        if grew:
+            for c in self.callers.get(key, ()):
+                self._enqueue(c)
+
+    def report(self, rule, key, node, detail) -> None:
+        fi = self.pkg.functions[key]
+        k = (rule, fi.path, node.lineno, node.col_offset)
+        if k not in self.findings:
+            self.findings[k] = Finding(
+                rule, fi.path, node.lineno, node.col_offset, detail, key
+            )
+
+    def _analyze(self, key: FuncKey) -> None:
+        fi = self.pkg.functions.get(key)
+        if fi is None:
+            return
+        st = self._state(key)
+        st.analyzed = True
+        walker = _CostWalker(self, fi, st)
+        walker.run()
+        self._summary_update(
+            key, walker.ret_class, walker.ret_stable, walker.terms
+        )
+
+
+class _CostWalker:
+    """One function body, statements in program order, operands always
+    evaluated (never short-circuited)."""
+
+    def __init__(self, eng: CostEngine, fi: FuncInfo, st: _FnState) -> None:
+        self.eng = eng
+        self.fi = fi
+        self.key = fi.key
+        self.in_cache_module = (
+            fi.path.rsplit("/", 1)[-1] in CACHE_MODULE_NAMES
+        )
+        self.is_p2p_root = st.is_p2p_root
+        # name -> (bound class, locally-store-derived). Stability never
+        # enters through parameters (module docstring: the cross-caller
+        # contamination class)
+        self.env: Dict[str, Tuple[int, bool]] = {
+            n: (c, False) for n, c in st.param_class.items()
+        }
+        self.ctx: List[int] = []  # enclosing loop bound classes
+        self.terms: Set[Tuple[int, ...]] = set()
+        self.ret_class: int = CONST
+        self.ret_stable: bool = False
+        self.sites: Dict[Tuple[int, int], CallSite] = {
+            (s.lineno, s.col): s for s in fi.calls
+        }
+
+    def run(self) -> None:
+        for node in self.fi.node.body:
+            self.stmt(node)
+
+    # -- env helpers --
+
+    def _cls(self, name: str) -> int:
+        return self.env.get(name, (CONST, False))[0]
+
+    def _stable(self, name: str) -> bool:
+        return self.env.get(name, (CONST, False))[1]
+
+    def _assign_name(self, name: str, cls: int, stable: bool) -> None:
+        self.env[name] = (cls, stable)
+
+    def _assign_target(self, tgt, cls: int, stable: bool) -> None:
+        if isinstance(tgt, ast.Name):
+            self._assign_name(tgt.id, cls, stable)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._assign_target(elt, cls, stable)
+        elif isinstance(tgt, ast.Starred):
+            self._assign_target(tgt.value, cls, stable)
+        elif isinstance(tgt, (ast.Subscript, ast.Attribute)):
+            if isinstance(tgt, ast.Subscript):
+                self.expr(tgt.slice)
+            base = tgt.value
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if isinstance(base, ast.Name):
+                bcls, bstab = self.env.get(base.id, (CONST, False))
+                self.env[base.id] = (max(bcls, cls), bstab or stable)
+
+    # -- terms --
+
+    def _add_term(self, factors: List[int], node, via: str = "") -> None:
+        term = _mk_term(factors)
+        if not term:
+            return
+        self.terms.add(term)
+        # superlinear fires exactly when the new factor/fold completes
+        # the second lin-or-worse factor (the enclosing context alone
+        # was not yet superlinear — no cascade re-reports)
+        if _lin_count(term) >= 2 and _lin_count(tuple(self.ctx)) < 2:
+            detail = (
+                f"per-request cost term `{render_term(term)}`: nested "
+                "non-const bounds — one request buys work proportional "
+                "to the product"
+            )
+            if via:
+                detail += f" (via {via})"
+            self.eng.report("cost-superlinear", self.key, node, detail)
+
+    # -- statements --
+
+    def stmt(self, node: ast.AST) -> None:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return
+        if isinstance(node, ast.Assign):
+            cls, stable = self.expr2(node.value)
+            for tgt in node.targets:
+                self._assign_target(tgt, cls, stable)
+        elif isinstance(node, ast.AnnAssign):
+            cls, stable = (
+                self.expr2(node.value) if node.value else (CONST, False)
+            )
+            self._assign_target(node.target, cls, stable)
+        elif isinstance(node, ast.AugAssign):
+            cls, stable = self.expr2(node.value)
+            if isinstance(node.target, ast.Name):
+                cur, curst = self.env.get(node.target.id, (CONST, False))
+                self._assign_name(
+                    node.target.id, max(cur, cls), curst or stable
+                )
+            else:
+                self._assign_target(node.target, cls, stable)
+        elif isinstance(node, ast.Expr):
+            self.expr(node.value)
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                cls, stable = self.expr2(node.value)
+                self.ret_class = max(self.ret_class, cls)
+                self.ret_stable = self.ret_stable or stable
+        elif isinstance(node, ast.If):
+            self._branch(node.test, node.body, node.orelse)
+        elif isinstance(node, ast.While):
+            self._while(node)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._for(node)
+        elif isinstance(node, ast.Try):
+            for s in node.body:
+                self.stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self.stmt(s)
+            for s in node.orelse:
+                self.stmt(s)
+            for s in node.finalbody:
+                self.stmt(s)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.expr(item.context_expr)
+            for s in node.body:
+                self.stmt(s)
+        elif isinstance(node, ast.Assert):
+            self.expr(node.test)
+            self._reclass_test(node.test)
+            if node.msg is not None:
+                self.expr(node.msg)
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self.expr(node.exc)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.env.pop(t.id, None)
+                else:
+                    self.expr(t)
+        elif isinstance(
+            node,
+            (ast.Global, ast.Nonlocal, ast.Pass, ast.Break, ast.Continue,
+             ast.Import, ast.ImportFrom),
+        ):
+            return
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.expr(child)
+                elif isinstance(child, ast.stmt):
+                    self.stmt(child)
+
+    def _branch(self, test, body, orelse) -> None:
+        self.expr(test)
+        self._reclass_test(test)
+        snap = dict(self.env)
+        for s in body:
+            self.stmt(s)
+        env_b = self.env
+        self.env = dict(snap)
+        for s in orelse:
+            self.stmt(s)
+        # join: worst class / any-stability survives
+        for name, (cls, stab) in env_b.items():
+            cur, curst = self.env.get(name, (CONST, False))
+            self.env[name] = (max(cur, cls), curst or stab)
+
+    def _loop_body(self, body) -> None:
+        # two joined passes so a name bound late in the body is seen by
+        # earlier uses on the next iteration
+        for _ in range(2):
+            for s in body:
+                self.stmt(s)
+
+    def _while(self, node: ast.While) -> None:
+        self.expr(node.test)
+        # a while loop is a cost factor only when its test reads an
+        # attacker/store-classed counter; event loops (`while True`,
+        # `while not closed.is_set()`) are the serving boundary
+        bound = CONST
+        for cmp_node in ast.walk(node.test):
+            if not isinstance(cmp_node, ast.Compare):
+                continue
+            for side in [cmp_node.left] + list(cmp_node.comparators):
+                for n in ast.walk(side):
+                    if isinstance(n, ast.Name):
+                        c = self._cls(n.id)
+                        if c >= STORE:
+                            bound = max(bound, c)
+        if bound >= STORE:
+            self._add_term(self.ctx + [bound], node)
+            self.ctx.append(bound)
+            self._loop_body(node.body)
+            self.ctx.pop()
+        else:
+            self._loop_body(node.body)
+        for s in node.orelse:
+            self.stmt(s)
+
+    def _bound_of_iter(self, iter_node: ast.AST) -> int:
+        if _iter_clamped(iter_node):
+            return CLAMPED
+        cls, _ = self.expr2(iter_node)
+        return cls
+
+    def _for(self, node) -> None:
+        # a p2p root's own `async for envelope in <channel>` loop is
+        # the per-request boundary, not a cost factor
+        boundary = (
+            isinstance(node, ast.AsyncFor)
+            and self.is_p2p_root
+            and isinstance(node.target, ast.Name)
+            and node.target.id == "envelope"
+        )
+        bound = CONST if boundary else self._bound_of_iter(node.iter)
+        _, iter_stable = self.expr2(node.iter)
+        if boundary:
+            self._assign_target(node.target, ATTACKER, False)
+        else:
+            # the element of an attacker-sized collection is attacker
+            # content; elements of protocol collections are one item
+            elem_cls = ATTACKER if bound == ATTACKER else CONST
+            self._assign_target(node.target, elem_cls, iter_stable)
+        if bound >= CLAMPED:
+            self._add_term(self.ctx + [bound], node)
+            self.ctx.append(bound)
+            self._loop_body(node.body)
+            self.ctx.pop()
+        else:
+            self._loop_body(node.body)
+        for s in node.orelse:
+            self.stmt(s)
+
+    # -- re-classification (the guard-then-raise idiom) --
+
+    def _reclass_test(self, test: ast.AST) -> None:
+        """A comparison between a lin-or-worse name and a lower-class
+        expression bounds the name by that expression for the rest of
+        the function: `if height > top: raise` pins an attacker height
+        into the store range; `if 0 < n < CAP` clamps it. Identity
+        tests bound nothing (the tmsafe is-exemption, re-applied)."""
+        for node in ast.walk(test):
+            if not isinstance(node, ast.Compare):
+                continue
+            if any(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in node.ops
+            ):
+                continue
+            sides = [node.left] + list(node.comparators)
+            side_cls = [self.expr(s) for s in sides]
+            floor = min(side_cls)
+            for side in sides:
+                for n in ast.walk(side):
+                    if not isinstance(n, ast.Name):
+                        continue
+                    cur, stab = self.env.get(n.id, (CONST, False))
+                    if cur >= LIN and floor < cur:
+                        new = CLAMPED if floor <= CLAMPED else floor
+                        self.env[n.id] = (new, stab)
+
+    # -- expressions --
+
+    def expr(self, node: Optional[ast.AST]) -> int:
+        return self.expr2(node)[0]
+
+    def expr2(self, node: Optional[ast.AST]) -> Tuple[int, bool]:
+        if node is None:
+            return CONST, False
+        if isinstance(node, ast.Constant):
+            return CONST, False
+        if isinstance(node, ast.Name):
+            if node.id in self.env:
+                return self.env[node.id]
+            if _is_screaming(node.id):
+                return CONST, False
+            if node.id in VSET_MARKERS:
+                return VSET, False
+            if node.id in BLOCK_MARKERS:
+                return BLOCK, False
+            return LIN, False
+        if isinstance(node, ast.Attribute):
+            vcls, vstab = self.expr2(node.value)
+            if _is_screaming(node.attr):
+                return CONST, vstab
+            if node.attr in VSET_MARKERS:
+                return VSET, vstab
+            if node.attr in BLOCK_MARKERS:
+                return BLOCK, vstab
+            if vcls == ATTACKER:
+                # fields of an attacker message are attacker-chosen
+                return ATTACKER, vstab
+            return LIN, vstab
+        if isinstance(node, ast.Await):
+            return self.expr2(node.value)
+        if isinstance(node, ast.Starred):
+            return self.expr2(node.value)
+        if isinstance(node, ast.BinOp):
+            lc, ls = self.expr2(node.left)
+            rc, rs = self.expr2(node.right)
+            if isinstance(node.op, ast.Mult):
+                self._check_repeat_alloc(node, lc, rc)
+            if isinstance(node.op, ast.Mod) and rc <= CLAMPED:
+                # v % bound pins v
+                return min(lc, CLAMPED), ls or rs
+            return max(lc, rc), ls or rs
+        if isinstance(node, ast.UnaryOp):
+            return self.expr2(node.operand)
+        if isinstance(node, ast.BoolOp):
+            cls, stab = CONST, False
+            for v in node.values:
+                c, s = self.expr2(v)
+                cls, stab = max(cls, c), stab or s
+            return cls, stab
+        if isinstance(node, ast.Compare):
+            self.expr(node.left)
+            for c in node.comparators:
+                self.expr(c)
+            return CONST, False
+        if isinstance(node, ast.IfExp):
+            self.expr(node.test)
+            self._reclass_test(node.test)
+            bc, bs = self.expr2(node.body)
+            oc, os_ = self.expr2(node.orelse)
+            return max(bc, oc), bs or os_
+        if isinstance(node, ast.Subscript):
+            vcls, vstab = self.expr2(node.value)
+            if isinstance(node.slice, ast.Slice):
+                self.expr(node.slice.lower)
+                self.expr(node.slice.upper)
+                self.expr(node.slice.step)
+                up = node.slice.upper
+                upname = ""
+                if isinstance(up, ast.Name):
+                    upname = up.id
+                elif isinstance(up, ast.Attribute):
+                    upname = up.attr
+                if up is not None and (
+                    isinstance(up, ast.Constant) or _is_screaming(upname)
+                ):
+                    return CLAMPED, vstab
+                # the pagination idiom `x[start : start + per_page]`:
+                # slice LENGTH is bounded by per_page even when start
+                # is attacker-chosen
+                if (
+                    isinstance(up, ast.BinOp)
+                    and isinstance(up.op, ast.Add)
+                    and node.slice.lower is not None
+                ):
+                    low_src = ast.dump(node.slice.lower)
+                    for base_side, len_side in (
+                        (up.left, up.right),
+                        (up.right, up.left),
+                    ):
+                        if (
+                            ast.dump(base_side) == low_src
+                            and self.expr(len_side) <= CLAMPED
+                        ):
+                            return CLAMPED, vstab
+                return vcls, vstab
+            self.expr(node.slice)
+            if vcls == ATTACKER:
+                return ATTACKER, vstab
+            return (LIN if vcls >= LIN else CONST), vstab
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            cls, stab = CONST, False
+            for e in node.elts:
+                c, s = self.expr2(e)
+                cls, stab = max(cls, c), stab or s
+            return cls, stab
+        if isinstance(node, ast.Dict):
+            cls, stab = CONST, False
+            for k in node.keys:
+                if k is not None:
+                    c, s = self.expr2(k)
+                    cls, stab = max(cls, c), stab or s
+            for v in node.values:
+                c, s = self.expr2(v)
+                cls, stab = max(cls, c), stab or s
+            return cls, stab
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            return self._comprehension(node)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                self.expr(v)
+            return CONST, False
+        if isinstance(node, ast.FormattedValue):
+            self.expr(node.value)
+            return CONST, False
+        if isinstance(node, ast.Lambda):
+            return CONST, False
+        if isinstance(node, ast.Slice):
+            self.expr(node.lower)
+            self.expr(node.upper)
+            self.expr(node.step)
+            return CONST, False
+        if isinstance(node, ast.NamedExpr):
+            cls, stab = self.expr2(node.value)
+            self._assign_target(node.target, cls, stab)
+            return cls, stab
+        cls, stab = CONST, False
+        for c in ast.iter_child_nodes(node):
+            if isinstance(c, ast.expr):
+                cc, cs = self.expr2(c)
+                cls, stab = max(cls, cc), stab or cs
+        return cls, stab
+
+    def _comprehension(self, node) -> Tuple[int, bool]:
+        pushed = 0
+        stab_any = False
+        for gen in node.generators:
+            bound = self._bound_of_iter(gen.iter)
+            _, iter_stable = self.expr2(gen.iter)
+            stab_any = stab_any or iter_stable
+            elem_cls = ATTACKER if bound == ATTACKER else CONST
+            self._assign_target(gen.target, elem_cls, iter_stable)
+            if bound >= CLAMPED:
+                self._add_term(self.ctx + [bound], gen.iter)
+                self.ctx.append(bound)
+                pushed += 1
+            for cond in gen.ifs:
+                self.expr(cond)
+                self._reclass_test(cond)
+        try:
+            if isinstance(node, ast.DictComp):
+                kc, ks = self.expr2(node.key)
+                vc, vs = self.expr2(node.value)
+                cls, stab = max(kc, vc), ks or vs
+            else:
+                cls, stab = self.expr2(node.elt)
+        finally:
+            for _ in range(pushed):
+                self.ctx.pop()
+        # the comprehension RESULT is a collection bounded by its
+        # outermost generator; its elements' stability propagates
+        bound0 = self._bound_of_iter(node.generators[0].iter)
+        return max(bound0, CONST), stab or stab_any
+
+    # -- calls --
+
+    def _call(self, node: ast.Call) -> Tuple[int, bool]:
+        func = node.func
+        recv_cls, recv_stab = CONST, False
+        attr = ""
+        if isinstance(func, ast.Attribute):
+            recv_cls, recv_stab = self.expr2(func.value)
+            attr = func.attr
+        arg_pairs = [self.expr2(a) for a in node.args]
+        kw_pairs: Dict[str, Tuple[int, bool]] = {}
+        spread = (CONST, False)
+        for kw in node.keywords:
+            p = self.expr2(kw.value)
+            if kw.arg is not None:
+                kw_pairs[kw.arg] = p
+            else:
+                spread = (max(spread[0], p[0]), spread[1] or p[1])
+        arg_classes = [c for c, _ in arg_pairs]
+        all_pairs = arg_pairs + list(kw_pairs.values()) + [spread]
+        max_arg = max([CONST] + [c for c, _ in all_pairs])
+        any_stable = any(s for _, s in all_pairs)
+
+        name = func.id if isinstance(func, ast.Name) else ""
+
+        # accumulating a stable value into a local container makes the
+        # container stable (`blocks.append(lb)` — the page the response
+        # constructor will wrap); the two-pass loop body makes earlier
+        # uses see it
+        if (
+            attr in ("append", "extend", "add", "insert", "appendleft",
+                     "update", "setdefault")
+            and any_stable
+            and isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+        ):
+            rname = func.value.id
+            rcls, _ = self.env.get(rname, (CONST, False))
+            self.env[rname] = (rcls, True)
+
+        # builtins with bound semantics
+        if name == "len":
+            return max_arg, False
+        if name in ("int", "abs", "ord", "round"):
+            return max_arg, False
+        if name == "min" and arg_classes:
+            lo = min(arg_classes)
+            hi = max(arg_classes)
+            if lo <= CLAMPED and hi > lo:
+                return CLAMPED, False  # the clamp expression itself
+            return lo, False
+        if name == "max" and arg_classes:
+            return max(arg_classes), False
+        if name == "range":
+            return max_arg, False
+        if name in ("bytes", "bytearray"):
+            if (
+                arg_classes
+                and arg_classes[0] >= STORE
+            ):
+                self.eng.report(
+                    "cost-unclamped-alloc",
+                    self.key,
+                    node,
+                    f"`{name}()` sized by an unclamped "
+                    f"`{CLASS_NAMES[arg_classes[0]]}`-class bound — "
+                    "allocation proportional to an unbounded input",
+                )
+            return CONST, any_stable
+        if name in ("sorted", "list", "tuple", "set", "frozenset",
+                    "reversed", "enumerate", "zip", "sum", "map",
+                    "filter", "dict"):
+            return max_arg, any_stable
+        if name in ("str", "repr", "bool", "float", "hex", "isinstance",
+                    "hasattr", "getattr", "print", "type", "format",
+                    "id"):
+            return CONST, False
+
+        # attribute families
+        if attr:
+            if attr in ("items", "values", "keys", "copy"):
+                return recv_cls, recv_stab
+            if attr in ("get", "pop", "setdefault") and recv_cls == ATTACKER:
+                # params.get(...) hands back an attacker-chosen value
+                return ATTACKER, recv_stab
+            if attr in ("height", "base", "size") and _is_store_recv(
+                getattr(func, "value", None)
+            ):
+                return STORE, False
+            if attr.startswith(_STORE_LOAD_PREFIXES) and _is_store_recv(
+                getattr(func, "value", None)
+            ):
+                # a store load: per-block-immutable content
+                return LIN, True
+
+        site = self.sites.get((node.lineno, node.col_offset))
+        target = site.target if site is not None else None
+
+        # -- cost-recompute: expensive pure work on stable inputs --
+        # an encoder's own recursion (to_proto calling its children's
+        # to_proto) is not a separate recompute: the finding belongs at
+        # the serving-side call that re-enters the encoder per request
+        in_encoder = self.fi.qualname.split(".")[-1] in EXPENSIVE_ATTRS
+        if not self.in_cache_module and not in_encoder:
+            expensive = (
+                attr in EXPENSIVE_ATTRS and recv_stab
+            ) or (
+                target in EXPENSIVE_TARGETS
+                and (recv_stab or any_stable)
+            )
+            if expensive:
+                what = attr or (target[1] if target else name)
+                self.eng.report(
+                    "cost-recompute",
+                    self.key,
+                    node,
+                    f"`{what}` on a store-derived (per-block-immutable) "
+                    "value inside the serving region — cacheable work "
+                    "paid per request (hold it in the per-block serving "
+                    "cache instead)",
+                )
+
+        if target is not None:
+            return self._internal_call(
+                node, target, arg_pairs, kw_pairs, (recv_cls, recv_stab),
+                max_arg, any_stable,
+            )
+
+        # unknown/external: result bounded by the inputs; stability
+        # survives pure transformation (`.hex()`, `b"".join(...)`)
+        return max(recv_cls if recv_cls == ATTACKER else CONST,
+                   CONST), recv_stab or any_stable
+
+    def _internal_call(
+        self, node, target: FuncKey, arg_pairs, kw_pairs, recv_pair,
+        max_arg: int, any_stable: bool,
+    ) -> Tuple[int, bool]:
+        callee = self.eng.pkg.functions.get(target)
+        if callee is None:
+            return CONST, any_stable
+        classes: Dict[str, int] = {}
+        args = callee.node.args
+        positional = [a.arg for a in args.posonlyargs + args.args]
+        params = positional + [a.arg for a in args.kwonlyargs]
+        pos = list(positional)
+        if pos and pos[0] in ("self", "cls"):
+            if recv_pair[0] > CONST:
+                classes[pos[0]] = recv_pair[0]
+            pos = pos[1:]
+        for i, (cls, _stab) in enumerate(arg_pairs):
+            if i < len(pos):
+                if cls > CONST:
+                    classes[pos[i]] = max(classes.get(pos[i], CONST), cls)
+        for kname, (cls, _stab) in kw_pairs.items():
+            if kname in params:
+                if cls > CONST:
+                    classes[kname] = max(classes.get(kname, CONST), cls)
+        if target == self.key:
+            # recursion: no self-fold (the tmsafe recursion rule owns
+            # attacker-driven depth); return current summary
+            st = self.eng._state(target)
+            return st.ret_class, st.ret_stable
+        st = self.eng._flow_into(
+            self.key, target, classes, node.lineno
+        )
+        # fold the callee's cost terms under the enclosing loop context
+        if st.terms:
+            via = self.eng.pkg.functions[target].render()
+            for t in st.terms:
+                self._add_term(self.ctx + list(t), node, via=via)
+        if target[1].endswith(".__init__"):
+            # constructor: the instance wraps its (possibly stable) args
+            return CONST, recv_pair[1] or any_stable
+        return st.ret_class, st.ret_stable
+
+    def _check_repeat_alloc(self, node, lc: int, rc: int) -> None:
+        for seq_side, n_cls in (
+            (node.left, rc),
+            (node.right, lc),
+        ):
+            if n_cls < STORE:
+                continue
+            if (
+                isinstance(seq_side, ast.Constant)
+                and isinstance(seq_side.value, (str, bytes))
+            ) or isinstance(seq_side, (ast.List, ast.Tuple)):
+                self.eng.report(
+                    "cost-unclamped-alloc",
+                    self.key,
+                    node,
+                    "sequence repetition sized by an unclamped "
+                    f"`{CLASS_NAMES[n_cls]}`-class bound — allocation "
+                    "proportional to an unbounded input",
+                )
+                return

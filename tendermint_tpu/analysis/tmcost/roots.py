@@ -1,0 +1,124 @@
+"""Serving-root discovery for tmcost.
+
+A *serving root* is a function whose invocation count is controlled by
+the outside world — one call per client request, per peer message, or
+per committed block. The cost gate's contract is per-request: every
+root gets a symbolic cost class checked against the reviewed budget
+table `cost_budgets.json`, and a root missing from the table is red
+(a new route cannot ship unbudgeted).
+
+Three families, the first two machine-derived the same way tmsafe
+derives its entries (so the catalog cannot rot by hand):
+
+1. **RPC route handlers** — every function in the package with an
+   `RPCRequest`-annotated parameter (the JSON-RPC routes in
+   rpc/core.py). One call per client HTTP/WS request.
+2. **P2P recv handlers** — every function with an `Envelope`-annotated
+   parameter plus the inline `async for envelope in <channel>` receive
+   loops (the evidence/mempool/pex reactor shape — same discovery as
+   tmsafe's validate pass). One call per peer message; the envelope
+   loop itself is the per-request boundary, not a cost factor.
+3. **Per-block consensus entry points** — a small REVIEWED catalog
+   (`CONSENSUS_ROOTS`): the functions the node pays once per block
+   regardless of traffic. Their budgets pin the committee-size trade
+   the paper centers on (EdDSA vs BLS, arxiv 2302.00418: commit
+   verification cost as a function of committee size).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..tmcheck.callgraph import FuncInfo, Package, _body_walk
+from ..tmsafe.sources import _annotated_params
+
+__all__ = ["Root", "CONSENSUS_ROOTS", "discover_roots", "root_id"]
+
+FuncKey = Tuple[str, str]
+
+# the per-block entry points: (path, qualname) -> why it is a root.
+# Every key must resolve in the call graph (pinned by test); adding an
+# entry here is a reviewed change, exactly like tmsafe's MUTATION_SINKS.
+CONSENSUS_ROOTS: Dict[FuncKey, str] = {
+    ("types/validation.py", "verify_commit"): (
+        "full commit verification — paid once per block by every full "
+        "node; the committee-size cost the paper trades against"
+    ),
+    ("types/validation.py", "verify_commit_light"): (
+        "light commit verification — blocksync/light-client per-header "
+        "cost"
+    ),
+    ("types/validation.py", "verify_commit_light_bulk"): (
+        "bulk light verification — the stateless fleet-serving path"
+    ),
+    ("state/execution.py", "BlockExecutor.apply_block"): (
+        "block execution + store writes — the per-commit critical path"
+    ),
+}
+
+
+class Root:
+    """One serving root: identity, family, tainted params."""
+
+    __slots__ = ("key", "family", "attacker_params", "why")
+
+    def __init__(
+        self,
+        key: FuncKey,
+        family: str,
+        attacker_params: Tuple[str, ...] = (),
+        why: str = "",
+    ) -> None:
+        self.key = key
+        self.family = family  # "rpc" | "p2p" | "consensus"
+        self.attacker_params = attacker_params
+        self.why = why
+
+    def render(self) -> str:
+        return f"{root_id(self.key)} [{self.family}]"
+
+
+def root_id(key: FuncKey) -> str:
+    """The budget-table identity of a root: 'path:qualname'."""
+    return f"{key[0]}:{key[1]}"
+
+
+def _has_envelope_loop(fi: FuncInfo) -> bool:
+    """Same shape test as tmsafe.validate: `async for envelope in ...`
+    marks an inline receive loop."""
+    for node in _body_walk(fi.node):
+        if (
+            isinstance(node, ast.AsyncFor)
+            and isinstance(node.target, ast.Name)
+            and node.target.id == "envelope"
+        ):
+            return True
+    return False
+
+
+def discover_roots(pkg: Package) -> List[Root]:
+    roots: Dict[FuncKey, Root] = {}
+    for key, fi in sorted(pkg.functions.items()):
+        if fi.path == "p2p/channel.py":
+            # the Channel is the typed pipe itself — its send/deliver
+            # methods take Envelope params but are plumbing, not
+            # handlers; the handler side is where per-request work
+            # begins
+            continue
+        rpc_params = _annotated_params(fi, "RPCRequest")
+        if rpc_params:
+            roots[key] = Root(key, "rpc", tuple(rpc_params))
+            continue
+        env_params = _annotated_params(fi, "Envelope")
+        if env_params:
+            roots[key] = Root(key, "p2p", tuple(env_params))
+            continue
+        if _has_envelope_loop(fi):
+            # the loop target "envelope" is the attacker-controlled
+            # value; the loop itself is the per-request boundary
+            roots[key] = Root(key, "p2p", ("envelope",))
+    for key, why in CONSENSUS_ROOTS.items():
+        if key in pkg.functions:
+            roots[key] = Root(key, "consensus", (), why)
+    return [roots[k] for k in sorted(roots)]

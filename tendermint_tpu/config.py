@@ -94,6 +94,10 @@ class RPCConfig:
     max_subscriptions_per_client: int = 5
     timeout_broadcast_tx_commit: float = 10.0
     max_body_bytes: int = 1_000_000
+    # per-block serving cache (rpc/servingcache.py): LRU capacity in
+    # blocks for each artifact family (encoded LightBlock blobs, held
+    # tx-proof merkle trees); 0 disables the cache for this node
+    serving_cache_blocks: int = 64
 
 
 @dataclass

@@ -30,6 +30,14 @@ __all__ = [
 EVIDENCE_CHANNEL = 0x38
 _BROADCAST_INTERVAL = 1.0  # reapply pending list to peers at this cadence
 
+# per-message evidence bound, enforced on BOTH sides: the receiver
+# verifies at most this many items per message (each new item costs a
+# 1/3-committee signature check — the per-message work must be bounded
+# by config, not by the peer), and our broadcast loop sends at most
+# this many per tick so the recv clamp never drops an honest sender's
+# tail (a bigger backlog simply drains across ticks).
+MAX_MSG_EVIDENCE = 64
+
 
 @dataclass
 class EvidenceListMessage:
@@ -96,7 +104,16 @@ class EvidenceReactor(Service):
 
     async def _recv_routine(self) -> None:
         async for envelope in self.channel:
-            for ev in envelope.message.evidence:
+            # per-message verification work is clamped: each NEW
+            # evidence item costs a committee-sized signature check
+            # (verify_commit_light_trusting; dup items short-circuit on
+            # the pool's is_pending/is_committed probe first), so an
+            # unclamped list let one message buy n_evidence × vset
+            # work (tmcost cost-superlinear, first-run finding). Our
+            # own sender paces to the same bound — one
+            # MAX_MSG_EVIDENCE chunk per broadcast tick — so an honest
+            # peer's items are never clamp-dropped here.
+            for ev in envelope.message.evidence[:MAX_MSG_EVIDENCE]:
                 try:
                     # validate-before-use (tmsafe safe-unvalidated-use):
                     # shape checks run before the pool touches state or
@@ -141,6 +158,13 @@ class EvidenceReactor(Service):
         while True:
             pending, _ = self.pool.pending_evidence(1 << 20)
             fresh = [ev for ev in pending if ev.hash() not in sent]
+            # pace sends to the receiver's per-message verification
+            # clamp: one MAX_MSG_EVIDENCE chunk per tick. An oversized
+            # single message would have its tail clamp-dropped on the
+            # far side and re-offers would resend the SAME prefix —
+            # chunked pacing is what makes the recv clamp lossless for
+            # honest senders (items beyond the chunk go next tick)
+            fresh = fresh[:MAX_MSG_EVIDENCE]
             if fresh:
                 if self.channel.try_send(
                     Envelope(

@@ -139,6 +139,7 @@ class WSConn:
 
     async def _writer_loop(self) -> None:
         closed = asyncio.ensure_future(self.closed.wait())
+        get: Optional[asyncio.Future] = None
         try:
             while not self.closed.is_set():
                 get = asyncio.ensure_future(self._sendq.get())
@@ -147,8 +148,10 @@ class WSConn:
                 )
                 if get not in done:
                     get.cancel()
+                    get = None
                     break
                 kind, payload = get.result()
+                get = None
                 if kind == "text":
                     frame = _encode_frame(0x1, payload.encode())
                 elif kind == "pong":
@@ -163,6 +166,14 @@ class WSConn:
             pass
         finally:
             closed.cancel()
+            if get is not None and not get.done():
+                # cancelled mid-wait (server stop with a live
+                # subscriber): asyncio.wait does NOT cancel its
+                # awaitables, so without this the pending Queue.get
+                # task survives to interpreter exit as a
+                # "Task was destroyed but it is pending!" leak
+                # (reproduced; pinned by tests/test_teardown.py)
+                get.cancel()
             self._close()
 
 

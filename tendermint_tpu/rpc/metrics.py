@@ -118,6 +118,31 @@ class RPCMetrics:
             "Light blocks returned per bulk light_blocks request.",
             buckets=(1.0, 2.0, 5.0, 10.0, 20.0, 50.0),
         )
+        self.tx_proofs_requests = r.counter(
+            "rpc",
+            "tx_proofs_requests",
+            "tx_proofs requests served (merkle proofs from the held "
+            "per-block tree).",
+        )
+        # per-block serving cache (rpc/servingcache.py): encoded
+        # LightBlock blobs + held MerkleMultiTrees
+        self.servingcache_hits = r.counter(
+            "rpc",
+            "servingcache_hits_total",
+            "Per-block serving-cache hits (page encode / tree build "
+            "skipped).",
+        )
+        self.servingcache_misses = r.counter(
+            "rpc",
+            "servingcache_misses_total",
+            "Per-block serving-cache misses (artifact assembled from "
+            "the stores).",
+        )
+        self.servingcache_evictions = r.counter(
+            "rpc",
+            "servingcache_evictions_total",
+            "Per-block serving-cache entries dropped by the LRU bound.",
+        )
         # SLO policy is per-struct (per-node): harnesses and tests
         # tighten thresholds without touching process-global state
         self.default_slo_s = DEFAULT_SLO_S

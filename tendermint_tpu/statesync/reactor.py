@@ -270,6 +270,11 @@ class StatesyncReactor(Service):
                     Envelope(
                         message=ParamsResponseMessage(
                             height=msg.height,
+                            # tmcost: cost-recompute-ok — ConsensusParams
+                            # is a fixed handful of ints; its encode is
+                            # O(1), not content-proportional, so a
+                            # per-block cache entry would cost more than
+                            # the work it saves
                             consensus_params=params.to_proto(),
                         ),
                         to=envelope.from_peer,

@@ -1,0 +1,41 @@
+"""cost-unclamped-alloc fixture: allocations proportional to
+store/attacker bounds, with clamped / guard-reclassed / suppressed
+twins."""
+
+from .rpctypes import RPCRequest
+
+MAX_BUF = 4096
+
+
+class Env:
+    def __init__(self, block_store) -> None:
+        self.block_store = block_store
+
+    async def store_buf(self, req: RPCRequest):
+        """RED: buffer sized by the whole store height range."""
+        n = self.block_store.height()
+        return bytes(n)
+
+    async def store_buf_clamped(self, req: RPCRequest):
+        """GREEN: min() clamp between derivation and use."""
+        n = self.block_store.height()
+        return bytes(min(n, MAX_BUF))
+
+    async def attacker_repeat(self, req: RPCRequest):
+        """RED: sequence repetition sized by a request integer."""
+        n = int(req.params.get("n"))
+        return b"\x00" * n
+
+    async def attacker_repeat_guarded(self, req: RPCRequest):
+        """GREEN: the guard-then-raise idiom re-classes n."""
+        n = int(req.params.get("n"))
+        if n > MAX_BUF:
+            raise ValueError("too big")
+        return b"\x00" * n
+
+    async def store_buf_suppressed(self, req: RPCRequest):
+        """GREEN (suppressed)."""
+        n = self.block_store.height()
+        # tmcost: cost-unclamped-alloc-ok — fixture rationale: bounded
+        # by an out-of-band operator invariant
+        return bytes(n)

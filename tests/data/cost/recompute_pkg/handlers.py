@@ -1,0 +1,29 @@
+"""cost-recompute fixture: expensive pure encode of store-derived
+content per request, with cached / suppressed twins."""
+
+from .rpctypes import RPCRequest
+from .servingcache import Cache
+
+
+class Env:
+    def __init__(self, block_store) -> None:
+        self.block_store = block_store
+        self.cache: Cache = Cache(block_store)
+
+    async def header_raw(self, req: RPCRequest):
+        """RED: per-block-immutable store content re-encoded per
+        request."""
+        meta = self.block_store.load_block_meta(req.params.get("height"))
+        return {"header": meta.header.to_proto().hex()}
+
+    async def header_cached(self, req: RPCRequest):
+        """GREEN: the work lives in the serving-cache module."""
+        blob = self.cache.blob(req.params.get("height"))
+        return {"header": blob.hex()}
+
+    async def header_suppressed(self, req: RPCRequest):
+        """GREEN (suppressed): reviewed-rationale escape hatch."""
+        meta = self.block_store.load_block_meta(req.params.get("height"))
+        # tmcost: cost-recompute-ok — fixture rationale: this encode is
+        # O(1) for this message shape
+        return {"header": meta.header.to_proto().hex()}

@@ -1,0 +1,2 @@
+class RPCRequest:
+    params: dict = {}

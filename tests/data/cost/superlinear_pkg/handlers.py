@@ -1,0 +1,55 @@
+"""cost-superlinear fixture: nested known-unbounded bounds per
+request, with clamped / suppressed / helper-fold twins."""
+
+from .rpctypes import RPCRequest
+
+MAX_PAGE = 20
+
+
+class ValSet:
+    validators: list = []
+
+
+def scan(req: RPCRequest, vals: ValSet):
+    """RED: attacker-sized outer loop x validator-set inner loop."""
+    total = 0
+    for h in req.params.get("heights"):
+        for v in vals.validators:
+            total += h + v
+    return total
+
+
+def scan_clamped(req: RPCRequest, vals: ValSet):
+    """GREEN: one clamp is enough — MAX_PAGE x vset is vset-linear."""
+    total = 0
+    for h in req.params.get("heights")[:MAX_PAGE]:
+        for v in vals.validators:
+            total += h + v
+    return total
+
+
+def scan_suppressed(req: RPCRequest, vals: ValSet):
+    """GREEN (suppressed): the reviewed-rationale escape hatch."""
+    total = 0
+    for h in req.params.get("heights"):
+        # tmcost: cost-superlinear-ok — fixture rationale: the inner
+        # set is bounded elsewhere by protocol admission
+        for v in vals.validators:
+            total += h + v
+    return total
+
+
+def _tally(vals: ValSet) -> int:
+    s = 0
+    for v in vals.validators:
+        s += v
+    return s
+
+
+def scan_via_helper(req: RPCRequest, vals: ValSet):
+    """RED at the call site: the callee's vset term folds into the
+    attacker loop (interprocedural cost summaries)."""
+    out = 0
+    for h in req.params.get("heights"):
+        out += _tally(vals) + h
+    return out

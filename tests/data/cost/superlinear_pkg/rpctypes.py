@@ -1,0 +1,11 @@
+"""Stub request/envelope types so root discovery fires on the fixture
+exactly as it does on the real package (annotation-name match)."""
+
+
+class RPCRequest:
+    params: dict = {}
+
+
+class Envelope:
+    message = None
+    from_peer = ""

@@ -283,6 +283,64 @@ print("OK")
     assert "OK" in r.stdout
 
 
+def test_tmcost_gate_row_never_initializes_jax():
+    """Same contract for the ISSUE-14 tmcost_gate row: banked CPU
+    block, pure stdlib AST, jax must never load — and the row reads
+    the gate's own stats (findings, suppressions, budget coverage)."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+row = bench.bench_tmcost_gate()
+assert row["wall_s"] > 0 and "findings" in row and "suppressed" in row
+assert set(row["findings"]) == {
+    "cost-superlinear", "cost-recompute",
+    "cost-unclamped-alloc", "cost-budget",
+}
+assert row["roots"] >= 50 and row["budgeted"] == row["roots"]
+assert "jax" not in sys.modules, "tmcost_gate dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout
+
+
+def test_serving_cache_row_never_initializes_jax():
+    """The ISSUE-14 serving-cache A/B row drives the REAL light_blocks
+    handler against proto-backed stub stores — pure codec + cache
+    work, jax must never load. Tiny shape; the full-size medians land
+    in BENCH_STATELESS.json on real runs."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+row = bench.bench_serving_cache_page(
+    n_vals=4, page=5, reps=1, rounds=1
+)
+assert row["page"] == 5 and row["cache_hits"] >= 5
+for key in ("warm_serve_ms", "uncached_serve_ms", "speedup_warm"):
+    assert row[key] > 0, key
+assert "jax" not in sys.modules, "serving-cache row dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout
+
+
 def test_load_smoke_row_never_initializes_jax():
     """The ISSUE-12 load row boots a live multi-node localnet and
     drives real HTTP/websocket traffic — all of it must stay off the

@@ -351,3 +351,71 @@ def test_lagging_node_catches_up():
             )
 
     run(go())
+
+
+def test_evidence_broadcast_paces_to_the_recv_clamp():
+    """Sender-side chunking regression (code-review finding on the
+    ISSUE-14 recv clamp): a pending backlog larger than
+    MAX_MSG_EVIDENCE must drain across broadcast ticks — one clamp-
+    sized chunk per message — instead of going out as one oversized
+    message whose tail the receiver's clamp would drop and whose
+    re-offers would resend the SAME prefix forever (pending_evidence
+    iterates in stable insertion order, so the starvation was
+    deterministic)."""
+    import tendermint_tpu.evidence.reactor as evr
+    from tendermint_tpu.evidence.reactor import (
+        MAX_MSG_EVIDENCE,
+        EvidenceReactor,
+    )
+
+    n_extra = 10
+
+    class _Ev:
+        def __init__(self, i):
+            self._h = i.to_bytes(8, "big")
+
+        def hash(self):
+            return self._h
+
+    class _Pool:
+        def __init__(self, n):
+            self.items = [_Ev(i) for i in range(n)]
+
+        def pending_evidence(self, _cap):
+            return list(self.items), 0
+
+    class _Chan:
+        def __init__(self):
+            self.sent = []
+
+        def try_send(self, env):
+            self.sent.append(env.message.evidence)
+            return True
+
+    async def go():
+        r = EvidenceReactor.__new__(EvidenceReactor)
+        r.pool = _Pool(MAX_MSG_EVIDENCE + n_extra)
+        r.channel = _Chan()
+        old = evr._BROADCAST_INTERVAL
+        evr._BROADCAST_INTERVAL = 0.001
+        try:
+            t = asyncio.ensure_future(r._broadcast_to_peer("peer0"))
+            for _ in range(500):
+                await asyncio.sleep(0.002)
+                if len(r.channel.sent) >= 2:
+                    break
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        finally:
+            evr._BROADCAST_INTERVAL = old
+        sent = r.channel.sent
+        assert sent, "broadcast loop never sent"
+        assert all(len(b) <= MAX_MSG_EVIDENCE for b in sent)
+        assert len(sent[0]) == MAX_MSG_EVIDENCE
+        delivered = {e.hash() for batch in sent for e in batch}
+        assert len(delivered) == MAX_MSG_EVIDENCE + n_extra
+
+    run(go())

@@ -1,0 +1,263 @@
+"""Per-block serving cache (rpc/servingcache.py) + the tx_proofs
+route: byte-identity with the uncached paths, hit/eviction accounting,
+the mutation-epoch flush, the tip seen-commit exclusion, and the
+kill switches — the dynamic half of tmcost's cost-recompute fix (the
+static half is tests/test_tmcost.py's strip-the-cache A/B)."""
+
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu.rpc import servingcache
+from tendermint_tpu.rpc.servingcache import ServingCache
+from tendermint_tpu.types.light import LightBlocksResponse
+from tendermint_tpu.types.tx import tx_hash, txs_hash, txs_proofs
+
+from .test_stateless_bulk import CHAIN, _BS, _SS, _call, _env, build_chain
+
+
+def _counter(env, name):
+    return getattr(env.metrics, "servingcache_" + name)._values.get(
+        (), 0.0
+    )
+
+
+# ---------------------------------------------------------------------------
+# light_blocks / light_block through the cache
+
+
+def test_page_bytes_identical_cold_and_warm():
+    """The blob-assembled page must be byte-identical to
+    LightBlocksResponse.to_proto — cold (all misses), warm (all hits),
+    and with the cache disabled."""
+    blocks = build_chain(12)
+    env = _env(blocks)
+    ref = LightBlocksResponse(
+        light_blocks=[blocks[h] for h in range(2, 9)], last_height=12
+    ).to_proto().hex()
+    cold = _call(env, min_height=2, max_height=8)
+    assert cold["light_blocks"] == ref
+    warm = _call(env, min_height=2, max_height=8)
+    assert warm["light_blocks"] == ref
+    assert _counter(env, "hits") >= 7.0
+    with servingcache.disabled():
+        off = _call(env, min_height=2, max_height=8)
+    assert off["light_blocks"] == ref
+
+
+def test_light_block_single_route_serves_the_same_blob():
+    blocks = build_chain(6)
+    env = _env(blocks)
+    res = asyncio.run(
+        env.light_block(_Req({"height": 4}))
+    )
+    assert res["light_block"] == blocks[4].to_proto().hex()
+    # second call is a pure cache hit
+    h0 = _counter(env, "hits")
+    res2 = asyncio.run(env.light_block(_Req({"height": 4})))
+    assert res2 == res and _counter(env, "hits") == h0 + 1
+
+
+class _Req:
+    def __init__(self, params):
+        self.params = params
+        self.ws = None
+        self.req_id = 1
+
+
+def test_lru_bound_and_eviction_accounting():
+    blocks = build_chain(30)
+    env = _env(blocks)
+    env.serving_cache.capacity = 5
+    for h in range(1, 21):
+        env.serving_cache.encoded_light_block(h)
+    assert len(env.serving_cache._blobs) <= 5
+    assert _counter(env, "evictions") >= 15.0
+
+
+def test_env_kill_switch_and_zero_capacity():
+    blocks = build_chain(8)
+    env = _env(blocks)
+    os.environ["TM_TPU_NO_SERVCACHE"] = "1"
+    try:
+        ref = _call(env, min_height=2, max_height=6)
+        assert env.serving_cache.entries() == 0
+    finally:
+        del os.environ["TM_TPU_NO_SERVCACHE"]
+    # capacity 0 (config [rpc] serving_cache_blocks = 0) also disables
+    env2 = _env(blocks)
+    env2.serving_cache.capacity = 0
+    got = _call(env2, min_height=2, max_height=6)
+    assert got == ref
+    assert env2.serving_cache.entries() == 0
+
+
+def test_mutation_epoch_flushes_the_cache():
+    """An in-place Validator (or Commit) wire-field write anywhere in
+    the process makes every cached encoding suspect: the next request
+    flushes and re-assembles (the PR-7 epoch machinery, ridden rather
+    than rebuilt)."""
+    blocks = build_chain(8)
+    env = _env(blocks)
+    _call(env, min_height=2, max_height=6)
+    assert env.serving_cache.entries() == 5
+    v = blocks[3].validator_set.validators[0]
+    v.voting_power = v.voting_power  # post-init write bumps the epoch
+    res = _call(env, min_height=2, max_height=6)
+    # flushed and rebuilt — fresh misses, and content still correct
+    page = LightBlocksResponse.from_proto(
+        bytes.fromhex(res["light_blocks"])
+    )
+    assert [b.height for b in page.light_blocks] == [2, 3, 4, 5, 6]
+    assert env.serving_cache.entries() == 5
+    c = blocks[4].signed_header.commit
+    c.round = c.round  # commit epoch too
+    env.serving_cache.encoded_light_block(2)
+    assert env.serving_cache.entries() == 1  # flushed again
+
+
+class _TipBS(_BS):
+    """Top height has no canonical commit — only the seen commit."""
+
+    def load_block_commit(self, h):
+        if h == self.height():
+            return None
+        return super().load_block_commit(h)
+
+    def load_seen_commit(self):
+        return self.blocks[self.height()].signed_header.commit
+
+
+def test_tip_seen_commit_fallback_is_served_but_never_cached():
+    blocks = build_chain(6)
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.rpc.core import Environment
+    from tendermint_tpu.rpc.metrics import RPCMetrics
+
+    env = Environment(
+        chain_id=CHAIN,
+        block_store=_TipBS(blocks),
+        state_store=_SS(blocks),
+        metrics=RPCMetrics(Registry()),
+    )
+    res = _call(env, min_height=4, max_height=6)
+    page = LightBlocksResponse.from_proto(
+        bytes.fromhex(res["light_blocks"])
+    )
+    assert [b.height for b in page.light_blocks] == [4, 5, 6]
+    # heights 4,5 cached; the tip (6, seen-commit) must not be
+    assert sorted(env.serving_cache._blobs) == [4, 5]
+
+
+# ---------------------------------------------------------------------------
+# tx_proofs route from the held tree
+
+
+class _TxBS:
+    def __init__(self, txs, top=5):
+        self.txs = txs
+        self._top = top
+
+    def height(self):
+        return self._top
+
+    def base(self):
+        return 1
+
+    def load_block(self, h):
+        class B:
+            pass
+
+        b = B()
+        b.txs = self.txs
+        return b if h <= self._top else None
+
+    def load_block_meta(self, h):
+        return object() if h <= self._top else None
+
+    def load_block_commit(self, h):
+        return object() if h <= self._top else None
+
+    def load_seen_commit(self):
+        return None
+
+
+def _tx_env(txs):
+    from tendermint_tpu.libs.metrics import Registry
+    from tendermint_tpu.rpc.core import Environment
+    from tendermint_tpu.rpc.metrics import RPCMetrics
+
+    return Environment(
+        chain_id=CHAIN,
+        block_store=_TxBS(txs),
+        state_store=_SS({}),
+        metrics=RPCMetrics(Registry()),
+    )
+
+
+def test_tx_proofs_route_serves_reference_identical_proofs():
+    from tendermint_tpu.crypto.merkle import Proof
+
+    txs = [b"tx-%d" % i for i in range(9)]
+    env = _tx_env(txs)
+    res = asyncio.run(
+        env.tx_proofs(_Req({"height": 3, "indices": [0, 4, 8]}))
+    )
+    assert res["root"] == txs_hash(txs).hex()
+    assert res["total"] == 9
+    ref = txs_proofs(txs)
+    for hexp, i in zip(res["proofs"], [0, 4, 8]):
+        p = Proof.from_proto_bytes(bytes.fromhex(hexp))
+        rp = ref[i]
+        assert (p.total, p.index, p.leaf_hash, p.aunts) == (
+            rp.total, rp.index, rp.leaf_hash, rp.aunts
+        )
+        # verifies against the header's data_hash root
+        p.verify(txs_hash(txs), tx_hash(txs[i]))
+    # the tree is HELD: same object serves the next request
+    t1 = env.serving_cache.tx_tree(3)
+    assert env.serving_cache.tx_tree(3) is t1
+
+
+def test_tx_proofs_route_param_validation_and_clamp():
+    from tendermint_tpu.rpc.core import TX_PROOFS_CAP
+    from tendermint_tpu.rpc.jsonrpc import RPCError
+
+    txs = [b"t%d" % i for i in range(4)]
+    env = _tx_env(txs)
+    for bad in (None, "nope", [1, "x"], [True], {"a": 1}):
+        with pytest.raises(RPCError):
+            asyncio.run(
+                env.tx_proofs(_Req({"height": 3, "indices": bad}))
+            )
+    with pytest.raises(RPCError):  # out of range
+        asyncio.run(
+            env.tx_proofs(_Req({"height": 3, "indices": [99]}))
+        )
+    with pytest.raises(RPCError):  # negative aliasing refused
+        asyncio.run(
+            env.tx_proofs(_Req({"height": 3, "indices": [-1]}))
+        )
+    # an index past int64 overflows inside numpy's asarray: that is
+    # invalid CLIENT input (INVALID_PARAMS), not an internal error
+    from tendermint_tpu.rpc.jsonrpc import INVALID_PARAMS
+
+    with pytest.raises(RPCError) as exc:
+        asyncio.run(
+            env.tx_proofs(_Req({"height": 3, "indices": [2**70]}))
+        )
+    assert exc.value.code == INVALID_PARAMS
+    # shrink-only clamp: an oversized list serves the first CAP
+    res = asyncio.run(
+        env.tx_proofs(
+            _Req({"height": 3, "indices": [0] * (TX_PROOFS_CAP + 50)})
+        )
+    )
+    assert len(res["proofs"]) == TX_PROOFS_CAP
+    assert env.metrics.tx_proofs_requests._values[()] == 1.0
+
+
+def test_tx_proofs_route_is_in_the_route_table():
+    env = _tx_env([b"a"])
+    assert env.routes()["tx_proofs"] == env.tx_proofs
