@@ -513,6 +513,68 @@ def bench_breaker_probe_overhead(reps: int = 20_000):
     }
 
 
+def bench_timeline_overhead(reps: int = 200_000, heights: int = 100):
+    """What the consensus flight recorder costs
+    (consensus/timeline.py): the DISABLED path as the step-transition
+    sites pay it (one `tl.enabled` attribute check, no call — the
+    counting-stub test pins that zero record() calls happen), the
+    enabled ring append, the always-on crossing mark, and a simulated
+    100-height run against a small ring proving the deque bound holds
+    under eviction (ISSUE 15 acceptance row)."""
+    from tendermint_tpu.consensus.timeline import TimelineRecorder
+
+    tl = TimelineRecorder(capacity=256, enabled=False)
+    # baseline: the loop scaffolding itself
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pass
+    base = time.perf_counter() - t0
+    # the disabled step-transition pattern from consensus/state.py
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        if tl.enabled:
+            tl.record("step", 1, 0, step="RoundStepPropose")
+    disabled_ns = (time.perf_counter() - t0 - base) / reps * 1e9
+    assert len(tl) == 0  # disabled: nothing recorded
+
+    tl.enable()
+    t0 = time.perf_counter()
+    for i in range(reps):
+        tl.record("step", i, 0, step="RoundStepPropose")
+    enabled_ns = (time.perf_counter() - t0 - base) / reps * 1e9
+    # the always-on crossing mark (dedup probe + metric anchor path);
+    # re-marking the same crossing is the hot shape (every vote after
+    # the threshold re-fires the detection site)
+    tl.mark_new_height(1)
+    tl.mark_polka(1, 0)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        tl.mark_polka(1, 0)
+    mark_dedup_ns = (time.perf_counter() - t0 - base) / reps * 1e9
+
+    # bounded over a simulated 100-height run (≈10 events/height
+    # against a 256-slot ring: eviction must hold the bound)
+    tl.reset()
+    for h in range(1, heights + 1):
+        tl.mark_new_height(h)
+        for step in ("NewRound", "Propose", "Prevote", "Precommit"):
+            tl.record("step", h, 0, step=f"RoundStep{step}")
+        tl.mark_proposal(h, 0)
+        tl.mark_prevote_any(h, 0)
+        tl.mark_polka(h, 0)
+        tl.mark_precommit_quorum(h, 0)
+        tl.mark_commit(h, 0, 0, "")
+    bounded = len(tl) <= tl.capacity
+    return {
+        "disabled_ns": round(disabled_ns, 2),
+        "enabled_record_ns": round(enabled_ns, 1),
+        "mark_dedup_ns": round(mark_dedup_ns, 1),
+        "ring_len_after_100_heights": len(tl),
+        "ring_capacity": tl.capacity,
+        "bounded": bounded,
+    }
+
+
 def bench_tmlive_gate():
     """Full tmlive liveness/boundedness gate (scripts/lint.py --live):
     wall time plus per-rule finding and suppression counts, recorded
@@ -2034,6 +2096,11 @@ def main() -> None:
         "breaker_overhead",
         bench_breaker_probe_overhead,
         "breaker_probe_overhead",
+    )
+    cpu_stage(
+        "timeline_overhead",
+        bench_timeline_overhead,
+        "timeline_overhead",
     )
     cpu_stage(
         "tmlive_gate",

@@ -1,0 +1,278 @@
+#!/usr/bin/env python3
+"""BENCH_*.json trajectory differ — catch perf regressions between a
+fresh bench line and the banked one (ISSUE 15 satellite).
+
+The repo banks bench trajectories (BENCH_r0N.json, BENCH_LOAD.json,
+BENCH_STATELESS.json, ...) as one JSON document per run; until now
+comparing a fresh run against the banked numbers was eyeball work.
+This script flattens both documents into dotted row keys, compares
+every numeric row they share, prints the % delta per row, and exits
+nonzero when any row regressed past the threshold — so a bench rerun
+can gate a PR the way the lint gates do.
+
+    python scripts/bench_compare.py FRESH.json BANKED.json
+    python scripts/bench_compare.py fresh.json BENCH_r05.json \\
+        --threshold 0.15 --rows 'verify_commit*'
+
+Direction matters: `*_per_s`-style rows are higher-is-better,
+`*_ms`/`*_s`/`*_us` latency rows are lower-is-better. Rows whose
+direction the suffix table can't classify are PRINTED but never fail
+the gate (a moving `num_cpu_devices` is information, not a
+regression). Rows present in the banked file but missing from the
+fresh one fail the gate — a silently vanished measurement is how
+trajectories rot. A row whose VALUE is null on either side (a
+measurement that legitimately had no value that run, e.g. a recovery
+phase that never happened) is reported as info and never fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Dict, Optional, Tuple
+
+__all__ = ["compare", "flatten", "direction_of", "main"]
+
+# metadata keys that are never measurements (any nesting level)
+_SKIP_KEYS = {
+    "schema",
+    "seed",
+    "recorded_unix",
+    "recorded_at",
+    "timestamp",
+    "git",
+    "note",
+    "notes",
+}
+
+# row-name suffix -> direction. higher = bigger is better,
+# lower = smaller is better. Checked longest-suffix-first.
+_HIGHER_SUFFIXES = (
+    "_per_s",
+    "per_s",
+    "_per_sec",
+    "_throughput",
+    "_hits",
+    "_held",
+    "sigs_per_s",
+    "headers_per_s",
+    "_speedup",
+    "_x",
+)
+_LOWER_SUFFIXES = (
+    "_ms",
+    "_us",
+    "_ns",
+    "_s",
+    "_seconds",
+    "_latency",
+    "_wall",
+    "_overhead",
+    "_errors",
+    "_timeouts",
+    "_dropped",
+    "_evictions",
+    "_misses",
+)
+
+
+def direction_of(key: str) -> Optional[int]:
+    """+1 higher-is-better, -1 lower-is-better, None unknown.
+    Segments are consulted leaf-first so the most specific name wins
+    (`routes_p99_ms.status` is a latency row: the `status` leaf says
+    nothing, its `routes_p99_ms` parent does)."""
+    for seg in reversed(key.lower().split(".")):
+        # throughput markers may sit mid-name with a qualifier after
+        # them (light_sync_warm_headers_per_s_150vals)
+        if "per_s" in seg or "throughput" in seg:
+            return 1
+        for suf in _HIGHER_SUFFIXES:
+            if seg.endswith(suf):
+                return 1
+        for suf in _LOWER_SUFFIXES:
+            if seg.endswith(suf):
+                return -1
+    return None
+
+
+def flatten(doc: dict, prefix: str = "") -> Dict[str, Optional[float]]:
+    """Numeric leaves of a bench document as {dotted.key: value};
+    bools and metadata keys are skipped. A JSON null leaf is kept as
+    None — "the measurement legitimately had no value this run"
+    (e.g. a chaos artifact's heal_detection_s when no stall-reset was
+    needed) is information, NOT a vanished row."""
+    out: Dict[str, Optional[float]] = {}
+    for k, v in doc.items():
+        if k in _SKIP_KEYS:
+            continue
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if v is None:
+            out[key] = None
+        elif isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict):
+            out.update(flatten(v, prefix=key + "."))
+        # lists/strings are not trajectory rows
+    return out
+
+
+def compare(
+    fresh: dict,
+    banked: dict,
+    threshold: float = 0.10,
+    rows: str = "",
+) -> Tuple[list, list]:
+    """Per-row comparison. Returns (report_rows, failures): every
+    report row is (key, banked, fresh, delta_pct, direction, status)
+    with status in {ok, regressed, improved, info, missing}."""
+    f_flat, b_flat = flatten(fresh), flatten(banked)
+    report, failures = [], []
+    for key in sorted(b_flat):
+        if rows and not fnmatch.fnmatch(key, rows):
+            continue
+        old = b_flat[key]
+        if key not in f_flat:
+            row = (key, old, None, None, direction_of(key), "missing")
+            report.append(row)
+            failures.append(row)
+            continue
+        new = f_flat[key]
+        if old is None or new is None:
+            # a null on either side is not comparable and not a
+            # regression — report it, never fail on it
+            report.append(
+                (key, old, new, None, direction_of(key), "info")
+            )
+            continue
+        if old == 0:
+            delta = 0.0 if new == 0 else float("inf")
+        else:
+            delta = (new - old) / abs(old)
+        d = direction_of(key)
+        if d is None:
+            status = "info"
+        elif (d > 0 and delta < -threshold) or (
+            d < 0 and delta > threshold
+        ):
+            status = "regressed"
+        elif (d > 0 and delta > threshold) or (
+            d < 0 and delta < -threshold
+        ):
+            status = "improved"
+        else:
+            status = "ok"
+        row = (key, old, new, delta, d, status)
+        report.append(row)
+        if status == "regressed":
+            failures.append(row)
+    return report, failures
+
+
+def _fmt_val(v) -> str:
+    if v is None:
+        return "-"
+    if abs(v) >= 1000:
+        return f"{v:.0f}"
+    return f"{v:.4g}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Compare a fresh bench JSON against a banked "
+        "BENCH_*.json; exit 1 on any regression past the threshold."
+    )
+    ap.add_argument("fresh", help="fresh bench line / document (JSON)")
+    ap.add_argument("banked", help="banked trajectory file (JSON)")
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="relative regression tolerance (default 0.10 = 10%%)",
+    )
+    ap.add_argument(
+        "--rows",
+        default="",
+        help="fnmatch filter on dotted row keys (e.g. 'verify_*')",
+    )
+    ap.add_argument(
+        "--all",
+        action="store_true",
+        help="print every row, not just changed/failed ones",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.fresh) as f:
+            fresh = json.load(f)
+        with open(args.banked) as f:
+            banked = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    report, failures = compare(
+        fresh, banked, threshold=args.threshold, rows=args.rows
+    )
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "threshold": args.threshold,
+                    "rows": [
+                        {
+                            "key": k,
+                            "banked": old,
+                            "fresh": new,
+                            "delta_pct": (
+                                round(delta * 100, 2)
+                                if delta is not None
+                                and delta != float("inf")
+                                else None
+                            ),
+                            "status": status,
+                        }
+                        for k, old, new, delta, _d, status in report
+                    ],
+                    "regressions": len(failures),
+                },
+                indent=1,
+            )
+        )
+    else:
+        shown = 0
+        for k, old, new, delta, d, status in report:
+            if not args.all and status in ("ok", "info"):
+                continue
+            arrow = {1: "↑better", -1: "↓better", None: ""}[d]
+            pct = (
+                "-"
+                if delta is None
+                else ("inf" if delta == float("inf") else f"{delta * 100:+.1f}%")
+            )
+            print(
+                f"{status:>9}  {k}: {_fmt_val(old)} -> "
+                f"{_fmt_val(new)}  ({pct}) {arrow}"
+            )
+            shown += 1
+        if shown == 0:
+            print(
+                f"all {len(report)} compared rows within "
+                f"{args.threshold * 100:.0f}% of the banked trajectory"
+            )
+        if failures:
+            print(
+                f"FAIL: {len(failures)} row(s) regressed past "
+                f"{args.threshold * 100:.0f}% (or went missing)",
+                file=sys.stderr,
+            )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""Offline consensus-timeline reconstruction from a WAL — the
+post-mortem half of the flight recorder (ISSUE 15).
+
+A wedged or dead node can't serve its `consensus_timeline` RPC ring,
+but its WAL holds every input the consensus loop processed (proposals,
+block parts, votes, timeouts — write-before-process) plus the
+round-step markers `_new_step` writes, each stamped with the wall
+clock. This script rebuilds the same event stream the live recorder
+captured and prints a per-height phase table: when the proposal
+landed, when the count-based +2/3 prevote/precommit thresholds
+crossed, how many rounds burned, how many timeouts fired, and the
+wall spans between phases — with ZERO live state.
+
+    python scripts/timeline_replay.py ~/.tendermint/data/cs.wal
+    python scripts/timeline_replay.py cs.wal --json out.json
+    python scripts/timeline_replay.py cs.wal --events     # raw stream
+    python scripts/timeline_replay.py cs.wal --validators 4
+
+Vote thresholds are COUNT-based (> 2/3 of the committee, inferred as
+max(validator_index)+1 unless --validators is given): exact for
+equal-power sets, an approximation otherwise — derived events carry a
+`derived` attr saying so. Gossip stall-resets are reactor-side state,
+not consensus inputs, so a WAL reconstruction cannot contain them
+(the live ring and the stall-reset counters do).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from tendermint_tpu.consensus.timeline import (  # noqa: E402
+    events_from_wal,
+    summarize_heights,
+)
+
+
+def _fmt(v, width):
+    s = "-" if v is None else str(v)
+    return s.rjust(width)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Rebuild the consensus flight-recorder timeline "
+        "from a WAL (post-mortem, zero live state)."
+    )
+    ap.add_argument("wal", help="path to the WAL head file (cs.wal)")
+    ap.add_argument(
+        "--validators",
+        type=int,
+        default=0,
+        help="committee size for the count-based vote thresholds "
+        "(default: inferred as max validator index + 1)",
+    )
+    ap.add_argument(
+        "--events",
+        action="store_true",
+        help="print the raw reconstructed event stream, one JSON "
+        "object per line, instead of the per-height table",
+    )
+    ap.add_argument(
+        "--json",
+        metavar="PATH",
+        default="",
+        help="also write {events, heights} as JSON to PATH "
+        "('-' = stdout)",
+    )
+    args = ap.parse_args(argv)
+
+    wal = args.wal
+    if os.path.isdir(wal):
+        # the default home layout is data/cs.wal/wal (config.py
+        # wal_file): pointing at the group DIRECTORY means its head
+        head = os.path.join(wal, "wal")
+        if not os.path.exists(head):
+            print(
+                f"error: {wal} is a directory without a 'wal' head "
+                "file — pass the WAL head file itself",
+                file=sys.stderr,
+            )
+            return 2
+        wal = head
+    if not os.path.exists(wal):
+        print(f"error: no WAL at {wal}", file=sys.stderr)
+        return 2
+    events = events_from_wal(wal, validators=args.validators)
+    heights = summarize_heights(events)
+
+    if args.json:
+        doc = json.dumps(
+            {"events": events, "heights": heights}, indent=1
+        )
+        if args.json == "-":
+            print(doc)
+        else:
+            with open(args.json, "w") as f:
+                f.write(doc + "\n")
+
+    if args.events:
+        for e in events:
+            print(json.dumps(e))
+        return 0
+
+    if not events:
+        print("no decodable records in the WAL group")
+        return 1
+
+    print(
+        f"{len(events)} events over {len(heights)} heights "
+        f"from {args.wal}"
+    )
+    hdr = (
+        "height  rounds  timeouts  prop->polka_ms  "
+        "polka->quorum_ms  quorum->commit_ms  total_ms"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for row in heights:
+        print(
+            _fmt(row["height"], 6)
+            + _fmt(row["rounds"], 8)
+            + _fmt(row["timeouts"], 10)
+            + _fmt(row["proposal_to_polka_ms"], 16)
+            + _fmt(row["polka_to_precommit_quorum_ms"], 18)
+            + _fmt(row["precommit_quorum_to_commit_ms"], 19)
+            + _fmt(row["first_event_to_commit_ms"], 10)
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `timeline_replay.py wal | head` closes our stdout mid-table
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -874,6 +874,60 @@ def cmd_debug_dump(args) -> int:
             "slow_requests.json",
             _trace.exemplars_to_json().encode(),
         )
+        # consensus flight-recorder timeline (docs/observability.md):
+        # the live ring over RPC when the node answers, else the WAL
+        # reconstruction — a wedged/dead node still explains itself
+        timeline_doc = None
+        if getattr(args, "rpc_url", ""):
+            try:
+                # follow the seq cursor: one page is at most
+                # TIMELINE_PAGE_CAP events, the resident ring holds up
+                # to consensus_timeline_capacity — the bundle wants
+                # all of it (page count bounded by capacity/cap + 1)
+                base = args.rpc_url.rstrip("/")
+                doc, cursor = None, 0
+                for _ in range(64):
+                    with urllib.request.urlopen(
+                        f"{base}/consensus_timeline?after_seq={cursor}",
+                        timeout=5,
+                    ) as resp:
+                        page = json.loads(resp.read())["result"]
+                    if doc is None:
+                        doc = page
+                    else:
+                        doc["events"].extend(page["events"])
+                        doc["next_seq"] = page["next_seq"]
+                    if not page["events"]:
+                        break
+                    cursor = page["next_seq"]
+                if doc is not None and doc.get("events"):
+                    # a disabled or just-reset ring answers with zero
+                    # events — the WAL reconstruction below still has
+                    # the story, so only a non-empty ring wins
+                    doc["source"] = "rpc_ring"
+                    timeline_doc = json.dumps(doc).encode()
+            except Exception:
+                timeline_doc = None  # fall through to the WAL
+        if timeline_doc is None:
+            try:
+                from ..consensus.timeline import (
+                    events_from_wal,
+                    summarize_heights,
+                )
+
+                events = events_from_wal(wal_path)
+                timeline_doc = json.dumps(
+                    {
+                        "source": "wal_reconstruction",
+                        "events": events,
+                        "heights": summarize_heights(events),
+                    }
+                ).encode()
+            except Exception as e:
+                timeline_doc = json.dumps(
+                    {"timeline_error": repr(e)}
+                ).encode()
+        add_bytes(tar, "timeline.json", timeline_doc)
         # live metrics scrape, best effort
         if args.metrics_url:
             try:

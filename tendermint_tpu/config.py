@@ -213,6 +213,14 @@ class InstrumentationConfig:
     # slow_requests.json. Off by default; process-wide like the ring.
     slo_exemplars: bool = False
     slo_exemplar_capacity: int = 64
+    # consensus flight recorder (consensus/timeline.py): bounded
+    # per-node ring of height/round events (step transitions,
+    # threshold crossings, timeouts, gossip stall-resets), served by
+    # the consensus_timeline RPC route and the debug bundle. ON by
+    # default — like the WAL it earns its keep post-mortem; the
+    # disabled path is one attribute check per step transition.
+    consensus_timeline: bool = True
+    consensus_timeline_capacity: int = 4096
 
 
 @dataclass

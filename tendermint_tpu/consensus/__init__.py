@@ -8,17 +8,20 @@ host-side orchestration.
 
 from .state import ConsensusState
 from .ticker import TimeoutTicker
+from .timeline import TimelineRecorder, events_from_wal
 from .types import HeightVoteSet, RoundState, RoundStep, step_name
 from .wal import WAL, NopWAL, iter_wal_records
 
 __all__ = [
     "ConsensusState",
     "TimeoutTicker",
+    "TimelineRecorder",
     "HeightVoteSet",
     "RoundState",
     "RoundStep",
     "step_name",
     "WAL",
     "NopWAL",
+    "events_from_wal",
     "iter_wal_records",
 ]

@@ -33,11 +33,38 @@ class ConsensusMetrics:
             "validators_power",
             "Total voting power of validators.",
         )
-        self.block_interval = r.histogram(
+        # quantile sketch rather than the reference's histogram: the
+        # chaos/load planes read p99 block interval directly (ISSUE 15
+        # reference-parity metrics; see docs/metrics.md "Latency
+        # sketches" for the error bound)
+        self.block_interval = r.sketch(
             "consensus",
             "block_interval_seconds",
             "Time between this and the last block.",
-            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+        )
+        self.rounds_per_height = r.histogram(
+            "consensus",
+            "rounds_per_height",
+            "Rounds needed to commit a height (1 = no burned round).",
+            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 11.0),
+        )
+        self.quorum_prevote_latency = r.sketch(
+            "consensus",
+            "quorum_prevote_seconds",
+            "Proposal received to +2/3 prevotes (polka), same round.",
+        )
+        self.quorum_precommit_latency = r.sketch(
+            "consensus",
+            "quorum_precommit_seconds",
+            "+2/3 prevotes (polka) to +2/3 precommits, same round.",
+        )
+        self.stall_resets = r.counter(
+            "consensus",
+            "stall_resets_total",
+            "Gossip stall-reset ticks (forget-and-resend of optimistic "
+            "delivered-marks) by reset site: catchup (peer >=2 behind), "
+            "live (same height), last_commit (peer one behind).",
+            label_names=("kind",),
         )
         self.num_txs = r.gauge(
             "consensus",

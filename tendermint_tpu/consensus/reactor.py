@@ -527,6 +527,7 @@ class ConsensusReactor(Service):
                 if not sent:
                     # The optimistic-marks hazard of the two catchup
                     # branches below, at the LIVE height (ISSUE 13):
+                    # (kind="live" in the stall-reset observability)
                     # a partitioned or lossy link drops the frame
                     # while the connection survives, our bits claim
                     # delivery, and with < 2/3 prevotes delivered no
@@ -538,7 +539,7 @@ class ConsensusReactor(Service):
                     # and resend — dup votes are idempotent on the
                     # receiver, and the burst is bounded to one
                     # vote-set resend per stall window.
-                    self._vote_stall_tick(ps, ps.reset_live_votes)
+                    self._vote_stall_tick(ps, ps.reset_live_votes, "live")
             elif (
                 prs.height != 0
                 and rs.height == prs.height + 1
@@ -554,7 +555,9 @@ class ConsensusReactor(Service):
                     # precommit bits via _get_vote_bits) are the lying
                     # ones (witnessed: the 2|2 campaign scenario
                     # wedged here after the live-height reset landed)
-                    self._vote_stall_tick(ps, ps.reset_live_votes)
+                    self._vote_stall_tick(
+                        ps, ps.reset_live_votes, "last_commit"
+                    )
             elif (
                 prs.height != 0
                 and rs.height >= prs.height + 2
@@ -591,6 +594,15 @@ class ConsensusReactor(Service):
                         )
                         if ps.vote_catchup_stall * sleep > 1.0:
                             ps.vote_catchup_stall = 0
+                            # visible wedge-save: counter + timeline
+                            # event (ISSUE 15 — these ticks used to
+                            # fire invisibly)
+                            self.cs.timeline.mark_stall_reset(
+                                "catchup",
+                                prs.height,
+                                commit.round,
+                                ps.peer_id,
+                            )
                             ps.reset_catchup_precommits(
                                 prs.height, commit.round, n
                             )
@@ -601,13 +613,16 @@ class ConsensusReactor(Service):
                 ps.live_vote_stall = 0
                 await asyncio.sleep(0)
 
-    def _vote_stall_tick(self, ps: PeerState, reset) -> None:
+    def _vote_stall_tick(self, ps: PeerState, reset, kind: str) -> None:
         """Count a nothing-to-send gossip tick while BOTH sides'
         round states are frozen; past the stall window, run `reset`
         (forget the optimistic delivered-marks so gossip resends).
         Any progress — a successful send, or either side moving —
         zeroes the counter, so healthy nets pay one integer bump per
-        idle tick and never reset."""
+        idle tick and never reset. `kind` labels the reset site
+        ("live" | "last_commit") in the stall-reset counter and the
+        flight-recorder event — a wedge-save used to be
+        indistinguishable from a quiet net (ISSUE 15)."""
         rs = self.cs.rs
         prs = ps.prs
         snap = (
@@ -623,6 +638,9 @@ class ConsensusReactor(Service):
             > 2.0
         ):
             ps.live_vote_stall = 0
+            self.cs.timeline.mark_stall_reset(
+                kind, rs.height, rs.round, ps.peer_id
+            )
             reset()
 
     def _validators_size_at(self, height: int) -> int:

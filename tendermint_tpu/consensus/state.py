@@ -44,6 +44,7 @@ from ..types.vote_set import ConflictingVoteError, VoteSet, commit_to_vote_set
 from .msgs import (
     BlockPartMessage,
     EndHeightMessage,
+    EventDataRoundStateWAL,
     MsgInfo,
     ProposalMessage,
     TimeoutInfo,
@@ -51,6 +52,12 @@ from .msgs import (
 )
 from .metrics import ConsensusMetrics
 from .ticker import TimeoutTicker
+from .timeline import (
+    EV_NEW_ROUND,
+    EV_STEP,
+    EV_TIMEOUT,
+    TimelineRecorder,
+)
 from .types import HeightVoteSet, RoundState, RoundStep, step_name
 from .wal import WAL, NopWAL
 
@@ -78,12 +85,22 @@ class ConsensusState(Service):
         evidence_pool=None,
         replay_mode: bool = False,
         metrics: Optional[ConsensusMetrics] = None,
+        timeline: Optional[TimelineRecorder] = None,
     ) -> None:
         super().__init__(name="consensus", logger=get_logger("consensus"))
         self.cfg = cfg
         # reference: internal/consensus/metrics.go threaded via
         # CSMetrics; per-node registry when node assembly provides one
         self.metrics = metrics if metrics is not None else ConsensusMetrics()
+        # per-node consensus flight recorder (consensus/timeline.py);
+        # node assembly threads the config-built one, bare
+        # constructions get a default-capacity ring feeding the same
+        # metrics struct
+        self.timeline: TimelineRecorder = (
+            timeline
+            if timeline is not None
+            else TimelineRecorder(metrics=self.metrics)
+        )
         self.block_exec = block_exec
         self.block_store = block_store
         self.privval = privval
@@ -242,6 +259,7 @@ class ConsensusState(Service):
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
         self.state = state
+        self.timeline.mark_new_height(height)
         self.metrics.height.set(height)
         self.metrics.rounds.set(0)
         self.metrics.validators.set(validators.size())
@@ -506,6 +524,15 @@ class ConsensusState(Service):
         ):
             self.logger.debug("ignoring tock because we are ahead", ti=repr(ti))
             return
+        tl = self.timeline
+        if tl.enabled:
+            tl.record(
+                EV_TIMEOUT,
+                ti.height,
+                ti.round,
+                step=step_name(ti.step),
+                duration_s=ti.duration_s,
+            )
         if ti.step == RoundStep.NEW_HEIGHT:
             await self._enter_new_round(ti.height, 0)
         elif ti.step == RoundStep.NEW_ROUND:
@@ -547,6 +574,11 @@ class ConsensusState(Service):
         rs.round = round_
         rs.step = RoundStep.NEW_ROUND
         rs.validators = validators
+        tl = self.timeline
+        if tl.enabled and round_ != 0:
+            # round 0 is covered by new_height; later entries are the
+            # burned rounds the fleet merger attributes
+            tl.record(EV_NEW_ROUND, height, round_)
         if round_ != 0:
             # round 0 keeps the proposal from NewHeight; later rounds start
             # over (valid block, if any, is re-proposed by the new proposer)
@@ -896,6 +928,9 @@ class ConsensusState(Service):
             hash=block.hash().hex()[:16],
             num_txs=len(block.txs),
         )
+        self.timeline.mark_commit(
+            height, rs.commit_round, len(block.txs), block.hash().hex()[:16]
+        )
         self.metrics.num_txs.set(len(block.txs))
         self.metrics.total_txs.inc(len(block.txs))
         self.metrics.block_size.set(block.size())
@@ -955,6 +990,7 @@ class ConsensusState(Service):
         if not proposal.verify(self.state.chain_id, proposer.pub_key):
             raise ValueError("invalid proposal signature")
         rs.proposal = proposal
+        self.timeline.mark_proposal(proposal.height, proposal.round)
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet.from_header(
                 proposal.block_id.part_set_header
@@ -979,6 +1015,7 @@ class ConsensusState(Service):
         if added and rs.proposal_block_parts.is_complete():
             data = rs.proposal_block_parts.assemble()
             rs.proposal_block = Block.from_proto(data)
+            self.timeline.mark_block(rs.height, rs.round)
             self.logger.info(
                 "received complete proposal block",
                 height=rs.proposal_block.header.height,
@@ -1098,8 +1135,16 @@ class ConsensusState(Service):
         rs = self.rs
         height = rs.height
         prevotes = rs.votes.prevotes(vote.round)
+        if prevotes.has_two_thirds_any():
+            self.timeline.mark_prevote_any(height, vote.round)
         block_id, ok = prevotes.two_thirds_majority()
         if ok:
+            if not block_id.is_zero():
+                # a nil polka (+2/3 AGAINST the proposal) is not the
+                # EV_POLKA crossing and must not feed the
+                # proposal->polka latency sketch — mirror of the
+                # precommit-quorum guard in _after_precommit_added
+                self.timeline.mark_polka(height, vote.round)
             # Unlock on a newer POL for a different block
             if (
                 rs.locked_block is not None
@@ -1157,6 +1202,8 @@ class ConsensusState(Service):
         height = rs.height
         precommits = rs.votes.precommits(vote.round)
         block_id, ok = precommits.two_thirds_majority()
+        if ok and not block_id.is_zero():
+            self.timeline.mark_precommit_quorum(height, vote.round)
         if ok:
             await self._enter_new_round(height, vote.round)
             await self._enter_precommit(height, vote.round)
@@ -1264,10 +1311,26 @@ class ConsensusState(Service):
     # events
 
     def _new_step(self) -> None:
+        step = step_name(self.rs.step)
+        if not self._replay_mode:
+            # round-state marker into the WAL (reference: state.go
+            # newStep -> wal.Write(rs)) — the step events the
+            # post-mortem reconstruction (timeline.events_from_wal)
+            # rebuilds the timeline from; buffered, no fsync
+            self.wal.write(
+                EventDataRoundStateWAL(
+                    height=self.rs.height,
+                    round=self.rs.round,
+                    step=step,
+                )
+            )
+        tl = self.timeline
+        if tl.enabled:
+            tl.record(EV_STEP, self.rs.height, self.rs.round, step=step)
         rsw = E.EventDataRoundState(
             height=self.rs.height,
             round=self.rs.round,
-            step=step_name(self.rs.step),
+            step=step,
         )
         if self.event_bus and not self._replay_mode:
             self.event_bus.publish_new_round_step(rsw)

@@ -1,0 +1,595 @@
+"""Consensus flight recorder — the per-node height/round timeline.
+
+The observability planes built so far see *requests* (rpc/metrics.py
+per-route SLO sketches + libs/trace.py exemplars) and *processes*
+(libs/trace.py spans + per-node metric registries), but nothing sees
+*consensus*: a chaos verdict carries a bare TTFC number, and the two
+gossip-wedge diagnoses (PRs 9/13) each took manual log archaeology.
+This module records the causal story of every height as structured
+events:
+
+    new_height -> new_round -> step transitions (Propose/Prevote/...)
+    proposal received -> complete block -> +2/3 prevote (any) ->
+    polka (+2/3 for one block) -> +2/3 precommit -> commit
+
+plus timeout fires and — critically — the gossip stall-reset ticks
+(`vote_catchup_stall` / `_vote_stall_tick`, reactor.py) that used to
+fire invisibly: a wedge-save is now distinguishable from a quiet net.
+
+Design follows libs/trace.py: a bounded ring (old events evicted,
+never blocked on), kill-switched (`[instrumentation]
+consensus_timeline`), with a consensus-grade-cheap disabled path —
+call sites in consensus/state.py guard on the plain `enabled`
+attribute, so a disabled recorder adds one attribute read to a step
+transition (bench.py `timeline_overhead` pins it). Unlike the trace
+ring the recorder is PER NODE (constructed in node assembly beside the
+metric Registry), so in-process localnet nodes keep disjoint
+timelines — the fleet merger (loadgen/timeline.py) depends on it.
+
+Events carry BOTH clocks: `t_mono_ns` (time.monotonic_ns — durations
+within one node) and `t_wall_ns` (time.time_ns — cross-node alignment
+on one box, and alignment with WAL record timestamps). The recorder
+also feeds the reference-parity consensus metrics from the same
+crossing events: the proposal->polka and polka->+2/3-precommit
+latency sketches, the rounds-per-height histogram, and the
+stall-reset counters (consensus/metrics.py) observe whether or not
+the ring itself is enabled — the kill switch silences the *ring*, not
+the metrics plane.
+
+Post-mortem twin: `events_from_wal()` reconstructs the same event
+stream from a consensus WAL — every input the node saw (proposals,
+parts, votes, timeouts) plus the round-step markers `_new_step`
+writes — so a wedged or dead node explains itself with zero live
+state (scripts/timeline_replay.py is the CLI).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "EV_BLOCK",
+    "EV_COMMIT",
+    "EV_NEW_HEIGHT",
+    "EV_NEW_ROUND",
+    "EV_POLKA",
+    "EV_PRECOMMIT_QUORUM",
+    "EV_PREVOTE_ANY",
+    "EV_PROPOSAL",
+    "EV_STALL_RESET",
+    "EV_STEP",
+    "EV_TIMEOUT",
+    "TimelineEvent",
+    "TimelineRecorder",
+    "events_from_wal",
+    "summarize_heights",
+]
+
+DEFAULT_CAPACITY = 4096
+
+# Event kinds — one shared vocabulary for the live recorder, the WAL
+# reconstruction, and the fleet merger. Keep in sync with
+# docs/observability.md's event table.
+EV_STEP = "step"  # round-step transition (step attr = RoundStep name)
+EV_NEW_HEIGHT = "new_height"  # entered a new height
+EV_NEW_ROUND = "new_round"  # entered round > 0 (rounds burned)
+EV_PROPOSAL = "proposal"  # signature-verified proposal accepted
+EV_BLOCK = "block"  # complete proposal block assembled
+EV_PREVOTE_ANY = "prevote_any"  # +2/3 prevotes for any block (mixed)
+EV_POLKA = "polka"  # +2/3 prevotes for ONE block
+EV_PRECOMMIT_QUORUM = "precommit_quorum"  # +2/3 precommits for a block
+EV_TIMEOUT = "timeout"  # a scheduled timeout actually fired
+EV_STALL_RESET = "stall_reset"  # gossip forget-and-resend tick
+EV_COMMIT = "commit"  # block finalized into the store
+
+
+class TimelineEvent:
+    """One recorded consensus event. Plain slots object — the ring
+    holds tens of thousands of these under chaos load."""
+
+    __slots__ = (
+        "seq",
+        "kind",
+        "height",
+        "round",
+        "step",
+        "t_mono_ns",
+        "t_wall_ns",
+        "attrs",
+    )
+
+    def __init__(
+        self,
+        seq: int,
+        kind: str,
+        height: int,
+        round_: int,
+        step: str,
+        t_mono_ns: int,
+        t_wall_ns: int,
+        attrs: Optional[Dict[str, Any]],
+    ) -> None:
+        self.seq = seq
+        self.kind = kind
+        self.height = height
+        self.round = round_
+        self.step = step
+        self.t_mono_ns = t_mono_ns
+        self.t_wall_ns = t_wall_ns
+        self.attrs = attrs
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "seq": self.seq,
+            "kind": self.kind,
+            "height": self.height,
+            "round": self.round,
+            "t_mono_ns": self.t_mono_ns,
+            "t_wall_ns": self.t_wall_ns,
+        }
+        if self.step:
+            d["step"] = self.step
+        if self.attrs:
+            d.update(self.attrs)
+        return d
+
+
+class TimelineRecorder:
+    """Bounded, kill-switched per-node ring of consensus events.
+
+    Hot-path contract (mirrors libs/trace.py's): consensus/state.py's
+    step-transition sites guard on the plain `enabled` attribute and
+    skip the call entirely when off, so the disabled recorder costs
+    one attribute read (pinned by the counting-stub test and the
+    `timeline_overhead` bench row). The `mark_*` crossing helpers are
+    ALWAYS called — they feed the consensus metrics sketches/counters
+    — and append to the ring only when enabled.
+
+    Single-writer by construction: every producer (consensus receive
+    loop, reactor gossip tasks, RPC readers) lives on the node's
+    asyncio loop, so ring appends never race and no lock is needed.
+    """
+
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        enabled: bool = True,
+        metrics=None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(
+                f"timeline capacity must be >= 1: {capacity}"
+            )
+        self.enabled = enabled
+        self.capacity = capacity
+        self.metrics = metrics  # ConsensusMetrics or None
+        # tmlive: bounded= ring (deque maxlen=capacity)
+        self._ring: deque = deque(maxlen=capacity)
+        self._next_seq = 1
+        # crossing dedup + latency anchors for the CURRENT height only
+        # — both cleared on every mark_new_height, so they are bounded
+        # by the events of one height
+        self._once: set = set()
+        self._anchors: Dict[str, Tuple[int, int]] = {}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        """Kill switch: subsequent events are not recorded (metric
+        feeds from mark_* keep observing — the switch silences the
+        ring, not the metrics plane)."""
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Drop every recorded event (tests; debug-dump isolation)."""
+        self._ring.clear()
+        self._once.clear()
+        self._anchors.clear()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    # -- recording -----------------------------------------------------
+
+    def record(
+        self,
+        kind: str,
+        height: int,
+        round_: int,
+        step: str = "",
+        **attrs: Any,
+    ) -> None:
+        """Append one event (no-op when disabled). Hot call sites
+        check `enabled` themselves first to skip argument building."""
+        if not self.enabled:
+            return
+        seq = self._next_seq
+        self._next_seq = seq + 1
+        self._ring.append(
+            TimelineEvent(
+                seq,
+                kind,
+                height,
+                round_,
+                step,
+                time.monotonic_ns(),
+                time.time_ns(),
+                attrs or None,
+            )
+        )
+
+    def _record_once(
+        self,
+        kind: str,
+        height: int,
+        round_: int,
+        **attrs: Any,
+    ) -> bool:
+        """Record a threshold crossing exactly once per (kind, height,
+        round) — detection sites (e.g. _after_prevote_added) re-fire on
+        every later vote. Returns True on the FIRST crossing whether or
+        not the ring is enabled, so metric anchors stay exact under the
+        kill switch."""
+        key = (kind, height, round_)
+        if key in self._once:
+            return False
+        self._once.add(key)
+        if self.enabled:
+            self.record(kind, height, round_, **attrs)
+        return True
+
+    # -- crossing marks (always called; they feed the metrics) ---------
+
+    def mark_new_height(self, height: int, round_: int = 0) -> None:
+        """Entering a height: clears the per-height dedup/anchor state
+        (bounded growth: both sets live one height)."""
+        self._once.clear()
+        self._anchors.clear()
+        self._anchor("new_height")
+        if self.enabled:
+            self.record(EV_NEW_HEIGHT, height, round_)
+
+    def mark_proposal(self, height: int, round_: int) -> None:
+        if self._record_once(EV_PROPOSAL, height, round_):
+            self._anchor("proposal", round_)
+
+    def mark_block(self, height: int, round_: int) -> None:
+        self._record_once(EV_BLOCK, height, round_)
+
+    def mark_prevote_any(self, height: int, round_: int) -> None:
+        self._record_once(EV_PREVOTE_ANY, height, round_)
+
+    def mark_polka(self, height: int, round_: int) -> None:
+        if self._record_once(EV_POLKA, height, round_):
+            lat = self._anchor_lat("proposal", round_)
+            self._anchor("polka", round_)
+            if lat is not None and self.metrics is not None:
+                self.metrics.quorum_prevote_latency.observe(lat)
+
+    def mark_precommit_quorum(self, height: int, round_: int) -> None:
+        if self._record_once(EV_PRECOMMIT_QUORUM, height, round_):
+            lat = self._anchor_lat("polka", round_)
+            self._anchor("precommit_quorum", round_)
+            if lat is not None and self.metrics is not None:
+                self.metrics.quorum_precommit_latency.observe(lat)
+
+    def mark_commit(
+        self, height: int, round_: int, num_txs: int, block_hash: str
+    ) -> None:
+        if self.metrics is not None:
+            # rounds needed to commit this height (1 = no burned round)
+            self.metrics.rounds_per_height.observe(round_ + 1)
+        if self.enabled:
+            self.record(
+                EV_COMMIT,
+                height,
+                round_,
+                num_txs=num_txs,
+                block=block_hash,
+            )
+
+    def mark_stall_reset(
+        self, kind: str, height: int, round_: int, peer: str
+    ) -> None:
+        """One gossip forget-and-resend tick fired (reactor.py).
+        `kind` is the reset site: catchup (>=2 behind, PR 9) | live
+        (same height, PR 13) | last_commit (one behind, PR 13). The
+        counter makes a wedge-save distinguishable from a quiet net
+        even with the ring disabled."""
+        if self.metrics is not None:
+            self.metrics.stall_resets.inc(kind=kind)
+        if self.enabled:
+            self.record(
+                EV_STALL_RESET,
+                height,
+                round_,
+                reset=kind,
+                peer=peer[:12],
+            )
+
+    def _anchor(self, name: str, round_: int = 0) -> None:
+        self._anchors[name] = (round_, time.monotonic_ns())
+
+    def _anchor_lat(self, name: str, round_: int) -> Optional[float]:
+        """Seconds since anchor `name`, only if it was set in the SAME
+        round (a proposal from round 0 must not time a round-3 polka)."""
+        got = self._anchors.get(name)
+        if got is None or got[0] != round_:
+            return None
+        return (time.monotonic_ns() - got[1]) / 1e9
+
+    # -- export --------------------------------------------------------
+
+    def snapshot(self) -> List[TimelineEvent]:
+        """The recorded events, oldest first."""
+        return list(self._ring)
+
+    def dropped_before(self) -> int:
+        """How many events were evicted by the ring bound (0 when the
+        whole history is still resident)."""
+        if not self._ring:
+            return self._next_seq - 1
+        return self._ring[0].seq - 1
+
+    def page(
+        self, after_seq: int, limit: int
+    ) -> Tuple[List[Dict[str, Any]], int, int]:
+        """Events with seq > after_seq, oldest first, at most `limit`
+        of them (callers clamp `limit` — rpc/core.py pins the server
+        cap). Returns (events, next_seq, dropped_before): pass
+        next_seq back as after_seq to resume the cursor."""
+        out: List[Dict[str, Any]] = []
+        next_seq = after_seq
+        for e in self._ring:
+            if e.seq <= after_seq:
+                continue
+            if len(out) >= limit:
+                break
+            out.append(e.to_dict())
+            next_seq = e.seq
+        return out, next_seq, self.dropped_before()
+
+    def to_json(self) -> str:
+        """The whole resident ring (debug bundle `timeline.json`)."""
+        return json.dumps(
+            {
+                "timeline": [e.to_dict() for e in self._ring],
+                "dropped_before": self.dropped_before(),
+                "enabled": self.enabled,
+            },
+            default=str,
+        )
+
+
+# ----------------------------------------------------------------------
+# WAL post-mortem reconstruction
+#
+# The WAL records every input the consensus loop processed (proposals,
+# block parts, votes, timeouts — write-before-process) plus the
+# EventDataRoundStateWAL step markers _new_step writes (reference:
+# state.go newStep -> wal.Write(rs)), each stamped with the wall clock
+# at write time. That is enough to rebuild the same event stream the
+# live recorder captured — for a node that is wedged or dead, with
+# zero live state.
+
+
+def events_from_wal(
+    path: str, validators: int = 0
+) -> List[Dict[str, Any]]:
+    """Reconstruct the timeline event stream from a WAL group.
+
+    `validators` sets the committee size for the vote-threshold
+    reconstruction; 0 infers it as max(validator_index)+1 over the
+    log. Thresholds are COUNT-based (> 2/3 of the committee, counted
+    per voted non-nil block — a mixed or all-nil vote set never fakes
+    a crossing), exact for equal-power validator sets (every
+    localnet/e2e net here) and an approximation otherwise — the
+    caveat every derived `polka` / `precommit_quorum` event carries
+    in its `derived` attr. Gossip
+    stall-resets are reactor-side state, not consensus inputs, so they
+    do not appear in a WAL reconstruction.
+    """
+    from ..types.canonical import PREVOTE_TYPE
+    from .msgs import (
+        BlockPartMessage,
+        EndHeightMessage,
+        EventDataRoundStateWAL,
+        MsgInfo,
+        ProposalMessage,
+        TimeoutInfo,
+        VoteMessage,
+    )
+    from .types import step_name
+    from .wal import iter_wal_group
+
+    records = list(iter_wal_group(path))
+    if validators <= 0:
+        top = -1
+        for _, msg in records:
+            if isinstance(msg, MsgInfo) and isinstance(
+                msg.msg, VoteMessage
+            ):
+                top = max(top, msg.msg.vote.validator_index)
+        validators = top + 1
+    quorum = (2 * validators) // 3 + 1 if validators > 0 else 0
+
+    events: List[Dict[str, Any]] = []
+    seq = 0
+
+    def emit(
+        t_ns: int, kind: str, height: int, round_: int, **attrs: Any
+    ) -> None:
+        nonlocal seq
+        seq += 1
+        d: Dict[str, Any] = {
+            "seq": seq,
+            "kind": kind,
+            "height": height,
+            "round": round_,
+            "t_wall_ns": t_ns,
+        }
+        d.update(attrs)
+        events.append(d)
+
+    # per-(height, round, type, block_id) distinct voters — keyed by
+    # the voted block so a mixed or all-nil vote set never fakes a
+    # crossing the live recorder would not have recorded (the live
+    # sites require +2/3 for ONE non-nil block); per-height part totals
+    voters: Dict[Tuple[int, int, int, bytes], set] = {}
+    seen_voters: Dict[Tuple[int, int, int], set] = {}
+    part_totals: Dict[Tuple[int, int], int] = {}
+    parts_seen: Dict[Tuple[int, int], set] = {}
+    crossed: set = set()
+    last_height = 0
+
+    for t_ns, msg in records:
+        if isinstance(msg, EventDataRoundStateWAL):
+            if msg.height != last_height:
+                emit(t_ns, EV_NEW_HEIGHT, msg.height, msg.round)
+                last_height = msg.height
+            emit(
+                t_ns, EV_STEP, msg.height, msg.round, step=msg.step
+            )
+            continue
+        if isinstance(msg, TimeoutInfo):
+            emit(
+                t_ns,
+                EV_TIMEOUT,
+                msg.height,
+                msg.round,
+                step=step_name(msg.step),
+                duration_s=msg.duration_s,
+            )
+            continue
+        if isinstance(msg, EndHeightMessage):
+            emit(t_ns, EV_COMMIT, msg.height, -1, derived="end_height")
+            continue
+        if not isinstance(msg, MsgInfo):
+            continue
+        inner = msg.msg
+        if isinstance(inner, ProposalMessage):
+            p = inner.proposal
+            key = (p.height, p.round)
+            part_totals[key] = p.block_id.part_set_header.total
+            emit(t_ns, EV_PROPOSAL, p.height, p.round)
+        elif isinstance(inner, BlockPartMessage):
+            key = (inner.height, inner.round)
+            seen = parts_seen.setdefault(key, set())
+            seen.add(inner.part.index)
+            total = part_totals.get(key)
+            if (
+                total is not None
+                and len(seen) >= total
+                and ("block",) + key not in crossed
+            ):
+                crossed.add(("block",) + key)
+                emit(t_ns, EV_BLOCK, inner.height, inner.round)
+        elif isinstance(inner, VoteMessage):
+            v = inner.vote
+            vkey = (v.height, v.round, v.type)
+            seen_all = seen_voters.setdefault(vkey, set())
+            if v.validator_index in seen_all:
+                continue  # gossip dup: must not re-fire the crossing
+            seen_all.add(v.validator_index)
+            if v.block_id.is_zero():
+                continue  # nil votes never form a polka/quorum
+            seen = voters.setdefault(
+                vkey + (v.block_id.key(),), set()
+            )
+            seen.add(v.validator_index)
+            if quorum and len(seen) == quorum:
+                kind = (
+                    EV_POLKA
+                    if v.type == PREVOTE_TYPE
+                    else EV_PRECOMMIT_QUORUM
+                )
+                emit(
+                    t_ns,
+                    kind,
+                    v.height,
+                    v.round,
+                    derived="count_threshold",
+                    voters=len(seen),
+                    committee=validators,
+                )
+    return events
+
+
+def summarize_heights(
+    events: List[Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Per-height post-mortem rows from a reconstructed (or exported)
+    event stream: when each phase first happened, rounds burned,
+    timeout count, and the wall-clock spans between phases — the
+    human-readable half of scripts/timeline_replay.py."""
+    by_height: Dict[int, List[Dict[str, Any]]] = {}
+    for e in events:
+        by_height.setdefault(e["height"], []).append(e)
+    rows: List[Dict[str, Any]] = []
+    for h in sorted(k for k in by_height if k > 0):
+        evs = by_height[h]
+        first: Dict[str, int] = {}
+        for e in evs:
+            t = e.get("t_wall_ns")
+            if t is None:
+                continue
+            k = e["kind"]
+            if k not in first:
+                first[k] = t
+        rounds = max((e["round"] for e in evs), default=0)
+        # the NewHeight timeout is the normal per-height pacing tick
+        # (timeout_commit); only the round-step timeouts are anomalies
+        timeouts = sum(
+            1
+            for e in evs
+            if e["kind"] == EV_TIMEOUT
+            and e.get("step") != "RoundStepNewHeight"
+        )
+        stalls = sum(1 for e in evs if e["kind"] == EV_STALL_RESET)
+
+        def span_ms(a: str, b: str) -> Optional[float]:
+            if a in first and b in first:
+                return round((first[b] - first[a]) / 1e6, 3)
+            return None
+
+        rows.append(
+            {
+                "height": h,
+                "rounds": max(rounds, 0),
+                "timeouts": timeouts,
+                "stall_resets": stalls,
+                "events": len(evs),
+                "proposal_to_polka_ms": span_ms(
+                    EV_PROPOSAL, EV_POLKA
+                ),
+                "polka_to_precommit_quorum_ms": span_ms(
+                    EV_POLKA, EV_PRECOMMIT_QUORUM
+                ),
+                "precommit_quorum_to_commit_ms": span_ms(
+                    EV_PRECOMMIT_QUORUM, EV_COMMIT
+                ),
+                "first_event_to_commit_ms": span_ms(
+                    next(
+                        (
+                            k
+                            for k in (
+                                EV_NEW_HEIGHT,
+                                EV_STEP,
+                                EV_PROPOSAL,
+                            )
+                            if k in first
+                        ),
+                        EV_COMMIT,
+                    ),
+                    EV_COMMIT,
+                ),
+            }
+        )
+    return rows

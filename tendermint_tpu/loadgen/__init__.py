@@ -25,6 +25,9 @@ Layout:
                  HTTP client pool, and the websocket subscriber pool
     scrape.py    mid-run registry snapshots from every node (mempool /
                  eventbus / inflight saturation)
+    timeline.py  fleet flight-recorder merger (ISSUE 15): per-height
+                 phase attribution + chaos TTFC recovery decomposition
+                 from the per-node consensus timelines
     report.py    merge the per-worker sketches into the BENCH_LOAD row
     run.py       orchestration: run_scenario / run_localnet_scenario
 """
@@ -41,6 +44,12 @@ from .report import build_report  # noqa: F401
 from .run import run_localnet_scenario, run_scenario  # noqa: F401
 from .scenario import OPS, Scenario  # noqa: F401
 from .scrape import Scraper  # noqa: F401
+from .timeline import (  # noqa: F401
+    attribute_heights,
+    collect,
+    decompose_recovery,
+    fleet_summary,
+)
 
 __all__ = [
     "OPS",
@@ -51,7 +60,11 @@ __all__ = [
     "Scenario",
     "Scraper",
     "SubscriberPool",
+    "attribute_heights",
     "build_report",
+    "collect",
+    "decompose_recovery",
+    "fleet_summary",
     "run_campaign",
     "run_chaos_scenario",
     "run_localnet_scenario",

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..crypto import faults
 from ..libs.rng import subseed as _subseed
+from . import timeline as fleet_timeline
 from .driver import ClientPool, run_open_loop
 from .localnet import Localnet, start_localnet
 from .scenario import Scenario
@@ -338,6 +339,7 @@ async def run_chaos_scenario(
         await asyncio.sleep(cs.baseline_s)
 
         await _arm_and_heal(cs, ln, seed)
+        heal_wall_ns = time.time_ns()
         heal_height = max(_heights(ln))
 
         ttfc = await _wait_heights_above(
@@ -348,6 +350,17 @@ async def run_chaos_scenario(
         stats, scheduled = await traffic
         traffic = None
         safety = _safety_check(ln)
+        # the flight-recorder artifact: the TTFC number above,
+        # decomposed into named recovery phases from the merged
+        # per-node timelines, plus the per-height attribution tail
+        # (loadgen/timeline.py; docs/observability.md)
+        fleet = fleet_timeline.collect(ln)
+        attribution = fleet_timeline.attribute_heights(fleet)
+        tl_artifact = fleet_timeline.decompose_recovery(
+            fleet, heal_wall_ns, heal_height
+        )
+        tl_artifact["heights_attributed"] = len(attribution)
+        tl_artifact["attribution_tail"] = attribution[-5:]
         row = {
             "name": cs.name,
             "kind": cs.kind,
@@ -367,6 +380,7 @@ async def run_chaos_scenario(
                 st.timeouts for st in stats.values()
             ),
             "scheduled_arrivals": scheduled,
+            "timeline": tl_artifact,
             "p2p_disconnects": _p2p_counters(
                 ln, "tendermint_tpu_p2p_peer_disconnects_total"
             ),

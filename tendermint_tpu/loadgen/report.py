@@ -30,6 +30,7 @@ def build_report(
     subscriber_events: int = 0,
     scraper: Optional[Scraper] = None,
     scheduled_arrivals: int = 0,
+    timeline: Optional[dict] = None,
 ) -> dict:
     routes = {op: st.to_dict() for op, st in sorted(route_stats.items())}
     total = sum(st.count for st in route_stats.values())
@@ -68,6 +69,10 @@ def build_report(
         },
         "saturation": sat,
     }
+    if timeline is not None:
+        # the fleet flight-recorder aggregate (loadgen/timeline.py):
+        # the consensus half of a slow-commit decomposition
+        report["consensus_timeline"] = timeline
     if scn.mode == "open":
         report["scheduled_arrivals"] = scheduled_arrivals
         report["offered_rate_per_s"] = scn.rate

@@ -92,6 +92,21 @@ async def run_scenario(
             await scrape_task
             scrape_task = None
         _, events = await subs.stop()
+        # consensus decomposition from the fleet's flight recorders
+        # (in-process nodes only): a slow broadcast_tx_commit p99 is
+        # either consensus-side — visible here as proposal->polka /
+        # polka->quorum / commit-spread stages — or serving-side,
+        # visible in the per-route sketches (docs/observability.md)
+        tl_summary = None
+        if nodes:
+            from . import timeline as fleet_timeline
+
+            try:
+                tl_summary = fleet_timeline.fleet_summary(
+                    fleet_timeline.collect(nodes)
+                )
+            except Exception:
+                tl_summary = None  # recorder disabled / foreign nodes
         return build_report(
             scn,
             stats,
@@ -102,6 +117,7 @@ async def run_scenario(
             subscriber_events=events,
             scraper=scraper,
             scheduled_arrivals=scheduled,
+            timeline=tl_summary,
         )
     finally:
         # unconditional teardown: a driver or scraper exception must

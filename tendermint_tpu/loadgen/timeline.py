@@ -1,0 +1,317 @@
+"""Fleet timeline merger — the cross-node layer of the flight recorder.
+
+Each node's consensus timeline (consensus/timeline.py) tells one
+node's story; this module scrapes ALL localnet nodes' rings, aligns
+events by (height, round) on the shared wall clock (one box — the
+in-process localnet's standing assumption), and answers the questions
+no single ring can:
+
+* **per-height phase attribution** — for every committed height: how
+  long from entering the height to the proposal landing (proposer
+  lag), how spread the +2/3 prevote / +2/3 precommit crossings were
+  across nodes (per-vote-type gossip lag), rounds burned, timeout and
+  stall-reset counts, and — when span tracing is on — the verify time
+  the height spent in addVote (libs/trace.py span data).
+* **recovery phase decomposition** — after a chaos heal instant, the
+  TTFC number splits into named phases: heal detection (first
+  stall-reset tick), gossip catch-up (first threshold crossing from
+  resent votes), first fresh proposal, quorum, commit. Every
+  scenario row in BENCH_CHAOS.json carries this artifact
+  (loadgen/chaos.py); the tmload report carries the steady-state
+  aggregate (loadgen/run.py) so a slow broadcast_tx_commit p99
+  decomposes into consensus pipeline stages.
+
+Zero RPC: the collectors read the in-process nodes' rings directly
+(the same trust model as chaos.py's store-level safety check). For
+process nets, the `consensus_timeline` RPC route serves the same
+events page by page.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..consensus.timeline import (
+    EV_COMMIT,
+    EV_NEW_HEIGHT,
+    EV_POLKA,
+    EV_PRECOMMIT_QUORUM,
+    EV_PREVOTE_ANY,
+    EV_PROPOSAL,
+    EV_STALL_RESET,
+    EV_TIMEOUT,
+)
+
+__all__ = [
+    "attribute_heights",
+    "collect",
+    "decompose_recovery",
+    "fleet_summary",
+    "stall_reset_counts",
+    "verify_ms_by_height",
+]
+
+# the threshold-crossing kinds resent gossip re-assembles first after
+# a heal — the "gossip catch-up" phase marker
+_CROSSINGS = (EV_PREVOTE_ANY, EV_POLKA, EV_PRECOMMIT_QUORUM)
+
+
+def collect(ln_or_nodes) -> Dict[str, List[dict]]:
+    """Scrape every localnet node's timeline ring: moniker -> event
+    dicts, oldest first. Accepts a Localnet or any sequence of Node
+    objects (a crash-restarted node contributes its fresh ring — the
+    pre-crash one died with the instance; its WAL still has the
+    history, see scripts/timeline_replay.py)."""
+    nodes = getattr(ln_or_nodes, "nodes", ln_or_nodes)
+    out: Dict[str, List[dict]] = {}
+    for i, node in enumerate(nodes):
+        base = getattr(getattr(node, "cfg", None), "base", None)
+        label = base.moniker if base is not None else f"node{i}"
+        out[label] = [
+            e.to_dict() for e in node.consensus.timeline.snapshot()
+        ]
+    return out
+
+
+def verify_ms_by_height() -> Dict[int, float]:
+    """Total addVote span time per height from the PROCESS-GLOBAL
+    trace ring (libs/trace.py) — the fleet's verify time per height
+    when span tracing is enabled, empty otherwise. Process-global:
+    in-process localnet nodes share the ring, so this is a fleet
+    total, not per-node."""
+    from ..libs import trace
+
+    out: Dict[int, float] = {}
+    for s in trace.snapshot():
+        if s.name == "addVote":
+            h = s.attrs.get("height")
+            if isinstance(h, int):
+                out[h] = out.get(h, 0.0) + s.dur_us / 1000.0
+    return out
+
+
+def _first(evs: List[dict], kind: str) -> Optional[int]:
+    ts = [e["t_wall_ns"] for e in evs if e["kind"] == kind]
+    return min(ts) if ts else None
+
+
+def _last(evs: List[dict], kind: str) -> Optional[int]:
+    ts = [e["t_wall_ns"] for e in evs if e["kind"] == kind]
+    return max(ts) if ts else None
+
+
+def _ms(a: Optional[int], b: Optional[int]) -> Optional[float]:
+    if a is None or b is None:
+        return None
+    return round((b - a) / 1e6, 3)
+
+
+def stall_reset_counts(
+    fleet: Dict[str, List[dict]], after_wall_ns: int = 0
+) -> Dict[str, int]:
+    """Fleet-wide stall-reset tick counts by reset kind (catchup /
+    live / last_commit), optionally only after a cut instant."""
+    out: Dict[str, int] = {}
+    for evs in fleet.values():
+        for e in evs:
+            if (
+                e["kind"] == EV_STALL_RESET
+                and e["t_wall_ns"] > after_wall_ns
+            ):
+                k = e.get("reset", "unknown")
+                out[k] = out.get(k, 0) + 1
+    return out
+
+
+def attribute_heights(
+    fleet: Dict[str, List[dict]],
+    verify_ms: Optional[Dict[int, float]] = None,
+) -> List[dict]:
+    """Per-height phase attribution across the fleet: one row per
+    height ANY node committed, from the merged event streams. Wall
+    clocks align because the fleet shares one box (module doc)."""
+    if verify_ms is None:
+        verify_ms = verify_ms_by_height()
+    by_height: Dict[int, List[dict]] = {}
+    committed: Dict[int, List[int]] = {}
+    for node, evs in fleet.items():
+        for e in evs:
+            h = e["height"]
+            by_height.setdefault(h, []).append(e)
+            if e["kind"] == EV_COMMIT:
+                committed.setdefault(h, []).append(e["t_wall_ns"])
+    rows: List[dict] = []
+    for h in sorted(committed):
+        evs = by_height[h]
+        first_enter = _first(evs, EV_NEW_HEIGHT)
+        first_proposal = _first(evs, EV_PROPOSAL)
+        commits = committed[h]
+        row = {
+            "height": h,
+            "nodes_committed": len(commits),
+            "rounds_burned": max(e["round"] for e in evs),
+            # entering the height -> the (first copy of the) proposal
+            # landing anywhere: block creation + first gossip hop
+            "proposer_lag_ms": _ms(first_enter, first_proposal),
+            # crossing spread across nodes = how long gossip took to
+            # carry each vote type's quorum fleet-wide
+            "prevote_gossip_lag_ms": _ms(
+                _first(evs, EV_POLKA), _last(evs, EV_POLKA)
+            ),
+            "precommit_gossip_lag_ms": _ms(
+                _first(evs, EV_PRECOMMIT_QUORUM),
+                _last(evs, EV_PRECOMMIT_QUORUM),
+            ),
+            "proposal_to_polka_ms": _ms(
+                first_proposal, _first(evs, EV_POLKA)
+            ),
+            "polka_to_quorum_ms": _ms(
+                _first(evs, EV_POLKA),
+                _first(evs, EV_PRECOMMIT_QUORUM),
+            ),
+            "commit_spread_ms": _ms(min(commits), max(commits)),
+            "timeouts": sum(
+                1
+                for e in evs
+                if e["kind"] == EV_TIMEOUT
+                and e.get("step") != "RoundStepNewHeight"
+            ),
+            "stall_resets": sum(
+                1 for e in evs if e["kind"] == EV_STALL_RESET
+            ),
+        }
+        if h in verify_ms:
+            row["verify_ms"] = round(verify_ms[h], 3)
+        rows.append(row)
+    return rows
+
+
+def decompose_recovery(
+    fleet: Dict[str, List[dict]],
+    heal_wall_ns: int,
+    heal_height: int,
+) -> dict:
+    """Split a chaos scenario's time-to-first-commit-after-heal into
+    named phases, all seconds since the heal instant:
+
+      heal_detection_s   first stall-reset tick after heal (the
+                         wedge-save firing; None = no reset needed)
+      gossip_catchup_s   first +2/3 threshold crossing anywhere (the
+                         resent votes re-assembling a quorum)
+      first_proposal_s   first proposal for FRESH work (height past
+                         the heal-instant network height)
+      quorum_s           first +2/3 precommit on that fresh work
+      commit_s           the SLOWEST node's first commit past the
+                         heal height — the timeline's own TTFC twin
+
+    Phases are fleet-wide minima (first anywhere) except commit_s
+    (slowest node — matching the chaos recovery verdict)."""
+
+    def since(t: Optional[int]) -> Optional[float]:
+        if t is None:
+            return None
+        return round((t - heal_wall_ns) / 1e9, 3)
+
+    after = [
+        e
+        for evs in fleet.values()
+        for e in evs
+        if e["t_wall_ns"] > heal_wall_ns
+    ]
+    t_detect = min(
+        (
+            e["t_wall_ns"]
+            for e in after
+            if e["kind"] == EV_STALL_RESET
+        ),
+        default=None,
+    )
+    t_catchup = min(
+        (
+            e["t_wall_ns"]
+            for e in after
+            if e["kind"] in _CROSSINGS
+        ),
+        default=None,
+    )
+    t_proposal = min(
+        (
+            e["t_wall_ns"]
+            for e in after
+            if e["kind"] == EV_PROPOSAL and e["height"] > heal_height
+        ),
+        default=None,
+    )
+    t_quorum = min(
+        (
+            e["t_wall_ns"]
+            for e in after
+            if e["kind"] == EV_PRECOMMIT_QUORUM
+            and e["height"] > heal_height
+        ),
+        default=None,
+    )
+    per_node_commit: List[int] = []
+    all_committed = True
+    for evs in fleet.values():
+        ts = [
+            e["t_wall_ns"]
+            for e in evs
+            if e["kind"] == EV_COMMIT
+            and e["height"] > heal_height
+            and e["t_wall_ns"] > heal_wall_ns
+        ]
+        if ts:
+            per_node_commit.append(min(ts))
+        else:
+            all_committed = False
+    t_commit = (
+        max(per_node_commit)
+        if per_node_commit and all_committed
+        else None
+    )
+    return {
+        "heal_height": heal_height,
+        "phases": {
+            "heal_detection_s": since(t_detect),
+            "gossip_catchup_s": since(t_catchup),
+            "first_proposal_s": since(t_proposal),
+            "quorum_s": since(t_quorum),
+            "commit_s": since(t_commit),
+        },
+        "stall_resets_after_heal": stall_reset_counts(
+            fleet, heal_wall_ns
+        ),
+        "stall_resets_total": stall_reset_counts(fleet),
+    }
+
+
+def fleet_summary(fleet: Dict[str, List[dict]]) -> dict:
+    """Steady-state aggregate of the per-height attribution — the
+    tmload report's consensus decomposition (a slow
+    broadcast_tx_commit p99 is either consensus-side, visible here,
+    or serving-side, visible in the route sketches)."""
+    rows = attribute_heights(fleet)
+
+    def agg(key: str) -> dict:
+        vals = [r[key] for r in rows if r.get(key) is not None]
+        if not vals:
+            return {"mean_ms": None, "max_ms": None}
+        return {
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "max_ms": round(max(vals), 3),
+        }
+
+    return {
+        "heights_attributed": len(rows),
+        "events_total": sum(len(v) for v in fleet.values()),
+        "rounds_burned_total": sum(r["rounds_burned"] for r in rows),
+        "timeouts_total": sum(r["timeouts"] for r in rows),
+        "stall_resets": stall_reset_counts(fleet),
+        "proposer_lag": agg("proposer_lag_ms"),
+        "proposal_to_polka": agg("proposal_to_polka_ms"),
+        "polka_to_quorum": agg("polka_to_quorum_ms"),
+        "prevote_gossip_lag": agg("prevote_gossip_lag_ms"),
+        "precommit_gossip_lag": agg("precommit_gossip_lag_ms"),
+        "commit_spread": agg("commit_spread_ms"),
+    }

@@ -432,6 +432,17 @@ class Node(Service):
             metrics=StateMetrics(self.metrics_registry),
         )
         wal = WAL(cfg.base.path(cfg.consensus.wal_file))
+        cs_metrics = ConsensusMetrics(self.metrics_registry)
+        # per-node flight recorder (consensus/timeline.py): the ring
+        # the consensus_timeline RPC route and debug bundle serve,
+        # feeding the quorum-latency/rounds/stall metrics above
+        from ..consensus.timeline import TimelineRecorder
+
+        timeline = TimelineRecorder(
+            capacity=cfg.instrumentation.consensus_timeline_capacity,
+            enabled=cfg.instrumentation.consensus_timeline,
+            metrics=cs_metrics,
+        )
         self.consensus = ConsensusState(
             cfg.consensus,
             state,
@@ -441,7 +452,8 @@ class Node(Service):
             event_bus=self.event_bus,
             wal=wal,
             evidence_pool=self.evidence_pool,
-            metrics=ConsensusMetrics(self.metrics_registry),
+            metrics=cs_metrics,
+            timeline=timeline,
         )
 
         # sync orchestration flags (reference: node/node.go:230

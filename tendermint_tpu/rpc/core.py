@@ -42,6 +42,7 @@ __all__ = [
     "Environment",
     "GENESIS_CHUNK_SIZE",
     "LIGHT_BLOCKS_PAGE_CAP",
+    "TIMELINE_PAGE_CAP",
     "TX_PROOFS_CAP",
 ]
 
@@ -57,6 +58,11 @@ LIGHT_BLOCKS_PAGE_CAP = 20
 # proof is ~32·log2(N) bytes, and the held tree serves K proofs in
 # K·log2(N) gathers, so 100 keeps the worst request under ~1 ms
 TX_PROOFS_CAP = 100
+
+# hard server-side page bound for the consensus_timeline route: one
+# event is a small flat dict (~120 bytes of JSON), so a full page
+# stays ~60 KB; clients resume via the seq cursor (after_seq)
+TIMELINE_PAGE_CAP = 512
 
 
 def encode(obj: Any) -> Any:
@@ -193,6 +199,7 @@ class Environment:
             "commit": self.commit,
             "validators": self.validators,
             "consensus_state": self.consensus_state,
+            "consensus_timeline": self.consensus_timeline,
             "dump_consensus_state": self.dump_consensus_state,
             "consensus_params": self.consensus_params,
             "unconfirmed_txs": self.unconfirmed_txs,
@@ -509,6 +516,39 @@ class Environment:
                     else ""
                 ),
             }
+        }
+
+    async def consensus_timeline(self, req: RPCRequest):
+        """Flight-recorder page: the node's consensus timeline ring
+        (consensus/timeline.py — step transitions, threshold
+        crossings, timeouts, stall-resets) as JSON events, oldest
+        first. Params: `after_seq` resumes the cursor (events with
+        seq > after_seq), `max_events` shrinks — never grows — the
+        hard TIMELINE_PAGE_CAP server page bound. `dropped_before` is
+        how many events the bounded ring has already evicted; a
+        scraper that fell behind sees the gap instead of silence
+        (framework route; the reference exposes only the instantaneous
+        /consensus_state)."""
+        if self.consensus is None:
+            raise RPCError(INTERNAL_ERROR, "consensus not available")
+        tl = self.consensus.timeline
+        after = int(req.params.get("after_seq", 0) or 0)
+        cap = TIMELINE_PAGE_CAP
+        max_events = int(req.params.get("max_events", 0) or 0)
+        if 0 < max_events < cap:
+            cap = max_events
+        with trace.span("consensus_timeline", after_seq=after):
+            events, next_seq, dropped = tl.page(after, cap)
+            trace.add_attrs(count=len(events))
+        return {
+            "node": (
+                self.cfg.base.moniker if self.cfg is not None else ""
+            ),
+            "enabled": tl.enabled,
+            "capacity": tl.capacity,
+            "events": events,
+            "next_seq": next_seq,
+            "dropped_before": dropped,
         }
 
     async def dump_consensus_state(self, req: RPCRequest):

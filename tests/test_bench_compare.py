@@ -1,0 +1,190 @@
+"""scripts/bench_compare.py — the BENCH_*.json trajectory differ
+(ISSUE 15 satellite): seeded regressed / improved / missing-row
+fixtures through the comparison engine and the CLI exit contract.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_compare",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "bench_compare.py",
+    ),
+)
+bench_compare = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_compare)
+
+
+BANKED = {
+    "schema": "bench/v1",  # metadata: never compared
+    "recorded_unix": 1_000.0,
+    "verify_commit_10k_per_s": 1000.0,
+    "warm_verify_ms": 0.32,
+    "nested": {"routes_p99_ms": {"status": 12.0}, "held": 16},
+    "num_cpu_devices": 8,  # direction unknown: info only
+    "all_passed": True,  # bools are not trajectory rows
+}
+
+
+def fresh(**overrides):
+    doc = {
+        "verify_commit_10k_per_s": 1000.0,
+        "warm_verify_ms": 0.32,
+        "nested": {"routes_p99_ms": {"status": 12.0}, "held": 16},
+        "num_cpu_devices": 8,
+    }
+    doc.update(overrides)
+    return doc
+
+
+class TestEngine:
+    def test_identical_documents_pass(self):
+        report, failures = bench_compare.compare(fresh(), BANKED)
+        assert failures == []
+        assert {r[5] for r in report} <= {"ok", "info"}
+
+    def test_throughput_regression_fails(self):
+        report, failures = bench_compare.compare(
+            fresh(verify_commit_10k_per_s=800.0), BANKED
+        )
+        assert [f[0] for f in failures] == ["verify_commit_10k_per_s"]
+        (key, old, new, delta, d, status) = failures[0]
+        assert status == "regressed" and d == 1
+        assert delta == pytest.approx(-0.2)
+
+    def test_latency_regression_fails_nested_too(self):
+        _, failures = bench_compare.compare(
+            fresh(nested={"routes_p99_ms": {"status": 30.0}, "held": 16}),
+            BANKED,
+        )
+        assert [f[0] for f in failures] == [
+            "nested.routes_p99_ms.status"
+        ]
+
+    def test_improvement_passes_and_is_labeled(self):
+        report, failures = bench_compare.compare(
+            fresh(verify_commit_10k_per_s=2000.0, warm_verify_ms=0.1),
+            BANKED,
+        )
+        assert failures == []
+        improved = {r[0] for r in report if r[5] == "improved"}
+        assert improved == {
+            "verify_commit_10k_per_s",
+            "warm_verify_ms",
+        }
+
+    def test_missing_row_fails(self):
+        doc = fresh()
+        del doc["warm_verify_ms"]
+        _, failures = bench_compare.compare(doc, BANKED)
+        assert [(f[0], f[5]) for f in failures] == [
+            ("warm_verify_ms", "missing")
+        ]
+
+    def test_unknown_direction_never_fails(self):
+        _, failures = bench_compare.compare(
+            fresh(num_cpu_devices=1), BANKED
+        )
+        assert failures == []
+
+    def test_null_value_is_info_not_missing(self):
+        """A null leaf (a measurement that legitimately had no value
+        that run — a chaos artifact's heal_detection_s when no
+        stall-reset was needed) must not fail as a vanished row, in
+        EITHER direction."""
+        banked = dict(BANKED, heal_detection_s=1.2)
+        report, failures = bench_compare.compare(
+            fresh(heal_detection_s=None), banked
+        )
+        assert failures == []
+        (row,) = [r for r in report if r[0] == "heal_detection_s"]
+        assert row[5] == "info" and row[2] is None
+        # null on the banked side, numeric fresh: also info
+        banked = dict(BANKED, heal_detection_s=None)
+        _, failures = bench_compare.compare(
+            fresh(heal_detection_s=3.4), banked
+        )
+        assert failures == []
+
+    def test_threshold_is_respected(self):
+        doc = fresh(verify_commit_10k_per_s=920.0)  # -8%
+        _, at10 = bench_compare.compare(doc, BANKED, threshold=0.10)
+        _, at5 = bench_compare.compare(doc, BANKED, threshold=0.05)
+        assert at10 == [] and len(at5) == 1
+
+    def test_rows_filter(self):
+        doc = fresh(verify_commit_10k_per_s=100.0, warm_verify_ms=99.0)
+        _, failures = bench_compare.compare(
+            doc, BANKED, rows="warm_*"
+        )
+        assert [f[0] for f in failures] == ["warm_verify_ms"]
+
+    def test_direction_table(self):
+        d = bench_compare.direction_of
+        assert d("verify_per_s") == 1
+        assert d("light_sync_warm_headers_per_s_150vals") == 1
+        assert d("nested.routes_p99_ms.status") == -1
+        assert d("tmlive_gate.wall_s") == -1
+        assert d("subscribers_held") == 1
+        assert d("num_cpu_devices") is None
+
+
+class TestCLI:
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_exit_zero_when_clean(self, tmp_path, capsys):
+        f = self._write(tmp_path, "fresh.json", fresh())
+        b = self._write(tmp_path, "banked.json", BANKED)
+        assert bench_compare.main([f, b]) == 0
+        assert "within 10%" in capsys.readouterr().out
+
+    def test_exit_one_on_regression_and_json_report(
+        self, tmp_path, capsys
+    ):
+        f = self._write(
+            tmp_path, "fresh.json", fresh(warm_verify_ms=1.0)
+        )
+        b = self._write(tmp_path, "banked.json", BANKED)
+        assert bench_compare.main([f, b, "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["regressions"] == 1
+        row = next(
+            r for r in doc["rows"] if r["key"] == "warm_verify_ms"
+        )
+        assert row["status"] == "regressed"
+
+    def test_exit_two_on_unreadable_input(self, tmp_path):
+        b = self._write(tmp_path, "banked.json", BANKED)
+        assert (
+            bench_compare.main(
+                [str(tmp_path / "missing.json"), b]
+            )
+            == 2
+        )
+
+    def test_self_compare_banked_artifacts(self, capsys):
+        """Every banked BENCH_* file in the repo self-compares clean
+        (the differ must accept the real artifact shapes)."""
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        compared = 0
+        for name in sorted(os.listdir(root)):
+            if not (
+                name.startswith("BENCH_") and name.endswith(".json")
+            ):
+                continue
+            path = os.path.join(root, name)
+            assert bench_compare.main([path, path]) == 0, name
+            compared += 1
+        assert compared >= 3  # the repo banks several trajectories

@@ -242,6 +242,13 @@ def test_catchup_votes_dropped_during_wait_sync_are_resent():
     async def go():
         net, nodes = make_cluster(4)
         laggard = nodes[3]
+        # stall-reset observability (ISSUE 15): the wedge-save must be
+        # VISIBLE — counter + flight-recorder event, not just the
+        # silent mark reset. Test-harness nodes share DEFAULT_REGISTRY,
+        # so one instrument counts the whole cluster; delta vs the
+        # entry value isolates this test from earlier ones.
+        stall_ctr = nodes[0].cs.metrics.stall_resets
+        catchup_base = stall_ctr.value(kind="catchup")
         for node in nodes[:3]:
             await node.start()
         await net.start()
@@ -273,6 +280,17 @@ def test_catchup_votes_dropped_during_wait_sync_are_resent():
                 laggard.block_store.load_block(height).hash()
                 == nodes[0].block_store.load_block(height).hash()
             )
+        # the recovery ran THROUGH the catchup stall-reset: the tick
+        # that saved the wedge is now observable (counter + a
+        # stall_reset event in some peer's flight recorder)
+        assert stall_ctr.value(kind="catchup") > catchup_base
+        assert any(
+            e.kind == "stall_reset"
+            and e.attrs
+            and e.attrs.get("reset") == "catchup"
+            for n in nodes[:3]
+            for e in n.cs.timeline.snapshot()
+        )
 
     run(go())
 
@@ -292,6 +310,11 @@ def test_live_votes_dropped_by_partition_are_resent():
 
     async def go():
         net, nodes = make_cluster(4)
+        # same shared-registry delta pattern as the catchup test above
+        stall_ctr = nodes[0].cs.metrics.stall_resets
+        live_base = stall_ctr.value(kind="live") + stall_ctr.value(
+            kind="last_commit"
+        )
         await start_cluster(net, nodes)
         try:
             await asyncio.gather(
@@ -321,6 +344,18 @@ def test_live_votes_dropped_by_partition_are_resent():
                 nodes[1].block_store.load_block(height).hash()
                 == nodes[0].block_store.load_block(height).hash()
             )
+        # the un-wedge ran through a live-height (or last-commit,
+        # when the partition straddled a commit boundary) stall-reset
+        # — visible as a counter bump + flight-recorder events
+        live_after = stall_ctr.value(kind="live") + stall_ctr.value(
+            kind="last_commit"
+        )
+        assert live_after > live_base
+        assert any(
+            e.kind == "stall_reset"
+            for n in nodes
+            for e in n.cs.timeline.snapshot()
+        )
 
     run(go())
 

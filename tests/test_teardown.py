@@ -66,6 +66,17 @@ def test_node_stop_with_live_ws_subscriber_leaves_no_pending_tasks():
                     await ws.close()
                 except Exception:
                     pass  # server side is already gone
+                # flight-recorder drain contract (ISSUE 15): the ring
+                # captured the run (bounded), survives the stop for
+                # post-mortem reads, records nothing further once
+                # disabled, and reset() drains it clean
+                tl = net.nodes[0].consensus.timeline
+                assert 0 < len(tl) <= tl.capacity
+                tl.disable()
+                tl.record("step", 999, 0, step="post-stop")
+                assert tl.snapshot()[-1].height != 999
+                tl.reset()
+                assert len(tl) == 0 and tl.snapshot() == []
             # give cancelled tasks their completion ticks
             for _ in range(10):
                 await asyncio.sleep(0)
