@@ -575,6 +575,164 @@ def bench_timeline_overhead(reps: int = 200_000, heights: int = 100):
     }
 
 
+def bench_profiler_overhead(reps: int = 200_000, window_s: float = 0.5):
+    """What the profiling plane costs (libs/profiler.py): the DISABLED
+    kill-switch path as every task-spawn site pays it (one
+    module-attribute read, no label write — the counting-stub teardown
+    test pins that zero samples land), the armed label write, a
+    CPU-bound A/B window with the sampler running at the default 97 Hz
+    (the in-process %-overhead the ≤5% served-throughput acceptance
+    bar generalizes), and a flood of distinct stacks against a tiny
+    stack cap proving the folded-stack aggregation bound holds under
+    collapse (ISSUE 16 acceptance row)."""
+    import asyncio
+    import threading
+
+    from tendermint_tpu.libs import profiler
+
+    profiler.disable()
+    profiler.disarm_labels()
+    profiler.reset()
+
+    class _FakeTask:
+        def get_loop(self):
+            raise RuntimeError("bench task has no loop")
+
+    task = _FakeTask()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pass
+    base = time.perf_counter() - t0
+    # the kill-switch path every Service.spawn / ensure_future site
+    # pays unconditionally
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        profiler.label_task(task, "bench:noop")
+    disabled_ns = (time.perf_counter() - t0 - base) / reps * 1e9
+    assert profiler.stats()["samples_total"] == 0  # kill-switch held
+
+    profiler.arm_labels()
+    loop = asyncio.new_event_loop()
+    profiler.register_loop(loop, threading.get_ident())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        profiler.label_task(task, "bench:noop")
+    armed_ns = (time.perf_counter() - t0 - base) / reps * 1e9
+    profiler.disarm_labels()
+    loop.close()
+
+    # CPU-bound A/B: same busy work with the sampler off, then on at
+    # the default hz (includes the lowered sys.setswitchinterval the
+    # sampler installs against GIL convoy bias — that IS its cost)
+    def busy(deadline: float) -> int:
+        n = 0
+        acc = 0
+        while time.perf_counter() < deadline:
+            for i in range(2_000):
+                acc = (acc * 1099511628211 + i) & 0xFFFFFFFFFFFFFFFF
+            n += 1
+        return n
+
+    # interleaved pairs + median: single-window A/B noise on this
+    # workload is the same magnitude as the effect (~±5%)
+    deltas = []
+    samples_total = 0
+    for _ in range(3):
+        off_iters = busy(time.perf_counter() + window_s)
+        profiler.reset()
+        profiler.enable()
+        on_iters = busy(time.perf_counter() + window_s)
+        samples_total += profiler.stats()["samples_total"]
+        profiler.disable()
+        if off_iters:
+            deltas.append((off_iters - on_iters) / off_iters * 100.0)
+    overhead_pct = sorted(deltas)[len(deltas) // 2] if deltas else 0.0
+
+    # boundedness: recursion at varying depths makes distinct folded
+    # stacks; against an 8-slot cap the aggregation must collapse, not
+    # grow (the tmlive bounded= contract on the sample dict)
+    def spin_at(depth: int, until: float) -> None:
+        if depth > 0:
+            spin_at(depth - 1, until)
+            return
+        while time.perf_counter() < until:
+            sum(range(200))
+
+    profiler.reset()
+    profiler.enable(hz=500, max_stacks=8)
+    t_end = time.perf_counter() + 0.3
+    d = 0
+    while time.perf_counter() < t_end:
+        spin_at(d % 24, min(t_end, time.perf_counter() + 0.01))
+        d += 1
+    flood = profiler.stats()
+    profiler.disable()
+    profiler.reset()
+    # restore the module defaults the flood run overrode (hz=500,
+    # max_stacks=8 would otherwise leak into the next enable())
+    profiler.enable(
+        hz=profiler.DEFAULT_HZ, max_stacks=profiler.DEFAULT_MAX_STACKS
+    )
+    profiler.disable()
+    profiler.reset()
+    return {
+        "disabled_label_ns": round(disabled_ns, 2),
+        "armed_label_ns": round(armed_ns, 1),
+        "sampling_overhead_pct_97hz": round(overhead_pct, 2),
+        "samples_in_window": samples_total,
+        "flood_stacks": flood["stacks"],
+        "flood_stack_cap": 8,
+        "flood_collapsed_samples": flood["collapsed_samples"],
+        "bounded": flood["stacks"] <= 8 + 8,  # cap + collapse keys
+    }
+
+
+def bench_fanout_publish(subs: int = 256, publishes: int = 2_000):
+    """The PR-16 profile-driven fix's component row: one
+    pubsub.Server.publish fan-out to `subs` held subscriptions, in the
+    load shape (every subscriber on the SAME query — one group, one
+    match, one shared Message) and the adversarial shape (every
+    subscriber on a distinct query — no grouping win, the pre-fix
+    cost shape). Before the grouped fan-out the load shape paid a
+    per-subscriber Message allocation plus a per-subscriber query
+    re-evaluation: ~2x this row's same_query number."""
+    import asyncio
+
+    from tendermint_tpu.pubsub import Server
+
+    events = {"tm.event": ["NewBlock"], "tx.height": ["5"]}
+
+    async def run_shape(queries):
+        srv = Server()
+        for i, q in enumerate(queries):
+            srv.subscribe(f"bench{i}", q, limit=publishes + 8)
+        t0 = time.perf_counter()
+        for _ in range(publishes):
+            matched, _depth, dropped = srv.publish({"h": 1}, events)
+            assert matched == subs and dropped == 0
+        us = (time.perf_counter() - t0) / publishes * 1e6
+        await srv.on_stop()
+        return us
+
+    async def run():
+        same = await run_shape(["tm.event = 'NewBlock'"] * subs)
+        distinct = await run_shape(
+            [
+                f"tm.event = 'NewBlock' AND tx.height < {1_000 + i}"
+                for i in range(subs)
+            ]
+        )
+        return same, distinct
+
+    same_us, distinct_us = asyncio.run(run())
+    return {
+        "subs": subs,
+        "deliveries_per_publish": subs,
+        "same_query_us": round(same_us, 1),
+        "distinct_query_us": round(distinct_us, 1),
+    }
+
+
 def bench_tmlive_gate():
     """Full tmlive liveness/boundedness gate (scripts/lint.py --live):
     wall time plus per-rule finding and suppression counts, recorded
@@ -1324,6 +1482,7 @@ def bench_load_smoke(
     seed: int = 2026,
     warmup_s: float = 1.0,
     mode: str = "open",
+    profile: bool = False,
 ):
     """ISSUE 12: the production-load row — a seeded open-loop mixed
     workload (broadcast_tx flood + RPC reads + held websocket
@@ -1353,7 +1512,7 @@ def bench_load_smoke(
     )
     with tempfile.TemporaryDirectory(prefix="tt-bench-load-") as home:
         report = asyncio.run(
-            run_localnet_scenario(scn, n_nodes, home)
+            run_localnet_scenario(scn, n_nodes, home, profile=profile)
         )
     # the banked line carries the headline numbers; the full report
     # (scenario recipe included) goes to BENCH_LOAD.json via
@@ -2127,10 +2286,71 @@ def main() -> None:
     )
 
     def _load_smoke_row():
-        row, report = bench_load_smoke()
+        # interleaved A/B (ISSUE 16): the same seeded scenario with the
+        # sampler off, then on at the default 97 Hz. The banked report
+        # is the PROFILED run — it carries the bottleneck ledger — and
+        # the A/B delta is the served-throughput cost of carrying it
+        # (acceptance bar: ≤5%).
+        base_row, _base_report = bench_load_smoke()
+        row, report = bench_load_smoke(profile=True)
+        base_rps = base_row["requests_per_s"]
+        prof_rps = row["requests_per_s"]
+        ab = {
+            "baseline_requests_per_s": base_rps,
+            "profiled_requests_per_s": prof_rps,
+            "served_delta_pct": (
+                round((base_rps - prof_rps) / base_rps * 100.0, 2)
+                if base_rps
+                else None
+            ),
+            "baseline_sustained_txs_per_s": base_row[
+                "sustained_txs_per_s"
+            ],
+            "profiled_sustained_txs_per_s": row["sustained_txs_per_s"],
+        }
+        report["profiler_ab"] = ab
+        row["profiler_ab"] = ab
+
+        # subscriber-scale variant (ISSUE 16 satellite): same workload
+        # at subscribers=256 — the fan-out regime the grouped publish
+        # fix targets. Banked as a variant row next to the main one.
+        subs_row, subs_report = bench_load_smoke(
+            duration_s=6.0, rate=150.0, subscribers=256, profile=True
+        )
+        subs = subs_report["subscribers"]
+        sat = subs_report["saturation"]
+        subs_summary = {
+            "subscribers_requested": subs["requested"],
+            "subscribers_connected": subs["connected"],
+            "subscribers_held": subs["held"],
+            "subscribers_shed": subs["connected"] - subs["held"],
+            "events_received": subs["events_received"],
+            "eventbus_fanout_lag_max": sat.get(
+                "eventbus_fanout_lag_max"
+            ),
+            "eventbus_deliveries_total_delta": sat.get(
+                "eventbus_deliveries_total_delta"
+            ),
+            "requests_per_s": subs_row["requests_per_s"],
+            "sustained_txs_per_s": subs_row["sustained_txs_per_s"],
+        }
+        report["variants"] = {"subs256": subs_report}
+        row["subs256"] = subs_summary
         _persist_load(report)
         return row
 
+    cpu_stage(
+        "profiler_overhead",
+        bench_profiler_overhead,
+        "profiler_overhead",
+        120.0,
+    )
+    cpu_stage(
+        "fanout_publish",
+        bench_fanout_publish,
+        "fanout_publish",
+        120.0,
+    )
     cpu_stage(
         "load_smoke",
         _load_smoke_row,

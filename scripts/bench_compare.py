@@ -23,6 +23,17 @@ fresh one fail the gate — a silently vanished measurement is how
 trajectories rot. A row whose VALUE is null on either side (a
 measurement that legitimately had no value that run, e.g. a recovery
 phase that never happened) is reported as info and never fails.
+
+`--ledger` switches to the bottleneck-ledger diff (ISSUE 16): instead
+of numeric rows it compares the two documents' `bottleneck_ledger`
+blocks — per-subsystem wall-sample share deltas in percentage points,
+buckets that newly entered or vanished from the ranked table, and the
+headline attribution/idle/serving-vs-consensus shifts. A throughput
+PR's claim ("moved time out of eventbus") is auditable as a share
+delta here. Ledger mode is informational (exit 0) — attribution
+SHIFTS are the point of a perf PR, not a regression; it exits 2 only
+when a side has no ledger. `--variant NAME` descends into
+`variants.NAME` first (the subs256 row banks its own ledger).
 """
 
 from __future__ import annotations
@@ -33,7 +44,14 @@ import json
 import sys
 from typing import Dict, Optional, Tuple
 
-__all__ = ["compare", "flatten", "direction_of", "main"]
+__all__ = [
+    "compare",
+    "compare_ledgers",
+    "direction_of",
+    "flatten",
+    "ledger_of",
+    "main",
+]
 
 # metadata keys that are never measurements (any nesting level)
 _SKIP_KEYS = {
@@ -172,6 +190,81 @@ def compare(
     return report, failures
 
 
+def ledger_of(doc: dict, variant: str = "") -> Optional[dict]:
+    """Find the bottleneck-ledger block in a BENCH_LOAD-shaped
+    document: `variants.NAME` first when asked, then the document's
+    `bottleneck_ledger`, then the document itself if it already IS a
+    ledger (a fixture or an extracted block)."""
+    if variant:
+        doc = (doc.get("variants") or {}).get(variant) or {}
+    led = doc.get("bottleneck_ledger")
+    if led is None and "entries" in doc and "samples_total" in doc:
+        led = doc
+    return led
+
+
+def compare_ledgers(fresh: dict, banked: dict) -> dict:
+    """Diff two bottleneck ledgers: per-subsystem share deltas in
+    percentage points (ranked by magnitude), new-entrant / vanished
+    buckets, and the headline attribution + split shifts."""
+
+    def _pp(new, old):
+        if new is None and old is None:
+            return None
+        return round(((new or 0.0) - (old or 0.0)) * 100, 2)
+
+    f_ent = {e["subsystem"]: e for e in fresh.get("entries", [])}
+    b_ent = {e["subsystem"]: e for e in banked.get("entries", [])}
+    rows = []
+    for name in sorted(set(f_ent) | set(b_ent)):
+        f, b = f_ent.get(name), b_ent.get(name)
+        rows.append(
+            {
+                "subsystem": name,
+                "banked_share": b["share"] if b else None,
+                "fresh_share": f["share"] if f else None,
+                "delta_pp": _pp(
+                    f["share"] if f else None,
+                    b["share"] if b else None,
+                ),
+                "status": (
+                    "shared" if f and b else ("new" if f else "vanished")
+                ),
+            }
+        )
+    rows.sort(key=lambda r: (-abs(r["delta_pp"] or 0.0), r["subsystem"]))
+
+    headline = {}
+    for key in ("attributed_share", "unattributed_share", "idle_share"):
+        headline[key] = {
+            "banked": banked.get(key),
+            "fresh": fresh.get(key),
+            "delta_pp": _pp(fresh.get(key), banked.get(key)),
+        }
+    f_split = fresh.get("consensus_vs_serving") or {}
+    b_split = banked.get("consensus_vs_serving") or {}
+    for key in ("serving_share", "consensus_share"):
+        headline[key] = {
+            "banked": b_split.get(key),
+            "fresh": f_split.get(key),
+            "delta_pp": _pp(f_split.get(key), b_split.get(key)),
+        }
+    return {
+        "samples": {
+            "banked": banked.get("samples_total"),
+            "fresh": fresh.get("samples_total"),
+        },
+        "headline": headline,
+        "subsystems": rows,
+        "new_entrants": [
+            r["subsystem"] for r in rows if r["status"] == "new"
+        ],
+        "vanished": [
+            r["subsystem"] for r in rows if r["status"] == "vanished"
+        ],
+    }
+
+
 def _fmt_val(v) -> str:
     if v is None:
         return "-"
@@ -206,6 +299,17 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--json", action="store_true", help="emit the report as JSON"
     )
+    ap.add_argument(
+        "--ledger",
+        action="store_true",
+        help="diff the documents' bottleneck_ledger blocks instead of "
+        "numeric rows (informational, exit 0)",
+    )
+    ap.add_argument(
+        "--variant",
+        default="",
+        help="with --ledger: diff variants.NAME's ledger (e.g. subs256)",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -216,6 +320,43 @@ def main(argv=None) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.ledger:
+        f_led = ledger_of(fresh, args.variant)
+        b_led = ledger_of(banked, args.variant)
+        if f_led is None or b_led is None:
+            side = "fresh" if f_led is None else "banked"
+            print(
+                f"error: no bottleneck_ledger in the {side} document"
+                + (f" (variant {args.variant!r})" if args.variant else ""),
+                file=sys.stderr,
+            )
+            return 2
+        diff = compare_ledgers(f_led, b_led)
+        if args.json:
+            print(json.dumps(diff, indent=1))
+            return 0
+        s = diff["samples"]
+        print(
+            f"ledger: {_fmt_val(s['banked'])} banked samples -> "
+            f"{_fmt_val(s['fresh'])} fresh"
+        )
+        for key, h in diff["headline"].items():
+            print(
+                f"{key:>20}: {_fmt_val(h['banked'])} -> "
+                f"{_fmt_val(h['fresh'])}  "
+                f"({h['delta_pp']:+.1f}pp)"
+                if h["delta_pp"] is not None
+                else f"{key:>20}: -"
+            )
+        for r in diff["subsystems"]:
+            pp = r["delta_pp"]
+            print(
+                f"{r['status']:>9}  {r['subsystem']}: "
+                f"{_fmt_val(r['banked_share'])} -> "
+                f"{_fmt_val(r['fresh_share'])}"
+                + (f"  ({pp:+.1f}pp)" if pp is not None else "")
+            )
+        return 0
     report, failures = compare(
         fresh, banked, threshold=args.threshold, rows=args.rows
     )

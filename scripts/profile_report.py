@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Render a profiler table (libs/profiler.py) into human-readable form.
+
+Input is either a `profile.json` (debug bundle / `profile` RPC route /
+tmload report's `profile` block) or raw collapsed-stack lines
+(`role;frame;frame... count`, the flamegraph.pl format emitted by
+`profiler.folded()`).
+
+    python scripts/profile_report.py profile.json
+    python scripts/profile_report.py --folded stacks.txt
+    python scripts/profile_report.py profile.json --top 15 --min-pct 2
+
+Outputs, in order:
+  1. the subsystem share table (the bottleneck ledger's raw ranking)
+  2. top-N **self** frames (innermost frame of each sample — who is ON
+     the CPU / holding the wall)
+  3. top-N **cumulative** frames (anywhere in the stack — who is
+     responsible transitively)
+  4. a collapsed flamegraph as an indented text tree (children sorted
+     by weight, pruned below --min-pct of total samples)
+
+Exit codes: 0 rendered, 2 unreadable/empty input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+
+def parse_folded_line(line: str) -> Tuple[List[str], int]:
+    """One `a;b;c N` line -> (frames, count). Raises ValueError."""
+    body, _, count = line.rstrip().rpartition(" ")
+    if not body:
+        raise ValueError(f"not a folded line: {line!r}")
+    return body.split(";"), int(count)
+
+
+def load_stacks(path: str, folded: bool) -> Tuple[List[dict], Dict[str, float]]:
+    """-> (entries [{stack: [frames], count}], subsystem_shares)."""
+    with open(path) as f:
+        raw = f.read()
+    entries: List[dict] = []
+    shares: Dict[str, float] = {}
+    if folded:
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            frames, count = parse_folded_line(line)
+            entries.append({"stack": frames, "count": count})
+    else:
+        doc = json.loads(raw)
+        if "profile" in doc and isinstance(doc["profile"], dict):
+            doc = doc["profile"]  # tmload report nesting
+        shares = doc.get("subsystem_shares", {}) or {}
+        for e in doc.get("stacks", []):
+            frames = e["stack"].split(";") if e.get("stack") else []
+            head = [e["role"]] if e.get("role") else []
+            if e.get("task"):
+                head.append(e["task"])
+            entries.append(
+                {"stack": head + frames, "count": int(e["count"])}
+            )
+    return entries, shares
+
+
+def self_cumulative(
+    entries: List[dict],
+) -> Tuple[Dict[str, int], Dict[str, int]]:
+    self_c: Dict[str, int] = {}
+    cum_c: Dict[str, int] = {}
+    for e in entries:
+        stack, count = e["stack"], e["count"]
+        if not stack:
+            continue
+        leaf = stack[-1]
+        self_c[leaf] = self_c.get(leaf, 0) + count
+        for frame in set(stack):
+            cum_c[frame] = cum_c.get(frame, 0) + count
+    return self_c, cum_c
+
+
+def print_table(
+    title: str, counts: Dict[str, int], total: int, top: int
+) -> None:
+    print(f"\n== {title} ==")
+    print(f"{'samples':>9}  {'share':>6}  frame")
+    for frame, n in sorted(counts.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{n:>9}  {100.0 * n / total:>5.1f}%  {frame}")
+
+
+class _Node:
+    __slots__ = ("count", "children")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.children: Dict[str, "_Node"] = {}
+
+
+def build_tree(entries: List[dict]) -> _Node:
+    root = _Node()
+    for e in entries:
+        root.count += e["count"]
+        node = root
+        for frame in e["stack"]:
+            node = node.children.setdefault(frame, _Node())
+            node.count += e["count"]
+    return root
+
+
+def print_tree(
+    node: _Node, total: int, min_count: int, depth: int = 0
+) -> None:
+    for frame, child in sorted(
+        node.children.items(), key=lambda kv: -kv[1].count
+    ):
+        if child.count < min_count:
+            continue
+        pct = 100.0 * child.count / total
+        print(f"{'  ' * depth}{pct:5.1f}% {child.count:>7}  {frame}")
+        print_tree(child, total, min_count, depth + 1)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="profile_report.py",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("path", help="profile.json (or folded text with --folded)")
+    ap.add_argument(
+        "--folded",
+        action="store_true",
+        help="input is raw collapsed-stack lines, not profile.json",
+    )
+    ap.add_argument(
+        "--top", type=int, default=25, help="rows in the self/cumulative tables"
+    )
+    ap.add_argument(
+        "--min-pct",
+        type=float,
+        default=1.0,
+        help="prune flame-tree nodes below this %% of total samples",
+    )
+    ap.add_argument(
+        "--no-tree", action="store_true", help="skip the flame tree"
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        entries, shares = load_stacks(args.path, args.folded)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 2
+    total = sum(e["count"] for e in entries)
+    if total == 0:
+        print(
+            f"error: no samples in {args.path} (profiler never enabled?)",
+            file=sys.stderr,
+        )
+        return 2
+
+    print(f"{total} samples, {len(entries)} unique stacks")
+    if shares:
+        print("\n== subsystem shares ==")
+        for name, share in sorted(shares.items(), key=lambda kv: -kv[1]):
+            print(f"{100.0 * share:>5.1f}%  {name}")
+
+    self_c, cum_c = self_cumulative(entries)
+    print_table(f"top {args.top} self", self_c, total, args.top)
+    print_table(f"top {args.top} cumulative", cum_c, total, args.top)
+
+    if not args.no_tree:
+        min_count = max(1, int(total * args.min_pct / 100.0))
+        print(f"\n== flame tree (>= {args.min_pct}% of samples) ==")
+        print_tree(build_tree(entries), total, min_count)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

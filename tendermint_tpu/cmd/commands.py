@@ -928,6 +928,51 @@ def cmd_debug_dump(args) -> int:
                     {"timeline_error": repr(e)}
                 ).encode()
         add_bytes(tar, "timeline.json", timeline_doc)
+        # profiling plane (libs/profiler.py): the live node's
+        # aggregated wall-clock samples over RPC when reachable (paged
+        # under PROFILE_PAGE_CAP), else this process's own profiler
+        # state — in-process embedders that profiled leave their table
+        # here next to trace.json
+        profile_doc = None
+        if getattr(args, "rpc_url", ""):
+            try:
+                base = args.rpc_url.rstrip("/")
+                with urllib.request.urlopen(
+                    f"{base}/profile?action=status", timeout=5
+                ) as resp:
+                    status = json.loads(resp.read())["result"]
+                stacks, cursor = [], 0
+                for _ in range(64):
+                    with urllib.request.urlopen(
+                        f"{base}/profile?action=snapshot&after={cursor}",
+                        timeout=5,
+                    ) as resp:
+                        page = json.loads(resp.read())["result"]
+                    stacks.extend(page["stacks"])
+                    if not page["stacks"]:
+                        break
+                    cursor = page["next"]
+                if status["stats"].get("samples_total"):
+                    # a never-enabled profiler answers with zero
+                    # samples — the in-process fallback below may
+                    # still have a table
+                    profile_doc = json.dumps(
+                        {
+                            "source": "rpc",
+                            "stats": status["stats"],
+                            "subsystem_shares": status.get(
+                                "subsystem_shares", {}
+                            ),
+                            "stacks": stacks,
+                        }
+                    ).encode()
+            except Exception:
+                profile_doc = None  # fall through to in-process
+        if profile_doc is None:
+            from ..libs import profiler as _profiler
+
+            profile_doc = _profiler.to_profile_json().encode()
+        add_bytes(tar, "profile.json", profile_doc)
         # live metrics scrape, best effort
         if args.metrics_url:
             try:

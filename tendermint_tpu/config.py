@@ -221,6 +221,18 @@ class InstrumentationConfig:
     # disabled path is one attribute check per step transition.
     consensus_timeline: bool = True
     consensus_timeline_capacity: int = 4096
+    # wall-clock sampling profiler (libs/profiler.py): daemon sampler
+    # over sys._current_frames() with subsystem + asyncio-task
+    # attribution, served by the `profile` RPC route, the debug
+    # bundle (profile.json) and the tmload bottleneck ledger. Off by
+    # default — sampling costs ~1-3% wall at the default 97 Hz;
+    # task-label *arming* (profiler_labels) is on so a profile
+    # started mid-run over RPC still sees long-lived pumps' origins
+    # (one attribute write per task spawn).
+    profiler: bool = False
+    profiler_hz: float = 97.0
+    profiler_max_stacks: int = 2048
+    profiler_labels: bool = True
 
 
 @dataclass

@@ -1,0 +1,534 @@
+"""Wall-clock sampling profiler: the fifth observability plane.
+
+Zero-dependency sibling of libs/trace.py. Where spans answer "what
+happened inside THIS call" and metrics answer "how often / how long on
+average", the profiler answers the question neither can: **where is
+CPU/wall time actually going** across the whole process, under
+production load, without instrumenting a single call site.
+
+A daemon sampler thread walks `sys._current_frames()` at a configurable
+rate (default ~97 Hz — a prime, so the sampler never phase-locks with
+the 10 ms/100 ms periodic work consensus and the schedulers do) and
+folds every thread's stack into a bounded aggregation keyed by
+(thread-role, task-label, folded stack). Two attribution layers make
+the samples comparable across runs and across PRs:
+
+1. **Subsystem buckets** — every sample is attributed to the innermost
+   in-package frame's subsystem (consensus / mempool / p2p / rpc /
+   eventbus / crypto-batch / merkle / store / serialization / ...), so
+   the tmload bottleneck ledger can rank "where the next 10x is
+   hiding" with stable names. Samples with no in-package frame land in
+   `idle` (event-loop selector poll / parked waits) or `stdlib`.
+
+2. **asyncio-task labels** — a sample on an event-loop thread is
+   sub-attributed to the *currently running task* (read cross-thread
+   via `asyncio.tasks.current_task(loop)`, a plain dict lookup), whose
+   origin is labeled where it is spawned (`label_task`: rpc route
+   pumps, WS writers, p2p channel pumps, service loops). So "the loop
+   is busy" decomposes into "the WS writer is busy".
+
+Kill-switched exactly like trace.py: OFF by default, `enable()` starts
+the sampler, `disable()` stops AND joins it (node teardown calls this —
+tests/test_teardown.py pins zero surviving threads). The disabled path
+of the only call-site hook (`label_task`) is a single module-attribute
+read. Labeling can be **armed** independently of sampling
+(`arm_labels()`, done at node start) so a profile started mid-run over
+RPC still sees the long-lived pumps' labels; an unarmed process pays
+tens of ns per spawn site and nothing else.
+
+Sampling bias note (docs/observability.md): this is a *wall-clock*
+profiler — a thread parked in a lock or a selector counts the same as
+one burning CPU. That is the point (lock convoys and fsync stalls are
+real time) but it means shares are shares of *wall*, not of CPU;
+`idle`/`wait` buckets keep the distinction visible. A second,
+GIL-specific bias: the sampler must acquire the GIL to read frames, and
+it acquires it at the target's next *release point* — so pure-Python
+CPU bursts shorter than the interpreter switch interval are attributed
+to the GIL-releasing call that ends them (a socket send, a hash, a
+selector poll) rather than the burst itself. `enable()` therefore
+drops `sys.setswitchinterval` to 1 ms for the profiling window (forced
+preemption then catches any burst over ~1 ms) and `disable()` restores
+the previous value.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_HZ",
+    "DEFAULT_MAX_STACKS",
+    "arm_labels",
+    "disable",
+    "disarm_labels",
+    "enable",
+    "folded",
+    "is_enabled",
+    "label_task",
+    "labels_armed",
+    "register_loop",
+    "register_thread",
+    "reset",
+    "snapshot",
+    "stats",
+    "subsystem_counts",
+    "subsystem_of",
+    "subsystem_shares",
+    "task_label",
+    "to_profile_json",
+]
+
+DEFAULT_HZ = 97.0  # prime: never phase-locks with 10/50/100 ms timers
+DEFAULT_MAX_STACKS = 2048
+_MAX_DEPTH = 48  # frames kept per stack (innermost wins the bucket)
+_CODE_CACHE_CAP = 8192  # folded-name cache: live code objects
+
+_enabled = False
+_armed = False  # label_task records labels (independent of sampling)
+_hz = DEFAULT_HZ
+_max_stacks = DEFAULT_MAX_STACKS
+
+# aggregation: (role, task_label, folded_stack, subsystem) -> count.
+# Only the sampler thread writes; _agg_lock makes snapshot/reset safe
+# against a concurrent sample tick.
+_agg: Dict[Tuple[str, str, str, str], int] = {}
+_agg_lock = threading.Lock()
+_samples_total = 0
+_collapsed_total = 0  # samples folded into <collapsed> by the cap
+_started_unix = 0.0
+
+_thread: Optional[threading.Thread] = None
+_stop_evt = threading.Event()
+# serializes enable/disable: two concurrent enable() callers (the
+# owning node + the profile RPC route) must not both observe
+# _enabled=False and start two sampler threads. The sampler thread
+# never takes this lock, so disable()'s join under it cannot deadlock.
+_lifecycle_lock = threading.Lock()
+_SWITCH_INTERVAL_S = 0.001  # forced-preemption bound while profiling
+_saved_switch_interval: Optional[float] = None
+
+# thread ident -> declared role ("loop", "wal", "verifier-watchdog"...)
+_roles: Dict[int, str] = {}
+# thread ident -> weakref to the asyncio loop running on it (for task
+# attribution); stale entries are pruned when the loop is gc'd
+_loops: Dict[int, "weakref.ref"] = {}
+_reg_lock = threading.Lock()
+
+# code object -> (folded entry, subsystem-or-"") — code objects are
+# interned per loaded module, so holding them leaks nothing new
+_code_cache: Dict[Any, Tuple[str, str]] = {}
+
+_PKG_MARKER = "tendermint_tpu" + "/"  # path fragment of our package
+
+# ordered: first matching prefix of the package-relative path wins
+_SUBSYSTEM_PREFIXES: Tuple[Tuple[str, str], ...] = (
+    ("consensus/", "consensus"),
+    ("mempool/", "mempool"),
+    ("p2p/", "p2p"),
+    ("blocksync/", "blocksync"),
+    ("statesync/", "statesync"),
+    ("evidence/", "evidence"),
+    ("light/", "light"),
+    ("rpc/", "rpc"),
+    ("eventbus/", "eventbus"),
+    ("pubsub/", "eventbus"),
+    ("crypto/merkle", "merkle"),
+    ("crypto/tmhash", "merkle"),
+    ("crypto/", "crypto-batch"),
+    ("store/", "store"),
+    ("state/", "store"),
+    ("encoding/", "serialization"),
+    ("types/", "serialization"),
+    ("abci/", "abci"),
+    ("node/", "node"),
+    ("libs/metrics", "metrics"),
+    ("libs/", "libs"),
+    ("loadgen/", "harness"),
+    ("e2e/", "harness"),
+    ("analysis/", "analysis"),
+    ("cmd/", "cmd"),
+)
+
+
+def subsystem_of(rel_path: str) -> str:
+    """Subsystem bucket for a package-relative module path
+    ("rpc/jsonrpc.py" -> "rpc"). Unmatched in-package files bucket by
+    their first path component, so every sample has a *named* home."""
+    for prefix, bucket in _SUBSYSTEM_PREFIXES:
+        if rel_path.startswith(prefix):
+            return bucket
+    head = rel_path.split("/", 1)[0]
+    return head[:-3] if head.endswith(".py") else head
+
+
+def _describe_code(code) -> Tuple[str, str]:
+    """(folded frame entry, subsystem) for one code object. Subsystem
+    is "" for frames outside the package."""
+    fn = code.co_filename.replace("\\", "/")
+    i = fn.rfind(_PKG_MARKER)
+    if i >= 0:
+        rel = fn[i + len(_PKG_MARKER):]
+        mod = rel[:-3] if rel.endswith(".py") else rel
+        return f"{mod.replace('/', '.')}:{code.co_name}", subsystem_of(rel)
+    # stdlib / site-packages: keep the last two path components
+    parts = fn.rsplit("/", 2)
+    stem = parts[-1]
+    stem = stem[:-3] if stem.endswith(".py") else stem
+    mod = f"{parts[-2]}.{stem}" if len(parts) > 2 else stem
+    return f"{mod}:{code.co_name}", ""
+
+
+def _entry_for(code) -> Tuple[str, str]:
+    ent = _code_cache.get(code)
+    if ent is None:
+        ent = _describe_code(code)
+        if len(_code_cache) < _CODE_CACHE_CAP:
+            # tmlive: bounded=keyed by live code objects (one per
+            # loaded function), hard-capped at _CODE_CACHE_CAP —
+            # overflow falls through to uncached computation
+            # tmlint: disable=lock-global-mutation — single GIL-atomic
+            # dict store memoizing a pure function; racers write the
+            # identical value (worst case the cap overshoots by one
+            # entry per racer)
+            _code_cache[code] = ent
+    return ent
+
+
+_WAIT_FUNCS = frozenset(
+    ("wait", "get", "put", "join", "_wait_for_tstate_lock", "acquire")
+)
+
+
+def _classify_leaf(leaf: str) -> str:
+    """Bucket for an out-of-package innermost frame: the event loop's
+    selector poll is `idle`, parked thread primitives are `wait`,
+    anything else is honest `stdlib` work (json, struct, ...)."""
+    mod, _, func = leaf.partition(":")
+    tail = mod.rsplit(".", 1)[-1]
+    if tail in ("selectors", "base_events"):
+        return "idle"
+    if tail in ("threading", "queue") and func in _WAIT_FUNCS:
+        return "wait"
+    return "stdlib"
+
+
+def _fold(frame) -> Tuple[Tuple[str, ...], str]:
+    """Walk a frame chain into (root-first folded stack, subsystem).
+    The subsystem is the innermost in-package frame's bucket; a stack
+    with none is `idle` (selector poll / loop plumbing) or `stdlib`."""
+    entries: List[str] = []
+    subsystem = ""
+    depth = 0
+    f = frame
+    while f is not None and depth < _MAX_DEPTH:
+        ent, sub = _entry_for(f.f_code)
+        entries.append(ent)
+        if not subsystem and sub:
+            subsystem = sub
+        f = f.f_back
+        depth += 1
+    if not subsystem:
+        subsystem = _classify_leaf(entries[0]) if entries else "stdlib"
+    entries.reverse()
+    return tuple(entries), subsystem
+
+
+# -- registration hooks ---------------------------------------------------
+
+
+def register_thread(role: str, ident: Optional[int] = None) -> None:
+    """Declare a thread's role ("loop", "wal", "verifier-watchdog");
+    samples of that thread report under the role instead of the raw
+    thread name."""
+    with _reg_lock:
+        # tmlive: bounded=keyed by thread ident — one entry per
+        # *declared* thread role; the process runs a fixed, small set
+        # of long-lived named threads
+        _roles[ident if ident is not None else threading.get_ident()] = role
+
+
+def register_loop(
+    loop: Optional[asyncio.AbstractEventLoop] = None,
+    ident: Optional[int] = None,
+) -> None:
+    """Bind an asyncio loop to the thread running it, so loop-thread
+    samples can be sub-attributed to the current task. Call from the
+    loop thread (node start does)."""
+    if loop is None:
+        loop = asyncio.get_event_loop()
+    with _reg_lock:
+        # tmlive: bounded=keyed by thread ident — one entry per thread
+        # that ever ran a registered loop; entries whose loop was gc'd
+        # are pruned by the sampler tick
+        _loops[ident if ident is not None else threading.get_ident()] = (
+            weakref.ref(loop)
+        )
+
+
+def label_task(task, label: str):
+    """Tag an asyncio task with its origin ("rpc:ws-writer",
+    "p2p:ch-pump:32", "service:consensus"). Samples that land while
+    this task runs report under the label. One module-attribute read
+    when neither armed nor sampling — hot spawn paths call this
+    unconditionally."""
+    if not (_armed or _enabled):
+        return task
+    try:
+        task._tt_profile_label = label
+    except AttributeError:
+        pass  # foreign task implementation without a __dict__
+    ident = threading.get_ident()
+    if ident not in _loops:
+        try:
+            register_loop(task.get_loop(), ident)
+        except Exception:
+            pass  # off-loop labeling: attribution degrades gracefully
+    return task
+
+
+def task_label(task) -> str:
+    """The label a sample of this task would report (labeled origin,
+    else the asyncio task name)."""
+    lbl = getattr(task, "_tt_profile_label", "")
+    if lbl:
+        return lbl
+    try:
+        return task.get_name()
+    except Exception:
+        return ""
+
+
+def arm_labels() -> None:
+    """Record task labels even while sampling is off, so a profile
+    started mid-run (RPC `profile` route) sees long-lived pumps'
+    origins. Node assembly arms at start."""
+    global _armed
+    _armed = True
+
+
+def disarm_labels() -> None:
+    global _armed
+    _armed = False
+
+
+def labels_armed() -> bool:
+    return _armed
+
+
+# -- the sampler ----------------------------------------------------------
+
+
+def _take_sample() -> None:
+    global _samples_total, _collapsed_total
+    frames = sys._current_frames()
+    own = threading.get_ident()
+    with _reg_lock:
+        roles = dict(_roles)
+        loops = dict(_loops)
+    names: Dict[int, str] = {}
+    for t in threading.enumerate():
+        names[t.ident] = t.name
+    with _agg_lock:
+        for ident, frame in frames.items():
+            if ident == own:
+                continue  # never profile the profiler
+            role = roles.get(ident) or names.get(ident) or f"t{ident}"
+            label = ""
+            ref = loops.get(ident)
+            if ref is not None:
+                loop = ref()
+                if loop is None:
+                    with _reg_lock:
+                        _loops.pop(ident, None)  # loop was gc'd
+                else:
+                    task = asyncio.tasks.current_task(loop)
+                    if task is not None:
+                        label = task_label(task)
+            stack, subsystem = _fold(frame)
+            key = (role, label, ";".join(stack), subsystem)
+            n = _agg.get(key)
+            if n is not None:
+                _agg[key] = n + 1
+            elif len(_agg) < _max_stacks:
+                # tmlive: bounded=hard cap _max_stacks: a novel stack
+                # beyond the cap collapses into the per-(role,
+                # subsystem) <collapsed> key below instead of growing
+                _agg[key] = 1
+            else:
+                ckey = (role, "", "<collapsed>", subsystem)
+                # tmlive: bounded=collapse keys are bounded by
+                # live-threads x the fixed subsystem alphabet — the
+                # eviction policy of the capped stack table
+                _agg[ckey] = _agg.get(ckey, 0) + 1
+                _collapsed_total += 1
+            _samples_total += 1
+
+
+def _sampler_main() -> None:
+    interval = 1.0 / _hz
+    # tmlive: block-ok — dedicated daemon sampler thread parked
+    # between ticks; the wait is bounded by 1/hz and disable() sets
+    # the event then joins
+    while not _stop_evt.wait(interval):
+        try:
+            _take_sample()
+        except Exception:
+            # a sampler crash must never take the node down; skip the
+            # tick (RuntimeError from a dict resized mid-enumerate in
+            # threading.enumerate, a frame gone mid-walk, ...)
+            pass
+
+
+def enable(
+    hz: Optional[float] = None, max_stacks: Optional[int] = None
+) -> None:
+    """Start sampling (idempotent). Also arms task labels."""
+    global _enabled, _hz, _max_stacks, _thread, _started_unix
+    if hz is not None and hz <= 0:
+        raise ValueError(f"profiler hz must be > 0: {hz}")
+    if max_stacks is not None and max_stacks < 1:
+        raise ValueError(
+            f"profiler max_stacks must be >= 1: {max_stacks}"
+        )
+    with _lifecycle_lock:
+        if hz is not None:
+            _hz = float(hz)
+        if max_stacks is not None:
+            _max_stacks = int(max_stacks)
+        if _enabled:
+            return
+        global _saved_switch_interval
+        cur = sys.getswitchinterval()
+        if cur > _SWITCH_INTERVAL_S:
+            _saved_switch_interval = cur
+            sys.setswitchinterval(_SWITCH_INTERVAL_S)
+        _stop_evt.clear()
+        _started_unix = time.time()
+        _thread = threading.Thread(
+            target=_sampler_main, name="tt-profiler", daemon=True
+        )
+        _enabled = True
+        _thread.start()
+
+
+def disable() -> None:
+    """Kill switch: stop the sampler and JOIN it — after return there
+    is no surviving profiler thread and no further samples."""
+    global _enabled, _thread, _saved_switch_interval
+    with _lifecycle_lock:
+        if not _enabled:
+            return
+        _enabled = False
+        _stop_evt.set()
+        t = _thread
+        _thread = None
+        if t is not None and t.is_alive():
+            # tmlive: block-ok — bounded by the sampler's 1/hz tick
+            # (the stop event is already set) plus the join timeout
+            t.join(timeout=5.0)
+        if _saved_switch_interval is not None:
+            sys.setswitchinterval(_saved_switch_interval)
+            _saved_switch_interval = None
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Drop every aggregated sample (tests; fresh profile windows)."""
+    global _samples_total, _collapsed_total
+    with _agg_lock:
+        _agg.clear()
+        _samples_total = 0
+        _collapsed_total = 0
+
+
+# -- export ---------------------------------------------------------------
+
+
+def snapshot(max_entries: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Aggregated stacks, highest count first:
+    {role, task, stack (root-first, ';'-joined), subsystem, count}."""
+    with _agg_lock:
+        items = sorted(
+            _agg.items(), key=lambda kv: (-kv[1], kv[0])
+        )
+    if max_entries is not None:
+        items = items[:max_entries]
+    return [
+        {
+            "role": role,
+            "task": label,
+            "stack": stack,
+            "subsystem": subsystem,
+            "count": count,
+        }
+        for (role, label, stack, subsystem), count in items
+    ]
+
+
+def folded(max_entries: Optional[int] = None) -> List[str]:
+    """Collapsed-stack lines (`role;[task;]frame;... count`) — the
+    flamegraph.pl / speedscope input format, consumed by
+    scripts/profile_report.py."""
+    out = []
+    for e in snapshot(max_entries):
+        head = f"{e['role']};{e['task']};" if e["task"] else f"{e['role']};"
+        out.append(f"{head}{e['stack']} {e['count']}")
+    return out
+
+
+def subsystem_counts() -> Dict[str, int]:
+    """Raw sample counts per subsystem bucket. Cumulative since the
+    last reset — harnesses diff two readings to isolate a window."""
+    with _agg_lock:
+        totals: Dict[str, int] = {}
+        for (role, label, stack, subsystem), count in _agg.items():
+            totals[subsystem] = totals.get(subsystem, 0) + count
+    return dict(sorted(totals.items()))
+
+
+def subsystem_shares() -> Dict[str, float]:
+    """Fraction of all samples per subsystem bucket (sums to 1.0 when
+    any samples exist) — the bottleneck ledger's raw material."""
+    totals = subsystem_counts()
+    grand = sum(totals.values())
+    if grand == 0:
+        return {}
+    return {k: v / grand for k, v in totals.items()}
+
+
+def stats() -> Dict[str, Any]:
+    """Profiler status: sampling state, rates, table pressure."""
+    with _agg_lock:
+        n_stacks = len(_agg)
+    return {
+        "enabled": _enabled,
+        "labels_armed": _armed,
+        "hz": _hz,
+        "samples_total": _samples_total,
+        "stacks": n_stacks,
+        "max_stacks": _max_stacks,
+        "collapsed_samples": _collapsed_total,
+        "started_unix": _started_unix if _enabled else 0.0,
+    }
+
+
+def to_profile_json() -> str:
+    """Export for the debug bundle's `profile.json`: status + the full
+    aggregated table + subsystem shares."""
+    return json.dumps(
+        {
+            "stats": stats(),
+            "subsystem_shares": subsystem_shares(),
+            "stacks": snapshot(),
+        },
+        default=str,
+    )

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 from typing import Coroutine, Optional
 
+from . import profiler
 from .log import Logger, get_logger
 
 __all__ = ["Service", "ServiceError"]
@@ -108,6 +109,10 @@ class Service:
         task = asyncio.get_event_loop().create_task(
             self._run_guarded(coro, name or self.name)
         )
+        # profiler task attribution: loop-thread samples landing while
+        # this task runs report "service:<svc>:<task>" instead of the
+        # bare loop (one attribute read when the profiler is cold)
+        profiler.label_task(task, f"service:{self.name}:{name or 'main'}")
         # If the task is cancelled before its first tick, the inner coroutine
         # never starts; close it then to avoid "never awaited" warnings.
         task.add_done_callback(lambda _t: coro.close())

@@ -92,6 +92,7 @@ async def start_localnet(
     timeout_commit: float = 0.2,
     trace_spans: bool = False,
     slo_exemplars: bool = False,
+    profiler: bool = False,
     genesis_time_ns: Optional[int] = None,
     db_backend: str = "memdb",
     ping_interval: float = 30.0,
@@ -147,6 +148,9 @@ async def start_localnet(
         cfg.p2p.pong_timeout = pong_timeout
         cfg.instrumentation.trace_spans = trace_spans
         cfg.instrumentation.slo_exemplars = slo_exemplars
+        # the sampler is process-wide; the first node to start owns it
+        # and stop-and-joins it at teardown (node/node.py _teardown)
+        cfg.instrumentation.profiler = profiler and i == 0
         cfg.ensure_dirs()
         genesis.save_as(cfg.base.path(cfg.base.genesis_file))
         FilePV.from_priv_key(

@@ -1,0 +1,180 @@
+"""The bottleneck ledger: merge the profiling plane into the tmload row.
+
+Three planes each hold one third of the "why is it slow" story:
+
+  * the **profiler** (libs/profiler.py) knows which *code* held the
+    wall — per-subsystem sample shares and the hot folded stacks;
+  * the **scraper** (loadgen/scrape.py) knows which *queues* were
+    saturated — fanout lag, mempool depth, inflight requests;
+  * the **flight recorder** (loadgen/timeline.py) knows whether the
+    *consensus* half (proposal→polka→quorum→commit) or the *serving*
+    half was the slow one.
+
+`build_ledger` joins them on the subsystem name into one ranked table
+— "where the next 10x is hiding" — that build_report banks into
+BENCH_LOAD.json, so every future throughput PR states its attribution
+shift with `scripts/bench_compare.py --ledger`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..libs import profiler
+
+__all__ = [
+    "NON_WORK_BUCKETS",
+    "SERVING_BUCKETS",
+    "UNATTRIBUTED_BUCKETS",
+    "build_ledger",
+    "capture_profile",
+]
+
+# buckets that are wall time but not *work* — reported as their own
+# ledger fields, excluded from the ranked work table
+NON_WORK_BUCKETS = frozenset(("idle", "wait"))
+
+# buckets with no named subsystem home: the ledger's honesty meter
+# (the acceptance bar keeps their joint share under 10%)
+UNATTRIBUTED_BUCKETS = frozenset(("stdlib",))
+
+# the serving half of the consensus-vs-serving split; everything else
+# that is work belongs to the consensus/replication half
+SERVING_BUCKETS = frozenset(("rpc", "eventbus", "serialization"))
+
+# which scraper saturation keys corroborate which subsystem's share —
+# a hot bucket WITH a saturated queue is a bottleneck, a hot bucket
+# without one is merely busy
+_SUBSYSTEM_SIGNALS: Dict[str, tuple] = {
+    "mempool": (
+        "mempool_size_max",
+        "mempool_failed_txs_total_delta",
+        "mempool_checktx_seconds_p99_max",
+        "mempool_lock_wait_seconds_p99_max",
+    ),
+    "eventbus": (
+        "eventbus_fanout_lag_max",
+        "eventbus_subscriptions_max",
+        "eventbus_deliveries_total_delta",
+        "eventbus_dropped_subscriptions_total_delta",
+    ),
+    "rpc": (
+        "rpc_inflight_requests_max",
+        "rpc_ws_connections_max",
+        "rpc_ws_slow_clients_dropped_total_delta",
+    ),
+    "p2p": (
+        "p2p_peer_disconnects_total_delta",
+        "p2p_send_queue_dropped_total_delta",
+        "p2p_net_faults_total_delta",
+    ),
+    "consensus": ("consensus_total_txs_delta",),
+}
+
+_TOP_STACKS_KEPT = 40  # per banked profile block: the hot tail only
+
+
+def capture_profile(
+    counts_before: Optional[Dict[str, int]] = None,
+) -> Dict[str, Any]:
+    """Snapshot the in-process profiler for the report's `profile`
+    block: stats, per-subsystem counts (whole run AND the measured
+    window when `counts_before` — a `profiler.subsystem_counts()`
+    reading taken at window start — is given), and the top stacks."""
+    counts = profiler.subsystem_counts()
+    doc: Dict[str, Any] = {
+        "stats": profiler.stats(),
+        "subsystem_counts": counts,
+        "subsystem_shares": profiler.subsystem_shares(),
+        "stacks": profiler.snapshot(_TOP_STACKS_KEPT),
+    }
+    if counts_before is not None:
+        window = {
+            k: counts.get(k, 0) - counts_before.get(k, 0)
+            for k in set(counts) | set(counts_before)
+        }
+        doc["window_counts"] = {
+            k: v for k, v in sorted(window.items()) if v > 0
+        }
+    return doc
+
+
+def _shares_of(counts: Dict[str, int]) -> Dict[str, float]:
+    total = sum(counts.values())
+    if total <= 0:
+        return {}
+    return {k: v / total for k, v in counts.items()}
+
+
+def build_ledger(
+    profile: Dict[str, Any],
+    saturation: Optional[Dict[str, float]] = None,
+    timeline: Optional[dict] = None,
+) -> Dict[str, Any]:
+    """The ranked bottleneck table. Uses the measured-window counts
+    when the profile has them (warmup excluded), else the whole run."""
+    counts: Dict[str, int] = dict(
+        profile.get("window_counts")
+        or profile.get("subsystem_counts")
+        or {}
+    )
+    shares = _shares_of(counts)
+    total = sum(counts.values())
+    sat = saturation or {}
+
+    idle = sum(shares.get(b, 0.0) for b in NON_WORK_BUCKETS)
+    unattributed = sum(shares.get(b, 0.0) for b in UNATTRIBUTED_BUCKETS)
+    work = {
+        k: v
+        for k, v in shares.items()
+        if k not in NON_WORK_BUCKETS and k not in UNATTRIBUTED_BUCKETS
+    }
+    work_total = sum(work.values())
+
+    entries = []
+    for rank, (name, share) in enumerate(
+        sorted(work.items(), key=lambda kv: (-kv[1], kv[0])), start=1
+    ):
+        signals = {
+            key: sat[key]
+            for key in _SUBSYSTEM_SIGNALS.get(name, ())
+            if key in sat
+        }
+        entries.append(
+            {
+                "rank": rank,
+                "subsystem": name,
+                "share": round(share, 4),
+                "work_share": (
+                    round(share / work_total, 4) if work_total else 0.0
+                ),
+                "samples": counts.get(name, 0),
+                "signals": signals,
+            }
+        )
+
+    serving = sum(work.get(b, 0.0) for b in SERVING_BUCKETS)
+    split: Dict[str, Any] = {
+        "serving_share": round(serving, 4),
+        "consensus_share": round(work_total - serving, 4),
+    }
+    if timeline is not None:
+        # the flight recorder's stage attribution rides along so the
+        # split is cross-checkable against consensus-internal timings
+        split["timeline"] = {
+            "heights_attributed": timeline.get("heights_attributed"),
+            "rounds_burned_total": timeline.get("rounds_burned_total"),
+            "timeouts_total": timeline.get("timeouts_total"),
+            "proposal_to_polka": timeline.get("proposal_to_polka"),
+            "polka_to_quorum": timeline.get("polka_to_quorum"),
+            "commit_spread": timeline.get("commit_spread"),
+        }
+
+    return {
+        "samples_total": total,
+        "attributed_share": round(1.0 - unattributed, 4),
+        "unattributed_share": round(unattributed, 4),
+        "idle_share": round(idle, 4),
+        "entries": entries,
+        "consensus_vs_serving": split,
+    }

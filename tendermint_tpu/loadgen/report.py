@@ -31,6 +31,8 @@ def build_report(
     scraper: Optional[Scraper] = None,
     scheduled_arrivals: int = 0,
     timeline: Optional[dict] = None,
+    profile: Optional[dict] = None,
+    ledger: Optional[dict] = None,
 ) -> dict:
     routes = {op: st.to_dict() for op, st in sorted(route_stats.items())}
     total = sum(st.count for st in route_stats.values())
@@ -73,6 +75,14 @@ def build_report(
         # the fleet flight-recorder aggregate (loadgen/timeline.py):
         # the consensus half of a slow-commit decomposition
         report["consensus_timeline"] = timeline
+    if profile is not None:
+        # the profiling plane's raw material (loadgen/profilemerge.py
+        # capture_profile): subsystem counts + the hot folded stacks
+        report["profile"] = profile
+    if ledger is not None:
+        # the ranked bottleneck table — profiler shares joined with
+        # saturation signals and the consensus-vs-serving split
+        report["bottleneck_ledger"] = ledger
     if scn.mode == "open":
         report["scheduled_arrivals"] = scheduled_arrivals
         report["offered_rate_per_s"] = scn.rate

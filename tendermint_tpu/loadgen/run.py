@@ -20,7 +20,9 @@ from .driver import (
     run_closed_loop,
     run_open_loop,
 )
+from ..libs import profiler
 from .localnet import start_localnet
+from .profilemerge import build_ledger, capture_profile
 from .report import build_report
 from .scenario import Scenario
 from .scrape import Scraper
@@ -75,6 +77,12 @@ async def run_scenario(
             if scraper is not None
             else None
         )
+        # profiling plane (libs/profiler.py): a subsystem-count
+        # reading at window start isolates the measured window's
+        # samples from warmup/boot for the bottleneck ledger
+        profiler_counts_before = (
+            profiler.subsystem_counts() if profiler.is_enabled() else None
+        )
         t0 = time.perf_counter()
         scheduled = 0
         if scn.mode == "open":
@@ -107,6 +115,16 @@ async def run_scenario(
                 )
             except Exception:
                 tl_summary = None  # recorder disabled / foreign nodes
+        # bottleneck ledger: profiler shares ⋈ scraper saturation ⋈
+        # flight-recorder split (loadgen/profilemerge.py)
+        profile_doc = ledger = None
+        if profiler.is_enabled():
+            profile_doc = capture_profile(profiler_counts_before)
+            ledger = build_ledger(
+                profile_doc,
+                scraper.saturation() if scraper is not None else {},
+                tl_summary,
+            )
         return build_report(
             scn,
             stats,
@@ -118,6 +136,8 @@ async def run_scenario(
             scraper=scraper,
             scheduled_arrivals=scheduled,
             timeline=tl_summary,
+            profile=profile_doc,
+            ledger=ledger,
         )
     finally:
         # unconditional teardown: a driver or scraper exception must
@@ -138,14 +158,18 @@ async def run_localnet_scenario(
     home: str,
     chain_id: str = "loadnet",
     timeout_commit: float = 0.2,
+    profile: bool = False,
 ) -> dict:
-    """Boot an in-process localnet, run the scenario, tear down."""
+    """Boot an in-process localnet, run the scenario, tear down.
+    `profile=True` runs the wall-clock sampler for the whole run and
+    banks the bottleneck ledger into the report."""
     net = await start_localnet(
         n_nodes,
         home,
         chain_id=chain_id,
         seed=scn.seed,
         timeout_commit=timeout_commit,
+        profiler=profile,
     )
     try:
         return await run_scenario(

@@ -63,6 +63,15 @@ _MAX_GAUGES = (
     "rpc_inflight_requests",
 )
 
+# sketch p99s tracked as run maxima (worst window across nodes).
+# lock_wait vs checktx is the mempool contention split: p99s moving
+# together means CheckTx is lock-bound (consensus holds the pool
+# across Commit+Update), lock_wait ≈ 0 means it is validation-bound.
+_P99_SKETCHES = (
+    "mempool_checktx_seconds",
+    "mempool_lock_wait_seconds",
+)
+
 # counters reported as whole-run deltas (first vs last sample)
 _DELTA_COUNTERS = (
     "consensus_total_txs",
@@ -135,6 +144,12 @@ class Scraper:
         for name in _MAX_GAUGES:
             out[name + "_max"] = max(
                 sum(self._series_sum(p, name) for p in snap)
+                for snap in samples
+            )
+        for name in _P99_SKETCHES:
+            key = _NS + name + "{quantile=0.99}"
+            out[name + "_p99_max"] = max(
+                max((p.get(key, 0.0) for p in snap), default=0.0)
                 for snap in samples
             )
         first, last = samples[0], samples[-1]

@@ -104,6 +104,13 @@ class TxMempool(Mempool):
         t0 = time.perf_counter()
         try:
             async with self._lock:
+                # the contention share on its own: checktx_seconds
+                # keeps folding the wait in (the total IS the ingest
+                # latency), this split says how much of it was waiting
+                # for consensus to release the pool
+                self.metrics.lock_wait_seconds.observe(
+                    time.perf_counter() - t0
+                )
                 return await self._check_tx_locked(tx, tx_info)
         finally:
             # lock wait included on purpose: under load the wait for

@@ -33,3 +33,16 @@ class MempoolMetrics:
             "End-to-end CheckTx ingest latency (lock wait + app "
             "round-trip + pool insert).",
         )
+        # the lock-wait half of that latency on its own (ISSUE 16
+        # satellite): checktx_seconds folds the wait for consensus to
+        # release the pool into the total, so a slow ingest p99 was
+        # not attributable to contention vs validation without this
+        # split. checktx p99 ≈ lock_wait p99 → contention-bound
+        # (consensus holds the pool across Commit+Update); lock_wait
+        # ≈ 0 → validation/insert-bound.
+        self.lock_wait_seconds = r.sketch(
+            "mempool",
+            "lock_wait_seconds",
+            "Time CheckTx spent waiting to acquire the mempool lock "
+            "(the contention share of checktx_seconds).",
+        )

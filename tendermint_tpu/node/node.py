@@ -109,6 +109,28 @@ class Node(Service):
             trace.enable_exemplars(
                 capacity=cfg.instrumentation.slo_exemplar_capacity
             )
+        # wall-clock sampling profiler (libs/profiler.py): process-wide
+        # like the trace ring. Arming labels alone is near-free and
+        # lets a profile started later (RPC `profile` route) attribute
+        # loop samples to the pumps spawned now; actually *sampling*
+        # starts only when cfg asks. The enabling node owns the
+        # stop-and-join at teardown.
+        self._profiler_owner = False
+        if cfg.instrumentation.profiler_labels or cfg.instrumentation.profiler:
+            from ..libs import profiler
+
+            profiler.arm_labels()
+        if cfg.instrumentation.profiler:
+            from ..libs import profiler
+
+            # a cfg-owned profile is per-run: drop samples a previous
+            # in-process run (bench A/B, back-to-back localnets) left
+            profiler.reset()
+            profiler.enable(
+                hz=cfg.instrumentation.profiler_hz,
+                max_stacks=cfg.instrumentation.profiler_max_stacks,
+            )
+            self._profiler_owner = True
 
         # -- device verifier install (the north-star seam) --
         # Done first so every later verification dispatches through it.
@@ -329,6 +351,12 @@ class Node(Service):
         through tears down whatever already started — Service.stop()
         won't call on_stop after a failed start."""
         self._acquire_data_lock()
+        # bind this loop (from its own thread — we are on it) so
+        # profiler samples of the loop thread sub-attribute to the
+        # running task's labeled origin
+        from ..libs import profiler
+
+        profiler.register_loop()
         try:
             await self._start_impl()
         except BaseException:
@@ -724,6 +752,14 @@ class Node(Service):
         await self._teardown()
 
     async def _teardown(self) -> None:
+        # stop-and-join the sampler FIRST if this node enabled it: no
+        # profiler thread may survive a node stop, and no sample may
+        # land after (tests/test_teardown.py pins both)
+        if getattr(self, "_profiler_owner", False):
+            from ..libs import profiler
+
+            profiler.disable()
+            self._profiler_owner = False
         ms = getattr(self, "_metrics_server", None)
         if ms is not None:
             ms.close()

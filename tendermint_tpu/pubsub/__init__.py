@@ -108,6 +108,17 @@ class Server(Service):
         super().__init__(name=name)
         # (client_id, query string) → Subscription
         self._subs: Dict[Tuple[str, str], Subscription] = {}
+        # publish-path index: query source → (compiled query, members).
+        # Load subscribers are overwhelmingly N clients × few distinct
+        # queries (tm.event='NewBlock' × hundreds), so the fan-out
+        # evaluates each DISTINCT query once per publish and batch-
+        # delivers one shared Message to the whole group — the
+        # PR-16 profiler's top serving-side fix (the per-subscriber
+        # Message allocation + per-subscriber query re-evaluation
+        # dominated publish at 256 subscribers).
+        self._groups: Dict[
+            str, Tuple[Query, Dict[Tuple[str, str], Subscription]]
+        ] = {}
 
     def subscribe(
         self, client_id: str, query: "Query | str", limit: int = 100
@@ -120,11 +131,30 @@ class Server(Service):
             )
         sub = Subscription(client_id, q, limit)
         self._subs[key] = sub
+        group = self._groups.get(key[1])
+        if group is None:
+            # tmlive: bounded=one group per distinct live query source;
+            # a group dies with its last member (_drop_key)
+            self._groups[key[1]] = (q, {key: sub})
+        else:
+            group[1][key] = sub
+        return sub
+
+    def _drop_key(self, key: Tuple[str, str]) -> Optional[Subscription]:
+        """Remove one subscription from both indexes."""
+        sub = self._subs.pop(key, None)
+        if sub is None:
+            return None
+        group = self._groups.get(key[1])
+        if group is not None:
+            group[1].pop(key, None)
+            if not group[1]:
+                del self._groups[key[1]]
         return sub
 
     def unsubscribe(self, client_id: str, query: "Query | str") -> None:
         qs = str(compile_query(query) if isinstance(query, str) else query)
-        sub = self._subs.pop((client_id, qs), None)
+        sub = self._drop_key((client_id, qs))
         if sub is None:
             raise SubscriptionError(f"{client_id} not subscribed to {qs}")
         sub._terminate("unsubscribed")
@@ -134,7 +164,7 @@ class Server(Service):
         if not keys:
             raise SubscriptionError(f"{client_id} has no subscriptions")
         for k in keys:
-            self._subs.pop(k)._terminate("unsubscribed")
+            self._drop_key(k)._terminate("unsubscribed")
 
     def num_clients(self) -> int:
         return len({cid for cid, _ in self._subs})
@@ -159,17 +189,26 @@ class Server(Service):
         dead: List[Tuple[str, str]] = []
         matched = 0
         max_depth = 0
-        for key, sub in self._subs.items():
-            if sub.query.matches(events):
+        msg: Optional[Message] = None
+        for source, (q, members) in self._groups.items():
+            # one query evaluation per DISTINCT query, not per
+            # subscriber — and one shared Message for every recipient
+            # (it is frozen, and `events` was always the same dict
+            # reference across recipients, so aliasing is unchanged)
+            if not q.matches(events):
+                continue
+            if msg is None:
+                msg = Message(data=data, events=events)
+            for key, sub in members.items():
                 matched += 1
-                if not sub._deliver(Message(data=data, events=events)):
+                if not sub._deliver(msg):
                     dead.append(key)
                 else:
                     depth = sub._queue.qsize()
                     if depth > max_depth:
                         max_depth = depth
         for key in dead:
-            self._subs.pop(key, None)
+            self._drop_key(key)
         return matched, max_depth, len(dead)
 
     def max_queue_depth(self) -> int:
@@ -185,3 +224,4 @@ class Server(Service):
         for sub in self._subs.values():
             sub._terminate("server stopped")
         self._subs.clear()
+        self._groups.clear()

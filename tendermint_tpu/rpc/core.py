@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional
 from ..crypto.keys import PubKey
 from ..types.validator import ValidatorSet
 from ..eventbus import EventBus
-from ..libs import trace
+from ..libs import profiler, trace
 from ..libs.log import get_logger
 from ..mempool import Mempool, MempoolError, TxInfo
 from ..pubsub import ERR_TERMINATED, SubscriptionError
@@ -42,6 +42,7 @@ __all__ = [
     "Environment",
     "GENESIS_CHUNK_SIZE",
     "LIGHT_BLOCKS_PAGE_CAP",
+    "PROFILE_PAGE_CAP",
     "TIMELINE_PAGE_CAP",
     "TX_PROOFS_CAP",
 ]
@@ -63,6 +64,12 @@ TX_PROOFS_CAP = 100
 # event is a small flat dict (~120 bytes of JSON), so a full page
 # stays ~60 KB; clients resume via the seq cursor (after_seq)
 TIMELINE_PAGE_CAP = 512
+
+# hard server-side page bound for the profile route's folded-stack
+# snapshot: an aggregated stack entry is ~0.5-1 KB of JSON (the folded
+# frame chain dominates), so a full page stays ~a quarter MB; clients
+# resume via the offset cursor (after)
+PROFILE_PAGE_CAP = 256
 
 
 def encode(obj: Any) -> Any:
@@ -200,6 +207,7 @@ class Environment:
             "validators": self.validators,
             "consensus_state": self.consensus_state,
             "consensus_timeline": self.consensus_timeline,
+            "profile": self.profile,
             "dump_consensus_state": self.dump_consensus_state,
             "consensus_params": self.consensus_params,
             "unconfirmed_txs": self.unconfirmed_txs,
@@ -551,6 +559,61 @@ class Environment:
             "dropped_before": dropped,
         }
 
+    async def profile(self, req: RPCRequest):
+        """The profiling plane over RPC (libs/profiler.py). Params:
+        `action` is one of
+
+          status   (default) sampler state + per-subsystem shares
+          start    begin sampling (optional `hz`, clamped to [1, 997];
+                   optional `reset` drops prior samples first)
+          stop     stop and join the sampler thread
+          snapshot one page of the aggregated folded stacks, highest
+                   count first; `after` resumes the offset cursor and
+                   `max_stacks` shrinks — never grows — the hard
+                   PROFILE_PAGE_CAP server page bound. Sampling keeps
+                   running between pages, so counts may drift across a
+                   paged read; page 0's `samples_total` timestamps the
+                   read.
+
+        Every answer carries `stats` so a scraper never needs a second
+        round-trip to learn the sampler state."""
+        action = str(req.params.get("action", "status") or "status")
+        if action == "start":
+            hz = req.params.get("hz")
+            if hz is not None:
+                hz = max(1.0, min(997.0, float(hz)))
+            if req.params.get("reset"):
+                profiler.reset()
+            profiler.enable(hz=hz)
+            return {"stats": profiler.stats()}
+        if action == "stop":
+            profiler.disable()
+            return {"stats": profiler.stats()}
+        if action == "snapshot":
+            after = int(req.params.get("after", 0) or 0)
+            cap = PROFILE_PAGE_CAP
+            max_stacks = int(req.params.get("max_stacks", 0) or 0)
+            if 0 < max_stacks < cap:
+                cap = max_stacks
+            entries = profiler.snapshot()
+            page = entries[after:after + cap]
+            return {
+                "stats": profiler.stats(),
+                "stacks": page,
+                "next": after + len(page),
+                "total_stacks": len(entries),
+            }
+        if action == "status":
+            return {
+                "stats": profiler.stats(),
+                "subsystem_shares": profiler.subsystem_shares(),
+            }
+        raise RPCError(
+            INVALID_PARAMS,
+            f"unknown profile action: {action!r} "
+            "(expected status/start/stop/snapshot)",
+        )
+
     async def dump_consensus_state(self, req: RPCRequest):
         """Full round state incl. vote sets (reference: consensus.go:36)."""
         if self.consensus is None:
@@ -606,7 +669,9 @@ class Environment:
             except MempoolError as e:
                 self.logger.info("async tx rejected", err=str(e))
 
-        asyncio.ensure_future(_check())
+        profiler.label_task(
+            asyncio.ensure_future(_check()), "rpc:broadcast-async-check"
+        )
         return {"hash": tx_hash(tx).hex()}
 
     async def broadcast_tx_sync(self, req: RPCRequest):
@@ -1018,7 +1083,12 @@ class Environment:
         except ValueError as e:
             raise RPCError(INVALID_PARAMS, f"invalid query: {e}")
         subs.add(query)
-        asyncio.ensure_future(self._pump_events(ws, sub, query, req.req_id))
+        profiler.label_task(
+            asyncio.ensure_future(
+                self._pump_events(ws, sub, query, req.req_id)
+            ),
+            "rpc:subscription-pump",
+        )
         return {}
 
     async def _pump_events(self, ws, sub, query: str, req_id) -> None:

@@ -21,7 +21,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional
 from urllib.parse import parse_qsl, urlsplit
 
 from ..crypto import faults
-from ..libs import trace
+from ..libs import profiler, trace
 from ..libs.log import get_logger
 
 __all__ = [
@@ -268,6 +268,7 @@ class JSONRPCServer:
 
     async def _handle_conn(self, reader, writer) -> None:
         task = asyncio.current_task()
+        profiler.label_task(task, "rpc:conn")
         self._conns.add(task)
         try:
             await self._serve_http(reader, writer)
@@ -495,7 +496,9 @@ class JSONRPCServer:
         self._ws_conns.add(ws)
         if self.metrics is not None:
             self.metrics.ws_connections.add(1)
-        wtask = asyncio.ensure_future(ws._writer_loop())
+        wtask = profiler.label_task(
+            asyncio.ensure_future(ws._writer_loop()), "rpc:ws-writer"
+        )
         msg = bytearray()
         try:
             while True:

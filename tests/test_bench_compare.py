@@ -172,6 +172,22 @@ class TestCLI:
             == 2
         )
 
+    def test_ledger_self_compare_banked_load_artifact(self, capsys):
+        """The real BENCH_LOAD.json carries a bottleneck ledger (ISSUE
+        16): it must self-diff clean through --ledger, for the main row
+        and the subs256 variant."""
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        path = os.path.join(root, "BENCH_LOAD.json")
+        assert bench_compare.main([path, path, "--ledger"]) == 0
+        assert (
+            bench_compare.main(
+                [path, path, "--ledger", "--variant", "subs256"]
+            )
+            == 0
+        )
+
     def test_self_compare_banked_artifacts(self, capsys):
         """Every banked BENCH_* file in the repo self-compares clean
         (the differ must accept the real artifact shapes)."""
@@ -188,3 +204,134 @@ class TestCLI:
             assert bench_compare.main([path, path]) == 0, name
             compared += 1
         assert compared >= 3  # the repo banks several trajectories
+
+
+def _ledger(entries, attributed=0.95, idle=0.5, serving=0.2,
+            consensus=0.25, samples=400):
+    return {
+        "samples_total": samples,
+        "attributed_share": attributed,
+        "unattributed_share": round(1.0 - attributed, 4),
+        "idle_share": idle,
+        "entries": [
+            {
+                "rank": i + 1,
+                "subsystem": name,
+                "share": share,
+                "work_share": 0.0,
+                "samples": int(share * samples),
+                "signals": {},
+            }
+            for i, (name, share) in enumerate(entries)
+        ],
+        "consensus_vs_serving": {
+            "serving_share": serving,
+            "consensus_share": consensus,
+        },
+    }
+
+
+_LED_BANKED = _ledger(
+    [("eventbus", 0.20), ("rpc", 0.15), ("consensus", 0.10)]
+)
+_LED_FRESH = _ledger(
+    [("consensus", 0.18), ("rpc", 0.14), ("merkle", 0.05)],
+    attributed=0.97,
+    serving=0.14,
+    samples=500,
+)
+
+
+class TestLedgerDiff:
+    """--ledger mode (ISSUE 16): the bottleneck-ledger differ."""
+
+    def test_ledger_of_locates_the_block(self):
+        doc = {"bottleneck_ledger": _LED_BANKED}
+        assert bench_compare.ledger_of(doc) is _LED_BANKED
+        # bare ledger fixtures pass through
+        assert bench_compare.ledger_of(_LED_BANKED) is _LED_BANKED
+        # variant descent
+        doc = {"variants": {"subs256": {"bottleneck_ledger": _LED_FRESH}}}
+        assert bench_compare.ledger_of(doc, "subs256") is _LED_FRESH
+        assert bench_compare.ledger_of(doc) is None
+        assert bench_compare.ledger_of({}, "subs256") is None
+
+    def test_compare_ledgers_share_deltas_and_buckets(self):
+        diff = bench_compare.compare_ledgers(_LED_FRESH, _LED_BANKED)
+        assert diff["samples"] == {"banked": 400, "fresh": 500}
+        by_name = {r["subsystem"]: r for r in diff["subsystems"]}
+        # the fix's claim, auditable: eventbus left the ranked table
+        assert by_name["eventbus"]["status"] == "vanished"
+        assert by_name["eventbus"]["delta_pp"] == pytest.approx(-20.0)
+        assert by_name["eventbus"]["fresh_share"] is None
+        assert by_name["merkle"]["status"] == "new"
+        assert by_name["merkle"]["delta_pp"] == pytest.approx(5.0)
+        assert by_name["consensus"]["status"] == "shared"
+        assert by_name["consensus"]["delta_pp"] == pytest.approx(8.0)
+        assert diff["new_entrants"] == ["merkle"]
+        assert diff["vanished"] == ["eventbus"]
+        # rows ranked by delta magnitude
+        mags = [abs(r["delta_pp"]) for r in diff["subsystems"]]
+        assert mags == sorted(mags, reverse=True)
+        h = diff["headline"]
+        assert h["attributed_share"]["delta_pp"] == pytest.approx(2.0)
+        assert h["serving_share"]["delta_pp"] == pytest.approx(-6.0)
+        assert h["consensus_share"]["delta_pp"] == pytest.approx(0.0)
+
+    def test_compare_ledgers_handles_missing_split(self):
+        bare = {"samples_total": 1, "entries": []}
+        diff = bench_compare.compare_ledgers(bare, bare)
+        assert diff["subsystems"] == []
+        assert diff["headline"]["serving_share"]["delta_pp"] is None
+
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_cli_ledger_mode_text_and_json(self, tmp_path, capsys):
+        f = self._write(
+            tmp_path,
+            "fresh.json",
+            {"bottleneck_ledger": _LED_FRESH},
+        )
+        b = self._write(
+            tmp_path,
+            "banked.json",
+            {"bottleneck_ledger": _LED_BANKED},
+        )
+        assert bench_compare.main([f, b, "--ledger"]) == 0
+        out = capsys.readouterr().out
+        assert "vanished  eventbus" in out
+        assert "new  merkle" in out
+        assert "attributed_share" in out
+        assert bench_compare.main([f, b, "--ledger", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["vanished"] == ["eventbus"]
+
+    def test_cli_ledger_mode_exit_two_without_ledger(
+        self, tmp_path, capsys
+    ):
+        f = self._write(
+            tmp_path, "fresh.json", {"bottleneck_ledger": _LED_FRESH}
+        )
+        b = self._write(tmp_path, "banked.json", {"requests_per_s": 1})
+        assert bench_compare.main([f, b, "--ledger"]) == 2
+        assert (
+            "banked" in capsys.readouterr().err
+        ), "error names the side missing the ledger"
+
+    def test_cli_ledger_variant_descent(self, tmp_path):
+        doc = {
+            "bottleneck_ledger": _LED_BANKED,
+            "variants": {
+                "subs256": {"bottleneck_ledger": _LED_FRESH}
+            },
+        }
+        p = self._write(tmp_path, "load.json", doc)
+        assert bench_compare.main(
+            [p, p, "--ledger", "--variant", "subs256"]
+        ) == 0
+        assert bench_compare.main(
+            [p, p, "--ledger", "--variant", "nope"]
+        ) == 2

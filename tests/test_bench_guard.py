@@ -420,6 +420,42 @@ print("OK")
     assert "OK" in r.stdout
 
 
+def test_profiler_rows_never_initialize_jax():
+    """The ISSUE-16 rows (profiler_overhead, fanout_publish) live in
+    the banked CPU block BEFORE the device probe: the sampler is pure
+    threading/sys stdlib and the fan-out row is pure pubsub — jax must
+    never load. Tiny shapes; the real numbers land in the banked line
+    on full runs."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+row = bench.bench_profiler_overhead(reps=20_000, window_s=0.1)
+for key in ("disabled_label_ns", "armed_label_ns",
+            "sampling_overhead_pct_97hz", "samples_in_window",
+            "flood_stacks", "flood_collapsed_samples"):
+    assert key in row, key
+assert row["bounded"], row
+from tendermint_tpu.libs import profiler
+assert not profiler.is_enabled() and not profiler.labels_armed()
+assert profiler.stats()["samples_total"] == 0  # row cleans up
+row = bench.bench_fanout_publish(subs=32, publishes=200)
+assert row["subs"] == 32 and row["deliveries_per_publish"] == 32
+assert row["same_query_us"] > 0 and row["distinct_query_us"] > 0
+assert "jax" not in sys.modules, "profiler rows dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout
+
+
 def test_stateless_bulk_rows_never_initialize_jax():
     """The ISSUE-11 rows (merkle_multiproof_10k,
     light_sync_bulk_150vals) live in the banked CPU block BEFORE the

@@ -25,6 +25,7 @@ import asyncio
 import gc
 import logging
 import tempfile
+import threading
 
 import pytest
 
@@ -99,3 +100,79 @@ def test_node_stop_with_live_ws_subscriber_leaves_no_pending_tasks():
         m for m in complaints if "destroyed but it is pending" in m
     ]
     assert not destroyed, destroyed
+
+
+def _profiler_threads():
+    return [
+        t for t in threading.enumerate() if t.name == "tt-profiler"
+    ]
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_profiler_sampler_stopped_and_joined_on_node_stop():
+    """ISSUE 16 teardown contract: the profiler-owning node's stop
+    STOPS AND JOINS the sampler thread — zero surviving threads, and
+    not one further sample lands after the stop."""
+    from tendermint_tpu.libs import profiler
+    from tendermint_tpu.loadgen.localnet import start_localnet
+
+    assert _profiler_threads() == []
+    profiler.reset()
+
+    async def scenario():
+        with tempfile.TemporaryDirectory() as home:
+            net = await start_localnet(1, home, profiler=True)
+            try:
+                assert profiler.is_enabled()
+                assert len(_profiler_threads()) == 1
+                # real consensus work under the sampler
+                await net.wait_for_height(3, timeout=60.0)
+            finally:
+                await net.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), timeout=120))
+    assert not profiler.is_enabled()
+    assert _profiler_threads() == [], "sampler survived node stop"
+    n = profiler.stats()["samples_total"]
+    assert n > 0, "profiler-enabled run collected no samples"
+    import time as _time
+
+    _time.sleep(0.1)
+    assert profiler.stats()["samples_total"] == n, (
+        "samples accrued after the sampler was stopped"
+    )
+    profiler.reset()
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_profiler_disabled_path_takes_zero_samples(monkeypatch):
+    """Counting-stub mirror of the trace/timeline disabled-path tests:
+    with `instrumentation.profiler=false` (the default) a REAL
+    consensus run must reach _take_sample zero times — the kill switch
+    is one module-attribute read, not a cheap sample."""
+    from tendermint_tpu.libs import profiler
+    from tendermint_tpu.loadgen.localnet import start_localnet
+
+    calls = {"n": 0}
+
+    def counting_stub():
+        calls["n"] += 1
+
+    monkeypatch.setattr(profiler, "_take_sample", counting_stub)
+    profiler.reset()
+
+    async def scenario():
+        with tempfile.TemporaryDirectory() as home:
+            net = await start_localnet(1, home)  # profiler off
+            try:
+                assert not profiler.is_enabled()
+                await net.wait_for_height(3, timeout=60.0)
+            finally:
+                await net.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), timeout=120))
+    assert calls["n"] == 0, (
+        f"disabled profiler sampled {calls['n']} times"
+    )
+    assert profiler.stats()["samples_total"] == 0
+    assert _profiler_threads() == []
