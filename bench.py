@@ -1483,6 +1483,8 @@ def bench_load_smoke(
     warmup_s: float = 1.0,
     mode: str = "open",
     profile: bool = False,
+    mix=None,
+    max_inflight: int = 64,
 ):
     """ISSUE 12: the production-load row — a seeded open-loop mixed
     workload (broadcast_tx flood + RPC reads + held websocket
@@ -1499,6 +1501,9 @@ def bench_load_smoke(
 
     from tendermint_tpu.loadgen import Scenario, run_localnet_scenario
 
+    kwargs = {}
+    if mix is not None:
+        kwargs["mix"] = tuple(mix)
     scn = Scenario(
         seed=seed,
         mode=mode,
@@ -1507,8 +1512,9 @@ def bench_load_smoke(
         rate=rate,
         ramp_s=min(1.0, duration_s / 4),
         subscribers=subscribers,
-        max_inflight=64,
+        max_inflight=max_inflight,
         timeout_s=10.0,
+        **kwargs,
     )
     with tempfile.TemporaryDirectory(prefix="tt-bench-load-") as home:
         report = asyncio.run(
@@ -2081,6 +2087,167 @@ def _probe_device_subprocess(timeout_s: float) -> bool:
         return False
 
 
+def load_smoke_row():
+    """The banked load_smoke stage row: interleaved A/B main scenario
+    plus the subs256, high-rate ingest, and subs1k variant rows;
+    persists BENCH_LOAD.json. Module-level so a perf PR can re-bank
+    the load trajectory without running the whole bench."""
+    # interleaved A/B (ISSUE 16): the same seeded scenario with the
+    # sampler off, then on at the default 97 Hz. The banked report
+    # is the PROFILED run — it carries the bottleneck ledger — and
+    # the A/B delta is the served-throughput cost of carrying it
+    # (acceptance bar: ≤5%).
+    base_row, _base_report = bench_load_smoke()
+    row, report = bench_load_smoke(profile=True)
+    base_rps = base_row["requests_per_s"]
+    prof_rps = row["requests_per_s"]
+    ab = {
+        "baseline_requests_per_s": base_rps,
+        "profiled_requests_per_s": prof_rps,
+        "served_delta_pct": (
+            round((base_rps - prof_rps) / base_rps * 100.0, 2)
+            if base_rps
+            else None
+        ),
+        "baseline_sustained_txs_per_s": base_row[
+            "sustained_txs_per_s"
+        ],
+        "profiled_sustained_txs_per_s": row["sustained_txs_per_s"],
+    }
+    report["profiler_ab"] = ab
+    row["profiler_ab"] = ab
+
+    # subscriber-scale variant (ISSUE 16 satellite): same workload
+    # at subscribers=256 — the fan-out regime the grouped publish
+    # fix targets. Banked as a variant row next to the main one.
+    subs_row, subs_report = bench_load_smoke(
+        duration_s=6.0, rate=150.0, subscribers=256, profile=True
+    )
+    subs = subs_report["subscribers"]
+    sat = subs_report["saturation"]
+    subs_summary = {
+        "subscribers_requested": subs["requested"],
+        "subscribers_connected": subs["connected"],
+        "subscribers_held": subs["held"],
+        "subscribers_shed": subs["connected"] - subs["held"],
+        "events_received": subs["events_received"],
+        "eventbus_fanout_lag_max": sat.get(
+            "eventbus_fanout_lag_max"
+        ),
+        "eventbus_deliveries_total_delta": sat.get(
+            "eventbus_deliveries_total_delta"
+        ),
+        "requests_per_s": subs_row["requests_per_s"],
+        "sustained_txs_per_s": subs_row["sustained_txs_per_s"],
+    }
+    # ISSUE 17 tentpole: the 10× trajectory. A write-heavy
+    # high-rate ingest row — the regime the sharded admission,
+    # FIFO-index gossip cursors, and pipelined serving paths were
+    # built for. Interleaved A/B like the main row so the banked
+    # variant carries its own bottleneck ledger and the
+    # sampler-off run keeps the throughput claim honest.
+    hr_kw = dict(
+        duration_s=8.0,
+        rate=1200.0,
+        max_inflight=256,
+        mix=(
+            ("broadcast_tx_sync", 8.0),
+            ("broadcast_tx_async", 1.0),
+            ("abci_query", 0.5),
+            ("status", 0.5),
+        ),
+    )
+    hr_base_row, _hr_base_report = bench_load_smoke(**hr_kw)
+    hr_row, hr_report = bench_load_smoke(profile=True, **hr_kw)
+    hr_base_rps = hr_base_row["requests_per_s"]
+    hr_prof_rps = hr_row["requests_per_s"]
+    hr_ab = {
+        "baseline_requests_per_s": hr_base_rps,
+        "profiled_requests_per_s": hr_prof_rps,
+        "served_delta_pct": (
+            round(
+                (hr_base_rps - hr_prof_rps) / hr_base_rps * 100.0, 2
+            )
+            if hr_base_rps
+            else None
+        ),
+        "baseline_sustained_txs_per_s": hr_base_row[
+            "sustained_txs_per_s"
+        ],
+        "profiled_sustained_txs_per_s": hr_row[
+            "sustained_txs_per_s"
+        ],
+    }
+    hr_report["profiler_ab"] = hr_ab
+    hr_sat = hr_report["saturation"]
+    hr_summary = {
+        "offered_rate_per_s": 1200.0,
+        "requests_per_s": hr_base_row["requests_per_s"],
+        "sustained_txs_per_s": hr_base_row["sustained_txs_per_s"],
+        "committed_txs_per_s": hr_base_row["committed_txs_per_s"],
+        "errors_total": hr_base_row["errors_total"],
+        "broadcast_p99_ms": hr_base_row["routes_p99_ms"].get(
+            "broadcast_tx_sync"
+        ),
+        "mempool_size_max": hr_sat.get("mempool_size_max"),
+        "mempool_evicted_total_delta": hr_sat.get(
+            "mempool_evicted_total_delta"
+        ),
+        "profiler_ab": hr_ab,
+    }
+
+    # ISSUE 17 satellite: the 1000+ subscriber regime. Banked
+    # headline is subscriber retention (shed MUST stay 0) and
+    # broadcast p99 while every one of the 1024 connections holds
+    # — the corked-writer/grouped-publish scale proof.
+    s1k_row, s1k_report = bench_load_smoke(
+        duration_s=6.0,
+        rate=150.0,
+        subscribers=1024,
+        max_inflight=128,
+        profile=True,
+    )
+    s1k_subs = s1k_report["subscribers"]
+    s1k_sat = s1k_report["saturation"]
+    s1k_summary = {
+        "subscribers_requested": s1k_subs["requested"],
+        "subscribers_connected": s1k_subs["connected"],
+        "subscribers_held": s1k_subs["held"],
+        "subscribers_shed": s1k_subs["connected"]
+        - s1k_subs["held"],
+        "events_received": s1k_subs["events_received"],
+        "broadcast_p99_ms": s1k_row["routes_p99_ms"].get(
+            "broadcast_tx_sync"
+        ),
+        "broadcast_p99_slo_ms": 750.0,
+        "eventbus_fanout_lag_max": s1k_sat.get(
+            "eventbus_fanout_lag_max"
+        ),
+        "requests_per_s": s1k_row["requests_per_s"],
+        "sustained_txs_per_s": s1k_row["sustained_txs_per_s"],
+    }
+
+    report["variants"] = {
+        "subs256": subs_report,
+        "highrate": hr_report,
+        "subs1k": s1k_report,
+    }
+    row["subs256"] = subs_summary
+    row["highrate"] = hr_summary
+    row["subs1k"] = s1k_summary
+    _persist_load(report)
+    return row
+
+
+def chaos_smoke_row():
+    """The banked chaos_smoke stage row; persists BENCH_CHAOS.json.
+    Module-level for the same targeted re-bank reason as
+    load_smoke_row."""
+    row, report = bench_chaos_smoke()
+    _persist_chaos(report)
+    return row
+
+
 def main() -> None:
     import os
 
@@ -2285,60 +2452,6 @@ def main() -> None:
         "mempool_checktx_per_s",
     )
 
-    def _load_smoke_row():
-        # interleaved A/B (ISSUE 16): the same seeded scenario with the
-        # sampler off, then on at the default 97 Hz. The banked report
-        # is the PROFILED run — it carries the bottleneck ledger — and
-        # the A/B delta is the served-throughput cost of carrying it
-        # (acceptance bar: ≤5%).
-        base_row, _base_report = bench_load_smoke()
-        row, report = bench_load_smoke(profile=True)
-        base_rps = base_row["requests_per_s"]
-        prof_rps = row["requests_per_s"]
-        ab = {
-            "baseline_requests_per_s": base_rps,
-            "profiled_requests_per_s": prof_rps,
-            "served_delta_pct": (
-                round((base_rps - prof_rps) / base_rps * 100.0, 2)
-                if base_rps
-                else None
-            ),
-            "baseline_sustained_txs_per_s": base_row[
-                "sustained_txs_per_s"
-            ],
-            "profiled_sustained_txs_per_s": row["sustained_txs_per_s"],
-        }
-        report["profiler_ab"] = ab
-        row["profiler_ab"] = ab
-
-        # subscriber-scale variant (ISSUE 16 satellite): same workload
-        # at subscribers=256 — the fan-out regime the grouped publish
-        # fix targets. Banked as a variant row next to the main one.
-        subs_row, subs_report = bench_load_smoke(
-            duration_s=6.0, rate=150.0, subscribers=256, profile=True
-        )
-        subs = subs_report["subscribers"]
-        sat = subs_report["saturation"]
-        subs_summary = {
-            "subscribers_requested": subs["requested"],
-            "subscribers_connected": subs["connected"],
-            "subscribers_held": subs["held"],
-            "subscribers_shed": subs["connected"] - subs["held"],
-            "events_received": subs["events_received"],
-            "eventbus_fanout_lag_max": sat.get(
-                "eventbus_fanout_lag_max"
-            ),
-            "eventbus_deliveries_total_delta": sat.get(
-                "eventbus_deliveries_total_delta"
-            ),
-            "requests_per_s": subs_row["requests_per_s"],
-            "sustained_txs_per_s": subs_row["sustained_txs_per_s"],
-        }
-        report["variants"] = {"subs256": subs_report}
-        row["subs256"] = subs_summary
-        _persist_load(report)
-        return row
-
     cpu_stage(
         "profiler_overhead",
         bench_profiler_overhead,
@@ -2353,19 +2466,14 @@ def main() -> None:
     )
     cpu_stage(
         "load_smoke",
-        _load_smoke_row,
+        load_smoke_row,
         "load_smoke",
         600.0,
     )
 
-    def _chaos_smoke_row():
-        row, report = bench_chaos_smoke()
-        _persist_chaos(report)
-        return row
-
     cpu_stage(
         "chaos_smoke",
-        _chaos_smoke_row,
+        chaos_smoke_row,
         "chaos_smoke",
         600.0,
     )

@@ -24,6 +24,15 @@ trajectories rot. A row whose VALUE is null on either side (a
 measurement that legitimately had no value that run, e.g. a recovery
 phase that never happened) is reported as info and never fails.
 
+`--gate` is the strict CI form of the default mode (ISSUE 17
+satellite): failing rows go to stderr followed by one `GATE
+PASS`/`GATE FAIL` verdict line, and — the difference that matters — an
+EMPTY gateable-row set fails. The default mode's "no failures → exit
+0" is the wrong contract for automation: a typo'd `--rows` filter or
+a malformed fresh document compares nothing and sails through; under
+`--gate` a run that held zero rows to the threshold is itself a
+failure.
+
 `--ledger` switches to the bottleneck-ledger diff (ISSUE 16): instead
 of numeric rows it compares the two documents' `bottleneck_ledger`
 blocks — per-subsystem wall-sample share deltas in percentage points,
@@ -300,6 +309,15 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="emit the report as JSON"
     )
     ap.add_argument(
+        "--gate",
+        action="store_true",
+        help="strict CI mode: print failing rows plus one GATE "
+        "PASS/FAIL verdict line, and fail when ZERO rows were "
+        "gateable (a gate that compared nothing must not pass — "
+        "a typo'd --rows filter or an empty banked file would "
+        "otherwise green-light anything)",
+    )
+    ap.add_argument(
         "--ledger",
         action="store_true",
         help="diff the documents' bottleneck_ledger blocks instead of "
@@ -360,6 +378,49 @@ def main(argv=None) -> int:
     report, failures = compare(
         fresh, banked, threshold=args.threshold, rows=args.rows
     )
+    if args.gate:
+        # gateable = rows the threshold can actually act on: a known
+        # direction and both values present, or a vanished measurement
+        gateable = [
+            r
+            for r in report
+            if r[5] in ("ok", "regressed", "improved", "missing")
+        ]
+        for k, old, new, delta, _d, status in failures:
+            pct = (
+                "vanished"
+                if status == "missing"
+                else (
+                    "inf"
+                    if delta == float("inf")
+                    else f"{delta * 100:+.1f}%"
+                )
+            )
+            print(
+                f"{status:>9}  {k}: {_fmt_val(old)} -> "
+                f"{_fmt_val(new)}  ({pct})",
+                file=sys.stderr,
+            )
+        if not gateable:
+            print(
+                f"GATE FAIL: 0 gateable rows (of {len(report)} "
+                f"compared) — nothing to hold the line on",
+                file=sys.stderr,
+            )
+            return 1
+        if failures:
+            print(
+                f"GATE FAIL: {len(failures)} of {len(gateable)} "
+                f"gateable rows regressed past "
+                f"{args.threshold * 100:.0f}% (or went missing)",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"GATE PASS: {len(gateable)} gateable rows within "
+            f"{args.threshold * 100:.0f}% of the banked trajectory"
+        )
+        return 0
     if args.json:
         print(
             json.dumps(

@@ -47,6 +47,16 @@ class ABCIClient(Service):
     async def check_tx(self, req: T.RequestCheckTx) -> T.ResponseCheckTx:
         raise NotImplementedError
 
+    async def check_tx_batch(
+        self, reqs: "list[T.RequestCheckTx]"
+    ) -> "list[T.ResponseCheckTx]":
+        """Validate a batch with one client round. Default: sequential
+        awaits (any transport works); LocalClient folds the batch into
+        one lock hold, SocketClient pipelines all frames before awaiting
+        — the FPGA-verifier shape (batch, pipeline) applied to the
+        admission path."""
+        return [await self.check_tx(r) for r in reqs]
+
     async def init_chain(self, req: T.RequestInitChain) -> T.ResponseInitChain:
         raise NotImplementedError
 
@@ -106,6 +116,16 @@ class _RequestForwardingClient(ABCIClient):
     async def check_tx(self, req):
         return await self._request(req)
 
+    async def check_tx_batch(self, reqs):
+        return await self._request_batch(reqs)
+
+    async def _request_batch(self, reqs):
+        """Pipelined fallback: issue every request before awaiting any
+        response. FIFO transports (socket) override to cork the writes."""
+        return list(
+            await asyncio.gather(*(self._request(r) for r in reqs))
+        )
+
     async def init_chain(self, req):
         return await self._request(req)
 
@@ -161,6 +181,13 @@ class LocalClient(ABCIClient):
 
     async def check_tx(self, req):
         return await self._call(self.app.check_tx, req)
+
+    async def check_tx_batch(self, reqs):
+        # one lock acquisition for the whole batch: under high ingest
+        # the per-call acquire/release (and the event-loop hop each one
+        # implies) dominates the synchronous app work itself
+        async with self._lock:
+            return [self.app.check_tx(r) for r in reqs]
 
     async def init_chain(self, req):
         return await self._call(self.app.init_chain, req)
@@ -266,6 +293,31 @@ class SocketClient(_RequestForwardingClient):
             self._writer.write(encode_varint(len(body)) + body)
             await self._writer.drain()
         return await fut
+
+    async def _request_batch(self, reqs):
+        """Cork the batch: all frames written (and their futures
+        enqueued) under one _write_lock hold, one drain — the server
+        sees a contiguous pipeline instead of lock-interleaved singles
+        (reference: socket_client.go queues requests the same way)."""
+        if not reqs:
+            return []
+        if self._writer is None:
+            raise ABCIClientError("socket client not started")
+        loop = asyncio.get_running_loop()
+        futs: list[asyncio.Future] = []
+        async with self._write_lock:
+            if self._err is not None:
+                raise ABCIClientError(str(self._err))
+            buf = bytearray()
+            for req in reqs:
+                fut = loop.create_future()
+                await self._pending.put(fut)
+                futs.append(fut)
+                body = encode_request(req)
+                buf += encode_varint(len(body)) + body
+            self._writer.write(bytes(buf))
+            await self._writer.drain()
+        return list(await asyncio.gather(*futs))
 
 
 async def _open(address: str):

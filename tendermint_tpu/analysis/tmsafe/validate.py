@@ -73,6 +73,11 @@ MUTATION_SINKS: Dict[FuncKey, str] = {
     ("mempool/mempool.py", "TxMempool.check_tx"): (
         "admits a transaction into the mempool"
     ),
+    ("mempool/mempool.py", "TxMempool.check_tx_batch"): (
+        "admits a whole batch of transactions into the mempool (the "
+        "sharded-admission fast path the gossip receive loop and the "
+        "RPC coalescing batcher resolve to)"
+    ),
     ("mempool/nop.py", "NopMempool.check_tx"): (
         "mempool admission (nop backend)"
     ),

@@ -139,6 +139,16 @@ class MempoolConfig:
     max_tx_bytes: int = 1 << 20
     ttl_duration: float = 0.0  # seconds; 0 = no TTL
     ttl_num_blocks: int = 0
+    # Admission shards: CheckTx takes only its tx-key-hashed shard's
+    # lock, so concurrent admissions on different shards overlap their
+    # app round-trips instead of convoying behind one pool-wide lock.
+    # Consensus's lock() is an epoch barrier across every shard, so the
+    # Commit+Update exclusion is unchanged. 1 = the pre-shard layout.
+    shards: int = 8
+    # Max txs bundled into one gossip envelope / one batched admission
+    # call (broadcast_tx ingestion and post-commit recheck reuse it as
+    # the ABCI pipelining grain).
+    tx_batch_size: int = 64
 
 
 @dataclass
@@ -173,6 +183,11 @@ class ConsensusConfig:
     create_empty_blocks: bool = True
     create_empty_blocks_interval: float = 0.0
     peer_gossip_sleep_duration: float = 0.1
+    # Block-part gossip window: how many missing parts one data-gossip
+    # iteration may burst to a peer before sleeping. Sends beyond the
+    # first use try_send, so a slow peer's full send queue sheds the
+    # rest of the window (backpressure) instead of stalling the routine.
+    peer_gossip_part_window: int = 16
     peer_query_maj23_sleep_duration: float = 2.0
     double_sign_check_height: int = 0
 

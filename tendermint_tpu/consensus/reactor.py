@@ -418,7 +418,13 @@ class ConsensusReactor(Service):
                             )
                         )
 
-            # 2) same height/round with matching part-set headers: parts
+            # 2) same height/round with matching part-set headers: a
+            # WINDOW of missing parts per iteration — one part per
+            # sleep tick made part delivery the block-latency floor
+            # for multi-part blocks (total_parts × sleep). try_send
+            # keeps the existing slow-peer shedding as backpressure:
+            # a full send queue truncates the window instead of
+            # stalling the routine.
             if (
                 not sent
                 and rs.proposal_block_parts is not None
@@ -428,30 +434,37 @@ class ConsensusReactor(Service):
                 and prs.proposal_block_parts_header
                 == rs.proposal_block_parts.header()
             ):
-                part = self._pick_part_to_send(
-                    rs.proposal_block_parts, prs.proposal_block_parts
-                )
-                if part is not None:
-                    sent = self.data_ch.try_send(
+                for _ in range(max(1, self.cfg.peer_gossip_part_window)):
+                    part = self._pick_part_to_send(
+                        rs.proposal_block_parts, prs.proposal_block_parts
+                    )
+                    if part is None:
+                        break
+                    if not self.data_ch.try_send(
                         Envelope(
                             message=BlockPartMessage(
                                 height=rs.height, round=rs.round, part=part
                             ),
                             to=ps.peer_id,
                         )
+                    ):
+                        break  # peer's send queue full: shed the rest
+                    ps.set_has_proposal_block_part(
+                        rs.height, rs.round, part.index
                     )
-                    if sent:
-                        ps.set_has_proposal_block_part(
-                            rs.height, rs.round, part.index
-                        )
+                    sent = True
 
-            # 3) peer is behind: parts of its next committed block
+            # 3) peer is behind: a window of parts of its next
+            # committed block (same shedding backpressure)
             if (
                 not sent
                 and 0 < prs.height < rs.height
                 and prs.height >= self.cs.block_store.base()
             ):
-                sent = self._gossip_catchup_part(ps)
+                for _ in range(max(1, self.cfg.peer_gossip_part_window)):
+                    if not self._gossip_catchup_part(ps):
+                        break
+                    sent = True
 
             if not sent:
                 await asyncio.sleep(sleep)

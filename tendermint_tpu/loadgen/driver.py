@@ -178,6 +178,11 @@ class _Workload:
             return await pool.call("block")  # latest
         if op == "light_blocks":
             return await pool.call("light_blocks", max_blocks=10)
+        if op == "tx_proofs":
+            # latest block, empty index list: exercises the held
+            # merkle-tree build + cache (the stateless serving cost)
+            # without depending on how many txs the block carries
+            return await pool.call("tx_proofs", indices=[])
         if op == "status":
             return await pool.call("status")
         raise ValueError(f"unknown op {op!r}")

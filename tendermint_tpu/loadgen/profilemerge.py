@@ -51,6 +51,8 @@ _SUBSYSTEM_SIGNALS: Dict[str, tuple] = {
         "mempool_failed_txs_total_delta",
         "mempool_checktx_seconds_p99_max",
         "mempool_lock_wait_seconds_p99_max",
+        "mempool_recheck_seconds_p99_max",
+        "mempool_evicted_total_delta",
     ),
     "eventbus": (
         "eventbus_fanout_lag_max",

@@ -17,13 +17,15 @@ from typing import Dict, Tuple
 __all__ = ["OPS", "Scenario"]
 
 # the route vocabulary the driver knows how to exercise: the write
-# flood, the three read shapes, and the cheap liveness probe
+# flood, the read shapes (including the two stateless-client serving
+# routes, light_blocks + tx_proofs), and the cheap liveness probe
 OPS = (
     "broadcast_tx_sync",
     "broadcast_tx_async",
     "abci_query",
     "block",
     "light_blocks",
+    "tx_proofs",
     "status",
 )
 

@@ -70,6 +70,10 @@ _MAX_GAUGES = (
 _P99_SKETCHES = (
     "mempool_checktx_seconds",
     "mempool_lock_wait_seconds",
+    # the other half of the consensus hold: per-block recheck of the
+    # surviving pool under the epoch barrier — a climbing p99 here
+    # with flat checktx means commit latency is pool-depth-bound
+    "mempool_recheck_seconds",
 )
 
 # counters reported as whole-run deltas (first vs last sample)
@@ -79,6 +83,9 @@ _DELTA_COUNTERS = (
     "eventbus_dropped_subscriptions_total",
     "rpc_ws_slow_clients_dropped_total",
     "mempool_failed_txs_total",
+    # silent exits that eat offered load before it reaches a proposal
+    # (labeled reason=expired|full children fold)
+    "mempool_evicted_total",
     # the chaos plane's lifecycle signals (labeled children fold)
     "p2p_peer_disconnects_total",
     "p2p_send_queue_dropped_total",

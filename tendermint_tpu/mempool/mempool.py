@@ -1,17 +1,30 @@
-"""TxMempool — the priority mempool.
+"""TxMempool — the priority mempool, sharded for concurrent admission.
 
 reference: internal/mempool/mempool.go (:28-56 design comment, CheckTx
 :202, priority eviction :264-312, Update :380, recheck :471, TTL purge
 :524). Transactions are validated through the ABCI mempool connection,
 held with their priority/sender, reaped for proposals in priority order,
 and gossiped in FIFO (arrival) order.
+
+Admission is partitioned into N tx-key-hashed shards, each with its own
+lock, seen-cache, and insertion-ordered tx map. CheckTx takes only its
+shard's lock, so concurrent admissions overlap their ABCI round-trips
+instead of convoying behind one pool-wide lock; consensus's lock() is an
+epoch barrier that acquires every shard lock (ascending order, the same
+order batch admission uses — no cycles), preserving the pre-shard
+Commit+Update exclusion exactly. Reap, recheck, expiry, and eviction
+operate on the global (-priority, seq) / seq orders, which are
+shard-independent because `seq` is globally monotonic — semantics are
+byte-identical to the unsharded pool (pinned by the oracle property
+tests in tests/test_mempool_sharded.py).
 """
 
 from __future__ import annotations
 
 import asyncio
 import time
-from typing import Dict, List, Optional, Sequence
+from bisect import bisect_right
+from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from ..abci import types as abci
 from ..abci.client import ABCIClient
@@ -33,6 +46,59 @@ __all__ = ["TxMempool"]
 # reference: internal/state/tx_filter.go pre-check is installed by the node;
 # here the byte cap is enforced directly from config.
 
+# Batch prevalidator plugin seam (the crypto.BatchVerifier shape applied
+# to admission): takes the batch's raw txs, returns one truthy/falsy
+# verdict per tx. CPU-bound implementations (e.g. stateless signature
+# checks over ops.ed25519_kernel.batch_verify_host) run in the default
+# executor so the event loop never blocks on them.
+Prevalidator = Callable[[Sequence[bytes]], Sequence[object]]
+
+
+class _Shard:
+    """One admission partition: lock + seen-cache + insertion-ordered txs."""
+
+    __slots__ = ("lock", "txs", "cache")
+
+    def __init__(self, cache) -> None:
+        self.lock = asyncio.Lock()
+        # tmlive: bounded=cfg.size txs across all shards (is_full gates
+        # every insert); cache bounded by its own LRU capacity
+        self.txs: Dict[bytes, WrappedTx] = {}
+        self.cache = cache
+
+
+class _ShardedCache:
+    """Seen-cache facade over the per-shard LRU caches.
+
+    A tx always hashes to the same shard, so membership/dedup semantics
+    match one global cache; only capacity-eviction order is per-shard.
+    """
+
+    def __init__(self, pool: "TxMempool") -> None:
+        self._pool = pool
+
+    def _cache_for(self, key: bytes):
+        return self._pool._shard_for_key(key).cache
+
+    def reset(self) -> None:
+        for s in self._pool._shards:
+            s.cache.reset()
+
+    def push(self, tx: bytes) -> bool:
+        return self._cache_for(tx_key(tx)).push(tx)
+
+    def remove(self, tx: bytes) -> None:
+        self._cache_for(tx_key(tx)).remove(tx)
+
+    def remove_by_key(self, key: bytes) -> None:
+        self._cache_for(key).remove_by_key(key)
+
+    def has(self, tx: bytes) -> bool:
+        return self._cache_for(tx_key(tx)).has(tx)
+
+    def __len__(self) -> int:
+        return sum(len(s.cache) for s in self._pool._shards)
+
 
 class TxMempool(Mempool):
     def __init__(
@@ -41,55 +107,107 @@ class TxMempool(Mempool):
         cfg: Optional[MempoolConfig] = None,
         height: int = 0,
         metrics: Optional[MempoolMetrics] = None,
+        prevalidator: Optional[Prevalidator] = None,
     ) -> None:
         self.cfg = cfg or MempoolConfig()
         self.logger = get_logger("mempool")
         self._app = app_conn
         self._height = height
-        self._txs: Dict[bytes, WrappedTx] = {}  # key → wtx, insertion order
-        self._senders: Dict[str, bytes] = {}  # sender → tx key
+        n = max(1, int(getattr(self.cfg, "shards", 1)))
+        if self.cfg.cache_size > 0:
+            # split capacity across shards so the pool-wide budget is
+            # unchanged; per-shard LRU order is the only divergence
+            per = -(-self.cfg.cache_size // n)  # ceil
+            caches = [LRUTxCache(per) for _ in range(n)]
+        else:
+            caches = [NopTxCache() for _ in range(n)]
+        # tmlive: bounded=cfg.shards partitions, fixed at construction
+        self._shards: List[_Shard] = [_Shard(c) for c in caches]
+        self._senders: Dict[str, bytes] = {}  # sender → tx key (global)
+        # FIFO index: the gossip cursor walk and the recheck iteration
+        # need "live txs in seq order from a cursor" without an O(pool)
+        # shard sweep per call (at a 5k-deep pool that sweep was the
+        # top mempool bucket in the load profile). `_fifo_live` is
+        # seq → wtx in insertion order (seq is assigned and inserted
+        # synchronously, so insertion order IS seq order);
+        # `_fifo_seqs` is the same seqs as a sorted list for bisect,
+        # with lazy deletion — compacted when dead entries outnumber
+        # live (tmlive: bounded=2×pool+64 by that compaction)
+        self._fifo_live: Dict[int, WrappedTx] = {}
+        self._fifo_seqs: List[int] = []
+        # live count per distinct priority: lets a full-pool insert
+        # answer "is there anything lower-priority to evict?" in
+        # O(#distinct priorities) instead of materializing every
+        # shard's txs just to reject (the uniform-priority flood case)
+        self._prio_counts: Dict[int, int] = {}
         self._bytes = 0
-        self.cache = (
-            LRUTxCache(self.cfg.cache_size)
-            if self.cfg.cache_size > 0
-            else NopTxCache()
-        )
-        self._lock = asyncio.Lock()  # held by consensus across Commit+Update
+        self._count = 0
+        self.cache = _ShardedCache(self)
         self._tx_available = asyncio.Event()
         self.metrics = metrics if metrics is not None else MempoolMetrics()
+        self._prevalidator = prevalidator
+
+    # -- shard routing --
+
+    def _shard_for_key(self, key: bytes) -> _Shard:
+        return self._shards[
+            int.from_bytes(key[:8], "big") % len(self._shards)
+        ]
+
+    @property
+    def _txs(self) -> Dict[bytes, WrappedTx]:
+        """Merged read-only view of every shard in global arrival (seq)
+        order — the unsharded pool's insertion order. Introspection and
+        tests only; hot paths use the FIFO index directly."""
+        return {w.key: w for w in self._fifo_live.values()}
+
+    def _all_wtxs(self) -> List[WrappedTx]:
+        # seq-ascending by construction (see _fifo_live comment)
+        return list(self._fifo_live.values())
 
     # -- sizes --
 
     def size(self) -> int:
-        return len(self._txs)
+        return self._count
 
     def size_bytes(self) -> int:
         return self._bytes
 
     def is_full(self, tx_size: int) -> bool:
         return (
-            len(self._txs) >= self.cfg.size
+            self._count >= self.cfg.size
             or self._bytes + tx_size > self.cfg.max_txs_bytes
         )
 
     # -- lifecycle with consensus --
 
     async def lock(self) -> None:
-        await self._lock.acquire()
+        """Epoch barrier: held by consensus across Commit+Update.
+        Acquires every shard lock in ascending order (the same order
+        check_tx_batch uses), excluding all admission exactly as the
+        single pre-shard lock did."""
+        for s in self._shards:
+            await s.lock.acquire()
 
     def unlock(self) -> None:
-        self._lock.release()
+        for s in reversed(self._shards):
+            s.lock.release()
 
     async def flush_app_conn(self) -> None:
         await self._app.flush()
 
     def flush(self) -> None:
         """Drop everything (RPC unsafe_flush_mempool)."""
-        self._txs.clear()
+        for s in self._shards:
+            s.txs.clear()
+            s.cache.reset()
         self._senders.clear()
+        self._fifo_live.clear()
+        self._fifo_seqs.clear()
+        self._prio_counts.clear()
         self._bytes = 0
+        self._count = 0
         self.metrics.size.set(0)
-        self.cache.reset()
 
     # -- ingestion --
 
@@ -98,12 +216,18 @@ class TxMempool(Mempool):
     ) -> abci.ResponseCheckTx:
         """Validate tx via the app and admit it to the pool
         (reference: internal/mempool/mempool.go:202-263). Takes the
-        mempool lock, so ingestion is excluded while consensus holds it
-        across Commit+Update — a tx can never be validated against
-        pre-commit app state and inserted post-commit."""
+        tx's shard lock, so ingestion is excluded while consensus holds
+        the epoch barrier across Commit+Update — a tx can never be
+        validated against pre-commit app state and inserted post-commit."""
         t0 = time.perf_counter()
+        if len(tx) > self.cfg.max_tx_bytes:
+            raise MempoolError(
+                f"tx too large: {len(tx)} > {self.cfg.max_tx_bytes}"
+            )
+        key = tx_key(tx)
+        shard = self._shard_for_key(key)
         try:
-            async with self._lock:
+            async with shard.lock:
                 # the contention share on its own: checktx_seconds
                 # keeps folding the wait in (the total IS the ingest
                 # latency), this split says how much of it was waiting
@@ -111,7 +235,19 @@ class TxMempool(Mempool):
                 self.metrics.lock_wait_seconds.observe(
                     time.perf_counter() - t0
                 )
-                return await self._check_tx_locked(tx, tx_info)
+                res = self._precheck(shard, tx, key, tx_info)
+                if isinstance(res, MempoolError):
+                    raise res
+                if self._prevalidator is not None:
+                    verdicts = await asyncio.get_running_loop(
+                    ).run_in_executor(None, self._prevalidator, [tx])
+                    if not verdicts[0]:
+                        return self._prevalidate_reject(tx)
+                resp = await self._app.check_tx(abci.RequestCheckTx(tx=tx))
+                out = self._admit(shard, tx, key, tx_info, resp)
+                if isinstance(out, MempoolError):
+                    raise out
+                return out
         finally:
             # lock wait included on purpose: under load the wait for
             # consensus to release the pool IS the ingest latency
@@ -119,40 +255,141 @@ class TxMempool(Mempool):
                 time.perf_counter() - t0
             )
 
-    async def _check_tx_locked(
-        self, tx: bytes, tx_info: Optional[TxInfo]
-    ) -> abci.ResponseCheckTx:
-        tx_info = tx_info or TxInfo()
-        if len(tx) > self.cfg.max_tx_bytes:
-            raise MempoolError(
-                f"tx too large: {len(tx)} > {self.cfg.max_tx_bytes}"
+    async def check_tx_batch(
+        self, txs: Sequence[bytes], tx_info: Optional[TxInfo] = None
+    ) -> List[Union[abci.ResponseCheckTx, MempoolError]]:
+        """Admit a batch with one pipelined ABCI round: per-tx outcomes
+        (a ResponseCheckTx, or the MempoolError check_tx would have
+        raised) in input order. The involved shard locks are held in
+        ascending order across the app call — the same exclusion
+        serial check_tx gets from its single shard lock, amortized, and
+        deadlock-free against the consensus barrier which acquires in
+        the same order. The high-rate ingest paths (gossip receive, RPC
+        broadcast batching) land here so the app lock and event-loop
+        hops are paid once per batch instead of once per tx.
+        """
+        if not txs:
+            return []
+        t0 = time.perf_counter()
+        keys = [tx_key(tx) for tx in txs]
+        shard_ids = sorted(
+            {
+                int.from_bytes(k[:8], "big") % len(self._shards)
+                for k in keys
+            }
+        )
+        for sid in shard_ids:
+            await self._shards[sid].lock.acquire()
+        try:
+            self.metrics.lock_wait_seconds.observe(
+                time.perf_counter() - t0
             )
-        key = tx_key(tx)
-        if not self.cache.push(tx):
+            out: List[Union[abci.ResponseCheckTx, MempoolError]] = [
+                None
+            ] * len(txs)
+            pending: List[int] = []  # indices awaiting the app verdict
+            for i, (tx, key) in enumerate(zip(txs, keys)):
+                if len(tx) > self.cfg.max_tx_bytes:
+                    out[i] = MempoolError(
+                        f"tx too large: {len(tx)} > "
+                        f"{self.cfg.max_tx_bytes}"
+                    )
+                    continue
+                shard = self._shard_for_key(key)
+                res = self._precheck(shard, tx, key, tx_info)
+                if isinstance(res, MempoolError):
+                    out[i] = res
+                else:
+                    pending.append(i)
+            if pending and self._prevalidator is not None:
+                # CPU-bound batch validation off-loop (BatchVerifier
+                # plugin boundary): the loop keeps serving while the
+                # executor grinds signatures
+                verdicts = await asyncio.get_running_loop(
+                ).run_in_executor(
+                    None, self._prevalidator, [txs[i] for i in pending]
+                )
+                kept = []
+                for i, ok in zip(pending, verdicts):
+                    if ok:
+                        kept.append(i)
+                    else:
+                        out[i] = self._prevalidate_reject(txs[i])
+                pending = kept
+            if pending:
+                resps = await self._app.check_tx_batch(
+                    [abci.RequestCheckTx(tx=txs[i]) for i in pending]
+                )
+                for i, resp in zip(pending, resps):
+                    out[i] = self._admit(
+                        self._shard_for_key(keys[i]),
+                        txs[i],
+                        keys[i],
+                        tx_info,
+                        resp,
+                    )
+            return out
+        finally:
+            for sid in reversed(shard_ids):
+                self._shards[sid].lock.release()
+            dur = time.perf_counter() - t0
+            for _ in txs:
+                self.metrics.checktx_seconds.observe(dur)
+
+    def _precheck(
+        self,
+        shard: _Shard,
+        tx: bytes,
+        key: bytes,
+        tx_info: Optional[TxInfo],
+    ) -> Optional[MempoolError]:
+        """Synchronous pre-app admission checks (dup/cache). Returns the
+        error check_tx would raise, or None to proceed to the app."""
+        tx_info = tx_info or TxInfo()
+        if not shard.cache.push(tx):
             # seen before: note the gossiping peer for the existing entry
-            wtx = self._txs.get(key)
+            wtx = shard.txs.get(key)
             if wtx is not None and tx_info.sender_id:
                 wtx.peers.add(tx_info.sender_id)
-            raise MempoolError("tx already exists in cache")
-        if key in self._txs:
+            return MempoolError("tx already exists in cache")
+        if key in shard.txs:
             # pool-resident but cache-evicted (shared LRU churn): don't
             # re-insert — that would double-count bytes and reset the
             # gossip seq (reference: mempool.go txStore.GetTxByHash guard)
-            wtx = self._txs[key]
+            wtx = shard.txs[key]
             if tx_info.sender_id:
                 wtx.peers.add(tx_info.sender_id)
-            raise MempoolError("tx already exists in the mempool")
+            return MempoolError("tx already exists in the mempool")
+        return None
 
-        res = await self._app.check_tx(abci.RequestCheckTx(tx=tx))
+    def _prevalidate_reject(self, tx: bytes) -> abci.ResponseCheckTx:
+        self.metrics.failed_txs.inc()
+        if not self.cfg.keep_invalid_txs_in_cache:
+            self.cache.remove(tx)
+        return abci.ResponseCheckTx(
+            code=1, log="rejected by batch prevalidator"
+        )
+
+    def _admit(
+        self,
+        shard: _Shard,
+        tx: bytes,
+        key: bytes,
+        tx_info: Optional[TxInfo],
+        res: abci.ResponseCheckTx,
+    ) -> Union[abci.ResponseCheckTx, MempoolError]:
+        """Post-app admission: sender dedup + insert. Synchronous, so it
+        is atomic with the app verdict from the event loop's view."""
+        tx_info = tx_info or TxInfo()
         if not res.is_ok:
             self.metrics.failed_txs.inc()
             if not self.cfg.keep_invalid_txs_in_cache:
-                self.cache.remove(tx)
+                shard.cache.remove(tx)
             return res
 
         if res.sender and res.sender in self._senders:
-            self.cache.remove(tx)
-            raise MempoolError(
+            shard.cache.remove(tx)
+            return MempoolError(
                 f"rejected tx with sender {res.sender!r}: already present"
             )
 
@@ -166,23 +403,35 @@ class TxMempool(Mempool):
         )
         if tx_info.sender_id:
             wtx.peers.add(tx_info.sender_id)
-        if not self._try_insert(wtx):
-            self.cache.remove(tx)
-            raise TxMempoolFullError(len(self._txs), self._bytes)
+        if not self._try_insert(shard, wtx):
+            shard.cache.remove(tx)
+            return TxMempoolFullError(self._count, self._bytes)
         return res
 
-    def _try_insert(self, wtx: WrappedTx) -> bool:
+    def _try_insert(self, shard: _Shard, wtx: WrappedTx) -> bool:
         """Insert, evicting strictly-lower-priority txs when full
-        (reference: internal/mempool/mempool.go:264-312)."""
+        (reference: internal/mempool/mempool.go:264-312). Victim choice
+        spans every shard on the global (priority, -seq) order — the
+        same candidates and order the unsharded pool picks."""
         if self.is_full(wtx.size()):
+            # fast reject before the O(pool) victim scan: under a
+            # uniform-priority flood every insert into a full pool
+            # lands here, and the scan-to-find-nothing was the
+            # profiler's top mempool stack at high offered rates
+            if not any(p < wtx.priority for p in self._prio_counts):
+                return False
             victims = sorted(
-                (w for w in self._txs.values() if w.priority < wtx.priority),
+                (
+                    w
+                    for w in self._all_wtxs()
+                    if w.priority < wtx.priority
+                ),
                 key=lambda w: (w.priority, -w.seq),
             )
             freed = 0
             chosen = []
             need_bytes = self._bytes + wtx.size() - self.cfg.max_txs_bytes
-            need_count = len(self._txs) + 1 - self.cfg.size
+            need_count = self._count + 1 - self.cfg.size
             for v in victims:
                 chosen.append(v)
                 freed += v.size()
@@ -195,42 +444,67 @@ class TxMempool(Mempool):
                     "evicting lower-priority tx", key=v.key.hex()[:16]
                 )
                 self._remove(v.key, remove_from_cache=True)
-        self._txs[wtx.key] = wtx
+                self.metrics.evicted_txs.inc(reason="full")
+        shard.txs[wtx.key] = wtx
         if wtx.sender:
             self._senders[wtx.sender] = wtx.key
+        self._fifo_live[wtx.seq] = wtx
+        self._fifo_seqs.append(wtx.seq)  # seq monotonic: stays sorted
+        self._prio_counts[wtx.priority] = (
+            self._prio_counts.get(wtx.priority, 0) + 1
+        )
         self._bytes += wtx.size()
-        self.metrics.size.set(len(self._txs))
+        self._count += 1
+        self.metrics.size.set(self._count)
         self._tx_available.set()
         return True
 
     def _remove(self, key: bytes, remove_from_cache: bool = False) -> None:
-        wtx = self._txs.pop(key, None)
+        shard = self._shard_for_key(key)
+        wtx = shard.txs.pop(key, None)
         if wtx is None:
             return
         if wtx.sender:
             self._senders.pop(wtx.sender, None)
+        self._fifo_live.pop(wtx.seq, None)
+        n = self._prio_counts.get(wtx.priority, 0) - 1
+        if n > 0:
+            self._prio_counts[wtx.priority] = n
+        else:
+            self._prio_counts.pop(wtx.priority, None)
+        # lazy deletion in the bisect list: compact once dead entries
+        # outnumber live ones (amortized O(1) per removal)
+        if len(self._fifo_seqs) - len(self._fifo_live) > max(
+            64, len(self._fifo_live)
+        ):
+            self._fifo_seqs = [
+                s for s in self._fifo_seqs if s in self._fifo_live
+            ]
         self._bytes -= wtx.size()
-        self.metrics.size.set(len(self._txs))
+        self._count -= 1
+        self.metrics.size.set(self._count)
         if remove_from_cache:
-            self.cache.remove_by_key(key)
+            shard.cache.remove_by_key(key)
 
     def remove_tx_by_key(self, key: bytes) -> None:
         self._remove(key, remove_from_cache=True)
 
     def get_tx(self, key: bytes) -> Optional[bytes]:
-        wtx = self._txs.get(key)
+        wtx = self._shard_for_key(key).txs.get(key)
         return wtx.tx if wtx else None
 
     # -- reaping (proposal construction) --
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
         """Priority-descending reap under byte/gas budgets
-        (reference: internal/mempool/mempool.go:328-366)."""
+        (reference: internal/mempool/mempool.go:328-366). The
+        (-priority, seq) sort key is a total order (seq unique), so the
+        result is shard-layout-independent."""
         out: List[bytes] = []
         total_bytes = 0
         total_gas = 0
         for wtx in sorted(
-            self._txs.values(), key=lambda w: (-w.priority, w.seq)
+            self._all_wtxs(), key=lambda w: (-w.priority, w.seq)
         ):
             sz = wtx.size()
             if max_bytes > -1 and total_bytes + sz > max_bytes:
@@ -243,8 +517,10 @@ class TxMempool(Mempool):
         return out
 
     def reap_max_txs(self, max_txs: int) -> List[bytes]:
-        n = len(self._txs) if max_txs < 0 else min(max_txs, len(self._txs))
-        ordered = sorted(self._txs.values(), key=lambda w: (-w.priority, w.seq))
+        n = self._count if max_txs < 0 else min(max_txs, self._count)
+        ordered = sorted(
+            self._all_wtxs(), key=lambda w: (-w.priority, w.seq)
+        )
         return [w.tx for w in ordered[:n]]
 
     # -- post-commit update --
@@ -255,7 +531,7 @@ class TxMempool(Mempool):
         block_txs: Sequence[bytes],
         deliver_tx_responses: Sequence[abci.ResponseDeliverTx],
     ) -> None:
-        """Called by BlockExecutor.Commit with the mempool lock held
+        """Called by BlockExecutor.Commit with the epoch barrier held
         (reference: internal/mempool/mempool.go:380-445)."""
         self._height = block_height
         for tx, res in zip(block_txs, deliver_tx_responses):
@@ -267,55 +543,113 @@ class TxMempool(Mempool):
 
         self._purge_expired(block_height)
 
-        if self._txs:
+        if self._count:
             if self.cfg.recheck:
-                await self._recheck()
-        if self._txs:
+                t0 = time.perf_counter()
+                try:
+                    await self._recheck()
+                finally:
+                    self.metrics.recheck_seconds.observe(
+                        time.perf_counter() - t0
+                    )
+        if self._count:
             self._tx_available.set()
 
     async def _recheck(self) -> None:
         """Re-validate all pool txs against post-commit app state
-        (reference: internal/mempool/mempool.go:471-523)."""
-        for key in list(self._txs.keys()):
-            wtx = self._txs.get(key)
-            if wtx is None:
-                continue
-            res = await self._app.check_tx(
-                abci.RequestCheckTx(tx=wtx.tx, type=abci.CheckTxType.RECHECK)
+        (reference: internal/mempool/mempool.go:471-523). Runs in
+        arrival (seq) order — the unsharded pool's iteration order —
+        pipelined through check_tx_batch in tx_batch_size chunks so the
+        per-call client overhead is paid once per chunk; the app sees
+        the identical request sequence."""
+        wtxs = self._all_wtxs()  # already seq-ascending (FIFO index)
+        chunk = max(1, int(getattr(self.cfg, "tx_batch_size", 64)))
+        for lo in range(0, len(wtxs), chunk):
+            batch = wtxs[lo : lo + chunk]
+            resps = await self._app.check_tx_batch(
+                [
+                    abci.RequestCheckTx(
+                        tx=w.tx, type=abci.CheckTxType.RECHECK
+                    )
+                    for w in batch
+                ]
             )
-            if not res.is_ok:
-                self._remove(
-                    key,
-                    remove_from_cache=not self.cfg.keep_invalid_txs_in_cache,
-                )
-            else:
-                wtx.priority = res.priority
-                wtx.gas_wanted = res.gas_wanted
+            for wtx, res in zip(batch, resps):
+                if not res.is_ok:
+                    self._remove(
+                        wtx.key,
+                        remove_from_cache=(
+                            not self.cfg.keep_invalid_txs_in_cache
+                        ),
+                    )
+                else:
+                    if res.priority != wtx.priority:
+                        # keep the per-priority live counts exact: the
+                        # full-pool fast reject consults them
+                        n = self._prio_counts.get(wtx.priority, 0) - 1
+                        if n > 0:
+                            self._prio_counts[wtx.priority] = n
+                        else:
+                            self._prio_counts.pop(wtx.priority, None)
+                        self._prio_counts[res.priority] = (
+                            self._prio_counts.get(res.priority, 0) + 1
+                        )
+                    wtx.priority = res.priority
+                    wtx.gas_wanted = res.gas_wanted
 
     def _purge_expired(self, block_height: int) -> None:
         """TTL eviction (reference: internal/mempool/mempool.go:524-570)."""
         if not self.cfg.ttl_duration and not self.cfg.ttl_num_blocks:
             return
         now = time.monotonic()
-        for key in list(self._txs.keys()):
-            wtx = self._txs[key]
-            if (
-                self.cfg.ttl_duration
-                and now - wtx.timestamp > self.cfg.ttl_duration
-            ) or (
-                self.cfg.ttl_num_blocks
-                and block_height - wtx.height > self.cfg.ttl_num_blocks
-            ):
-                self._remove(key, remove_from_cache=True)
+        for shard in self._shards:
+            for key in list(shard.txs.keys()):
+                wtx = shard.txs[key]
+                if (
+                    self.cfg.ttl_duration
+                    and now - wtx.timestamp > self.cfg.ttl_duration
+                ) or (
+                    self.cfg.ttl_num_blocks
+                    and block_height - wtx.height > self.cfg.ttl_num_blocks
+                ):
+                    self._remove(key, remove_from_cache=True)
+                    self.metrics.evicted_txs.inc(reason="expired")
 
     # -- gossip support --
 
     def next_gossip_tx(self, after_seq: int) -> Optional[WrappedTx]:
-        """First tx with seq > after_seq in FIFO order, or None."""
-        for wtx in self._txs.values():  # insertion-ordered
-            if wtx.seq > after_seq:
+        """First tx with seq > after_seq in FIFO order, or None —
+        O(log pool) via the bisectable FIFO index (a per-peer cursor
+        deep in a big pool would otherwise rescan the whole head on
+        every wakeup)."""
+        i = bisect_right(self._fifo_seqs, after_seq)
+        while i < len(self._fifo_seqs):
+            wtx = self._fifo_live.get(self._fifo_seqs[i])
+            if wtx is not None:
                 return wtx
+            i += 1  # lazily-deleted entry
         return None
+
+    def next_gossip_txs(
+        self, after_seq: int, max_txs: int, max_bytes: int
+    ) -> List[WrappedTx]:
+        """Up to max_txs FIFO-successors of after_seq within a byte
+        budget — one gossip envelope's worth (the windowed analog of
+        next_gossip_tx; reference reactor batches txs the same way).
+        O(log pool + window), same index as next_gossip_tx."""
+        out: List[WrappedTx] = []
+        total = 0
+        i = bisect_right(self._fifo_seqs, after_seq)
+        while i < len(self._fifo_seqs) and len(out) < max_txs:
+            wtx = self._fifo_live.get(self._fifo_seqs[i])
+            i += 1
+            if wtx is None:
+                continue  # lazily-deleted entry
+            total += len(wtx.tx)
+            if out and total > max_bytes:
+                break
+            out.append(wtx)
+        return out
 
     async def wait_for_tx(self, after_seq: int) -> WrappedTx:
         """Block until a tx with seq > after_seq exists (gossip cursor,

@@ -46,3 +46,23 @@ class MempoolMetrics:
             "Time CheckTx spent waiting to acquire the mempool lock "
             "(the contention share of checktx_seconds).",
         )
+        # the other half of the consensus hold: update() re-CheckTx's
+        # every surviving pool tx under the lock, and that serial cost
+        # scales with pool depth — without this sketch a slow commit
+        # wasn't attributable to recheck vs app.commit (ISSUE 17
+        # satellite; pairs with checktx_seconds/lock_wait_seconds)
+        self.recheck_seconds = r.sketch(
+            "mempool",
+            "recheck_seconds",
+            "Post-commit recheck duration per block (all pool txs "
+            "re-validated under the consensus-held lock).",
+        )
+        # why txs leave without committing: TTL expiry vs full-pool
+        # priority eviction — the two exits that silently eat offered
+        # load before it ever reaches a proposal
+        self.evicted_txs = r.counter(
+            "mempool",
+            "evicted_total",
+            "Transactions evicted from the pool, by reason.",
+            label_names=("reason",),
+        )

@@ -19,7 +19,7 @@ from ..p2p.channel import Channel
 from ..p2p.peermanager import PeerStatus
 from ..p2p.types import ChannelDescriptor, Envelope
 from .mempool import TxMempool
-from .types import MempoolError, TxInfo
+from .types import TxInfo
 
 __all__ = ["MempoolReactor", "TxsMessage", "MEMPOOL_CHANNEL", "mempool_channel_descriptor"]
 
@@ -95,27 +95,34 @@ class MempoolReactor(Service):
         async for envelope in self.channel:
             msg = envelope.message
             info = TxInfo(sender_id=envelope.from_peer)
-            for tx in msg.txs:
-                try:
-                    # tmsafe: safe-unvalidated-use-ok — a tx is opaque
-                    # app bytes with no validate_basic of its own;
-                    # CheckTx IS the validation (size caps enforced by
-                    # the channel descriptor's max_tx_bytes upstream)
-                    await self.mempool.check_tx(tx, info)
-                except MempoolError:
-                    pass  # dup/full/invalid: normal gossip noise
+            # tmsafe: safe-unvalidated-use-ok — a tx is opaque app
+            # bytes with no validate_basic of its own; CheckTx IS the
+            # validation (size caps enforced by the channel
+            # descriptor's max_tx_bytes upstream). One pipelined batch
+            # per envelope: dup/full/invalid outcomes come back as
+            # values (normal gossip noise, dropped).
+            await self.mempool.check_tx_batch(list(msg.txs), info)
 
     async def _broadcast_to_peer(self, peer_id: str) -> None:
-        """Walk the FIFO cursor; skip txs the peer already knows
-        (reference: reactor.go:150-230 broadcastTxRoutine)."""
+        """Walk the FIFO cursor, bundling a window of txs per envelope;
+        skip txs the peer already knows (reference: reactor.go:150-230
+        broadcastTxRoutine, which batches the same way)."""
         cursor = -1
+        batch = max(1, int(getattr(self.mempool.cfg, "tx_batch_size", 1)))
+        max_bytes = self.mempool.cfg.max_tx_bytes
         while True:
-            wtx = await self.mempool.wait_for_tx(cursor)
-            cursor = wtx.seq
-            if peer_id in wtx.peers:
-                continue  # peer sent it to us
+            await self.mempool.wait_for_tx(cursor)
+            window = self.mempool.next_gossip_txs(cursor, batch, max_bytes)
+            if not window:
+                continue
+            cursor = window[-1].seq
+            txs = tuple(
+                w.tx for w in window if peer_id not in w.peers
+            )
+            if not txs:
+                continue  # peer sent all of them to us
             # blocking send: backpressure instead of silently skipping the
-            # tx for this peer forever (reference blocks on SendEnvelope)
+            # txs for this peer forever (reference blocks on SendEnvelope)
             await self.channel.send(
-                Envelope(message=TxsMessage(txs=(wtx.tx,)), to=peer_id)
+                Envelope(message=TxsMessage(txs=txs), to=peer_id)
             )

@@ -44,6 +44,12 @@ INTERNAL_ERROR = -32603
 
 _WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
+# Per-connection pipelining depth: how many requests may be in flight
+# at once before the read loop stalls (backpressure). Bounds the
+# memory a single pipelining client can pin server-side.
+HTTP_PIPELINE_DEPTH = 64
+WS_PIPELINE_DEPTH = 64
+
 
 class RPCError(Exception):
     """Carries a JSON-RPC error code + message to the client."""
@@ -106,10 +112,16 @@ class WSConn:
         self._metrics = metrics  # RPCMetrics or None
 
     async def send_json(self, obj: Any) -> None:
+        await self.send_text(json.dumps(obj))
+
+    async def send_text(self, text: str) -> None:
+        """Enqueue one already-serialized text frame — the fan-out path
+        (rpc.core._pump_events) serializes once per event group and
+        hands every subscriber the shared string."""
         if self.closed.is_set():
             return
         try:
-            self._sendq.put_nowait(("text", json.dumps(obj)))
+            self._sendq.put_nowait(("text", text))
         except asyncio.QueueFull:
             # slow client: drop the connection rather than buffer
             # unboundedly (reference pubsub terminates slow subscribers)
@@ -150,17 +162,31 @@ class WSConn:
                     get.cancel()
                     get = None
                     break
-                kind, payload = get.result()
+                items = [get.result()]
                 get = None
-                if kind == "text":
-                    frame = _encode_frame(0x1, payload.encode())
-                elif kind == "pong":
-                    frame = _encode_frame(0xA, payload)
-                else:  # close
-                    frame = _encode_frame(0x8, payload)
-                self.writer.write(frame)
+                # cork: drain everything already queued into ONE write
+                # + drain per wakeup — under fan-out load the queue
+                # holds a burst per published event, and per-frame
+                # write/drain round-trips dominated the writer
+                while True:
+                    try:
+                        items.append(self._sendq.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                buf = bytearray()
+                closing = False
+                for kind, payload in items:
+                    if kind == "text":
+                        buf += _encode_frame(0x1, payload.encode())
+                    elif kind == "pong":
+                        buf += _encode_frame(0xA, payload)
+                    else:  # close
+                        buf += _encode_frame(0x8, payload)
+                        closing = True
+                        break
+                self.writer.write(bytes(buf))
                 await self.writer.drain()
-                if kind == "close":
+                if closing:
                     break
         except (ConnectionError, asyncio.CancelledError):
             pass
@@ -288,51 +314,119 @@ class JSONRPCServer:
                 pass
 
     async def _serve_http(self, reader, writer) -> None:
-        while True:
-            req_line = await reader.readline()
-            if not req_line:
-                return
-            try:
-                method, target, _version = (
-                    req_line.decode("latin-1").strip().split(" ", 2)
-                )
-            except ValueError:
-                return
-            headers: Dict[str, str] = {}
+        """HTTP/1.1 loop, pipelined: each request is dispatched as its
+        own task the moment it is parsed, and a per-connection writer
+        queue preserves HTTP/1.1 response order — so one slow handler
+        (broadcast_tx_commit waiting a block) no longer head-of-line-
+        blocks the requests a pipelining client queued behind it.
+        Inflight per connection is bounded by the queue capacity: when
+        it fills, the read loop stalls (backpressure) instead of
+        buffering unboundedly."""
+        resp_q: asyncio.Queue = asyncio.Queue(maxsize=HTTP_PIPELINE_DEPTH)
+        wtask = profiler.label_task(
+            asyncio.ensure_future(self._http_writer_loop(writer, resp_q)),
+            "rpc:http-writer",
+        )
+        pending: set = set()
+        try:
             while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b"\n", b""):
+                req_line = await reader.readline()
+                if not req_line:
                     break
-                k, _, v = line.decode("latin-1").partition(":")
-                headers[k.strip().lower()] = v.strip()
+                try:
+                    method, target, _version = (
+                        req_line.decode("latin-1").strip().split(" ", 2)
+                    )
+                except ValueError:
+                    break
+                headers: Dict[str, str] = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode("latin-1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
 
-            if headers.get("upgrade", "").lower() == "websocket":
-                await self._serve_websocket(reader, writer, headers)
-                return
+                if headers.get("upgrade", "").lower() == "websocket":
+                    # flush the pipeline, retire the writer, then hand
+                    # the raw stream over to the websocket server
+                    await resp_q.join()
+                    resp_q.put_nowait(None)
+                    await wtask
+                    await self._serve_websocket(reader, writer, headers)
+                    return
 
-            body = b""
-            n = int(headers.get("content-length", "0") or "0")
-            if n > self.max_body_bytes:
-                await self._http_reply(writer, 413, b"body too large")
-                return
-            if n:
-                body = await reader.readexactly(n)
+                body = b""
+                n = int(headers.get("content-length", "0") or "0")
+                if n > self.max_body_bytes:
+                    await resp_q.put((413, b"body too large", "text/plain"))
+                    break
+                if n:
+                    body = await reader.readexactly(n)
 
-            if method == "POST":
-                resp = await self._handle_post_body(body)
-            elif method == "GET":
-                resp = await self._handle_uri(target)
-            else:
-                await self._http_reply(writer, 405, b"method not allowed")
-                return
-            # default=str: a handler returning an exotic object must not
-            # kill the connection mid-response
-            payload = json.dumps(resp, default=str).encode()
-            await self._http_reply(
-                writer, 200, payload, ctype="application/json"
-            )
-            if headers.get("connection", "").lower() == "close":
-                return
+                if method == "POST":
+                    task = asyncio.ensure_future(
+                        self._handle_post_body(body)
+                    )
+                elif method == "GET":
+                    task = asyncio.ensure_future(self._handle_uri(target))
+                else:
+                    await resp_q.put(
+                        (405, b"method not allowed", "text/plain")
+                    )
+                    break
+                profiler.label_task(task, "rpc:http-dispatch")
+                pending.add(task)
+                task.add_done_callback(pending.discard)
+                await resp_q.put(task)  # bounded inflight
+                if headers.get("connection", "").lower() == "close":
+                    break
+            # client finished (EOF / close / protocol error): drain the
+            # responses already admitted, then retire the writer
+            await resp_q.join()
+            resp_q.put_nowait(None)
+            await wtask
+        finally:
+            wtask.cancel()
+            for t in list(pending):
+                t.cancel()
+
+    async def _http_writer_loop(self, writer, q: asyncio.Queue) -> None:
+        """FIFO response writer for one pipelined HTTP connection.
+        Consumes (status, body, ctype) tuples or in-flight dispatch
+        tasks in request order; a None sentinel retires it. Never stops
+        consuming on a broken transport — it keeps draining (discarding)
+        so the read loop's bounded put/join can't deadlock."""
+        broken = False
+        while True:
+            item = await q.get()
+            try:
+                if item is None:
+                    return
+                if broken:
+                    continue
+                try:
+                    if isinstance(item, tuple):
+                        status, body, ctype = item
+                    else:
+                        resp = await item
+                        status = 200
+                        # default=str: a handler returning an exotic
+                        # object must not kill the connection
+                        body = json.dumps(resp, default=str).encode()
+                        ctype = "application/json"
+                    await self._http_reply(writer, status, body, ctype=ctype)
+                except asyncio.CancelledError:
+                    raise
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    broken = True
+                except Exception as e:  # pragma: no cover - defensive
+                    self.logger.error(
+                        "rpc http response error", err=repr(e)
+                    )
+                    broken = True
+            finally:
+                q.task_done()
 
     async def _http_reply(
         self, writer, status: int, body: bytes, ctype: str = "text/plain"
@@ -500,6 +594,8 @@ class JSONRPCServer:
             asyncio.ensure_future(ws._writer_loop()), "rpc:ws-writer"
         )
         msg = bytearray()
+        sem = asyncio.Semaphore(WS_PIPELINE_DEPTH)
+        inflight: set = set()
         try:
             while True:
                 opcode, payload = await _read_frame(reader)
@@ -528,8 +624,20 @@ class JSONRPCServer:
                         msg.clear()
                         continue
                     msg.clear()
-                    resp = await self._dispatch_obj(obj, ws=ws)
-                    await ws.send_json(resp)
+                    # dispatch off the read loop: a slow handler
+                    # (broadcast_tx_commit waits a whole block) must
+                    # not head-of-line-block the frames behind it;
+                    # clients match responses by id. The semaphore
+                    # bounds per-connection inflight (backpressure).
+                    await sem.acquire()
+                    task = profiler.label_task(
+                        asyncio.ensure_future(
+                            self._ws_dispatch(obj, ws, sem)
+                        ),
+                        "rpc:ws-dispatch",
+                    )
+                    inflight.add(task)
+                    task.add_done_callback(inflight.discard)
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -538,3 +646,12 @@ class JSONRPCServer:
             if self.metrics is not None:
                 self.metrics.ws_connections.add(-1)
             wtask.cancel()
+            for task in list(inflight):
+                task.cancel()
+
+    async def _ws_dispatch(self, obj: Any, ws: WSConn, sem) -> None:
+        try:
+            resp = await self._dispatch_obj(obj, ws=ws)
+            await ws.send_json(resp)
+        finally:
+            sem.release()

@@ -206,6 +206,98 @@ class TestCLI:
         assert compared >= 3  # the repo banks several trajectories
 
 
+class TestGate:
+    """--gate (ISSUE 17 satellite): the strict CI contract — failing
+    rows + one verdict line, and an empty gateable-row set FAILS."""
+
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_gate_passes_clean_with_verdict(self, tmp_path, capsys):
+        f = self._write(tmp_path, "fresh.json", fresh())
+        b = self._write(tmp_path, "banked.json", BANKED)
+        assert bench_compare.main([f, b, "--gate"]) == 0
+        out = capsys.readouterr().out
+        assert out.startswith("GATE PASS:")
+        assert "within 10%" in out
+
+    def test_gate_fails_on_regression_with_rows_on_stderr(
+        self, tmp_path, capsys
+    ):
+        f = self._write(
+            tmp_path,
+            "fresh.json",
+            fresh(verify_commit_10k_per_s=500.0, warm_verify_ms=2.0),
+        )
+        b = self._write(tmp_path, "banked.json", BANKED)
+        assert bench_compare.main([f, b, "--gate"]) == 1
+        captured = capsys.readouterr()
+        assert "GATE FAIL: 2 of" in captured.err
+        assert "verify_commit_10k_per_s" in captured.err
+        assert "warm_verify_ms" in captured.err
+        assert "GATE PASS" not in captured.out
+
+    def test_gate_fails_on_missing_row(self, tmp_path, capsys):
+        doc = fresh()
+        del doc["warm_verify_ms"]
+        f = self._write(tmp_path, "fresh.json", doc)
+        b = self._write(tmp_path, "banked.json", BANKED)
+        assert bench_compare.main([f, b, "--gate"]) == 1
+        assert "vanished" in capsys.readouterr().err
+
+    def test_gate_fails_on_zero_gateable_rows(self, tmp_path, capsys):
+        """The contract the default mode lacks: a filter that matched
+        nothing, or a banked doc with only direction-unknown rows,
+        must FAIL the gate rather than vacuously pass it."""
+        f = self._write(tmp_path, "fresh.json", fresh())
+        b = self._write(tmp_path, "banked.json", BANKED)
+        # fnmatch filter that matches no row at all
+        assert (
+            bench_compare.main(
+                [f, b, "--gate", "--rows", "no_such_row_*"]
+            )
+            == 1
+        )
+        assert "0 gateable rows" in capsys.readouterr().err
+        # ...while the DEFAULT mode exits 0 on the same inputs (the
+        # vacuous pass --gate exists to close off)
+        assert (
+            bench_compare.main([f, b, "--rows", "no_such_row_*"]) == 0
+        )
+        capsys.readouterr()
+        # direction-unknown-only documents: nothing gateable either
+        f2 = self._write(tmp_path, "f2.json", {"num_cpu_devices": 8})
+        b2 = self._write(tmp_path, "b2.json", {"num_cpu_devices": 4})
+        assert bench_compare.main([f2, b2, "--gate"]) == 1
+        assert "0 gateable rows" in capsys.readouterr().err
+
+    def test_gate_respects_threshold(self, tmp_path, capsys):
+        f = self._write(
+            tmp_path,
+            "fresh.json",
+            fresh(verify_commit_10k_per_s=920.0),  # -8%
+        )
+        b = self._write(tmp_path, "banked.json", BANKED)
+        assert bench_compare.main([f, b, "--gate"]) == 0
+        capsys.readouterr()
+        assert (
+            bench_compare.main([f, b, "--gate", "--threshold", "0.05"])
+            == 1
+        )
+
+    def test_gate_self_compare_banked_load_artifact(self, capsys):
+        """The real BENCH_LOAD.json gates clean against itself — the
+        strict mode accepts the repo's actual artifact shape."""
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        path = os.path.join(root, "BENCH_LOAD.json")
+        assert bench_compare.main([path, path, "--gate"]) == 0
+        assert capsys.readouterr().out.startswith("GATE PASS:")
+
+
 def _ledger(entries, attributed=0.95, idle=0.5, serving=0.2,
             consensus=0.25, samples=400):
     return {

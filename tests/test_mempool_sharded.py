@@ -1,0 +1,465 @@
+"""Sharded-mempool oracle property tests (ISSUE 17 tentpole pin).
+
+The sharded TxMempool (cfg.shards > 1) must be byte-identical to the
+unsharded pool in every externally observable order: reap, gossip FIFO,
+recheck app-call sequence, eviction victims, expiry, sender dedup. The
+oracle is the same TxMempool with shards=1 (the pre-shard layout), fed
+the identical op sequence; states are compared by tx BYTES, never by
+WrappedTx.seq — the seq counter is process-global, so the two pools
+draw interleaved values, but the relative order within each pool (the
+only thing semantics depend on) is the same.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from tendermint_tpu.abci import KVStoreApplication, LocalClient
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.libs.metrics import Registry
+from tendermint_tpu.mempool import MempoolError, TxInfo, TxMempool, tx_key
+from tendermint_tpu.mempool.metrics import MempoolMetrics
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class OracleApp(KVStoreApplication):
+    """Deterministic CheckTx verdicts driven by the tx bytes:
+
+      ``s<sender>|p<prio>:payload`` — ok, with that sender/priority
+      ``p<prio>:payload``           — ok, no sender
+      ``bad...``                    — code=1 rejection
+      any tx containing ``@drop``   — rejected on RECHECK only
+
+    Also records every CheckTx tx in arrival order (``calls``) so the
+    sharded pool's app-call sequence can be pinned against the oracle's.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def check_tx(self, req):
+        tx = req.tx
+        self.calls.append((tx, req.type))
+        if tx.startswith(b"bad"):
+            return abci.ResponseCheckTx(code=1, log="rejected")
+        if req.type == abci.CheckTxType.RECHECK and b"@drop" in tx:
+            return abci.ResponseCheckTx(code=1, log="recheck-rejected")
+        sender, body = "", tx
+        if tx.startswith(b"s") and b"|" in tx:
+            s, _, body = tx.partition(b"|")
+            sender = s[1:].decode()
+        prio = 0
+        if body.startswith(b"p") and b":" in body:
+            try:
+                prio = int(body[1 : body.index(b":")])
+            except ValueError:
+                pass
+        return abci.ResponseCheckTx(
+            gas_wanted=1, priority=prio, sender=sender
+        )
+
+
+def make_pool(shards, cfg=None, app=None):
+    cfg = cfg or MempoolConfig()
+    cfg.shards = shards
+    app = app or OracleApp()
+    pool = TxMempool(
+        LocalClient(app),
+        cfg,
+        metrics=MempoolMetrics(Registry()),
+    )
+    return pool, app
+
+
+def fifo_walk(pool):
+    """The gossip cursor's view: every pool tx in FIFO order."""
+    out, cur = [], -1
+    while True:
+        w = pool.next_gossip_tx(cur)
+        if w is None:
+            return out
+        out.append(w.tx)
+        cur = w.seq
+
+
+def fingerprint(pool):
+    """Every externally observable order, in tx bytes."""
+    return {
+        "size": pool.size(),
+        "bytes": pool.size_bytes(),
+        "reap_all": pool.reap_max_bytes_max_gas(-1, -1),
+        "reap_gas3": pool.reap_max_bytes_max_gas(-1, 3),
+        "reap_top2": pool.reap_max_txs(2),
+        "fifo": fifo_walk(pool),
+        "senders": {s: k for s, k in pool._senders.items()},
+        "cached": sorted(
+            k for s in pool._shards for k in getattr(
+                s.cache, "_map", {}
+            )
+        ),
+    }
+
+
+def check_invariants(pool):
+    """The global accounting must equal the per-shard truth."""
+    wtxs = [w for s in pool._shards for w in s.txs.values()]
+    assert pool.size() == len(wtxs)
+    assert pool.size_bytes() == sum(w.size() for w in wtxs)
+    assert len({w.key for w in wtxs}) == len(wtxs)
+    for s in pool._shards:
+        for k, w in s.txs.items():
+            assert pool._shard_for_key(k) is s
+            assert w.key == k
+    senders = {w.sender: w.key for w in wtxs if w.sender}
+    assert pool._senders == senders
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_oracle_random_trajectory():
+    """Seeded random op soup — admissions (dups, bad txs, senders,
+    priorities), commits with recheck, TTL expiry — applied to the
+    sharded pool and the shards=1 oracle in lockstep. After every op
+    the externally observable state must match byte-for-byte."""
+
+    async def go():
+        rng = random.Random(0xC0FFEE)
+        cfg_a = MempoolConfig(size=24, max_txs_bytes=24 * 64)
+        cfg_a.ttl_num_blocks = 6
+        cfg_b = MempoolConfig(size=24, max_txs_bytes=24 * 64)
+        cfg_b.ttl_num_blocks = 6
+        sharded, app_a = make_pool(8, cfg_a)
+        oracle, app_b = make_pool(1, cfg_b)
+
+        issued = []
+        height = 0
+
+        def new_tx(i):
+            prio = rng.randrange(0, 5)
+            if rng.random() < 0.1:
+                return b"bad%d" % i
+            if rng.random() < 0.3:
+                return b"s%d|p%d:tx%d" % (rng.randrange(12), prio, i)
+            if rng.random() < 0.2:
+                return b"p%d:@drop-tx%d" % (prio, i)
+            return b"p%d:tx%d" % (prio, i)
+
+        for step in range(400):
+            op = rng.random()
+            if op < 0.75 or not issued:
+                tx = (
+                    rng.choice(issued)
+                    if issued and rng.random() < 0.15
+                    else new_tx(step)
+                )
+                issued.append(tx)
+                info = TxInfo(sender_id=rng.randrange(4))
+                outcomes = []
+                for pool in (sharded, oracle):
+                    try:
+                        res = await pool.check_tx(tx, info)
+                        outcomes.append(("ok", res.code))
+                    except MempoolError as e:
+                        outcomes.append(("err", type(e).__name__))
+                assert outcomes[0] == outcomes[1], (step, tx, outcomes)
+            else:
+                height += 1
+                committed = sharded.reap_max_txs(rng.randrange(0, 6))
+                resps = [
+                    abci.ResponseDeliverTx(
+                        code=0 if rng.random() < 0.8 else 1
+                    )
+                    for _ in committed
+                ]
+                await sharded.update(height, committed, resps)
+                await oracle.update(height, committed, resps)
+            check_invariants(sharded)
+            assert fingerprint(sharded) == fingerprint(oracle), step
+
+        assert sharded.size() > 0  # the soup actually admitted txs
+
+    run(go())
+
+
+def test_batch_matches_serial():
+    """check_tx_batch must produce, per input index, exactly the
+    outcome serial check_tx yields — including errors-as-values — and
+    leave the pool in the identical state."""
+
+    async def go():
+        batch_pool, _ = make_pool(8)
+        serial_pool, _ = make_pool(8)
+        cfg = batch_pool.cfg
+        txs = [
+            b"p5:a",
+            b"bad1",
+            b"s7|p2:b",
+            b"p5:a",  # dup of index 0
+            b"s7|p9:c",  # sender dup of index 2
+            b"x" * (cfg.max_tx_bytes + 1),
+            b"p1:d",
+        ]
+        batch_out = await batch_pool.check_tx_batch(list(txs))
+        serial_out = []
+        for tx in txs:
+            try:
+                serial_out.append(await serial_pool.check_tx(tx))
+            except MempoolError as e:
+                serial_out.append(e)
+
+        assert len(batch_out) == len(serial_out) == len(txs)
+        for i, (b, s) in enumerate(zip(batch_out, serial_out)):
+            assert type(b) is type(s), (i, b, s)
+            if isinstance(b, abci.ResponseCheckTx):
+                assert (b.code, b.priority, b.sender) == (
+                    s.code,
+                    s.priority,
+                    s.sender,
+                ), i
+        assert fingerprint(batch_pool) == fingerprint(serial_pool)
+        check_invariants(batch_pool)
+
+    run(go())
+
+
+def test_batch_empty_and_single():
+    async def go():
+        pool, _ = make_pool(8)
+        assert await pool.check_tx_batch([]) == []
+        out = await pool.check_tx_batch([b"p3:solo"])
+        assert len(out) == 1 and out[0].is_ok
+        assert pool.size() == 1
+
+    run(go())
+
+
+def test_epoch_barrier_excludes_all_admission():
+    """lock() (held by consensus across Commit+Update) must block both
+    serial and batch admission on every shard until unlock()."""
+
+    async def go():
+        pool, _ = make_pool(8)
+        await pool.lock()
+        t1 = asyncio.create_task(pool.check_tx(b"p1:a"))
+        t2 = asyncio.create_task(pool.check_tx_batch([b"p2:b", b"p3:c"]))
+        await asyncio.sleep(0.02)
+        assert not t1.done() and not t2.done()
+        assert pool.size() == 0
+        pool.unlock()
+        res1 = await asyncio.wait_for(t1, 1)
+        res2 = await asyncio.wait_for(t2, 1)
+        assert res1.is_ok and all(r.is_ok for r in res2)
+        assert pool.size() == 3
+
+    run(go())
+
+
+def test_barrier_vs_batch_no_deadlock():
+    """Barrier and batch admission acquire shard locks in the same
+    ascending order — interleaving them many times must never wedge."""
+
+    async def go():
+        pool, _ = make_pool(8)
+
+        async def churn_barrier():
+            for _ in range(50):
+                await pool.lock()
+                await asyncio.sleep(0)
+                pool.unlock()
+                await asyncio.sleep(0)
+
+        async def churn_batch(tag):
+            for i in range(50):
+                await pool.check_tx_batch(
+                    [b"p1:%s-%d-%d" % (tag, i, j) for j in range(4)]
+                )
+
+        await asyncio.wait_for(
+            asyncio.gather(
+                churn_barrier(), churn_batch(b"x"), churn_batch(b"y")
+            ),
+            10,
+        )
+        check_invariants(pool)
+        assert pool.size() == 400
+
+    run(go())
+
+
+def test_concurrent_admission_invariants():
+    """Many overlapped check_tx/check_tx_batch calls (the app verdict
+    suspends mid-admission) must keep the global accounting exact: no
+    double-admits, no lost bytes, sender dedup global."""
+
+    async def go():
+        pool, app = make_pool(8)
+        rng = random.Random(7)
+        orig = LocalClient.check_tx
+
+        async def slow_check_tx(self, req):
+            await asyncio.sleep(rng.random() * 0.002)
+            return await orig(self, req)
+
+        pool._app.check_tx = slow_check_tx.__get__(pool._app)
+        txs = [
+            b"s%d|p%d:c%d" % (i % 9, i % 5, i) for i in range(60)
+        ] + [b"p%d:n%d" % (i % 5, i) for i in range(60)]
+        rng.shuffle(txs)
+
+        async def admit(tx):
+            try:
+                return await pool.check_tx(tx)
+            except MempoolError as e:
+                return e
+
+        coros = [admit(tx) for tx in txs[:80]]
+        coros.append(pool.check_tx_batch(txs[80:]))
+        await asyncio.gather(*coros)
+        check_invariants(pool)
+        # 9 sender slots + 60 senderless candidates, minus pool caps
+        keys = {tx_key(w.tx) for s in pool._shards for w in s.txs.values()}
+        assert len(keys) == pool.size()
+        assert len({w.sender for s in pool._shards
+                    for w in s.txs.values() if w.sender}) == len(
+            pool._senders
+        )
+
+    run(go())
+
+
+def test_eviction_spans_shards_and_counts_reason():
+    """A full pool must evict the globally lowest-priority tx no matter
+    which shard holds it, and count it under reason=full."""
+
+    async def go():
+        cfg = MempoolConfig(size=4)
+        pool, _ = make_pool(8, cfg)
+        for i in range(4):
+            await pool.check_tx(b"p1:fill%d" % i)
+        resident = set(fifo_walk(pool))
+        res = await pool.check_tx(b"p9:vip")
+        assert res.is_ok and pool.size() == 4
+        now = set(fifo_walk(pool))
+        assert b"p9:vip" in now
+        assert len(resident - now) == 1  # exactly one low-prio victim
+        full = pool.metrics.evicted_txs._values.get(("full",), 0)
+        assert full == 1
+
+    run(go())
+
+
+def test_expiry_counts_reason():
+    async def go():
+        cfg = MempoolConfig()
+        cfg.ttl_num_blocks = 1
+        cfg.recheck = False
+        pool, _ = make_pool(8, cfg)
+        for i in range(5):
+            await pool.check_tx(b"p1:e%d" % i)
+        await pool.update(5, [], [])  # 5 - 0 > 1 → all expired
+        assert pool.size() == 0
+        expired = pool.metrics.evicted_txs._values.get(("expired",), 0)
+        assert expired == 5
+
+    run(go())
+
+
+def test_recheck_app_call_sequence_matches_oracle():
+    """The batched recheck must present the app the identical request
+    sequence (tx order and RECHECK type) as the unsharded pool —
+    chunking through check_tx_batch is invisible to the app."""
+
+    async def go():
+        cfg_a = MempoolConfig()
+        cfg_a.tx_batch_size = 3  # force multiple chunks
+        sharded, app_a = make_pool(8, cfg_a)
+        oracle, app_b = make_pool(1)
+        for i in range(10):
+            tx = b"p%d:r%d%s" % (
+                i % 4, i, b"@drop" if i % 3 == 0 else b""
+            )
+            await sharded.check_tx(tx)
+            await oracle.check_tx(tx)
+        app_a.calls.clear()
+        app_b.calls.clear()
+        await sharded.update(2, [], [])
+        await oracle.update(2, [], [])
+        assert app_a.calls == app_b.calls
+        assert all(
+            t == abci.CheckTxType.RECHECK for _, t in app_a.calls
+        )
+        assert fingerprint(sharded) == fingerprint(oracle)
+
+    run(go())
+
+
+def test_batch_prevalidator_runs_off_loop_and_rejects():
+    """The BatchVerifier-shaped prevalidator sees only the txs that
+    survived precheck, in input order, and its rejections surface as
+    code!=0 responses without reaching the app."""
+
+    async def go():
+        seen = []
+
+        def prevalidate(txs):
+            seen.append(list(txs))
+            return [b"deny" not in t for t in txs]
+
+        app = OracleApp()
+        cfg = MempoolConfig()
+        cfg.shards = 8
+        pool = TxMempool(
+            LocalClient(app),
+            cfg,
+            metrics=MempoolMetrics(Registry()),
+            prevalidator=prevalidate,
+        )
+        out = await pool.check_tx_batch(
+            [b"p1:ok1", b"p1:deny-a", b"p1:ok1", b"p2:ok2"]
+        )
+        assert seen == [[b"p1:ok1", b"p1:deny-a", b"p2:ok2"]]
+        assert out[0].is_ok
+        assert not out[1].is_ok  # prevalidator rejection
+        assert isinstance(out[2], MempoolError)  # in-batch dup
+        assert out[3].is_ok
+        # rejected tx never reached the app, and is re-admittable
+        assert all(b"deny" not in t for t, _ in app.calls)
+        assert not pool.cache.has(b"p1:deny-a")
+        # serial path consults the same plugin
+        with pytest.raises(MempoolError):
+            await pool.check_tx(b"p1:ok1")  # cached
+        res = await pool.check_tx(b"p3:deny-b")
+        assert not res.is_ok
+
+    run(go())
+
+
+def test_windowed_gossip_matches_cursor_walk():
+    """next_gossip_txs(cursor, n, budget) must return exactly the next
+    n FIFO successors the one-at-a-time cursor walk would visit."""
+
+    async def go():
+        pool, _ = make_pool(8)
+        for i in range(20):
+            await pool.check_tx(b"p%d:g%d" % (i % 7, i))
+        walk = fifo_walk(pool)
+        cur, windowed = -1, []
+        while True:
+            win = pool.next_gossip_txs(cur, 6, 1 << 20)
+            if not win:
+                break
+            windowed.extend(w.tx for w in win)
+            cur = win[-1].seq
+        assert windowed == walk
+        # byte budget: first tx always granted, then cut
+        win = pool.next_gossip_txs(-1, 100, 1)
+        assert len(win) == 1 and win[0].tx == walk[0]
+
+    run(go())

@@ -1,0 +1,264 @@
+"""Grouped pubsub publish under churn (ISSUE 17 satellite, pinning the
+PR-16 group fan-out).
+
+The publish path indexes subscriptions by DISTINCT query source
+(`Server._groups`) and batch-delivers one shared frozen Message per
+group. These tests pin the invariants that index must keep under
+concurrent subscribe/unsubscribe-during-publish traffic: the two
+indexes never disagree, a group dies with its last member (including
+overflow terminations discovered mid-publish), and no live subscriber
+ever loses or double-receives a message.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from tendermint_tpu.pubsub import Server, SubscriptionError
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def assert_indexes_consistent(s: Server) -> None:
+    """_subs and _groups must be two views of the same membership."""
+    grouped = {}
+    for source, (q, members) in s._groups.items():
+        assert members, f"empty group {source!r} not cleaned up"
+        assert str(q) == source
+        for key, sub in members.items():
+            assert key[1] == source
+            assert key not in grouped, f"{key} in two groups"
+            grouped[key] = sub
+    assert grouped == s._subs
+
+
+QUERIES = (
+    "tm.event = 'NewBlock'",
+    "tm.event = 'Tx'",
+    "tm.event EXISTS",
+)
+
+
+def test_group_index_consistency_under_churn():
+    """Seeded random subscribe/unsubscribe/unsubscribe_all/publish soup:
+    after every op the group index must exactly mirror _subs."""
+
+    async def go():
+        rng = random.Random(0xFEED)
+        s = Server()
+        await s.start()
+        live = {}  # (client, query) → Subscription
+        for step in range(600):
+            op = rng.random()
+            cid = f"c{rng.randrange(12)}"
+            q = rng.choice(QUERIES)
+            if op < 0.45:
+                if (cid, q) in live:
+                    with pytest.raises(SubscriptionError):
+                        s.subscribe(cid, q)
+                else:
+                    live[(cid, q)] = s.subscribe(cid, q, limit=4)
+            elif op < 0.7:
+                if (cid, q) in live:
+                    s.unsubscribe(cid, q)
+                    del live[(cid, q)]
+                else:
+                    with pytest.raises(SubscriptionError):
+                        s.unsubscribe(cid, q)
+            elif op < 0.8:
+                mine = [k for k in live if k[0] == cid]
+                if mine:
+                    s.unsubscribe_all(cid)
+                    for k in mine:
+                        del live[k]
+                else:
+                    with pytest.raises(SubscriptionError):
+                        s.unsubscribe_all(cid)
+            else:
+                # publishes overflow slow (never-drained) subscribers,
+                # exercising the mid-publish dead-group sweep
+                _, _, dropped = s.publish(
+                    step, {"tm.event": [rng.choice(["NewBlock", "Tx"])]}
+                )
+                if dropped:
+                    live = {
+                        k: v for k, v in live.items() if k in s._subs
+                    }
+            assert set(live) == set(s._subs), step
+            assert_indexes_consistent(s)
+        await s.stop()
+
+    run(go())
+
+
+def test_publish_shares_one_message_across_groups():
+    """One publish allocates ONE frozen Message, delivered by reference
+    to every matched subscriber in every matched group."""
+
+    async def go():
+        s = Server()
+        await s.start()
+        subs = [
+            s.subscribe("a", "tm.event = 'Tx'"),
+            s.subscribe("b", "tm.event = 'Tx'"),
+            s.subscribe("c", "tm.event EXISTS"),
+        ]
+        miss = s.subscribe("d", "tm.event = 'NewBlock'")
+        s.publish("payload", {"tm.event": ["Tx"]})
+        msgs = [await sub.next() for sub in subs]
+        assert msgs[0] is msgs[1] is msgs[2]
+        assert msgs[0].data == "payload"
+        assert miss._queue.qsize() == 0
+        await s.stop()
+
+    run(go())
+
+
+def test_overflow_mid_publish_drops_only_the_dead():
+    """A subscriber overflowing during the fan-out is terminated and
+    removed from both indexes on that same publish; its group survives
+    while it has other members and dies with its last one."""
+
+    async def go():
+        s = Server()
+        await s.start()
+        slow = s.subscribe("slow", "tm.event = 'Tx'", limit=1)
+        fast = s.subscribe("fast", "tm.event = 'Tx'", limit=16)
+        lone = s.subscribe("lone", "tm.event EXISTS", limit=1)
+
+        s.publish(1, {"tm.event": ["Tx"]})  # fills slow and lone
+        matched, _, dropped = s.publish(2, {"tm.event": ["Tx"]})
+        assert matched == 3 and dropped == 2  # slow + lone overflow
+
+        # survivors: only fast; the Tx group kept its live member, the
+        # EXISTS group lost its last and must be gone entirely
+        assert set(s._subs) == {("fast", str(fast.query))}
+        assert set(s._groups) == {str(fast.query)}
+        assert_indexes_consistent(s)
+
+        # fast is unaffected: both messages, in order
+        assert (await fast.next()).data == 1
+        assert (await fast.next()).data == 2
+
+        # the dead drain their buffer then error out
+        assert (await slow.next()).data == 1
+        with pytest.raises(SubscriptionError):
+            await slow.next()
+
+        # a fresh publish matches only the survivor
+        matched, _, dropped = s.publish(3, {"tm.event": ["Tx"]})
+        assert matched == 1 and dropped == 0
+        await s.stop()
+
+    run(go())
+
+
+def test_no_lost_or_duplicate_deliveries_under_concurrent_churn():
+    """A publisher streams numbered messages while transient
+    subscribers churn on the same query. Stable subscribers must see
+    the full stream exactly once in order; every transient subscriber
+    must see a contiguous, duplicate-free window of it."""
+
+    async def go():
+        s = Server()
+        await s.start()
+        n_msgs = 120
+        stable = [
+            s.subscribe(f"stable{i}", "tm.event = 'Tx'", limit=n_msgs + 8)
+            for i in range(4)
+        ]
+        windows = []
+
+        async def publisher():
+            for i in range(n_msgs):
+                s.publish(i, {"tm.event": ["Tx"]})
+                await asyncio.sleep(0)
+
+        async def churner(tag):
+            rng = random.Random(hash(tag) & 0xFFFF)
+            for r in range(12):
+                sub = s.subscribe(
+                    f"t{tag}-{r}", "tm.event = 'Tx'", limit=n_msgs + 8
+                )
+                for _ in range(rng.randrange(1, 6)):
+                    await asyncio.sleep(0)
+                s.unsubscribe(f"t{tag}-{r}", "tm.event = 'Tx'")
+                got = []
+                try:
+                    while True:
+                        got.append(sub._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    pass
+                # the terminate sentinel has no .data; drop it
+                windows.append(
+                    [m.data for m in got if hasattr(m, "data")]
+                )
+
+        await asyncio.gather(
+            publisher(), churner("a"), churner("b"), churner("c")
+        )
+        assert_indexes_consistent(s)
+
+        for sub in stable:
+            seen = []
+            while sub._queue.qsize():
+                seen.append((await sub.next()).data)
+            assert seen == list(range(n_msgs))
+
+        for w in windows:
+            assert w == sorted(set(w))  # no dups, ascending
+            if w:  # contiguous: a window, not a sieve
+                assert w == list(range(w[0], w[0] + len(w)))
+        await s.stop()
+
+    run(go())
+
+
+def test_unsubscribe_wakes_blocked_consumer():
+    """A consumer parked in next() must wake with SubscriptionError the
+    moment its subscription is unsubscribed mid-publish-stream — the
+    sentinel push, not a poll."""
+
+    async def go():
+        s = Server()
+        await s.start()
+        sub = s.subscribe("c1", "tm.event = 'Tx'")
+
+        async def consume():
+            with pytest.raises(SubscriptionError, match="unsubscribed"):
+                while True:
+                    await sub.next()
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.01)
+        assert not task.done()
+        s.publish("x", {"tm.event": ["Tx"]})
+        await asyncio.sleep(0.01)  # consumer drains the real message
+        s.unsubscribe("c1", "tm.event = 'Tx'")
+        await asyncio.wait_for(task, 1)
+        assert s.num_subscriptions() == 0
+        assert_indexes_consistent(s)
+        await s.stop()
+
+    run(go())
+
+
+def test_late_subscriber_sees_only_later_messages():
+    async def go():
+        s = Server()
+        await s.start()
+        s.subscribe("early", "tm.event = 'Tx'", limit=64)
+        s.publish(0, {"tm.event": ["Tx"]})
+        s.publish(1, {"tm.event": ["Tx"]})
+        late = s.subscribe("late", "tm.event = 'Tx'", limit=64)
+        s.publish(2, {"tm.event": ["Tx"]})
+        got = []
+        while late._queue.qsize():
+            got.append((await late.next()).data)
+        assert got == [2]
+        assert_indexes_consistent(s)
+        await s.stop()
+
+    run(go())

@@ -103,20 +103,23 @@ def test_head_suppression_catalog_is_exactly_the_opaque_tx_sites(
     head_report,
 ):
     """The head catalog of accepted-by-rationale sites is exactly the
-    three mempool-admission calls: a tx is opaque app bytes with no
-    validate_basic of its own — CheckTx IS its validation (gossip
-    receive loop + the two RPC broadcast routes). Every other
-    first-run finding got a real fix (BitArray clamp + packed elems,
-    blockchain page clamp, evidence validate-before-add ×2), not a
-    comment. A new entry here means someone added a
-    `# tmsafe: <rule>-ok` — review the rationale, then extend this pin
-    deliberately."""
+    two mempool-admission calls: a tx is opaque app bytes with no
+    validate_basic of its own — CheckTx IS its validation. The batch
+    ingest on the gossip receive loop (check_tx_batch, now a cataloged
+    sink itself) plus the single serial-admission chokepoint all three
+    RPC broadcast routes resolve to (Environment._admit_tx — the
+    coalescing-batcher refactor collapsed the two per-route
+    suppressions into one). Every other first-run finding got a real
+    fix (BitArray clamp + packed elems, blockchain page clamp,
+    evidence validate-before-add ×2), not a comment. A new entry here
+    means someone added a `# tmsafe: <rule>-ok` — review the
+    rationale, then extend this pin deliberately."""
     by_site = {(rule, path) for rule, path, _ln in head_report.suppressed}
     assert by_site == {
         ("safe-unvalidated-use", "mempool/reactor.py"),
         ("safe-unvalidated-use", "rpc/core.py"),
     }
-    assert len(head_report.suppressed) == 3
+    assert len(head_report.suppressed) == 2
 
 
 # ---------------------------------------------------------------------------
