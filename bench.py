@@ -1642,6 +1642,91 @@ def _persist_chaos(report: dict) -> None:
         pass
 
 
+def bench_byz_smoke(
+    n_nodes: int = 4,
+    seed: int = 2026,
+    rate: float = 40.0,
+    scenarios=None,
+):
+    """ISSUE 18: the byzantine-campaign row — the shipped misbehavior
+    catalog (duplicate-vote equivocation at both vote steps,
+    conflicting proposals, amnesia under round churn, vote
+    withholding, the ≥1/3 light-client fork control, and the
+    crash-between-fsync-and-broadcast double-sign guard) run against
+    fresh in-process localnets under seeded open-loop traffic, with
+    the safety verdict (byte-identical stored commit hashes), the
+    accountability verdict (every injected equivocation height yields
+    committed DuplicateVoteEvidence within the scenario SLO), and the
+    divergence-detection verdict machine-checked per scenario.
+    Jax-free by the same construction as chaos_smoke (guard:
+    tests/test_bench_guard.py). Seeded end to end: byzantine rules,
+    traffic schedule, and the forged coalition's keys all derive from
+    the campaign seed (consensus/byzantine.py contract)."""
+    import asyncio
+    import tempfile
+
+    from tendermint_tpu.loadgen import run_byz_campaign
+
+    with tempfile.TemporaryDirectory(prefix="tt-bench-byz-") as home:
+        report = asyncio.run(
+            run_byz_campaign(
+                home,
+                scenarios=scenarios,
+                n_nodes=n_nodes,
+                seed=seed,
+                rate=rate,
+            )
+        )
+    by_name = {r["name"]: r for r in report["scenarios"]}
+    row = {
+        "scenarios": len(report["scenarios"]),
+        "all_passed": report["all_passed"],
+        "safety_ok": all(
+            r["safety_ok"] for r in report["scenarios"]
+        ),
+        "evidence_committed_total": sum(
+            r.get("evidence_committed", 0)
+            for r in report["scenarios"]
+        ),
+        # lower-is-better `_s` leaves the bench_compare gate watches:
+        # detection→commit and fork-detection latencies must not creep
+        "tte_evidence_commit_s": {
+            name: by_name[name].get("tte_evidence_commit_s")
+            for name in ("equivocate_prevote", "equivocate_precommit")
+            if name in by_name
+        },
+        "lightclient_detect_tte_s": by_name.get(
+            "lightclient_fork", {}
+        ).get("detect_tte_s"),
+        "double_sign_ttfc_after_restart_s": by_name.get(
+            "double_sign_guard", {}
+        ).get("ttfc_after_restart_s"),
+    }
+    return row, report
+
+
+def _persist_byz(report: dict) -> None:
+    """Write BENCH_BYZ.json — the byzantine-campaign trajectory the
+    ISSUE 18 acceptance criteria are audited against (per-scenario
+    safety/accountability/detection verdicts, seeds, fired schedules).
+    Same side-file rationale as _persist_chaos."""
+    import os
+    import time as _time
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_BYZ.json",
+        )
+        with open(path, "w") as f:
+            json.dump(
+                {"recorded_unix": _time.time(), **report}, f, indent=1
+            )
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def bench_mempool_checktx(n_txs: int = 2000):
     """Mempool CheckTx ingest rate against the kvstore app over the
     local ABCI client (reference harness:
@@ -2248,6 +2333,15 @@ def chaos_smoke_row():
     return row
 
 
+def byz_smoke_row():
+    """The banked byz_smoke stage row; persists BENCH_BYZ.json.
+    Module-level for the same targeted re-bank reason as
+    load_smoke_row."""
+    row, report = bench_byz_smoke()
+    _persist_byz(report)
+    return row
+
+
 def main() -> None:
     import os
 
@@ -2475,6 +2569,12 @@ def main() -> None:
         "chaos_smoke",
         chaos_smoke_row,
         "chaos_smoke",
+        600.0,
+    )
+    cpu_stage(
+        "byz_smoke",
+        byz_smoke_row,
+        "byz_smoke",
         600.0,
     )
     cpu_stage(

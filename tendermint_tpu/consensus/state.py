@@ -931,6 +931,13 @@ class ConsensusState(Service):
         self.timeline.mark_commit(
             height, rs.commit_round, len(block.txs), block.hash().hex()[:16]
         )
+        if block.evidence:
+            self.timeline.mark_evidence_committed(
+                height,
+                rs.commit_round,
+                len(block.evidence),
+                [ev.height() for ev in block.evidence],
+            )
         self.metrics.num_txs.set(len(block.txs))
         self.metrics.total_txs.inc(len(block.txs))
         self.metrics.block_size.set(block.size())
@@ -1077,6 +1084,11 @@ class ConsensusState(Service):
                 self.evpool, "report_conflicting_votes"
             ):
                 self.evpool.report_conflicting_votes(e.vote_a, e.vote_b)
+                self.timeline.mark_evidence_seen(
+                    vote.height,
+                    vote.round,
+                    vote.validator_address.hex(),
+                )
             self.logger.debug(
                 "found and sent conflicting votes to the evidence pool",
                 vote_a=str(e.vote_a), vote_b=str(e.vote_b),

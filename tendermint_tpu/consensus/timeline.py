@@ -54,6 +54,8 @@ __all__ = [
     "DEFAULT_CAPACITY",
     "EV_BLOCK",
     "EV_COMMIT",
+    "EV_EVIDENCE_COMMITTED",
+    "EV_EVIDENCE_SEEN",
     "EV_NEW_HEIGHT",
     "EV_NEW_ROUND",
     "EV_POLKA",
@@ -85,6 +87,8 @@ EV_PRECOMMIT_QUORUM = "precommit_quorum"  # +2/3 precommits for a block
 EV_TIMEOUT = "timeout"  # a scheduled timeout actually fired
 EV_STALL_RESET = "stall_reset"  # gossip forget-and-resend tick
 EV_COMMIT = "commit"  # block finalized into the store
+EV_EVIDENCE_SEEN = "evidence_seen"  # conflicting votes detected here
+EV_EVIDENCE_COMMITTED = "evidence_committed"  # block carried evidence
 
 
 class TimelineEvent:
@@ -294,6 +298,33 @@ class TimelineRecorder:
                 num_txs=num_txs,
                 block=block_hash,
             )
+
+    def mark_evidence_seen(
+        self, height: int, round_: int, validator: str
+    ) -> None:
+        """This node's vote_set caught conflicting votes (the
+        equivocation detection site, state.py _try_add_vote). Once per
+        (height, round): gossip re-delivers the same conflicting pair
+        from every peer that holds it."""
+        self._record_once(
+            EV_EVIDENCE_SEEN, height, round_, validator=validator[:12]
+        )
+
+    def mark_evidence_committed(
+        self, height: int, round_: int, count: int, ev_heights: list
+    ) -> None:
+        """A finalized block carried evidence — the accountability
+        endpoint the byzantine campaign SLO-checks (loadgen/byz.py
+        joins evidence_seen -> evidence_committed across the fleet for
+        per-height evidence-commit latency). `ev_heights` are the
+        heights the committed items incriminate."""
+        self._record_once(
+            EV_EVIDENCE_COMMITTED,
+            height,
+            round_,
+            count=count,
+            ev_heights=ev_heights,
+        )
 
     def mark_stall_reset(
         self, kind: str, height: int, round_: int, peer: str

@@ -17,6 +17,13 @@ Fault points are NAMED strings consulted at the boundary they model:
     tpu.gather     crypto/tpu_verifier.py, inside the gather barrier
     wal.write      consensus/wal.py, the framed append (short writes)
     wal.fsync      consensus/wal.py, every fsync (rotation included)
+    privval.save   privval/file.py, the last-sign-state checkpoint
+                   write (io_error = fsync failure, raise = crash
+                   before persist), keyed by the node home's basename
+    privval.release privval/file.py, between the last-sign-state fsync
+                   and the signature leaving the signer — a raise here
+                   IS the SIGKILL-between-sign-and-send arc the
+                   double-sign invariant is proven across (same key)
     rpc.route      rpc/jsonrpc.py _dispatch, keyed by method name —
                    inside the per-route latency measurement, so an
                    injected hang produces an honest SLO-breach

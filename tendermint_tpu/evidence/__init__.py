@@ -3,6 +3,7 @@
 reference: internal/evidence/.
 """
 
+from .metrics import EvidenceMetrics
 from .pool import EvidenceError, EvidencePool
 from .reactor import (
     EVIDENCE_CHANNEL,
@@ -20,6 +21,7 @@ __all__ = [
     "EVIDENCE_CHANNEL",
     "EvidenceError",
     "EvidenceListMessage",
+    "EvidenceMetrics",
     "EvidencePool",
     "EvidenceReactor",
     "evidence_channel_descriptor",

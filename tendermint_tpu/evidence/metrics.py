@@ -1,0 +1,35 @@
+"""Evidence pool metrics struct
+(reference: internal/evidence metrics), per-node when threaded from
+node assembly — see consensus/metrics.py for the pattern. The pool
+mutators (pool.py _add_pending/_mark_committed/_prune_expired) keep
+`pool_size` exact, so loadgen/scrape.py can fold the byzantine
+campaign's evidence flow into per-window deltas.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.metrics import DEFAULT_REGISTRY, Registry
+
+__all__ = ["EvidenceMetrics"]
+
+
+class EvidenceMetrics:
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        r = registry if registry is not None else DEFAULT_REGISTRY
+        self.pool_size = r.gauge(
+            "evidence",
+            "pool_size",
+            "Verified evidence pending inclusion in a block.",
+        )
+        self.committed_total = r.counter(
+            "evidence",
+            "committed_total",
+            "Evidence items committed in blocks (marked by Update).",
+        )
+        self.expired_total = r.counter(
+            "evidence",
+            "expired_total",
+            "Pending evidence pruned after aging past both expiry bounds.",
+        )

@@ -20,6 +20,7 @@ from ..types.evidence import (
     evidence_to_proto,
 )
 from ..types.vote import Vote
+from .metrics import EvidenceMetrics
 from .verify import verify_evidence
 
 __all__ = ["EvidencePool", "EvidenceError"]
@@ -37,11 +38,16 @@ def _key(prefix: bytes, ev: Evidence) -> bytes:
 
 
 class EvidencePool:
-    def __init__(self, db, state_store, block_store) -> None:
+    def __init__(self, db, state_store, block_store, metrics=None) -> None:
         self.db = db
         self.state_store = state_store
         self.block_store = block_store
         self.logger = get_logger("evidence.pool")
+        # per-node registry when node assembly provides one; bare
+        # constructions share DEFAULT_REGISTRY (idempotent register)
+        self.metrics = (
+            metrics if metrics is not None else EvidenceMetrics()
+        )
         self._pending: List[Evidence] = []
         self._pending_keys: set = set()
         # consensus-reported double signs buffered until the next Update
@@ -49,6 +55,7 @@ class EvidencePool:
         # reference: pool.go:188-204 + consensus buffer handling)
         self._consensus_buffer: List[Tuple[Vote, Vote]] = []
         self._load_pending()
+        self.metrics.pool_size.set(len(self._pending))
 
     # -- queries --
 
@@ -166,12 +173,14 @@ class EvidencePool:
             ):
                 self.db.delete(_key(_PENDING_PREFIX, ev))
                 self._pending_keys.discard(_key(_PENDING_PREFIX, ev))
+                self.metrics.expired_total.inc()
                 self.logger.info(
                     "pruned expired evidence", height=ev.height()
                 )
             else:
                 keep.append(ev)
         self._pending = keep
+        self.metrics.pool_size.set(len(self._pending))
 
     # -- storage --
 
@@ -180,11 +189,13 @@ class EvidencePool:
         self.db.set(key, evidence_to_proto(ev))
         self._pending.append(ev)
         self._pending_keys.add(key)
+        self.metrics.pool_size.set(len(self._pending))
 
     def _mark_committed(self, commit_height: int, ev: Evidence) -> None:
         self.db.set(
             _key(_COMMITTED_PREFIX, ev), struct.pack(">q", commit_height)
         )
+        self.metrics.committed_total.inc()
         key = _key(_PENDING_PREFIX, ev)
         if key in self._pending_keys:
             self.db.delete(key)
@@ -192,6 +203,7 @@ class EvidencePool:
             self._pending = [
                 p for p in self._pending if p.hash() != ev.hash()
             ]
+            self.metrics.pool_size.set(len(self._pending))
 
     def _load_pending(self) -> None:
         end = _PENDING_PREFIX[:-1] + bytes([_PENDING_PREFIX[-1] + 1])

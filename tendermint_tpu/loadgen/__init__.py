@@ -20,6 +20,12 @@ Layout:
                  latency, crash-restarts, churn) under open-loop
                  traffic, with machine-checked safety + recovery
                  verdicts — BENCH_CHAOS.json is its trajectory
+    byz.py       the byzantine campaign runner (ISSUE 18): seeded
+                 misbehavior (equivocation, conflicting proposals,
+                 amnesia, withholding), the ≥1/3 light-client fork
+                 control, and the crash-window double-sign guard —
+                 safety/accountability/detection verdicts banked as
+                 BENCH_BYZ.json
     driver.py    open-loop (fixed/Poisson arrival, latency from the
                  *intended* send time) and closed-loop drivers, the
                  HTTP client pool, and the websocket subscriber pool
@@ -32,6 +38,12 @@ Layout:
     run.py       orchestration: run_scenario / run_localnet_scenario
 """
 
+from .byz import (  # noqa: F401
+    ByzScenario,
+    run_byz_campaign,
+    run_byz_scenario,
+    shipped_byz_scenarios,
+)
 from .chaos import (  # noqa: F401
     ChaosScenario,
     run_campaign,
@@ -53,6 +65,7 @@ from .timeline import (  # noqa: F401
 
 __all__ = [
     "OPS",
+    "ByzScenario",
     "ChaosScenario",
     "ClientPool",
     "Localnet",
@@ -65,10 +78,13 @@ __all__ = [
     "collect",
     "decompose_recovery",
     "fleet_summary",
+    "run_byz_campaign",
+    "run_byz_scenario",
     "run_campaign",
     "run_chaos_scenario",
     "run_localnet_scenario",
     "run_scenario",
+    "shipped_byz_scenarios",
     "shipped_scenarios",
     "start_localnet",
 ]

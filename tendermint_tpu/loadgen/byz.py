@@ -1,0 +1,793 @@
+"""Byzantine campaign runner — seeded misbehavior under production load,
+with machine-checked safety, accountability, and detection verdicts.
+
+ISSUE 18: the chaos plane (loadgen/chaos.py) proved the net survives
+crash-shaped faults; this module proves it survives LIES. Each
+`ByzScenario` boots a fresh in-process localnet, arms the byzantine
+adversary plane (consensus/byzantine.py, the TM_TPU_BYZ contract) so
+one designated validator misbehaves on a seeded schedule, drives the
+seeded tmload open-loop traffic gun at the net for the whole run, and
+renders per-scenario verdicts, all machine-checked:
+
+* **safety** — byte-identical stored block-ID hashes across ALL honest
+  nodes at every common height (chaos.py's `_safety_check`, reused).
+  With the victim at 10/40 voting power (f=1 < n/3) ANY divergence
+  fails the scenario.
+* **accountability** — every injected equivocation height yields a
+  committed `DuplicateVoteEvidence` naming the victim within the
+  scenario's `evidence_slo_s`, each evidence item committed exactly
+  once, height-stamped via the flight recorder's `evidence_seen` /
+  `evidence_committed` timeline events (consensus/timeline.py).
+* **detection** — the `lightclient_fork` control scenario forges a
+  2-of-4 coalition block (20/40 = 1/2 ≥ 1/3 of trusted power: enough
+  to pass the light client's skipping-verification trust check) and
+  serves it from a lying primary; the divergence detector must raise
+  `DivergenceError` against the honest witness and report attack
+  evidence to the providers.
+* **double-sign protection** — the `double_sign_guard` arc SIGKILLs
+  the victim between last-sign-state fsync and vote broadcast (the
+  `privval.release` fault point, crypto/faults.py) on a sqlite-backed
+  net, restarts it, and requires that NO duplicate-vote evidence
+  naming the victim is ever committed: the persisted last-sign state
+  is the double-sign guard, and the crash window must not defeat it.
+
+Reproducibility is the PR-3/PR-18 plane contract end to end: byzantine
+rules own a `random.Random(seed)` derived from the campaign seed, the
+traffic arrival schedule is the seeded tmload schedule, and the forged
+coalition signs with the localnet's seed-derived validator keys.
+
+bench.py's `byz_smoke` row runs the shipped catalog in the banked
+jax-free CPU block and persists the trajectory as BENCH_BYZ.json.
+docs/resilience.md documents the scenario catalog and SLO policy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..consensus import byzantine
+from ..consensus.timeline import EV_EVIDENCE_COMMITTED, EV_EVIDENCE_SEEN
+from ..crypto import faults
+from ..crypto.ed25519 import PrivKeyEd25519
+from ..libs.rng import subseed as _subseed
+from ..light import Client, DivergenceError, LightStore, TrustOptions
+from ..light.provider import LocalProvider
+from ..store.kv import MemKV
+from ..types.block_id import BlockID
+from ..types.canonical import PRECOMMIT_TYPE
+from ..types.evidence import DuplicateVoteEvidence
+from ..types.light import LightBlock, SignedHeader
+from ..types.part_set import PartSetHeader
+from ..types.validator import Validator, ValidatorSet
+from ..types.commit import Commit, CommitSig
+from ..types.vote import Vote
+from . import timeline as fleet_timeline
+from .chaos import _heights, _safety_check, _wait_heights_above
+from .driver import ClientPool, run_open_loop
+from .localnet import Localnet, start_localnet
+from .scenario import Scenario
+
+__all__ = [
+    "ByzScenario",
+    "run_byz_campaign",
+    "run_byz_scenario",
+    "shipped_byz_scenarios",
+]
+
+_HOUR_NS = 3600 * 1_000_000_000
+_VICTIM_IDX = 1  # load1: the adversary plane's default victim
+
+
+@dataclass
+class ByzScenario:
+    """One byzantine arc. `kind` picks the machinery:
+
+    behavior          spec is a TM_TPU_BYZ rule fragment (`{seed}` is
+                      filled with the scenario seed) armed BEFORE the
+                      localnet boots so node assembly installs the
+                      harness on the victim; the run waits for the
+                      fleet to clear the misbehavior height window,
+                      then renders safety + (optionally) the evidence
+                      accountability verdict
+    lightclient_fork  no consensus misbehavior: a ≥1/3 coalition block
+                      is forged at the provider layer and served by a
+                      lying light-client primary against an honest
+                      witness — the detection control scenario
+    double_sign_guard no byzantine rules either: the PRODUCTION signer
+                      is crashed between state fsync and broadcast
+                      (privval.release fault point) and restarted on
+                      sqlite stores; the verdict is the absence of
+                      evidence naming the victim
+    """
+
+    name: str
+    kind: str = "behavior"
+    spec: str = ""  # TM_TPU_BYZ fragment, "{seed}" substituted
+    h_lo: int = 4
+    h_hi: int = 6
+    evidence_slo_s: Optional[float] = None  # None = no evidence verdict
+    expect_fired: bool = True  # require the rule to actually fire
+    baseline_s: float = 1.0
+    recovery_slo_s: float = 20.0
+    # extra crypto/faults.py net rules armed only while the fleet is
+    # inside the misbehavior window (amnesia needs round churn: vote
+    # delay past timeout_prevote forces round > 0 so locks can form
+    # and then be forgotten)
+    net_rules: list = field(default_factory=list)
+
+    def db_backend(self) -> str:
+        # the restart arc needs stores that survive the node instance
+        return "sqlite" if self.kind == "double_sign_guard" else "memdb"
+
+
+def shipped_byz_scenarios() -> List[ByzScenario]:
+    """The shipped catalog (4-node nets, victim load1 at 10/40 power;
+    docs/resilience.md): duplicate-vote equivocation at both vote
+    steps, conflicting proposals, amnesia under round churn, vote
+    withholding, the ≥1/3 light-client fork control, and the
+    crash-between-fsync-and-broadcast double-sign guard."""
+    vote_ch = 0x22  # consensus VOTE_CHANNEL
+    return [
+        ByzScenario(
+            name="equivocate_prevote",
+            spec="equivocate:h=4..6:step=prevote:seed={seed}",
+            h_lo=4,
+            h_hi=6,
+            evidence_slo_s=15.0,
+        ),
+        ByzScenario(
+            name="equivocate_precommit",
+            spec="equivocate:h=4..6:step=precommit:seed={seed}",
+            h_lo=4,
+            h_hi=6,
+            evidence_slo_s=15.0,
+        ),
+        ByzScenario(
+            # the victim proposes ~1 height in 4 (round-robin): the
+            # window spans 8 heights so it is proposer at least once
+            name="conflicting_proposal",
+            spec="conflicting_proposal:h=4..11:seed={seed}",
+            h_lo=4,
+            h_hi=11,
+        ),
+        ByzScenario(
+            # no duplicate-vote evidence exists across rounds — the
+            # amnesia verdict is safety-only; fired count is recorded
+            # but not required (a lock at round > 0 on the victim is
+            # churn-dependent)
+            name="amnesia",
+            spec="amnesia:h=4..7:seed={seed}",
+            h_lo=4,
+            h_hi=7,
+            expect_fired=False,
+            net_rules=[
+                {
+                    "point": "p2p.send",
+                    "mode": "delay",
+                    "p": 0.3,
+                    "delay_s": 1.1,
+                    "ch": vote_ch,
+                }
+            ],
+            recovery_slo_s=30.0,
+        ),
+        ByzScenario(
+            # liveness pressure, never evidence: 30/40 honest power
+            # still clears 2/3 so the chain must keep committing
+            name="withhold",
+            spec="withhold:h=4..6:seed={seed}",
+            h_lo=4,
+            h_hi=6,
+        ),
+        ByzScenario(
+            name="lightclient_fork",
+            kind="lightclient_fork",
+        ),
+        ByzScenario(
+            name="double_sign_guard",
+            kind="double_sign_guard",
+            recovery_slo_s=30.0,
+        ),
+    ]
+
+
+def _victim_address(scenario_seed: int, idx: int = _VICTIM_IDX) -> bytes:
+    """The victim's validator address, recomputed from the localnet's
+    seed-derived key schedule (loadgen/localnet.py)."""
+    priv = PrivKeyEd25519.from_seed(
+        scenario_seed.to_bytes(8, "big") + bytes([idx]) * 24
+    )
+    return priv.pub_key().address()
+
+
+def _committed_evidence(
+    ln: Localnet, victim_addr: bytes
+) -> List[Tuple[int, DuplicateVoteEvidence]]:
+    """(commit height, evidence) for every committed DuplicateVote-
+    Evidence naming the victim, read from node 0's store (the safety
+    check separately proves all stores hold identical blocks)."""
+    out: List[Tuple[int, DuplicateVoteEvidence]] = []
+    store = ln.nodes[0].block_store
+    for h in range(1, store.height() + 1):
+        block = store.load_block(h)
+        if block is None:
+            continue
+        for ev in block.evidence:
+            if (
+                isinstance(ev, DuplicateVoteEvidence)
+                and ev.vote_a.validator_address == victim_addr
+            ):
+                out.append((h, ev))
+    return out
+
+
+def _evidence_unique(ln: Localnet) -> bool:
+    """Each evidence item must be committed exactly once chain-wide
+    (the pool's committed-set must stop re-proposal and re-commit)."""
+    seen: set = set()
+    store = ln.nodes[0].block_store
+    for h in range(1, store.height() + 1):
+        block = store.load_block(h)
+        if block is None:
+            continue
+        for ev in block.evidence:
+            k = ev.hash()
+            if k in seen:
+                return False
+            seen.add(k)
+    return True
+
+
+async def _wait_evidence(
+    ln: Localnet,
+    victim_addr: bytes,
+    want_heights: set,
+    timeout_s: float,
+) -> Tuple[Optional[float], List[Tuple[int, DuplicateVoteEvidence]]]:
+    """Poll node 0's store until committed duplicate-vote evidence
+    covers every height in `want_heights`; returns (seconds it took or
+    None on timeout, the rows found either way)."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    rows: List[Tuple[int, DuplicateVoteEvidence]] = []
+    while time.monotonic() < deadline:
+        rows = _committed_evidence(ln, victim_addr)
+        if want_heights <= {ev.height() for _, ev in rows}:
+            return time.monotonic() - t0, rows
+        await asyncio.sleep(0.1)
+    return None, rows
+
+
+def _evidence_timeline(fleet: Dict[str, List[dict]]) -> dict:
+    """The flight-recorder stamp of the evidence lifecycle: first
+    detection tick, first commit tick, and the detect→commit latency
+    those two pins give (all nodes share one wall clock — the
+    in-process localnet's standing assumption)."""
+    seen = [
+        e
+        for evs in fleet.values()
+        for e in evs
+        if e["kind"] == EV_EVIDENCE_SEEN
+    ]
+    committed = [
+        e
+        for evs in fleet.values()
+        for e in evs
+        if e["kind"] == EV_EVIDENCE_COMMITTED
+    ]
+    t_seen = min((e["t_wall_ns"] for e in seen), default=None)
+    t_commit = min((e["t_wall_ns"] for e in committed), default=None)
+    return {
+        "evidence_seen_events": len(seen),
+        "evidence_committed_events": len(committed),
+        "evidence_seen_heights": sorted({e["height"] for e in seen}),
+        "evidence_committed_at": sorted(
+            {e["height"] for e in committed}
+        ),
+        "detect_to_commit_s": (
+            round((t_commit - t_seen) / 1e9, 3)
+            if t_seen is not None and t_commit is not None
+            else None
+        ),
+    }
+
+
+def _start_traffic(
+    ln: Localnet, scenario_seed: int, rate: float, duration_s: float
+) -> Tuple[asyncio.Future, List[ClientPool]]:
+    scn = Scenario(
+        seed=scenario_seed,
+        mode="open",
+        duration_s=duration_s,
+        rate=rate,
+        ramp_s=0.5,
+        subscribers=0,
+        max_inflight=32,
+        timeout_s=3.0,
+        mix=(("broadcast_tx_async", 3.0), ("status", 1.0)),
+    ).validate()
+    per_pool = max(1, scn.max_inflight // len(ln.rpc_addrs))
+    pools = [
+        ClientPool(a, size=per_pool, timeout_s=scn.timeout_s)
+        for a in ln.rpc_addrs
+    ]
+    return asyncio.ensure_future(run_open_loop(scn, pools)), pools
+
+
+async def run_byz_scenario(
+    sc: ByzScenario,
+    home: str,
+    n_nodes: int = 4,
+    seed: int = 2026,
+    rate: float = 50.0,
+) -> dict:
+    """Boot a fresh localnet, run one byzantine arc under open-loop
+    traffic, tear down, return the verdict row."""
+    scenario_seed = _subseed(seed, sc.name)
+    if sc.kind == "lightclient_fork":
+        return await _run_lightclient_fork(
+            sc, home, n_nodes, scenario_seed, rate
+        )
+    if sc.kind == "double_sign_guard":
+        return await _run_double_sign_guard(
+            sc, home, n_nodes, scenario_seed, rate
+        )
+    if sc.kind != "behavior":
+        raise ValueError(f"unknown byzantine kind {sc.kind!r}")
+
+    # arm BEFORE boot: hooks install at node assembly (byzantine.py)
+    os.environ["TM_TPU_BYZ"] = sc.spec.format(seed=scenario_seed)
+    byzantine.load_env()
+    assert byzantine.armed(), sc.spec
+    victim_addr = _victim_address(scenario_seed)
+    ln = await start_localnet(
+        n_nodes,
+        os.path.join(home, sc.name),
+        chain_id=f"byz-{sc.name}",
+        seed=scenario_seed,
+        db_backend=sc.db_backend(),
+    )
+    traffic: Optional[asyncio.Future] = None
+    pools: List[ClientPool] = []
+    try:
+        traffic, pools = _start_traffic(
+            ln, scenario_seed, rate, sc.baseline_s + 15.0
+        )
+        base_ok = await _wait_heights_above(
+            ln, min(_heights(ln)), timeout_s=20.0
+        )
+        await asyncio.sleep(sc.baseline_s)
+
+        # hold any riding net faults for the whole misbehavior window:
+        # the fleet clearing h_hi means every armed height was played
+        with contextlib.ExitStack() as stack:
+            for i, r in enumerate(sc.net_rules):
+                stack.enter_context(
+                    faults.inject(
+                        r["point"],
+                        r["mode"],
+                        p=r.get("p", 1.0),
+                        seed=_subseed(scenario_seed, f"{sc.name}-net{i}"),
+                        src=r.get("src"),
+                        dst=r.get("dst"),
+                        ch=r.get("ch"),
+                        delay_s=r.get("delay_s", 0.05),
+                    )
+                )
+            window_ok = await _wait_heights_above(
+                ln, sc.h_hi, timeout_s=sc.recovery_slo_s * 2 + 10.0
+            )
+
+        fired = [
+            f for h in byzantine.harnesses() for f in h.fired
+        ]
+        fired_heights = sorted({f[1] for f in fired})
+        behavior = sc.spec.split(":", 1)[0]
+
+        tte: Optional[float] = None
+        ev_rows: List[Tuple[int, DuplicateVoteEvidence]] = []
+        accountable = True
+        if sc.evidence_slo_s is not None:
+            want = {
+                f[1] for f in fired if f[0] == "equivocate"
+            }
+            tte, ev_rows = await _wait_evidence(
+                ln, victim_addr, want, timeout_s=sc.evidence_slo_s
+            )
+            accountable = bool(want) and tte is not None
+        else:
+            # misbehavior without conflicting signatures (or none at
+            # all) must NEVER produce evidence against the victim
+            ev_rows = _committed_evidence(ln, victim_addr)
+            accountable = not ev_rows
+
+        safety = _safety_check(ln)
+        unique_ok = _evidence_unique(ln)
+        stats, scheduled = await traffic
+        traffic = None
+        fleet = fleet_timeline.collect(ln)
+        ev_tl = _evidence_timeline(fleet)
+        fired_ok = bool(fired) if sc.expect_fired else True
+        row = {
+            "name": sc.name,
+            "kind": sc.kind,
+            "behavior": behavior,
+            "seed": scenario_seed,
+            "spec": os.environ.get("TM_TPU_BYZ", ""),
+            "victim": f"load{_VICTIM_IDX}",
+            "evidence_slo_s": sc.evidence_slo_s,
+            "baseline_commit_ok": base_ok is not None,
+            "window_cleared": window_ok is not None,
+            "fired": len(fired),
+            "fired_heights": fired_heights,
+            "tte_evidence_commit_s": (
+                round(tte, 3) if tte is not None else None
+            ),
+            "evidence_committed": len(ev_rows),
+            "evidence_heights": sorted(
+                {ev.height() for _, ev in ev_rows}
+            ),
+            "evidence_committed_at": sorted({h for h, _ in ev_rows}),
+            "evidence_unique_ok": unique_ok,
+            "accountable": accountable,
+            **safety,
+            "timeline": ev_tl,
+            "requests_total": sum(st.count for st in stats.values()),
+            "request_errors": sum(st.errors for st in stats.values()),
+            "scheduled_arrivals": scheduled,
+            "consults": byzantine.consults(),
+            "passed": bool(
+                safety["safety_ok"]
+                and base_ok is not None
+                and window_ok is not None
+                and fired_ok
+                and accountable
+                and unique_ok
+            ),
+        }
+        return row
+    finally:
+        os.environ.pop("TM_TPU_BYZ", None)
+        byzantine.reset()
+        faults.set_partition("")
+        if traffic is not None:
+            traffic.cancel()
+            await asyncio.gather(traffic, return_exceptions=True)
+        for p in pools:
+            await p.close()
+        await ln.stop()
+
+
+# ---------------------------------------------------------------------------
+# lightclient_fork: the ≥1/3 detection control
+
+
+class _LyingPrimary(LocalProvider):
+    """Serves the node's real chain everywhere EXCEPT the forged
+    height — the minimal lying primary: its history verifies, so the
+    only thing that can catch the fork is an honest witness."""
+
+    def __init__(self, block_store, state_store, forged: LightBlock):
+        super().__init__(block_store, state_store, id_="lying-primary")
+        self.forged = forged
+
+    async def light_block(self, height: int) -> LightBlock:
+        if height == self.forged.height:
+            return self.forged
+        return await super().light_block(height)
+
+
+def _forge_coalition_block(
+    honest: LightBlock, chain_id: str, scenario_seed: int
+) -> LightBlock:
+    """A properly-signed conflicting block at `honest.height`, signed
+    by a 2-of-4 coalition of the localnet's REAL validators (20/40 =
+    1/2 of trusted power: past the light client's 1/3 trust level, and
+    2/2 of the block's own declared set). Only app_hash and
+    validators_hash differ from the honest header — the forgery an
+    attacker with 1/3+ of stake can actually produce."""
+    coalition_privs = [
+        PrivKeyEd25519.from_seed(
+            scenario_seed.to_bytes(8, "big") + bytes([i]) * 24
+        )
+        for i in range(2)
+    ]
+    pairs = [
+        (Validator(pub_key=p.pub_key(), voting_power=10), p)
+        for p in coalition_privs
+    ]
+    coalition = ValidatorSet([v for v, _ in pairs])
+    by_addr = {v.address: p for v, p in pairs}
+    header = dataclasses.replace(
+        honest.signed_header.header,
+        app_hash=b"\x66" * 32,
+        validators_hash=coalition.hash(),
+    )
+    bid = BlockID(
+        hash=header.hash(),
+        part_set_header=PartSetHeader(total=1, hash=b"\x22" * 32),
+    )
+    sigs = []
+    for i, v in enumerate(coalition.validators):
+        vote = Vote(
+            type=PRECOMMIT_TYPE,
+            height=header.height,
+            round=0,
+            block_id=bid,
+            timestamp_ns=header.time_ns,
+            validator_address=v.address,
+            validator_index=i,
+        )
+        vote.signature = by_addr[v.address].sign(
+            vote.sign_bytes(chain_id)
+        )
+        sigs.append(
+            CommitSig.for_block(
+                vote.signature, v.address, vote.timestamp_ns
+            )
+        )
+    commit = Commit(
+        height=header.height, round=0, block_id=bid, signatures=sigs
+    )
+    return LightBlock(
+        signed_header=SignedHeader(header=header, commit=commit),
+        validator_set=coalition,
+    )
+
+
+async def _run_lightclient_fork(
+    sc: ByzScenario, home: str, n_nodes: int, scenario_seed: int,
+    rate: float,
+) -> dict:
+    trust_h = 2
+    ln = await start_localnet(
+        n_nodes,
+        os.path.join(home, sc.name),
+        chain_id=f"byz-{sc.name}",
+        seed=scenario_seed,
+    )
+    traffic: Optional[asyncio.Future] = None
+    pools: List[ClientPool] = []
+    try:
+        traffic, pools = _start_traffic(
+            ln, scenario_seed, rate, sc.baseline_s + 10.0
+        )
+        base_ok = await _wait_heights_above(
+            ln, min(_heights(ln)), timeout_s=20.0
+        )
+        # the fork target must be non-adjacent to the trust root (the
+        # skipping path is what the coalition's 1/3+ power defeats)
+        # and fully stored everywhere (commit for h lands with h+1)
+        await _wait_heights_above(ln, trust_h + 3, timeout_s=30.0)
+        target = min(_heights(ln)) - 1
+
+        witness = LocalProvider(
+            ln.nodes[1].block_store,
+            ln.nodes[1].state_store,
+            id_="honest-witness",
+        )
+        honest = await witness.light_block(target)
+        forged = _forge_coalition_block(
+            honest, ln.chain_id, scenario_seed
+        )
+        assert forged.signed_header.hash() != honest.signed_header.hash()
+        primary = _LyingPrimary(
+            ln.nodes[0].block_store, ln.nodes[0].state_store, forged
+        )
+        root = await witness.light_block(trust_h)
+        client = Client(
+            ln.chain_id,
+            TrustOptions(
+                period_ns=200 * _HOUR_NS,
+                height=trust_h,
+                hash=root.signed_header.hash(),
+            ),
+            primary,
+            [witness],
+            LightStore(MemKV()),
+        )
+        t0 = time.monotonic()
+        detected = False
+        attack_evidence = 0
+        try:
+            await client.verify_light_block_at_height(target)
+        except DivergenceError as e:
+            detected = True
+            attack_evidence = len(e.evidence)
+        detect_tte_s = time.monotonic() - t0
+        reported = len(witness.reported_evidence) + len(
+            primary.reported_evidence
+        )
+
+        safety = _safety_check(ln)
+        stats, scheduled = await traffic
+        traffic = None
+        row = {
+            "name": sc.name,
+            "kind": sc.kind,
+            "seed": scenario_seed,
+            "trust_height": trust_h,
+            "fork_height": target,
+            "coalition_power": 20,
+            "total_power": n_nodes * 10,
+            "baseline_commit_ok": base_ok is not None,
+            "divergence_detected": detected,
+            "attack_evidence": attack_evidence,
+            "evidence_reported_to_providers": reported,
+            "detect_tte_s": round(detect_tte_s, 3),
+            **safety,
+            "requests_total": sum(st.count for st in stats.values()),
+            "request_errors": sum(st.errors for st in stats.values()),
+            "scheduled_arrivals": scheduled,
+            "passed": bool(
+                safety["safety_ok"]
+                and base_ok is not None
+                and detected
+                and attack_evidence > 0
+                and reported > 0
+            ),
+        }
+        return row
+    finally:
+        if traffic is not None:
+            traffic.cancel()
+            await asyncio.gather(traffic, return_exceptions=True)
+        for p in pools:
+            await p.close()
+        await ln.stop()
+
+
+# ---------------------------------------------------------------------------
+# double_sign_guard: crash between last-sign-state fsync and broadcast
+
+
+async def _run_double_sign_guard(
+    sc: ByzScenario, home: str, n_nodes: int, scenario_seed: int,
+    rate: float,
+) -> dict:
+    victim = _VICTIM_IDX
+    victim_addr = _victim_address(scenario_seed, victim)
+    ln = await start_localnet(
+        n_nodes,
+        os.path.join(home, sc.name),
+        chain_id=f"byz-{sc.name}",
+        seed=scenario_seed,
+        db_backend="sqlite",
+    )
+    traffic: Optional[asyncio.Future] = None
+    pools: List[ClientPool] = []
+    try:
+        traffic, pools = _start_traffic(
+            ln, scenario_seed, rate, sc.baseline_s + 15.0
+        )
+        base_ok = await _wait_heights_above(
+            ln, min(_heights(ln)), timeout_s=20.0
+        )
+        await asyncio.sleep(sc.baseline_s)
+
+        # crash the victim's NEXT signature release: last-sign state
+        # is fsynced, the signature never leaves the privval — the
+        # exact SIGKILL-between-fsync-and-broadcast instant
+        fault_fired = False
+        with faults.inject(
+            "privval.release", "raise", times=1,
+            key=f"load{victim}",
+        ) as rule:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if rule.fired >= 1:
+                    fault_fired = True
+                    break
+                await asyncio.sleep(0.05)
+            crash_height = max(_heights(ln))
+            # the process dies holding a persisted HRS whose vote was
+            # never sent; the restart must honor that state
+            await ln.restart(victim)
+
+        ttfc = await _wait_heights_above(
+            ln, crash_height, timeout_s=sc.recovery_slo_s * 2 + 5.0
+        )
+        recovered = ttfc is not None and ttfc <= sc.recovery_slo_s
+        # let the net commit a few more heights: any conflicting
+        # signature the restarted victim produced would surface as
+        # committed evidence here
+        await _wait_heights_above(
+            ln, crash_height + 3, timeout_s=sc.recovery_slo_s
+        )
+
+        ev_rows = _committed_evidence(ln, victim_addr)
+        safety = _safety_check(ln)
+        stats, scheduled = await traffic
+        traffic = None
+        row = {
+            "name": sc.name,
+            "kind": sc.kind,
+            "seed": scenario_seed,
+            "victim": f"load{victim}",
+            "fault_point": "privval.release",
+            "fault_fired": fault_fired,
+            "crash_height": crash_height,
+            "ttfc_after_restart_s": (
+                round(ttfc, 3) if ttfc is not None else None
+            ),
+            "recovered_within_slo": recovered,
+            "victim_evidence_committed": len(ev_rows),
+            **safety,
+            "requests_total": sum(st.count for st in stats.values()),
+            "request_errors": sum(st.errors for st in stats.values()),
+            "scheduled_arrivals": scheduled,
+            "passed": bool(
+                safety["safety_ok"]
+                and base_ok is not None
+                and fault_fired
+                and recovered
+                and not ev_rows  # the double-sign guard held
+            ),
+        }
+        return row
+    finally:
+        faults.reset()
+        if traffic is not None:
+            traffic.cancel()
+            await asyncio.gather(traffic, return_exceptions=True)
+        for p in pools:
+            await p.close()
+        await ln.stop()
+
+
+async def run_byz_campaign(
+    home: str,
+    scenarios: Optional[Sequence[ByzScenario]] = None,
+    n_nodes: int = 4,
+    seed: int = 2026,
+    rate: float = 50.0,
+) -> dict:
+    """Run the catalog; returns the BENCH_BYZ.json document."""
+    scenarios = (
+        list(scenarios)
+        if scenarios is not None
+        else shipped_byz_scenarios()
+    )
+    rows = []
+    for sc in scenarios:
+        rows.append(
+            await run_byz_scenario(
+                sc, home, n_nodes=n_nodes, seed=seed, rate=rate
+            )
+        )
+    by_name = {r["name"]: r for r in rows}
+    # the gateable summary: bench_compare's flatten() skips lists, so
+    # the per-scenario accountability/detection latencies are lifted
+    # into a dict block — every leaf ends `_s` (lower-is-better) and a
+    # scenario vanishing from a fresh run is a missing row = gate fail
+    summary = {
+        "tte_evidence_commit_s": {
+            name: r.get("tte_evidence_commit_s")
+            for name, r in by_name.items()
+            if r.get("evidence_slo_s") is not None
+        },
+        "lightclient_detect_tte_s": by_name.get(
+            "lightclient_fork", {}
+        ).get("detect_tte_s"),
+        "double_sign_ttfc_after_restart_s": by_name.get(
+            "double_sign_guard", {}
+        ).get("ttfc_after_restart_s"),
+        "evidence_committed_hits": sum(
+            r.get("evidence_committed", 0) for r in rows
+        ),
+    }
+    return {
+        "schema": "bench_byz/v1",
+        "seed": seed,
+        "nodes": n_nodes,
+        "offered_rate_per_s": rate,
+        "scenarios": rows,
+        "summary": summary,
+        "all_passed": all(r["passed"] for r in rows),
+    }

@@ -61,6 +61,9 @@ _MAX_GAUGES = (
     "eventbus_subscriptions",
     "rpc_ws_connections",
     "rpc_inflight_requests",
+    # byzantine campaigns: peak verified-but-uncommitted evidence —
+    # a sustained high-water mark means inclusion lags detection
+    "evidence_pool_size",
 )
 
 # sketch p99s tracked as run maxima (worst window across nodes).
@@ -90,6 +93,11 @@ _DELTA_COUNTERS = (
     "p2p_peer_disconnects_total",
     "p2p_send_queue_dropped_total",
     "p2p_net_faults_total",
+    # the evidence lifecycle's terminal states (byzantine campaigns):
+    # committed = accountability achieved, expired = accountability
+    # window missed — a nonzero expired delta fails the verdict
+    "evidence_committed_total",
+    "evidence_expired_total",
 )
 
 

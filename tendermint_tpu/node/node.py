@@ -38,6 +38,7 @@ from ..crypto import tpu_verifier
 from ..eventbus import EventBus, EventBusMetrics
 from ..consensus.metrics import ConsensusMetrics
 from ..evidence import (
+    EvidenceMetrics,
     EvidencePool,
     EvidenceReactor,
     evidence_channel_descriptor,
@@ -448,7 +449,10 @@ class Node(Service):
             metrics=MempoolMetrics(self.metrics_registry),
         )
         self.evidence_pool = EvidencePool(
-            self._evidence_db, self.state_store, self.block_store
+            self._evidence_db,
+            self.state_store,
+            self.block_store,
+            metrics=EvidenceMetrics(self.metrics_registry),
         )
         self.block_exec = BlockExecutor(
             self.state_store,
@@ -504,6 +508,15 @@ class Node(Service):
             cfg=cfg.consensus,
             wait_sync=wait_sync,
         )
+        # byzantine adversary plane (consensus/byzantine.py): one
+        # armed() check at assembly — a disarmed process (TM_TPU_BYZ
+        # unset) installs nothing and pays nothing on any hot path
+        from ..consensus import byzantine
+
+        if byzantine.armed():
+            byzantine.maybe_install(
+                self.consensus, self.consensus_reactor, cfg.base.moniker
+            )
         self.mempool_reactor = MempoolReactor(
             self.mempool,
             self.router.open_channel(mempool_channel_descriptor()),

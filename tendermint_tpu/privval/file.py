@@ -17,6 +17,7 @@ import os
 import time
 from dataclasses import dataclass, field
 
+from ..crypto import faults
 from ..crypto.ed25519 import PrivKeyEd25519
 from ..crypto.keys import (
     PrivKey,
@@ -53,6 +54,17 @@ def vote_to_step(vote: Vote) -> int:
 # the consensus core serializes signing, so the fsync happens at most
 # once per own-vote — same policy as the reference's WAL WriteSync.
 _atomic_write = atomic_write
+
+
+def _node_key(state_file_path: str) -> str:
+    """Fault-point key for the privval.* points: the node home's
+    basename (key/state files live at <home>/config/... and
+    <home>/data/...), so a rule's `key=` can target one validator in a
+    multi-node net."""
+    d = os.path.dirname(state_file_path)
+    if os.path.basename(d) in ("config", "data"):
+        d = os.path.dirname(d)
+    return os.path.basename(d)
 
 
 def _strip_timestamp(sign_bytes: bytes, ts_field: int) -> bytes:
@@ -163,6 +175,12 @@ class FilePVLastSignState:
         return False
 
     def save(self) -> None:
+        if faults.armed():
+            # "privval.save": the checkpoint write/fsync itself fails
+            # (io_error) or the process dies before persisting (raise).
+            # Keyed by the node home's basename so multi-node chaos
+            # scenarios can target one validator's signer.
+            faults.fire("privval.save", key=_node_key(self.file_path))
         data = json.dumps(
             {
                 "height": self.height,
@@ -313,6 +331,15 @@ class FilePV(PrivValidator):
 
         sig = self.key.priv_key.sign(sign_bytes)
         self._save_signed(height, round_, step, sign_bytes, sig)
+        if faults.armed():
+            # "privval.release": SIGKILL between the last-sign-state
+            # fsync and the vote leaving the process — the seam the
+            # double-sign invariant is proven across (the restarted
+            # signer must re-release THIS signature, never a
+            # conflicting one; tests/test_privval.py pins it)
+            faults.fire(
+                "privval.release", key=_node_key(lss.file_path)
+            )
         vote.signature = sig
 
     def _sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
@@ -342,6 +369,10 @@ class FilePV(PrivValidator):
 
         sig = self.key.priv_key.sign(sign_bytes)
         self._save_signed(height, round_, step, sign_bytes, sig)
+        if faults.armed():
+            faults.fire(
+                "privval.release", key=_node_key(lss.file_path)
+            )
         proposal.signature = sig
 
     def _save_signed(

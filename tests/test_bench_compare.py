@@ -287,6 +287,66 @@ class TestGate:
             == 1
         )
 
+    def test_gate_covers_byz_artifact_shape(self, tmp_path, capsys):
+        """ISSUE 18 satellite: the BENCH_BYZ summary block's TTE/TTFC
+        leaves are direction-annotated (all `_s` = lower-is-better), a
+        regressed accountability latency fails the gate, and a
+        scenario vanishing from the summary is a missing row = fail."""
+
+        def byz_doc(tte=0.4, detect=0.01, drop=None):
+            summary = {
+                "tte_evidence_commit_s": {
+                    "equivocate_prevote": tte,
+                    "equivocate_precommit": 0.5,
+                },
+                "lightclient_detect_tte_s": detect,
+                "double_sign_ttfc_after_restart_s": 2.1,
+                "evidence_committed_hits": 6,
+            }
+            if drop:
+                del summary["tte_evidence_commit_s"][drop]
+            return {
+                "schema": "bench_byz/v1",
+                "seed": 2026,
+                "nodes": 4,
+                "offered_rate_per_s": 40.0,
+                "scenarios": [],  # lists are never rows
+                "summary": summary,
+                "all_passed": True,
+            }
+
+        assert bench_compare.direction_of(
+            "summary.tte_evidence_commit_s.equivocate_prevote"
+        ) == -1
+        assert bench_compare.direction_of(
+            "summary.lightclient_detect_tte_s"
+        ) == -1
+        b = self._write(tmp_path, "banked.json", byz_doc())
+        f = self._write(tmp_path, "fresh.json", byz_doc())
+        assert bench_compare.main([f, b, "--gate"]) == 0
+        capsys.readouterr()
+        # detection-to-commit latency doubled: regression
+        f2 = self._write(tmp_path, "f2.json", byz_doc(tte=0.9))
+        assert bench_compare.main([f2, b, "--gate"]) == 1
+        assert "tte_evidence_commit_s" in capsys.readouterr().err
+        # a scenario dropped out of the campaign: missing row
+        f3 = self._write(
+            tmp_path, "f3.json", byz_doc(drop="equivocate_prevote")
+        )
+        assert bench_compare.main([f3, b, "--gate"]) == 1
+        assert "vanished" in capsys.readouterr().err
+
+    def test_gate_self_compare_banked_byz_artifact(self, capsys):
+        """The real BENCH_BYZ.json gates clean against itself — the
+        strict mode accepts the byzantine artifact shape, with its
+        summary block supplying the gateable rows."""
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        path = os.path.join(root, "BENCH_BYZ.json")
+        assert bench_compare.main([path, path, "--gate"]) == 0
+        assert capsys.readouterr().out.startswith("GATE PASS:")
+
     def test_gate_self_compare_banked_load_artifact(self, capsys):
         """The real BENCH_LOAD.json gates clean against itself — the
         strict mode accepts the repo's actual artifact shape."""

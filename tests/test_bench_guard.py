@@ -420,6 +420,51 @@ print("OK")
     assert "OK" in r.stdout
 
 
+def test_byz_smoke_row_never_initializes_jax():
+    """The ISSUE-18 byzantine row boots live localnets with the
+    adversary plane armed, drives equivocation, and reads the
+    safety/accountability verdicts — all in the banked CPU block
+    BEFORE the device probe, so none of it may touch the jax backend
+    (consensus/byzantine.py is pure stdlib; loadgen/localnet.py pins
+    tpu.enable=false). One equivocation scenario here; the real
+    BENCH_BYZ.json run uses the shipped catalog."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+from tendermint_tpu.loadgen import ByzScenario
+sc = ByzScenario(
+    name="equivocate_prevote",
+    spec="equivocate:h=4..5:step=prevote:seed={seed}",
+    h_lo=4, h_hi=5, evidence_slo_s=20.0, baseline_s=0.5,
+)
+row, report = bench.bench_byz_smoke(
+    n_nodes=4, seed=11, rate=25.0, scenarios=[sc]
+)
+assert row["scenarios"] == 1
+assert report["schema"] == "bench_byz/v1"
+r = report["scenarios"][0]
+assert r["safety_ok"] and r["heights_checked"] >= 1, r
+assert r["fired"] >= 1 and r["accountable"], r
+assert r["evidence_committed"] >= 1 and r["passed"], r
+assert row["evidence_committed_total"] >= 1
+assert report["summary"]["tte_evidence_commit_s"], report["summary"]
+from tendermint_tpu.consensus import byzantine
+assert not byzantine.armed(), "the arc left the plane armed"
+assert "jax" not in sys.modules, "byz smoke dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout
+
+
 def test_profiler_rows_never_initialize_jax():
     """The ISSUE-16 rows (profiler_overhead, fanout_publish) live in
     the banked CPU block BEFORE the device probe: the sampler is pure

@@ -1,0 +1,258 @@
+"""Byzantine adversary plane tests (ISSUE 18).
+
+Tier-1: the TM_TPU_BYZ rule grammar (parse, seed reproducibility, the
+raise-once env latch — the crypto/faults.py contract, mirrored), the
+zero-overhead kill switch (a disarmed localnet installs no harness and
+consults no rule), and one seconds-scale end-to-end equivocation arc
+on a live 4-node localnet proving the full evidence lifecycle:
+harness-crafted duplicate vote → vote_set conflict → evidence pool →
+gossip → committed DuplicateVoteEvidence naming the victim. The full
+shipped catalog (conflicting proposals, amnesia, withholding, the
+light-client fork control, the double-sign guard) is the bench
+byz_smoke row (BENCH_BYZ.json).
+"""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.consensus import byzantine
+from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+from tendermint_tpu.types.canonical import PRECOMMIT_TYPE, PREVOTE_TYPE
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+
+def run(coro, timeout=240.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    """Every test starts and ends disarmed, with the env latch
+    re-armed so a TM_TPU_BYZ leaked by another test cannot bleed in."""
+    monkeypatch.delenv("TM_TPU_BYZ", raising=False)
+    byzantine.reset()
+    yield
+    byzantine.reset()
+
+
+# -- the rule grammar --------------------------------------------------
+
+
+def test_env_spec_parses_and_arms(monkeypatch):
+    monkeypatch.setenv(
+        "TM_TPU_BYZ",
+        "equivocate:h=4..7:seed=9:step=precommit;"
+        "withhold:h=5:p=0.5:times=2:victim=load2",
+    )
+    byzantine.load_env()
+    assert byzantine.armed()
+    rules = {r.behavior: r for r in byzantine.rules()}
+    eq = rules["equivocate"]
+    assert (eq.h_lo, eq.h_hi, eq.seed, eq.step) == (4, 7, 9, "precommit")
+    assert eq.victim == "load1"  # the default victim
+    wh = rules["withhold"]
+    # h=N pins a single height; victim/p/times pass through
+    assert (wh.h_lo, wh.h_hi) == (5, 5)
+    assert (wh.p, wh.times, wh.victim) == (0.5, 2, "load2")
+    monkeypatch.setenv("TM_TPU_BYZ", "")
+    byzantine.load_env()
+    assert not byzantine.armed()
+
+
+def test_malformed_env_spec_raises_once_then_disarmed(monkeypatch):
+    """A bad TM_TPU_BYZ surfaces ONCE; the latch rises even on parse
+    failure, and all-or-nothing parsing arms none of a spec that dies
+    mid-list (the crypto/faults.py load_env contract)."""
+    monkeypatch.setenv(
+        "TM_TPU_BYZ", "equivocate:h=4..6;teleport:h=5"
+    )
+    monkeypatch.setattr(byzantine, "_ENV_LOADED", False)
+    with pytest.raises(ValueError):
+        byzantine.armed()
+    assert not byzantine.armed()  # latched: no re-raise, disarmed
+    assert byzantine.rules() == []
+    # a corrected spec re-arms via the explicit reload path
+    monkeypatch.setenv("TM_TPU_BYZ", "equivocate:h=4..6")
+    byzantine.load_env()
+    assert byzantine.armed()
+
+
+def test_bad_options_raise():
+    with pytest.raises(ValueError):
+        byzantine._parse_rule("equivocate:warp=9")
+    with pytest.raises(ValueError):
+        byzantine._parse_rule("equivocate:h")
+    with pytest.raises(ValueError):
+        byzantine.ByzRule("teleport")
+    with pytest.raises(ValueError):
+        byzantine.ByzRule("equivocate", step="commit")
+
+
+def test_rules_are_seed_reproducible():
+    """Whether consult k misbehaves is a pure function of (seed, k):
+    the plane's reproducibility contract (module doc)."""
+
+    def pattern(seed):
+        fired = []
+        with byzantine.inject(
+            "equivocate", h_lo=1, p=0.5, seed=seed
+        ) as rule:
+            for i in range(50):
+                if (
+                    byzantine._plan(
+                        "equivocate", 5, "load1", PREVOTE_TYPE
+                    )
+                    is not None
+                ):
+                    fired.append(i)
+            assert rule.fired == len(fired)
+        return fired
+
+    a, b, c = pattern(7), pattern(7), pattern(8)
+    assert a == b
+    assert a != c  # different seed, different schedule
+    assert a  # p=0.5 over 50 consults fires at least once
+
+
+def test_window_victim_step_and_times_filters():
+    with byzantine.inject(
+        "equivocate", h_lo=4, h_hi=6, step="precommit", times=1
+    ):
+        # outside the height window / wrong victim / wrong step: no
+        assert byzantine._plan("equivocate", 3, "load1") is None
+        assert byzantine._plan("equivocate", 7, "load1") is None
+        assert byzantine._plan("equivocate", 5, "load0") is None
+        assert (
+            byzantine._plan("equivocate", 5, "load1", PREVOTE_TYPE)
+            is None
+        )
+        # matching consult fires; the times budget then exhausts
+        assert (
+            byzantine._plan("equivocate", 5, "load1", PRECOMMIT_TYPE)
+            is not None
+        )
+        assert (
+            byzantine._plan("equivocate", 5, "load1", PRECOMMIT_TYPE)
+            is None
+        )
+    assert not byzantine.armed()  # scope exited: disarmed
+
+
+# -- the kill switch ---------------------------------------------------
+
+
+def test_disarmed_localnet_never_consults(tmp_path):
+    """The zero-overhead contract: with TM_TPU_BYZ unset no node
+    installs a harness and no hook consults the rule list — the
+    byzantine plane costs a disarmed production net exactly nothing
+    beyond one armed() check at assembly."""
+    from tendermint_tpu.loadgen import start_localnet
+
+    assert not byzantine.armed()
+
+    async def go():
+        ln = await start_localnet(2, str(tmp_path / "calm"), seed=31)
+        try:
+            await ln.wait_for_height(3, timeout=60.0)
+        finally:
+            await ln.stop()
+
+    run(go())
+    assert byzantine.consults() == 0
+    assert byzantine.harnesses() == []
+
+
+# -- the end-to-end evidence lifecycle ---------------------------------
+
+
+def test_live_equivocation_commits_evidence(tmp_path):
+    """One end-to-end equivocation arc in tier-1 (the full catalog is
+    the bench byz_smoke row): the env-armed plane makes load1 sign
+    duplicate prevotes at heights 4-5, and every honest node must
+    commit DuplicateVoteEvidence naming the victim — detection,
+    pooling, gossip, and block inclusion all live."""
+    import os
+
+    from tendermint_tpu.loadgen import start_localnet
+
+    seed = 41
+    os.environ["TM_TPU_BYZ"] = f"equivocate:h=4..5:seed={seed}"
+    try:
+        byzantine.load_env()
+        assert byzantine.armed()
+        victim_priv = PrivKeyEd25519.from_seed(
+            seed.to_bytes(8, "big") + bytes([1]) * 24
+        )
+        victim_addr = victim_priv.pub_key().address()
+
+        async def go():
+            ln = await start_localnet(
+                4, str(tmp_path / "byznet"), seed=seed
+            )
+            try:
+                # clear the misbehavior window plus slack for the
+                # evidence to gossip and land in a committed block
+                await ln.wait_for_height(7, timeout=90.0)
+                deadline = asyncio.get_event_loop().time() + 30.0
+                found = []
+                while asyncio.get_event_loop().time() < deadline:
+                    found = _victim_evidence(
+                        ln.nodes[0].block_store, victim_addr
+                    )
+                    if {ev.height() for ev in found} >= {4, 5}:
+                        break
+                    await asyncio.sleep(0.2)
+                # the harness actually misbehaved, on schedule
+                fired = [
+                    f
+                    for h in byzantine.harnesses()
+                    for f in h.fired
+                ]
+                assert fired, "harness never fired"
+                assert {f[1] for f in fired} == {4, 5}
+                assert {ev.height() for ev in found} >= {4, 5}, found
+                for ev in found:
+                    # conflicting votes, same HRS+validator, both
+                    # verifiable against the victim's key
+                    a, b = ev.vote_a, ev.vote_b
+                    assert a.block_id.key() != b.block_id.key()
+                    assert a.validator_address == victim_addr
+                    assert b.validator_address == victim_addr
+                    assert (a.height, a.round, a.type) == (
+                        b.height,
+                        b.round,
+                        b.type,
+                    )
+                # every OTHER node committed the same evidence (the
+                # stores hold identical blocks — gossip + consensus
+                # carried accountability fleet-wide)
+                for n in ln.nodes[1:]:
+                    other = _victim_evidence(
+                        n.block_store, victim_addr
+                    )
+                    assert {e.hash() for e in other} >= {
+                        e.hash() for e in found
+                    }
+            finally:
+                await ln.stop()
+
+        run(go())
+        assert byzantine.consults() > 0
+    finally:
+        os.environ.pop("TM_TPU_BYZ", None)
+
+
+def _victim_evidence(store, victim_addr):
+    out = []
+    for h in range(1, store.height() + 1):
+        block = store.load_block(h)
+        if block is None:
+            continue
+        for ev in block.evidence:
+            if (
+                isinstance(ev, DuplicateVoteEvidence)
+                and ev.vote_a.validator_address == victim_addr
+            ):
+                out.append(ev)
+    return out

@@ -476,3 +476,79 @@ class TestEvidenceExpiry:
         ev.timestamp_ns += 1
         with pytest.raises(ValueError, match="different time"):
             verify_evidence(ev, state, ss, bs)
+
+
+# ---------------------------------------------------------------------------
+# pool metrics + pruning (ISSUE 18 satellites): the evidence metrics
+# family tracks the lifecycle on a live net, pruning counts expiries,
+# and committed evidence is never re-admitted
+
+
+def test_pool_metrics_and_pruning_on_live_net():
+    from tendermint_tpu.evidence import EvidenceMetrics
+    from tendermint_tpu.libs.metrics import Registry
+
+    async def go():
+        net, nodes = make_cluster(4)
+        await start_cluster(net, nodes)
+        try:
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, timeout=60.0) for n in nodes)
+            )
+            node = nodes[0]
+            # a private registry: the cluster harness pools share
+            # DEFAULT_REGISTRY, where four nodes' gauges overwrite
+            # each other (real nodes get per-node registries from
+            # node assembly)
+            node.evpool.metrics = EvidenceMetrics(Registry())
+            m = node.evpool.metrics
+            assert m.pool_size.value() == 0.0
+
+            vals = node.state_store.load_validators(2)
+            t2 = node.block_store.load_block_meta(2).header.time_ns
+            priv = PrivKeyEd25519.from_seed(bytes([103]) * 32)
+            idx, _ = vals.get_by_address(priv.pub_key().address())
+            ev = make_double_sign(priv, 2, vals, t2, index=idx)
+            node.evpool.add_evidence(ev)
+            assert m.pool_size.value() == 1.0
+
+            async def committed():
+                while not node.evpool.is_committed(ev):
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(committed(), 60.0)
+            # committed: drained from pending, counted once, and
+            # never re-admitted (the no-regossip guarantee)
+            assert m.pool_size.value() == 0.0
+            assert m.committed_total.value() >= 1.0
+            node.evpool.add_evidence(ev)
+            assert not node.evpool.is_pending(ev)
+            assert m.pool_size.value() == 0.0
+
+            # pruning: fresh evidence at height 2, then a state past
+            # BOTH expiry bounds (verify.py's AND-semantics) — the
+            # prune drops it and counts the missed accountability
+            priv2 = PrivKeyEd25519.from_seed(bytes([102]) * 32)
+            idx2, _ = vals.get_by_address(priv2.pub_key().address())
+            ev2 = make_double_sign(priv2, 2, vals, t2, index=idx2)
+            node.evpool.add_evidence(ev2)
+            assert m.pool_size.value() == 1.0
+            aged = State(
+                chain_id=CHAIN,
+                last_block_height=2 + 50,
+                last_block_time_ns=t2 + 500 * NS,
+                consensus_params=ConsensusParams(
+                    evidence=EvidenceParams(
+                        max_age_num_blocks=10,
+                        max_age_duration_ns=100 * NS,
+                    )
+                ),
+            )
+            node.evpool.update(aged, [])
+            assert not node.evpool.is_pending(ev2)
+            assert m.pool_size.value() == 0.0
+            assert m.expired_total.value() == 1.0
+        finally:
+            await stop_cluster(net, nodes)
+
+    run(go())

@@ -6,6 +6,7 @@ import os
 
 import pytest
 
+from tendermint_tpu.crypto import faults
 from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
 from tendermint_tpu.privval import FilePV, MockPV
 from tendermint_tpu.privval.file import (
@@ -169,6 +170,104 @@ def test_state_file_is_json(tmp_path, pv):
         raw = json.load(f)
     assert raw["height"] == 1 and raw["step"] == STEP_PREVOTE
     assert len(bytes.fromhex(raw["signature"])) == 64
+
+
+def test_sigkill_between_fsync_and_broadcast_resends_same_vote(tmp_path):
+    """THE double-sign-protection regression (ISSUE 18 acceptance
+    criterion): kill the validator between the last-sign-state fsync
+    and the vote leaving the process, restart, and the signer must
+    re-release the IDENTICAL signature — and refuse a conflicting
+    block at that HRS forever. Fails if either the atomic-save or the
+    fsync-before-sign ordering in FilePV._sign_vote is broken."""
+    k, s = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(k, s)
+    pv.save()
+
+    vote = make_vote(height=4, addr=pv.key.address)
+    vote.timestamp_ns = 1_700_000_000_000_000_000
+    # the SIGKILL seam: privval.release fires AFTER _save_signed (state
+    # durably on disk) and BEFORE vote.signature is set (nothing ever
+    # broadcast) — exactly a crash between fsync and send
+    with faults.inject("privval.release", "raise", times=1):
+        with pytest.raises(faults.DeviceFault):
+            run(pv.sign_vote("c", vote))
+    assert vote.signature == b""  # the signature never escaped
+
+    # ...but the checkpoint DID hit disk before the crash
+    restarted = FilePV.load(k, s)
+    assert restarted.last_sign_state.height == 4
+    assert restarted.last_sign_state.step == STEP_PREVOTE
+    saved_sig = restarted.last_sign_state.signature
+    assert saved_sig
+
+    # restart path: the same vote is re-signed byte-identically (the
+    # saved signature is re-released, no second signing event)
+    revote = make_vote(height=4, addr=pv.key.address)
+    revote.timestamp_ns = vote.timestamp_ns
+    run(restarted.sign_vote("c", revote))
+    assert revote.signature == saved_sig
+    assert revote.verify("c", pv.key.pub_key) is None  # raises on bad sig
+
+    # and a CONFLICTING block at the same HRS is refused outright
+    evil = make_vote(
+        height=4, block_id=make_block_id(b"\x66" * 32), addr=pv.key.address
+    )
+    with pytest.raises(ValueError, match="conflicting data"):
+        run(restarted.sign_vote("c", evil))
+
+
+def test_save_io_error_withholds_signature(tmp_path):
+    """An fsync failure on the checkpoint (privval.save io_error) must
+    abort the signing — the signature never escapes with an unpersisted
+    HRS, so a crash-restart cannot be tricked into double-signing."""
+    k, s = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(k, s)
+    pv.save()
+    vote = make_vote(height=2, addr=pv.key.address)
+    with faults.inject("privval.save", "io_error", times=1):
+        with pytest.raises(OSError):
+            run(pv.sign_vote("c", vote))
+    assert vote.signature == b""
+    # disk still holds the pre-sign state; a reload signs cleanly
+    reloaded = FilePV.load(k, s)
+    assert reloaded.last_sign_state.height == 0
+    run(reloaded.sign_vote("c", make_vote(height=2, addr=pv.key.address)))
+
+
+def test_privval_fault_key_targets_one_node(tmp_path):
+    """The privval.* points are keyed by node-home basename so a chaos
+    rule can crash load1's signer while load0 keeps signing."""
+    homes = {}
+    for name in ("load0", "load1"):
+        d = tmp_path / name / "data"
+        d.mkdir(parents=True)
+        homes[name] = FilePV.generate(
+            str(tmp_path / name / "k.json"),
+            str(d / "priv_validator_state.json"),
+        )
+    with faults.inject("privval.release", "raise", key="load1"):
+        run(homes["load0"].sign_vote(
+            "c", make_vote(addr=homes["load0"].key.address)
+        ))  # untargeted node unaffected
+        with pytest.raises(faults.DeviceFault):
+            run(homes["load1"].sign_vote(
+                "c", make_vote(addr=homes["load1"].key.address)
+            ))
+
+
+def test_torn_tmp_file_is_harmless(tmp_path):
+    """A crash mid-atomic-write leaves only <state>.tmp debris; the
+    real state file is untouched and the reloaded signer keeps its
+    double-sign checkpoint."""
+    k, s = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(k, s)
+    pv.save()
+    run(pv.sign_vote("c", make_vote(height=6, addr=pv.key.address)))
+    # simulate the torn temp file a crash during the NEXT save leaves
+    with open(s + ".tmp", "w") as f:
+        f.write('{"height": 99, "round"')  # truncated json
+    reloaded = FilePV.load(k, s)
+    assert reloaded.last_sign_state.height == 6
 
 
 def test_secp256k1_file_pv_round_trip(tmp_path):
