@@ -817,6 +817,108 @@ def bench_tmcost_gate():
     }
 
 
+def bench_tmmc_gate():
+    """The tmmc exhaustive-exploration gate (scripts/lint.py --mc)
+    plus the reduction measurement its "exhaustive" claim rests on.
+
+    Two sub-runs, both pure-CPU (the model harness drives the real
+    consensus implementation with in-memory stores — never initializes
+    jax, pinned by tests/test_bench_guard.py):
+
+      1. the gate scenario itself (4 validators, 2 heights, one
+         equivocator) at the in-gate budgets — wall, states explored,
+         dedup/sleep pruning counts;
+      2. ``measure_reduction`` at an exhaustible depth horizon: the
+         reduced explorer (sleep sets + fingerprint dedup) exhausts
+         the subspace, then naive enumeration (no reduction) re-covers
+         the same unique states — ``reduction_x`` is the state-visit
+         ratio at identical coverage, ``edges_x`` the edge ratio.
+
+    TM_TPU_MC_BENCH_FAST=1 shrinks the reduction horizon by one depth
+    level (seconds instead of ~a minute) for smoke/guard runs; the
+    banked BENCH_MC.json always comes from a full run."""
+    import os
+
+    from tendermint_tpu.analysis import tmmc
+    from tendermint_tpu.analysis.tmmc.explorer import (
+        Budgets,
+        measure_reduction,
+    )
+
+    fast = bool(os.environ.get("TM_TPU_MC_BENCH_FAST"))
+    t0 = time.perf_counter()
+    rep = tmmc.analyze()
+    gate_wall = time.perf_counter() - t0
+    st = rep.stats
+    horizon = Budgets(
+        max_states=5_000,
+        max_depth=3 if fast else 5,
+        max_edges=10_000,
+        wall_s=20.0,
+    )
+    red = measure_reduction(
+        tmmc.GATE_CONFIG,
+        horizon,
+        seed=tmmc.GATE_SEED,
+        naive_edge_factor=12.0,
+        naive_wall_s=8.0 if fast else 120.0,
+    )
+    row = {
+        "gate_wall_s": round(gate_wall, 2),
+        "gate_states": st["states"],
+        "gate_edges": st["edges"],
+        "gate_states_per_s": round(st["states"] / max(gate_wall, 1e-9), 1),
+        "gate_dedup_hits": st["dedup_hits"],
+        "gate_sleep_skips": st["sleep_skips"],
+        "gate_stopped_by": st["stopped_by"],
+        "gate_violations": len(rep.violations),
+        "horizon_depth": horizon.max_depth,
+        "reduction_x": red["reduction_x"],
+        "edges_x": red["edges_x"],
+        "coverage_matched": red["coverage_matched"],
+        "reduced_states": red["reduced"]["states"],
+        "reduced_edges": red["reduced"]["edges"],
+        "reduced_wall_s": red["reduced"]["wall_s"],
+        "naive_states": red["naive"]["states"],
+        "naive_edges": red["naive"]["edges"],
+        "naive_wall_s": red["naive"]["wall_s"],
+    }
+    if not fast:
+        # smoke/guard runs must never clobber the banked full-run
+        # record the acceptance criteria are audited against
+        _persist_mc(
+            {
+                "config": tmmc.GATE_CONFIG.describe(),
+                "gate_budgets": tmmc.GATE_BUDGETS.describe(),
+                **row,
+            }
+        )
+    return row
+
+
+def _persist_mc(record: dict) -> None:
+    """Write BENCH_MC.json — the model-checking trajectory row the
+    ISSUE 19 acceptance criteria are audited against: the in-gate
+    exploration cost and the >=10x reduction-vs-naive measurement.
+    Written as the stage lands (same rationale as _persist_midround)
+    and kept out of the driver's one-line budget."""
+    import os
+    import time as _time
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_MC.json",
+        )
+        with open(path, "w") as f:
+            json.dump(
+                {"recorded_unix": _time.time(), **record}, f, indent=1
+            )
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def bench_serving_cache_page(
     n_vals: int = 150, page: int = 20, reps: int = 3, rounds: int = 3
 ):
@@ -2539,6 +2641,12 @@ def main() -> None:
         bench_tmcost_gate,
         "tmcost_gate",
         120.0,
+    )
+    cpu_stage(
+        "tmmc_gate",
+        bench_tmmc_gate,
+        "tmmc_gate",
+        300.0,
     )
     cpu_stage(
         "mempool",

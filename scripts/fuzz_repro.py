@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Replay a tmmc witness trace (or re-run a named exploration) and
+dump the flight-recorder timeline of every model node.
+
+The model checker (tendermint_tpu/analysis/tmmc) emits violations as
+replayable witnesses: (seed, config, explicit transition list). This
+CLI is the other half of that contract — it re-executes a banked
+witness deterministically on the REAL consensus implementation and
+renders what each node's TimelineRecorder captured, so a red gate
+finding turns into a per-node, per-height event narrative instead of
+a fingerprint.
+
+    python scripts/fuzz_repro.py trace.json           # replay a banked
+                                                      # witness file
+    python scripts/fuzz_repro.py trace.json --events  # full per-node
+                                                      # event stream
+    python scripts/fuzz_repro.py trace.json --json out.json
+    python scripts/fuzz_repro.py --config gate --seed 0
+                                                      # re-run a named
+                                                      # scenario; on
+                                                      # violation,
+                                                      # minimize + dump
+    python scripts/fuzz_repro.py --config gate --save witness.json
+                                                      # bank the
+                                                      # minimized trace
+
+Exit codes: 0 — the outcome matched expectation (a trace carrying a
+rule reproduced it; a rule-less trace or green exploration stayed
+green); 1 — it did not (expected violation failed to reproduce, an
+unexpected one appeared, or the exploration found violations).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from tendermint_tpu.analysis import tmmc  # noqa: E402
+from tendermint_tpu.analysis.tmmc.explorer import (  # noqa: E402
+    Trace,
+    explore,
+    minimize_trace,
+    replay_trace,
+)
+
+
+def _fmt_transition(t) -> str:
+    if t[0] == "t":
+        return f"timeout@mc{t[1]}"
+    return f"deliver@mc{t[1]} {t[2]}"
+
+
+def _node_dump(node) -> dict:
+    return {
+        "moniker": node.moniker,
+        "height": node.cs.rs.height,
+        "round": node.cs.rs.round,
+        "step": node.cs.rs.step,
+        "store_height": node.block_store.height(),
+        "detections": [list(d) for d in node.detections],
+        "pending_evidence": len(node.evpool._pending),
+        "events": [e.to_dict() for e in node.timeline.snapshot()],
+    }
+
+
+def _print_timeline(dump: dict, events: bool) -> None:
+    for nd in dump["nodes"]:
+        print(
+            f"\n== {nd['moniker']}  h{nd['height']} r{nd['round']} "
+            f"s{nd['step']}  store={nd['store_height']} "
+            f"detections={len(nd['detections'])} "
+            f"pending_evidence={nd['pending_evidence']} =="
+        )
+        evs = nd["events"]
+        if not events:
+            # phase view: drop the per-transition `step` churn, keep
+            # the crossings (proposal/polka/quorum/commit/evidence)
+            evs = [e for e in evs if e["kind"] != "step"]
+        for e in evs:
+            attrs = {
+                k: v
+                for k, v in e.items()
+                if k
+                not in ("seq", "kind", "height", "round", "step",
+                        "t_mono_ns", "t_wall_ns")
+            }
+            extra = f"  {attrs}" if attrs else ""
+            print(
+                f"  [{e['seq']:>4}] h{e['height']} r{e['round']} "
+                f"{e['kind']}{extra}"
+            )
+
+
+def _replay_and_dump(trace: Trace) -> dict:
+    net, found, complete = replay_trace(trace)
+    try:
+        dump = {
+            "config": trace.config,
+            "seed": trace.seed,
+            "rule": trace.rule,
+            "transitions": [
+                _fmt_transition(t) for t in trace.transitions
+            ],
+            "complete": complete,
+            "violations": [
+                {"rule": r, "message": m} for r, m in found
+            ],
+            "nodes": [_node_dump(n) for n in net.nodes],
+        }
+    finally:
+        net.close()
+        net.loop.close()
+    return dump
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Replay a tmmc witness trace into a "
+        "flight-recorder timeline dump."
+    )
+    ap.add_argument(
+        "trace", nargs="?",
+        help="witness trace JSON (as banked by --save or emitted by "
+        "the gate); omit to run --config exploration instead",
+    )
+    ap.add_argument(
+        "--config", default=None,
+        help="named tmmc scenario to explore (gate, agreement-ab, "
+        "accountability-ab) when no trace file is given",
+    )
+    ap.add_argument(
+        "--seed", type=int, default=None,
+        help="override the scenario's schedule seed",
+    )
+    ap.add_argument(
+        "--events", action="store_true",
+        help="print the FULL per-node event stream (default: phase "
+        "crossings only)",
+    )
+    ap.add_argument(
+        "--json", metavar="OUT",
+        help="also write the machine-readable dump to OUT",
+    )
+    ap.add_argument(
+        "--save", metavar="OUT",
+        help="exploration mode: bank the minimized witness trace",
+    )
+    args = ap.parse_args(argv)
+
+    if args.trace is None and args.config is None:
+        ap.error("give a trace file or --config NAME")
+
+    if args.trace is not None:
+        with open(args.trace) as f:
+            trace = Trace.from_json(json.load(f))
+        dump = _replay_and_dump(trace)
+        _print_timeline(dump, args.events)
+        reproduced = [v["rule"] for v in dump["violations"]]
+        if not dump["complete"]:
+            print("\nreplay INCOMPLETE: a transition was not enabled "
+                  "(trace does not match this tree)", file=sys.stderr)
+        print(f"\nviolations: {reproduced or 'none'}")
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(dump, f, indent=1, sort_keys=True)
+            print(f"wrote {args.json}")
+        if trace.rule:
+            ok = dump["complete"] and trace.rule in reproduced
+            print(f"expected {trace.rule}: "
+                  f"{'reproduced' if ok else 'NOT reproduced'}")
+            return 0 if ok else 1
+        return 1 if reproduced else 0
+
+    cfg, budgets, seed = tmmc.named_config(args.config)
+    if args.seed is not None:
+        seed = args.seed
+    print(f"exploring {args.config}: {cfg.describe()}")
+    print(f"budgets {budgets.describe()} seed {seed}")
+    result = explore(cfg, budgets, seed=seed, stop_at_first=True)
+    st = result.stats
+    print(
+        f"states={st['states']} edges={st['edges']} "
+        f"unique={st['unique_fingerprints']} "
+        f"dedup_hits={st['dedup_hits']} "
+        f"sleep_skips={st['sleep_skips']} "
+        f"stopped_by={st['stopped_by']} wall={st['wall_s']}s"
+    )
+    if not result.violations:
+        print("no violations within the horizon")
+        return 0
+    first = result.violations[0]
+    print(f"\nVIOLATION {first.rule}: {first.message}")
+    print(f"minimizing witness (depth {len(first.trace.transitions)})...")
+    small = minimize_trace(first.trace)
+    print(f"minimized depth {len(small.transitions)}")
+    dump = _replay_and_dump(small)
+    _print_timeline(dump, args.events)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(dump, f, indent=1, sort_keys=True)
+        print(f"wrote {args.json}")
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(small.to_json(), f, indent=1, sort_keys=True)
+        print(f"banked witness -> {args.save}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
-"""tmlint + tmcheck + tmrace + tmtrace + tmlive + tmsafe + tmcost CLI
-— the consensus-invariant static analyzers.
+"""tmlint + tmcheck + tmrace + tmtrace + tmlive + tmsafe + tmcost +
+tmmc CLI — the consensus-invariant static analyzers.
 
 Usage:
     python scripts/lint.py                    # full gate: tmlint +
@@ -18,6 +18,11 @@ Usage:
                                               # safety pass only
     python scripts/lint.py --cost             # tmcost per-request
                                               # cost-bound pass only
+    python scripts/lint.py --mc               # tmmc exhaustive model-
+                                              # checking gate only
+                                              # (DYNAMIC: runs the
+                                              # consensus implementation
+                                              # under the explorer)
     python scripts/lint.py --cost-update      # regenerate the reviewed
                                               # per-request budget table
     python scripts/lint.py --memo-audit       # memo-soundness audit
@@ -58,8 +63,9 @@ tendermint_tpu/analysis/tmrace/race_baseline.json (race),
 tendermint_tpu/analysis/tmtrace/trace_baseline.json (trace),
 tendermint_tpu/analysis/tmlive/live_baseline.json (live),
 tendermint_tpu/analysis/tmsafe/safe_baseline.json (adv),
-tendermint_tpu/analysis/tmcost/cost_baseline.json (cost), and the
-golden tables tendermint_tpu/analysis/tmcheck/schema.json +
+tendermint_tpu/analysis/tmcost/cost_baseline.json (cost),
+tendermint_tpu/analysis/tmmc/mc_baseline.json (mc — ships empty and
+should stay empty), and the golden tables tendermint_tpu/analysis/tmcheck/schema.json +
 tendermint_tpu/analysis/tmtrace/jit_signatures.json +
 tendermint_tpu/analysis/tmcost/cost_budgets.json.
 --baseline-update / --schema-update / --signatures-update /
@@ -69,7 +75,7 @@ workflow and the suppression policy (`# tmlint: disable=<rule>`,
 `# tmcheck: taint-ok/taint-break`, `# tmcheck:
 unparsed=N/unwritten=N`, `# tmrace: race-ok/guarded-by`,
 `# tmtrace: trace-ok`, `# tmlive: block-ok/grow-ok/bounded=`,
-`# tmsafe: <rule>-ok`, `# tmcost: <rule>-ok`).
+`# tmsafe: <rule>-ok`, `# tmcost: <rule>-ok`, `# tmmc: mc-ok`).
 
 The full gate parses the package ONCE: the tmcheck call-graph build is
 the shared substrate every section (including tmlint's syntactic rules
@@ -149,6 +155,12 @@ def main(argv=None) -> int:
         help="run only the tmcost per-request cost-bound pass",
     )
     ap.add_argument(
+        "--mc", action="store_true",
+        help="run only the tmmc exhaustive model-checking gate "
+             "(dynamic: explores the real consensus implementation "
+             "for the fixed 4-validator/2-height byzantine scenario)",
+    )
+    ap.add_argument(
         "--cost-update", action="store_true", dest="cost_update",
         help="regenerate the reviewed per-request cost budget table "
              "(tendermint_tpu/analysis/tmcost/cost_budgets.json)",
@@ -206,6 +218,9 @@ def main(argv=None) -> int:
             print(f"{rid}: {title}")
         for rid, title in tmcost.RULES:
             print(f"{rid}: {title}")
+        from tendermint_tpu.analysis import tmmc
+        for rid, title in tmmc.RULES:
+            print(f"{rid}: {title}")
         return 0
 
     filtered = bool(args.rules or args.paths)
@@ -238,6 +253,7 @@ def main(argv=None) -> int:
         or args.live
         or args.adv
         or args.cost
+        or args.mc
         or args.memo_audit
         or trace_selected
     ):
@@ -247,7 +263,7 @@ def main(argv=None) -> int:
         # the update mode below disables them)
         print(
             "error: --schema-update requires a full-package run "
-            "(drop --rule/--taint/--race/--live/--adv/--cost/"
+            "(drop --rule/--taint/--race/--live/--adv/--cost/--mc/"
             "--memo-audit/--trace and path arguments)",
             file=sys.stderr,
         )
@@ -260,6 +276,7 @@ def main(argv=None) -> int:
         or args.live
         or args.adv
         or args.cost
+        or args.mc
         or args.memo_audit
         or trace_selected
         or args.schema_update
@@ -270,7 +287,7 @@ def main(argv=None) -> int:
         print(
             "error: --signatures-update requires a full-package run "
             "(drop --rule/--taint/--schema/--race/--live/--adv/--cost/"
-            "--memo-audit/--trace/other update modes and path "
+            "--mc/--memo-audit/--trace/other update modes and path "
             "arguments)",
             file=sys.stderr,
         )
@@ -282,6 +299,7 @@ def main(argv=None) -> int:
         or args.race
         or args.live
         or args.adv
+        or args.mc
         or args.memo_audit
         or trace_selected
         or args.schema_update
@@ -293,7 +311,7 @@ def main(argv=None) -> int:
         # returning 0 (same hazard class as --schema-update)
         print(
             "error: --cost-update requires a full-package run "
-            "(drop --rule/--taint/--schema/--race/--live/--adv/"
+            "(drop --rule/--taint/--schema/--race/--live/--adv/--mc/"
             "--memo-audit/--trace/other update modes and path "
             "arguments)",
             file=sys.stderr,
@@ -307,6 +325,7 @@ def main(argv=None) -> int:
         or args.live
         or args.adv
         or args.cost
+        or args.mc
         or args.memo_audit
         or trace_selected
     )
@@ -318,6 +337,7 @@ def main(argv=None) -> int:
         "live": args.live,
         "adv": args.adv,
         "cost": args.cost,
+        "mc": args.mc,
         "memo": args.memo_audit,
         "trace": trace_selected,
     }
@@ -334,6 +354,7 @@ def main(argv=None) -> int:
     run_live = _only("live")
     run_adv = _only("adv")
     run_cost = _only("cost")
+    run_mc = _only("mc")
     run_memo = _only("memo")
     run_trace = _only("trace")
     # update modes run ONLY the sections they update: computing (then
@@ -349,6 +370,7 @@ def main(argv=None) -> int:
         run_live = False
         run_adv = False
         run_cost = False
+        run_mc = False
         run_memo = False
         run_trace = False
     if args.signatures_update:
@@ -359,6 +381,7 @@ def main(argv=None) -> int:
         run_live = False
         run_adv = False
         run_cost = False
+        run_mc = False
         run_memo = False
         run_trace = False
     if args.cost_update:
@@ -369,6 +392,7 @@ def main(argv=None) -> int:
         run_live = False
         run_adv = False
         run_cost = False
+        run_mc = False
         run_memo = False
         run_trace = False
 
@@ -644,6 +668,49 @@ def main(argv=None) -> int:
                 )
                 new.extend(trace_gated)
 
+        if run_mc:
+            # DYNAMIC section — no AST substrate: it runs the real
+            # consensus implementation under the tmmc explorer for the
+            # fixed gate scenario (4 validators, 2 heights, one
+            # equivocator) and converts invariant violations into lint
+            # findings anchored at the failed checker's def line in
+            # analysis/tmmc/invariants.py. Imported lazily: the model
+            # harness pulls in the full consensus stack, which no
+            # static section needs.
+            from tendermint_tpu.analysis import tmmc
+            mc_report = tmmc.analyze()
+            mc_v = tmmc.mc_violations(mc_report)
+            violations.extend(mc_v)
+            if args.stats:
+                st = mc_report.stats
+                print(
+                    f"-- tmmc gate: {st.get('states')} states / "
+                    f"{st.get('edges')} edges in {st.get('wall_s')}s, "
+                    f"dedup_hits={st.get('dedup_hits')}, "
+                    f"sleep_skips={st.get('sleep_skips')}, "
+                    f"stopped_by={st.get('stopped_by')}, "
+                    f"suppressed={mc_report.suppressed} --"
+                )
+            if args.baseline_update:
+                counts = tmlint.save_baseline(
+                    mc_v,
+                    tmmc.MC_BASELINE_PATH,
+                    note=tmmc.MC_BASELINE_NOTE,
+                )
+                print(
+                    f"mc baseline updated: {len(counts)} fingerprints "
+                    f"-> {tmmc.MC_BASELINE_PATH}"
+                )
+            elif args.no_baseline:
+                new.extend(mc_v)
+            else:
+                new.extend(
+                    tmlint.new_violations(
+                        mc_v,
+                        tmlint.load_baseline(tmmc.MC_BASELINE_PATH),
+                    )
+                )
+
         if args.signatures_update:
             sig_pkg = pkg or tmcheck.build_package()
             pkg = sig_pkg
@@ -700,6 +767,7 @@ def main(argv=None) -> int:
                 ("live", run_live),
                 ("adv", run_adv),
                 ("cost", run_cost),
+                ("mc", run_mc),
                 ("memo", run_memo),
                 ("trace", run_trace),
             )
@@ -726,7 +794,8 @@ def main(argv=None) -> int:
             "taint-ok/taint-break/unparsed=N, # tmrace: "
             "race-ok/guarded-by=..., # tmtrace: trace-ok, "
             "# tmlive: block-ok/grow-ok/bounded=..., "
-            "# tmsafe: <rule>-ok, # tmcost: <rule>-ok), or for "
+            "# tmsafe: <rule>-ok, # tmcost: <rule>-ok, "
+            "# tmmc: mc-ok), or for "
             "consciously accepted changes run scripts/lint.py "
             "--baseline-update / --schema-update / --signatures-update "
             "/ --cost-update.",

@@ -133,6 +133,13 @@ CATALOG: List[MemoEntry] = [
         "memo on any encoded-field write",
     ),
     MemoEntry(
+        "types/header.py", "Header.hash", "consensus",
+        "field-merkle root; every Header field feeds the tree, so "
+        "__setattr__ drops the memo on ANY attribute write (the "
+        "dataclass __init__ included) — same discipline as "
+        "Vote._SB_FIELDS",
+    ),
+    MemoEntry(
         "types/validator.py", "ValidatorSet.hash", "consensus",
         "merkle root over SimpleValidator leaves; cleared by _reindex",
     ),

@@ -1,0 +1,92 @@
+"""tmmc — exhaustive consensus exploration (stateless model checking).
+
+The ninth..first gate sections prove DATAFLOW properties (determinism,
+taint, races, cost) by reading the package; the chaos/byzantine
+campaigns SAMPLE schedules at random. This package closes the gap
+between them: it exhaustively explores vote/proposal/part/timeout
+delivery interleavings of the REAL consensus implementation — the
+actual `consensus/state.py` ConsensusState objects, not an abstract
+model — for small configs (2-4 validators, 1-3 heights), with the
+PR-18 byzantine behavior catalog (`consensus/byzantine.py`) composed
+in as adversary transitions, so the explored space includes lying
+nodes and not just reordering.
+
+Pieces:
+
+- `harness`   — ModelNet: N in-process validators whose network and
+                timers are lifted into an explicit pending set; a
+                transition is "deliver one pending message" or "fire
+                one pending timeout". schedulefuzz's Schedule seam
+                supplies the deterministic enumeration order (the same
+                seed-discipline the random campaigns bank).
+- `explorer`  — DFS with sleep-set partial-order reduction and
+                state-fingerprint dedup (round-state + vote-set +
+                commit-hash fingerprints), depth/state/edge/wall
+                budgets, a naive mode for measuring the reduction, and
+                greedy trace minimization.
+- `invariants`— agreement, validity, accountability, stall-freedom —
+                checked at EVERY explored state; any violation emits a
+                minimized, replayable trace (seed + transition list)
+                that `replay_trace` re-executes deterministically and
+                the PR-15 flight recorder renders as a per-height
+                story (scripts/fuzz_repro.py).
+- `gate`      — the `scripts/lint.py --mc` section: exit 0/1/2, a
+                counted fingerprint baseline shipped EMPTY
+                (mc_baseline.json), suppression form `# tmmc: mc-ok`,
+                refusal-matrix parity with the other update modes.
+
+docs/static_analysis.md ("Exhaustive exploration") has the state
+model, the reduction argument, the invariant table, and the
+trace-replay cookbook.
+"""
+
+from .explorer import (  # noqa: F401
+    Budgets,
+    ExploreResult,
+    MCViolation,
+    Trace,
+    explore,
+    measure_reduction,
+    minimize_trace,
+    replay_trace,
+)
+from .gate import (  # noqa: F401
+    GATE_BUDGETS,
+    GATE_CONFIG,
+    GATE_SEED,
+    MC_BASELINE_NOTE,
+    MC_BASELINE_PATH,
+    RULES,
+    Report,
+    analyze,
+    mc_violations,
+    named_config,
+    new_mc_violations,
+    update_mc_baseline,
+)
+from .harness import MCConfig, ModelNet  # noqa: F401
+
+__all__ = [
+    "Budgets",
+    "ExploreResult",
+    "GATE_BUDGETS",
+    "GATE_CONFIG",
+    "GATE_SEED",
+    "MCConfig",
+    "MCViolation",
+    "MC_BASELINE_NOTE",
+    "MC_BASELINE_PATH",
+    "ModelNet",
+    "RULES",
+    "Report",
+    "Trace",
+    "analyze",
+    "explore",
+    "mc_violations",
+    "measure_reduction",
+    "minimize_trace",
+    "named_config",
+    "new_mc_violations",
+    "replay_trace",
+    "update_mc_baseline",
+]

@@ -1,0 +1,533 @@
+"""Stateless DFS explorer over ModelNet schedules.
+
+Exploration is *stateless* in the model-checking sense: there is one
+live ModelNet; descending applies transitions to it, and backtracking
+re-executes the target prefix from a fresh net (counted in
+``stats["replays"]``). Signature memoization in the harness makes
+re-execution cheap — the ed25519 cost is paid once per distinct
+message for the whole exploration.
+
+Reduction is two-layered:
+
+- **Sleep sets** (partial-order reduction): transitions on different
+  nodes commute — a node's transition mutates only that node plus
+  append-only ``pending`` sets at peers, and purge/enabledness at a
+  node depend only on that node's own round-state — so a sibling
+  already explored at state ``s`` is not re-explored under a child
+  reached by an independent transition.
+- **Fingerprint dedup**: a SHA-1 over every node's round-state,
+  vote sets, commit chain, evidence, pending sets and adversary
+  record; a revisited fingerprint prunes the whole subtree.
+
+Both are exhaustive *within the budgets* (depth/states/edges/wall and
+the config's round cap); the gate reports the budgets alongside the
+result so "zero violations" is always read as "zero violations within
+this recorded horizon". Combining dedup with sleep sets can prune a
+re-entry path whose sleep set differs — the budgets, not the dedup,
+are already the soundness boundary here, and the naive mode exists to
+measure exactly what the reduction buys (``measure_reduction``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...libs.schedulefuzz import Schedule
+from .harness import MCConfig, ModelNet
+
+Transition = Tuple
+_CheckFn = Callable[[ModelNet, List[Transition]], List[Tuple[str, str]]]
+
+
+def _default_check(net: ModelNet, enabled: List[Transition]):
+    from . import invariants
+
+    return invariants.check_all(net, enabled)
+
+
+# ---------------------------------------------------------------------------
+# results
+
+
+@dataclass
+class Budgets:
+    """Exploration horizon. All four are hard caps; whichever trips
+    first is recorded in stats["stopped_by"]."""
+
+    max_states: int = 20_000
+    max_depth: int = 64
+    max_edges: int = 60_000
+    wall_s: float = 60.0
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "max_states": self.max_states,
+            "max_depth": self.max_depth,
+            "max_edges": self.max_edges,
+            "wall_s": self.wall_s,
+        }
+
+
+@dataclass
+class Trace:
+    """A replayable witness: config + seed + explicit transition list.
+    ``transitions`` round-trips through JSON as nested lists;
+    ``replay_trace`` re-executes it deterministically."""
+
+    seed: int
+    config: Dict[str, Any]
+    transitions: List[Transition]
+    rule: str = ""
+    message: str = ""
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "config": self.config,
+            "transitions": [list(t) for t in self.transitions],
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "Trace":
+        return cls(
+            seed=int(d["seed"]),
+            config=dict(d["config"]),
+            transitions=[_tuplify(t) for t in d["transitions"]],
+            rule=d.get("rule", ""),
+            message=d.get("message", ""),
+        )
+
+
+def _tuplify(x):
+    return tuple(_tuplify(i) for i in x) if isinstance(x, list) else x
+
+
+@dataclass
+class MCViolation:
+    rule: str
+    message: str
+    trace: Trace
+
+
+@dataclass
+class ExploreResult:
+    violations: List[MCViolation]
+    stats: Dict[str, Any]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# ---------------------------------------------------------------------------
+# DFS core
+
+
+@dataclass
+class _Frame:
+    path: List[Transition]
+    todo: List[Transition]
+    sleep: frozenset
+    done: List[Transition] = field(default_factory=list)
+    next_i: int = 0
+
+
+class _Explorer:
+    def __init__(
+        self,
+        cfg: MCConfig,
+        budgets: Budgets,
+        seed: int,
+        check: Optional[_CheckFn],
+        reduce: bool = True,
+        dedup: bool = True,
+        stop_at_first: bool = True,
+        target_unique: Optional[int] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.budgets = budgets
+        self.seed = seed
+        self.check = check if check is not None else _default_check
+        self.reduce = reduce
+        self.dedup = dedup
+        self.stop_at_first = stop_at_first
+        self.target_unique = target_unique
+        self.loop = asyncio.new_event_loop()
+        self.memos: List[Dict[bytes, bytes]] = [
+            {} for _ in range(cfg.n_validators)
+        ]
+        self.sched = Schedule(seed)
+        self.net = ModelNet(cfg, self.loop, self.memos)
+        self.cur_path: List[Transition] = []
+        self.seen: set = set()
+        self.violations: List[MCViolation] = []
+        self.stats: Dict[str, Any] = {
+            "states": 0,
+            "edges": 0,
+            "replays": 0,
+            "replay_steps": 0,
+            "dedup_hits": 0,
+            "sleep_skips": 0,
+            "terminals": 0,
+            "pruned_round_cap": 0,
+            "suppressed_done": 0,
+            "max_depth_seen": 0,
+            "unique_fingerprints": 0,
+            "stopped_by": "exhausted",
+        }
+
+    def close(self) -> None:
+        self.net.close()
+        self.loop.close()
+
+    # -- replay machinery ---------------------------------------------
+
+    def _goto(self, path: List[Transition]) -> None:
+        cur = self.cur_path
+        if len(path) >= len(cur) and path[: len(cur)] == cur:
+            suffix = path[len(cur) :]
+        else:
+            self.net.close()
+            self.net = ModelNet(self.cfg, self.loop, self.memos)
+            self.stats["replays"] += 1
+            self.stats["replay_steps"] += len(path)
+            suffix = path
+        for t in suffix:
+            self.net.apply(t)
+        self.cur_path = list(path)
+
+    # -- expansion ----------------------------------------------------
+
+    def _order(self, children: List[Transition], depth: int) -> List[Transition]:
+        """Schedule-seeded child order, deliveries before timeouts.
+
+        The partition is a search heuristic, not a restriction: DFS
+        still explores every child. Putting deliveries first means the
+        first dive follows the synchronous happy path — commits happen
+        within a few dozen transitions, so commit-conditioned
+        invariants (agreement, accountability) are probed immediately
+        instead of after the timeout-heavy asynchronous subtrees."""
+        label = f"mc:{depth}:{self.stats['states']}"
+        sched = Schedule(self.sched.subseed(label))
+        deliveries = sched.shuffled(sorted(t for t in children if t[0] == "d"))
+        timeouts = sched.shuffled(sorted(t for t in children if t[0] == "t"))
+        return deliveries + timeouts
+
+    def _record_violations(
+        self, found: List[Tuple[str, str]], path: List[Transition]
+    ) -> None:
+        for rule, message in found:
+            self.violations.append(
+                MCViolation(
+                    rule=rule,
+                    message=message,
+                    trace=Trace(
+                        seed=self.seed,
+                        config=self.cfg.describe(),
+                        transitions=list(path),
+                        rule=rule,
+                        message=message,
+                    ),
+                )
+            )
+
+    def run(self) -> ExploreResult:
+        t0 = time.perf_counter()
+        st = self.stats
+        net = self.net
+        try:
+            # root state — always recorded in ``seen``: the dedup flag
+            # controls subtree pruning, not unique-state bookkeeping
+            # (naive-mode coverage counts must be comparable)
+            self.seen.add(net.fingerprint())
+            st["states"] += 1
+            enabled = net.transitions()
+            st["pruned_round_cap"] += net.pruned_round_cap
+            st["suppressed_done"] += net.suppressed_done
+            self._record_violations(self.check(net, enabled), [])
+            if self.violations and self.stop_at_first:
+                st["stopped_by"] = "violation"
+                return ExploreResult(self.violations, self._finish(st, t0))
+            stack = [_Frame(path=[], todo=self._order(enabled, 0), sleep=frozenset())]
+
+            while stack:
+                if time.perf_counter() - t0 > self.budgets.wall_s:
+                    st["stopped_by"] = "wall_s"
+                    break
+                if st["states"] >= self.budgets.max_states:
+                    st["stopped_by"] = "max_states"
+                    break
+                if st["edges"] >= self.budgets.max_edges:
+                    st["stopped_by"] = "max_edges"
+                    break
+                frame = stack[-1]
+                if frame.next_i >= len(frame.todo):
+                    stack.pop()
+                    continue
+                t = frame.todo[frame.next_i]
+                frame.next_i += 1
+                if self.reduce and t in frame.sleep:
+                    st["sleep_skips"] += 1
+                    continue
+                explored_before = list(frame.done)
+                frame.done.append(t)
+                self._goto(frame.path)
+                self.net.apply(t)
+                net = self.net
+                st["edges"] += 1
+                path = frame.path + [t]
+                self.cur_path = path
+                st["max_depth_seen"] = max(st["max_depth_seen"], len(path))
+                fp = net.fingerprint()
+                if fp in self.seen:
+                    st["dedup_hits"] += 1
+                    if self.dedup:
+                        continue
+                self.seen.add(fp)
+                st["states"] += 1
+                if (
+                    self.target_unique is not None
+                    and len(self.seen) >= self.target_unique
+                ):
+                    st["stopped_by"] = "coverage"
+                    break
+                enabled = net.transitions()
+                st["pruned_round_cap"] += net.pruned_round_cap
+                st["suppressed_done"] += net.suppressed_done
+                found = self.check(net, enabled)
+                if found:
+                    self._record_violations(found, path)
+                    if self.stop_at_first:
+                        st["stopped_by"] = "violation"
+                        break
+                if net.all_done():
+                    st["terminals"] += 1
+                    continue
+                if len(path) >= self.budgets.max_depth:
+                    continue
+                if self.reduce:
+                    enabled_set = set(enabled)
+                    child_sleep = frozenset(
+                        x
+                        for x in (set(frame.sleep) | set(explored_before))
+                        if x[1] != t[1] and x in enabled_set
+                    )
+                else:
+                    child_sleep = frozenset()
+                stack.append(
+                    _Frame(
+                        path=path,
+                        todo=self._order(enabled, len(path)),
+                        sleep=child_sleep,
+                    )
+                )
+        finally:
+            self.close()
+        return ExploreResult(self.violations, self._finish(st, t0))
+
+    def _finish(self, st: Dict[str, Any], t0: float) -> Dict[str, Any]:
+        st["wall_s"] = round(time.perf_counter() - t0, 3)
+        st["unique_fingerprints"] = len(self.seen)
+        st["seed"] = self.seed
+        st["budgets"] = self.budgets.describe()
+        st["config"] = self.cfg.describe()
+        st["reduce"] = self.reduce
+        st["dedup"] = self.dedup
+        return st
+
+
+# ---------------------------------------------------------------------------
+# public API
+
+
+def explore(
+    cfg: MCConfig,
+    budgets: Optional[Budgets] = None,
+    seed: int = 0,
+    check: Optional[_CheckFn] = None,
+    reduce: bool = True,
+    dedup: bool = True,
+    stop_at_first: bool = True,
+    target_unique: Optional[int] = None,
+) -> ExploreResult:
+    """Exhaustively explore ``cfg`` within ``budgets``. On violation,
+    each MCViolation carries a replayable Trace; reproduce with::
+
+        python scripts/fuzz_repro.py --trace trace.json
+    """
+    ex = _Explorer(
+        cfg,
+        budgets or Budgets(),
+        seed,
+        check,
+        reduce=reduce,
+        dedup=dedup,
+        stop_at_first=stop_at_first,
+        target_unique=target_unique,
+    )
+    return ex.run()
+
+
+def _replay(
+    cfg: MCConfig,
+    transitions: List[Transition],
+    check: Optional[_CheckFn] = None,
+) -> Tuple[Optional[ModelNet], List[Tuple[str, str]], bool]:
+    """Apply ``transitions`` on a fresh net. Returns (net, violations
+    found at any prefix, all_enabled). The caller must ``close()`` the
+    returned net (and its loop via net.loop)."""
+    check = check if check is not None else _default_check
+    loop = asyncio.new_event_loop()
+    net = ModelNet(cfg, loop)
+    found: List[Tuple[str, str]] = []
+    seen_rules: set = set()
+
+    def _check_now() -> None:
+        enabled = net.transitions()
+        for rule, message in check(net, enabled):
+            if rule not in seen_rules:
+                seen_rules.add(rule)
+                found.append((rule, message))
+
+    _check_now()
+    for t in transitions:
+        enabled = net.transitions()
+        if t not in enabled:
+            return net, found, False
+        net.apply(t)
+        _check_now()
+    return net, found, True
+
+
+def replay_trace(
+    trace: Trace, check: Optional[_CheckFn] = None
+) -> Tuple[ModelNet, List[Tuple[str, str]], bool]:
+    """Re-execute a Trace. Returns (net, violations, complete). The
+    net is live (timelines, stores, evidence pools inspectable);
+    callers must ``net.close()`` and ``net.loop.close()``."""
+    cfg = MCConfig(
+        n_validators=trace.config["n_validators"],
+        target_height=trace.config["target_height"],
+        max_round=trace.config["max_round"],
+        byz=tuple(dict(s) for s in trace.config.get("byz", ())),
+    )
+    return _replay(cfg, list(trace.transitions), check)
+
+
+def minimize_trace(
+    trace: Trace,
+    check: Optional[_CheckFn] = None,
+    max_passes: int = 4,
+) -> Trace:
+    """Greedy delta-debugging: repeatedly drop single transitions (in
+    reverse order) while the replay still reaches a violation of the
+    same rule with every remaining transition enabled."""
+
+    def _still_fails(transitions: List[Transition]) -> bool:
+        net, found, complete = _replay(
+            _cfg_of(trace), transitions, check
+        )
+        net.close()
+        net.loop.close()
+        return complete and any(rule == trace.rule for rule, _ in found)
+
+    best = list(trace.transitions)
+    for _ in range(max_passes):
+        shrunk = False
+        i = len(best) - 1
+        while i >= 0:
+            candidate = best[:i] + best[i + 1 :]
+            if _still_fails(candidate):
+                best = candidate
+                shrunk = True
+            i -= 1
+        if not shrunk:
+            break
+    return Trace(
+        seed=trace.seed,
+        config=trace.config,
+        transitions=best,
+        rule=trace.rule,
+        message=trace.message,
+    )
+
+
+def _cfg_of(trace: Trace) -> MCConfig:
+    return MCConfig(
+        n_validators=trace.config["n_validators"],
+        target_height=trace.config["target_height"],
+        max_round=trace.config["max_round"],
+        byz=tuple(dict(s) for s in trace.config.get("byz", ())),
+    )
+
+
+def measure_reduction(
+    cfg: MCConfig,
+    budgets: Optional[Budgets] = None,
+    seed: int = 0,
+    naive_edge_factor: float = 12.0,
+    naive_wall_s: float = 120.0,
+) -> Dict[str, Any]:
+    """Exhausted-horizon comparison of reduced vs naive enumeration.
+
+    The reduced run (sleep sets + dedup) must EXHAUST its horizon —
+    use a budget whose depth bound is reachable (the gate/bench budget
+    is tuned for this). Its unique-fingerprint count is then the
+    complete coverage of that subspace. The naive run (no sleep sets,
+    no dedup pruning) re-enumerates the same subspace path by path and
+    stops as soon as it has *seen* every state the reduced run covered
+    (``stopped_by == "coverage"``), exhausts the tree itself, or burns
+    ``naive_edge_factor`` times the reduced edge count / the wall cap
+    without getting there — whichever is first.
+
+    Two ratios at that point:
+
+        reduction_x (= states_x) = naive state visits / reduced state
+                                   visits — the classic POR metric
+        edges_x                  = naive edges / reduced edges
+
+    When the naive run matched coverage or exhausted, the ratios are
+    exact for that horizon; otherwise (``coverage_matched`` False,
+    ``reduction_lower_bound`` True) they are lower bounds: even that
+    much naive effort did not reproduce what the reduced run covered
+    exhaustively.
+    """
+    budgets = budgets or Budgets()
+    reduced = explore(
+        cfg, budgets, seed=seed, reduce=True, dedup=True,
+        stop_at_first=False,
+    )
+    target = reduced.stats["unique_fingerprints"]
+    naive_budget = Budgets(
+        max_states=10**9,
+        max_depth=budgets.max_depth,
+        max_edges=int(reduced.stats["edges"] * naive_edge_factor),
+        wall_s=naive_wall_s,
+    )
+    naive = explore(
+        cfg,
+        naive_budget,
+        seed=seed,
+        reduce=False,
+        dedup=False,
+        stop_at_first=False,
+        target_unique=target,
+    )
+    matched = naive.stats["unique_fingerprints"] >= target
+    exact = matched or naive.stats["stopped_by"] == "exhausted"
+    states_x = naive.stats["states"] / max(1, reduced.stats["states"])
+    edges_x = naive.stats["edges"] / max(1, reduced.stats["edges"])
+    return {
+        "reduced": reduced.stats,
+        "naive": naive.stats,
+        "reduced_exhausted": reduced.stats["stopped_by"] == "exhausted",
+        "coverage_matched": matched,
+        "reduction_lower_bound": not exact,
+        "reduction_x": round(states_x, 2),
+        "edges_x": round(edges_x, 2),
+    }

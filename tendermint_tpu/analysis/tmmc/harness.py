@@ -1,0 +1,716 @@
+"""ModelNet — N real ConsensusState instances with the network and the
+clock lifted into explicit, enumerable transition sets.
+
+The model checker needs three things the live node assembly hides:
+
+1. **Explicit nondeterminism.** Every inter-node message (vote,
+   proposal, block part) lands in the *receiver's* ``pending`` dict
+   instead of a socket; every scheduled timeout parks in a single
+   per-node slot instead of an asyncio timer. A transition is "deliver
+   one pending message to one node" or "fire one pending timeout" —
+   nothing else moves the system.
+
+2. **Determinism under re-execution.** Stateless exploration replays
+   prefixes from the root thousands of times, so every wallclock read
+   in the hot path is replaced: ``cs._vote_time`` becomes a per-node
+   logical clock, MemoPV pins proposal timestamps from the same clock
+   (MockPV would stamp ``time.time_ns()``), and ed25519 signing — the
+   dominant cost at ~0.5 ms/signature — is memoized per validator
+   across replays keyed by sign-bytes.
+
+3. **The real adversary.** Byzantine behavior is NOT re-modeled: the
+   PR-18 ``consensus/byzantine.py`` catalog is armed via its own
+   ``inject()`` seam and installed with its own ``maybe_install()``
+   against a duck-typed ``_ModelReactor``, so the lies the checker
+   explores are byte-for-byte the lies the chaos campaigns send.
+   Model configs restrict rules to p=1.0 / times=None so firing is a
+   pure function of (height, round, step) and replays are exact.
+
+Message-loss modeling: the model delivers messages at most once and
+never drops an *enabled* one, but purges messages the receiver can no
+longer use (past-height votes, stale proposals, duplicate parts).
+Future-height/round messages are *held* (disabled, not purged) until
+the receiver catches up — this models the real reactor's catchup
+gossip, which re-offers state a late peer missed; consuming such a
+message as a no-op would instead model unrecoverable loss and produce
+stall artifacts the real network cannot exhibit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...abci import KVStoreApplication, LocalClient
+from ...config import ConsensusConfig, MempoolConfig
+from ...consensus import ConsensusState, RoundStep
+from ...consensus import byzantine
+from ...consensus.msgs import (
+    BlockPartMessage,
+    MsgInfo,
+    ProposalMessage,
+    TimeoutInfo,
+    VoteMessage,
+)
+from ...consensus.timeline import TimelineRecorder
+from ...crypto.ed25519 import PrivKeyEd25519
+from ...evidence.pool import EvidencePool
+from ...mempool import TxMempool
+from ...privval import MockPV
+from ...state import StateStore, state_from_genesis
+from ...state.execution import BlockExecutor
+from ...store.block_store import BlockStore
+from ...store.kv import MemKV
+from ...types.genesis import GenesisDoc, GenesisValidator
+
+MC_CHAIN_ID = "tmmc-chain"
+_GENESIS_TIME_NS = 1_700_000_000_000_000_000
+_MS = 1_000_000  # ns
+
+
+def _h8(b: Optional[bytes]) -> str:
+    """Short stable hex tag for hashes inside transition keys."""
+    return b.hex()[:12] if b else "nil"
+
+
+# ---------------------------------------------------------------------------
+# configuration
+
+
+@dataclass(frozen=True)
+class MCConfig:
+    """One model-checking scenario: validators, horizon, adversary."""
+
+    n_validators: int = 4
+    target_height: int = 2
+    max_round: int = 1
+    power: int = 10
+    # byzantine rule specs: kwargs for byzantine.inject(); the victim
+    # moniker must be one of mc0..mc{n-1}
+    byz: Tuple[Dict[str, Any], ...] = ()
+    chain_id: str = MC_CHAIN_ID
+
+    def __post_init__(self) -> None:
+        if self.n_validators < 1:
+            raise ValueError("n_validators must be >= 1")
+        if self.target_height < 1:
+            raise ValueError("target_height must be >= 1")
+        if self.max_round < 0:
+            raise ValueError("max_round must be >= 0")
+        for spec in self.byz:
+            if spec.get("p", 1.0) != 1.0 or spec.get("times") is not None:
+                # probabilistic/counted rules carry module-global rng +
+                # fired state across re-executions; the checker needs
+                # firing to be a pure function of (height, round, step)
+                raise ValueError(
+                    "model-checked byz rules must be deterministic: "
+                    f"p=1.0 and times=None required, got {spec!r}"
+                )
+            victim = spec.get("victim", "load1")
+            if not (victim.startswith("mc") and victim[2:].isdigit()):
+                raise ValueError(
+                    f"byz victim must be an mc<N> moniker, got {victim!r}"
+                )
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "n_validators": self.n_validators,
+            "target_height": self.target_height,
+            "max_round": self.max_round,
+            "byz": [dict(s) for s in self.byz],
+        }
+
+
+# key derivation + genesis are pure functions of (n, power, chain) and
+# get rebuilt on every backtrack replay — memoized for the exploration
+# lifetime. tmlive: bounded= keyed by distinct MCConfig shapes, a
+# handful per process
+_KEYGEN_CACHE: Dict[Tuple[int, int, str], Tuple[list, GenesisDoc]] = {}
+
+
+def _keys_and_genesis(n: int, power: int, chain_id: str):
+    cached = _KEYGEN_CACHE.get((n, power, chain_id))
+    if cached is None:
+        privs = [
+            PrivKeyEd25519.from_seed(bytes([i + 1]) * 32) for i in range(n)
+        ]
+        genesis = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time_ns=_GENESIS_TIME_NS,
+            validators=[
+                GenesisValidator(pub_key=p.pub_key(), power=power)
+                for p in privs
+            ],
+        )
+        cached = (privs, genesis)
+        _KEYGEN_CACHE[(n, power, chain_id)] = cached
+    return cached
+
+
+def _mc_consensus_config() -> ConsensusConfig:
+    # durations are irrelevant (the stub ticker never sleeps); the
+    # flags that change step logic are what matter
+    return ConsensusConfig(
+        timeout_propose=0.1,
+        timeout_propose_delta=0.0,
+        timeout_prevote=0.1,
+        timeout_prevote_delta=0.0,
+        timeout_precommit=0.1,
+        timeout_precommit_delta=0.0,
+        timeout_commit=0.01,
+        skip_timeout_commit=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# determinism shims
+
+
+class _StubTicker:
+    """Ticker twin that parks the newest timeout in ``node.pending_timeout``
+    instead of arming an asyncio timer (same replacement discipline as
+    consensus/ticker.py TimeoutTicker.schedule)."""
+
+    def __init__(self, node: "ModelNode") -> None:
+        self._node = node
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        cur = self._node.pending_timeout
+        if cur is not None:
+            if ti.height < cur.height:
+                return
+            if ti.height == cur.height:
+                if ti.round < cur.round:
+                    return
+                if (
+                    ti.round == cur.round
+                    and cur.step > 0
+                    and ti.step <= cur.step
+                ):
+                    return
+        self._node.pending_timeout = ti
+
+    async def start(self) -> None:  # Service duck-typing; never used
+        return None
+
+    async def stop(self) -> None:
+        self._node.pending_timeout = None
+
+
+class MemoPV(MockPV):
+    """MockPV with (a) logical proposal timestamps and (b) signature
+    memoization across replays.
+
+    MockPV stamps ``time.time_ns()`` into zero-timestamp proposals,
+    which would make every re-executed prefix diverge; votes are
+    already pinned because the harness patches ``cs._vote_time``.
+    The memo dict is per-validator and owned by the explorer so the
+    ~0.5 ms ed25519 signing cost is paid once per distinct message
+    across the whole exploration, not once per replay.
+    """
+
+    def __init__(self, priv, clock, memo: Dict[bytes, bytes]) -> None:
+        super().__init__(priv)
+        self._clock = clock
+        self._memo = memo
+
+    async def sign_vote(self, chain_id: str, vote) -> None:
+        sb = vote.sign_bytes(chain_id)
+        sig = self._memo.get(sb)
+        if sig is None:
+            sig = self.priv_key.sign(sb)
+            self._memo[sb] = sig
+        vote.signature = sig
+
+    async def sign_proposal(self, chain_id: str, proposal) -> None:
+        if proposal.timestamp_ns == 0:
+            proposal.timestamp_ns = self._clock()
+        sb = proposal.sign_bytes(chain_id)
+        sig = self._memo.get(sb)
+        if sig is None:
+            sig = self.priv_key.sign(sb)
+            self._memo[sb] = sig
+        proposal.signature = sig
+
+
+# ---------------------------------------------------------------------------
+# adversary adapter
+
+
+class _ModelChannel:
+    """Duck-typed p2p channel: ByzantineHarness.try_send lands the evil
+    message straight in the target node's pending set."""
+
+    def __init__(self, net: "ModelNet") -> None:
+        self._net = net
+
+    def try_send(self, env) -> bool:
+        self._net._enqueue_for(env.to, env.message)
+        return True
+
+
+class _ModelReactor:
+    """The slice of ConsensusReactor that byzantine.ByzantineHarness
+    touches: ``.peers`` for target selection, ``.vote_ch``/``.data_ch``
+    for sending."""
+
+    def __init__(self, net: "ModelNet", node: "ModelNode") -> None:
+        self.peers = [
+            n.moniker for n in net.nodes if n.moniker != node.moniker
+        ]
+        self.vote_ch = _ModelChannel(net)
+        self.data_ch = _ModelChannel(net)
+
+
+# ---------------------------------------------------------------------------
+# nodes
+
+
+@dataclass
+class ModelNode:
+    index: int
+    moniker: str
+    priv: Any
+    cs: ConsensusState
+    evpool: EvidencePool
+    block_store: BlockStore
+    state_store: StateStore
+    timeline: TimelineRecorder
+    # pending[key] = message object; key encodes identity so duplicate
+    # gossip collapses (setdefault) and evil twins stay distinct
+    pending: Dict[Tuple, Any] = field(default_factory=dict)
+    pending_timeout: Optional[TimeoutInfo] = None
+    clock_ns: int = 0
+    # (equivocator height, equivocator addr tag, local store height at
+    # detection) — written by the evpool spy, read by accountability
+    detections: List[Tuple[int, str, int]] = field(default_factory=list)
+    byz_harness: Optional[Any] = None
+
+    def done(self, target_height: int) -> bool:
+        return self.block_store.height() >= target_height
+
+    def _vote_time(self) -> int:
+        st = self.cs.state
+        floor = (
+            st.last_block_time_ns + _MS
+            if st is not None and st.last_block_time_ns > 0
+            else _GENESIS_TIME_NS + _MS
+        )
+        self.clock_ns = max(self.clock_ns + _MS, floor)
+        return self.clock_ns
+
+
+# ---------------------------------------------------------------------------
+# the net
+
+
+class ModelNet:
+    """N-validator model universe. Mutated only through ``apply()``;
+    rebuilt from scratch (same cfg) when the explorer backtracks past
+    the current path."""
+
+    def __init__(
+        self,
+        cfg: MCConfig,
+        loop: asyncio.AbstractEventLoop,
+        sign_memos: Optional[List[Dict[bytes, bytes]]] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.loop = loop
+        self.sign_memos = (
+            sign_memos
+            if sign_memos is not None
+            else [{} for _ in range(cfg.n_validators)]
+        )
+        self.nodes: List[ModelNode] = []
+        self._by_moniker: Dict[str, ModelNode] = {}
+        # block hashes produced by any honest proposer (validity set)
+        self.proposed: set = set()
+        # enumeration bookkeeping from the last transitions() call
+        self.pruned_round_cap = 0
+        self.suppressed_done = 0
+        self._byz_stack = contextlib.ExitStack()
+        self._closed = False
+        self._build()
+
+    # -- construction -------------------------------------------------
+
+    def _build(self) -> None:
+        cfg = self.cfg
+        privs, genesis = _keys_and_genesis(
+            cfg.n_validators, cfg.power, cfg.chain_id
+        )
+        byzantine.reset()
+        for spec in cfg.byz:
+            self._byz_stack.enter_context(byzantine.inject(**spec))
+
+        for i, priv in enumerate(privs):
+            moniker = f"mc{i}"
+            app = KVStoreApplication()
+            client = LocalClient(app)
+            state_store = StateStore(MemKV())
+            state = state_from_genesis(genesis)
+            state_store.save(state)
+            block_store = BlockStore(MemKV())
+            evpool = EvidencePool(MemKV(), state_store, block_store)
+            mempool = TxMempool(client, MempoolConfig())
+            block_exec = BlockExecutor(
+                state_store,
+                client,
+                mempool,
+                block_store=block_store,
+                evidence_pool=evpool,
+            )
+            timeline = TimelineRecorder(capacity=4096)
+            node = ModelNode(
+                index=i,
+                moniker=moniker,
+                priv=priv,
+                cs=None,  # type: ignore[arg-type]  # set just below
+                evpool=evpool,
+                block_store=block_store,
+                state_store=state_store,
+                timeline=timeline,
+            )
+            pv = MemoPV(priv, node._vote_time, self.sign_memos[i])
+            cs = ConsensusState(
+                _mc_consensus_config(),
+                state,
+                block_exec,
+                block_store,
+                privval=pv,
+                evidence_pool=evpool,
+                timeline=timeline,
+            )
+            node.cs = cs
+            # the start() work the model does synchronously: pubkey
+            # fetch, ticker swap, round-0 schedule — no services run
+            cs.privval_pub_key = priv.pub_key()
+            cs.ticker = _StubTicker(node)
+            cs._vote_time = node._vote_time
+            self._spy_evpool(node)
+            self._spy_proposals(block_exec)
+            self.nodes.append(node)
+            self._by_moniker[moniker] = node
+
+        for node in self.nodes:
+            reactor = _ModelReactor(self, node)
+            node.byz_harness = byzantine.maybe_install(
+                node.cs, reactor, node.moniker
+            )
+            node.cs._schedule_round_0()
+
+    def _spy_evpool(self, node: ModelNode) -> None:
+        orig = node.evpool.report_conflicting_votes
+
+        def spy(vote_a, vote_b, _node=node, _orig=orig):
+            _node.detections.append(
+                (
+                    vote_a.height,
+                    _h8(vote_a.validator_address),
+                    _node.block_store.height(),
+                )
+            )
+            return _orig(vote_a, vote_b)
+
+        node.evpool.report_conflicting_votes = spy  # type: ignore[assignment]
+
+    def _spy_proposals(self, block_exec: BlockExecutor) -> None:
+        orig = block_exec.create_proposal_block
+
+        def spy(height, state, commit, addr, _orig=orig):
+            block, parts = _orig(height, state, commit, addr)
+            self.proposed.add(block.hash())
+            return block, parts
+
+        block_exec.create_proposal_block = spy  # type: ignore[assignment]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._byz_stack.close()
+        byzantine.reset()
+
+    # -- message plumbing ---------------------------------------------
+
+    @staticmethod
+    def key_for(msg) -> Optional[Tuple]:
+        if isinstance(msg, VoteMessage):
+            v = msg.vote
+            return (
+                "v",
+                v.height,
+                v.round,
+                v.type,
+                v.validator_index,
+                _h8(v.block_id.hash),
+            )
+        if isinstance(msg, ProposalMessage):
+            p = msg.proposal
+            return ("p", p.height, p.round, p.pol_round, _h8(p.block_id.hash))
+        if isinstance(msg, BlockPartMessage):
+            root = msg.part.proof.compute_root_hash()
+            return ("b", msg.height, msg.round, msg.part.index, _h8(root))
+        return None
+
+    def _enqueue_for(self, moniker: str, msg) -> None:
+        key = self.key_for(msg)
+        if key is None:
+            return
+        self._by_moniker[moniker].pending.setdefault(key, msg)
+
+    def _broadcast(self, src: ModelNode, msg) -> None:
+        key = self.key_for(msg)
+        if key is None:
+            return
+        for node in self.nodes:
+            if node is not src:
+                node.pending.setdefault(key, msg)
+
+    async def _drain_internal(self, node: ModelNode) -> None:
+        """Process the node's own outputs synchronously, broadcasting
+        each to peers first (mirrors the receive-loop's internal-first
+        priority without running the loop)."""
+        q = node.cs.internal_msg_queue
+        while not q.empty():
+            mi = q.get_nowait()
+            self._broadcast(node, mi.msg)
+            await node.cs._handle_msg(mi)
+
+    # -- enabledness --------------------------------------------------
+
+    def _deliverable(self, node: ModelNode, key: Tuple) -> bool:
+        rs = node.cs.rs
+        kind = key[0]
+        if kind == "v":
+            # exact-height only; the late-precommit catchup path
+            # (vote.height+1 == rs.height) is reached via held votes
+            # delivered before the receiver advanced
+            return key[1] == rs.height
+        if kind == "p":
+            return (
+                key[1] == rs.height
+                and key[2] == rs.round
+                and rs.proposal is None
+            )
+        if kind == "b":
+            if key[1] != rs.height:
+                return False
+            ps = rs.proposal_block_parts
+            if ps is None:
+                return False  # held until the proposal header lands
+            return (
+                _h8(ps.header().hash) == key[4]
+                and key[3] < ps.total
+                and ps.get_part(key[3]) is None
+            )
+        return False
+
+    def _purge(self) -> None:
+        """Drop pending messages and timeouts the receiver can never
+        use again. Run after every transition so equal states have
+        equal pending sets (the fingerprint covers them)."""
+        for node in self.nodes:
+            rs = node.cs.rs
+            dead = []
+            for key in node.pending:
+                kind = key[0]
+                if kind == "v":
+                    if key[1] < rs.height:
+                        dead.append(key)
+                elif kind == "p":
+                    if key[1] < rs.height or (
+                        key[1] == rs.height
+                        and (
+                            key[2] < rs.round
+                            or (key[2] == rs.round and rs.proposal is not None)
+                        )
+                    ):
+                        dead.append(key)
+                elif kind == "b":
+                    if key[1] < rs.height:
+                        dead.append(key)
+                    else:
+                        ps = rs.proposal_block_parts
+                        if (
+                            key[1] == rs.height
+                            and ps is not None
+                            and _h8(ps.header().hash) == key[4]
+                            and key[3] < ps.total
+                            and ps.get_part(key[3]) is not None
+                        ):
+                            dead.append(key)
+            for key in dead:
+                del node.pending[key]
+            ti = node.pending_timeout
+            if ti is not None and (
+                ti.height != rs.height
+                or ti.round < rs.round
+                or (ti.round == rs.round and ti.step < rs.step)
+            ):
+                # _handle_timeout would ignore it (state.py stale guard)
+                node.pending_timeout = None
+
+    def transitions(self) -> List[Tuple]:
+        """Enabled transitions: ("t", node_idx) fires the pending
+        timeout, ("d", node_idx, key) delivers one pending message.
+        Also refreshes pruning counters (round cap, finished nodes)."""
+        self.pruned_round_cap = 0
+        self.suppressed_done = 0
+        out: List[Tuple] = []
+        for node in self.nodes:
+            node_trans: List[Tuple] = []
+            ti = node.pending_timeout
+            if ti is not None:
+                if (
+                    ti.step == RoundStep.PRECOMMIT_WAIT
+                    and ti.round >= self.cfg.max_round
+                ):
+                    # round horizon: never advance past max_round
+                    self.pruned_round_cap += 1
+                else:
+                    node_trans.append(("t", node.index))
+            for key in sorted(node.pending):
+                if self._deliverable(node, key):
+                    node_trans.append(("d", node.index, key))
+            if node.done(self.cfg.target_height):
+                # finished nodes stop acting; their already-broadcast
+                # messages stay deliverable at laggards
+                self.suppressed_done += len(node_trans)
+            else:
+                out.extend(node_trans)
+        return out
+
+    def all_done(self) -> bool:
+        return all(n.done(self.cfg.target_height) for n in self.nodes)
+
+    # -- execution ----------------------------------------------------
+
+    def apply(self, t: Tuple) -> None:
+        self.loop.run_until_complete(self._apply_async(t))
+
+    async def _apply_async(self, t: Tuple) -> None:
+        node = self.nodes[t[1]]
+        if t[0] == "t":
+            ti = node.pending_timeout
+            if ti is None:
+                raise RuntimeError(f"timeout transition not enabled: {t}")
+            node.pending_timeout = None
+            await node.cs._handle_timeout(ti)
+        else:
+            msg = node.pending.pop(t[2], None)
+            if msg is None or not self._deliverable_key_ok(node, t[2], msg):
+                raise RuntimeError(f"deliver transition not enabled: {t}")
+            await node.cs._handle_msg(MsgInfo(msg=msg, peer_id="mc-net"))
+        await self._drain_internal(node)
+        self._purge()
+
+    def _deliverable_key_ok(self, node: ModelNode, key: Tuple, msg) -> bool:
+        # re-add so _deliverable sees a consistent view, then remove
+        node.pending[key] = msg
+        ok = self._deliverable(node, key)
+        del node.pending[key]
+        return ok
+
+    # -- fingerprint ---------------------------------------------------
+
+    def fingerprint(self) -> bytes:
+        acc: List[Tuple] = []
+        for node in self.nodes:
+            rs = node.cs.rs
+            votes_fp: List[Tuple] = []
+            if rs.votes is not None:
+                for r in sorted(rs.votes._round_vote_sets):
+                    pv, pc = rs.votes._round_vote_sets[r]
+                    votes_fp.append(
+                        (
+                            r,
+                            tuple(
+                                sorted(
+                                    (v.validator_index, _h8(v.block_id.hash))
+                                    for v in pv.list_votes()
+                                )
+                            ),
+                            tuple(
+                                sorted(
+                                    (v.validator_index, _h8(v.block_id.hash))
+                                    for v in pc.list_votes()
+                                )
+                            ),
+                        )
+                    )
+            lc = rs.last_commit
+            lc_fp = (
+                tuple(sorted(v.validator_index for v in lc.list_votes()))
+                if lc is not None
+                else ()
+            )
+            chain = []
+            for h in range(1, node.block_store.height() + 1):
+                meta = node.block_store.load_block_meta(h)
+                chain.append(_h8(meta.block_id.hash) if meta else "gone")
+            ps = rs.proposal_block_parts
+            ps_fp = (
+                (
+                    _h8(ps.header().hash),
+                    sum(
+                        1 << i
+                        for i, part in enumerate(ps.parts)
+                        if part is not None
+                    ),
+                )
+                if ps is not None
+                else None
+            )
+            prop = rs.proposal
+            prop_fp = (
+                (prop.height, prop.round, prop.pol_round, _h8(prop.block_id.hash))
+                if prop is not None
+                else None
+            )
+            ti = node.pending_timeout
+            harness = node.byz_harness
+            acc.append(
+                (
+                    rs.height,
+                    rs.round,
+                    rs.step,
+                    prop_fp,
+                    ps_fp,
+                    _h8(rs.proposal_block.hash())
+                    if rs.proposal_block is not None
+                    else None,
+                    (
+                        rs.locked_round,
+                        _h8(rs.locked_block.hash())
+                        if rs.locked_block is not None
+                        else None,
+                    ),
+                    (
+                        rs.valid_round,
+                        _h8(rs.valid_block.hash())
+                        if rs.valid_block is not None
+                        else None,
+                    ),
+                    rs.triggered_timeout_precommit,
+                    tuple(votes_fp),
+                    lc_fp,
+                    tuple(chain),
+                    _h8(node.cs.state.app_hash),
+                    tuple(sorted(_h8(ev.hash()) for ev in node.evpool._pending)),
+                    tuple(
+                        (va.height, _h8(va.validator_address))
+                        for va, _vb in node.evpool._consensus_buffer
+                    ),
+                    tuple(sorted(node.pending)),
+                    (ti.height, ti.round, ti.step) if ti is not None else None,
+                    node.clock_ns,
+                    tuple(harness.fired) if harness is not None else (),
+                    tuple(node.detections),
+                )
+            )
+        return hashlib.sha1(repr(acc).encode()).digest()

@@ -1,0 +1,148 @@
+"""Machine-checked consensus invariants, evaluated at EVERY explored
+state.
+
+Each checker returns a list of human-readable messages; ``check_all``
+tags them with the gate rule name. The gate anchors its
+tmlint.Violation at the ``def`` line of the failed checker, so the
+suppression form ``# tmmc: mc-ok`` (or ``# tmmc: mc-ok=<rule>``) on
+that line is what the lint substrate scans.
+
+| rule              | property                                        |
+|-------------------|-------------------------------------------------|
+| mc-agreement      | no two nodes commit different block IDs at a    |
+|                   | height                                          |
+| mc-validity       | every committed block was produced by an honest |
+|                   | proposer (never the byzantine EVIL block)       |
+| mc-accountability | every *detected* equivocation has pending or    |
+|                   | committed DuplicateVoteEvidence once the        |
+|                   | detecting node's pool has run an update         |
+| mc-stall          | some transition is enabled while any node is    |
+|                   | below the target height (modulo the round cap)  |
+
+Accountability deliberately conditions on DETECTION, not on the
+adversary having fired: an evil vote delivered after its victim moved
+past the height is silently dropped by the real implementation (no
+conflict is ever observed), which is correct behavior, not an
+accountability failure. The harness records detections by spying on
+``evpool.report_conflicting_votes``; once the detecting node's store
+advances past the detection point (so ``EvidencePool.update`` has
+processed the consensus buffer), matching evidence must exist.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ...consensus.byzantine import EVIL_BLOCK_ID
+
+
+def check_agreement(net) -> List[str]:
+    out: List[str] = []
+    by_height = {}
+    for node in net.nodes:
+        for h in range(1, node.block_store.height() + 1):
+            meta = node.block_store.load_block_meta(h)
+            if meta is None:
+                continue
+            first = by_height.setdefault(
+                h, (node.moniker, meta.block_id.hash)
+            )
+            if first[1] != meta.block_id.hash:
+                out.append(
+                    f"height {h}: {first[0]} committed "
+                    f"{first[1].hex()[:12]} but {node.moniker} committed "
+                    f"{meta.block_id.hash.hex()[:12]}"
+                )
+    return out
+
+
+def check_validity(net) -> List[str]:
+    out: List[str] = []
+    for node in net.nodes:
+        for h in range(1, node.block_store.height() + 1):
+            meta = node.block_store.load_block_meta(h)
+            if meta is None:
+                continue
+            bh = meta.block_id.hash
+            if bh == EVIL_BLOCK_ID.hash:
+                out.append(
+                    f"{node.moniker} committed the byzantine EVIL block "
+                    f"at height {h}"
+                )
+            elif bh not in net.proposed:
+                out.append(
+                    f"{node.moniker} committed {bh.hex()[:12]} at height "
+                    f"{h} which no honest proposer produced"
+                )
+    return out
+
+
+def check_accountability(net) -> List[str]:
+    out: List[str] = []
+    for node in net.nodes:
+        for eq_height, addr_tag, store_at_detect in node.detections:
+            if node.block_store.height() <= store_at_detect:
+                # no EvidencePool.update has run since the detection;
+                # the double-sign is still in the consensus buffer
+                continue
+            if _has_matching_evidence(node, eq_height, addr_tag):
+                continue
+            out.append(
+                f"{node.moniker} detected equivocation by {addr_tag} at "
+                f"height {eq_height} (store height {store_at_detect}) but "
+                f"holds no pending or committed DuplicateVoteEvidence at "
+                f"store height {node.block_store.height()}"
+            )
+    return out
+
+
+def _has_matching_evidence(node, eq_height: int, addr_tag: str) -> bool:
+    def _matches(ev) -> bool:
+        vote_a = getattr(ev, "vote_a", None)
+        return (
+            vote_a is not None
+            and vote_a.height == eq_height
+            and vote_a.validator_address.hex()[:12] == addr_tag
+        )
+
+    if any(_matches(ev) for ev in node.evpool._pending):
+        return True
+    for h in range(1, node.block_store.height() + 1):
+        block = node.block_store.load_block(h)
+        if block is not None and any(_matches(ev) for ev in block.evidence):
+            return True
+    return False
+
+
+def check_stall(net, enabled) -> List[str]:
+    if net.all_done():
+        return []
+    if enabled:
+        return []
+    if net.pruned_round_cap > 0 or net.suppressed_done > 0:
+        # progress exists beyond the exploration horizon (a capped
+        # round advance, or a finished node's suppressed actions) —
+        # the model cut it, the protocol didn't stall
+        return []
+    lagging = [
+        f"{n.moniker}@h{n.cs.rs.height}r{n.cs.rs.round}s{n.cs.rs.step}"
+        for n in net.nodes
+        if not n.done(net.cfg.target_height)
+    ]
+    return [
+        "no transition enabled while nodes are below target height "
+        f"{net.cfg.target_height}: {', '.join(lagging)}"
+    ]
+
+
+def check_all(net, enabled) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    for msg in check_agreement(net):
+        out.append(("mc-agreement", msg))
+    for msg in check_validity(net):
+        out.append(("mc-validity", msg))
+    for msg in check_accountability(net):
+        out.append(("mc-accountability", msg))
+    for msg in check_stall(net, enabled):
+        out.append(("mc-stall", msg))
+    return out

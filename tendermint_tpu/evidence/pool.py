@@ -102,6 +102,10 @@ class EvidencePool:
         """Block-validation path: every item must verify and not be
         committed; duplicates in one block are invalid
         (reference: pool.go:206-260)."""
+        if not evidence:
+            # the overwhelmingly common case — don't pay a full state
+            # decode (ValidatorSet included) per evidence-free block
+            return
         state = self.state_store.load()
         seen = set()
         for ev in evidence:

@@ -84,12 +84,28 @@ class Header:
     evidence_hash: bytes = b""
     proposer_address: bytes = b""
 
+    # every field feeds the field-merkle, so assigning ANY attribute
+    # (the dataclass __init__ included) drops the hash memo below —
+    # same invalidation discipline as Vote._SB_FIELDS
+    def __setattr__(self, name: str, value) -> None:
+        self.__dict__.pop("_hash_memo", None)
+        object.__setattr__(self, name, value)
+
     def hash(self) -> bytes:
         """Merkle tree over the 14 fields in declaration order
         (reference: types/block.go:448-485). Empty if ValidatorsHash is
-        missing (header not yet populated)."""
+        missing (header not yet populated).
+
+        Memoized: one header is hashed repeatedly on the hot path
+        (proposal/part-set identity, prevote targets, validate_block,
+        commit finalization, evidence time lookups), always with
+        identical fields. __setattr__ invalidation means mutation can
+        never serve a stale hash."""
         if not self.validators_hash:
             return b""
+        memo = self.__dict__.get("_hash_memo")
+        if memo is not None:
+            return memo
         leaves = [
             self.version.to_proto(),
             _cdc_string(self.chain_id),
@@ -106,7 +122,9 @@ class Header:
             _cdc_bytes(self.evidence_hash),
             _cdc_bytes(self.proposer_address),
         ]
-        return merkle.hash_from_byte_slices(leaves)
+        h = merkle.hash_from_byte_slices(leaves)
+        self.__dict__["_hash_memo"] = h
+        return h
 
     def validate_basic(self) -> None:
         if len(self.chain_id) > 50:

@@ -76,6 +76,42 @@ def _release_compiled_executables():
     gc.collect()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _byz_plane_leak_guard():
+    """Fail fast when a test module leaks the byzantine plane.
+
+    The adversary plane is ambient process state (TM_TPU_BYZ env,
+    byzantine._RULES, the installed-harness registry): a module that
+    arms it and forgets to disarm silently turns every LATER module's
+    consensus nodes byzantine — failures would surface far from the
+    leak (the tmmc model checker is especially exposed: its builds
+    call byzantine.maybe_install on every node). Checked at every
+    module boundary; the plane is healed before failing so one leak
+    produces one failure, not a cascade."""
+    yield
+    import os as _os
+
+    from tendermint_tpu.consensus import byzantine
+
+    leaks = []
+    if _os.environ.get("TM_TPU_BYZ"):
+        leaks.append(f"TM_TPU_BYZ={_os.environ['TM_TPU_BYZ']!r} still set")
+    n_rules = len(byzantine.rules())
+    if n_rules:
+        leaks.append(f"{n_rules} armed rule(s)")
+    n_harn = len(byzantine.harnesses())
+    if n_harn:
+        leaks.append(f"{n_harn} registered harness(es)")
+    if leaks:
+        _os.environ.pop("TM_TPU_BYZ", None)
+        byzantine.reset()
+        pytest.fail(
+            "byzantine plane leaked past a test module: "
+            + "; ".join(leaks)
+            + " (arm via monkeypatch/ExitStack and reset() in teardown)"
+        )
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running gates (ASAN sweep, big e2e runs)"

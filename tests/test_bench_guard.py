@@ -312,6 +312,49 @@ print("OK")
     assert "OK" in r.stdout
 
 
+def test_tmmc_gate_row_never_initializes_jax():
+    """Same contract for the ISSUE-19 tmmc_gate row: the model
+    harness drives the REAL consensus implementation with in-memory
+    stores — pure-CPU protocol execution, jax must never load.
+    TM_TPU_MC_BENCH_FAST shrinks the reduction horizon so this guard
+    stays cheap; the banked full-run record (and its persist) is only
+    written by real bench runs."""
+    import json as _json
+
+    script = """
+import json, sys
+sys.path.insert(0, %r)
+import bench
+row = bench.bench_tmmc_gate()
+assert row["gate_wall_s"] > 0 and row["gate_states"] > 0
+assert row["gate_violations"] == 0
+assert row["reduction_x"] >= 1.0
+assert "jax" not in sys.modules, "tmmc_gate dragged jax in"
+print("ROW=" + json.dumps(row))
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": "", "TM_TPU_MC_BENCH_FAST": "1"},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout
+    row = _json.loads(
+        r.stdout.split("ROW=", 1)[1].splitlines()[0]
+    )
+    # fast mode must not have clobbered the banked full-run artifact
+    banked = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_MC.json",
+    )
+    with open(banked) as f:
+        full = _json.load(f)
+    assert full["horizon_depth"] > row["horizon_depth"]
+
+
 def test_serving_cache_row_never_initializes_jax():
     """The ISSUE-14 serving-cache A/B row drives the REAL light_blocks
     handler against proto-backed stub stores — pure codec + cache
