@@ -144,12 +144,17 @@ def bench_sign_keygen(reps: int = 300):
 _COMMIT_MEMO: dict = {}
 
 
-def _make_commit(n_vals: int, chain_id: str, mixed: bool = False):
+def _make_commit(
+    n_vals: int, chain_id: str, mixed: bool = False,
+    key_type: str = "ed25519",
+):
     """A synthetic height-1 commit signed by all n_vals validators.
-    `mixed` interleaves ed25519 and sr25519 keys 1:1 (BASELINE config
-    5's mixed-curve stress shape). Memoized — a 10k build is ~10k
-    sequential signs, and the two breakdown benches share one."""
-    key = (n_vals, chain_id, mixed)
+    `mixed` rotates ed25519 / sr25519 / secp256k1 keys 1:1:1 (BASELINE
+    config 5's mixed-curve stress shape, extended to three classes now
+    secp256k1 is native); `key_type` picks a single uniform class
+    otherwise. Memoized — a 10k build is ~10k sequential signs, and
+    the two breakdown benches share one."""
+    key = (n_vals, chain_id, mixed, key_type)
     if key in _COMMIT_MEMO:
         return _COMMIT_MEMO[key]
     from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
@@ -161,10 +166,17 @@ def _make_commit(n_vals: int, chain_id: str, mixed: bool = False):
 
     def _priv(i: int):
         seed = int(i).to_bytes(4, "big") + b"\x33" * 28
-        if mixed and i % 2 == 1:
+        kind = key_type
+        if mixed:
+            kind = ("ed25519", "sr25519", "secp256k1")[i % 3]
+        if kind == "sr25519":
             from tendermint_tpu.crypto.sr25519 import PrivKeySr25519
 
             return PrivKeySr25519.from_seed(seed)
+        if kind == "secp256k1":
+            from tendermint_tpu.crypto.secp256k1 import PrivKeySecp256k1
+
+            return PrivKeySecp256k1(seed)
         return PrivKeyEd25519.from_seed(seed)
 
     privs = [_priv(i) for i in range(n_vals)]
@@ -229,7 +241,7 @@ def bench_cpu_batch_throughput(n: int = 8192):
 
 def bench_commit_latency(
     n_vals: int, reps: int, light: bool, mixed: bool = False,
-    use_device: bool = True,
+    use_device: bool = True, key_type: str = "ed25519",
 ):
     """p50/p95 wall latency of a full commit verification, with the
     verified-signature cache DISABLED — the honest cold number (the
@@ -246,8 +258,12 @@ def bench_commit_latency(
 
     if use_device:
         tpu_verifier.install(min_batch=2)
-    chain_id = f"bench-{n_vals}" + ("-mixed" if mixed else "")
-    vals, commit = _make_commit(n_vals, chain_id, mixed=mixed)
+    chain_id = f"bench-{n_vals}" + ("-mixed" if mixed else "") + (
+        f"-{key_type}" if key_type != "ed25519" else ""
+    )
+    vals, commit = _make_commit(
+        n_vals, chain_id, mixed=mixed, key_type=key_type
+    )
     fn = (
         validation.verify_commit_light if light else validation.verify_commit
     )
@@ -815,6 +831,110 @@ def bench_tmcost_gate():
         "region": rep.stats.get("region", 0),
         "budgeted": rep.stats.get("budgeted", 0),
     }
+
+
+def bench_tmct_gate():
+    """Full tmct secret-flow / constant-time gate (scripts/lint.py
+    --ct): wall time plus per-rule finding and suppression counts,
+    recorded in every BENCH_* line so a gate-runtime regression (or a
+    timing/lifetime leak slipping into the crypto plane) shows up next
+    to the numbers it guards. Pure stdlib AST over the package —
+    banked CPU block, never initializes jax (pinned by
+    tests/test_bench_guard.py)."""
+    from tendermint_tpu.analysis import tmct
+
+    t0 = time.perf_counter()
+    rep = tmct.analyze()
+    wall = time.perf_counter() - t0
+    # read the gate's own stats so this row can never diverge from it
+    per_rule = {
+        rid: rep.stats.get(f"findings[{rid}]", 0)
+        for rid, _ in tmct.RULES
+    }
+    return {
+        "wall_s": round(wall, 2),
+        "findings": per_rule,
+        "suppressed": rep.stats.get("suppressed", 0),
+        "privkey_classes": rep.stats.get("privkey_classes", 0),
+        "secret_attrs": rep.stats.get("secret_attrs", 0),
+        "seeded_functions": rep.stats.get("seeded_functions", 0),
+        "region": rep.stats.get("region", 0),
+    }
+
+
+def bench_secp_plane(reps: int = 3):
+    """The native secp256k1 plane's commit-verification rows, banked
+    as BENCH_SECP.json the moment they land (same crash-safety
+    rationale as _persist_mc):
+
+      - verify_commit_1k_secp: a 1000-validator commit signed entirely
+        by secp256k1 keys through the production CPU seam — the
+        pure-Python backend's honest cold p50/p95;
+      - verify_commit_10k_mixed_keys: the BASELINE config 5 stress
+        shape re-measured now `mixed` rotates THREE key classes
+        (ed25519 / sr25519 / secp256k1, 1:1:1) instead of two — the
+        number is not comparable to pre-native rows and is re-banked
+        here so the trajectory records the semantics change;
+      - single-op sign/verify microcosts for the new backend.
+
+    Pure CPU (use_device=False): secp256k1 has no device plane; its
+    verify_batch rides the BatchVerifier plugin seam on CPU."""
+    from tendermint_tpu.crypto.secp256k1 import PrivKeySecp256k1
+
+    sk = PrivKeySecp256k1((7).to_bytes(4, "big") + b"\x33" * 28)
+    pk = sk.pub_key()
+    msg = b"bench-secp-microcost"
+    sig = sk.sign(msg)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        sk.sign(msg)
+    sign_us = (time.perf_counter() - t0) / 20 * 1e6
+    t0 = time.perf_counter()
+    for _ in range(20):
+        pk.verify_signature(msg, sig)
+    verify_us = (time.perf_counter() - t0) / 20 * 1e6
+
+    p50_secp, p95_secp = bench_commit_latency(
+        1_000, reps=reps, light=False, use_device=False,
+        key_type="secp256k1",
+    )
+    p50_mixed, p95_mixed = bench_commit_latency(
+        10_000, reps=reps, light=False, mixed=True, use_device=False
+    )
+    row = {
+        "secp_sign_us": round(sign_us, 1),
+        "secp_verify_us": round(verify_us, 1),
+        "verify_commit_1k_secp": {
+            "p50_ms": round(p50_secp, 2), "p95_ms": round(p95_secp, 2),
+        },
+        "verify_commit_10k_mixed_keys": {
+            "p50_ms": round(p50_mixed, 2), "p95_ms": round(p95_mixed, 2),
+            "rotation": "ed25519/sr25519/secp256k1 1:1:1",
+        },
+    }
+    _persist_secp(row)
+    return row
+
+
+def _persist_secp(record: dict) -> None:
+    """Write BENCH_SECP.json — the native-secp256k1 trajectory rows
+    the ISSUE 20 acceptance criteria are audited against. Written as
+    the stage lands and kept out of the driver's one-line budget."""
+    import os
+    import time as _time
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_SECP.json",
+        )
+        with open(path, "w") as f:
+            json.dump(
+                {"recorded_unix": _time.time(), **record}, f, indent=1
+            )
+            f.write("\n")
+    except OSError:
+        pass
 
 
 def bench_tmmc_gate():
@@ -2641,6 +2761,18 @@ def main() -> None:
         bench_tmcost_gate,
         "tmcost_gate",
         120.0,
+    )
+    cpu_stage(
+        "tmct_gate",
+        bench_tmct_gate,
+        "tmct_gate",
+        120.0,
+    )
+    cpu_stage(
+        "secp_plane",
+        bench_secp_plane,
+        "secp_plane",
+        600.0,
     )
     cpu_stage(
         "tmmc_gate",

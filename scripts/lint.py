@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """tmlint + tmcheck + tmrace + tmtrace + tmlive + tmsafe + tmcost +
-tmmc CLI — the consensus-invariant static analyzers.
+tmmc + tmct CLI — the consensus-invariant static analyzers.
 
 Usage:
     python scripts/lint.py                    # full gate: tmlint +
@@ -23,6 +23,10 @@ Usage:
                                               # (DYNAMIC: runs the
                                               # consensus implementation
                                               # under the explorer)
+    python scripts/lint.py --ct               # tmct secret-flow /
+                                              # constant-time pass only
+                                              # (crypto-plane timing +
+                                              # lifetime proof)
     python scripts/lint.py --cost-update      # regenerate the reviewed
                                               # per-request budget table
     python scripts/lint.py --memo-audit       # memo-soundness audit
@@ -65,7 +69,10 @@ tendermint_tpu/analysis/tmlive/live_baseline.json (live),
 tendermint_tpu/analysis/tmsafe/safe_baseline.json (adv),
 tendermint_tpu/analysis/tmcost/cost_baseline.json (cost),
 tendermint_tpu/analysis/tmmc/mc_baseline.json (mc — ships empty and
-should stay empty), and the golden tables tendermint_tpu/analysis/tmcheck/schema.json +
+should stay empty), tendermint_tpu/analysis/tmct/ct_baseline.json
+(ct — ships empty and stays empty: crypto-plane findings are fixed or
+suppressed in-file with a written reason, never baselined), and the
+golden tables tendermint_tpu/analysis/tmcheck/schema.json +
 tendermint_tpu/analysis/tmtrace/jit_signatures.json +
 tendermint_tpu/analysis/tmcost/cost_budgets.json.
 --baseline-update / --schema-update / --signatures-update /
@@ -75,7 +82,8 @@ workflow and the suppression policy (`# tmlint: disable=<rule>`,
 `# tmcheck: taint-ok/taint-break`, `# tmcheck:
 unparsed=N/unwritten=N`, `# tmrace: race-ok/guarded-by`,
 `# tmtrace: trace-ok`, `# tmlive: block-ok/grow-ok/bounded=`,
-`# tmsafe: <rule>-ok`, `# tmcost: <rule>-ok`, `# tmmc: mc-ok`).
+`# tmsafe: <rule>-ok`, `# tmcost: <rule>-ok`, `# tmmc: mc-ok`,
+`# tmct: ct-ok — why` — the tmct reason is mandatory).
 
 The full gate parses the package ONCE: the tmcheck call-graph build is
 the shared substrate every section (including tmlint's syntactic rules
@@ -95,6 +103,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tendermint_tpu.analysis import (  # noqa: E402
     tmcheck,
     tmcost,
+    tmct,
     tmlint,
     tmlive,
     tmrace,
@@ -161,6 +170,11 @@ def main(argv=None) -> int:
              "for the fixed 4-validator/2-height byzantine scenario)",
     )
     ap.add_argument(
+        "--ct", action="store_true",
+        help="run only the tmct secret-flow / constant-time pass "
+             "(crypto-plane timing + lifetime proof)",
+    )
+    ap.add_argument(
         "--cost-update", action="store_true", dest="cost_update",
         help="regenerate the reviewed per-request cost budget table "
              "(tendermint_tpu/analysis/tmcost/cost_budgets.json)",
@@ -221,6 +235,8 @@ def main(argv=None) -> int:
         from tendermint_tpu.analysis import tmmc
         for rid, title in tmmc.RULES:
             print(f"{rid}: {title}")
+        for rid, title in tmct.RULES:
+            print(f"{rid}: {title}")
         return 0
 
     filtered = bool(args.rules or args.paths)
@@ -254,6 +270,7 @@ def main(argv=None) -> int:
         or args.adv
         or args.cost
         or args.mc
+        or args.ct
         or args.memo_audit
         or trace_selected
     ):
@@ -264,7 +281,7 @@ def main(argv=None) -> int:
         print(
             "error: --schema-update requires a full-package run "
             "(drop --rule/--taint/--race/--live/--adv/--cost/--mc/"
-            "--memo-audit/--trace and path arguments)",
+            "--ct/--memo-audit/--trace and path arguments)",
             file=sys.stderr,
         )
         return 2
@@ -277,6 +294,7 @@ def main(argv=None) -> int:
         or args.adv
         or args.cost
         or args.mc
+        or args.ct
         or args.memo_audit
         or trace_selected
         or args.schema_update
@@ -287,8 +305,8 @@ def main(argv=None) -> int:
         print(
             "error: --signatures-update requires a full-package run "
             "(drop --rule/--taint/--schema/--race/--live/--adv/--cost/"
-            "--mc/--memo-audit/--trace/other update modes and path "
-            "arguments)",
+            "--mc/--ct/--memo-audit/--trace/other update modes and "
+            "path arguments)",
             file=sys.stderr,
         )
         return 2
@@ -300,6 +318,7 @@ def main(argv=None) -> int:
         or args.live
         or args.adv
         or args.mc
+        or args.ct
         or args.memo_audit
         or trace_selected
         or args.schema_update
@@ -312,7 +331,7 @@ def main(argv=None) -> int:
         print(
             "error: --cost-update requires a full-package run "
             "(drop --rule/--taint/--schema/--race/--live/--adv/--mc/"
-            "--memo-audit/--trace/other update modes and path "
+            "--ct/--memo-audit/--trace/other update modes and path "
             "arguments)",
             file=sys.stderr,
         )
@@ -326,6 +345,7 @@ def main(argv=None) -> int:
         or args.adv
         or args.cost
         or args.mc
+        or args.ct
         or args.memo_audit
         or trace_selected
     )
@@ -338,6 +358,7 @@ def main(argv=None) -> int:
         "adv": args.adv,
         "cost": args.cost,
         "mc": args.mc,
+        "ct": args.ct,
         "memo": args.memo_audit,
         "trace": trace_selected,
     }
@@ -355,6 +376,7 @@ def main(argv=None) -> int:
     run_adv = _only("adv")
     run_cost = _only("cost")
     run_mc = _only("mc")
+    run_ct = _only("ct")
     run_memo = _only("memo")
     run_trace = _only("trace")
     # update modes run ONLY the sections they update: computing (then
@@ -371,6 +393,7 @@ def main(argv=None) -> int:
         run_adv = False
         run_cost = False
         run_mc = False
+        run_ct = False
         run_memo = False
         run_trace = False
     if args.signatures_update:
@@ -382,6 +405,7 @@ def main(argv=None) -> int:
         run_adv = False
         run_cost = False
         run_mc = False
+        run_ct = False
         run_memo = False
         run_trace = False
     if args.cost_update:
@@ -393,6 +417,7 @@ def main(argv=None) -> int:
         run_adv = False
         run_cost = False
         run_mc = False
+        run_ct = False
         run_memo = False
         run_trace = False
 
@@ -411,6 +436,7 @@ def main(argv=None) -> int:
         or run_live
         or run_adv
         or run_cost
+        or run_ct
         or run_memo
         or run_trace
         or args.signatures_update
@@ -711,6 +737,43 @@ def main(argv=None) -> int:
                     )
                 )
 
+        if run_ct:
+            # one analyze() pass serves report, baseline diff AND
+            # baseline update (same single-pass rule as tmrace)
+            ct_pkg = pkg or tmcheck.build_package()
+            pkg = ct_pkg
+            ct_report = tmct.analyze(ct_pkg)
+            ct_v = ct_report.violations
+            violations.extend(ct_v)
+            if args.stats:
+                st = ct_report.stats
+                print(
+                    f"-- tmct gate: {st.get('privkey_classes')} privkey "
+                    f"classes / {st.get('secret_attrs')} secret attrs / "
+                    f"{st.get('seeded_functions')} seeded functions, "
+                    f"region={st.get('region')} analyzed functions, "
+                    f"suppressed={st.get('suppressed')} --"
+                )
+            if args.baseline_update:
+                counts = tmlint.save_baseline(
+                    ct_v,
+                    tmct.CT_BASELINE_PATH,
+                    note=tmct.CT_BASELINE_NOTE,
+                )
+                print(
+                    f"ct baseline updated: {len(counts)} fingerprints "
+                    f"-> {tmct.CT_BASELINE_PATH}"
+                )
+            elif args.no_baseline:
+                new.extend(ct_v)
+            else:
+                new.extend(
+                    tmlint.new_violations(
+                        ct_v,
+                        tmlint.load_baseline(tmct.CT_BASELINE_PATH),
+                    )
+                )
+
         if args.signatures_update:
             sig_pkg = pkg or tmcheck.build_package()
             pkg = sig_pkg
@@ -768,6 +831,7 @@ def main(argv=None) -> int:
                 ("adv", run_adv),
                 ("cost", run_cost),
                 ("mc", run_mc),
+                ("ct", run_ct),
                 ("memo", run_memo),
                 ("trace", run_trace),
             )
@@ -795,7 +859,7 @@ def main(argv=None) -> int:
             "race-ok/guarded-by=..., # tmtrace: trace-ok, "
             "# tmlive: block-ok/grow-ok/bounded=..., "
             "# tmsafe: <rule>-ok, # tmcost: <rule>-ok, "
-            "# tmmc: mc-ok), or for "
+            "# tmmc: mc-ok, # tmct: ct-ok — why), or for "
             "consciously accepted changes run scripts/lint.py "
             "--baseline-update / --schema-update / --signatures-update "
             "/ --cost-update.",

@@ -1,0 +1,276 @@
+"""tmct — secret-flow / constant-time proof over the crypto plane.
+
+The eleventh lint-gate section. Every prior gate proves properties of
+code under *hostile input*; tmct proves properties of code holding
+*secrets*: private-key bytes, signing nonces (RFC 6979 HMAC-DRBG
+state, the sr25519 merlin witness scalar), and expanded-key
+intermediates. Two things must never happen to them, and both are
+whole-program dataflow properties, not local style:
+
+**Timing** — secret-dependent control flow or memory addressing. A
+branch on key bits, a loop bounded by a nonce, a table indexed by a
+scalar window, an `==` that short-circuits at the first differing
+byte, a two-arg `pow` whose bignum cost tracks the exponent: each one
+modulates *observable duration* by secret content, and a remote
+adversary integrates over many probes. Pure Python cannot be
+cycle-constant; what the gate enforces is **structure, not cycles**
+(docs/static_analysis.md): the trace *shape* — which statements run,
+which indices are touched, where comparisons stop — must be
+independent of secret values. Comparisons route through
+`libs/ctutil.bytes_eq`; lookups use arithmetic-mask scans
+(ed25519_math._comb_select, secp256k1._ct_select); exponent paths use
+3-arg pow.
+
+**Lifetime / exfiltration** — secrets reaching rendered text or
+shared state: f-strings, repr/print/format, exception args, logging
+calls, the telemetry plane (libs/{log,metrics,profiler,trace}), or
+any PR-9-cataloged shared container (crypto/sigcache, module-global
+memos/rings) where a value outlives the operation that needed it.
+
+Sources are machine-derived (sources.py): the transitive PrivKey
+subclass closure, its non-public instance attrs and ctor params,
+PrivKey-typed annotations package-wide, and os.urandom births inside
+crypto//privval/. The taint engine (secretflow.py) runs the tmsafe
+worklist architecture over the PR-5 call graph: per-function parameter
+joins, return summaries, dynamic class-attribute growth (storing a
+secret into `self.x` re-analyzes the class), declassification only at
+named published-output boundaries (sign/pub_key/address/verify_*/
+bytes_eq).
+
+Rules:
+
+- `ct-secret-branch` — if/while/ternary/assert/comprehension
+  condition, or a range() bound, derived from a secret.
+- `ct-secret-index` — subscript whose index involves a secret.
+- `ct-secret-compare` — ==/!=/in/not-in with a secret operand
+  (`is None` is presence, not content, and stays clean).
+- `ct-vartime-pow` — two-arg pow/** with a secret exponent.
+- `ct-leak-telemetry` — secret into f-string/repr/print/format/
+  exception args/logging/telemetry plane, plus dataclass secret-typed
+  fields without field(repr=False) (the generated __repr__ leak).
+- `ct-leak-lifetime` — secret argument into crypto/sigcache, or a
+  secret stored into a module-global name/container.
+
+Suppressions: `# tmct: ct-ok — why` on the line or comment block
+above it. The reason is *mandatory* — a bare `ct-ok` does not parse —
+because every sanctioned site is a human-reviewed claim (rejection
+sampling on locally-generated entropy, a published boolean, a
+range check whose failure is fatal anyway). Counted fingerprint
+baseline `ct_baseline.json` ships — and is pinned by test — EMPTY:
+the crypto plane starts clean and stays clean.
+
+Run via `scripts/lint.py --ct` (in the default full gate).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+from ..tmlint import (
+    Violation,
+    comment_cover_lines,
+    load_baseline,
+    new_violations,
+    save_baseline,
+)
+from ..tmcheck.callgraph import Package, build_package
+from . import secretflow, sources  # noqa: F401
+from .secretflow import SecretEngine
+from .sources import SecretCatalog, derive_catalog
+
+__all__ = [
+    "RULES",
+    "CT_BASELINE_PATH",
+    "CT_BASELINE_NOTE",
+    "CtReport",
+    "analyze",
+    "ct_violations",
+    "new_ct_violations",
+    "update_ct_baseline",
+    "suppressed_lines",
+]
+
+CT_BASELINE_PATH = os.path.join(
+    os.path.dirname(__file__), "ct_baseline.json"
+)
+
+CT_BASELINE_NOTE = (
+    "Accepted pre-existing secret-flow findings, fingerprinted by "
+    "rule:path:sha1(source_line)[:12]. This ships empty and stays "
+    "empty: the crypto plane has no tolerated timing or lifetime "
+    "leaks. A new finding is fixed, or suppressed in-file with a "
+    "justified '# tmct: ct-ok — why' — never baselined."
+)
+
+RULES = [
+    (
+        "ct-secret-branch",
+        "control flow (if/while/ternary/assert/comprehension/range "
+        "bound) conditioned on a secret-derived value",
+    ),
+    (
+        "ct-secret-index",
+        "subscript index derived from a secret — data-dependent "
+        "memory access pattern",
+    ),
+    (
+        "ct-secret-compare",
+        "==/!=/in/not-in with a secret operand — short-circuits at "
+        "the first differing byte; use libs/ctutil.bytes_eq",
+    ),
+    (
+        "ct-vartime-pow",
+        "two-arg pow/** with a secret exponent — value-dependent "
+        "bignum work; the 3-arg modular form is sanctioned",
+    ),
+    (
+        "ct-leak-telemetry",
+        "secret reaching rendered text: f-string, repr/print/format, "
+        "exception args, logging calls, the telemetry plane, or a "
+        "dataclass __repr__ without field(repr=False)",
+    ),
+    (
+        "ct-leak-lifetime",
+        "secret reaching shared long-lived state: crypto/sigcache "
+        "arguments, module-global names or containers",
+    ),
+]
+
+# The reason is mandatory: a dash (em/en/double/single) followed by at
+# least one non-space character. A bare `# tmct: ct-ok` does not count.
+_SUPPRESS_RE = re.compile(
+    r"#\s*tmct:\s*ct-ok\s*(?:—|–|--|-)\s*\S"
+)
+
+
+def suppressed_lines(lines: List[str]) -> Set[int]:
+    """Covered line numbers for `# tmct: ct-ok — why` annotations
+    (comment-block-above convention shared with the family). One
+    annotation covers every tmct rule on the covered lines: the
+    reviewed claim is about the *site*, not one rule id."""
+    out: Set[int] = set()
+    for i, text in enumerate(lines, start=1):
+        if not _SUPPRESS_RE.search(text):
+            continue
+        out.update(comment_cover_lines(lines, i, text))
+    return out
+
+
+class CtReport:
+    def __init__(self) -> None:
+        self.catalog: Optional[SecretCatalog] = None
+        self.findings: List[secretflow.Finding] = []
+        self.violations: List[Violation] = []
+        self.stats: Dict[str, int] = {}
+        # (rule, path, line) dropped by an in-file suppression — the
+        # head-catalog test pins this set
+        self.suppressed: List[tuple] = []
+
+
+def analyze(pkg: Optional[Package] = None) -> CtReport:
+    pkg = pkg or build_package()
+    report = CtReport()
+
+    supp: Dict[str, Set[int]] = {}
+    for path, mod in pkg.modules.items():
+        covered = suppressed_lines(mod.lines)
+        if covered:
+            supp[path] = covered
+
+    def is_suppressed(path: str, lineno: int) -> bool:
+        return lineno in supp.get(path, ())
+
+    def line_text(path: str, lineno: int) -> str:
+        lines = pkg.modules[path].lines
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1].strip()
+        return ""
+
+    cat = derive_catalog(pkg)
+    report.catalog = cat
+    engine = SecretEngine(pkg, cat)
+    findings = engine.run()
+    report.findings = findings
+
+    violations: List[Violation] = []
+    n_supp = 0
+    for f in findings:
+        if is_suppressed(f.path, f.lineno):
+            n_supp += 1
+            report.suppressed.append((f.rule, f.path, f.lineno))
+            continue
+        chain = engine.chain(f.key)
+        witness = " -> ".join(chain)
+        violations.append(
+            Violation(
+                rule=f.rule,
+                path=f.path,
+                line=f.lineno,
+                col=f.col,
+                message=f"{f.detail}; witness: {witness}",
+                source=line_text(f.path, f.lineno),
+            )
+        )
+
+    # class-shape findings (dataclass __repr__) come from the catalog,
+    # not the dataflow engine
+    for path, lineno, col, detail in cat.repr_leaks:
+        if is_suppressed(path, lineno):
+            n_supp += 1
+            report.suppressed.append(("ct-leak-telemetry", path, lineno))
+            continue
+        violations.append(
+            Violation(
+                rule="ct-leak-telemetry",
+                path=path,
+                line=lineno,
+                col=col,
+                message=detail,
+                source=line_text(path, lineno),
+            )
+        )
+
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    report.violations = violations
+    per_rule: Dict[str, int] = {rid: 0 for rid, _ in RULES}
+    for v in violations:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+    report.stats = {
+        "privkey_classes": len(cat.privkey_class_names),
+        "secret_attrs": sum(
+            len(a) for a in cat.class_secret_attrs.values()
+        ),
+        "seeded_functions": len(cat.seed_params),
+        "region": sum(
+            1 for st in engine.states.values() if st.analyzed
+        ),
+        "suppressed": n_supp,
+        **{f"findings[{rid}]": n for rid, n in per_rule.items()},
+    }
+    return report
+
+
+def ct_violations(pkg: Optional[Package] = None) -> List[Violation]:
+    return analyze(pkg).violations
+
+
+def new_ct_violations(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+) -> List[Violation]:
+    violations = ct_violations(pkg)
+    baseline = load_baseline(baseline_path or CT_BASELINE_PATH)
+    return new_violations(violations, baseline)
+
+
+def update_ct_baseline(
+    pkg: Optional[Package] = None,
+    baseline_path: Optional[str] = None,
+) -> Dict[str, int]:
+    return save_baseline(
+        ct_violations(pkg),
+        baseline_path or CT_BASELINE_PATH,
+        note=CT_BASELINE_NOTE,
+    )

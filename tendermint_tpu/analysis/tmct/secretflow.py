@@ -1,0 +1,1038 @@
+"""Secret-taint dataflow over the PR-5 call graph.
+
+A two-level taint lattice — CLEAN < CARRIER < SECRET — born at the
+machine-derived sources in sources.py and propagated through
+arithmetic, hashing, containers, and internal calls (monotone fixpoint
+with per-function parameter joins and return summaries, the tmsafe
+worklist architecture).
+
+**SECRET** is raw key material: private scalars, seed bytes, signing
+nonces, expanded-key intermediates. Timing sinks (branch/index/
+compare/pow) and telemetry sinks fire on it.
+
+**CARRIER** is an object *holding* secrets — a PrivKey instance, a
+FilePVKey record. Method calls on a carrier declassify by name (sign /
+pub_key / address / verify_* publish their output by design); reading
+a raw-material attribute off one re-enters SECRET; everything else
+reads CLEAN. Only the lifetime sinks fire on carriers — parking a key
+object in a module-global cache keeps the secret alive exactly like
+parking its bytes — while its `.height`-style public fields flow
+freely through the consensus plane without dragging taint along.
+
+Declassification boundaries (the only taint kills):
+
+- a call to `sign` / `pub_key` / `address` / `public_*` / `verify_*` /
+  `type` / `equals`: the output is published by design — a signature,
+  a public key, an address. Their *internals* are still analyzed.
+- `libs/ctutil.bytes_eq`: the comparison's boolean is public by
+  contract (its path to the answer is the constant-structure part);
+- a store into a public-named attribute (`self._pub = ...`): the
+  pubkey-derivation boundary;
+- structural reads: `len()`, `type()`, `isinstance()`, `is None`
+  identity tests — they observe shape/presence, not bytes.
+
+Two sink classes (the rule split in __init__.RULES):
+
+**timing** — ct-secret-branch (if/while/ternary/assert tests, range()
+loop bounds, comprehension conditions on a SECRET), ct-secret-index
+(subscript whose index involves a SECRET), ct-secret-compare
+(==/!=/in/not-in with a SECRET operand — route through
+libs/ctutil.bytes_eq), ct-vartime-pow (two-arg pow / ** with a SECRET
+exponent: CPython's non-modular pow is value-dependent bignum work;
+three-arg pow is the sanctioned modular inverse).
+
+**lifetime/exfiltration** — ct-leak-telemetry (f-strings, repr/print/
+format, exception args, logging-method calls, any call into
+libs/{log,metrics,profiler,trace} with a SECRET argument) and
+ct-leak-lifetime (a SECRET-or-CARRIER argument into crypto/sigcache,
+or stored into a module-global name or container — the PR-9 shared
+sigcache/memo/ring surfaces, where a value outlives its operation).
+
+Iterating secret *bytes* (`for b in key`) is deliberately not a
+branch finding: the iteration count is the public length, not the
+value. Only a secret-valued bound (`range(k)`, `while k:`) is.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..tmcheck.callgraph import CallSite, FuncInfo, Package
+from .sources import PUBLIC_ATTR_RE, SecretCatalog
+
+__all__ = ["SecretEngine", "Finding", "CLEAN", "CARRIER", "SECRET"]
+
+FuncKey = Tuple[str, str]
+
+CLEAN = 0
+CARRIER = 1
+SECRET = 2
+
+# method names whose call RESULT is public by design, wherever the
+# receiver's secrecy came from (the operation's published output)
+_DECLASS_METHODS = {
+    "sign",
+    "pub_key",
+    "address",
+    "type",
+    "equals",
+    "sign_vote",
+    "sign_proposal",
+    # wire-encoding a group element is a publication boundary: the
+    # bytes it produces (a compressed point — the signature's R, a
+    # public key) are published by design
+    "compress",
+}
+_DECLASS_PREFIXES = ("pub", "public", "verify")
+
+# resolved publication boundaries: group-element serializers whose
+# output ships in a signature or key — no taint flows in (branching
+# on a to-be-published value is benign) and none comes out
+_PUBLICATION_TARGETS = {
+    ("crypto/ristretto.py", "encode"),
+    ("crypto/ed25519_math.py", "compress"),
+    ("crypto/secp256k1.py", "_compress"),
+}
+
+# builtins observing structure, not content
+_STRUCTURAL_BUILTINS = {
+    "len",
+    "type",
+    "isinstance",
+    "issubclass",
+    "hasattr",
+    "callable",
+    "id",
+}
+
+# method names on a CARRIER that hand back the raw material
+_CARRIER_RAW_METHODS = {"bytes", "to_bytes", "secret_bytes"}
+
+# logging-method names: `X.debug(secret)` is exfiltration no matter
+# what X resolves to
+_LOG_METHODS = {
+    "debug",
+    "info",
+    "warning",
+    "warn",
+    "error",
+    "exception",
+    "critical",
+}
+
+# telemetry plane modules: any resolved call into them with a secret
+# argument is a leak (metrics labels, trace span attrs, profiler tags)
+_TELEMETRY_SUFFIXES = (
+    "libs/log.py",
+    "libs/metrics.py",
+    "libs/profiler.py",
+    "libs/trace.py",
+)
+
+# shared-container plane (PR-9 catalog): values stored here outlive
+# the operation that produced them
+_LIFETIME_SUFFIXES = ("crypto/sigcache.py",)
+
+_CONTAINER_MUTATORS = {
+    "append",
+    "appendleft",
+    "add",
+    "extend",
+    "insert",
+    "update",
+    "setdefault",
+    "put",
+    "put_nowait",
+}
+
+
+class Finding:
+    __slots__ = ("rule", "path", "lineno", "col", "detail", "key")
+
+    def __init__(self, rule, path, lineno, col, detail, key):
+        self.rule = rule
+        self.path = path
+        self.lineno = lineno
+        self.col = col
+        self.detail = detail
+        self.key = key
+
+
+class _FnState:
+    """Polymorphic return summary: `ret_base` is the return taint with
+    clean parameters (internal births only — urandom, secret self
+    attrs, secret-returning callees); `param_dep` says whether tainted
+    arguments can raise it. A call site's result is then
+    max(ret_base, args-if-param_dep) — shared arithmetic (point_add,
+    field helpers) called with public inputs stays clean even though
+    the signing plane also routes secrets through it."""
+
+    __slots__ = ("param_taint", "ret_base", "param_dep", "analyzed")
+
+    def __init__(self) -> None:
+        self.param_taint: Dict[str, int] = {}
+        self.ret_base: int = CLEAN
+        self.param_dep = False
+        self.analyzed = False
+
+    def call_ret(self, max_arg: int) -> int:
+        return max(self.ret_base, max_arg if self.param_dep else CLEAN)
+
+
+class SecretEngine:
+    def __init__(self, pkg: Package, cat: SecretCatalog) -> None:
+        self.pkg = pkg
+        self.cat = cat
+        self.states: Dict[FuncKey, _FnState] = {}
+        self.callers: Dict[FuncKey, Set[FuncKey]] = {}
+        self.parent: Dict[FuncKey, Tuple[FuncKey, int]] = {}
+        self.findings: Dict[Tuple[str, str, int, int], Finding] = {}
+        self._work: List[FuncKey] = []
+        self._queued: Set[FuncKey] = set()
+        # (path, class) -> set of method FuncKeys, for re-analysis when
+        # a secret attr is discovered on the class mid-run
+        self._class_methods: Dict[Tuple[str, str], Set[FuncKey]] = {}
+        for key, fi in pkg.functions.items():
+            if fi.class_name:
+                self._class_methods.setdefault(
+                    (fi.path, fi.class_name), set()
+                ).add(key)
+        # module path -> names assigned at module top level (the
+        # process-global lifetime surface)
+        self._module_globals: Dict[str, Set[str]] = {}
+        for path, mod in pkg.modules.items():
+            g: Set[str] = set()
+            for node in mod.tree.body:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        g.add(t.id)
+            self._module_globals[path] = g
+
+    # -- public --
+
+    def run(self) -> List[Finding]:
+        # every function is analyzed at least once: sources can be born
+        # mid-body (os.urandom, a generate() call, a secret attr load),
+        # not just at seeded parameters
+        for key in self.pkg.functions:
+            st = self._state(key)
+            for p in self.cat.seed_params.get(key, ()):
+                st.param_taint[p] = SECRET
+            for p in self.cat.carrier_params.get(key, ()):
+                if st.param_taint.get(p, CLEAN) < CARRIER:
+                    st.param_taint[p] = CARRIER
+            self._enqueue(key)
+        while self._work:
+            key = self._work.pop()
+            self._queued.discard(key)
+            self._analyze(key)
+        return sorted(
+            self.findings.values(),
+            key=lambda f: (f.path, f.lineno, f.col, f.rule),
+        )
+
+    def chain(self, key: FuncKey) -> List[str]:
+        seen: Set[FuncKey] = set()
+        out: List[str] = []
+        cur: Optional[FuncKey] = key
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            fi = self.pkg.functions.get(cur)
+            out.append(fi.render() if fi else f"{cur[0]}:{cur[1]}")
+            nxt = self.parent.get(cur)
+            cur = nxt[0] if nxt else None
+        out.reverse()
+        return out
+
+    # -- machinery --
+
+    def _state(self, key: FuncKey) -> _FnState:
+        st = self.states.get(key)
+        if st is None:
+            st = _FnState()
+            self.states[key] = st
+        return st
+
+    def _enqueue(self, key: FuncKey) -> None:
+        if key not in self._queued:
+            self._queued.add(key)
+            self._work.append(key)
+
+    def _flow_into(
+        self, caller: FuncKey, callee: FuncKey, taints: Dict[str, int],
+        lineno: int,
+    ) -> None:
+        st = self._state(callee)
+        grew = False
+        for name, kind in taints.items():
+            if kind > st.param_taint.get(name, CLEAN):
+                st.param_taint[name] = kind
+                grew = True
+        if grew or not st.analyzed:
+            self.parent.setdefault(callee, (caller, lineno))
+            self._enqueue(callee)
+        self.callers.setdefault(callee, set()).add(caller)
+
+    def _ret_update(
+        self, key: FuncKey, ret_base: int, param_dep: bool
+    ) -> None:
+        st = self._state(key)
+        if ret_base > st.ret_base or (param_dep and not st.param_dep):
+            st.ret_base = max(st.ret_base, ret_base)
+            st.param_dep = st.param_dep or param_dep
+            for c in self.callers.get(key, ()):
+                self._enqueue(c)
+
+    def mark_secret_attr(self, path: str, cls: str, attr: str) -> None:
+        """A method stored raw SECRET material into self.<attr>: the
+        class now carries it; re-analyze its methods so reads see it.
+        PubKey-plane classes are exempt — everything stored in one is
+        published output (the derivation boundary already fired)."""
+        if self.cat.is_pubkey_class(cls):
+            return
+        key = (path, cls)
+        attrs = self.cat.class_secret_attrs.setdefault(key, set())
+        if attr not in attrs:
+            attrs.add(attr)
+            for mk in self._class_methods.get(key, ()):
+                self._enqueue(mk)
+
+    def report(self, rule, key, node, detail) -> None:
+        fi = self.pkg.functions[key]
+        k = (rule, fi.path, node.lineno, node.col_offset)
+        if k not in self.findings:
+            self.findings[k] = Finding(
+                rule, fi.path, node.lineno, node.col_offset, detail, key
+            )
+
+    def has_finding_at(self, key: FuncKey, lineno: int) -> bool:
+        fi = self.pkg.functions[key]
+        return any(
+            k[1] == fi.path and k[2] == lineno for k in self.findings
+        )
+
+    def _analyze(self, key: FuncKey) -> None:
+        fi = self.pkg.functions.get(key)
+        if fi is None:
+            return
+        st = self._state(key)
+        st.analyzed = True
+        # concrete pass: actual joined parameter taints, reporting on
+        concrete = _BodyWalker(self, fi, dict(st.param_taint), True)
+        concrete.run()
+        # base pass: clean params, internal births only
+        if st.param_taint:
+            base = _BodyWalker(self, fi, {}, False)
+            base.run()
+            ret_base = base.ret
+        else:
+            ret_base = concrete.ret
+        # generic pass: hypothetical all-secret params — does the
+        # return depend on what callers pass in?
+        args = fi.node.args
+        params = [
+            a.arg
+            for a in args.posonlyargs + args.args + args.kwonlyargs
+            if a.arg not in ("self", "cls")
+        ]
+        param_dep = False
+        if params:
+            generic = _BodyWalker(
+                self, fi, {p: SECRET for p in params}, False
+            )
+            generic.run()
+            param_dep = generic.ret > ret_base
+        self._ret_update(key, ret_base, param_dep)
+
+
+class _BodyWalker:
+    """One function body, statements in program order, operands always
+    evaluated (the tmsafe never-short-circuit discipline)."""
+
+    def __init__(
+        self,
+        eng: SecretEngine,
+        fi: FuncInfo,
+        env: Dict[str, int],
+        report_mode: bool,
+    ) -> None:
+        self.eng = eng
+        self.fi = fi
+        self.key = fi.key
+        self.env: Dict[str, int] = env
+        self.report_mode = report_mode
+        self.ret: int = CLEAN
+        self.globals = eng._module_globals.get(fi.path, set())
+        self.global_decls: Set[str] = set()
+        self.class_key = (fi.path, fi.class_name) if fi.class_name else None
+        self.in_crypto_plane = (
+            "/crypto/" in fi.path or "/privval/" in fi.path
+            or fi.path.startswith(("crypto/", "privval/"))
+        )
+        self.sites: Dict[Tuple[int, int], CallSite] = {
+            (s.lineno, s.col): s for s in fi.calls
+        }
+
+    def run(self) -> None:
+        for node in self.fi.node.body:
+            self.stmt(node)
+
+    # -- helpers --
+
+    def _report(self, rule, key, node, detail) -> None:
+        # the base and generic passes run hypothetical environments —
+        # only the concrete pass reports
+        if self.report_mode:
+            self.eng.report(rule, key, node, detail)
+
+    def _secret_attrs(self) -> Set[str]:
+        if self.class_key is None:
+            return set()
+        attrs = self.eng.cat.class_secret_attrs.get(self.class_key, set())
+        if self.eng.cat.is_privkey_class(self.class_key[1]):
+            # inherited raw material: a subclass method reads the attrs
+            # its base assigned (class_secret_attrs is keyed by the
+            # assigning class, so the closure-wide union covers MRO)
+            return attrs | self.eng.cat.raw_attr_union()
+        return attrs
+
+    def _assign_name(self, name: str, kind: int) -> None:
+        if kind:
+            self.env[name] = kind
+        else:
+            self.env.pop(name, None)
+
+    def _assign_target(self, tgt: ast.AST, kind: int, value=None) -> None:
+        if isinstance(tgt, ast.Name):
+            if kind and tgt.id in self.global_decls:
+                self._report(
+                    "ct-leak-lifetime",
+                    self.key,
+                    tgt,
+                    f"secret assigned to module-global `{tgt.id}` — key "
+                    "material outliving its operation in process state",
+                )
+            self._assign_name(tgt.id, kind)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            parts = None
+            if isinstance(value, (ast.Tuple, ast.List)) and len(
+                value.elts
+            ) == len(tgt.elts):
+                parts = value.elts
+            for i, elt in enumerate(tgt.elts):
+                if parts is not None:
+                    self._assign_target(elt, self.expr(parts[i]))
+                else:
+                    self._assign_target(elt, kind)
+        elif isinstance(tgt, ast.Attribute):
+            if (
+                isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+                and self.fi.class_name
+            ):
+                if (
+                    self.report_mode
+                    and kind == SECRET
+                    and not PUBLIC_ATTR_RE.search(tgt.attr)
+                ):
+                    self.eng.mark_secret_attr(
+                        self.fi.path, self.fi.class_name, tgt.attr
+                    )
+                # a CARRIER store or a public-named store is not raw
+                # material entering the class: carrier attrs read back
+                # through the annotation-derived secret_attr_names set,
+                # and a public-named attr is the pubkey-derivation
+                # declassification boundary
+            else:
+                self.expr(tgt.value)
+        elif isinstance(tgt, ast.Subscript):
+            self._store_subscript(tgt, kind)
+        elif isinstance(tgt, ast.Starred):
+            self._assign_target(tgt.value, kind)
+
+    def _store_subscript(self, tgt: ast.Subscript, kind: int) -> None:
+        idx_kind = self.expr(tgt.slice)
+        if idx_kind == SECRET:
+            self._report(
+                "ct-secret-index",
+                self.key,
+                tgt,
+                "subscript STORE indexed by a secret-derived value — "
+                "the access pattern is data-dependent",
+            )
+        base = tgt.value
+        self.expr(base)
+        if (
+            kind
+            and isinstance(base, ast.Name)
+            and base.id in self.globals
+            and base.id not in self.env
+        ):
+            self._report(
+                "ct-leak-lifetime",
+                self.key,
+                tgt,
+                f"secret stored into module-global container "
+                f"`{base.id}` — the PR-9 shared-cache lifetime rule: "
+                "key material must not outlive its operation",
+            )
+        if kind and isinstance(base, ast.Name):
+            cur = self.env.get(base.id, CLEAN)
+            if kind > cur:
+                self.env[base.id] = kind
+
+    # -- statements --
+
+    def stmt(self, node: ast.AST) -> None:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return
+        if isinstance(node, ast.Global):
+            self.global_decls.update(node.names)
+            return
+        if isinstance(node, ast.Assign):
+            kind = self.expr(node.value)
+            for tgt in node.targets:
+                self._assign_target(tgt, kind, node.value)
+        elif isinstance(node, ast.AnnAssign):
+            kind = self.expr(node.value) if node.value else CLEAN
+            self._assign_target(node.target, kind, node.value)
+        elif isinstance(node, ast.AugAssign):
+            kind = self.expr(node.value)
+            if isinstance(node.target, ast.Name):
+                cur = self.env.get(node.target.id, CLEAN)
+                self._assign_name(node.target.id, max(cur, kind))
+            else:
+                self._assign_target(node.target, kind)
+        elif isinstance(node, ast.Expr):
+            self.expr(node.value)
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                self.ret = max(self.ret, self.expr(node.value))
+        elif isinstance(node, ast.If):
+            self._branch(node.test, node.body, node.orelse, "if")
+        elif isinstance(node, ast.While):
+            t = self.expr(node.test)
+            self._maybe_branch_report(node.test, t, "while")
+            self._loop_body(node.body)
+            for s in node.orelse:
+                self.stmt(s)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._for(node)
+        elif isinstance(node, ast.Try):
+            for s in node.body:
+                self.stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self.stmt(s)
+            for s in node.orelse:
+                self.stmt(s)
+            for s in node.finalbody:
+                self.stmt(s)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                kind = self.expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign_target(item.optional_vars, kind)
+            for s in node.body:
+                self.stmt(s)
+        elif isinstance(node, ast.Assert):
+            t = self.expr(node.test)
+            self._maybe_branch_report(node.test, t, "assert")
+            if node.msg is not None:
+                m = self.expr(node.msg)
+                if m == SECRET:
+                    self._report(
+                        "ct-leak-telemetry",
+                        self.key,
+                        node,
+                        "secret in an assert message — AssertionError "
+                        "text reaches logs and crash reports",
+                    )
+        elif isinstance(node, ast.Raise):
+            self._raise(node)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.env.pop(t.id, None)
+                else:
+                    self.expr(t)
+        elif isinstance(
+            node,
+            (ast.Nonlocal, ast.Pass, ast.Break, ast.Continue, ast.Import,
+             ast.ImportFrom),
+        ):
+            return
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.expr(child)
+                elif isinstance(child, ast.stmt):
+                    self.stmt(child)
+
+    def _raise(self, node: ast.Raise) -> None:
+        if node.exc is None:
+            return
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            kinds = [self.expr(a) for a in exc.args]
+            kinds += [self.expr(kw.value) for kw in exc.keywords]
+            if any(k == SECRET for k in kinds):
+                self._report(
+                    "ct-leak-telemetry",
+                    self.key,
+                    node,
+                    "secret in exception args — error text propagates "
+                    "to logs, RPC error frames, and crash reports",
+                )
+        else:
+            self.expr(exc)
+
+    def _maybe_branch_report(self, test, kind: int, what: str) -> None:
+        if kind != SECRET or not self.report_mode:
+            return
+        # an Eq/In compare in the test already produced the (more
+        # specific) ct-secret-compare on this line
+        if self.eng.has_finding_at(self.key, test.lineno):
+            return
+        self.eng.report(
+            "ct-secret-branch",
+            self.key,
+            test,
+            f"secret-dependent `{what}` — control flow is a function "
+            "of key material (structure-not-cycles: the trace shape "
+            "must not depend on secret bits)",
+        )
+
+    def _branch(self, test, body, orelse, what: str) -> None:
+        t = self.expr(test)
+        self._maybe_branch_report(test, t, what)
+        snap = dict(self.env)
+        for s in body:
+            self.stmt(s)
+        env_b = self.env
+        self.env = dict(snap)
+        for s in orelse:
+            self.stmt(s)
+        for name, kind in env_b.items():
+            if kind > self.env.get(name, CLEAN):
+                self.env[name] = kind
+
+    def _loop_body(self, body) -> None:
+        for _ in range(2):
+            for s in body:
+                self.stmt(s)
+
+    def _for(self, node) -> None:
+        iter_kind = self.expr(node.iter)
+        # `for _ in range(secret)` — the COUNT is the secret. Direct
+        # iteration over secret bytes has a public count (the length)
+        # and binds secret elements instead.
+        if (
+            isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and any(self.expr(a) == SECRET for a in node.iter.args)
+        ):
+            self._report(
+                "ct-secret-branch",
+                self.key,
+                node.iter,
+                "loop bound derived from a secret — iteration count "
+                "is a function of key material",
+            )
+        self._assign_target(node.target, iter_kind)
+        self._loop_body(node.body)
+        for s in node.orelse:
+            self.stmt(s)
+
+    # -- expressions --
+
+    def expr(self, node: Optional[ast.AST]) -> int:
+        if node is None:
+            return CLEAN
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, CLEAN)
+        if isinstance(node, ast.Constant):
+            return CLEAN
+        if isinstance(node, ast.Attribute):
+            return self._attribute(node)
+        if isinstance(node, (ast.Await, ast.Starred)):
+            return self.expr(node.value)
+        if isinstance(node, ast.BinOp):
+            left = self.expr(node.left)
+            right = self.expr(node.right)
+            if isinstance(node.op, ast.Pow) and right == SECRET:
+                self._report(
+                    "ct-vartime-pow",
+                    self.key,
+                    node,
+                    "`**` with a secret exponent — non-modular "
+                    "exponentiation is value-dependent bignum work; "
+                    "use 3-arg pow",
+                )
+            return max(left, right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return max(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self._compare(node)
+        if isinstance(node, ast.IfExp):
+            t = self.expr(node.test)
+            self._maybe_branch_report(node.test, t, "ternary")
+            return max(self.expr(node.body), self.expr(node.orelse))
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            kinds = [self.expr(e) for e in node.elts]
+            return max(kinds) if kinds else CLEAN
+        if isinstance(node, ast.Dict):
+            kinds = [self.expr(k) for k in node.keys if k is not None]
+            kinds += [self.expr(v) for v in node.values]
+            return max(kinds) if kinds else CLEAN
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            return self._comprehension(node)
+        if isinstance(node, ast.JoinedStr):
+            leak = CLEAN
+            for v in node.values:
+                leak = max(leak, self.expr(v))
+            if leak == SECRET:
+                self._report(
+                    "ct-leak-telemetry",
+                    self.key,
+                    node,
+                    "secret interpolated into an f-string — formatted "
+                    "text flows to logs/errors/operator surfaces",
+                )
+                return SECRET
+            # rendering a CARRIER goes through its (redacting)
+            # __repr__ — the dataclass-repr rule polices that shape
+            return CLEAN
+        if isinstance(node, ast.FormattedValue):
+            return self.expr(node.value)
+        if isinstance(node, ast.Lambda):
+            return CLEAN
+        if isinstance(node, ast.Slice):
+            return max(
+                self.expr(node.lower),
+                self.expr(node.upper),
+                self.expr(node.step),
+            )
+        if isinstance(node, ast.NamedExpr):
+            kind = self.expr(node.value)
+            self._assign_target(node.target, kind)
+            return kind
+        kinds = [
+            self.expr(c)
+            for c in ast.iter_child_nodes(node)
+            if isinstance(c, ast.expr)
+        ]
+        return max(kinds) if kinds else CLEAN
+
+    def _attribute(self, node: ast.Attribute) -> int:
+        if node.attr in self.eng.cat.secret_attr_names:
+            # an annotation-declared key field (FilePVKey.priv_key):
+            # the read yields the key *object*
+            self.expr(node.value)
+            return CARRIER
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self._secret_attrs()
+        ):
+            return SECRET
+        base = self.expr(node.value)
+        if base == CLEAN:
+            return CLEAN
+        if PUBLIC_ATTR_RE.search(node.attr):
+            # reading a public-named field off a secret carrier:
+            # priv.pub — the derivation boundary again
+            return CLEAN
+        if base == CARRIER:
+            # a key object's non-public fields: raw-material names
+            # re-enter SECRET; anything else (heights, timestamps,
+            # flags riding on the same record) reads CLEAN
+            if node.attr in self.eng.cat.raw_attr_union():
+                return SECRET
+            return CLEAN
+        return base
+
+    def _compare(self, node: ast.Compare) -> int:
+        kinds = [self.expr(node.left)]
+        kinds.extend(self.expr(c) for c in node.comparators)
+        top = max(kinds)
+        if top != SECRET:
+            # carrier comparisons are object-level decisions; the
+            # byte-compare inside an equals() body is analyzed there
+            return CLEAN
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            # identity against None observes presence, not bytes
+            return CLEAN
+        if any(
+            isinstance(op, (ast.Eq, ast.NotEq, ast.In, ast.NotIn))
+            for op in node.ops
+        ):
+            self._report(
+                "ct-secret-compare",
+                self.key,
+                node,
+                "equality/membership on a secret — `==` short-circuits "
+                "at the first differing byte; route through "
+                "libs/ctutil.bytes_eq",
+            )
+        return SECRET
+
+    def _subscript(self, node: ast.Subscript) -> int:
+        base = self.expr(node.value)
+        idx_kind = self.expr(node.slice)
+        if idx_kind == SECRET and isinstance(node.ctx, ast.Load):
+            self._report(
+                "ct-secret-index",
+                self.key,
+                node,
+                "table lookup indexed by a secret-derived value — the "
+                "memory-access pattern leaks through cache timing; "
+                "use an arithmetic-mask scan",
+            )
+        return max(base, idx_kind)
+
+    def _comprehension(self, node) -> int:
+        for gen in node.generators:
+            iter_kind = self.expr(gen.iter)
+            if (
+                isinstance(gen.iter, ast.Call)
+                and isinstance(gen.iter.func, ast.Name)
+                and gen.iter.func.id == "range"
+                and any(self.expr(a) == SECRET for a in gen.iter.args)
+            ):
+                self._report(
+                    "ct-secret-branch",
+                    self.key,
+                    gen.iter,
+                    "comprehension bound derived from a secret",
+                )
+            self._assign_target(gen.target, iter_kind)
+            for cond in gen.ifs:
+                t = self.expr(cond)
+                self._maybe_branch_report(cond, t, "comprehension-if")
+        if isinstance(node, ast.DictComp):
+            return max(self.expr(node.key), self.expr(node.value))
+        return self.expr(node.elt)
+
+    # -- calls --
+
+    def _call(self, node: ast.Call) -> int:
+        func = node.func
+        recv_kind = CLEAN
+        attr = ""
+        if isinstance(func, ast.Attribute):
+            recv_kind = self.expr(func.value)
+            attr = func.attr
+        arg_kinds = [self.expr(a) for a in node.args]
+        kw_kinds: Dict[str, int] = {}
+        spread = CLEAN
+        for kw in node.keywords:
+            k = self.expr(kw.value)
+            if kw.arg is not None:
+                kw_kinds[kw.arg] = k
+            else:
+                spread = max(spread, k)
+        max_arg = max([CLEAN, spread] + arg_kinds + list(kw_kinds.values()))
+
+        name = func.id if isinstance(func, ast.Name) else ""
+
+        # container mutation taints the receiver
+        if (
+            attr in _CONTAINER_MUTATORS
+            and max_arg
+            and isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+        ):
+            recv_name = func.value.id
+            if recv_name in self.globals and recv_name not in self.env:
+                self._report(
+                    "ct-leak-lifetime",
+                    self.key,
+                    node,
+                    f"secret pushed into module-global container "
+                    f"`{recv_name}` — key material outliving its "
+                    "operation in process state",
+                )
+            cur = self.env.get(recv_name, CLEAN)
+            if max_arg > cur:
+                self.env[recv_name] = max_arg
+
+        # declassification + structural builtins
+        if name in _STRUCTURAL_BUILTINS:
+            return CLEAN
+        if name in ("bytes_eq", "compare_digest") or attr in (
+            "bytes_eq",
+            "compare_digest",
+        ):
+            # the constant-structure comparators: their boolean is
+            # public by contract
+            return CLEAN
+        if name == "pow":
+            if len(node.args) == 2 and arg_kinds[1] == SECRET:
+                self._report(
+                    "ct-vartime-pow",
+                    self.key,
+                    node,
+                    "two-arg pow() with a secret exponent — "
+                    "value-dependent bignum work; the modular 3-arg "
+                    "form is the sanctioned inverse/exponent path",
+                )
+            return max_arg
+        if name in ("repr", "ascii"):
+            if max_arg == SECRET:
+                self._report(
+                    "ct-leak-telemetry",
+                    self.key,
+                    node,
+                    "repr() of a secret — renders key bytes into text",
+                )
+            return CLEAN
+        if name == "print":
+            if max_arg == SECRET:
+                self._report(
+                    "ct-leak-telemetry",
+                    self.key,
+                    node,
+                    "secret printed to an operator surface",
+                )
+            return CLEAN
+        if name == "format" or attr == "format":
+            if max_arg == SECRET or recv_kind == SECRET:
+                self._report(
+                    "ct-leak-telemetry",
+                    self.key,
+                    node,
+                    "secret passed through str.format — formatted text "
+                    "flows to logs/errors/operator surfaces",
+                )
+            return CLEAN
+
+        # logging methods: exfiltration regardless of receiver identity
+        if attr in _LOG_METHODS and max_arg == SECRET:
+            self._report(
+                "ct-leak-telemetry",
+                self.key,
+                node,
+                f"secret argument to `.{attr}()` — a logging call; "
+                "key material must never reach the log plane",
+            )
+
+        # entropy birth: urandom in the crypto/privval planes mints
+        # key material and signing nonces
+        if attr == "urandom" or name == "urandom":
+            return SECRET if self.in_crypto_plane else CLEAN
+
+        site = self.sites.get((node.lineno, node.col_offset))
+        if site is not None and site.target is not None:
+            return self._internal_call(node, site, arg_kinds, kw_kinds,
+                                       recv_kind, max_arg)
+
+        # unresolved method call on a tainted receiver: declassified by
+        # name; a raw-extraction name on a carrier re-enters SECRET;
+        # `.hex()`/`.to_bytes()` on raw material keep secrecy
+        if attr:
+            if attr in _DECLASS_METHODS or attr.startswith(
+                _DECLASS_PREFIXES
+            ):
+                return CLEAN
+            if recv_kind == CARRIER:
+                base = (
+                    SECRET if attr in _CARRIER_RAW_METHODS else CARRIER
+                )
+                return max(base, max_arg)
+            return max(recv_kind, max_arg)
+        return max_arg
+
+    def _internal_call(
+        self, node, site, arg_kinds, kw_kinds, recv_kind, max_arg
+    ) -> int:
+        target: FuncKey = site.target
+        callee = self.eng.pkg.functions.get(target)
+        method = target[1].split(".")[-1]
+
+        # sinks on the resolved target's home module
+        if max(max_arg, recv_kind) and target[0].endswith(
+            _LIFETIME_SUFFIXES
+        ):
+            self._report(
+                "ct-leak-lifetime",
+                self.key,
+                node,
+                f"secret argument into {target[1]} "
+                "(crypto/sigcache.py) — cache keys must be derived "
+                "from public data only (pubkey, sign_bytes, "
+                "signature)",
+            )
+        elif max_arg == SECRET and target[0].endswith(
+            _TELEMETRY_SUFFIXES
+        ):
+            self._report(
+                "ct-leak-telemetry",
+                self.key,
+                node,
+                f"secret argument into {target[1]} — the telemetry "
+                "plane (log/metrics/trace/profiler) is an operator "
+                "surface",
+            )
+
+        if callee is None:
+            return max(recv_kind, max_arg)
+
+        declass = (
+            method in _DECLASS_METHODS
+            or method.startswith(_DECLASS_PREFIXES)
+            or target in _PUBLICATION_TARGETS
+        )
+
+        if self.report_mode and not declass and target != self.key:
+            # taint only flows through non-published interfaces, and
+            # only from the concrete pass (the hypothetical passes
+            # must not poison callee summaries)
+            taints: Dict[str, int] = {}
+            args = callee.node.args
+            positional = [a.arg for a in args.posonlyargs + args.args]
+            params = positional + [a.arg for a in args.kwonlyargs]
+            pos = list(positional)
+            if pos and pos[0] in ("self", "cls"):
+                pos = pos[1:]
+            for i, kind in enumerate(arg_kinds):
+                if kind and i < len(pos):
+                    taints[pos[i]] = max(taints.get(pos[i], CLEAN), kind)
+            for kname, kind in kw_kinds.items():
+                if kind and kname in params:
+                    taints[kname] = max(taints.get(kname, CLEAN), kind)
+            self.eng._flow_into(self.key, target, taints, node.lineno)
+
+        cls_name = target[1].split(".")[0] if "." in target[1] else ""
+        if method == "__init__":
+            if self.eng.cat.is_privkey_class(cls_name):
+                # constructing a key object yields a carrier even from
+                # clean args (the instance is key material either way)
+                return CARRIER
+            if self.eng.cat.is_pubkey_class(cls_name):
+                # a PubKey object is published output — the derivation
+                # boundary already declassified what went into it
+                return CLEAN
+            return max(recv_kind, max_arg)
+        if target in self.eng.cat.secret_return_keys:
+            return CARRIER
+        if declass:
+            return CLEAN
+        return self.eng._state(target).call_ret(max_arg)

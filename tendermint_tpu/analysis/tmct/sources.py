@@ -1,0 +1,264 @@
+"""Secret-source derivation for tmct — machine-derived, never hand-listed.
+
+What counts as a secret is read off the package itself, the same
+golden-source discipline as tmsafe's entry families:
+
+- **PrivKey subclasses** (transitive closure over base-class names
+  rooted at `crypto.keys.PrivKey`): every instance attribute the class
+  assigns whose name does not read as public (`_pub*`, `pub*`,
+  `addr*`, `*path`, `*type*`, `*name*`) is key material, and every
+  non-self parameter of `__init__` is the raw key bytes entering it.
+- **Secret-typed annotations**: any attribute or parameter annotated
+  with a PrivKey type anywhere in the package (FilePVKey.priv_key) is
+  a secret *carrier* — method calls on it are declassified by name
+  (`sign`, `pub_key`, `address`, ...), everything else stays secret.
+- **Secret-returning functions**: a return annotation naming a
+  PrivKey type (factories like keys.generate_priv_key) marks the
+  call's result secret at every call site.
+- **Entropy births**: `os.urandom` inside crypto/ and privval/
+  modules mints key material and signing nonces (sr25519's merlin
+  witness, secp256k1 keygen). Outside those planes urandom feeds
+  request IDs and jitter — not in scope.
+
+Signing nonces and expanded-key intermediates (RFC 6979 state, the
+sr25519 witness scalar, `_expand_seed`'s clamped `a`) need no special
+listing: they are *derived* from the seeds above and the engine's
+propagation reaches them interprocedurally.
+
+The one AST-invisible sink lives here too: a `@dataclass` whose
+secret-typed field lacks `repr=False` gets a generated __repr__ that
+embeds the secret — reported as ct-leak-telemetry at the field's line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from ..tmcheck.callgraph import Package
+
+__all__ = ["SecretCatalog", "derive_catalog", "PUBLIC_ATTR_RE"]
+
+FuncKey = Tuple[str, str]
+ClassKey = Tuple[str, str]  # (path, class name)
+
+# attribute names that hold public material even on a PrivKey subclass
+PUBLIC_ATTR_RE = re.compile(
+    r"^_{0,2}(pub|addr)|path$|type|name$", re.IGNORECASE
+)
+
+# the hierarchy root every key class derives from
+_ROOT_CLASS = "PrivKey"
+
+
+class SecretCatalog:
+    """Everything the engine treats as a secret seed, plus the findings
+    only a class-shape scan (not dataflow) can produce."""
+
+    def __init__(self) -> None:
+        # PrivKey + all transitive subclasses, by leaf name
+        self.privkey_class_names: Set[str] = set()
+        # PubKey + subclasses: the *public* plane — everything stored
+        # in one is published output (derivation declassifies), so
+        # dynamic secret-attr growth never applies to them
+        self.pubkey_class_names: Set[str] = set()
+        # (path, class) -> secret instance-attribute names
+        self.class_secret_attrs: Dict[ClassKey, Set[str]] = {}
+        # attribute names annotated with a PrivKey type anywhere
+        self.secret_attr_names: Set[str] = set()
+        # function keys whose return annotation names a PrivKey type
+        self.secret_return_keys: Set[FuncKey] = set()
+        # raw-material params (PrivKey-subclass __init__ args: the key
+        # bytes themselves): key -> param names, seeded SECRET
+        self.seed_params: Dict[FuncKey, Set[str]] = {}
+        # PrivKey-typed params package-wide (key *objects*): seeded
+        # CARRIER — method calls on them declassify by name, their raw
+        # fields re-enter SECRET
+        self.carrier_params: Dict[FuncKey, Set[str]] = {}
+        # dataclass fields leaking through a generated __repr__:
+        # (path, lineno, col, detail)
+        self.repr_leaks: List[Tuple[str, int, int, str]] = []
+
+    def is_privkey_class(self, name: str) -> bool:
+        return name.split(".")[-1] in self.privkey_class_names
+
+    def is_pubkey_class(self, name: str) -> bool:
+        return name.split(".")[-1] in self.pubkey_class_names
+
+    def raw_attr_union(self) -> Set[str]:
+        """Every raw-material attribute name across key classes —
+        reading one of these off a CARRIER re-enters SECRET."""
+        out: Set[str] = set()
+        for attrs in self.class_secret_attrs.values():
+            out |= attrs
+        return out
+
+
+def _leaf(name: str) -> str:
+    return name.split(".")[-1]
+
+
+def _ann_names(ann) -> Set[str]:
+    """Leaf identifiers in an annotation, including string annotations
+    ('PrivKeySecp256k1') and Optional/quoted forms."""
+    out: Set[str] = set()
+    if ann is None:
+        return out
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        for tok in re.findall(r"[A-Za-z_][A-Za-z0-9_]*", ann.value):
+            out.add(tok)
+        return out
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for tok in re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value):
+                out.add(tok)
+    return out
+
+
+def _is_dataclass(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = ""
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        if name == "dataclass":
+            return True
+    return False
+
+
+def _field_suppresses_repr(value) -> bool:
+    """True iff the field default is `field(..., repr=False)`."""
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+    if name != "field":
+        return False
+    for kw in value.keywords:
+        if (
+            kw.arg == "repr"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+        ):
+            return True
+    return False
+
+
+def derive_catalog(pkg: Package) -> SecretCatalog:
+    cat = SecretCatalog()
+
+    # -- transitive PrivKey subclass closure over base-name edges --
+    class_bases: Dict[str, Set[str]] = {}
+    for mod in pkg.modules.values():
+        for cname, rec in mod.classes.items():
+            class_bases.setdefault(cname, set()).update(
+                _leaf(b) for b in rec["bases"]
+            )
+    def closure(root: str) -> Set[str]:
+        out = {root}
+        grew = True
+        while grew:
+            grew = False
+            for cname, bases in class_bases.items():
+                if cname not in out and bases & out:
+                    out.add(cname)
+                    grew = True
+        return out
+
+    names = closure(_ROOT_CLASS)
+    cat.privkey_class_names = names
+    cat.pubkey_class_names = closure("PubKey")
+
+    for path, mod in pkg.modules.items():
+        for cname, rec in mod.classes.items():
+            node: ast.ClassDef = rec["node"]
+
+            # -- annotation-derived carriers (any class) --
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                    item.target, ast.Name
+                ):
+                    if _ann_names(item.annotation) & names:
+                        cat.secret_attr_names.add(item.target.id)
+                        if _is_dataclass(node) and not (
+                            _field_suppresses_repr(item.value)
+                        ):
+                            cat.repr_leaks.append(
+                                (
+                                    path,
+                                    item.lineno,
+                                    item.col_offset,
+                                    f"dataclass {cname}.{item.target.id} "
+                                    "is a secret-typed field without "
+                                    "field(repr=False): the generated "
+                                    "__repr__ embeds key material in "
+                                    "any log/debug/assert rendering",
+                                )
+                            )
+
+            if cname not in names:
+                continue
+
+            # -- PrivKey subclass: secret attrs + ctor params --
+            key: ClassKey = (path, cname)
+            attrs: Set[str] = set()
+            for item in ast.walk(node):
+                if isinstance(item, ast.Assign):
+                    for tgt in item.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and not PUBLIC_ATTR_RE.search(tgt.attr)
+                        ):
+                            attrs.add(tgt.attr)
+            for slot_src in node.body:
+                if (
+                    isinstance(slot_src, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "__slots__"
+                        for t in slot_src.targets
+                    )
+                    and isinstance(slot_src.value, (ast.Tuple, ast.List))
+                ):
+                    for elt in slot_src.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            if not PUBLIC_ATTR_RE.search(elt.value):
+                                attrs.add(elt.value)
+            if attrs:
+                cat.class_secret_attrs[key] = attrs
+
+            init_key = (path, f"{cname}.__init__")
+            fi = pkg.functions.get(init_key)
+            if fi is not None:
+                args = fi.node.args
+                params = {
+                    a.arg
+                    for a in args.posonlyargs + args.args + args.kwonlyargs
+                    if a.arg not in ("self", "cls")
+                }
+                if params:
+                    cat.seed_params[init_key] = params
+
+    # -- secret-typed params and returns, package-wide --
+    for fkey, fi in pkg.functions.items():
+        args = fi.node.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if a.annotation is not None and (
+                _ann_names(a.annotation) & names
+            ):
+                cat.carrier_params.setdefault(fkey, set()).add(a.arg)
+        ret_ann = getattr(fi.node, "returns", None)
+        if ret_ann is not None and _ann_names(ret_ann) & names:
+            cat.secret_return_keys.add(fkey)
+
+    return cat

@@ -146,6 +146,10 @@ def _keys_and_genesis(n: int, power: int, chain_id: str):
             ],
         )
         cached = (privs, genesis)
+        # tmct: ct-ok — deterministic model-checker fixture keys
+        # (seeds are the literal bytes([i+1])*32 above), cached so
+        # thousands of explored schedules share one keygen; they are
+        # not operational key material
         _KEYGEN_CACHE[(n, power, chain_id)] = cached
     return cached
 

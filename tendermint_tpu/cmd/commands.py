@@ -200,6 +200,9 @@ def cmd_gen_validator(args) -> int:
         "pub_key": {"type": key_type, "value": priv.pub_key().bytes().hex()},
         "priv_key": {"type": key_type, "value": priv.bytes().hex()},
     }
+    # tmct: ct-ok — gen_validator's documented contract IS emitting the
+    # fresh private key JSON on stdout for the operator to install
+    # (reference: commands/gen_validator.go prints priv_validator JSON)
     print(json.dumps(out, indent=2))
     return 0
 

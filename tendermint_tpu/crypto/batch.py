@@ -227,6 +227,9 @@ def _register_defaults() -> None:
         register_cpu_factory(SR, Sr25519BatchVerifier)
     except ImportError:  # sr25519 backend optional
         pass
+    from .secp256k1 import KEY_TYPE as SECP, Secp256k1BatchVerifier
+
+    register_cpu_factory(SECP, Secp256k1BatchVerifier)
 
 
 _register_defaults()

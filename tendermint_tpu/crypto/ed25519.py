@@ -126,7 +126,7 @@ class PrivKeyEd25519(PrivKey):
             )
         else:
             a, _prefix = _expand_seed(self._seed)
-            self._pub = ed25519_math.compress(ed25519_math.mul_base(a))
+            self._pub = ed25519_math.compress(ed25519_math.mul_base_ct(a))
 
     @classmethod
     def generate(cls) -> "PrivKeyEd25519":
@@ -149,7 +149,7 @@ class PrivKeyEd25519(PrivKey):
             int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little")
             % ed25519_math.L
         )
-        R = ed25519_math.compress(ed25519_math.mul_base(r))
+        R = ed25519_math.compress(ed25519_math.mul_base_ct(r))
         k = ed25519_math.sha512_mod_l(R, self._pub, msg)
         s = (r + k * a) % ed25519_math.L
         return R + s.to_bytes(32, "little")
@@ -265,6 +265,8 @@ def _call_verify_full(fn, items) -> bool:
         pos += len(msg)
     offs[n] = pos
     rc = fn(pk_b, sig_b, b"".join(chunks), offs, _os.urandom(16 * n), n)
+    # tmct: ct-ok — rc is the batch verifier's public verdict; the
+    # urandom argument is the RLC randomizer coin, not key material
     return rc == 1
 
 

@@ -34,6 +34,7 @@ __all__ = [
     "point_double",
     "scalar_mult",
     "mul_base",
+    "mul_base_ct",
     "zip215_verify",
     "sha512_mod_l",
 ]
@@ -150,11 +151,8 @@ B_POINT: Point = (_B_X, _B_Y, 1, _B_X * _B_Y % P)
 _BASE_COMB: list | None = None
 
 
-def mul_base(k: int) -> Point:
-    """k*B for any k: reduced mod L up front (B has order L, so the
-    product is identical and the 64-window comb always covers it)."""
+def _base_comb() -> list:
     global _BASE_COMB
-    k %= L
     if _BASE_COMB is None:
         tbl = []
         base = B_POINT
@@ -165,14 +163,58 @@ def mul_base(k: int) -> Point:
             tbl.append(row)
             base = point_add(row[15], base)  # base * 16
         _BASE_COMB = tbl
+    return _BASE_COMB
+
+
+def mul_base(k: int) -> Point:
+    """k*B for any k: reduced mod L up front (B has order L, so the
+    product is identical and the 64-window comb always covers it).
+
+    PUBLIC-scalar path only (verification): the loop bound and the
+    window branch depend on k. Secret scalars — signing nonces,
+    expanded keys — go through mul_base_ct (the tmct gate pins the
+    split)."""
+    tbl = _base_comb()
+    k %= L
     q = IDENTITY
     w = 0
     while k:
         d = k & 15
         if d:
-            q = point_add(q, _BASE_COMB[w][d])
+            q = point_add(q, tbl[w][d])
         k >>= 4
         w += 1
+    return q
+
+
+def _comb_select(row: list, d: int) -> Point:
+    """Constant-structure row lookup: scan all 16 entries, keep the
+    match via an arithmetic mask — `((j ^ d) - 1) >> 4` is -1 exactly
+    when j == d, else 0. No comparison or subscript on the secret."""
+    x = y = z = t = 0
+    for j in range(16):
+        mask = ((j ^ d) - 1) >> 4
+        ex, ey, ez, et = row[j]
+        x |= ex & mask
+        y |= ey & mask
+        z |= ez & mask
+        t |= et & mask
+    return x, y, z, t
+
+
+def mul_base_ct(k: int) -> Point:
+    """k*B with a fixed execution structure for SECRET scalars: all 64
+    comb windows are walked, every window does one masked row scan and
+    one unified addition (add-2008-hwcd-3 is identity-safe on the
+    prime-order subgroup), so neither the trace shape nor the table
+    access order is a function of k's bits. Pure Python cannot be
+    cycle-constant; the contract is structural (docs/static_analysis.md
+    tmct: structure-not-cycles)."""
+    tbl = _base_comb()
+    k %= L
+    q = IDENTITY
+    for w in range(64):
+        q = point_add(q, _comb_select(tbl[w], (k >> (4 * w)) & 15))
     return q
 
 

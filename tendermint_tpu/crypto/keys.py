@@ -79,6 +79,11 @@ class PrivKey(ABC):
     @abstractmethod
     def type(self) -> str: ...
 
+    def __repr__(self) -> str:
+        # never render key material: reprs reach logs, tracebacks, and
+        # debugger output (tmct ct-leak-telemetry lifetime contract)
+        return f"<{type(self).__name__} redacted>"
+
 
 class BatchVerifier(ABC):
     """Accumulate (pk, msg, sig) triples, verify all at once.

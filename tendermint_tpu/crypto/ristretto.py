@@ -23,6 +23,7 @@ __all__ = [
     "eq",
     "BASE",
     "mul_base",
+    "mul_base_ct",
     "add",
     "scalar_mult",
     "L",
@@ -138,3 +139,9 @@ def scalar_mult(k: int, p: Point) -> Point:
 
 def mul_base(k: int) -> Point:
     return em.mul_base(k % L)
+
+
+def mul_base_ct(k: int) -> Point:
+    """Secret-scalar basepoint multiply: fixed comb structure, masked
+    table scan (see ed25519_math.mul_base_ct — the tmct contract)."""
+    return em.mul_base_ct(k % L)

@@ -61,7 +61,9 @@ def _basemul_encode(k: int) -> bytes:
     out = native.ristretto_basemul(int(k).to_bytes(32, "little"))
     if out is not None:
         return out
-    return rst.encode(rst.mul_base(k))
+    # every _basemul_encode caller passes a secret scalar (expanded
+    # key in keygen, merlin witness nonce in sign) — CT comb only
+    return rst.encode(rst.mul_base_ct(k))
 
 
 def _signing_transcript(msg: bytes) -> Transcript:
@@ -154,9 +156,12 @@ def _native_verify_one(
     rc = lib.tm_sr25519_verify_full(
         pk_bytes, sig, msg, offs, os.urandom(16), 1
     )
-    if rc == 1:
+    # rc is the verifier's public accept/reject verdict; the urandom
+    # argument is the batch equation's public randomizer coin (RLC
+    # soundness), not key material
+    if rc == 1:  # tmct: ct-ok — public verdict of a public-input verify
         return True
-    if rc == 0:
+    if rc == 0:  # tmct: ct-ok — public verdict of a public-input verify
         return False
     return None  # undecodable encoding or alloc failure: oracle decides
 

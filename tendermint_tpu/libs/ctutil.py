@@ -1,0 +1,29 @@
+"""Constant-time comparison helpers for secret material.
+
+A plain `==` on bytes short-circuits at the first mismatching byte —
+the comparison's duration is a function of the secret prefix it
+matched. Anywhere a secret (key bytes, nonces, MACs) is compared, the
+tmct gate (scripts/lint.py --ct, rule ct-secret-compare) requires the
+comparison to route through here instead.
+
+Pure Python cannot promise cycle-constancy; what `bytes_eq` promises
+is *structure*: the CPython primitive `hmac.compare_digest` scans the
+full length of both operands regardless of where they differ, so the
+data-dependent short-circuit — the part a remote timing adversary can
+integrate over many probes — is gone (docs/static_analysis.md, "why
+Python constant-time means structure, not cycles").
+"""
+
+from __future__ import annotations
+
+import hmac as _hmac
+
+__all__ = ["bytes_eq"]
+
+
+def bytes_eq(a: bytes, b: bytes) -> bool:
+    """Compare two byte strings without a secret-dependent
+    short-circuit. The boolean result is public by contract — callers
+    branch on it freely (the *decision* is published behavior; the
+    *path to it* is what must not leak)."""
+    return _hmac.compare_digest(bytes(a), bytes(b))

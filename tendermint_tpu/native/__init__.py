@@ -215,6 +215,9 @@ def ristretto_basemul(scalar_le32: bytes) -> Optional[bytes]:
     if lib is None:
         return None
     out = ctypes.create_string_buffer(32)
+    # tmct: ct-ok — FFI status code only: the native basemul is a
+    # fixed-window constant-structure ladder, and rc reflects library
+    # availability/buffer validity, never scalar bits
     if lib.tm_ristretto_basemul(scalar_le32, out) != 0:
         return None
     return out.raw

@@ -10,7 +10,7 @@ from __future__ import annotations
 import base64
 import json
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from ..crypto.ed25519 import PrivKeyEd25519
 from ..libs.osutil import atomic_write
@@ -21,7 +21,9 @@ __all__ = ["NodeKey"]
 
 @dataclass
 class NodeKey:
-    priv_key: PrivKeyEd25519
+    # repr=False: the generated __repr__ must never embed key material
+    # (tmct ct-leak-telemetry — logs render reprs)
+    priv_key: PrivKeyEd25519 = field(repr=False)
 
     @property
     def node_id(self) -> NodeID:

@@ -97,7 +97,11 @@ class FilePVKey:
 
     address: bytes
     pub_key: PubKey
-    priv_key: PrivKey
+    # repr=False: the generated __repr__ must never embed key material
+    # (tmct ct-leak-telemetry — logs and crash reports render reprs);
+    # PrivKey.__repr__ additionally redacts itself, this keeps the key
+    # object out of the record's rendering entirely
+    priv_key: PrivKey = field(repr=False)
     file_path: str = ""
 
     def save(self) -> None:

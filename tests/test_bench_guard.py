@@ -312,6 +312,46 @@ print("OK")
     assert "OK" in r.stdout
 
 
+def test_tmct_gate_row_never_initializes_jax():
+    """Same contract for the ISSUE-20 tmct_gate row: banked CPU
+    block, pure stdlib AST over the crypto plane, jax must never
+    load — and the row reads the gate's own stats (per-rule findings,
+    suppressions, the machine-derived source-catalog sizes) so it can
+    never diverge from `scripts/lint.py --ct`."""
+    script = """
+import sys
+sys.path.insert(0, %r)
+import bench
+row = bench.bench_tmct_gate()
+assert row["wall_s"] > 0 and "findings" in row and "suppressed" in row
+assert set(row["findings"]) == {
+    "ct-secret-branch", "ct-secret-index", "ct-secret-compare",
+    "ct-vartime-pow", "ct-leak-telemetry", "ct-leak-lifetime",
+}
+assert sum(row["findings"].values()) == 0, "head crypto plane is red"
+assert row["privkey_classes"] >= 4 and row["secret_attrs"] >= 1
+assert "jax" not in sys.modules, "tmct_gate dragged jax in"
+# the secp commit rows ride the same banked CPU block: the
+# pure-Python backend must never drag jax in either (small n so the
+# guard stays cheap; the banked BENCH_SECP.json comes from full runs)
+p50, p95 = bench.bench_commit_latency(
+    12, reps=2, light=False, use_device=False, key_type="secp256k1"
+)
+assert p50 > 0 and p95 >= p50
+assert "jax" not in sys.modules, "secp commit row dragged jax in"
+print("OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": ""},
+    )
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "OK" in r.stdout
+
+
 def test_tmmc_gate_row_never_initializes_jax():
     """Same contract for the ISSUE-19 tmmc_gate row: the model
     harness drives the REAL consensus implementation with in-memory

@@ -162,15 +162,16 @@ def test_batch_dispatch():
     assert batch.supports_batch_verifier(sk.pub_key())
     bv = batch.create_batch_verifier(sk.pub_key(), size_hint=4)
     assert isinstance(bv, Ed25519BatchVerifier)
-    pytest.importorskip("cryptography")  # secp256k1 is gated on the wheel
+    # secp256k1 batches first-class through the native backend now
+    # (the PR-1 wheel-gated shim raised here)
     sk2 = PrivKeySecp256k1.generate()
-    assert not batch.supports_batch_verifier(sk2.pub_key())
-    with pytest.raises(ValueError):
-        batch.create_batch_verifier(sk2.pub_key())
+    assert batch.supports_batch_verifier(sk2.pub_key())
+    bv2 = batch.create_batch_verifier(sk2.pub_key(), size_hint=4)
+    ok_empty, bits_empty = bv2.verify()
+    assert (ok_empty, bits_empty) == (False, [])
 
 
 def test_secp256k1_roundtrip():
-    pytest.importorskip("cryptography")  # secp256k1 is gated on the wheel
     sk = PrivKeySecp256k1.generate()
     pk = sk.pub_key()
     assert len(pk.bytes()) == 33
@@ -188,7 +189,6 @@ def test_secp256k1_roundtrip():
 
 
 def test_pubkey_proto_roundtrip():
-    pytest.importorskip("cryptography")  # secp256k1 is gated on the wheel
     for sk in (PrivKeyEd25519.generate(), PrivKeySecp256k1.generate()):
         pk = sk.pub_key()
         enc = pubkey_to_proto(pk)

@@ -274,7 +274,6 @@ def test_secp256k1_file_pv_round_trip(tmp_path):
     """reference privval/file.go:188 GenFilePV supports secp256k1;
     generate, sign a vote, persist, reload, and verify the signature
     with the reloaded public key."""
-    pytest.importorskip("cryptography")  # secp256k1 is gated on the wheel
     from tendermint_tpu.privval.file import FilePV
     from tendermint_tpu.types.block_id import BlockID, PartSetHeader
     from tendermint_tpu.types.vote import Vote, PRECOMMIT_TYPE
@@ -307,3 +306,52 @@ def test_secp256k1_file_pv_round_trip(tmp_path):
     # unsupported types still rejected
     with pytest.raises(ValueError):
         FilePV.generate(str(tmp_path / "x"), str(tmp_path / "y"), "sr25519x")
+
+
+# -- secret redaction (tmct ct-leak-telemetry lifetime contract) --
+
+
+def test_repr_never_renders_key_material(pv, tmp_path):
+    """reprs reach logs, tracebacks, assertion messages, and debugger
+    output. The PrivKey base redacts itself, FilePVKey/NodeKey exclude
+    the field from their generated __repr__ — none of the renderings
+    may contain the seed or its hex."""
+    from tendermint_tpu.node.key import NodeKey
+
+    priv = pv.key.priv_key
+    raw = priv.bytes()
+    needles = (raw.hex(), raw.hex().upper(), repr(raw))
+    for rendering in (
+        repr(priv),
+        str(priv),
+        repr(pv.key),
+        f"{pv.key}",
+        repr(NodeKey(priv_key=PrivKeyEd25519.generate())),
+    ):
+        for needle in needles:
+            assert needle not in rendering
+    assert "redacted" in repr(priv)
+    # the PUBLIC half still renders usefully
+    assert pv.key.pub_key.bytes().hex()[:16] in repr(pv.key.pub_key)
+
+
+def test_repr_redaction_covers_every_key_class(tmp_path):
+    from tendermint_tpu.crypto.keys import generate_priv_key
+
+    for key_type in ("ed25519", "secp256k1"):
+        sk = generate_priv_key(key_type)
+        assert sk.bytes().hex() not in repr(sk)
+        assert "redacted" in repr(sk)
+
+
+def test_double_sign_refusal_error_has_no_key_material(pv):
+    """The HRS-regression ValueError text reaches logs and RPC error
+    surfaces — it must name heights and steps, never the key."""
+    vote = make_vote(height=5, round_=1)
+    run(pv.sign_vote("chain", vote))
+    stale = make_vote(height=4, round_=0)
+    with pytest.raises(ValueError) as exc_info:
+        run(pv.sign_vote("chain", stale))
+    text = str(exc_info.value)
+    assert "height regression" in text
+    assert pv.key.priv_key.bytes().hex() not in text

@@ -1,0 +1,353 @@
+"""The native secp256k1 backend, pinned against external vectors.
+
+Four layers: (1) RFC 6979 deterministic nonces and full signatures
+against the community-standard secp256k1+SHA-256 vector set (the
+Trezor/bitcointalk corpus — RFC 6979's own appendix covers only the
+NIST curves); (2) Wycheproof-class edge cases, ported by construction
+rather than by hex blob: zero/overflow scalars, high-s malleability,
+malformed encodings, off-curve and invalid-prefix pubkeys; (3) the
+property pin the batch plugin depends on — verify_batch's accept/
+reject is byte-identical to the single-verify loop over any mixed
+batch; (4) the BatchVerifier plugin contract (one-shot drain, exact
+bitmap, type discipline) and the crypto.keys first-class dispatch the
+PR-1 shim used to raise on.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from tendermint_tpu.crypto import batch
+from tendermint_tpu.crypto.keys import (
+    generate_priv_key,
+    privkey_from_type_and_bytes,
+    pubkey_from_proto,
+    pubkey_from_type_and_bytes,
+    pubkey_to_proto,
+)
+from tendermint_tpu.crypto.secp256k1 import (
+    _HALF_ORDER,
+    _ORDER,
+    _P,
+    _decompress,
+    _rfc6979_k,
+    PrivKeySecp256k1,
+    PubKeySecp256k1,
+    Secp256k1BatchVerifier,
+    verify_batch,
+)
+
+# ---------------------------------------------------------------------------
+# RFC 6979 deterministic nonces + full signatures (external vectors)
+
+# (privkey scalar, message, expected k) — the secp256k1+SHA-256 set
+# circulated with the RFC (Trezor crypto tests / bitcointalk vectors).
+_K_VECTORS = [
+    (
+        1,
+        b"Satoshi Nakamoto",
+        0x8F8A276C19F4149656B280621E358CCE24F5F52542772691EE69063B74F15D15,
+    ),
+    (
+        1,
+        b"All those moments will be lost in time, like tears in rain. "
+        b"Time to die...",
+        0x38AA22D72376B4DBC472E06C3BA403EE0A394DA63FC58D88686C611ABA98D6B3,
+    ),
+    (
+        _ORDER - 1,
+        b"Satoshi Nakamoto",
+        0x33A19B60E25FB6F4435AF53A3D42D493644827367E6453928554F43E49AA6F90,
+    ),
+    (
+        0xF8B8AF8CE3C7CCA5E300D33939540C10D45CE001B8F252BFBC57BA0342904181,
+        b"Alan Turing",
+        0x525A82B70E67874398067543FD84C83D30C175FDC45FDEEE082FE13B1D7CFDF1,
+    ),
+]
+
+# (privkey scalar, message, r hex, s hex) — full low-s signatures
+_SIG_VECTORS = [
+    (
+        1,
+        b"Satoshi Nakamoto",
+        "934b1ea10a4b3c1757e2b0c017d0b6143ce3c9a7e6a4a49860d7a6ab210ee3d8",
+        "2442ce9d2b916064108014783e923ec36b49743e2ffa1c4496f01a512aafd9e5",
+    ),
+    (
+        0xF8B8AF8CE3C7CCA5E300D33939540C10D45CE001B8F252BFBC57BA0342904181,
+        b"Alan Turing",
+        "7063ae83e7f62bbb171798131b4a0564b956930092b33b07b395615d9ec7e15c",
+        "58dfcc1e00a35e1572f366ffe34ba0fc47db1e7189759b9fb233c5b05ab388ea",
+    ),
+]
+
+
+@pytest.mark.parametrize("d,msg,expected_k", _K_VECTORS)
+def test_rfc6979_nonce_vectors(d, msg, expected_k):
+    h1 = hashlib.sha256(msg).digest()
+    assert _rfc6979_k(d.to_bytes(32, "big"), h1) == expected_k
+
+
+@pytest.mark.parametrize("d,msg,r_hex,s_hex", _SIG_VECTORS)
+def test_signature_vectors(d, msg, r_hex, s_hex):
+    sk = PrivKeySecp256k1(d.to_bytes(32, "big"))
+    sig = sk.sign(msg)
+    assert sig[:32].hex() == r_hex
+    assert sig[32:].hex() == s_hex
+    assert sk.pub_key().verify_signature(msg, sig)
+
+
+def test_sign_is_deterministic():
+    sk = PrivKeySecp256k1((7).to_bytes(32, "big"))
+    assert sk.sign(b"msg") == sk.sign(b"msg")
+    assert sk.sign(b"msg") != sk.sign(b"msg2")
+
+
+def test_sign_always_low_s():
+    for d in (1, 2, 3, 0xDEADBEEF, _ORDER - 2):
+        sk = PrivKeySecp256k1(d.to_bytes(32, "big"))
+        for i in range(4):
+            sig = sk.sign(b"low-s probe %d" % i)
+            assert int.from_bytes(sig[32:], "big") <= _HALF_ORDER
+
+
+# ---------------------------------------------------------------------------
+# Wycheproof-class edge cases (ported by construction)
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    sk = PrivKeySecp256k1(
+        0xC9AFA9D845BA75166B5C215767B1D6934E50C3DB36E89B127B8A622B120F6721
+        .to_bytes(32, "big")
+    )
+    return sk, sk.pub_key()
+
+
+def test_valid_signature_accepts(keypair):
+    sk, pk = keypair
+    msg = b"wycheproof-style base case"
+    assert pk.verify_signature(msg, sk.sign(msg))
+
+
+def test_modified_message_rejects(keypair):
+    sk, pk = keypair
+    sig = sk.sign(b"message one")
+    assert not pk.verify_signature(b"message two", sig)
+
+
+def test_zero_r_or_s_rejects(keypair):
+    _, pk = keypair
+    msg = b"zero scalar cases"
+    zero = (0).to_bytes(32, "big")
+    one = (1).to_bytes(32, "big")
+    assert not pk.verify_signature(msg, zero + one)
+    assert not pk.verify_signature(msg, one + zero)
+    assert not pk.verify_signature(msg, zero + zero)
+
+
+def test_r_or_s_at_or_above_order_rejects(keypair):
+    sk, pk = keypair
+    msg = b"overflow scalar cases"
+    sig = sk.sign(msg)
+    n = _ORDER.to_bytes(32, "big")
+    big = (_ORDER + 1).to_bytes(32, "big")
+    ff = b"\xff" * 32
+    assert not pk.verify_signature(msg, n + sig[32:])
+    assert not pk.verify_signature(msg, big + sig[32:])
+    assert not pk.verify_signature(msg, ff + sig[32:])
+    assert not pk.verify_signature(msg, sig[:32] + n)
+    assert not pk.verify_signature(msg, sig[:32] + ff)
+
+
+def test_high_s_malleated_twin_rejects(keypair):
+    """The reference requires normalized s (secp256k1.go Verify): the
+    algebraically-valid (r, N-s) twin must NOT verify — consensus
+    signatures cannot be malleable."""
+    sk, pk = keypair
+    msg = b"malleability case"
+    sig = sk.sign(msg)
+    s = int.from_bytes(sig[32:], "big")
+    twin = sig[:32] + (_ORDER - s).to_bytes(32, "big")
+    assert not pk.verify_signature(msg, twin)
+
+
+def test_wrong_length_signature_rejects(keypair):
+    sk, pk = keypair
+    msg = b"length cases"
+    sig = sk.sign(msg)
+    assert not pk.verify_signature(msg, sig[:63])
+    assert not pk.verify_signature(msg, sig + b"\x00")
+    assert not pk.verify_signature(msg, b"")
+
+
+def test_garbage_signature_rejects(keypair):
+    _, pk = keypair
+    assert not pk.verify_signature(b"m", b"\x01" * 64)
+
+
+def test_decompress_rejects_bad_encodings():
+    # x with no square-root solution for y^2 = x^3 + 7
+    assert _decompress(b"\x02" + (5).to_bytes(32, "big")) is None
+    # x >= field prime
+    assert _decompress(b"\x02" + _P.to_bytes(32, "big")) is None
+    assert _decompress(b"\x02" + b"\xff" * 32) is None
+    # uncompressed / infinity prefixes are not valid compressed forms
+    assert _decompress(b"\x04" + (1).to_bytes(32, "big")) is None
+    assert _decompress(b"\x00" + (1).to_bytes(32, "big")) is None
+
+
+def test_decompress_parity_selects_y(keypair):
+    _, pk = keypair
+    x, y = _decompress(pk.bytes())
+    assert (y * y - (x * x * x + 7)) % _P == 0
+    assert (y & 1) == (pk.bytes()[0] & 1)
+    # the flipped-parity encoding is the conjugate point
+    flipped = bytes([pk.bytes()[0] ^ 1]) + pk.bytes()[1:]
+    x2, y2 = _decompress(flipped)
+    assert x2 == x and y2 == (_P - y)
+
+
+def test_off_curve_pubkey_never_verifies(keypair):
+    sk, _ = keypair
+    msg = b"off-curve pubkey"
+    sig = sk.sign(msg)
+    bad_pk = PubKeySecp256k1(b"\x02" + (5).to_bytes(32, "big"))
+    assert not bad_pk.verify_signature(msg, sig)
+
+
+def test_privkey_scalar_range_enforced():
+    with pytest.raises(ValueError):
+        PrivKeySecp256k1(b"\x00" * 32)  # d = 0
+    with pytest.raises(ValueError):
+        PrivKeySecp256k1(_ORDER.to_bytes(32, "big"))  # d = N
+    with pytest.raises(ValueError):
+        PrivKeySecp256k1(b"\x00" * 31)  # wrong length
+    PrivKeySecp256k1((_ORDER - 1).to_bytes(32, "big"))  # d = N-1 valid
+
+
+# ---------------------------------------------------------------------------
+# batch: byte-identical to the single-verify loop
+
+
+def _mixed_batch(n=24, seed=0xC0FFEE):
+    """Deterministic mixed batch: valid sigs, corrupted sigs, wrong
+    messages, high-s twins, malformed pubkeys — the verify_batch
+    equivalence domain."""
+    rng = random.Random(seed)
+    keys = [
+        PrivKeySecp256k1(rng.randrange(1, _ORDER).to_bytes(32, "big"))
+        for _ in range(6)
+    ]
+    items = []
+    for i in range(n):
+        sk = keys[i % len(keys)]
+        pk = sk.pub_key()
+        msg = b"batch item %d" % i
+        sig = sk.sign(msg)
+        kind = i % 5
+        if kind == 1:  # corrupt one signature byte
+            pos = rng.randrange(64)
+            sig = sig[:pos] + bytes([sig[pos] ^ 0x40]) + sig[pos + 1:]
+        elif kind == 2:  # signature over a different message
+            msg = b"different message %d" % i
+        elif kind == 3:  # high-s malleated twin
+            s = int.from_bytes(sig[32:], "big")
+            sig = sig[:32] + (_ORDER - s).to_bytes(32, "big")
+        elif kind == 4:  # pubkey with an off-curve x
+            pk = PubKeySecp256k1(b"\x02" + (5).to_bytes(32, "big"))
+        items.append((pk, msg, sig))
+    return items
+
+
+def test_verify_batch_matches_single_loop_exactly():
+    items = _mixed_batch()
+    ok, bits = verify_batch(items)
+    expected = [
+        PubKeySecp256k1(pk.bytes()).verify_signature(msg, sig)
+        for pk, msg, sig in items
+    ]
+    assert bits == expected
+    assert ok == all(expected)
+    assert any(expected) and not all(expected)  # the mix is a real mix
+
+
+def test_verify_batch_all_valid():
+    items = [it for it in _mixed_batch(n=25) if it[0].bytes()[0] != 0x02
+             or _decompress(it[0].bytes()) is not None]
+    valid = []
+    for i in range(8):
+        sk = PrivKeySecp256k1((i + 11).to_bytes(32, "big"))
+        msg = b"all-valid %d" % i
+        valid.append((sk.pub_key(), msg, sk.sign(msg)))
+    ok, bits = verify_batch(valid)
+    assert ok is True and bits == [True] * 8
+
+
+def test_verify_batch_empty_is_false():
+    assert verify_batch([]) == (False, [])
+
+
+def test_batch_verifier_contract():
+    """The plugin contract: exact bitmap in add() order, one-shot
+    drain — a second verify() without new add()s returns (False, []) —
+    and type/size discipline at add()."""
+    items = _mixed_batch(n=10, seed=7)
+    bv = Secp256k1BatchVerifier()
+    for pk, msg, sig in items:
+        bv.add(pk, msg, sig)
+    assert len(bv) == 10
+    ok, bits = bv.verify()
+    expected = [
+        PubKeySecp256k1(pk.bytes()).verify_signature(msg, sig)
+        for pk, msg, sig in items
+    ]
+    assert bits == expected and ok == all(expected)
+    assert bv.verify() == (False, [])  # drained
+    sk = PrivKeySecp256k1((3).to_bytes(32, "big"))
+    with pytest.raises(ValueError):
+        bv.add(sk.pub_key(), b"m", b"short")
+    from tendermint_tpu.crypto.ed25519 import PrivKeyEd25519
+
+    with pytest.raises(TypeError):
+        bv.add(PrivKeyEd25519.generate().pub_key(), b"m", b"\x00" * 64)
+
+
+def test_batch_dispatch_returns_secp_verifier():
+    """crypto.batch now serves secp256k1 first-class — the PR-1 shim's
+    'does not support batching' raise is gone."""
+    sk = PrivKeySecp256k1.generate()
+    assert batch.supports_batch_verifier(sk.pub_key())
+    bv = batch.create_batch_verifier(sk.pub_key(), size_hint=4)
+    assert isinstance(bv, Secp256k1BatchVerifier)
+
+
+# ---------------------------------------------------------------------------
+# crypto.keys first-class dispatch
+
+
+def test_keys_dispatch_no_longer_raises():
+    sk = generate_priv_key("secp256k1")
+    assert isinstance(sk, PrivKeySecp256k1)
+    assert sk.type() == "secp256k1"
+    clone = privkey_from_type_and_bytes("secp256k1", sk.bytes())
+    assert clone.pub_key().bytes() == sk.pub_key().bytes()
+    pk = pubkey_from_type_and_bytes("secp256k1", sk.pub_key().bytes())
+    assert pk == sk.pub_key()
+
+
+def test_pubkey_proto_roundtrip_secp():
+    sk = PrivKeySecp256k1((42).to_bytes(32, "big"))
+    pk = sk.pub_key()
+    assert pubkey_from_proto(pubkey_to_proto(pk)) == pk
+
+
+def test_generate_yields_working_key():
+    sk = PrivKeySecp256k1.generate()
+    assert len(sk.bytes()) == 32
+    msg = b"fresh key"
+    assert sk.pub_key().verify_signature(msg, sk.sign(msg))
+    assert len(sk.pub_key().bytes()) == 33
+    assert len(sk.pub_key().address()) == 20

@@ -642,7 +642,7 @@ def test_cli_full_run_includes_tmcheck_sections():
     r = _run_cli("--stats")
     assert r.returncode == 0, r.stdout + r.stderr
     assert (
-        "[tmlint+taint+schema+race+live+adv+cost+mc+memo+trace]" in r.stdout
+        "[tmlint+taint+schema+race+live+adv+cost+mc+ct+memo+trace]" in r.stdout
     )
     # the shared-substrate satellite: the full gate parses the package
     # once and says so in the stats line

@@ -82,10 +82,13 @@ def test_live_baseline_is_checked_in_and_empty():
 
 def test_full_package_run_under_budget(head_report):
     """Runtime budget: the live pass runs on every tier-1 invocation
-    and must stay under 10 s for the whole package (measured ~7 s,
-    call-graph build + lockset propagation included). Times the
+    and must stay bounded for the whole package (call-graph build +
+    lockset propagation included; ~7 s when pinned, ~9.7 s by PR 20 —
+    the package grew four analyzer subpackages and a native curve
+    since, so the pin is 15 s to stop sub-second scheduler noise from
+    flaking tier-1 while still catching a real blow-up). Times the
     module fixture's run rather than paying a second full analyze."""
-    assert head_report.elapsed_s < 10.0, (
+    assert head_report.elapsed_s < 15.0, (
         f"tmlive full-package run took {head_report.elapsed_s:.1f}s"
     )
 
